@@ -1,0 +1,228 @@
+"""Protocol layer tests: enums, intents, keys, msgpack codec, record roundtrip."""
+
+import msgpack as c_msgpack  # cross-check oracle only
+import pytest
+
+from zeebe_tpu.protocol import (
+    Intent,
+    KeyGenerator,
+    Record,
+    RecordType,
+    RejectionType,
+    ValueType,
+    command,
+    decode_key_in_partition,
+    decode_partition_id,
+    encode_partition_id,
+    event,
+    rejection,
+)
+from zeebe_tpu.protocol import msgpack as zp_msgpack
+from zeebe_tpu.protocol.intent import (
+    JobIntent,
+    ProcessInstanceIntent,
+)
+
+
+class TestIntents:
+    def test_every_value_type_has_intents(self):
+        for vt in ValueType:
+            if vt in (ValueType.NULL_VAL, ValueType.SBE_UNKNOWN):
+                continue
+            enum_cls = Intent.for_value_type(vt)
+            assert len(list(enum_cls)) > 0, vt
+
+    def test_event_vs_command_classification(self):
+        assert ProcessInstanceIntent.ELEMENT_ACTIVATING.is_event
+        assert not ProcessInstanceIntent.ACTIVATE_ELEMENT.is_event
+        assert JobIntent.CREATED.is_event
+        assert not JobIntent.COMPLETE.is_event
+
+    def test_event_names_resolve_to_members(self):
+        # Every name in an intent enum's event set must be an actual member.
+        for vt in ValueType:
+            if vt in (ValueType.NULL_VAL, ValueType.SBE_UNKNOWN):
+                continue
+            enum_cls = Intent.for_value_type(vt)
+            members = {m.name for m in enum_cls}
+            assert enum_cls._EVENT_NAMES <= members, vt
+
+
+class TestKeys:
+    def test_roundtrip(self):
+        key = encode_partition_id(3, 12345)
+        assert decode_partition_id(key) == 3
+        assert decode_key_in_partition(key) == 12345
+
+    def test_generator_monotonic_and_partition_scoped(self):
+        gen = KeyGenerator(partition_id=2)
+        k1, k2 = gen.next_key(), gen.next_key()
+        assert k2 > k1
+        assert decode_partition_id(k1) == 2
+
+    def test_replay_fast_forward(self):
+        gen = KeyGenerator(partition_id=1)
+        gen.set_key_if_higher(encode_partition_id(1, 100))
+        assert decode_key_in_partition(gen.next_key()) == 101
+        # keys from other partitions are ignored
+        gen.set_key_if_higher(encode_partition_id(2, 9999))
+        assert decode_key_in_partition(gen.next_key()) == 102
+
+    def test_out_of_range(self):
+        with pytest.raises(ValueError):
+            encode_partition_id(1 << 14, 1)
+
+
+MSGPACK_CASES = [
+    None,
+    True,
+    False,
+    0,
+    1,
+    127,
+    128,
+    255,
+    256,
+    65535,
+    65536,
+    2**32 - 1,
+    2**32,
+    2**63 - 1,
+    -1,
+    -32,
+    -33,
+    -128,
+    -129,
+    -32768,
+    -32769,
+    -(2**31),
+    -(2**63),
+    1.5,
+    -2.25,
+    "",
+    "hello",
+    "x" * 31,
+    "x" * 32,
+    "x" * 255,
+    "x" * 256,
+    "x" * 70000,
+    "unicode ✓ ünïcodé",
+    b"",
+    b"\x00\xff" * 10,
+    b"b" * 300,
+    [],
+    [1, "two", 3.0, None, True],
+    list(range(20)),
+    {},
+    {"a": 1, "b": [1, 2], "c": {"nested": "map"}},
+    {"k" + str(i): i for i in range(20)},
+]
+
+
+class TestMsgPack:
+    @pytest.mark.parametrize("obj", MSGPACK_CASES, ids=lambda o: repr(o)[:40])
+    def test_roundtrip(self, obj):
+        assert zp_msgpack.unpackb(zp_msgpack.packb(obj)) == obj
+
+    @pytest.mark.parametrize("obj", MSGPACK_CASES, ids=lambda o: repr(o)[:40])
+    def test_cross_decode_with_c_msgpack(self, obj):
+        # our encoder → C decoder
+        assert c_msgpack.unpackb(zp_msgpack.packb(obj), strict_map_key=False) == obj
+        # C encoder → our decoder
+        assert zp_msgpack.unpackb(c_msgpack.packb(obj)) == obj
+
+    def test_trailing_bytes_rejected(self):
+        with pytest.raises(zp_msgpack.MsgPackError):
+            zp_msgpack.unpackb(zp_msgpack.packb(1) + b"\x01")
+
+    def test_truncated_rejected(self):
+        data = zp_msgpack.packb({"key": "value" * 10})
+        with pytest.raises(zp_msgpack.MsgPackError):
+            zp_msgpack.unpackb(data[:-3])
+
+
+class TestRecord:
+    def _sample(self):
+        return command(
+            ValueType.PROCESS_INSTANCE,
+            ProcessInstanceIntent.ACTIVATE_ELEMENT,
+            {
+                "bpmnProcessId": "proc",
+                "processInstanceKey": encode_partition_id(1, 7),
+                "elementId": "task_a",
+                "version": 3,
+            },
+            key=encode_partition_id(1, 9),
+            request_stream_id=5,
+            request_id=42,
+        )
+
+    def test_roundtrip(self):
+        rec = self._sample()
+        data = rec.to_bytes()
+        back = Record.from_bytes(data, position=100, partition_id=1)
+        assert back.record_type == rec.record_type
+        assert back.value_type == rec.value_type
+        assert back.intent == rec.intent
+        assert dict(back.value) == dict(rec.value)
+        assert back.key == rec.key
+        assert back.position == 100
+        assert back.request_id == 42
+
+    def test_rejection_builder(self):
+        cmd = self._sample().replace(position=55)
+        rej = rejection(cmd, RejectionType.NOT_FOUND, "no such element")
+        assert rej.record_type == RecordType.COMMAND_REJECTION
+        assert rej.intent == cmd.intent
+        assert rej.source_record_position == 55
+        assert rej.rejection_reason == "no such element"
+        # rejections answer the original request
+        assert rej.request_id == cmd.request_id
+
+    def test_json_view(self):
+        rec = event(
+            ValueType.JOB, JobIntent.CREATED, {"type": "payment"}, key=1, position=10
+        )
+        js = rec.to_json_dict()
+        assert js["recordType"] == "EVENT"
+        assert js["valueType"] == "JOB"
+        assert js["intent"] == "CREATED"
+        assert js["value"] == {"type": "payment"}
+
+    def test_negative_defaults_roundtrip(self):
+        rec = event(ValueType.TIMER, Intent.for_value_type(ValueType.TIMER)(0), {})
+        back = Record.from_bytes(rec.to_bytes())
+        assert back.key == -1
+        assert back.source_record_position == -1
+        assert back.request_id == -1
+
+
+class TestRobustness:
+    """Regression tests for review findings: corrupt/adversarial wire input."""
+
+    def test_partition_id_overflow_rejected(self):
+        # 13-bit wire field, but ids >= 4096 would overflow signed int64 keys
+        with pytest.raises(ValueError):
+            encode_partition_id(4096, 1)
+        key = encode_partition_id(4095, 1)
+        assert key > 0 and key < 2**63
+
+    def test_msgpack_invalid_utf8_raises_msgpack_error(self):
+        with pytest.raises(zp_msgpack.MsgPackError):
+            zp_msgpack.unpackb(b"\xa2\xff\xff")
+
+    def test_msgpack_unhashable_map_key_raises_msgpack_error(self):
+        with pytest.raises(zp_msgpack.MsgPackError):
+            zp_msgpack.unpackb(b"\x81\x90\x01")
+
+    def test_record_trailing_garbage_rejected(self):
+        rec = event(ValueType.JOB, JobIntent.CREATED, {"type": "x"})
+        with pytest.raises(ValueError):
+            Record.from_bytes(rec.to_bytes() + b"GARBAGE")
+
+    def test_record_unknown_value_type_raises_value_error(self):
+        rec = event(ValueType.JOB, JobIntent.CREATED, {"type": "x"})
+        data = bytearray(rec.to_bytes())
+        data[1] = 255  # SBE_UNKNOWN
+        with pytest.raises(ValueError):
+            Record.from_bytes(bytes(data))

@@ -1,0 +1,25 @@
+"""zeebe_tpu — a TPU-native distributed workflow engine with Zeebe-capability parity.
+
+A horizontally-scalable, fault-tolerant BPMN 2.0 process engine where the per-record
+BPMN state machine is re-expressed as a data-parallel automaton kernel in JAX:
+thousands of process-instance element records packed into device arrays, advanced
+lock-step under ``jax.jit``/``pjit`` over a TPU mesh, while the host keeps the
+event-sourced log, replication, snapshotting, state store, and client API.
+
+Layer map (mirrors SURVEY.md §1, reference: honlyc/zeebe):
+
+- ``protocol``    record schema: RecordType/ValueType/Intent, msgpack codec, keys
+- ``journal``     append-only segmented log with checksummed framing
+- ``state``       column-family KV store with transactions + snapshots
+- ``logstreams``  per-partition log facade: sequencer, writer, readers
+- ``stream``      stream-processing platform: processing/replay state machines
+- ``engine``      BPMN workflow engine: processors, event appliers, engine state
+- ``models``      BPMN model, fluent builder, deploy-time transformer
+- ``feel``        FEEL-lite expression language (parse/eval + device compilation)
+- ``ops``         JAX/Pallas device kernels: the batched automaton step
+- ``parallel``    mesh/sharding, partitions, inter-partition command routing
+- ``gateway``     client-facing API front-end
+- ``exporters``   exporter SPI + recording exporter test harness
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,186 @@
+"""Record — the unit of the event-sourced stream.
+
+A record is metadata (position, key, record type, value type, intent, rejection)
+plus a value payload (a msgpack map). Mirrors the reference's ``Record<T>``
+interface and ``RecordMetadata`` SBE header (reference: protocol/src/main/java/io/
+camunda/zeebe/protocol/record/Record.java; protocol-impl/…/record/RecordMetadata.java).
+
+Values are plain dicts with camelCase keys matching the reference's JSON view, so
+parity tests can diff event streams directly against reference semantics.
+Serialization is a fixed-layout metadata header + msgpack value body.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Any, Mapping
+
+from zeebe_tpu.protocol import msgpack
+from zeebe_tpu.protocol.enums import RecordType, RejectionType, ValueType
+from zeebe_tpu.protocol.intent import Intent
+
+# Wire layout for the serialized metadata header, preceding the msgpack body
+# (the reference frames this with SBE; we use a fixed little-endian struct —
+# same information, simpler codegen story):
+#   u8 recordType | u8 valueType | u8 intent | u8 rejectionType
+#   i64 key | i64 sourceRecordPosition | i64 timestamp
+#   i32 requestStreamId | i64 requestId | i64 operationReference
+#   u16 rejectionReasonLen | rejectionReason (utf-8)
+#   u32 valueLen | value (msgpack)
+_HEADER = struct.Struct("<BBBBqqqiqqH")
+
+NO_POSITION = -1
+NO_KEY = -1
+NO_REQUEST = -1
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class Record:
+    """Immutable stream record. ``position`` is assigned by the sequencer at
+    append time; ``source_record_position`` back-links a follow-up record to the
+    command that produced it (drives replay's lastProcessedPosition tracking)."""
+
+    record_type: RecordType
+    value_type: ValueType
+    intent: Intent
+    value: Mapping[str, Any]
+    key: int = NO_KEY
+    position: int = NO_POSITION
+    source_record_position: int = NO_POSITION
+    timestamp: int = 0  # epoch millis, assigned at append time
+    partition_id: int = 0
+    rejection_type: RejectionType = RejectionType.NULL_VAL
+    rejection_reason: str = ""
+    # Request correlation for client responses (gateway stream/request ids).
+    request_stream_id: int = NO_REQUEST
+    request_id: int = NO_REQUEST
+    # Client-supplied reference carried through to events (reference 8.4 feature).
+    operation_reference: int = 0
+
+    @property
+    def is_command(self) -> bool:
+        return self.record_type == RecordType.COMMAND
+
+    @property
+    def is_event(self) -> bool:
+        return self.record_type == RecordType.EVENT
+
+    @property
+    def is_rejection(self) -> bool:
+        return self.record_type == RecordType.COMMAND_REJECTION
+
+    def replace(self, **kw: Any) -> "Record":
+        return dataclasses.replace(self, **kw)
+
+    # -- serialization -------------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        reason = self.rejection_reason.encode("utf-8")
+        body = msgpack.packb(dict(self.value))
+        header = _HEADER.pack(
+            int(self.record_type),
+            int(self.value_type),
+            int(self.intent),
+            int(self.rejection_type),
+            self.key,
+            self.source_record_position,
+            self.timestamp,
+            self.request_stream_id,
+            self.request_id,
+            self.operation_reference,
+            len(reason),
+        )
+        return b"".join((header, reason, struct.pack("<I", len(body)), body))
+
+    @classmethod
+    def from_bytes(cls, data: bytes, position: int = NO_POSITION, partition_id: int = 0) -> "Record":
+        (
+            record_type,
+            value_type,
+            intent_val,
+            rejection_type,
+            key,
+            source_pos,
+            timestamp,
+            request_stream_id,
+            request_id,
+            operation_reference,
+            reason_len,
+        ) = _HEADER.unpack_from(data, 0)
+        off = _HEADER.size
+        reason = data[off : off + reason_len].decode("utf-8")
+        off += reason_len
+        (value_len,) = struct.unpack_from("<I", data, off)
+        off += 4
+        if off + value_len != len(data):
+            raise ValueError(
+                f"record frame length mismatch: header says {off + value_len}, got {len(data)}"
+            )
+        value = msgpack.unpackb(data[off : off + value_len])
+        vt = ValueType(value_type)
+        intent = Intent.for_value_type(vt)(intent_val)
+        return cls(
+            record_type=RecordType(record_type),
+            value_type=vt,
+            intent=intent,
+            value=value,
+            key=key,
+            position=position,
+            source_record_position=source_pos,
+            timestamp=timestamp,
+            partition_id=partition_id,
+            rejection_type=RejectionType(rejection_type),
+            rejection_reason=reason,
+            request_stream_id=request_stream_id,
+            request_id=request_id,
+            operation_reference=operation_reference,
+        )
+
+    # -- JSON view (reference: protocol-jackson) -----------------------------
+
+    def to_json_dict(self) -> dict[str, Any]:
+        """Camel-case JSON view matching the reference's Record JSON shape."""
+        return {
+            "position": self.position,
+            "sourceRecordPosition": self.source_record_position,
+            "key": self.key,
+            "timestamp": self.timestamp,
+            "recordType": self.record_type.name,
+            "valueType": self.value_type.name,
+            "intent": self.intent.name,
+            "partitionId": self.partition_id,
+            "rejectionType": self.rejection_type.name,
+            "rejectionReason": self.rejection_reason,
+            "operationReference": self.operation_reference,
+            "value": dict(self.value),
+        }
+
+
+def command(value_type: ValueType, intent: Intent, value: Mapping[str, Any], **kw: Any) -> Record:
+    return Record(RecordType.COMMAND, value_type, intent, value, **kw)
+
+
+def event(value_type: ValueType, intent: Intent, value: Mapping[str, Any], **kw: Any) -> Record:
+    return Record(RecordType.EVENT, value_type, intent, value, **kw)
+
+
+def rejection(
+    cmd: Record, rejection_type: RejectionType, reason: str, position: int = NO_POSITION
+) -> Record:
+    """Build the COMMAND_REJECTION record answering ``cmd``."""
+    return Record(
+        record_type=RecordType.COMMAND_REJECTION,
+        value_type=cmd.value_type,
+        intent=cmd.intent,
+        value=cmd.value,
+        key=cmd.key,
+        position=position,
+        source_record_position=cmd.position,
+        partition_id=cmd.partition_id,
+        rejection_type=rejection_type,
+        rejection_reason=reason,
+        request_stream_id=cmd.request_stream_id,
+        request_id=cmd.request_id,
+        operation_reference=cmd.operation_reference,
+    )
