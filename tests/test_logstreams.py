@@ -1,0 +1,94 @@
+"""Log stream tests: position assignment, batch atomicity, readers, recovery."""
+
+import pytest
+
+from zeebe_tpu.journal import SegmentedJournal
+from zeebe_tpu.logstreams import LogAppendEntry, LogStream
+from zeebe_tpu.protocol import ValueType, command, event
+from zeebe_tpu.protocol.intent import JobIntent, ProcessInstanceIntent
+
+
+def make_cmd(n=0):
+    return command(
+        ValueType.PROCESS_INSTANCE,
+        ProcessInstanceIntent.ACTIVATE_ELEMENT,
+        {"elementId": f"el{n}"},
+    )
+
+
+def make_ev(n=0):
+    return event(ValueType.JOB, JobIntent.CREATED, {"type": f"t{n}"})
+
+
+@pytest.fixture
+def stream(tmp_path):
+    journal = SegmentedJournal(tmp_path)
+    s = LogStream(journal, partition_id=1, clock=lambda: 12345)
+    yield s
+    journal.close()
+
+
+class TestWriter:
+    def test_positions_contiguous_across_batches(self, stream):
+        p1 = stream.writer.try_write([LogAppendEntry(make_cmd())])
+        p2 = stream.writer.try_write([LogAppendEntry(make_ev(1)), LogAppendEntry(make_ev(2))])
+        assert p1 == 1
+        assert p2 == 3  # batch positions 2,3
+        assert stream.last_position == 3
+
+    def test_empty_batch_is_noop(self, stream):
+        assert stream.writer.try_write([]) == -1
+        assert stream.last_position == 0
+
+    def test_source_position_recorded(self, stream):
+        stream.writer.try_write([LogAppendEntry(make_cmd())])
+        stream.writer.try_write([LogAppendEntry(make_ev())], source_position=1)
+        rec = stream.read_at_or_after(2)
+        assert rec.source_position == 1
+
+    def test_timestamp_assigned(self, stream):
+        stream.writer.try_write([LogAppendEntry(make_cmd())])
+        assert stream.read_at_or_after(1).record.timestamp == 12345
+
+
+class TestReader:
+    def test_read_all_in_order(self, stream):
+        for i in range(5):
+            stream.writer.try_write([LogAppendEntry(make_cmd(i))])
+        got = list(stream.new_reader())
+        assert [r.position for r in got] == [1, 2, 3, 4, 5]
+        assert [r.record.value["elementId"] for r in got] == [f"el{i}" for i in range(5)]
+
+    def test_read_from_position(self, stream):
+        for i in range(5):
+            stream.writer.try_write([LogAppendEntry(make_cmd(i))])
+        got = list(stream.new_reader(from_position=3))
+        assert [r.position for r in got] == [3, 4, 5]
+
+    def test_processed_flag_survives(self, stream):
+        stream.writer.try_write(
+            [LogAppendEntry(make_cmd()), LogAppendEntry.of_processed(make_ev())]
+        )
+        recs = list(stream.new_reader())
+        assert [r.processed for r in recs] == [False, True]
+
+    def test_batch_containing(self, stream):
+        stream.writer.try_write([LogAppendEntry(make_cmd())])
+        stream.writer.try_write([LogAppendEntry(make_ev(1)), LogAppendEntry(make_ev(2))])
+        batch = stream.read_batch_containing(3)
+        assert [r.position for r in batch] == [2, 3]
+
+
+class TestRecovery:
+    def test_position_continues_after_reopen(self, tmp_path):
+        journal = SegmentedJournal(tmp_path)
+        s = LogStream(journal, partition_id=1)
+        s.writer.try_write([LogAppendEntry(make_cmd()), LogAppendEntry(make_cmd())])
+        journal.close()
+
+        journal2 = SegmentedJournal(tmp_path)
+        s2 = LogStream(journal2, partition_id=1)
+        assert s2.last_position == 2
+        p = s2.writer.try_write([LogAppendEntry(make_cmd())])
+        assert p == 3
+        journal2.close()

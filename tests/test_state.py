@@ -1,0 +1,250 @@
+"""State store tests: column families, transactions, iteration, consistency
+checks, snapshot roundtrip; snapshot store lifecycle + chunked replication."""
+
+import pytest
+
+from zeebe_tpu.state import (
+    ColumnFamilyCode,
+    FileBasedSnapshotStore,
+    InvalidSnapshotError,
+    SnapshotId,
+    ZbDb,
+    ZbDbInconsistentError,
+)
+
+
+@pytest.fixture
+def db():
+    return ZbDb()
+
+
+class TestTransactions:
+    def test_commit_visible(self, db):
+        cf = db.column_family(ColumnFamilyCode.JOBS)
+        with db.transaction():
+            cf.put((1,), {"type": "a"})
+        with db.transaction():
+            assert cf.get((1,)) == {"type": "a"}
+
+    def test_rollback_discards(self, db):
+        cf = db.column_family(ColumnFamilyCode.JOBS)
+        with pytest.raises(RuntimeError, match="boom"):
+            with db.transaction():
+                cf.put((1,), "v")
+                raise RuntimeError("boom")
+        with db.transaction():
+            assert cf.get((1,)) is None
+
+    def test_read_your_writes(self, db):
+        cf = db.column_family(ColumnFamilyCode.JOBS)
+        with db.transaction():
+            cf.put((1,), "v1")
+            assert cf.get((1,)) == "v1"
+            cf.delete((1,))
+            assert cf.get((1,)) is None
+
+    def test_no_access_outside_transaction(self, db):
+        cf = db.column_family(ColumnFamilyCode.JOBS)
+        with pytest.raises(RuntimeError):
+            cf.get((1,))
+
+    def test_nested_transactions_rejected(self, db):
+        with db.transaction():
+            with pytest.raises(RuntimeError):
+                with db.transaction():
+                    pass
+
+
+class TestColumnFamilies:
+    def test_families_isolated(self, db):
+        jobs = db.column_family(ColumnFamilyCode.JOBS)
+        timers = db.column_family(ColumnFamilyCode.TIMERS)
+        with db.transaction():
+            jobs.put((1,), "job")
+            timers.put((1,), "timer")
+        with db.transaction():
+            assert jobs.get((1,)) == "job"
+            assert timers.get((1,)) == "timer"
+            assert len(list(jobs.items())) == 1
+
+    def test_composite_keys_ordered_iteration(self, db):
+        cf = db.column_family(ColumnFamilyCode.TIMER_DUE_DATES)
+        with db.transaction():
+            cf.put((300, 7), "c")
+            cf.put((100, 5), "a")
+            cf.put((200, 6), "b")
+            cf.put((100, 9), "a2")
+        with db.transaction():
+            assert list(cf.values()) == ["a", "a2", "b", "c"]
+
+    def test_negative_int_ordering(self, db):
+        cf = db.column_family(ColumnFamilyCode.DEFAULT)
+        with db.transaction():
+            for v in (5, -3, 0, -100, 42):
+                cf.put((v,), v)
+        with db.transaction():
+            assert list(cf.values()) == [-100, -3, 0, 5, 42]
+
+    def test_prefix_iteration(self, db):
+        cf = db.column_family(ColumnFamilyCode.ELEMENT_INSTANCE_PARENT_CHILD)
+        with db.transaction():
+            cf.put((1, 10), "c1")
+            cf.put((1, 11), "c2")
+            cf.put((2, 12), "other-parent")
+        with db.transaction():
+            assert list(cf.values(prefix=(1,))) == ["c1", "c2"]
+
+    def test_string_keys(self, db):
+        cf = db.column_family(ColumnFamilyCode.PROCESS_CACHE_BY_ID_AND_VERSION)
+        with db.transaction():
+            cf.put(("order", 1), "v1")
+            cf.put(("order", 2), "v2")
+            cf.put(("order-express", 1), "x1")
+        with db.transaction():
+            # prefix ("order",) must not match "order-express" (NUL terminator)
+            assert list(cf.values(prefix=("order",))) == ["v1", "v2"]
+
+    def test_iteration_sees_pending_writes(self, db):
+        cf = db.column_family(ColumnFamilyCode.JOBS)
+        with db.transaction():
+            cf.put((2,), "b")
+        with db.transaction():
+            cf.put((1,), "a")
+            cf.put((3,), "c")
+            cf.delete((2,))
+            assert list(cf.values()) == ["a", "c"]
+
+
+class TestConsistencyChecks:
+    def test_insert_existing_rejected(self):
+        db = ZbDb(consistency_checks=True)
+        cf = db.column_family(ColumnFamilyCode.JOBS)
+        with db.transaction():
+            cf.insert((1,), "a")
+            with pytest.raises(ZbDbInconsistentError):
+                cf.insert((1,), "b")
+
+    def test_update_missing_rejected(self):
+        db = ZbDb(consistency_checks=True)
+        cf = db.column_family(ColumnFamilyCode.JOBS)
+        with db.transaction():
+            with pytest.raises(ZbDbInconsistentError):
+                cf.update((404,), "x")
+
+    def test_delete_missing_rejected(self):
+        db = ZbDb(consistency_checks=True)
+        cf = db.column_family(ColumnFamilyCode.JOBS)
+        with db.transaction():
+            with pytest.raises(ZbDbInconsistentError):
+                cf.delete((404,))
+
+    def test_foreign_key_checker(self):
+        db = ZbDb(consistency_checks=True)
+        procs = db.column_family(ColumnFamilyCode.PROCESS_CACHE)
+
+        def check_job(db_, value):
+            with_cf = db_.column_family(ColumnFamilyCode.PROCESS_CACHE)
+            if not with_cf.exists((value["processKey"],)):
+                raise ZbDbInconsistentError("dangling processKey")
+
+        db.register_foreign_key_check(ColumnFamilyCode.JOBS, check_job)
+        jobs = db.column_family(ColumnFamilyCode.JOBS)
+        with db.transaction():
+            procs.put((7,), {"id": "p"})
+            jobs.put((1,), {"processKey": 7})  # ok
+            with pytest.raises(ZbDbInconsistentError):
+                jobs.put((2,), {"processKey": 999})
+
+
+class TestDbSnapshot:
+    def test_roundtrip_and_equality(self, db):
+        cf = db.column_family(ColumnFamilyCode.VARIABLES)
+        with db.transaction():
+            for i in range(50):
+                cf.put((i, f"var{i}"), {"value": i})
+        raw = db.to_snapshot_bytes()
+        restored = ZbDb.from_snapshot_bytes(raw)
+        assert restored.content_equals(db)
+        with restored.transaction():
+            got = restored.column_family(ColumnFamilyCode.VARIABLES).get((3, "var3"))
+        assert got == {"value": 3}
+
+    def test_corrupt_snapshot_rejected(self, db):
+        with db.transaction():
+            db.column_family(ColumnFamilyCode.JOBS).put((1,), "x")
+        raw = bytearray(db.to_snapshot_bytes())
+        raw[-1] ^= 0xFF
+        with pytest.raises(ValueError, match="checksum"):
+            ZbDb.from_snapshot_bytes(bytes(raw))
+
+
+class TestSnapshotStore:
+    def test_take_persist_latest(self, tmp_path):
+        store = FileBasedSnapshotStore(tmp_path)
+        t = store.new_transient_snapshot(index=10, term=1, processed_position=99, exported_position=50)
+        t.write_file("state.zdb", b"statedata")
+        snap = t.persist()
+        assert str(snap.id) == "10-1-99-50"
+        latest = store.latest_snapshot()
+        assert latest is not None and latest.id == SnapshotId(10, 1, 99, 50)
+        assert latest.read_file("state.zdb") == b"statedata"
+
+    def test_older_snapshots_purged(self, tmp_path):
+        store = FileBasedSnapshotStore(tmp_path)
+        for idx in (5, 10, 15):
+            t = store.new_transient_snapshot(idx, 1, idx * 10, 0)
+            t.write_file("f", b"d%d" % idx)
+            t.persist()
+        snaps = store.list_snapshots()
+        assert len(snaps) == 1
+        assert snaps[0].id.index == 15
+
+    def test_stale_transient_rejected(self, tmp_path):
+        store = FileBasedSnapshotStore(tmp_path)
+        t = store.new_transient_snapshot(10, 1, 1, 0)
+        t.write_file("f", b"x")
+        t.persist()
+        with pytest.raises(InvalidSnapshotError):
+            store.new_transient_snapshot(9, 1, 1, 0)
+
+    def test_corrupt_snapshot_dropped_on_open(self, tmp_path):
+        store = FileBasedSnapshotStore(tmp_path)
+        t = store.new_transient_snapshot(10, 1, 1, 0)
+        t.write_file("f", b"data")
+        snap = t.persist()
+        # corrupt the file after persist
+        (snap.path / "f").write_bytes(b"tampered")
+        store2 = FileBasedSnapshotStore(tmp_path)
+        assert store2.latest_snapshot() is None
+
+    def test_pending_leftovers_cleaned(self, tmp_path):
+        store = FileBasedSnapshotStore(tmp_path)
+        t = store.new_transient_snapshot(10, 1, 1, 0)
+        t.write_file("f", b"x")  # never persisted
+        store2 = FileBasedSnapshotStore(tmp_path)
+        assert list(store2.pending_dir.iterdir()) == []
+
+    def test_chunked_replication_roundtrip(self, tmp_path):
+        src = FileBasedSnapshotStore(tmp_path / "leader")
+        t = src.new_transient_snapshot(20, 2, 500, 400)
+        t.write_file("state.zdb", b"S" * (3 * 1024 * 1024))  # multi-chunk
+        t.write_file("meta", b"m")
+        snap = t.persist()
+        dst = FileBasedSnapshotStore(tmp_path / "follower")
+        received = dst.receive_snapshot(src.chunk_reader(snap, chunk_size=1 << 20))
+        assert received.id == snap.id
+        assert received.read_file("state.zdb") == b"S" * (3 * 1024 * 1024)
+        assert received.read_file("meta") == b"m"
+
+    def test_corrupt_chunk_rejected(self, tmp_path):
+        src = FileBasedSnapshotStore(tmp_path / "leader")
+        t = src.new_transient_snapshot(20, 2, 500, 400)
+        t.write_file("f", b"data")
+        snap = t.persist()
+        chunks = list(src.chunk_reader(snap))
+        import dataclasses
+
+        bad = [dataclasses.replace(chunks[0], data=b"tampered!")] + chunks[1:]
+        dst = FileBasedSnapshotStore(tmp_path / "follower")
+        with pytest.raises(InvalidSnapshotError):
+            dst.receive_snapshot(iter(bad))

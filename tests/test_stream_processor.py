@@ -1,0 +1,279 @@
+"""Stream platform tests with a fake RecordProcessor (reference strategy §4.4:
+stream-platform/src/test with fake processors).
+
+The fake processor implements a tiny counter machine: INCREMENT commands produce
+INCREMENTED events which add to a counter in state; a CHAIN command produces a
+follow-up INCREMENT command (exercising batch processing); a BOOM command raises.
+"""
+
+import pytest
+
+from zeebe_tpu.journal import SegmentedJournal
+from zeebe_tpu.logstreams import LogAppendEntry, LogStream
+from zeebe_tpu.protocol import Record, RecordType, ValueType, command, event
+from zeebe_tpu.protocol.intent import SignalIntent
+from zeebe_tpu.state import ColumnFamilyCode, ZbDb
+from zeebe_tpu.stream import (
+    Phase,
+    ProcessingResultBuilder,
+    RecordProcessor,
+    StreamProcessor,
+    StreamProcessorMode,
+)
+
+# We borrow the SIGNAL value type for the fake machine; intents:
+INCREMENT = SignalIntent.BROADCAST  # command
+INCREMENTED = SignalIntent.BROADCASTED  # event
+
+
+class CounterProcessor(RecordProcessor):
+    """Fake machine: counter in state; op in the value drives behavior."""
+
+    def __init__(self, db: ZbDb):
+        self.cf = db.column_family(ColumnFamilyCode.DEFAULT)
+        self.processed_ops = []
+
+    def accepts(self, value_type):
+        return value_type == ValueType.SIGNAL
+
+    def process(self, logged, result):
+        op = logged.record.value.get("op", "inc")
+        self.processed_ops.append(op)
+        if op == "boom":
+            raise RuntimeError("kaboom")
+        if op == "chain":
+            # produce a follow-up command (processed in-batch if budget allows)
+            result.append_record(
+                command(ValueType.SIGNAL, INCREMENT, {"op": "inc", "amount": 10})
+            )
+            return
+        amount = logged.record.value.get("amount", 1)
+        ev = event(ValueType.SIGNAL, INCREMENTED, {"amount": amount})
+        self._apply(ev)
+        result.append_record(ev)
+        if logged.record.request_id >= 0:
+            result.with_response(ev, logged.record.request_stream_id, logged.record.request_id)
+
+    def _apply(self, ev: Record):
+        count = self.cf.get(("counter",)) or 0
+        self.cf.put(("counter",), count + ev.value["amount"])
+
+    def replay(self, logged):
+        self._apply(logged.record)
+
+    def counter(self, db):
+        with db.transaction():
+            return self.cf.get(("counter",)) or 0
+
+
+def make_env(tmp_path, mode=StreamProcessorMode.PROCESSING, max_batch=100, subdir="log"):
+    journal = SegmentedJournal(tmp_path / subdir)
+    stream = LogStream(journal, partition_id=1, clock=lambda: 1000)
+    db = ZbDb()
+    proc = CounterProcessor(db)
+    responses = []
+    sp = StreamProcessor(
+        stream, db, proc, mode=mode, max_commands_in_batch=max_batch,
+        response_sink=responses.append,
+    )
+    return journal, stream, db, proc, sp, responses
+
+
+def write_cmd(stream, op="inc", amount=1, request_id=-1):
+    return stream.writer.try_write(
+        [LogAppendEntry(command(ValueType.SIGNAL, INCREMENT, {"op": op, "amount": amount},
+                                request_id=request_id, request_stream_id=9))]
+    )
+
+
+class TestProcessing:
+    def test_command_produces_event_and_state(self, tmp_path):
+        journal, stream, db, proc, sp, responses = make_env(tmp_path)
+        sp.start()
+        write_cmd(stream, amount=5)
+        steps = sp.run_until_idle()
+        assert steps == 1
+        assert proc.counter(db) == 5
+        events = [r for r in stream.new_reader() if r.record.is_event]
+        assert len(events) == 1
+        assert events[0].record.value["amount"] == 5
+        assert events[0].source_position == 1
+        journal.close()
+
+    def test_response_delivered(self, tmp_path):
+        journal, stream, db, proc, sp, responses = make_env(tmp_path)
+        sp.start()
+        write_cmd(stream, amount=2, request_id=77)
+        sp.run_until_idle()
+        assert len(responses) == 1
+        assert responses[0].request_id == 77
+        assert responses[0].record.value["amount"] == 2
+        journal.close()
+
+    def test_follow_up_command_processed_in_batch(self, tmp_path):
+        journal, stream, db, proc, sp, responses = make_env(tmp_path)
+        sp.start()
+        write_cmd(stream, op="chain")
+        sp.run_until_idle()
+        assert proc.counter(db) == 10
+        recs = list(stream.new_reader())
+        # batch: chained INCREMENT command (processed) + INCREMENTED event
+        cmds = [r for r in recs if r.record.is_command and r.position > 1]
+        assert len(cmds) == 1 and cmds[0].processed
+        assert proc.processed_ops == ["chain", "inc"]
+        journal.close()
+
+    def test_batch_budget_defers_follow_up(self, tmp_path):
+        journal, stream, db, proc, sp, responses = make_env(tmp_path, max_batch=1)
+        sp.start()
+        write_cmd(stream, op="chain")
+        sp.run_until_idle()
+        # follow-up command written unprocessed, then processed as its own step
+        assert proc.counter(db) == 10
+        recs = list(stream.new_reader())
+        follow_cmds = [r for r in recs if r.record.is_command and r.position > 1]
+        assert len(follow_cmds) == 1 and not follow_cmds[0].processed
+        journal.close()
+
+
+class TestErrorHandling:
+    def test_error_rolls_back_and_rejects(self, tmp_path):
+        journal, stream, db, proc, sp, responses = make_env(tmp_path)
+        sp.start()
+        write_cmd(stream, op="boom", request_id=5)
+        write_cmd(stream, amount=3, request_id=6)
+        sp.run_until_idle()
+        assert proc.counter(db) == 3  # boom rolled back, next command fine
+        rejections = [r for r in stream.new_reader() if r.record.is_rejection]
+        assert len(rejections) == 1
+        assert "kaboom" in rejections[0].record.rejection_reason
+        assert len(responses) == 2  # rejection response + ok response
+        assert responses[0].record.is_rejection
+        journal.close()
+
+
+class TestReplay:
+    def test_replay_reaches_identical_state(self, tmp_path):
+        journal, stream, db, proc, sp, _ = make_env(tmp_path)
+        sp.start()
+        for amount in (1, 2, 3, 4):
+            write_cmd(stream, amount=amount)
+        write_cmd(stream, op="chain")
+        sp.run_until_idle()
+        assert proc.counter(db) == 20
+        journal.close()
+
+        # fresh db, same log → replay-only must land on the same state
+        journal2 = SegmentedJournal(tmp_path / "log")
+        stream2 = LogStream(journal2, partition_id=1)
+        db2 = ZbDb()
+        proc2 = CounterProcessor(db2)
+        sp2 = StreamProcessor(stream2, db2, proc2, mode=StreamProcessorMode.REPLAY)
+        sp2.start()
+        sp2.run_until_idle()
+        assert proc2.counter(db2) == 20
+        assert sp2.last_processed_position == sp.last_processed_position
+        journal2.close()
+
+    def test_restart_does_not_reprocess(self, tmp_path):
+        journal, stream, db, proc, sp, _ = make_env(tmp_path)
+        sp.start()
+        write_cmd(stream, amount=7)
+        sp.run_until_idle()
+        journal.close()
+
+        # restart with *fresh state* (no snapshot): replay rebuilds, then
+        # processing resumes without double-applying
+        journal2 = SegmentedJournal(tmp_path / "log")
+        stream2 = LogStream(journal2, partition_id=1)
+        db2 = ZbDb()
+        proc2 = CounterProcessor(db2)
+        sp2 = StreamProcessor(stream2, db2, proc2)
+        sp2.start()
+        sp2.run_until_idle()
+        assert proc2.counter(db2) == 7
+        assert proc2.processed_ops == []  # nothing reprocessed
+        # new commands still work
+        write_cmd(stream2, amount=1)
+        sp2.run_until_idle()
+        assert proc2.counter(db2) == 8
+        journal2.close()
+
+    def test_follower_mode_applies_continuously(self, tmp_path):
+        journal, stream, db, proc, sp, _ = make_env(tmp_path)
+        sp.start()
+        write_cmd(stream, amount=2)
+        sp.run_until_idle()
+
+        follower_db = ZbDb()
+        follower_proc = CounterProcessor(follower_db)
+        follower = StreamProcessor(stream, follower_db, follower_proc, mode=StreamProcessorMode.REPLAY)
+        follower.start()
+        assert follower.phase == Phase.REPLAY
+        assert follower_proc.counter(follower_db) == 2
+        # leader processes more; follower catches up incrementally
+        write_cmd(stream, amount=3)
+        sp.run_until_idle()
+        follower.run_until_idle()
+        assert follower_proc.counter(follower_db) == 5
+        journal.close()
+
+
+class TestScheduleService:
+    def test_due_tasks_write_commands(self, tmp_path):
+        journal, stream, db, proc, sp, _ = make_env(tmp_path)
+        sp.start()
+        fired = []
+        sp.schedule_service.run_at(
+            500, lambda: (fired.append(1), [command(ValueType.SIGNAL, INCREMENT, {"amount": 4})])[1]
+        )
+        sp.run_until_idle()
+        assert fired == [1]
+        assert proc.counter(db) == 4
+        journal.close()
+
+    def test_future_tasks_not_run(self, tmp_path):
+        journal, stream, db, proc, sp, _ = make_env(tmp_path)
+        sp.start()
+        sp.schedule_service.run_at(99999, lambda: [])
+        sp.run_until_idle()
+        assert sp.schedule_service.next_due_millis == 99999
+        journal.close()
+
+    def test_cancelled_task_not_run(self, tmp_path):
+        journal, stream, db, proc, sp, _ = make_env(tmp_path)
+        sp.start()
+        handle = sp.schedule_service.run_at(500, lambda: [command(ValueType.SIGNAL, INCREMENT, {})])
+        handle.cancel()
+        sp.run_until_idle()
+        assert proc.counter(db) == 0
+        journal.close()
+
+
+class TestSnapshotRecovery:
+    def test_recover_from_snapshot_does_not_reapply_events(self, tmp_path):
+        """Regression: replay must skip events whose source position is <= the
+        snapshot's last-processed position (else state double-applies)."""
+        journal, stream, db, proc, sp, _ = make_env(tmp_path)
+        sp.start()
+        for amount in (1, 2, 3):
+            write_cmd(stream, amount=amount)
+        sp.run_until_idle()
+        snapshot_bytes = db.to_snapshot_bytes()
+        # post-snapshot traffic
+        write_cmd(stream, amount=10)
+        sp.run_until_idle()
+        journal.close()
+
+        from zeebe_tpu.state import ZbDb as _ZbDb
+
+        journal2 = SegmentedJournal(tmp_path / "log")
+        stream2 = LogStream(journal2, partition_id=1)
+        db2 = _ZbDb.from_snapshot_bytes(snapshot_bytes)
+        proc2 = CounterProcessor(db2)
+        sp2 = StreamProcessor(stream2, db2, proc2)
+        sp2.start()
+        sp2.run_until_idle()
+        assert proc2.counter(db2) == 16  # 1+2+3 (snapshot) + 10 (replayed)
+        assert proc2.processed_ops == []  # replay only, no reprocessing
+        journal2.close()

@@ -1,0 +1,17 @@
+"""Durable segmented journal (SURVEY.md §2.3)."""
+
+from zeebe_tpu.journal.journal import (
+    ASQN_IGNORE,
+    CorruptedJournalError,
+    InvalidAsqnError,
+    JournalRecord,
+    SegmentedJournal,
+)
+
+__all__ = [
+    "ASQN_IGNORE",
+    "CorruptedJournalError",
+    "InvalidAsqnError",
+    "JournalRecord",
+    "SegmentedJournal",
+]

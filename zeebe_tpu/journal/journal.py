@@ -1,0 +1,406 @@
+"""Append-only segmented journal with checksummed framing.
+
+The durable log under Raft and the log stream (reference: journal/src/main/java/io/
+camunda/zeebe/journal/file/SegmentedJournal.java:34, SegmentedJournalWriter,
+SegmentsManager, SparseJournalIndex, record/SBESerializer.java,
+util/ChecksumGenerator.java, JournalMetaStore.java).
+
+Design (host-side, file-per-segment):
+- A journal is a directory of fixed-capacity segment files ``<name>-<id>.log``
+  plus a ``meta`` file holding the last-flushed index.
+- Each segment starts with a fixed header (magic, version, segment id, first
+  index); records are framed as
+  ``u32 length | u32 crc32c | u64 index | i64 asqn | data``.
+- ``asqn`` (application sequence number) carries the record *position* assigned
+  by the sequencer, enabling ``seek_to_asqn`` during recovery — exactly the
+  reference's asqn contract (SegmentedJournal's JournalRecord.asqn).
+- A sparse in-memory index (every Nth record) accelerates seeks.
+- Corruption: a bad checksum or truncated frame on open truncates the journal at
+  the last valid record (the reference's CorruptedJournalException/FrameUtil
+  handling — data after a crash-torn write is discarded, consistent with Raft
+  semantics where unflushed suffix entries were never acknowledged).
+
+The hot append path is deliberately simple buffered-write + explicit flush so it
+can later be swapped for the C++ implementation without contract changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import struct
+import zlib
+from pathlib import Path
+from typing import Iterator
+
+_MAGIC = 0x5A4A4E4C  # "ZJNL"
+_VERSION = 1
+_SEG_HEADER = struct.Struct("<IIQQ")  # magic, version, segment_id, first_index
+_FRAME = struct.Struct("<IIQq")  # length, crc32, index, asqn
+_SPARSE_EVERY = 64
+
+
+class CorruptedJournalError(Exception):
+    """Unrecoverable corruption detected (e.g. bad segment header)."""
+
+
+class InvalidAsqnError(Exception):
+    """Append with an asqn that is not monotonically increasing."""
+
+
+ASQN_IGNORE = -1
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class JournalRecord:
+    index: int
+    asqn: int
+    data: bytes
+
+
+def _checksum(index: int, asqn: int, data: bytes) -> int:
+    head = struct.pack("<Qq", index, asqn)
+    return zlib.crc32(data, zlib.crc32(head)) & 0xFFFFFFFF
+
+
+class _Segment:
+    """One segment file: header + frames. Keeps an in-memory sparse index of
+    (record index → file offset) for every ``_SPARSE_EVERY``-th record."""
+
+    def __init__(self, path: Path, segment_id: int, first_index: int, create: bool) -> None:
+        self.path = path
+        self.segment_id = segment_id
+        self.first_index = first_index
+        self.last_index = first_index - 1
+        self.last_asqn = ASQN_IGNORE
+        self.sparse: list[tuple[int, int]] = []  # (index, offset)
+        if create:
+            self.file = open(path, "w+b")
+            self.file.write(_SEG_HEADER.pack(_MAGIC, _VERSION, segment_id, first_index))
+            self.file.flush()
+            self.size = _SEG_HEADER.size
+        else:
+            self.file = open(path, "r+b")
+            self.size = _SEG_HEADER.size  # recomputed by scan()
+
+    @classmethod
+    def open_existing(cls, path: Path) -> "_Segment":
+        with open(path, "rb") as f:
+            raw = f.read(_SEG_HEADER.size)
+        if len(raw) < _SEG_HEADER.size:
+            raise CorruptedJournalError(f"segment header truncated: {path}")
+        magic, version, segment_id, first_index = _SEG_HEADER.unpack(raw)
+        if magic != _MAGIC:
+            raise CorruptedJournalError(f"bad segment magic in {path}: 0x{magic:08x}")
+        if version != _VERSION:
+            raise CorruptedJournalError(f"unsupported segment version {version} in {path}")
+        return cls(path, segment_id, first_index, create=False)
+
+    def scan(self) -> None:
+        """Rebuild in-memory state from disk; truncate at first corrupt frame."""
+        f = self.file
+        f.seek(0, os.SEEK_END)
+        file_len = f.tell()
+        offset = _SEG_HEADER.size
+        expected = self.first_index
+        self.sparse.clear()
+        mv = None
+        f.seek(0)
+        mv = memoryview(f.read())
+        while offset + _FRAME.size <= file_len:
+            length, crc, index, asqn = _FRAME.unpack_from(mv, offset)
+            end = offset + _FRAME.size + length
+            if length == 0 or end > file_len or index != expected:
+                break
+            data = bytes(mv[offset + _FRAME.size : end])
+            if _checksum(index, asqn, data) != crc:
+                break
+            if (index - self.first_index) % _SPARSE_EVERY == 0:
+                self.sparse.append((index, offset))
+            self.last_index = index
+            if asqn != ASQN_IGNORE:
+                self.last_asqn = asqn
+            expected += 1
+            offset = end
+        mv.release()
+        if offset < file_len:
+            # crash-torn or corrupt suffix: discard it
+            f.truncate(offset)
+            f.flush()
+        self.size = offset
+
+    def append(self, index: int, asqn: int, data: bytes) -> None:
+        frame = _FRAME.pack(len(data), _checksum(index, asqn, data), index, asqn)
+        self.file.seek(self.size)
+        self.file.write(frame)
+        self.file.write(data)
+        if (index - self.first_index) % _SPARSE_EVERY == 0:
+            self.sparse.append((index, self.size))
+        self.size += _FRAME.size + len(data)
+        self.last_index = index
+        if asqn != ASQN_IGNORE:
+            self.last_asqn = asqn
+
+    def read_from(self, index: int) -> Iterator[JournalRecord]:
+        """Yield records from ``index`` (clamped to first_index) to the end."""
+        if index < self.first_index:
+            index = self.first_index
+        if index > self.last_index:
+            return
+        # sparse seek: greatest indexed offset <= index
+        offset = _SEG_HEADER.size
+        for idx, off in self.sparse:
+            if idx <= index:
+                offset = off
+            else:
+                break
+        self.file.flush()
+        self.file.seek(offset)
+        mv = memoryview(self.file.read(self.size - offset))
+        pos = 0
+        while pos + _FRAME.size <= len(mv):
+            length, crc, rec_index, asqn = _FRAME.unpack_from(mv, pos)
+            data = bytes(mv[pos + _FRAME.size : pos + _FRAME.size + length])
+            pos += _FRAME.size + length
+            if rec_index >= index:
+                yield JournalRecord(rec_index, asqn, data)
+        mv.release()
+
+    def read_entry(self, index: int) -> JournalRecord | None:
+        """Read exactly one record by index (sparse-index seek + bounded walk),
+        without materializing the rest of the segment."""
+        if index < self.first_index or index > self.last_index:
+            return None
+        # nearest sparse offset at or before index
+        offset = _SEG_HEADER.size
+        for idx, off in self.sparse:
+            if idx <= index:
+                offset = off
+            else:
+                break
+        f = self.file
+        f.flush()
+        while offset < self.size:
+            f.seek(offset)
+            head = f.read(_FRAME.size)
+            if len(head) < _FRAME.size:
+                return None
+            length, crc, rec_index, asqn = _FRAME.unpack(head)
+            if rec_index == index:
+                return JournalRecord(rec_index, asqn, f.read(length))
+            offset += _FRAME.size + length
+        return None
+
+    def truncate_after(self, index: int) -> None:
+        """Delete all records with index > ``index``."""
+        if index >= self.last_index:
+            return
+        offset = _SEG_HEADER.size
+        new_last = self.first_index - 1
+        new_asqn = ASQN_IGNORE
+        for rec in self.read_from(self.first_index):
+            if rec.index > index:
+                break
+            offset += _FRAME.size + len(rec.data)
+            new_last = rec.index
+            if rec.asqn != ASQN_IGNORE:
+                new_asqn = rec.asqn
+        self.file.truncate(offset)
+        self.file.flush()
+        self.size = offset
+        self.last_index = new_last
+        self.last_asqn = new_asqn
+        self.sparse = [(i, o) for i, o in self.sparse if i <= new_last]
+
+    def flush(self) -> None:
+        self.file.flush()
+        os.fsync(self.file.fileno())
+
+    def close(self) -> None:
+        self.file.close()
+
+    def delete(self) -> None:
+        self.close()
+        self.path.unlink(missing_ok=True)
+
+
+class SegmentedJournal:
+    """The journal: ordered segments, append/read/seek/truncate/compact.
+
+    Indexes are 1-based and contiguous; asqns are strictly increasing where
+    provided (reference: SegmentedJournalWriter append validation).
+    """
+
+    def __init__(
+        self,
+        directory: str | Path,
+        name: str = "journal",
+        max_segment_size: int = 8 * 1024 * 1024,
+    ) -> None:
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.name = name
+        self.max_segment_size = max_segment_size
+        self._meta_path = self.dir / f"{name}.meta"
+        self.segments: list[_Segment] = []
+        self._open_or_create()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _segment_path(self, segment_id: int) -> Path:
+        return self.dir / f"{self.name}-{segment_id}.log"
+
+    def _open_or_create(self) -> None:
+        paths = sorted(
+            self.dir.glob(f"{self.name}-*.log"),
+            key=lambda p: int(p.stem.rsplit("-", 1)[1]),
+        )
+        prev_last: int | None = None
+        for path in paths:
+            seg = _Segment.open_existing(path)
+            seg.scan()
+            if prev_last is not None and seg.first_index != prev_last + 1:
+                # gap between segments: discard this and all later segments
+                seg.delete()
+                for later in paths[paths.index(path) + 1 :]:
+                    later.unlink(missing_ok=True)
+                break
+            self.segments.append(seg)
+            prev_last = seg.last_index
+        if not self.segments:
+            self.segments.append(_Segment(self._segment_path(1), 1, 1, create=True))
+        # drop empty trailing segments except the first
+        while len(self.segments) > 1 and self.segments[-1].last_index < self.segments[-1].first_index:
+            self.segments.pop().delete()
+
+    def close(self) -> None:
+        for seg in self.segments:
+            seg.close()
+
+    # -- properties ----------------------------------------------------------
+
+    @property
+    def first_index(self) -> int:
+        return self.segments[0].first_index
+
+    @property
+    def last_index(self) -> int:
+        return self.segments[-1].last_index
+
+    @property
+    def last_asqn(self) -> int:
+        for seg in reversed(self.segments):
+            if seg.last_asqn != ASQN_IGNORE:
+                return seg.last_asqn
+        return ASQN_IGNORE
+
+    def is_empty(self) -> bool:
+        return self.last_index < self.first_index
+
+    # -- write path ----------------------------------------------------------
+
+    def append(self, data: bytes, asqn: int = ASQN_IGNORE) -> JournalRecord:
+        """Append one record; returns it with its assigned index."""
+        if asqn != ASQN_IGNORE and asqn <= self.last_asqn:
+            raise InvalidAsqnError(f"asqn {asqn} <= last asqn {self.last_asqn}")
+        tail = self.segments[-1]
+        if tail.size + _FRAME.size + len(data) > self.max_segment_size and tail.last_index >= tail.first_index:
+            tail = self._roll_segment()
+        index = tail.last_index + 1
+        tail.append(index, asqn, data)
+        return JournalRecord(index, asqn, data)
+
+    def _roll_segment(self) -> _Segment:
+        prev = self.segments[-1]
+        prev.flush()
+        seg = _Segment(
+            self._segment_path(prev.segment_id + 1),
+            prev.segment_id + 1,
+            prev.last_index + 1,
+            create=True,
+        )
+        self.segments.append(seg)
+        return seg
+
+    def flush(self) -> int:
+        """fsync all dirty segments; persist and return the last flushed index
+        (reference: JournalMetaStore last-flushed index)."""
+        for seg in self.segments:
+            seg.flush()
+        idx = self.last_index
+        tmp = self._meta_path.with_suffix(".tmp")
+        tmp.write_bytes(struct.pack("<Q", max(idx, 0)))
+        os.replace(tmp, self._meta_path)
+        return idx
+
+    @property
+    def last_flushed_index(self) -> int:
+        try:
+            return struct.unpack("<Q", self._meta_path.read_bytes())[0]
+        except FileNotFoundError:
+            return 0
+
+    # -- read path -----------------------------------------------------------
+
+    def read_from(self, index: int) -> Iterator[JournalRecord]:
+        """Iterate records with record.index >= index, in order."""
+        for seg in self.segments:
+            if seg.last_index < index:
+                continue
+            yield from seg.read_from(index)
+
+    def read_entry(self, index: int) -> JournalRecord | None:
+        """Random-access read of one record by index (O(segment count) + one
+        sparse-bounded walk; no whole-segment materialization)."""
+        for seg in self.segments:
+            if seg.first_index <= index <= seg.last_index:
+                return seg.read_entry(index)
+        return None
+
+    def entries_meta(self) -> Iterator[tuple[int, int]]:
+        """Yield (index, asqn) for every record — header-only scan used to
+        rebuild derived indexes on open (e.g. the log stream's position map)."""
+        for seg in self.segments:
+            f = seg.file
+            f.flush()
+            offset = _SEG_HEADER.size
+            while offset < seg.size:
+                f.seek(offset)
+                head = f.read(_FRAME.size)
+                if len(head) < _FRAME.size:
+                    break
+                length, _, rec_index, asqn = _FRAME.unpack(head)
+                yield rec_index, asqn
+                offset += _FRAME.size + length
+
+    def seek_to_asqn(self, asqn: int) -> int:
+        """Return the index of the last record with record.asqn <= asqn
+        (0 if none) — recovery's entry point (reference: Journal.seekToAsqn)."""
+        best = 0
+        for rec in self.read_from(self.first_index):
+            if rec.asqn != ASQN_IGNORE and rec.asqn <= asqn:
+                best = rec.index
+            elif rec.asqn != ASQN_IGNORE and rec.asqn > asqn:
+                break
+        return best
+
+    # -- admin ---------------------------------------------------------------
+
+    def truncate_after(self, index: int) -> None:
+        """Remove all records after ``index`` (Raft conflict resolution)."""
+        while len(self.segments) > 1 and self.segments[-1].first_index > index:
+            self.segments.pop().delete()
+        self.segments[-1].truncate_after(index)
+
+    def compact(self, index: int) -> None:
+        """Delete whole segments whose records are all < ``index`` (snapshot
+        compaction; reference: SegmentedJournal.deleteUntil). Never deletes the
+        tail segment."""
+        while len(self.segments) > 1 and self.segments[0].last_index < index:
+            self.segments.pop(0).delete()
+
+    def reset(self, next_index: int) -> None:
+        """Discard everything and restart at ``next_index`` (snapshot install)."""
+        for seg in self.segments:
+            seg.delete()
+        self.segments = [_Segment(self._segment_path(1), 1, next_index, create=True)]

@@ -1,0 +1,17 @@
+"""Per-partition log stream abstraction (SURVEY.md §2.5)."""
+
+from zeebe_tpu.logstreams.log_stream import (
+    LogAppendEntry,
+    LoggedRecord,
+    LogStream,
+    LogStreamReader,
+    LogStreamWriter,
+)
+
+__all__ = [
+    "LogAppendEntry",
+    "LoggedRecord",
+    "LogStream",
+    "LogStreamReader",
+    "LogStreamWriter",
+]

@@ -147,18 +147,24 @@ def _pack_int(v: int, buf: bytearray) -> None:
             raise MsgPackError(f"int too small: {v}")
 
 
+_MAX_DEPTH = 256
+
+
 class _Reader:
-    __slots__ = ("data", "pos")
+    __slots__ = ("data", "pos", "depth")
 
     def __init__(self, data: bytes) -> None:
         self.data = data
         self.pos = 0
+        self.depth = 0
 
     def read(self) -> Any:
         data = self.data
         i = self.pos
         if i >= len(data):
             raise MsgPackError("truncated msgpack data")
+        if self.depth > _MAX_DEPTH:
+            raise MsgPackError(f"msgpack nesting exceeds {_MAX_DEPTH}")
         b = data[i]
         self.pos = i + 1
         if b < 0x80:  # positive fixint
@@ -187,13 +193,18 @@ class _Reader:
         return self._take(n).decode("utf-8")
 
     def _read_array(self, n: int) -> list:
-        return [self.read() for _ in range(n)]
+        self.depth += 1
+        out = [self.read() for _ in range(n)]
+        self.depth -= 1
+        return out
 
     def _read_map(self, n: int) -> dict:
+        self.depth += 1
         out = {}
         for _ in range(n):
             k = self.read()
             out[k] = self.read()
+        self.depth -= 1
         return out
 
     def _u(self, fmt: str, n: int) -> int:

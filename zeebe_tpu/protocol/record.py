@@ -77,6 +77,12 @@ class Record:
 
     def to_bytes(self) -> bytes:
         reason = self.rejection_reason.encode("utf-8")
+        if len(reason) > 0xFFFF:
+            # the wire field is u16; truncate on a codepoint boundary so an
+            # oversized error message can never poison the append path
+            reason = reason[:0xFFFF]
+            while reason and (reason[-1] & 0xC0) == 0x80:
+                reason = reason[:-1]
         body = msgpack.packb(dict(self.value))
         header = _HEADER.pack(
             int(self.record_type),
@@ -95,6 +101,13 @@ class Record:
 
     @classmethod
     def from_bytes(cls, data: bytes, position: int = NO_POSITION, partition_id: int = 0) -> "Record":
+        try:
+            return cls._from_bytes(data, position, partition_id)
+        except (struct.error, UnicodeDecodeError, msgpack.MsgPackError) as exc:
+            raise ValueError(f"malformed record frame: {exc}") from exc
+
+    @classmethod
+    def _from_bytes(cls, data: bytes, position: int, partition_id: int) -> "Record":
         (
             record_type,
             value_type,

@@ -1,0 +1,33 @@
+"""State substrate: column-family KV store + snapshot store (SURVEY.md §2.4, §2.6)."""
+
+from zeebe_tpu.state.db import (
+    ColumnFamily,
+    ColumnFamilyCode,
+    Transaction,
+    ZbDb,
+    ZbDbInconsistentError,
+    encode_key,
+)
+from zeebe_tpu.state.snapshot import (
+    FileBasedSnapshotStore,
+    InvalidSnapshotError,
+    PersistedSnapshot,
+    SnapshotChunk,
+    SnapshotId,
+    TransientSnapshot,
+)
+
+__all__ = [
+    "ColumnFamily",
+    "ColumnFamilyCode",
+    "FileBasedSnapshotStore",
+    "InvalidSnapshotError",
+    "PersistedSnapshot",
+    "SnapshotChunk",
+    "SnapshotId",
+    "Transaction",
+    "TransientSnapshot",
+    "ZbDb",
+    "ZbDbInconsistentError",
+    "encode_key",
+]

@@ -1,0 +1,402 @@
+"""Column-family KV state store with transactions — the zb-db equivalent.
+
+Reference: zb-db/src/main/java/io/camunda/zeebe/db/ZeebeDb.java,
+impl/rocksdb/transaction/ZeebeTransaction.java:22, TransactionalColumnFamily,
+DbLong/DbString/DbCompositeKey key types, ConsistencyChecksSettings.java:10.
+
+Like the reference — a single store where *logical* column families share one
+keyspace via an enum prefix — but host-memory-resident: the data set a
+partition owns is bounded by snapshot size, the durability story is the log +
+snapshots (state is always recomputable by replay), so an LSM on disk buys
+nothing on the hot path. The store is an ordered map from encoded
+``(cf, *key_parts)`` tuples to msgpack-able values, with:
+
+- order-preserving key encoding (ints sign-flipped big-endian, strings
+  NUL-terminated) so prefix iteration matches RocksDB iterator semantics;
+- optimistic transactions: an overlay of pending puts/deletes applied on
+  commit, discarded on rollback — the processing state machine wraps each
+  command batch in one transaction (reference: ProcessingStateMachine:55-93);
+- optional foreign-key consistency checks (reference: ForeignKeyChecker);
+- whole-state serialization for the snapshot store (state/snapshot.py).
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+import zlib
+from bisect import bisect_left, insort
+from typing import Any, Callable, Iterator
+
+from zeebe_tpu.protocol import msgpack
+
+
+class ZbDbInconsistentError(Exception):
+    """A consistency check failed (reference: ZeebeDbInconsistentException)."""
+
+
+class ColumnFamilyCode(enum.IntEnum):
+    """Logical column families (reference: protocol/…/ZbColumnFamilies.java:20).
+
+    Only families the engine currently uses are defined; codes are append-only
+    and are the first byte of every encoded key.
+    """
+
+    DEFAULT = 0
+    KEY = 1  # key generator state
+    PROCESS_VERSION = 2
+    PROCESS_CACHE = 3
+    PROCESS_CACHE_BY_ID_AND_VERSION = 4
+    PROCESS_CACHE_DIGEST_BY_ID = 5
+    ELEMENT_INSTANCE_PARENT_CHILD = 6
+    ELEMENT_INSTANCE_KEY = 7
+    NUMBER_OF_TAKEN_SEQUENCE_FLOWS = 8
+    JOBS = 10
+    JOB_STATES = 11
+    JOB_DEADLINES = 12
+    JOB_ACTIVATABLE = 13
+    JOB_BACKOFF = 14
+    MESSAGE_KEY = 20
+    MESSAGES = 21
+    MESSAGE_DEADLINES = 22
+    MESSAGE_IDS = 23
+    MESSAGE_CORRELATED = 24
+    MESSAGE_PROCESSES = 25
+    MESSAGE_SUBSCRIPTION_BY_KEY = 30
+    MESSAGE_SUBSCRIPTION_BY_SENT_TIME = 31
+    MESSAGE_SUBSCRIPTION_BY_NAME_AND_CORRELATION_KEY = 32
+    PROCESS_SUBSCRIPTION_BY_KEY = 33
+    MESSAGE_START_EVENT_SUBSCRIPTION_BY_NAME_AND_KEY = 34
+    MESSAGE_START_EVENT_SUBSCRIPTION_BY_KEY_AND_NAME = 35
+    TIMERS = 40
+    TIMER_DUE_DATES = 41
+    PENDING_DEPLOYMENT = 50
+    DEPLOYMENT_RAW = 51
+    EVENT_SCOPE = 60
+    EVENT_TRIGGER = 61
+    VARIABLES = 70
+    TEMPORARY_VARIABLE_STORE = 71
+    INCIDENTS = 80
+    INCIDENT_PROCESS_INSTANCES = 81
+    INCIDENT_JOBS = 82
+    BANNED_INSTANCE = 90
+    EXPORTER = 100
+    LAST_PROCESSED_POSITION = 101
+    MIGRATIONS_STATE = 102
+    PROCESS_INSTANCE_KEY_BY_DEFINITION_KEY = 103
+    SIGNAL_SUBSCRIPTION_BY_NAME_AND_KEY = 110
+    SIGNAL_SUBSCRIPTION_BY_KEY_AND_NAME = 111
+    DISTRIBUTION = 120
+    PENDING_DISTRIBUTION = 121
+    COMMAND_DISTRIBUTION_RECORD = 122
+    MULTI_INSTANCE_OUTPUT = 130
+    AWAIT_RESULT_METADATA = 131
+    CHECKPOINT = 140
+    FORMS = 150
+    DMN_DECISIONS = 160
+    DMN_DECISION_REQUIREMENTS = 161
+    DMN_LATEST_DECISION_BY_ID = 162
+    DMN_LATEST_DRG_BY_ID = 163
+    USER_TASKS = 170
+    USER_TASK_STATES = 171
+    COMPENSATION_SUBSCRIPTION = 180
+    PROCESS_INSTANCE_RESULT = 190
+
+
+_I64 = struct.Struct(">Q")
+
+
+def _encode_part(part: Any, out: bytearray) -> None:
+    """Order-preserving encoding per key part, type-tagged so mixed-type parts
+    cannot collide: ints sort before strings sort before bytes."""
+    if isinstance(part, bool):
+        raise TypeError("bool key parts are ambiguous; use int 0/1")
+    if isinstance(part, int):
+        out.append(0x01)
+        # flip sign bit: two's-complement int64 → lexicographically ordered u64
+        out += _I64.pack((part & 0xFFFFFFFFFFFFFFFF) ^ 0x8000000000000000)
+    elif isinstance(part, str):
+        raw = part.encode("utf-8")
+        if b"\x00" in raw:
+            raise ValueError("NUL byte in string key part")
+        out.append(0x02)
+        out += raw
+        out.append(0x00)
+    elif isinstance(part, bytes):
+        out.append(0x03)
+        out += _I64.pack(len(part))
+        out += part
+    else:
+        raise TypeError(f"unsupported key part type {type(part).__name__}")
+
+
+def encode_key(cf: ColumnFamilyCode, parts: tuple) -> bytes:
+    out = bytearray(struct.pack(">H", int(cf)))
+    for part in parts:
+        _encode_part(part, out)
+    return bytes(out)
+
+
+_DELETED = object()
+
+
+class Transaction:
+    """Pending puts/deletes overlaying the committed store."""
+
+    __slots__ = ("_db", "_writes", "closed")
+
+    def __init__(self, db: "ZbDb") -> None:
+        self._db = db
+        self._writes: dict[bytes, Any] = {}
+        self.closed = False
+
+    def get(self, key: bytes) -> Any:
+        if key in self._writes:
+            val = self._writes[key]
+            return None if val is _DELETED else val
+        return self._db._data.get(key)
+
+    def put(self, key: bytes, value: Any) -> None:
+        self._writes[key] = value
+
+    def delete(self, key: bytes) -> None:
+        self._writes[key] = _DELETED
+
+    def exists(self, key: bytes) -> bool:
+        if key in self._writes:
+            return self._writes[key] is not _DELETED
+        return key in self._db._data
+
+    def iterate(self, prefix: bytes) -> Iterator[tuple[bytes, Any]]:
+        """Ordered iteration over committed ∪ pending entries under prefix.
+
+        Snapshot semantics (RocksDB-iterator-like): the view is materialized at
+        call time, so scan-and-update loops (job deadlines, timer due dates)
+        see a stable snapshot and never skip or double-see entries mutated
+        mid-iteration.
+        """
+        db = self._db
+        snapshot: list[tuple[bytes, Any]] = []
+        overlay = {k: v for k, v in self._writes.items() if k.startswith(prefix)}
+        for key in db._keys_with_prefix(prefix):
+            if key in overlay:
+                continue  # superseded by pending write/delete
+            snapshot.append((key, db._data[key]))
+        for key, val in overlay.items():
+            if val is not _DELETED:
+                snapshot.append((key, val))
+        snapshot.sort(key=lambda kv: kv[0])
+        return iter(snapshot)
+
+    def commit(self) -> None:
+        db = self._db
+        for key, val in self._writes.items():
+            if val is _DELETED:
+                db._delete_committed(key)
+            else:
+                db._put_committed(key, val)
+        self._writes.clear()
+        self.closed = True
+
+    def rollback(self) -> None:
+        self._writes.clear()
+        self.closed = True
+
+
+class ColumnFamily:
+    """Typed facade over one logical column family within a transaction context.
+
+    Keys are tuples of (int | str | bytes); values any msgpack-able object.
+    Mirrors the reference's TransactionalColumnFamily get/put/iterate surface.
+    """
+
+    __slots__ = ("_db", "code", "_prefix")
+
+    def __init__(self, db: "ZbDb", code: ColumnFamilyCode) -> None:
+        self._db = db
+        self.code = code
+        self._prefix = struct.pack(">H", int(code))
+
+    def _ctx(self) -> Transaction:
+        return self._db.require_transaction()
+
+    def _key(self, key_parts: tuple) -> bytes:
+        if not isinstance(key_parts, tuple):
+            key_parts = (key_parts,)
+        return encode_key(self.code, key_parts)
+
+    def get(self, key_parts: tuple) -> Any:
+        return self._ctx().get(self._key(key_parts))
+
+    def exists(self, key_parts: tuple) -> bool:
+        return self._ctx().exists(self._key(key_parts))
+
+    def put(self, key_parts: tuple, value: Any) -> None:
+        self._db._check_foreign_keys(self.code, value)
+        self._ctx().put(self._key(key_parts), value)
+
+    def insert(self, key_parts: tuple, value: Any) -> None:
+        """Put that requires the key to be absent (consistency precondition)."""
+        key = self._key(key_parts)
+        ctx = self._ctx()
+        if self._db.consistency_checks and ctx.exists(key):
+            raise ZbDbInconsistentError(f"insert: key already exists in {self.code.name}: {key_parts}")
+        self._db._check_foreign_keys(self.code, value)
+        ctx.put(key, value)
+
+    def update(self, key_parts: tuple, value: Any) -> None:
+        """Put that requires the key to exist (consistency precondition)."""
+        key = self._key(key_parts)
+        ctx = self._ctx()
+        if self._db.consistency_checks and not ctx.exists(key):
+            raise ZbDbInconsistentError(f"update: key missing in {self.code.name}: {key_parts}")
+        self._db._check_foreign_keys(self.code, value)
+        ctx.put(key, value)
+
+    def delete(self, key_parts: tuple) -> None:
+        key = self._key(key_parts)
+        ctx = self._ctx()
+        if self._db.consistency_checks and not ctx.exists(key):
+            raise ZbDbInconsistentError(f"delete: key missing in {self.code.name}: {key_parts}")
+        ctx.delete(key)
+
+    def items(self, prefix: tuple = ()) -> Iterator[tuple[bytes, Any]]:
+        """Iterate (encoded_key, value) pairs under a key-part prefix, ordered."""
+        pfx = self._prefix
+        if prefix:
+            out = bytearray(pfx)
+            for part in prefix:
+                _encode_part(part, out)
+            pfx = bytes(out)
+        yield from self._ctx().iterate(pfx)
+
+    def values(self, prefix: tuple = ()) -> Iterator[Any]:
+        for _, v in self.items(prefix):
+            yield v
+
+    def is_empty(self, prefix: tuple = ()) -> bool:
+        return next(self.items(prefix), None) is None
+
+    def first_value(self, prefix: tuple = ()) -> Any:
+        item = next(self.items(prefix), None)
+        return None if item is None else item[1]
+
+
+class ZbDb:
+    """The partition state store. One instance per partition.
+
+    ``transaction()`` is a context manager committing on success, rolling back
+    on exception — the unit of processing atomicity.
+    """
+
+    def __init__(self, consistency_checks: bool = False) -> None:
+        self._data: dict[bytes, Any] = {}
+        self._sorted_keys: list[bytes] = []
+        self._txn: Transaction | None = None
+        self.consistency_checks = consistency_checks
+        self._foreign_key_checkers: dict[ColumnFamilyCode, Callable[["ZbDb", Any], None]] = {}
+
+    # -- committed-store internals ------------------------------------------
+
+    def _put_committed(self, key: bytes, value: Any) -> None:
+        if key not in self._data:
+            insort(self._sorted_keys, key)
+        self._data[key] = value
+
+    def _delete_committed(self, key: bytes) -> None:
+        if key in self._data:
+            del self._data[key]
+            i = bisect_left(self._sorted_keys, key)
+            if i < len(self._sorted_keys) and self._sorted_keys[i] == key:
+                self._sorted_keys.pop(i)
+
+    def _keys_with_prefix(self, prefix: bytes) -> list[bytes]:
+        lo = bisect_left(self._sorted_keys, prefix)
+        hi = bisect_left(self._sorted_keys, prefix + b"\xff\xff\xff\xff\xff\xff\xff\xff\xff")
+        keys = self._sorted_keys[lo:hi]
+        # conservative guard against the hi-bound heuristic overshooting
+        return [k for k in keys if k.startswith(prefix)]
+
+    # -- transactions --------------------------------------------------------
+
+    def transaction(self) -> "_TxnContext":
+        return _TxnContext(self)
+
+    def require_transaction(self) -> Transaction:
+        if self._txn is None or self._txn.closed:
+            raise RuntimeError("state access outside a transaction")
+        return self._txn
+
+    @property
+    def in_transaction(self) -> bool:
+        return self._txn is not None and not self._txn.closed
+
+    # -- column families -----------------------------------------------------
+
+    def column_family(self, code: ColumnFamilyCode) -> ColumnFamily:
+        return ColumnFamily(self, code)
+
+    def register_foreign_key_check(
+        self, code: ColumnFamilyCode, check: Callable[["ZbDb", Any], None]
+    ) -> None:
+        self._foreign_key_checkers[code] = check
+
+    def _check_foreign_keys(self, code: ColumnFamilyCode, value: Any) -> None:
+        if self.consistency_checks:
+            checker = self._foreign_key_checkers.get(code)
+            if checker is not None:
+                checker(self, value)
+
+    # -- snapshot serialization ---------------------------------------------
+
+    SNAPSHOT_MAGIC = b"ZSNP\x01"
+
+    def to_snapshot_bytes(self) -> bytes:
+        """Serialize the committed state (msgpack body + crc32 trailer)."""
+        if self.in_transaction:
+            raise RuntimeError("cannot snapshot with an open transaction")
+        body = msgpack.packb(
+            [[k, v] for k, v in ((k, self._data[k]) for k in self._sorted_keys)]
+        )
+        crc = zlib.crc32(body) & 0xFFFFFFFF
+        return self.SNAPSHOT_MAGIC + struct.pack("<I", crc) + body
+
+    @classmethod
+    def from_snapshot_bytes(cls, raw: bytes, consistency_checks: bool = False) -> "ZbDb":
+        if raw[:5] != cls.SNAPSHOT_MAGIC:
+            raise ValueError("bad state snapshot magic")
+        (crc,) = struct.unpack_from("<I", raw, 5)
+        body = raw[9:]
+        if zlib.crc32(body) & 0xFFFFFFFF != crc:
+            raise ValueError("state snapshot checksum mismatch")
+        db = cls(consistency_checks=consistency_checks)
+        for k, v in msgpack.unpackb(body):
+            db._data[k] = v
+            db._sorted_keys.append(k)
+        return db
+
+    def content_equals(self, other: "ZbDb") -> bool:
+        """Deep state equality — the replay≡processing test oracle."""
+        return self._data == other._data
+
+
+class _TxnContext:
+    __slots__ = ("_db", "_txn")
+
+    def __init__(self, db: ZbDb) -> None:
+        self._db = db
+
+    def __enter__(self) -> Transaction:
+        if self._db.in_transaction:
+            raise RuntimeError("nested transactions are not supported")
+        self._txn = Transaction(self._db)
+        self._db._txn = self._txn
+        return self._txn
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if not self._txn.closed:
+            if exc_type is None:
+                self._txn.commit()
+            else:
+                self._txn.rollback()
+        self._db._txn = None

@@ -1,0 +1,265 @@
+"""File-based snapshot store: transient → persisted snapshot lifecycle.
+
+Reference: snapshot/src/main/java/io/camunda/zeebe/snapshots/impl/
+FileBasedSnapshotStore.java:51, FileBasedSnapshotId.java, SfvChecksumImpl.java,
+FileBasedSnapshotChunkReader.java.
+
+A snapshot is a directory of files identified by
+``<index>-<term>-<processedPosition>-<exportedPosition>``; it is written into a
+pending dir, checksummed (one CRC per file recorded in an SFV-style manifest),
+then atomically renamed into place. Only the latest valid snapshot is kept
+(older ones are purged on persist), except snapshots pinned by a reservation
+(backup in progress). A chunk reader serves replication to followers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import shutil
+import zlib
+from pathlib import Path
+from typing import Callable, Iterator
+
+_ID_RE = re.compile(r"^(\d+)-(\d+)-(\d+)-(\d+)$")
+_MANIFEST = "CHECKSUM.sfv"
+
+
+class InvalidSnapshotError(Exception):
+    pass
+
+
+@dataclasses.dataclass(frozen=True, order=True, slots=True)
+class SnapshotId:
+    """Ordering is by (index, term, processed_position, exported_position) —
+    field order matters for comparisons (reference: FileBasedSnapshotId)."""
+
+    index: int
+    term: int
+    processed_position: int
+    exported_position: int
+
+    def __str__(self) -> str:
+        return f"{self.index}-{self.term}-{self.processed_position}-{self.exported_position}"
+
+    @classmethod
+    def parse(cls, name: str) -> "SnapshotId | None":
+        m = _ID_RE.match(name)
+        if not m:
+            return None
+        return cls(*(int(g) for g in m.groups()))
+
+
+def _file_crc(path: Path) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while chunk := f.read(1 << 20):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _write_manifest(directory: Path) -> None:
+    lines = []
+    for p in sorted(directory.iterdir()):
+        if p.name != _MANIFEST and p.is_file():
+            lines.append(f"{p.name}\t{_file_crc(p):08x}\n")
+    (directory / _MANIFEST).write_text("".join(lines))
+
+
+def _verify_manifest(directory: Path) -> bool:
+    manifest = directory / _MANIFEST
+    if not manifest.exists():
+        return False
+    expected = {}
+    for line in manifest.read_text().splitlines():
+        name, _, crc = line.partition("\t")
+        expected[name] = int(crc, 16)
+    actual = {
+        p.name: _file_crc(p) for p in directory.iterdir() if p.is_file() and p.name != _MANIFEST
+    }
+    return expected == actual
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class PersistedSnapshot:
+    id: SnapshotId
+    path: Path
+
+    def files(self) -> list[Path]:
+        return sorted(p for p in self.path.iterdir() if p.is_file())
+
+    def read_file(self, name: str) -> bytes:
+        return (self.path / name).read_bytes()
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class SnapshotChunk:
+    """One replication unit (reference: SnapshotChunk SBE message)."""
+
+    snapshot_id: str
+    chunk_name: str
+    offset: int
+    total_size: int
+    data: bytes
+    checksum: int
+
+
+class TransientSnapshot:
+    """A snapshot being taken; becomes persisted (and visible) only on persist()."""
+
+    def __init__(self, store: "FileBasedSnapshotStore", snap_id: SnapshotId) -> None:
+        self._store = store
+        self.id = snap_id
+        self.path = store.pending_dir / str(snap_id)
+        if self.path.exists():
+            shutil.rmtree(self.path)
+        self.path.mkdir(parents=True)
+        self._taken = False
+
+    def take(self, writer: Callable[[Path], None]) -> None:
+        """Run ``writer(dir)`` to populate the snapshot directory."""
+        writer(self.path)
+        self._taken = True
+
+    def write_file(self, name: str, data: bytes) -> None:
+        (self.path / name).write_bytes(data)
+        self._taken = True
+
+    def persist(self) -> PersistedSnapshot:
+        if not self._taken:
+            raise InvalidSnapshotError("transient snapshot has no content")
+        _write_manifest(self.path)
+        return self._store._persist(self)
+
+    def abort(self) -> None:
+        shutil.rmtree(self.path, ignore_errors=True)
+
+
+class FileBasedSnapshotStore:
+    """Snapshot lifecycle manager for one partition's state directory."""
+
+    def __init__(self, directory: str | Path) -> None:
+        self.root = Path(directory)
+        self.snapshots_dir = self.root / "snapshots"
+        self.pending_dir = self.root / "pending"
+        self.snapshots_dir.mkdir(parents=True, exist_ok=True)
+        self.pending_dir.mkdir(parents=True, exist_ok=True)
+        self._reservations: set[SnapshotId] = set()
+        # clean pending leftovers from a crash
+        for p in self.pending_dir.iterdir():
+            shutil.rmtree(p, ignore_errors=True)
+        # drop corrupt persisted snapshots (crash mid-rename etc.)
+        for p in list(self.snapshots_dir.iterdir()):
+            snap_id = SnapshotId.parse(p.name)
+            if snap_id is None or not _verify_manifest(p):
+                shutil.rmtree(p, ignore_errors=True)
+
+    # -- queries -------------------------------------------------------------
+
+    def latest_snapshot(self) -> PersistedSnapshot | None:
+        best: SnapshotId | None = None
+        for p in self.snapshots_dir.iterdir():
+            snap_id = SnapshotId.parse(p.name)
+            if snap_id is not None and (best is None or snap_id > best):
+                best = snap_id
+        if best is None:
+            return None
+        return PersistedSnapshot(best, self.snapshots_dir / str(best))
+
+    def list_snapshots(self) -> list[PersistedSnapshot]:
+        out = []
+        for p in sorted(self.snapshots_dir.iterdir()):
+            snap_id = SnapshotId.parse(p.name)
+            if snap_id is not None:
+                out.append(PersistedSnapshot(snap_id, p))
+        return sorted(out, key=lambda s: s.id)
+
+    # -- take ----------------------------------------------------------------
+
+    def new_transient_snapshot(
+        self, index: int, term: int, processed_position: int, exported_position: int
+    ) -> TransientSnapshot:
+        snap_id = SnapshotId(index, term, processed_position, exported_position)
+        latest = self.latest_snapshot()
+        if latest is not None and snap_id <= latest.id:
+            raise InvalidSnapshotError(
+                f"snapshot {snap_id} is not newer than latest {latest.id}"
+            )
+        return TransientSnapshot(self, snap_id)
+
+    def _persist(self, transient: TransientSnapshot) -> PersistedSnapshot:
+        target = self.snapshots_dir / str(transient.id)
+        if target.exists():
+            shutil.rmtree(target)
+        os.replace(transient.path, target)
+        self._fsync_dir(self.snapshots_dir)
+        self._purge_older_than(transient.id)
+        return PersistedSnapshot(transient.id, target)
+
+    @staticmethod
+    def _fsync_dir(path: Path) -> None:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def _purge_older_than(self, keep: SnapshotId) -> None:
+        for snap in self.list_snapshots():
+            if snap.id < keep and snap.id not in self._reservations:
+                shutil.rmtree(snap.path, ignore_errors=True)
+
+    # -- reservations (pin during backup) ------------------------------------
+
+    def reserve(self, snap_id: SnapshotId) -> None:
+        self._reservations.add(snap_id)
+
+    def release(self, snap_id: SnapshotId) -> None:
+        self._reservations.discard(snap_id)
+        latest = self.latest_snapshot()
+        if latest is not None:
+            self._purge_older_than(latest.id)
+
+    # -- replication ---------------------------------------------------------
+
+    def chunk_reader(
+        self, snapshot: PersistedSnapshot, chunk_size: int = 1 << 20
+    ) -> Iterator[SnapshotChunk]:
+        """Stream a snapshot as checksummed chunks (leader → follower install)."""
+        for f in snapshot.files():
+            data = f.read_bytes()
+            total = len(data)
+            for off in range(0, max(total, 1), chunk_size):
+                piece = data[off : off + chunk_size]
+                yield SnapshotChunk(
+                    snapshot_id=str(snapshot.id),
+                    chunk_name=f.name,
+                    offset=off,
+                    total_size=total,
+                    data=piece,
+                    checksum=zlib.crc32(piece) & 0xFFFFFFFF,
+                )
+
+    def receive_snapshot(self, chunks: Iterator[SnapshotChunk]) -> PersistedSnapshot:
+        """Follower side: rebuild a snapshot from replicated chunks."""
+        transient: TransientSnapshot | None = None
+        files: dict[str, bytearray] = {}
+        snap_id: SnapshotId | None = None
+        for chunk in chunks:
+            if zlib.crc32(chunk.data) & 0xFFFFFFFF != chunk.checksum:
+                raise InvalidSnapshotError(f"chunk checksum mismatch: {chunk.chunk_name}")
+            if snap_id is None:
+                snap_id = SnapshotId.parse(chunk.snapshot_id)
+                if snap_id is None:
+                    raise InvalidSnapshotError(f"bad snapshot id {chunk.snapshot_id}")
+            buf = files.setdefault(chunk.chunk_name, bytearray())
+            if len(buf) != chunk.offset:
+                raise InvalidSnapshotError(f"out-of-order chunk for {chunk.chunk_name}")
+            buf += chunk.data
+        if snap_id is None:
+            raise InvalidSnapshotError("no chunks received")
+        transient = TransientSnapshot(self, snap_id)
+        for name, buf in files.items():
+            transient.write_file(name, bytes(buf))
+        return transient.persist()

@@ -1,0 +1,262 @@
+"""Stream processor: replay → processing state machines over one partition's log.
+
+Reference: stream-platform/src/main/java/io/camunda/zeebe/stream/impl/
+StreamProcessor.java:77 (phases), ProcessingStateMachine.java:94 (command loop
+documented at :55-93, batchProcessing :328-374), ReplayStateMachine.java:42
+(REPLAY_FILTER: events only), StreamProcessorMode.java.
+
+The command loop per step:
+  read next unprocessed command → open txn → process (engine applies events to
+  state as it appends them) → recursively process follow-up commands in the same
+  txn up to ``max_commands_in_batch`` (marking them processed in the log) →
+  append all follow-ups as one batch (source = command position) → record last
+  processed position → commit → execute side effects (client responses).
+
+Replay applies EVENT records only (processed-marked commands and rejections are
+skipped) and tracks the last processed position from event source backlinks, so
+a restarted or follower partition reaches state identical to the one that
+processed the commands — the determinism contract the whole design rests on
+(and what lets the TPU backend batch thousands of steps without changing
+observable semantics).
+
+Synchronous and pump-driven: callers (broker partition actor, tests, bench)
+call ``run_until_idle``. The reference's actor pipeline exists to decouple
+threads; one owner thread per partition gives the same single-writer guarantee.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+from typing import Callable
+
+from zeebe_tpu.logstreams import LogAppendEntry, LoggedRecord, LogStream
+from zeebe_tpu.protocol import Record, RecordType, RejectionType, rejection
+from zeebe_tpu.state import ColumnFamilyCode, ZbDb
+from zeebe_tpu.stream.api import (
+    ClientResponse,
+    ExceededBatchRecordSizeError,
+    ProcessingErrorHandling,
+    ProcessingResultBuilder,
+    ProcessingScheduleService,
+    RecordProcessor,
+)
+
+logger = logging.getLogger("zeebe_tpu.stream")
+
+
+class Phase(enum.Enum):
+    INITIAL = "initial"
+    REPLAY = "replay"
+    PROCESSING = "processing"
+    FAILED = "failed"
+
+
+class StreamProcessorMode(enum.Enum):
+    """PROCESSING: replay then process (leaders). REPLAY: replay continuously
+    (followers) — reference: StreamProcessorMode.java:10-22."""
+
+    PROCESSING = "processing"
+    REPLAY = "replay"
+
+
+class StreamProcessor:
+    """One partition's processing heart. Owns the db transaction lifecycle."""
+
+    def __init__(
+        self,
+        log_stream: LogStream,
+        db: ZbDb,
+        processor: RecordProcessor,
+        mode: StreamProcessorMode = StreamProcessorMode.PROCESSING,
+        max_commands_in_batch: int = 100,
+        response_sink: Callable[[ClientResponse], None] | None = None,
+        clock_millis: Callable[[], int] | None = None,
+    ) -> None:
+        self.log_stream = log_stream
+        self.db = db
+        self.processor = processor
+        self.mode = mode
+        self.max_commands_in_batch = max_commands_in_batch
+        self.response_sink = response_sink or (lambda response: None)
+        self.phase = Phase.INITIAL
+        self._positions = db.column_family(ColumnFamilyCode.LAST_PROCESSED_POSITION)
+        clock = clock_millis or log_stream.clock_millis
+        self.schedule_service = ProcessingScheduleService(clock, self._write_scheduled_commands)
+        self._reader_position = 1
+        self.last_processed_position = -1
+        self.last_written_position = -1
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _load_last_processed(self) -> int:
+        with self.db.transaction():
+            pos = self._positions.get(("last",))
+        return pos if pos is not None else -1
+
+    def _store_last_processed(self, position: int) -> None:
+        # caller must hold the open processing transaction
+        self._positions.put(("last",), position)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """Recover: replay from the last processed position, then (in
+        PROCESSING mode) become ready to process commands."""
+        self.phase = Phase.REPLAY
+        self.last_processed_position = self._load_last_processed()
+        self._reader_position = 1 if self.last_processed_position < 0 else self.last_processed_position + 1
+        self.replay_available()
+        if self.mode == StreamProcessorMode.PROCESSING:
+            self.phase = Phase.PROCESSING
+            # processing scans from the start of the unreplayed suffix
+            self._reader_position = (
+                1 if self.last_processed_position < 0 else self.last_processed_position + 1
+            )
+
+    # -- replay --------------------------------------------------------------
+
+    def replay_available(self) -> int:
+        """Apply committed events not yet reflected in state. Returns number of
+        events applied. In REPLAY mode this is the follower's steady state."""
+        applied = 0
+        position = self._reader_position
+        while True:
+            logged = self.log_stream.read_at_or_after(position)
+            if logged is None:
+                break
+            batch = self.log_stream.read_batch_containing(logged.position)
+            with self.db.transaction():
+                max_source = -1
+                for rec in batch:
+                    if rec.position < position:
+                        continue
+                    # Skip events already reflected in state: their producing
+                    # command's position (source backlink) is <= the recovered
+                    # last-processed position. This is what makes snapshot +
+                    # replay idempotent (reference: ReplayStateMachine skips
+                    # up to the snapshot's processed position).
+                    if rec.record.is_event and rec.source_position > self.last_processed_position:
+                        self.processor.replay(rec)
+                        applied += 1
+                        if rec.source_position > max_source:
+                            max_source = rec.source_position
+                if max_source > self.last_processed_position:
+                    self.last_processed_position = max_source
+                    self._store_last_processed(max_source)
+            position = batch[-1].position + 1
+        self._reader_position = position
+        return applied
+
+    # -- processing ----------------------------------------------------------
+
+    def _next_command(self) -> LoggedRecord | None:
+        position = self._reader_position
+        while True:
+            logged = self.log_stream.read_at_or_after(position)
+            if logged is None:
+                self._reader_position = position
+                return None
+            if logged.record.is_command and not logged.processed:
+                self._reader_position = logged.position + 1
+                return logged
+            position = logged.position + 1
+
+    def process_next(self) -> bool:
+        """Process one command; returns False when no command is pending."""
+        if self.phase != Phase.PROCESSING:
+            raise RuntimeError(f"cannot process in phase {self.phase}")
+        cmd = self._next_command()
+        if cmd is None:
+            return False
+        self._process_command(cmd)
+        return True
+
+    def _process_command(self, cmd: LoggedRecord) -> None:
+        builder = ProcessingResultBuilder()
+        try:
+            with self.db.transaction():
+                self._batch_process(cmd, builder)
+                self._write_and_mark(cmd, builder)
+        except Exception as error:  # noqa: BLE001 — the rollback/onError seam
+            logger.debug("processing error at position %s: %s", cmd.position, error, exc_info=True)
+            self._on_processing_error(cmd, error)
+            return
+        self._execute_side_effects(builder)
+
+    def _batch_process(self, cmd: LoggedRecord, builder: ProcessingResultBuilder) -> None:
+        """The batchProcessing loop: the input command plus follow-up commands
+        produced during the step, processed in one transaction."""
+        self.processor.process(cmd, builder)
+        budget = self.max_commands_in_batch - 1
+        scan = 0
+        while budget > 0:
+            follow_up = None
+            while scan < len(builder.follow_ups):
+                entry = builder.follow_ups[scan]
+                if entry.record.is_command and not entry.processed:
+                    follow_up = entry
+                    break
+                scan += 1
+            if follow_up is None:
+                break
+            follow_up.processed = True
+            budget -= 1
+            logged = LoggedRecord(
+                record=follow_up.record,
+                position=-1,  # in-batch: position assigned at write time
+                source_position=cmd.position,
+                processed=True,
+            )
+            self.processor.process(logged, builder)
+            scan += 1
+
+    def _write_and_mark(self, cmd: LoggedRecord, builder: ProcessingResultBuilder) -> None:
+        entries = [LogAppendEntry(f.record, f.processed) for f in builder.follow_ups]
+        if entries:
+            self.last_written_position = self.log_stream.writer.try_write(
+                entries, source_position=cmd.position
+            )
+        self.last_processed_position = cmd.position
+        self._store_last_processed(cmd.position)
+
+    def _on_processing_error(self, cmd: LoggedRecord, error: Exception) -> None:
+        builder = ProcessingResultBuilder()
+        with self.db.transaction():
+            handling = self.processor.on_processing_error(error, cmd, builder)
+            if handling == ProcessingErrorHandling.REJECT and builder.response is None:
+                rej = rejection(cmd.record.replace(position=cmd.position),
+                                RejectionType.PROCESSING_ERROR, str(error)[:8192])
+                builder.append_record(rej)
+                if cmd.record.request_id >= 0:
+                    builder.with_response(rej, cmd.record.request_stream_id, cmd.record.request_id)
+            self._write_and_mark(cmd, builder)
+        self._execute_side_effects(builder)
+
+    def _execute_side_effects(self, builder: ProcessingResultBuilder) -> None:
+        if builder.response is not None:
+            self.response_sink(builder.response)
+        for task in builder.post_commit_tasks:
+            try:
+                task()
+            except Exception:  # noqa: BLE001 — side effects must not wedge the loop
+                logger.exception("post-commit task failed")
+
+    # -- pump ----------------------------------------------------------------
+
+    def _write_scheduled_commands(self, commands: list[Record]) -> None:
+        self.log_stream.writer.try_write([LogAppendEntry(c) for c in commands])
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> int:
+        """Drive scheduled tasks + processing until no work remains (or, in
+        REPLAY mode, replay everything available). Returns steps executed."""
+        steps = 0
+        if self.phase == Phase.REPLAY:
+            return self.replay_available()
+        while steps < max_steps:
+            self.schedule_service.run_due_tasks()
+            if not self.process_next():
+                if self.schedule_service.run_due_tasks() == 0:
+                    break
+            steps += 1
+        return steps
