@@ -1,0 +1,266 @@
+"""BPMN model tests: fluent builder, XML roundtrip, transformer validation."""
+
+import pytest
+
+from zeebe_tpu.models.bpmn import (
+    Bpmn,
+    BpmnModelError,
+    ProcessValidationError,
+    parse_bpmn_xml,
+    to_bpmn_xml,
+    transform,
+)
+from zeebe_tpu.protocol.enums import BpmnElementType, BpmnEventType
+
+
+def one_task():
+    return (
+        Bpmn.create_executable_process("one_task")
+        .start_event("start")
+        .service_task("task", job_type="work")
+        .end_event("end")
+        .done()
+    )
+
+
+def branching():
+    return (
+        Bpmn.create_executable_process("branching")
+        .start_event("start")
+        .exclusive_gateway("gw")
+        .sequence_flow_id("to_big")
+        .condition_expression("amount >= 100")
+        .service_task("big", job_type="big-order")
+        .end_event("end_big")
+        .move_to_element("gw")
+        .sequence_flow_id("to_small")
+        .default_flow()
+        .service_task("small", job_type="small-order")
+        .end_event("end_small")
+        .done()
+    )
+
+
+def fork_join():
+    return (
+        Bpmn.create_executable_process("fork_join")
+        .start_event("start")
+        .parallel_gateway("fork")
+        .service_task("a", job_type="a")
+        .parallel_gateway("join")
+        .end_event("end")
+        .move_to_element("fork")
+        .service_task("b", job_type="b")
+        .connect_to("join")
+        .done()
+    )
+
+
+class TestBuilder:
+    def test_linear_process(self):
+        model = one_task()
+        assert set(model.elements) == {"start", "task", "end"}
+        assert len(model.flows) == 2
+        assert model.elements["task"].job_type == "work"
+        flows = model.outgoing("start")
+        assert len(flows) == 1 and flows[0].target_id == "task"
+
+    def test_branching_with_conditions(self):
+        model = branching()
+        gw_out = model.outgoing("gw")
+        assert len(gw_out) == 2
+        to_big = model.flows["to_big"]
+        assert to_big.condition == "amount >= 100"
+        assert model.elements["gw"].default_flow_id == "to_small"
+
+    def test_fork_join(self):
+        model = fork_join()
+        assert len(model.incoming("join")) == 2
+        assert len(model.outgoing("fork")) == 2
+
+    def test_duplicate_id_rejected(self):
+        with pytest.raises(BpmnModelError):
+            Bpmn.create_executable_process("p").start_event("x").end_event("x")
+
+    def test_sub_process(self):
+        model = (
+            Bpmn.create_executable_process("p")
+            .start_event("start")
+            .sub_process("sub")
+            .start_event("sub_start")
+            .end_event("sub_end")
+            .sub_process_done()
+            .end_event("end")
+            .done()
+        )
+        assert model.elements["sub_start"].parent_id == "sub"
+        assert model.elements["sub"].parent_id is None
+        # flow from sub-process to end exists
+        assert any(f.source_id == "sub" and f.target_id == "end" for f in model.flows.values())
+
+    def test_boundary_event(self):
+        model = (
+            Bpmn.create_executable_process("p")
+            .start_event("s")
+            .service_task("t", job_type="w")
+            .boundary_timer("tmr", attached_to="t", duration="PT5S")
+            .end_event("timeout_end")
+            .move_to_element("t")
+            .end_event("e")
+            .done()
+        )
+        assert model.elements["tmr"].attached_to_id == "t"
+        assert model.outgoing("tmr")[0].target_id == "timeout_end"
+
+
+class TestXmlRoundtrip:
+    @pytest.mark.parametrize("factory", [one_task, branching, fork_join])
+    def test_roundtrip(self, factory):
+        model = factory()
+        xml = to_bpmn_xml(model)
+        parsed = parse_bpmn_xml(xml)[0]
+        assert set(parsed.elements) == set(model.elements)
+        assert set(parsed.flows) == set(model.flows)
+        for fid, flow in model.flows.items():
+            assert parsed.flows[fid].condition == flow.condition
+        for eid, el in model.elements.items():
+            assert parsed.elements[eid].element_type == el.element_type
+            assert parsed.elements[eid].job_type == el.job_type
+
+    def test_message_and_timer_events(self):
+        model = (
+            Bpmn.create_executable_process("evts")
+            .start_event("s")
+            .intermediate_catch_timer("wait", duration="PT10S")
+            .intermediate_catch_message("msg", message_name="order-paid", correlation_key="=orderId")
+            .end_event("e")
+            .done()
+        )
+        parsed = parse_bpmn_xml(to_bpmn_xml(model))[0]
+        assert parsed.elements["wait"].timer.duration == "PT10S"
+        assert parsed.elements["msg"].message.name == "order-paid"
+        assert parsed.elements["msg"].message.correlation_key == "=orderId"
+
+    def test_invalid_xml_rejected(self):
+        with pytest.raises(BpmnModelError):
+            parse_bpmn_xml("<not-bpmn/>")
+        with pytest.raises(BpmnModelError):
+            parse_bpmn_xml("garbage <<<")
+
+    def test_io_mappings_roundtrip(self):
+        model = (
+            Bpmn.create_executable_process("io")
+            .start_event("s")
+            .service_task("t", job_type="w")
+            .zeebe_input("=order.total", "total")
+            .zeebe_output("=result", "outcome")
+            .end_event("e")
+            .done()
+        )
+        parsed = parse_bpmn_xml(to_bpmn_xml(model))[0]
+        el = parsed.elements["t"]
+        assert el.inputs[0].source == "=order.total" and el.inputs[0].target == "total"
+        assert el.outputs[0].source == "=result" and el.outputs[0].target == "outcome"
+
+
+class TestTransform:
+    def test_one_task_executable(self):
+        exe = transform(one_task())
+        assert exe.root.element_type == BpmnElementType.PROCESS
+        assert exe.element("start").idx == exe.none_start_of(0)
+        task = exe.element("task")
+        assert task.job_type.evaluate({}) == "work"
+        assert task.job_retries.evaluate({}) == "3"
+        # adjacency
+        start = exe.element("start")
+        assert len(start.outgoing) == 1
+        assert exe.flows[start.outgoing[0]].target_idx == task.idx
+
+    def test_join_count(self):
+        exe = transform(fork_join())
+        assert exe.element("join").incoming_count == 2
+
+    def test_conditions_parsed(self):
+        exe = transform(branching())
+        gw = exe.element("gw")
+        conds = [exe.flows[f].condition for f in gw.outgoing]
+        evaluated = [c.evaluate({"amount": 150}) if c else None for c in conds]
+        assert True in evaluated
+        assert gw.default_flow_idx >= 0
+
+    def test_validation_no_start(self):
+        model = Bpmn.create_executable_process("p").done()
+        with pytest.raises(ProcessValidationError, match="no start"):
+            transform(model)
+
+    def test_validation_missing_condition(self):
+        model = (
+            Bpmn.create_executable_process("p")
+            .start_event("s")
+            .exclusive_gateway("gw")
+            .end_event("e1")
+            .move_to_element("gw")
+            .end_event("e2")
+            .done()
+        )
+        with pytest.raises(ProcessValidationError, match="condition"):
+            transform(model)
+
+    def test_validation_unreachable(self):
+        builder = Bpmn.create_executable_process("p").start_event("s").end_event("e")
+        builder.model.elements["island"] = type(builder.model.elements["e"])(
+            id="island", element_type=BpmnElementType.TASK
+        )
+        with pytest.raises(ProcessValidationError, match="unreachable"):
+            transform(builder.done())
+
+    def test_validation_bad_feel_rejected(self):
+        model = (
+            Bpmn.create_executable_process("p")
+            .start_event("s")
+            .exclusive_gateway("gw")
+            .condition_expression("amount >")  # parse error (applies to s->gw flow)
+            .end_event("e")
+            .done()
+        )
+        with pytest.raises(ProcessValidationError):
+            transform(model)
+
+    def test_validation_collects_multiple_errors(self):
+        model = (
+            Bpmn.create_executable_process("p")
+            .start_event("s")
+            .exclusive_gateway("gw")
+            .end_event("e1")
+            .move_to_element("gw")
+            .end_event("e2")
+            .done()
+        )
+        model.elements["island"] = type(model.elements["e1"])(
+            id="island", element_type=BpmnElementType.TASK
+        )
+        with pytest.raises(ProcessValidationError) as exc_info:
+            transform(model)
+        assert "condition" in str(exc_info.value) and "unreachable" in str(exc_info.value)
+
+    def test_digest_stable_and_distinct(self):
+        d1 = transform(one_task()).digest
+        d2 = transform(one_task()).digest
+        d3 = transform(branching()).digest
+        assert d1 == d2 != d3
+
+    def test_boundary_transform(self):
+        model = (
+            Bpmn.create_executable_process("p")
+            .start_event("s")
+            .service_task("t", job_type="w")
+            .boundary_timer("tmr", attached_to="t", duration="PT5S")
+            .end_event("te")
+            .move_to_element("t")
+            .end_event("e")
+            .done()
+        )
+        exe = transform(model)
+        assert exe.element("tmr").attached_to_idx == exe.element("t").idx
+        assert exe.element("t").boundary_idxs == [exe.element("tmr").idx]
+        assert exe.element("tmr").event_type == BpmnEventType.TIMER

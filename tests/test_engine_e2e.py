@@ -1,0 +1,365 @@
+"""End-to-end engine tests: deploy → create instance → jobs → completion,
+asserting on the event stream like the reference's RecordingExporter tests.
+
+The intent sequences asserted here mirror the reference engine's published
+event streams for the same scenarios (e.g. the docs' one-task example:
+ACTIVATING/ACTIVATED/COMPLETING/COMPLETED per element, SEQUENCE_FLOW_TAKEN
+between elements, job lifecycle interleaved).
+"""
+
+import pytest
+
+from zeebe_tpu.models.bpmn import Bpmn
+from zeebe_tpu.protocol import RecordType, ValueType
+from zeebe_tpu.protocol.enums import BpmnElementType
+from zeebe_tpu.protocol.intent import (
+    DeploymentIntent,
+    IncidentIntent,
+    JobIntent,
+    ProcessInstanceIntent as PI,
+    ProcessIntent,
+    VariableIntent,
+)
+from zeebe_tpu.testing import EngineHarness
+
+
+@pytest.fixture
+def harness(tmp_path):
+    h = EngineHarness(tmp_path)
+    yield h
+    h.close()
+
+
+def one_task():
+    return (
+        Bpmn.create_executable_process("one_task")
+        .start_event("start")
+        .service_task("task", job_type="work")
+        .end_event("end")
+        .done()
+    )
+
+
+class TestDeployment:
+    def test_deploy_creates_process(self, harness):
+        harness.deploy(one_task())
+        process = harness.exporter.process_records().with_intent(ProcessIntent.CREATED).first()
+        assert process.record.value["bpmnProcessId"] == "one_task"
+        assert process.record.value["version"] == 1
+        deployment = (
+            harness.exporter.deployment_records().with_intent(DeploymentIntent.CREATED).first()
+        )
+        assert deployment.record.value["processesMetadata"][0]["bpmnProcessId"] == "one_task"
+        assert harness.exporter.deployment_records().with_intent(
+            DeploymentIntent.FULLY_DISTRIBUTED
+        ).exists()
+
+    def test_redeploy_same_is_duplicate(self, harness):
+        harness.deploy(one_task())
+        harness.deploy(one_task())
+        deployments = harness.exporter.deployment_records().with_intent(DeploymentIntent.CREATED).to_list()
+        assert deployments[1].record.value["processesMetadata"][0]["duplicate"] is True
+        assert deployments[1].record.value["processesMetadata"][0]["version"] == 1
+        # only one PROCESS CREATED event
+        assert harness.exporter.process_records().with_intent(ProcessIntent.CREATED).count() == 1
+
+    def test_redeploy_changed_bumps_version(self, harness):
+        harness.deploy(one_task())
+        changed = (
+            Bpmn.create_executable_process("one_task")
+            .start_event("start")
+            .service_task("task", job_type="different-type")
+            .end_event("end")
+            .done()
+        )
+        harness.deploy(changed)
+        versions = [
+            r.record.value["version"]
+            for r in harness.exporter.process_records().with_intent(ProcessIntent.CREATED)
+        ]
+        assert versions == [1, 2]
+
+    def test_invalid_process_rejected(self, harness):
+        bad = Bpmn.create_executable_process("bad").done()  # no start event
+        harness.deploy(bad)
+        rejections = harness.exporter.deployment_records().rejections().to_list()
+        assert len(rejections) == 1
+        assert "start" in rejections[0].record.rejection_reason
+
+    def test_deploy_responds_to_request(self, harness):
+        harness.deploy(one_task())
+        assert any(
+            r.record.value_type == ValueType.DEPLOYMENT for r in harness.responses
+        )
+
+
+class TestOneTaskLifecycle:
+    def test_instance_runs_to_task(self, harness):
+        harness.deploy(one_task())
+        pi_key = harness.create_instance("one_task")
+        # process + start event lifecycle
+        process_intents = (
+            harness.exporter.process_instance_records()
+            .events()
+            .with_element_id("one_task")
+            .intent_sequence()
+        )
+        assert process_intents == ["ELEMENT_ACTIVATING", "ELEMENT_ACTIVATED"]
+        start_intents = (
+            harness.exporter.process_instance_records()
+            .events()
+            .with_element_id("start")
+            .intent_sequence()
+        )
+        assert start_intents == [
+            "ELEMENT_ACTIVATING", "ELEMENT_ACTIVATED", "ELEMENT_COMPLETING", "ELEMENT_COMPLETED",
+        ]
+        # flow taken to the task, task waits activated with a job
+        assert (
+            harness.exporter.process_instance_records()
+            .with_intent(PI.SEQUENCE_FLOW_TAKEN)
+            .with_element_type(BpmnElementType.SEQUENCE_FLOW)
+            .count()
+            == 1
+        )
+        task_intents = (
+            harness.exporter.process_instance_records().events().with_element_id("task").intent_sequence()
+        )
+        assert task_intents == ["ELEMENT_ACTIVATING", "ELEMENT_ACTIVATED"]
+        job = harness.exporter.job_records().with_intent(JobIntent.CREATED).first()
+        assert job.record.value["type"] == "work"
+        assert job.record.value["elementId"] == "task"
+        assert job.record.value["processInstanceKey"] == pi_key
+
+    def test_complete_job_completes_instance(self, harness):
+        harness.deploy(one_task())
+        pi_key = harness.create_instance("one_task")
+        jobs = harness.activate_jobs("work")
+        assert len(jobs) == 1
+        harness.complete_job(jobs[0]["key"])
+
+        assert harness.is_instance_done(pi_key)
+        end_intents = (
+            harness.exporter.process_instance_records().events().with_element_id("end").intent_sequence()
+        )
+        assert end_intents == [
+            "ELEMENT_ACTIVATING", "ELEMENT_ACTIVATED", "ELEMENT_COMPLETING", "ELEMENT_COMPLETED",
+        ]
+        # the process itself completes last
+        proc_events = (
+            harness.exporter.process_instance_records()
+            .events()
+            .with_element_id("one_task")
+            .intent_sequence()
+        )
+        assert proc_events == [
+            "ELEMENT_ACTIVATING", "ELEMENT_ACTIVATED", "ELEMENT_COMPLETING", "ELEMENT_COMPLETED",
+        ]
+        # full event order sanity: process completed is the last PI event
+        all_pi = harness.exporter.process_instance_records().events().to_list()
+        assert all_pi[-1].record.value["elementId"] == "one_task"
+        assert all_pi[-1].record.intent == PI.ELEMENT_COMPLETED
+
+    def test_job_activation_carries_variables(self, harness):
+        harness.deploy(one_task())
+        harness.create_instance("one_task", variables={"amount": 99, "user": "bo"})
+        jobs = harness.activate_jobs("work")
+        assert jobs[0]["variables"] == {"amount": 99, "user": "bo"}
+
+    def test_job_completion_variables_merge(self, harness):
+        harness.deploy(one_task())
+        pi_key = harness.create_instance("one_task")
+        jobs = harness.activate_jobs("work")
+        harness.complete_job(jobs[0]["key"], variables={"result": "ok"})
+        var = harness.exporter.variable_records().with_intent(VariableIntent.CREATED).with_value(
+            name="result"
+        ).first()
+        assert var.record.value["value"] == "ok"
+        assert var.record.value["scopeKey"] == pi_key
+
+
+class TestExclusiveGateway:
+    def deploy_branching(self, harness):
+        harness.deploy(
+            Bpmn.create_executable_process("branching")
+            .start_event("start")
+            .exclusive_gateway("gw")
+            .sequence_flow_id("to_big")
+            .condition_expression("amount >= 100")
+            .service_task("big", job_type="big-order")
+            .end_event("end_big")
+            .move_to_element("gw")
+            .sequence_flow_id("to_small")
+            .default_flow()
+            .service_task("small", job_type="small-order")
+            .end_event("end_small")
+            .done()
+        )
+
+    def test_condition_true_path(self, harness):
+        self.deploy_branching(harness)
+        harness.create_instance("branching", variables={"amount": 150})
+        job = harness.exporter.job_records().with_intent(JobIntent.CREATED).first()
+        assert job.record.value["type"] == "big-order"
+        taken = harness.exporter.process_instance_records().with_intent(PI.SEQUENCE_FLOW_TAKEN).to_list()
+        assert any(t.record.value["elementId"] == "to_big" for t in taken)
+
+    def test_default_path(self, harness):
+        self.deploy_branching(harness)
+        harness.create_instance("branching", variables={"amount": 10})
+        job = harness.exporter.job_records().with_intent(JobIntent.CREATED).first()
+        assert job.record.value["type"] == "small-order"
+
+    def test_no_match_no_default_raises_incident(self, harness):
+        harness.deploy(
+            Bpmn.create_executable_process("nodefault")
+            .start_event("s")
+            .exclusive_gateway("gw")
+            .condition_expression("x > 10")
+            .end_event("e")
+            .done()
+        )
+        harness.create_instance("nodefault", variables={"x": 1})
+        incident = harness.exporter.incident_records().with_intent(IncidentIntent.CREATED).first()
+        assert incident.record.value["errorType"] == "CONDITION_ERROR"
+        assert incident.record.value["elementId"] == "gw"
+
+    def test_incident_resolution_retries_gateway(self, harness):
+        harness.deploy(
+            Bpmn.create_executable_process("nodefault")
+            .start_event("s")
+            .exclusive_gateway("gw")
+            .condition_expression("x > 10")
+            .end_event("e")
+            .done()
+        )
+        pi_key = harness.create_instance("nodefault", variables={"x": 1})
+        incident = harness.exporter.incident_records().with_intent(IncidentIntent.CREATED).first()
+        # fix the variable, resolve → process completes
+        harness.set_variables(pi_key, {"x": 50})
+        harness.resolve_incident(incident.record.key)
+        assert harness.is_instance_done(pi_key)
+
+
+class TestParallelGateway:
+    def test_fork_join(self, harness):
+        harness.deploy(
+            Bpmn.create_executable_process("fork_join")
+            .start_event("start")
+            .parallel_gateway("fork")
+            .service_task("a", job_type="a")
+            .parallel_gateway("join")
+            .end_event("end")
+            .move_to_element("fork")
+            .service_task("b", job_type="b")
+            .connect_to("join")
+            .done()
+        )
+        pi_key = harness.create_instance("fork_join")
+        # both branches have jobs
+        assert len(harness.activate_jobs("a")) == 1
+        assert len(harness.activate_jobs("b")) == 1
+        jobs_a = harness.exporter.job_records().with_intent(JobIntent.CREATED).with_value(type="a").first()
+        harness.complete_job(jobs_a.record.key)
+        # join not yet satisfied: the join must not have activated
+        assert not (
+            harness.exporter.process_instance_records()
+            .with_element_id("join").events().exists()
+        )
+        assert not harness.is_instance_done(pi_key)
+        jobs_b = harness.exporter.job_records().with_intent(JobIntent.CREATED).with_value(type="b").first()
+        harness.complete_job(jobs_b.record.key)
+        # join activated exactly once, process completed
+        join_intents = (
+            harness.exporter.process_instance_records().events().with_element_id("join").intent_sequence()
+        )
+        assert join_intents == [
+            "ELEMENT_ACTIVATING", "ELEMENT_ACTIVATED", "ELEMENT_COMPLETING", "ELEMENT_COMPLETED",
+        ]
+        assert harness.is_instance_done(pi_key)
+
+
+class TestJobFailure:
+    def test_fail_with_retries_reactivatable(self, harness):
+        harness.deploy(one_task())
+        harness.create_instance("one_task")
+        jobs = harness.activate_jobs("work")
+        harness.fail_job(jobs[0]["key"], retries=2, error_message="flaky")
+        # job activatable again
+        jobs2 = harness.activate_jobs("work")
+        assert len(jobs2) == 1
+        assert jobs2[0]["retries"] == 2
+
+    def test_fail_no_retries_creates_incident(self, harness):
+        harness.deploy(one_task())
+        harness.create_instance("one_task")
+        jobs = harness.activate_jobs("work")
+        harness.fail_job(jobs[0]["key"], retries=0, error_message="broken")
+        incident = harness.exporter.incident_records().with_intent(IncidentIntent.CREATED).first()
+        assert incident.record.value["errorType"] == "JOB_NO_RETRIES"
+        assert incident.record.value["jobKey"] == jobs[0]["key"]
+        # not activatable anymore
+        assert harness.activate_jobs("work") == []
+
+    def test_incident_resolution_after_retries_update(self, harness):
+        harness.deploy(one_task())
+        pi_key = harness.create_instance("one_task")
+        jobs = harness.activate_jobs("work")
+        harness.fail_job(jobs[0]["key"], retries=0)
+        incident = harness.exporter.incident_records().with_intent(IncidentIntent.CREATED).first()
+        harness.update_job_retries(jobs[0]["key"], retries=3)
+        harness.resolve_incident(incident.record.key)
+        jobs2 = harness.activate_jobs("work")
+        assert len(jobs2) == 1
+        harness.complete_job(jobs2[0]["key"])
+        assert harness.is_instance_done(pi_key)
+
+
+class TestCancel:
+    def test_cancel_terminates_tree(self, harness):
+        harness.deploy(one_task())
+        pi_key = harness.create_instance("one_task")
+        jobs = harness.activate_jobs("work")
+        harness.cancel_instance(pi_key)
+        assert harness.is_instance_done(pi_key)
+        # task terminated, job canceled
+        assert (
+            harness.exporter.process_instance_records()
+            .with_element_id("task")
+            .with_intent(PI.ELEMENT_TERMINATED)
+            .exists()
+        )
+        assert harness.exporter.job_records().with_intent(JobIntent.CANCELED).exists()
+        # process terminated last
+        assert (
+            harness.exporter.process_instance_records()
+            .with_element_id("one_task")
+            .with_intent(PI.ELEMENT_TERMINATED)
+            .exists()
+        )
+
+    def test_cancel_unknown_rejected(self, harness):
+        harness.deploy(one_task())
+        harness.cancel_instance(999999)
+        assert (
+            harness.exporter.process_instance_records()
+            .rejections()
+            .with_intent(PI.CANCEL)
+            .exists()
+        )
+
+
+class TestCreateRejections:
+    def test_unknown_process_rejected(self, harness):
+        harness.write_command(
+            __import__("zeebe_tpu.protocol", fromlist=["command"]).command(
+                ValueType.PROCESS_INSTANCE_CREATION,
+                __import__(
+                    "zeebe_tpu.protocol.intent", fromlist=["ProcessInstanceCreationIntent"]
+                ).ProcessInstanceCreationIntent.CREATE,
+                {"bpmnProcessId": "ghost", "version": -1, "variables": {}},
+            ),
+            request_id=10,
+        )
+        rej = harness.exporter.all().rejections().first()
+        assert "ghost" in rej.record.rejection_reason

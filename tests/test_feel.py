@@ -1,0 +1,166 @@
+"""FEEL-lite tests: parsing, evaluation semantics, null propagation, builtins."""
+
+import pytest
+
+from zeebe_tpu.feel import FeelEvalError, FeelParseError, parse_expression, parse_feel
+
+
+def ev(src, **ctx):
+    return parse_feel(src).evaluate(ctx)
+
+
+class TestLiterals:
+    @pytest.mark.parametrize(
+        "src,expected",
+        [
+            ("1", 1),
+            ("1.5", 1.5),
+            ('"hi"', "hi"),
+            ("true", True),
+            ("false", False),
+            ("null", None),
+            ("[1, 2, 3]", [1, 2, 3]),
+            ("[]", []),
+            ("{x: 1, y: \"a\"}", {"x": 1, "y": "a"}),
+            ("{}", {}),
+        ],
+    )
+    def test_literal(self, src, expected):
+        assert ev(src) == expected
+
+
+class TestArithmetic:
+    @pytest.mark.parametrize(
+        "src,expected",
+        [
+            ("1 + 2", 3),
+            ("10 - 4", 6),
+            ("3 * 4", 12),
+            ("10 / 4", 2.5),
+            ("-5 + 2", -3),
+            ("2 + 3 * 4", 14),
+            ("(2 + 3) * 4", 20),
+            ("10 / 0", None),  # FEEL: division by zero is null
+            ('"a" + "b"', "ab"),
+        ],
+    )
+    def test_arith(self, src, expected):
+        assert ev(src) == expected
+
+    def test_null_propagation(self):
+        assert ev("missing + 1") is None
+        assert ev("1 + missing") is None
+
+
+class TestComparison:
+    @pytest.mark.parametrize(
+        "src,ctx,expected",
+        [
+            ("x = 5", {"x": 5}, True),
+            ("x != 5", {"x": 5}, False),
+            ("x < 10", {"x": 5}, True),
+            ("x <= 5", {"x": 5}, True),
+            ("x > 10", {"x": 5}, False),
+            ("x >= 5", {"x": 5}, True),
+            ('name = "alice"', {"name": "alice"}, True),
+            ("x < 10", {}, None),  # null comparison → null
+            ("x = null", {}, True),
+            ("x in [1..10]", {"x": 5}, True),
+            ("x in [1..10]", {"x": 11}, False),
+            ("x in [1, 2, 3]", {"x": 2}, True),
+            ("x in [1, 2, 3]", {"x": 9}, False),
+        ],
+    )
+    def test_cmp(self, src, ctx, expected):
+        assert ev(src, **ctx) == expected
+
+
+class TestBoolean:
+    def test_and_or(self):
+        assert ev("true and true") is True
+        assert ev("true and false") is False
+        assert ev("false or true") is True
+        assert ev("x > 1 and x < 10", x=5) is True
+
+    def test_ternary_logic(self):
+        # FEEL three-valued logic: false and null = false; true and null = null
+        assert ev("false and missing") is False
+        assert ev("true or missing") is True
+        assert ev("true and missing") is None
+        assert ev("false or missing") is None
+
+    def test_not(self):
+        assert ev("not(true)") is False
+        assert ev("not(x > 3)", x=1) is True
+
+
+class TestVariables:
+    def test_nested_paths(self):
+        assert ev("order.customer.name", order={"customer": {"name": "bo"}}) == "bo"
+
+    def test_missing_is_null(self):
+        assert ev("order.customer.name", order={}) is None
+        assert ev("nope") is None
+
+    def test_if_then_else(self):
+        assert ev('if x > 5 then "big" else "small"', x=9) == "big"
+        assert ev('if x > 5 then "big" else "small"', x=3) == "small"
+        # non-true condition takes else branch (null condition)
+        assert ev('if missing > 5 then "big" else "small"') == "small"
+
+    def test_list_indexing_one_based(self):
+        assert ev("xs[1]", xs=[10, 20, 30]) == 10
+        assert ev("xs[3]", xs=[10, 20, 30]) == 30
+        assert ev("xs[-1]", xs=[10, 20, 30]) == 30
+        assert ev("xs[4]", xs=[10, 20, 30]) is None
+
+
+class TestBuiltins:
+    @pytest.mark.parametrize(
+        "src,ctx,expected",
+        [
+            ('contains("hello", "ell")', {}, True),
+            ('starts with("hello", "he")', {}, True),
+            ('ends with("hello", "lo")', {}, True),
+            ('upper case("abc")', {}, "ABC"),
+            ('string length("abcd")', {}, 4),
+            ("count(xs)", {"xs": [1, 2, 3]}, 3),
+            ("sum(xs)", {"xs": [1, 2, 3]}, 6),
+            ("min(3, 1, 2)", {}, 1),
+            ("max(xs)", {"xs": [4, 9, 2]}, 9),
+            ("floor(3.7)", {}, 3),
+            ("ceiling(3.2)", {}, 4),
+            ("abs(-5)", {}, 5),
+            ("modulo(10, 3)", {}, 1),
+            ("string(42)", {}, "42"),
+            ('number("42")', {}, 42),
+            ("is defined(x)", {"x": 1}, True),
+            ("is defined(x)", {}, False),
+            ("append(xs, 4)", {"xs": [1, 2]}, [1, 2, 4]),
+            ("list contains(xs, 2)", {"xs": [1, 2]}, True),
+        ],
+    )
+    def test_builtin(self, src, ctx, expected):
+        assert ev(src, **ctx) == expected
+
+    def test_unknown_function(self):
+        with pytest.raises(FeelEvalError):
+            ev("frobnicate(1)")
+
+
+class TestExpressionFacade:
+    def test_static_vs_feel(self):
+        static = parse_expression("just-a-string")
+        assert static.is_static and static.evaluate({}) == "just-a-string"
+        feel = parse_expression("= 1 + 1")
+        assert not feel.is_static and feel.evaluate({}) == 2
+
+    def test_parse_error_at_parse_time(self):
+        with pytest.raises(FeelParseError):
+            parse_expression("= 1 +")
+        with pytest.raises(FeelParseError):
+            parse_expression("= @@nope")
+
+    def test_trailing_junk_rejected(self):
+        with pytest.raises(FeelParseError):
+            parse_expression("= 1 2")

@@ -226,3 +226,21 @@ class TestRobustness:
         data[1] = 255  # SBE_UNKNOWN
         with pytest.raises(ValueError):
             Record.from_bytes(bytes(data))
+
+
+class TestReasonTruncation:
+    def test_oversized_multibyte_reason_roundtrips(self):
+        """Regression: u16 truncation must not leave a dangling UTF-8 lead byte."""
+        from zeebe_tpu.protocol.intent import JobIntent
+
+        rec = Record(
+            record_type=RecordType.COMMAND_REJECTION,
+            value_type=ValueType.JOB,
+            intent=JobIntent.COMPLETE,
+            value={},
+            rejection_type=RejectionType.PROCESSING_ERROR,
+            rejection_reason="é" * 40000,
+        )
+        back = Record.from_bytes(rec.to_bytes())
+        assert back.rejection_reason.startswith("é")
+        assert len(back.rejection_reason.encode()) <= 0xFFFF

@@ -277,3 +277,28 @@ class TestSnapshotRecovery:
         assert proc2.counter(db2) == 16  # 1+2+3 (snapshot) + 10 (replayed)
         assert proc2.processed_ops == []  # replay only, no reprocessing
         journal2.close()
+
+
+class TestRejectionReplay:
+    def test_rejection_only_step_not_reprocessed_after_restart(self, tmp_path):
+        """Regression: a command whose only output was a rejection must not be
+        reprocessed on restart (rejections carry the source backlink too)."""
+        journal, stream, db, proc, sp, responses = make_env(tmp_path)
+        sp.start()
+        write_cmd(stream, op="boom", request_id=5)
+        sp.run_until_idle()
+        n_records = sum(1 for _ in stream.new_reader())
+        journal.close()
+
+        journal2 = SegmentedJournal(tmp_path / "log")
+        stream2 = LogStream(journal2, partition_id=1)
+        db2 = ZbDb()
+        proc2 = CounterProcessor(db2)
+        responses2 = []
+        sp2 = StreamProcessor(stream2, db2, proc2, response_sink=responses2.append)
+        sp2.start()
+        sp2.run_until_idle()
+        assert proc2.processed_ops == []  # not reprocessed
+        assert responses2 == []  # no duplicate client response
+        assert sum(1 for _ in stream2.new_reader()) == n_records  # no new records
+        journal2.close()

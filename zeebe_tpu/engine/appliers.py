@@ -1,0 +1,225 @@
+"""Event appliers: the only code allowed to mutate engine state.
+
+Reference: engine/src/main/java/io/camunda/zeebe/engine/state/appliers/ (65
+files; EventAppliers.java:48 registers one TypedEventApplier per intent).
+``apply`` is called both during processing (via the StateWriter, immediately
+after the event is appended to the result) and during replay — by construction
+the same code path, which is what makes replay ≡ processing.
+
+Every applier also feeds the key generator (``observe_key``) so replay
+restores the highest assigned key (reference: ReplayStateMachine key restore).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from zeebe_tpu.engine.engine_state import (
+    EI_ACTIVATED,
+    EI_ACTIVATING,
+    EI_COMPLETED,
+    EI_COMPLETING,
+    EI_TERMINATED,
+    EI_TERMINATING,
+    EngineState,
+)
+from zeebe_tpu.protocol import Record, ValueType
+from zeebe_tpu.protocol.enums import BpmnElementType
+from zeebe_tpu.protocol.intent import (
+    DeploymentIntent,
+    IncidentIntent,
+    JobBatchIntent,
+    JobIntent,
+    ProcessInstanceCreationIntent,
+    ProcessInstanceIntent,
+    ProcessIntent,
+    SignalIntent,
+    TimerIntent,
+    VariableIntent,
+)
+
+
+class EventAppliers:
+    def __init__(self, state: EngineState) -> None:
+        self.state = state
+        self._appliers: dict[tuple[ValueType, int], Callable[[Record], None]] = {}
+        self._register()
+
+    def _register(self) -> None:
+        reg = self._appliers
+        reg[(ValueType.PROCESS, int(ProcessIntent.CREATED))] = self._process_created
+        reg[(ValueType.DEPLOYMENT, int(DeploymentIntent.CREATED))] = self._noop
+        reg[(ValueType.DEPLOYMENT, int(DeploymentIntent.FULLY_DISTRIBUTED))] = self._noop
+        reg[(ValueType.PROCESS_INSTANCE_CREATION, int(ProcessInstanceCreationIntent.CREATED))] = self._noop
+        reg[(ValueType.PROCESS_INSTANCE, int(ProcessInstanceIntent.ELEMENT_ACTIVATING))] = self._element_activating
+        reg[(ValueType.PROCESS_INSTANCE, int(ProcessInstanceIntent.ELEMENT_ACTIVATED))] = self._element_activated
+        reg[(ValueType.PROCESS_INSTANCE, int(ProcessInstanceIntent.ELEMENT_COMPLETING))] = self._element_completing
+        reg[(ValueType.PROCESS_INSTANCE, int(ProcessInstanceIntent.ELEMENT_COMPLETED))] = self._element_completed
+        reg[(ValueType.PROCESS_INSTANCE, int(ProcessInstanceIntent.ELEMENT_TERMINATING))] = self._element_terminating
+        reg[(ValueType.PROCESS_INSTANCE, int(ProcessInstanceIntent.ELEMENT_TERMINATED))] = self._element_terminated
+        reg[(ValueType.PROCESS_INSTANCE, int(ProcessInstanceIntent.SEQUENCE_FLOW_TAKEN))] = self._sequence_flow_taken
+        reg[(ValueType.JOB, int(JobIntent.CREATED))] = self._job_created
+        reg[(ValueType.JOB, int(JobIntent.COMPLETED))] = self._job_completed
+        reg[(ValueType.JOB, int(JobIntent.FAILED))] = self._job_failed
+        reg[(ValueType.JOB, int(JobIntent.TIMED_OUT))] = self._job_timed_out
+        reg[(ValueType.JOB, int(JobIntent.RETRIES_UPDATED))] = self._job_retries_updated
+        reg[(ValueType.JOB, int(JobIntent.CANCELED))] = self._job_canceled
+        reg[(ValueType.JOB, int(JobIntent.RECURRED_AFTER_BACKOFF))] = self._job_recurred
+        reg[(ValueType.JOB_BATCH, int(JobBatchIntent.ACTIVATED))] = self._job_batch_activated
+        reg[(ValueType.VARIABLE, int(VariableIntent.CREATED))] = self._variable_set
+        reg[(ValueType.VARIABLE, int(VariableIntent.UPDATED))] = self._variable_set
+        reg[(ValueType.INCIDENT, int(IncidentIntent.CREATED))] = self._incident_created
+        reg[(ValueType.INCIDENT, int(IncidentIntent.RESOLVED))] = self._incident_resolved
+        from zeebe_tpu.protocol.intent import VariableDocumentIntent
+
+        reg[(ValueType.VARIABLE_DOCUMENT, int(VariableDocumentIntent.UPDATED))] = self._noop
+
+    def can_apply(self, record: Record) -> bool:
+        return (record.value_type, int(record.intent)) in self._appliers
+
+    def apply(self, record: Record) -> None:
+        applier = self._appliers.get((record.value_type, int(record.intent)))
+        if applier is None:
+            raise KeyError(
+                f"no event applier for {record.value_type.name} {record.intent.name}"
+            )
+        if record.key >= 0:
+            self.state.observe_key(record.key)
+        applier(record)
+
+    # -- appliers ------------------------------------------------------------
+
+    def _noop(self, record: Record) -> None:
+        pass
+
+    def _process_created(self, record: Record) -> None:
+        v = record.value
+        self.state.processes.put_process(
+            key=v["processDefinitionKey"],
+            bpmn_process_id=v["bpmnProcessId"],
+            version=v["version"],
+            resource_name=v["resourceName"],
+            resource_xml=v["resource"],
+            digest=v["checksum"],
+        )
+
+    # element lifecycle
+
+    def _element_activating(self, record: Record) -> None:
+        v = record.value
+        ei = self.state.element_instances
+        ei.create(record.key, v, EI_ACTIVATING)
+        scope_key = v.get("flowScopeKey", -1)
+        if scope_key >= 0:
+            ei.add_child(scope_key)
+            # token accounting is derived from the process model, like the
+            # reference's appliers (they consult ProcessState): a parallel
+            # gateway join consumes one token per incoming flow; elements
+            # activated via a flow consume one; elements activated directly
+            # (start events, boundary events, scopes) consume none.
+            exe = self.state.processes.executable(v["processDefinitionKey"])
+            element = exe.element(v["elementId"])
+            if element.element_type == BpmnElementType.PARALLEL_GATEWAY:
+                ei.consume_active_flows(scope_key, element.incoming_count)
+                ei.decrement_taken_flows_for_join(scope_key, element.idx)
+            elif element.element_type in (
+                BpmnElementType.START_EVENT,
+                BpmnElementType.BOUNDARY_EVENT,
+                BpmnElementType.EVENT_SUB_PROCESS,
+            ):
+                pass
+            else:
+                ei.consume_active_flows(scope_key, min(1, element.incoming_count))
+
+    def _element_activated(self, record: Record) -> None:
+        self.state.element_instances.set_state(record.key, EI_ACTIVATED)
+
+    def _element_completing(self, record: Record) -> None:
+        self.state.element_instances.set_state(record.key, EI_COMPLETING)
+
+    def _element_completed(self, record: Record) -> None:
+        v = record.value
+        ei = self.state.element_instances
+        ei.set_state(record.key, EI_COMPLETED)
+        scope_key = v.get("flowScopeKey", -1)
+        if scope_key >= 0:
+            ei.remove_child(scope_key)
+        self.state.variables.remove_scope(record.key)
+        ei.remove(record.key)
+
+    def _element_terminating(self, record: Record) -> None:
+        self.state.element_instances.set_state(record.key, EI_TERMINATING)
+
+    def _element_terminated(self, record: Record) -> None:
+        v = record.value
+        ei = self.state.element_instances
+        ei.set_state(record.key, EI_TERMINATED)
+        scope_key = v.get("flowScopeKey", -1)
+        if scope_key >= 0:
+            ei.remove_child(scope_key)
+        self.state.variables.remove_scope(record.key)
+        ei.remove(record.key)
+
+    def _sequence_flow_taken(self, record: Record) -> None:
+        v = record.value
+        ei = self.state.element_instances
+        scope_key = v["flowScopeKey"]
+        # a token is now in transit on this flow
+        ei.add_active_flow(scope_key)
+        # parallel-gateway joins count taken incoming flows
+        exe = self.state.processes.executable(v["processDefinitionKey"])
+        flow = exe.flow(v["elementId"])
+        target = exe.elements[flow.target_idx]
+        if target.element_type == BpmnElementType.PARALLEL_GATEWAY:
+            ei.increment_taken_flow(scope_key, target.idx, flow.idx)
+
+    # jobs
+
+    def _job_created(self, record: Record) -> None:
+        self.state.jobs.create(record.key, record.value)
+        element_key = record.value.get("elementInstanceKey", -1)
+        if element_key >= 0 and self.state.element_instances.get(element_key) is not None:
+            self.state.element_instances.update(element_key, jobKey=record.key)
+
+    def _job_completed(self, record: Record) -> None:
+        self.state.jobs.complete(record.key)
+        element_key = record.value.get("elementInstanceKey", -1)
+        if element_key >= 0 and self.state.element_instances.get(element_key) is not None:
+            self.state.element_instances.update(element_key, jobKey=-1)
+
+    def _job_failed(self, record: Record) -> None:
+        self.state.jobs.fail(
+            record.key, record.value["retries"], record.value.get("retryBackoff", -1)
+        )
+
+    def _job_timed_out(self, record: Record) -> None:
+        self.state.jobs.timeout(record.key)
+
+    def _job_retries_updated(self, record: Record) -> None:
+        self.state.jobs.update_retries(record.key, record.value["retries"])
+
+    def _job_canceled(self, record: Record) -> None:
+        self.state.jobs.cancel(record.key)
+
+    def _job_recurred(self, record: Record) -> None:
+        self.state.jobs.recur_after_backoff(record.key, record.value.get("recurAt", -1))
+
+    def _job_batch_activated(self, record: Record) -> None:
+        v = record.value
+        deadline = v["deadline"]
+        for job_key in v["jobKeys"]:
+            self.state.jobs.activate(job_key, v.get("worker", ""), deadline)
+
+    # variables
+
+    def _variable_set(self, record: Record) -> None:
+        v = record.value
+        self.state.variables.set_variable(v["scopeKey"], v["name"], v["value"])
+
+    # incidents
+
+    def _incident_created(self, record: Record) -> None:
+        self.state.incidents.create(record.key, record.value)
+
+    def _incident_resolved(self, record: Record) -> None:
+        self.state.incidents.resolve(record.key)

@@ -1,0 +1,420 @@
+"""BPMN element lifecycle processing — the core state machine.
+
+Reference: engine/src/main/java/io/camunda/zeebe/engine/processing/bpmn/
+BpmnStreamProcessor.java:36 (processRecord :75 → guard → processEvent :133
+switching on ACTIVATE/COMPLETE/TERMINATE_ELEMENT), ProcessInstanceLifecycle
+(legal transitions), behavior/BpmnStateTransitionBehavior (lifecycle event
+chains + sequence-flow taking), and the per-type element processors under
+bpmn/{container,task,event,gateway}/.
+
+Lifecycle chains produced by one command (identical in shape to the
+reference's event streams):
+
+  ACTIVATE_ELEMENT →  ELEMENT_ACTIVATING, ELEMENT_ACTIVATED
+                      [wait states stop here: tasks with jobs, catch events]
+                      [pass-through elements continue:]
+                      ELEMENT_COMPLETING, ELEMENT_COMPLETED,
+                      SEQUENCE_FLOW_TAKEN*, follow-up ACTIVATE_ELEMENT cmds
+  COMPLETE_ELEMENT →  ELEMENT_COMPLETING, ELEMENT_COMPLETED, flows, …
+  TERMINATE_ELEMENT → ELEMENT_TERMINATING, [terminate children/cancel job],
+                      ELEMENT_TERMINATED, scope follow-ups
+
+Scope completion: when the last token in a scope disappears (no active
+children, no tokens in transit), the scope's COMPLETE_ELEMENT command is
+written — process completion bubbles up from end events exactly as in the
+reference's afterExecutionPathCompleted.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from zeebe_tpu.engine.engine_state import (
+    EI_ACTIVATED,
+    EI_ACTIVATING,
+    EI_COMPLETING,
+    EI_TERMINATING,
+    EngineState,
+)
+from zeebe_tpu.engine.writers import Writers
+from zeebe_tpu.feel import FeelEvalError
+from zeebe_tpu.logstreams import LoggedRecord
+from zeebe_tpu.models.bpmn import ExecutableElement, ExecutableProcess
+from zeebe_tpu.protocol import RejectionType, ValueType
+from zeebe_tpu.protocol.enums import BpmnElementType, BpmnEventType, ErrorType
+from zeebe_tpu.protocol.intent import (
+    IncidentIntent,
+    JobIntent,
+    ProcessInstanceIntent,
+    ProcessInstanceResultIntent,
+    VariableIntent,
+)
+
+PI = ProcessInstanceIntent
+
+
+class BpmnProcessor:
+    """Handles PROCESS_INSTANCE ACTIVATE/COMPLETE/TERMINATE_ELEMENT commands."""
+
+    def __init__(self, state: EngineState, clock_millis) -> None:
+        self.state = state
+        self.clock_millis = clock_millis
+
+    # ------------------------------------------------------------------ entry
+
+    def process(self, cmd: LoggedRecord, writers: Writers) -> None:
+        intent = cmd.record.intent
+        value = dict(cmd.record.value)
+        key = cmd.record.key
+
+        if intent == PI.ACTIVATE_ELEMENT:
+            exe = self._executable(value)
+            element = exe.element(value["elementId"])
+            self._activate(key, value, exe, element, writers)
+        elif intent == PI.COMPLETE_ELEMENT:
+            instance = self.state.element_instances.get(key)
+            # COMPLETING is legal here: incident resolution retries a stalled
+            # completing transition (condition/output-mapping failures)
+            if instance is None or instance["state"] not in (EI_ACTIVATED, EI_ACTIVATING, EI_COMPLETING):
+                writers.respond_rejection(
+                    cmd, RejectionType.INVALID_STATE,
+                    f"expected element instance {key} to be activated, but it is "
+                    + ("not present" if instance is None else "not in an activatable state"),
+                )
+                return
+            value = instance["value"]
+            exe = self._executable(value)
+            element = exe.element(value["elementId"])
+            self._complete(key, value, exe, element, writers)
+        elif intent == PI.TERMINATE_ELEMENT:
+            instance = self.state.element_instances.get(key)
+            if instance is None:
+                writers.respond_rejection(
+                    cmd, RejectionType.NOT_FOUND, f"no element instance {key}"
+                )
+                return
+            value = instance["value"]
+            exe = self._executable(value)
+            element = exe.element(value["elementId"])
+            self._terminate(key, value, exe, element, writers)
+        else:
+            writers.respond_rejection(
+                cmd, RejectionType.INVALID_ARGUMENT, f"unsupported intent {intent.name}"
+            )
+
+    def _executable(self, value: dict) -> ExecutableProcess:
+        exe = self.state.processes.executable(value["processDefinitionKey"])
+        if exe is None:
+            raise KeyError(f"unknown process definition {value['processDefinitionKey']}")
+        return exe
+
+    # -------------------------------------------------------------- activation
+
+    def _activate(
+        self, key: int, value: dict, exe: ExecutableProcess,
+        element: ExecutableElement, writers: Writers,
+    ) -> None:
+        value = _pi_value(value, element)
+        writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATING, value)
+
+        # input mappings create a local variable scope on the element instance
+        if element.inputs:
+            context = self.state.variables.collect(value.get("flowScopeKey", -1))
+            try:
+                for expr, target in element.inputs:
+                    result = expr.evaluate(context, self.clock_millis)
+                    self._write_variable(writers, key, value, target, result)
+            except FeelEvalError as exc:
+                self._raise_incident(writers, key, value, ErrorType.IO_MAPPING_ERROR, str(exc))
+                return
+
+        et = element.element_type
+        if et == BpmnElementType.PROCESS or et == BpmnElementType.SUB_PROCESS:
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
+            start_idx = element.child_start_idx if et == BpmnElementType.SUB_PROCESS else exe.none_start_of(0)
+            start = exe.elements[start_idx]
+            self._write_activate(writers, exe, start, scope_key=key, value=value)
+        elif et == BpmnElementType.START_EVENT:
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
+            self._complete(key, value, exe, element, writers)
+        elif et in (BpmnElementType.SERVICE_TASK, BpmnElementType.SEND_TASK,
+                    BpmnElementType.BUSINESS_RULE_TASK, BpmnElementType.SCRIPT_TASK,
+                    BpmnElementType.USER_TASK) and element.job_type is not None:
+            context = self.state.variables.collect(key)
+            try:
+                job_type = element.job_type.evaluate(context, self.clock_millis)
+                retries = int(element.job_retries.evaluate(context, self.clock_millis))
+            except (FeelEvalError, TypeError, ValueError) as exc:
+                self._raise_incident(writers, key, value, ErrorType.EXTRACT_VALUE_ERROR, str(exc))
+                return
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
+            job_key = self.state.next_key()
+            writers.append_event(
+                job_key, ValueType.JOB, JobIntent.CREATED,
+                {
+                    "type": job_type,
+                    "retries": retries,
+                    "worker": "",
+                    "deadline": -1,
+                    "variables": {},
+                    "customHeaders": element.task_headers,
+                    "elementId": element.id,
+                    "elementInstanceKey": key,
+                    "processInstanceKey": value["processInstanceKey"],
+                    "processDefinitionKey": value["processDefinitionKey"],
+                    "processDefinitionVersion": value["version"],
+                    "bpmnProcessId": value["bpmnProcessId"],
+                    "errorMessage": "",
+                },
+            )
+            # wait state: completion comes from the job COMPLETE command
+        elif et == BpmnElementType.SCRIPT_TASK and element.script_expression is not None:
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
+            context = self.state.variables.collect(key)
+            try:
+                result = element.script_expression.evaluate(context, self.clock_millis)
+            except FeelEvalError as exc:
+                self._raise_incident(writers, key, value, ErrorType.EXTRACT_VALUE_ERROR, str(exc))
+                return
+            if element.script_result_variable:
+                self._write_variable(
+                    writers, value.get("flowScopeKey", -1), value,
+                    element.script_result_variable, result,
+                )
+            self._complete(key, value, exe, element, writers)
+        elif et in (BpmnElementType.MANUAL_TASK, BpmnElementType.TASK,
+                    BpmnElementType.EXCLUSIVE_GATEWAY, BpmnElementType.PARALLEL_GATEWAY,
+                    BpmnElementType.END_EVENT, BpmnElementType.INTERMEDIATE_THROW_EVENT):
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
+            self._complete(key, value, exe, element, writers)
+        else:
+            # elements not yet implemented behave as pass-through tasks
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
+            self._complete(key, value, exe, element, writers)
+
+    # -------------------------------------------------------------- completion
+
+    def _complete(
+        self, key: int, value: dict, exe: ExecutableProcess,
+        element: ExecutableElement, writers: Writers,
+    ) -> None:
+        value = _pi_value(value, element)
+        instance = self.state.element_instances.get(key)
+        if instance is None or instance["state"] != EI_COMPLETING:
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_COMPLETING, value)
+        # else: retrying a stalled completing transition after incident resolution
+
+        # output mappings evaluate against the element scope, write to parent
+        if element.outputs:
+            context = self.state.variables.collect(key)
+            try:
+                for expr, target in element.outputs:
+                    result = expr.evaluate(context, self.clock_millis)
+                    self._write_variable(
+                        writers, value.get("flowScopeKey", -1), value, target, result
+                    )
+            except FeelEvalError as exc:
+                self._raise_incident(writers, key, value, ErrorType.IO_MAPPING_ERROR, str(exc))
+                return
+
+        if element.element_type == BpmnElementType.EXCLUSIVE_GATEWAY and (
+            len(element.outgoing) > 1
+            or any(exe.flows[f].condition is not None for f in element.outgoing)
+        ):
+            taken = self._choose_exclusive_flow(key, value, exe, element, writers)
+            if taken is None:
+                return  # incident raised; stays in COMPLETING
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_COMPLETED, value)
+            self._take_flow(writers, exe, taken, value)
+        else:
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_COMPLETED, value)
+            for fidx in element.outgoing:
+                self._take_flow(writers, exe, exe.flows[fidx], value)
+
+        if element.element_type == BpmnElementType.PROCESS:
+            self._on_process_completed(key, value, writers)
+            return
+        if not element.outgoing:
+            self._check_scope_completion(value.get("flowScopeKey", -1), writers)
+
+    def _choose_exclusive_flow(self, key, value, exe, element, writers):
+        context = self.state.variables.collect(key)
+        for fidx in element.outgoing:
+            if fidx == element.default_flow_idx:
+                continue
+            flow = exe.flows[fidx]
+            if flow.condition is None:
+                continue
+            try:
+                result = flow.condition.evaluate(context, self.clock_millis)
+            except FeelEvalError as exc:
+                self._raise_incident(writers, key, value, ErrorType.EXTRACT_VALUE_ERROR, str(exc))
+                return None
+            if result is True:
+                return flow
+        if element.default_flow_idx >= 0:
+            return exe.flows[element.default_flow_idx]
+        self._raise_incident(
+            writers, key, value, ErrorType.CONDITION_ERROR,
+            f"Expected at least one condition to evaluate to true, or to have a default flow "
+            f"at gateway '{element.id}'",
+        )
+        return None
+
+    def _take_flow(self, writers: Writers, exe: ExecutableProcess, flow, scope_value: dict) -> None:
+        # the scope containing the flow is the completing element's flow scope
+        scope_key = scope_value.get("flowScopeKey", -1)
+        flow_value = {
+            "bpmnProcessId": scope_value["bpmnProcessId"],
+            "version": scope_value["version"],
+            "processDefinitionKey": scope_value["processDefinitionKey"],
+            "processInstanceKey": scope_value["processInstanceKey"],
+            "elementId": flow.id,
+            "flowScopeKey": scope_key,
+            "bpmnElementType": BpmnElementType.SEQUENCE_FLOW.name,
+            "bpmnEventType": BpmnEventType.UNSPECIFIED.name,
+        }
+        flow_key = self.state.next_key()
+        writers.append_event(flow_key, ValueType.PROCESS_INSTANCE, PI.SEQUENCE_FLOW_TAKEN, flow_value)
+
+        target = exe.elements[flow.target_idx]
+        if target.element_type == BpmnElementType.PARALLEL_GATEWAY:
+            incoming = [f.idx for f in exe.flows if f.target_idx == target.idx]
+            if self.state.element_instances.taken_flows_satisfy_join(scope_key, target.idx, incoming):
+                self._write_activate(writers, exe, target, scope_key, scope_value)
+        else:
+            self._write_activate(writers, exe, target, scope_key, scope_value)
+
+    def _write_activate(
+        self, writers: Writers, exe: ExecutableProcess, element: ExecutableElement,
+        scope_key: int, value: dict,
+    ) -> None:
+        new_key = self.state.next_key()
+        child_value = {
+            "bpmnProcessId": value["bpmnProcessId"],
+            "version": value["version"],
+            "processDefinitionKey": value["processDefinitionKey"],
+            "processInstanceKey": value["processInstanceKey"],
+            "elementId": element.id,
+            "flowScopeKey": scope_key,
+            "bpmnElementType": element.element_type.name,
+            "bpmnEventType": element.event_type.name,
+        }
+        writers.append_command(new_key, ValueType.PROCESS_INSTANCE, PI.ACTIVATE_ELEMENT, child_value)
+
+    # -------------------------------------------------------- scope completion
+
+    def _check_scope_completion(self, scope_key: int, writers: Writers) -> None:
+        if scope_key < 0:
+            return
+        scope = self.state.element_instances.get(scope_key)
+        if scope is None:
+            return
+        if scope["state"] not in (EI_ACTIVATED, EI_ACTIVATING):
+            return
+        if scope["activeChildren"] == 0 and scope["activeFlows"] == 0:
+            writers.append_command(
+                scope_key, ValueType.PROCESS_INSTANCE, PI.COMPLETE_ELEMENT, {}
+            )
+
+    def _on_process_completed(self, key: int, value: dict, writers: Writers) -> None:
+        # bubble into a parent process (call activity) — forthcoming; top-level
+        # completion may answer a create-with-result request (handled by the
+        # creation processor's awaitResult bookkeeping, stored on the instance)
+        pass
+
+    # -------------------------------------------------------------- terminate
+
+    def _terminate(
+        self, key: int, value: dict, exe: ExecutableProcess,
+        element: ExecutableElement, writers: Writers,
+    ) -> None:
+        value = _pi_value(value, element)
+        instance = self.state.element_instances.get(key)
+        writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_TERMINATING, value)
+
+        job_key = instance.get("jobKey", -1)
+        if job_key >= 0:
+            job = self.state.jobs.get(job_key)
+            if job is not None:
+                writers.append_event(job_key, ValueType.JOB, JobIntent.CANCELED, job)
+
+        children = self.state.element_instances.children_keys(key)
+        if children:
+            for child_key in children:
+                writers.append_command(
+                    child_key, ValueType.PROCESS_INSTANCE, PI.TERMINATE_ELEMENT, {}
+                )
+            # stay TERMINATING; the last terminated child finishes this scope
+            return
+
+        self._finish_terminate(key, value, writers)
+
+    def _finish_terminate(self, key: int, value: dict, writers: Writers) -> None:
+        scope_key = value.get("flowScopeKey", -1)
+        writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_TERMINATED, value)
+        if scope_key >= 0:
+            scope = self.state.element_instances.get(scope_key)
+            if scope is not None and scope["state"] == EI_TERMINATING:
+                if self.state.element_instances.get(scope_key)["activeChildren"] == 0:
+                    scope_value = scope["value"]
+                    exe = self._executable(scope_value)
+                    self._finish_terminate(scope_key, _pi_value(scope_value, exe.element(scope_value["elementId"])), writers)
+
+    # -------------------------------------------------------------- incidents
+
+    def _raise_incident(
+        self, writers: Writers, element_key: int, value: dict,
+        error_type: ErrorType, message: str,
+    ) -> None:
+        incident_key = self.state.next_key()
+        writers.append_event(
+            incident_key, ValueType.INCIDENT, IncidentIntent.CREATED,
+            {
+                "errorType": error_type.name,
+                "errorMessage": message,
+                "bpmnProcessId": value.get("bpmnProcessId", ""),
+                "processDefinitionKey": value.get("processDefinitionKey", -1),
+                "processInstanceKey": value.get("processInstanceKey", -1),
+                "elementId": value.get("elementId", ""),
+                "elementInstanceKey": element_key,
+                "jobKey": -1,
+                "variableScopeKey": element_key,
+            },
+        )
+
+    # -------------------------------------------------------------- variables
+
+    def _write_variable(
+        self, writers: Writers, scope_key: int, pi_value: dict, name: str, result: Any
+    ) -> None:
+        exists = self.state.variables.has_local(scope_key, name)
+        var_key = self.state.next_key()
+        writers.append_event(
+            var_key, ValueType.VARIABLE,
+            VariableIntent.UPDATED if exists else VariableIntent.CREATED,
+            {
+                "name": name,
+                "value": result,
+                "scopeKey": scope_key,
+                "processInstanceKey": pi_value.get("processInstanceKey", -1),
+                "processDefinitionKey": pi_value.get("processDefinitionKey", -1),
+                "bpmnProcessId": pi_value.get("bpmnProcessId", ""),
+            },
+        )
+
+
+def _pi_value(value: dict, element: ExecutableElement) -> dict:
+    """Canonical PROCESS_INSTANCE record value (camelCase, reference shape)."""
+    return {
+        "bpmnProcessId": value["bpmnProcessId"],
+        "version": value["version"],
+        "processDefinitionKey": value["processDefinitionKey"],
+        "processInstanceKey": value["processInstanceKey"],
+        "elementId": element.id,
+        "flowScopeKey": value.get("flowScopeKey", -1),
+        "bpmnElementType": element.element_type.name,
+        "bpmnEventType": element.event_type.name,
+        "parentProcessInstanceKey": value.get("parentProcessInstanceKey", -1),
+        "parentElementInstanceKey": value.get("parentElementInstanceKey", -1),
+    }

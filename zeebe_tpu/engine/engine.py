@@ -1,0 +1,98 @@
+"""Engine: the RecordProcessor implementation for one partition.
+
+Reference: engine/src/main/java/io/camunda/zeebe/engine/Engine.java:40
+(implements RecordProcessor; process :100 looks up a TypedRecordProcessor in
+RecordProcessorMap by (RecordType, ValueType, Intent); replay :94 delegates to
+EventApplier; banned-instance guard :126) and
+processing/EngineProcessors.createEngineProcessors (EngineProcessors.java:61).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from zeebe_tpu.engine.appliers import EventAppliers
+from zeebe_tpu.engine.bpmn import BpmnProcessor
+from zeebe_tpu.engine.engine_state import EngineState
+from zeebe_tpu.engine.processors import (
+    DeploymentProcessor,
+    IncidentResolveProcessor,
+    JobBatchProcessor,
+    JobProcessors,
+    ProcessInstanceCancelProcessor,
+    ProcessInstanceCreationProcessor,
+    VariableDocumentProcessor,
+)
+from zeebe_tpu.engine.writers import Writers
+from zeebe_tpu.logstreams import LoggedRecord
+from zeebe_tpu.protocol import RejectionType, ValueType
+from zeebe_tpu.protocol.intent import (
+    DeploymentIntent,
+    IncidentIntent,
+    JobBatchIntent,
+    JobIntent,
+    ProcessInstanceCreationIntent,
+    ProcessInstanceIntent,
+    VariableDocumentIntent,
+)
+from zeebe_tpu.state import ZbDb
+from zeebe_tpu.stream import ProcessingResultBuilder, RecordProcessor
+
+
+class Engine(RecordProcessor):
+    def __init__(self, db: ZbDb, partition_id: int = 1, clock_millis: Callable[[], int] | None = None) -> None:
+        self.state = EngineState(db, partition_id)
+        self.appliers = EventAppliers(self.state)
+        clock = clock_millis or (lambda: 0)
+        self.clock_millis = clock
+
+        bpmn = BpmnProcessor(self.state, clock)
+        deployment = DeploymentProcessor(self.state)
+        creation = ProcessInstanceCreationProcessor(self.state, bpmn)
+        cancel = ProcessInstanceCancelProcessor(self.state)
+        jobs = JobProcessors(self.state, clock)
+        job_batch = JobBatchProcessor(self.state, clock)
+        incidents = IncidentResolveProcessor(self.state)
+        variables = VariableDocumentProcessor(self.state)
+        self.bpmn = bpmn
+
+        # the RecordProcessorMap: (ValueType, command intent) → handler
+        self._processors: dict[tuple[ValueType, int], Callable[[LoggedRecord, Writers], None]] = {
+            (ValueType.DEPLOYMENT, int(DeploymentIntent.CREATE)): deployment.process,
+            (ValueType.PROCESS_INSTANCE_CREATION, int(ProcessInstanceCreationIntent.CREATE)): creation.process,
+            (ValueType.PROCESS_INSTANCE, int(ProcessInstanceIntent.ACTIVATE_ELEMENT)): bpmn.process,
+            (ValueType.PROCESS_INSTANCE, int(ProcessInstanceIntent.COMPLETE_ELEMENT)): bpmn.process,
+            (ValueType.PROCESS_INSTANCE, int(ProcessInstanceIntent.TERMINATE_ELEMENT)): bpmn.process,
+            (ValueType.PROCESS_INSTANCE, int(ProcessInstanceIntent.CANCEL)): cancel.process,
+            (ValueType.JOB, int(JobIntent.COMPLETE)): jobs.complete,
+            (ValueType.JOB, int(JobIntent.FAIL)): jobs.fail,
+            (ValueType.JOB, int(JobIntent.UPDATE_RETRIES)): jobs.update_retries,
+            (ValueType.JOB, int(JobIntent.TIME_OUT)): jobs.time_out,
+            (ValueType.JOB, int(JobIntent.THROW_ERROR)): jobs.throw_error,
+            (ValueType.JOB_BATCH, int(JobBatchIntent.ACTIVATE)): job_batch.process,
+            (ValueType.INCIDENT, int(IncidentIntent.RESOLVE)): incidents.process,
+            (ValueType.VARIABLE_DOCUMENT, int(VariableDocumentIntent.UPDATE)): variables.process,
+        }
+        self.state.load_key_generator()
+
+    # -- RecordProcessor SPI -------------------------------------------------
+
+    def accepts(self, value_type: ValueType) -> bool:
+        return any(vt == value_type for vt, _ in self._processors)
+
+    def process(self, record: LoggedRecord, result: ProcessingResultBuilder) -> None:
+        writers = Writers(result, self.appliers)
+        pi_key = record.record.value.get("processInstanceKey", -1) if record.record.value else -1
+        if self.state.banned.is_banned(pi_key):
+            return  # quarantined instance: drop silently (reference Engine:126)
+        handler = self._processors.get((record.record.value_type, int(record.record.intent)))
+        if handler is None:
+            writers.respond_rejection(
+                record, RejectionType.INVALID_ARGUMENT,
+                f"no processor for {record.record.value_type.name} {record.record.intent.name}",
+            )
+            return
+        handler(record, writers)
+
+    def replay(self, record: LoggedRecord) -> None:
+        self.appliers.apply(record.record)

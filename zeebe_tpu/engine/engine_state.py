@@ -1,0 +1,498 @@
+"""Engine state facades over the column-family store.
+
+Reference: engine/src/main/java/io/camunda/zeebe/engine/state/ — ProcessingDbState
+aggregating ProcessState, ElementInstanceState (parent/child trees +
+NUMBER_OF_TAKEN_SEQUENCE_FLOWS), JobState (activatable queues, deadlines,
+backoff), VariableState (scope hierarchy), TimerInstanceState, IncidentState,
+MessageState, DistributionState, BannedInstanceState.
+
+Only event appliers (appliers.py) may call the mutating methods — the
+reference enforces this with ArchUnit; here the convention is enforced by the
+replay≡processing property tests.
+
+Element-instance token accounting: each scope instance tracks
+``active_children`` (element instances whose flow scope is this instance) and
+``active_flows`` (tokens in transit on sequence flows of this scope). A scope
+can complete when both are zero. Parallel-gateway joins count taken incoming
+flows per (scope, gateway) in NUMBER_OF_TAKEN_SEQUENCE_FLOWS, exactly the
+reference's join bookkeeping (docs/engine_questions.md:16-46).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+from zeebe_tpu.models.bpmn import ExecutableProcess, parse_bpmn_xml, transform
+from zeebe_tpu.protocol import KeyGenerator
+from zeebe_tpu.state import ColumnFamilyCode as CF
+from zeebe_tpu.state import ZbDb
+
+# element-instance lifecycle states (stored as ints)
+EI_ACTIVATING = 0
+EI_ACTIVATED = 1
+EI_COMPLETING = 2
+EI_COMPLETED = 3
+EI_TERMINATING = 4
+EI_TERMINATED = 5
+
+# job states
+JOB_ACTIVATABLE = 0
+JOB_ACTIVATED = 1
+JOB_FAILED = 2
+JOB_ERROR_THROWN = 3
+
+
+class ProcessState:
+    """Deployed process definitions: by key, by (id, version), latest, digest.
+
+    Caches compiled ExecutableProcess objects outside the db (they are
+    deterministic functions of the stored XML)."""
+
+    def __init__(self, db: ZbDb) -> None:
+        self._by_key = db.column_family(CF.PROCESS_CACHE)
+        self._by_id_version = db.column_family(CF.PROCESS_CACHE_BY_ID_AND_VERSION)
+        self._digest = db.column_family(CF.PROCESS_CACHE_DIGEST_BY_ID)
+        self._version = db.column_family(CF.PROCESS_VERSION)
+        self._compiled: dict[int, ExecutableProcess] = {}
+
+    # mutators (appliers only)
+
+    def put_process(self, key: int, bpmn_process_id: str, version: int, resource_name: str,
+                    resource_xml: str, digest: str) -> None:
+        meta = {
+            "bpmnProcessId": bpmn_process_id,
+            "version": version,
+            "processDefinitionKey": key,
+            "resourceName": resource_name,
+            "resource": resource_xml,
+            "checksum": digest,
+        }
+        self._by_key.put((key,), meta)
+        self._by_id_version.put((bpmn_process_id, version), key)
+        self._digest.put((bpmn_process_id,), digest)
+        self._version.put((bpmn_process_id,), version)
+
+    # queries
+
+    def next_version(self, bpmn_process_id: str) -> int:
+        return (self._version.get((bpmn_process_id,)) or 0) + 1
+
+    def latest_version(self, bpmn_process_id: str) -> int | None:
+        return self._version.get((bpmn_process_id,))
+
+    def latest_digest(self, bpmn_process_id: str) -> str | None:
+        return self._digest.get((bpmn_process_id,))
+
+    def get_by_key(self, key: int) -> dict | None:
+        return self._by_key.get((key,))
+
+    def get_key_by_id_version(self, bpmn_process_id: str, version: int) -> int | None:
+        return self._by_id_version.get((bpmn_process_id, version))
+
+    def get_latest_by_id(self, bpmn_process_id: str) -> dict | None:
+        version = self.latest_version(bpmn_process_id)
+        if version is None:
+            return None
+        key = self.get_key_by_id_version(bpmn_process_id, version)
+        return None if key is None else self.get_by_key(key)
+
+    def executable(self, key: int) -> ExecutableProcess | None:
+        exe = self._compiled.get(key)
+        if exe is not None:
+            return exe
+        meta = self.get_by_key(key)
+        if meta is None:
+            return None
+        model = next(
+            m for m in parse_bpmn_xml(meta["resource"]) if m.process_id == meta["bpmnProcessId"]
+        )
+        exe = transform(model)
+        self._compiled[key] = exe
+        return exe
+
+
+class ElementInstanceState:
+    """Element-instance tree + token accounting + parallel-gateway counters."""
+
+    def __init__(self, db: ZbDb) -> None:
+        self._instances = db.column_family(CF.ELEMENT_INSTANCE_KEY)
+        self._parent_child = db.column_family(CF.ELEMENT_INSTANCE_PARENT_CHILD)
+        self._taken_flows = db.column_family(CF.NUMBER_OF_TAKEN_SEQUENCE_FLOWS)
+
+    # mutators
+
+    def create(self, key: int, record_value: dict, state: int) -> None:
+        instance = {
+            "key": key,
+            "state": state,
+            "value": dict(record_value),
+            "activeChildren": 0,
+            "activeFlows": 0,
+            "jobKey": -1,
+            "interruptedByKey": -1,
+        }
+        self._instances.put((key,), instance)
+        parent = record_value.get("flowScopeKey", -1)
+        if parent >= 0:
+            self._parent_child.put((parent, key), None)
+
+    def update(self, key: int, **fields: Any) -> None:
+        instance = self._instances.get((key,))
+        instance.update(fields)
+        self._instances.put((key,), instance)
+
+    def set_state(self, key: int, state: int) -> None:
+        self.update(key, state=state)
+
+    def remove(self, key: int) -> None:
+        instance = self._instances.get((key,))
+        if instance is None:
+            return
+        parent = instance["value"].get("flowScopeKey", -1)
+        if parent >= 0:
+            self._parent_child.delete((parent, key))
+        self._instances.delete((key,))
+
+    def add_child(self, scope_key: int) -> None:
+        instance = self._instances.get((scope_key,))
+        instance["activeChildren"] += 1
+        self._instances.put((scope_key,), instance)
+
+    def remove_child(self, scope_key: int) -> None:
+        instance = self._instances.get((scope_key,))
+        if instance is None:
+            return  # scope already gone (terminated concurrently)
+        instance["activeChildren"] -= 1
+        self._instances.put((scope_key,), instance)
+
+    def add_active_flow(self, scope_key: int) -> None:
+        instance = self._instances.get((scope_key,))
+        instance["activeFlows"] += 1
+        self._instances.put((scope_key,), instance)
+
+    def consume_active_flows(self, scope_key: int, count: int) -> None:
+        if count <= 0:
+            return
+        instance = self._instances.get((scope_key,))
+        if instance is None:
+            return
+        instance["activeFlows"] -= count
+        self._instances.put((scope_key,), instance)
+
+    def increment_taken_flow(self, scope_key: int, gateway_idx: int, flow_idx: int) -> None:
+        count = self._taken_flows.get((scope_key, gateway_idx, flow_idx)) or 0
+        self._taken_flows.put((scope_key, gateway_idx, flow_idx), count + 1)
+
+    def decrement_taken_flows_for_join(self, scope_key: int, gateway_idx: int) -> None:
+        """Consume one token from every incoming flow of the gateway."""
+        for enc_key, count in list(self._taken_flows.items((scope_key, gateway_idx))):
+            if count > 1:
+                self._taken_flows._ctx().put(enc_key, count - 1)
+            else:
+                self._taken_flows._ctx().delete(enc_key)
+
+    # queries
+
+    def get(self, key: int) -> dict | None:
+        return self._instances.get((key,))
+
+    def children_keys(self, scope_key: int) -> list[int]:
+        # parent_child CF key layout: u16 cf | 0x01 i64(scope) | 0x01 i64(child)
+        return [_decode_trailing_i64(enc_key) for enc_key, _ in self._parent_child.items((scope_key,))]
+
+    def taken_flow_count(self, scope_key: int, gateway_idx: int, flow_idx: int) -> int:
+        return self._taken_flows.get((scope_key, gateway_idx, flow_idx)) or 0
+
+    def taken_flows_satisfy_join(self, scope_key: int, gateway_idx: int, incoming_flow_idxs: list[int]) -> bool:
+        return all(
+            self.taken_flow_count(scope_key, gateway_idx, fidx) > 0 for fidx in incoming_flow_idxs
+        )
+
+
+class JobState:
+    """Jobs + activatable queue by type + deadlines + retry backoff."""
+
+    def __init__(self, db: ZbDb) -> None:
+        self._jobs = db.column_family(CF.JOBS)
+        self._states = db.column_family(CF.JOB_STATES)
+        self._activatable = db.column_family(CF.JOB_ACTIVATABLE)
+        self._deadlines = db.column_family(CF.JOB_DEADLINES)
+        self._backoff = db.column_family(CF.JOB_BACKOFF)
+
+    # mutators
+
+    def create(self, key: int, record_value: dict) -> None:
+        self._jobs.put((key,), dict(record_value))
+        self._states.put((key,), JOB_ACTIVATABLE)
+        self._activatable.put((record_value["type"], key), None)
+
+    def activate(self, key: int, worker: str, deadline: int) -> None:
+        job = self._jobs.get((key,))
+        job["worker"] = worker
+        job["deadline"] = deadline
+        self._jobs.put((key,), job)
+        self._states.put((key,), JOB_ACTIVATED)
+        self._activatable.delete((job["type"], key))
+        self._deadlines.put((deadline, key), None)
+
+    def complete(self, key: int) -> None:
+        self._remove(key)
+
+    def cancel(self, key: int) -> None:
+        self._remove(key)
+
+    def _remove(self, key: int) -> None:
+        job = self._jobs.get((key,))
+        if job is None:
+            return
+        state = self._states.get((key,))
+        if state == JOB_ACTIVATABLE:
+            self._activatable.delete((job["type"], key))
+        if state == JOB_ACTIVATED and job.get("deadline", -1) >= 0:
+            self._deadlines.delete((job["deadline"], key))
+        self._jobs.delete((key,))
+        self._states.delete((key,))
+
+    def fail(self, key: int, retries: int, backoff_until: int = -1) -> None:
+        job = self._jobs.get((key,))
+        state = self._states.get((key,))
+        if state == JOB_ACTIVATED and job.get("deadline", -1) >= 0:
+            self._deadlines.delete((job["deadline"], key))
+        job["retries"] = retries
+        job["deadline"] = -1
+        self._jobs.put((key,), job)
+        if retries > 0:
+            if backoff_until > 0:
+                self._states.put((key,), JOB_FAILED)
+                self._backoff.put((backoff_until, key), None)
+            else:
+                self._states.put((key,), JOB_ACTIVATABLE)
+                self._activatable.put((job["type"], key), None)
+        else:
+            self._states.put((key,), JOB_FAILED)
+
+    def recur_after_backoff(self, key: int, backoff_until: int) -> None:
+        job = self._jobs.get((key,))
+        if backoff_until > 0 and self._backoff.exists((backoff_until, key)):
+            self._backoff.delete((backoff_until, key))
+        self._states.put((key,), JOB_ACTIVATABLE)
+        self._activatable.put((job["type"], key), None)
+
+    def timeout(self, key: int) -> None:
+        """Deadline passed: activated → activatable again."""
+        job = self._jobs.get((key,))
+        if job.get("deadline", -1) >= 0:
+            self._deadlines.delete((job["deadline"], key))
+        job["deadline"] = -1
+        job["worker"] = ""
+        self._jobs.put((key,), job)
+        self._states.put((key,), JOB_ACTIVATABLE)
+        self._activatable.put((job["type"], key), None)
+
+    def update_retries(self, key: int, retries: int) -> None:
+        job = self._jobs.get((key,))
+        job["retries"] = retries
+        self._jobs.put((key,), job)
+
+    def make_activatable(self, key: int) -> None:
+        """After retries updated on a no-retries-failed job + incident resolve."""
+        job = self._jobs.get((key,))
+        self._states.put((key,), JOB_ACTIVATABLE)
+        self._activatable.put((job["type"], key), None)
+
+    # queries
+
+    def get(self, key: int) -> dict | None:
+        return self._jobs.get((key,))
+
+    def state_of(self, key: int) -> int | None:
+        return self._states.get((key,))
+
+    def activatable_keys(self, job_type: str, limit: int) -> list[int]:
+        out = []
+        for enc_key, _ in self._activatable.items((job_type,)):
+            out.append(_decode_trailing_i64(enc_key))
+            if len(out) >= limit:
+                break
+        return out
+
+    def expired_deadlines(self, now_millis: int) -> list[int]:
+        out = []
+        for enc_key, _ in self._deadlines.items():
+            deadline, job_key = _decode_two_i64(enc_key)
+            if deadline > now_millis:
+                break
+            out.append(job_key)
+        return out
+
+    def backoff_due(self, now_millis: int) -> list[tuple[int, int]]:
+        out = []
+        for enc_key, _ in self._backoff.items():
+            until, job_key = _decode_two_i64(enc_key)
+            if until > now_millis:
+                break
+            out.append((until, job_key))
+        return out
+
+
+def _decode_trailing_i64(enc_key: bytes) -> int:
+    import struct as _struct
+
+    (flipped,) = _struct.unpack(">Q", enc_key[-8:])
+    value = flipped ^ 0x8000000000000000
+    return value - (1 << 64) if value >= (1 << 63) else value
+
+
+def _decode_two_i64(enc_key: bytes) -> tuple[int, int]:
+    import struct as _struct
+
+    (f1,) = _struct.unpack(">Q", enc_key[3:11])
+    (f2,) = _struct.unpack(">Q", enc_key[12:20])
+    v1 = f1 ^ 0x8000000000000000
+    v2 = f2 ^ 0x8000000000000000
+    v1 = v1 - (1 << 64) if v1 >= (1 << 63) else v1
+    v2 = v2 - (1 << 64) if v2 >= (1 << 63) else v2
+    return v1, v2
+
+
+class VariableState:
+    """Scoped variables: (scopeKey, name) → value; lookup walks the scope chain."""
+
+    def __init__(self, db: ZbDb, element_instances: ElementInstanceState) -> None:
+        self._vars = db.column_family(CF.VARIABLES)
+        self._instances = element_instances
+
+    # mutators
+
+    def set_variable(self, scope_key: int, name: str, value: Any) -> None:
+        self._vars.put((scope_key, name), value)
+
+    def remove_scope(self, scope_key: int) -> None:
+        for enc_key, _ in list(self._vars.items((scope_key,))):
+            self._vars._ctx().delete(enc_key)
+
+    # queries
+
+    def get_local(self, scope_key: int, name: str) -> Any:
+        return self._vars.get((scope_key, name))
+
+    def has_local(self, scope_key: int, name: str) -> bool:
+        return self._vars.exists((scope_key, name))
+
+    def locals_of(self, scope_key: int) -> dict[str, Any]:
+        out = {}
+        for enc_key, value in self._vars.items((scope_key,)):
+            name = enc_key[2 + 9 + 1 : -1].decode("utf-8")
+            out[name] = value
+        return out
+
+    def find_scope_with(self, scope_key: int, name: str) -> int | None:
+        """Nearest enclosing scope defining ``name`` (for variable updates)."""
+        cur = scope_key
+        while cur >= 0:
+            if self.has_local(cur, name):
+                return cur
+            instance = self._instances.get(cur)
+            if instance is None:
+                return None
+            cur = instance["value"].get("flowScopeKey", -1)
+        return None
+
+    def collect(self, scope_key: int) -> dict[str, Any]:
+        """Effective variables visible from a scope (inner shadows outer) —
+        the evaluation context for conditions and mappings."""
+        chain = []
+        cur = scope_key
+        while cur >= 0:
+            chain.append(cur)
+            instance = self._instances.get(cur)
+            if instance is None:
+                break
+            cur = instance["value"].get("flowScopeKey", -1)
+        out: dict[str, Any] = {}
+        for scope in reversed(chain):
+            out.update(self.locals_of(scope))
+        return out
+
+
+class IncidentState:
+    def __init__(self, db: ZbDb) -> None:
+        self._incidents = db.column_family(CF.INCIDENTS)
+        self._by_element = db.column_family(CF.INCIDENT_PROCESS_INSTANCES)
+        self._by_job = db.column_family(CF.INCIDENT_JOBS)
+
+    def create(self, key: int, record_value: dict) -> None:
+        self._incidents.put((key,), dict(record_value))
+        element_key = record_value.get("elementInstanceKey", -1)
+        if element_key >= 0:
+            self._by_element.put((element_key, key), None)
+        job_key = record_value.get("jobKey", -1)
+        if job_key >= 0:
+            self._by_job.put((job_key,), key)
+
+    def resolve(self, key: int) -> None:
+        incident = self._incidents.get((key,))
+        if incident is None:
+            return
+        element_key = incident.get("elementInstanceKey", -1)
+        if element_key >= 0:
+            self._by_element.delete((element_key, key))
+        job_key = incident.get("jobKey", -1)
+        if job_key >= 0:
+            self._by_job.delete((job_key,))
+        self._incidents.delete((key,))
+
+    def get(self, key: int) -> dict | None:
+        return self._incidents.get((key,))
+
+    def incident_key_for_job(self, job_key: int) -> int | None:
+        return self._by_job.get((job_key,))
+
+
+class BannedInstanceState:
+    """Poison process instances quarantined instead of wedging the partition
+    (reference: state/instance/BannedInstanceState, Engine.java:126)."""
+
+    def __init__(self, db: ZbDb) -> None:
+        self._banned = db.column_family(CF.BANNED_INSTANCE)
+
+    def ban(self, process_instance_key: int) -> None:
+        self._banned.put((process_instance_key,), True)
+
+    def is_banned(self, process_instance_key: int) -> bool:
+        return process_instance_key >= 0 and self._banned.exists((process_instance_key,))
+
+
+class EngineState:
+    """Aggregates all engine sub-states over one partition's db + key generator
+    (reference: ProcessingDbState)."""
+
+    def __init__(self, db: ZbDb, partition_id: int) -> None:
+        self.db = db
+        self.partition_id = partition_id
+        self.processes = ProcessState(db)
+        self.element_instances = ElementInstanceState(db)
+        self.jobs = JobState(db)
+        self.variables = VariableState(db, self.element_instances)
+        self.incidents = IncidentState(db)
+        self.banned = BannedInstanceState(db)
+        self._key_cf = db.column_family(CF.KEY)
+        self.key_generator = KeyGenerator(partition_id)
+        self._key_loaded = False
+
+    def load_key_generator(self) -> None:
+        with self.db.transaction():
+            current = self._key_cf.get(("next",))
+        if current is not None:
+            self.key_generator = KeyGenerator(self.partition_id, start=current)
+        self._key_loaded = True
+
+    def next_key(self) -> int:
+        key = self.key_generator.next_key()
+        self._key_cf.put(("next",), self.key_generator.current)
+        return key
+
+    def observe_key(self, key: int) -> None:
+        """Replay path: fast-forward the generator past keys seen in events."""
+        self.key_generator.set_key_if_higher(key)
+        self._key_cf.put(("next",), self.key_generator.current)

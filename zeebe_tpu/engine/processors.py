@@ -1,0 +1,489 @@
+"""Command processors outside the BPMN lifecycle core.
+
+Reference: engine/…/processing/deployment/DeploymentCreateProcessor.java,
+processinstance/CreateProcessInstanceProcessor.java:46 and
+CancelProcessInstanceHandler, job/{JobBatchActivateProcessor.java:33,
+JobCompleteProcessor, JobFailProcessor, JobThrowErrorProcessor,
+JobTimeOutProcessor, JobUpdateRetriesProcessor, JobYieldProcessor,
+DefaultJobCommandPreconditionGuard}, incident/ResolveIncidentProcessor,
+variable/VariableBehavior (document updates).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from zeebe_tpu.engine.bpmn import BpmnProcessor
+from zeebe_tpu.engine.engine_state import (
+    EI_ACTIVATED,
+    EngineState,
+    JOB_ACTIVATABLE,
+    JOB_ACTIVATED,
+    JOB_FAILED,
+)
+from zeebe_tpu.engine.writers import Writers
+from zeebe_tpu.logstreams import LoggedRecord
+from zeebe_tpu.models.bpmn import BpmnModelError, parse_bpmn_xml, transform
+from zeebe_tpu.protocol import RejectionType, ValueType
+from zeebe_tpu.protocol.enums import BpmnElementType, ErrorType
+from zeebe_tpu.protocol.intent import (
+    DeploymentIntent,
+    IncidentIntent,
+    JobBatchIntent,
+    JobIntent,
+    ProcessInstanceCreationIntent,
+    ProcessInstanceIntent,
+    ProcessIntent,
+    VariableDocumentIntent,
+    VariableIntent,
+)
+
+
+class DeploymentProcessor:
+    """DEPLOYMENT CREATE: parse + validate resources, version processes, emit
+    PROCESS CREATED per definition and DEPLOYMENT CREATED/FULLY_DISTRIBUTED."""
+
+    def __init__(self, state: EngineState) -> None:
+        self.state = state
+
+    def process(self, cmd: LoggedRecord, writers: Writers) -> None:
+        value = cmd.record.value
+        resources = value.get("resources", [])
+        if not resources:
+            writers.respond_rejection(cmd, RejectionType.INVALID_ARGUMENT, "no resources")
+            return
+
+        processes_metadata = []
+        try:
+            parsed = []
+            for res in resources:
+                xml = res["resource"]
+                # checksum over the resource bytes (reference: DigestGenerator
+                # hashes the deployed resource, not the compiled form)
+                checksum = hashlib.sha256(xml.encode("utf-8")).hexdigest()
+                for model in parse_bpmn_xml(xml):
+                    transform(model)  # validation only; rejects bad deployments
+                    parsed.append((res["resourceName"], xml, model, checksum))
+        except BpmnModelError as exc:
+            writers.respond_rejection(cmd, RejectionType.INVALID_ARGUMENT, str(exc))
+            return
+
+        deployment_key = self.state.next_key()
+        for resource_name, xml, model, checksum in parsed:
+            previous_digest = self.state.processes.latest_digest(model.process_id)
+            duplicate = previous_digest == checksum
+            if duplicate:
+                version = self.state.processes.latest_version(model.process_id)
+                process_key = self.state.processes.get_key_by_id_version(model.process_id, version)
+            else:
+                version = self.state.processes.next_version(model.process_id)
+                process_key = self.state.next_key()
+            meta = {
+                "bpmnProcessId": model.process_id,
+                "version": version,
+                "processDefinitionKey": process_key,
+                "resourceName": resource_name,
+                "checksum": checksum,
+                "duplicate": duplicate,
+            }
+            processes_metadata.append(meta)
+            if not duplicate:
+                writers.append_event(
+                    process_key, ValueType.PROCESS, ProcessIntent.CREATED,
+                    {**meta, "resource": xml},
+                )
+
+        deployment_value = {
+            "resources": [
+                {"resourceName": r["resourceName"], "resource": r["resource"]} for r in resources
+            ],
+            "processesMetadata": processes_metadata,
+            "decisionsMetadata": [],
+            "decisionRequirementsMetadata": [],
+            "formMetadata": [],
+        }
+        created = writers.append_event(
+            deployment_key, ValueType.DEPLOYMENT, DeploymentIntent.CREATED, deployment_value
+        )
+        writers.respond(cmd, created)
+        # single-partition deployments are immediately fully distributed;
+        # multi-partition distribution rides CommandDistributionBehavior
+        writers.append_event(
+            deployment_key, ValueType.DEPLOYMENT, DeploymentIntent.FULLY_DISTRIBUTED,
+            deployment_value,
+        )
+
+
+class ProcessInstanceCreationProcessor:
+    """PROCESS_INSTANCE_CREATION CREATE: resolve the definition, write CREATED,
+    seed variables, and kick off activation of the process element."""
+
+    def __init__(self, state: EngineState, bpmn: BpmnProcessor) -> None:
+        self.state = state
+        self.bpmn = bpmn
+
+    def process(self, cmd: LoggedRecord, writers: Writers) -> None:
+        value = cmd.record.value
+        bpmn_process_id = value.get("bpmnProcessId", "")
+        definition_key = value.get("processDefinitionKey", -1)
+        version = value.get("version", -1)
+
+        if definition_key > 0:
+            meta = self.state.processes.get_by_key(definition_key)
+        elif version > 0:
+            key = self.state.processes.get_key_by_id_version(bpmn_process_id, version)
+            meta = None if key is None else self.state.processes.get_by_key(key)
+        else:
+            meta = self.state.processes.get_latest_by_id(bpmn_process_id)
+        if meta is None:
+            writers.respond_rejection(
+                cmd, RejectionType.NOT_FOUND,
+                f"Expected to find process definition with process ID '{bpmn_process_id}', "
+                "but none found",
+            )
+            return
+
+        process_instance_key = self.state.next_key()
+        created_value = {
+            "bpmnProcessId": meta["bpmnProcessId"],
+            "version": meta["version"],
+            "processDefinitionKey": meta["processDefinitionKey"],
+            "processInstanceKey": process_instance_key,
+            "variables": value.get("variables", {}),
+            "startInstructions": value.get("startInstructions", []),
+        }
+        created = writers.append_event(
+            process_instance_key, ValueType.PROCESS_INSTANCE_CREATION,
+            ProcessInstanceCreationIntent.CREATED, created_value,
+        )
+        writers.respond(cmd, created)
+
+        pi_value = {
+            "bpmnProcessId": meta["bpmnProcessId"],
+            "version": meta["version"],
+            "processDefinitionKey": meta["processDefinitionKey"],
+            "processInstanceKey": process_instance_key,
+            "elementId": meta["bpmnProcessId"],
+            "flowScopeKey": -1,
+            "bpmnElementType": BpmnElementType.PROCESS.name,
+            "bpmnEventType": "UNSPECIFIED",
+        }
+        writers.append_command(
+            process_instance_key, ValueType.PROCESS_INSTANCE,
+            ProcessInstanceIntent.ACTIVATE_ELEMENT, pi_value,
+        )
+        # seed variables as events *after* CREATED — they apply to the root
+        # scope which exists once ELEMENT_ACTIVATING runs; Zeebe orders the
+        # variable events before activation, with the scope key pre-assigned.
+        for name, val in (value.get("variables") or {}).items():
+            var_key = self.state.next_key()
+            writers.append_event(
+                var_key, ValueType.VARIABLE, VariableIntent.CREATED,
+                {
+                    "name": name,
+                    "value": val,
+                    "scopeKey": process_instance_key,
+                    "processInstanceKey": process_instance_key,
+                    "processDefinitionKey": meta["processDefinitionKey"],
+                    "bpmnProcessId": meta["bpmnProcessId"],
+                },
+            )
+
+
+class ProcessInstanceCancelProcessor:
+    """PROCESS_INSTANCE CANCEL (key = process instance key)."""
+
+    def __init__(self, state: EngineState) -> None:
+        self.state = state
+
+    def process(self, cmd: LoggedRecord, writers: Writers) -> None:
+        key = cmd.record.key
+        instance = self.state.element_instances.get(key)
+        if instance is None or instance["value"].get("flowScopeKey", -1) >= 0:
+            writers.respond_rejection(
+                cmd, RejectionType.NOT_FOUND,
+                f"Expected to cancel existing process instance with key {key}, but none found",
+            )
+            return
+        writers.append_command(key, ValueType.PROCESS_INSTANCE,
+                               ProcessInstanceIntent.TERMINATE_ELEMENT, {})
+        writers.respond(cmd, cmd.record.replace())
+
+
+class JobProcessors:
+    """COMPLETE / FAIL / THROW_ERROR / TIME_OUT / UPDATE_RETRIES / CANCEL."""
+
+    def __init__(self, state: EngineState, clock_millis) -> None:
+        self.state = state
+        self.clock_millis = clock_millis
+
+    def _precondition(self, cmd: LoggedRecord, writers: Writers, expect_activated: bool = True):
+        """DefaultJobCommandPreconditionGuard: job exists and is in a valid state."""
+        key = cmd.record.key
+        job = self.state.jobs.get(key)
+        if job is None:
+            writers.respond_rejection(
+                cmd, RejectionType.NOT_FOUND,
+                f"Expected to find job with key {key}, but no such job was found",
+            )
+            return None
+        return job
+
+    def complete(self, cmd: LoggedRecord, writers: Writers) -> None:
+        job = self._precondition(cmd, writers)
+        if job is None:
+            return
+        key = cmd.record.key
+        variables = cmd.record.value.get("variables", {}) or {}
+        completed_value = {**job, "variables": variables}
+        completed = writers.append_event(key, ValueType.JOB, JobIntent.COMPLETED, completed_value)
+        writers.respond(cmd, completed)
+
+        element_key = job.get("elementInstanceKey", -1)
+        instance = self.state.element_instances.get(element_key)
+        if instance is not None:
+            # completion variables merge into the process instance scope
+            # (reference default propagation without output mappings)
+            pi_key = job.get("processInstanceKey", -1)
+            for name, val in variables.items():
+                target_scope = self.state.variables.find_scope_with(element_key, name) or pi_key
+                var_key = self.state.next_key()
+                exists = self.state.variables.has_local(target_scope, name)
+                writers.append_event(
+                    var_key, ValueType.VARIABLE,
+                    VariableIntent.UPDATED if exists else VariableIntent.CREATED,
+                    {
+                        "name": name, "value": val, "scopeKey": target_scope,
+                        "processInstanceKey": pi_key,
+                        "processDefinitionKey": job.get("processDefinitionKey", -1),
+                        "bpmnProcessId": job.get("bpmnProcessId", ""),
+                    },
+                )
+            writers.append_command(
+                element_key, ValueType.PROCESS_INSTANCE,
+                ProcessInstanceIntent.COMPLETE_ELEMENT, {},
+            )
+
+    def fail(self, cmd: LoggedRecord, writers: Writers) -> None:
+        job = self._precondition(cmd, writers)
+        if job is None:
+            return
+        key = cmd.record.key
+        retries = cmd.record.value.get("retries", 0)
+        backoff = cmd.record.value.get("retryBackOff", 0)
+        error_message = cmd.record.value.get("errorMessage", "")
+        failed_value = {**job, "retries": retries, "errorMessage": error_message}
+        if backoff > 0 and retries > 0:
+            failed_value["retryBackoff"] = self.clock_millis() + backoff
+        failed = writers.append_event(key, ValueType.JOB, JobIntent.FAILED, failed_value)
+        writers.respond(cmd, failed)
+        if retries <= 0:
+            incident_key = self.state.next_key()
+            writers.append_event(
+                incident_key, ValueType.INCIDENT, IncidentIntent.CREATED,
+                {
+                    "errorType": ErrorType.JOB_NO_RETRIES.name,
+                    "errorMessage": error_message or "No more retries left.",
+                    "bpmnProcessId": job.get("bpmnProcessId", ""),
+                    "processDefinitionKey": job.get("processDefinitionKey", -1),
+                    "processInstanceKey": job.get("processInstanceKey", -1),
+                    "elementId": job.get("elementId", ""),
+                    "elementInstanceKey": job.get("elementInstanceKey", -1),
+                    "jobKey": key,
+                    "variableScopeKey": job.get("elementInstanceKey", -1),
+                },
+            )
+
+    def update_retries(self, cmd: LoggedRecord, writers: Writers) -> None:
+        job = self._precondition(cmd, writers)
+        if job is None:
+            return
+        retries = cmd.record.value.get("retries", 0)
+        if retries < 1:
+            writers.respond_rejection(
+                cmd, RejectionType.INVALID_ARGUMENT, f"retries must be >0, got {retries}"
+            )
+            return
+        updated = writers.append_event(
+            cmd.record.key, ValueType.JOB, JobIntent.RETRIES_UPDATED, {**job, "retries": retries}
+        )
+        writers.respond(cmd, updated)
+
+    def time_out(self, cmd: LoggedRecord, writers: Writers) -> None:
+        job = self._precondition(cmd, writers)
+        if job is None:
+            return
+        if self.state.jobs.state_of(cmd.record.key) != JOB_ACTIVATED:
+            writers.respond_rejection(cmd, RejectionType.INVALID_STATE, "job is not activated")
+            return
+        writers.append_event(cmd.record.key, ValueType.JOB, JobIntent.TIMED_OUT, job)
+
+    def throw_error(self, cmd: LoggedRecord, writers: Writers) -> None:
+        job = self._precondition(cmd, writers)
+        if job is None:
+            return
+        # error boundary routing is forthcoming; until then an unhandled error
+        # becomes an incident (reference: UNHANDLED_ERROR_EVENT)
+        error_code = cmd.record.value.get("errorCode", "")
+        thrown = writers.append_event(
+            cmd.record.key, ValueType.JOB, JobIntent.ERROR_THROWN,
+            {**job, "errorCode": error_code,
+             "errorMessage": cmd.record.value.get("errorMessage", "")},
+        )
+        writers.respond(cmd, thrown)
+        incident_key = self.state.next_key()
+        writers.append_event(
+            incident_key, ValueType.INCIDENT, IncidentIntent.CREATED,
+            {
+                "errorType": ErrorType.UNHANDLED_ERROR_EVENT.name,
+                "errorMessage": f"An error was thrown with the code '{error_code}' "
+                                "but not caught.",
+                "bpmnProcessId": job.get("bpmnProcessId", ""),
+                "processDefinitionKey": job.get("processDefinitionKey", -1),
+                "processInstanceKey": job.get("processInstanceKey", -1),
+                "elementId": job.get("elementId", ""),
+                "elementInstanceKey": job.get("elementInstanceKey", -1),
+                "jobKey": cmd.record.key,
+                "variableScopeKey": job.get("elementInstanceKey", -1),
+            },
+        )
+
+
+class JobBatchProcessor:
+    """JOB_BATCH ACTIVATE: collect activatable jobs of a type with variables
+    (reference: JobBatchActivateProcessor.java:33 + JobBatchCollector)."""
+
+    def __init__(self, state: EngineState, clock_millis) -> None:
+        self.state = state
+        self.clock_millis = clock_millis
+
+    def process(self, cmd: LoggedRecord, writers: Writers) -> None:
+        value = cmd.record.value
+        job_type = value.get("type", "")
+        worker = value.get("worker", "")
+        timeout = value.get("timeout", 300_000)
+        max_jobs = value.get("maxJobsToActivate", 32)
+        if not job_type or timeout <= 0 or max_jobs <= 0:
+            writers.respond_rejection(
+                cmd, RejectionType.INVALID_ARGUMENT,
+                f"Expected type, positive timeout and maxJobsToActivate "
+                f"(got type={job_type!r} timeout={timeout} max={max_jobs})",
+            )
+            return
+        deadline = self.clock_millis() + timeout
+        keys = self.state.jobs.activatable_keys(job_type, max_jobs)
+        jobs = []
+        for key in keys:
+            job = dict(self.state.jobs.get(key))
+            element_key = job.get("elementInstanceKey", -1)
+            job["variables"] = self.state.variables.collect(element_key)
+            job["worker"] = worker
+            job["deadline"] = deadline
+            jobs.append(job)
+        batch_key = self.state.next_key()
+        activated_value = {
+            "type": job_type,
+            "worker": worker,
+            "timeout": timeout,
+            "maxJobsToActivate": max_jobs,
+            "jobKeys": keys,
+            "jobs": jobs,
+            "deadline": deadline,
+            "truncated": False,
+        }
+        activated = writers.append_event(
+            batch_key, ValueType.JOB_BATCH, JobBatchIntent.ACTIVATED, activated_value
+        )
+        writers.respond(cmd, activated)
+
+
+class IncidentResolveProcessor:
+    """INCIDENT RESOLVE: drop the incident and retry the stalled work."""
+
+    def __init__(self, state: EngineState) -> None:
+        self.state = state
+
+    def process(self, cmd: LoggedRecord, writers: Writers) -> None:
+        key = cmd.record.key
+        incident = self.state.incidents.get(key)
+        if incident is None:
+            writers.respond_rejection(
+                cmd, RejectionType.NOT_FOUND,
+                f"Expected to resolve incident with key {key}, but no such incident was found",
+            )
+            return
+        resolved = writers.append_event(key, ValueType.INCIDENT, IncidentIntent.RESOLVED, incident)
+        writers.respond(cmd, resolved)
+
+        job_key = incident.get("jobKey", -1)
+        if job_key >= 0:
+            job = self.state.jobs.get(job_key)
+            if job is not None and job.get("retries", 0) > 0:
+                # worker updated retries; job becomes activatable again
+                writers.append_event(
+                    job_key, ValueType.JOB, JobIntent.RECURRED_AFTER_BACKOFF,
+                    {**job, "recurAt": -1},
+                )
+            return
+        element_key = incident.get("elementInstanceKey", -1)
+        instance = self.state.element_instances.get(element_key)
+        if instance is not None:
+            # re-run the stalled transition: COMPLETING retries completion,
+            # ACTIVATING retries activation
+            from zeebe_tpu.engine.engine_state import EI_COMPLETING, EI_ACTIVATING
+
+            if instance["state"] == EI_COMPLETING:
+                writers.append_command(
+                    element_key, ValueType.PROCESS_INSTANCE,
+                    ProcessInstanceIntent.COMPLETE_ELEMENT, {},
+                )
+            elif instance["state"] == EI_ACTIVATING:
+                writers.append_command(
+                    element_key, ValueType.PROCESS_INSTANCE,
+                    ProcessInstanceIntent.ACTIVATE_ELEMENT, instance["value"],
+                )
+
+
+class VariableDocumentProcessor:
+    """VARIABLE_DOCUMENT UPDATE: merge a document into a scope (SetVariables)."""
+
+    def __init__(self, state: EngineState) -> None:
+        self.state = state
+
+    def process(self, cmd: LoggedRecord, writers: Writers) -> None:
+        value = cmd.record.value
+        scope_key = value.get("scopeKey", -1)
+        instance = self.state.element_instances.get(scope_key)
+        if instance is None:
+            writers.respond_rejection(
+                cmd, RejectionType.NOT_FOUND,
+                f"Expected to update variables for element with key {scope_key}, "
+                "but no such element was found",
+            )
+            return
+        local = value.get("local", False)
+        pi_value = instance["value"]
+        for name, val in (value.get("variables") or {}).items():
+            if local:
+                target_scope = scope_key
+            else:
+                target_scope = self.state.variables.find_scope_with(scope_key, name)
+                if target_scope is None:
+                    target_scope = pi_value.get("processInstanceKey", scope_key)
+            exists = self.state.variables.has_local(target_scope, name)
+            var_key = self.state.next_key()
+            writers.append_event(
+                var_key, ValueType.VARIABLE,
+                VariableIntent.UPDATED if exists else VariableIntent.CREATED,
+                {
+                    "name": name, "value": val, "scopeKey": target_scope,
+                    "processInstanceKey": pi_value.get("processInstanceKey", -1),
+                    "processDefinitionKey": pi_value.get("processDefinitionKey", -1),
+                    "bpmnProcessId": pi_value.get("bpmnProcessId", ""),
+                },
+            )
+        doc_key = self.state.next_key()
+        updated = writers.append_event(
+            doc_key, ValueType.VARIABLE_DOCUMENT, VariableDocumentIntent.UPDATED, value
+        )
+        writers.respond(cmd, updated)

@@ -1,0 +1,71 @@
+"""Engine writers: the append-and-apply seam.
+
+Reference: engine/…/processing/streamprocessor/writers/Writers.java —
+StateWriter (appending an event also applies it to state immediately,
+StateWriter.java:11), TypedCommandWriter, TypedRejectionWriter,
+TypedResponseWriter. Keeping "write event" and "apply event" in lock-step is
+what guarantees replay equivalence.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from zeebe_tpu.engine.appliers import EventAppliers
+from zeebe_tpu.logstreams import LoggedRecord
+from zeebe_tpu.protocol import (
+    Record,
+    RejectionType,
+    ValueType,
+    command,
+    event,
+    rejection,
+)
+from zeebe_tpu.protocol.intent import Intent
+from zeebe_tpu.stream import ProcessingResultBuilder
+
+
+class Writers:
+    def __init__(self, builder: ProcessingResultBuilder, appliers: EventAppliers) -> None:
+        self._builder = builder
+        self._appliers = appliers
+
+    # -- StateWriter: append event + apply immediately ------------------------
+
+    def append_event(
+        self, key: int, value_type: ValueType, intent: Intent, value: Mapping[str, Any]
+    ) -> Record:
+        rec = event(value_type, intent, value, key=key)
+        self._builder.append_record(rec)
+        self._appliers.apply(rec)
+        return rec
+
+    # -- TypedCommandWriter ---------------------------------------------------
+
+    def append_command(
+        self, key: int, value_type: ValueType, intent: Intent, value: Mapping[str, Any]
+    ) -> Record:
+        rec = command(value_type, intent, value, key=key)
+        self._builder.append_record(rec)
+        return rec
+
+    # -- TypedRejectionWriter -------------------------------------------------
+
+    def append_rejection(
+        self, cmd: LoggedRecord, rejection_type: RejectionType, reason: str
+    ) -> Record:
+        rec = rejection(cmd.record.replace(position=cmd.position), rejection_type, reason)
+        self._builder.append_record(rec)
+        return rec
+
+    # -- TypedResponseWriter --------------------------------------------------
+
+    def respond(self, cmd: LoggedRecord, record: Record) -> None:
+        if cmd.record.request_id >= 0:
+            self._builder.with_response(
+                record, cmd.record.request_stream_id, cmd.record.request_id
+            )
+
+    def respond_rejection(self, cmd: LoggedRecord, rejection_type: RejectionType, reason: str) -> None:
+        rec = self.append_rejection(cmd, rejection_type, reason)
+        self.respond(cmd, rec)

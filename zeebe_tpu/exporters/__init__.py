@@ -1,0 +1,5 @@
+"""Exporter SPI + built-in exporters (SURVEY.md §2.13 exporters)."""
+
+from zeebe_tpu.exporters.recording import RecordingExporter, RecordStream
+
+__all__ = ["RecordingExporter", "RecordStream"]

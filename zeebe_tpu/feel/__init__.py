@@ -1,0 +1,21 @@
+"""FEEL-lite expression language (SURVEY.md §2.9 expression-language/feel)."""
+
+from zeebe_tpu.feel.feel import (
+    Evaluator,
+    Expression,
+    FeelError,
+    FeelEvalError,
+    FeelParseError,
+    parse_expression,
+    parse_feel,
+)
+
+__all__ = [
+    "Evaluator",
+    "Expression",
+    "FeelError",
+    "FeelEvalError",
+    "FeelParseError",
+    "parse_expression",
+    "parse_feel",
+]

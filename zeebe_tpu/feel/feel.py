@@ -1,0 +1,593 @@
+"""FEEL-lite: the expression language for conditions, io-mappings, and timers.
+
+Reference: expression-language/src/main/java/io/camunda/zeebe/el/
+(FeelExpressionLanguage.java:36 — parse at deploy, evaluate against variable
+context); the reference delegates to the external camunda FEEL Scala engine,
+so this module is a from-scratch interpreter of the FEEL subset Zeebe
+workloads use (S-FEEL + common extensions):
+
+- literals: numbers, strings, booleans, null, lists, contexts
+- variable references with dotted paths (``order.customer.name``)
+- arithmetic ``+ - * /``, unary minus, comparison ``= != < <= > >=``
+- boolean ``and`` / ``or`` / ``not(x)``, parentheses
+- ``if <c> then <a> else <b>``
+- ``x in [a..b]`` ranges and ``in`` list membership
+- a pragmatic builtin set: string(), number(), contains(), starts with(),
+  ends with(), upper case(), lower case(), count(), sum(), min(), max(),
+  floor(), ceiling(), abs(), modulo(), not(), is defined(), string length(),
+  append(), list contains(), now() (from an injected clock)
+
+Expressions come in two forms (reference semantics): a plain attribute value is
+a *static* string; a value starting with ``=`` is a FEEL expression. Parsing
+happens once at deploy time (``parse``); evaluation takes a dict context.
+
+The parsed AST is also the input for the device compiler
+(zeebe_tpu.ops.condition_table) which lowers numeric/boolean condition
+expressions to a vectorized stack VM for in-kernel gateway decisions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Any, Callable
+
+# ---------------------------------------------------------------------------
+# AST
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class Lit:
+    value: Any
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class Var:
+    path: tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class Unary:
+    op: str
+    operand: Any
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class Bin:
+    op: str
+    left: Any
+    right: Any
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class If:
+    cond: Any
+    then: Any
+    orelse: Any
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class Call:
+    name: str
+    args: tuple
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class ListLit:
+    items: tuple
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class ContextLit:
+    entries: tuple  # of (name, expr)
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class Range:
+    lo: Any
+    hi: Any
+    lo_closed: bool
+    hi_closed: bool
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class In:
+    needle: Any
+    haystack: Any
+
+
+class FeelError(Exception):
+    pass
+
+
+class FeelParseError(FeelError):
+    pass
+
+
+class FeelEvalError(FeelError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Tokenizer
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<number>\d+(?:\.\d+)?)
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<op><=|>=|!=|\.\.|[=<>+\-*/(),\[\]{}.:])
+  | (?P<name>[A-Za-z_][A-Za-z_0-9]*)
+    """,
+    re.VERBOSE,
+)
+
+# multi-word builtin names (FEEL allows spaces in function names)
+_MULTIWORD = {
+    ("starts", "with"): "starts with",
+    ("ends", "with"): "ends with",
+    ("upper", "case"): "upper case",
+    ("lower", "case"): "lower case",
+    ("is", "defined"): "is defined",
+    ("string", "length"): "string length",
+    ("list", "contains"): "list contains",
+}
+
+_KEYWORDS = {"if", "then", "else", "and", "or", "true", "false", "null", "in", "not"}
+
+
+def _tokenize(src: str) -> list[tuple[str, str]]:
+    tokens: list[tuple[str, str]] = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if not m:
+            raise FeelParseError(f"unexpected character {src[pos]!r} at {pos} in {src!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        text = m.group()
+        tokens.append((kind, text))
+    # fuse multi-word names
+    fused: list[tuple[str, str]] = []
+    i = 0
+    while i < len(tokens):
+        if (
+            i + 1 < len(tokens)
+            and tokens[i][0] == "name"
+            and tokens[i + 1][0] == "name"
+            and (tokens[i][1], tokens[i + 1][1]) in _MULTIWORD
+        ):
+            fused.append(("name", _MULTIWORD[(tokens[i][1], tokens[i + 1][1])]))
+            i += 2
+        else:
+            fused.append(tokens[i])
+            i += 1
+    return fused
+
+
+# ---------------------------------------------------------------------------
+# Parser (precedence climbing)
+
+
+class _Parser:
+    def __init__(self, tokens: list[tuple[str, str]], src: str) -> None:
+        self.tokens = tokens
+        self.pos = 0
+        self.src = src
+
+    def peek(self) -> tuple[str, str] | None:
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def next(self) -> tuple[str, str]:
+        tok = self.peek()
+        if tok is None:
+            raise FeelParseError(f"unexpected end of expression: {self.src!r}")
+        self.pos += 1
+        return tok
+
+    def expect(self, text: str) -> None:
+        tok = self.next()
+        if tok[1] != text:
+            raise FeelParseError(f"expected {text!r}, got {tok[1]!r} in {self.src!r}")
+
+    def at(self, text: str) -> bool:
+        tok = self.peek()
+        return tok is not None and tok[1] == text
+
+    def parse(self) -> Any:
+        node = self.expr()
+        if self.peek() is not None:
+            raise FeelParseError(f"trailing input at {self.peek()[1]!r} in {self.src!r}")
+        return node
+
+    def expr(self) -> Any:
+        if self.at("if"):
+            self.next()
+            cond = self.expr()
+            self.expect("then")
+            then = self.expr()
+            self.expect("else")
+            orelse = self.expr()
+            return If(cond, then, orelse)
+        return self.or_expr()
+
+    def or_expr(self) -> Any:
+        node = self.and_expr()
+        while self.at("or"):
+            self.next()
+            node = Bin("or", node, self.and_expr())
+        return node
+
+    def and_expr(self) -> Any:
+        node = self.cmp_expr()
+        while self.at("and"):
+            self.next()
+            node = Bin("and", node, self.cmp_expr())
+        return node
+
+    def cmp_expr(self) -> Any:
+        node = self.add_expr()
+        tok = self.peek()
+        if tok is not None and tok[1] in ("=", "!=", "<", "<=", ">", ">="):
+            op = self.next()[1]
+            return Bin(op, node, self.add_expr())
+        if tok is not None and tok[1] == "in":
+            self.next()
+            return In(node, self.in_target())
+        return node
+
+    def in_target(self) -> Any:
+        if self.at("["):
+            # could be a range [a..b] or a list [a, b, c]
+            save = self.pos
+            self.next()
+            lo = self.expr()
+            if self.at(".."):
+                self.next()
+                hi = self.expr()
+                self.expect("]")
+                return Range(lo, hi, True, True)
+            self.pos = save
+            return self.primary()
+        if self.at("(") or self.at("]"):
+            # open ranges like (a..b) — parse as range with open bounds
+            open_lo = self.next()[1] in ("(", "]")
+            lo = self.expr()
+            self.expect("..")
+            hi = self.expr()
+            closing = self.next()[1]
+            return Range(lo, hi, not open_lo, closing == "]")
+        return self.add_expr()
+
+    def add_expr(self) -> Any:
+        node = self.mul_expr()
+        while True:
+            tok = self.peek()
+            if tok is not None and tok[1] in ("+", "-"):
+                op = self.next()[1]
+                node = Bin(op, node, self.mul_expr())
+            else:
+                return node
+
+    def mul_expr(self) -> Any:
+        node = self.unary_expr()
+        while True:
+            tok = self.peek()
+            if tok is not None and tok[1] in ("*", "/"):
+                op = self.next()[1]
+                node = Bin(op, node, self.unary_expr())
+            else:
+                return node
+
+    def unary_expr(self) -> Any:
+        if self.at("-"):
+            self.next()
+            return Unary("-", self.unary_expr())
+        return self.postfix_expr()
+
+    def postfix_expr(self) -> Any:
+        node = self.primary()
+        while True:
+            if self.at("."):
+                # path access fuses into Var where possible
+                self.next()
+                kind, text = self.next()
+                if kind != "name":
+                    raise FeelParseError(f"expected name after '.' in {self.src!r}")
+                if isinstance(node, Var):
+                    node = Var(node.path + (text,))
+                else:
+                    node = Bin("access", node, Lit(text))
+            elif self.at("["):
+                self.next()
+                index = self.expr()
+                self.expect("]")
+                node = Bin("index", node, index)
+            else:
+                return node
+
+    def primary(self) -> Any:
+        kind, text = self.next()
+        if kind == "number":
+            value = float(text) if "." in text else int(text)
+            return Lit(value)
+        if kind == "string":
+            return Lit(_unescape(text[1:-1]))
+        if text == "(":
+            node = self.expr()
+            self.expect(")")
+            return node
+        if text == "[":
+            items = []
+            if not self.at("]"):
+                items.append(self.expr())
+                while self.at(","):
+                    self.next()
+                    items.append(self.expr())
+            self.expect("]")
+            return ListLit(tuple(items))
+        if text == "{":
+            entries = []
+            if not self.at("}"):
+                entries.append(self.context_entry())
+                while self.at(","):
+                    self.next()
+                    entries.append(self.context_entry())
+            self.expect("}")
+            return ContextLit(tuple(entries))
+        if kind == "name" or text in ("not",):
+            if text == "true":
+                return Lit(True)
+            if text == "false":
+                return Lit(False)
+            if text == "null":
+                return Lit(None)
+            if text in _KEYWORDS and text != "not":
+                raise FeelParseError(f"unexpected keyword {text!r} in {self.src!r}")
+            if self.at("("):
+                self.next()
+                args = []
+                if not self.at(")"):
+                    args.append(self.expr())
+                    while self.at(","):
+                        self.next()
+                        args.append(self.expr())
+                self.expect(")")
+                return Call(text, tuple(args))
+            return Var((text,))
+        raise FeelParseError(f"unexpected token {text!r} in {self.src!r}")
+
+    def context_entry(self) -> tuple[str, Any]:
+        kind, text = self.next()
+        if kind == "string":
+            name = _unescape(text[1:-1])
+        elif kind == "name":
+            name = text
+        else:
+            raise FeelParseError(f"bad context key {text!r} in {self.src!r}")
+        self.expect(":")
+        return (name, self.expr())
+
+
+def _unescape(s: str) -> str:
+    return s.replace('\\"', '"').replace("\\\\", "\\").replace("\\n", "\n").replace("\\t", "\t")
+
+
+# ---------------------------------------------------------------------------
+# Evaluator
+
+
+def _num(v: Any) -> float | int:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise FeelEvalError(f"expected number, got {type(v).__name__}")
+    return v
+
+
+_BUILTINS: dict[str, Callable[..., Any]] = {
+    "string": lambda v: "null" if v is None else (str(v).lower() if isinstance(v, bool) else str(v)),
+    "number": lambda v: float(v) if isinstance(v, str) and "." in v else (int(v) if isinstance(v, str) else _num(v)),
+    "contains": lambda s, sub: isinstance(s, str) and sub in s,
+    "starts with": lambda s, p: isinstance(s, str) and s.startswith(p),
+    "ends with": lambda s, p: isinstance(s, str) and s.endswith(p),
+    "upper case": lambda s: s.upper(),
+    "lower case": lambda s: s.lower(),
+    "string length": lambda s: len(s),
+    "count": lambda xs: len(xs),
+    "sum": lambda xs: sum(xs),
+    "min": lambda *xs: min(xs[0] if len(xs) == 1 and isinstance(xs[0], list) else xs),
+    "max": lambda *xs: max(xs[0] if len(xs) == 1 and isinstance(xs[0], list) else xs),
+    "floor": lambda v: math.floor(_num(v)),
+    "ceiling": lambda v: math.ceil(_num(v)),
+    "abs": lambda v: abs(_num(v)),
+    "modulo": lambda a, b: _num(a) % _num(b),
+    "sqrt": lambda v: math.sqrt(_num(v)),
+    "not": lambda v: (not v) if isinstance(v, bool) else None,
+    "append": lambda xs, *vs: list(xs) + list(vs),
+    "list contains": lambda xs, v: v in xs,
+}
+
+
+class Evaluator:
+    def __init__(self, context: dict[str, Any], clock_millis: Callable[[], int] | None = None):
+        self.ctx = context
+        self.clock_millis = clock_millis
+
+    def eval(self, node: Any) -> Any:
+        method = getattr(self, f"_eval_{type(node).__name__}")
+        return method(node)
+
+    def _eval_Lit(self, node: Lit) -> Any:
+        return node.value
+
+    def _eval_Var(self, node: Var) -> Any:
+        value: Any = self.ctx
+        for part in node.path:
+            if isinstance(value, dict) and part in value:
+                value = value[part]
+            else:
+                return None  # FEEL: missing variable evaluates to null
+        return value
+
+    def _eval_Unary(self, node: Unary) -> Any:
+        return -_num(self.eval(node.operand))
+
+    def _eval_Bin(self, node: Bin) -> Any:
+        op = node.op
+        if op == "and":
+            left = self.eval(node.left)
+            if left is False:
+                return False
+            right = self.eval(node.right)
+            if left is True and right is True:
+                return True
+            return False if right is False else None
+        if op == "or":
+            left = self.eval(node.left)
+            if left is True:
+                return True
+            right = self.eval(node.right)
+            if right is True:
+                return True
+            return False if (left is False and right is False) else None
+        left = self.eval(node.left)
+        right = self.eval(node.right)
+        if op == "access":
+            return left.get(right) if isinstance(left, dict) else None
+        if op == "index":
+            if isinstance(left, list):
+                i = int(_num(right))
+                # FEEL is 1-based; negative indexes count from the end
+                if 1 <= i <= len(left):
+                    return left[i - 1]
+                if -len(left) <= i <= -1:
+                    return left[i]
+                return None
+            return None
+        if op == "=":
+            return left == right
+        if op == "!=":
+            return left != right
+        if op in ("<", "<=", ">", ">="):
+            if left is None or right is None:
+                return None
+            try:
+                if op == "<":
+                    return left < right
+                if op == "<=":
+                    return left <= right
+                if op == ">":
+                    return left > right
+                return left >= right
+            except TypeError:
+                raise FeelEvalError(f"cannot compare {type(left).__name__} and {type(right).__name__}")
+        if left is None or right is None:
+            return None
+        if op == "+":
+            if isinstance(left, str) and isinstance(right, str):
+                return left + right
+            return _num(left) + _num(right)
+        if op == "-":
+            return _num(left) - _num(right)
+        if op == "*":
+            return _num(left) * _num(right)
+        if op == "/":
+            divisor = _num(right)
+            if divisor == 0:
+                return None  # FEEL: division by zero is null
+            return _num(left) / divisor
+        raise FeelEvalError(f"unknown operator {op!r}")
+
+    def _eval_If(self, node: If) -> Any:
+        return self.eval(node.then) if self.eval(node.cond) is True else self.eval(node.orelse)
+
+    def _eval_Call(self, node: Call) -> Any:
+        if node.name == "is defined":
+            return self.eval(node.args[0]) is not None
+        if node.name == "now":
+            if self.clock_millis is None:
+                raise FeelEvalError("now() requires a clock")
+            return self.clock_millis()
+        fn = _BUILTINS.get(node.name)
+        if fn is None:
+            raise FeelEvalError(f"unknown function {node.name!r}")
+        args = [self.eval(a) for a in node.args]
+        try:
+            return fn(*args)
+        except FeelEvalError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — builtin misuse becomes an eval error
+            raise FeelEvalError(f"{node.name}() failed: {exc}")
+
+    def _eval_ListLit(self, node: ListLit) -> Any:
+        return [self.eval(item) for item in node.items]
+
+    def _eval_ContextLit(self, node: ContextLit) -> Any:
+        return {name: self.eval(expr) for name, expr in node.entries}
+
+    def _eval_Range(self, node: Range) -> Any:
+        raise FeelEvalError("range is only valid on the right of 'in'")
+
+    def _eval_In(self, node: In) -> Any:
+        needle = self.eval(node.needle)
+        target = node.haystack
+        if isinstance(target, Range):
+            lo = self.eval(target.lo)
+            hi = self.eval(target.hi)
+            if needle is None or lo is None or hi is None:
+                return None
+            ok_lo = needle >= lo if target.lo_closed else needle > lo
+            ok_hi = needle <= hi if target.hi_closed else needle < hi
+            return ok_lo and ok_hi
+        hay = self.eval(target)
+        if isinstance(hay, list):
+            return needle in hay
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Public API (the ExpressionLanguage facade)
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class Expression:
+    """A parsed expression: static string or FEEL AST (reference:
+    el/Expression.java — isStatic/getExpression)."""
+
+    source: str
+    is_static: bool
+    ast: Any = None
+
+    def evaluate(self, context: dict[str, Any], clock_millis: Callable[[], int] | None = None) -> Any:
+        if self.is_static:
+            return self.source
+        return Evaluator(context, clock_millis).eval(self.ast)
+
+
+_parse_cache: dict[str, Expression] = {}
+
+
+def parse_expression(source: str | None) -> Expression | None:
+    """Attribute-value semantics: ``= expr`` is FEEL, anything else static.
+    Parse errors raise FeelParseError at deploy time (reference behavior:
+    invalid expressions reject the deployment)."""
+    if source is None:
+        return None
+    cached = _parse_cache.get(source)
+    if cached is not None:
+        return cached
+    if source.startswith("="):
+        ast = _Parser(_tokenize(source[1:]), source).parse()
+        expr = Expression(source=source, is_static=False, ast=ast)
+    else:
+        expr = Expression(source=source, is_static=True)
+    if len(_parse_cache) < 10000:
+        _parse_cache[source] = expr
+    return expr
+
+
+def parse_feel(source: str) -> Expression:
+    """Parse a bare FEEL expression (no '=' marker), e.g. condition bodies."""
+    return Expression(source=source, is_static=False, ast=_Parser(_tokenize(source), source).parse())

@@ -163,6 +163,11 @@ class _Segment:
             data = bytes(mv[pos + _FRAME.size : pos + _FRAME.size + length])
             pos += _FRAME.size + length
             if rec_index >= index:
+                if _checksum(rec_index, asqn, data) != crc:
+                    mv.release()
+                    raise CorruptedJournalError(
+                        f"checksum mismatch reading record {rec_index} in {self.path}"
+                    )
                 yield JournalRecord(rec_index, asqn, data)
         mv.release()
 
@@ -187,7 +192,12 @@ class _Segment:
                 return None
             length, crc, rec_index, asqn = _FRAME.unpack(head)
             if rec_index == index:
-                return JournalRecord(rec_index, asqn, f.read(length))
+                data = f.read(length)
+                if _checksum(rec_index, asqn, data) != crc:
+                    raise CorruptedJournalError(
+                        f"checksum mismatch reading record {rec_index} in {self.path}"
+                    )
+                return JournalRecord(rec_index, asqn, data)
             offset += _FRAME.size + length
         return None
 
@@ -404,3 +414,7 @@ class SegmentedJournal:
         for seg in self.segments:
             seg.delete()
         self.segments = [_Segment(self._segment_path(1), 1, next_index, create=True)]
+        # invalidate the stale flushed-index marker from the pre-reset log
+        tmp = self._meta_path.with_suffix(".tmp")
+        tmp.write_bytes(struct.pack("<Q", max(next_index - 1, 0)))
+        os.replace(tmp, self._meta_path)

@@ -154,6 +154,7 @@ class LogStream:
         # parallel arrays: batch first positions (sorted) and journal indexes
         self._batch_positions: list[int] = []
         self._batch_indexes: list[int] = []
+        self._batch_cache: tuple[int, list[LoggedRecord]] | None = None
         self.rebuild_index()
         self._writer = LogStreamWriter(self)
 
@@ -162,6 +163,7 @@ class LogStream:
         (call after external journal mutation, e.g. Raft truncation)."""
         self._batch_positions.clear()
         self._batch_indexes.clear()
+        self._batch_cache = None
         for index, asqn in self.journal.entries_meta():
             if asqn >= 0:
                 self._batch_positions.append(asqn)
@@ -173,10 +175,17 @@ class LogStream:
             self._next_position = 1
 
     def _read_batch_at(self, journal_index: int) -> list[LoggedRecord]:
+        # one-slot decode cache: sequential readers (processing, replay,
+        # exporters) hit the same batch once per record otherwise
+        cached = self._batch_cache
+        if cached is not None and cached[0] == journal_index:
+            return cached[1]
         jrec = self.journal.read_entry(journal_index)
         if jrec is None:
             return []
-        return _deserialize_batch(jrec.data, self.partition_id)
+        batch = _deserialize_batch(jrec.data, self.partition_id)
+        self._batch_cache = (journal_index, batch)
+        return batch
 
     def _on_appended(self, first_position: int, journal_index: int) -> None:
         self._batch_positions.append(first_position)

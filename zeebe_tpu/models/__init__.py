@@ -1,0 +1,1 @@
+"""Model libraries: BPMN (and DMN, forthcoming) — SURVEY.md §2.9."""

@@ -83,6 +83,8 @@ class Record:
             reason = reason[:0xFFFF]
             while reason and (reason[-1] & 0xC0) == 0x80:
                 reason = reason[:-1]
+            if reason and reason[-1] >= 0xC0:  # dangling lead byte
+                reason = reason[:-1]
         body = msgpack.packb(dict(self.value))
         header = _HEADER.pack(
             int(self.record_type),

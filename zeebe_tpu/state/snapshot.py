@@ -192,6 +192,17 @@ class FileBasedSnapshotStore:
         target = self.snapshots_dir / str(transient.id)
         if target.exists():
             shutil.rmtree(target)
+        # make file *contents* durable before the rename publishes the
+        # snapshot — else a crash yields a "persisted" snapshot with torn
+        # data after the log prefix was compacted away
+        for p in transient.path.iterdir():
+            if p.is_file():
+                fd = os.open(p, os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+        self._fsync_dir(transient.path)
         os.replace(transient.path, target)
         self._fsync_dir(self.snapshots_dir)
         self._purge_older_than(transient.id)

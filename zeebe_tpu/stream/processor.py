@@ -136,11 +136,18 @@ class StreamProcessor:
                     # last-processed position. This is what makes snapshot +
                     # replay idempotent (reference: ReplayStateMachine skips
                     # up to the snapshot's processed position).
-                    if rec.record.is_event and rec.source_position > self.last_processed_position:
-                        self.processor.replay(rec)
-                        applied += 1
-                        if rec.source_position > max_source:
-                            max_source = rec.source_position
+                    if rec.source_position > self.last_processed_position:
+                        if rec.record.is_event:
+                            self.processor.replay(rec)
+                            applied += 1
+                            if rec.source_position > max_source:
+                                max_source = rec.source_position
+                        elif rec.record.is_rejection:
+                            # a rejection-only step still marks its command
+                            # processed, else restart reprocesses it and
+                            # duplicates the rejection + client response
+                            if rec.source_position > max_source:
+                                max_source = rec.source_position
                 if max_source > self.last_processed_position:
                     self.last_processed_position = max_source
                     self._store_last_processed(max_source)

@@ -1,0 +1,209 @@
+"""EngineHarness — the EngineRule equivalent: a real engine on a real log with
+no gateway, no Raft, no network.
+
+Reference: engine/src/test/java/io/camunda/zeebe/engine/util/EngineRule.java:73,
+TestStreams (writes commands directly to the log), ProcessingExporterTransistor
+(feeds every written record into the RecordingExporter), ControlledActorClock
+(deterministic time).
+
+Also the module the bench and the gateway-less demo drive — the reference uses
+EngineRule for its CI perf gate (EngineLargeStatePerformanceTest) the same way.
+"""
+
+from __future__ import annotations
+
+import tempfile
+from pathlib import Path
+from typing import Any
+
+from zeebe_tpu.engine.engine import Engine
+from zeebe_tpu.exporters.recording import RecordingExporter
+from zeebe_tpu.journal import SegmentedJournal
+from zeebe_tpu.logstreams import LogAppendEntry, LogStream
+from zeebe_tpu.models.bpmn import ProcessModel, to_bpmn_xml
+from zeebe_tpu.protocol import Record, ValueType, command
+from zeebe_tpu.protocol.intent import (
+    DeploymentIntent,
+    IncidentIntent,
+    JobBatchIntent,
+    JobIntent,
+    ProcessInstanceCreationIntent,
+    ProcessInstanceIntent,
+    VariableDocumentIntent,
+)
+from zeebe_tpu.state import ZbDb
+from zeebe_tpu.stream import StreamProcessor, StreamProcessorMode
+
+
+class ControlledClock:
+    """Deterministic test clock (reference: ControlledActorClock)."""
+
+    def __init__(self, start_millis: int = 1_000_000) -> None:
+        self.millis = start_millis
+
+    def __call__(self) -> int:
+        return self.millis
+
+    def advance(self, millis: int) -> None:
+        self.millis += millis
+
+
+class EngineHarness:
+    def __init__(
+        self,
+        directory: str | Path | None = None,
+        partition_id: int = 1,
+        max_commands_in_batch: int = 100,
+        consistency_checks: bool = True,
+    ) -> None:
+        self._tmp = None
+        if directory is None:
+            self._tmp = tempfile.TemporaryDirectory()
+            directory = self._tmp.name
+        self.clock = ControlledClock()
+        self.journal = SegmentedJournal(Path(directory) / "log")
+        self.stream = LogStream(self.journal, partition_id, clock=self.clock)
+        self.db = ZbDb(consistency_checks=consistency_checks)
+        self.engine = Engine(self.db, partition_id, clock_millis=self.clock)
+        self.exporter = RecordingExporter()
+        self.responses: list = []
+        self.processor = StreamProcessor(
+            self.stream,
+            self.db,
+            self.engine,
+            max_commands_in_batch=max_commands_in_batch,
+            response_sink=self.responses.append,
+            clock_millis=self.clock,
+        )
+        self.processor.start()
+        self._exported_until = 0
+
+    def close(self) -> None:
+        self.journal.close()
+        if self._tmp is not None:
+            self._tmp.cleanup()
+
+    # -- pump ----------------------------------------------------------------
+
+    def pump(self) -> None:
+        """Process everything pending, then transfer new records to the
+        exporter (the ProcessingExporterTransistor role)."""
+        self.processor.run_until_idle()
+        for logged in self.stream.new_reader(self._exported_until + 1):
+            self.exporter.export(logged)
+            self._exported_until = logged.position
+    # -- command ingress (the TestStreams role) ------------------------------
+
+    def write_command(self, record: Record, request_id: int = -1) -> None:
+        rec = record.replace(request_id=request_id, request_stream_id=0) if request_id >= 0 else record
+        self.stream.writer.try_write([LogAppendEntry(rec)])
+        self.pump()
+
+    # -- fluent client-ish API ----------------------------------------------
+
+    def deploy(self, *models: ProcessModel | str, request_id: int = 1) -> None:
+        resources = []
+        for i, model in enumerate(models):
+            xml = model if isinstance(model, str) else to_bpmn_xml(model)
+            name = f"resource_{i}.bpmn"
+            if isinstance(model, ProcessModel):
+                name = f"{model.process_id}.bpmn"
+            resources.append({"resourceName": name, "resource": xml})
+        self.write_command(
+            command(ValueType.DEPLOYMENT, DeploymentIntent.CREATE, {"resources": resources}),
+            request_id=request_id,
+        )
+
+    def create_instance(
+        self, bpmn_process_id: str, variables: dict[str, Any] | None = None,
+        version: int = -1, request_id: int = 2,
+    ) -> int:
+        self.write_command(
+            command(
+                ValueType.PROCESS_INSTANCE_CREATION,
+                ProcessInstanceCreationIntent.CREATE,
+                {
+                    "bpmnProcessId": bpmn_process_id,
+                    "version": version,
+                    "variables": variables or {},
+                },
+            ),
+            request_id=request_id,
+        )
+        created = (
+            self.exporter.all()
+            .with_value_type(ValueType.PROCESS_INSTANCE_CREATION)
+            .with_intent(ProcessInstanceCreationIntent.CREATED)
+            .to_list()
+        )
+        return created[-1].record.value["processInstanceKey"]
+
+    def cancel_instance(self, process_instance_key: int, request_id: int = 3) -> None:
+        self.write_command(
+            command(ValueType.PROCESS_INSTANCE, ProcessInstanceIntent.CANCEL, {},
+                    key=process_instance_key),
+            request_id=request_id,
+        )
+
+    def activate_jobs(
+        self, job_type: str, worker: str = "test-worker", max_jobs: int = 32,
+        timeout: int = 300_000, request_id: int = 4,
+    ) -> list[dict]:
+        before = self.exporter.job_batch_records().with_intent(JobBatchIntent.ACTIVATED).count()
+        self.write_command(
+            command(
+                ValueType.JOB_BATCH, JobBatchIntent.ACTIVATE,
+                {"type": job_type, "worker": worker, "timeout": timeout,
+                 "maxJobsToActivate": max_jobs},
+            ),
+            request_id=request_id,
+        )
+        batches = self.exporter.job_batch_records().with_intent(JobBatchIntent.ACTIVATED).to_list()
+        new = batches[before:]
+        jobs = []
+        for batch in new:
+            for key, job in zip(batch.record.value["jobKeys"], batch.record.value["jobs"]):
+                jobs.append({"key": key, **job})
+        return jobs
+
+    def complete_job(self, job_key: int, variables: dict | None = None, request_id: int = 5) -> None:
+        self.write_command(
+            command(ValueType.JOB, JobIntent.COMPLETE, {"variables": variables or {}}, key=job_key),
+            request_id=request_id,
+        )
+
+    def fail_job(self, job_key: int, retries: int, error_message: str = "", request_id: int = 6) -> None:
+        self.write_command(
+            command(ValueType.JOB, JobIntent.FAIL,
+                    {"retries": retries, "errorMessage": error_message}, key=job_key),
+            request_id=request_id,
+        )
+
+    def resolve_incident(self, incident_key: int, request_id: int = 7) -> None:
+        self.write_command(
+            command(ValueType.INCIDENT, IncidentIntent.RESOLVE, {}, key=incident_key),
+            request_id=request_id,
+        )
+
+    def update_job_retries(self, job_key: int, retries: int, request_id: int = 8) -> None:
+        self.write_command(
+            command(ValueType.JOB, JobIntent.UPDATE_RETRIES, {"retries": retries}, key=job_key),
+            request_id=request_id,
+        )
+
+    def set_variables(self, scope_key: int, variables: dict, local: bool = False, request_id: int = 9) -> None:
+        self.write_command(
+            command(ValueType.VARIABLE_DOCUMENT, VariableDocumentIntent.UPDATE,
+                    {"scopeKey": scope_key, "variables": variables, "local": local}),
+            request_id=request_id,
+        )
+
+    # -- state helpers -------------------------------------------------------
+
+    def is_instance_done(self, process_instance_key: int) -> bool:
+        with self.db.transaction():
+            return self.engine.state.element_instances.get(process_instance_key) is None
+
+    def variables_of(self, scope_key: int) -> dict:
+        with self.db.transaction():
+            return self.engine.state.variables.collect(scope_key)
