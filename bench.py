@@ -1,0 +1,91 @@
+"""Flagship benchmark: one-task-process workload on the automaton kernel.
+
+Mirrors the reference's EngineLargeStatePerformanceTest + benchmarks/
+one_task.bpmn workload (BASELINE.md): process instances of
+start → service task → end are driven to completion and we measure process-
+instance state transitions per second on one chip. A "transition" is one
+lifecycle event the reference would write to its log (ELEMENT_ACTIVATING/
+ACTIVATED/COMPLETING/COMPLETED, SEQUENCE_FLOW_TAKEN) — one_task costs 16 per
+instance, identical to the reference engine's event count for the same
+scenario (see tests/test_automaton.py parity tests).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N/50000}
+vs_baseline is the ratio against BASELINE.json's north star of >= 50k
+transitions/s/chip (>1.0 beats the target; the Java reference engine does
+~450 instance round trips/s ≈ 7.2k transitions/s on its CI anchor).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+from zeebe_tpu.models.bpmn import Bpmn, transform
+from zeebe_tpu.ops.automaton import DeviceTables, make_state, run_to_completion
+from zeebe_tpu.ops.tables import compile_tables
+
+
+def build_workload(num_instances: int):
+    exe = transform(
+        Bpmn.create_executable_process("one_task")
+        .start_event("start")
+        .service_task("task", job_type="work")
+        .end_event("end")
+        .done()
+    )
+    tables = compile_tables([exe])
+    dt = DeviceTables.from_tables(tables)
+    def_of = np.zeros(num_instances, np.int32)
+    return tables, dt, def_of
+
+
+def main() -> None:
+    num_instances = 1 << 20  # ~1M instances per round (throughput-optimal)
+    rounds = 5
+    tables, dt, def_of = build_workload(num_instances)
+
+    def fresh_state():
+        # one token per instance for a linear process: T = I
+        return make_state(tables, num_instances, def_of, token_capacity=num_instances)
+
+    config = tables.kernel_config  # static traits let XLA prune unused machinery
+
+    # warmup: compile + one full run
+    state = fresh_state()
+    final, steps = run_to_completion(dt, state, max_steps=64, config=config)
+    jax.block_until_ready(final["transitions"])
+    per_run_transitions = int(final["transitions"])
+    assert bool(final["done"].all()) and not bool(final["overflow"])
+
+    states = [fresh_state() for _ in range(rounds)]
+    for s in states:
+        jax.block_until_ready(s["elem"])
+
+    t0 = time.perf_counter()
+    totals = []
+    for s in states:
+        final, _ = run_to_completion(dt, s, max_steps=64, config=config)
+        totals.append(final["transitions"])
+    jax.block_until_ready(totals)
+    elapsed = time.perf_counter() - t0
+
+    total_transitions = rounds * per_run_transitions
+    per_sec = total_transitions / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "process_instance_transitions_per_sec_per_chip",
+                "value": round(per_sec, 1),
+                "unit": "transitions/s",
+                "vs_baseline": round(per_sec / 50000.0, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -3,11 +3,18 @@
 Multi-chip TPU hardware is not available in CI; sharding/pjit paths are
 validated on host-platform virtual devices (the driver separately dry-runs the
 multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: the environment's TPU plugin re-selects its platform programmatically at
+import, so JAX_PLATFORMS alone is not enough — jax.config.update after import
+is what actually pins the CPU backend.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

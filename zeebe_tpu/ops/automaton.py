@@ -1,0 +1,461 @@
+"""The data-parallel BPMN automaton kernel.
+
+This is the BASELINE.json north star: the reference's BpmnStreamProcessor +
+per-element BpmnElementProcessor handlers (engine/…/processing/bpmn/) re-
+expressed as one `jax.jit` step advancing thousands of process instances
+lock-step on a TPU. Design notes:
+
+- **SoA token pool**: a token is a (element, phase, instance) triple in flat
+  int32 arrays of capacity T. No Python objects, no per-token control flow —
+  the element-type dispatch (the reference's switch in BpmnElementProcessors)
+  is masked vector arithmetic over the deploy-time tables (tables.py).
+- **Lock-step semantics**: one kernel step advances every live token through
+  one element pass. Within a step tokens are independent (per-instance state
+  only); the host merges device events back into the partition's event-sourced
+  log in deterministic slot order, making the batched schedule a reordering-
+  equivalent of the reference's one-at-a-time processing.
+- **Movement is allocation**: every taken sequence flow (including parallel
+  fan-out) becomes a placement request; free token slots are assigned by
+  prefix-sum, parallel-join arrivals are ranked with a stable sort so exactly
+  the completing arrival proceeds — the NUMBER_OF_TAKEN_SEQUENCE_FLOWS
+  counters live in a dense [instances, elements] array.
+- **Conditions** run on a vectorized stack VM over per-instance float32
+  variable slots (compile_condition), so exclusive-gateway routing needs no
+  host round trip.
+- **TPU mapping**: everything is static-shaped, int32/float32, and fuses into
+  a handful of XLA kernels; gathers/scatters ride the VPU while the MXU stays
+  free for future DMN/decision-table batch evaluation. Scaling over a mesh is
+  data-parallel over instances (see zeebe_tpu.parallel.mesh) — the partition
+  axis of the reference maps to the mesh axis here.
+
+Job handling: ``auto_jobs=True`` emulates instant workers on-device (bench
+mode, isolates engine throughput); otherwise tokens park in PHASE_WAIT and the
+host completes jobs between steps (``complete_jobs``), which is how the real
+job-worker path drives the kernel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from zeebe_tpu.ops.tables import (
+    K_END,
+    K_EXCLUSIVE,
+    K_FORK,
+    K_JOIN,
+    K_NONE,
+    K_PASS,
+    K_TASK,
+    MAX_PROG_LEN,
+    OP_ADD,
+    OP_AND,
+    OP_DIV,
+    OP_EQ,
+    OP_GE,
+    OP_GT,
+    OP_LE,
+    OP_LT,
+    OP_MUL,
+    OP_NE,
+    OP_NEG,
+    OP_NOP,
+    OP_NOT,
+    OP_OR,
+    OP_PUSH_CONST,
+    OP_PUSH_VAR,
+    OP_SUB,
+    STACK_DEPTH,
+    ProcessTables,
+)
+
+# token phases
+PHASE_AT = 0  # at element, executes this step
+PHASE_WAIT = 1  # task activated, waiting for job completion
+PHASE_DONE = 2  # job completed, finish task this step
+PHASE_STALLED = 3  # incident raised; host must resolve
+
+
+@dataclasses.dataclass
+class DeviceTables:
+    """ProcessTables moved to device arrays (a pytree via tree_flatten)."""
+
+    kernel_op: jax.Array
+    in_count: jax.Array
+    job_type: jax.Array
+    out_count: jax.Array
+    out_target: jax.Array
+    out_cond: jax.Array
+    out_flow_idx: jax.Array
+    default_slot: jax.Array
+    start_elem: jax.Array
+    cond_ops: jax.Array
+    cond_args: jax.Array
+
+    @classmethod
+    def from_tables(cls, t: ProcessTables) -> "DeviceTables":
+        return cls(
+            kernel_op=jnp.asarray(t.kernel_op),
+            in_count=jnp.asarray(t.in_count),
+            job_type=jnp.asarray(t.job_type),
+            out_count=jnp.asarray(t.out_count),
+            out_target=jnp.asarray(t.out_target),
+            out_cond=jnp.asarray(t.out_cond),
+            out_flow_idx=jnp.asarray(t.out_flow_idx),
+            default_slot=jnp.asarray(t.default_slot),
+            start_elem=jnp.asarray(t.start_elem),
+            cond_ops=jnp.asarray(t.cond_ops),
+            cond_args=jnp.asarray(t.cond_args),
+        )
+
+
+jax.tree_util.register_pytree_node(
+    DeviceTables,
+    lambda t: (tuple(getattr(t, f.name) for f in dataclasses.fields(t)), None),
+    lambda _, children: DeviceTables(*children),
+)
+
+
+def make_state(
+    tables: ProcessTables,
+    num_instances: int,
+    definition_of_instance: np.ndarray,
+    initial_slots: np.ndarray | None = None,
+    token_capacity: int | None = None,
+    num_shards: int = 1,
+) -> dict:
+    """Fresh automaton state: one token per instance, parked at the start
+    event. Arrays are a plain dict pytree so jit/donation/sharding apply.
+
+    With ``num_shards > 1`` the layout is shard-block-aligned for axis-0
+    sharding over a mesh: shard s owns instance rows [s*I/n, (s+1)*I/n) and
+    the token-pool block [s*T/n, (s+1)*T/n); token ``inst`` values are
+    *local* to the shard block (the kernel body runs on local shapes under
+    shard_map, so per-shard indices must be self-contained)."""
+    I = num_instances
+    T = token_capacity or (2 * I)
+    if I % num_shards or T % num_shards:
+        raise ValueError(f"instances ({I}) and tokens ({T}) must divide num_shards ({num_shards})")
+    E = tables.max_elements
+    S = tables.num_slots
+    def_of = np.asarray(definition_of_instance, np.int32)
+    elem = np.full(T, -1, np.int32)
+    phase = np.zeros(T, np.int32)
+    inst = np.zeros(T, np.int32)
+    Il, Tl = I // num_shards, T // num_shards
+    if Il > Tl:
+        raise ValueError("token capacity per shard smaller than instances per shard")
+    for s in range(num_shards):
+        block = slice(s * Tl, s * Tl + Il)
+        elem[block] = tables.start_elem[def_of[s * Il : (s + 1) * Il]]
+        inst[block] = np.arange(Il, dtype=np.int32)
+    slots = (
+        np.asarray(initial_slots, np.float32)
+        if initial_slots is not None
+        else np.zeros((I, S), np.float32)
+    )
+    return {
+        "elem": jnp.asarray(elem),
+        "phase": jnp.asarray(phase),
+        "inst": jnp.asarray(inst),
+        "def_of": jnp.asarray(def_of),
+        "var_slots": jnp.asarray(slots),
+        "join_counts": jnp.zeros((I, E), jnp.int32),
+        "done": jnp.zeros(I, jnp.bool_),
+        "incident": jnp.zeros(I, jnp.bool_),
+        "transitions": jnp.zeros((), jnp.int32),
+        "jobs_created": jnp.zeros((), jnp.int32),
+        "completed": jnp.zeros((), jnp.int32),
+        "overflow": jnp.zeros((), jnp.bool_),
+    }
+
+
+# ---------------------------------------------------------------------------
+# condition VM
+
+
+def _eval_program(ops: jax.Array, args: jax.Array, slots: jax.Array) -> jax.Array:
+    """Evaluate one condition program against one instance's slots → bool."""
+
+    def body(i, carry):
+        stack, sp = carry
+        op = ops[i]
+        arg = args[i]
+        push_val = jnp.where(op == OP_PUSH_VAR, slots[arg.astype(jnp.int32)], arg)
+        a = stack[jnp.maximum(sp - 2, 0)]
+        b = stack[jnp.maximum(sp - 1, 0)]
+        bin_val = jnp.select(
+            [
+                op == OP_LT, op == OP_LE, op == OP_GT, op == OP_GE,
+                op == OP_EQ, op == OP_NE, op == OP_AND, op == OP_OR,
+                op == OP_ADD, op == OP_SUB, op == OP_MUL, op == OP_DIV,
+            ],
+            [
+                (a < b).astype(jnp.float32), (a <= b).astype(jnp.float32),
+                (a > b).astype(jnp.float32), (a >= b).astype(jnp.float32),
+                (jnp.abs(a - b) < 1e-9).astype(jnp.float32),
+                (jnp.abs(a - b) >= 1e-9).astype(jnp.float32),
+                jnp.minimum(a, b), jnp.maximum(a, b),
+                a + b, a - b, a * b,
+                jnp.where(b != 0, a / jnp.where(b == 0, 1.0, b), 0.0),
+            ],
+            default=jnp.float32(0.0),
+        )
+        un_val = jnp.select(
+            [op == OP_NOT, op == OP_NEG],
+            [1.0 - jnp.minimum(b, 1.0), -b],
+            default=jnp.float32(0.0),
+        )
+        is_push = (op == OP_PUSH_CONST) | (op == OP_PUSH_VAR)
+        is_un = (op == OP_NOT) | (op == OP_NEG)
+        # note: OP_NOT sits inside the 3..15 numeric range — exclude unaries
+        is_bin = (op >= OP_LT) & (op <= OP_DIV) & ~is_un
+        new_top = jnp.where(is_push, push_val, jnp.where(is_bin, bin_val, un_val))
+        write_pos = jnp.where(is_push, sp, jnp.where(is_bin, sp - 2, sp - 1))
+        do_write = is_push | is_bin | is_un
+        # NOPs write out of bounds → dropped
+        write_pos = jnp.where(do_write, jnp.clip(write_pos, 0, STACK_DEPTH - 1), STACK_DEPTH)
+        stack = stack.at[write_pos].set(new_top, mode="drop")
+        sp = sp + jnp.where(is_push, 1, jnp.where(is_bin, -1, 0))
+        return stack, sp
+
+    stack0 = jnp.zeros(STACK_DEPTH, jnp.float32)
+    stack, sp = jax.lax.fori_loop(0, MAX_PROG_LEN, body, (stack0, jnp.int32(0)))
+    return stack[jnp.maximum(sp - 1, 0)] > 0.5
+
+
+# vmapped over (program_id per request, slots per request)
+def _eval_conditions(cond_ops, cond_args, prog_ids, slot_rows):
+    def one(pid, slots):
+        return jax.lax.cond(
+            pid >= 0,
+            lambda: _eval_program(cond_ops[jnp.maximum(pid, 0)], cond_args[jnp.maximum(pid, 0)], slots),
+            lambda: jnp.bool_(False),
+        )
+    return jax.vmap(one)(prog_ids, slot_rows)
+
+
+# ---------------------------------------------------------------------------
+# the step kernel
+
+
+@partial(jax.jit, static_argnames=("auto_jobs", "emit_events", "config"))
+def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events: bool = False,
+         config=None):
+    """One lock-step advance of every live token. Returns (state', events)
+    where events is None unless emit_events (parity/integration mode).
+    ``config`` (static KernelConfig) prunes join/condition machinery the
+    deployed process set does not use."""
+    from zeebe_tpu.ops.tables import KernelConfig
+
+    if config is None:
+        config = KernelConfig()
+    T = state["elem"].shape[0]
+    I = state["def_of"].shape[0]
+    E = tables.kernel_op.shape[1]
+    FO = tables.out_target.shape[2]
+
+    elem = state["elem"]
+    phase = state["phase"]
+    inst = state["inst"]
+    def_of_tok = state["def_of"][inst]
+
+    live = elem >= 0
+    op = jnp.where(live, tables.kernel_op[def_of_tok, jnp.maximum(elem, 0)], K_NONE)
+    stalled = phase == PHASE_STALLED
+
+    # --- what does each token do this step? ------------------------------
+    is_task = op == K_TASK
+    executing = live & (phase == PHASE_AT) & ~stalled
+    arriving_task = executing & is_task
+    pass_attempt = executing & ~is_task
+    if auto_jobs:
+        waiting_done = live & is_task & (phase == PHASE_WAIT)
+    else:
+        waiting_done = live & is_task & (phase == PHASE_DONE)
+
+    # --- exclusive gateway condition evaluation ---------------------------
+    out_count = tables.out_count[def_of_tok, jnp.maximum(elem, 0)]
+    targets = tables.out_target[def_of_tok, jnp.maximum(elem, 0)]  # [T, FO]
+    conds = tables.out_cond[def_of_tok, jnp.maximum(elem, 0)]  # [T, FO]
+    slot_idx = jnp.arange(FO)[None, :]
+
+    is_excl = op == K_EXCLUSIVE
+    need_eval = (is_excl & pass_attempt)[:, None] & (conds >= 0)
+    if config.has_conditions:
+        prog_ids = jnp.where(need_eval, conds, -1).reshape(-1)
+        slot_rows = jnp.repeat(state["var_slots"][inst], FO, axis=0)
+        cond_true = _eval_conditions(tables.cond_ops, tables.cond_args, prog_ids, slot_rows)
+        cond_true = cond_true.reshape(T, FO) & need_eval
+    else:
+        cond_true = jnp.zeros((T, FO), jnp.bool_)
+
+    first_true = jnp.argmax(cond_true, axis=1)
+    any_true = jnp.any(cond_true, axis=1)
+    default = tables.default_slot[def_of_tok, jnp.maximum(elem, 0)]
+    excl_choice = jnp.where(any_true, first_true, default)  # -1 if no default
+    excl_no_match = is_excl & pass_attempt & ~any_true & (default < 0)
+
+    # no-match raises an incident: the token stalls instead of completing
+    full_pass = pass_attempt & ~excl_no_match
+    completing = full_pass | waiting_done  # completes & moves this step
+
+    take_mask = jnp.where(
+        is_excl[:, None],
+        (slot_idx == excl_choice[:, None]) & (excl_choice >= 0)[:, None],
+        slot_idx < out_count[:, None],
+    )
+    take_mask = take_mask & completing[:, None] & (targets >= 0)
+
+    # --- transition counting ----------------------------------------------
+    # full pass = 4 lifecycle events; task arrival = 2; task completion = 2;
+    # an instance finishing adds the process element's completing/completed
+    flows_taken = take_mask.sum()
+    per_token = (
+        jnp.where(full_pass, 4, 0)
+        + jnp.where(arriving_task, 2, 0)
+        + jnp.where(waiting_done, 2, 0)
+    )
+
+    # --- movement: flatten taken flows into placement requests ------------
+    req_target = jnp.where(take_mask, targets, -1).reshape(-1)  # [T*FO]
+    req_inst = jnp.repeat(inst, FO)
+    req_def = jnp.repeat(def_of_tok, FO)
+    req_live = req_target >= 0
+
+    if config.has_joins:
+        # parallel-join arrivals: stable-rank same-(inst, target) requests so
+        # exactly the arrival that fills the join proceeds
+        req_op = jnp.where(
+            req_live, tables.kernel_op[req_def, jnp.maximum(req_target, 0)], K_NONE
+        )
+        is_join_req = req_op == K_JOIN
+        join_key = jnp.where(is_join_req, req_inst * E + req_target, jnp.int32(2**30))
+        order = jnp.argsort(join_key, stable=True)
+        sorted_key = join_key[order]
+        new_run = jnp.concatenate([jnp.ones(1, jnp.bool_), sorted_key[1:] != sorted_key[:-1]])
+        idxs = jnp.arange(T * FO, dtype=jnp.int32)
+        run_start = jax.lax.associative_scan(jnp.maximum, jnp.where(new_run, idxs, 0))
+        rank_sorted = idxs - run_start
+        rank = jnp.zeros(T * FO, jnp.int32).at[order].set(rank_sorted)
+
+        prior = state["join_counts"][req_inst, jnp.maximum(req_target, 0)]
+        arity = jnp.maximum(tables.in_count[req_def, jnp.maximum(req_target, 0)], 1)
+        count_after = prior + rank + 1
+        join_completes = is_join_req & (count_after % arity == 0)
+        proceeds = req_live & (~is_join_req | join_completes)
+
+        flat_key = jnp.where(is_join_req, req_inst * E + req_target, 0)
+        arrivals_flat = jnp.zeros((I * E,), jnp.int32).at[flat_key].add(
+            jnp.where(is_join_req, 1, 0)
+        )
+        consumed_flat = jnp.zeros((I * E,), jnp.int32).at[flat_key].add(
+            jnp.where(join_completes, arity, 0)
+        )
+        join_counts = state["join_counts"] + (arrivals_flat - consumed_flat).reshape(I, E)
+    else:
+        proceeds = req_live
+        join_counts = state["join_counts"]
+
+    # --- token slot allocation (prefix-sum into freed slots) --------------
+    elem_after_exec = jnp.where(completing, -1, elem)
+    free = elem_after_exec < 0
+    free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1
+    # rank → slot id map (ranks are unique per free slot; non-free dropped)
+    slot_of_rank = jnp.zeros(T, jnp.int32).at[
+        jnp.where(free, free_rank, T)
+    ].set(jnp.arange(T, dtype=jnp.int32), mode="drop")
+    place_rank = jnp.cumsum(proceeds.astype(jnp.int32)) - 1
+    free_count = free.sum()
+    valid = proceeds & (place_rank < free_count)
+    overflow = state["overflow"] | jnp.any(proceeds & ~valid)
+    dest = jnp.where(valid, slot_of_rank[jnp.clip(place_rank, 0, T - 1)], T)
+
+    new_elem = elem_after_exec.at[dest].set(req_target, mode="drop")
+    new_inst = inst.at[dest].set(req_inst, mode="drop")
+
+    new_phase = jnp.where(arriving_task, PHASE_WAIT, phase)
+    new_phase = jnp.where(excl_no_match, PHASE_STALLED, new_phase)
+    new_phase = new_phase.at[dest].set(PHASE_AT, mode="drop")
+
+    # --- instance completion ----------------------------------------------
+    live_after = new_elem >= 0
+    tokens_per_inst = jnp.zeros(I, jnp.int32).at[new_inst].add(live_after.astype(jnp.int32))
+    was_done = state["done"]
+    newly_done = ~was_done & (tokens_per_inst == 0)
+    done = was_done | newly_done
+    incident = state["incident"] | jnp.zeros(I, jnp.bool_).at[inst].max(excl_no_match)
+
+    transitions = (
+        state["transitions"]
+        + per_token.sum()
+        + flows_taken
+        + 2 * newly_done.sum()  # process element completing/completed
+    )
+    jobs_created = state["jobs_created"] + arriving_task.sum()
+    completed = state["completed"] + newly_done.sum()
+
+    new_state = {
+        "elem": new_elem,
+        "phase": new_phase,
+        "inst": new_inst,
+        "def_of": state["def_of"],
+        "var_slots": state["var_slots"],
+        "join_counts": join_counts,
+        "done": done,
+        "incident": incident,
+        "transitions": transitions,
+        "jobs_created": jobs_created,
+        "completed": completed,
+        "overflow": overflow,
+    }
+
+    events = None
+    if emit_events:
+        events = {
+            "full_pass": full_pass,
+            "task_arrive": arriving_task,
+            "task_done": waiting_done,
+            "elem": elem,
+            "inst": inst,
+            "take_mask": take_mask,
+            "newly_done": newly_done,
+            "no_match": excl_no_match,
+        }
+    return new_state, events
+
+
+@partial(jax.jit, static_argnames=("max_steps", "auto_jobs", "config"))
+def run_to_completion(tables: DeviceTables, state: dict, max_steps: int = 1000,
+                      auto_jobs: bool = True, config=None):
+    """Run steps until every instance is done (or max_steps) in one device
+    program — no host round trips (the bench path)."""
+
+    def cond(carry):
+        state, steps = carry
+        return (steps < max_steps) & jnp.any(state["elem"] >= 0)
+
+    def body(carry):
+        state, steps = carry
+        state, _ = step(tables, state, auto_jobs=auto_jobs, emit_events=False, config=config)
+        return state, steps + 1
+
+    state, steps = jax.lax.while_loop(cond, body, (state, jnp.int32(0)))
+    return state, steps
+
+
+def complete_jobs(state: dict, token_slots: jax.Array, result_slots: jax.Array | None = None,
+                  result_values: jax.Array | None = None) -> dict:
+    """Host-side job completion (non-auto mode): move waiting tokens to
+    PHASE_DONE, optionally writing job result variables into instance slots."""
+    phase = state["phase"].at[token_slots].set(PHASE_DONE)
+    new_state = dict(state)
+    new_state["phase"] = phase
+    if result_slots is not None and result_values is not None:
+        inst = state["inst"][token_slots]
+        new_state["var_slots"] = state["var_slots"].at[inst, result_slots].set(result_values)
+    return new_state

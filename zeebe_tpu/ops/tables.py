@@ -1,0 +1,280 @@
+"""Deploy-time compilation: ExecutableProcess → dense device tables.
+
+This is the TPU-native re-expression of the reference's per-record interpreter
+(BASELINE.json north star): at deploy time each process graph is lowered to
+static int32 arrays — element opcodes, CSR flow adjacency, join arities — and
+every FEEL sequence-flow condition is compiled to a fixed-length stack program
+over per-instance float32 variable slots. The automaton kernel
+(zeebe_tpu.ops.automaton) then advances thousands of instances lock-step with
+no Python in the loop: a token's behavior is a predicated gather over these
+tables, the BpmnElementProcessor switch becomes masked vector ops.
+
+Multiple process definitions share one table set (padded to the max element
+count) so a mixed workload (BASELINE config #5) runs in a single kernel:
+``definition_of_instance`` selects each instance's row block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from zeebe_tpu.feel import feel as F
+from zeebe_tpu.models.bpmn import ExecutableProcess
+from zeebe_tpu.protocol.enums import BpmnElementType
+
+# condition VM opcodes
+OP_NOP = 0
+OP_PUSH_CONST = 1
+OP_PUSH_VAR = 2
+OP_LT = 3
+OP_LE = 4
+OP_GT = 5
+OP_GE = 6
+OP_EQ = 7
+OP_NE = 8
+OP_AND = 9
+OP_OR = 10
+OP_NOT = 11
+OP_ADD = 12
+OP_SUB = 13
+OP_MUL = 14
+OP_DIV = 15
+OP_NEG = 16
+
+MAX_PROG_LEN = 24
+STACK_DEPTH = 8
+
+
+class ConditionNotCompilable(Exception):
+    """Condition uses features outside the device subset (strings, lists,
+    functions) — the element falls back to host evaluation."""
+
+
+@dataclasses.dataclass
+class SlotMap:
+    """Variable name → device slot assignment (shared across a table set)."""
+
+    names: dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def slot(self, name: str) -> int:
+        if name not in self.names:
+            self.names[name] = len(self.names)
+        return self.names[name]
+
+    @property
+    def count(self) -> int:
+        return max(1, len(self.names))
+
+
+def compile_condition(ast, slots: SlotMap) -> list[tuple[int, float]]:
+    """Lower a FEEL AST to a postfix stack program; raises
+    ConditionNotCompilable for non-numeric constructs."""
+    prog: list[tuple[int, float]] = []
+
+    def emit(node) -> None:
+        if isinstance(node, F.Lit):
+            v = node.value
+            if isinstance(v, bool):
+                prog.append((OP_PUSH_CONST, 1.0 if v else 0.0))
+            elif isinstance(v, (int, float)):
+                prog.append((OP_PUSH_CONST, float(v)))
+            else:
+                raise ConditionNotCompilable(f"literal {v!r}")
+        elif isinstance(node, F.Var):
+            if len(node.path) != 1:
+                raise ConditionNotCompilable(f"path {node.path}")
+            prog.append((OP_PUSH_VAR, float(slots.slot(node.path[0]))))
+        elif isinstance(node, F.Unary):
+            emit(node.operand)
+            prog.append((OP_NEG, 0.0))
+        elif isinstance(node, F.Call) and node.name == "not" and len(node.args) == 1:
+            emit(node.args[0])
+            prog.append((OP_NOT, 0.0))
+        elif isinstance(node, F.Bin):
+            ops = {
+                "<": OP_LT, "<=": OP_LE, ">": OP_GT, ">=": OP_GE,
+                "=": OP_EQ, "!=": OP_NE, "and": OP_AND, "or": OP_OR,
+                "+": OP_ADD, "-": OP_SUB, "*": OP_MUL, "/": OP_DIV,
+            }
+            if node.op not in ops:
+                raise ConditionNotCompilable(f"operator {node.op}")
+            emit(node.left)
+            emit(node.right)
+            prog.append((ops[node.op], 0.0))
+        else:
+            raise ConditionNotCompilable(type(node).__name__)
+
+    emit(ast)
+    if len(prog) > MAX_PROG_LEN:
+        raise ConditionNotCompilable(f"program too long ({len(prog)})")
+    return prog
+
+
+# device opcodes per element behavior (indexes the kernel's behavior masks)
+K_NONE = 0  # unused slot / process root
+K_PASS = 1  # pass-through: start/end/manual/undefined/throw events
+K_TASK = 2  # job-worker task: wait for job completion
+K_EXCLUSIVE = 3  # exclusive gateway: conditional routing
+K_FORK = 4  # parallel gateway, fan-out
+K_JOIN = 5  # parallel gateway, fan-in (in_count > 1)
+K_END = 6  # end event: token dies, instance may complete
+
+_KERNEL_OP = {
+    BpmnElementType.START_EVENT: K_PASS,
+    BpmnElementType.MANUAL_TASK: K_PASS,
+    BpmnElementType.TASK: K_PASS,
+    BpmnElementType.INTERMEDIATE_THROW_EVENT: K_PASS,
+    BpmnElementType.END_EVENT: K_END,
+    BpmnElementType.SERVICE_TASK: K_TASK,
+    BpmnElementType.SEND_TASK: K_TASK,
+    BpmnElementType.SCRIPT_TASK: K_TASK,
+    BpmnElementType.BUSINESS_RULE_TASK: K_TASK,
+    BpmnElementType.USER_TASK: K_TASK,
+    BpmnElementType.EXCLUSIVE_GATEWAY: K_EXCLUSIVE,
+    BpmnElementType.PARALLEL_GATEWAY: K_FORK,  # switched to K_JOIN if in_count > 1
+}
+
+
+@dataclasses.dataclass
+class ProcessTables:
+    """Dense tables for a set of process definitions (numpy; the kernel moves
+    them to device). Shapes: D definitions, E max elements, FL max flows,
+    C conditions, FO max fan-out."""
+
+    # per definition × element
+    kernel_op: np.ndarray  # [D, E] int32
+    in_count: np.ndarray  # [D, E] int32 (join arity)
+    job_type: np.ndarray  # [D, E] int32, -1 = none
+    out_count: np.ndarray  # [D, E] int32
+    out_target: np.ndarray  # [D, E, FO] int32 (element idx, -1 pad)
+    out_cond: np.ndarray  # [D, E, FO] int32 (condition row, -1 = unconditional)
+    out_flow_idx: np.ndarray  # [D, E, FO] int32 (model flow idx, for events)
+    default_slot: np.ndarray  # [D, E] int32 (slot in out_* arrays, -1 none)
+    start_elem: np.ndarray  # [D] int32
+    elem_count: np.ndarray  # [D] int32
+    # condition programs
+    cond_ops: np.ndarray  # [C, P] int32
+    cond_args: np.ndarray  # [C, P] float32
+    # bookkeeping
+    slot_map: SlotMap = dataclasses.field(default_factory=SlotMap)
+    job_type_names: list[str] = dataclasses.field(default_factory=list)
+    definitions: list[ExecutableProcess] = dataclasses.field(default_factory=list)
+
+    @property
+    def num_definitions(self) -> int:
+        return self.kernel_op.shape[0]
+
+    @property
+    def max_elements(self) -> int:
+        return self.kernel_op.shape[1]
+
+    @property
+    def num_slots(self) -> int:
+        return self.slot_map.count
+
+    @property
+    def kernel_config(self) -> "KernelConfig":
+        return KernelConfig(
+            has_joins=bool((self.kernel_op == 5).any()),  # K_JOIN
+            has_conditions=bool((self.out_cond >= 0).any()),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelConfig:
+    """Static (hashable) workload traits; lets XLA drop unused machinery —
+    join ranking sorts and the condition VM cost real time when the deployed
+    process set never exercises them."""
+
+    has_joins: bool = True
+    has_conditions: bool = True
+
+
+def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = None) -> ProcessTables:
+    """Compile process definitions into one shared table set. ``max_fanout``
+    defaults to the actual maximum across the definitions (smaller FO keeps
+    the kernel's flattened placement arrays tight)."""
+    if max_fanout is None:
+        max_fanout = max(
+            (len(el.outgoing) for p in processes for el in p.elements), default=1
+        )
+        max_fanout = max(max_fanout, 1)
+    slots = SlotMap()
+    job_types: dict[str, int] = {}
+    cond_programs: list[list[tuple[int, float]]] = []
+
+    D = len(processes)
+    E = max(len(p.elements) for p in processes)
+    kernel_op = np.zeros((D, E), np.int32)
+    in_count = np.zeros((D, E), np.int32)
+    job_type = np.full((D, E), -1, np.int32)
+    out_count = np.zeros((D, E), np.int32)
+    out_target = np.full((D, E, max_fanout), -1, np.int32)
+    out_cond = np.full((D, E, max_fanout), -1, np.int32)
+    out_flow_idx = np.full((D, E, max_fanout), -1, np.int32)
+    default_slot = np.full((D, E), -1, np.int32)
+    start_elem = np.zeros(D, np.int32)
+    elem_count = np.zeros(D, np.int32)
+
+    for d, exe in enumerate(processes):
+        elem_count[d] = len(exe.elements)
+        start_elem[d] = exe.none_start_of(0)
+        for el in exe.elements[1:]:
+            if el.parent_idx != 0:
+                raise ConditionNotCompilable(
+                    "device tables support flat processes (sub-process scopes "
+                    "run on the host path for now)"
+                )
+            op = _KERNEL_OP.get(el.element_type)
+            if op is None:
+                raise ConditionNotCompilable(f"element type {el.element_type.name}")
+            if el.element_type == BpmnElementType.PARALLEL_GATEWAY and el.incoming_count > 1:
+                op = K_JOIN
+            kernel_op[d, el.idx] = op
+            in_count[d, el.idx] = el.incoming_count
+            if len(el.outgoing) > max_fanout:
+                raise ConditionNotCompilable(f"fan-out {len(el.outgoing)} > {max_fanout}")
+            out_count[d, el.idx] = len(el.outgoing)
+            if op == K_TASK and el.job_type is not None and el.job_type.is_static:
+                name = el.job_type.source
+                if name not in job_types:
+                    job_types[name] = len(job_types)
+                job_type[d, el.idx] = job_types[name]
+            for slot_i, fidx in enumerate(el.outgoing):
+                flow = exe.flows[fidx]
+                out_target[d, el.idx, slot_i] = flow.target_idx
+                out_flow_idx[d, el.idx, slot_i] = flow.idx
+                if fidx == el.default_flow_idx:
+                    default_slot[d, el.idx] = slot_i
+                elif flow.condition is not None and op == K_EXCLUSIVE:
+                    prog = compile_condition(flow.condition.ast, slots)
+                    out_cond[d, el.idx, slot_i] = len(cond_programs)
+                    cond_programs.append(prog)
+
+    C = max(1, len(cond_programs))
+    cond_ops = np.zeros((C, MAX_PROG_LEN), np.int32)
+    cond_args = np.zeros((C, MAX_PROG_LEN), np.float32)
+    for ci, prog in enumerate(cond_programs):
+        for pi, (op, arg) in enumerate(prog):
+            cond_ops[ci, pi] = op
+            cond_args[ci, pi] = arg
+
+    return ProcessTables(
+        kernel_op=kernel_op,
+        in_count=in_count,
+        job_type=job_type,
+        out_count=out_count,
+        out_target=out_target,
+        out_cond=out_cond,
+        out_flow_idx=out_flow_idx,
+        default_slot=default_slot,
+        start_elem=start_elem,
+        elem_count=elem_count,
+        cond_ops=cond_ops,
+        cond_args=cond_args,
+        slot_map=slots,
+        job_type_names=list(job_types),
+        definitions=list(processes),
+    )
