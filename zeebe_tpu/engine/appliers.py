@@ -70,9 +70,31 @@ class EventAppliers:
         reg[(ValueType.VARIABLE, int(VariableIntent.UPDATED))] = self._variable_set
         reg[(ValueType.INCIDENT, int(IncidentIntent.CREATED))] = self._incident_created
         reg[(ValueType.INCIDENT, int(IncidentIntent.RESOLVED))] = self._incident_resolved
-        from zeebe_tpu.protocol.intent import VariableDocumentIntent
+        from zeebe_tpu.protocol.intent import (
+            MessageIntent,
+            MessageStartEventSubscriptionIntent,
+            MessageSubscriptionIntent,
+            ProcessMessageSubscriptionIntent,
+            VariableDocumentIntent,
+        )
 
         reg[(ValueType.VARIABLE_DOCUMENT, int(VariableDocumentIntent.UPDATED))] = self._noop
+        reg[(ValueType.TIMER, int(TimerIntent.CREATED))] = self._timer_created
+        reg[(ValueType.TIMER, int(TimerIntent.TRIGGERED))] = self._timer_removed
+        reg[(ValueType.TIMER, int(TimerIntent.CANCELED))] = self._timer_removed
+        reg[(ValueType.MESSAGE, int(MessageIntent.PUBLISHED))] = self._message_published
+        reg[(ValueType.MESSAGE, int(MessageIntent.EXPIRED))] = self._message_removed
+        reg[(ValueType.MESSAGE_SUBSCRIPTION, int(MessageSubscriptionIntent.CREATED))] = self._msg_sub_created
+        reg[(ValueType.MESSAGE_SUBSCRIPTION, int(MessageSubscriptionIntent.CORRELATING))] = self._msg_sub_correlating
+        reg[(ValueType.MESSAGE_SUBSCRIPTION, int(MessageSubscriptionIntent.CORRELATED))] = self._msg_sub_correlated
+        reg[(ValueType.MESSAGE_SUBSCRIPTION, int(MessageSubscriptionIntent.DELETED))] = self._msg_sub_deleted
+        reg[(ValueType.PROCESS_MESSAGE_SUBSCRIPTION, int(ProcessMessageSubscriptionIntent.CREATING))] = self._pms_creating
+        reg[(ValueType.PROCESS_MESSAGE_SUBSCRIPTION, int(ProcessMessageSubscriptionIntent.CREATED))] = self._pms_created
+        reg[(ValueType.PROCESS_MESSAGE_SUBSCRIPTION, int(ProcessMessageSubscriptionIntent.CORRELATED))] = self._pms_correlated
+        reg[(ValueType.PROCESS_MESSAGE_SUBSCRIPTION, int(ProcessMessageSubscriptionIntent.DELETED))] = self._pms_deleted
+        reg[(ValueType.MESSAGE_START_EVENT_SUBSCRIPTION, int(MessageStartEventSubscriptionIntent.CREATED))] = self._msg_start_created
+        reg[(ValueType.MESSAGE_START_EVENT_SUBSCRIPTION, int(MessageStartEventSubscriptionIntent.CORRELATED))] = self._noop
+        reg[(ValueType.MESSAGE_START_EVENT_SUBSCRIPTION, int(MessageStartEventSubscriptionIntent.DELETED))] = self._msg_start_deleted
 
     def can_apply(self, record: Record) -> bool:
         return (record.value_type, int(record.intent)) in self._appliers
@@ -223,3 +245,70 @@ class EventAppliers:
 
     def _incident_resolved(self, record: Record) -> None:
         self.state.incidents.resolve(record.key)
+
+    # timers
+
+    def _timer_created(self, record: Record) -> None:
+        self.state.timers.create(record.key, record.value)
+
+    def _timer_removed(self, record: Record) -> None:
+        self.state.timers.remove(record.key)
+
+    # messages
+
+    def _message_published(self, record: Record) -> None:
+        self.state.messages.put(record.key, record.value, record.value.get("deadline", -1))
+
+    def _message_removed(self, record: Record) -> None:
+        self.state.messages.remove(record.key, record.value.get("deadline", -1))
+
+    def _msg_sub_created(self, record: Record) -> None:
+        self.state.message_subscriptions.put(record.key, record.value)
+
+    def _msg_sub_correlating(self, record: Record) -> None:
+        v = record.value
+        self.state.messages.mark_correlated(v["messageKey"], v.get("processInstanceKey", -1))
+
+    def _msg_sub_correlated(self, record: Record) -> None:
+        # catch-event subscriptions close on correlation
+        if record.value.get("interrupting", True):
+            self.state.message_subscriptions.remove(record.key)
+
+    def _msg_sub_deleted(self, record: Record) -> None:
+        self.state.message_subscriptions.remove(record.key)
+
+    def _pms_creating(self, record: Record) -> None:
+        v = record.value
+        self.state.process_message_subscriptions.put(
+            v["elementInstanceKey"], v["messageName"], v
+        )
+
+    def _pms_created(self, record: Record) -> None:
+        v = record.value
+        self.state.process_message_subscriptions.put(
+            v["elementInstanceKey"], v["messageName"], v
+        )
+
+    def _pms_correlated(self, record: Record) -> None:
+        v = record.value
+        if v.get("interrupting", True):
+            self.state.process_message_subscriptions.remove(
+                v["elementInstanceKey"], v["messageName"]
+            )
+
+    def _pms_deleted(self, record: Record) -> None:
+        v = record.value
+        self.state.process_message_subscriptions.remove(
+            v["elementInstanceKey"], v["messageName"]
+        )
+
+    def _msg_start_created(self, record: Record) -> None:
+        v = record.value
+        self.state.message_start_subscriptions.put(
+            v["messageName"], v["processDefinitionKey"], v
+        )
+
+    def _msg_start_deleted(self, record: Record) -> None:
+        self.state.message_start_subscriptions.remove_for_process(
+            record.value["processDefinitionKey"]
+        )

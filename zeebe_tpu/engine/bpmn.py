@@ -47,6 +47,7 @@ from zeebe_tpu.protocol.intent import (
     JobIntent,
     ProcessInstanceIntent,
     ProcessInstanceResultIntent,
+    TimerIntent,
     VariableIntent,
 )
 
@@ -56,9 +57,11 @@ PI = ProcessInstanceIntent
 class BpmnProcessor:
     """Handles PROCESS_INSTANCE ACTIVATE/COMPLETE/TERMINATE_ELEMENT commands."""
 
-    def __init__(self, state: EngineState, clock_millis) -> None:
+    def __init__(self, state: EngineState, clock_millis, sender=None, partition_count: int = 1) -> None:
         self.state = state
         self.clock_millis = clock_millis
+        self.sender = sender  # InterPartitionCommandSender (set via Engine.wire)
+        self.partition_count = partition_count
 
     # ------------------------------------------------------------------ entry
 
@@ -114,6 +117,7 @@ class BpmnProcessor:
         self, key: int, value: dict, exe: ExecutableProcess,
         element: ExecutableElement, writers: Writers,
     ) -> None:
+        start_override = value.get("startElementId")
         value = _pi_value(value, element)
         writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATING, value)
 
@@ -128,10 +132,18 @@ class BpmnProcessor:
                 self._raise_incident(writers, key, value, ErrorType.IO_MAPPING_ERROR, str(exc))
                 return
 
+        # boundary-event subscriptions attach when the host activity activates
+        if element.boundary_idxs:
+            self._open_boundary_subscriptions(key, value, exe, element, writers)
+
         et = element.element_type
         if et == BpmnElementType.PROCESS or et == BpmnElementType.SUB_PROCESS:
             writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
-            start_idx = element.child_start_idx if et == BpmnElementType.SUB_PROCESS else exe.none_start_of(0)
+            if et == BpmnElementType.SUB_PROCESS:
+                start_idx = element.child_start_idx
+            else:
+                # message/timer start events carry an explicit start element
+                start_idx = exe.by_id[start_override] if start_override else exe.none_start_of(0)
             start = exe.elements[start_idx]
             self._write_activate(writers, exe, start, scope_key=key, value=value)
         elif et == BpmnElementType.START_EVENT:
@@ -182,6 +194,14 @@ class BpmnProcessor:
                     element.script_result_variable, result,
                 )
             self._complete(key, value, exe, element, writers)
+        elif et in (BpmnElementType.INTERMEDIATE_CATCH_EVENT, BpmnElementType.RECEIVE_TASK):
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
+            if element.event_type == BpmnEventType.TIMER or element.timer_duration is not None:
+                self._create_timer(key, value, element, element, writers)
+            elif element.message_name is not None:
+                if not self._open_message_subscription(key, value, element, element, writers):
+                    return
+            # wait state: timer trigger / message correlation completes it
         elif et in (BpmnElementType.MANUAL_TASK, BpmnElementType.TASK,
                     BpmnElementType.EXCLUSIVE_GATEWAY, BpmnElementType.PARALLEL_GATEWAY,
                     BpmnElementType.END_EVENT, BpmnElementType.INTERMEDIATE_THROW_EVENT):
@@ -191,6 +211,133 @@ class BpmnProcessor:
             # elements not yet implemented behave as pass-through tasks
             writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
             self._complete(key, value, exe, element, writers)
+
+    # ------------------------------------------------- event subscriptions
+
+    def _eval_duration_millis(self, expr, context) -> int:
+        from zeebe_tpu.utils import parse_duration_millis
+
+        raw = expr.evaluate(context, self.clock_millis)
+        if isinstance(raw, (int, float)):
+            return int(raw)
+        return parse_duration_millis(str(raw))
+
+    def _create_timer(self, host_key: int, value: dict, catching: ExecutableElement,
+                      host: ExecutableElement, writers: Writers,
+                      repetitions: int = 1, interval: int = -1) -> None:
+        context = self.state.variables.collect(host_key)
+        try:
+            duration = self._eval_duration_millis(catching.timer_duration, context)
+        except Exception as exc:  # noqa: BLE001 — bad timer → incident
+            self._raise_incident(writers, host_key, value, ErrorType.EXTRACT_VALUE_ERROR, str(exc))
+            return
+        timer_key = self.state.next_key()
+        writers.append_event(
+            timer_key, ValueType.TIMER, TimerIntent.CREATED,
+            {
+                "elementId": host.id,
+                "targetElementId": catching.id,
+                "elementInstanceKey": host_key,
+                "processInstanceKey": value.get("processInstanceKey", -1),
+                "processDefinitionKey": value.get("processDefinitionKey", -1),
+                "dueDate": self.clock_millis() + duration,
+                "repetitions": repetitions,
+                "interval": interval if interval > 0 else duration,
+            },
+        )
+
+    def _open_message_subscription(self, host_key: int, value: dict,
+                                   catching: ExecutableElement, host: ExecutableElement,
+                                   writers: Writers) -> bool:
+        from zeebe_tpu.parallel.partitioning import subscription_partition_id
+        from zeebe_tpu.protocol import command as make_command
+        from zeebe_tpu.protocol.intent import (
+            MessageSubscriptionIntent,
+            ProcessMessageSubscriptionIntent,
+        )
+
+        context = self.state.variables.collect(host_key)
+        try:
+            correlation_key = catching.correlation_key.evaluate(context, self.clock_millis)
+        except FeelEvalError as exc:
+            self._raise_incident(writers, host_key, value, ErrorType.EXTRACT_VALUE_ERROR, str(exc))
+            return False
+        if correlation_key is None:
+            self._raise_incident(
+                writers, host_key, value, ErrorType.EXTRACT_VALUE_ERROR,
+                f"correlation key of '{catching.id}' evaluated to null",
+            )
+            return False
+        correlation_key = str(correlation_key)
+        # the process partition allocates the message-side subscription key so
+        # both sides can address it (open, correlate-ack, delete)
+        msg_sub_key = self.state.next_key()
+        sub_value = {
+            "processInstanceKey": value.get("processInstanceKey", -1),
+            "elementInstanceKey": host_key,
+            "messageName": catching.message_name,
+            "correlationKey": correlation_key,
+            "targetElementId": catching.id,
+            "interrupting": catching.interrupting,
+            "bpmnProcessId": value.get("bpmnProcessId", ""),
+            "subscriptionPartitionId": self.state.partition_id,
+            "messageSubscriptionKey": msg_sub_key,
+        }
+        writers.append_event(
+            host_key, ValueType.PROCESS_MESSAGE_SUBSCRIPTION,
+            ProcessMessageSubscriptionIntent.CREATING, sub_value,
+        )
+        message_partition = subscription_partition_id(correlation_key, self.partition_count)
+        open_cmd = make_command(
+            ValueType.MESSAGE_SUBSCRIPTION, MessageSubscriptionIntent.CREATE, sub_value,
+            key=msg_sub_key,
+        )
+        sender = self.sender
+        writers.after_commit(lambda: sender.send_command(message_partition, open_cmd))
+        return True
+
+    def _open_boundary_subscriptions(self, host_key: int, value: dict,
+                                     exe: ExecutableProcess, host: ExecutableElement,
+                                     writers: Writers) -> None:
+        for bidx in host.boundary_idxs:
+            boundary = exe.elements[bidx]
+            if boundary.event_type == BpmnEventType.TIMER and boundary.timer_duration is not None:
+                reps = 1 if boundary.interrupting else -1
+                self._create_timer(host_key, value, boundary, host, writers, repetitions=reps)
+            elif boundary.event_type == BpmnEventType.MESSAGE and boundary.message_name:
+                self._open_message_subscription(host_key, value, boundary, host, writers)
+
+    def _close_subscriptions(self, key: int, value: dict, writers: Writers) -> None:
+        """Cancel timers + message subscriptions attached to an element
+        instance when it completes or terminates."""
+        from zeebe_tpu.parallel.partitioning import subscription_partition_id
+        from zeebe_tpu.protocol import command as make_command
+        from zeebe_tpu.protocol.intent import (
+            MessageSubscriptionIntent,
+            ProcessMessageSubscriptionIntent,
+            TimerIntent,
+        )
+
+        for timer_key, timer in self.state.timers.timers_for_element_instance(key):
+            writers.append_event(timer_key, ValueType.TIMER, TimerIntent.CANCELED, timer)
+        for sub in self.state.process_message_subscriptions.subscriptions_of(key):
+            writers.append_event(
+                key, ValueType.PROCESS_MESSAGE_SUBSCRIPTION,
+                ProcessMessageSubscriptionIntent.DELETED, sub,
+            )
+            message_partition = subscription_partition_id(
+                sub["correlationKey"], self.partition_count
+            )
+            sub_key = sub.get("messageSubscriptionKey", -1)
+            if sub_key >= 0:
+                delete_cmd = make_command(
+                    ValueType.MESSAGE_SUBSCRIPTION, MessageSubscriptionIntent.DELETE,
+                    dict(sub), key=sub_key,
+                )
+                sender = self.sender
+                writers.after_commit(
+                    lambda mp=message_partition, dc=delete_cmd: sender.send_command(mp, dc)
+                )
 
     # -------------------------------------------------------------- completion
 
@@ -216,6 +363,9 @@ class BpmnProcessor:
             except FeelEvalError as exc:
                 self._raise_incident(writers, key, value, ErrorType.IO_MAPPING_ERROR, str(exc))
                 return
+
+        # boundary/catch subscriptions close when the element leaves ACTIVATED
+        self._close_subscriptions(key, value, writers)
 
         if element.element_type == BpmnElementType.EXCLUSIVE_GATEWAY and (
             len(element.outgoing) > 1
@@ -338,6 +488,7 @@ class BpmnProcessor:
             job = self.state.jobs.get(job_key)
             if job is not None:
                 writers.append_event(job_key, ValueType.JOB, JobIntent.CANCELED, job)
+        self._close_subscriptions(key, value, writers)
 
         children = self.state.element_instances.children_keys(key)
         if children:

@@ -31,29 +31,61 @@ from zeebe_tpu.protocol.intent import (
     IncidentIntent,
     JobBatchIntent,
     JobIntent,
+    MessageIntent,
+    MessageSubscriptionIntent,
     ProcessInstanceCreationIntent,
     ProcessInstanceIntent,
+    ProcessMessageSubscriptionIntent,
+    TimerIntent,
     VariableDocumentIntent,
 )
 from zeebe_tpu.state import ZbDb
 from zeebe_tpu.stream import ProcessingResultBuilder, RecordProcessor
 
 
+class _SenderProxy:
+    """Late-bound InterPartitionCommandSender (wired once the log exists)."""
+
+    def __init__(self) -> None:
+        self.delegate = None
+
+    def send_command(self, receiver_partition_id: int, record) -> None:
+        if self.delegate is None:
+            raise RuntimeError("inter-partition sender not wired")
+        self.delegate.send_command(receiver_partition_id, record)
+
+
 class Engine(RecordProcessor):
-    def __init__(self, db: ZbDb, partition_id: int = 1, clock_millis: Callable[[], int] | None = None) -> None:
+    def __init__(self, db: ZbDb, partition_id: int = 1,
+                 clock_millis: Callable[[], int] | None = None,
+                 partition_count: int = 1) -> None:
         self.state = EngineState(db, partition_id)
         self.appliers = EventAppliers(self.state)
         clock = clock_millis or (lambda: 0)
         self.clock_millis = clock
+        self.partition_count = partition_count
+        self.sender = _SenderProxy()
 
-        bpmn = BpmnProcessor(self.state, clock)
-        deployment = DeploymentProcessor(self.state)
+        from zeebe_tpu.engine.message_timer import (
+            MessageProcessors,
+            MessageSubscriptionProcessors,
+            ProcessMessageSubscriptionProcessors,
+            TimerProcessors,
+        )
+
+        bpmn = BpmnProcessor(self.state, clock, sender=self.sender,
+                             partition_count=partition_count)
+        deployment = DeploymentProcessor(self.state, clock)
         creation = ProcessInstanceCreationProcessor(self.state, bpmn)
         cancel = ProcessInstanceCancelProcessor(self.state)
         jobs = JobProcessors(self.state, clock)
         job_batch = JobBatchProcessor(self.state, clock)
         incidents = IncidentResolveProcessor(self.state)
         variables = VariableDocumentProcessor(self.state)
+        timers = TimerProcessors(self.state, clock, bpmn)
+        messages = MessageProcessors(self.state, clock, partition_count, self.sender)
+        msg_subs = MessageSubscriptionProcessors(self.state, self.sender)
+        pms = ProcessMessageSubscriptionProcessors(self.state, self.sender, partition_count)
         self.bpmn = bpmn
 
         # the RecordProcessorMap: (ValueType, command intent) → handler
@@ -72,8 +104,20 @@ class Engine(RecordProcessor):
             (ValueType.JOB_BATCH, int(JobBatchIntent.ACTIVATE)): job_batch.process,
             (ValueType.INCIDENT, int(IncidentIntent.RESOLVE)): incidents.process,
             (ValueType.VARIABLE_DOCUMENT, int(VariableDocumentIntent.UPDATE)): variables.process,
+            (ValueType.JOB, int(JobIntent.RECUR_AFTER_BACKOFF)): jobs.recur_after_backoff,
+            (ValueType.TIMER, int(TimerIntent.TRIGGER)): timers.trigger,
+            (ValueType.MESSAGE, int(MessageIntent.PUBLISH)): messages.publish,
+            (ValueType.MESSAGE, int(MessageIntent.EXPIRE)): messages.expire,
+            (ValueType.MESSAGE_SUBSCRIPTION, int(MessageSubscriptionIntent.CREATE)): msg_subs.create,
+            (ValueType.MESSAGE_SUBSCRIPTION, int(MessageSubscriptionIntent.CORRELATE)): msg_subs.correlate_ack,
+            (ValueType.MESSAGE_SUBSCRIPTION, int(MessageSubscriptionIntent.DELETE)): msg_subs.delete,
+            (ValueType.PROCESS_MESSAGE_SUBSCRIPTION, int(ProcessMessageSubscriptionIntent.CORRELATE)): pms.correlate,
         }
         self.state.load_key_generator()
+
+    def wire_sender(self, sender) -> None:
+        """Install the inter-partition command sender (loopback or cluster)."""
+        self.sender.delegate = sender
 
     # -- RecordProcessor SPI -------------------------------------------------
 

@@ -250,6 +250,9 @@ class JobState:
             self._activatable.delete((job["type"], key))
         if state == JOB_ACTIVATED and job.get("deadline", -1) >= 0:
             self._deadlines.delete((job["deadline"], key))
+        backoff_until = job.get("backoffUntil", -1)
+        if backoff_until > 0 and self._backoff.exists((backoff_until, key)):
+            self._backoff.delete((backoff_until, key))
         self._jobs.delete((key,))
         self._states.delete((key,))
 
@@ -260,6 +263,8 @@ class JobState:
             self._deadlines.delete((job["deadline"], key))
         job["retries"] = retries
         job["deadline"] = -1
+        if backoff_until > 0:
+            job["backoffUntil"] = backoff_until
         self._jobs.put((key,), job)
         if retries > 0:
             if backoff_until > 0:
@@ -273,8 +278,11 @@ class JobState:
 
     def recur_after_backoff(self, key: int, backoff_until: int) -> None:
         job = self._jobs.get((key,))
-        if backoff_until > 0 and self._backoff.exists((backoff_until, key)):
-            self._backoff.delete((backoff_until, key))
+        stored_until = job.pop("backoffUntil", -1)
+        for until in (backoff_until, stored_until):
+            if until > 0 and self._backoff.exists((until, key)):
+                self._backoff.delete((until, key))
+        self._jobs.put((key,), job)
         self._states.put((key,), JOB_ACTIVATABLE)
         self._activatable.put((job["type"], key), None)
 
@@ -333,6 +341,18 @@ class JobState:
                 break
             out.append((until, job_key))
         return out
+
+    def next_deadline(self) -> int | None:
+        for enc_key, _ in self._deadlines.items():
+            deadline, _key = _decode_two_i64(enc_key)
+            return deadline
+        return None
+
+    def next_backoff(self) -> int | None:
+        for enc_key, _ in self._backoff.items():
+            until, _key = _decode_two_i64(enc_key)
+            return until
+        return None
 
 
 def _decode_trailing_i64(enc_key: bytes) -> int:
@@ -415,6 +435,210 @@ class VariableState:
         return out
 
 
+class TimerState:
+    """Timer instances + due-date index + per-element index (reference:
+    TimerInstanceState keys timers by (elementInstanceKey, timerKey))."""
+
+    def __init__(self, db: ZbDb) -> None:
+        self._timers = db.column_family(CF.TIMERS)
+        self._due = db.column_family(CF.TIMER_DUE_DATES)
+        self._by_element = db.column_family(CF.TIMER_BY_ELEMENT)
+
+    def create(self, key: int, record_value: dict) -> None:
+        self._timers.put((key,), dict(record_value))
+        self._due.put((record_value["dueDate"], key), None)
+        element_key = record_value.get("elementInstanceKey", -1)
+        if element_key >= 0:
+            self._by_element.put((element_key, key), None)
+
+    def remove(self, key: int) -> None:
+        timer = self._timers.get((key,))
+        if timer is None:
+            return
+        self._due.delete((timer["dueDate"], key))
+        element_key = timer.get("elementInstanceKey", -1)
+        if element_key >= 0 and self._by_element.exists((element_key, key)):
+            self._by_element.delete((element_key, key))
+        self._timers.delete((key,))
+
+    def get(self, key: int) -> dict | None:
+        return self._timers.get((key,))
+
+    def due_timers(self, now_millis: int) -> list[tuple[int, dict]]:
+        out = []
+        for enc_key, _ in self._due.items():
+            due, key = _decode_two_i64(enc_key)
+            if due > now_millis:
+                break
+            out.append((key, self._timers.get((key,))))
+        return out
+
+    def next_due(self) -> int | None:
+        for enc_key, _ in self._due.items():
+            due, _key = _decode_two_i64(enc_key)
+            return due
+        return None
+
+    def timers_for_element_instance(self, element_instance_key: int) -> list[tuple[int, dict]]:
+        out = []
+        for enc_key, _ in self._by_element.items((element_instance_key,)):
+            key = _decode_trailing_i64(enc_key)
+            out.append((key, self._timers.get((key,))))
+        return out
+
+    def start_timers_for_process(self, process_definition_key: int) -> list[tuple[int, dict]]:
+        """Timer-start-event timers of a definition (deploy-time scan is fine)."""
+        out = []
+        for enc_key, timer in self._timers.items():
+            if (
+                timer.get("elementInstanceKey", -1) < 0
+                and timer.get("processDefinitionKey") == process_definition_key
+            ):
+                out.append((_decode_trailing_i64(enc_key), timer))
+        return out
+
+
+class MessageState:
+    """Published message buffer + TTL deadlines + id dedup (reference:
+    MessageState: MESSAGES, MESSAGE_DEADLINES, MESSAGE_IDS CFs)."""
+
+    def __init__(self, db: ZbDb) -> None:
+        self._messages = db.column_family(CF.MESSAGES)
+        self._by_name_key = db.column_family(CF.MESSAGE_PROCESSES)  # (name, corrKey, msgKey)
+        self._deadlines = db.column_family(CF.MESSAGE_DEADLINES)
+        self._ids = db.column_family(CF.MESSAGE_IDS)
+        self._correlated = db.column_family(CF.MESSAGE_CORRELATED)
+
+    def put(self, key: int, record_value: dict, deadline: int) -> None:
+        self._messages.put((key,), dict(record_value))
+        self._by_name_key.put((record_value["name"], record_value["correlationKey"], key), None)
+        if deadline > 0:
+            self._deadlines.put((deadline, key), None)
+        message_id = record_value.get("messageId") or ""
+        if message_id:
+            self._ids.put((record_value["name"], record_value["correlationKey"], message_id), key)
+
+    def remove(self, key: int, deadline: int) -> None:
+        msg = self._messages.get((key,))
+        if msg is None:
+            return
+        self._by_name_key.delete((msg["name"], msg["correlationKey"], key))
+        if deadline > 0 and self._deadlines.exists((deadline, key)):
+            self._deadlines.delete((deadline, key))
+        message_id = msg.get("messageId") or ""
+        if message_id and self._ids.exists((msg["name"], msg["correlationKey"], message_id)):
+            self._ids.delete((msg["name"], msg["correlationKey"], message_id))
+        for enc_key, _ in list(self._correlated.items((key,))):
+            self._correlated._ctx().delete(enc_key)
+        self._messages.delete((key,))
+
+    def get(self, key: int) -> dict | None:
+        return self._messages.get((key,))
+
+    def is_id_taken(self, name: str, correlation_key: str, message_id: str) -> bool:
+        return self._ids.exists((name, correlation_key, message_id))
+
+    def buffered_for(self, name: str, correlation_key: str) -> list[int]:
+        out = []
+        for enc_key, _ in self._by_name_key.items((name, correlation_key)):
+            out.append(_decode_trailing_i64(enc_key))
+        return out
+
+    def mark_correlated(self, message_key: int, process_instance_key: int) -> None:
+        self._correlated.put((message_key, process_instance_key), None)
+
+    def was_correlated_to(self, message_key: int, process_instance_key: int) -> bool:
+        return self._correlated.exists((message_key, process_instance_key))
+
+    def expired(self, now_millis: int) -> list[tuple[int, int]]:
+        out = []
+        for enc_key, _ in self._deadlines.items():
+            deadline, key = _decode_two_i64(enc_key)
+            if deadline > now_millis:
+                break
+            out.append((deadline, key))
+        return out
+
+    def next_deadline(self) -> int | None:
+        for enc_key, _ in self._deadlines.items():
+            deadline, _key = _decode_two_i64(enc_key)
+            return deadline
+        return None
+
+
+class MessageSubscriptionState:
+    """Message-partition side of correlation: subscriptions by (name, corrKey)
+    (reference: MessageSubscriptionState)."""
+
+    def __init__(self, db: ZbDb) -> None:
+        self._by_key = db.column_family(CF.MESSAGE_SUBSCRIPTION_BY_KEY)
+        self._by_name = db.column_family(CF.MESSAGE_SUBSCRIPTION_BY_NAME_AND_CORRELATION_KEY)
+
+    def put(self, key: int, record_value: dict) -> None:
+        v = dict(record_value)
+        self._by_key.put((key,), v)
+        self._by_name.put((v["messageName"], v["correlationKey"], key), None)
+
+    def remove(self, key: int) -> None:
+        sub = self._by_key.get((key,))
+        if sub is None:
+            return
+        self._by_name.delete((sub["messageName"], sub["correlationKey"], key))
+        self._by_key.delete((key,))
+
+    def get(self, key: int) -> dict | None:
+        return self._by_key.get((key,))
+
+    def find(self, name: str, correlation_key: str) -> list[tuple[int, dict]]:
+        out = []
+        for enc_key, _ in self._by_name.items((name, correlation_key)):
+            key = _decode_trailing_i64(enc_key)
+            out.append((key, self._by_key.get((key,))))
+        return out
+
+
+class ProcessMessageSubscriptionState:
+    """Process-partition side: subscriptions by element instance (reference:
+    ProcessMessageSubscriptionState)."""
+
+    def __init__(self, db: ZbDb) -> None:
+        self._by_key = db.column_family(CF.PROCESS_SUBSCRIPTION_BY_KEY)
+
+    def put(self, element_instance_key: int, message_name: str, record_value: dict) -> None:
+        self._by_key.put((element_instance_key, message_name), dict(record_value))
+
+    def update(self, element_instance_key: int, message_name: str, **fields) -> None:
+        sub = self._by_key.get((element_instance_key, message_name))
+        sub.update(fields)
+        self._by_key.put((element_instance_key, message_name), sub)
+
+    def remove(self, element_instance_key: int, message_name: str) -> None:
+        if self._by_key.exists((element_instance_key, message_name)):
+            self._by_key.delete((element_instance_key, message_name))
+
+    def get(self, element_instance_key: int, message_name: str) -> dict | None:
+        return self._by_key.get((element_instance_key, message_name))
+
+    def subscriptions_of(self, element_instance_key: int) -> list[dict]:
+        return list(self._by_key.values((element_instance_key,)))
+
+
+class MessageStartEventSubscriptionState:
+    def __init__(self, db: ZbDb) -> None:
+        self._by_name = db.column_family(CF.MESSAGE_START_EVENT_SUBSCRIPTION_BY_NAME_AND_KEY)
+
+    def put(self, message_name: str, process_definition_key: int, record_value: dict) -> None:
+        self._by_name.put((message_name, process_definition_key), dict(record_value))
+
+    def remove_for_process(self, process_definition_key: int) -> None:
+        for enc_key, v in list(self._by_name.items()):
+            if v.get("processDefinitionKey") == process_definition_key:
+                self._by_name._ctx().delete(enc_key)
+
+    def find(self, message_name: str) -> list[dict]:
+        return list(self._by_name.values((message_name,)))
+
+
 class IncidentState:
     def __init__(self, db: ZbDb) -> None:
         self._incidents = db.column_family(CF.INCIDENTS)
@@ -476,6 +700,11 @@ class EngineState:
         self.variables = VariableState(db, self.element_instances)
         self.incidents = IncidentState(db)
         self.banned = BannedInstanceState(db)
+        self.timers = TimerState(db)
+        self.messages = MessageState(db)
+        self.message_subscriptions = MessageSubscriptionState(db)
+        self.process_message_subscriptions = ProcessMessageSubscriptionState(db)
+        self.message_start_subscriptions = MessageStartEventSubscriptionState(db)
         self._key_cf = db.column_family(CF.KEY)
         self.key_generator = KeyGenerator(partition_id)
         self._key_loaded = False

@@ -1,0 +1,447 @@
+"""Timers, message publish/correlation, and due-date checking.
+
+Reference: engine/…/processing/timer/ (TriggerTimerProcessor, DueDateChecker
+:19), processing/message/ (MessagePublishProcessor, MessageCorrelator,
+MessageExpireProcessor, Message(Start)EventSubscription processors,
+MessageObserver), message/command/SubscriptionCommandSender.java:43, and
+job/JobTimeoutTrigger.java:21 + JobBackoffChecker.
+
+Correlation is the reference's two-partition protocol even on one partition
+(commands loop back): the process partition opens a PROCESS_MESSAGE_
+SUBSCRIPTION and sends MESSAGE_SUBSCRIPTION CREATE to hash(correlationKey)'s
+partition; publishing correlates there and sends PROCESS_MESSAGE_SUBSCRIPTION
+CORRELATE back; completion acks with MESSAGE_SUBSCRIPTION CORRELATE.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from zeebe_tpu.engine.engine_state import EI_ACTIVATED, EngineState
+from zeebe_tpu.engine.writers import Writers
+from zeebe_tpu.logstreams import LoggedRecord
+from zeebe_tpu.parallel.partitioning import (
+    InterPartitionCommandSender,
+    subscription_partition_id,
+)
+from zeebe_tpu.protocol import Record, RejectionType, ValueType, command
+from zeebe_tpu.protocol.intent import (
+    JobIntent,
+    MessageIntent,
+    MessageStartEventSubscriptionIntent,
+    MessageSubscriptionIntent,
+    ProcessInstanceCreationIntent,
+    ProcessInstanceIntent,
+    ProcessMessageSubscriptionIntent,
+    TimerIntent,
+)
+
+
+class TimerProcessors:
+    """TIMER TRIGGER / CANCEL commands."""
+
+    def __init__(self, state: EngineState, clock_millis, bpmn) -> None:
+        self.state = state
+        self.clock_millis = clock_millis
+        self.bpmn = bpmn
+
+    def trigger(self, cmd: LoggedRecord, writers: Writers) -> None:
+        key = cmd.record.key
+        timer = self.state.timers.get(key)
+        if timer is None:
+            writers.respond_rejection(
+                cmd, RejectionType.NOT_FOUND, f"timer {key} not found or already triggered"
+            )
+            return
+        writers.append_event(key, ValueType.TIMER, TimerIntent.TRIGGERED, timer)
+
+        element_instance_key = timer.get("elementInstanceKey", -1)
+        target_element_id = timer["targetElementId"]
+        if element_instance_key < 0:
+            # timer start event: create a new process instance at that start
+            self._trigger_start_event(timer, writers)
+            return
+        instance = self.state.element_instances.get(element_instance_key)
+        if instance is None:
+            return  # element already gone; TRIGGERED still recorded
+        pi_value = instance["value"]
+        exe = self.state.processes.executable(pi_value["processDefinitionKey"])
+        element = exe.element(pi_value["elementId"])
+        if element.id == target_element_id:
+            # intermediate catch event fired: complete it
+            writers.append_command(
+                element_instance_key, ValueType.PROCESS_INSTANCE,
+                ProcessInstanceIntent.COMPLETE_ELEMENT, {},
+            )
+            return
+        # boundary timer on an activity
+        boundary = exe.element(target_element_id)
+        scope_key = pi_value.get("flowScopeKey", -1)
+        boundary_value = {
+            "bpmnProcessId": pi_value["bpmnProcessId"],
+            "version": pi_value["version"],
+            "processDefinitionKey": pi_value["processDefinitionKey"],
+            "processInstanceKey": pi_value["processInstanceKey"],
+            "elementId": boundary.id,
+            "flowScopeKey": scope_key,
+            "bpmnElementType": boundary.element_type.name,
+            "bpmnEventType": boundary.event_type.name,
+        }
+        new_key = self.state.next_key()
+        writers.append_command(
+            new_key, ValueType.PROCESS_INSTANCE,
+            ProcessInstanceIntent.ACTIVATE_ELEMENT, boundary_value,
+        )
+        if boundary.interrupting:
+            writers.append_command(
+                element_instance_key, ValueType.PROCESS_INSTANCE,
+                ProcessInstanceIntent.TERMINATE_ELEMENT, {},
+            )
+        else:
+            reps = timer.get("repetitions", 1)
+            if reps == -1 or reps > 1:
+                interval = timer.get("interval", -1)
+                if interval > 0:
+                    timer_key = self.state.next_key()
+                    writers.append_event(
+                        timer_key, ValueType.TIMER, TimerIntent.CREATED,
+                        {
+                            **timer,
+                            "dueDate": self.clock_millis() + interval,
+                            "repetitions": reps - 1 if reps > 0 else -1,
+                        },
+                    )
+
+    def _trigger_start_event(self, timer: dict, writers: Writers) -> None:
+        meta = self.state.processes.get_by_key(timer["processDefinitionKey"])
+        if meta is None:
+            return
+        writers.append_command(
+            -1, ValueType.PROCESS_INSTANCE_CREATION, ProcessInstanceCreationIntent.CREATE,
+            {
+                "bpmnProcessId": meta["bpmnProcessId"],
+                "processDefinitionKey": meta["processDefinitionKey"],
+                "version": meta["version"],
+                "variables": {},
+                "startElementId": timer["targetElementId"],
+            },
+        )
+        reps = timer.get("repetitions", 1)
+        interval = timer.get("interval", -1)
+        if (reps == -1 or reps > 1) and interval > 0:
+            timer_key = self.state.next_key()
+            writers.append_event(
+                timer_key, ValueType.TIMER, TimerIntent.CREATED,
+                {
+                    **timer,
+                    "dueDate": self.clock_millis() + interval,
+                    "repetitions": reps - 1 if reps > 0 else -1,
+                },
+            )
+
+    def cancel(self, cmd: LoggedRecord, writers: Writers) -> None:
+        timer = self.state.timers.get(cmd.record.key)
+        if timer is None:
+            return
+        writers.append_event(cmd.record.key, ValueType.TIMER, TimerIntent.CANCELED, timer)
+
+
+class MessageProcessors:
+    """MESSAGE PUBLISH / EXPIRE on the message partition."""
+
+    def __init__(
+        self, state: EngineState, clock_millis, partition_count: int,
+        sender: InterPartitionCommandSender,
+    ) -> None:
+        self.state = state
+        self.clock_millis = clock_millis
+        self.partition_count = partition_count
+        self.sender = sender
+
+    def publish(self, cmd: LoggedRecord, writers: Writers) -> None:
+        value = cmd.record.value
+        name = value.get("name", "")
+        correlation_key = value.get("correlationKey", "")
+        message_id = value.get("messageId", "") or ""
+        ttl = value.get("timeToLive", 0)
+        if message_id and self.state.messages.is_id_taken(name, correlation_key, message_id):
+            writers.respond_rejection(
+                cmd, RejectionType.ALREADY_EXISTS,
+                f"a message with id '{message_id}' is already published",
+            )
+            return
+        key = self.state.next_key()
+        deadline = self.clock_millis() + max(ttl, 0)
+        published_value = {
+            "name": name,
+            "correlationKey": correlation_key,
+            "messageId": message_id,
+            "timeToLive": ttl,
+            "variables": value.get("variables", {}),
+            "deadline": deadline,
+        }
+        published = writers.append_event(
+            key, ValueType.MESSAGE, MessageIntent.PUBLISHED, published_value
+        )
+        writers.respond(cmd, published)
+
+        # correlate to open subscriptions (once per process instance)
+        for sub_key, sub in self.state.message_subscriptions.find(name, correlation_key):
+            pi_key = sub.get("processInstanceKey", -1)
+            if self.state.messages.was_correlated_to(key, pi_key):
+                continue
+            self._correlate(key, published_value, sub_key, sub, writers)
+
+        # message start events
+        for start_sub in self.state.message_start_subscriptions.find(name):
+            writers.append_event(
+                self.state.next_key(), ValueType.MESSAGE_START_EVENT_SUBSCRIPTION,
+                MessageStartEventSubscriptionIntent.CORRELATED,
+                {**start_sub, "messageKey": key, "correlationKey": correlation_key},
+            )
+            writers.append_command(
+                -1, ValueType.PROCESS_INSTANCE_CREATION, ProcessInstanceCreationIntent.CREATE,
+                {
+                    "bpmnProcessId": start_sub["bpmnProcessId"],
+                    "processDefinitionKey": start_sub["processDefinitionKey"],
+                    "version": -1,
+                    "variables": published_value["variables"],
+                    "startElementId": start_sub["startEventId"],
+                },
+            )
+
+    def _correlate(self, message_key: int, message: dict, sub_key: int, sub: dict,
+                   writers: Writers) -> None:
+        _correlate_to_subscription(
+            self.state, self.sender, message_key, message, sub_key, sub, writers
+        )
+
+    def expire(self, cmd: LoggedRecord, writers: Writers) -> None:
+        key = cmd.record.key
+        msg = self.state.messages.get(key)
+        if msg is None:
+            return
+        writers.append_event(key, ValueType.MESSAGE, MessageIntent.EXPIRED, msg)
+
+
+def _correlate_to_subscription(
+    state: EngineState, sender, message_key: int, message: dict,
+    sub_key: int, sub: dict, writers: Writers,
+) -> None:
+    """Message-partition correlation: CORRELATING event + ship the CORRELATE
+    command to the subscription's process partition."""
+    writers.append_event(
+        sub_key, ValueType.MESSAGE_SUBSCRIPTION, MessageSubscriptionIntent.CORRELATING,
+        {**sub, "messageKey": message_key, "variables": message.get("variables", {})},
+    )
+    receiver = sub.get("subscriptionPartitionId", state.partition_id)
+    correlate_cmd = command(
+        ValueType.PROCESS_MESSAGE_SUBSCRIPTION,
+        ProcessMessageSubscriptionIntent.CORRELATE,
+        {
+            "processInstanceKey": sub["processInstanceKey"],
+            "elementInstanceKey": sub["elementInstanceKey"],
+            "messageName": sub["messageName"],
+            "correlationKey": sub["correlationKey"],
+            "messageKey": message_key,
+            "messageSubscriptionKey": sub_key,
+            "variables": message.get("variables", {}),
+            "subscriptionPartitionId": state.partition_id,
+        },
+        key=sub["elementInstanceKey"],
+    )
+    writers.after_commit(lambda: sender.send_command(receiver, correlate_cmd))
+
+
+class MessageSubscriptionProcessors:
+    """Message-partition side: CREATE (open) / CORRELATE (ack) / DELETE."""
+
+    def __init__(self, state: EngineState, sender: InterPartitionCommandSender) -> None:
+        self.state = state
+        self.sender = sender
+
+    def create(self, cmd: LoggedRecord, writers: Writers) -> None:
+        value = dict(cmd.record.value)
+        # the process partition pre-allocates the subscription key (it travels
+        # in the command key) so it can later address deletes/acks
+        sub_key = cmd.record.key if cmd.record.key >= 0 else self.state.next_key()
+        writers.append_event(
+            sub_key, ValueType.MESSAGE_SUBSCRIPTION, MessageSubscriptionIntent.CREATED, value
+        )
+        # an already-buffered message may correlate immediately
+        name, corr = value["messageName"], value["correlationKey"]
+        pi_key = value.get("processInstanceKey", -1)
+        for message_key in self.state.messages.buffered_for(name, corr):
+            if self.state.messages.was_correlated_to(message_key, pi_key):
+                continue
+            message = self.state.messages.get(message_key)
+            _correlate_to_subscription(
+                self.state, self.sender, message_key, message, sub_key, value, writers
+            )
+            break
+
+    def correlate_ack(self, cmd: LoggedRecord, writers: Writers) -> None:
+        key = cmd.record.key
+        sub = self.state.message_subscriptions.get(key)
+        if sub is None:
+            return
+        writers.append_event(
+            key, ValueType.MESSAGE_SUBSCRIPTION, MessageSubscriptionIntent.CORRELATED,
+            {**sub, "messageKey": cmd.record.value.get("messageKey", -1)},
+        )
+
+    def delete(self, cmd: LoggedRecord, writers: Writers) -> None:
+        key = cmd.record.key
+        sub = self.state.message_subscriptions.get(key)
+        if sub is None:
+            return
+        writers.append_event(key, ValueType.MESSAGE_SUBSCRIPTION, MessageSubscriptionIntent.DELETED, sub)
+
+
+class ProcessMessageSubscriptionProcessors:
+    """Process-partition side: CORRELATE completes the waiting element."""
+
+    def __init__(self, state: EngineState, sender: InterPartitionCommandSender,
+                 partition_count: int) -> None:
+        self.state = state
+        self.sender = sender
+        self.partition_count = partition_count
+
+    def correlate(self, cmd: LoggedRecord, writers: Writers) -> None:
+        value = cmd.record.value
+        element_key = value.get("elementInstanceKey", -1)
+        name = value.get("messageName", "")
+        sub = self.state.process_message_subscriptions.get(element_key, name)
+        instance = self.state.element_instances.get(element_key)
+        if sub is None or instance is None:
+            # element gone (terminated/completed); the subscription-close path
+            # already sent the delete — at-least-once semantics
+            return
+        writers.append_event(
+            element_key, ValueType.PROCESS_MESSAGE_SUBSCRIPTION,
+            ProcessMessageSubscriptionIntent.CORRELATED,
+            {**sub, "messageKey": value.get("messageKey", -1)},
+        )
+        # message variables merge into the process instance scope
+        pi_value = instance["value"]
+        from zeebe_tpu.protocol.intent import VariableIntent
+
+        for var_name, var_value in (value.get("variables") or {}).items():
+            var_key = self.state.next_key()
+            target_scope = (
+                self.state.variables.find_scope_with(element_key, var_name)
+                or pi_value["processInstanceKey"]
+            )
+            exists = self.state.variables.has_local(target_scope, var_name)
+            writers.append_event(
+                var_key, ValueType.VARIABLE,
+                VariableIntent.UPDATED if exists else VariableIntent.CREATED,
+                {
+                    "name": var_name, "value": var_value, "scopeKey": target_scope,
+                    "processInstanceKey": pi_value["processInstanceKey"],
+                    "processDefinitionKey": pi_value["processDefinitionKey"],
+                    "bpmnProcessId": pi_value["bpmnProcessId"],
+                },
+            )
+
+        target_element_id = sub.get("targetElementId", pi_value["elementId"])
+        if target_element_id == pi_value["elementId"]:
+            # catch event / receive task: complete the waiting element
+            writers.append_command(
+                element_key, ValueType.PROCESS_INSTANCE,
+                ProcessInstanceIntent.COMPLETE_ELEMENT, {},
+            )
+        else:
+            # boundary message event: activate the boundary; interrupting
+            # boundaries terminate the host activity
+            exe = self.state.processes.executable(pi_value["processDefinitionKey"])
+            boundary = exe.element(target_element_id)
+            boundary_value = {
+                "bpmnProcessId": pi_value["bpmnProcessId"],
+                "version": pi_value["version"],
+                "processDefinitionKey": pi_value["processDefinitionKey"],
+                "processInstanceKey": pi_value["processInstanceKey"],
+                "elementId": boundary.id,
+                "flowScopeKey": pi_value.get("flowScopeKey", -1),
+                "bpmnElementType": boundary.element_type.name,
+                "bpmnEventType": boundary.event_type.name,
+            }
+            writers.append_command(
+                self.state.next_key(), ValueType.PROCESS_INSTANCE,
+                ProcessInstanceIntent.ACTIVATE_ELEMENT, boundary_value,
+            )
+            if boundary.interrupting:
+                writers.append_command(
+                    element_key, ValueType.PROCESS_INSTANCE,
+                    ProcessInstanceIntent.TERMINATE_ELEMENT, {},
+                )
+
+        # ack to the message partition so the (single-use) subscription closes
+        message_sub_key = value.get("messageSubscriptionKey", -1)
+        if message_sub_key >= 0:
+            message_partition = subscription_partition_id(
+                sub["correlationKey"], self.partition_count
+            )
+            ack = command(
+                ValueType.MESSAGE_SUBSCRIPTION, MessageSubscriptionIntent.CORRELATE,
+                {"messageKey": value.get("messageKey", -1)},
+                key=message_sub_key,
+            )
+            writers.after_commit(
+                lambda: self.sender.send_command(message_partition, ack)
+            )
+
+
+class DueDateCheckers:
+    """Schedules and runs the due-date sweeps: timers, message TTL, job
+    deadlines, job retry backoff (reference: DueDateChecker, MessageObserver,
+    JobTimeoutTrigger, JobBackoffChecker). Wired by the harness/broker pump:
+    call ``reschedule()`` after every processing batch."""
+
+    def __init__(self, engine_state: EngineState, schedule_service, clock_millis) -> None:
+        self.state = engine_state
+        self.schedule = schedule_service
+        self.clock_millis = clock_millis
+        self._handle = None
+
+    def _next_due(self) -> int | None:
+        with self.state.db.transaction():
+            candidates = [
+                self.state.timers.next_due(),
+                self.state.messages.next_deadline(),
+                self.state.jobs.next_deadline(),
+                self.state.jobs.next_backoff(),
+            ]
+        due = [c for c in candidates if c is not None]
+        return min(due) if due else None
+
+    def reschedule(self) -> None:
+        due = self._next_due()
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
+        if due is not None:
+            self._handle = self.schedule.run_at(due, self._sweep)
+
+    def _sweep(self) -> list[Record]:
+        now = self.clock_millis()
+        commands: list[Record] = []
+        with self.state.db.transaction():
+            for timer_key, _timer in self.state.timers.due_timers(now):
+                commands.append(
+                    command(ValueType.TIMER, TimerIntent.TRIGGER, {}, key=timer_key)
+                )
+            for _deadline, message_key in self.state.messages.expired(now):
+                commands.append(
+                    command(ValueType.MESSAGE, MessageIntent.EXPIRE, {}, key=message_key)
+                )
+            for job_key in self.state.jobs.expired_deadlines(now):
+                commands.append(
+                    command(ValueType.JOB, JobIntent.TIME_OUT, {}, key=job_key)
+                )
+            for until, job_key in self.state.jobs.backoff_due(now):
+                commands.append(
+                    command(ValueType.JOB, JobIntent.RECUR_AFTER_BACKOFF,
+                            {"recurAt": until}, key=job_key)
+                )
+        return commands

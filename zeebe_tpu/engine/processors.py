@@ -41,10 +41,12 @@ from zeebe_tpu.protocol.intent import (
 
 class DeploymentProcessor:
     """DEPLOYMENT CREATE: parse + validate resources, version processes, emit
-    PROCESS CREATED per definition and DEPLOYMENT CREATED/FULLY_DISTRIBUTED."""
+    PROCESS CREATED per definition and DEPLOYMENT CREATED/FULLY_DISTRIBUTED,
+    and (re)register message/timer start-event subscriptions."""
 
-    def __init__(self, state: EngineState) -> None:
+    def __init__(self, state: EngineState, clock_millis=None) -> None:
         self.state = state
+        self.clock_millis = clock_millis or (lambda: 0)
 
     def process(self, cmd: LoggedRecord, writers: Writers) -> None:
         value = cmd.record.value
@@ -62,15 +64,20 @@ class DeploymentProcessor:
                 # hashes the deployed resource, not the compiled form)
                 checksum = hashlib.sha256(xml.encode("utf-8")).hexdigest()
                 for model in parse_bpmn_xml(xml):
-                    transform(model)  # validation only; rejects bad deployments
-                    parsed.append((res["resourceName"], xml, model, checksum))
+                    exe = transform(model)  # also rejects bad deployments
+                    parsed.append((res["resourceName"], xml, model, checksum, exe))
         except BpmnModelError as exc:
             writers.respond_rejection(cmd, RejectionType.INVALID_ARGUMENT, str(exc))
             return
 
         deployment_key = self.state.next_key()
-        for resource_name, xml, model, checksum in parsed:
+        for resource_name, xml, model, checksum, exe in parsed:
             previous_digest = self.state.processes.latest_digest(model.process_id)
+            previous_version = self.state.processes.latest_version(model.process_id)
+            previous_key = (
+                self.state.processes.get_key_by_id_version(model.process_id, previous_version)
+                if previous_version is not None else None
+            )
             duplicate = previous_digest == checksum
             if duplicate:
                 version = self.state.processes.latest_version(model.process_id)
@@ -92,6 +99,9 @@ class DeploymentProcessor:
                     process_key, ValueType.PROCESS, ProcessIntent.CREATED,
                     {**meta, "resource": xml},
                 )
+                self._register_start_subscriptions(
+                    writers, exe, meta, previous_key
+                )
 
         deployment_value = {
             "resources": [
@@ -112,6 +122,65 @@ class DeploymentProcessor:
             deployment_key, ValueType.DEPLOYMENT, DeploymentIntent.FULLY_DISTRIBUTED,
             deployment_value,
         )
+
+
+    def _register_start_subscriptions(self, writers, exe, meta, previous_key):
+        """Message/timer start events of the new latest version; the previous
+        version's subscriptions are closed (reference: deployment transformer
+        subscription lifecycle)."""
+        from zeebe_tpu.protocol.enums import BpmnEventType
+        from zeebe_tpu.protocol.intent import (
+            MessageStartEventSubscriptionIntent,
+            TimerIntent,
+        )
+        from zeebe_tpu.utils import parse_cycle, parse_duration_millis
+
+        if previous_key is not None:
+            # close the *previous* version's start subscriptions: whether they
+            # must go depends on what the old version had, not the new one
+            old_exe = self.state.processes.executable(previous_key)
+            old_has_msg_start = old_exe is not None and any(
+                el.element_type == BpmnElementType.START_EVENT
+                and el.event_type == BpmnEventType.MESSAGE
+                for el in old_exe.elements[1:]
+            )
+            if old_has_msg_start:
+                writers.append_event(
+                    self.state.next_key(), ValueType.MESSAGE_START_EVENT_SUBSCRIPTION,
+                    MessageStartEventSubscriptionIntent.DELETED,
+                    {"processDefinitionKey": previous_key, "bpmnProcessId": meta["bpmnProcessId"]},
+                )
+            for timer_key, timer in self.state.timers.start_timers_for_process(previous_key):
+                writers.append_event(timer_key, ValueType.TIMER, TimerIntent.CANCELED, timer)
+        for el in exe.elements[1:]:
+            if el.element_type != BpmnElementType.START_EVENT:
+                continue
+            if el.event_type == BpmnEventType.MESSAGE and el.message_name:
+                writers.append_event(
+                    self.state.next_key(), ValueType.MESSAGE_START_EVENT_SUBSCRIPTION,
+                    MessageStartEventSubscriptionIntent.CREATED,
+                    {
+                        "processDefinitionKey": meta["processDefinitionKey"],
+                        "bpmnProcessId": meta["bpmnProcessId"],
+                        "startEventId": el.id,
+                        "messageName": el.message_name,
+                    },
+                )
+            elif el.event_type == BpmnEventType.TIMER and el.timer_cycle:
+                reps, interval = parse_cycle(el.timer_cycle)
+                writers.append_event(
+                    self.state.next_key(), ValueType.TIMER, TimerIntent.CREATED,
+                    {
+                        "elementId": el.id,
+                        "targetElementId": el.id,
+                        "elementInstanceKey": -1,
+                        "processInstanceKey": -1,
+                        "processDefinitionKey": meta["processDefinitionKey"],
+                        "dueDate": self.clock_millis() + interval,
+                        "repetitions": reps,
+                        "interval": interval,
+                    },
+                )
 
 
 class ProcessInstanceCreationProcessor:
@@ -168,6 +237,8 @@ class ProcessInstanceCreationProcessor:
             "bpmnElementType": BpmnElementType.PROCESS.name,
             "bpmnEventType": "UNSPECIFIED",
         }
+        if value.get("startElementId"):
+            pi_value["startElementId"] = value["startElementId"]
         writers.append_command(
             process_instance_key, ValueType.PROCESS_INSTANCE,
             ProcessInstanceIntent.ACTIVATE_ELEMENT, pi_value,
@@ -308,6 +379,15 @@ class JobProcessors:
             cmd.record.key, ValueType.JOB, JobIntent.RETRIES_UPDATED, {**job, "retries": retries}
         )
         writers.respond(cmd, updated)
+
+    def recur_after_backoff(self, cmd: LoggedRecord, writers: Writers) -> None:
+        job = self._precondition(cmd, writers)
+        if job is None:
+            return
+        writers.append_event(
+            cmd.record.key, ValueType.JOB, JobIntent.RECURRED_AFTER_BACKOFF,
+            {**job, "recurAt": cmd.record.value.get("recurAt", -1)},
+        )
 
     def time_out(self, cmd: LoggedRecord, writers: Writers) -> None:
         job = self._precondition(cmd, writers)

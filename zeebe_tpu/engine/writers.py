@@ -69,3 +69,8 @@ class Writers:
     def respond_rejection(self, cmd: LoggedRecord, rejection_type: RejectionType, reason: str) -> None:
         rec = self.append_rejection(cmd, rejection_type, reason)
         self.respond(cmd, rec)
+
+    # -- SideEffectWriter: run after the transaction commits ------------------
+
+    def after_commit(self, task) -> None:
+        self._builder.append_post_commit_task(task)

@@ -325,9 +325,14 @@ def _validate(
                         f"{where}: event-based gateway must target catch events"
                     )
         if (
-            et in (BpmnElementType.INTERMEDIATE_CATCH_EVENT, BpmnElementType.RECEIVE_TASK)
-            and exe.event_type == BpmnEventType.MESSAGE or et == BpmnElementType.RECEIVE_TASK
-        ) and exe.message_name is not None and exe.correlation_key is None:
+            exe.message_name is not None
+            and exe.correlation_key is None
+            and et in (
+                BpmnElementType.INTERMEDIATE_CATCH_EVENT,
+                BpmnElementType.RECEIVE_TASK,
+                BpmnElementType.BOUNDARY_EVENT,
+            )
+        ):
             errors.append(f"{where}: message catch needs a correlation key")
         if et == BpmnElementType.BOUNDARY_EVENT and exe.attached_to_idx < 0:
             errors.append(f"{where}: boundary event not attached")

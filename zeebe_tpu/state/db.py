@@ -70,6 +70,7 @@ class ColumnFamilyCode(enum.IntEnum):
     MESSAGE_START_EVENT_SUBSCRIPTION_BY_KEY_AND_NAME = 35
     TIMERS = 40
     TIMER_DUE_DATES = 41
+    TIMER_BY_ELEMENT = 42
     PENDING_DEPLOYMENT = 50
     DEPLOYMENT_RAW = 51
     EVENT_SCOPE = 60

@@ -75,6 +75,17 @@ class EngineHarness:
             response_sink=self.responses.append,
             clock_millis=self.clock,
         )
+        from zeebe_tpu.engine.message_timer import DueDateCheckers
+        from zeebe_tpu.parallel.partitioning import LoopbackCommandSender
+
+        self.engine.wire_sender(
+            LoopbackCommandSender(
+                lambda rec: self.stream.writer.try_write(
+                    [LogAppendEntry(rec)]
+                )
+            )
+        )
+        self.checkers = DueDateCheckers(self.engine.state, self.processor.schedule_service, self.clock)
         self.processor.start()
         self._exported_until = 0
 
@@ -86,12 +97,27 @@ class EngineHarness:
     # -- pump ----------------------------------------------------------------
 
     def pump(self) -> None:
-        """Process everything pending, then transfer new records to the
-        exporter (the ProcessingExporterTransistor role)."""
-        self.processor.run_until_idle()
+        """Process everything pending (including due scheduled work), then
+        transfer new records to the exporter (ProcessingExporterTransistor)."""
+        for _ in range(1000):
+            self.processor.run_until_idle()
+            self.checkers.reschedule()
+            due = self.processor.schedule_service.next_due_millis
+            if due is None or due > self.clock():
+                break
+        else:
+            raise RuntimeError(
+                "pump did not quiesce after 1000 rounds — a due-date sweep is "
+                "producing commands that fail to clear their due state"
+            )
         for logged in self.stream.new_reader(self._exported_until + 1):
             self.exporter.export(logged)
             self._exported_until = logged.position
+
+    def advance_time(self, millis: int) -> None:
+        """Advance the controlled clock and process whatever becomes due."""
+        self.clock.advance(millis)
+        self.pump()
     # -- command ingress (the TestStreams role) ------------------------------
 
     def write_command(self, record: Record, request_id: int = -1) -> None:
@@ -188,6 +214,26 @@ class EngineHarness:
     def update_job_retries(self, job_key: int, retries: int, request_id: int = 8) -> None:
         self.write_command(
             command(ValueType.JOB, JobIntent.UPDATE_RETRIES, {"retries": retries}, key=job_key),
+            request_id=request_id,
+        )
+
+    def publish_message(
+        self, name: str, correlation_key: str, variables: dict | None = None,
+        ttl: int = 3_600_000, message_id: str = "", request_id: int = 11,
+    ) -> None:
+        from zeebe_tpu.protocol.intent import MessageIntent
+
+        self.write_command(
+            command(
+                ValueType.MESSAGE, MessageIntent.PUBLISH,
+                {
+                    "name": name,
+                    "correlationKey": correlation_key,
+                    "timeToLive": ttl,
+                    "messageId": message_id,
+                    "variables": variables or {},
+                },
+            ),
             request_id=request_id,
         )
 

@@ -1,0 +1,5 @@
+"""Shared utilities."""
+
+from zeebe_tpu.utils.time_util import InvalidTimerError, parse_cycle, parse_duration_millis
+
+__all__ = ["InvalidTimerError", "parse_cycle", "parse_duration_millis"]

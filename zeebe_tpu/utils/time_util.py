@@ -1,0 +1,45 @@
+"""ISO-8601 duration/cycle parsing for timer definitions.
+
+Reference: the engine's timer transformation uses the BPMN timer definitions
+(duration PT5S, cycles R3/PT10S, dates) evaluated via FEEL; this module is the
+duration arithmetic behind it.
+"""
+
+from __future__ import annotations
+
+import re
+
+_DURATION_RE = re.compile(
+    r"^P(?:(?P<days>\d+(?:\.\d+)?)D)?"
+    r"(?:T(?:(?P<hours>\d+(?:\.\d+)?)H)?(?:(?P<minutes>\d+(?:\.\d+)?)M)?"
+    r"(?:(?P<seconds>\d+(?:\.\d+)?)S)?)?$"
+)
+_CYCLE_RE = re.compile(r"^R(?P<reps>\d*)/(?P<dur>.+)$")
+
+
+class InvalidTimerError(ValueError):
+    pass
+
+
+def parse_duration_millis(text: str) -> int:
+    """'PT5S' → 5000. Supports D/H/M/S components (weeks/months are rejected,
+    matching the engine's interval subset)."""
+    m = _DURATION_RE.match(text.strip())
+    if not m or text.strip() in ("P", "PT"):
+        raise InvalidTimerError(f"invalid ISO-8601 duration: {text!r}")
+    days = float(m.group("days") or 0)
+    hours = float(m.group("hours") or 0)
+    minutes = float(m.group("minutes") or 0)
+    seconds = float(m.group("seconds") or 0)
+    if days == hours == minutes == seconds == 0 and "0" not in text:
+        raise InvalidTimerError(f"empty duration: {text!r}")
+    return int(((days * 24 + hours) * 60 + minutes) * 60000 + seconds * 1000)
+
+
+def parse_cycle(text: str) -> tuple[int, int]:
+    """'R3/PT10S' → (3, 10000); 'R/PT10S' → (-1, 10000) (infinite)."""
+    m = _CYCLE_RE.match(text.strip())
+    if not m:
+        raise InvalidTimerError(f"invalid ISO-8601 cycle: {text!r}")
+    reps = int(m.group("reps")) if m.group("reps") else -1
+    return reps, parse_duration_millis(m.group("dur"))
