@@ -131,6 +131,11 @@ class EventAppliers:
         v = record.value
         ei = self.state.element_instances
         ei.create(record.key, v, EI_ACTIVATING)
+        # a child process of a call activity back-links itself so terminate can
+        # reach it (reference: ElementInstance.calledChildInstanceKey)
+        parent_ei = v.get("parentElementInstanceKey", -1)
+        if parent_ei >= 0 and ei.get(parent_ei) is not None:
+            ei.update(parent_ei, calledChildInstanceKey=record.key)
         scope_key = v.get("flowScopeKey", -1)
         if scope_key >= 0:
             ei.add_child(scope_key)
@@ -138,10 +143,17 @@ class EventAppliers:
             # reference's appliers (they consult ProcessState): a parallel
             # gateway join consumes one token per incoming flow; elements
             # activated via a flow consume one; elements activated directly
-            # (start events, boundary events, scopes) consume none.
+            # (start events, boundary events, scopes, multi-instance inner
+            # instances) consume none.
             exe = self.state.processes.executable(v["processDefinitionKey"])
             element = exe.element(v["elementId"])
-            if element.element_type == BpmnElementType.PARALLEL_GATEWAY:
+            is_mi_inner = (
+                element.multi_instance is not None
+                and v.get("bpmnElementType") != BpmnElementType.MULTI_INSTANCE_BODY.name
+            )
+            if is_mi_inner:
+                pass
+            elif element.element_type == BpmnElementType.PARALLEL_GATEWAY:
                 ei.consume_active_flows(scope_key, element.incoming_count)
                 ei.decrement_taken_flows_for_join(scope_key, element.idx)
             elif element.element_type in (

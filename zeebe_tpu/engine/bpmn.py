@@ -118,12 +118,43 @@ class BpmnProcessor:
         element: ExecutableElement, writers: Writers,
     ) -> None:
         start_override = value.get("startElementId")
+        mi_item = value.get("miItem")
+        has_mi_item = "miItem" in value
+        is_mi_body = (
+            element.multi_instance is not None
+            and value.get("bpmnElementType") == BpmnElementType.MULTI_INSTANCE_BODY.name
+        )
+        is_mi_inner = element.multi_instance is not None and not is_mi_body
         value = _pi_value(value, element)
-        writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATING, value)
+        # an instance already in ACTIVATING is an incident-resolution retry —
+        # don't re-append the lifecycle event (the applier would double-count
+        # tokens/children) and don't re-open boundary subscriptions
+        instance = self.state.element_instances.get(key)
+        retrying = instance is not None and instance["state"] == EI_ACTIVATING
+        if not retrying:
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATING, value)
+
+        if is_mi_body:
+            # boundary events attach to the multi-instance body, not the inner
+            # instances (reference: MultiInstanceBodyProcessor)
+            if element.boundary_idxs and not retrying:
+                self._open_boundary_subscriptions(key, value, exe, element, writers)
+            self._activate_mi_body(key, value, exe, element, writers)
+            return
+
+        if is_mi_inner and not retrying:
+            # inputElement local variable precedes input mappings so they can
+            # reference it (reference: MultiInstanceBodyProcessor child setup);
+            # a null item still creates the variable with value null
+            mi = element.multi_instance
+            if mi.input_element and has_mi_item:
+                self._write_variable(writers, key, value, mi.input_element, mi_item)
 
         # input mappings create a local variable scope on the element instance
         if element.inputs:
-            context = self.state.variables.collect(value.get("flowScopeKey", -1))
+            context = self.state.variables.collect(
+                key if is_mi_inner else value.get("flowScopeKey", -1)
+            )
             try:
                 for expr, target in element.inputs:
                     result = expr.evaluate(context, self.clock_millis)
@@ -133,7 +164,7 @@ class BpmnProcessor:
                 return
 
         # boundary-event subscriptions attach when the host activity activates
-        if element.boundary_idxs:
+        if element.boundary_idxs and not is_mi_inner and not retrying:
             self._open_boundary_subscriptions(key, value, exe, element, writers)
 
         et = element.element_type
@@ -202,6 +233,8 @@ class BpmnProcessor:
                 if not self._open_message_subscription(key, value, element, element, writers):
                     return
             # wait state: timer trigger / message correlation completes it
+        elif et == BpmnElementType.CALL_ACTIVITY:
+            self._activate_call_activity(key, value, exe, element, writers)
         elif et in (BpmnElementType.MANUAL_TASK, BpmnElementType.TASK,
                     BpmnElementType.EXCLUSIVE_GATEWAY, BpmnElementType.PARALLEL_GATEWAY,
                     BpmnElementType.END_EVENT, BpmnElementType.INTERMEDIATE_THROW_EVENT):
@@ -211,6 +244,190 @@ class BpmnProcessor:
             # elements not yet implemented behave as pass-through tasks
             writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
             self._complete(key, value, exe, element, writers)
+
+    # ---------------------------------------------------------- multi-instance
+
+    def _eval_input_collection(self, body_key: int, value: dict, element: ExecutableElement,
+                               writers: Writers) -> list | None:
+        """Evaluate the input collection; incident (and None) if not a list."""
+        context = self.state.variables.collect(body_key)
+        mi = element.multi_instance
+        try:
+            items = mi.input_collection.evaluate(context, self.clock_millis)
+        except FeelEvalError as exc:
+            self._raise_incident(writers, body_key, value, ErrorType.EXTRACT_VALUE_ERROR, str(exc))
+            return None
+        if not isinstance(items, list):
+            self._raise_incident(
+                writers, body_key, value, ErrorType.EXTRACT_VALUE_ERROR,
+                f"Expected the input collection of '{element.id}' to be an array, "
+                f"but it evaluated to {items!r}",
+            )
+            return None
+        return items
+
+    def _activate_mi_body(self, key: int, value: dict, exe: ExecutableProcess,
+                          element: ExecutableElement, writers: Writers) -> None:
+        """Reference: processing/bpmn/container/MultiInstanceBodyProcessor —
+        evaluate inputCollection, spawn inner instances (all for parallel, the
+        first for sequential), seed the output collection."""
+        mi = element.multi_instance
+        items = self._eval_input_collection(key, value, element, writers)
+        if items is None:
+            return  # incident raised; body stays ACTIVATING
+        writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
+
+        if mi.output_collection:
+            self._write_variable(
+                writers, key, value, mi.output_collection, [None] * len(items)
+            )
+
+        if not items:
+            self._complete(key, value, exe, element, writers)
+            return
+        if mi.is_sequential:
+            self._write_mi_inner_activate(writers, key, value, element, items[0], 1)
+        else:
+            for i, item in enumerate(items):
+                self._write_mi_inner_activate(writers, key, value, element, item, i + 1)
+
+    def _write_mi_inner_activate(self, writers: Writers, body_key: int, body_value: dict,
+                                 element: ExecutableElement, item, loop_counter: int) -> None:
+        inner_key = self.state.next_key()
+        inner_value = {
+            "bpmnProcessId": body_value["bpmnProcessId"],
+            "version": body_value["version"],
+            "processDefinitionKey": body_value["processDefinitionKey"],
+            "processInstanceKey": body_value["processInstanceKey"],
+            "elementId": element.id,
+            "flowScopeKey": body_key,
+            "bpmnElementType": element.element_type.name,
+            "bpmnEventType": element.event_type.name,
+            "loopCounter": loop_counter,
+            "miItem": item,
+        }
+        writers.append_command(
+            inner_key, ValueType.PROCESS_INSTANCE, PI.ACTIVATE_ELEMENT, inner_value
+        )
+
+    def _on_mi_inner_completed(self, inner_key: int, inner_value: dict,
+                               exe: ExecutableProcess, element: ExecutableElement,
+                               writers: Writers) -> None:
+        """Collect the output element, advance a sequential loop, and complete
+        the body when the last inner instance finishes. Called after the inner
+        ELEMENT_COMPLETED event was applied (instance and scope are gone)."""
+        mi = element.multi_instance
+        body_key = inner_value.get("flowScopeKey", -1)
+        body = self.state.element_instances.get(body_key)
+        if body is None or body["state"] not in (EI_ACTIVATED, EI_ACTIVATING):
+            return  # body interrupted/terminated meanwhile
+        body_value = body["value"]
+        loop_counter = inner_value.get("loopCounter", 0)
+
+        if mi.is_sequential:
+            # re-read the collection per iteration, matching the reference
+            # implementation (MultiInstanceBodyProcessor.onChildCompleted
+            # re-reads the input collection; mutating it mid-loop is documented
+            # as unsupported in both engines)
+            items = self._eval_input_collection(body_key, body_value, element, writers)
+            if items is None:
+                return
+            if loop_counter < len(items):
+                self._write_mi_inner_activate(
+                    writers, body_key, body_value, element, items[loop_counter],
+                    loop_counter + 1,
+                )
+                return
+        if body["activeChildren"] == 0:
+            writers.append_command(
+                body_key, ValueType.PROCESS_INSTANCE, PI.COMPLETE_ELEMENT, {}
+            )
+
+    def _collect_mi_output(self, inner_key: int, inner_value: dict,
+                           element: ExecutableElement, writers: Writers) -> bool:
+        """Store the evaluated outputElement into the body's output collection
+        at position loopCounter-1. Runs before the inner COMPLETED event so the
+        inner variable scope is still live. Returns False (after raising an
+        incident) when the output element cannot be evaluated — the inner
+        instance stays COMPLETING and incident resolution retries."""
+        mi = element.multi_instance
+        if not mi.output_collection or mi.output_element is None:
+            return True
+        body_key = inner_value.get("flowScopeKey", -1)
+        context = self.state.variables.collect(inner_key)
+        try:
+            item = mi.output_element.evaluate(context, self.clock_millis)
+        except FeelEvalError as exc:
+            self._raise_incident(
+                writers, inner_key, inner_value, ErrorType.EXTRACT_VALUE_ERROR, str(exc)
+            )
+            return False
+        collection = self.state.variables.get_local(body_key, mi.output_collection)
+        if not isinstance(collection, list):
+            return True
+        idx = inner_value.get("loopCounter", 0) - 1
+        if 0 <= idx < len(collection):
+            updated = list(collection)
+            updated[idx] = item
+            body = self.state.element_instances.get(body_key)
+            body_value = body["value"] if body else inner_value
+            self._write_variable(writers, body_key, body_value, mi.output_collection, updated)
+        return True
+
+    # ----------------------------------------------------------- call activity
+
+    def _activate_call_activity(self, key: int, value: dict, exe: ExecutableProcess,
+                                element: ExecutableElement, writers: Writers) -> None:
+        """Reference: processing/bpmn/container/CallActivityProcessor — resolve
+        the called process, create a child instance with the parent back-links,
+        and copy the call-activity scope variables into the child root."""
+        meta = self.state.processes.get_latest_by_id(element.called_process_id)
+        if meta is None:
+            self._raise_incident(
+                writers, key, value, ErrorType.CALLED_ELEMENT_ERROR,
+                f"Expected process with BPMN process id '{element.called_process_id}' "
+                "to be deployed, but not found",
+            )
+            return  # stays ACTIVATING; resolve retries
+        called = self.state.processes.executable(meta["processDefinitionKey"])
+        if called.root.child_start_idx < 0:
+            self._raise_incident(
+                writers, key, value, ErrorType.CALLED_ELEMENT_ERROR,
+                f"Expected process '{element.called_process_id}' to have a none start "
+                "event, but not found",
+            )
+            return
+        writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
+
+        child_key = self.state.next_key()
+        child_value = {
+            "bpmnProcessId": meta["bpmnProcessId"],
+            "version": meta["version"],
+            "processDefinitionKey": meta["processDefinitionKey"],
+            "processInstanceKey": child_key,
+            "elementId": meta["bpmnProcessId"],
+            "flowScopeKey": -1,
+            "bpmnElementType": BpmnElementType.PROCESS.name,
+            "bpmnEventType": BpmnEventType.UNSPECIFIED.name,
+            "parentProcessInstanceKey": value.get("processInstanceKey", -1),
+            "parentElementInstanceKey": key,
+        }
+        writers.append_command(
+            child_key, ValueType.PROCESS_INSTANCE, PI.ACTIVATE_ELEMENT, child_value
+        )
+        # propagate all visible variables into the child root scope
+        # (reference default: propagateAllParentVariables=true)
+        for name, val in self.state.variables.collect(key).items():
+            var_key = self.state.next_key()
+            writers.append_event(
+                var_key, ValueType.VARIABLE, VariableIntent.CREATED,
+                {
+                    "name": name, "value": val, "scopeKey": child_key,
+                    "processInstanceKey": child_key,
+                    "processDefinitionKey": meta["processDefinitionKey"],
+                    "bpmnProcessId": meta["bpmnProcessId"],
+                },
+            )
 
     # ------------------------------------------------- event subscriptions
 
@@ -345,14 +562,21 @@ class BpmnProcessor:
         self, key: int, value: dict, exe: ExecutableProcess,
         element: ExecutableElement, writers: Writers,
     ) -> None:
+        is_mi_body = (
+            element.multi_instance is not None
+            and value.get("bpmnElementType") == BpmnElementType.MULTI_INSTANCE_BODY.name
+        )
+        is_mi_inner = element.multi_instance is not None and not is_mi_body
         value = _pi_value(value, element)
         instance = self.state.element_instances.get(key)
         if instance is None or instance["state"] != EI_COMPLETING:
             writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_COMPLETING, value)
         # else: retrying a stalled completing transition after incident resolution
 
-        # output mappings evaluate against the element scope, write to parent
-        if element.outputs:
+        # output mappings evaluate against the element scope, write to parent.
+        # With multi-instance they apply on the body (which sees the output
+        # collection), not on each inner instance (reference docs).
+        if element.outputs and not is_mi_inner:
             context = self.state.variables.collect(key)
             try:
                 for expr, target in element.outputs:
@@ -366,6 +590,32 @@ class BpmnProcessor:
 
         # boundary/catch subscriptions close when the element leaves ACTIVATED
         self._close_subscriptions(key, value, writers)
+
+        if is_mi_inner:
+            if not self._collect_mi_output(key, value, element, writers):
+                return  # incident raised; stays COMPLETING, resolve retries
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_COMPLETED, value)
+            self._on_mi_inner_completed(key, value, exe, element, writers)
+            return
+
+        if is_mi_body and element.multi_instance.output_collection:
+            # propagate the collected output to the body's outer scope before
+            # the body scope disappears with ELEMENT_COMPLETED
+            collection = self.state.variables.get_local(
+                key, element.multi_instance.output_collection
+            )
+            if collection is not None:
+                self._write_variable(
+                    writers, value.get("flowScopeKey", -1), value,
+                    element.multi_instance.output_collection, collection,
+                )
+
+        # child process locals must be captured before COMPLETED removes the
+        # scope — a call activity's output mappings read them (reference:
+        # CallActivityProcessor.onChildCompleted)
+        child_locals: dict | None = None
+        if element.element_type == BpmnElementType.PROCESS:
+            child_locals = self.state.variables.locals_of(key)
 
         if element.element_type == BpmnElementType.EXCLUSIVE_GATEWAY and (
             len(element.outgoing) > 1
@@ -382,7 +632,7 @@ class BpmnProcessor:
                 self._take_flow(writers, exe, exe.flows[fidx], value)
 
         if element.element_type == BpmnElementType.PROCESS:
-            self._on_process_completed(key, value, writers)
+            self._on_process_completed(key, value, child_locals or {}, writers)
             return
         if not element.outgoing:
             self._check_scope_completion(value.get("flowScopeKey", -1), writers)
@@ -440,6 +690,13 @@ class BpmnProcessor:
         scope_key: int, value: dict,
     ) -> None:
         new_key = self.state.next_key()
+        # an element with loop characteristics is entered through its
+        # multi-instance body wrapper (reference: MULTI_INSTANCE_BODY element)
+        element_type_name = (
+            BpmnElementType.MULTI_INSTANCE_BODY.name
+            if element.multi_instance is not None
+            else element.element_type.name
+        )
         child_value = {
             "bpmnProcessId": value["bpmnProcessId"],
             "version": value["version"],
@@ -447,7 +704,7 @@ class BpmnProcessor:
             "processInstanceKey": value["processInstanceKey"],
             "elementId": element.id,
             "flowScopeKey": scope_key,
-            "bpmnElementType": element.element_type.name,
+            "bpmnElementType": element_type_name,
             "bpmnEventType": element.event_type.name,
         }
         writers.append_command(new_key, ValueType.PROCESS_INSTANCE, PI.ACTIVATE_ELEMENT, child_value)
@@ -467,11 +724,36 @@ class BpmnProcessor:
                 scope_key, ValueType.PROCESS_INSTANCE, PI.COMPLETE_ELEMENT, {}
             )
 
-    def _on_process_completed(self, key: int, value: dict, writers: Writers) -> None:
-        # bubble into a parent process (call activity) — forthcoming; top-level
-        # completion may answer a create-with-result request (handled by the
-        # creation processor's awaitResult bookkeeping, stored on the instance)
-        pass
+    def _on_process_completed(self, key: int, value: dict, child_locals: dict,
+                              writers: Writers) -> None:
+        """A completed child of a call activity propagates its root variables
+        and completes the call activity (reference: CallActivityProcessor).
+
+        With output mappings, child variables land in the call activity's
+        local scope so the mappings can read them; without, the reference
+        default (propagateAllChildVariables=true) merges them upward like job
+        completion variables."""
+        parent_ei_key = value.get("parentElementInstanceKey", -1)
+        if parent_ei_key < 0:
+            return
+        parent = self.state.element_instances.get(parent_ei_key)
+        if parent is None or parent["state"] not in (EI_ACTIVATED, EI_ACTIVATING):
+            return  # parent terminated/interrupted meanwhile
+        parent_value = parent["value"]
+        call_element = self._executable(parent_value).element(parent_value["elementId"])
+        parent_pi_key = parent_value.get("processInstanceKey", -1)
+        for name, val in child_locals.items():
+            if call_element.outputs:
+                target_scope = parent_ei_key
+            else:
+                target_scope = (
+                    self.state.variables.find_scope_with(parent_ei_key, name)
+                    or parent_pi_key
+                )
+            self._write_variable(writers, target_scope, parent_value, name, val)
+        writers.append_command(
+            parent_ei_key, ValueType.PROCESS_INSTANCE, PI.COMPLETE_ELEMENT, {}
+        )
 
     # -------------------------------------------------------------- terminate
 
@@ -489,6 +771,15 @@ class BpmnProcessor:
             if job is not None:
                 writers.append_event(job_key, ValueType.JOB, JobIntent.CANCELED, job)
         self._close_subscriptions(key, value, writers)
+
+        # a call activity first terminates its called child instance; the child
+        # root's termination resumes this element (see _finish_terminate)
+        child_pi_key = instance.get("calledChildInstanceKey", -1)
+        if child_pi_key >= 0 and self.state.element_instances.get(child_pi_key) is not None:
+            writers.append_command(
+                child_pi_key, ValueType.PROCESS_INSTANCE, PI.TERMINATE_ELEMENT, {}
+            )
+            return
 
         children = self.state.element_instances.children_keys(key)
         if children:
@@ -511,6 +802,19 @@ class BpmnProcessor:
                     scope_value = scope["value"]
                     exe = self._executable(scope_value)
                     self._finish_terminate(scope_key, _pi_value(scope_value, exe.element(scope_value["elementId"])), writers)
+            return
+        # a terminated child-process root resumes its call activity's terminate
+        parent_ei_key = value.get("parentElementInstanceKey", -1)
+        if parent_ei_key >= 0:
+            parent = self.state.element_instances.get(parent_ei_key)
+            if parent is not None and parent["state"] == EI_TERMINATING:
+                parent_value = parent["value"]
+                exe = self._executable(parent_value)
+                self._finish_terminate(
+                    parent_ei_key,
+                    _pi_value(parent_value, exe.element(parent_value["elementId"])),
+                    writers,
+                )
 
     # -------------------------------------------------------------- incidents
 
@@ -557,15 +861,22 @@ class BpmnProcessor:
 
 def _pi_value(value: dict, element: ExecutableElement) -> dict:
     """Canonical PROCESS_INSTANCE record value (camelCase, reference shape)."""
-    return {
+    # the body wrapper of a multi-instance element keeps its own element type
+    mi_body = value.get("bpmnElementType") == BpmnElementType.MULTI_INSTANCE_BODY.name
+    out = {
         "bpmnProcessId": value["bpmnProcessId"],
         "version": value["version"],
         "processDefinitionKey": value["processDefinitionKey"],
         "processInstanceKey": value["processInstanceKey"],
         "elementId": element.id,
         "flowScopeKey": value.get("flowScopeKey", -1),
-        "bpmnElementType": element.element_type.name,
+        "bpmnElementType": (
+            BpmnElementType.MULTI_INSTANCE_BODY.name if mi_body else element.element_type.name
+        ),
         "bpmnEventType": element.event_type.name,
         "parentProcessInstanceKey": value.get("parentProcessInstanceKey", -1),
         "parentElementInstanceKey": value.get("parentElementInstanceKey", -1),
     }
+    if "loopCounter" in value:
+        out["loopCounter"] = value["loopCounter"]
+    return out

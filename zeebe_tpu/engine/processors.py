@@ -314,10 +314,22 @@ class JobProcessors:
         instance = self.state.element_instances.get(element_key)
         if instance is not None:
             # completion variables merge into the process instance scope
-            # (reference default propagation without output mappings)
+            # (reference default propagation), EXCEPT when the element has
+            # output mappings or is a multi-instance inner instance — then the
+            # variables merge into the element's local scope so the mappings /
+            # outputElement can read them and parallel siblings don't collide
+            # (reference: VariableBehavior.mergeDocument + MI docs)
             pi_key = job.get("processInstanceKey", -1)
+            merge_local = False
+            exe = self.state.processes.executable(job.get("processDefinitionKey", -1))
+            if exe is not None and job.get("elementId", "") in exe.by_id:
+                element = exe.element(job["elementId"])
+                merge_local = bool(element.outputs) or element.multi_instance is not None
             for name, val in variables.items():
-                target_scope = self.state.variables.find_scope_with(element_key, name) or pi_key
+                if merge_local:
+                    target_scope = element_key
+                else:
+                    target_scope = self.state.variables.find_scope_with(element_key, name) or pi_key
                 var_key = self.state.next_key()
                 exists = self.state.variables.has_local(target_scope, name)
                 writers.append_event(
