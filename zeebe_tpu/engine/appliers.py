@@ -162,6 +162,15 @@ class EventAppliers:
                 BpmnElementType.EVENT_SUB_PROCESS,
             ):
                 pass
+            elif element.element_type == BpmnElementType.INTERMEDIATE_CATCH_EVENT and all(
+                exe.elements[f.source_idx].element_type == BpmnElementType.EVENT_BASED_GATEWAY
+                for f in exe.flows
+                if f.target_idx == element.idx
+            ):
+                # a catch event after an event-based gateway activates directly —
+                # the flow gateway→event is never taken (BPMN spec), so there is
+                # no in-transit token to consume
+                pass
             else:
                 ei.consume_active_flows(scope_key, min(1, element.incoming_count))
 

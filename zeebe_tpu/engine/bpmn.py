@@ -86,6 +86,11 @@ class BpmnProcessor:
                 )
                 return
             value = instance["value"]
+            # an event-based gateway's COMPLETE command names the catch event
+            # that fired (reference: EventBasedGatewayProcessor.onComplete reads
+            # the event trigger); pass it through to completion
+            if "triggeredElementId" in cmd.record.value:
+                value = {**value, "triggeredElementId": cmd.record.value["triggeredElementId"]}
             exe = self._executable(value)
             element = exe.element(value["elementId"])
             self._complete(key, value, exe, element, writers)
@@ -120,6 +125,9 @@ class BpmnProcessor:
         start_override = value.get("startElementId")
         mi_item = value.get("miItem")
         has_mi_item = "miItem" in value
+        # set when an event-based gateway's triggered catch event is activated
+        # directly (no subscription to open; complete immediately)
+        event_triggered = bool(value.get("eventTriggered"))
         is_mi_body = (
             element.multi_instance is not None
             and value.get("bpmnElementType") == BpmnElementType.MULTI_INSTANCE_BODY.name
@@ -227,12 +235,47 @@ class BpmnProcessor:
             self._complete(key, value, exe, element, writers)
         elif et in (BpmnElementType.INTERMEDIATE_CATCH_EVENT, BpmnElementType.RECEIVE_TASK):
             writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
-            if element.event_type == BpmnEventType.TIMER or element.timer_duration is not None:
+            if event_triggered:
+                # the event already fired at the event-based gateway; pass through
+                self._complete(key, value, exe, element, writers)
+            elif element.event_type == BpmnEventType.TIMER or element.timer_duration is not None:
                 self._create_timer(key, value, element, element, writers)
             elif element.message_name is not None:
                 if not self._open_message_subscription(key, value, element, element, writers):
                     return
             # wait state: timer trigger / message correlation completes it
+        elif et == BpmnElementType.EVENT_BASED_GATEWAY:
+            # subscribe to every succeeding catch event on the gateway's own
+            # element instance; first trigger wins (reference:
+            # EventBasedGatewayProcessor.onActivate subscribes BEFORE
+            # transitioning to activated). Pre-validate every subscription
+            # expression first so a failure writes no subscription events and
+            # leaves the gateway ACTIVATING — incident resolution then retries
+            # the whole activation without duplicating timers.
+            targets = [exe.elements[exe.flows[fidx].target_idx] for fidx in element.outgoing]
+            context = self.state.variables.collect(key)
+            for target in targets:
+                try:
+                    if target.event_type == BpmnEventType.TIMER or target.timer_duration is not None:
+                        self._eval_duration_millis(target.timer_duration, context)
+                    elif target.message_name is not None:
+                        ck = target.correlation_key.evaluate(context, self.clock_millis)
+                        if ck is None:
+                            raise FeelEvalError(
+                                f"correlation key of '{target.id}' evaluated to null"
+                            )
+                except (FeelEvalError, TypeError, ValueError) as exc:
+                    self._raise_incident(
+                        writers, key, value, ErrorType.EXTRACT_VALUE_ERROR, str(exc)
+                    )
+                    return
+            for target in targets:
+                if target.event_type == BpmnEventType.TIMER or target.timer_duration is not None:
+                    self._create_timer(key, value, target, element, writers)
+                elif target.message_name is not None:
+                    self._open_message_subscription(key, value, target, element, writers)
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
+            # wait state: the first triggered event completes the gateway
         elif et == BpmnElementType.CALL_ACTIVITY:
             self._activate_call_activity(key, value, exe, element, writers)
         elif et in (BpmnElementType.MANUAL_TASK, BpmnElementType.TASK,
@@ -293,21 +336,16 @@ class BpmnProcessor:
 
     def _write_mi_inner_activate(self, writers: Writers, body_key: int, body_value: dict,
                                  element: ExecutableElement, item, loop_counter: int) -> None:
-        inner_key = self.state.next_key()
-        inner_value = {
-            "bpmnProcessId": body_value["bpmnProcessId"],
-            "version": body_value["version"],
-            "processDefinitionKey": body_value["processDefinitionKey"],
-            "processInstanceKey": body_value["processInstanceKey"],
-            "elementId": element.id,
-            "flowScopeKey": body_key,
-            "bpmnElementType": element.element_type.name,
-            "bpmnEventType": element.event_type.name,
-            "loopCounter": loop_counter,
-            "miItem": item,
-        }
-        writers.append_command(
-            inner_key, ValueType.PROCESS_INSTANCE, PI.ACTIVATE_ELEMENT, inner_value
+        # the extra's bpmnElementType overrides _write_activate's
+        # MULTI_INSTANCE_BODY wrapping: the inner instance IS the element
+        exe = self.state.processes.executable(body_value["processDefinitionKey"])
+        self._write_activate(
+            writers, exe, element, body_key, body_value,
+            extra={
+                "bpmnElementType": element.element_type.name,
+                "loopCounter": loop_counter,
+                "miItem": item,
+            },
         )
 
     def _on_mi_inner_completed(self, inner_key: int, inner_value: dict,
@@ -567,6 +605,7 @@ class BpmnProcessor:
             and value.get("bpmnElementType") == BpmnElementType.MULTI_INSTANCE_BODY.name
         )
         is_mi_inner = element.multi_instance is not None and not is_mi_body
+        triggered_element_id = value.get("triggeredElementId")
         value = _pi_value(value, element)
         instance = self.state.element_instances.get(key)
         if instance is None or instance["state"] != EI_COMPLETING:
@@ -594,6 +633,30 @@ class BpmnProcessor:
         if is_mi_inner:
             if not self._collect_mi_output(key, value, element, writers):
                 return  # incident raised; stays COMPLETING, resolve retries
+            # a sequential loop re-reads the input collection to find the next
+            # item; validate it NOW, while this inner is still COMPLETING, so a
+            # bad collection raises a retryable incident instead of stalling
+            # the ACTIVATED body after COMPLETED is written
+            mi = element.multi_instance
+            if mi.is_sequential:
+                body_key = value.get("flowScopeKey", -1)
+                body = self.state.element_instances.get(body_key)
+                if body is not None and body["state"] in (EI_ACTIVATED, EI_ACTIVATING):
+                    context = self.state.variables.collect(body_key)
+                    try:
+                        items = mi.input_collection.evaluate(context, self.clock_millis)
+                    except FeelEvalError as exc:
+                        self._raise_incident(
+                            writers, key, value, ErrorType.EXTRACT_VALUE_ERROR, str(exc)
+                        )
+                        return
+                    if not isinstance(items, list):
+                        self._raise_incident(
+                            writers, key, value, ErrorType.EXTRACT_VALUE_ERROR,
+                            f"Expected the input collection of '{element.id}' to be an "
+                            f"array, but it evaluated to {items!r}",
+                        )
+                        return
             writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_COMPLETED, value)
             self._on_mi_inner_completed(key, value, exe, element, writers)
             return
@@ -626,10 +689,45 @@ class BpmnProcessor:
                 return  # incident raised; stays in COMPLETING
             writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_COMPLETED, value)
             self._take_flow(writers, exe, taken, value)
+        elif element.element_type == BpmnElementType.INCLUSIVE_GATEWAY and (
+            len(element.outgoing) > 1
+            or any(exe.flows[f].condition is not None for f in element.outgoing)
+        ):
+            # fork: take EVERY flow whose condition holds; default only when
+            # none hold (reference: InclusiveGatewayProcessor.findSequenceFlowsToTake)
+            taken_flows = self._choose_inclusive_flows(key, value, exe, element, writers)
+            if taken_flows is None:
+                return  # incident raised; stays in COMPLETING
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_COMPLETED, value)
+            for flow in taken_flows:
+                self._take_flow(writers, exe, flow, value)
+        elif element.element_type == BpmnElementType.EVENT_BASED_GATEWAY and triggered_element_id:
+            # per the BPMN spec the sequence flow to the triggered event is NOT
+            # taken — the event activates directly (reference:
+            # EventBasedGatewayProcessor.onComplete :65-76)
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_COMPLETED, value)
+            target = exe.elements[exe.by_id[triggered_element_id]]
+            self._write_activate(
+                writers, exe, target, value.get("flowScopeKey", -1), value,
+                extra={"eventTriggered": True},
+            )
         else:
             writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_COMPLETED, value)
             for fidx in element.outgoing:
                 self._take_flow(writers, exe, exe.flows[fidx], value)
+            if (
+                element.element_type == BpmnElementType.END_EVENT
+                and element.event_type == BpmnEventType.TERMINATE
+            ):
+                # terminate every other active element instance in the flow
+                # scope; the scope completes when the last one is gone
+                # (reference: EndEventProcessor TerminateEndEventBehavior)
+                scope_key = value.get("flowScopeKey", -1)
+                for child_key in self.state.element_instances.children_keys(scope_key):
+                    if child_key != key:
+                        writers.append_command(
+                            child_key, ValueType.PROCESS_INSTANCE, PI.TERMINATE_ELEMENT, {}
+                        )
 
         if element.element_type == BpmnElementType.PROCESS:
             self._on_process_completed(key, value, child_locals or {}, writers)
@@ -654,6 +752,37 @@ class BpmnProcessor:
                 return flow
         if element.default_flow_idx >= 0:
             return exe.flows[element.default_flow_idx]
+        self._raise_incident(
+            writers, key, value, ErrorType.CONDITION_ERROR,
+            f"Expected at least one condition to evaluate to true, or to have a default flow "
+            f"at gateway '{element.id}'",
+        )
+        return None
+
+    def _choose_inclusive_flows(self, key, value, exe, element, writers):
+        """All outgoing flows with true conditions; the default flow only when
+        no condition holds (reference: InclusiveGatewayProcessor)."""
+        context = self.state.variables.collect(key)
+        taken = []
+        for fidx in element.outgoing:
+            if fidx == element.default_flow_idx:
+                continue
+            flow = exe.flows[fidx]
+            if flow.condition is None:
+                # unconditional non-default flow on a single-outgoing gateway
+                taken.append(flow)
+                continue
+            try:
+                result = flow.condition.evaluate(context, self.clock_millis)
+            except FeelEvalError as exc:
+                self._raise_incident(writers, key, value, ErrorType.EXTRACT_VALUE_ERROR, str(exc))
+                return None
+            if result is True:
+                taken.append(flow)
+        if taken:
+            return taken
+        if element.default_flow_idx >= 0:
+            return [exe.flows[element.default_flow_idx]]
         self._raise_incident(
             writers, key, value, ErrorType.CONDITION_ERROR,
             f"Expected at least one condition to evaluate to true, or to have a default flow "
@@ -687,7 +816,7 @@ class BpmnProcessor:
 
     def _write_activate(
         self, writers: Writers, exe: ExecutableProcess, element: ExecutableElement,
-        scope_key: int, value: dict,
+        scope_key: int, value: dict, extra: dict | None = None,
     ) -> None:
         new_key = self.state.next_key()
         # an element with loop characteristics is entered through its
@@ -707,6 +836,8 @@ class BpmnProcessor:
             "bpmnElementType": element_type_name,
             "bpmnEventType": element.event_type.name,
         }
+        if extra:
+            child_value.update(extra)
         writers.append_command(new_key, ValueType.PROCESS_INSTANCE, PI.ACTIVATE_ELEMENT, child_value)
 
     # -------------------------------------------------------- scope completion
@@ -743,7 +874,12 @@ class BpmnProcessor:
         call_element = self._executable(parent_value).element(parent_value["elementId"])
         parent_pi_key = parent_value.get("processInstanceKey", -1)
         for name, val in child_locals.items():
-            if call_element.outputs:
+            if call_element.outputs or call_element.multi_instance is not None:
+                # with output mappings the mappings read the call activity's
+                # local scope; under multi-instance, parallel siblings must not
+                # overwrite each other via the shared parent scope — results
+                # land locally and flow out through outputElement collection
+                # (same invariant as job-completion merge_local)
                 target_scope = parent_ei_key
             else:
                 target_scope = (
@@ -802,6 +938,10 @@ class BpmnProcessor:
                     scope_value = scope["value"]
                     exe = self._executable(scope_value)
                     self._finish_terminate(scope_key, _pi_value(scope_value, exe.element(scope_value["elementId"])), writers)
+            elif scope is not None:
+                # a terminate end event removed its siblings while the scope
+                # stays active — the last terminated child completes the scope
+                self._check_scope_completion(scope_key, writers)
             return
         # a terminated child-process root resumes its call activity's terminate
         parent_ei_key = value.get("parentElementInstanceKey", -1)

@@ -25,6 +25,7 @@ from zeebe_tpu.parallel.partitioning import (
     subscription_partition_id,
 )
 from zeebe_tpu.protocol import Record, RejectionType, ValueType, command
+from zeebe_tpu.protocol.enums import BpmnElementType
 from zeebe_tpu.protocol.intent import (
     JobIntent,
     MessageIntent,
@@ -72,6 +73,15 @@ class TimerProcessors:
             writers.append_command(
                 element_instance_key, ValueType.PROCESS_INSTANCE,
                 ProcessInstanceIntent.COMPLETE_ELEMENT, {},
+            )
+            return
+        if element.element_type == BpmnElementType.EVENT_BASED_GATEWAY:
+            # first event wins: complete the gateway toward the fired target
+            # (reference: EventBasedGatewayProcessor.onComplete)
+            writers.append_command(
+                element_instance_key, ValueType.PROCESS_INSTANCE,
+                ProcessInstanceIntent.COMPLETE_ELEMENT,
+                {"triggeredElementId": target_element_id},
             )
             return
         # boundary timer on an activity
@@ -345,11 +355,20 @@ class ProcessMessageSubscriptionProcessors:
             )
 
         target_element_id = sub.get("targetElementId", pi_value["elementId"])
+        host_exe = self.state.processes.executable(pi_value["processDefinitionKey"])
+        host_element = host_exe.element(pi_value["elementId"])
         if target_element_id == pi_value["elementId"]:
             # catch event / receive task: complete the waiting element
             writers.append_command(
                 element_key, ValueType.PROCESS_INSTANCE,
                 ProcessInstanceIntent.COMPLETE_ELEMENT, {},
+            )
+        elif host_element.element_type == BpmnElementType.EVENT_BASED_GATEWAY:
+            # first event wins: complete the gateway toward the fired target
+            writers.append_command(
+                element_key, ValueType.PROCESS_INSTANCE,
+                ProcessInstanceIntent.COMPLETE_ELEMENT,
+                {"triggeredElementId": target_element_id},
             )
         else:
             # boundary message event: activate the boundary; interrupting

@@ -307,22 +307,50 @@ def _validate(
             errors.append(f"{where}: end event cannot have outgoing flows")
         if et in (BpmnElementType.SERVICE_TASK, BpmnElementType.SEND_TASK) and exe.job_type is None:
             errors.append(f"{where}: missing zeebe:taskDefinition job type")
-        if et == BpmnElementType.EXCLUSIVE_GATEWAY and len(exe.outgoing) > 1:
+        if (
+            et in (BpmnElementType.EXCLUSIVE_GATEWAY, BpmnElementType.INCLUSIVE_GATEWAY)
+            and len(exe.outgoing) > 1
+        ):
             for fidx in exe.outgoing:
                 f = flows[fidx]
                 if f.condition is None and fidx != exe.default_flow_idx:
                     errors.append(
                         f"{where}: outgoing flow {f.id!r} needs a condition (or default)"
                     )
+        if et == BpmnElementType.INCLUSIVE_GATEWAY and exe.incoming_count > 1:
+            # fork-only in the reference version (bpmn-model/…/validation/zeebe/
+            # InclusiveGatewayValidator.java:41-45)
+            errors.append(
+                f"{where}: currently the inclusive gateway can only have one incoming sequence flow"
+            )
         if et == BpmnElementType.EVENT_BASED_GATEWAY:
+            # reference: bpmn-model/…/validation/zeebe/EventBasedGatewayValidator.java:55-65
+            if len(exe.outgoing) < 2:
+                errors.append(
+                    f"{where}: event-based gateway must have at least 2 outgoing sequence flows"
+                )
             for fidx in exe.outgoing:
                 target = elements[flows[fidx].target_idx]
-                if target.element_type not in (
-                    BpmnElementType.INTERMEDIATE_CATCH_EVENT,
-                    BpmnElementType.RECEIVE_TASK,
+                if target.element_type != BpmnElementType.INTERMEDIATE_CATCH_EVENT or (
+                    target.event_type
+                    not in (BpmnEventType.TIMER, BpmnEventType.MESSAGE, BpmnEventType.SIGNAL)
                 ):
                     errors.append(
-                        f"{where}: event-based gateway must target catch events"
+                        f"{where}: event-based gateway must not have an outgoing sequence flow "
+                        "to other elements than message/timer/signal intermediate catch events"
+                    )
+                elif any(
+                    elements[f.source_idx].element_type != BpmnElementType.EVENT_BASED_GATEWAY
+                    for f in flows
+                    if f.target_idx == target.idx
+                ):
+                    # a triggered catch event activates without its sequence
+                    # flow being taken; mixing in normal incoming flows would
+                    # make token accounting ambiguous (the engine's applier
+                    # derives the no-token-consumed rule from this shape)
+                    errors.append(
+                        f"{where}: catch event {target.id!r} after an event-based gateway "
+                        "must not have other incoming sequence flows"
                     )
         if (
             exe.message_name is not None

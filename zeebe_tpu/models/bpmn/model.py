@@ -186,6 +186,18 @@ class ProcessBuilder:
             ProcessElement(element_id or self._auto_id("end"), BpmnElementType.END_EVENT, name)
         )
 
+    def end_event_terminate(self, element_id: str | None = None) -> "ProcessBuilder":
+        """Terminate end event: completes, then terminates every other active
+        element instance in its flow scope (reference: EndEventProcessor
+        TerminateEndEventBehavior)."""
+        return self._add_element(
+            ProcessElement(
+                element_id or self._auto_id("end"),
+                BpmnElementType.END_EVENT,
+                event_type=BpmnEventType.TERMINATE,
+            )
+        )
+
     def intermediate_catch_timer(self, element_id: str, duration: str) -> "ProcessBuilder":
         el = ProcessElement(
             element_id, BpmnElementType.INTERMEDIATE_CATCH_EVENT, event_type=BpmnEventType.TIMER
