@@ -65,6 +65,7 @@ class EventAppliers:
         reg[(ValueType.JOB, int(JobIntent.RETRIES_UPDATED))] = self._job_retries_updated
         reg[(ValueType.JOB, int(JobIntent.CANCELED))] = self._job_canceled
         reg[(ValueType.JOB, int(JobIntent.RECURRED_AFTER_BACKOFF))] = self._job_recurred
+        reg[(ValueType.JOB, int(JobIntent.ERROR_THROWN))] = self._job_error_thrown
         reg[(ValueType.JOB_BATCH, int(JobBatchIntent.ACTIVATED))] = self._job_batch_activated
         reg[(ValueType.VARIABLE, int(VariableIntent.CREATED))] = self._variable_set
         reg[(ValueType.VARIABLE, int(VariableIntent.UPDATED))] = self._variable_set
@@ -95,6 +96,17 @@ class EventAppliers:
         reg[(ValueType.MESSAGE_START_EVENT_SUBSCRIPTION, int(MessageStartEventSubscriptionIntent.CREATED))] = self._msg_start_created
         reg[(ValueType.MESSAGE_START_EVENT_SUBSCRIPTION, int(MessageStartEventSubscriptionIntent.CORRELATED))] = self._noop
         reg[(ValueType.MESSAGE_START_EVENT_SUBSCRIPTION, int(MessageStartEventSubscriptionIntent.DELETED))] = self._msg_start_deleted
+        from zeebe_tpu.protocol.intent import (
+            EscalationIntent,
+            SignalIntent,
+            SignalSubscriptionIntent,
+        )
+
+        reg[(ValueType.SIGNAL, int(SignalIntent.BROADCASTED))] = self._noop
+        reg[(ValueType.SIGNAL_SUBSCRIPTION, int(SignalSubscriptionIntent.CREATED))] = self._signal_sub_created
+        reg[(ValueType.SIGNAL_SUBSCRIPTION, int(SignalSubscriptionIntent.DELETED))] = self._signal_sub_deleted
+        reg[(ValueType.ESCALATION, int(EscalationIntent.ESCALATED))] = self._noop
+        reg[(ValueType.ESCALATION, int(EscalationIntent.NOT_ESCALATED))] = self._noop
 
     def can_apply(self, record: Record) -> bool:
         return (record.value_type, int(record.intent)) in self._appliers
@@ -333,3 +345,20 @@ class EventAppliers:
         self.state.message_start_subscriptions.remove_for_process(
             record.value["processDefinitionKey"]
         )
+
+    # signals
+
+    def _signal_sub_key(self, v: dict) -> int:
+        element_key = v.get("catchEventInstanceKey", -1)
+        return element_key if element_key >= 0 else v.get("processDefinitionKey", -1)
+
+    def _signal_sub_created(self, record: Record) -> None:
+        v = record.value
+        self.state.signal_subscriptions.put(v["signalName"], self._signal_sub_key(v), v)
+
+    def _signal_sub_deleted(self, record: Record) -> None:
+        v = record.value
+        self.state.signal_subscriptions.remove(v["signalName"], self._signal_sub_key(v))
+
+    def _job_error_thrown(self, record: Record) -> None:
+        self.state.jobs.error_thrown(record.key)

@@ -43,10 +43,13 @@ from zeebe_tpu.models.bpmn import ExecutableElement, ExecutableProcess
 from zeebe_tpu.protocol import RejectionType, ValueType
 from zeebe_tpu.protocol.enums import BpmnElementType, BpmnEventType, ErrorType
 from zeebe_tpu.protocol.intent import (
+    EscalationIntent,
     IncidentIntent,
     JobIntent,
     ProcessInstanceIntent,
     ProcessInstanceResultIntent,
+    SignalIntent,
+    SignalSubscriptionIntent,
     TimerIntent,
     VariableIntent,
 )
@@ -176,13 +179,21 @@ class BpmnProcessor:
             self._open_boundary_subscriptions(key, value, exe, element, writers)
 
         et = element.element_type
-        if et == BpmnElementType.PROCESS or et == BpmnElementType.SUB_PROCESS:
+        if et in (BpmnElementType.PROCESS, BpmnElementType.SUB_PROCESS,
+                  BpmnElementType.EVENT_SUB_PROCESS):
+            # event sub-process start subscriptions open on the scope instance;
+            # pre-validated so a failure leaves the scope ACTIVATING (retryable).
+            # No `retrying` guard: any earlier failure happened before a single
+            # subscription event was written (pre-validation is all-or-nothing
+            # and ACTIVATED follows immediately), so a retry must re-open
+            if not self._open_scope_event_subscriptions(key, value, exe, element, writers):
+                return
             writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
-            if et == BpmnElementType.SUB_PROCESS:
-                start_idx = element.child_start_idx
-            else:
-                # message/timer start events carry an explicit start element
+            if et == BpmnElementType.PROCESS:
+                # message/timer/signal start events carry an explicit start element
                 start_idx = exe.by_id[start_override] if start_override else exe.none_start_of(0)
+            else:
+                start_idx = element.child_start_idx
             start = exe.elements[start_idx]
             self._write_activate(writers, exe, start, scope_key=key, value=value)
         elif et == BpmnElementType.START_EVENT:
@@ -243,7 +254,9 @@ class BpmnProcessor:
             elif element.message_name is not None:
                 if not self._open_message_subscription(key, value, element, element, writers):
                     return
-            # wait state: timer trigger / message correlation completes it
+            elif element.signal_name is not None:
+                self._open_signal_subscription(key, value, element, writers)
+            # wait state: timer trigger / message correlation / signal completes it
         elif et == BpmnElementType.EVENT_BASED_GATEWAY:
             # subscribe to every succeeding catch event on the gateway's own
             # element instance; first trigger wins (reference:
@@ -274,10 +287,42 @@ class BpmnProcessor:
                     self._create_timer(key, value, target, element, writers)
                 elif target.message_name is not None:
                     self._open_message_subscription(key, value, target, element, writers)
+                elif target.signal_name is not None:
+                    self._open_signal_subscription(key, value, target, writers)
             writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
             # wait state: the first triggered event completes the gateway
         elif et == BpmnElementType.CALL_ACTIVITY:
             self._activate_call_activity(key, value, exe, element, writers)
+        elif et == BpmnElementType.END_EVENT and element.event_type == BpmnEventType.ERROR:
+            # find the catcher BEFORE activating: an unhandled error leaves the
+            # end event ACTIVATING with a retryable incident (reference:
+            # EndEventProcessor ErrorEndEventBehavior)
+            catcher = self._find_catcher(key, BpmnEventType.ERROR, element.error_code)
+            if catcher is None:
+                self._raise_incident(
+                    writers, key, value, ErrorType.UNHANDLED_ERROR_EVENT,
+                    f"Expected to throw an error event with the code "
+                    f"'{element.error_code}', but it was not caught. No error events "
+                    "are available in the scope.",
+                )
+                return
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
+            self._execute_catch(catcher, writers)
+            # the end event never completes: the interruption terminates it
+        elif et in (BpmnElementType.END_EVENT, BpmnElementType.INTERMEDIATE_THROW_EVENT) \
+                and element.event_type == BpmnEventType.ESCALATION:
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
+            if self._throw_escalation(key, value, element, writers):
+                self._complete(key, value, exe, element, writers)
+            # else: an interrupting catcher will terminate this throw event
+        elif et in (BpmnElementType.END_EVENT, BpmnElementType.INTERMEDIATE_THROW_EVENT) \
+                and element.event_type == BpmnEventType.SIGNAL:
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
+            writers.append_command(
+                self.state.next_key(), ValueType.SIGNAL, SignalIntent.BROADCAST,
+                {"signalName": element.signal_name, "variables": {}},
+            )
+            self._complete(key, value, exe, element, writers)
         elif et in (BpmnElementType.MANUAL_TASK, BpmnElementType.TASK,
                     BpmnElementType.EXCLUSIVE_GATEWAY, BpmnElementType.PARALLEL_GATEWAY,
                     BpmnElementType.END_EVENT, BpmnElementType.INTERMEDIATE_THROW_EVENT):
@@ -480,9 +525,18 @@ class BpmnProcessor:
     def _create_timer(self, host_key: int, value: dict, catching: ExecutableElement,
                       host: ExecutableElement, writers: Writers,
                       repetitions: int = 1, interval: int = -1) -> None:
-        context = self.state.variables.collect(host_key)
         try:
-            duration = self._eval_duration_millis(catching.timer_duration, context)
+            if catching.timer_duration is not None:
+                context = self.state.variables.collect(host_key)
+                duration = self._eval_duration_millis(catching.timer_duration, context)
+            elif catching.timer_cycle:
+                # R<n>/<duration> cycle (non-interrupting repeating events)
+                from zeebe_tpu.utils import parse_cycle
+
+                repetitions, duration = parse_cycle(catching.timer_cycle)
+                interval = duration
+            else:
+                raise ValueError(f"timer '{catching.id}' has no duration or cycle")
         except Exception as exc:  # noqa: BLE001 — bad timer → incident
             self._raise_incident(writers, host_key, value, ErrorType.EXTRACT_VALUE_ERROR, str(exc))
             return
@@ -561,6 +615,68 @@ class BpmnProcessor:
                 self._create_timer(host_key, value, boundary, host, writers, repetitions=reps)
             elif boundary.event_type == BpmnEventType.MESSAGE and boundary.message_name:
                 self._open_message_subscription(host_key, value, boundary, host, writers)
+            elif boundary.event_type == BpmnEventType.SIGNAL and boundary.signal_name:
+                self._open_signal_subscription(host_key, value, boundary, writers)
+            # error/escalation boundaries need no subscription: the throw walk
+            # finds them via the model (reference: CatchEventAnalyzer)
+
+    def _open_scope_event_subscriptions(self, key: int, value: dict,
+                                        exe: ExecutableProcess, element: ExecutableElement,
+                                        writers: Writers) -> bool:
+        """Open timer/message/signal subscriptions for the scope's event
+        sub-processes (reference: BpmnEventSubscriptionBehavior
+        subscribeToEvents for ExecutableFlowElementContainer). Expressions are
+        pre-validated; on failure an incident is raised and the scope stays
+        ACTIVATING."""
+        esps = exe.event_sub_processes_of(element.idx)
+        if not esps:
+            return True
+        # the scope instance's own context — the same one the subscription
+        # open evaluates in (input mappings have already written to `key`)
+        context = self.state.variables.collect(key)
+        for esp in esps:
+            start = exe.elements[esp.child_start_idx]
+            try:
+                if start.event_type == BpmnEventType.TIMER and start.timer_duration is not None:
+                    self._eval_duration_millis(start.timer_duration, context)
+                elif start.event_type == BpmnEventType.MESSAGE:
+                    ck = start.correlation_key.evaluate(context, self.clock_millis)
+                    if ck is None:
+                        raise FeelEvalError(
+                            f"correlation key of '{start.id}' evaluated to null"
+                        )
+            except (FeelEvalError, TypeError, ValueError) as exc:
+                self._raise_incident(writers, key, value, ErrorType.EXTRACT_VALUE_ERROR, str(exc))
+                return False
+        for esp in esps:
+            start = exe.elements[esp.child_start_idx]
+            if start.event_type == BpmnEventType.TIMER and (
+                start.timer_duration is not None or start.timer_cycle
+            ):
+                reps = 1 if start.interrupting else -1
+                self._create_timer(key, value, start, element, writers, repetitions=reps)
+            elif start.event_type == BpmnEventType.MESSAGE and start.message_name:
+                if not self._open_message_subscription(key, value, start, element, writers):
+                    return False  # defensive: pre-validation should have caught it
+            elif start.event_type == BpmnEventType.SIGNAL and start.signal_name:
+                self._open_signal_subscription(key, value, start, writers)
+        return True
+
+    def _open_signal_subscription(self, host_key: int, value: dict,
+                                  catching: ExecutableElement, writers: Writers) -> None:
+        writers.append_event(
+            self.state.next_key(), ValueType.SIGNAL_SUBSCRIPTION,
+            SignalSubscriptionIntent.CREATED,
+            {
+                "signalName": catching.signal_name,
+                "catchEventId": catching.id,
+                "catchEventInstanceKey": host_key,
+                "processDefinitionKey": value.get("processDefinitionKey", -1),
+                "bpmnProcessId": value.get("bpmnProcessId", ""),
+                "processInstanceKey": value.get("processInstanceKey", -1),
+                "interrupting": catching.interrupting,
+            },
+        )
 
     def _close_subscriptions(self, key: int, value: dict, writers: Writers) -> None:
         """Cancel timers + message subscriptions attached to an element
@@ -575,6 +691,10 @@ class BpmnProcessor:
 
         for timer_key, timer in self.state.timers.timers_for_element_instance(key):
             writers.append_event(timer_key, ValueType.TIMER, TimerIntent.CANCELED, timer)
+        for sub in self.state.signal_subscriptions.subscriptions_of(key):
+            writers.append_event(
+                key, ValueType.SIGNAL_SUBSCRIPTION, SignalSubscriptionIntent.DELETED, sub
+            )
         for sub in self.state.process_message_subscriptions.subscriptions_of(key):
             writers.append_event(
                 key, ValueType.PROCESS_MESSAGE_SUBSCRIPTION,
@@ -593,6 +713,186 @@ class BpmnProcessor:
                 writers.after_commit(
                     lambda mp=message_partition, dc=delete_cmd: sender.send_command(mp, dc)
                 )
+
+    # ------------------------------------------------- event throwing/catching
+
+    def _find_catcher(self, from_key: int, event_type: BpmnEventType, code: str | None):
+        """Walk the scope hierarchy outward from the throwing element, crossing
+        call-activity boundaries, to the closest matching catcher (reference:
+        processing/common/CatchEventAnalyzer). Within one level an exact code
+        match beats a catch-all (no code). Returns
+        (kind, exe, catch_element, host_instance_key, host_value) or None —
+        kind is "boundary" or "esp"."""
+        ei = self.state.element_instances
+        instance_key = from_key
+        while instance_key >= 0:
+            instance = ei.get(instance_key)
+            if instance is None:
+                return None
+            ivalue = instance["value"]
+            exe = self.state.processes.executable(ivalue["processDefinitionKey"])
+            element = exe.element(ivalue["elementId"])
+
+            def code_of(el):
+                return el.error_code if event_type == BpmnEventType.ERROR else el.escalation_code
+
+            def pick(candidates):
+                exact = [c for c in candidates if code_of(c[-1]) == code]
+                return exact[0] if exact else (candidates[0] if candidates else None)
+
+            if element.element_type in (
+                BpmnElementType.PROCESS, BpmnElementType.SUB_PROCESS,
+                BpmnElementType.EVENT_SUB_PROCESS,
+            ):
+                esp_candidates = []
+                for esp in exe.event_sub_processes_of(element.idx):
+                    start = exe.elements[esp.child_start_idx]
+                    if start.event_type == event_type and (
+                        code_of(start) is None or code_of(start) == code
+                    ):
+                        esp_candidates.append((esp, start))
+                chosen = pick([(e, s) for e, s in esp_candidates])
+                if chosen:
+                    return ("esp", exe, chosen[0], instance_key, ivalue)
+            # boundary events on this element — but not for a multi-instance
+            # inner instance (boundaries attach to the body, which is the next
+            # level out)
+            is_mi_inner = (
+                element.multi_instance is not None
+                and ivalue.get("bpmnElementType") != BpmnElementType.MULTI_INSTANCE_BODY.name
+            )
+            if not is_mi_inner:
+                boundary_candidates = [
+                    (exe.elements[bidx],)
+                    for bidx in element.boundary_idxs
+                    if exe.elements[bidx].event_type == event_type
+                    and (
+                        code_of(exe.elements[bidx]) is None
+                        or code_of(exe.elements[bidx]) == code
+                    )
+                ]
+                chosen = pick(boundary_candidates)
+                if chosen:
+                    return ("boundary", exe, chosen[0], instance_key, ivalue)
+            fsk = ivalue.get("flowScopeKey", -1)
+            instance_key = fsk if fsk >= 0 else ivalue.get("parentElementInstanceKey", -1)
+        return None
+
+    def _execute_catch(self, catcher, writers: Writers) -> None:
+        """Activate the catcher and apply its interruption semantics."""
+        kind, exe, catch_element, host_key, host_value = catcher
+        if kind == "boundary":
+            boundary_value = {
+                "bpmnProcessId": host_value["bpmnProcessId"],
+                "version": host_value["version"],
+                "processDefinitionKey": host_value["processDefinitionKey"],
+                "processInstanceKey": host_value["processInstanceKey"],
+                "elementId": catch_element.id,
+                "flowScopeKey": host_value.get("flowScopeKey", -1),
+                "bpmnElementType": BpmnElementType.BOUNDARY_EVENT.name,
+                "bpmnEventType": catch_element.event_type.name,
+            }
+            writers.append_command(
+                self.state.next_key(), ValueType.PROCESS_INSTANCE,
+                PI.ACTIVATE_ELEMENT, boundary_value,
+            )
+            if catch_element.interrupting:
+                writers.append_command(
+                    host_key, ValueType.PROCESS_INSTANCE, PI.TERMINATE_ELEMENT, {}
+                )
+        else:  # event sub-process inside scope host_key
+            start = exe.elements[catch_element.child_start_idx]
+            esp_value = {
+                "bpmnProcessId": host_value["bpmnProcessId"],
+                "version": host_value["version"],
+                "processDefinitionKey": host_value["processDefinitionKey"],
+                "processInstanceKey": host_value["processInstanceKey"],
+                "elementId": catch_element.id,
+                "flowScopeKey": host_key,
+                "bpmnElementType": BpmnElementType.EVENT_SUB_PROCESS.name,
+                "bpmnEventType": start.event_type.name,
+            }
+            writers.append_command(
+                self.state.next_key(), ValueType.PROCESS_INSTANCE,
+                PI.ACTIVATE_ELEMENT, esp_value,
+            )
+            if catch_element.interrupting:
+                # the interrupted scope accepts no further event triggers and
+                # every sibling of the event sub-process terminates
+                self._close_subscriptions(host_key, host_value, writers)
+                for child_key in self.state.element_instances.children_keys(host_key):
+                    writers.append_command(
+                        child_key, ValueType.PROCESS_INSTANCE, PI.TERMINATE_ELEMENT, {}
+                    )
+
+    def throw_error_from(self, element_key: int, error_code: str, writers: Writers) -> bool:
+        """Route a thrown BPMN error (job THROW_ERROR or error end event) to
+        the closest catcher. Returns False when unhandled."""
+        catcher = self._find_catcher(element_key, BpmnEventType.ERROR, error_code)
+        if catcher is None:
+            return False
+        self._execute_catch(catcher, writers)
+        return True
+
+    def _throw_escalation(self, key: int, value: dict, element: ExecutableElement,
+                          writers: Writers) -> bool:
+        """Throw an escalation; returns True when the throwing element can
+        complete (uncaught, or caught non-interrupting — reference:
+        BpmnEventPublicationBehavior.throwEscalationEvent)."""
+        code = element.escalation_code
+        catcher = self._find_catcher(key, BpmnEventType.ESCALATION, code)
+        esc_value = {
+            "escalationCode": code or "",
+            "throwElementId": element.id,
+            "catchElementId": catcher[2].id if catcher else "",
+            "processInstanceKey": value.get("processInstanceKey", -1),
+            "processDefinitionKey": value.get("processDefinitionKey", -1),
+            "bpmnProcessId": value.get("bpmnProcessId", ""),
+        }
+        writers.append_event(
+            self.state.next_key(), ValueType.ESCALATION,
+            EscalationIntent.ESCALATED if catcher else EscalationIntent.NOT_ESCALATED,
+            esc_value,
+        )
+        if catcher is None:
+            return True  # uncaught escalations are not errors; continue
+        self._execute_catch(catcher, writers)
+        return not catcher[2].interrupting
+
+    def route_trigger(self, host_key: int, target_element_id: str, writers: Writers) -> bool:
+        """Route a fired event subscription (timer, message, signal) hosted on
+        ``host_key`` toward its target: the waiting catch element itself, an
+        event-based gateway, a boundary event, or an event sub-process start.
+        Returns False when the host instance is gone."""
+        instance = self.state.element_instances.get(host_key)
+        if instance is None:
+            return False
+        pi_value = instance["value"]
+        exe = self.state.processes.executable(pi_value["processDefinitionKey"])
+        host_element = exe.element(pi_value["elementId"])
+        if target_element_id == pi_value["elementId"]:
+            writers.append_command(
+                host_key, ValueType.PROCESS_INSTANCE, PI.COMPLETE_ELEMENT, {}
+            )
+            return True
+        if host_element.element_type == BpmnElementType.EVENT_BASED_GATEWAY:
+            writers.append_command(
+                host_key, ValueType.PROCESS_INSTANCE, PI.COMPLETE_ELEMENT,
+                {"triggeredElementId": target_element_id},
+            )
+            return True
+        target = exe.element(target_element_id)
+        if (
+            target.element_type == BpmnElementType.START_EVENT
+            and target.parent_idx >= 0
+            and exe.elements[target.parent_idx].element_type == BpmnElementType.EVENT_SUB_PROCESS
+        ):
+            esp = exe.elements[target.parent_idx]
+            self._execute_catch(("esp", exe, esp, host_key, pi_value), writers)
+            return True
+        # boundary event on the host activity
+        self._execute_catch(("boundary", exe, target, host_key, pi_value), writers)
+        return True
 
     # -------------------------------------------------------------- completion
 

@@ -36,6 +36,7 @@ from zeebe_tpu.protocol.intent import (
     ProcessInstanceCreationIntent,
     ProcessInstanceIntent,
     ProcessMessageSubscriptionIntent,
+    SignalIntent,
     TimerIntent,
     VariableDocumentIntent,
 )
@@ -73,19 +74,23 @@ class Engine(RecordProcessor):
             TimerProcessors,
         )
 
+        from zeebe_tpu.engine.signal import SignalProcessors
+
         bpmn = BpmnProcessor(self.state, clock, sender=self.sender,
                              partition_count=partition_count)
         deployment = DeploymentProcessor(self.state, clock)
         creation = ProcessInstanceCreationProcessor(self.state, bpmn)
         cancel = ProcessInstanceCancelProcessor(self.state)
-        jobs = JobProcessors(self.state, clock)
+        jobs = JobProcessors(self.state, clock, bpmn)
         job_batch = JobBatchProcessor(self.state, clock)
-        incidents = IncidentResolveProcessor(self.state)
+        incidents = IncidentResolveProcessor(self.state, bpmn)
         variables = VariableDocumentProcessor(self.state)
         timers = TimerProcessors(self.state, clock, bpmn)
         messages = MessageProcessors(self.state, clock, partition_count, self.sender)
         msg_subs = MessageSubscriptionProcessors(self.state, self.sender)
-        pms = ProcessMessageSubscriptionProcessors(self.state, self.sender, partition_count)
+        pms = ProcessMessageSubscriptionProcessors(self.state, self.sender, partition_count,
+                                                   bpmn=bpmn)
+        signals = SignalProcessors(self.state, bpmn)
         self.bpmn = bpmn
 
         # the RecordProcessorMap: (ValueType, command intent) → handler
@@ -112,6 +117,7 @@ class Engine(RecordProcessor):
             (ValueType.MESSAGE_SUBSCRIPTION, int(MessageSubscriptionIntent.CORRELATE)): msg_subs.correlate_ack,
             (ValueType.MESSAGE_SUBSCRIPTION, int(MessageSubscriptionIntent.DELETE)): msg_subs.delete,
             (ValueType.PROCESS_MESSAGE_SUBSCRIPTION, int(ProcessMessageSubscriptionIntent.CORRELATE)): pms.correlate,
+            (ValueType.SIGNAL, int(SignalIntent.BROADCAST)): signals.broadcast,
         }
         self.state.load_key_generator()
 

@@ -302,6 +302,11 @@ class JobState:
         job["retries"] = retries
         self._jobs.put((key,), job)
 
+    def error_thrown(self, key: int) -> None:
+        """The job is consumed by a thrown BPMN error (reference:
+        JobErrorThrownApplier removes it from activatable/deadline sets)."""
+        self._remove(key)
+
     def make_activatable(self, key: int) -> None:
         """After retries updated on a no-retries-failed job + incident resolve."""
         job = self._jobs.get((key,))
@@ -639,6 +644,44 @@ class MessageStartEventSubscriptionState:
         return list(self._by_name.values((message_name,)))
 
 
+class SignalSubscriptionState:
+    """Signal subscriptions (reference: state/signal/DbSignalSubscriptionState):
+    keyed (signalName, subscriptionKey) where the subscription key is the
+    process definition key for start-event subscriptions and the element
+    instance key for catch-event/boundary/event-sub-process subscriptions."""
+
+    def __init__(self, db: ZbDb) -> None:
+        self._by_name = db.column_family(CF.SIGNAL_SUBSCRIPTION_BY_NAME_AND_KEY)
+        self._by_key = db.column_family(CF.SIGNAL_SUBSCRIPTION_BY_KEY_AND_NAME)
+
+    def put(self, signal_name: str, subscription_key: int, record_value: dict) -> None:
+        self._by_name.put((signal_name, subscription_key), dict(record_value))
+        self._by_key.put((subscription_key, signal_name), None)
+
+    def remove(self, signal_name: str, subscription_key: int) -> None:
+        if self._by_name.exists((signal_name, subscription_key)):
+            self._by_name.delete((signal_name, subscription_key))
+        if self._by_key.exists((subscription_key, signal_name)):
+            self._by_key.delete((subscription_key, signal_name))
+
+    def find(self, signal_name: str) -> list[dict]:
+        return list(self._by_name.values((signal_name,)))
+
+    def names_of(self, subscription_key: int) -> list[str]:
+        out = []
+        for enc_key, _ in self._by_key.items((subscription_key,)):
+            # key layout: u16 cf | 0x01 i64(key) | 0x01 utf8(name) | 0x00
+            out.append(enc_key[2 + 9 + 1 : -1].decode("utf-8"))
+        return out
+
+    def subscriptions_of(self, subscription_key: int) -> list[dict]:
+        return [
+            sub
+            for name in self.names_of(subscription_key)
+            if (sub := self._by_name.get((name, subscription_key))) is not None
+        ]
+
+
 class IncidentState:
     def __init__(self, db: ZbDb) -> None:
         self._incidents = db.column_family(CF.INCIDENTS)
@@ -705,6 +748,7 @@ class EngineState:
         self.message_subscriptions = MessageSubscriptionState(db)
         self.process_message_subscriptions = ProcessMessageSubscriptionState(db)
         self.message_start_subscriptions = MessageStartEventSubscriptionState(db)
+        self.signal_subscriptions = SignalSubscriptionState(db)
         self._key_cf = db.column_family(CF.KEY)
         self.key_generator = KeyGenerator(partition_id)
         self._key_loaded = False

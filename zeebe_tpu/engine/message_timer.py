@@ -67,60 +67,25 @@ class TimerProcessors:
             return  # element already gone; TRIGGERED still recorded
         pi_value = instance["value"]
         exe = self.state.processes.executable(pi_value["processDefinitionKey"])
-        element = exe.element(pi_value["elementId"])
-        if element.id == target_element_id:
-            # intermediate catch event fired: complete it
-            writers.append_command(
-                element_instance_key, ValueType.PROCESS_INSTANCE,
-                ProcessInstanceIntent.COMPLETE_ELEMENT, {},
-            )
-            return
-        if element.element_type == BpmnElementType.EVENT_BASED_GATEWAY:
-            # first event wins: complete the gateway toward the fired target
-            # (reference: EventBasedGatewayProcessor.onComplete)
-            writers.append_command(
-                element_instance_key, ValueType.PROCESS_INSTANCE,
-                ProcessInstanceIntent.COMPLETE_ELEMENT,
-                {"triggeredElementId": target_element_id},
-            )
-            return
-        # boundary timer on an activity
-        boundary = exe.element(target_element_id)
-        scope_key = pi_value.get("flowScopeKey", -1)
-        boundary_value = {
-            "bpmnProcessId": pi_value["bpmnProcessId"],
-            "version": pi_value["version"],
-            "processDefinitionKey": pi_value["processDefinitionKey"],
-            "processInstanceKey": pi_value["processInstanceKey"],
-            "elementId": boundary.id,
-            "flowScopeKey": scope_key,
-            "bpmnElementType": boundary.element_type.name,
-            "bpmnEventType": boundary.event_type.name,
-        }
-        new_key = self.state.next_key()
-        writers.append_command(
-            new_key, ValueType.PROCESS_INSTANCE,
-            ProcessInstanceIntent.ACTIVATE_ELEMENT, boundary_value,
-        )
-        if boundary.interrupting:
-            writers.append_command(
-                element_instance_key, ValueType.PROCESS_INSTANCE,
-                ProcessInstanceIntent.TERMINATE_ELEMENT, {},
-            )
-        else:
+        target = exe.element(target_element_id)
+        # routes to: the waiting catch event itself, an event-based gateway,
+        # a boundary event, or an event sub-process start
+        self.bpmn.route_trigger(element_instance_key, target_element_id, writers)
+        # repeating timers (non-interrupting boundary / event sub-process
+        # start with an R-cycle) reschedule themselves
+        if target_element_id != pi_value["elementId"] and not target.interrupting:
             reps = timer.get("repetitions", 1)
-            if reps == -1 or reps > 1:
-                interval = timer.get("interval", -1)
-                if interval > 0:
-                    timer_key = self.state.next_key()
-                    writers.append_event(
-                        timer_key, ValueType.TIMER, TimerIntent.CREATED,
-                        {
-                            **timer,
-                            "dueDate": self.clock_millis() + interval,
-                            "repetitions": reps - 1 if reps > 0 else -1,
-                        },
-                    )
+            interval = timer.get("interval", -1)
+            if (reps == -1 or reps > 1) and interval > 0:
+                timer_key = self.state.next_key()
+                writers.append_event(
+                    timer_key, ValueType.TIMER, TimerIntent.CREATED,
+                    {
+                        **timer,
+                        "dueDate": self.clock_millis() + interval,
+                        "repetitions": reps - 1 if reps > 0 else -1,
+                    },
+                )
 
     def _trigger_start_event(self, timer: dict, writers: Writers) -> None:
         meta = self.state.processes.get_by_key(timer["processDefinitionKey"])
@@ -312,10 +277,11 @@ class ProcessMessageSubscriptionProcessors:
     """Process-partition side: CORRELATE completes the waiting element."""
 
     def __init__(self, state: EngineState, sender: InterPartitionCommandSender,
-                 partition_count: int) -> None:
+                 partition_count: int, bpmn) -> None:
         self.state = state
         self.sender = sender
         self.partition_count = partition_count
+        self.bpmn = bpmn
 
     def correlate(self, cmd: LoggedRecord, writers: Writers) -> None:
         value = cmd.record.value
@@ -355,45 +321,9 @@ class ProcessMessageSubscriptionProcessors:
             )
 
         target_element_id = sub.get("targetElementId", pi_value["elementId"])
-        host_exe = self.state.processes.executable(pi_value["processDefinitionKey"])
-        host_element = host_exe.element(pi_value["elementId"])
-        if target_element_id == pi_value["elementId"]:
-            # catch event / receive task: complete the waiting element
-            writers.append_command(
-                element_key, ValueType.PROCESS_INSTANCE,
-                ProcessInstanceIntent.COMPLETE_ELEMENT, {},
-            )
-        elif host_element.element_type == BpmnElementType.EVENT_BASED_GATEWAY:
-            # first event wins: complete the gateway toward the fired target
-            writers.append_command(
-                element_key, ValueType.PROCESS_INSTANCE,
-                ProcessInstanceIntent.COMPLETE_ELEMENT,
-                {"triggeredElementId": target_element_id},
-            )
-        else:
-            # boundary message event: activate the boundary; interrupting
-            # boundaries terminate the host activity
-            exe = self.state.processes.executable(pi_value["processDefinitionKey"])
-            boundary = exe.element(target_element_id)
-            boundary_value = {
-                "bpmnProcessId": pi_value["bpmnProcessId"],
-                "version": pi_value["version"],
-                "processDefinitionKey": pi_value["processDefinitionKey"],
-                "processInstanceKey": pi_value["processInstanceKey"],
-                "elementId": boundary.id,
-                "flowScopeKey": pi_value.get("flowScopeKey", -1),
-                "bpmnElementType": boundary.element_type.name,
-                "bpmnEventType": boundary.event_type.name,
-            }
-            writers.append_command(
-                self.state.next_key(), ValueType.PROCESS_INSTANCE,
-                ProcessInstanceIntent.ACTIVATE_ELEMENT, boundary_value,
-            )
-            if boundary.interrupting:
-                writers.append_command(
-                    element_key, ValueType.PROCESS_INSTANCE,
-                    ProcessInstanceIntent.TERMINATE_ELEMENT, {},
-                )
+        # routes to: the waiting catch element, an event-based gateway, a
+        # boundary event, or an event sub-process start
+        self.bpmn.route_trigger(element_key, target_element_id, writers)
 
         # ack to the message partition so the (single-use) subscription closes
         message_sub_key = value.get("messageSubscriptionKey", -1)

@@ -152,10 +152,29 @@ class DeploymentProcessor:
                 )
             for timer_key, timer in self.state.timers.start_timers_for_process(previous_key):
                 writers.append_event(timer_key, ValueType.TIMER, TimerIntent.CANCELED, timer)
+        from zeebe_tpu.protocol.intent import SignalSubscriptionIntent
+
+        if previous_key is not None:
+            self._close_signal_start_subscriptions(writers, previous_key, meta)
         for el in exe.elements[1:]:
-            if el.element_type != BpmnElementType.START_EVENT:
+            # only ROOT-scope start events start new instances; event
+            # sub-process starts subscribe at scope activation instead
+            if el.element_type != BpmnElementType.START_EVENT or el.parent_idx != 0:
                 continue
-            if el.event_type == BpmnEventType.MESSAGE and el.message_name:
+            if el.event_type == BpmnEventType.SIGNAL and el.signal_name:
+                writers.append_event(
+                    self.state.next_key(), ValueType.SIGNAL_SUBSCRIPTION,
+                    SignalSubscriptionIntent.CREATED,
+                    {
+                        "signalName": el.signal_name,
+                        "catchEventId": el.id,
+                        "catchEventInstanceKey": -1,
+                        "processDefinitionKey": meta["processDefinitionKey"],
+                        "bpmnProcessId": meta["bpmnProcessId"],
+                        "interrupting": True,
+                    },
+                )
+            elif el.event_type == BpmnEventType.MESSAGE and el.message_name:
                 writers.append_event(
                     self.state.next_key(), ValueType.MESSAGE_START_EVENT_SUBSCRIPTION,
                     MessageStartEventSubscriptionIntent.CREATED,
@@ -180,6 +199,17 @@ class DeploymentProcessor:
                         "repetitions": reps,
                         "interval": interval,
                     },
+                )
+
+
+    def _close_signal_start_subscriptions(self, writers, previous_key, meta):
+        from zeebe_tpu.protocol.intent import SignalSubscriptionIntent
+
+        for sub in self.state.signal_subscriptions.subscriptions_of(previous_key):
+            if sub.get("catchEventInstanceKey", -1) < 0:
+                writers.append_event(
+                    self.state.next_key(), ValueType.SIGNAL_SUBSCRIPTION,
+                    SignalSubscriptionIntent.DELETED, sub,
                 )
 
 
@@ -284,9 +314,10 @@ class ProcessInstanceCancelProcessor:
 class JobProcessors:
     """COMPLETE / FAIL / THROW_ERROR / TIME_OUT / UPDATE_RETRIES / CANCEL."""
 
-    def __init__(self, state: EngineState, clock_millis) -> None:
+    def __init__(self, state: EngineState, clock_millis, bpmn=None) -> None:
         self.state = state
         self.clock_millis = clock_millis
+        self.bpmn = bpmn
 
     def _precondition(self, cmd: LoggedRecord, writers: Writers, expect_activated: bool = True):
         """DefaultJobCommandPreconditionGuard: job exists and is in a valid state."""
@@ -411,11 +442,13 @@ class JobProcessors:
         writers.append_event(cmd.record.key, ValueType.JOB, JobIntent.TIMED_OUT, job)
 
     def throw_error(self, cmd: LoggedRecord, writers: Writers) -> None:
+        """Reference: processing/job/JobThrowErrorProcessor — the job is
+        consumed (ERROR_THROWN), then the error routes to the closest error
+        boundary/event sub-process; unhandled → UNHANDLED_ERROR_EVENT incident
+        whose resolution re-attempts the throw."""
         job = self._precondition(cmd, writers)
         if job is None:
             return
-        # error boundary routing is forthcoming; until then an unhandled error
-        # becomes an incident (reference: UNHANDLED_ERROR_EVENT)
         error_code = cmd.record.value.get("errorCode", "")
         thrown = writers.append_event(
             cmd.record.key, ValueType.JOB, JobIntent.ERROR_THROWN,
@@ -423,6 +456,9 @@ class JobProcessors:
              "errorMessage": cmd.record.value.get("errorMessage", "")},
         )
         writers.respond(cmd, thrown)
+        element_key = job.get("elementInstanceKey", -1)
+        if self.bpmn is not None and self.bpmn.throw_error_from(element_key, error_code, writers):
+            return
         incident_key = self.state.next_key()
         writers.append_event(
             incident_key, ValueType.INCIDENT, IncidentIntent.CREATED,
@@ -434,9 +470,10 @@ class JobProcessors:
                 "processDefinitionKey": job.get("processDefinitionKey", -1),
                 "processInstanceKey": job.get("processInstanceKey", -1),
                 "elementId": job.get("elementId", ""),
-                "elementInstanceKey": job.get("elementInstanceKey", -1),
+                "elementInstanceKey": element_key,
                 "jobKey": cmd.record.key,
-                "variableScopeKey": job.get("elementInstanceKey", -1),
+                "variableScopeKey": element_key,
+                "errorCode": error_code,
             },
         )
 
@@ -492,8 +529,9 @@ class JobBatchProcessor:
 class IncidentResolveProcessor:
     """INCIDENT RESOLVE: drop the incident and retry the stalled work."""
 
-    def __init__(self, state: EngineState) -> None:
+    def __init__(self, state: EngineState, bpmn=None) -> None:
         self.state = state
+        self.bpmn = bpmn
 
     def process(self, cmd: LoggedRecord, writers: Writers) -> None:
         key = cmd.record.key
@@ -507,6 +545,23 @@ class IncidentResolveProcessor:
         resolved = writers.append_event(key, ValueType.INCIDENT, IncidentIntent.RESOLVED, incident)
         writers.respond(cmd, resolved)
 
+        if (
+            incident.get("errorType") == ErrorType.UNHANDLED_ERROR_EVENT.name
+            and incident.get("jobKey", -1) >= 0
+            and self.bpmn is not None
+        ):
+            # re-attempt the job's error throw (a catcher may exist now, e.g.
+            # after process modification); still uncaught → fresh incident
+            element_key = incident.get("elementInstanceKey", -1)
+            error_code = incident.get("errorCode", "")
+            if not self.bpmn.throw_error_from(element_key, error_code, writers):
+                writers.append_event(
+                    self.state.next_key(), ValueType.INCIDENT, IncidentIntent.CREATED,
+                    {**incident,
+                     "errorMessage": f"An error was thrown with the code '{error_code}' "
+                                     "but not caught."},
+                )
+            return
         job_key = incident.get("jobKey", -1)
         if job_key >= 0:
             job = self.state.jobs.get(job_key)

@@ -130,3 +130,12 @@ class RecordingExporter:
 
     def message_records(self) -> RecordStream:
         return self.all().with_value_type(ValueType.MESSAGE)
+
+    def signal_records(self) -> RecordStream:
+        return self.all().with_value_type(ValueType.SIGNAL)
+
+    def signal_subscription_records(self) -> RecordStream:
+        return self.all().with_value_type(ValueType.SIGNAL_SUBSCRIPTION)
+
+    def escalation_records(self) -> RecordStream:
+        return self.all().with_value_type(ValueType.ESCALATION)

@@ -114,6 +114,13 @@ class ExecutableProcess:
     def none_start_of(self, scope_idx: int) -> int:
         return self.elements[scope_idx].child_start_idx
 
+    def event_sub_processes_of(self, scope_idx: int) -> list[ExecutableElement]:
+        return [
+            e
+            for e in self.elements
+            if e.element_type == BpmnElementType.EVENT_SUB_PROCESS and e.parent_idx == scope_idx
+        ]
+
 
 def _parse(source: str | None, errors: list[str], where: str) -> Expression | None:
     if source is None:
@@ -281,7 +288,7 @@ def _validate(
     has_msg_or_timer_start = any(
         e.element_type == BpmnElementType.START_EVENT
         and e.parent_idx == 0
-        and e.event_type in (BpmnEventType.TIMER, BpmnEventType.MESSAGE)
+        and e.event_type in (BpmnEventType.TIMER, BpmnEventType.MESSAGE, BpmnEventType.SIGNAL)
         for e in elements[1:]
     )
     if len(root_starts) == 0 and not has_msg_or_timer_start:
@@ -297,6 +304,46 @@ def _validate(
                 errors.append(f"sub-process {exe.id!r} needs exactly one none start event")
             else:
                 exe.child_start_idx = starts[0]
+        elif exe.element_type == BpmnElementType.EVENT_SUB_PROCESS:
+            # exactly one TYPED start event (reference: EventSubProcess
+            # validators — timer/message/error/signal/escalation starts)
+            starts = [
+                e.idx
+                for e in elements[1:]
+                if e.element_type == BpmnElementType.START_EVENT and e.parent_idx == exe.idx
+            ]
+            if len(starts) != 1:
+                errors.append(
+                    f"event sub-process {exe.id!r} needs exactly one start event"
+                )
+                continue
+            start = elements[starts[0]]
+            if start.event_type not in (
+                BpmnEventType.TIMER,
+                BpmnEventType.MESSAGE,
+                BpmnEventType.ERROR,
+                BpmnEventType.SIGNAL,
+                BpmnEventType.ESCALATION,
+            ):
+                errors.append(
+                    f"event sub-process {exe.id!r} start event must be typed "
+                    "(timer/message/error/signal/escalation)"
+                )
+            if start.event_type == BpmnEventType.ERROR and not start.interrupting:
+                errors.append(
+                    f"error event sub-process {exe.id!r} must be interrupting"
+                )
+            if start.event_type == BpmnEventType.MESSAGE and start.correlation_key is None:
+                errors.append(
+                    f"event sub-process {exe.id!r} message start needs a correlation key"
+                )
+            if exe.incoming_count > 0 or exe.outgoing:
+                errors.append(
+                    f"event sub-process {exe.id!r} must not have sequence flows"
+                )
+            exe.child_start_idx = starts[0]
+            exe.event_type = start.event_type
+            exe.interrupting = start.interrupting
 
     for exe in elements[1:]:
         where = f"element {exe.id!r}"
@@ -369,6 +416,10 @@ def _validate(
         # reachability-lite: non-start, non-boundary elements need an incoming flow
         if (
             exe.incoming_count == 0
-            and et not in (BpmnElementType.START_EVENT, BpmnElementType.BOUNDARY_EVENT)
+            and et not in (
+                BpmnElementType.START_EVENT,
+                BpmnElementType.BOUNDARY_EVENT,
+                BpmnElementType.EVENT_SUB_PROCESS,
+            )
         ):
             errors.append(f"{where}: unreachable (no incoming sequence flow)")

@@ -171,20 +171,65 @@ class ProcessBuilder:
             ProcessElement(element_id or self._auto_id("start"), BpmnElementType.START_EVENT, name)
         )
 
-    def timer_start_event(self, element_id: str, cycle: str | None = None, date: str | None = None) -> "ProcessBuilder":
-        el = ProcessElement(element_id, BpmnElementType.START_EVENT, event_type=BpmnEventType.TIMER)
-        el.timer = TimerDefinition(cycle=cycle, date=date)
+    def timer_start_event(
+        self, element_id: str, cycle: str | None = None, date: str | None = None,
+        duration: str | None = None, interrupting: bool = True,
+    ) -> "ProcessBuilder":
+        el = ProcessElement(
+            element_id, BpmnElementType.START_EVENT, event_type=BpmnEventType.TIMER,
+            interrupting=interrupting,
+        )
+        el.timer = TimerDefinition(cycle=cycle, date=date, duration=duration)
         return self._add_element(el)
 
-    def message_start_event(self, element_id: str, message_name: str) -> "ProcessBuilder":
-        el = ProcessElement(element_id, BpmnElementType.START_EVENT, event_type=BpmnEventType.MESSAGE)
-        el.message = MessageDefinition(name=message_name)
+    def message_start_event(
+        self, element_id: str, message_name: str, correlation_key: str | None = None,
+        interrupting: bool = True,
+    ) -> "ProcessBuilder":
+        """Process-level message start events have no correlation key; event
+        sub-process message starts require one (reference validators)."""
+        el = ProcessElement(
+            element_id, BpmnElementType.START_EVENT, event_type=BpmnEventType.MESSAGE,
+            interrupting=interrupting,
+        )
+        el.message = MessageDefinition(name=message_name, correlation_key=correlation_key)
         return self._add_element(el)
 
     def end_event(self, element_id: str | None = None, name: str = "") -> "ProcessBuilder":
         return self._add_element(
             ProcessElement(element_id or self._auto_id("end"), BpmnElementType.END_EVENT, name)
         )
+
+    def signal_start_event(self, element_id: str, signal_name: str) -> "ProcessBuilder":
+        el = ProcessElement(
+            element_id, BpmnElementType.START_EVENT, event_type=BpmnEventType.SIGNAL,
+            signal_name=signal_name,
+        )
+        return self._add_element(el)
+
+    def error_start_event(self, element_id: str, error_code: str | None = None) -> "ProcessBuilder":
+        """Typed start event for an error event sub-process (always interrupting)."""
+        el = ProcessElement(
+            element_id, BpmnElementType.START_EVENT, event_type=BpmnEventType.ERROR,
+            error_code=error_code,
+        )
+        return self._add_element(el)
+
+    def escalation_start_event(
+        self, element_id: str, escalation_code: str | None = None, interrupting: bool = True
+    ) -> "ProcessBuilder":
+        el = ProcessElement(
+            element_id, BpmnElementType.START_EVENT, event_type=BpmnEventType.ESCALATION,
+            escalation_code=escalation_code, interrupting=interrupting,
+        )
+        return self._add_element(el)
+
+    def interrupting(self, flag: bool) -> "ProcessBuilder":
+        """Set the interrupting flag of the element at the cursor (event
+        sub-process start events, boundary events)."""
+        el_id = self._require_cursor()
+        self.model.elements[el_id].interrupting = flag
+        return self
 
     def end_event_terminate(self, element_id: str | None = None) -> "ProcessBuilder":
         """Terminate end event: completes, then terminates every other active
@@ -257,6 +302,68 @@ class ProcessBuilder:
         return self._add_element(
             ProcessElement(element_id or self._auto_id("throw"), BpmnElementType.INTERMEDIATE_THROW_EVENT)
         )
+
+    def boundary_signal(
+        self, element_id: str, attached_to: str, signal_name: str, interrupting: bool = True
+    ) -> "ProcessBuilder":
+        el = ProcessElement(
+            element_id,
+            BpmnElementType.BOUNDARY_EVENT,
+            event_type=BpmnEventType.SIGNAL,
+            interrupting=interrupting,
+            attached_to_id=attached_to,
+            signal_name=signal_name,
+        )
+        return self._add_element(el, connect=False)
+
+    def boundary_escalation(
+        self, element_id: str, attached_to: str, escalation_code: str | None = None,
+        interrupting: bool = True,
+    ) -> "ProcessBuilder":
+        el = ProcessElement(
+            element_id,
+            BpmnElementType.BOUNDARY_EVENT,
+            event_type=BpmnEventType.ESCALATION,
+            interrupting=interrupting,
+            attached_to_id=attached_to,
+            escalation_code=escalation_code,
+        )
+        return self._add_element(el, connect=False)
+
+    def intermediate_catch_signal(self, element_id: str, signal_name: str) -> "ProcessBuilder":
+        el = ProcessElement(
+            element_id, BpmnElementType.INTERMEDIATE_CATCH_EVENT,
+            event_type=BpmnEventType.SIGNAL, signal_name=signal_name,
+        )
+        return self._add_element(el)
+
+    def intermediate_throw_escalation(self, element_id: str, escalation_code: str) -> "ProcessBuilder":
+        el = ProcessElement(
+            element_id, BpmnElementType.INTERMEDIATE_THROW_EVENT,
+            event_type=BpmnEventType.ESCALATION, escalation_code=escalation_code,
+        )
+        return self._add_element(el)
+
+    def intermediate_throw_signal(self, element_id: str, signal_name: str) -> "ProcessBuilder":
+        el = ProcessElement(
+            element_id, BpmnElementType.INTERMEDIATE_THROW_EVENT,
+            event_type=BpmnEventType.SIGNAL, signal_name=signal_name,
+        )
+        return self._add_element(el)
+
+    def end_event_escalation(self, element_id: str, escalation_code: str) -> "ProcessBuilder":
+        el = ProcessElement(
+            element_id, BpmnElementType.END_EVENT,
+            event_type=BpmnEventType.ESCALATION, escalation_code=escalation_code,
+        )
+        return self._add_element(el)
+
+    def end_event_signal(self, element_id: str, signal_name: str) -> "ProcessBuilder":
+        el = ProcessElement(
+            element_id, BpmnElementType.END_EVENT,
+            event_type=BpmnEventType.SIGNAL, signal_name=signal_name,
+        )
+        return self._add_element(el)
 
     def end_event_error(self, element_id: str, error_code: str) -> "ProcessBuilder":
         el = ProcessElement(
@@ -339,6 +446,18 @@ class ProcessBuilder:
         self._add_element(ProcessElement(element_id, BpmnElementType.SUB_PROCESS))
         self._scope_stack.append(element_id)
         self._cursor = None  # next element starts the embedded flow
+        return self
+
+    def event_sub_process(self, element_id: str) -> "ProcessBuilder":
+        """Event sub-process: no incoming/outgoing flows; starts from its own
+        typed start event when that event triggers in the enclosing scope
+        (reference: bpmn/container/EventSubProcessProcessor). Close the scope
+        with sub_process_done()."""
+        self._add_element(
+            ProcessElement(element_id, BpmnElementType.EVENT_SUB_PROCESS), connect=False
+        )
+        self._scope_stack.append(element_id)
+        self._cursor = None
         return self
 
     def sub_process_done(self) -> "ProcessBuilder":

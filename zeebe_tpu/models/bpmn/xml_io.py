@@ -53,6 +53,8 @@ _TAG_TO_TYPE = {
     "callActivity": BpmnElementType.CALL_ACTIVITY,
 }
 _TYPE_TO_TAG = {v: k for k, v in _TAG_TO_TYPE.items()}
+# an event sub-process is a subProcess with triggeredByEvent="true"
+_TYPE_TO_TAG[BpmnElementType.EVENT_SUB_PROCESS] = "subProcess"
 
 
 def parse_bpmn_xml(xml_text: str | bytes) -> list[ProcessModel]:
@@ -73,20 +75,23 @@ def parse_bpmn_xml(xml_text: str | bytes) -> list[ProcessModel]:
     signals: dict[str, str] = {}
     for sig in root.findall(f"{_B}signal"):
         signals[sig.get("id", "")] = sig.get("name", "")
+    escalations: dict[str, str] = {}
+    for esc in root.findall(f"{_B}escalation"):
+        escalations[esc.get("id", "")] = esc.get("escalationCode", "")
 
     out = []
     for proc in root.findall(f"{_B}process"):
         if proc.get("isExecutable", "true") not in ("true", "1"):
             continue
         model = ProcessModel(process_id=proc.get("id", ""), name=proc.get("name", ""))
-        _parse_scope(proc, model, parent_id=None, messages=messages, errors=errors, signals=signals)
+        _parse_scope(proc, model, parent_id=None, messages=messages, errors=errors, signals=signals, escalations=escalations)
         out.append(model)
     if not out:
         raise BpmnModelError("no executable process in document")
     return out
 
 
-def _parse_scope(scope_el, model: ProcessModel, parent_id, messages, errors, signals) -> None:
+def _parse_scope(scope_el, model: ProcessModel, parent_id, messages, errors, signals, escalations) -> None:
     for child in scope_el:
         tag = child.tag.removeprefix(_B)
         if tag == "sequenceFlow":
@@ -104,21 +109,25 @@ def _parse_scope(scope_el, model: ProcessModel, parent_id, messages, errors, sig
         etype = _TAG_TO_TYPE.get(tag)
         if etype is None:
             continue
+        if etype == BpmnElementType.SUB_PROCESS and child.get("triggeredByEvent") in ("true", "1"):
+            etype = BpmnElementType.EVENT_SUB_PROCESS
         el = ProcessElement(id=child.get("id", ""), element_type=etype, name=child.get("name", ""))
         el.parent_id = parent_id
         if etype == BpmnElementType.BOUNDARY_EVENT:
             el.attached_to_id = child.get("attachedToRef")
             el.interrupting = child.get("cancelActivity", "true") in ("true", "1")
+        if etype == BpmnElementType.START_EVENT:
+            el.interrupting = child.get("isInterrupting", "true") in ("true", "1")
         if etype == BpmnElementType.EXCLUSIVE_GATEWAY or etype == BpmnElementType.INCLUSIVE_GATEWAY:
             el.default_flow_id = child.get("default")
-        _parse_event_definitions(child, el, messages, errors, signals)
+        _parse_event_definitions(child, el, messages, errors, signals, escalations)
         _parse_extensions(child, el)
         model.elements[el.id] = el
-        if etype == BpmnElementType.SUB_PROCESS:
-            _parse_scope(child, model, parent_id=el.id, messages=messages, errors=errors, signals=signals)
+        if etype in (BpmnElementType.SUB_PROCESS, BpmnElementType.EVENT_SUB_PROCESS):
+            _parse_scope(child, model, parent_id=el.id, messages=messages, errors=errors, signals=signals, escalations=escalations)
 
 
-def _parse_event_definitions(el_xml, el: ProcessElement, messages, errors, signals) -> None:
+def _parse_event_definitions(el_xml, el: ProcessElement, messages, errors, signals, escalations) -> None:
     timer = el_xml.find(f"{_B}timerEventDefinition")
     if timer is not None:
         el.event_type = BpmnEventType.TIMER
@@ -141,6 +150,11 @@ def _parse_event_definitions(el_xml, el: ProcessElement, messages, errors, signa
     if sig is not None:
         el.event_type = BpmnEventType.SIGNAL
         el.signal_name = signals.get(sig.get("signalRef", ""), sig.get("signalRef", ""))
+    esc = el_xml.find(f"{_B}escalationEventDefinition")
+    if esc is not None:
+        el.event_type = BpmnEventType.ESCALATION
+        ref = esc.get("escalationRef")
+        el.escalation_code = escalations.get(ref, ref) if ref else None
     if el_xml.find(f"{_B}terminateEventDefinition") is not None:
         el.event_type = BpmnEventType.TERMINATE
 
@@ -205,16 +219,26 @@ def to_bpmn_xml(models: Iterable[ProcessModel] | ProcessModel) -> str:
     root = ET.Element(f"{_B}definitions", {"targetNamespace": "http://zeebe-tpu/bpmn"})
     message_names: dict[str, str] = {}
     error_codes: dict[str, str] = {}
+    signal_names: dict[str, str] = {}
+    escalation_codes: dict[str, str] = {}
     for model in models:
         for el in model.elements.values():
             if el.message is not None:
                 message_names.setdefault(el.message.name, f"msg_{len(message_names)}")
             if el.error_code:
                 error_codes.setdefault(el.error_code, f"err_{len(error_codes)}")
+            if el.signal_name:
+                signal_names.setdefault(el.signal_name, f"sig_{len(signal_names)}")
+            if el.escalation_code:
+                escalation_codes.setdefault(el.escalation_code, f"esc_{len(escalation_codes)}")
     for name, mid in message_names.items():
         ET.SubElement(root, f"{_B}message", {"id": mid, "name": name})
     for code, eid in error_codes.items():
         ET.SubElement(root, f"{_B}error", {"id": eid, "errorCode": code})
+    for name, sid in signal_names.items():
+        ET.SubElement(root, f"{_B}signal", {"id": sid, "name": name})
+    for code, eid in escalation_codes.items():
+        ET.SubElement(root, f"{_B}escalation", {"id": eid, "escalationCode": code})
     for model in models:
         proc = ET.SubElement(
             root, f"{_B}process",
@@ -225,8 +249,9 @@ def to_bpmn_xml(models: Iterable[ProcessModel] | ProcessModel) -> str:
         ordered = sorted(model.elements.values(), key=lambda e: _depth(model, e))
         for el in ordered:
             parent = scopes[el.parent_id]
-            node = _element_to_xml(parent, el, message_names, error_codes)
-            if el.element_type == BpmnElementType.SUB_PROCESS:
+            node = _element_to_xml(parent, el, message_names, error_codes,
+                                   signal_names, escalation_codes)
+            if el.element_type in (BpmnElementType.SUB_PROCESS, BpmnElementType.EVENT_SUB_PROCESS):
                 scopes[el.id] = node
         for flow in model.flows.values():
             scope_id = model.elements[flow.source_id].parent_id
@@ -250,13 +275,18 @@ def _depth(model: ProcessModel, el: ProcessElement) -> int:
     return d
 
 
-def _element_to_xml(parent, el: ProcessElement, message_names, error_codes) -> ET.Element:
+def _element_to_xml(parent, el: ProcessElement, message_names, error_codes,
+                    signal_names, escalation_codes) -> ET.Element:
     attrs = {"id": el.id}
     if el.name:
         attrs["name"] = el.name
     if el.element_type == BpmnElementType.BOUNDARY_EVENT:
         attrs["attachedToRef"] = el.attached_to_id or ""
         attrs["cancelActivity"] = "true" if el.interrupting else "false"
+    if el.element_type == BpmnElementType.START_EVENT and not el.interrupting:
+        attrs["isInterrupting"] = "false"
+    if el.element_type == BpmnElementType.EVENT_SUB_PROCESS:
+        attrs["triggeredByEvent"] = "true"
     if el.default_flow_id:
         attrs["default"] = el.default_flow_id
     node = ET.SubElement(parent, f"{_B}{_TYPE_TO_TAG[el.element_type]}", attrs)
@@ -310,8 +340,18 @@ def _element_to_xml(parent, el: ProcessElement, message_names, error_codes) -> E
         ET.SubElement(
             node, f"{_B}messageEventDefinition", {"messageRef": message_names[el.message.name]}
         )
-    elif el.event_type == BpmnEventType.ERROR and el.error_code:
-        ET.SubElement(node, f"{_B}errorEventDefinition", {"errorRef": error_codes[el.error_code]})
+    elif el.event_type == BpmnEventType.ERROR:
+        err_attrs = {"errorRef": error_codes[el.error_code]} if el.error_code else {}
+        ET.SubElement(node, f"{_B}errorEventDefinition", err_attrs)
+    elif el.event_type == BpmnEventType.SIGNAL and el.signal_name:
+        ET.SubElement(
+            node, f"{_B}signalEventDefinition", {"signalRef": signal_names[el.signal_name]}
+        )
+    elif el.event_type == BpmnEventType.ESCALATION:
+        esc_attrs = (
+            {"escalationRef": escalation_codes[el.escalation_code]} if el.escalation_code else {}
+        )
+        ET.SubElement(node, f"{_B}escalationEventDefinition", esc_attrs)
     elif el.event_type == BpmnEventType.TERMINATE:
         ET.SubElement(node, f"{_B}terminateEventDefinition")
 

@@ -160,6 +160,7 @@ class EngineHarness:
             self.exporter.all()
             .with_value_type(ValueType.PROCESS_INSTANCE_CREATION)
             .with_intent(ProcessInstanceCreationIntent.CREATED)
+            .with_value(bpmnProcessId=bpmn_process_id)
             .to_list()
         )
         return created[-1].record.value["processInstanceKey"]
@@ -234,6 +235,23 @@ class EngineHarness:
                     "variables": variables or {},
                 },
             ),
+            request_id=request_id,
+        )
+
+    def broadcast_signal(self, name: str, variables: dict | None = None, request_id: int = 12) -> None:
+        from zeebe_tpu.protocol.intent import SignalIntent
+
+        self.write_command(
+            command(ValueType.SIGNAL, SignalIntent.BROADCAST,
+                    {"signalName": name, "variables": variables or {}}),
+            request_id=request_id,
+        )
+
+    def throw_job_error(self, job_key: int, error_code: str, error_message: str = "",
+                        request_id: int = 13) -> None:
+        self.write_command(
+            command(ValueType.JOB, JobIntent.THROW_ERROR,
+                    {"errorCode": error_code, "errorMessage": error_message}, key=job_key),
             request_id=request_id,
         )
 
