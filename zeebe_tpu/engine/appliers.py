@@ -107,6 +107,13 @@ class EventAppliers:
         reg[(ValueType.SIGNAL_SUBSCRIPTION, int(SignalSubscriptionIntent.DELETED))] = self._signal_sub_deleted
         reg[(ValueType.ESCALATION, int(EscalationIntent.ESCALATED))] = self._noop
         reg[(ValueType.ESCALATION, int(EscalationIntent.NOT_ESCALATED))] = self._noop
+        from zeebe_tpu.protocol.intent import CommandDistributionIntent, DeploymentIntent as _DI
+
+        reg[(ValueType.COMMAND_DISTRIBUTION, int(CommandDistributionIntent.STARTED))] = self._distribution_started
+        reg[(ValueType.COMMAND_DISTRIBUTION, int(CommandDistributionIntent.DISTRIBUTING))] = self._distribution_distributing
+        reg[(ValueType.COMMAND_DISTRIBUTION, int(CommandDistributionIntent.ACKNOWLEDGED))] = self._distribution_acknowledged
+        reg[(ValueType.COMMAND_DISTRIBUTION, int(CommandDistributionIntent.FINISHED))] = self._distribution_finished
+        reg[(ValueType.DEPLOYMENT, int(_DI.DISTRIBUTED))] = self._noop
 
     def can_apply(self, record: Record) -> bool:
         return (record.value_type, int(record.intent)) in self._appliers
@@ -125,6 +132,26 @@ class EventAppliers:
 
     def _noop(self, record: Record) -> None:
         pass
+
+    # command distribution (reference: state/appliers/CommandDistribution*Applier)
+
+    def _distribution_started(self, record: Record) -> None:
+        self.state.distribution.start(record.key, record.value)
+
+    def _distribution_distributing(self, record: Record) -> None:
+        self.state.distribution.add_pending(record.key, record.value["partitionId"])
+
+    def _distribution_acknowledged(self, record: Record) -> None:
+        if record.value.get("received"):
+            # receiver-side marker: dedups retried distribution sends
+            self.state.distribution.mark_received(
+                record.key, record.value.get("receivedAt", 0)
+            )
+        else:
+            self.state.distribution.remove_pending(record.key, record.value["partitionId"])
+
+    def _distribution_finished(self, record: Record) -> None:
+        self.state.distribution.finish(record.key)
 
     def _process_created(self, record: Record) -> None:
         v = record.value

@@ -27,6 +27,7 @@ from zeebe_tpu.engine.writers import Writers
 from zeebe_tpu.logstreams import LoggedRecord
 from zeebe_tpu.protocol import RejectionType, ValueType
 from zeebe_tpu.protocol.intent import (
+    CommandDistributionIntent,
     DeploymentIntent,
     IncidentIntent,
     JobBatchIntent,
@@ -75,10 +76,18 @@ class Engine(RecordProcessor):
         )
 
         from zeebe_tpu.engine.signal import SignalProcessors
+        from zeebe_tpu.engine.distribution import (
+            CommandDistributionAcknowledgeProcessor,
+            CommandDistributionBehavior,
+        )
 
         bpmn = BpmnProcessor(self.state, clock, sender=self.sender,
                              partition_count=partition_count)
-        deployment = DeploymentProcessor(self.state, clock)
+        self.distribution_behavior = CommandDistributionBehavior(
+            self.state, partition_count, self.sender, clock_millis=clock
+        )
+        distribution = self.distribution_behavior if partition_count > 1 else None
+        deployment = DeploymentProcessor(self.state, clock, distribution=distribution)
         creation = ProcessInstanceCreationProcessor(self.state, bpmn)
         cancel = ProcessInstanceCancelProcessor(self.state)
         jobs = JobProcessors(self.state, clock, bpmn)
@@ -90,7 +99,19 @@ class Engine(RecordProcessor):
         msg_subs = MessageSubscriptionProcessors(self.state, self.sender)
         pms = ProcessMessageSubscriptionProcessors(self.state, self.sender, partition_count,
                                                    bpmn=bpmn)
-        signals = SignalProcessors(self.state, bpmn)
+        signals = SignalProcessors(self.state, bpmn, distribution=distribution)
+        dist_ack = CommandDistributionAcknowledgeProcessor(self.state)
+        self.distribution_ack = dist_ack
+
+        from zeebe_tpu.protocol.intent import DeploymentIntent as _DI
+
+        def _deployment_fully_distributed(wr, distribution_key, stored):
+            wr.append_event(
+                distribution_key, ValueType.DEPLOYMENT, _DI.FULLY_DISTRIBUTED,
+                stored.get("commandValue", {}),
+            )
+
+        dist_ack.on_finished(ValueType.DEPLOYMENT, _deployment_fully_distributed)
         self.bpmn = bpmn
 
         # the RecordProcessorMap: (ValueType, command intent) → handler
@@ -118,6 +139,7 @@ class Engine(RecordProcessor):
             (ValueType.MESSAGE_SUBSCRIPTION, int(MessageSubscriptionIntent.DELETE)): msg_subs.delete,
             (ValueType.PROCESS_MESSAGE_SUBSCRIPTION, int(ProcessMessageSubscriptionIntent.CORRELATE)): pms.correlate,
             (ValueType.SIGNAL, int(SignalIntent.BROADCAST)): signals.broadcast,
+            (ValueType.COMMAND_DISTRIBUTION, int(CommandDistributionIntent.ACKNOWLEDGE)): dist_ack.process,
         }
         self.state.load_key_generator()
 

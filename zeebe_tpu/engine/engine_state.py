@@ -730,6 +730,83 @@ class BannedInstanceState:
         return process_instance_key >= 0 and self._banned.exists((process_instance_key,))
 
 
+class DistributionState:
+    """Pending command distributions (reference: state/distribution/
+    DbDistributionState — COMMAND_DISTRIBUTION_RECORD stores the distributed
+    command, PENDING_DISTRIBUTION marks (distributionKey, partition) pairs still
+    awaiting an ACKNOWLEDGE; receiver side dedups retried sends)."""
+
+    # receiver dedup markers are retained long enough to absorb origin retries,
+    # then purged (deterministically, from the applier) so state and snapshots
+    # don't grow without bound
+    RECEIVED_RETENTION_MS = 24 * 3_600_000
+
+    def __init__(self, db: ZbDb) -> None:
+        self._records = db.column_family(CF.COMMAND_DISTRIBUTION_RECORD)
+        self._pending = db.column_family(CF.PENDING_DISTRIBUTION)
+        self._received = db.column_family(CF.DISTRIBUTION)
+        self._received_by_time = db.column_family(CF.RECEIVED_DISTRIBUTION_BY_TIME)
+
+    def start(self, distribution_key: int, stored: dict) -> None:
+        self._records.put((distribution_key,), dict(stored))
+
+    def get(self, distribution_key: int) -> dict | None:
+        return self._records.get((distribution_key,))
+
+    def add_pending(self, distribution_key: int, partition: int) -> None:
+        self._pending.put((distribution_key, partition), None)
+
+    def remove_pending(self, distribution_key: int, partition: int) -> None:
+        if self._pending.exists((distribution_key, partition)):
+            self._pending.delete((distribution_key, partition))
+
+    def pending_partitions(self, distribution_key: int) -> list[int]:
+        return [
+            _decode_trailing_i64(enc) for enc, _ in self._pending.items((distribution_key,))
+        ]
+
+    def is_pending(self, distribution_key: int, partition: int) -> bool:
+        return self._pending.exists((distribution_key, partition))
+
+    def none_pending(self, distribution_key: int) -> bool:
+        return self._pending.is_empty((distribution_key,))
+
+    def has_any_pending(self) -> bool:
+        return not self._pending.is_empty()
+
+    def all_pending(self) -> list[tuple[int, int]]:
+        return [_decode_two_i64(enc) for enc, _ in self._pending.items()]
+
+    def finish(self, distribution_key: int) -> None:
+        if self._records.exists((distribution_key,)):
+            self._records.delete((distribution_key,))
+
+    def mark_received(self, distribution_key: int, received_at: int) -> None:
+        if self._received.exists((distribution_key,)):
+            return
+        self._received.put((distribution_key,), received_at)
+        self._received_by_time.put((received_at, distribution_key), None)
+        # Purge markers older than the retention window, keyed by the event's
+        # own clock value so replay purges identically. A retry arriving after
+        # its marker was purged re-executes the command (at-least-once);
+        # receiver processors stay idempotent at the domain level (e.g. the
+        # deployment digest check) to keep that harmless.
+        cutoff = received_at - self.RECEIVED_RETENTION_MS
+        expired: list[tuple[int, int]] = []
+        for enc, _ in self._received_by_time.items():
+            at, key = _decode_two_i64(enc)
+            if at >= cutoff:
+                break
+            expired.append((at, key))
+        for at, key in expired:
+            self._received_by_time.delete((at, key))
+            if self._received.exists((key,)):
+                self._received.delete((key,))
+
+    def was_received(self, distribution_key: int) -> bool:
+        return self._received.exists((distribution_key,))
+
+
 class EngineState:
     """Aggregates all engine sub-states over one partition's db + key generator
     (reference: ProcessingDbState)."""
@@ -749,6 +826,7 @@ class EngineState:
         self.process_message_subscriptions = ProcessMessageSubscriptionState(db)
         self.message_start_subscriptions = MessageStartEventSubscriptionState(db)
         self.signal_subscriptions = SignalSubscriptionState(db)
+        self.distribution = DistributionState(db)
         self._key_cf = db.column_family(CF.KEY)
         self.key_generator = KeyGenerator(partition_id)
         self._key_loaded = False

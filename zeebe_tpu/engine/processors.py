@@ -44,11 +44,15 @@ class DeploymentProcessor:
     PROCESS CREATED per definition and DEPLOYMENT CREATED/FULLY_DISTRIBUTED,
     and (re)register message/timer start-event subscriptions."""
 
-    def __init__(self, state: EngineState, clock_millis=None) -> None:
+    def __init__(self, state: EngineState, clock_millis=None, distribution=None) -> None:
         self.state = state
         self.clock_millis = clock_millis or (lambda: 0)
+        self.distribution = distribution  # CommandDistributionBehavior | None
 
     def process(self, cmd: LoggedRecord, writers: Writers) -> None:
+        if self.distribution is not None and self.distribution.is_distributed_command(cmd):
+            self._process_distributed(cmd, writers)
+            return
         value = cmd.record.value
         resources = value.get("resources", [])
         if not resources:
@@ -116,15 +120,69 @@ class DeploymentProcessor:
             deployment_key, ValueType.DEPLOYMENT, DeploymentIntent.CREATED, deployment_value
         )
         writers.respond(cmd, created)
-        # single-partition deployments are immediately fully distributed;
-        # multi-partition distribution rides CommandDistributionBehavior
+        distributing = (
+            self.distribution is not None
+            and self.distribution.distribute(
+                writers, deployment_key, ValueType.DEPLOYMENT, DeploymentIntent.CREATE,
+                deployment_value,
+            )
+        )
+        if not distributing:
+            # single-partition deployments are immediately fully distributed;
+            # otherwise FULLY_DISTRIBUTED is written by the completion hook once
+            # every partition ACKNOWLEDGEd (docs/generalized_distribution.md)
+            writers.append_event(
+                deployment_key, ValueType.DEPLOYMENT, DeploymentIntent.FULLY_DISTRIBUTED,
+                deployment_value,
+            )
+
+    def _process_distributed(self, cmd: LoggedRecord, writers: Writers) -> None:
+        """Receiver side of deployment distribution: store the definitions under
+        the origin-minted keys, open message/signal start subscriptions locally
+        (timer start events run only on the deployment partition), then ack."""
+        self.distribution.handle_distributed(
+            cmd, writers, lambda: self._apply_distributed_deployment(cmd, writers)
+        )
+
+    def _apply_distributed_deployment(self, cmd: LoggedRecord, writers: Writers) -> None:
+        value = cmd.record.value
+        # parse each resource exactly once (mirrors the origin path)
+        executables: dict[str, "object"] = {}
+        for res in value.get("resources", []):
+            for model in parse_bpmn_xml(res["resource"]):
+                executables[model.process_id] = (res["resource"], transform(model))
+        for meta in value.get("processesMetadata", []):
+            if meta.get("duplicate"):
+                continue
+            # domain-level idempotence: a retry whose dedup marker was already
+            # purged must not re-deploy (digest check, same as the origin path)
+            if self.state.processes.latest_digest(meta["bpmnProcessId"]) == meta["checksum"]:
+                continue
+            entry = executables.get(meta["bpmnProcessId"])
+            if entry is None:
+                continue
+            xml, exe = entry
+            previous_version = self.state.processes.latest_version(meta["bpmnProcessId"])
+            previous_key = (
+                self.state.processes.get_key_by_id_version(
+                    meta["bpmnProcessId"], previous_version
+                )
+                if previous_version is not None else None
+            )
+            writers.append_event(
+                meta["processDefinitionKey"], ValueType.PROCESS, ProcessIntent.CREATED,
+                {**meta, "resource": xml},
+            )
+            self._register_start_subscriptions(
+                writers, exe, meta, previous_key, include_timers=False
+            )
         writers.append_event(
-            deployment_key, ValueType.DEPLOYMENT, DeploymentIntent.FULLY_DISTRIBUTED,
-            deployment_value,
+            cmd.record.key, ValueType.DEPLOYMENT, DeploymentIntent.DISTRIBUTED, value
         )
 
 
-    def _register_start_subscriptions(self, writers, exe, meta, previous_key):
+    def _register_start_subscriptions(self, writers, exe, meta, previous_key,
+                                      include_timers=True):
         """Message/timer start events of the new latest version; the previous
         version's subscriptions are closed (reference: deployment transformer
         subscription lifecycle)."""
@@ -185,7 +243,7 @@ class DeploymentProcessor:
                         "messageName": el.message_name,
                     },
                 )
-            elif el.event_type == BpmnEventType.TIMER and el.timer_cycle:
+            elif el.event_type == BpmnEventType.TIMER and el.timer_cycle and include_timers:
                 reps, interval = parse_cycle(el.timer_cycle)
                 writers.append_event(
                     self.state.next_key(), ValueType.TIMER, TimerIntent.CREATED,

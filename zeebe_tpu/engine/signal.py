@@ -23,18 +23,35 @@ from zeebe_tpu.protocol.intent import (
 
 
 class SignalProcessors:
-    def __init__(self, state: EngineState, bpmn) -> None:
+    def __init__(self, state: EngineState, bpmn, distribution=None) -> None:
         self.state = state
         self.bpmn = bpmn
+        self.distribution = distribution  # CommandDistributionBehavior | None
 
     def broadcast(self, cmd: LoggedRecord, writers: Writers) -> None:
         value = dict(cmd.record.value)
+        if self.distribution is not None and self.distribution.is_distributed_command(cmd):
+            # receiver: the whole local broadcast (event + subscription
+            # triggering) runs once per distribution key, then acks
+            self.distribution.handle_distributed(
+                cmd, writers,
+                lambda: self._broadcast_locally(cmd.record.key, value, writers),
+            )
+            return
+        key = cmd.record.key if cmd.record.key >= 0 else self.state.next_key()
+        broadcasted = self._broadcast_locally(key, value, writers)
+        writers.respond(cmd, broadcasted)
+        if self.distribution is not None:
+            self.distribution.distribute(
+                writers, key, ValueType.SIGNAL, SignalIntent.BROADCAST, value
+            )
+
+    def _broadcast_locally(self, key: int, value: dict, writers: Writers):
         name = value.get("signalName", "")
         variables = value.get("variables") or {}
-        key = cmd.record.key if cmd.record.key >= 0 else self.state.next_key()
-        broadcasted = writers.append_event(key, ValueType.SIGNAL, SignalIntent.BROADCASTED, value)
-        writers.respond(cmd, broadcasted)
-
+        broadcasted = writers.append_event(
+            key, ValueType.SIGNAL, SignalIntent.BROADCASTED, value
+        )
         for sub in list(self.state.signal_subscriptions.find(name)):
             host_key = sub.get("catchEventInstanceKey", -1)
             if host_key >= 0:
@@ -62,6 +79,7 @@ class SignalProcessors:
                         "startElementId": sub.get("catchEventId", ""),
                     },
                 )
+        return broadcasted
 
     def _merge_variables(self, instance: dict, host_key: int, variables: dict,
                          writers: Writers) -> None:

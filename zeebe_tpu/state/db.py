@@ -92,6 +92,7 @@ class ColumnFamilyCode(enum.IntEnum):
     COMMAND_DISTRIBUTION_RECORD = 122
     MULTI_INSTANCE_OUTPUT = 130
     AWAIT_RESULT_METADATA = 131
+    RECEIVED_DISTRIBUTION_BY_TIME = 123
     CHECKPOINT = 140
     FORMS = 150
     DMN_DECISIONS = 160

@@ -55,16 +55,20 @@ class EngineHarness:
         partition_id: int = 1,
         max_commands_in_batch: int = 100,
         consistency_checks: bool = True,
+        partition_count: int = 1,
+        sender=None,
+        clock: ControlledClock | None = None,
     ) -> None:
         self._tmp = None
         if directory is None:
             self._tmp = tempfile.TemporaryDirectory()
             directory = self._tmp.name
-        self.clock = ControlledClock()
+        self.clock = clock or ControlledClock()
         self.journal = SegmentedJournal(Path(directory) / "log")
         self.stream = LogStream(self.journal, partition_id, clock=self.clock)
         self.db = ZbDb(consistency_checks=consistency_checks)
-        self.engine = Engine(self.db, partition_id, clock_millis=self.clock)
+        self.engine = Engine(self.db, partition_id, clock_millis=self.clock,
+                             partition_count=partition_count)
         self.exporter = RecordingExporter()
         self.responses: list = []
         self.processor = StreamProcessor(
@@ -75,17 +79,19 @@ class EngineHarness:
             response_sink=self.responses.append,
             clock_millis=self.clock,
         )
+        from zeebe_tpu.engine.distribution import CommandRedistributor
         from zeebe_tpu.engine.message_timer import DueDateCheckers
         from zeebe_tpu.parallel.partitioning import LoopbackCommandSender
 
-        self.engine.wire_sender(
-            LoopbackCommandSender(
-                lambda rec: self.stream.writer.try_write(
-                    [LogAppendEntry(rec)]
-                )
+        if sender is None:
+            sender = LoopbackCommandSender(
+                lambda rec: self.stream.writer.try_write([LogAppendEntry(rec)])
             )
-        )
+        self.engine.wire_sender(sender)
         self.checkers = DueDateCheckers(self.engine.state, self.processor.schedule_service, self.clock)
+        self.redistributor = CommandRedistributor(
+            self.engine.state, self.engine.sender, self.processor.schedule_service, self.clock
+        )
         self.processor.start()
         self._exported_until = 0
 
@@ -96,12 +102,22 @@ class EngineHarness:
 
     # -- pump ----------------------------------------------------------------
 
+    # set by MultiPartitionHarness: partition pumps then drive the whole cluster
+    cluster = None
+
     def pump(self) -> None:
         """Process everything pending (including due scheduled work), then
         transfer new records to the exporter (ProcessingExporterTransistor)."""
+        if self.cluster is not None:
+            self.cluster.pump_all()
+            return
+        self._pump_local()
+
+    def _pump_local(self) -> None:
         for _ in range(1000):
             self.processor.run_until_idle()
             self.checkers.reschedule()
+            self.redistributor.reschedule()
             due = self.processor.schedule_service.next_due_millis
             if due is None or due > self.clock():
                 break
@@ -271,3 +287,107 @@ class EngineHarness:
     def variables_of(self, scope_key: int) -> dict:
         with self.db.transaction():
             return self.engine.state.variables.collect(scope_key)
+
+
+class MultiPartitionHarness:
+    """N in-process partitions wired through a loopback inter-partition sender —
+    the reference's primary multi-node harness (EngineRule with partitionCount>1
+    + TestInterPartitionCommandSender, engine/src/test/…/util/
+    TestInterPartitionCommandSender.java): full multi-partition engine logic in
+    one process, no Raft, no network."""
+
+    def __init__(self, partition_count: int = 3, directory: str | Path | None = None,
+                 consistency_checks: bool = True) -> None:
+        from zeebe_tpu.parallel.partitioning import InProcessClusterSender
+
+        self._tmp = None
+        if directory is None:
+            self._tmp = tempfile.TemporaryDirectory()
+            directory = self._tmp.name
+        self.partition_count = partition_count
+        self.clock = ControlledClock()
+        self.sender = InProcessClusterSender()
+        self.partitions: dict[int, EngineHarness] = {}
+        self._pumping = False
+        for pid in range(1, partition_count + 1):
+            h = EngineHarness(
+                directory=Path(directory) / f"partition-{pid}",
+                partition_id=pid,
+                partition_count=partition_count,
+                sender=self.sender,
+                clock=self.clock,
+                consistency_checks=consistency_checks,
+            )
+            h.cluster = self
+            self.partitions[pid] = h
+            self.sender.register(
+                pid, lambda rec, h=h: h.stream.writer.try_write([LogAppendEntry(rec)])
+            )
+        self._round_robin = 0
+
+    def close(self) -> None:
+        for h in self.partitions.values():
+            h.close()
+        if self._tmp is not None:
+            self._tmp.cleanup()
+
+    def partition(self, partition_id: int) -> EngineHarness:
+        return self.partitions[partition_id]
+
+    # -- cluster pump ---------------------------------------------------------
+
+    def pump_all(self) -> None:
+        """Pump every partition until the whole cluster quiesces (inter-partition
+        sends land on sibling logs and must be drained in turn)."""
+        if self._pumping:
+            return
+        self._pumping = True
+        try:
+            for _ in range(1000):
+                # quiesce on log END positions, not exporter positions: a round
+                # whose only effect is a cross-partition send into an
+                # already-pumped sibling log must trigger another round
+                before = tuple(h.stream._next_position for h in self.partitions.values())
+                for h in self.partitions.values():
+                    h._pump_local()
+                after = tuple(h.stream._next_position for h in self.partitions.values())
+                if after == before:
+                    return
+            raise RuntimeError("cluster pump did not quiesce after 1000 rounds")
+        finally:
+            self._pumping = False
+
+    def advance_time(self, millis: int) -> None:
+        self.clock.advance(millis)
+        self.pump_all()
+
+    # -- cluster-level client API --------------------------------------------
+
+    def deploy(self, *models: ProcessModel | str, request_id: int = 1) -> None:
+        """Deployments always enter on the deployment partition (1)."""
+        self.partitions[1].deploy(*models, request_id=request_id)
+
+    def create_instance(self, bpmn_process_id: str, variables: dict[str, Any] | None = None,
+                        partition_id: int | None = None, version: int = -1) -> int:
+        """Round-robin instance creation across partitions (the gateway's
+        RequestDispatchStrategy) unless a partition is pinned."""
+        if partition_id is None:
+            partition_id = (self._round_robin % self.partition_count) + 1
+            self._round_robin += 1
+        return self.partitions[partition_id].create_instance(
+            bpmn_process_id, variables, version=version
+        )
+
+    def publish_message(self, name: str, correlation_key: str, **kw: Any) -> None:
+        """Messages route by correlation-key hash (SubscriptionUtil)."""
+        from zeebe_tpu.parallel.partitioning import subscription_partition_id
+
+        pid = subscription_partition_id(correlation_key, self.partition_count)
+        self.partitions[pid].publish_message(name, correlation_key, **kw)
+
+    def records(self):
+        """All partitions' records merged (position-interleaved per partition)."""
+        out = []
+        for h in self.partitions.values():
+            out.extend(h.exporter.all().to_list())
+        return out
