@@ -1,0 +1,204 @@
+"""Cluster messaging: topic-addressed request/reply between members.
+
+Reference: atomix/cluster/src/main/java/io/atomix/cluster/messaging/impl/
+NettyMessagingService.java — topic-addressed (`consume`/`send`) request/reply
+over TCP. Two implementations:
+
+- ``LoopbackNetwork``: in-process, deterministic, with drop/partition fault
+  injection — the unit-test substrate (the reference tests Raft the same way,
+  atomix/cluster/src/test with local transports).
+- ``TcpMessagingService``: asyncio TCP with length-prefixed msgpack frames —
+  the real multi-host backend (DCN path; ICI carries only in-kernel jax
+  collectives, never these control messages).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import threading
+from collections import deque
+from typing import Any, Callable
+
+from zeebe_tpu.protocol.msgpack import packb, unpackb
+
+# handler(sender_id, payload) -> reply payload | None
+Handler = Callable[[str, Any], Any]
+
+
+class MessagingService:
+    """Interface: subscribe to topics, send one-way messages."""
+
+    member_id: str
+
+    def subscribe(self, topic: str, handler: Handler) -> None:
+        raise NotImplementedError
+
+    def send(self, member_id: str, topic: str, payload: Any) -> None:
+        """Fire-and-forget (Raft piggybacks replies as separate messages)."""
+        raise NotImplementedError
+
+
+class LoopbackMessaging(MessagingService):
+    def __init__(self, network: "LoopbackNetwork", member_id: str) -> None:
+        self.network = network
+        self.member_id = member_id
+        self.handlers: dict[str, Handler] = {}
+
+    def subscribe(self, topic: str, handler: Handler) -> None:
+        self.handlers[topic] = handler
+
+    def send(self, member_id: str, topic: str, payload: Any) -> None:
+        self.network.enqueue(self.member_id, member_id, topic, payload)
+
+
+class LoopbackNetwork:
+    """Deterministic in-process network with fault injection.
+
+    Messages are queued and delivered only on ``deliver_all`` / ``deliver_one``
+    so tests control interleaving exactly. ``partition(a, b)`` drops traffic
+    between two members (both directions) until ``heal()``.
+    """
+
+    def __init__(self) -> None:
+        self.members: dict[str, LoopbackMessaging] = {}
+        self.queue: deque[tuple[str, str, str, Any]] = deque()
+        self._partitions: set[frozenset[str]] = set()
+        self.dropped: int = 0
+
+    def join(self, member_id: str) -> LoopbackMessaging:
+        svc = LoopbackMessaging(self, member_id)
+        self.members[member_id] = svc
+        return svc
+
+    # -- fault injection ------------------------------------------------------
+
+    def partition(self, a: str, b: str) -> None:
+        self._partitions.add(frozenset((a, b)))
+
+    def isolate(self, member_id: str) -> None:
+        for other in self.members:
+            if other != member_id:
+                self.partition(member_id, other)
+
+    def heal(self, a: str | None = None, b: str | None = None) -> None:
+        if a is None:
+            self._partitions.clear()
+        elif b is None:
+            self._partitions = {p for p in self._partitions if a not in p}
+        else:
+            self._partitions.discard(frozenset((a, b)))
+
+    def _blocked(self, a: str, b: str) -> bool:
+        return frozenset((a, b)) in self._partitions
+
+    # -- delivery -------------------------------------------------------------
+
+    def enqueue(self, sender: str, target: str, topic: str, payload: Any) -> None:
+        self.queue.append((sender, target, topic, payload))
+
+    def deliver_one(self) -> bool:
+        if not self.queue:
+            return False
+        sender, target, topic, payload = self.queue.popleft()
+        if self._blocked(sender, target) or target not in self.members:
+            self.dropped += 1
+            return True
+        handler = self.members[target].handlers.get(topic)
+        if handler is not None:
+            handler(sender, payload)
+        return True
+
+    def deliver_all(self, max_messages: int = 100_000) -> int:
+        count = 0
+        while self.queue and count < max_messages:
+            self.deliver_one()
+            count += 1
+        return count
+
+
+_FRAME = struct.Struct("<I")
+
+
+class TcpMessagingService(MessagingService):
+    """asyncio TCP messaging: one connection per peer, frames are
+    ``len | msgpack{topic, sender, payload}`` (the NettyMessagingService
+    protocol-v2 shape without the compression/TLS options)."""
+
+    def __init__(self, member_id: str, bind: tuple[str, int],
+                 peers: dict[str, tuple[str, int]]) -> None:
+        self.member_id = member_id
+        self.bind = bind
+        self.peers = dict(peers)
+        self.handlers: dict[str, Handler] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self._writers: dict[str, asyncio.StreamWriter] = {}
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._started = threading.Event()
+
+    def subscribe(self, topic: str, handler: Handler) -> None:
+        self.handlers[topic] = handler
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        """Run the event loop on a daemon thread (the host control plane;
+        reference brokers likewise run messaging on dedicated Netty threads)."""
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"messaging-{self.member_id}")
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(self._serve())
+        self._loop.run_forever()
+
+    async def _serve(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_connection, self.bind[0], self.bind[1]
+        )
+        self._started.set()
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    async def _on_connection(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                header = await reader.readexactly(_FRAME.size)
+                (length,) = _FRAME.unpack(header)
+                frame = unpackb(await reader.readexactly(length))
+                handler = self.handlers.get(frame["topic"])
+                if handler is not None:
+                    handler(frame["sender"], frame["payload"])
+        except (asyncio.IncompleteReadError, ConnectionError):
+            writer.close()
+
+    def send(self, member_id: str, topic: str, payload: Any) -> None:
+        if self._loop is None:
+            raise RuntimeError("messaging not started")
+        self._loop.call_soon_threadsafe(
+            lambda: self._loop.create_task(self._send(member_id, topic, payload))
+        )
+
+    async def _send(self, member_id: str, topic: str, payload: Any) -> None:
+        try:
+            writer = self._writers.get(member_id)
+            if writer is None or writer.is_closing():
+                if member_id not in self.peers:
+                    return
+                host, port = self.peers[member_id]
+                _, writer = await asyncio.open_connection(host, port)
+                self._writers[member_id] = writer
+            data = packb({"topic": topic, "sender": self.member_id, "payload": payload})
+            writer.write(_FRAME.pack(len(data)) + data)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            self._writers.pop(member_id, None)  # peer down: drop (Raft retries)

@@ -1,0 +1,506 @@
+"""Raft consensus, one instance per partition, over the segmented journal.
+
+Reference: atomix/cluster/src/main/java/io/atomix/raft/ — RaftContext.java:105,
+roles/{LeaderRole.java:593-707, FollowerRole, CandidateRole, PassiveRole},
+LeaderAppender.java (replication loop), pre-vote + priority election
+(RaftElectionConfig), snapshot replication to lagging followers (PassiveRole +
+FileBasedReceivedSnapshot), and the Zeebe write ingress
+LeaderRole.appendEntry(lowestPos, highestPos, data, listener) (:655-685).
+
+TPU-native re-design: no actor threads — a RaftNode is a deterministic state
+machine advanced by ``tick(now)`` and delivered messages, identical under the
+loopback test network and the TCP backend. Entries carry opaque ``bytes`` (the
+log-stream batch payloads) plus an ``asqn`` (application sequence number =
+stream position of the batch's first record), so the log stream can seek after
+recovery exactly like the reference (journal asqn-seek, SURVEY §2.3).
+
+Persistent per-node state: the journal itself plus a small meta file
+(currentTerm, votedFor) — the reference's MetaStore.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import random
+from pathlib import Path
+from typing import Any, Callable
+
+from zeebe_tpu.cluster.messaging import MessagingService
+from zeebe_tpu.journal import SegmentedJournal
+from zeebe_tpu.protocol.msgpack import packb, unpackb
+
+HEARTBEAT_INTERVAL_MS = 250
+ELECTION_TIMEOUT_MS = 2_500
+MAX_ENTRIES_PER_APPEND = 64
+SNAPSHOT_CHUNK_BYTES = 512 * 1024
+
+
+class RaftRole(enum.Enum):
+    INACTIVE = "inactive"
+    FOLLOWER = "follower"
+    CANDIDATE = "candidate"
+    LEADER = "leader"
+
+
+class RaftNode:
+    """One member of one partition's replication group."""
+
+    def __init__(
+        self,
+        messaging: MessagingService,
+        partition_id: int,
+        members: list[str],
+        directory: str | Path,
+        clock_millis: Callable[[], int],
+        priority: int = 1,
+        seed: int | None = None,
+    ) -> None:
+        self.messaging = messaging
+        self.member_id = messaging.member_id
+        self.partition_id = partition_id
+        self.members = sorted(members)
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.clock_millis = clock_millis
+        self.priority = priority
+        # deterministic jitter per member (tests are reproducible)
+        self._rng = random.Random(
+            seed if seed is not None else hash((self.member_id, partition_id)) & 0xFFFF
+        )
+
+        self.journal = SegmentedJournal(self.directory / "raft-log")
+        self._meta_path = self.directory / "raft-meta.json"
+        self.current_term = 0
+        self.voted_for: str | None = None
+        self._load_meta()
+
+        self.role = RaftRole.FOLLOWER
+        self.leader_id: str | None = None
+        self.commit_index = 0
+        # snapshot bookkeeping (log prefix replaced by a snapshot)
+        self.snapshot_index = 0
+        self.snapshot_term = 0
+        self._snapshot_bytes: bytes | None = None
+        self._pending_snapshot: dict[str, Any] | None = None
+
+        # leader volatile state
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+        self._pending_appends: dict[int, Callable[[int], None]] = {}
+
+        # election timers
+        self._last_heartbeat_ms = clock_millis()
+        self._election_deadline_ms = self._next_election_deadline()
+        self._last_heartbeat_sent_ms = 0
+        self._votes: set[str] = set()
+        self._prevotes: set[str] = set()
+
+        self.role_listeners: list[Callable[[RaftRole, int], None]] = []
+        self.commit_listeners: list[Callable[[int], None]] = []
+        # snapshot provider: () -> (index, term, bytes) | None — installed by
+        # the partition owner so lagging followers receive state snapshots
+        self.snapshot_provider: Callable[[], tuple[int, int, bytes] | None] | None = None
+        self.snapshot_receiver: Callable[[bytes], None] | None = None
+
+        t = f"raft-{partition_id}"
+        messaging.subscribe(f"{t}-vote", self._on_vote_request)
+        messaging.subscribe(f"{t}-vote-resp", self._on_vote_response)
+        messaging.subscribe(f"{t}-append", self._on_append_request)
+        messaging.subscribe(f"{t}-append-resp", self._on_append_response)
+        messaging.subscribe(f"{t}-snapshot", self._on_install_snapshot)
+
+    # -- persistence ----------------------------------------------------------
+
+    def _load_meta(self) -> None:
+        if self._meta_path.exists():
+            meta = json.loads(self._meta_path.read_text())
+            self.current_term = meta["term"]
+            self.voted_for = meta["votedFor"]
+
+    def _store_meta(self) -> None:
+        self._meta_path.write_text(
+            json.dumps({"term": self.current_term, "votedFor": self.voted_for})
+        )
+
+    def close(self) -> None:
+        self.journal.close()
+
+    # -- log accessors --------------------------------------------------------
+
+    def _last_log_index(self) -> int:
+        return max(self.journal.last_index, self.snapshot_index)
+
+    def _entry_term(self, index: int) -> int:
+        if index == 0:
+            return 0
+        if index == self.snapshot_index:
+            return self.snapshot_term
+        rec = self.journal.read_entry(index)
+        if rec is None:
+            return -1
+        return unpackb(rec.data)["term"]
+
+    def _last_log_term(self) -> int:
+        return self._entry_term(self._last_log_index())
+
+    def _read_entries(self, from_index: int, limit: int) -> list[dict]:
+        out = []
+        for rec in self.journal.read_from(from_index):
+            entry = unpackb(rec.data)
+            entry["index"] = rec.index
+            out.append(entry)
+            if len(out) >= limit:
+                break
+        return out
+
+    # -- timers ---------------------------------------------------------------
+
+    def _next_election_deadline(self) -> int:
+        # priority shortens the timeout so preferred members win elections
+        # (reference: RaftElectionConfig priority election)
+        jitter = self._rng.randrange(ELECTION_TIMEOUT_MS // 2)
+        bias = ELECTION_TIMEOUT_MS // (2 * max(self.priority, 1))
+        return self.clock_millis() + bias + jitter
+
+    def tick(self, now_millis: int | None = None) -> None:
+        now = self.clock_millis() if now_millis is None else now_millis
+        if self.role == RaftRole.LEADER:
+            if now - self._last_heartbeat_sent_ms >= HEARTBEAT_INTERVAL_MS:
+                self._broadcast_appends()
+        elif now >= self._election_deadline_ms:
+            self._start_prevote()
+
+    # -- elections ------------------------------------------------------------
+
+    def _start_prevote(self) -> None:
+        """Pre-vote phase: probe electability without disturbing the term
+        (reference: raft pre-vote, PreVoteRequest)."""
+        self._election_deadline_ms = self._next_election_deadline()
+        self._prevotes = {self.member_id}
+        if self._quorum(len(self._prevotes)):
+            self._start_election()
+            return
+        for m in self._other_members():
+            self._send(m, "vote", {
+                "term": self.current_term + 1,
+                "candidate": self.member_id,
+                "lastLogIndex": self._last_log_index(),
+                "lastLogTerm": self._last_log_term(),
+                "prevote": True,
+            })
+
+    def _start_election(self) -> None:
+        self._set_term(self.current_term + 1, vote_for=self.member_id)
+        self._become(RaftRole.CANDIDATE)
+        self._votes = {self.member_id}
+        self._election_deadline_ms = self._next_election_deadline()
+        if self._quorum(len(self._votes)):
+            self._become_leader()
+            return
+        for m in self._other_members():
+            self._send(m, "vote", {
+                "term": self.current_term,
+                "candidate": self.member_id,
+                "lastLogIndex": self._last_log_index(),
+                "lastLogTerm": self._last_log_term(),
+                "prevote": False,
+            })
+
+    def _on_vote_request(self, sender: str, req: dict) -> None:
+        term = req["term"]
+        up_to_date = (
+            req["lastLogTerm"] > self._last_log_term()
+            or (req["lastLogTerm"] == self._last_log_term()
+                and req["lastLogIndex"] >= self._last_log_index())
+        )
+        if req.get("prevote"):
+            # grant if we'd vote for them in term `term` and our own election
+            # timer has expired enough that a real election is plausible
+            granted = term > self.current_term and up_to_date
+            self._send(sender, "vote-resp", {
+                "term": self.current_term, "granted": granted, "prevote": True,
+                "voter": self.member_id,
+            })
+            return
+        if term > self.current_term:
+            self._set_term(term)
+            self._become(RaftRole.FOLLOWER)
+        granted = (
+            term == self.current_term
+            and self.voted_for in (None, req["candidate"])
+            and up_to_date
+        )
+        if granted:
+            self.voted_for = req["candidate"]
+            self._store_meta()
+            self._election_deadline_ms = self._next_election_deadline()
+        self._send(sender, "vote-resp", {
+            "term": self.current_term, "granted": granted, "prevote": False,
+            "voter": self.member_id,
+        })
+
+    def _on_vote_response(self, sender: str, resp: dict) -> None:
+        if resp.get("prevote"):
+            if resp["granted"] and self.role != RaftRole.LEADER:
+                self._prevotes.add(resp["voter"])
+                if self._quorum(len(self._prevotes)):
+                    self._start_election()
+            return
+        if resp["term"] > self.current_term:
+            self._set_term(resp["term"])
+            self._become(RaftRole.FOLLOWER)
+            return
+        if self.role != RaftRole.CANDIDATE or resp["term"] != self.current_term:
+            return
+        if resp["granted"]:
+            self._votes.add(resp["voter"])
+            if self._quorum(len(self._votes)):
+                self._become_leader()
+
+    def _become_leader(self) -> None:
+        self._become(RaftRole.LEADER)
+        self.leader_id = self.member_id
+        last = self._last_log_index()
+        self.next_index = {m: last + 1 for m in self._other_members()}
+        self.match_index = {m: 0 for m in self._other_members()}
+        # commit an initial entry to finalize entries from previous terms
+        # (reference: InitialEntry appended on leader transition)
+        self._append_local({"term": self.current_term, "init": True, "asqn": -1,
+                            "data": b""})
+        self._broadcast_appends()
+
+    # -- write ingress (ZeebeLogAppender.appendEntry equivalent) ---------------
+
+    def append(self, data: bytes, asqn: int = -1,
+               on_commit: Callable[[int], None] | None = None) -> int | None:
+        """Leader-only append; returns the raft index (None if not leader).
+        ``on_commit`` fires with the index once the entry is replicated to a
+        quorum (reference: AppendListener.onCommit)."""
+        if self.role != RaftRole.LEADER:
+            return None
+        index = self._append_local({
+            "term": self.current_term, "init": False, "asqn": asqn, "data": data,
+        })
+        if on_commit is not None:
+            self._pending_appends[index] = on_commit
+        self._broadcast_appends()
+        return index
+
+    def _append_local(self, entry: dict) -> int:
+        asqn = entry.get("asqn", -1)
+        rec = self.journal.append(
+            packb({k: v for k, v in entry.items() if k != "index"}),
+            asqn=asqn if asqn is not None and asqn >= 0 else -1,  # ASQN_IGNORE
+        )
+        return rec.index
+
+    # -- replication ----------------------------------------------------------
+
+    def _broadcast_appends(self) -> None:
+        self._last_heartbeat_sent_ms = self.clock_millis()
+        for m in self._other_members():
+            self._send_append(m)
+        self._advance_commit()  # single-node cluster commits immediately
+
+    def _send_append(self, member: str) -> None:
+        next_idx = self.next_index.get(member, self._last_log_index() + 1)
+        if next_idx <= self.snapshot_index:
+            self._send_snapshot(member)
+            return
+        prev_index = next_idx - 1
+        prev_term = self._entry_term(prev_index)
+        entries = self._read_entries(next_idx, MAX_ENTRIES_PER_APPEND)
+        self._send(member, "append", {
+            "term": self.current_term,
+            "leader": self.member_id,
+            "prevIndex": prev_index,
+            "prevTerm": prev_term,
+            "entries": entries,
+            "commit": self.commit_index,
+        })
+
+    def _on_append_request(self, sender: str, req: dict) -> None:
+        if req["term"] < self.current_term:
+            self._send(sender, "append-resp", {
+                "term": self.current_term, "success": False,
+                "lastIndex": self._last_log_index(), "follower": self.member_id,
+            })
+            return
+        if req["term"] > self.current_term:
+            self._set_term(req["term"])
+        if self.role != RaftRole.FOLLOWER:
+            self._become(RaftRole.FOLLOWER)
+        self.leader_id = req["leader"]
+        self._election_deadline_ms = self._next_election_deadline()
+
+        prev_index, prev_term = req["prevIndex"], req["prevTerm"]
+        local_prev_term = self._entry_term(prev_index)
+        if prev_index > 0 and local_prev_term != prev_term:
+            # consistency check failed: ask leader to back up
+            self._send(sender, "append-resp", {
+                "term": self.current_term, "success": False,
+                "lastIndex": min(self._last_log_index(), prev_index - 1),
+                "follower": self.member_id,
+            })
+            return
+        for entry in req["entries"]:
+            index = entry["index"]
+            local_term = self._entry_term(index)
+            if local_term == -1 or index > self._last_log_index():
+                self._append_at(index, entry)
+            elif local_term != entry["term"]:
+                self.journal.truncate_after(index - 1)
+                self._append_at(index, entry)
+        if req["commit"] > self.commit_index:
+            self._set_commit(min(req["commit"], self._last_log_index()))
+        self._send(sender, "append-resp", {
+            "term": self.current_term, "success": True,
+            "lastIndex": self._last_log_index(), "follower": self.member_id,
+        })
+
+    def _append_at(self, index: int, entry: dict) -> None:
+        expected = self.journal.last_index + 1
+        if index != expected:
+            if index <= self.journal.last_index:
+                self.journal.truncate_after(index - 1)
+            else:
+                # gap after snapshot install: reset the journal base
+                self.journal.reset(index)
+        self._append_local(entry)
+
+    def _on_append_response(self, sender: str, resp: dict) -> None:
+        if resp["term"] > self.current_term:
+            self._set_term(resp["term"])
+            self._become(RaftRole.FOLLOWER)
+            return
+        if self.role != RaftRole.LEADER:
+            return
+        follower = resp["follower"]
+        if resp["success"]:
+            self.match_index[follower] = resp["lastIndex"]
+            self.next_index[follower] = resp["lastIndex"] + 1
+            self._advance_commit()
+        else:
+            # back up (follower hints with its last index)
+            self.next_index[follower] = max(1, min(
+                self.next_index.get(follower, 1) - 1, resp["lastIndex"] + 1
+            ))
+            self._send_append(follower)
+
+    def _advance_commit(self) -> None:
+        """Advance commit index to the highest index replicated on a quorum
+        whose entry is from the current term (Raft §5.4.2)."""
+        last = self._last_log_index()
+        for candidate in range(last, self.commit_index, -1):
+            count = 1 + sum(1 for m in self._other_members()
+                            if self.match_index.get(m, 0) >= candidate)
+            if self._quorum(count) and self._entry_term(candidate) == self.current_term:
+                self._set_commit(candidate)
+                break
+
+    def _set_commit(self, index: int) -> None:
+        if index <= self.commit_index:
+            return
+        self.commit_index = index
+        for pending_index in sorted(self._pending_appends):
+            if pending_index <= index:
+                self._pending_appends.pop(pending_index)(pending_index)
+        for listener in self.commit_listeners:
+            listener(index)
+
+    # -- snapshot install ------------------------------------------------------
+
+    def set_snapshot(self, index: int, term: int, data: bytes) -> None:
+        """Owner took a state snapshot: the log up to ``index`` can compact
+        (reference: snapshot → Raft compacts log up to snapshot index)."""
+        self.snapshot_index = index
+        self.snapshot_term = term
+        self._snapshot_bytes = data
+        self.journal.compact(index + 1)
+
+    def _send_snapshot(self, member: str) -> None:
+        snap = None
+        if self.snapshot_provider is not None:
+            snap = self.snapshot_provider()
+        if snap is None and self._snapshot_bytes is not None:
+            snap = (self.snapshot_index, self.snapshot_term, self._snapshot_bytes)
+        if snap is None:
+            return
+        index, term, data = snap
+        for offset in range(0, max(len(data), 1), SNAPSHOT_CHUNK_BYTES):
+            chunk = data[offset:offset + SNAPSHOT_CHUNK_BYTES]
+            self._send(member, "snapshot", {
+                "term": self.current_term, "leader": self.member_id,
+                "index": index, "snapTerm": term,
+                "offset": offset, "chunk": chunk,
+                "done": offset + SNAPSHOT_CHUNK_BYTES >= len(data),
+            })
+
+    def _on_install_snapshot(self, sender: str, req: dict) -> None:
+        if req["term"] < self.current_term:
+            return
+        if req["term"] > self.current_term:
+            self._set_term(req["term"])
+        self._become(RaftRole.FOLLOWER)
+        self.leader_id = req["leader"]
+        self._election_deadline_ms = self._next_election_deadline()
+        if req["offset"] == 0:
+            self._pending_snapshot = {"index": req["index"], "term": req["snapTerm"],
+                                      "data": bytearray()}
+        if self._pending_snapshot is None:
+            return
+        self._pending_snapshot["data"] += req["chunk"]
+        if req["done"]:
+            snap = self._pending_snapshot
+            self._pending_snapshot = None
+            self.snapshot_index = snap["index"]
+            self.snapshot_term = snap["term"]
+            self._snapshot_bytes = bytes(snap["data"])
+            self.journal.reset(snap["index"] + 1)
+            self.commit_index = max(self.commit_index, snap["index"])
+            if self.snapshot_receiver is not None:
+                self.snapshot_receiver(bytes(snap["data"]))
+            self._send(sender, "append-resp", {
+                "term": self.current_term, "success": True,
+                "lastIndex": snap["index"], "follower": self.member_id,
+            })
+
+    # -- helpers ---------------------------------------------------------------
+
+    def _other_members(self) -> list[str]:
+        return [m for m in self.members if m != self.member_id]
+
+    def _quorum(self, count: int) -> bool:
+        return count >= len(self.members) // 2 + 1
+
+    def _set_term(self, term: int, vote_for: str | None = None) -> None:
+        if term > self.current_term or vote_for is not None:
+            self.current_term = term
+            self.voted_for = vote_for
+            self._store_meta()
+
+    def _become(self, role: RaftRole) -> None:
+        if self.role is role:
+            return
+        self.role = role
+        if role != RaftRole.LEADER:
+            self._pending_appends.clear()
+        for listener in self.role_listeners:
+            listener(role, self.current_term)
+
+    def _send(self, member: str, suffix: str, payload: dict) -> None:
+        self.messaging.send(member, f"raft-{self.partition_id}-{suffix}", payload)
+
+    # -- committed-entry reader (log storage integration) ----------------------
+
+    def committed_entries(self, from_index: int) -> list[dict]:
+        """Entries up to the commit index (application entries only carry data)."""
+        out = []
+        for rec in self.journal.read_from(from_index):
+            if rec.index > self.commit_index:
+                break
+            entry = unpackb(rec.data)
+            entry["index"] = rec.index
+            out.append(entry)
+        return out

@@ -107,13 +107,13 @@ class EventAppliers:
         reg[(ValueType.SIGNAL_SUBSCRIPTION, int(SignalSubscriptionIntent.DELETED))] = self._signal_sub_deleted
         reg[(ValueType.ESCALATION, int(EscalationIntent.ESCALATED))] = self._noop
         reg[(ValueType.ESCALATION, int(EscalationIntent.NOT_ESCALATED))] = self._noop
-        from zeebe_tpu.protocol.intent import CommandDistributionIntent, DeploymentIntent as _DI
+        from zeebe_tpu.protocol.intent import CommandDistributionIntent
 
         reg[(ValueType.COMMAND_DISTRIBUTION, int(CommandDistributionIntent.STARTED))] = self._distribution_started
         reg[(ValueType.COMMAND_DISTRIBUTION, int(CommandDistributionIntent.DISTRIBUTING))] = self._distribution_distributing
         reg[(ValueType.COMMAND_DISTRIBUTION, int(CommandDistributionIntent.ACKNOWLEDGED))] = self._distribution_acknowledged
         reg[(ValueType.COMMAND_DISTRIBUTION, int(CommandDistributionIntent.FINISHED))] = self._distribution_finished
-        reg[(ValueType.DEPLOYMENT, int(_DI.DISTRIBUTED))] = self._noop
+        reg[(ValueType.DEPLOYMENT, int(DeploymentIntent.DISTRIBUTED))] = self._noop
 
     def can_apply(self, record: Record) -> bool:
         return (record.value_type, int(record.intent)) in self._appliers

@@ -103,11 +103,9 @@ class Engine(RecordProcessor):
         dist_ack = CommandDistributionAcknowledgeProcessor(self.state)
         self.distribution_ack = dist_ack
 
-        from zeebe_tpu.protocol.intent import DeploymentIntent as _DI
-
         def _deployment_fully_distributed(wr, distribution_key, stored):
             wr.append_event(
-                distribution_key, ValueType.DEPLOYMENT, _DI.FULLY_DISTRIBUTED,
+                distribution_key, ValueType.DEPLOYMENT, DeploymentIntent.FULLY_DISTRIBUTED,
                 stored.get("commandValue", {}),
             )
 

@@ -146,11 +146,17 @@ class DeploymentProcessor:
 
     def _apply_distributed_deployment(self, cmd: LoggedRecord, writers: Writers) -> None:
         value = cmd.record.value
-        # parse each resource exactly once (mirrors the origin path)
-        executables: dict[str, "object"] = {}
-        for res in value.get("resources", []):
-            for model in parse_bpmn_xml(res["resource"]):
-                executables[model.process_id] = (res["resource"], transform(model))
+        executables: dict[str, tuple[str, "object"]] = {}
+
+        def parsed(process_id: str) -> tuple[str, "object"] | None:
+            # parse lazily, each resource at most once: a no-op redeploy
+            # (all metas duplicate/digest-matched) must not pay any parse cost
+            if not executables:
+                for res in value.get("resources", []):
+                    for model in parse_bpmn_xml(res["resource"]):
+                        executables[model.process_id] = (res["resource"], transform(model))
+            return executables.get(process_id)
+
         for meta in value.get("processesMetadata", []):
             if meta.get("duplicate"):
                 continue
@@ -158,7 +164,7 @@ class DeploymentProcessor:
             # purged must not re-deploy (digest check, same as the origin path)
             if self.state.processes.latest_digest(meta["bpmnProcessId"]) == meta["checksum"]:
                 continue
-            entry = executables.get(meta["bpmnProcessId"])
+            entry = parsed(meta["bpmnProcessId"])
             if entry is None:
                 continue
             xml, exe = entry

@@ -90,9 +90,9 @@ class ColumnFamilyCode(enum.IntEnum):
     DISTRIBUTION = 120
     PENDING_DISTRIBUTION = 121
     COMMAND_DISTRIBUTION_RECORD = 122
+    RECEIVED_DISTRIBUTION_BY_TIME = 123
     MULTI_INSTANCE_OUTPUT = 130
     AWAIT_RESULT_METADATA = 131
-    RECEIVED_DISTRIBUTION_BY_TIME = 123
     CHECKPOINT = 140
     FORMS = 150
     DMN_DECISIONS = 160
