@@ -291,6 +291,7 @@ class TestTcpMessaging:
             a.send("b", "echo", {"x": 1, "blob": b"\x00\xff"})
             deadline = time.time() + 5
             while not got and time.time() < deadline:
+                b.poll()  # handlers run on the application thread
                 time.sleep(0.01)
             assert got == [("a", {"x": 1, "blob": b"\x00\xff"})]
         finally:

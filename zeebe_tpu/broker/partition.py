@@ -1,0 +1,360 @@
+"""ZeebePartition: one partition's full vertical on one broker node.
+
+Reference: broker/src/main/java/io/camunda/zeebe/broker/system/partitions/
+ZeebePartition.java:38 — an actor listening to Raft role changes and running
+the transition steps (ZeebePartitionFactory.java:71-85): LogStorage → LogStream
+→ ZeebeDb (recover from snapshot, StateControllerImpl.recover :74) → …
+→ StreamProcessor → SnapshotDirector → ExporterDirector.
+
+Design (tpu-native): the Raft log is the replication transport + durable
+command record; the partition materializes the *committed prefix* into its
+local stream journal, identically on leaders and followers, so the stream
+processor, exporters, and recovery read one consistent log regardless of role.
+Positions are assigned by the leader at Raft-append time (the Sequencer run
+ahead of commit); entries that never commit are simply never materialized —
+exactly the reference's "uncommitted entries are invisible above the log
+storage" contract.
+"""
+
+from __future__ import annotations
+
+import shutil
+from pathlib import Path
+from typing import Any, Callable
+
+from zeebe_tpu.cluster.messaging import MessagingService
+from zeebe_tpu.cluster.raft import RaftNode, RaftRole
+from zeebe_tpu.engine.distribution import CommandRedistributor
+from zeebe_tpu.engine.engine import Engine
+from zeebe_tpu.engine.message_timer import DueDateCheckers
+from zeebe_tpu.exporters.director import ExporterDirector
+from zeebe_tpu.journal import SegmentedJournal
+from zeebe_tpu.logstreams import LogAppendEntry, LogStream
+from zeebe_tpu.protocol import Record
+from zeebe_tpu.protocol.msgpack import packb, unpackb
+from zeebe_tpu.state import ZbDb
+from zeebe_tpu.state.snapshot import FileBasedSnapshotStore
+from zeebe_tpu.stream import StreamProcessor, StreamProcessorMode
+
+DEFAULT_SNAPSHOT_PERIOD_MS = 5 * 60 * 1000
+
+
+class _RaftWriter:
+    """LogStreamWriter-shaped adapter the StreamProcessor writes through:
+    follow-ups and scheduled commands replicate via Raft before they become
+    readable (reference: AtomixLogStorage.append → LeaderRole.appendEntry)."""
+
+    def __init__(self, partition: "ZeebePartition") -> None:
+        self.partition = partition
+
+    def try_write(self, entries, source_position: int = -1) -> int:
+        result = self.partition.write_entries(list(entries), source_position)
+        return result if result is not None else -1
+
+
+class ZeebePartition:
+    def __init__(
+        self,
+        messaging: MessagingService,
+        partition_id: int,
+        members: list[str],
+        directory: str | Path,
+        clock_millis: Callable[[], int],
+        partition_count: int = 1,
+        exporters_factory: Callable[[], dict[str, Any]] | None = None,
+        inter_partition_sender=None,
+        response_sink: Callable[[Any], None] | None = None,
+        snapshot_period_ms: int = DEFAULT_SNAPSHOT_PERIOD_MS,
+        priority: int = 1,
+        consistency_checks: bool = True,
+    ) -> None:
+        self.partition_id = partition_id
+        self.partition_count = partition_count
+        self.directory = Path(directory)
+        self.clock_millis = clock_millis
+        # factory, not instances: each partition (and each transition) gets its
+        # own exporter instances — a shared instance's controller would ack
+        # positions into whichever partition opened it last
+        self.exporters_factory = exporters_factory or (lambda: {})
+        self.inter_partition_sender = inter_partition_sender
+        self.response_sink = response_sink or (lambda r: None)
+        self.snapshot_period_ms = snapshot_period_ms
+        self.consistency_checks = consistency_checks
+
+        self.snapshot_store = FileBasedSnapshotStore(self.directory / "snapshots")
+        self.raft = RaftNode(
+            messaging, partition_id, members, self.directory / "raft",
+            clock_millis, priority=priority,
+        )
+        self.raft.commit_listeners.append(self._on_raft_commit)
+        self.raft.role_listeners.append(self._on_role_change)
+        self.raft.snapshot_provider = self._provide_install_snapshot
+        self.raft.snapshot_receiver = self._receive_install_snapshot
+
+        self._stream_dir = self.directory / "stream"
+        self.stream_journal = SegmentedJournal(self._stream_dir)
+        self.stream = LogStream(self.stream_journal, partition_id, clock=clock_millis)
+
+        self.role = RaftRole.FOLLOWER
+        self.db: ZbDb | None = None
+        self.engine: Engine | None = None
+        self.processor: StreamProcessor | None = None
+        self.exporter_director: ExporterDirector | None = None
+        self.checkers: DueDateCheckers | None = None
+        self.redistributor: CommandRedistributor | None = None
+        self._applied_raft_index = 0
+        self._next_position = self.stream.last_position + 1
+        self._last_snapshot_ms = clock_millis()
+        self._transition()  # start as follower (replay mode)
+        # catch up on whatever the raft log already committed before we wired
+        self._materialize_committed()
+
+    # -- raft integration ------------------------------------------------------
+
+    def _on_raft_commit(self, commit_index: int) -> None:
+        self._materialize_committed()
+
+    def _materialize_committed(self) -> None:
+        """Append newly committed raft entries' payloads to the stream journal."""
+        for entry in self.raft.committed_entries(self._applied_raft_index + 1):
+            self._applied_raft_index = entry["index"]
+            if entry.get("init") or not entry.get("data"):
+                continue
+            self.stream.append_committed_payload(entry["data"], entry["asqn"])
+        self._next_position = max(self._next_position, self.stream.last_position + 1)
+
+    def _on_role_change(self, role: RaftRole, term: int) -> None:
+        self.role = role
+        self._transition()
+
+    # -- transition steps (reference: PartitionTransitionImpl) -----------------
+
+    def _transition(self) -> None:
+        """Tear down and rebuild the processing vertical for the current role:
+        recover db from the latest snapshot, replay the stream journal, then
+        process (leader) or keep replaying (follower)."""
+        self._recover_db()
+        mode = (
+            StreamProcessorMode.PROCESSING
+            if self.role == RaftRole.LEADER else StreamProcessorMode.REPLAY
+        )
+        self.engine = Engine(
+            self.db, self.partition_id, clock_millis=self.clock_millis,
+            partition_count=self.partition_count,
+        )
+        if self.inter_partition_sender is not None:
+            self.engine.wire_sender(self.inter_partition_sender)
+        self.processor = StreamProcessor(
+            self.stream, self.db, self.engine, mode=mode,
+            response_sink=self.response_sink, clock_millis=self.clock_millis,
+            writer=_RaftWriter(self),
+        )
+        self.processor.start()
+        self.checkers = DueDateCheckers(
+            self.engine.state, self.processor.schedule_service, self.clock_millis
+        )
+        self.redistributor = CommandRedistributor(
+            self.engine.state, self.engine.sender,
+            self.processor.schedule_service, self.clock_millis,
+        )
+        if self.exporter_director is not None:
+            self.exporter_director.close()  # flush partial bulks, run Exporter.close
+        self.exporter_director = ExporterDirector(
+            self.stream, self.db, self.exporters_factory(),
+        )
+        if self.role == RaftRole.LEADER:
+            # leader sequencer continues after the last position in the raft
+            # log (committed or not — uncommitted entries still own positions)
+            self._next_position = max(
+                self._next_position, self._last_raft_position() + 1
+            )
+
+    def _recover_db(self) -> None:
+        """StateControllerImpl.recover: latest valid snapshot → runtime db."""
+        snapshot = self.snapshot_store.latest_snapshot()
+        if snapshot is not None:
+            self.db = ZbDb.from_snapshot_bytes(
+                snapshot.read_file("state.bin"),
+                consistency_checks=self.consistency_checks,
+            )
+        else:
+            self.db = ZbDb(consistency_checks=self.consistency_checks)
+
+    def _last_raft_position(self) -> int:
+        """Highest stream position assigned in the raft log (scan the suffix
+        after the materialized prefix; usually empty or tiny)."""
+        last = self.stream.last_position
+        for rec in self.raft.journal.read_from(self._applied_raft_index + 1):
+            entry = unpackb(rec.data)
+            if entry.get("init") or not entry.get("data"):
+                continue
+            # count of records in the batch payload is the first u32
+            import struct
+
+            count = struct.unpack_from("<I", entry["data"], 0)[0]
+            last = max(last, entry["asqn"] + count - 1)
+        return last
+
+    # -- command ingress (CommandApiRequestHandler equivalent) -----------------
+
+    def write_commands(self, records: list[Record],
+                       source_position: int = -1) -> int | None:
+        """Leader-only: sequence the records and append to Raft; they become
+        processable once committed. Returns the last assigned position."""
+        return self.write_entries([LogAppendEntry(r) for r in records],
+                                  source_position)
+
+    def write_entries(self, entries: list[LogAppendEntry],
+                      source_position: int = -1) -> int | None:
+        if self.role != RaftRole.LEADER or not entries:
+            return None
+        first_position = self._next_position
+        payload = self.stream.serialize_batch(entries, first_position, source_position)
+        index = self.raft.append(payload, asqn=first_position)
+        if index is None:
+            return None
+        self._next_position = first_position + len(entries)
+        return first_position + len(entries) - 1
+
+    # -- pump (the actor loop, driven by the broker) ---------------------------
+
+    def pump(self) -> int:
+        """Advance processing/replay, scheduled work, and exporters."""
+        work = 0
+        if self.processor is None:
+            return work
+        if self.role == RaftRole.LEADER and self.processor.phase.value == "processing":
+            work += self.processor.run_until_idle()
+            self.checkers.reschedule()
+            self.redistributor.reschedule()
+            due = self.processor.schedule_service.next_due_millis
+            if due is not None and due <= self.clock_millis():
+                work += 1  # scheduled commands were written; next pump processes
+        else:
+            work += self.processor.replay_available()
+        work += self.exporter_director.export_available()
+        self._maybe_snapshot()
+        return work
+
+    # -- snapshotting (AsyncSnapshotDirector equivalent) -----------------------
+
+    def _maybe_snapshot(self) -> None:
+        now = self.clock_millis()
+        if now - self._last_snapshot_ms < self.snapshot_period_ms:
+            return
+        self._last_snapshot_ms = now
+        self.take_snapshot()
+
+    def take_snapshot(self) -> bool:
+        """Snapshot the db at lastProcessedPosition, then compact both logs up
+        to min(processed, exported) (reference: AsyncSnapshotDirector.java:37 —
+        wait for commit, persist, then Raft compacts)."""
+        if self.processor is None or self.db is None:
+            return False
+        processed = self.processor.last_processed_position
+        if processed < 0:
+            return False
+        # the reference waits until lastWrittenPosition is committed before
+        # persisting (AsyncSnapshotDirector): our materialized stream journal
+        # IS the committed prefix, so written-but-unmaterialized means wait
+        if self.processor.last_written_position > self.stream.last_position:
+            return False
+        exported = self.exporter_director.lowest_exporter_position()
+        term = self.raft.current_term
+        raft_index = self.raft.journal.seek_to_asqn(processed)
+        if raft_index <= 0:
+            raft_index = self.raft.snapshot_index
+        try:
+            transient = self.snapshot_store.new_transient_snapshot(
+                raft_index, term, processed, exported if exported < 2**62 else processed
+            )
+        except Exception:
+            return False  # not newer than the latest snapshot
+        transient.write_file("state.bin", self.db.to_snapshot_bytes())
+        transient.write_file("meta.bin", packb({
+            "lastProcessed": processed,
+            "lastPosition": self.stream.last_position,
+        }))
+        snapshot = transient.persist()
+        # raft log compaction bound: nothing above the snapshot index, nothing
+        # unexported, nothing unmaterialized
+        compact_position = min(processed, exported)
+        compact_index = self.raft.journal.seek_to_asqn(compact_position)
+        if compact_index > 1:
+            # the snapshot boundary's term is the term of the entry it replaces
+            # (not the current term) or _entry_term answers wrongly at the
+            # boundary and replication backs up into a needless snapshot install
+            boundary_term = self.raft.entry_term(compact_index - 1)
+            self.raft.set_snapshot(
+                compact_index - 1, boundary_term,
+                self._install_payload(snapshot),
+            )
+        return True
+
+    # -- snapshot replication (leader → lagging follower) ----------------------
+
+    def _install_payload(self, snapshot) -> bytes:
+        return packb({
+            "state": snapshot.read_file("state.bin"),
+            "meta": snapshot.read_file("meta.bin"),
+        })
+
+    def _provide_install_snapshot(self):
+        snapshot = self.snapshot_store.latest_snapshot()
+        if snapshot is None:
+            return None
+        return (self.raft.snapshot_index, self.raft.snapshot_term,
+                self._install_payload(snapshot))
+
+    def _receive_install_snapshot(self, data: bytes) -> None:
+        """Follower fell behind the leader's compacted log: replace local state
+        wholesale (reference: PassiveRole + FileBasedReceivedSnapshot →
+        StateControllerImpl recover)."""
+        payload = unpackb(data)
+        meta = unpackb(payload["meta"])
+        # persist locally so restart recovers from it
+        try:
+            transient = self.snapshot_store.new_transient_snapshot(
+                self.raft.snapshot_index, self.raft.snapshot_term,
+                meta["lastProcessed"], meta["lastProcessed"],
+            )
+            transient.write_file("state.bin", payload["state"])
+            transient.write_file("meta.bin", payload["meta"])
+            transient.persist()
+        except Exception:
+            pass  # not newer than what we have
+        # reset the stream journal past the snapshot and rebuild the vertical
+        self.stream_journal.close()
+        shutil.rmtree(self._stream_dir, ignore_errors=True)
+        self.stream_journal = SegmentedJournal(self._stream_dir)
+        self.stream = LogStream(self.stream_journal, self.partition_id,
+                                clock=self.clock_millis)
+        self.stream._next_position = meta["lastPosition"] + 1
+        self._next_position = meta["lastPosition"] + 1
+        self._transition()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def tick(self) -> None:
+        self.raft.tick()
+
+    def close(self) -> None:
+        if self.exporter_director is not None:
+            self.exporter_director.close()
+        self.raft.close()
+        self.stream_journal.close()
+
+    @property
+    def is_leader(self) -> bool:
+        return self.role == RaftRole.LEADER
+
+    def health(self) -> dict:
+        return {
+            "partitionId": self.partition_id,
+            "role": self.role.value,
+            "term": self.raft.current_term,
+            "commitIndex": self.raft.commit_index,
+            "lastPosition": self.stream.last_position,
+            "lastProcessed": self.processor.last_processed_position
+            if self.processor else -1,
+        }

@@ -123,7 +123,13 @@ _FRAME = struct.Struct("<I")
 class TcpMessagingService(MessagingService):
     """asyncio TCP messaging: one connection per peer, frames are
     ``len | msgpack{topic, sender, payload}`` (the NettyMessagingService
-    protocol-v2 shape without the compression/TLS options)."""
+    protocol-v2 shape without the compression/TLS options).
+
+    Thread model: the IO loop only *enqueues* received frames; the application
+    thread dispatches them to handlers via ``poll()`` — so RaftNode /
+    MembershipService state machines are mutated from exactly one thread,
+    identical to the loopback network's ``deliver_all`` (single-writer per
+    partition, the same discipline the reference enforces with actors)."""
 
     def __init__(self, member_id: str, bind: tuple[str, int],
                  peers: dict[str, tuple[str, int]]) -> None:
@@ -136,6 +142,8 @@ class TcpMessagingService(MessagingService):
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self._started = threading.Event()
+        self._inbox: deque[tuple[str, str, Any]] = deque()
+        self._inbox_lock = threading.Lock()
 
     def subscribe(self, topic: str, handler: Handler) -> None:
         self.handlers[topic] = handler
@@ -175,11 +183,27 @@ class TcpMessagingService(MessagingService):
                 header = await reader.readexactly(_FRAME.size)
                 (length,) = _FRAME.unpack(header)
                 frame = unpackb(await reader.readexactly(length))
-                handler = self.handlers.get(frame["topic"])
-                if handler is not None:
-                    handler(frame["sender"], frame["payload"])
+                with self._inbox_lock:
+                    self._inbox.append(
+                        (frame["topic"], frame["sender"], frame["payload"])
+                    )
         except (asyncio.IncompleteReadError, ConnectionError):
             writer.close()
+
+    def poll(self, max_messages: int = 10_000) -> int:
+        """Dispatch queued frames to handlers on the calling thread. Drive this
+        from the same loop that calls tick() on the protocol state machines."""
+        count = 0
+        while count < max_messages:
+            with self._inbox_lock:
+                if not self._inbox:
+                    break
+                topic, sender, payload = self._inbox.popleft()
+            handler = self.handlers.get(topic)
+            if handler is not None:
+                handler(sender, payload)
+            count += 1
+        return count
 
     def send(self, member_id: str, topic: str, payload: Any) -> None:
         if self._loop is None:

@@ -83,6 +83,7 @@ class RaftNode:
         self.snapshot_term = 0
         self._snapshot_bytes: bytes | None = None
         self._pending_snapshot: dict[str, Any] | None = None
+        self._snapshot_sent_ms: dict[str, int] = {}
 
         # leader volatile state
         self.next_index: dict[str, int] = {}
@@ -175,7 +176,12 @@ class RaftNode:
 
     def _start_prevote(self) -> None:
         """Pre-vote phase: probe electability without disturbing the term
-        (reference: raft pre-vote, PreVoteRequest)."""
+        (reference: raft pre-vote, PreVoteRequest). A candidate whose election
+        timed out retries the election directly — prevote responses are only
+        collected while still a follower."""
+        if self.role == RaftRole.CANDIDATE:
+            self._start_election()
+            return
         self._election_deadline_ms = self._next_election_deadline()
         self._prevotes = {self.member_id}
         if self._quorum(len(self._prevotes)):
@@ -191,6 +197,7 @@ class RaftNode:
             })
 
     def _start_election(self) -> None:
+        self._prevotes = set()  # stale grants must not re-trigger elections
         self._set_term(self.current_term + 1, vote_for=self.member_id)
         self._become(RaftRole.CANDIDATE)
         self._votes = {self.member_id}
@@ -215,9 +222,14 @@ class RaftNode:
                 and req["lastLogIndex"] >= self._last_log_index())
         )
         if req.get("prevote"):
-            # grant if we'd vote for them in term `term` and our own election
-            # timer has expired enough that a real election is plausible
-            granted = term > self.current_term and up_to_date
+            # leader stickiness: deny pre-votes while we hear from a live
+            # leader, so a rejoining partitioned node cannot depose a healthy
+            # one (raft pre-vote + check-quorum semantics)
+            heard_recently = (
+                self.leader_id is not None
+                and self.clock_millis() - self._last_heartbeat_ms < ELECTION_TIMEOUT_MS
+            )
+            granted = term > self.current_term and up_to_date and not heard_recently
             self._send(sender, "vote-resp", {
                 "term": self.current_term, "granted": granted, "prevote": True,
                 "voter": self.member_id,
@@ -242,7 +254,9 @@ class RaftNode:
 
     def _on_vote_response(self, sender: str, resp: dict) -> None:
         if resp.get("prevote"):
-            if resp["granted"] and self.role != RaftRole.LEADER:
+            # only followers collect pre-votes; once the election started the
+            # round is over (stale grants otherwise burn terms + reset votes)
+            if resp["granted"] and self.role == RaftRole.FOLLOWER:
                 self._prevotes.add(resp["voter"])
                 if self._quorum(len(self._prevotes)):
                     self._start_election()
@@ -332,6 +346,7 @@ class RaftNode:
         if self.role != RaftRole.FOLLOWER:
             self._become(RaftRole.FOLLOWER)
         self.leader_id = req["leader"]
+        self._last_heartbeat_ms = self.clock_millis()
         self._election_deadline_ms = self._next_election_deadline()
 
         prev_index, prev_term = req["prevIndex"], req["prevTerm"]
@@ -419,7 +434,18 @@ class RaftNode:
         self._snapshot_bytes = data
         self.journal.compact(index + 1)
 
+    def entry_term(self, index: int) -> int:
+        """Term of the entry at ``index`` (snapshot boundary aware)."""
+        return self._entry_term(index)
+
     def _send_snapshot(self, member: str) -> None:
+        # throttle: a full snapshot per heartbeat per lagging follower is
+        # O(snapshot bytes) of redundant work; resend only after a quiet period
+        now = self.clock_millis()
+        last_sent = self._snapshot_sent_ms.get(member, -ELECTION_TIMEOUT_MS)
+        if now - last_sent < ELECTION_TIMEOUT_MS:
+            return
+        self._snapshot_sent_ms[member] = now
         snap = None
         if self.snapshot_provider is not None:
             snap = self.snapshot_provider()
@@ -444,11 +470,18 @@ class RaftNode:
             self._set_term(req["term"])
         self._become(RaftRole.FOLLOWER)
         self.leader_id = req["leader"]
+        self._last_heartbeat_ms = self.clock_millis()
         self._election_deadline_ms = self._next_election_deadline()
         if req["offset"] == 0:
             self._pending_snapshot = {"index": req["index"], "term": req["snapTerm"],
                                       "data": bytearray()}
         if self._pending_snapshot is None:
+            return
+        # continuity check: a dropped middle chunk must abort reassembly and
+        # wait for a fresh offset-0 retransmit, never install torn bytes
+        if (req["offset"] != len(self._pending_snapshot["data"])
+                or req["index"] != self._pending_snapshot["index"]):
+            self._pending_snapshot = None
             return
         self._pending_snapshot["data"] += req["chunk"]
         if req["done"]:

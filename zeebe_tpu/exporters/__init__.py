@@ -1,5 +1,17 @@
 """Exporter SPI + built-in exporters (SURVEY.md §2.13 exporters)."""
 
+from zeebe_tpu.exporters.api import Exporter, ExporterContext, ExporterController
+from zeebe_tpu.exporters.director import ExporterDirector, ExportersState
+from zeebe_tpu.exporters.elasticsearch import ElasticsearchExporter
 from zeebe_tpu.exporters.recording import RecordingExporter, RecordStream
 
-__all__ = ["RecordingExporter", "RecordStream"]
+__all__ = [
+    "Exporter",
+    "ExporterContext",
+    "ExporterController",
+    "ExporterDirector",
+    "ExportersState",
+    "ElasticsearchExporter",
+    "RecordingExporter",
+    "RecordStream",
+]

@@ -1,0 +1,61 @@
+"""Exporter SPI: the plugin seam for shipping committed records to sinks.
+
+Reference: exporter-api/src/main/java/io/camunda/zeebe/exporter/api/
+Exporter.java — lifecycle ``configure(context) → open(controller) →
+export(record)* → close()``; the Controller exposes
+``updateLastExportedRecordPosition`` (bounds log compaction) and
+``scheduleCancellableTask`` (flush timers).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from zeebe_tpu.logstreams import LoggedRecord
+
+
+@dataclass
+class ExporterContext:
+    """Configuration handed to the exporter before open (reference:
+    Exporter#configure(Context) — id, configuration map, record filter)."""
+
+    exporter_id: str
+    configuration: dict[str, Any] = field(default_factory=dict)
+    # optional record filter: (record_type_name, value_type_name) -> bool
+    record_filter: Callable[[LoggedRecord], bool] | None = None
+
+
+class ExporterController:
+    """Hands the exporter its position-acknowledgement and task scheduling
+    (reference: exporter-api Controller; ExporterContainer implements it)."""
+
+    def __init__(self, on_position: Callable[[int], None],
+                 schedule: Callable[[int, Callable[[], None]], Any] | None = None) -> None:
+        self._on_position = on_position
+        self._schedule = schedule
+
+    def update_last_exported_position(self, position: int) -> None:
+        self._on_position(position)
+
+    def schedule_task(self, delay_millis: int, task: Callable[[], None]) -> Any:
+        if self._schedule is None:
+            raise RuntimeError("scheduling not available in this context")
+        return self._schedule(delay_millis, task)
+
+
+class Exporter:
+    """Base class; subclasses override what they need (reference default
+    methods on the Exporter interface)."""
+
+    def configure(self, context: ExporterContext) -> None:
+        self.context = context
+
+    def open(self, controller: ExporterController) -> None:
+        self.controller = controller
+
+    def export(self, record: LoggedRecord) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass

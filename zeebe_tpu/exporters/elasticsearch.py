@@ -1,0 +1,98 @@
+"""Elasticsearch/OpenSearch-compatible exporter.
+
+Reference: exporters/elasticsearch-exporter/src/main/java/io/camunda/zeebe/
+exporter/ElasticsearchExporter.java — converts records to JSON documents,
+batches them into a bulk request (one action line + one source line per
+record, the ES `_bulk` NDJSON format), indexes per value-type-and-date
+(``zeebe-record_<valueType>_<version>_<date>``), flushes on bulk size/memory/
+interval, acks the last flushed position.
+
+No network egress in this environment, so the bulk sink is pluggable: the
+default writes NDJSON bulk files to a directory (one file per flush); a
+callable sink receives the raw NDJSON payload and can POST it to a real
+cluster. The document shape matches the reference's record JSON (camelCase
+fields via ``Record.to_json_dict``).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Callable
+
+from zeebe_tpu.exporters.api import Exporter, ExporterContext, ExporterController
+from zeebe_tpu.logstreams import LoggedRecord
+
+INDEX_PREFIX = "zeebe-record"
+VERSION = "8.4.0"
+
+
+class ElasticsearchExporter(Exporter):
+    def __init__(self, sink: Callable[[str], None] | None = None,
+                 directory: str | Path | None = None,
+                 bulk_size: int = 1_000) -> None:
+        if sink is None and directory is None:
+            raise ValueError("need a sink callable or a bulk-file directory")
+        self._directory = Path(directory) if directory else None
+        if self._directory is not None:
+            self._directory.mkdir(parents=True, exist_ok=True)
+        self._sink = sink
+        self.bulk_size = bulk_size
+        self._bulk: list[str] = []
+        self._bulk_last_position = -1
+        self._flush_count = 0
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def configure(self, context: ExporterContext) -> None:
+        super().configure(context)
+        self.bulk_size = context.configuration.get("bulkSize", self.bulk_size)
+
+    def export(self, record: LoggedRecord) -> None:
+        doc = record.record.to_json_dict()
+        doc["position"] = record.position
+        index = self._index_for(record)
+        doc_id = f"{record.position}-{doc.get('partitionId', 1)}"
+        self._bulk.append(json.dumps(
+            {"index": {"_index": index, "_id": doc_id}}, separators=(",", ":")
+        ))
+        self._bulk.append(json.dumps(doc, separators=(",", ":"), default=_json_default))
+        self._bulk_last_position = record.position
+        if len(self._bulk) // 2 >= self.bulk_size:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._bulk:
+            return
+        payload = "\n".join(self._bulk) + "\n"
+        if self._sink is not None:
+            self._sink(payload)
+        if self._directory is not None:
+            path = self._directory / f"bulk-{self._flush_count:08d}.ndjson"
+            path.write_text(payload)
+        self._flush_count += 1
+        self._bulk.clear()
+        self.controller.update_last_exported_position(self._bulk_last_position)
+
+    def close(self) -> None:
+        self.flush()
+
+    # -- helpers ---------------------------------------------------------------
+
+    def _index_for(self, record: LoggedRecord) -> str:
+        value_type = record.record.value_type.name.lower().replace("_", "-")
+        day = _day_of(record.record.timestamp)
+        return f"{INDEX_PREFIX}_{value_type}_{VERSION}_{day}"
+
+
+def _day_of(timestamp_millis: int) -> str:
+    import datetime
+
+    dt = datetime.datetime.fromtimestamp(timestamp_millis / 1000, datetime.timezone.utc)
+    return dt.strftime("%Y-%m-%d")
+
+
+def _json_default(obj):
+    if isinstance(obj, bytes):
+        return obj.hex()
+    raise TypeError(f"not JSON serializable: {type(obj).__name__}")

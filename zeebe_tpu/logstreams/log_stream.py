@@ -195,6 +195,27 @@ class LogStream:
     def writer(self) -> LogStreamWriter:
         return self._writer
 
+    def append_committed_payload(self, payload: bytes, first_position: int) -> None:
+        """Materialize a batch that was sequenced elsewhere (the Raft leader)
+        and is now committed: the payload embeds its record positions, assigned
+        at ingress. Used by the broker partition on leaders AND followers — the
+        stream journal holds exactly the committed prefix of the Raft log
+        (reference: AtomixLogStorage reads committed Raft entries; we
+        materialize them so readers/recovery are identical on every role)."""
+        if first_position < self._next_position:
+            return  # already materialized (e.g. re-delivered commit)
+        jrec = self.journal.append(payload, asqn=first_position)
+        self._on_appended(first_position, jrec.index)
+        batch = self._read_batch_at(jrec.index)
+        self._next_position = batch[-1].position + 1 if batch else first_position + 1
+
+    def serialize_batch(self, entries: list[LogAppendEntry], first_position: int,
+                        source_position: int = -1) -> bytes:
+        """Sequencer half of the write path: assign positions into a payload
+        without appending (the Raft path appends only after quorum commit)."""
+        return _serialize_batch(entries, first_position, source_position,
+                                self.clock_millis())
+
     @property
     def last_position(self) -> int:
         return self._next_position - 1

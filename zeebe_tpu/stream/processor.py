@@ -72,11 +72,16 @@ class StreamProcessor:
         max_commands_in_batch: int = 100,
         response_sink: Callable[[ClientResponse], None] | None = None,
         clock_millis: Callable[[], int] | None = None,
+        writer=None,
     ) -> None:
         self.log_stream = log_stream
         self.db = db
         self.processor = processor
         self.mode = mode
+        # pluggable write path: the broker passes a Raft-appending writer so
+        # follow-ups/scheduled commands replicate before becoming readable
+        # (reference: Sequencer → LogStorageAppender → AtomixLogStorage → Raft)
+        self.writer = writer if writer is not None else log_stream.writer
         self.max_commands_in_batch = max_commands_in_batch
         self.response_sink = response_sink or (lambda response: None)
         self.phase = Phase.INITIAL
@@ -221,7 +226,7 @@ class StreamProcessor:
     def _write_and_mark(self, cmd: LoggedRecord, builder: ProcessingResultBuilder) -> None:
         entries = [LogAppendEntry(f.record, f.processed) for f in builder.follow_ups]
         if entries:
-            self.last_written_position = self.log_stream.writer.try_write(
+            self.last_written_position = self.writer.try_write(
                 entries, source_position=cmd.position
             )
         self.last_processed_position = cmd.position
@@ -252,7 +257,7 @@ class StreamProcessor:
     # -- pump ----------------------------------------------------------------
 
     def _write_scheduled_commands(self, commands: list[Record]) -> None:
-        self.log_stream.writer.try_write([LogAppendEntry(c) for c in commands])
+        self.writer.try_write([LogAppendEntry(c) for c in commands])
 
     def run_until_idle(self, max_steps: int = 1_000_000) -> int:
         """Drive scheduled tasks + processing until no work remains (or, in
