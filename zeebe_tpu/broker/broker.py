@@ -73,11 +73,24 @@ class ClusterInterPartitionSender:
         )
 
 
+def resolve_leader_partition(brokers, partition_id: int):
+    """The partition replica that currently owns leadership: during failover a
+    deposed-but-isolated leader may still claim the role; the highest term wins
+    (the gateway resolves the same way via gossiped topology)."""
+    best, best_term = None, -1
+    for b in brokers:
+        p = b.partitions.get(partition_id)
+        if p is not None and p.is_leader and p.raft.current_term > best_term:
+            best, best_term = p, p.raft.current_term
+    return best
+
+
 class Broker:
     def __init__(self, cfg: BrokerCfg, messaging: MessagingService,
                  directory: str | Path | None = None,
                  clock_millis: Callable[[], int] | None = None,
-                 exporters_factory: Callable[[], dict[str, Any]] | None = None) -> None:
+                 exporters_factory: Callable[[], dict[str, Any]] | None = None,
+                 response_sink: Callable[[Any], None] | None = None) -> None:
         import time
 
         self.cfg = cfg
@@ -92,6 +105,7 @@ class Broker:
             messaging, cfg.cluster_members, self.clock_millis
         )
         self.responses: list = []
+        sink = response_sink if response_sink is not None else self.responses.append
         self.partitions: dict[int, ZeebePartition] = {}
         sender = ClusterInterPartitionSender(self)
         for partition_id, members in partition_distribution(cfg).items():
@@ -104,7 +118,7 @@ class Broker:
                 partition_count=cfg.partition_count,
                 exporters_factory=exporters_factory,
                 inter_partition_sender=sender,
-                response_sink=self.responses.append,
+                response_sink=sink,
                 snapshot_period_ms=cfg.snapshot_period_ms,
                 consistency_checks=cfg.consistency_checks,
             )
@@ -261,13 +275,13 @@ class InProcessCluster:
         """During failover a deposed-but-isolated leader may still claim the
         role; the highest term wins (the gateway resolves the same way via
         gossiped topology, which always carries the newest term's claim)."""
-        best: Broker | None = None
-        best_term = -1
+        leader = resolve_leader_partition(self.brokers.values(), partition_id)
+        if leader is None:
+            return None
         for b in self.brokers.values():
-            p = b.partitions.get(partition_id)
-            if p is not None and p.is_leader and p.raft.current_term > best_term:
-                best, best_term = b, p.raft.current_term
-        return best
+            if b.partitions.get(partition_id) is leader:
+                return b
+        return None
 
     def write_command(self, partition_id: int, record: Record) -> int | None:
         broker = self.leader_broker(partition_id)

@@ -1,0 +1,175 @@
+"""zbctl-parity CLI.
+
+Reference: clients/go/cmd/zbctl/internal/commands/*.go — status, deploy,
+create instance/worker, activate jobs, complete/fail job, publish message,
+broadcast signal, resolve incident, set variables. JSON in, JSON out.
+
+Usage: python -m zeebe_tpu.cli --address host:port <command> …
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _out(obj) -> None:
+    print(json.dumps(obj, indent=2, default=str))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="zbctl",
+                                     description="tpu-zeebe cluster CLI")
+    parser.add_argument("--address", default="127.0.0.1:26500",
+                        help="gateway address (host:port)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("status", help="cluster topology")
+
+    p = sub.add_parser("deploy", help="deploy BPMN resources")
+    p.add_argument("files", nargs="+")
+
+    p = sub.add_parser("create", help="create resources")
+    create_sub = p.add_subparsers(dest="what", required=True)
+    ci = create_sub.add_parser("instance")
+    ci.add_argument("process_id")
+    ci.add_argument("--variables", default="{}")
+    ci.add_argument("--version", type=int, default=0)
+    ci.add_argument("--with-result", action="store_true")
+    cw = create_sub.add_parser("worker")
+    cw.add_argument("job_type")
+    cw.add_argument("--handler", default="",
+                    help="python expression over `job` returning variables dict")
+    cw.add_argument("--max-jobs", type=int, default=32)
+
+    p = sub.add_parser("cancel", help="cancel instance")
+    p.add_argument("what", choices=["instance"])
+    p.add_argument("key", type=int)
+
+    p = sub.add_parser("activate", help="activate jobs")
+    p.add_argument("what", choices=["jobs"])
+    p.add_argument("job_type")
+    p.add_argument("--max-jobs", type=int, default=32)
+    p.add_argument("--worker", default="zbctl")
+
+    p = sub.add_parser("complete", help="complete job")
+    p.add_argument("what", choices=["job"])
+    p.add_argument("key", type=int)
+    p.add_argument("--variables", default="{}")
+
+    p = sub.add_parser("fail", help="fail job")
+    p.add_argument("what", choices=["job"])
+    p.add_argument("key", type=int)
+    p.add_argument("--retries", type=int, required=True)
+    p.add_argument("--message", default="")
+
+    p = sub.add_parser("publish", help="publish message")
+    p.add_argument("what", choices=["message"])
+    p.add_argument("name")
+    p.add_argument("--correlation-key", required=True)
+    p.add_argument("--variables", default="{}")
+    p.add_argument("--ttl", type=int, default=3_600_000)
+    p.add_argument("--message-id", default="")
+
+    p = sub.add_parser("broadcast", help="broadcast signal")
+    p.add_argument("what", choices=["signal"])
+    p.add_argument("name")
+    p.add_argument("--variables", default="{}")
+
+    p = sub.add_parser("resolve", help="resolve incident")
+    p.add_argument("what", choices=["incident"])
+    p.add_argument("key", type=int)
+
+    p = sub.add_parser("set", help="set variables")
+    p.add_argument("what", choices=["variables"])
+    p.add_argument("key", type=int)
+    p.add_argument("--variables", required=True)
+    p.add_argument("--local", action="store_true")
+
+    args = parser.parse_args(argv)
+
+    from zeebe_tpu.client import JobWorker, ZeebeTpuClient
+
+    client = ZeebeTpuClient(args.address)
+    try:
+        return _dispatch(client, args)
+    finally:
+        client.close()
+
+
+def _dispatch(client, args) -> int:
+    if args.cmd == "status":
+        topo = client.topology()
+        _out({"clusterSize": topo.cluster_size,
+              "partitionsCount": topo.partitions_count,
+              "replicationFactor": topo.replication_factor,
+              "gatewayVersion": topo.gateway_version,
+              "brokers": topo.brokers})
+    elif args.cmd == "deploy":
+        _out(client.deploy_resource(*args.files))
+    elif args.cmd == "create" and args.what == "instance":
+        variables = json.loads(args.variables)
+        if args.with_result:
+            result = client.create_instance_with_result(
+                args.process_id, version=args.version, variables=variables)
+            _out({"processInstanceKey": result.process_instance_key,
+                  "variables": result.variables})
+        else:
+            instance = client.create_instance(
+                args.process_id, version=args.version, variables=variables)
+            _out({"processDefinitionKey": instance.process_definition_key,
+                  "bpmnProcessId": instance.bpmn_process_id,
+                  "version": instance.version,
+                  "processInstanceKey": instance.process_instance_key})
+    elif args.cmd == "create" and args.what == "worker":
+        handler_expr = args.handler or "{}"
+
+        def handler(job):
+            return eval(handler_expr, {"job": job, "json": json})  # noqa: S307
+
+        from zeebe_tpu.client import JobWorker
+
+        worker = JobWorker(client, args.job_type, handler,
+                           max_jobs_active=args.max_jobs).start()
+        print(f"worker on '{args.job_type}' started; ctrl-c to stop",
+              file=sys.stderr)
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            worker.stop()
+    elif args.cmd == "cancel":
+        client.cancel_instance(args.key)
+        _out({"canceled": args.key})
+    elif args.cmd == "activate":
+        jobs = client.activate_jobs(args.job_type, max_jobs=args.max_jobs,
+                                    worker=args.worker)
+        _out({"jobs": [vars(j) for j in jobs]})
+    elif args.cmd == "complete":
+        client.complete_job(args.key, json.loads(args.variables))
+        _out({"completed": args.key})
+    elif args.cmd == "fail":
+        client.fail_job(args.key, args.retries, args.message)
+        _out({"failed": args.key, "retries": args.retries})
+    elif args.cmd == "publish":
+        key = client.publish_message(args.name, args.correlation_key,
+                                     json.loads(args.variables), args.ttl,
+                                     args.message_id)
+        _out({"messageKey": key})
+    elif args.cmd == "broadcast":
+        key = client.broadcast_signal(args.name, json.loads(args.variables))
+        _out({"signalKey": key})
+    elif args.cmd == "resolve":
+        client.resolve_incident(args.key)
+        _out({"resolved": args.key})
+    elif args.cmd == "set":
+        key = client.set_variables(args.key, json.loads(args.variables),
+                                   local=args.local)
+        _out({"key": key})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

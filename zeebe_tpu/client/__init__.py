@@ -1,0 +1,6 @@
+"""Client library: ZeebeClient-equivalent fluent API + job worker (SURVEY §2.11)."""
+
+from zeebe_tpu.client.client import ZeebeTpuClient
+from zeebe_tpu.client.worker import JobWorker
+
+__all__ = ["ZeebeTpuClient", "JobWorker"]

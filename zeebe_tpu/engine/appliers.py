@@ -114,6 +114,9 @@ class EventAppliers:
         reg[(ValueType.COMMAND_DISTRIBUTION, int(CommandDistributionIntent.ACKNOWLEDGED))] = self._distribution_acknowledged
         reg[(ValueType.COMMAND_DISTRIBUTION, int(CommandDistributionIntent.FINISHED))] = self._distribution_finished
         reg[(ValueType.DEPLOYMENT, int(DeploymentIntent.DISTRIBUTED))] = self._noop
+        from zeebe_tpu.protocol.intent import ProcessInstanceResultIntent
+
+        reg[(ValueType.PROCESS_INSTANCE_RESULT, int(ProcessInstanceResultIntent.COMPLETED))] = self._noop
 
     def can_apply(self, record: Record) -> bool:
         return (record.value_type, int(record.intent)) in self._appliers

@@ -1166,6 +1166,8 @@ class BpmnProcessor:
         completion variables."""
         parent_ei_key = value.get("parentElementInstanceKey", -1)
         if parent_ei_key < 0:
+            if self.on_root_completed is not None:
+                self.on_root_completed(key, value, child_locals, writers)
             return
         parent = self.state.element_instances.get(parent_ei_key)
         if parent is None or parent["state"] not in (EI_ACTIVATED, EI_ACTIVATING):
@@ -1190,6 +1192,11 @@ class BpmnProcessor:
         writers.append_command(
             parent_ei_key, ValueType.PROCESS_INSTANCE, PI.COMPLETE_ELEMENT, {}
         )
+
+    # set by the Engine: root-instance completion/termination hooks
+    # (await-result responses + parked-request cleanup)
+    on_root_completed = None
+    on_root_terminated = None
 
     # -------------------------------------------------------------- terminate
 
@@ -1243,6 +1250,11 @@ class BpmnProcessor:
                 # stays active — the last terminated child completes the scope
                 self._check_scope_completion(scope_key, writers)
             return
+        # a terminated root answers/cleans parked await-result requests
+        if (value.get("bpmnElementType") == BpmnElementType.PROCESS.name
+                and value.get("parentElementInstanceKey", -1) < 0
+                and self.on_root_terminated is not None):
+            self.on_root_terminated(key, value, writers)
         # a terminated child-process root resumes its call activity's terminate
         parent_ei_key = value.get("parentElementInstanceKey", -1)
         if parent_ei_key >= 0:

@@ -88,7 +88,13 @@ class Engine(RecordProcessor):
         )
         distribution = self.distribution_behavior if partition_count > 1 else None
         deployment = DeploymentProcessor(self.state, clock, distribution=distribution)
-        creation = ProcessInstanceCreationProcessor(self.state, bpmn)
+        # transient await-result requests (CreateProcessInstanceWithResult):
+        # in-memory by design — they die with the node, the client retries
+        self.await_results: dict[int, tuple[int, int, list]] = {}
+        creation = ProcessInstanceCreationProcessor(self.state, bpmn,
+                                                    await_results=self.await_results)
+        bpmn.on_root_completed = self._on_root_completed
+        bpmn.on_root_terminated = self._on_root_terminated
         cancel = ProcessInstanceCancelProcessor(self.state)
         jobs = JobProcessors(self.state, clock, bpmn)
         job_batch = JobBatchProcessor(self.state, clock)
@@ -140,6 +146,49 @@ class Engine(RecordProcessor):
             (ValueType.COMMAND_DISTRIBUTION, int(CommandDistributionIntent.ACKNOWLEDGE)): dist_ack.process,
         }
         self.state.load_key_generator()
+
+    def _on_root_completed(self, key: int, value: dict, child_locals: dict,
+                           writers) -> None:
+        """Answer a parked CreateProcessInstanceWithResult request with the
+        root scope's final variables (reference: ProcessProcessor →
+        BpmnProcessResultSenderBehavior, ProcessInstanceResultIntent)."""
+        parked = self.await_results.pop(key, None)
+        if parked is None:
+            return
+        request_id, stream_id, fetch = parked
+        variables = dict(child_locals)
+        if fetch:
+            variables = {k: v for k, v in variables.items() if k in fetch}
+        from zeebe_tpu.protocol.intent import ProcessInstanceResultIntent
+
+        result = writers.append_event(
+            key, ValueType.PROCESS_INSTANCE_RESULT,
+            ProcessInstanceResultIntent.COMPLETED,
+            {**{k: value.get(k) for k in (
+                "bpmnProcessId", "version", "processDefinitionKey",
+                "processInstanceKey")},
+             "variables": variables},
+        )
+        writers.respond_to(result, stream_id, request_id)
+
+    def _on_root_terminated(self, key: int, value: dict, writers) -> None:
+        """A canceled instance fails its parked await-result request fast
+        instead of leaking it until the request times out."""
+        parked = self.await_results.pop(key, None)
+        if parked is None:
+            return
+        request_id, stream_id, _ = parked
+        from zeebe_tpu.protocol import rejection
+        from zeebe_tpu.protocol import command as _command
+        from zeebe_tpu.protocol.intent import ProcessInstanceCreationIntent as _PIC
+
+        rej = rejection(
+            _command(ValueType.PROCESS_INSTANCE_CREATION, _PIC.CREATE,
+                     {"processInstanceKey": key}),
+            RejectionType.NOT_FOUND,
+            f"process instance {key} was terminated before completing",
+        )
+        writers.respond_to(rej, stream_id, request_id)
 
     def wire_sender(self, sender) -> None:
         """Install the inter-partition command sender (loopback or cluster)."""

@@ -281,9 +281,14 @@ class ProcessInstanceCreationProcessor:
     """PROCESS_INSTANCE_CREATION CREATE: resolve the definition, write CREATED,
     seed variables, and kick off activation of the process element."""
 
-    def __init__(self, state: EngineState, bpmn: BpmnProcessor) -> None:
+    def __init__(self, state: EngineState, bpmn: BpmnProcessor,
+                 await_results: dict | None = None) -> None:
         self.state = state
         self.bpmn = bpmn
+        # transient request state, NOT in the replicated db: an await-result
+        # request dies with the broker, exactly like the reference's
+        # AwaitProcessInstanceResultMetadata (the client retries)
+        self.await_results = await_results if await_results is not None else {}
 
     def process(self, cmd: LoggedRecord, writers: Writers) -> None:
         value = cmd.record.value
@@ -319,7 +324,14 @@ class ProcessInstanceCreationProcessor:
             process_instance_key, ValueType.PROCESS_INSTANCE_CREATION,
             ProcessInstanceCreationIntent.CREATED, created_value,
         )
-        writers.respond(cmd, created)
+        if value.get("awaitResult") and cmd.record.request_id >= 0:
+            # response deferred until the instance completes (CreateWithResult)
+            self.await_results[process_instance_key] = (
+                cmd.record.request_id, cmd.record.request_stream_id,
+                list(value.get("fetchVariables", [])),
+            )
+        else:
+            writers.respond(cmd, created)
 
         pi_value = {
             "bpmnProcessId": meta["bpmnProcessId"],

@@ -66,6 +66,11 @@ class Writers:
                 record, cmd.record.request_stream_id, cmd.record.request_id
             )
 
+    def respond_to(self, record: Record, request_stream_id: int, request_id: int) -> None:
+        """Answer a parked request from an earlier command (await-result)."""
+        if request_id >= 0:
+            self._builder.add_response(record, request_stream_id, request_id)
+
     def respond_rejection(self, cmd: LoggedRecord, rejection_type: RejectionType, reason: str) -> None:
         rec = self.append_rejection(cmd, rejection_type, reason)
         self.respond(cmd, rec)

@@ -30,8 +30,22 @@ class ExporterContainer:
         self.exporter = exporter
         self.state = state
         self.position = state.position(exporter_id)
+        # highest position handed to the exporter but not yet acked; a skip may
+        # only advance the persisted position when nothing is pending, or a
+        # crash-before-flush loses the buffered records to compaction
+        # (reference: ExporterContainer.updateLastExportedRecordPosition)
+        self.last_delivered = self.position
         exporter.configure(ExporterContext(exporter_id, configuration or {}))
         exporter.open(ExporterController(self._update_position))
+
+    def deliver(self, record) -> None:
+        self.last_delivered = record.position
+        self.exporter.export(record)
+
+    def skip(self, position: int) -> None:
+        if self.last_delivered <= self.position:  # nothing unacked in flight
+            self._update_position(position)
+        self.last_delivered = max(self.last_delivered, position)
 
     def _update_position(self, position: int) -> None:
         if position > self.position:
@@ -99,9 +113,9 @@ class ExporterDirector:
                     continue  # already acked by this exporter (restart resume)
                 ctx = container.exporter.context
                 if ctx.record_filter is not None and not ctx.record_filter(logged):
-                    container._update_position(logged.position)
+                    container.skip(logged.position)
                     continue
-                container.exporter.export(logged)
+                container.deliver(logged)
             self._next_position = logged.position + 1
             count += 1
             if count >= max_records:
@@ -110,10 +124,12 @@ class ExporterDirector:
 
     def lowest_exporter_position(self) -> int:
         """Log compaction bound (reference: min exporter position vs snapshot
-        position, AsyncSnapshotDirector)."""
+        position, AsyncSnapshotDirector). Uses the containers' in-memory
+        positions (0 until first ack) so a bulk exporter that never flushed
+        still pins the log."""
         if not self.containers:
             return 2**62
-        return self.state.lowest_position()
+        return min(c.position for c in self.containers)
 
     def close(self) -> None:
         for container in self.containers:

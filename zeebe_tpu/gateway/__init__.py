@@ -1,0 +1,6 @@
+"""gRPC gateway: client API front-end (SURVEY §2.11)."""
+
+from zeebe_tpu.gateway.broker_client import ClusterRuntime
+from zeebe_tpu.gateway.gateway import Gateway, GatewayService
+
+__all__ = ["ClusterRuntime", "Gateway", "GatewayService"]

@@ -1,0 +1,469 @@
+"""gRPC gateway: the client API front-end.
+
+Reference: gateway/src/main/java/io/camunda/zeebe/gateway/ — Gateway boots the
+gRPC server, EndpointManager.java:78 bridges rpcs to broker requests through
+RequestMapper.java:66 / ResponseMapper.java:58; ActivateJobs long-polls via
+LongPollingActivateJobsHandler.java:36 fanning out round-robin across
+partitions (RoundRobinActivateJobsHandler).
+
+The service is registered with ``grpc.method_handlers_generic_handler`` over
+protoc-generated messages (no grpcio-tools in the image — message codegen via
+``protoc --python_out``, service wiring by hand)."""
+
+from __future__ import annotations
+
+import json
+import time
+from concurrent import futures
+from typing import Any, Callable
+
+import grpc
+
+from zeebe_tpu.gateway.proto import gateway_pb2 as pb  # noqa: E402
+
+from zeebe_tpu.gateway.broker_client import (  # noqa: E402
+    DEPLOYMENT_PARTITION,
+    ClusterRuntime,
+    NoLeaderError,
+    RequestTimeoutError,
+)
+from zeebe_tpu.protocol import ValueType, command  # noqa: E402
+from zeebe_tpu.protocol.intent import (  # noqa: E402
+    DeploymentIntent,
+    IncidentIntent,
+    JobBatchIntent,
+    JobIntent,
+    MessageIntent,
+    ProcessInstanceCreationIntent,
+    ProcessInstanceIntent,
+    SignalIntent,
+    VariableDocumentIntent,
+)
+
+VERSION = "8.4.0-tpu"
+
+
+def _vars(json_str: str) -> dict:
+    if not json_str:
+        return {}
+    parsed = json.loads(json_str)
+    if not isinstance(parsed, dict):
+        raise ValueError("variables must be a JSON object")
+    return parsed
+
+
+class GatewayService:
+    """One method per rpc; raises grpc errors via context.abort."""
+
+    def __init__(self, runtime: ClusterRuntime) -> None:
+        self.runtime = runtime
+
+    # -- topology --------------------------------------------------------------
+
+    def Topology(self, request, context):
+        topo = self.runtime.topology()
+        brokers = []
+        for i, b in enumerate(topo["brokers"]):
+            partitions = [
+                pb.Partition(
+                    partitionId=p["partitionId"],
+                    role=pb.Partition.LEADER if p["role"] == "leader"
+                    else pb.Partition.FOLLOWER,
+                    health=pb.Partition.HEALTHY,
+                )
+                for p in b["partitions"]
+            ]
+            brokers.append(pb.BrokerInfo(
+                nodeId=i, host="127.0.0.1", port=0, partitions=partitions,
+                version=VERSION,
+            ))
+        return pb.TopologyResponse(
+            brokers=brokers, clusterSize=topo["clusterSize"],
+            partitionsCount=topo["partitionsCount"],
+            replicationFactor=topo["replicationFactor"], gatewayVersion=VERSION,
+        )
+
+    # -- deployment ------------------------------------------------------------
+
+    def DeployResource(self, request, context):
+        resources = [
+            {"resourceName": r.name, "resource": r.content.decode("utf-8")}
+            for r in request.resources
+        ]
+        record = self._submit(
+            context, DEPLOYMENT_PARTITION,
+            command(ValueType.DEPLOYMENT, DeploymentIntent.CREATE,
+                    {"resources": resources}),
+        )
+        deployments = [
+            pb.Deployment(process=pb.ProcessMetadata(
+                bpmnProcessId=m["bpmnProcessId"], version=m["version"],
+                processDefinitionKey=m["processDefinitionKey"],
+                resourceName=m["resourceName"],
+                tenantId="<default>",
+            ))
+            for m in record.value.get("processesMetadata", [])
+        ]
+        for m in record.value.get("decisionsMetadata", []):
+            deployments.append(pb.Deployment(decision=pb.DecisionMetadata(
+                dmnDecisionId=m.get("decisionId", ""),
+                dmnDecisionName=m.get("decisionName", ""),
+                version=m.get("version", 1), decisionKey=m.get("decisionKey", -1),
+                dmnDecisionRequirementsId=m.get("decisionRequirementsId", ""),
+                decisionRequirementsKey=m.get("decisionRequirementsKey", -1),
+                tenantId="<default>",
+            )))
+        return pb.DeployResourceResponse(
+            key=record.key, deployments=deployments, tenantId="<default>",
+        )
+
+    # -- process instances -----------------------------------------------------
+
+    def CreateProcessInstance(self, request, context):
+        partition = self.runtime.partition_for_new_instance()
+        value = {
+            "bpmnProcessId": request.bpmnProcessId,
+            "processDefinitionKey": request.processDefinitionKey or -1,
+            "version": request.version or -1,
+            "variables": self._parse_vars(context, request.variables),
+        }
+        if request.startInstructions:
+            value["startInstructions"] = [
+                {"elementId": si.elementId} for si in request.startInstructions
+            ]
+        record = self._submit(
+            context, partition,
+            command(ValueType.PROCESS_INSTANCE_CREATION,
+                    ProcessInstanceCreationIntent.CREATE, value),
+        )
+        return pb.CreateProcessInstanceResponse(
+            processDefinitionKey=record.value.get("processDefinitionKey", -1),
+            bpmnProcessId=record.value.get("bpmnProcessId", ""),
+            version=record.value.get("version", -1),
+            processInstanceKey=record.value.get("processInstanceKey", -1),
+            tenantId="<default>",
+        )
+
+    def CreateProcessInstanceWithResult(self, request, context):
+        """The engine parks the request and answers it from the root-completion
+        step with the final variables (ProcessInstanceResultIntent.COMPLETED)."""
+        inner = request.request
+        partition = self.runtime.partition_for_new_instance()
+        value = {
+            "bpmnProcessId": inner.bpmnProcessId,
+            "processDefinitionKey": inner.processDefinitionKey or -1,
+            "version": inner.version or -1,
+            "variables": self._parse_vars(context, inner.variables),
+            "awaitResult": True,
+            "fetchVariables": list(request.fetchVariables),
+        }
+        timeout_s = (request.requestTimeout or 10_000) / 1000
+        record = self._submit(
+            context, partition,
+            command(ValueType.PROCESS_INSTANCE_CREATION,
+                    ProcessInstanceCreationIntent.CREATE, value),
+            timeout_s=timeout_s,
+        )
+        return pb.CreateProcessInstanceWithResultResponse(
+            processDefinitionKey=record.value.get("processDefinitionKey", -1),
+            bpmnProcessId=record.value.get("bpmnProcessId", ""),
+            version=record.value.get("version", -1),
+            processInstanceKey=record.value.get("processInstanceKey", -1),
+            variables=json.dumps(record.value.get("variables", {})),
+            tenantId="<default>",
+        )
+
+    def CancelProcessInstance(self, request, context):
+        partition = self.runtime.partition_for_key(request.processInstanceKey)
+        self._submit(
+            context, partition,
+            command(ValueType.PROCESS_INSTANCE, ProcessInstanceIntent.CANCEL,
+                    {}, key=request.processInstanceKey),
+        )
+        return pb.CancelProcessInstanceResponse()
+
+    # -- messages / signals ----------------------------------------------------
+
+    def PublishMessage(self, request, context):
+        partition = self.runtime.partition_for_correlation_key(request.correlationKey)
+        record = self._submit(
+            context, partition,
+            command(ValueType.MESSAGE, MessageIntent.PUBLISH, {
+                "name": request.name,
+                "correlationKey": request.correlationKey,
+                "timeToLive": request.timeToLive or 3_600_000,
+                "messageId": request.messageId,
+                "variables": self._parse_vars(context, request.variables),
+            }),
+        )
+        return pb.PublishMessageResponse(key=record.key, tenantId="<default>")
+
+    def BroadcastSignal(self, request, context):
+        record = self._submit(
+            context, DEPLOYMENT_PARTITION,
+            command(ValueType.SIGNAL, SignalIntent.BROADCAST, {
+                "signalName": request.signalName,
+                "variables": self._parse_vars(context, request.variables),
+            }),
+        )
+        return pb.BroadcastSignalResponse(key=record.key, tenantId="<default>")
+
+    # -- jobs ------------------------------------------------------------------
+
+    def ActivateJobs(self, request, context):
+        """Fan out across partitions round-robin until maxJobs or all empty;
+        long-poll until requestTimeout if nothing was activated."""
+        deadline = time.time() + max((request.requestTimeout or 0), 0) / 1000
+        remaining = request.maxJobsToActivate or 32
+        while True:
+            jobs = []
+            for partition_id in range(1, self.runtime.partition_count + 1):
+                if remaining <= 0:
+                    break
+                # peek before writing: an idle long-poller must not flood the
+                # replicated log with empty JOB_BATCH ACTIVATE commands
+                if not self.runtime.has_activatable_jobs(partition_id, request.type):
+                    continue
+                record = self._submit(
+                    context, partition_id,
+                    command(ValueType.JOB_BATCH, JobBatchIntent.ACTIVATE, {
+                        "type": request.type,
+                        "worker": request.worker or "default",
+                        "timeout": request.timeout or 300_000,
+                        "maxJobsToActivate": remaining,
+                    }),
+                )
+                for key, job in zip(record.value.get("jobKeys", []),
+                                    record.value.get("jobs", [])):
+                    jobs.append(self._activated_job(request, key, job))
+                    remaining -= 1
+            if jobs:
+                yield pb.ActivateJobsResponse(jobs=jobs)
+                return
+            if time.time() >= deadline:
+                return
+            time.sleep(0.02)
+
+    def StreamActivatedJobs(self, request, context):
+        """Job push: stream jobs as they are created (reference: job push via
+        RemoteJobStreamer; here the gateway polls activatable state — same
+        client-visible contract, server push lands with the transport layer)."""
+        while context.is_active():
+            record = None
+            for partition_id in range(1, self.runtime.partition_count + 1):
+                if not self.runtime.has_activatable_jobs(partition_id, request.type):
+                    continue
+                record = self._submit(
+                    context, partition_id,
+                    command(ValueType.JOB_BATCH, JobBatchIntent.ACTIVATE, {
+                        "type": request.type,
+                        "worker": request.worker or "default",
+                        "timeout": request.timeout or 300_000,
+                        "maxJobsToActivate": 32,
+                    }),
+                )
+                for key, job in zip(record.value.get("jobKeys", []),
+                                    record.value.get("jobs", [])):
+                    yield self._activated_job(request, key, job)
+            time.sleep(0.05)
+
+    def _activated_job(self, request, key: int, job: dict) -> "pb.ActivatedJob":
+        return pb.ActivatedJob(
+            key=key,
+            type=job.get("type", request.type),
+            processInstanceKey=job.get("processInstanceKey", -1),
+            bpmnProcessId=job.get("bpmnProcessId", ""),
+            processDefinitionVersion=job.get("processDefinitionVersion", -1),
+            processDefinitionKey=job.get("processDefinitionKey", -1),
+            elementId=job.get("elementId", ""),
+            elementInstanceKey=job.get("elementInstanceKey", -1),
+            customHeaders=json.dumps(job.get("customHeaders", {})),
+            worker=job.get("worker", ""),
+            retries=job.get("retries", 3),
+            deadline=job.get("deadline", -1),
+            variables=json.dumps(job.get("variables", {})),
+            tenantId="<default>",
+        )
+
+    def CompleteJob(self, request, context):
+        self._job_command(context, request.jobKey, JobIntent.COMPLETE, {
+            "variables": self._parse_vars(context, request.variables),
+        })
+        return pb.CompleteJobResponse()
+
+    def FailJob(self, request, context):
+        self._job_command(context, request.jobKey, JobIntent.FAIL, {
+            "retries": request.retries,
+            "errorMessage": request.errorMessage,
+            "retryBackOff": request.retryBackOff,
+            "variables": self._parse_vars(context, request.variables),
+        })
+        return pb.FailJobResponse()
+
+    def ThrowError(self, request, context):
+        self._job_command(context, request.jobKey, JobIntent.THROW_ERROR, {
+            "errorCode": request.errorCode,
+            "errorMessage": request.errorMessage,
+            "variables": self._parse_vars(context, request.variables),
+        })
+        return pb.ThrowErrorResponse()
+
+    def UpdateJobRetries(self, request, context):
+        self._job_command(context, request.jobKey, JobIntent.UPDATE_RETRIES, {
+            "retries": request.retries,
+        })
+        return pb.UpdateJobRetriesResponse()
+
+    def UpdateJobTimeout(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "UpdateJobTimeout pending")
+
+    def _job_command(self, context, job_key: int, intent, value: dict):
+        partition = self.runtime.partition_for_key(job_key)
+        return self._submit(
+            context, partition,
+            command(ValueType.JOB, intent, value, key=job_key),
+        )
+
+    # -- variables / incidents -------------------------------------------------
+
+    def SetVariables(self, request, context):
+        partition = self.runtime.partition_for_key(request.elementInstanceKey)
+        record = self._submit(
+            context, partition,
+            command(ValueType.VARIABLE_DOCUMENT, VariableDocumentIntent.UPDATE, {
+                "scopeKey": request.elementInstanceKey,
+                "variables": self._parse_vars(context, request.variables),
+                "local": request.local,
+            }),
+        )
+        return pb.SetVariablesResponse(key=record.key)
+
+    def ResolveIncident(self, request, context):
+        partition = self.runtime.partition_for_key(request.incidentKey)
+        self._submit(
+            context, partition,
+            command(ValueType.INCIDENT, IncidentIntent.RESOLVE, {},
+                    key=request.incidentKey),
+        )
+        return pb.ResolveIncidentResponse()
+
+    # -- pending engine features ----------------------------------------------
+
+    def ModifyProcessInstance(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "modification pending")
+
+    def MigrateProcessInstance(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "migration pending")
+
+    def EvaluateDecision(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "DMN pending")
+
+    def DeleteResource(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "resource deletion pending")
+
+    # -- plumbing --------------------------------------------------------------
+
+    def _parse_vars(self, context, json_str: str) -> dict:
+        try:
+            return _vars(json_str)
+        except (json.JSONDecodeError, ValueError) as exc:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+
+    def _submit(self, context, partition_id: int, record, timeout_s: float = 10.0):
+        try:
+            response = self.runtime.submit(partition_id, record, timeout_s=timeout_s)
+        except NoLeaderError as exc:
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
+        except RequestTimeoutError as exc:
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
+        if response.is_rejection:
+            context.abort(
+                _rejection_status(response.rejection_type.name),
+                response.rejection_reason,
+            )
+        return response
+
+
+def _rejection_status(rejection_type: str) -> grpc.StatusCode:
+    return {
+        "INVALID_ARGUMENT": grpc.StatusCode.INVALID_ARGUMENT,
+        "NOT_FOUND": grpc.StatusCode.NOT_FOUND,
+        "ALREADY_EXISTS": grpc.StatusCode.ALREADY_EXISTS,
+        "INVALID_STATE": grpc.StatusCode.FAILED_PRECONDITION,
+        "PROCESSING_ERROR": grpc.StatusCode.INTERNAL,
+        "EXCEEDED_BATCH_RECORD_SIZE": grpc.StatusCode.RESOURCE_EXHAUSTED,
+    }.get(rejection_type, grpc.StatusCode.UNKNOWN)
+
+
+_SERVICE = "gateway_protocol.Gateway"
+
+_UNARY = {
+    "Topology": (pb.TopologyRequest, pb.TopologyResponse),
+    "DeployResource": (pb.DeployResourceRequest, pb.DeployResourceResponse),
+    "CreateProcessInstance": (pb.CreateProcessInstanceRequest, pb.CreateProcessInstanceResponse),
+    "CreateProcessInstanceWithResult": (pb.CreateProcessInstanceWithResultRequest, pb.CreateProcessInstanceWithResultResponse),
+    "CancelProcessInstance": (pb.CancelProcessInstanceRequest, pb.CancelProcessInstanceResponse),
+    "PublishMessage": (pb.PublishMessageRequest, pb.PublishMessageResponse),
+    "CompleteJob": (pb.CompleteJobRequest, pb.CompleteJobResponse),
+    "FailJob": (pb.FailJobRequest, pb.FailJobResponse),
+    "ThrowError": (pb.ThrowErrorRequest, pb.ThrowErrorResponse),
+    "UpdateJobRetries": (pb.UpdateJobRetriesRequest, pb.UpdateJobRetriesResponse),
+    "UpdateJobTimeout": (pb.UpdateJobTimeoutRequest, pb.UpdateJobTimeoutResponse),
+    "SetVariables": (pb.SetVariablesRequest, pb.SetVariablesResponse),
+    "ResolveIncident": (pb.ResolveIncidentRequest, pb.ResolveIncidentResponse),
+    "BroadcastSignal": (pb.BroadcastSignalRequest, pb.BroadcastSignalResponse),
+    "ModifyProcessInstance": (pb.ModifyProcessInstanceRequest, pb.ModifyProcessInstanceResponse),
+    "MigrateProcessInstance": (pb.MigrateProcessInstanceRequest, pb.MigrateProcessInstanceResponse),
+    "EvaluateDecision": (pb.EvaluateDecisionRequest, pb.EvaluateDecisionResponse),
+    "DeleteResource": (pb.DeleteResourceRequest, pb.DeleteResourceResponse),
+}
+
+_SERVER_STREAMING = {
+    "ActivateJobs": (pb.ActivateJobsRequest, pb.ActivateJobsResponse),
+    "StreamActivatedJobs": (pb.StreamActivatedJobsRequest, pb.ActivatedJob),
+}
+
+
+class Gateway:
+    """Boots the gRPC server over a ClusterRuntime (StandaloneGateway +
+    embedded-broker mode in one; reference: dist StandaloneGateway.java)."""
+
+    def __init__(self, runtime: ClusterRuntime, bind: str = "127.0.0.1:0",
+                 max_workers: int = 16) -> None:
+        self.runtime = runtime
+        self.service = GatewayService(runtime)
+        handlers = {}
+        for name, (req_cls, resp_cls) in _UNARY.items():
+            handlers[name] = grpc.unary_unary_rpc_method_handler(
+                _wrap(getattr(self.service, name)),
+                request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString,
+            )
+        for name, (req_cls, resp_cls) in _SERVER_STREAMING.items():
+            handlers[name] = grpc.unary_stream_rpc_method_handler(
+                _wrap(getattr(self.service, name)),
+                request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString,
+            )
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        self.server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
+        )
+        self.port = self.server.add_insecure_port(bind)
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self, grace: float = 1.0) -> None:
+        self.server.stop(grace)
+
+
+def _wrap(method: Callable) -> Callable:
+    def handler(request, context):
+        return method(request, context)
+
+    return handler

@@ -1,0 +1,49 @@
+"""Standalone broker+gateway app (reference: dist/…/StandaloneBroker.java with
+embedded gateway): boots an in-process cluster runtime and serves the gRPC
+client API.
+
+Usage: python -m zeebe_tpu.standalone [--port 26500] [--partitions 3]
+       [--brokers 1] [--replication 1] [--data-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="zeebe-tpu-broker")
+    parser.add_argument("--port", type=int, default=26500)
+    parser.add_argument("--partitions", type=int, default=1)
+    parser.add_argument("--brokers", type=int, default=1)
+    parser.add_argument("--replication", type=int, default=1)
+    parser.add_argument("--data-dir", default=None)
+    args = parser.parse_args(argv)
+
+    from zeebe_tpu.gateway import ClusterRuntime, Gateway
+
+    runtime = ClusterRuntime(
+        broker_count=args.brokers, partition_count=args.partitions,
+        replication_factor=args.replication, directory=args.data_dir,
+    )
+    runtime.start()
+    gateway = Gateway(runtime, bind=f"0.0.0.0:{args.port}")
+    gateway.start()
+    print(f"gateway listening on {gateway.address} "
+          f"({args.brokers} broker(s), {args.partitions} partition(s), "
+          f"replication {args.replication})", file=sys.stderr)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    stop.wait()
+    gateway.stop()
+    runtime.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,7 @@ class ProcessingResultBuilder:
     def __init__(self, max_batch_size_bytes: int = 4 * 1024 * 1024) -> None:
         self.follow_ups: list[FollowUpRecord] = []
         self.response: ClientResponse | None = None
+        self.extra_responses: list[ClientResponse] = []
         self.post_commit_tasks: list[Callable[[], None]] = []
         self._size = 0
         self._max_size = max_batch_size_bytes
@@ -66,6 +67,11 @@ class ProcessingResultBuilder:
 
     def with_response(self, record: Record, request_stream_id: int, request_id: int) -> None:
         self.response = ClientResponse(record, request_stream_id, request_id)
+
+    def add_response(self, record: Record, request_stream_id: int, request_id: int) -> None:
+        """An extra response to a *different* parked request (await-result:
+        the process-completion step answers the original create request)."""
+        self.extra_responses.append(ClientResponse(record, request_stream_id, request_id))
 
     def append_post_commit_task(self, task: Callable[[], None]) -> None:
         self.post_commit_tasks.append(task)

@@ -248,6 +248,8 @@ class StreamProcessor:
     def _execute_side_effects(self, builder: ProcessingResultBuilder) -> None:
         if builder.response is not None:
             self.response_sink(builder.response)
+        for extra in builder.extra_responses:
+            self.response_sink(extra)
         for task in builder.post_commit_tasks:
             try:
                 task()
