@@ -172,3 +172,27 @@ class TestJobWorker:
             assert worker.failed_count >= 1
         finally:
             worker.stop()
+
+
+class TestEvaluateDecision:
+    def test_evaluate_decision_rpc(self, stack):
+        import json as _json
+
+        from zeebe_tpu.gateway.proto import gateway_pb2 as pb
+        from tests.test_dmn import DISH_DMN
+
+        client, _ = stack
+        client.deploy_resource(("dish.dmn", DISH_DMN))
+        stub = client.channel.unary_unary(
+            "/gateway_protocol.Gateway/EvaluateDecision",
+            request_serializer=pb.EvaluateDecisionRequest.SerializeToString,
+            response_deserializer=pb.EvaluateDecisionResponse.FromString,
+        )
+        resp = stub(pb.EvaluateDecisionRequest(
+            decisionId="dish",
+            variables=_json.dumps({"season": "Winter", "guestCount": 12}),
+        ))
+        assert _json.loads(resp.decisionOutput) == "Pasta"
+        assert resp.decisionId == "dish"
+        [d] = resp.evaluatedDecisions
+        assert d.matchedRules[0].ruleIndex == 2

@@ -147,6 +147,14 @@ class ZeebeTpuClient:
                  "processDefinitionKey": d.process.processDefinitionKey}
                 for d in r.deployments if d.WhichOneof("Metadata") == "process"
             ],
+            "decisions": [
+                {"decisionId": d.decision.dmnDecisionId,
+                 "decisionName": d.decision.dmnDecisionName,
+                 "version": d.decision.version,
+                 "decisionKey": d.decision.decisionKey,
+                 "decisionRequirementsKey": d.decision.decisionRequirementsKey}
+                for d in r.deployments if d.WhichOneof("Metadata") == "decision"
+            ],
         }
 
     # -- process instances -----------------------------------------------------

@@ -117,6 +117,16 @@ class EventAppliers:
         from zeebe_tpu.protocol.intent import ProcessInstanceResultIntent
 
         reg[(ValueType.PROCESS_INSTANCE_RESULT, int(ProcessInstanceResultIntent.COMPLETED))] = self._noop
+        from zeebe_tpu.protocol.intent import (
+            DecisionEvaluationIntent,
+            DecisionIntent,
+            DecisionRequirementsIntent,
+        )
+
+        reg[(ValueType.DECISION_REQUIREMENTS, int(DecisionRequirementsIntent.CREATED))] = self._drg_created
+        reg[(ValueType.DECISION, int(DecisionIntent.CREATED))] = self._decision_created
+        reg[(ValueType.DECISION_EVALUATION, int(DecisionEvaluationIntent.EVALUATED))] = self._noop
+        reg[(ValueType.DECISION_EVALUATION, int(DecisionEvaluationIntent.FAILED))] = self._noop
 
     def can_apply(self, record: Record) -> bool:
         return (record.value_type, int(record.intent)) in self._appliers
@@ -135,6 +145,12 @@ class EventAppliers:
 
     def _noop(self, record: Record) -> None:
         pass
+
+    def _drg_created(self, record: Record) -> None:
+        self.state.decisions.put_drg(record.key, record.value)
+
+    def _decision_created(self, record: Record) -> None:
+        self.state.decisions.put_decision(record.key, record.value)
 
     # command distribution (reference: state/appliers/CommandDistribution*Applier)
 

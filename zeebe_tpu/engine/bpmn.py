@@ -65,6 +65,11 @@ class BpmnProcessor:
         self.clock_millis = clock_millis
         self.sender = sender  # InterPartitionCommandSender (set via Engine.wire)
         self.partition_count = partition_count
+        from zeebe_tpu.engine.decision import BpmnDecisionBehavior
+
+        self.decision_behavior = BpmnDecisionBehavior(
+            state, self._raise_incident, self._write_variable
+        )
 
     # ------------------------------------------------------------------ entry
 
@@ -199,6 +204,15 @@ class BpmnProcessor:
         elif et == BpmnElementType.START_EVENT:
             writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
             self._complete(key, value, exe, element, writers)
+        elif (et == BpmnElementType.BUSINESS_RULE_TASK
+              and element.called_decision_id is not None):
+            # zeebe:calledDecision: evaluate BEFORE transitioning to ACTIVATED —
+            # an evaluation incident must leave the element ACTIVATING so
+            # incident resolution can retry the activation (reference:
+            # BusinessRuleTaskProcessor evaluates in onActivate)
+            if self.decision_behavior.evaluate_called_decision(key, value, element, writers):
+                writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
+                self._complete(key, value, exe, element, writers)
         elif et in (BpmnElementType.SERVICE_TASK, BpmnElementType.SEND_TASK,
                     BpmnElementType.BUSINESS_RULE_TASK, BpmnElementType.SCRIPT_TASK,
                     BpmnElementType.USER_TASK) and element.job_type is not None:

@@ -28,6 +28,7 @@ from zeebe_tpu.logstreams import LoggedRecord
 from zeebe_tpu.protocol import RejectionType, ValueType
 from zeebe_tpu.protocol.intent import (
     CommandDistributionIntent,
+    DecisionEvaluationIntent,
     DeploymentIntent,
     IncidentIntent,
     JobBatchIntent,
@@ -108,6 +109,9 @@ class Engine(RecordProcessor):
         signals = SignalProcessors(self.state, bpmn, distribution=distribution)
         dist_ack = CommandDistributionAcknowledgeProcessor(self.state)
         self.distribution_ack = dist_ack
+        from zeebe_tpu.engine.decision import DecisionEvaluationProcessor
+
+        decision_eval = DecisionEvaluationProcessor(self.state)
 
         def _deployment_fully_distributed(wr, distribution_key, stored):
             wr.append_event(
@@ -144,6 +148,7 @@ class Engine(RecordProcessor):
             (ValueType.PROCESS_MESSAGE_SUBSCRIPTION, int(ProcessMessageSubscriptionIntent.CORRELATE)): pms.correlate,
             (ValueType.SIGNAL, int(SignalIntent.BROADCAST)): signals.broadcast,
             (ValueType.COMMAND_DISTRIBUTION, int(CommandDistributionIntent.ACKNOWLEDGE)): dist_ack.process,
+            (ValueType.DECISION_EVALUATION, int(DecisionEvaluationIntent.EVALUATE)): decision_eval.process,
         }
         self.state.load_key_generator()
 

@@ -807,6 +807,80 @@ class DistributionState:
         return self._received.exists((distribution_key,))
 
 
+class DecisionState:
+    """Deployed DMN decision requirement graphs + decisions (reference:
+    state/deployment/DbDecisionState — decisions by key, latest by id, DRGs by
+    key with the raw resource for re-parse on recovery)."""
+
+    def __init__(self, db: ZbDb) -> None:
+        self._decisions = db.column_family(CF.DMN_DECISIONS)
+        self._drgs = db.column_family(CF.DMN_DECISION_REQUIREMENTS)
+        self._latest_decision = db.column_family(CF.DMN_LATEST_DECISION_BY_ID)
+        self._latest_drg = db.column_family(CF.DMN_LATEST_DRG_BY_ID)
+        self._by_drg = db.column_family(CF.DMN_DECISIONS_BY_DRG)
+        self._parsed: dict[int, object] = {}  # drg_key → ParsedDrg (cache)
+
+    def put_drg(self, drg_key: int, meta: dict) -> None:
+        self._drgs.put((drg_key,), dict(meta))
+        latest = self._latest_drg.get((meta["decisionRequirementsId"],))
+        if latest is None or meta["version"] >= latest.get("version", 0):
+            self._latest_drg.put((meta["decisionRequirementsId"],),
+                                 {"version": meta["version"], "key": drg_key})
+
+    def put_decision(self, decision_key: int, meta: dict) -> None:
+        self._decisions.put((decision_key,), dict(meta))
+        self._by_drg.put((meta["decisionRequirementsKey"], decision_key), None)
+        latest_key = self._latest_decision.get((meta["decisionId"],))
+        latest = self._decisions.get((latest_key,)) if latest_key else None
+        if latest is None or meta["version"] >= latest.get("version", 0):
+            self._latest_decision.put((meta["decisionId"],), decision_key)
+
+    def decision_by_key(self, decision_key: int) -> dict | None:
+        return self._decisions.get((decision_key,))
+
+    def latest_decision_by_id(self, decision_id: str) -> dict | None:
+        key = self._latest_decision.get((decision_id,))
+        return None if key is None else self._decisions.get((key,))
+
+    def drg_by_key(self, drg_key: int) -> dict | None:
+        return self._drgs.get((drg_key,))
+
+    def latest_drg_meta(self, drg_id: str) -> dict | None:
+        latest = self._latest_drg.get((drg_id,))
+        return None if latest is None else self._drgs.get((latest["key"],))
+
+    def decisions_of_drg(self, drg_key: int) -> list[dict]:
+        return [
+            self._decisions.get((_decode_trailing_i64(enc),))
+            for enc, _ in self._by_drg.items((drg_key,))
+        ]
+
+    def latest_drg_digest(self, drg_id: str) -> str | None:
+        latest = self._latest_drg.get((drg_id,))
+        if latest is None:
+            return None
+        drg = self._drgs.get((latest["key"],))
+        return None if drg is None else drg.get("checksum")
+
+    def latest_drg_version(self, drg_id: str) -> int:
+        latest = self._latest_drg.get((drg_id,))
+        return 0 if latest is None else latest["version"]
+
+    def parsed_drg(self, drg_key: int):
+        """Parse-once cache over the stored DMN resource."""
+        cached = self._parsed.get(drg_key)
+        if cached is not None:
+            return cached
+        drg_meta = self._drgs.get((drg_key,))
+        if drg_meta is None:
+            return None
+        from zeebe_tpu.dmn import parse_dmn_xml
+
+        parsed = parse_dmn_xml(drg_meta["resource"])
+        self._parsed[drg_key] = parsed
+        return parsed
+
+
 class EngineState:
     """Aggregates all engine sub-states over one partition's db + key generator
     (reference: ProcessingDbState)."""
@@ -827,6 +901,7 @@ class EngineState:
         self.message_start_subscriptions = MessageStartEventSubscriptionState(db)
         self.signal_subscriptions = SignalSubscriptionState(db)
         self.distribution = DistributionState(db)
+        self.decisions = DecisionState(db)
         self._key_cf = db.column_family(CF.KEY)
         self.key_generator = KeyGenerator(partition_id)
         self._key_loaded = False

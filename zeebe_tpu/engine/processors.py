@@ -23,6 +23,7 @@ from zeebe_tpu.engine.engine_state import (
 )
 from zeebe_tpu.engine.writers import Writers
 from zeebe_tpu.logstreams import LoggedRecord
+from zeebe_tpu.dmn import DmnParseError, parse_dmn_xml
 from zeebe_tpu.models.bpmn import BpmnModelError, parse_bpmn_xml, transform
 from zeebe_tpu.protocol import RejectionType, ValueType
 from zeebe_tpu.protocol.enums import BpmnElementType, ErrorType
@@ -62,15 +63,21 @@ class DeploymentProcessor:
         processes_metadata = []
         try:
             parsed = []
+            dmn_parsed = []
             for res in resources:
                 xml = res["resource"]
                 # checksum over the resource bytes (reference: DigestGenerator
                 # hashes the deployed resource, not the compiled form)
                 checksum = hashlib.sha256(xml.encode("utf-8")).hexdigest()
+                if res["resourceName"].endswith(".dmn"):
+                    dmn_parsed.append(
+                        (res["resourceName"], xml, parse_dmn_xml(xml), checksum)
+                    )
+                    continue
                 for model in parse_bpmn_xml(xml):
                     exe = transform(model)  # also rejects bad deployments
                     parsed.append((res["resourceName"], xml, model, checksum, exe))
-        except BpmnModelError as exc:
+        except (BpmnModelError, DmnParseError) as exc:
             writers.respond_rejection(cmd, RejectionType.INVALID_ARGUMENT, str(exc))
             return
 
@@ -107,13 +114,15 @@ class DeploymentProcessor:
                     writers, exe, meta, previous_key
                 )
 
+        decisions_metadata, drg_metadata = self._deploy_dmn(dmn_parsed, writers)
+
         deployment_value = {
             "resources": [
                 {"resourceName": r["resourceName"], "resource": r["resource"]} for r in resources
             ],
             "processesMetadata": processes_metadata,
-            "decisionsMetadata": [],
-            "decisionRequirementsMetadata": [],
+            "decisionsMetadata": decisions_metadata,
+            "decisionRequirementsMetadata": drg_metadata,
             "formMetadata": [],
         }
         created = writers.append_event(
@@ -135,6 +144,61 @@ class DeploymentProcessor:
                 deployment_key, ValueType.DEPLOYMENT, DeploymentIntent.FULLY_DISTRIBUTED,
                 deployment_value,
             )
+
+    def _deploy_dmn(self, dmn_parsed, writers: Writers):
+        """Version DRGs + decisions and write their CREATED events (reference:
+        deployment/transform DmnResourceTransformer)."""
+        from zeebe_tpu.protocol.intent import (
+            DecisionIntent,
+            DecisionRequirementsIntent,
+        )
+
+        decisions_metadata: list[dict] = []
+        drg_metadata: list[dict] = []
+        for resource_name, xml, drg, checksum in dmn_parsed:
+            duplicate = self.state.decisions.latest_drg_digest(drg.drg_id) == checksum
+            if duplicate:
+                # idempotent redeploy still reports the existing keys/versions
+                # (mirrors the BPMN duplicate path's metadata contract)
+                existing = dict(self.state.decisions.latest_drg_meta(drg.drg_id))
+                existing.pop("resource", None)
+                drg_metadata.append({**existing, "duplicate": True})
+                for meta in self.state.decisions.decisions_of_drg(
+                        existing["decisionRequirementsKey"]):
+                    decisions_metadata.append({**meta, "duplicate": True})
+                continue
+            version = self.state.decisions.latest_drg_version(drg.drg_id) + 1
+            drg_key = self.state.next_key()
+            drg_meta = {
+                "decisionRequirementsId": drg.drg_id,
+                "decisionRequirementsName": drg.name,
+                "version": version,
+                "decisionRequirementsKey": drg_key,
+                "namespace": drg.namespace,
+                "resourceName": resource_name,
+                "checksum": checksum,
+            }
+            drg_metadata.append(drg_meta)
+            writers.append_event(
+                drg_key, ValueType.DECISION_REQUIREMENTS,
+                DecisionRequirementsIntent.CREATED,
+                {**drg_meta, "resource": xml},
+            )
+            for decision in drg.decisions.values():
+                decision_key = self.state.next_key()
+                meta = {
+                    "decisionId": decision.decision_id,
+                    "decisionName": decision.name,
+                    "version": version,
+                    "decisionKey": decision_key,
+                    "decisionRequirementsKey": drg_key,
+                    "decisionRequirementsId": drg.drg_id,
+                }
+                decisions_metadata.append(meta)
+                writers.append_event(
+                    decision_key, ValueType.DECISION, DecisionIntent.CREATED, meta
+                )
+        return decisions_metadata, drg_metadata
 
     def _process_distributed(self, cmd: LoggedRecord, writers: Writers) -> None:
         """Receiver side of deployment distribution: store the definitions under
@@ -181,6 +245,30 @@ class DeploymentProcessor:
             )
             self._register_start_subscriptions(
                 writers, exe, meta, previous_key, include_timers=False
+            )
+        # DMN resources replicate under the origin-minted keys/versions
+        from zeebe_tpu.protocol.intent import (
+            DecisionIntent,
+            DecisionRequirementsIntent,
+        )
+
+        dmn_by_name = {
+            r["resourceName"]: r["resource"] for r in value.get("resources", [])
+        }
+        for drg_meta in value.get("decisionRequirementsMetadata", []):
+            if (self.state.decisions.latest_drg_digest(drg_meta["decisionRequirementsId"])
+                    == drg_meta["checksum"]):
+                continue
+            writers.append_event(
+                drg_meta["decisionRequirementsKey"], ValueType.DECISION_REQUIREMENTS,
+                DecisionRequirementsIntent.CREATED,
+                {**drg_meta, "resource": dmn_by_name.get(drg_meta["resourceName"], "")},
+            )
+        for meta in value.get("decisionsMetadata", []):
+            if self.state.decisions.decision_by_key(meta["decisionKey"]) is not None:
+                continue
+            writers.append_event(
+                meta["decisionKey"], ValueType.DECISION, DecisionIntent.CREATED, meta
             )
         writers.append_event(
             cmd.record.key, ValueType.DEPLOYMENT, DeploymentIntent.DISTRIBUTED, value

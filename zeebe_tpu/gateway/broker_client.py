@@ -177,6 +177,7 @@ class ClusterRuntime:
             return self._responses.pop(request_id)
         finally:
             self._pending.pop(request_id, None)
+            self._responses.pop(request_id, None)
 
     def _resolve(self, response) -> None:
         event = self._pending.get(response.request_id)

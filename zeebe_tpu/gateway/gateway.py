@@ -356,7 +356,59 @@ class GatewayService:
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "migration pending")
 
     def EvaluateDecision(self, request, context):
-        context.abort(grpc.StatusCode.UNIMPLEMENTED, "DMN pending")
+        from zeebe_tpu.protocol.intent import DecisionEvaluationIntent
+
+        record = self._submit(
+            context, DEPLOYMENT_PARTITION,
+            command(ValueType.DECISION_EVALUATION, DecisionEvaluationIntent.EVALUATE, {
+                "decisionId": request.decisionId,
+                "decisionKey": request.decisionKey or -1,
+                "variables": self._parse_vars(context, request.variables),
+            }),
+        )
+        v = record.value
+        return pb.EvaluateDecisionResponse(
+            decisionKey=v.get("decisionKey", -1),
+            decisionId=v.get("decisionId", ""),
+            decisionName=v.get("decisionName", ""),
+            decisionVersion=v.get("decisionVersion", -1),
+            decisionRequirementsId=v.get("decisionRequirementsId", ""),
+            decisionRequirementsKey=v.get("decisionRequirementsKey", -1),
+            decisionOutput=json.dumps(v.get("decisionOutput")),
+            failedDecisionId=v.get("failedDecisionId", ""),
+            failureMessage=v.get("evaluationFailureMessage", ""),
+            tenantId="<default>",
+            decisionInstanceKey=record.key,
+            evaluatedDecisions=[
+                pb.EvaluatedDecision(
+                    decisionId=d.get("decisionId", ""),
+                    decisionName=d.get("decisionName", ""),
+                    decisionType=d.get("decisionType", ""),
+                    decisionOutput=json.dumps(d.get("decisionOutput")),
+                    tenantId="<default>",
+                    evaluatedInputs=[
+                        pb.EvaluatedDecisionInput(
+                            inputId=i.get("inputId", ""),
+                            inputName=i.get("inputName", ""),
+                            inputValue=json.dumps(i.get("inputValue")),
+                        ) for i in d.get("evaluatedInputs", [])
+                    ],
+                    matchedRules=[
+                        pb.MatchedDecisionRule(
+                            ruleId=r.get("ruleId", ""),
+                            ruleIndex=r.get("ruleIndex", 0),
+                            evaluatedOutputs=[
+                                pb.EvaluatedDecisionOutput(
+                                    outputId=o.get("outputId", ""),
+                                    outputName=o.get("outputName", ""),
+                                    outputValue=json.dumps(o.get("outputValue")),
+                                ) for o in r.get("evaluatedOutputs", [])
+                            ],
+                        ) for r in d.get("matchedRules", [])
+                    ],
+                ) for d in v.get("evaluatedDecisions", [])
+            ],
+        )
 
     def DeleteResource(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "resource deletion pending")

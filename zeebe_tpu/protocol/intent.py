@@ -273,6 +273,7 @@ class DecisionRequirementsIntent(Intent):
 class DecisionEvaluationIntent(Intent):
     EVALUATED = 0
     FAILED = 1
+    EVALUATE = 2  # standalone evaluation command (gateway EvaluateDecision rpc)
 
     _EVENT_NAMES = enum.nonmember(frozenset({"EVALUATED", "FAILED"}))
 

@@ -99,6 +99,7 @@ class ColumnFamilyCode(enum.IntEnum):
     DMN_DECISION_REQUIREMENTS = 161
     DMN_LATEST_DECISION_BY_ID = 162
     DMN_LATEST_DRG_BY_ID = 163
+    DMN_DECISIONS_BY_DRG = 164
     USER_TASKS = 170
     USER_TASK_STATES = 171
     COMPENSATION_SUBSCRIPTION = 180
