@@ -1,0 +1,22 @@
+"""Backup/restore: cluster-consistent checkpoints shipped to a backup store.
+
+Reference: backup/ + backup-stores/{s3,gcs} + restore/ (SURVEY §2.12, §5.4) —
+CheckpointRecordsProcessor.java:34 (CHECKPOINT records interleaved on the
+stream; inter-partition commands carry checkpoint ids so a cluster-wide
+consistent checkpoint forms without stopping processing), BackupServiceImpl
+(snapshot + segments → BackupStore), PartitionRestoreService.java:36.
+"""
+
+from zeebe_tpu.backup.checkpoint import CheckpointProcessor, CheckpointState
+from zeebe_tpu.backup.store import Backup, BackupStatus, FileSystemBackupStore
+from zeebe_tpu.backup.service import BackupService, PartitionRestoreService
+
+__all__ = [
+    "Backup",
+    "BackupService",
+    "BackupStatus",
+    "CheckpointProcessor",
+    "CheckpointState",
+    "FileSystemBackupStore",
+    "PartitionRestoreService",
+]

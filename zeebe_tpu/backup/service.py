@@ -1,0 +1,75 @@
+"""Backup + restore services over a partition's files.
+
+Reference: backup/src/main/java/io/camunda/zeebe/backup/management/
+BackupServiceImpl (snapshot + segment files → BackupStore, reserving the
+snapshot during the copy) and restore/…/PartitionRestoreService.java:36
+(download backup, reconstitute the partition data directories so a broker
+boots from them).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from zeebe_tpu.backup.store import Backup, BackupStatus, FileSystemBackupStore
+
+
+class BackupService:
+    """Takes one partition's backup at a checkpoint."""
+
+    def __init__(self, store: FileSystemBackupStore, node_id: str) -> None:
+        self.store = store
+        self.node_id = node_id
+
+    def take_backup(self, partition, checkpoint_id: int,
+                    checkpoint_position: int) -> BackupStatus:
+        """Backup = current persisted snapshot + the stream journal suffix
+        (events after the snapshot up to the checkpoint). The partition keeps
+        processing — the checkpoint record already fixed the logical cut."""
+        partition.take_snapshot()
+        snapshot = partition.snapshot_store.latest_snapshot()
+        snapshot_files = {}
+        descriptor = {"snapshotId": None}
+        if snapshot is not None:
+            descriptor["snapshotId"] = str(snapshot.id)
+            snapshot_files = {p.name: p.read_bytes() for p in snapshot.files()}
+        partition.stream_journal.flush()
+        segment_files = {
+            p.name: p.read_bytes()
+            for p in sorted(partition.stream_journal.dir.iterdir())
+            if p.is_file()
+        }
+        backup = Backup(
+            checkpoint_id=checkpoint_id,
+            partition_id=partition.partition_id,
+            node_id=self.node_id,
+            checkpoint_position=checkpoint_position,
+            descriptor=descriptor,
+            snapshot_files=snapshot_files,
+            segment_files=segment_files,
+        )
+        return self.store.save(backup)
+
+
+class PartitionRestoreService:
+    """Reconstitute a partition data directory from a backup; a broker started
+    over the directory recovers via the normal snapshot+replay path."""
+
+    def __init__(self, store: FileSystemBackupStore) -> None:
+        self.store = store
+
+    def restore(self, checkpoint_id: int, partition_id: int,
+                target_directory: str | Path) -> None:
+        backup = self.store.read(checkpoint_id, partition_id)
+        target = Path(target_directory)
+        stream_dir = target / "stream"
+        snapshot_dir = target / "snapshots" / "snapshots"
+        stream_dir.mkdir(parents=True, exist_ok=True)
+        for name, data in backup.segment_files.items():
+            (stream_dir / name).write_bytes(data)
+        snapshot_id = backup.descriptor.get("snapshotId")
+        if snapshot_id and backup.snapshot_files:
+            snap_target = snapshot_dir / snapshot_id
+            snap_target.mkdir(parents=True, exist_ok=True)
+            for name, data in backup.snapshot_files.items():
+                (snap_target / name).write_bytes(data)

@@ -67,7 +67,11 @@ class ClusterInterPartitionSender:
         leader = self.broker.known_leader(receiver_partition_id)
         if leader is None:
             return  # no known leader: the redistributor/checker will retry
-        payload = {"record": record.to_bytes(), "key": record.key}
+        # piggyback the checkpoint id: the receiver creates the checkpoint
+        # BEFORE processing, keeping cluster-wide backups consistent
+        # (reference: InterPartitionCommandSenderImpl checkpoint-id prefix)
+        payload = {"record": record.to_bytes(), "key": record.key,
+                   "checkpointId": self.broker.latest_checkpoint_id()}
         self.broker.messaging.send(
             leader, f"{INTER_PARTITION_TOPIC}-{receiver_partition_id}", payload
         )
@@ -90,7 +94,8 @@ class Broker:
                  directory: str | Path | None = None,
                  clock_millis: Callable[[], int] | None = None,
                  exporters_factory: Callable[[], dict[str, Any]] | None = None,
-                 response_sink: Callable[[Any], None] | None = None) -> None:
+                 response_sink: Callable[[Any], None] | None = None,
+                 backup_store_directory: str | Path | None = None) -> None:
         import time
 
         self.cfg = cfg
@@ -106,6 +111,14 @@ class Broker:
         )
         self.responses: list = []
         sink = response_sink if response_sink is not None else self.responses.append
+        backup_service = None
+        if backup_store_directory is not None:
+            from zeebe_tpu.backup import BackupService, FileSystemBackupStore
+
+            self.backup_store = FileSystemBackupStore(backup_store_directory)
+            backup_service = BackupService(self.backup_store, cfg.node_id)
+        else:
+            self.backup_store = None
         self.partitions: dict[int, ZeebePartition] = {}
         sender = ClusterInterPartitionSender(self)
         for partition_id, members in partition_distribution(cfg).items():
@@ -121,6 +134,8 @@ class Broker:
                 response_sink=sink,
                 snapshot_period_ms=cfg.snapshot_period_ms,
                 consistency_checks=cfg.consistency_checks,
+                backup_service=backup_service,
+                on_checkpoint=self._observe_checkpoint,
             )
             messaging.subscribe(
                 f"{INTER_PARTITION_TOPIC}-{partition_id}",
@@ -138,8 +153,18 @@ class Broker:
         record = Record.from_bytes(payload["record"])
         record = record.replace(key=payload.get("key", record.key))
         partition = self.partitions.get(partition_id)
-        if partition is not None and partition.is_leader:
-            partition.write_commands([record])
+        if partition is None or not partition.is_leader:
+            return
+        incoming_checkpoint = payload.get("checkpointId", 0)
+        if incoming_checkpoint > partition.latest_checkpoint_id():
+            from zeebe_tpu.protocol import ValueType as _VT
+            from zeebe_tpu.protocol import command as _command
+            from zeebe_tpu.protocol.intent import CheckpointIntent as _CI
+
+            partition.write_commands([_command(
+                _VT.CHECKPOINT, _CI.CREATE, {"checkpointId": incoming_checkpoint},
+            )])
+        partition.write_commands([record])
 
     def _on_client_command(self, partition_id: int, sender: str,
                            payload: dict) -> None:
@@ -205,6 +230,40 @@ class Broker:
             "nodeId": self.cfg.node_id,
             "partitions": [p.health() for p in self.partitions.values()],
         }
+
+    # -- backup ----------------------------------------------------------------
+
+    _checkpoint_cache = 0
+
+    def latest_checkpoint_id(self) -> int:
+        """Hot path (piggybacked on every inter-partition send): cached, and
+        bumped by the partitions' checkpoint-created listeners."""
+        if self._checkpoint_cache == 0:
+            self._checkpoint_cache = max(
+                (p.latest_checkpoint_id() for p in self.partitions.values()),
+                default=0,
+            )
+        return self._checkpoint_cache
+
+    def _observe_checkpoint(self, checkpoint_id: int) -> None:
+        if checkpoint_id > self._checkpoint_cache:
+            self._checkpoint_cache = checkpoint_id
+
+    def trigger_checkpoint(self, checkpoint_id: int) -> int:
+        """Write CHECKPOINT CREATE to every local leader partition (the admin
+        BackupRequest fan-out, reference: BackupApiRequestHandler). Returns how
+        many partitions accepted the trigger."""
+        from zeebe_tpu.protocol import ValueType as _VT
+        from zeebe_tpu.protocol import command as _command
+        from zeebe_tpu.protocol.intent import CheckpointIntent as _CI
+
+        count = 0
+        for partition in self.partitions.values():
+            if partition.is_leader and partition.write_commands([_command(
+                _VT.CHECKPOINT, _CI.CREATE, {"checkpointId": checkpoint_id},
+            )]) is not None:
+                count += 1
+        return count
 
 
 class InProcessCluster:

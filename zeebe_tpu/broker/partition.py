@@ -67,6 +67,8 @@ class ZeebePartition:
         snapshot_period_ms: int = DEFAULT_SNAPSHOT_PERIOD_MS,
         priority: int = 1,
         consistency_checks: bool = True,
+        backup_service=None,
+        on_checkpoint=None,
     ) -> None:
         self.partition_id = partition_id
         self.partition_count = partition_count
@@ -80,6 +82,8 @@ class ZeebePartition:
         self.response_sink = response_sink or (lambda r: None)
         self.snapshot_period_ms = snapshot_period_ms
         self.consistency_checks = consistency_checks
+        self.backup_service = backup_service  # BackupService | None
+        self.on_checkpoint = on_checkpoint  # broker cache-bump hook
 
         self.snapshot_store = FileBasedSnapshotStore(self.directory / "snapshots")
         self.raft = RaftNode(
@@ -162,6 +166,7 @@ class ZeebePartition:
         self.exporter_director = ExporterDirector(
             self.stream, self.db, self.exporters_factory(),
         )
+        self.engine.checkpoint.listeners.append(self._on_checkpoint_created)
         if self.role == RaftRole.LEADER:
             # leader sequencer continues after the last position in the raft
             # log (committed or not — uncommitted entries still own positions)
@@ -343,6 +348,18 @@ class ZeebePartition:
             self.exporter_director.close()
         self.raft.close()
         self.stream_journal.close()
+
+    def latest_checkpoint_id(self) -> int:
+        if self.engine is None:
+            return 0
+        with self.db.transaction():
+            return self.engine.checkpoint_state.latest_id()
+
+    def _on_checkpoint_created(self, checkpoint_id: int, position: int) -> None:
+        if self.on_checkpoint is not None:
+            self.on_checkpoint(checkpoint_id)
+        if self.backup_service is not None:
+            self.backup_service.take_backup(self, checkpoint_id, position)
 
     @property
     def is_leader(self) -> bool:

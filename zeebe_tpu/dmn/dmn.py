@@ -281,13 +281,15 @@ def parse_unary_tests(text: str, decision_id: str = "?") -> Callable[[Any, dict]
     text = (text or "").strip()
     if text in ("", "-"):
         return lambda value, ctx: True
-    if text.startswith("not(") and text.endswith(")"):
-        inner = parse_unary_tests(text[4:-1], decision_id)
-        return lambda value, ctx: not inner(value, ctx)
+    # disjunction first: 'not("a"), not("b")' is a list of two negations,
+    # not one big not(...) wrapper
     parts = _split_top_level(text)
     if len(parts) > 1:
         tests = [parse_unary_tests(p, decision_id) for p in parts]
         return lambda value, ctx: any(t(value, ctx) for t in tests)
+    if text.startswith("not(") and text.endswith(")"):
+        inner = parse_unary_tests(text[4:-1], decision_id)
+        return lambda value, ctx: not inner(value, ctx)
     return _parse_single_test(text, decision_id)
 
 
@@ -348,8 +350,11 @@ def _parse_single_test(text: str, decision_id: str) -> Callable[[Any, dict], boo
 
             return cmp
     if "?" in _strip_strings(text):
-        # boolean expression over the input value, e.g. "? * 2 > 10"
-        expr = _compile(text.replace("?", "__input__"), decision_id)
+        # boolean expression over the input value, e.g. "? * 2 > 10";
+        # substitute only outside string literals ('? = "N/A?"' keeps the
+        # question mark inside the string)
+        expr = _compile(_replace_outside_strings(text, "?", "__input__"),
+                        decision_id)
 
         def qmark(value, ctx):
             return bool(_eval(expr, {**ctx, "__input__": value}))
@@ -362,6 +367,19 @@ def _parse_single_test(text: str, decision_id: str) -> Callable[[Any, dict], boo
         return _eval(expr, ctx) == value
 
     return eq
+
+
+def _replace_outside_strings(text: str, needle: str, replacement: str) -> str:
+    out, in_str = [], False
+    for ch in text:
+        if ch == '"':
+            in_str = not in_str
+            out.append(ch)
+        elif not in_str and ch == needle:
+            out.append(replacement)
+        else:
+            out.append(ch)
+    return "".join(out)
 
 
 def _strip_strings(text: str) -> str:
@@ -539,13 +557,21 @@ class DecisionEngine:
             agg = decision.aggregation
             if not agg or agg == "LIST":
                 return values
-            numbers = [v for v in values if isinstance(v, (int, float))]
-            if agg == "SUM":
-                return sum(numbers)
-            if agg == "MIN":
-                return min(numbers) if numbers else None
-            if agg == "MAX":
-                return max(numbers) if numbers else None
             if agg == "COUNT":
                 return len(values)
+            non_numeric = [v for v in values
+                           if not isinstance(v, (int, float)) or isinstance(v, bool)]
+            if non_numeric:
+                # a modeling error must surface as an evaluation failure, not a
+                # plausible-looking partial aggregate (reference behavior)
+                raise DmnEvalError(
+                    f"COLLECT {agg} over non-numeric outputs {non_numeric!r} in "
+                    f"'{decision.decision_id}'", decision.decision_id,
+                )
+            if agg == "SUM":
+                return sum(values)
+            if agg == "MIN":
+                return min(values) if values else None
+            if agg == "MAX":
+                return max(values) if values else None
         return shape(matched[0][2])

@@ -127,6 +127,10 @@ class EventAppliers:
         reg[(ValueType.DECISION, int(DecisionIntent.CREATED))] = self._decision_created
         reg[(ValueType.DECISION_EVALUATION, int(DecisionEvaluationIntent.EVALUATED))] = self._noop
         reg[(ValueType.DECISION_EVALUATION, int(DecisionEvaluationIntent.FAILED))] = self._noop
+        from zeebe_tpu.protocol.intent import CheckpointIntent
+
+        reg[(ValueType.CHECKPOINT, int(CheckpointIntent.CREATED))] = self._checkpoint_created
+        reg[(ValueType.CHECKPOINT, int(CheckpointIntent.IGNORED))] = self._noop
 
     def can_apply(self, record: Record) -> bool:
         return (record.value_type, int(record.intent)) in self._appliers
@@ -145,6 +149,11 @@ class EventAppliers:
 
     def _noop(self, record: Record) -> None:
         pass
+
+    def _checkpoint_created(self, record: Record) -> None:
+        self.state.checkpoints.put(
+            record.value["checkpointId"], record.value["checkpointPosition"]
+        )
 
     def _drg_created(self, record: Record) -> None:
         self.state.decisions.put_drg(record.key, record.value)

@@ -20,7 +20,7 @@ from zeebe_tpu.protocol.intent import DecisionEvaluationIntent
 _ENGINE = DecisionEngine()
 
 
-def evaluation_record_value(state: EngineState, decision_meta: dict,
+def evaluation_record_value(decision_meta: dict,
                             result: DecisionEvaluationResult) -> dict:
     """The DECISION_EVALUATION record shape (reference: protocol-impl
     DecisionEvaluationRecord — full audit trail)."""
@@ -100,7 +100,7 @@ class BpmnDecisionBehavior:
         context = self.state.variables.collect(key)
         result = evaluate_decision(self.state, decision_meta, context)
         eval_key = self.state.next_key()
-        record_value = evaluation_record_value(self.state, decision_meta, result)
+        record_value = evaluation_record_value(decision_meta, result)
         record_value.update({
             "processInstanceKey": value.get("processInstanceKey", -1),
             "elementInstanceKey": key,
@@ -159,6 +159,6 @@ class DecisionEvaluationProcessor:
             eval_key, ValueType.DECISION_EVALUATION,
             DecisionEvaluationIntent.FAILED if result.failed
             else DecisionEvaluationIntent.EVALUATED,
-            evaluation_record_value(self.state, decision_meta, result),
+            evaluation_record_value(decision_meta, result),
         )
         writers.respond(cmd, record)

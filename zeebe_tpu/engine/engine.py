@@ -27,6 +27,7 @@ from zeebe_tpu.engine.writers import Writers
 from zeebe_tpu.logstreams import LoggedRecord
 from zeebe_tpu.protocol import RejectionType, ValueType
 from zeebe_tpu.protocol.intent import (
+    CheckpointIntent,
     CommandDistributionIntent,
     DecisionEvaluationIntent,
     DeploymentIntent,
@@ -112,6 +113,10 @@ class Engine(RecordProcessor):
         from zeebe_tpu.engine.decision import DecisionEvaluationProcessor
 
         decision_eval = DecisionEvaluationProcessor(self.state)
+        from zeebe_tpu.backup.checkpoint import CheckpointProcessor
+
+        self.checkpoint_state = self.state.checkpoints
+        self.checkpoint = CheckpointProcessor(self.checkpoint_state)
 
         def _deployment_fully_distributed(wr, distribution_key, stored):
             wr.append_event(
@@ -149,6 +154,7 @@ class Engine(RecordProcessor):
             (ValueType.SIGNAL, int(SignalIntent.BROADCAST)): signals.broadcast,
             (ValueType.COMMAND_DISTRIBUTION, int(CommandDistributionIntent.ACKNOWLEDGE)): dist_ack.process,
             (ValueType.DECISION_EVALUATION, int(DecisionEvaluationIntent.EVALUATE)): decision_eval.process,
+            (ValueType.CHECKPOINT, int(CheckpointIntent.CREATE)): self.checkpoint.process,
         }
         self.state.load_key_generator()
 

@@ -902,6 +902,9 @@ class EngineState:
         self.signal_subscriptions = SignalSubscriptionState(db)
         self.distribution = DistributionState(db)
         self.decisions = DecisionState(db)
+        from zeebe_tpu.backup.checkpoint import CheckpointState
+
+        self.checkpoints = CheckpointState(db)
         self._key_cf = db.column_family(CF.KEY)
         self.key_generator = KeyGenerator(partition_id)
         self._key_loaded = False
