@@ -1,0 +1,246 @@
+"""Ops shell tests: metrics, health, backpressure, config binding, disk
+monitor, management server (reference: SURVEY §5.5/§5.6, backpressure docs,
+dist/shared/management actuator endpoints)."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from zeebe_tpu.broker.backpressure import (
+    AimdLimit,
+    CommandRateLimiter,
+    VegasLimit,
+)
+from zeebe_tpu.broker.config import load_broker_cfg
+from zeebe_tpu.broker.disk import DiskSpaceMonitor
+from zeebe_tpu.protocol import ValueType, command
+from zeebe_tpu.protocol.intent import JobIntent, ProcessInstanceCreationIntent
+from zeebe_tpu.utils.health import CriticalComponentsHealthMonitor, HealthStatus
+from zeebe_tpu.utils.metrics import MetricsRegistry
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_exposition(self):
+        reg = MetricsRegistry()
+        reg.counter("records_total", "records", ("partition",)).labels("1").inc(3)
+        reg.gauge("role").set(1)
+        reg.histogram("latency", buckets=(0.1, 1.0)).observe(0.5)
+        text = reg.expose()
+        assert 'zeebe_records_total{partition="1"} 3.0' in text
+        assert "zeebe_role 1" in text
+        assert 'zeebe_latency_bucket{le="1.0"} 1' in text
+        assert "zeebe_latency_count 1" in text
+        assert "# TYPE zeebe_records_total counter" in text
+
+    def test_same_name_returns_same_metric(self):
+        reg = MetricsRegistry()
+        assert reg.counter("x") is reg.counter("x")
+
+
+class TestHealthMonitor:
+    def test_aggregates_to_worst(self):
+        mon = CriticalComponentsHealthMonitor()
+        mon.register("a")
+        mon.register("b")
+        assert mon.is_healthy()
+        mon.report("b", HealthStatus.UNHEALTHY, "raft stalled")
+        assert mon.status() == HealthStatus.UNHEALTHY
+        mon.report("a", HealthStatus.DEAD)
+        assert mon.status() == HealthStatus.DEAD
+
+    def test_listeners_fire_on_change_only(self):
+        mon = CriticalComponentsHealthMonitor()
+        events = []
+        mon.add_listener(lambda r: events.append((r.component, r.status)))
+        mon.report("x", HealthStatus.UNHEALTHY)
+        mon.report("x", HealthStatus.UNHEALTHY)  # no change
+        mon.report("x", HealthStatus.HEALTHY)
+        assert events == [("x", HealthStatus.UNHEALTHY), ("x", HealthStatus.HEALTHY)]
+
+
+def _cmd():
+    return command(ValueType.PROCESS_INSTANCE_CREATION,
+                   ProcessInstanceCreationIntent.CREATE, {})
+
+
+class TestBackpressure:
+    def test_fixed_limit_rejects_above_limit(self):
+        limiter = CommandRateLimiter("fixed", limit=2)
+        assert limiter.try_acquire(_cmd())
+        limiter.on_appended(1)
+        limiter.on_appended(2)
+        assert not limiter.try_acquire(_cmd())
+        assert limiter.dropped_total == 1
+        limiter.on_processed(1)
+        assert limiter.try_acquire(_cmd())
+
+    def test_whitelist_bypasses(self):
+        limiter = CommandRateLimiter("fixed", limit=0)
+        complete = command(ValueType.JOB, JobIntent.COMPLETE, {}, key=1)
+        assert limiter.try_acquire(complete)
+        assert not limiter.try_acquire(_cmd())
+
+    def test_aimd_backs_off_on_timeout(self):
+        limit = AimdLimit(initial=100, timeout_ms=10)
+        limit.on_sample(50.0, 10, dropped=False)  # rtt above timeout
+        assert limit.limit < 100
+        before = limit.limit
+        limit.on_sample(1.0, before, dropped=False)  # fast + loaded: grow
+        assert limit.limit == before + 1
+
+    def test_vegas_adapts(self):
+        limit = VegasLimit(initial=20)
+        for _ in range(5):
+            limit.on_sample(10.0, 10, dropped=False)  # rtt == minRTT: no queue
+        assert limit.limit > 20
+        grown = limit.limit
+        for _ in range(50):
+            limit.on_sample(1000.0, 10, dropped=False)  # huge queueing
+        assert limit.limit < grown
+
+
+class TestConfigBinding:
+    def test_env_binding_and_validation(self):
+        cfg = load_broker_cfg(env={
+            "ZEEBE_BROKER_CLUSTER_NODEID": "node-7",
+            "ZEEBE_BROKER_CLUSTER_PARTITIONSCOUNT": "5",
+            "ZEEBE_BROKER_CLUSTER_INITIALCONTACTPOINTS": "node-7,node-8",
+            "ZEEBE_BROKER_BACKPRESSURE_ALGORITHM": "aimd",
+            "ZEEBE_BROKER_BACKPRESSURE_ENABLED": "false",
+            "ZEEBE_BROKER_PROCESSING_MAXCOMMANDSINBATCH": "42",
+        })
+        assert cfg.base.node_id == "node-7"
+        assert cfg.base.partition_count == 5
+        assert cfg.base.cluster_members == ["node-7", "node-8"]
+        assert cfg.backpressure.algorithm == "aimd"
+        assert not cfg.backpressure.enabled
+        assert cfg.processing.max_commands_in_batch == 42
+
+    def test_invalid_config_rejected(self):
+        with pytest.raises(ValueError):
+            load_broker_cfg(env={"ZEEBE_BROKER_CLUSTER_PARTITIONSCOUNT": "0"})
+        with pytest.raises(ValueError):
+            load_broker_cfg(env={"ZEEBE_BROKER_BACKPRESSURE_ALGORITHM": "nope"})
+
+    def test_overrides_beat_env(self):
+        cfg = load_broker_cfg(
+            env={"ZEEBE_BROKER_CLUSTER_PARTITIONSCOUNT": "5"},
+            overrides={"base.partition_count": 2},
+        )
+        assert cfg.base.partition_count == 2
+
+
+class TestDiskMonitor:
+    def test_pauses_below_watermark(self, tmp_path):
+        clock = {"now": 0}
+        monitor = DiskSpaceMonitor(tmp_path, min_free_bytes=1,
+                                   interval_ms=100,
+                                   clock_millis=lambda: clock["now"])
+        events = []
+        monitor.listeners.append(events.append)
+        assert not monitor.check(0)
+        # absurd watermark → out of space
+        monitor.min_free_bytes = 2**62
+        clock["now"] = 200
+        assert monitor.check()
+        assert events == [True]
+        monitor.min_free_bytes = 1
+        clock["now"] = 400
+        assert not monitor.check()
+        assert events == [True, False]
+
+    def test_rate_limited(self, tmp_path):
+        clock = {"now": 0}
+        monitor = DiskSpaceMonitor(tmp_path, min_free_bytes=2**62,
+                                   interval_ms=1000,
+                                   clock_millis=lambda: clock["now"])
+        clock["now"] = 1000
+        assert monitor.check()
+        monitor.min_free_bytes = 1
+        clock["now"] = 1500  # within interval: stale answer
+        assert monitor.check()
+        clock["now"] = 2100
+        assert not monitor.check()
+
+
+class TestManagementServer:
+    @pytest.fixture(scope="class")
+    def broker_stack(self, tmp_path_factory):
+        from zeebe_tpu.broker import Broker, BrokerCfg
+        from zeebe_tpu.broker.management import ManagementServer
+        from zeebe_tpu.cluster.messaging import LoopbackNetwork
+        from zeebe_tpu.testing import ControlledClock
+
+        clock = ControlledClock()
+        net = LoopbackNetwork()
+        cfg = BrokerCfg(node_id="b0", partition_count=1, replication_factor=1,
+                        cluster_members=["b0"])
+        broker = Broker(cfg, net.join("b0"),
+                        directory=tmp_path_factory.mktemp("mgmt"),
+                        clock_millis=clock,
+                        backup_store_directory=tmp_path_factory.mktemp("bk"))
+        for _ in range(300):
+            clock.advance(50)
+            broker.pump()
+            net.deliver_all()
+        server = ManagementServer(broker)
+        server.start()
+        yield broker, server, clock, net
+        server.stop()
+        broker.close()
+
+    def _get(self, server, path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}{path}"
+        ) as resp:
+            return resp.status, resp.read().decode()
+
+    def test_health_ready_partitions(self, broker_stack):
+        broker, server, clock, net = broker_stack
+        status, body = self._get(server, "/health")
+        assert status == 200
+        assert json.loads(body)["status"] == "HEALTHY"
+        status, body = self._get(server, "/ready")
+        assert status == 200 and json.loads(body)["ready"]
+        status, body = self._get(server, "/partitions")
+        assert json.loads(body)[0]["partitionId"] == 1
+
+    def test_metrics_exposition(self, broker_stack):
+        broker, server, clock, net = broker_stack
+        status, body = self._get(server, "/metrics")
+        assert status == 200
+        assert "zeebe_raft_role" in body
+
+    def test_backup_trigger_endpoint(self, broker_stack):
+        broker, server, clock, net = broker_stack
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/backups/3", method="POST"
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 202
+            assert json.loads(resp.read())["partitions"] == 1
+        for _ in range(20):
+            clock.advance(50)
+            broker.pump()
+            net.deliver_all()
+        status, body = self._get(server, "/backups")
+        entries = json.loads(body)
+        assert any(e["checkpointId"] == 3 and e["status"] == "COMPLETED"
+                   for e in entries)
+
+    def test_pause_resume(self, broker_stack):
+        broker, server, clock, net = broker_stack
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/pause", method="POST")
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+        assert all(p.paused for p in broker.partitions.values())
+        assert broker.write_command(1, _cmd()) is None  # ingress rejected
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/resume", method="POST")
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+        assert not any(p.paused for p in broker.partitions.values())

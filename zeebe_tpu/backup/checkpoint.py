@@ -69,9 +69,3 @@ class CheckpointProcessor:
                 listener(checkpoint_id, position)
 
         writers.after_commit(notify)
-
-    def apply(self, record) -> None:
-        """Event applier (CREATED only; IGNORED is a no-op)."""
-        if record.intent == CheckpointIntent.CREATED:
-            self.state.put(record.value["checkpointId"],
-                           record.value["checkpointPosition"])

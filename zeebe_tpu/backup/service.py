@@ -67,6 +67,18 @@ class PartitionRestoreService:
         stream_dir.mkdir(parents=True, exist_ok=True)
         for name, data in backup.segment_files.items():
             (stream_dir / name).write_bytes(data)
+        # cut the restored log at the checkpoint: records appended after the
+        # CHECKPOINT command (the backup raced ongoing processing) would move
+        # the logical cut point and break cross-partition consistency
+        from zeebe_tpu.journal import SegmentedJournal
+
+        journal = SegmentedJournal(stream_dir)
+        try:
+            cut_index = journal.seek_to_asqn(backup.checkpoint_position)
+            if cut_index > 0:
+                journal.truncate_after(cut_index)
+        finally:
+            journal.close()
         snapshot_id = backup.descriptor.get("snapshotId")
         if snapshot_id and backup.snapshot_files:
             snap_target = snapshot_dir / snapshot_id

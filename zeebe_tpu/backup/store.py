@@ -104,12 +104,14 @@ class FileSystemBackupStore:
         out = []
         partitions = (
             [self.directory / str(partition_id)] if partition_id is not None
-            else sorted(p for p in self.directory.iterdir() if p.is_dir())
+            else sorted((p for p in self.directory.iterdir() if p.is_dir()),
+                        key=lambda p: int(p.name))
         )
         for pdir in partitions:
             if not pdir.exists():
                 continue
-            for cdir in sorted(pdir.iterdir()):
+            for cdir in sorted(pdir.iterdir(),
+                               key=lambda p: int(p.name.removesuffix(".tmp"))):
                 if cdir.is_dir() and not cdir.name.endswith(".tmp"):
                     out.append(self.get_status(int(cdir.name), int(pdir.name)))
         return out

@@ -95,8 +95,16 @@ class Broker:
                  clock_millis: Callable[[], int] | None = None,
                  exporters_factory: Callable[[], dict[str, Any]] | None = None,
                  response_sink: Callable[[Any], None] | None = None,
-                 backup_store_directory: str | Path | None = None) -> None:
+                 backup_store_directory: str | Path | None = None,
+                 backpressure_algorithm: str = "vegas",
+                 backpressure_enabled: bool = True,
+                 disk_min_free_bytes: int = 0) -> None:
         import time
+
+        from zeebe_tpu.broker.backpressure import CommandRateLimiter
+        from zeebe_tpu.broker.disk import DiskSpaceMonitor
+        from zeebe_tpu.utils.health import CriticalComponentsHealthMonitor
+        from zeebe_tpu.utils.metrics import REGISTRY
 
         self.cfg = cfg
         self.messaging = messaging
@@ -106,9 +114,30 @@ class Broker:
             directory = self._tmp.name
         self.directory = Path(directory)
         self.clock_millis = clock_millis or (lambda: int(time.time() * 1000))
+        self.disk_monitor = (
+            DiskSpaceMonitor(self.directory, disk_min_free_bytes,
+                             clock_millis=self.clock_millis)
+            if disk_min_free_bytes > 0 else None
+        )
         self.membership = MembershipService(
             messaging, cfg.cluster_members, self.clock_millis
         )
+        self.health_monitor = CriticalComponentsHealthMonitor(cfg.node_id)
+        self._metrics = {
+            "written": REGISTRY.counter(
+                "log_appender_record_appended_total",
+                "records appended to partition logs", ("node", "partition")),
+            "dropped": REGISTRY.counter(
+                "backpressure_requests_dropped_total",
+                "client commands rejected by backpressure", ("node", "partition")),
+            "inflight": REGISTRY.gauge(
+                "backpressure_inflight_requests_count",
+                "commands appended but not yet processed", ("node", "partition")),
+            "role": REGISTRY.gauge(
+                "raft_role", "1=leader 0=follower", ("node", "partition")),
+            "health": REGISTRY.gauge(
+                "health", "0=healthy 1=unhealthy 2=dead", ("node",)),
+        }
         self.responses: list = []
         sink = response_sink if response_sink is not None else self.responses.append
         backup_service = None
@@ -124,6 +153,9 @@ class Broker:
         for partition_id, members in partition_distribution(cfg).items():
             if cfg.node_id not in members:
                 continue
+            limiter = CommandRateLimiter(
+                backpressure_algorithm, clock_millis=self.clock_millis,
+            ) if backpressure_enabled else None
             self.partitions[partition_id] = ZeebePartition(
                 messaging, partition_id, members,
                 self.directory / f"partition-{partition_id}",
@@ -136,7 +168,9 @@ class Broker:
                 consistency_checks=cfg.consistency_checks,
                 backup_service=backup_service,
                 on_checkpoint=self._observe_checkpoint,
+                backpressure=limiter,
             )
+            self.health_monitor.register(f"partition-{partition_id}")
             messaging.subscribe(
                 f"{INTER_PARTITION_TOPIC}-{partition_id}",
                 lambda s, p, pid=partition_id: self._on_inter_partition_command(pid, s, p),
@@ -174,11 +208,12 @@ class Broker:
             partition.write_commands([record])
 
     def write_command(self, partition_id: int, record: Record) -> int | None:
-        """Local API ingress (the gateway talks to the leader broker)."""
+        """Local API ingress (the gateway talks to the leader broker):
+        backpressure + disk-pause gated, unlike internal write paths."""
         partition = self.partitions.get(partition_id)
         if partition is None or not partition.is_leader:
             return None
-        return partition.write_commands([record])
+        return partition.client_write(record)
 
     # -- topology --------------------------------------------------------------
 
@@ -214,10 +249,41 @@ class Broker:
         for partition in self.partitions.values():
             partition.tick()
         self.membership.tick()
+        if self.disk_monitor is not None:
+            disk_paused = self.disk_monitor.check()
+            for partition in self.partitions.values():
+                partition.disk_paused = disk_paused
         for partition in self.partitions.values():
             work += partition.pump()
+        self._update_observability()
         self._gossip_roles()
         return work
+
+    def _update_observability(self) -> None:
+        from zeebe_tpu.utils.health import HealthStatus
+
+        node = self.cfg.node_id
+        for pid, partition in self.partitions.items():
+            label = str(pid)
+            self._metrics["role"].labels(node, label).set(
+                1 if partition.is_leader else 0)
+            if partition.limiter is not None:
+                self._metrics["inflight"].labels(node, label).set(
+                    len(partition.limiter.in_flight))
+                dropped = self._metrics["dropped"].labels(node, label)
+                dropped.value = float(partition.limiter.dropped_total)
+            self._metrics["written"].labels(node, label).value = float(
+                partition.stream.last_position)
+            failed = (
+                partition.processor is not None
+                and partition.processor.phase.value == "failed"
+            )
+            self.health_monitor.report(
+                f"partition-{pid}",
+                HealthStatus.UNHEALTHY if failed else HealthStatus.HEALTHY,
+            )
+        self._metrics["health"].labels(node).set(
+            float(self.health_monitor.status()))
 
     def close(self) -> None:
         for partition in self.partitions.values():
@@ -230,6 +296,15 @@ class Broker:
             "nodeId": self.cfg.node_id,
             "partitions": [p.health() for p in self.partitions.values()],
         }
+
+    def pause_processing(self) -> None:
+        """BrokerAdminService pause: stop accepting client commands."""
+        for partition in self.partitions.values():
+            partition.paused = True
+
+    def resume_processing(self) -> None:
+        for partition in self.partitions.values():
+            partition.paused = False
 
     # -- backup ----------------------------------------------------------------
 

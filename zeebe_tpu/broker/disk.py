@@ -1,0 +1,45 @@
+"""Disk-space monitor: pause ingestion when the data volume runs low.
+
+Reference: broker/src/main/java/io/camunda/zeebe/broker/system/monitoring/
+DiskSpaceUsageMonitorActor.java:22,57-72 — periodic free-space check against
+the configured watermark; listeners pause command ingestion (and exporting)
+while below it and resume once space frees up. Processing of already-committed
+work continues so the log can compact itself back under the watermark.
+"""
+
+from __future__ import annotations
+
+import shutil
+from pathlib import Path
+from typing import Callable
+
+
+class DiskSpaceMonitor:
+    def __init__(self, directory: str | Path, min_free_bytes: int,
+                 interval_ms: int = 10_000,
+                 clock_millis: Callable[[], int] | None = None) -> None:
+        import time
+
+        self.directory = Path(directory)
+        self.min_free_bytes = min_free_bytes
+        self.interval_ms = interval_ms
+        self.clock_millis = clock_millis or (lambda: int(time.time() * 1000))
+        self.out_of_space = False
+        self._last_check_ms = 0
+        self.listeners: list[Callable[[bool], None]] = []
+
+    def free_bytes(self) -> int:
+        return shutil.disk_usage(self.directory).free
+
+    def check(self, now_millis: int | None = None) -> bool:
+        """Returns True when ingestion must pause. Rate-limited by interval."""
+        now = self.clock_millis() if now_millis is None else now_millis
+        if now - self._last_check_ms < self.interval_ms:
+            return self.out_of_space
+        self._last_check_ms = now
+        below = self.free_bytes() < self.min_free_bytes
+        if below != self.out_of_space:
+            self.out_of_space = below
+            for listener in self.listeners:
+                listener(below)
+        return self.out_of_space

@@ -1,0 +1,117 @@
+"""Management HTTP server: health probes, metrics, admin operations.
+
+Reference: dist/shared/management — actuator endpoints (startup/ready/liveness
+probes wired to BrokerHealthCheckService, Prometheus servlet, /actuator/backups
+trigger, pause/resume processing via BrokerAdminService).
+
+Endpoints:
+  GET  /health    → aggregated component health (liveness)
+  GET  /ready     → 200 when every local partition has a role and a processor
+  GET  /metrics   → Prometheus text exposition
+  GET  /partitions → per-partition health dicts
+  POST /backups/<id> → trigger a cluster-consistent checkpoint
+  GET  /backups   → backup store listing (when a store is configured)
+  POST /pause | /resume → pause/resume stream processing (BrokerAdminService)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from zeebe_tpu.utils.metrics import REGISTRY
+
+
+class ManagementServer:
+    def __init__(self, broker, bind: tuple[str, int] = ("127.0.0.1", 0),
+                 registry=None) -> None:
+        self.broker = broker
+        self.registry = registry or REGISTRY
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send(self, code: int, body: str,
+                      content_type: str = "application/json") -> None:
+                data = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                try:
+                    outer._get(self)
+                except Exception as exc:  # management must not crash the broker
+                    self._send(500, json.dumps({"error": str(exc)}))
+
+            def do_POST(self):
+                try:
+                    outer._post(self)
+                except Exception as exc:
+                    self._send(500, json.dumps({"error": str(exc)}))
+
+        self.server = ThreadingHTTPServer(bind, Handler)
+        self.port = self.server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def _get(self, handler) -> None:
+        path = handler.path.split("?", 1)[0]
+        if path == "/metrics":
+            handler._send(200, self.registry.expose(), "text/plain; version=0.0.4")
+        elif path == "/health":
+            health = self.broker.health_monitor.to_dict()
+            code = 200 if self.broker.health_monitor.is_healthy() else 503
+            handler._send(code, json.dumps(health))
+        elif path == "/ready":
+            ready = all(
+                p.processor is not None for p in self.broker.partitions.values()
+            )
+            handler._send(200 if ready else 503, json.dumps({"ready": ready}))
+        elif path == "/partitions":
+            handler._send(200, json.dumps(
+                [p.health() for p in self.broker.partitions.values()]
+            ))
+        elif path == "/backups":
+            if self.broker.backup_store is None:
+                handler._send(404, json.dumps({"error": "no backup store configured"}))
+                return
+            statuses = [
+                {"checkpointId": s.checkpoint_id, "partitionId": s.partition_id,
+                 "status": s.status.value}
+                for s in self.broker.backup_store.list_backups()
+            ]
+            handler._send(200, json.dumps(statuses))
+        else:
+            handler._send(404, json.dumps({"error": f"unknown path {path}"}))
+
+    def _post(self, handler) -> None:
+        path = handler.path.split("?", 1)[0]
+        if path.startswith("/backups/"):
+            checkpoint_id = int(path.rsplit("/", 1)[-1])
+            accepted = self.broker.trigger_checkpoint(checkpoint_id)
+            handler._send(202, json.dumps(
+                {"checkpointId": checkpoint_id, "partitions": accepted}
+            ))
+        elif path == "/pause":
+            self.broker.pause_processing()
+            handler._send(200, json.dumps({"paused": True}))
+        elif path == "/resume":
+            self.broker.resume_processing()
+            handler._send(200, json.dumps({"paused": False}))
+        else:
+            handler._send(404, json.dumps({"error": f"unknown path {path}"}))
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True, name="management-server")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

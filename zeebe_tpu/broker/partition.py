@@ -39,6 +39,11 @@ from zeebe_tpu.stream import StreamProcessor, StreamProcessorMode
 DEFAULT_SNAPSHOT_PERIOD_MS = 5 * 60 * 1000
 
 
+class BackpressureExceeded(Exception):
+    """Client command rejected by the in-flight limiter (maps to gRPC
+    RESOURCE_EXHAUSTED at the gateway)."""
+
+
 class _RaftWriter:
     """LogStreamWriter-shaped adapter the StreamProcessor writes through:
     follow-ups and scheduled commands replicate via Raft before they become
@@ -69,6 +74,7 @@ class ZeebePartition:
         consistency_checks: bool = True,
         backup_service=None,
         on_checkpoint=None,
+        backpressure=None,
     ) -> None:
         self.partition_id = partition_id
         self.partition_count = partition_count
@@ -84,6 +90,12 @@ class ZeebePartition:
         self.consistency_checks = consistency_checks
         self.backup_service = backup_service  # BackupService | None
         self.on_checkpoint = on_checkpoint  # broker cache-bump hook
+        # client-ingress backpressure (CommandRateLimiter | None) and the
+        # disk-monitor pause flag; both gate client_write only — follow-ups,
+        # scheduled commands, and inter-partition traffic always pass
+        self.limiter = backpressure
+        self.paused = False        # admin pause (BrokerAdminService)
+        self.disk_paused = False   # disk watermark pause — independent source
 
         self.snapshot_store = FileBasedSnapshotStore(self.directory / "snapshots")
         self.raft = RaftNode(
@@ -202,6 +214,22 @@ class ZeebePartition:
 
     # -- command ingress (CommandApiRequestHandler equivalent) -----------------
 
+    def client_write(self, record: Record) -> int | None:
+        """Client API ingress: backpressure + pause gate, then the normal
+        write path (reference: CommandApiRequestHandler.handleExecuteCommand —
+        rate limiter check before LogStreamWriter.tryWrite)."""
+        if self.paused or self.disk_paused:
+            return None
+        if self.limiter is not None and not self.limiter.try_acquire(record):
+            raise BackpressureExceeded(
+                f"partition {self.partition_id} has reached its in-flight "
+                f"command limit ({self.limiter.limit})"
+            )
+        position = self.write_commands([record])
+        if position is not None and self.limiter is not None:
+            self.limiter.on_appended(position)
+        return position
+
     def write_commands(self, records: list[Record],
                        source_position: int = -1) -> int | None:
         """Leader-only: sequence the records and append to Raft; they become
@@ -238,6 +266,10 @@ class ZeebePartition:
         else:
             work += self.processor.replay_available()
         work += self.exporter_director.export_available()
+        if self.limiter is not None and self.limiter.in_flight:
+            processed = self.processor.last_processed_position
+            for position in [p for p in self.limiter.in_flight if p <= processed]:
+                self.limiter.on_processed(position)
         self._maybe_snapshot()
         return work
 

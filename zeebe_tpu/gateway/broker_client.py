@@ -35,12 +35,20 @@ class NoLeaderError(Exception):
     pass
 
 
+class ResourceExhaustedError(Exception):
+    pass
+
+
 class ClusterRuntime:
     """Owns N in-process brokers and the pump thread; thread-safe ingress."""
 
     def __init__(self, broker_count: int = 1, partition_count: int = 1,
                  replication_factor: int = 1, directory=None,
-                 exporters_factory=None) -> None:
+                 exporters_factory=None,
+                 backpressure_algorithm: str = "vegas",
+                 backpressure_enabled: bool = True,
+                 disk_min_free_bytes: int = 0,
+                 backup_store_directory=None) -> None:
         self.partition_count = partition_count
         self.net = LoopbackNetwork()
         self._lock = threading.RLock()
@@ -64,6 +72,10 @@ class ClusterRuntime:
                 directory=(Path(directory) / m if directory else None),
                 exporters_factory=exporters_factory,
                 response_sink=self._resolve,
+                backpressure_algorithm=backpressure_algorithm,
+                backpressure_enabled=backpressure_enabled,
+                disk_min_free_bytes=disk_min_free_bytes,
+                backup_store_directory=backup_store_directory,
             )
         self._running = False
         self._thread: threading.Thread | None = None
@@ -158,13 +170,18 @@ class ClusterRuntime:
         rec = record.replace(request_id=request_id, request_stream_id=0)
         deadline = time.time() + timeout_s
         try:
+            from zeebe_tpu.broker.partition import BackpressureExceeded
+
             written = False
             while time.time() < deadline:
                 with self._lock:
                     leader = self._leader_partition(partition_id)
                     if leader is not None:
-                        if leader.write_commands([rec]) is not None:
-                            written = True
+                        try:
+                            if leader.client_write(rec) is not None:
+                                written = True
+                        except BackpressureExceeded as exc:
+                            raise ResourceExhaustedError(str(exc)) from exc
                 if written:
                     break
                 time.sleep(0.01)

@@ -26,6 +26,7 @@ from zeebe_tpu.gateway.broker_client import (  # noqa: E402
     ClusterRuntime,
     NoLeaderError,
     RequestTimeoutError,
+    ResourceExhaustedError,
 )
 from zeebe_tpu.protocol import ValueType, command  # noqa: E402
 from zeebe_tpu.protocol.intent import (  # noqa: E402
@@ -426,6 +427,8 @@ class GatewayService:
             response = self.runtime.submit(partition_id, record, timeout_s=timeout_s)
         except NoLeaderError as exc:
             context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
+        except ResourceExhaustedError as exc:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
         except RequestTimeoutError as exc:
             context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
         if response.is_rejection:

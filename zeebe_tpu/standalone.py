@@ -21,25 +21,55 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--brokers", type=int, default=1)
     parser.add_argument("--replication", type=int, default=1)
     parser.add_argument("--data-dir", default=None)
+    parser.add_argument("--management-port", type=int, default=0,
+                        help="health/metrics/admin HTTP port (0 = disabled)")
     args = parser.parse_args(argv)
 
+    from zeebe_tpu.broker.config import load_broker_cfg
     from zeebe_tpu.gateway import ClusterRuntime, Gateway
 
+    # ZEEBE_BROKER_* env vars bind first; explicit CLI flags override
+    overrides = {}
+    if "--partitions" in (argv or sys.argv):
+        overrides["base.partition_count"] = args.partitions
+    if "--replication" in (argv or sys.argv):
+        overrides["base.replication_factor"] = args.replication
+    cfg = load_broker_cfg(overrides=overrides)
     runtime = ClusterRuntime(
-        broker_count=args.brokers, partition_count=args.partitions,
-        replication_factor=args.replication, directory=args.data_dir,
+        broker_count=args.brokers,
+        partition_count=(args.partitions if "base.partition_count" in overrides
+                         else cfg.base.partition_count),
+        replication_factor=(args.replication if "base.replication_factor" in overrides
+                            else cfg.base.replication_factor),
+        directory=args.data_dir,
+        backpressure_algorithm=cfg.backpressure.algorithm,
+        backpressure_enabled=cfg.backpressure.enabled,
+        disk_min_free_bytes=(cfg.disk.min_free_bytes
+                             if cfg.disk.enable_monitoring and args.data_dir else 0),
     )
     runtime.start()
     gateway = Gateway(runtime, bind=f"0.0.0.0:{args.port}")
     gateway.start()
     print(f"gateway listening on {gateway.address} "
-          f"({args.brokers} broker(s), {args.partitions} partition(s), "
-          f"replication {args.replication})", file=sys.stderr)
+          f"({args.brokers} broker(s), {runtime.partition_count} partition(s))",
+          file=sys.stderr)
+    management = None
+    if args.management_port:
+        from zeebe_tpu.broker.management import ManagementServer
+
+        management = ManagementServer(
+            next(iter(runtime.brokers.values())),
+            bind=("0.0.0.0", args.management_port),
+        )
+        management.start()
+        print(f"management on :{management.port}", file=sys.stderr)
 
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     stop.wait()
+    if management is not None:
+        management.stop()
     gateway.stop()
     runtime.stop()
     return 0

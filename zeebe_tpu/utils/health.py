@@ -1,0 +1,72 @@
+"""Component health aggregation.
+
+Reference: scheduler/src/main/java/io/camunda/zeebe/scheduler/health/
+CriticalComponentsHealthMonitor.java:26 — named components report
+HEALTHY/UNHEALTHY/DEAD; the monitor aggregates to the worst status; partition
+health feeds broker health (BrokerHealthCheckService) and the startup/ready/
+liveness probes on the management server.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable
+
+
+class HealthStatus(enum.IntEnum):
+    # ordered by severity so aggregation is max()
+    HEALTHY = 0
+    UNHEALTHY = 1
+    DEAD = 2
+
+
+class HealthReport:
+    def __init__(self, component: str, status: HealthStatus,
+                 message: str = "") -> None:
+        self.component = component
+        self.status = status
+        self.message = message
+
+    def to_dict(self) -> dict:
+        return {"component": self.component, "status": self.status.name,
+                "message": self.message}
+
+
+class CriticalComponentsHealthMonitor:
+    """Aggregates component healths; listeners fire on any status change."""
+
+    def __init__(self, name: str = "broker") -> None:
+        self.name = name
+        self._components: dict[str, HealthReport] = {}
+        self._listeners: list[Callable[[HealthReport], None]] = []
+
+    def register(self, component: str) -> None:
+        self._components.setdefault(
+            component, HealthReport(component, HealthStatus.HEALTHY)
+        )
+
+    def add_listener(self, listener: Callable[[HealthReport], None]) -> None:
+        self._listeners.append(listener)
+
+    def report(self, component: str, status: HealthStatus, message: str = "") -> None:
+        previous = self._components.get(component)
+        report = HealthReport(component, status, message)
+        self._components[component] = report
+        if previous is None or previous.status != status:
+            for listener in self._listeners:
+                listener(report)
+
+    def status(self) -> HealthStatus:
+        if not self._components:
+            return HealthStatus.HEALTHY
+        return max(r.status for r in self._components.values())
+
+    def is_healthy(self) -> bool:
+        return self.status() == HealthStatus.HEALTHY
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "status": self.status().name,
+            "components": [r.to_dict() for r in self._components.values()],
+        }

@@ -1,0 +1,199 @@
+"""Prometheus-style metrics registry (no external client dependency).
+
+Reference: the reference uses Prometheus simpleclient throughout — 111 metric
+names under namespace ``zeebe`` (SURVEY §5.5): stream_processor_*, sequencer_*,
+log_appender_*, journal_*, snapshot_*, raft_*/election_latency_in_ms,
+backpressure_*, exporter_*, gateway_*, process_instance_execution_time,
+actor_*. Scraped via the management server's /metrics in the standard text
+exposition format.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Iterable
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str, label_names: tuple[str, ...]) -> None:
+        self.name = name
+        self.help = help_text
+        self.label_names = label_names
+        self._children: dict[tuple, "_Child"] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values: str) -> "_Child":
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, got {values}"
+            )
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._child_cls()(self, key)
+                self._children[key] = child
+            return child
+
+    def _default(self) -> "_Child":
+        return self.labels(*([] if not self.label_names else [""] * len(self.label_names)))
+
+
+class _Child:
+    def __init__(self, parent: _Metric, label_values: tuple) -> None:
+        self.parent = parent
+        self.label_values = label_values
+
+    def _label_str(self) -> str:
+        if not self.parent.label_names:
+            return ""
+        pairs = ",".join(
+            f'{n}="{v}"' for n, v in zip(self.parent.label_names, self.label_values)
+        )
+        return "{" + pairs + "}"
+
+
+class Counter(_Metric):
+    type_name = "counter"
+
+    class Child(_Child):
+        def __init__(self, parent, label_values):
+            super().__init__(parent, label_values)
+            self.value = 0.0
+
+        def inc(self, amount: float = 1.0) -> None:
+            self.value += amount
+
+    def _child_cls(self):
+        return Counter.Child
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def collect(self) -> Iterable[str]:
+        for child in self._children.values():
+            yield f"{self.name}{child._label_str()} {child.value}"
+
+
+class Gauge(_Metric):
+    type_name = "gauge"
+
+    class Child(_Child):
+        def __init__(self, parent, label_values):
+            super().__init__(parent, label_values)
+            self.value = 0.0
+
+        def set(self, value: float) -> None:
+            self.value = value
+
+        def inc(self, amount: float = 1.0) -> None:
+            self.value += amount
+
+        def dec(self, amount: float = 1.0) -> None:
+            self.value -= amount
+
+    def _child_cls(self):
+        return Gauge.Child
+
+    def set(self, value: float) -> None:
+        self._default().set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default().dec(amount)
+
+    def collect(self) -> Iterable[str]:
+        for child in self._children.values():
+            yield f"{self.name}{child._label_str()} {child.value}"
+
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                    2.5, 5.0, 10.0)
+
+
+class Histogram(_Metric):
+    type_name = "histogram"
+
+    def __init__(self, name, help_text, label_names, buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_text, label_names)
+        self.buckets = tuple(sorted(buckets))
+
+    class Child(_Child):
+        def __init__(self, parent, label_values):
+            super().__init__(parent, label_values)
+            self.bucket_counts = [0] * (len(parent.buckets) + 1)
+            self.sum = 0.0
+            self.count = 0
+
+        def observe(self, value: float) -> None:
+            idx = bisect.bisect_left(self.parent.buckets, value)
+            self.bucket_counts[idx] += 1
+            self.sum += value
+            self.count += 1
+
+    def _child_cls(self):
+        return Histogram.Child
+
+    def observe(self, value: float) -> None:
+        self._default().observe(value)
+
+    def collect(self) -> Iterable[str]:
+        for child in self._children.values():
+            labels = child._label_str()
+            base = labels[1:-1] if labels else ""
+            cumulative = 0
+            for bucket, count in zip(self.buckets, child.bucket_counts):
+                cumulative += count
+                le = f'le="{bucket}"'
+                inner = f"{base},{le}" if base else le
+                yield f"{self.name}_bucket{{{inner}}} {cumulative}"
+            cumulative += child.bucket_counts[-1]
+            le = 'le="+Inf"'
+            inner = f"{base},{le}" if base else le
+            yield f"{self.name}_bucket{{{inner}}} {cumulative}"
+            yield f"{self.name}_sum{labels} {child.sum}"
+            yield f"{self.name}_count{labels} {child.count}"
+
+
+class MetricsRegistry:
+    def __init__(self, namespace: str = "zeebe") -> None:
+        self.namespace = namespace
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, cls, name: str, help_text: str, labels: tuple, **kw) -> _Metric:
+        full = f"{self.namespace}_{name}"
+        with self._lock:
+            metric = self._metrics.get(full)
+            if metric is None:
+                metric = cls(full, help_text, tuple(labels), **kw)
+                self._metrics[full] = metric
+            return metric
+
+    def counter(self, name: str, help_text: str = "",
+                labels: tuple[str, ...] = ()) -> Counter:
+        return self._register(Counter, name, help_text, labels)
+
+    def gauge(self, name: str, help_text: str = "",
+              labels: tuple[str, ...] = ()) -> Gauge:
+        return self._register(Gauge, name, help_text, labels)
+
+    def histogram(self, name: str, help_text: str = "",
+                  labels: tuple[str, ...] = (), buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram, name, help_text, labels, buckets=buckets)
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        lines = []
+        for metric in self._metrics.values():
+            lines.append(f"# HELP {metric.name} {metric.help}")
+            lines.append(f"# TYPE {metric.name} {metric.type_name}")
+            lines.extend(metric.collect())
+        return "\n".join(lines) + "\n"
+
+
+# process-global default registry (the reference's CollectorRegistry.default)
+REGISTRY = MetricsRegistry()
