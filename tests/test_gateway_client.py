@@ -196,3 +196,44 @@ class TestEvaluateDecision:
         assert resp.decisionId == "dish"
         [d] = resp.evaluatedDecisions
         assert d.matchedRules[0].ruleIndex == 2
+
+
+class TestModificationRpcs:
+    def test_modify_and_delete_resource(self, stack):
+        import json as _json
+
+        from zeebe_tpu.gateway.proto import gateway_pb2 as pb
+
+        client, _ = stack
+        deployed = client.deploy_resource(("mod.bpmn", one_task("modp", "mod_work")))
+        instance = client.create_instance("modp")
+        jobs = client.activate_jobs("mod_work")
+        [job] = [j for j in jobs if j.process_instance_key == instance.process_instance_key]
+        modify = client.channel.unary_unary(
+            "/gateway_protocol.Gateway/ModifyProcessInstance",
+            request_serializer=pb.ModifyProcessInstanceRequest.SerializeToString,
+            response_deserializer=pb.ModifyProcessInstanceResponse.FromString,
+        )
+        modify(pb.ModifyProcessInstanceRequest(
+            processInstanceKey=instance.process_instance_key,
+            activateInstructions=[
+                pb.ModifyProcessInstanceRequest.ActivateInstruction(elementId="e")],
+            terminateInstructions=[
+                pb.ModifyProcessInstanceRequest.TerminateInstruction(
+                    elementInstanceKey=job.element_instance_key)],
+        ))
+        # the instance jumped to the end event and completed
+        remaining = [j for j in client.activate_jobs("mod_work")
+                     if j.process_instance_key == instance.process_instance_key]
+        assert remaining == []
+        # delete the definition: new instances are rejected
+        delete = client.channel.unary_unary(
+            "/gateway_protocol.Gateway/DeleteResource",
+            request_serializer=pb.DeleteResourceRequest.SerializeToString,
+            response_deserializer=pb.DeleteResourceResponse.FromString,
+        )
+        delete(pb.DeleteResourceRequest(
+            resourceKey=deployed["processes"][0]["processDefinitionKey"]))
+        with pytest.raises(grpc.RpcError) as err:
+            client.create_instance("modp")
+        assert err.value.code() == grpc.StatusCode.NOT_FOUND

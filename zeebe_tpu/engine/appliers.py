@@ -131,6 +131,27 @@ class EventAppliers:
 
         reg[(ValueType.CHECKPOINT, int(CheckpointIntent.CREATED))] = self._checkpoint_created
         reg[(ValueType.CHECKPOINT, int(CheckpointIntent.IGNORED))] = self._noop
+        from zeebe_tpu.protocol.intent import (
+            ProcessInstanceMigrationIntent,
+            ProcessInstanceModificationIntent,
+            ResourceDeletionIntent,
+        )
+
+        reg[(ValueType.PROCESS_INSTANCE_MODIFICATION, int(ProcessInstanceModificationIntent.MODIFIED))] = self._noop
+        reg[(ValueType.PROCESS_INSTANCE_MIGRATION, int(ProcessInstanceMigrationIntent.MIGRATED))] = self._migrated
+        reg[(ValueType.RESOURCE_DELETION, int(ResourceDeletionIntent.DELETING))] = self._noop
+        reg[(ValueType.RESOURCE_DELETION, int(ResourceDeletionIntent.DELETED))] = self._resource_deleted
+        from zeebe_tpu.protocol.intent import UserTaskIntent
+
+        reg[(ValueType.USER_TASK, int(UserTaskIntent.CREATING))] = self._noop
+        reg[(ValueType.USER_TASK, int(UserTaskIntent.CREATED))] = self._user_task_created
+        reg[(ValueType.USER_TASK, int(UserTaskIntent.COMPLETING))] = self._noop
+        reg[(ValueType.USER_TASK, int(UserTaskIntent.COMPLETED))] = self._user_task_removed
+        reg[(ValueType.USER_TASK, int(UserTaskIntent.CANCELING))] = self._noop
+        reg[(ValueType.USER_TASK, int(UserTaskIntent.CANCELED))] = self._user_task_removed
+        reg[(ValueType.USER_TASK, int(UserTaskIntent.ASSIGNING))] = self._noop
+        reg[(ValueType.USER_TASK, int(UserTaskIntent.ASSIGNED))] = self._user_task_updated
+        reg[(ValueType.USER_TASK, int(UserTaskIntent.UPDATED))] = self._user_task_updated
 
     def can_apply(self, record: Record) -> bool:
         return (record.value_type, int(record.intent)) in self._appliers
@@ -149,6 +170,25 @@ class EventAppliers:
 
     def _noop(self, record: Record) -> None:
         pass
+
+    def _user_task_created(self, record: Record) -> None:
+        self.state.user_tasks.create(record.key, record.value)
+
+    def _user_task_updated(self, record: Record) -> None:
+        self.state.user_tasks.update(record.key, record.value)
+
+    def _user_task_removed(self, record: Record) -> None:
+        self.state.user_tasks.remove(record.key)
+
+    def _migrated(self, record: Record) -> None:
+        from zeebe_tpu.engine.modification import apply_migrated
+
+        apply_migrated(self.state, record)
+
+    def _resource_deleted(self, record: Record) -> None:
+        resource_key = record.value["resourceKey"]
+        self.state.processes.delete(resource_key)
+        self.state.decisions.delete_drg(resource_key)
 
     def _checkpoint_created(self, record: Record) -> None:
         self.state.checkpoints.put(
@@ -218,7 +258,8 @@ class EventAppliers:
                 element.multi_instance is not None
                 and v.get("bpmnElementType") != BpmnElementType.MULTI_INSTANCE_BODY.name
             )
-            if is_mi_inner:
+            if is_mi_inner or v.get("directActivation"):
+                # modification-activated: no token was in transit
                 pass
             elif element.element_type == BpmnElementType.PARALLEL_GATEWAY:
                 ei.consume_active_flows(scope_key, element.incoming_count)

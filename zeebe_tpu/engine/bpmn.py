@@ -46,6 +46,7 @@ from zeebe_tpu.protocol.intent import (
     EscalationIntent,
     IncidentIntent,
     JobIntent,
+    UserTaskIntent,
     ProcessInstanceIntent,
     ProcessInstanceResultIntent,
     SignalIntent,
@@ -204,6 +205,29 @@ class BpmnProcessor:
         elif et == BpmnElementType.START_EVENT:
             writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
             self._complete(key, value, exe, element, writers)
+        elif et == BpmnElementType.USER_TASK and element.native_user_task:
+            # native user task: lifecycle records instead of a job
+            # (reference: zeebe:userTask → UserTaskProcessors)
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
+            task_key = self.state.next_key()
+            task_value = {
+                "userTaskKey": task_key,
+                "assignee": element.user_task_assignee or "",
+                "candidateGroups": element.user_task_candidate_groups or "",
+                "candidateUsers": "",
+                "dueDate": "",
+                "followUpDate": "",
+                "elementId": element.id,
+                "elementInstanceKey": key,
+                "processInstanceKey": value["processInstanceKey"],
+                "processDefinitionKey": value["processDefinitionKey"],
+                "bpmnProcessId": value["bpmnProcessId"],
+            }
+            writers.append_event(task_key, ValueType.USER_TASK,
+                                 UserTaskIntent.CREATING, task_value)
+            writers.append_event(task_key, ValueType.USER_TASK,
+                                 UserTaskIntent.CREATED, task_value)
+            # wait state: completion comes from the USER_TASK COMPLETE command
         elif (et == BpmnElementType.BUSINESS_RULE_TASK
               and element.called_decision_id is not None):
             # zeebe:calledDecision: evaluate BEFORE transitioning to ACTIVATED —
@@ -1222,6 +1246,13 @@ class BpmnProcessor:
         instance = self.state.element_instances.get(key)
         writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_TERMINATING, value)
 
+        user_task_key = self.state.user_tasks.key_for_element(key)
+        if user_task_key is not None:
+            task = self.state.user_tasks.get(user_task_key)
+            writers.append_event(user_task_key, ValueType.USER_TASK,
+                                 UserTaskIntent.CANCELING, task)
+            writers.append_event(user_task_key, ValueType.USER_TASK,
+                                 UserTaskIntent.CANCELED, task)
         job_key = instance.get("jobKey", -1)
         if job_key >= 0:
             job = self.state.jobs.get(job_key)
@@ -1345,4 +1376,7 @@ def _pi_value(value: dict, element: ExecutableElement) -> dict:
     }
     if "loopCounter" in value:
         out["loopCounter"] = value["loopCounter"]
+    if value.get("directActivation"):
+        # modification-activated: the applier must not consume a flow token
+        out["directActivation"] = True
     return out

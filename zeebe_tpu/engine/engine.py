@@ -29,6 +29,9 @@ from zeebe_tpu.protocol import RejectionType, ValueType
 from zeebe_tpu.protocol.intent import (
     CheckpointIntent,
     CommandDistributionIntent,
+    ProcessInstanceMigrationIntent,
+    ProcessInstanceModificationIntent,
+    ResourceDeletionIntent,
     DecisionEvaluationIntent,
     DeploymentIntent,
     IncidentIntent,
@@ -41,6 +44,7 @@ from zeebe_tpu.protocol.intent import (
     ProcessMessageSubscriptionIntent,
     SignalIntent,
     TimerIntent,
+    UserTaskIntent,
     VariableDocumentIntent,
 )
 from zeebe_tpu.state import ZbDb
@@ -113,6 +117,18 @@ class Engine(RecordProcessor):
         from zeebe_tpu.engine.decision import DecisionEvaluationProcessor
 
         decision_eval = DecisionEvaluationProcessor(self.state)
+        from zeebe_tpu.engine.modification import (
+            ProcessInstanceMigrationProcessor,
+            ProcessInstanceModificationProcessor,
+            ResourceDeletionProcessor,
+        )
+
+        from zeebe_tpu.engine.user_task import UserTaskProcessors
+
+        user_tasks = UserTaskProcessors(self.state)
+        modification = ProcessInstanceModificationProcessor(self.state, bpmn)
+        migration = ProcessInstanceMigrationProcessor(self.state)
+        resource_deletion = ResourceDeletionProcessor(self.state, distribution)
         from zeebe_tpu.backup.checkpoint import CheckpointProcessor
 
         self.checkpoint_state = self.state.checkpoints
@@ -155,6 +171,13 @@ class Engine(RecordProcessor):
             (ValueType.COMMAND_DISTRIBUTION, int(CommandDistributionIntent.ACKNOWLEDGE)): dist_ack.process,
             (ValueType.DECISION_EVALUATION, int(DecisionEvaluationIntent.EVALUATE)): decision_eval.process,
             (ValueType.CHECKPOINT, int(CheckpointIntent.CREATE)): self.checkpoint.process,
+            (ValueType.PROCESS_INSTANCE_MODIFICATION, int(ProcessInstanceModificationIntent.MODIFY)): modification.process,
+            (ValueType.PROCESS_INSTANCE_MIGRATION, int(ProcessInstanceMigrationIntent.MIGRATE)): migration.process,
+            (ValueType.RESOURCE_DELETION, int(ResourceDeletionIntent.DELETE)): resource_deletion.process,
+            (ValueType.USER_TASK, int(UserTaskIntent.COMPLETE)): user_tasks.complete,
+            (ValueType.USER_TASK, int(UserTaskIntent.ASSIGN)): user_tasks.assign,
+            (ValueType.USER_TASK, int(UserTaskIntent.CLAIM)): user_tasks.claim,
+            (ValueType.USER_TASK, int(UserTaskIntent.UPDATE)): user_tasks.update,
         }
         self.state.load_key_generator()
 

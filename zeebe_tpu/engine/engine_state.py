@@ -96,6 +96,31 @@ class ProcessState:
         key = self.get_key_by_id_version(bpmn_process_id, version)
         return None if key is None else self.get_by_key(key)
 
+    def delete(self, key: int) -> None:
+        """Resource deletion: the definition stops being startable (removed
+        from the id/version indexes; previous version repointed as latest) but
+        the stored resource stays so RUNNING instances keep executing
+        (reference: deleted definitions serve in-flight instances)."""
+        meta = self._by_key.get((key,))
+        if meta is None:
+            return
+        process_id = meta["bpmnProcessId"]
+        version = meta["version"]
+        self._by_key.put((key,), {**meta, "deleted": True})
+        if self._by_id_version.exists((process_id, version)):
+            self._by_id_version.delete((process_id, version))
+        if self._version.get((process_id,)) == version:
+            for v in range(version - 1, 0, -1):
+                prev_key = self._by_id_version.get((process_id, v))
+                if prev_key is not None:
+                    prev = self._by_key.get((prev_key,))
+                    self._version.put((process_id,), v)
+                    self._digest.put((process_id,), prev["checksum"])
+                    return
+            self._version.delete((process_id,))
+            if self._digest.exists((process_id,)):
+                self._digest.delete((process_id,))
+
     def executable(self, key: int) -> ExecutableProcess | None:
         exe = self._compiled.get(key)
         if exe is not None:
@@ -237,6 +262,12 @@ class JobState:
 
     def complete(self, key: int) -> None:
         self._remove(key)
+
+    def update_value(self, key: int, record_value: dict) -> None:
+        """Retarget job metadata without touching lifecycle indexes
+        (migration applier)."""
+        if self._jobs.exists((key,)):
+            self._jobs.put((key,), dict(record_value))
 
     def cancel(self, key: int) -> None:
         self._remove(key)
@@ -866,6 +897,41 @@ class DecisionState:
         latest = self._latest_drg.get((drg_id,))
         return 0 if latest is None else latest["version"]
 
+    def delete_drg(self, drg_key: int) -> None:
+        """Resource deletion: drop the DRG and all its decisions."""
+        drg = self._drgs.get((drg_key,))
+        if drg is None:
+            return
+        for meta in self.decisions_of_drg(drg_key):
+            if meta is None:
+                continue
+            decision_key = meta["decisionKey"]
+            self._decisions.delete((decision_key,))
+            self._by_drg.delete((drg_key, decision_key))
+            if self._latest_decision.get((meta["decisionId"],)) == decision_key:
+                self._latest_decision.delete((meta["decisionId"],))
+        self._drgs.delete((drg_key,))
+        self._parsed.pop(drg_key, None)
+        drg_id = drg["decisionRequirementsId"]
+        latest = self._latest_drg.get((drg_id,))
+        if latest is not None and latest.get("key") == drg_key:
+            self._latest_drg.delete((drg_id,))
+            # repoint latest to the highest remaining version of the same DRG
+            best = None
+            for remaining in self._drgs.values():
+                if remaining.get("decisionRequirementsId") != drg_id:
+                    continue
+                if best is None or remaining["version"] > best["version"]:
+                    best = remaining
+            if best is not None:
+                best_key = best["decisionRequirementsKey"]
+                self._latest_drg.put((drg_id,),
+                                     {"version": best["version"], "key": best_key})
+                for meta in self.decisions_of_drg(best_key):
+                    if meta is not None:
+                        self._latest_decision.put((meta["decisionId"],),
+                                                  meta["decisionKey"])
+
     def parsed_drg(self, drg_key: int):
         """Parse-once cache over the stored DMN resource."""
         cached = self._parsed.get(drg_key)
@@ -879,6 +945,37 @@ class DecisionState:
         parsed = parse_dmn_xml(drg_meta["resource"])
         self._parsed[drg_key] = parsed
         return parsed
+
+
+class UserTaskState:
+    """Native user tasks (reference: state/usertask/DbUserTaskState)."""
+
+    def __init__(self, db: ZbDb) -> None:
+        self._tasks = db.column_family(CF.USER_TASKS)
+        self._by_element = db.column_family(CF.USER_TASK_STATES)
+
+    def create(self, key: int, record_value: dict) -> None:
+        self._tasks.put((key,), dict(record_value))
+        self._by_element.put((record_value["elementInstanceKey"],), key)
+
+    def update(self, key: int, record_value: dict) -> None:
+        if self._tasks.exists((key,)):
+            self._tasks.put((key,), dict(record_value))
+
+    def remove(self, key: int) -> None:
+        task = self._tasks.get((key,))
+        if task is None:
+            return
+        element_key = task.get("elementInstanceKey", -1)
+        if self._by_element.exists((element_key,)):
+            self._by_element.delete((element_key,))
+        self._tasks.delete((key,))
+
+    def get(self, key: int) -> dict | None:
+        return self._tasks.get((key,))
+
+    def key_for_element(self, element_instance_key: int) -> int | None:
+        return self._by_element.get((element_instance_key,))
 
 
 class EngineState:
@@ -905,6 +1002,7 @@ class EngineState:
         from zeebe_tpu.backup.checkpoint import CheckpointState
 
         self.checkpoints = CheckpointState(db)
+        self.user_tasks = UserTaskState(db)
         self._key_cf = db.column_family(CF.KEY)
         self.key_generator = KeyGenerator(partition_id)
         self._key_loaded = False

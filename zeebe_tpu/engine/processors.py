@@ -277,6 +277,12 @@ class DeploymentProcessor:
 
     def _register_start_subscriptions(self, writers, exe, meta, previous_key,
                                       include_timers=True):
+        register_start_subscriptions(self.state, self.clock_millis, writers,
+                                     exe, meta, previous_key, include_timers)
+
+
+def register_start_subscriptions(state, clock_millis, writers, exe, meta,
+                                 previous_key, include_timers=True):
         """Message/timer start events of the new latest version; the previous
         version's subscriptions are closed (reference: deployment transformer
         subscription lifecycle)."""
@@ -290,7 +296,7 @@ class DeploymentProcessor:
         if previous_key is not None:
             # close the *previous* version's start subscriptions: whether they
             # must go depends on what the old version had, not the new one
-            old_exe = self.state.processes.executable(previous_key)
+            old_exe = state.processes.executable(previous_key)
             old_has_msg_start = old_exe is not None and any(
                 el.element_type == BpmnElementType.START_EVENT
                 and el.event_type == BpmnEventType.MESSAGE
@@ -298,16 +304,16 @@ class DeploymentProcessor:
             )
             if old_has_msg_start:
                 writers.append_event(
-                    self.state.next_key(), ValueType.MESSAGE_START_EVENT_SUBSCRIPTION,
+                    state.next_key(), ValueType.MESSAGE_START_EVENT_SUBSCRIPTION,
                     MessageStartEventSubscriptionIntent.DELETED,
                     {"processDefinitionKey": previous_key, "bpmnProcessId": meta["bpmnProcessId"]},
                 )
-            for timer_key, timer in self.state.timers.start_timers_for_process(previous_key):
+            for timer_key, timer in state.timers.start_timers_for_process(previous_key):
                 writers.append_event(timer_key, ValueType.TIMER, TimerIntent.CANCELED, timer)
         from zeebe_tpu.protocol.intent import SignalSubscriptionIntent
 
         if previous_key is not None:
-            self._close_signal_start_subscriptions(writers, previous_key, meta)
+            _close_signal_start_subscriptions(state, writers, previous_key, meta)
         for el in exe.elements[1:]:
             # only ROOT-scope start events start new instances; event
             # sub-process starts subscribe at scope activation instead
@@ -315,7 +321,7 @@ class DeploymentProcessor:
                 continue
             if el.event_type == BpmnEventType.SIGNAL and el.signal_name:
                 writers.append_event(
-                    self.state.next_key(), ValueType.SIGNAL_SUBSCRIPTION,
+                    state.next_key(), ValueType.SIGNAL_SUBSCRIPTION,
                     SignalSubscriptionIntent.CREATED,
                     {
                         "signalName": el.signal_name,
@@ -328,7 +334,7 @@ class DeploymentProcessor:
                 )
             elif el.event_type == BpmnEventType.MESSAGE and el.message_name:
                 writers.append_event(
-                    self.state.next_key(), ValueType.MESSAGE_START_EVENT_SUBSCRIPTION,
+                    state.next_key(), ValueType.MESSAGE_START_EVENT_SUBSCRIPTION,
                     MessageStartEventSubscriptionIntent.CREATED,
                     {
                         "processDefinitionKey": meta["processDefinitionKey"],
@@ -340,29 +346,29 @@ class DeploymentProcessor:
             elif el.event_type == BpmnEventType.TIMER and el.timer_cycle and include_timers:
                 reps, interval = parse_cycle(el.timer_cycle)
                 writers.append_event(
-                    self.state.next_key(), ValueType.TIMER, TimerIntent.CREATED,
+                    state.next_key(), ValueType.TIMER, TimerIntent.CREATED,
                     {
                         "elementId": el.id,
                         "targetElementId": el.id,
                         "elementInstanceKey": -1,
                         "processInstanceKey": -1,
                         "processDefinitionKey": meta["processDefinitionKey"],
-                        "dueDate": self.clock_millis() + interval,
+                        "dueDate": clock_millis() + interval,
                         "repetitions": reps,
                         "interval": interval,
                     },
                 )
 
 
-    def _close_signal_start_subscriptions(self, writers, previous_key, meta):
-        from zeebe_tpu.protocol.intent import SignalSubscriptionIntent
+def _close_signal_start_subscriptions(state, writers, previous_key, meta):
+    from zeebe_tpu.protocol.intent import SignalSubscriptionIntent
 
-        for sub in self.state.signal_subscriptions.subscriptions_of(previous_key):
-            if sub.get("catchEventInstanceKey", -1) < 0:
-                writers.append_event(
-                    self.state.next_key(), ValueType.SIGNAL_SUBSCRIPTION,
-                    SignalSubscriptionIntent.DELETED, sub,
-                )
+    for sub in state.signal_subscriptions.subscriptions_of(previous_key):
+        if sub.get("catchEventInstanceKey", -1) < 0:
+            writers.append_event(
+                state.next_key(), ValueType.SIGNAL_SUBSCRIPTION,
+                SignalSubscriptionIntent.DELETED, sub,
+            )
 
 
 class ProcessInstanceCreationProcessor:
@@ -391,7 +397,7 @@ class ProcessInstanceCreationProcessor:
             meta = None if key is None else self.state.processes.get_by_key(key)
         else:
             meta = self.state.processes.get_latest_by_id(bpmn_process_id)
-        if meta is None:
+        if meta is None or meta.get("deleted"):
             writers.respond_rejection(
                 cmd, RejectionType.NOT_FOUND,
                 f"Expected to find process definition with process ID '{bpmn_process_id}', "

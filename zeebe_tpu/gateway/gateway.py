@@ -351,10 +351,57 @@ class GatewayService:
     # -- pending engine features ----------------------------------------------
 
     def ModifyProcessInstance(self, request, context):
-        context.abort(grpc.StatusCode.UNIMPLEMENTED, "modification pending")
+        from zeebe_tpu.protocol.intent import ProcessInstanceModificationIntent
+
+        partition = self.runtime.partition_for_key(request.processInstanceKey)
+        value = {
+            "activateInstructions": [
+                {
+                    "elementId": ai.elementId,
+                    "ancestorElementInstanceKey": ai.ancestorElementInstanceKey or -1,
+                    "variableInstructions": [
+                        {"variables": self._parse_vars(context, vi.variables),
+                         "scopeId": vi.scopeId}
+                        for vi in ai.variableInstructions
+                    ],
+                }
+                for ai in request.activateInstructions
+            ],
+            "terminateInstructions": [
+                {"elementInstanceKey": ti.elementInstanceKey}
+                for ti in request.terminateInstructions
+            ],
+        }
+        self._submit(
+            context, partition,
+            command(ValueType.PROCESS_INSTANCE_MODIFICATION,
+                    ProcessInstanceModificationIntent.MODIFY, value,
+                    key=request.processInstanceKey),
+        )
+        return pb.ModifyProcessInstanceResponse()
 
     def MigrateProcessInstance(self, request, context):
-        context.abort(grpc.StatusCode.UNIMPLEMENTED, "migration pending")
+        from zeebe_tpu.protocol.intent import ProcessInstanceMigrationIntent
+
+        partition = self.runtime.partition_for_key(request.processInstanceKey)
+        plan = request.migrationPlan
+        value = {
+            "migrationPlan": {
+                "targetProcessDefinitionKey": plan.targetProcessDefinitionKey,
+                "mappingInstructions": [
+                    {"sourceElementId": m.sourceElementId,
+                     "targetElementId": m.targetElementId}
+                    for m in plan.mappingInstructions
+                ],
+            },
+        }
+        self._submit(
+            context, partition,
+            command(ValueType.PROCESS_INSTANCE_MIGRATION,
+                    ProcessInstanceMigrationIntent.MIGRATE, value,
+                    key=request.processInstanceKey),
+        )
+        return pb.MigrateProcessInstanceResponse()
 
     def EvaluateDecision(self, request, context):
         from zeebe_tpu.protocol.intent import DecisionEvaluationIntent
@@ -412,7 +459,16 @@ class GatewayService:
         )
 
     def DeleteResource(self, request, context):
-        context.abort(grpc.StatusCode.UNIMPLEMENTED, "resource deletion pending")
+        from zeebe_tpu.protocol.intent import ResourceDeletionIntent
+
+        # resources live on the partition that minted their key
+        partition = self.runtime.partition_for_key(request.resourceKey)
+        self._submit(
+            context, partition,
+            command(ValueType.RESOURCE_DELETION, ResourceDeletionIntent.DELETE,
+                    {"resourceKey": request.resourceKey}),
+        )
+        return pb.DeleteResourceResponse()
 
     # -- plumbing --------------------------------------------------------------
 

@@ -75,6 +75,9 @@ class ExecutableElement:
     # misc
     called_process_id: str | None = None
     called_decision_id: str | None = None
+    native_user_task: bool = False
+    user_task_assignee: str | None = None
+    user_task_candidate_groups: str | None = None
     decision_result_variable: str | None = None
     script_expression: Expression | None = None
     script_result_variable: str | None = None
@@ -207,6 +210,9 @@ def _lower_element(
     exe.task_headers = dict(el.task_headers)
     exe.called_process_id = el.called_process_id
     exe.called_decision_id = el.called_decision_id
+    exe.native_user_task = el.native_user_task
+    exe.user_task_assignee = el.user_task_assignee
+    exe.user_task_candidate_groups = el.user_task_candidate_groups
     exe.decision_result_variable = el.decision_result_variable
     exe.script_result_variable = el.script_result_variable
     if el.parent_id is not None:

@@ -84,6 +84,9 @@ class ProcessElement:
     script_result_variable: str | None = None
     # business rule task with called decision
     called_decision_id: str | None = None
+    native_user_task: bool = False
+    user_task_assignee: str | None = None
+    user_task_candidate_groups: str | None = None
     decision_result_variable: str | None = None
 
 
@@ -393,9 +396,18 @@ class ProcessBuilder:
             raise BpmnModelError("send task requires job_type")
         return self._job_task(element_id, BpmnElementType.SEND_TASK, "send", job_type, **kw)
 
-    def user_task(self, element_id: str | None = None) -> "ProcessBuilder":
+    def user_task(self, element_id: str | None = None, *,
+                  native: bool = False, assignee: str | None = None,
+                  candidate_groups: str | None = None) -> "ProcessBuilder":
+        """Job-based by default (reference 8.4 default worker contract);
+        ``native=True`` uses the zeebe:userTask native lifecycle records."""
         el = ProcessElement(element_id or self._auto_id("user"), BpmnElementType.USER_TASK)
-        el.job_type = "io.camunda.zeebe:userTask"
+        if native:
+            el.native_user_task = True
+            el.user_task_assignee = assignee
+            el.user_task_candidate_groups = candidate_groups
+        else:
+            el.job_type = "io.camunda.zeebe:userTask"
         return self._add_element(el)
 
     def manual_task(self, element_id: str | None = None) -> "ProcessBuilder":

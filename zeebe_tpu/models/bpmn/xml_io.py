@@ -192,6 +192,13 @@ def _parse_extensions(el_xml, el: ProcessElement) -> None:
     if script is not None:
         el.script_expression = script.get("expression")
         el.script_result_variable = script.get("resultVariable")
+    native_ut = ext.find(f"{_Z}userTask")
+    if native_ut is not None:
+        el.native_user_task = True
+        assignment = ext.find(f"{_Z}assignmentDefinition")
+        if assignment is not None:
+            el.user_task_assignee = assignment.get("assignee")
+            el.user_task_candidate_groups = assignment.get("candidateGroups")
     loop = el_xml.find(f"{_B}multiInstanceLoopCharacteristics")
     if loop is not None:
         mi = MultiInstanceDefinition(is_sequential=loop.get("isSequential", "false") in ("true", "1"))
@@ -327,6 +334,15 @@ def _element_to_xml(parent, el: ProcessElement, message_names, error_codes,
         if el.script_result_variable:
             attrs["resultVariable"] = el.script_result_variable
         ET.SubElement(ext_el(), f"{_Z}script", attrs)
+    if el.native_user_task:
+        ET.SubElement(ext_el(), f"{_Z}userTask", {})
+        assignment = {}
+        if el.user_task_assignee:
+            assignment["assignee"] = el.user_task_assignee
+        if el.user_task_candidate_groups:
+            assignment["candidateGroups"] = el.user_task_candidate_groups
+        if assignment:
+            ET.SubElement(ext_el(), f"{_Z}assignmentDefinition", assignment)
 
     if el.event_type == BpmnEventType.TIMER and el.timer is not None:
         timer = ET.SubElement(node, f"{_B}timerEventDefinition")

@@ -60,6 +60,7 @@ class ValueType(enum.IntEnum):
     FORM = 29
     USER_TASK = 30
     PROCESS_INSTANCE_RESULT = 31
+    PROCESS_INSTANCE_MIGRATION = 32
     SBE_UNKNOWN = 255
 
 

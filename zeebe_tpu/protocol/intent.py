@@ -86,6 +86,13 @@ class ProcessInstanceModificationIntent(Intent):
     _EVENT_NAMES = enum.nonmember(frozenset({"MODIFIED"}))
 
 
+class ProcessInstanceMigrationIntent(Intent):
+    MIGRATE = 0
+    MIGRATED = 1
+
+    _EVENT_NAMES = enum.nonmember(frozenset({"MIGRATED"}))
+
+
 class ProcessInstanceBatchIntent(Intent):
     ACTIVATE = 0
     ACTIVATED = 1
@@ -368,6 +375,7 @@ class UserTaskIntent(Intent):
 
 
 _INTENTS_BY_VALUE_TYPE: dict[ValueType, type[Intent]] = {
+    ValueType.PROCESS_INSTANCE_MIGRATION: ProcessInstanceMigrationIntent,
     ValueType.JOB: JobIntent,
     ValueType.DEPLOYMENT: DeploymentIntent,
     ValueType.PROCESS_INSTANCE: ProcessInstanceIntent,
