@@ -151,3 +151,41 @@ class TestSignalDistribution:
             and r.record.value.get("bpmnElementType") == "PROCESS"
         ]
         assert len(started) == 3
+
+
+DISH_DMN = """<?xml version="1.0" encoding="UTF-8"?>
+<definitions xmlns="https://www.omg.org/spec/DMN/20191111/MODEL/"
+             id="dish_drg" name="Dish decisions" namespace="test">
+  <decision id="dish" name="Dish">
+    <decisionTable hitPolicy="UNIQUE">
+      <input id="i1" label="season">
+        <inputExpression><text>season</text></inputExpression>
+      </input>
+      <output id="o1" name="dish" />
+      <rule id="r1">
+        <inputEntry><text>"Winter"</text></inputEntry>
+        <outputEntry><text>"Spareribs"</text></outputEntry>
+      </rule>
+    </decisionTable>
+  </decision>
+</definitions>
+"""
+
+
+class TestMixedDeploymentDistribution:
+    def test_bpmn_plus_dmn_deployment_applies_on_all_partitions(self, cluster):
+        """Regression: receiver-side distribution must not feed .dmn resources
+        into the BPMN parser (that wedged redistribution in a retry loop)."""
+        cluster.deploy(one_task_process("mixed"), ("dish.dmn", DISH_DMN))
+        for pid in (1, 2, 3):
+            state = cluster.partition(pid).engine.state
+            with cluster.partition(pid).db.transaction():
+                assert state.processes.latest_version("mixed") == 1, f"partition {pid}"
+                assert not state.distribution.has_any_pending(), f"partition {pid}"
+        # instances of the distributed process start on non-origin partitions
+        pi_key = cluster.create_instance("mixed", partition_id=3)
+        h = cluster.partition(3)
+        jobs = h.activate_jobs("work")
+        assert len(jobs) == 1
+        h.complete_job(jobs[0]["key"])
+        assert h.is_instance_done(pi_key)

@@ -244,3 +244,41 @@ class TestManagementServer:
         with urllib.request.urlopen(req) as resp:
             assert resp.status == 200
         assert not any(p.paused for p in broker.partitions.values())
+
+
+class TestBackpressureGateRejection:
+    def test_gate_rejection_does_not_collapse_limit(self):
+        """Regression: a burst of gated rejections must not multiplicatively
+        shrink the limit (death spiral); only timed-out in-flight samples do."""
+        from zeebe_tpu.broker.backpressure import CommandRateLimiter
+
+        now = [0]
+        lim = CommandRateLimiter(algorithm="aimd", clock_millis=lambda: now[0],
+                                 timeout_ms=1000, initial=10)
+        rec = _cmd()
+        before = lim.limit
+        for pos in range(before):
+            assert lim.try_acquire(rec)
+            lim.on_appended(pos)
+        for _ in range(100):  # burst of rejections at the gate
+            assert not lim.try_acquire(rec)
+        assert lim.limit == before
+        assert lim.dropped_total == 100
+        # fast completions keep/raise the limit
+        for pos in range(before):
+            now[0] += 1
+            lim.on_processed(pos)
+        assert lim.limit >= before
+
+    def test_timed_out_inflight_shrinks_limit(self):
+        from zeebe_tpu.broker.backpressure import CommandRateLimiter
+
+        now = [0]
+        lim = CommandRateLimiter(algorithm="aimd", clock_millis=lambda: now[0],
+                                 timeout_ms=10, initial=10)
+        rec = _cmd()
+        assert lim.try_acquire(rec)
+        lim.on_appended(1)
+        now[0] += 50  # exceed timeout
+        lim.on_processed(1)
+        assert lim.limit < 10

@@ -89,12 +89,18 @@ class CommandRateLimiter:
     registered on the command api request path)."""
 
     def __init__(self, algorithm: str = "vegas", enabled: bool = True,
-                 clock_millis: Callable[[], int] | None = None, **kw) -> None:
+                 clock_millis: Callable[[], int] | None = None,
+                 timeout_ms: int = 10_000, **kw) -> None:
         import time
 
+        if algorithm == "aimd":
+            # one timeout threshold for both the drop-sample gate here and
+            # AIMD's internal rtt backoff — not two inconsistent ones
+            kw.setdefault("timeout_ms", timeout_ms)
         self.algorithm = LIMITS[algorithm](**kw)
         self.enabled = enabled
         self.clock_millis = clock_millis or (lambda: int(time.time() * 1000))
+        self.timeout_ms = timeout_ms
         self.in_flight: dict[int, int] = {}  # position → acquire time ms
         self.dropped_total = 0
 
@@ -108,8 +114,11 @@ class CommandRateLimiter:
         if (record.value_type, int(record.intent)) in WHITELIST:
             return True
         if len(self.in_flight) >= self.algorithm.limit:
+            # gate rejections are NOT fed to the limit algorithm: the Netflix
+            # concurrency-limits reference only records drop samples for timed-
+            # out in-flight requests, and multiplicative-decrease per rejected
+            # request collapses the limit to min under a burst (death spiral)
             self.dropped_total += 1
-            self.algorithm.on_sample(0, len(self.in_flight), dropped=True)
             return False
         return True
 
@@ -120,4 +129,6 @@ class CommandRateLimiter:
         started = self.in_flight.pop(position, None)
         if started is not None:
             rtt = self.clock_millis() - started
-            self.algorithm.on_sample(rtt, len(self.in_flight), dropped=False)
+            # drop samples come only from in-flight RTTs exceeding the timeout
+            self.algorithm.on_sample(rtt, len(self.in_flight),
+                                     dropped=rtt > self.timeout_ms)

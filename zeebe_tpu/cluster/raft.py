@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import enum
 import json
+import os
 import random
 from pathlib import Path
 from typing import Any, Callable
@@ -55,6 +56,7 @@ class RaftNode:
         clock_millis: Callable[[], int],
         priority: int = 1,
         seed: int | None = None,
+        flush_policy: str = "immediate",
     ) -> None:
         self.messaging = messaging
         self.member_id = messaging.member_id
@@ -70,6 +72,15 @@ class RaftNode:
         )
 
         self.journal = SegmentedJournal(self.directory / "raft-log")
+        # "immediate": fsync before acking appends / advancing own match —
+        # the reference's default (journal flush-before-ack, SURVEY §2.2);
+        # "delayed": fsync on the next tick (reference DelayedFlusher);
+        # "none": never fsync (tests).
+        if flush_policy not in ("immediate", "delayed", "none"):
+            raise ValueError(f"unknown flush_policy {flush_policy!r}")
+        self.flush_policy = flush_policy
+        self._flushed_index = self.journal.last_index
+        self._flush_dirty = False
         self._meta_path = self.directory / "raft-meta.json"
         self.current_term = 0
         self.voted_for: str | None = None
@@ -120,11 +131,51 @@ class RaftNode:
             self.voted_for = meta["votedFor"]
 
     def _store_meta(self) -> None:
-        self._meta_path.write_text(
-            json.dumps({"term": self.current_term, "votedFor": self.voted_for})
-        )
+        # temp-file + fsync + atomic rename: a crash mid-write must never
+        # leave a torn meta file, and a persisted vote must survive the crash
+        # (double-vote safety) — reference MetaStore semantics
+        tmp = self._meta_path.with_suffix(".json.tmp")
+        with open(tmp, "w") as f:
+            f.write(json.dumps({"term": self.current_term, "votedFor": self.voted_for}))
+            f.flush()
+            if self.flush_policy != "none":
+                os.fsync(f.fileno())
+        os.replace(tmp, self._meta_path)
+        if self.flush_policy != "none":
+            # the rename itself must be durable before a vote response leaves
+            dir_fd = os.open(self.directory, os.O_RDONLY)
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
+
+    def _after_local_append(self) -> None:
+        """Durability barrier after appending entries, before acknowledging
+        them (follower ack, or leader counting itself toward the quorum)."""
+        if self.flush_policy == "immediate":
+            self._flush_journal()
+        elif self.flush_policy == "delayed":
+            self._flush_dirty = True
+
+    def _flush_journal(self) -> None:
+        if self.journal.last_index != self._flushed_index:
+            self.journal.flush()
+            self._flushed_index = self.journal.last_index
+        self._flush_dirty = False
+
+    def _truncate_after(self, index: int) -> None:
+        self.journal.truncate_after(index)
+        # conflicting entries re-appended on top of a truncation must be
+        # fsynced again even when the log lands back on the old flushed index
+        self._flushed_index = min(self._flushed_index, index)
+
+    def _reset_journal(self, next_index: int) -> None:
+        self.journal.reset(next_index)
+        self._flushed_index = min(self._flushed_index, next_index - 1)
 
     def close(self) -> None:
+        if self.flush_policy != "none":
+            self._flush_journal()  # drain a pending delayed flush on shutdown
         self.journal.close()
 
     # -- log accessors --------------------------------------------------------
@@ -166,6 +217,8 @@ class RaftNode:
 
     def tick(self, now_millis: int | None = None) -> None:
         now = self.clock_millis() if now_millis is None else now_millis
+        if self._flush_dirty:
+            self._flush_journal()  # delayed flush policy drains here
         if self.role == RaftRole.LEADER:
             if now - self._last_heartbeat_sent_ms >= HEARTBEAT_INTERVAL_MS:
                 self._broadcast_appends()
@@ -282,6 +335,7 @@ class RaftNode:
         # (reference: InitialEntry appended on leader transition)
         self._append_local({"term": self.current_term, "init": True, "asqn": -1,
                             "data": b""})
+        self._after_local_append()
         self._broadcast_appends()
 
     # -- write ingress (ZeebeLogAppender.appendEntry equivalent) ---------------
@@ -296,6 +350,7 @@ class RaftNode:
         index = self._append_local({
             "term": self.current_term, "init": False, "asqn": asqn, "data": data,
         })
+        self._after_local_append()
         if on_commit is not None:
             self._pending_appends[index] = on_commit
         self._broadcast_appends()
@@ -365,8 +420,9 @@ class RaftNode:
             if local_term == -1 or index > self._last_log_index():
                 self._append_at(index, entry)
             elif local_term != entry["term"]:
-                self.journal.truncate_after(index - 1)
+                self._truncate_after(index - 1)
                 self._append_at(index, entry)
+        self._after_local_append()  # flush BEFORE acking (Raft durability)
         if req["commit"] > self.commit_index:
             self._set_commit(min(req["commit"], self._last_log_index()))
         self._send(sender, "append-resp", {
@@ -378,10 +434,10 @@ class RaftNode:
         expected = self.journal.last_index + 1
         if index != expected:
             if index <= self.journal.last_index:
-                self.journal.truncate_after(index - 1)
+                self._truncate_after(index - 1)
             else:
                 # gap after snapshot install: reset the journal base
-                self.journal.reset(index)
+                self._reset_journal(index)
         self._append_local(entry)
 
     def _on_append_response(self, sender: str, resp: dict) -> None:
@@ -490,7 +546,7 @@ class RaftNode:
             self.snapshot_index = snap["index"]
             self.snapshot_term = snap["term"]
             self._snapshot_bytes = bytes(snap["data"])
-            self.journal.reset(snap["index"] + 1)
+            self._reset_journal(snap["index"] + 1)
             self.commit_index = max(self.commit_index, snap["index"])
             if self.snapshot_receiver is not None:
                 self.snapshot_receiver(bytes(snap["data"]))

@@ -217,6 +217,11 @@ class DeploymentProcessor:
             # (all metas duplicate/digest-matched) must not pay any parse cost
             if not executables:
                 for res in value.get("resources", []):
+                    # mirror the origin-side filter: only .bpmn resources are
+                    # process models; .dmn XML would make parse_bpmn_xml raise
+                    # and wedge redistribution in a retry loop
+                    if res.get("resourceName", "").endswith(".dmn"):
+                        continue
                     for model in parse_bpmn_xml(res["resource"]):
                         executables[model.process_id] = (res["resource"], transform(model))
             return executables.get(process_id)

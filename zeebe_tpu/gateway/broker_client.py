@@ -52,6 +52,7 @@ class ClusterRuntime:
         self.partition_count = partition_count
         self.net = LoopbackNetwork()
         self._lock = threading.RLock()
+        self._round_robin = itertools.count()
         # request ids carry a startup nonce in the high bits: a restarted
         # gateway must never resolve a backlog command's stale request_id
         # against a fresh in-flight request
@@ -134,8 +135,6 @@ class ClusterRuntime:
             }
 
     # -- partition selection ---------------------------------------------------
-
-    _round_robin = itertools.count()
 
     def partition_for_new_instance(self) -> int:
         return next(self._round_robin) % self.partition_count + 1

@@ -192,7 +192,7 @@ class GatewayService:
             command(ValueType.MESSAGE, MessageIntent.PUBLISH, {
                 "name": request.name,
                 "correlationKey": request.correlationKey,
-                "timeToLive": request.timeToLive or 3_600_000,
+                "timeToLive": request.timeToLive,
                 "messageId": request.messageId,
                 "variables": self._parse_vars(context, request.variables),
             }),

@@ -252,6 +252,7 @@ class SegmentedJournal:
         self.name = name
         self.max_segment_size = max_segment_size
         self._meta_path = self.dir / f"{name}.meta"
+        self._meta_fd: int | None = None
         self.segments: list[_Segment] = []
         self._open_or_create()
 
@@ -286,6 +287,9 @@ class SegmentedJournal:
     def close(self) -> None:
         for seg in self.segments:
             seg.close()
+        if self._meta_fd is not None:
+            os.close(self._meta_fd)
+            self._meta_fd = None
 
     # -- properties ----------------------------------------------------------
 
@@ -333,14 +337,18 @@ class SegmentedJournal:
         return seg
 
     def flush(self) -> int:
-        """fsync all dirty segments; persist and return the last flushed index
-        (reference: JournalMetaStore last-flushed index)."""
-        for seg in self.segments:
-            seg.flush()
+        """fsync the tail segment (the only one that can be dirty: rolling
+        flushes the previous segment, and truncation makes the truncated
+        segment the tail) and record the last flushed index (reference:
+        JournalMetaStore last-flushed index). The meta write is advisory —
+        recovery re-derives state from segment scans — so it is a plain
+        8-byte overwrite, not an fsync'd rename, keeping the hot append path
+        at one fsync per flush."""
+        self.segments[-1].flush()
         idx = self.last_index
-        tmp = self._meta_path.with_suffix(".tmp")
-        tmp.write_bytes(struct.pack("<Q", max(idx, 0)))
-        os.replace(tmp, self._meta_path)
+        if self._meta_fd is None:
+            self._meta_fd = os.open(self._meta_path, os.O_RDWR | os.O_CREAT, 0o644)
+        os.pwrite(self._meta_fd, struct.pack("<Q", max(idx, 0)), 0)
         return idx
 
     @property
@@ -415,6 +423,6 @@ class SegmentedJournal:
             seg.delete()
         self.segments = [_Segment(self._segment_path(1), 1, next_index, create=True)]
         # invalidate the stale flushed-index marker from the pre-reset log
-        tmp = self._meta_path.with_suffix(".tmp")
-        tmp.write_bytes(struct.pack("<Q", max(next_index - 1, 0)))
-        os.replace(tmp, self._meta_path)
+        if self._meta_fd is None:
+            self._meta_fd = os.open(self._meta_path, os.O_RDWR | os.O_CREAT, 0o644)
+        os.pwrite(self._meta_fd, struct.pack("<Q", max(next_index - 1, 0)), 0)

@@ -143,13 +143,16 @@ class EngineHarness:
 
     # -- fluent client-ish API ----------------------------------------------
 
-    def deploy(self, *models: ProcessModel | str, request_id: int = 1) -> None:
+    def deploy(self, *models: ProcessModel | str | tuple, request_id: int = 1) -> None:
         resources = []
         for i, model in enumerate(models):
-            xml = model if isinstance(model, str) else to_bpmn_xml(model)
-            name = f"resource_{i}.bpmn"
-            if isinstance(model, ProcessModel):
-                name = f"{model.process_id}.bpmn"
+            if isinstance(model, tuple):  # (resourceName, raw xml) e.g. .dmn
+                name, xml = model
+            else:
+                xml = model if isinstance(model, str) else to_bpmn_xml(model)
+                name = f"resource_{i}.bpmn"
+                if isinstance(model, ProcessModel):
+                    name = f"{model.process_id}.bpmn"
             resources.append({"resourceName": name, "resource": xml})
         self.write_command(
             command(ValueType.DEPLOYMENT, DeploymentIntent.CREATE, {"resources": resources}),
