@@ -90,17 +90,20 @@ class CommandRateLimiter:
 
     def __init__(self, algorithm: str = "vegas", enabled: bool = True,
                  clock_millis: Callable[[], int] | None = None,
-                 timeout_ms: int = 10_000, **kw) -> None:
+                 timeout_ms: int | None = None, **kw) -> None:
         import time
 
-        if algorithm == "aimd":
+        if algorithm == "aimd" and timeout_ms is not None:
             # one timeout threshold for both the drop-sample gate here and
             # AIMD's internal rtt backoff — not two inconsistent ones
             kw.setdefault("timeout_ms", timeout_ms)
         self.algorithm = LIMITS[algorithm](**kw)
         self.enabled = enabled
         self.clock_millis = clock_millis or (lambda: int(time.time() * 1000))
-        self.timeout_ms = timeout_ms
+        # default: inherit the algorithm's own threshold (AIMD: 200ms) so an
+        # unconfigured limiter keeps its pre-existing sensitivity
+        self.timeout_ms = (timeout_ms if timeout_ms is not None
+                           else getattr(self.algorithm, "timeout_ms", 10_000))
         self.in_flight: dict[int, int] = {}  # position → acquire time ms
         self.dropped_total = 0
 

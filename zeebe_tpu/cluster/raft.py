@@ -79,7 +79,12 @@ class RaftNode:
         if flush_policy not in ("immediate", "delayed", "none"):
             raise ValueError(f"unknown flush_policy {flush_policy!r}")
         self.flush_policy = flush_policy
-        self._flushed_index = self.journal.last_index
+        # trust only the journal's flush marker on open: entries beyond it may
+        # sit in the OS page cache (a process crash reopens them readable, but
+        # a later power loss would drop them), so they get re-fsynced before
+        # this node acks anything
+        self._flushed_index = min(self.journal.last_flushed_index,
+                                  self.journal.last_index)
         self._flush_dirty = False
         self._meta_path = self.directory / "raft-meta.json"
         self.current_term = 0

@@ -346,10 +346,13 @@ class SegmentedJournal:
         at one fsync per flush."""
         self.segments[-1].flush()
         idx = self.last_index
+        self._write_flush_marker(max(idx, 0))
+        return idx
+
+    def _write_flush_marker(self, idx: int) -> None:
         if self._meta_fd is None:
             self._meta_fd = os.open(self._meta_path, os.O_RDWR | os.O_CREAT, 0o644)
-        os.pwrite(self._meta_fd, struct.pack("<Q", max(idx, 0)), 0)
-        return idx
+        os.pwrite(self._meta_fd, struct.pack("<Q", idx), 0)
 
     @property
     def last_flushed_index(self) -> int:
@@ -423,6 +426,4 @@ class SegmentedJournal:
             seg.delete()
         self.segments = [_Segment(self._segment_path(1), 1, next_index, create=True)]
         # invalidate the stale flushed-index marker from the pre-reset log
-        if self._meta_fd is None:
-            self._meta_fd = os.open(self._meta_path, os.O_RDWR | os.O_CREAT, 0o644)
-        os.pwrite(self._meta_fd, struct.pack("<Q", max(next_index - 1, 0)), 0)
+        self._write_flush_marker(max(next_index - 1, 0))
