@@ -1,0 +1,706 @@
+"""The device-kernel execution backend: batched command processing.
+
+This is the seam BASELINE.json names: the automaton kernel
+(zeebe_tpu.ops.automaton) registered behind the stream platform's
+RecordProcessor SPI as the partition's batched execution engine. The stream
+processor collects a group of committed commands, this backend advances every
+touched process instance lock-step on the device, and the decoded results are
+materialized as the *identical* record stream the sequential engine would have
+written — same events, same intermediate processed commands, same keys, same
+values — through the normal Writers, so appliers, replay, exporters, and
+snapshots see no difference.
+
+Reference seams: stream-platform/src/main/java/io/camunda/zeebe/stream/api/
+RecordProcessor.java (the SPI), engine/src/main/java/io/camunda/zeebe/engine/
+Engine.java:40 (the sequential implementation this shadows), and the
+batchProcessing loop in ProcessingStateMachine.java:328-374 whose FIFO
+follow-up order the materializer reproduces exactly.
+
+Eligibility: a process definition rides the kernel when it lowers to device
+tables (flat graph of tasks / exclusive / parallel gateways / none events with
+numeric FEEL conditions — zeebe_tpu.ops.tables) and none of its elements need
+host-only behaviors (io mappings, boundary events, timers, messages, scripts).
+Commands of other definitions — and commands whose instances are not in a
+reconstructable state — fall back to the sequential engine, command by
+command, preserving exact semantics.
+
+Known float caveat: condition programs evaluate in float32 on device while the
+host FEEL evaluator uses float64 — comparisons within ~1e-7 of the boundary
+can diverge. The reference has no analogous dual path; boundary-exact process
+conditions should use integers.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+from zeebe_tpu.models.bpmn.executable import ExecutableElement, ExecutableProcess
+from zeebe_tpu.ops.tables import (
+    _KERNEL_OP,
+    ConditionNotCompilable,
+    K_JOIN,
+    K_TASK,
+    ProcessTables,
+    compile_tables,
+)
+from zeebe_tpu.protocol import ValueType
+from zeebe_tpu.protocol.enums import BpmnElementType, BpmnEventType, ErrorType
+from zeebe_tpu.protocol.intent import (
+    IncidentIntent,
+    JobIntent,
+    ProcessInstanceCreationIntent,
+    ProcessInstanceIntent as PI,
+)
+
+logger = logging.getLogger("zeebe_tpu.kernel_backend")
+
+# token phases (mirrors zeebe_tpu.ops.automaton)
+_PHASE_AT = 0
+_PHASE_WAIT = 1
+_PHASE_DONE = 2
+
+_CANDIDATE_COMMANDS = {
+    (ValueType.PROCESS_INSTANCE_CREATION, int(ProcessInstanceCreationIntent.CREATE)),
+    (ValueType.JOB, int(JobIntent.COMPLETE)),
+}
+
+
+def _is_numeric(v: Any) -> bool:
+    return isinstance(v, (bool, int, float)) and not isinstance(v, str)
+
+
+def check_element_eligibility(exe: ExecutableProcess, el: ExecutableElement) -> bool:
+    """True when the sequential engine's behavior for this element is exactly
+    the kernel's opcode behavior (engine/…/processing/bpmn element processors
+    vs ops/automaton masks)."""
+    op = _KERNEL_OP.get(el.element_type)
+    if op is None:
+        return False
+    if el.event_type not in (BpmnEventType.NONE, BpmnEventType.UNSPECIFIED):
+        return False
+    if el.inputs or el.outputs or el.boundary_idxs or el.multi_instance is not None:
+        return False
+    if el.native_user_task or el.called_decision_id or el.script_expression is not None:
+        return False
+    if (
+        el.timer_duration is not None
+        or el.timer_cycle is not None
+        or el.timer_date is not None
+        or el.message_name is not None
+        or el.signal_name is not None
+    ):
+        return False
+    if op == K_TASK:
+        # job-worker semantics only, with deploy-time-constant type/retries
+        if el.job_type is None or not el.job_type.is_static:
+            return False
+        if el.job_retries is not None and not el.job_retries.is_static:
+            return False
+    return True
+
+
+@dataclass
+class _DefInfo:
+    index: int
+    key: int
+    exe: ExecutableProcess
+    cond_var_names: frozenset[str]
+    job_types: dict[int, str]  # element idx → static job type
+    job_retries: dict[int, int]
+    join_idxs: list[int]  # element idxs of K_JOIN gateways
+
+
+class KernelRegistry:
+    """Per-partition registry of kernel-eligible definitions sharing one
+    compiled table set (ops/tables.compile_tables). Grows as deployments are
+    first touched; recompiles the shared tables on growth (deploys are rare)."""
+
+    def __init__(self, max_definitions: int = 64) -> None:
+        self.max_definitions = max_definitions
+        self._by_key: dict[int, _DefInfo] = {}
+        self._ineligible: set[int] = set()
+        self._infos: list[_DefInfo] = []
+        self._tables: ProcessTables | None = None
+        self._device = None
+
+    def lookup(self, definition_key: int, exe: ExecutableProcess | None) -> _DefInfo | None:
+        info = self._by_key.get(definition_key)
+        if info is not None:
+            return info
+        if definition_key in self._ineligible or exe is None:
+            return None
+        if len(self._infos) >= self.max_definitions:
+            return None
+        if not all(check_element_eligibility(exe, el) for el in exe.elements[1:]):
+            self._ineligible.add(definition_key)
+            return None
+        try:
+            solo = compile_tables([exe])
+        except ConditionNotCompilable:
+            self._ineligible.add(definition_key)
+            return None
+        clock = lambda: 0  # noqa: E731 — static expressions ignore the clock
+        job_types: dict[int, str] = {}
+        job_retries: dict[int, int] = {}
+        join_idxs: list[int] = []
+        for el in exe.elements[1:]:
+            if solo.kernel_op[0, el.idx] == K_TASK:
+                job_types[el.idx] = el.job_type.evaluate({}, clock)
+                job_retries[el.idx] = (
+                    int(el.job_retries.evaluate({}, clock)) if el.job_retries is not None else 3
+                )
+            if solo.kernel_op[0, el.idx] == K_JOIN:
+                join_idxs.append(el.idx)
+        info = _DefInfo(
+            index=len(self._infos),
+            key=definition_key,
+            exe=exe,
+            cond_var_names=frozenset(solo.slot_map.names),
+            job_types=job_types,
+            job_retries=job_retries,
+            join_idxs=join_idxs,
+        )
+        self._infos.append(info)
+        self._by_key[definition_key] = info
+        self._tables = None  # recompile shared set lazily
+        self._device = None
+        return info
+
+    @property
+    def tables(self) -> ProcessTables:
+        if self._tables is None:
+            self._tables = compile_tables([i.exe for i in self._infos])
+        return self._tables
+
+    @property
+    def device_tables(self):
+        if self._device is None:
+            from zeebe_tpu.ops.automaton import DeviceTables
+
+            self._device = DeviceTables.from_tables(self.tables)
+        return self._device
+
+
+@dataclass
+class _Token:
+    slot: int
+    elem_idx: int
+    key: int  # element instance key (-1 until minted at materialization)
+    value: dict  # the record value the ACTIVATE command carried
+    phase: int = _PHASE_AT
+
+
+@dataclass
+class _Inst:
+    idx: int  # row in the device batch
+    info: _DefInfo
+    new: bool  # created by this group (vs reconstructed)
+    pi_key: int = -1
+    meta: dict | None = None  # creation: resolved definition metadata
+    tokens: list[_Token] = field(default_factory=list)
+    join_counts: dict[int, int] = field(default_factory=dict)  # elem idx → arrivals
+    slots: dict[str, float] = field(default_factory=dict)  # condition variables
+    done_emitted: bool = False
+
+
+@dataclass
+class _Admitted:
+    cmd: Any  # LoggedRecord
+    inst: _Inst
+    resume_token: _Token | None = None  # job complete: the PHASE_DONE token
+
+
+class KernelBackend:
+    """Admits groups of commands, runs the automaton kernel, materializes the
+    sequential-equivalent record stream. One instance per partition."""
+
+    def __init__(self, engine, max_group: int = 256, max_steps: int = 4096) -> None:
+        self.engine = engine
+        self.registry = KernelRegistry()
+        self.max_group = max_group
+        self.max_steps = max_steps
+        # observability
+        self.groups_processed = 0
+        self.commands_processed = 0
+        self.fallbacks = 0
+
+    # -- candidate test (no state access) ----------------------------------
+
+    def is_candidate(self, record) -> bool:
+        return (record.value_type, int(record.intent)) in _CANDIDATE_COMMANDS
+
+    # -- admission ----------------------------------------------------------
+
+    def _admit(self, cmd, instances: dict[int, _Inst]) -> _Admitted | None:
+        record = cmd.record
+        kind = (record.value_type, int(record.intent))
+        if kind == (ValueType.PROCESS_INSTANCE_CREATION, int(ProcessInstanceCreationIntent.CREATE)):
+            return self._admit_creation(cmd, instances)
+        if kind == (ValueType.JOB, int(JobIntent.COMPLETE)):
+            return self._admit_job_complete(cmd, instances)
+        return None
+
+    def _admit_creation(self, cmd, instances) -> _Admitted | None:
+        state = self.engine.state
+        value = cmd.record.value
+        if value.get("startInstructions"):
+            return None
+        bpmn_process_id = value.get("bpmnProcessId", "")
+        definition_key = value.get("processDefinitionKey", -1)
+        version = value.get("version", -1)
+        if definition_key > 0:
+            meta = state.processes.get_by_key(definition_key)
+        elif version > 0:
+            key = state.processes.get_key_by_id_version(bpmn_process_id, version)
+            meta = None if key is None else state.processes.get_by_key(key)
+        else:
+            meta = state.processes.get_latest_by_id(bpmn_process_id)
+        if meta is None or meta.get("deleted"):
+            return None  # sequential path writes the NOT_FOUND rejection
+        def_key = meta["processDefinitionKey"]
+        info = self.registry.lookup(def_key, state.processes.executable(def_key))
+        if info is None:
+            return None
+        variables = value.get("variables") or {}
+        slots: dict[str, float] = {}
+        for name in info.cond_var_names:
+            v = variables.get(name)
+            if not _is_numeric(v):
+                # a condition could read this variable: the host FEEL path and
+                # the device float path would disagree on null/strings
+                return None
+            slots[name] = float(v)
+        inst = _Inst(idx=len(instances), info=info, new=True, meta=meta, slots=slots)
+        return _Admitted(cmd=cmd, inst=inst)
+
+    def _admit_job_complete(self, cmd, instances) -> _Admitted | None:
+        state = self.engine.state
+        job_key = cmd.record.key
+        job = state.jobs.get(job_key)
+        if job is None:
+            return None  # sequential path writes the NOT_FOUND rejection
+        pi_key = job.get("processInstanceKey", -1)
+        if pi_key in (i.pi_key for i in instances.values()):
+            return None  # same-instance conflict: next group
+        def_key = job.get("processDefinitionKey", -1)
+        info = self.registry.lookup(def_key, state.processes.executable(def_key))
+        if info is None:
+            return None
+        root = state.element_instances.get(pi_key)
+        from zeebe_tpu.engine.engine_state import EI_ACTIVATED
+
+        if root is None or root["state"] != EI_ACTIVATED:
+            return None
+        # every live element instance must be a task parked on a job — any
+        # other state (mid-transition, incident) is not reconstructable
+        exe = info.exe
+        tokens: list[_Token] = []
+        resume: _Token | None = None
+        for child_key in sorted(state.element_instances.children_keys(pi_key)):
+            child = state.element_instances.get(child_key)
+            if child is None or child["state"] != EI_ACTIVATED:
+                return None
+            elem_id = child["value"].get("elementId", "")
+            if elem_id not in exe.by_id:
+                return None
+            el = exe.element(elem_id)
+            if self.registry.tables.kernel_op[info.index, el.idx] != K_TASK:
+                return None
+            if child.get("jobKey", -1) < 0:
+                return None
+            tok = _Token(slot=-1, elem_idx=el.idx, key=child_key,
+                         value=dict(child["value"]), phase=_PHASE_WAIT)
+            if child_key == job.get("elementInstanceKey", -1):
+                tok.phase = _PHASE_DONE
+                resume = tok
+            tokens.append(tok)
+        if resume is None:
+            return None
+        # pending parallel-join arrivals → device join counters
+        join_counts: dict[int, int] = {}
+        for jidx in info.join_idxs:
+            el = exe.elements[jidx]
+            total = sum(
+                state.element_instances.taken_flow_count(pi_key, jidx, f.idx)
+                for f in exe.flows
+                if f.target_idx == jidx
+            )
+            if total:
+                join_counts[jidx] = total
+        # condition variables: post-merge view (scope vars + completion vars)
+        merged = state.variables.collect(pi_key)
+        merged.update(cmd.record.value.get("variables") or {})
+        slots: dict[str, float] = {}
+        for name in info.cond_var_names:
+            v = merged.get(name)
+            if not _is_numeric(v):
+                return None
+            slots[name] = float(v)
+        inst = _Inst(idx=len(instances), info=info, new=False, pi_key=pi_key,
+                     tokens=tokens, join_counts=join_counts, slots=slots)
+        return _Admitted(cmd=cmd, inst=inst, resume_token=resume)
+
+    # -- device run ----------------------------------------------------------
+
+    @staticmethod
+    def _pow2(n: int) -> int:
+        p = 8
+        while p < n:
+            p *= 2
+        return p
+
+    def _run_kernel(self, admitted: list[_Admitted]) -> list[dict] | None:
+        """Build the group batch, step to quiescence, return per-step host
+        events (None → caller must fall back)."""
+        import jax
+        import jax.numpy as jnp
+
+        from zeebe_tpu.ops.automaton import step
+
+        tables = self.registry.tables
+        insts = [a.inst for a in admitted]
+        n_real = len(insts)
+        n_tokens = sum(max(1, len(i.tokens)) for i in insts)
+        I = self._pow2(n_real)
+        T = self._pow2(max(16, 4 * n_tokens))
+        E = tables.max_elements
+        S = tables.num_slots
+
+        elem = np.full(T, -1, np.int32)
+        phase = np.zeros(T, np.int32)
+        inst_arr = np.zeros(T, np.int32)
+        def_of = np.zeros(I, np.int32)
+        var_slots = np.zeros((I, S), np.float32)
+        join_counts = np.zeros((I, E), np.int32)
+        done = np.zeros(I, np.bool_)
+        done[n_real:] = True  # padding rows must never report newly_done
+
+        slot = 0
+        for i in insts:
+            def_of[i.idx] = i.info.index
+            for name, v in i.slots.items():
+                var_slots[i.idx, tables.slot_map.names[name]] = v
+            for jidx, count in i.join_counts.items():
+                join_counts[i.idx, jidx] = count
+            if i.new:
+                i.tokens = [_Token(slot=slot, elem_idx=int(tables.start_elem[i.info.index]),
+                                   key=-1, value={})]
+                elem[slot] = i.tokens[0].elem_idx
+                phase[slot] = _PHASE_AT
+                inst_arr[slot] = i.idx
+                slot += 1
+            else:
+                for tok in i.tokens:
+                    tok.slot = slot
+                    elem[slot] = tok.elem_idx
+                    phase[slot] = tok.phase
+                    inst_arr[slot] = i.idx
+                    slot += 1
+
+        state = {
+            "elem": jnp.asarray(elem),
+            "phase": jnp.asarray(phase),
+            "inst": jnp.asarray(inst_arr),
+            "def_of": jnp.asarray(def_of),
+            "var_slots": jnp.asarray(var_slots),
+            "join_counts": jnp.asarray(join_counts),
+            "done": jnp.asarray(done),
+            "incident": jnp.zeros(I, jnp.bool_),
+            "transitions": jnp.zeros((), jnp.int32),
+            "jobs_created": jnp.zeros((), jnp.int32),
+            "completed": jnp.zeros((), jnp.int32),
+            "overflow": jnp.zeros((), jnp.bool_),
+        }
+        config = tables.kernel_config
+        dt = self.registry.device_tables
+        steps: list[dict] = []
+        for _ in range(self.max_steps):
+            host_elem = np.asarray(state["elem"])
+            host_phase = np.asarray(state["phase"])
+            if not ((host_elem >= 0) & ((host_phase == _PHASE_AT) | (host_phase == _PHASE_DONE))).any():
+                break
+            state, ev = step(dt, state, auto_jobs=False, emit_events=True, config=config)
+            steps.append(jax.device_get(ev))
+        else:
+            logger.warning("kernel group did not quiesce in %d steps; falling back", self.max_steps)
+            return None
+        if bool(np.asarray(state["overflow"])):
+            logger.warning("kernel token pool overflow (T=%d); falling back", T)
+            return None
+        return steps
+
+    # -- materialization ------------------------------------------------------
+
+    def process_group(self, cmds, make_builder: Callable[[], Any]) -> tuple[list, list]:
+        """Pull commands from the ``cmds`` iterator while they admit (lazy: a
+        non-admittable head costs one log read, not a full peek), run the
+        kernel, and materialize each admitted command's record burst into its
+        own result builder. Returns (admitted_cmds, builders); an empty list
+        means the caller should process the head command sequentially.
+
+        Must run inside the partition's open db transaction."""
+        instances: dict[int, _Inst] = {}
+        admitted: list[_Admitted] = []
+        for cmd in cmds:
+            adm = self._admit(cmd, instances)
+            if adm is None:
+                break
+            instances[adm.inst.idx] = adm.inst
+            admitted.append(adm)
+            if len(admitted) >= self.max_group:
+                break
+        if not admitted:
+            self.fallbacks += 1
+            return [], []
+        steps = self._run_kernel(admitted)
+        if steps is None:
+            self.fallbacks += 1
+            return [], []
+
+        from zeebe_tpu.engine.writers import Writers
+
+        builders = []
+        for adm in admitted:
+            builder = make_builder()
+            writers = Writers(builder, self.engine.appliers)
+            if adm.inst.new:
+                self._materialize_creation(adm, steps, writers, builder)
+            else:
+                self._materialize_job_complete(adm, steps, writers, builder)
+            builders.append(builder)
+        self.groups_processed += 1
+        self.commands_processed += len(admitted)
+        return [a.cmd for a in admitted], builders
+
+    def _mark_last_command_processed(self, builder) -> None:
+        for entry in reversed(builder.follow_ups):
+            if entry.record.is_command:
+                entry.processed = True
+                return
+
+    def _materialize_creation(self, adm: _Admitted, steps, writers, builder) -> None:
+        from zeebe_tpu.engine.bpmn import _pi_value
+
+        engine = self.engine
+        state = engine.state
+        inst = adm.inst
+        exe = inst.info.exe
+        # the sequential creation processor writes CREATED + response +
+        # ACTIVATE(process) command + seed VARIABLE events — reuse it verbatim
+        creation = engine._processors[
+            (ValueType.PROCESS_INSTANCE_CREATION, int(ProcessInstanceCreationIntent.CREATE))
+        ]
+        mark = len(builder.follow_ups)
+        creation(adm.cmd, writers)
+        # locate the minted instance key + the ACTIVATE(process) command
+        activate_cmd = None
+        for entry in builder.follow_ups[mark:]:
+            if entry.record.is_command and entry.record.value_type == ValueType.PROCESS_INSTANCE:
+                activate_cmd = entry
+                break
+        if activate_cmd is None:  # rejection (definition vanished mid-group)
+            return
+        activate_cmd.processed = True
+        inst.pi_key = activate_cmd.record.key
+        process_el = exe.root
+        value = _pi_value(dict(activate_cmd.record.value), process_el)
+        writers.append_event(inst.pi_key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATING, value)
+        writers.append_event(inst.pi_key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
+        # ACTIVATE(start) — mirror BpmnProcessor._write_activate
+        start = exe.elements[exe.none_start_of(0)]
+        tok = inst.tokens[0]
+        tok.key = state.next_key()
+        tok.value = self._child_value(value, start, inst.pi_key)
+        writers.append_command(tok.key, ValueType.PROCESS_INSTANCE,
+                               PI.ACTIVATE_ELEMENT, tok.value)
+        self._mark_last_command_processed(builder)
+        self._cascade(inst, steps, writers, builder)
+
+    def _materialize_job_complete(self, adm: _Admitted, steps, writers, builder) -> None:
+        engine = self.engine
+        job_complete = engine._processors[(ValueType.JOB, int(JobIntent.COMPLETE))]
+        job_complete(adm.cmd, writers)  # JOB COMPLETED + response + variables
+        self._mark_last_command_processed(builder)  # the COMPLETE_ELEMENT cmd
+        self._cascade(adm.inst, steps, writers, builder)
+
+    @staticmethod
+    def _child_value(scope_value: dict, element: ExecutableElement, scope_key: int) -> dict:
+        """Mirror BpmnProcessor._write_activate's record value exactly."""
+        return {
+            "bpmnProcessId": scope_value["bpmnProcessId"],
+            "version": scope_value["version"],
+            "processDefinitionKey": scope_value["processDefinitionKey"],
+            "processInstanceKey": scope_value["processInstanceKey"],
+            "elementId": element.id,
+            "flowScopeKey": scope_key,
+            "bpmnElementType": element.element_type.name,
+            "bpmnEventType": element.event_type.name,
+        }
+
+    def _cascade(self, inst: _Inst, steps, writers, builder) -> None:
+        """Walk the device steps for one instance in the sequential engine's
+        FIFO follow-up order, writing its record burst."""
+        from zeebe_tpu.engine.bpmn import _pi_value
+
+        state = self.engine.state
+        exe = inst.info.exe
+        order: list[_Token] = list(inst.tokens)
+
+        for ev in steps:
+            if inst.done_emitted or not order:
+                break
+            additions: list[_Token] = []
+            for tok in list(order):
+                s = tok.slot
+                if ev["inst"][s] != inst.idx or ev["elem"][s] != tok.elem_idx:
+                    continue  # slot reused after this token died (stale entry)
+                element = exe.elements[tok.elem_idx]
+                value = _pi_value(tok.value, element)
+                if ev["task_arrive"][s]:
+                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                         PI.ELEMENT_ACTIVATING, value)
+                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                         PI.ELEMENT_ACTIVATED, value)
+                    self._emit_job_created(inst, tok, element, writers)
+                    tok.phase = _PHASE_WAIT
+                elif ev["task_done"][s]:
+                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                         PI.ELEMENT_COMPLETING, value)
+                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                         PI.ELEMENT_COMPLETED, value)
+                    self._emit_flows(inst, tok, value, ev, writers, builder, additions)
+                    order.remove(tok)
+                elif ev["full_pass"][s]:
+                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                         PI.ELEMENT_ACTIVATING, value)
+                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                         PI.ELEMENT_ACTIVATED, value)
+                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                         PI.ELEMENT_COMPLETING, value)
+                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                         PI.ELEMENT_COMPLETED, value)
+                    self._emit_flows(inst, tok, value, ev, writers, builder, additions)
+                    order.remove(tok)
+                elif ev["no_match"][s]:
+                    # gateway with no true condition and no default: incident,
+                    # element parks in COMPLETING (BpmnProcessor._complete →
+                    # _choose_exclusive_flow → _raise_incident)
+                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                         PI.ELEMENT_ACTIVATING, value)
+                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                         PI.ELEMENT_ACTIVATED, value)
+                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                         PI.ELEMENT_COMPLETING, value)
+                    incident_key = state.next_key()
+                    writers.append_event(
+                        incident_key, ValueType.INCIDENT, IncidentIntent.CREATED,
+                        {
+                            "errorType": ErrorType.CONDITION_ERROR.name,
+                            "errorMessage": (
+                                "Expected at least one condition to evaluate to true, "
+                                f"or to have a default flow at gateway '{element.id}'"
+                            ),
+                            "bpmnProcessId": value.get("bpmnProcessId", ""),
+                            "processDefinitionKey": value.get("processDefinitionKey", -1),
+                            "processInstanceKey": value.get("processInstanceKey", -1),
+                            "elementId": value.get("elementId", ""),
+                            "elementInstanceKey": tok.key,
+                            "jobKey": -1,
+                            "variableScopeKey": tok.key,
+                        },
+                    )
+                    order.remove(tok)
+            order.extend(additions)
+            inst.tokens = order
+            if ev["newly_done"][inst.idx] and not inst.done_emitted:
+                self._emit_process_completed(inst, writers, builder)
+
+    def _emit_flows(self, inst: _Inst, tok: _Token, value: dict, ev, writers,
+                    builder, additions: list[_Token]) -> None:
+        """SEQUENCE_FLOW_TAKEN + child ACTIVATE commands for one completing
+        token, in flow-slot order (mirrors _complete → _take_flow)."""
+        state = self.engine.state
+        tables = self.registry.tables
+        exe = inst.info.exe
+        d = inst.info.index
+        e = tok.elem_idx
+        T = ev["elem"].shape[0]
+        for fo in range(ev["take_mask"].shape[1]):
+            if not ev["take_mask"][tok.slot, fo]:
+                continue
+            flow = exe.flows[int(tables.out_flow_idx[d, e, fo])]
+            flow_value = {
+                "bpmnProcessId": value["bpmnProcessId"],
+                "version": value["version"],
+                "processDefinitionKey": value["processDefinitionKey"],
+                "processInstanceKey": value["processInstanceKey"],
+                "elementId": flow.id,
+                "flowScopeKey": value.get("flowScopeKey", -1),
+                "bpmnElementType": BpmnElementType.SEQUENCE_FLOW.name,
+                "bpmnEventType": BpmnEventType.UNSPECIFIED.name,
+            }
+            flow_key = state.next_key()
+            writers.append_event(flow_key, ValueType.PROCESS_INSTANCE,
+                                 PI.SEQUENCE_FLOW_TAKEN, flow_value)
+            dest = int(ev["dest"][tok.slot, fo])
+            if dest < T:
+                target = exe.elements[flow.target_idx]
+                child_key = state.next_key()
+                child_value = self._child_value(value, target, value.get("flowScopeKey", -1))
+                writers.append_command(child_key, ValueType.PROCESS_INSTANCE,
+                                       PI.ACTIVATE_ELEMENT, child_value)
+                self._mark_last_command_processed(builder)
+                additions.append(_Token(slot=dest, elem_idx=target.idx,
+                                        key=child_key, value=child_value))
+
+    def _emit_job_created(self, inst: _Inst, tok: _Token, element: ExecutableElement,
+                          writers) -> None:
+        """Mirror BpmnProcessor._activate's job-worker task branch."""
+        state = self.engine.state
+        value = tok.value
+        job_key = state.next_key()
+        writers.append_event(
+            job_key, ValueType.JOB, JobIntent.CREATED,
+            {
+                "type": inst.info.job_types[element.idx],
+                "retries": inst.info.job_retries[element.idx],
+                "worker": "",
+                "deadline": -1,
+                "variables": {},
+                "customHeaders": element.task_headers,
+                "elementId": element.id,
+                "elementInstanceKey": tok.key,
+                "processInstanceKey": value["processInstanceKey"],
+                "processDefinitionKey": value["processDefinitionKey"],
+                "processDefinitionVersion": value["version"],
+                "bpmnProcessId": value["bpmnProcessId"],
+                "errorMessage": "",
+            },
+        )
+
+    def _emit_process_completed(self, inst: _Inst, writers, builder) -> None:
+        """Mirror _check_scope_completion → COMPLETE_ELEMENT(process) →
+        _complete(process) → _on_process_completed."""
+        from zeebe_tpu.engine.bpmn import _pi_value
+
+        state = self.engine.state
+        bpmn = self.engine.bpmn
+        root = state.element_instances.get(inst.pi_key)
+        if root is None:
+            return
+        writers.append_command(inst.pi_key, ValueType.PROCESS_INSTANCE,
+                               PI.COMPLETE_ELEMENT, {})
+        self._mark_last_command_processed(builder)
+        process_el = inst.info.exe.root
+        value = _pi_value(dict(root["value"]), process_el)
+        writers.append_event(inst.pi_key, ValueType.PROCESS_INSTANCE,
+                             PI.ELEMENT_COMPLETING, value)
+        child_locals = state.variables.locals_of(inst.pi_key)
+        writers.append_event(inst.pi_key, ValueType.PROCESS_INSTANCE,
+                             PI.ELEMENT_COMPLETED, value)
+        bpmn._on_process_completed(inst.pi_key, value, child_locals or {}, writers)
+        inst.done_emitted = True

@@ -386,7 +386,11 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     live_after = new_elem >= 0
     tokens_per_inst = jnp.zeros(I, jnp.int32).at[new_inst].add(live_after.astype(jnp.int32))
     was_done = state["done"]
-    newly_done = ~was_done & (tokens_per_inst == 0)
+    # a pending parallel-join arrival is an active sequence flow: the scope
+    # only completes when no tokens AND no unconsumed arrivals remain
+    # (reference: scope completion requires activeFlows == 0)
+    pending_arrivals = join_counts.sum(axis=1)
+    newly_done = ~was_done & (tokens_per_inst == 0) & (pending_arrivals == 0)
     done = was_done | newly_done
     incident = state["incident"] | jnp.zeros(I, jnp.bool_).at[inst].max(excl_no_match)
 
@@ -425,6 +429,10 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
             "take_mask": take_mask,
             "newly_done": newly_done,
             "no_match": excl_no_match,
+            # placement slot per flattened (token, flow-slot) request; T means
+            # no token was placed (join arrival merged, or dropped) — lets the
+            # host decoder track slot→logical-token identity (kernel backend)
+            "dest": dest.reshape(T, FO),
         }
     return new_state, events
 

@@ -232,6 +232,16 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                 raise ConditionNotCompilable(f"element type {el.element_type.name}")
             if el.element_type == BpmnElementType.PARALLEL_GATEWAY and el.incoming_count > 1:
                 op = K_JOIN
+            if (
+                op == K_EXCLUSIVE
+                and len(el.outgoing) == 1
+                and el.default_flow_idx < 0
+                and all(exe.flows[f].condition is None for f in el.outgoing)
+            ):
+                # a single unconditional outgoing flow routes like a pass-through
+                # (the engine's generic completion path takes it; K_EXCLUSIVE
+                # with no true condition and no default would stall instead)
+                op = K_PASS
             kernel_op[d, el.idx] = op
             in_count[d, el.idx] = el.incoming_count
             if len(el.outgoing) > max_fanout:

@@ -73,6 +73,7 @@ class StreamProcessor:
         response_sink: Callable[[ClientResponse], None] | None = None,
         clock_millis: Callable[[], int] | None = None,
         writer=None,
+        kernel_backend=None,
     ) -> None:
         self.log_stream = log_stream
         self.db = db
@@ -83,6 +84,10 @@ class StreamProcessor:
         # (reference: Sequencer → LogStorageAppender → AtomixLogStorage → Raft)
         self.writer = writer if writer is not None else log_stream.writer
         self.max_commands_in_batch = max_commands_in_batch
+        # optional batched device execution (engine/kernel_backend.py): groups
+        # of eligible commands ride the automaton kernel instead of the
+        # per-command sequential path; everything else falls through unchanged
+        self.kernel_backend = kernel_backend
         self.response_sink = response_sink or (lambda response: None)
         self.phase = Phase.INITIAL
         self._positions = db.column_family(ColumnFamilyCode.LAST_PROCESSED_POSITION)
@@ -173,6 +178,67 @@ class StreamProcessor:
                 self._reader_position = logged.position + 1
                 return logged
             position = logged.position + 1
+
+    def _iter_candidate_commands(self):
+        """Lazily yield pending commands in log order, stopping at the first
+        the kernel backend cannot be a candidate for. Does not consume."""
+        position = self._reader_position
+        while True:
+            logged = self.log_stream.read_at_or_after(position)
+            if logged is None:
+                return
+            position = logged.position + 1
+            if not (logged.record.is_command and not logged.processed):
+                continue
+            if not self.kernel_backend.is_candidate(logged.record):
+                return
+            yield logged
+
+    def process_available_batch(self) -> int:
+        """Process a group of kernel-eligible commands in one device run and
+        one transaction; returns commands consumed (0 → sequential path)."""
+        if self.kernel_backend is None or self.phase != Phase.PROCESSING:
+            return 0
+        cmds: list[LoggedRecord] = []
+        builders: list[ProcessingResultBuilder] = []
+        write_failed = False
+        try:
+            with self.db.transaction():
+                cmds, builders = self.kernel_backend.process_group(
+                    self._iter_candidate_commands(), ProcessingResultBuilder
+                )
+                if not cmds:
+                    return 0
+                try:
+                    for cmd, builder in zip(cmds, builders):
+                        entries = [
+                            LogAppendEntry(f.record, f.processed) for f in builder.follow_ups
+                        ]
+                        if entries:
+                            self.last_written_position = self.writer.try_write(
+                                entries, source_position=cmd.position
+                            )
+                except Exception:
+                    write_failed = True
+                    raise
+                self.last_processed_position = cmds[-1].position
+                self._store_last_processed(self.last_processed_position)
+        except Exception:  # noqa: BLE001 — the fallback/rollback seam
+            if write_failed:
+                # a partial group append is already in the log; reprocessing
+                # in-process would duplicate those records. Fail the partition
+                # — restart replays the log, re-derives last-processed from
+                # event source backlinks, and resumes exactly after the
+                # partially-written commands (the reference treats appender
+                # failures as partition-fatal the same way).
+                self.phase = Phase.FAILED
+                raise
+            logger.exception("kernel group processing failed; falling back to sequential")
+            return 0
+        self._reader_position = cmds[-1].position + 1
+        for builder in builders:
+            self._execute_side_effects(builder)
+        return len(cmds)
 
     def process_next(self) -> bool:
         """Process one command; returns False when no command is pending."""
@@ -269,6 +335,11 @@ class StreamProcessor:
             return self.replay_available()
         while steps < max_steps:
             self.schedule_service.run_due_tasks()
+            if self.kernel_backend is not None:
+                consumed = self.process_available_batch()
+                if consumed:
+                    steps += consumed
+                    continue
             if not self.process_next():
                 if self.schedule_service.run_due_tasks() == 0:
                     break

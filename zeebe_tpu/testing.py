@@ -58,6 +58,7 @@ class EngineHarness:
         partition_count: int = 1,
         sender=None,
         clock: ControlledClock | None = None,
+        use_kernel_backend: bool = False,
     ) -> None:
         self._tmp = None
         if directory is None:
@@ -71,6 +72,12 @@ class EngineHarness:
                              partition_count=partition_count)
         self.exporter = RecordingExporter()
         self.responses: list = []
+        kernel_backend = None
+        if use_kernel_backend:
+            from zeebe_tpu.engine.kernel_backend import KernelBackend
+
+            kernel_backend = KernelBackend(self.engine)
+        self.kernel_backend = kernel_backend
         self.processor = StreamProcessor(
             self.stream,
             self.db,
@@ -78,6 +85,7 @@ class EngineHarness:
             max_commands_in_batch=max_commands_in_batch,
             response_sink=self.responses.append,
             clock_millis=self.clock,
+            kernel_backend=kernel_backend,
         )
         from zeebe_tpu.engine.distribution import CommandRedistributor
         from zeebe_tpu.engine.message_timer import DueDateCheckers
