@@ -1,0 +1,128 @@
+"""Parity tests: native C msgpack codec vs the pure-Python specification.
+
+The C extension (zeebe_tpu/native/codec.c) must be byte-identical to
+protocol/msgpack.py on every value and raise MsgPackError on the same
+malformed inputs — it sits on the record hot path (append/replay/export/
+transport), so a single divergent byte would break replay determinism.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+
+import pytest
+
+from zeebe_tpu.protocol import msgpack
+
+pytestmark = pytest.mark.skipif(
+    msgpack.packb is msgpack.py_packb, reason="native codec unavailable"
+)
+
+
+def _random_value(rng: random.Random, depth: int = 0):
+    t = rng.randint(0, 9 if depth < 3 else 6)
+    if t == 0:
+        return None
+    if t == 1:
+        return rng.choice([True, False])
+    if t == 2:
+        return rng.randint(-(2**63), 2**64 - 1)
+    if t == 3:
+        return rng.random() * 1e9 - 5e8
+    if t == 4:
+        return "".join(chr(rng.randint(32, 0x10FF)) for _ in range(rng.randint(0, 40)))
+    if t == 5:
+        return bytes(rng.randint(0, 255) for _ in range(rng.randint(0, 300)))
+    if t == 6:
+        return rng.randint(-128, 127)
+    if t == 7:
+        return [_random_value(rng, depth + 1) for _ in range(rng.randint(0, 8))]
+    return {
+        (_random_value(rng, 4) if rng.random() < 0.5 else f"k{i}"): _random_value(rng, depth + 1)
+        for i in range(rng.randint(0, 8))
+    }
+
+
+def test_randomized_byte_parity():
+    rng = random.Random(20260729)
+    for _ in range(2000):
+        obj = _random_value(rng)
+        native = msgpack.packb(obj)
+        pure = msgpack.py_packb(obj)
+        assert native == pure
+        assert msgpack.unpackb(native) == msgpack.py_unpackb(native)
+
+
+def test_int_boundaries():
+    for v in [0, 0x7F, 0x80, 0xFF, 0x100, 0xFFFF, 0x10000, 0xFFFFFFFF,
+              0x100000000, 2**64 - 1, -1, -32, -33, -0x80, -0x81, -0x8000,
+              -0x8001, -0x80000000, -0x80000001, -(2**63)]:
+        assert msgpack.packb(v) == msgpack.py_packb(v)
+        assert msgpack.unpackb(msgpack.packb(v)) == v
+
+
+def test_int_out_of_range():
+    for v in (2**64, -(2**63) - 1):
+        with pytest.raises(msgpack.MsgPackError):
+            msgpack.packb(v)
+        with pytest.raises(msgpack.MsgPackError):
+            msgpack.py_packb(v)
+
+
+def test_float_and_nan():
+    for v in (0.0, -0.0, 1.5, math.inf, -math.inf):
+        assert msgpack.packb(v) == msgpack.py_packb(v)
+        assert msgpack.unpackb(msgpack.packb(v)) == v
+    assert msgpack.packb(math.nan) == msgpack.py_packb(math.nan)
+    assert math.isnan(msgpack.unpackb(msgpack.packb(math.nan)))
+
+
+def test_float32_decodes():
+    import struct
+
+    blob = b"\xca" + struct.pack(">f", 1.5)
+    assert msgpack.unpackb(blob) == msgpack.py_unpackb(blob) == 1.5
+
+
+def test_malformed_inputs_raise_msgpack_error():
+    cases = [b"", b"\xc1", b"\xa5ab", b"\x00\x00", b"\xd9", b"\xdc\x00",
+             b"\x81\xa1a", b"\xa1\xff"]
+    for bad in cases:
+        with pytest.raises(msgpack.MsgPackError):
+            msgpack.unpackb(bad)
+        with pytest.raises(msgpack.MsgPackError):
+            msgpack.py_unpackb(bad)
+
+
+def test_unpackable_type_raises():
+    with pytest.raises(msgpack.MsgPackError):
+        msgpack.packb(object())
+
+
+def test_deep_nesting_guard():
+    deep = None
+    for _ in range(300):
+        deep = [deep]
+    with pytest.raises(msgpack.MsgPackError):
+        msgpack.packb(deep)
+    with pytest.raises(msgpack.MsgPackError):
+        msgpack.py_packb(deep)
+    blob = b"\x91" * 300 + b"\xc0"
+    with pytest.raises(msgpack.MsgPackError):
+        msgpack.unpackb(blob)
+    with pytest.raises(msgpack.MsgPackError):
+        msgpack.py_unpackb(blob)
+
+
+def test_dict_insertion_order_preserved():
+    d = {"z": 1, "a": 2, "m": 3}
+    assert msgpack.packb(d) == msgpack.py_packb(d)
+    assert list(msgpack.unpackb(msgpack.packb(d))) == ["z", "a", "m"]
+
+
+def test_memoryview_and_bytearray():
+    raw = bytes(range(256))
+    for obj in (bytearray(raw), memoryview(raw)):
+        assert msgpack.packb(obj) == msgpack.py_packb(obj)
+    assert msgpack.unpackb(memoryview(msgpack.packb(raw))) == raw

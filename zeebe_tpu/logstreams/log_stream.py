@@ -23,6 +23,7 @@ from typing import Iterator
 
 from zeebe_tpu.journal import SegmentedJournal
 from zeebe_tpu.protocol import Record
+from zeebe_tpu.protocol.msgpack import unpackb as msgpack_unpackb
 
 _BATCH_HEADER = struct.Struct("<IqQ")  # record count, source position, timestamp ms
 _ENTRY_HEADER = struct.Struct("<BqI")  # processed flag, position, record length
@@ -72,22 +73,69 @@ class LogStreamWriter:
         with self._lock:
             first_position = stream._next_position
             timestamp = stream.clock_millis()
-            payload = _serialize_batch(entries, first_position, source_position, timestamp)
+            payload, stamped, bodies = _serialize_batch_with_bodies(
+                entries, first_position, source_position, timestamp
+            )
             jrec = stream.journal.append(payload, asqn=first_position)
             stream._on_appended(first_position, jrec.index)
             stream._next_position = first_position + len(entries)
+            # seed the decode cache from the in-memory entries: every local
+            # append is read back at least twice (processing scan + export),
+            # and the bytes round-trip is pure waste for records we hold.
+            # The value is re-decoded from the body bytes just written
+            # (tuple→list normalization etc.) so a cached read is
+            # indistinguishable from a disk read. Oversized rejection reasons
+            # are truncated on the wire (Record.encode) — skip seeding then so
+            # the cached view never diverges from disk (cheap codepoint-count
+            # precheck before paying for the utf-8 encode).
+            if any(
+                len(r.rejection_reason) > 0x3FFF
+                and len(r.rejection_reason.encode("utf-8")) > 0xFFFF
+                for r in stamped
+            ):
+                return first_position + len(entries) - 1
+            stream._cache_batch(
+                jrec.index,
+                [
+                    LoggedRecord(
+                        record=record.replace(
+                            position=first_position + i,
+                            partition_id=stream.partition_id,
+                            value=msgpack_unpackb(bodies[i]),
+                        ),
+                        position=first_position + i,
+                        source_position=source_position,
+                        processed=entries[i].processed,
+                    )
+                    for i, record in enumerate(stamped)
+                ],
+            )
         return first_position + len(entries) - 1
 
 
 def _serialize_batch(
     entries: list[LogAppendEntry], first_position: int, source_position: int, timestamp: int
 ) -> bytes:
+    return _serialize_batch_with_bodies(entries, first_position, source_position, timestamp)[0]
+
+
+def _serialize_batch_with_bodies(
+    entries: list[LogAppendEntry], first_position: int, source_position: int, timestamp: int
+) -> tuple[bytes, list[Record], list[bytes]]:
+    """Serialize; also returns the timestamp-stamped records and each record's
+    msgpack value body so the writer can seed the decode cache without
+    re-encoding anything."""
     parts = [_BATCH_HEADER.pack(len(entries), source_position, timestamp)]
+    stamped: list[Record] = []
+    bodies: list[bytes] = []
     for i, entry in enumerate(entries):
-        rec_bytes = entry.record.replace(timestamp=timestamp).to_bytes()
+        record = entry.record.replace(timestamp=timestamp)
+        rec_bytes, body = record.encode()
+        stamped.append(record)
+        bodies.append(body)
         parts.append(_ENTRY_HEADER.pack(1 if entry.processed else 0, first_position + i, len(rec_bytes)))
         parts.append(rec_bytes)
-    return b"".join(parts)
+    return b"".join(parts), stamped, bodies
 
 
 def _deserialize_batch(payload: bytes, partition_id: int) -> list[LoggedRecord]:
@@ -111,7 +159,10 @@ def _deserialize_batch(payload: bytes, partition_id: int) -> list[LoggedRecord]:
 
 
 class LogStreamReader:
-    """Sequential reader over the stream from a given position."""
+    """Sequential reader over the stream from a given position. Keeps a batch
+    cursor hint so the sequential case (the only hot one: processing, replay,
+    export all walk forward) costs one dict hit instead of a bisect + batch
+    rescan per record."""
 
     def __init__(self, stream: "LogStream", from_position: int = 1) -> None:
         self._stream = stream
@@ -119,22 +170,25 @@ class LogStreamReader:
 
     def seek(self, position: int) -> None:
         self._position = max(position, 1)
+        self._hint = -1
 
     def seek_to_end(self) -> None:
         self._position = self._stream.last_position + 1
+        self._hint = -1
 
     def __iter__(self) -> Iterator[LoggedRecord]:
         return self
 
     def __next__(self) -> LoggedRecord:
-        rec = self._stream.read_at_or_after(self._position)
+        rec, self._hint = self._stream.read_with_hint(self._position, self._hint)
         if rec is None:
             raise StopIteration
         self._position = rec.position + 1
         return rec
 
     def has_next(self) -> bool:
-        return self._stream.read_at_or_after(self._position) is not None
+        rec, self._hint = self._stream.read_with_hint(self._position, self._hint)
+        return rec is not None
 
 
 class LogStream:
@@ -154,7 +208,12 @@ class LogStream:
         # parallel arrays: batch first positions (sorted) and journal indexes
         self._batch_positions: list[int] = []
         self._batch_indexes: list[int] = []
-        self._batch_cache: tuple[int, list[LoggedRecord]] | None = None
+        # decoded-batch LRU keyed by journal index: the processing reader, the
+        # kernel group scanner, and exporters all walk the same recent suffix
+        # interleaved, so a single-slot cache thrashes (every read re-decodes
+        # a batch); 1024 batches ≈ one processing burst window
+        self._batch_cache: dict[int, list[LoggedRecord]] = {}
+        self._batch_cache_limit = 1024
         self.rebuild_index()
         self._writer = LogStreamWriter(self)
 
@@ -163,7 +222,7 @@ class LogStream:
         (call after external journal mutation, e.g. Raft truncation)."""
         self._batch_positions.clear()
         self._batch_indexes.clear()
-        self._batch_cache = None
+        self._batch_cache.clear()
         for index, asqn in self.journal.entries_meta():
             if asqn >= 0:
                 self._batch_positions.append(asqn)
@@ -175,21 +234,29 @@ class LogStream:
             self._next_position = 1
 
     def _read_batch_at(self, journal_index: int) -> list[LoggedRecord]:
-        # one-slot decode cache: sequential readers (processing, replay,
-        # exporters) hit the same batch once per record otherwise
-        cached = self._batch_cache
-        if cached is not None and cached[0] == journal_index:
-            return cached[1]
+        cache = self._batch_cache
+        batch = cache.get(journal_index)
+        if batch is not None:
+            return batch
         jrec = self.journal.read_entry(journal_index)
         if jrec is None:
             return []
         batch = _deserialize_batch(jrec.data, self.partition_id)
-        self._batch_cache = (journal_index, batch)
+        self._cache_batch(journal_index, batch)
         return batch
 
     def _on_appended(self, first_position: int, journal_index: int) -> None:
         self._batch_positions.append(first_position)
         self._batch_indexes.append(journal_index)
+
+    def _cache_batch(self, journal_index: int, batch: list[LoggedRecord]) -> None:
+        cache = self._batch_cache
+        if len(cache) >= self._batch_cache_limit:
+            # evict the oldest-decoded half in one sweep (dicts iterate in
+            # insertion order); cheaper than per-hit LRU bookkeeping
+            for key in list(cache)[: self._batch_cache_limit // 2]:
+                del cache[key]
+        cache[journal_index] = batch
 
     @property
     def writer(self) -> LogStreamWriter:
@@ -232,21 +299,37 @@ class LogStream:
 
     def read_at_or_after(self, position: int) -> LoggedRecord | None:
         """First record with record.position >= position, or None."""
+        return self.read_with_hint(position, -1)[0]
+
+    def read_with_hint(self, position: int, hint: int) -> tuple[LoggedRecord | None, int]:
+        """``read_at_or_after`` with a batch-slot cursor: ``hint`` is the slot
+        the caller last read from (-1 = unknown); returns (record, slot) so
+        sequential readers skip the bisect. A stale hint (e.g. after
+        rebuild_index truncated the arrays) is detected and falls back."""
         if position > self.last_position:
-            return None
-        slot = self._batch_slot_for(position)
+            return None, hint
+        positions = self._batch_positions
+        n = len(positions)
+        slot = -1
+        if 0 <= hint < n and positions[hint] <= position:
+            if hint + 1 >= n or positions[hint + 1] > position:
+                slot = hint
+            elif hint + 2 >= n or positions[hint + 2] > position:
+                slot = hint + 1
         if slot < 0:
-            slot = 0
+            slot = self._batch_slot_for(position)
+            if slot < 0:
+                slot = 0
         batch = self._read_batch_at(self._batch_indexes[slot])
         for logged in batch:
             if logged.position >= position:
-                return logged
+                return logged, slot
         # position falls in a gap after this batch; first record of the next
         if slot + 1 < len(self._batch_indexes):
             nxt = self._read_batch_at(self._batch_indexes[slot + 1])
             if nxt:
-                return nxt[0]
-        return None
+                return nxt[0], slot + 1
+        return None, slot
 
     def read_batch_containing(self, position: int) -> list[LoggedRecord]:
         """The whole sequenced batch holding ``position`` (for batch replay)."""

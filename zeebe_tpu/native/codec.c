@@ -1,0 +1,517 @@
+/* Native msgpack codec — the record-value hot path.
+ *
+ * C implementation of zeebe_tpu/protocol/msgpack.py (that module is the
+ * specification; tests assert byte-equality between the two). The reference
+ * keeps its record codec native for the same reason (zero-alloc MsgPackWriter/
+ * MsgPackReader over Agrona buffers, msgpack-core/src/main/java/io/camunda/
+ * zeebe/msgpack/spec/): every record append, replay, export, and transport
+ * frame round-trips through it.
+ *
+ * Exposes packb(obj) -> bytes and unpackb(buffer) -> obj, raising the
+ * exception class registered via set_error_class (MsgPackError) on malformed
+ * input — same contract as the Python module.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+static PyObject *error_class = NULL; /* MsgPackError, set from Python */
+
+static PyObject *codec_error(const char *fmt, ...)
+{
+    char buf[256];
+    va_list ap;
+    va_start(ap, fmt);
+    vsnprintf(buf, sizeof buf, fmt, ap);
+    va_end(ap);
+    PyErr_SetString(error_class ? error_class : PyExc_ValueError, buf);
+    return NULL;
+}
+
+/* ---------------------------------------------------------------- writer */
+
+typedef struct {
+    uint8_t *data;
+    Py_ssize_t len;
+    Py_ssize_t cap;
+} Writer;
+
+static int writer_grow(Writer *w, Py_ssize_t need)
+{
+    Py_ssize_t cap = w->cap ? w->cap : 256;
+    while (cap < w->len + need)
+        cap *= 2;
+    uint8_t *p = PyMem_Realloc(w->data, cap);
+    if (!p) {
+        PyErr_NoMemory();
+        return -1;
+    }
+    w->data = p;
+    w->cap = cap;
+    return 0;
+}
+
+static inline int put(Writer *w, const void *src, Py_ssize_t n)
+{
+    if (w->len + n > w->cap && writer_grow(w, n) < 0)
+        return -1;
+    memcpy(w->data + w->len, src, n);
+    w->len += n;
+    return 0;
+}
+
+static inline int put1(Writer *w, uint8_t b) { return put(w, &b, 1); }
+
+static inline int put_be16(Writer *w, uint16_t v)
+{
+    uint8_t b[2] = {(uint8_t)(v >> 8), (uint8_t)v};
+    return put(w, b, 2);
+}
+
+static inline int put_be32(Writer *w, uint32_t v)
+{
+    uint8_t b[4] = {(uint8_t)(v >> 24), (uint8_t)(v >> 16), (uint8_t)(v >> 8), (uint8_t)v};
+    return put(w, b, 4);
+}
+
+static inline int put_be64(Writer *w, uint64_t v)
+{
+    uint8_t b[8];
+    for (int i = 0; i < 8; i++)
+        b[i] = (uint8_t)(v >> (56 - 8 * i));
+    return put(w, b, 8);
+}
+
+static int pack_obj(Writer *w, PyObject *obj, int depth);
+
+static int pack_long(Writer *w, PyObject *obj)
+{
+    int overflow = 0;
+    long long v = PyLong_AsLongLongAndOverflow(obj, &overflow);
+    if (overflow > 0) {
+        unsigned long long u = PyLong_AsUnsignedLongLong(obj);
+        if (u == (unsigned long long)-1 && PyErr_Occurred()) {
+            PyErr_Clear();
+            codec_error("int too large");
+            return -1;
+        }
+        return put1(w, 0xCF) < 0 || put_be64(w, u) < 0 ? -1 : 0;
+    }
+    if (overflow < 0) {
+        codec_error("int too small");
+        return -1;
+    }
+    if (v == -1 && PyErr_Occurred())
+        return -1;
+    if (v >= 0) {
+        if (v < 0x80)
+            return put1(w, (uint8_t)v);
+        if (v < 0x100)
+            return put1(w, 0xCC) < 0 || put1(w, (uint8_t)v) < 0 ? -1 : 0;
+        if (v < 0x10000)
+            return put1(w, 0xCD) < 0 || put_be16(w, (uint16_t)v) < 0 ? -1 : 0;
+        if (v < 0x100000000LL)
+            return put1(w, 0xCE) < 0 || put_be32(w, (uint32_t)v) < 0 ? -1 : 0;
+        return put1(w, 0xCF) < 0 || put_be64(w, (uint64_t)v) < 0 ? -1 : 0;
+    }
+    if (v >= -32)
+        return put1(w, (uint8_t)(v & 0xFF));
+    if (v >= -0x80)
+        return put1(w, 0xD0) < 0 || put1(w, (uint8_t)(int8_t)v) < 0 ? -1 : 0;
+    if (v >= -0x8000)
+        return put1(w, 0xD1) < 0 || put_be16(w, (uint16_t)(int16_t)v) < 0 ? -1 : 0;
+    if (v >= -0x80000000LL)
+        return put1(w, 0xD2) < 0 || put_be32(w, (uint32_t)(int32_t)v) < 0 ? -1 : 0;
+    return put1(w, 0xD3) < 0 || put_be64(w, (uint64_t)v) < 0 ? -1 : 0;
+}
+
+static int pack_str(Writer *w, PyObject *obj)
+{
+    Py_ssize_t n;
+    const char *raw = PyUnicode_AsUTF8AndSize(obj, &n);
+    if (!raw)
+        return -1;
+    if (n < 32) {
+        if (put1(w, (uint8_t)(0xA0 | n)) < 0)
+            return -1;
+    } else if (n < 0x100) {
+        if (put1(w, 0xD9) < 0 || put1(w, (uint8_t)n) < 0)
+            return -1;
+    } else if (n < 0x10000) {
+        if (put1(w, 0xDA) < 0 || put_be16(w, (uint16_t)n) < 0)
+            return -1;
+    } else {
+        if (put1(w, 0xDB) < 0 || put_be32(w, (uint32_t)n) < 0)
+            return -1;
+    }
+    return put(w, raw, n);
+}
+
+static int pack_bin(Writer *w, const uint8_t *raw, Py_ssize_t n)
+{
+    if (n < 0x100) {
+        if (put1(w, 0xC4) < 0 || put1(w, (uint8_t)n) < 0)
+            return -1;
+    } else if (n < 0x10000) {
+        if (put1(w, 0xC5) < 0 || put_be16(w, (uint16_t)n) < 0)
+            return -1;
+    } else {
+        if (put1(w, 0xC6) < 0 || put_be32(w, (uint32_t)n) < 0)
+            return -1;
+    }
+    return put(w, raw, n);
+}
+
+#define MAX_DEPTH 256
+
+static int pack_obj(Writer *w, PyObject *obj, int depth)
+{
+    if (depth > MAX_DEPTH) {
+        codec_error("msgpack nesting exceeds %d", MAX_DEPTH);
+        return -1;
+    }
+    if (obj == Py_None)
+        return put1(w, 0xC0);
+    if (obj == Py_True)
+        return put1(w, 0xC3);
+    if (obj == Py_False)
+        return put1(w, 0xC2);
+    if (PyLong_Check(obj))
+        return pack_long(w, obj);
+    if (PyFloat_Check(obj)) {
+        double d = PyFloat_AS_DOUBLE(obj);
+        uint64_t bits;
+        memcpy(&bits, &d, 8);
+        return put1(w, 0xCB) < 0 || put_be64(w, bits) < 0 ? -1 : 0;
+    }
+    if (PyUnicode_Check(obj))
+        return pack_str(w, obj);
+    if (PyBytes_Check(obj))
+        return pack_bin(w, (const uint8_t *)PyBytes_AS_STRING(obj), PyBytes_GET_SIZE(obj));
+    if (PyByteArray_Check(obj))
+        return pack_bin(w, (const uint8_t *)PyByteArray_AS_STRING(obj), PyByteArray_GET_SIZE(obj));
+    if (PyMemoryView_Check(obj)) {
+        Py_buffer *view = PyMemoryView_GET_BUFFER(obj);
+        if (!PyBuffer_IsContiguous(view, 'C')) {
+            codec_error("cannot msgpack non-contiguous memoryview");
+            return -1;
+        }
+        return pack_bin(w, (const uint8_t *)view->buf, view->len);
+    }
+    if (PyList_Check(obj) || PyTuple_Check(obj)) {
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(obj);
+        if (n < 16) {
+            if (put1(w, (uint8_t)(0x90 | n)) < 0)
+                return -1;
+        } else if (n < 0x10000) {
+            if (put1(w, 0xDC) < 0 || put_be16(w, (uint16_t)n) < 0)
+                return -1;
+        } else {
+            if (put1(w, 0xDD) < 0 || put_be32(w, (uint32_t)n) < 0)
+                return -1;
+        }
+        for (Py_ssize_t i = 0; i < n; i++)
+            if (pack_obj(w, PySequence_Fast_GET_ITEM(obj, i), depth + 1) < 0)
+                return -1;
+        return 0;
+    }
+    if (PyDict_Check(obj)) {
+        Py_ssize_t n = PyDict_GET_SIZE(obj);
+        if (n < 16) {
+            if (put1(w, (uint8_t)(0x80 | n)) < 0)
+                return -1;
+        } else if (n < 0x10000) {
+            if (put1(w, 0xDE) < 0 || put_be16(w, (uint16_t)n) < 0)
+                return -1;
+        } else {
+            if (put1(w, 0xDF) < 0 || put_be32(w, (uint32_t)n) < 0)
+                return -1;
+        }
+        PyObject *key, *value;
+        Py_ssize_t pos = 0;
+        while (PyDict_Next(obj, &pos, &key, &value)) {
+            if (pack_obj(w, key, depth + 1) < 0)
+                return -1;
+            if (pack_obj(w, value, depth + 1) < 0)
+                return -1;
+        }
+        return 0;
+    }
+    codec_error("cannot msgpack type %s", Py_TYPE(obj)->tp_name);
+    return -1;
+}
+
+static PyObject *codec_packb(PyObject *self, PyObject *obj)
+{
+    Writer w = {NULL, 0, 0};
+    if (pack_obj(&w, obj, 0) < 0) {
+        PyMem_Free(w.data);
+        return NULL;
+    }
+    PyObject *out = PyBytes_FromStringAndSize((const char *)w.data, w.len);
+    PyMem_Free(w.data);
+    return out;
+}
+
+/* ---------------------------------------------------------------- reader */
+
+typedef struct {
+    const uint8_t *data;
+    Py_ssize_t len;
+    Py_ssize_t pos;
+} Reader;
+
+static PyObject *read_obj(Reader *r, int depth);
+
+static inline int take(Reader *r, Py_ssize_t n, const uint8_t **out)
+{
+    if (r->pos + n > r->len) {
+        codec_error("truncated msgpack data");
+        return -1;
+    }
+    *out = r->data + r->pos;
+    r->pos += n;
+    return 0;
+}
+
+static inline int read_be(Reader *r, int n, uint64_t *out)
+{
+    const uint8_t *p;
+    if (take(r, n, &p) < 0)
+        return -1;
+    uint64_t v = 0;
+    for (int i = 0; i < n; i++)
+        v = (v << 8) | p[i];
+    *out = v;
+    return 0;
+}
+
+static PyObject *read_str(Reader *r, Py_ssize_t n)
+{
+    const uint8_t *p;
+    if (take(r, n, &p) < 0)
+        return NULL;
+    PyObject *s = PyUnicode_DecodeUTF8((const char *)p, n, NULL);
+    if (!s && PyErr_ExceptionMatches(PyExc_UnicodeDecodeError)) {
+        PyErr_Clear();
+        codec_error("malformed msgpack data: invalid utf-8");
+    }
+    return s;
+}
+
+static PyObject *read_bin(Reader *r, Py_ssize_t n)
+{
+    const uint8_t *p;
+    if (take(r, n, &p) < 0)
+        return NULL;
+    return PyBytes_FromStringAndSize((const char *)p, n);
+}
+
+static PyObject *read_array(Reader *r, Py_ssize_t n, int depth)
+{
+    if (depth > MAX_DEPTH)
+        return codec_error("msgpack nesting exceeds %d", MAX_DEPTH);
+    PyObject *list = PyList_New(n);
+    if (!list)
+        return NULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = read_obj(r, depth);
+        if (!item) {
+            Py_DECREF(list);
+            return NULL;
+        }
+        PyList_SET_ITEM(list, i, item);
+    }
+    return list;
+}
+
+static PyObject *read_map(Reader *r, Py_ssize_t n, int depth)
+{
+    if (depth > MAX_DEPTH)
+        return codec_error("msgpack nesting exceeds %d", MAX_DEPTH);
+    PyObject *dict = PyDict_New();
+    if (!dict)
+        return NULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *key = read_obj(r, depth);
+        if (!key) {
+            Py_DECREF(dict);
+            return NULL;
+        }
+        PyObject *value = read_obj(r, depth);
+        if (!value) {
+            Py_DECREF(key);
+            Py_DECREF(dict);
+            return NULL;
+        }
+        int rc = PyDict_SetItem(dict, key, value);
+        Py_DECREF(key);
+        Py_DECREF(value);
+        if (rc < 0) {
+            Py_DECREF(dict);
+            if (PyErr_ExceptionMatches(PyExc_TypeError)) { /* unhashable key */
+                PyErr_Clear();
+                return codec_error("malformed msgpack data: unhashable map key");
+            }
+            return NULL;
+        }
+    }
+    return dict;
+}
+
+static PyObject *read_obj(Reader *r, int depth)
+{
+    const uint8_t *p;
+    uint64_t u;
+    if (take(r, 1, &p) < 0)
+        return NULL;
+    uint8_t b = *p;
+    if (b < 0x80)
+        return PyLong_FromLong(b);
+    if (b >= 0xE0)
+        return PyLong_FromLong((long)b - 0x100);
+    if (b <= 0x8F)
+        return read_map(r, b & 0x0F, depth + 1);
+    if (b <= 0x9F)
+        return read_array(r, b & 0x0F, depth + 1);
+    if (b <= 0xBF)
+        return read_str(r, b & 0x1F);
+    switch (b) {
+    case 0xC0:
+        Py_RETURN_NONE;
+    case 0xC2:
+        Py_RETURN_FALSE;
+    case 0xC3:
+        Py_RETURN_TRUE;
+    case 0xC4:
+        if (read_be(r, 1, &u) < 0)
+            return NULL;
+        return read_bin(r, (Py_ssize_t)u);
+    case 0xC5:
+        if (read_be(r, 2, &u) < 0)
+            return NULL;
+        return read_bin(r, (Py_ssize_t)u);
+    case 0xC6:
+        if (read_be(r, 4, &u) < 0)
+            return NULL;
+        return read_bin(r, (Py_ssize_t)u);
+    case 0xCA: {
+        if (read_be(r, 4, &u) < 0)
+            return NULL;
+        uint32_t bits = (uint32_t)u;
+        float f;
+        memcpy(&f, &bits, 4);
+        return PyFloat_FromDouble((double)f);
+    }
+    case 0xCB: {
+        if (read_be(r, 8, &u) < 0)
+            return NULL;
+        double d;
+        memcpy(&d, &u, 8);
+        return PyFloat_FromDouble(d);
+    }
+    case 0xCC:
+        if (read_be(r, 1, &u) < 0)
+            return NULL;
+        return PyLong_FromUnsignedLongLong(u);
+    case 0xCD:
+        if (read_be(r, 2, &u) < 0)
+            return NULL;
+        return PyLong_FromUnsignedLongLong(u);
+    case 0xCE:
+        if (read_be(r, 4, &u) < 0)
+            return NULL;
+        return PyLong_FromUnsignedLongLong(u);
+    case 0xCF:
+        if (read_be(r, 8, &u) < 0)
+            return NULL;
+        return PyLong_FromUnsignedLongLong(u);
+    case 0xD0:
+        if (read_be(r, 1, &u) < 0)
+            return NULL;
+        return PyLong_FromLong((int8_t)u);
+    case 0xD1:
+        if (read_be(r, 2, &u) < 0)
+            return NULL;
+        return PyLong_FromLong((int16_t)u);
+    case 0xD2:
+        if (read_be(r, 4, &u) < 0)
+            return NULL;
+        return PyLong_FromLong((int32_t)u);
+    case 0xD3:
+        if (read_be(r, 8, &u) < 0)
+            return NULL;
+        return PyLong_FromLongLong((int64_t)u);
+    case 0xD9:
+        if (read_be(r, 1, &u) < 0)
+            return NULL;
+        return read_str(r, (Py_ssize_t)u);
+    case 0xDA:
+        if (read_be(r, 2, &u) < 0)
+            return NULL;
+        return read_str(r, (Py_ssize_t)u);
+    case 0xDB:
+        if (read_be(r, 4, &u) < 0)
+            return NULL;
+        return read_str(r, (Py_ssize_t)u);
+    case 0xDC:
+        if (read_be(r, 2, &u) < 0)
+            return NULL;
+        return read_array(r, (Py_ssize_t)u, depth + 1);
+    case 0xDD:
+        if (read_be(r, 4, &u) < 0)
+            return NULL;
+        return read_array(r, (Py_ssize_t)u, depth + 1);
+    case 0xDE:
+        if (read_be(r, 2, &u) < 0)
+            return NULL;
+        return read_map(r, (Py_ssize_t)u, depth + 1);
+    case 0xDF:
+        if (read_be(r, 4, &u) < 0)
+            return NULL;
+        return read_map(r, (Py_ssize_t)u, depth + 1);
+    default:
+        return codec_error("unsupported msgpack byte 0x%02x", b);
+    }
+}
+
+static PyObject *codec_unpackb(PyObject *self, PyObject *arg)
+{
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0)
+        return NULL;
+    Reader r = {(const uint8_t *)view.buf, view.len, 0};
+    PyObject *obj = read_obj(&r, 0);
+    if (obj && r.pos != r.len) {
+        Py_DECREF(obj);
+        obj = codec_error("trailing bytes after msgpack value: %zd", r.len - r.pos);
+    }
+    PyBuffer_Release(&view);
+    return obj;
+}
+
+static PyObject *codec_set_error_class(PyObject *self, PyObject *cls)
+{
+    Py_XINCREF(cls);
+    Py_XDECREF(error_class);
+    error_class = cls;
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef codec_methods[] = {
+    {"packb", codec_packb, METH_O, "Serialize an object to msgpack bytes."},
+    {"unpackb", codec_unpackb, METH_O, "Deserialize one msgpack value (consumes all bytes)."},
+    {"set_error_class", codec_set_error_class, METH_O, "Register the exception class raised on malformed input."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef codec_module = {
+    PyModuleDef_HEAD_INIT, "_zb_codec", "Native msgpack codec for zeebe_tpu records.", -1, codec_methods,
+};
+
+PyMODINIT_FUNC PyInit__zb_codec(void)
+{
+    return PyModule_Create(&codec_module);
+}

@@ -437,6 +437,87 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     return new_state, events
 
 
+def _pack_events(ev: dict, I: int, T: int) -> jax.Array:
+    """Pack one step's event pytree into a single int32 [T, 4 + 2*FO] tensor
+    (one device buffer per chunk transfer instead of ~11 — each host fetch
+    over the TPU tunnel pays per-buffer latency):
+
+      col 0: flags — bit0 full_pass, bit1 task_arrive, bit2 task_done,
+             bit3 no_match, bit4 newly_done (row t < I = instance t)
+      col 1: elem, col 2: inst, col 3: active count (row 0 only)
+      cols 4..4+FO: dest per flow slot (T = none)
+      cols 4+FO..4+2*FO: take_mask per flow slot
+    """
+    FO = ev["take_mask"].shape[1]
+    flags = (
+        ev["full_pass"].astype(jnp.int32)
+        | (ev["task_arrive"].astype(jnp.int32) << 1)
+        | (ev["task_done"].astype(jnp.int32) << 2)
+        | (ev["no_match"].astype(jnp.int32) << 3)
+    )
+    newly = jnp.zeros(T, jnp.int32).at[:I].set(ev["newly_done"].astype(jnp.int32))
+    flags = flags | (newly << 4)
+    return jnp.concatenate(
+        [
+            flags[:, None],
+            ev["elem"][:, None],
+            ev["inst"][:, None],
+            jnp.zeros((T, 1), jnp.int32).at[0, 0].set(ev["active"]),
+            ev["dest"].astype(jnp.int32),
+            ev["take_mask"].astype(jnp.int32),
+        ],
+        axis=1,
+    )
+
+
+def unpack_events(packed: np.ndarray, I: int) -> dict:
+    """Host-side inverse of _pack_events for one step row ([T, 4+2*FO])."""
+    FO = (packed.shape[1] - 4) // 2
+    flags = packed[:, 0]
+    return {
+        "full_pass": (flags & 1).astype(bool),
+        "task_arrive": (flags & 2).astype(bool),
+        "task_done": (flags & 4).astype(bool),
+        "no_match": (flags & 8).astype(bool),
+        "newly_done": (flags[:I] & 16).astype(bool),
+        "elem": packed[:, 1],
+        "inst": packed[:, 2],
+        "dest": packed[:, 4 : 4 + FO],
+        "take_mask": packed[:, 4 + FO :].astype(bool),
+    }
+
+
+@partial(jax.jit, static_argnames=("n_steps", "config"))
+def run_collect(tables: DeviceTables, state: dict, n_steps: int = 16, config=None):
+    """Advance ``n_steps`` lock-steps in ONE device program, stacking each
+    step's event tensors — the integration path's batched variant of calling
+    ``step(emit_events=True)`` in a host loop. A quiesced state is a fixed
+    point of ``step`` (no executing tokens → all masks false, no counters
+    move), so over-running costs idle FLOPs but never wrong events.
+
+    Returns (state', packed) where packed is ONE int32 [n_steps, T, 4+2*FO]
+    tensor (see _pack_events; decode per step with unpack_events). Row 0's
+    col 3 holds the post-step active-token count — the host checks
+    packed[-1, 0, 3] == 0 to decide whether another chunk is needed."""
+    I = state["def_of"].shape[0]
+    T = state["elem"].shape[0]
+
+    def body(state, _):
+        state, ev = step(tables, state, auto_jobs=False, emit_events=True, config=config)
+        ev["active"] = (
+            (state["elem"] >= 0)
+            & ((state["phase"] == PHASE_AT) | (state["phase"] == PHASE_DONE))
+        ).sum()
+        packed = _pack_events(ev, I, T)
+        # row 1 / col 3 is unused — carry the overflow flag so the host needs
+        # exactly one device fetch per chunk
+        packed = packed.at[1, 3].set(state["overflow"].astype(jnp.int32))
+        return state, packed
+
+    state, packed = jax.lax.scan(body, state, None, length=n_steps)
+    return state, packed
+
+
 @partial(jax.jit, static_argnames=("max_steps", "auto_jobs", "config"))
 def run_to_completion(tables: DeviceTables, state: dict, max_steps: int = 1000,
                       auto_jobs: bool = True, config=None):

@@ -41,7 +41,9 @@ def packb(obj: Any) -> bytes:
     return bytes(buf)
 
 
-def _pack(obj: Any, buf: bytearray) -> None:
+def _pack(obj: Any, buf: bytearray, depth: int = 0) -> None:
+    if depth > _MAX_DEPTH:
+        raise MsgPackError(f"msgpack nesting exceeds {_MAX_DEPTH}")
     if obj is None:
         buf.append(0xC0)
     elif obj is True:
@@ -92,7 +94,7 @@ def _pack(obj: Any, buf: bytearray) -> None:
             buf.append(0xDD)
             buf += _pack_u32(n)
         for item in obj:
-            _pack(item, buf)
+            _pack(item, buf, depth + 1)
     elif isinstance(obj, dict):
         n = len(obj)
         if n < 16:
@@ -104,8 +106,8 @@ def _pack(obj: Any, buf: bytearray) -> None:
             buf.append(0xDF)
             buf += _pack_u32(n)
         for k, v in obj.items():
-            _pack(k, buf)
-            _pack(v, buf)
+            _pack(k, buf, depth + 1)
+            _pack(v, buf, depth + 1)
     else:
         raise MsgPackError(f"cannot msgpack type {type(obj).__name__}")
 
@@ -254,3 +256,17 @@ def unpackb(data: bytes) -> Any:
     if r.pos != len(r.data):
         raise MsgPackError(f"trailing bytes after msgpack value: {len(r.data) - r.pos}")
     return obj
+
+
+# keep the pure-Python implementations importable under stable names (the
+# native parity tests and the ZEEBE_TPU_NO_NATIVE escape hatch use them)
+py_packb = packb
+py_unpackb = unpackb
+
+from zeebe_tpu import native as _native  # noqa: E402  (cycle-free leaf package)
+
+_codec = _native.load_codec()
+if _codec is not None:
+    _codec.set_error_class(MsgPackError)
+    packb = _codec.packb
+    unpackb = _codec.unpackb

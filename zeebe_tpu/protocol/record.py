@@ -71,11 +71,24 @@ class Record:
         return self.record_type == RecordType.COMMAND_REJECTION
 
     def replace(self, **kw: Any) -> "Record":
-        return dataclasses.replace(self, **kw)
+        # hand-rolled dataclasses.replace: this runs once per record on the
+        # append path (timestamp/request stamping) and dataclasses.replace's
+        # signature re-validation is ~4x the cost of the constructor call.
+        # _FIELDS is derived from the dataclass below so new fields can
+        # never be silently dropped.
+        current = {name: getattr(self, name) for name in _FIELDS}
+        current.update(kw)
+        return Record(**current)
 
     # -- serialization -------------------------------------------------------
 
     def to_bytes(self) -> bytes:
+        return self.encode()[0]
+
+    def encode(self) -> tuple[bytes, bytes]:
+        """Serialize; returns (frame, value_body) — the msgpack value bytes
+        are exposed so the append path can seed its decode cache without
+        re-packing the value."""
         reason = self.rejection_reason.encode("utf-8")
         if len(reason) > 0xFFFF:
             # the wire field is u16; truncate on a codepoint boundary so an
@@ -99,7 +112,7 @@ class Record:
             self.operation_reference,
             len(reason),
         )
-        return b"".join((header, reason, struct.pack("<I", len(body)), body))
+        return b"".join((header, reason, struct.pack("<I", len(body)), body)), body
 
     @classmethod
     def from_bytes(cls, data: bytes, position: int = NO_POSITION, partition_id: int = 0) -> "Record":
@@ -170,6 +183,9 @@ class Record:
             "operationReference": self.operation_reference,
             "value": dict(self.value),
         }
+
+
+_FIELDS = tuple(f.name for f in dataclasses.fields(Record))
 
 
 def command(value_type: ValueType, intent: Intent, value: Mapping[str, Any], **kw: Any) -> Record:

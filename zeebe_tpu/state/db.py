@@ -144,13 +144,20 @@ _DELETED = object()
 
 
 class Transaction:
-    """Pending puts/deletes overlaying the committed store."""
+    """Pending puts/deletes overlaying the committed store.
 
-    __slots__ = ("_db", "_writes", "closed")
+    ``_sorted_writes`` mirrors ``_writes``'s keys in sorted order (insort on
+    first write of a key) so prefix iteration is a bisect range over the
+    overlay instead of a full overlay scan — batch processing applies tens of
+    thousands of events in one transaction, and an O(pending-writes) cost per
+    ``iterate`` call turns the group quadratic."""
+
+    __slots__ = ("_db", "_writes", "_sorted_writes", "closed")
 
     def __init__(self, db: "ZbDb") -> None:
         self._db = db
         self._writes: dict[bytes, Any] = {}
+        self._sorted_writes: list[bytes] = []
         self.closed = False
 
     def get(self, key: bytes) -> Any:
@@ -160,9 +167,13 @@ class Transaction:
         return self._db._data.get(key)
 
     def put(self, key: bytes, value: Any) -> None:
+        if key not in self._writes:
+            insort(self._sorted_writes, key)
         self._writes[key] = value
 
     def delete(self, key: bytes) -> None:
+        if key not in self._writes:
+            insort(self._sorted_writes, key)
         self._writes[key] = _DELETED
 
     def exists(self, key: bytes) -> bool:
@@ -180,12 +191,23 @@ class Transaction:
         """
         db = self._db
         snapshot: list[tuple[bytes, Any]] = []
-        overlay = {k: v for k, v in self._writes.items() if k.startswith(prefix)}
+        writes = self._writes
+        lo = bisect_left(self._sorted_writes, prefix)
+        hi = bisect_left(
+            self._sorted_writes, prefix + b"\xff\xff\xff\xff\xff\xff\xff\xff\xff"
+        )
+        overlay_keys = [k for k in self._sorted_writes[lo:hi] if k.startswith(prefix)]
+        if not overlay_keys:
+            for key in db._keys_with_prefix(prefix):
+                snapshot.append((key, db._data[key]))
+            return iter(snapshot)
+        overlay = set(overlay_keys)
         for key in db._keys_with_prefix(prefix):
             if key in overlay:
                 continue  # superseded by pending write/delete
             snapshot.append((key, db._data[key]))
-        for key, val in overlay.items():
+        for key in overlay_keys:
+            val = writes[key]
             if val is not _DELETED:
                 snapshot.append((key, val))
         snapshot.sort(key=lambda kv: kv[0])

@@ -94,6 +94,7 @@ class StreamProcessor:
         clock = clock_millis or log_stream.clock_millis
         self.schedule_service = ProcessingScheduleService(clock, self._write_scheduled_commands)
         self._reader_position = 1
+        self._scan_hint = -1  # batch-slot cursor for the sequential scans
         self.last_processed_position = -1
         self.last_written_position = -1
 
@@ -170,7 +171,7 @@ class StreamProcessor:
     def _next_command(self) -> LoggedRecord | None:
         position = self._reader_position
         while True:
-            logged = self.log_stream.read_at_or_after(position)
+            logged, self._scan_hint = self.log_stream.read_with_hint(position, self._scan_hint)
             if logged is None:
                 self._reader_position = position
                 return None
@@ -184,7 +185,7 @@ class StreamProcessor:
         the kernel backend cannot be a candidate for. Does not consume."""
         position = self._reader_position
         while True:
-            logged = self.log_stream.read_at_or_after(position)
+            logged, self._scan_hint = self.log_stream.read_with_hint(position, self._scan_hint)
             if logged is None:
                 return
             position = logged.position + 1
