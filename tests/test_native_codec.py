@@ -126,3 +126,30 @@ def test_memoryview_and_bytearray():
     for obj in (bytearray(raw), memoryview(raw)):
         assert msgpack.packb(obj) == msgpack.py_packb(obj)
     assert msgpack.unpackb(memoryview(msgpack.packb(raw))) == raw
+
+
+def test_huge_claimed_container_raises_not_memoryerror():
+    # corrupt frames claiming billions of elements must fail fast as
+    # MsgPackError (the consumer contract), never MemoryError
+    for bad in (b"\xdd\x7f\xff\xff\xff", b"\xdf\x7f\xff\xff\xff",
+                b"\xdc\xff\xff", b"\xde\xff\xff"):
+        with pytest.raises(msgpack.MsgPackError):
+            msgpack.unpackb(bad)
+        with pytest.raises(msgpack.MsgPackError):
+            msgpack.py_unpackb(bad)
+
+
+def test_prefix_boundary_keys_visible_in_iterate():
+    # keys whose suffix sorts above prefix+9*0xff must still be seen by
+    # prefix iteration (committed and pending overlay alike)
+    from zeebe_tpu.state.db import ZbDb, ColumnFamilyCode
+
+    db = ZbDb()
+    cf = db.column_family(ColumnFamilyCode.VARIABLES)
+    big = (1 << 63) - 1  # sign-flipped encoding is 8x 0xff
+    with db.transaction():
+        cf.put((big, "a"), 1)
+    with db.transaction():
+        cf.put((big, "b"), 2)
+        keys = [k for k, _ in cf.items(())]
+        assert len(keys) == 2

@@ -313,6 +313,10 @@ static PyObject *read_array(Reader *r, Py_ssize_t n, int depth)
 {
     if (depth > MAX_DEPTH)
         return codec_error("msgpack nesting exceeds %d", MAX_DEPTH);
+    /* every element needs >= 1 byte: reject corrupt lengths before the
+     * allocation so malformed frames raise MsgPackError, not MemoryError */
+    if (n > r->len - r->pos)
+        return codec_error("truncated msgpack data");
     PyObject *list = PyList_New(n);
     if (!list)
         return NULL;
@@ -331,6 +335,8 @@ static PyObject *read_map(Reader *r, Py_ssize_t n, int depth)
 {
     if (depth > MAX_DEPTH)
         return codec_error("msgpack nesting exceeds %d", MAX_DEPTH);
+    if (n > (r->len - r->pos) / 2) /* each entry needs >= 2 bytes */
+        return codec_error("truncated msgpack data");
     PyObject *dict = PyDict_New();
     if (!dict)
         return NULL;

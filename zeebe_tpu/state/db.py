@@ -143,6 +143,19 @@ def encode_key(cf: ColumnFamilyCode, parts: tuple) -> bytes:
 _DELETED = object()
 
 
+def _prefix_successor(prefix: bytes) -> bytes | None:
+    """The smallest byte string greater than every string starting with
+    ``prefix`` (exact range upper bound for sorted-key bisects), or None when
+    no such bound exists (prefix is empty or all 0xff)."""
+    p = bytearray(prefix)
+    while p and p[-1] == 0xFF:
+        p.pop()
+    if not p:
+        return None
+    p[-1] += 1
+    return bytes(p)
+
+
 class Transaction:
     """Pending puts/deletes overlaying the committed store.
 
@@ -192,11 +205,11 @@ class Transaction:
         db = self._db
         snapshot: list[tuple[bytes, Any]] = []
         writes = self._writes
-        lo = bisect_left(self._sorted_writes, prefix)
-        hi = bisect_left(
-            self._sorted_writes, prefix + b"\xff\xff\xff\xff\xff\xff\xff\xff\xff"
-        )
-        overlay_keys = [k for k in self._sorted_writes[lo:hi] if k.startswith(prefix)]
+        sw = self._sorted_writes
+        lo = bisect_left(sw, prefix)
+        end = _prefix_successor(prefix)
+        hi = bisect_left(sw, end) if end is not None else len(sw)
+        overlay_keys = sw[lo:hi]
         if not overlay_keys:
             for key in db._keys_with_prefix(prefix):
                 snapshot.append((key, db._data[key]))
@@ -337,10 +350,9 @@ class ZbDb:
 
     def _keys_with_prefix(self, prefix: bytes) -> list[bytes]:
         lo = bisect_left(self._sorted_keys, prefix)
-        hi = bisect_left(self._sorted_keys, prefix + b"\xff\xff\xff\xff\xff\xff\xff\xff\xff")
-        keys = self._sorted_keys[lo:hi]
-        # conservative guard against the hi-bound heuristic overshooting
-        return [k for k in keys if k.startswith(prefix)]
+        end = _prefix_successor(prefix)
+        hi = bisect_left(self._sorted_keys, end) if end is not None else len(self._sorted_keys)
+        return self._sorted_keys[lo:hi]
 
     # -- transactions --------------------------------------------------------
 
