@@ -119,15 +119,19 @@ class _Gen:
         return b.end_event("end").done()
 
 
-def _random_vars(rng: random.Random) -> dict:
+def _random_vars(rng: random.Random, constant: bool = False) -> dict:
+    if constant:
+        # identical variables per instance → burst-template fingerprints
+        # collide → the production fast path actually serves (see _run_one)
+        return {"x": 7, "y": 3, "z": 11}
     return {name: rng.randint(0, 20) for name in VAR_NAMES if rng.random() < 0.8}
 
 
 def _drive(h: EngineHarness, model, pid: str, job_types: set[str],
-           rng: random.Random, instances: int) -> None:
+           rng: random.Random, instances: int, constant_vars: bool = False) -> None:
     h.deploy(model)
     for _ in range(instances):
-        h.create_instance(pid, variables=_random_vars(rng))
+        h.create_instance(pid, variables=_random_vars(rng, constant_vars))
     # run all jobs to exhaustion; completion payloads are keyed off the job
     # key so both runs (whose logs must be position/key-identical anyway)
     # derive the same values
@@ -167,23 +171,32 @@ def _fingerprint(h: EngineHarness) -> list:
 def _run_one(seed: int) -> None:
     gen_rng = random.Random(seed)
     gen = _Gen(gen_rng, f"rand_{seed}")
-    model = gen.build()  # built ONCE — both runs must deploy identical XML
+    model = gen.build()  # built ONCE — all runs must deploy identical XML
     instances = gen_rng.randint(1, 3)
+    # every 4th seed: constant variables + a THIRD run with template audit
+    # off, so the randomized suite also exercises the production fast path
+    # (instantiated bursts via append_prepatched) against the oracle
+    constant_vars = seed % 4 == 0
+    modes = ["seq", "audit"] + (["fast"] if constant_vars else [])
     logs = []
     stats = None
-    for use_kernel in (False, True):
-        h = EngineHarness(use_kernel_backend=use_kernel)
+    for mode in modes:
+        h = EngineHarness(use_kernel_backend=mode != "seq")
+        if mode == "fast":
+            h.kernel_backend.audit_templates = False
         try:
             _drive(h, model, gen.pid, gen.job_types_used,
-                   random.Random(seed + 1), instances)
+                   random.Random(seed + 1), instances, constant_vars)
             logs.append(_fingerprint(h))
-            if use_kernel:
+            if mode == "audit":
                 stats = (h.kernel_backend.groups_processed,
                          h.kernel_backend.commands_processed,
                          h.kernel_backend.fallbacks)
         finally:
             h.close()
-    seq_log, ker_log = logs
+    seq_log, ker_log = logs[0], logs[1]
+    if len(logs) == 3:
+        assert logs[2] == seq_log, f"seed {seed}: fast-path log diverges"
     if seq_log != ker_log:
         for i, (a, b) in enumerate(zip(seq_log, ker_log)):
             assert a == b, f"seed {seed}: first divergence at record {i}:\n  seq={a}\n  ker={b}"

@@ -1,0 +1,601 @@
+"""Burst templates: run-time codegen of a command's full record burst.
+
+The sequential materializer (kernel_backend's cascade + the head processors)
+is a deterministic function of a small input vector: the keys it mints, the
+command's correlation fields, the clock, and the instance-scoped state it
+reads. For a given *route* through a definition (the device-step trace) and a
+given byte-image of those state reads (the context fingerprint), its output —
+the serialized log batch, the state write-set, the client responses — is
+IDENTICAL up to substituting that input vector.
+
+So we capture it once per (definition, kind, trace, fingerprint): run the slow
+path with the inputs tagged (RoleInt) or registered by value (keys are unique
+ints ≥ 2^51, so value-equality identifies them unambiguously — equal ints are
+the same quantity), record where each input lands in the payload bytes / db
+keys / value objects, and replay every later identical-shaped command by
+patching a byte template — no Writers, no per-event appliers, no Record
+objects. This is the same trick the reference plays with SBE codegen
+(protocol/src/main/resources/protocol.xml): fixed layouts patched at
+runtime; here the layouts are derived from the engine itself at first use.
+
+Safety model:
+- the cache key pins the route (trace) AND every instance-scoped document the
+  slow path reads (fingerprint) — a command whose inputs differ in any
+  non-role byte can never hit a template built for another;
+- capture validates by re-instantiating with the capture inputs and requiring
+  byte-equality with the slow path's own serialization;
+- EngineHarness runs kernel backends in audit mode by default: every template
+  hit ALSO runs the slow path and asserts payload/state/response equality, so
+  the whole test suite (incl. the 120-process randomized parity suite)
+  continuously cross-checks the codegen against the interpreter.
+
+Reference seams: ProcessingStateMachine's writeRecords batch
+(stream-platform/…/ProcessingStateMachine.java:495), SBE codegen
+(protocol.xml), StateWriter lock-step apply (StateWriter.java:11).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from zeebe_tpu.protocol import msgpack
+from zeebe_tpu.state.db import ColumnFamilyCode
+
+# record header layout (protocol/record.py _HEADER = "<BBBBqqqiqqH")
+_REC_KEY_OFF = 4
+_REC_SOURCE_OFF = 12
+_REC_TS_OFF = 20
+_REC_STREAM_OFF = 28
+_REC_REQ_OFF = 32
+_REC_OPREF_OFF = 40
+_REC_REASON_LEN_OFF = 48
+_REC_HEADER_SIZE = 50
+_BATCH_HEADER = struct.Struct("<IqQ")
+_ENTRY_HEADER = struct.Struct("<BqI")
+
+_PACK_LE_Q = struct.Struct("<q")
+_PACK_LE_I = struct.Struct("<i")
+_PACK_BE_Q = struct.Struct(">Q")
+
+_ROLE_VALUE_MIN = 1 << 32  # below this, only explicit RoleInt tagging counts
+
+
+class RoleInt(int):
+    """An int carrying its provenance ('which template input am I').
+
+    (int subclasses cannot use nonempty __slots__, so instances carry a dict —
+    they only exist transiently during capture/audit runs.)"""
+
+    def __new__(cls, value: int, role: tuple):
+        obj = super().__new__(cls, value)
+        obj.role = role
+        return obj
+
+
+class _RoleSlot:
+    """Sentinel standing in for a role inside a template value object."""
+
+    __slots__ = ("role",)
+
+    def __init__(self, role: tuple) -> None:
+        self.role = role
+
+    def __repr__(self) -> str:  # debugging clarity only
+        return f"<role {self.role}>"
+
+
+class NotTemplatable(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# role resolution
+
+
+def _role_of(v: Any, role_map: dict[int, tuple]) -> tuple | None:
+    if isinstance(v, RoleInt):
+        return v.role
+    if isinstance(v, int) and not isinstance(v, bool) and v >= _ROLE_VALUE_MIN:
+        return role_map.get(int(v))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# msgpack serialization with role-offset tracking (mirrors msgpack._pack; the
+# parity invariant is enforced by the capture-time byte-equality check against
+# the slow path's own codec output)
+
+_pack_f64 = struct.Struct(">d").pack
+_pack_u16 = struct.Struct(">H").pack
+_pack_u32 = struct.Struct(">I").pack
+_pack_u64 = struct.Struct(">Q").pack
+_pack_i8 = struct.Struct(">b").pack
+_pack_i16 = struct.Struct(">h").pack
+_pack_i32 = struct.Struct(">i").pack
+_pack_i64 = struct.Struct(">q").pack
+
+
+def _pack_with_roles(obj: Any, buf: bytearray, patches: list, role_map: dict) -> None:
+    role = _role_of(obj, role_map)
+    if role is not None:
+        v = int(obj)
+        if not (0 <= v < 1 << 64) or v < _ROLE_VALUE_MIN:
+            raise NotTemplatable(f"role int out of patchable range: {v}")
+        buf.append(0xCF)
+        patches.append((len(buf), "be_q", role))
+        buf += _pack_u64(v)
+        return
+    if obj is None:
+        buf.append(0xC0)
+    elif obj is True:
+        buf.append(0xC3)
+    elif obj is False:
+        buf.append(0xC2)
+    elif isinstance(obj, int):
+        _pack_int_plain(obj, buf)
+    elif isinstance(obj, float):
+        buf.append(0xCB)
+        buf += _pack_f64(obj)
+    elif isinstance(obj, str):
+        raw = obj.encode("utf-8")
+        n = len(raw)
+        if n < 32:
+            buf.append(0xA0 | n)
+        elif n < 0x100:
+            buf.append(0xD9)
+            buf.append(n)
+        elif n < 0x10000:
+            buf.append(0xDA)
+            buf += _pack_u16(n)
+        else:
+            buf.append(0xDB)
+            buf += _pack_u32(n)
+        buf += raw
+    elif isinstance(obj, (bytes, bytearray, memoryview)):
+        raw = bytes(obj)
+        n = len(raw)
+        if n < 0x100:
+            buf.append(0xC4)
+            buf.append(n)
+        elif n < 0x10000:
+            buf.append(0xC5)
+            buf += _pack_u16(n)
+        else:
+            buf.append(0xC6)
+            buf += _pack_u32(n)
+        buf += raw
+    elif isinstance(obj, (list, tuple)):
+        n = len(obj)
+        if n < 16:
+            buf.append(0x90 | n)
+        elif n < 0x10000:
+            buf.append(0xDC)
+            buf += _pack_u16(n)
+        else:
+            buf.append(0xDD)
+            buf += _pack_u32(n)
+        for item in obj:
+            _pack_with_roles(item, buf, patches, role_map)
+    elif isinstance(obj, dict):
+        n = len(obj)
+        if n < 16:
+            buf.append(0x80 | n)
+        elif n < 0x10000:
+            buf.append(0xDE)
+            buf += _pack_u16(n)
+        else:
+            buf.append(0xDF)
+            buf += _pack_u32(n)
+        for k, v in obj.items():
+            _pack_with_roles(k, buf, patches, role_map)
+            _pack_with_roles(v, buf, patches, role_map)
+    else:
+        raise NotTemplatable(f"cannot template msgpack type {type(obj).__name__}")
+
+
+def _pack_int_plain(v: int, buf: bytearray) -> None:
+    if v >= 0:
+        if v < 0x80:
+            buf.append(v)
+        elif v < 0x100:
+            buf.append(0xCC)
+            buf.append(v)
+        elif v < 0x10000:
+            buf.append(0xCD)
+            buf += _pack_u16(v)
+        elif v < 0x100000000:
+            buf.append(0xCE)
+            buf += _pack_u32(v)
+        else:
+            buf.append(0xCF)
+            buf += _pack_u64(v)
+    else:
+        if v >= -32:
+            buf.append(v & 0xFF)
+        elif v >= -0x80:
+            buf.append(0xD0)
+            buf += _pack_i8(v)
+        elif v >= -0x8000:
+            buf.append(0xD1)
+            buf += _pack_i16(v)
+        elif v >= -0x80000000:
+            buf.append(0xD2)
+            buf += _pack_i32(v)
+        else:
+            buf.append(0xD3)
+            buf += _pack_i64(v)
+
+
+# ---------------------------------------------------------------------------
+# value-object templating (state writes, response record values)
+
+
+def _templatize_value(obj: Any, role_map: dict):
+    """Replace role ints with _RoleSlot sentinels; returns (template, n_roles)."""
+    role = _role_of(obj, role_map)
+    if role is not None:
+        return _RoleSlot(role), 1
+    if isinstance(obj, dict):
+        n = 0
+        out = {}
+        for k, v in obj.items():
+            kt, nk = _templatize_value(k, role_map)
+            vt, nv = _templatize_value(v, role_map)
+            out[k if nk == 0 else kt] = vt
+            n += nk + nv
+        return out, n
+    if isinstance(obj, (list, tuple)):
+        items = []
+        n = 0
+        for v in obj:
+            vt, nv = _templatize_value(v, role_map)
+            items.append(vt)
+            n += nv
+        return (items if isinstance(obj, list) else tuple(items)), n
+    if isinstance(obj, RoleInt):  # small tagged int (request ids)
+        return _RoleSlot(obj.role), 1
+    return obj, 0
+
+
+def _build_value(template: Any, resolve: Callable[[tuple], int]):
+    """Instantiate a templatized value object."""
+    if isinstance(template, _RoleSlot):
+        return resolve(template.role)
+    if isinstance(template, dict):
+        return {
+            (_build_value(k, resolve) if isinstance(k, _RoleSlot) else k): _build_value(v, resolve)
+            for k, v in template.items()
+        }
+    if isinstance(template, list):
+        return [_build_value(v, resolve) for v in template]
+    if isinstance(template, tuple):
+        return tuple(_build_value(v, resolve) for v in template)
+    return template
+
+
+# ---------------------------------------------------------------------------
+# encoded-db-key templating (keys are self-describing: type-tagged parts)
+
+
+def _templatize_db_key(enc: bytes, role_map: dict) -> tuple[bytes, list]:
+    """Parse an encoded state key; return (bytes, [(offset, role)]) patching
+    int parts whose value is a role. Layout per state/db._encode_part:
+    u16 cf | parts, each 0x01+BE-u64(sign-flipped) | 0x02+utf8+NUL |
+    0x03+BE-u64-len+bytes."""
+    patches = []
+    off = 2
+    n = len(enc)
+    while off < n:
+        tag = enc[off]
+        off += 1
+        if tag == 0x01:
+            raw = _PACK_BE_Q.unpack_from(enc, off)[0]
+            v = raw ^ 0x8000000000000000
+            if v >= 1 << 63:
+                v -= 1 << 64
+            role = role_map.get(v) if v >= _ROLE_VALUE_MIN else None
+            if role is not None:
+                patches.append((off, role))
+            off += 8
+        elif tag == 0x02:
+            end = enc.index(b"\x00", off)
+            off = end + 1
+        elif tag == 0x03:
+            length = _PACK_BE_Q.unpack_from(enc, off)[0]
+            off += 8 + length
+        else:
+            raise NotTemplatable(f"unknown key part tag 0x{tag:02x}")
+    return enc, patches
+
+
+# ---------------------------------------------------------------------------
+# the template
+
+
+@dataclass
+class StateOp:
+    op: str  # "put" | "del"
+    key: bytes
+    key_patches: list  # [(offset, role)]
+    value_template: Any = None
+    # fast value rebuild: when the value round-trips the codec exactly, it is
+    # stored as msgpack bytes + patch offsets and rebuilt with one C unpack —
+    # also guaranteeing a FRESH object per instantiation (the engine mutates
+    # state values in place, so sharing a template object would corrupt
+    # every instance that hit the template)
+    value_bytes: bytes | None = None
+    value_byte_patches: list = field(default_factory=list)
+
+    def build_value(self, resolve: Callable[[tuple], int]):
+        if self.value_bytes is not None:
+            if self.value_byte_patches:
+                buf = bytearray(self.value_bytes)
+                for off, _fmt, role in self.value_byte_patches:
+                    _PACK_BE_Q.pack_into(buf, off, resolve(role) & 0xFFFFFFFFFFFFFFFF)
+                return msgpack.unpackb(bytes(buf))
+            return msgpack.unpackb(self.value_bytes)
+        return _build_value(self.value_template, resolve)
+
+
+@dataclass
+class ResponseTemplate:
+    extra: bool  # False → with_response, True → add_response (await-result)
+    header: dict  # field → constant or _RoleSlot
+    value_template: Any = None
+    stream_role: Any = None  # constant int or _RoleSlot
+    req_role: Any = None
+
+
+@dataclass
+class PreparedBurst:
+    """An instantiated template, ready for the writer: the payload needs only
+    position/timestamp patching inside the append lock."""
+
+    buf: bytearray
+    pos_offsets: list[int]
+    ts_offsets: list[int]
+    count: int
+    responses: list  # [(extra, Record, request_stream_id, request_id)]
+    has_pending_commands: bool = False
+
+
+@dataclass
+class BurstTemplate:
+    """Everything needed to replay one command's burst by patching."""
+
+    payload: bytes
+    count: int  # records in the batch
+    pos_offsets: list[int]  # entry-header position fields (first_position + i)
+    ts_offsets: list[int]  # batch header + per-record timestamp fields
+    role_patches: list  # [(offset, fmt, role)] fmt ∈ {"be_q","le_q","le_i"}
+    mint_count: int
+    state_ops: list[StateOp] = field(default_factory=list)
+    responses: list[ResponseTemplate] = field(default_factory=list)
+    has_pending_commands: bool = False
+
+    def instantiate_payload(self, resolve: Callable[[tuple], int]) -> bytearray:
+        buf = bytearray(self.payload)
+        for off, fmt, role in self.role_patches:
+            v = resolve(role)
+            if fmt == "be_q":
+                _PACK_BE_Q.pack_into(buf, off, v & 0xFFFFFFFFFFFFFFFF)
+            elif fmt == "le_q":
+                _PACK_LE_Q.pack_into(buf, off, v)
+            else:
+                _PACK_LE_I.pack_into(buf, off, v)
+        return buf
+
+    def apply_state(self, txn, resolve: Callable[[tuple], int]) -> None:
+        for op in self.state_ops:
+            if op.key_patches:
+                key = bytearray(op.key)
+                for off, role in op.key_patches:
+                    _PACK_BE_Q.pack_into(
+                        key, off, (resolve(role) & 0xFFFFFFFFFFFFFFFF) ^ 0x8000000000000000
+                    )
+                key = bytes(key)
+            else:
+                key = op.key
+            if op.op == "put":
+                txn.put(key, op.build_value(resolve))
+            else:
+                txn.delete(key)
+
+    def build_responses(self, resolve: Callable[[tuple], int]):
+        from zeebe_tpu.protocol.record import Record
+
+        out = []
+        for rt in self.responses:
+            fields = {
+                k: (resolve(v.role) if isinstance(v, _RoleSlot) else v)
+                for k, v in rt.header.items()
+            }
+            fields["value"] = _build_value(rt.value_template, resolve)
+            rec = Record(**fields)
+            stream = resolve(rt.stream_role.role) if isinstance(rt.stream_role, _RoleSlot) else rt.stream_role
+            req = resolve(rt.req_role.role) if isinstance(rt.req_role, _RoleSlot) else rt.req_role
+            out.append((rt.extra, rec, stream, req))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# capture
+
+
+def build_template(
+    builder,
+    state_log: list,
+    role_map: dict[int, tuple],
+    mint_count: int,
+    partition_id: int,
+) -> BurstTemplate:
+    """Build a BurstTemplate from one slow-path materialization: the result
+    builder (records + responses) and the transaction's write capture log.
+    Raises NotTemplatable when anything resists the role model."""
+    if builder.post_commit_tasks:
+        raise NotTemplatable("post-commit tasks cannot be templated")
+
+    # ---- payload: batch header + per-entry header + record frames ----------
+    payload = bytearray(_BATCH_HEADER.pack(len(builder.follow_ups), -1, 0))
+    pos_offsets: list[int] = []
+    ts_offsets: list[int] = [12]  # batch header timestamp
+    role_patches: list = [(4, "le_q", ("source_position",))]
+    for fu in builder.follow_ups:
+        rec = fu.record
+        if rec.rejection_reason and len(rec.rejection_reason.encode("utf-8")) > 0xFFFF:
+            raise NotTemplatable("oversized rejection reason")
+        body = bytearray()
+        body_patches: list = []
+        _pack_with_roles(dict(rec.value), body, body_patches, role_map)
+        reason = rec.rejection_reason.encode("utf-8")
+        entry_off = len(payload)
+        rec_off = entry_off + _ENTRY_HEADER.size
+        rec_len = _REC_HEADER_SIZE + len(reason) + 4 + len(body)
+        payload += _ENTRY_HEADER.pack(1 if fu.processed else 0, 0, rec_len)
+        pos_offsets.append(entry_off + 1)
+        header = struct.pack(
+            "<BBBBqqqiqqH",
+            int(rec.record_type),
+            int(rec.value_type),
+            int(rec.intent),
+            int(rec.rejection_type),
+            int(rec.key),
+            int(rec.source_record_position),
+            0,  # timestamp patched at append
+            int(rec.request_stream_id),
+            int(rec.request_id),
+            int(rec.operation_reference),
+            len(reason),
+        )
+        payload += header
+        # header field roles
+        for value, off, fmt in (
+            (rec.key, _REC_KEY_OFF, "le_q"),
+            (rec.source_record_position, _REC_SOURCE_OFF, "le_q"),
+            (rec.request_stream_id, _REC_STREAM_OFF, "le_i"),
+            (rec.request_id, _REC_REQ_OFF, "le_q"),
+            (rec.operation_reference, _REC_OPREF_OFF, "le_q"),
+        ):
+            role = _role_of(value, role_map)
+            if role is not None:
+                role_patches.append((rec_off + off, fmt, role))
+        ts_offsets.append(rec_off + _REC_TS_OFF)
+        payload += reason
+        payload += struct.pack("<I", len(body))
+        body_base = len(payload)
+        for boff, fmt, role in body_patches:
+            role_patches.append((body_base + boff, fmt, role))
+        payload += body
+
+    # ---- state ops ---------------------------------------------------------
+    # collapse to the final op per key: instantiation replays ops blindly
+    # (no reads in between), so only the last write to each key matters —
+    # slow-path bursts touch the same element-instance row once per lifecycle
+    # event, and replaying every intermediate version would dominate the fast
+    # path
+    final_ops: dict[bytes, tuple] = {}
+    for op, enc_key, value in state_log:
+        cf = struct.unpack_from(">H", enc_key, 0)[0]
+        if cf == int(ColumnFamilyCode.KEY):
+            continue  # replaced by the single bulk-mint write at instantiation
+        if enc_key in final_ops:
+            del final_ops[enc_key]  # re-insert to keep last-write order
+        final_ops[enc_key] = (op, value)
+    state_ops: list[StateOp] = []
+    for enc_key, (op, value) in final_ops.items():
+        key_bytes, key_patches = _templatize_db_key(enc_key, role_map)
+        if op != "put":
+            state_ops.append(StateOp("del", key_bytes, key_patches))
+            continue
+        entry = StateOp("put", key_bytes, key_patches)
+        # prefer the bytes rebuild when the value survives the codec exactly
+        try:
+            vbuf = bytearray()
+            vpatches: list = []
+            _pack_with_roles(value, vbuf, vpatches, role_map)
+            if msgpack.unpackb(bytes(vbuf)) == value:
+                entry.value_bytes = bytes(vbuf)
+                entry.value_byte_patches = vpatches
+            else:
+                raise NotTemplatable("value not codec-stable")
+        except (NotTemplatable, msgpack.MsgPackError):
+            vt, _n = _templatize_value(value, role_map)
+            entry.value_template = vt
+        state_ops.append(entry)
+
+    # ---- responses ---------------------------------------------------------
+    responses: list[ResponseTemplate] = []
+    all_responses = ([] if builder.response is None else [(False, builder.response)]) + [
+        (True, r) for r in builder.extra_responses
+    ]
+    for extra, resp in all_responses:
+        rec = resp.record
+        header: dict[str, Any] = {}
+        for name in (
+            "record_type", "value_type", "intent", "key", "position",
+            "source_record_position", "timestamp", "partition_id",
+            "rejection_type", "rejection_reason", "request_stream_id",
+            "request_id", "operation_reference",
+        ):
+            v = getattr(rec, name)
+            role = _role_of(v, role_map)
+            header[name] = _RoleSlot(role) if role is not None else v
+        vt, _ = _templatize_value(dict(rec.value), role_map)
+        stream_role = _role_of(resp.request_stream_id, role_map)
+        req_role = _role_of(resp.request_id, role_map)
+        responses.append(
+            ResponseTemplate(
+                extra=extra,
+                header=header,
+                value_template=vt,
+                stream_role=(
+                    _RoleSlot(stream_role) if stream_role is not None else int(resp.request_stream_id)
+                ),
+                req_role=_RoleSlot(req_role) if req_role is not None else int(resp.request_id),
+            )
+        )
+
+    return BurstTemplate(
+        payload=bytes(payload),
+        count=len(builder.follow_ups),
+        pos_offsets=pos_offsets,
+        ts_offsets=ts_offsets,
+        role_patches=role_patches,
+        mint_count=mint_count,
+        state_ops=state_ops,
+        responses=responses,
+        has_pending_commands=any(
+            f.record.is_command and not f.processed for f in builder.follow_ups
+        ),
+    )
+
+
+def serialize_reference(builder, first_position: int, source_position: int, timestamp: int) -> bytes:
+    """The slow path's own serialization of the builder (for capture-time
+    byte-equality validation of a freshly built template)."""
+    from zeebe_tpu.logstreams.log_stream import LogAppendEntry, _serialize_batch
+
+    entries = [LogAppendEntry(f.record, f.processed) for f in builder.follow_ups]
+    return _serialize_batch(entries, first_position, source_position, timestamp)
+
+
+def validate_template(template: BurstTemplate, builder, resolve: Callable[[tuple], int]) -> None:
+    """Instantiate with the capture inputs and require byte-equality with the
+    slow path's serializer output for synthetic position/timestamp."""
+    synth_pos, synth_src, synth_ts = 977_717, 977_713, 1_234_567_890_123
+
+    def resolve_with_synth(role: tuple) -> int:
+        if role == ("source_position",):
+            return synth_src
+        return resolve(role)
+
+    buf = template.instantiate_payload(resolve_with_synth)
+    for i, off in enumerate(template.pos_offsets):
+        _PACK_LE_Q.pack_into(buf, off, synth_pos + i)
+    for off in template.ts_offsets:
+        _PACK_LE_Q.pack_into(buf, off, synth_ts)
+    expected = serialize_reference(builder, synth_pos, synth_src, synth_ts)
+    if bytes(buf) != expected:
+        raise NotTemplatable("template instantiation does not reproduce the slow path bytes")

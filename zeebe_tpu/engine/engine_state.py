@@ -1019,6 +1019,16 @@ class EngineState:
         self._key_cf.put(("next",), self.key_generator.current)
         return key
 
+    def bulk_mint(self, count: int) -> list[int]:
+        """Mint ``count`` keys with a single generator-state write (the burst
+        template fast path: same final generator state as ``count`` next_key
+        calls, one CF put instead of ``count``)."""
+        gen = self.key_generator
+        mints = [gen.next_key() for _ in range(count)]
+        if count:
+            self._key_cf.put(("next",), gen.current)
+        return mints
+
     def observe_key(self, key: int) -> None:
         """Replay path: fast-forward the generator past keys seen in events."""
         self.key_generator.set_key_if_higher(key)

@@ -63,6 +63,10 @@ _PHASE_AT = 0
 _PHASE_WAIT = 1
 _PHASE_DONE = 2
 
+# below this, ints are never treated as keys by value (burst_templates)
+_ROLE_VALUE_MIN = 1 << 32
+_MISSING = object()
+
 _CANDIDATE_COMMANDS = {
     (ValueType.PROCESS_INSTANCE_CREATION, int(ProcessInstanceCreationIntent.CREATE)),
     (ValueType.JOB, int(JobIntent.COMPLETE)),
@@ -212,6 +216,13 @@ class _Admitted:
     cmd: Any  # LoggedRecord
     inst: _Inst
     resume_token: _Token | None = None  # job complete: the PHASE_DONE token
+    kind: str = "c"  # "c" creation | "j" job complete
+    # instance-scoped documents the head processors will read — the burst
+    # template's context fingerprint is computed over these (role-normalized)
+    fp_docs: list | None = None
+    # False → this command must not ride a burst template (e.g. it touches
+    # engine.await_results, which lives outside the captured state store)
+    templatable: bool = True
 
 
 class KernelBackend:
@@ -219,16 +230,28 @@ class KernelBackend:
     sequential-equivalent record stream. One instance per partition."""
 
     def __init__(self, engine, max_group: int = 256, max_steps: int = 4096,
-                 chunk_steps: int = 16) -> None:
+                 chunk_steps: int = 16, use_templates: bool = True,
+                 audit_templates: bool = False) -> None:
         self.engine = engine
         self.registry = KernelRegistry()
         self.max_group = max_group
         self.max_steps = max_steps
         self.chunk_steps = chunk_steps
+        # burst templates (engine/burst_templates.py): replay a command's
+        # whole record burst by patching a captured byte template. audit mode
+        # (tests) shadows every template hit with the slow path and asserts
+        # byte/state/response equality instead of serving the fast result.
+        self.use_templates = use_templates
+        self.audit_templates = audit_templates
+        self._templates: dict = {}
+        self._template_cache_limit = 1024
         # observability
         self.groups_processed = 0
         self.commands_processed = 0
         self.fallbacks = 0
+        self.template_hits = 0
+        self.template_misses = 0
+        self.template_audits = 0
 
     # -- candidate test (no state access) ----------------------------------
 
@@ -277,7 +300,9 @@ class KernelBackend:
                 return None
             slots[name] = float(v)
         inst = _Inst(idx=len(instances), info=info, new=True, meta=meta, slots=slots)
-        return _Admitted(cmd=cmd, inst=inst)
+        templatable = not (value.get("awaitResult") and cmd.record.request_id >= 0)
+        return _Admitted(cmd=cmd, inst=inst, kind="c",
+                         fp_docs=[dict(value), meta], templatable=templatable)
 
     def _admit_job_complete(self, cmd, instances) -> _Admitted | None:
         state = self.engine.state
@@ -344,7 +369,19 @@ class KernelBackend:
             slots[name] = float(v)
         inst = _Inst(idx=len(instances), info=info, new=False, pi_key=pi_key,
                      tokens=tokens, join_counts=join_counts, slots=slots)
-        return _Admitted(cmd=cmd, inst=inst, resume_token=resume)
+        root_value = dict(root["value"])
+        return _Admitted(
+            cmd=cmd, inst=inst, resume_token=resume, kind="j",
+            fp_docs=[
+                dict(cmd.record.value),
+                dict(job),
+                root_value,
+                [dict(t.value) for t in tokens],
+                sorted(merged.items()),
+                sorted(join_counts.items()),
+            ],
+            templatable=pi_key not in self.engine.await_results,
+        )
 
     # -- device run ----------------------------------------------------------
 
@@ -453,9 +490,12 @@ class KernelBackend:
     def process_group(self, cmds, make_builder: Callable[[], Any]) -> tuple[list, list]:
         """Pull commands from the ``cmds`` iterator while they admit (lazy: a
         non-admittable head costs one log read, not a full peek), run the
-        kernel, and materialize each admitted command's record burst into its
-        own result builder. Returns (admitted_cmds, builders); an empty list
-        means the caller should process the head command sequentially.
+        kernel, and materialize each admitted command's record burst — either
+        through a burst template (fast path: patched bytes + state deltas) or
+        through the Writers/appliers slow path (which doubles as template
+        capture). Returns (admitted_cmds, results) where each result is a
+        ProcessingResultBuilder or a PreparedBurst; empty lists mean the
+        caller should process the head command sequentially.
 
         Must run inside the partition's open db transaction."""
         instances: dict[int, _Inst] = {}
@@ -476,20 +516,260 @@ class KernelBackend:
             self.fallbacks += 1
             return [], []
 
-        from zeebe_tpu.engine.writers import Writers
-
-        builders = []
+        results = []
         for adm in admitted:
-            builder = make_builder()
-            writers = Writers(builder, self.engine.appliers)
-            if adm.inst.new:
-                self._materialize_creation(adm, steps, writers, builder)
-            else:
-                self._materialize_job_complete(adm, steps, writers, builder)
-            builders.append(builder)
+            ops = self._cascade_ops(adm.inst, steps)
+            results.append(self._materialize(adm, ops, make_builder))
         self.groups_processed += 1
         self.commands_processed += len(admitted)
-        return [a.cmd for a in admitted], builders
+        return [a.cmd for a in admitted], results
+
+    # -- template routing ----------------------------------------------------
+
+    def _materialize(self, adm: _Admitted, ops: list, make_builder):
+        from zeebe_tpu.engine import burst_templates as bt
+        from zeebe_tpu.engine.writers import Writers
+
+        template = None
+        key = None
+        if self.use_templates and adm.templatable:
+            key = (adm.kind, adm.inst.info.index, tuple(ops),
+                   self._fingerprint(adm))
+            template = self._templates.get(key, _MISSING)
+            if template is _MISSING:
+                template = None
+                miss = True
+            else:
+                miss = False
+                # move-to-end so eviction (oldest-half sweep) drops cold
+                # entries, not the hottest templates
+                del self._templates[key]
+                self._templates[key] = template
+            if template is not None and not self.audit_templates:
+                self.template_hits += 1
+                return self._instantiate(template, adm)
+        else:
+            miss = False
+
+        # slow path (also: template capture on first miss, audit on hit)
+        capture = self.use_templates and adm.templatable and miss
+        txn = self.engine.state.db.require_transaction()
+        state = self.engine.state
+        role_map, wrapped = self._roles_for(adm)
+        mints: list[int] = []
+        orig_next_key = state.next_key
+        if capture or (template is not None and self.audit_templates):
+            def tagged_next_key():
+                v = orig_next_key()
+                mints.append(v)
+                return v
+            state.next_key = tagged_next_key
+            txn.capture = cap_log = []
+        builder = make_builder()
+        writers = Writers(builder, self.engine.appliers)
+        try:
+            if adm.inst.new:
+                self._materialize_creation(wrapped, adm, ops, writers, builder)
+            else:
+                self._materialize_job_complete(wrapped, adm, ops, writers, builder)
+        finally:
+            if capture or (template is not None and self.audit_templates):
+                state.next_key = orig_next_key
+                txn.capture = None
+        if capture:
+            self.template_misses += 1
+            for i, v in enumerate(mints):
+                if v in role_map:
+                    role_map = None  # role collision → not templatable
+                    break
+                role_map[v] = ("mint", i)
+            if role_map is not None:
+                try:
+                    tmpl = bt.build_template(
+                        builder, cap_log, role_map, len(mints),
+                        state.partition_id,
+                    )
+                    bt.validate_template(tmpl, builder, self._resolver(adm, mints))
+                    self._store_template(key, tmpl)
+                except bt.NotTemplatable as exc:
+                    logger.debug("trace not templatable: %s", exc)
+                    self._store_template(key, None)
+            else:
+                self._store_template(key, None)
+        elif template is not None and self.audit_templates:
+            self.template_audits += 1
+            self._audit_template(template, adm, builder, cap_log, mints)
+        return builder
+
+    def _store_template(self, key, template) -> None:
+        cache = self._templates
+        if len(cache) >= self._template_cache_limit:
+            for k in list(cache)[: self._template_cache_limit // 2]:
+                del cache[k]
+        cache[key] = template
+
+    def _fingerprint(self, adm: _Admitted) -> bytes:
+        """Byte image of the instance-scoped documents the slow path reads,
+        with role values (keys known at admission) normalized away so two
+        commands differing only in key identity fingerprint equal."""
+        from zeebe_tpu.protocol.msgpack import packb
+
+        roles = {}
+        inst = adm.inst
+        if inst.pi_key >= _ROLE_VALUE_MIN:
+            roles[inst.pi_key] = "p"
+        for j, tok in enumerate(inst.tokens):
+            if tok.key >= _ROLE_VALUE_MIN:
+                roles[tok.key] = f"t{j}"
+        if adm.cmd.record.key >= _ROLE_VALUE_MIN:
+            roles[adm.cmd.record.key] = "k"
+
+        def norm(obj):
+            if isinstance(obj, bool):
+                return obj
+            if isinstance(obj, int) and obj >= _ROLE_VALUE_MIN:
+                r = roles.get(obj)
+                return ["\x00r", r] if r is not None else obj
+            if isinstance(obj, dict):
+                return {k: norm(v) for k, v in obj.items()}
+            if isinstance(obj, (list, tuple)):
+                return [norm(v) for v in obj]
+            return obj
+
+        return packb(norm(adm.fp_docs))
+
+    def _roles_for(self, adm: _Admitted):
+        """(value→role map, role-tagged command) for capture/audit runs."""
+        from zeebe_tpu.engine.burst_templates import RoleInt
+
+        role_map: dict[int, tuple] = {}
+        inst = adm.inst
+        if inst.pi_key >= _ROLE_VALUE_MIN:
+            role_map[inst.pi_key] = ("pi",)
+        for j, tok in enumerate(inst.tokens):
+            if tok.key >= _ROLE_VALUE_MIN:
+                role_map[tok.key] = ("tok", j)
+        cmd = adm.cmd
+        rec = cmd.record
+        if rec.key >= _ROLE_VALUE_MIN:
+            role_map.setdefault(rec.key, ("cmd_key",))
+        wrapped_rec = rec.replace(
+            request_stream_id=RoleInt(rec.request_stream_id, ("req_stream",)),
+            request_id=RoleInt(rec.request_id, ("req_id",)),
+            operation_reference=RoleInt(rec.operation_reference, ("opref",)),
+        )
+        from zeebe_tpu.logstreams import LoggedRecord
+
+        wrapped = LoggedRecord(
+            record=wrapped_rec,
+            position=RoleInt(cmd.position, ("source_position",)),
+            source_position=cmd.source_position,
+            processed=cmd.processed,
+        )
+        return role_map, wrapped
+
+    def _resolver(self, adm: _Admitted, mints: list[int]):
+        cmd = adm.cmd
+        inst = adm.inst
+        toks = inst.tokens
+
+        def resolve(role: tuple) -> int:
+            kind = role[0]
+            if kind == "mint":
+                return mints[role[1]]
+            if kind == "source_position":
+                return cmd.position
+            if kind == "req_id":
+                return cmd.record.request_id
+            if kind == "req_stream":
+                return cmd.record.request_stream_id
+            if kind == "opref":
+                return cmd.record.operation_reference
+            if kind == "cmd_key":
+                return cmd.record.key
+            if kind == "pi":
+                return inst.pi_key
+            if kind == "tok":
+                return toks[role[1]].key
+            raise KeyError(role)
+
+        return resolve
+
+    def _instantiate(self, template, adm: _Admitted):
+        from zeebe_tpu.engine.burst_templates import PreparedBurst
+
+        state = self.engine.state
+        mints = state.bulk_mint(template.mint_count)
+        resolve = self._resolver(adm, mints)
+        buf = template.instantiate_payload(resolve)
+        txn = state.db.require_transaction()
+        template.apply_state(txn, resolve)
+        responses = template.build_responses(resolve)
+        return PreparedBurst(
+            buf=buf,
+            pos_offsets=template.pos_offsets,
+            ts_offsets=template.ts_offsets,
+            count=template.count,
+            responses=responses,
+            has_pending_commands=template.has_pending_commands,
+        )
+
+    def _audit_template(self, template, adm: _Admitted, builder, cap_log, mints) -> None:
+        """Shadow-check a template hit against the slow path just executed."""
+        from zeebe_tpu.engine import burst_templates as bt
+        from zeebe_tpu.state.db import ColumnFamilyCode
+        import struct as _struct
+
+        if len(mints) != template.mint_count:
+            raise AssertionError(
+                f"template audit: mint count {template.mint_count} != slow path {len(mints)}"
+            )
+        resolve = self._resolver(adm, mints)
+        bt.validate_template(template, builder, resolve)
+        # state ops: template replay vs the slow path's capture log, collapsed
+        # to the final op per key exactly as build_template does (minus the
+        # KEY column family, which the template replaces with bulk mint)
+        final: dict[bytes, tuple] = {}
+        for op, key, value in cap_log:
+            if _struct.unpack_from(">H", key, 0)[0] == int(ColumnFamilyCode.KEY):
+                continue
+            if key in final:
+                del final[key]
+            final[key] = (op, value)
+        expected = [(op, key, value) for key, (op, value) in final.items()]
+
+        class _Recorder:
+            def __init__(self):
+                self.ops = []
+
+            def put(self, key, value):
+                self.ops.append(("put", key, value))
+
+            def delete(self, key):
+                self.ops.append(("del", key, None))
+
+        rec = _Recorder()
+        template.apply_state(rec, resolve)
+        if len(rec.ops) != len(expected):
+            raise AssertionError(
+                f"template audit: {len(rec.ops)} state ops vs slow path {len(expected)}"
+            )
+        for (op_a, key_a, val_a), (op_b, key_b, val_b) in zip(rec.ops, expected):
+            if op_a != op_b or key_a != key_b or (op_a == "put" and val_a != val_b):
+                raise AssertionError(
+                    f"template audit: state op mismatch {op_a} {key_a!r} vs {op_b} {key_b!r}"
+                )
+        # responses
+        got = template.build_responses(resolve)
+        want = ([] if builder.response is None else [(False, builder.response)]) + [
+            (True, r) for r in builder.extra_responses
+        ]
+        if len(got) != len(want):
+            raise AssertionError("template audit: response count mismatch")
+        for (extra_a, rec_a, stream_a, req_a), (extra_b, resp) in zip(got, want):
+            if (extra_a != extra_b or stream_a != resp.request_stream_id
+                    or req_a != resp.request_id or rec_a != resp.record):
+                raise AssertionError("template audit: response mismatch")
 
     def _mark_last_command_processed(self, builder) -> None:
         for entry in reversed(builder.follow_ups):
@@ -497,7 +777,7 @@ class KernelBackend:
                 entry.processed = True
                 return
 
-    def _materialize_creation(self, adm: _Admitted, steps, writers, builder) -> None:
+    def _materialize_creation(self, cmd, adm: _Admitted, ops, writers, builder) -> None:
         from zeebe_tpu.engine.bpmn import _pi_value
 
         engine = self.engine
@@ -510,7 +790,7 @@ class KernelBackend:
             (ValueType.PROCESS_INSTANCE_CREATION, int(ProcessInstanceCreationIntent.CREATE))
         ]
         mark = len(builder.follow_ups)
-        creation(adm.cmd, writers)
+        creation(cmd, writers)
         # locate the minted instance key + the ACTIVATE(process) command
         activate_cmd = None
         for entry in builder.follow_ups[mark:]:
@@ -533,14 +813,14 @@ class KernelBackend:
         writers.append_command(tok.key, ValueType.PROCESS_INSTANCE,
                                PI.ACTIVATE_ELEMENT, tok.value)
         self._mark_last_command_processed(builder)
-        self._cascade(inst, steps, writers, builder)
+        self._emit_ops(inst, ops, writers, builder)
 
-    def _materialize_job_complete(self, adm: _Admitted, steps, writers, builder) -> None:
+    def _materialize_job_complete(self, cmd, adm: _Admitted, ops, writers, builder) -> None:
         engine = self.engine
         job_complete = engine._processors[(ValueType.JOB, int(JobIntent.COMPLETE))]
-        job_complete(adm.cmd, writers)  # JOB COMPLETED + response + variables
+        job_complete(cmd, writers)  # JOB COMPLETED + response + variables
         self._mark_last_command_processed(builder)  # the COMPLETE_ELEMENT cmd
-        self._cascade(adm.inst, steps, writers, builder)
+        self._emit_ops(adm.inst, ops, writers, builder)
 
     @staticmethod
     def _child_value(scope_value: dict, element: ExecutableElement, scope_key: int) -> dict:
@@ -556,121 +836,160 @@ class KernelBackend:
             "bpmnEventType": element.event_type.name,
         }
 
-    def _cascade(self, inst: _Inst, steps, writers, builder) -> None:
-        """Walk the device steps for one instance in the sequential engine's
-        FIFO follow-up order, writing its record burst."""
+    # -- device-step decoding: trace extraction + emission -------------------
+    #
+    # The old single-pass cascade is split in two: _cascade_ops walks the
+    # device steps once and produces a route trace over *logical* token ids
+    # (slot- and key-free, so it doubles as the burst-template cache key);
+    # _emit_ops interprets a trace through the Writers in exactly the order
+    # the one-pass walk used to emit.
+
+    def _cascade_ops(self, inst: _Inst, steps) -> list:
+        """Trace one instance's route through the device steps.
+
+        Ops (logical token ids; initial tokens are 0..len(tokens)-1, flow
+        targets get ids in creation order):
+          ("arrive", l, elem)      task activated, token parks
+          ("done", l, elem)        parked task completes (job completed)
+          ("pass", l, elem)        full activate+complete pass
+          ("nomatch", l, elem)     exclusive gateway with no matching flow
+          ("flow", l, elem, fo, new_l)  flow slot fo taken; new_l == -1 when
+                                   no token was placed (join arrival merged)
+          ("complete",)            the process instance completed
+        """
+        tables = self.registry.tables
+        d = inst.info.index
+        exe = inst.info.exe
+        ops: list = []
+        # live: [logical id, slot, elem_idx]
+        live = [[l, t.slot, t.elem_idx] for l, t in enumerate(inst.tokens)]
+        next_l = len(live)
+        done_emitted = False
+        for ev in steps:
+            if done_emitted or not live:
+                break
+            T = ev["elem"].shape[0]
+            additions: list = []
+            for tok in list(live):
+                l, s, e = tok
+                if ev["inst"][s] != inst.idx or ev["elem"][s] != e:
+                    continue  # slot reused after this token died (stale entry)
+                if ev["task_arrive"][s]:
+                    ops.append(("arrive", l, e))
+                elif ev["task_done"][s] or ev["full_pass"][s]:
+                    ops.append(("done" if ev["task_done"][s] else "pass", l, e))
+                    for fo in range(ev["take_mask"].shape[1]):
+                        if not ev["take_mask"][s, fo]:
+                            continue
+                        dest = int(ev["dest"][s, fo])
+                        if dest < T:
+                            flow = exe.flows[int(tables.out_flow_idx[d, e, fo])]
+                            nl = next_l
+                            next_l += 1
+                            additions.append([nl, dest, flow.target_idx])
+                            ops.append(("flow", l, e, fo, nl))
+                        else:
+                            ops.append(("flow", l, e, fo, -1))
+                    live.remove(tok)
+                elif ev["no_match"][s]:
+                    ops.append(("nomatch", l, e))
+                    live.remove(tok)
+            live.extend(additions)
+            if ev["newly_done"][inst.idx] and not done_emitted:
+                ops.append(("complete",))
+                done_emitted = True
+        return ops
+
+    def _emit_ops(self, inst: _Inst, ops: list, writers, builder) -> None:
+        """Interpret a trace, writing the instance's record burst in the
+        sequential engine's FIFO follow-up order."""
         from zeebe_tpu.engine.bpmn import _pi_value
 
-        state = self.engine.state
-        exe = inst.info.exe
-        order: list[_Token] = list(inst.tokens)
-
-        for ev in steps:
-            if inst.done_emitted or not order:
-                break
-            additions: list[_Token] = []
-            for tok in list(order):
-                s = tok.slot
-                if ev["inst"][s] != inst.idx or ev["elem"][s] != tok.elem_idx:
-                    continue  # slot reused after this token died (stale entry)
-                element = exe.elements[tok.elem_idx]
-                value = _pi_value(tok.value, element)
-                if ev["task_arrive"][s]:
-                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
-                                         PI.ELEMENT_ACTIVATING, value)
-                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
-                                         PI.ELEMENT_ACTIVATED, value)
-                    self._emit_job_created(inst, tok, element, writers)
-                    tok.phase = _PHASE_WAIT
-                elif ev["task_done"][s]:
-                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
-                                         PI.ELEMENT_COMPLETING, value)
-                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
-                                         PI.ELEMENT_COMPLETED, value)
-                    self._emit_flows(inst, tok, value, ev, writers, builder, additions)
-                    order.remove(tok)
-                elif ev["full_pass"][s]:
-                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
-                                         PI.ELEMENT_ACTIVATING, value)
-                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
-                                         PI.ELEMENT_ACTIVATED, value)
-                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
-                                         PI.ELEMENT_COMPLETING, value)
-                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
-                                         PI.ELEMENT_COMPLETED, value)
-                    self._emit_flows(inst, tok, value, ev, writers, builder, additions)
-                    order.remove(tok)
-                elif ev["no_match"][s]:
-                    # gateway with no true condition and no default: incident,
-                    # element parks in COMPLETING (BpmnProcessor._complete →
-                    # _choose_exclusive_flow → _raise_incident)
-                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
-                                         PI.ELEMENT_ACTIVATING, value)
-                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
-                                         PI.ELEMENT_ACTIVATED, value)
-                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
-                                         PI.ELEMENT_COMPLETING, value)
-                    incident_key = state.next_key()
-                    writers.append_event(
-                        incident_key, ValueType.INCIDENT, IncidentIntent.CREATED,
-                        {
-                            "errorType": ErrorType.CONDITION_ERROR.name,
-                            "errorMessage": (
-                                "Expected at least one condition to evaluate to true, "
-                                f"or to have a default flow at gateway '{element.id}'"
-                            ),
-                            "bpmnProcessId": value.get("bpmnProcessId", ""),
-                            "processDefinitionKey": value.get("processDefinitionKey", -1),
-                            "processInstanceKey": value.get("processInstanceKey", -1),
-                            "elementId": value.get("elementId", ""),
-                            "elementInstanceKey": tok.key,
-                            "jobKey": -1,
-                            "variableScopeKey": tok.key,
-                        },
-                    )
-                    order.remove(tok)
-            order.extend(additions)
-            inst.tokens = order
-            if ev["newly_done"][inst.idx] and not inst.done_emitted:
-                self._emit_process_completed(inst, writers, builder)
-
-    def _emit_flows(self, inst: _Inst, tok: _Token, value: dict, ev, writers,
-                    builder, additions: list[_Token]) -> None:
-        """SEQUENCE_FLOW_TAKEN + child ACTIVATE commands for one completing
-        token, in flow-slot order (mirrors _complete → _take_flow)."""
         state = self.engine.state
         tables = self.registry.tables
         exe = inst.info.exe
         d = inst.info.index
-        e = tok.elem_idx
-        T = ev["elem"].shape[0]
-        for fo in range(ev["take_mask"].shape[1]):
-            if not ev["take_mask"][tok.slot, fo]:
+        toks: dict[int, _Token] = dict(enumerate(inst.tokens))
+        for op in ops:
+            kind = op[0]
+            if kind == "complete":
+                self._emit_process_completed(inst, writers, builder)
                 continue
-            flow = exe.flows[int(tables.out_flow_idx[d, e, fo])]
-            flow_value = {
-                "bpmnProcessId": value["bpmnProcessId"],
-                "version": value["version"],
-                "processDefinitionKey": value["processDefinitionKey"],
-                "processInstanceKey": value["processInstanceKey"],
-                "elementId": flow.id,
-                "flowScopeKey": value.get("flowScopeKey", -1),
-                "bpmnElementType": BpmnElementType.SEQUENCE_FLOW.name,
-                "bpmnEventType": BpmnEventType.UNSPECIFIED.name,
-            }
-            flow_key = state.next_key()
-            writers.append_event(flow_key, ValueType.PROCESS_INSTANCE,
-                                 PI.SEQUENCE_FLOW_TAKEN, flow_value)
-            dest = int(ev["dest"][tok.slot, fo])
-            if dest < T:
-                target = exe.elements[flow.target_idx]
-                child_key = state.next_key()
-                child_value = self._child_value(value, target, value.get("flowScopeKey", -1))
-                writers.append_command(child_key, ValueType.PROCESS_INSTANCE,
-                                       PI.ACTIVATE_ELEMENT, child_value)
-                self._mark_last_command_processed(builder)
-                additions.append(_Token(slot=dest, elem_idx=target.idx,
-                                        key=child_key, value=child_value))
+            l, e = op[1], op[2]
+            tok = toks[l]
+            element = exe.elements[e]
+            value = _pi_value(tok.value, element)
+            if kind == "arrive":
+                writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                     PI.ELEMENT_ACTIVATING, value)
+                writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                     PI.ELEMENT_ACTIVATED, value)
+                self._emit_job_created(inst, tok, element, writers)
+            elif kind == "done":
+                writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                     PI.ELEMENT_COMPLETING, value)
+                writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                     PI.ELEMENT_COMPLETED, value)
+            elif kind == "pass":
+                writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                     PI.ELEMENT_ACTIVATING, value)
+                writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                     PI.ELEMENT_ACTIVATED, value)
+                writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                     PI.ELEMENT_COMPLETING, value)
+                writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                     PI.ELEMENT_COMPLETED, value)
+            elif kind == "flow":
+                fo, new_l = op[3], op[4]
+                flow = exe.flows[int(tables.out_flow_idx[d, e, fo])]
+                flow_value = {
+                    "bpmnProcessId": value["bpmnProcessId"],
+                    "version": value["version"],
+                    "processDefinitionKey": value["processDefinitionKey"],
+                    "processInstanceKey": value["processInstanceKey"],
+                    "elementId": flow.id,
+                    "flowScopeKey": value.get("flowScopeKey", -1),
+                    "bpmnElementType": BpmnElementType.SEQUENCE_FLOW.name,
+                    "bpmnEventType": BpmnEventType.UNSPECIFIED.name,
+                }
+                flow_key = state.next_key()
+                writers.append_event(flow_key, ValueType.PROCESS_INSTANCE,
+                                     PI.SEQUENCE_FLOW_TAKEN, flow_value)
+                if new_l >= 0:
+                    target = exe.elements[flow.target_idx]
+                    child_key = state.next_key()
+                    child_value = self._child_value(value, target,
+                                                    value.get("flowScopeKey", -1))
+                    writers.append_command(child_key, ValueType.PROCESS_INSTANCE,
+                                           PI.ACTIVATE_ELEMENT, child_value)
+                    self._mark_last_command_processed(builder)
+                    toks[new_l] = _Token(slot=-1, elem_idx=target.idx,
+                                         key=child_key, value=child_value)
+            elif kind == "nomatch":
+                writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                     PI.ELEMENT_ACTIVATING, value)
+                writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                     PI.ELEMENT_ACTIVATED, value)
+                writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                     PI.ELEMENT_COMPLETING, value)
+                incident_key = state.next_key()
+                writers.append_event(
+                    incident_key, ValueType.INCIDENT, IncidentIntent.CREATED,
+                    {
+                        "errorType": ErrorType.CONDITION_ERROR.name,
+                        "errorMessage": (
+                            "Expected at least one condition to evaluate to true, "
+                            f"or to have a default flow at gateway '{element.id}'"
+                        ),
+                        "bpmnProcessId": value.get("bpmnProcessId", ""),
+                        "processDefinitionKey": value.get("processDefinitionKey", -1),
+                        "processInstanceKey": value.get("processInstanceKey", -1),
+                        "elementId": value.get("elementId", ""),
+                        "elementInstanceKey": tok.key,
+                        "jobKey": -1,
+                        "variableScopeKey": tok.key,
+                    },
+                )
 
     def _emit_job_created(self, inst: _Inst, tok: _Token, element: ExecutableElement,
                           writers) -> None:

@@ -27,6 +27,7 @@ from zeebe_tpu.protocol.msgpack import unpackb as msgpack_unpackb
 
 _BATCH_HEADER = struct.Struct("<IqQ")  # record count, source position, timestamp ms
 _ENTRY_HEADER = struct.Struct("<BqI")  # processed flag, position, record length
+_PACK_LE_Q = struct.Struct("<q")
 
 
 @dataclasses.dataclass(frozen=True, slots=True)
@@ -79,6 +80,9 @@ class LogStreamWriter:
             jrec = stream.journal.append(payload, asqn=first_position)
             stream._on_appended(first_position, jrec.index)
             stream._next_position = first_position + len(entries)
+            stream._batch_has_commands[jrec.index] = any(
+                e.record.is_command and not e.processed for e in entries
+            )
             # seed the decode cache from the in-memory entries: every local
             # append is read back at least twice (processing scan + export),
             # and the bytes round-trip is pure waste for records we hold.
@@ -111,6 +115,29 @@ class LogStreamWriter:
                 ],
             )
         return first_position + len(entries) - 1
+
+    def append_prepatched(
+        self, buf: bytearray, pos_offsets: list[int], ts_offsets: list[int],
+        count: int, has_pending_commands: bool = False,
+    ) -> int:
+        """Append a pre-serialized batch whose only unknown fields are the
+        positions and timestamps (the burst-template fast path): patch them
+        under the lock and hand the bytes straight to the journal. Returns the
+        last record's position. The decode cache is NOT seeded — readers
+        decode on demand — but the command-scan skip index is."""
+        stream = self._stream
+        with self._lock:
+            first_position = stream._next_position
+            timestamp = stream.clock_millis()
+            for i, off in enumerate(pos_offsets):
+                _PACK_LE_Q.pack_into(buf, off, first_position + i)
+            for off in ts_offsets:
+                _PACK_LE_Q.pack_into(buf, off, timestamp)
+            jrec = stream.journal.append(bytes(buf), asqn=first_position)
+            stream._on_appended(first_position, jrec.index)
+            stream._next_position = first_position + count
+            stream._batch_has_commands[jrec.index] = has_pending_commands
+        return first_position + count - 1
 
 
 def _serialize_batch(
@@ -145,11 +172,14 @@ def _deserialize_batch(payload: bytes, partition_id: int) -> list[LoggedRecord]:
     for _ in range(count):
         processed, position, length = _ENTRY_HEADER.unpack_from(payload, off)
         off += _ENTRY_HEADER.size
-        record = Record.from_bytes(payload[off : off + length], position=position, partition_id=partition_id)
+        record = Record.from_bytes(
+            payload[off : off + length], position=position,
+            partition_id=partition_id, timestamp=timestamp,
+        )
         off += length
         out.append(
             LoggedRecord(
-                record=record.replace(timestamp=timestamp),
+                record=record,
                 position=position,
                 source_position=source_position,
                 processed=bool(processed),
@@ -214,6 +244,10 @@ class LogStream:
         # a batch); 1024 batches ≈ one processing burst window
         self._batch_cache: dict[int, list[LoggedRecord]] = {}
         self._batch_cache_limit = 1024
+        # journal index → False when the batch is known to contain no
+        # unprocessed commands (burst appends): the command scan skips such
+        # batches without decoding them. Absent = unknown (must decode).
+        self._batch_has_commands: dict[int, bool] = {}
         self.rebuild_index()
         self._writer = LogStreamWriter(self)
 
@@ -223,6 +257,7 @@ class LogStream:
         self._batch_positions.clear()
         self._batch_indexes.clear()
         self._batch_cache.clear()
+        self._batch_has_commands.clear()
         for index, asqn in self.journal.entries_meta():
             if asqn >= 0:
                 self._batch_positions.append(asqn)
@@ -243,6 +278,10 @@ class LogStream:
             return []
         batch = _deserialize_batch(jrec.data, self.partition_id)
         self._cache_batch(journal_index, batch)
+        if journal_index not in self._batch_has_commands:
+            self._batch_has_commands[journal_index] = any(
+                r.record.is_command and not r.processed for r in batch
+            )
         return batch
 
     def _on_appended(self, first_position: int, journal_index: int) -> None:
@@ -301,6 +340,49 @@ class LogStream:
         """First record with record.position >= position, or None."""
         return self.read_with_hint(position, -1)[0]
 
+    def next_command_with_hint(
+        self, position: int, hint: int
+    ) -> tuple[LoggedRecord | None, int, int]:
+        """Like read_with_hint, but for the command scan: whole batches known
+        to contain no unprocessed commands (``_batch_has_commands`` is False)
+        are skipped without decoding. Returns (record, hint, scan_position):
+        the first record at-or-after ``position`` that MAY be an unprocessed
+        command (the caller still filters — the skip is an optimization, not a
+        contract), and the position the scan safely advanced to (when no
+        record is returned the caller may resume from scan_position and never
+        rescan the skipped batches)."""
+        while True:
+            if position > self.last_position:
+                return None, hint, position
+            slot = self._locate_slot(position, hint)
+            has = self._batch_has_commands.get(self._batch_indexes[slot])
+            if has is False:
+                hint = slot
+                if slot + 1 < len(self._batch_positions):
+                    position = self._batch_positions[slot + 1]
+                    continue
+                return None, slot, self.last_position + 1
+            batch = self._read_batch_at(self._batch_indexes[slot])
+            for logged in batch:
+                if logged.position >= position:
+                    return logged, slot, logged.position
+            if slot + 1 < len(self._batch_indexes):
+                position = self._batch_positions[slot + 1]
+                hint = slot + 1
+                continue
+            return None, slot, self.last_position + 1
+
+    def _locate_slot(self, position: int, hint: int) -> int:
+        positions = self._batch_positions
+        n = len(positions)
+        if 0 <= hint < n and positions[hint] <= position:
+            if hint + 1 >= n or positions[hint + 1] > position:
+                return hint
+            if hint + 2 >= n or positions[hint + 2] > position:
+                return hint + 1
+        slot = self._batch_slot_for(position)
+        return 0 if slot < 0 else slot
+
     def read_with_hint(self, position: int, hint: int) -> tuple[LoggedRecord | None, int]:
         """``read_at_or_after`` with a batch-slot cursor: ``hint`` is the slot
         the caller last read from (-1 = unknown); returns (record, slot) so
@@ -308,18 +390,7 @@ class LogStream:
         rebuild_index truncated the arrays) is detected and falls back."""
         if position > self.last_position:
             return None, hint
-        positions = self._batch_positions
-        n = len(positions)
-        slot = -1
-        if 0 <= hint < n and positions[hint] <= position:
-            if hint + 1 >= n or positions[hint + 1] > position:
-                slot = hint
-            elif hint + 2 >= n or positions[hint + 2] > position:
-                slot = hint + 1
-        if slot < 0:
-            slot = self._batch_slot_for(position)
-            if slot < 0:
-                slot = 0
+        slot = self._locate_slot(position, hint)
         batch = self._read_batch_at(self._batch_indexes[slot])
         for logged in batch:
             if logged.position >= position:

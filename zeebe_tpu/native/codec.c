@@ -311,8 +311,6 @@ static PyObject *read_bin(Reader *r, Py_ssize_t n)
 
 static PyObject *read_array(Reader *r, Py_ssize_t n, int depth)
 {
-    if (depth > MAX_DEPTH)
-        return codec_error("msgpack nesting exceeds %d", MAX_DEPTH);
     /* every element needs >= 1 byte: reject corrupt lengths before the
      * allocation so malformed frames raise MsgPackError, not MemoryError */
     if (n > r->len - r->pos)
@@ -333,8 +331,6 @@ static PyObject *read_array(Reader *r, Py_ssize_t n, int depth)
 
 static PyObject *read_map(Reader *r, Py_ssize_t n, int depth)
 {
-    if (depth > MAX_DEPTH)
-        return codec_error("msgpack nesting exceeds %d", MAX_DEPTH);
     if (n > (r->len - r->pos) / 2) /* each entry needs >= 2 bytes */
         return codec_error("truncated msgpack data");
     PyObject *dict = PyDict_New();
@@ -371,6 +367,11 @@ static PyObject *read_obj(Reader *r, int depth)
 {
     const uint8_t *p;
     uint64_t u;
+    /* depth = number of enclosing containers; checked at value-read entry to
+     * mirror the pure-Python _Reader.read() exactly (a container at the limit
+     * still decodes if it has no children) */
+    if (depth > MAX_DEPTH)
+        return codec_error("msgpack nesting exceeds %d", MAX_DEPTH);
     if (take(r, 1, &p) < 0)
         return NULL;
     uint8_t b = *p;

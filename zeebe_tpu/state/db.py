@@ -165,13 +165,17 @@ class Transaction:
     thousands of events in one transaction, and an O(pending-writes) cost per
     ``iterate`` call turns the group quadratic."""
 
-    __slots__ = ("_db", "_writes", "_sorted_writes", "closed")
+    __slots__ = ("_db", "_writes", "_sorted_writes", "closed", "capture")
 
     def __init__(self, db: "ZbDb") -> None:
         self._db = db
         self._writes: dict[bytes, Any] = {}
         self._sorted_writes: list[bytes] = []
         self.closed = False
+        # optional write-capture log: when a list, every put/delete is also
+        # appended as ("put", key, value) / ("del", key, None) — the burst
+        # template builder uses this to learn a command's state write-set
+        self.capture: list | None = None
 
     def get(self, key: bytes) -> Any:
         if key in self._writes:
@@ -183,11 +187,15 @@ class Transaction:
         if key not in self._writes:
             insort(self._sorted_writes, key)
         self._writes[key] = value
+        if self.capture is not None:
+            self.capture.append(("put", key, value))
 
     def delete(self, key: bytes) -> None:
         if key not in self._writes:
             insort(self._sorted_writes, key)
         self._writes[key] = _DELETED
+        if self.capture is not None:
+            self.capture.append(("del", key, None))
 
     def exists(self, key: bytes) -> bool:
         if key in self._writes:

@@ -171,9 +171,12 @@ class StreamProcessor:
     def _next_command(self) -> LoggedRecord | None:
         position = self._reader_position
         while True:
-            logged, self._scan_hint = self.log_stream.read_with_hint(position, self._scan_hint)
+            logged, self._scan_hint, scanned = self.log_stream.next_command_with_hint(
+                position, self._scan_hint
+            )
             if logged is None:
-                self._reader_position = position
+                # safe to resume after batches the scan proved command-free
+                self._reader_position = max(position, scanned)
                 return None
             if logged.record.is_command and not logged.processed:
                 self._reader_position = logged.position + 1
@@ -185,7 +188,9 @@ class StreamProcessor:
         the kernel backend cannot be a candidate for. Does not consume."""
         position = self._reader_position
         while True:
-            logged, self._scan_hint = self.log_stream.read_with_hint(position, self._scan_hint)
+            logged, self._scan_hint, _ = self.log_stream.next_command_with_hint(
+                position, self._scan_hint
+            )
             if logged is None:
                 return
             position = logged.position + 1
@@ -200,6 +205,8 @@ class StreamProcessor:
         one transaction; returns commands consumed (0 → sequential path)."""
         if self.kernel_backend is None or self.phase != Phase.PROCESSING:
             return 0
+        from zeebe_tpu.engine.burst_templates import PreparedBurst
+
         cmds: list[LoggedRecord] = []
         builders: list[ProcessingResultBuilder] = []
         write_failed = False
@@ -211,9 +218,17 @@ class StreamProcessor:
                 if not cmds:
                     return 0
                 try:
-                    for cmd, builder in zip(cmds, builders):
+                    for cmd, result in zip(cmds, builders):
+                        if isinstance(result, PreparedBurst):
+                            if result.count:
+                                self.last_written_position = self.writer.append_prepatched(
+                                    result.buf, result.pos_offsets,
+                                    result.ts_offsets, result.count,
+                                    has_pending_commands=result.has_pending_commands,
+                                )
+                            continue
                         entries = [
-                            LogAppendEntry(f.record, f.processed) for f in builder.follow_ups
+                            LogAppendEntry(f.record, f.processed) for f in result.follow_ups
                         ]
                         if entries:
                             self.last_written_position = self.writer.try_write(
@@ -237,8 +252,12 @@ class StreamProcessor:
             logger.exception("kernel group processing failed; falling back to sequential")
             return 0
         self._reader_position = cmds[-1].position + 1
-        for builder in builders:
-            self._execute_side_effects(builder)
+        for result in builders:
+            if isinstance(result, PreparedBurst):
+                for _extra, record, stream_id, request_id in result.responses:
+                    self.response_sink(ClientResponse(record, stream_id, request_id))
+            else:
+                self._execute_side_effects(result)
         return len(cmds)
 
     def process_next(self) -> bool:

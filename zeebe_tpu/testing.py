@@ -76,7 +76,10 @@ class EngineHarness:
         if use_kernel_backend:
             from zeebe_tpu.engine.kernel_backend import KernelBackend
 
-            kernel_backend = KernelBackend(self.engine)
+            # audit mode: every burst-template hit ALSO runs the slow path
+            # and asserts byte/state/response equality — the whole test suite
+            # continuously cross-checks the template codegen
+            kernel_backend = KernelBackend(self.engine, audit_templates=True)
         self.kernel_backend = kernel_backend
         self.processor = StreamProcessor(
             self.stream,
