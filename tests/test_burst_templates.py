@@ -172,6 +172,45 @@ class TestFastPathEquivalence:
 
         assert_fast_path_equivalent(scenario, min_hits=0)
 
+    def test_mixed_request_and_requestless_commands(self):
+        # request presence changes the burst shape (client response or not):
+        # templates captured from one must never serve the other
+        def scenario(h):
+            from zeebe_tpu.protocol import ValueType
+            from zeebe_tpu.protocol.intent import ProcessInstanceCreationIntent
+            from zeebe_tpu.protocol.record import command
+
+            h.deploy(one_task())
+            create = {"bpmnProcessId": "one_task", "version": -1, "variables": {"x": 1}}
+            for i in range(6):
+                cmd = command(ValueType.PROCESS_INSTANCE_CREATION,
+                              ProcessInstanceCreationIntent.CREATE, create)
+                if i % 2 == 0:
+                    h.write_command(cmd, request_id=100 + i)
+                else:
+                    h.write_command(cmd)  # request-free (internal-style)
+            for job in h.activate_jobs("work", max_jobs=10):
+                h.complete_job(job["key"])
+
+        seq_log, seq_resp, seq_state, _ = _run(scenario, "seq")
+        fast_log, fast_resp, fast_state, stats = _run(scenario, "fast")
+        assert stats["hits"] >= 2
+        assert fast_log == seq_log
+        assert fast_resp == seq_resp
+        assert fast_state == seq_state
+
+    def test_fingerprint_role_marker_not_forgeable(self):
+        # a variable whose literal value mimics the fingerprint role marker
+        # must not collide with a key-referencing context
+        def scenario(h):
+            h.deploy(one_task())
+            h.create_instance("one_task", variables={"x": 1, "v": ["\x00r", "p"]})
+            h.create_instance("one_task", variables={"x": 1, "v": ["\x00r", "p"]})
+            for job in h.activate_jobs("work", max_jobs=5):
+                h.complete_job(job["key"])
+
+        assert_fast_path_equivalent(scenario, min_hits=1)
+
     def test_restart_replay_after_fast_path(self):
         # events written by prepatched appends must replay to identical state
         from zeebe_tpu.engine import Engine

@@ -404,8 +404,15 @@ class KernelBackend:
         insts = [a.inst for a in admitted]
         n_real = len(insts)
         n_tokens = sum(max(1, len(i.tokens)) for i in insts)
-        I = self._pow2(n_real)
-        T = self._pow2(max(16, 4 * n_tokens))
+        # two shape buckets: XLA specializes on shapes, not occupancy, so
+        # groups are padded to either the small (64) or the max-group
+        # geometry — exactly two compilations per table set, small groups
+        # don't pay the big bucket's device time, and a warmup at each bucket
+        # keeps compilation out of steady state. Token-heavy groups overflow
+        # to the next power of two (rare; costs one extra compile).
+        small = min(64, self._pow2(self.max_group))
+        I = small if n_real <= small else self._pow2(self.max_group)
+        T = self._pow2(max(4 * I, 4 * n_tokens))
         E = tables.max_elements
         S = tables.num_slots
 
@@ -533,7 +540,11 @@ class KernelBackend:
         template = None
         key = None
         if self.use_templates and adm.templatable:
-            key = (adm.kind, adm.inst.info.index, tuple(ops),
+            # request presence is part of the burst SHAPE (Writers.respond
+            # only emits a client response when request_id >= 0), so it must
+            # be in the key — the ids themselves are patched roles
+            key = (adm.kind, adm.inst.info.index,
+                   adm.cmd.record.request_id >= 0, tuple(ops),
                    self._fingerprint(adm))
             template = self._templates.get(key, _MISSING)
             if template is _MISSING:
@@ -631,9 +642,14 @@ class KernelBackend:
                 r = roles.get(obj)
                 return ["\x00r", r] if r is not None else obj
             if isinstance(obj, dict):
-                return {k: norm(v) for k, v in obj.items()}
+                return {norm(k): norm(v) for k, v in obj.items()}
             if isinstance(obj, (list, tuple)):
                 return [norm(v) for v in obj]
+            if isinstance(obj, str) and obj.startswith("\x00"):
+                # escape NUL-prefixed strings so user data can never forge
+                # the ["\x00r", tag] role marker (prefix escaping keeps the
+                # normalization injective)
+                return "\x00s" + obj
             return obj
 
         return packb(norm(adm.fp_docs))

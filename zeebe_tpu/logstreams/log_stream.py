@@ -243,7 +243,9 @@ class LogStream:
         # interleaved, so a single-slot cache thrashes (every read re-decodes
         # a batch); 1024 batches ≈ one processing burst window
         self._batch_cache: dict[int, list[LoggedRecord]] = {}
-        self._batch_cache_limit = 1024
+        # sized so one ingress burst window (thousands of single-command
+        # batches) plus its follow-up reads stays decoded end-to-end
+        self._batch_cache_limit = 8192
         # journal index → False when the batch is known to contain no
         # unprocessed commands (burst appends): the command scan skips such
         # batches without decoding them. Absent = unknown (must decode).

@@ -287,10 +287,21 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     is_excl = op == K_EXCLUSIVE
     need_eval = (is_excl & pass_attempt)[:, None] & (conds >= 0)
     if config.has_conditions:
-        prog_ids = jnp.where(need_eval, conds, -1).reshape(-1)
-        slot_rows = jnp.repeat(state["var_slots"][inst], FO, axis=0)
-        cond_true = _eval_conditions(tables.cond_ops, tables.cond_args, prog_ids, slot_rows)
-        cond_true = cond_true.reshape(T, FO) & need_eval
+        # scalar-predicated skip: in steps where no executing token sits on a
+        # conditional gateway (most steps of job-completion cascades), the
+        # whole vectorized VM is skipped — the pred is a scalar, so lax.cond
+        # stays real control flow (unlike a vmapped cond, which would lower
+        # to select and evaluate both branches for every lane)
+        def eval_all(_):
+            prog_ids = jnp.where(need_eval, conds, -1).reshape(-1)
+            slot_rows = jnp.repeat(state["var_slots"][inst], FO, axis=0)
+            out = _eval_conditions(tables.cond_ops, tables.cond_args, prog_ids, slot_rows)
+            return out.reshape(T, FO) & need_eval
+
+        cond_true = jax.lax.cond(
+            jnp.any(need_eval), eval_all,
+            lambda _: jnp.zeros((T, FO), jnp.bool_), operand=None,
+        )
     else:
         cond_true = jnp.zeros((T, FO), jnp.bool_)
 

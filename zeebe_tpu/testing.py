@@ -79,7 +79,10 @@ class EngineHarness:
             # audit mode: every burst-template hit ALSO runs the slow path
             # and asserts byte/state/response equality — the whole test suite
             # continuously cross-checks the template codegen
-            kernel_backend = KernelBackend(self.engine, audit_templates=True)
+            # small group bucket: tests drive few instances at a time, and
+            # the kernel pads every group to the max-group geometry
+            kernel_backend = KernelBackend(self.engine, max_group=64,
+                                           audit_templates=True)
         self.kernel_backend = kernel_backend
         self.processor = StreamProcessor(
             self.stream,
