@@ -34,6 +34,8 @@ class _Gen:
         self.pid = pid
         self.job_types_used: set[str] = set()
         self.has_no_default_gateway = False
+        self.has_timers = False
+        self.messages: set[str] = set()
 
     def next_id(self, prefix: str) -> str:
         self.n += 1
@@ -58,14 +60,27 @@ class _Gen:
         if depth >= 3:
             return self.task(b)
         roll = rng.random()
-        if roll < 0.45:
+        if roll < 0.40:
             return self.task(b)
+        if roll < 0.48:
+            return self.catch_event(b)
         if roll < 0.60:
             b = self.block(b, depth + 1)
             return self.block(b, depth + 1)
         if roll < 0.85:
             return self.exclusive(b, depth)
         return self.parallel(b, depth)
+
+    def catch_event(self, b):
+        """A timer or message intermediate catch (rides the kernel's K_CATCH
+        park/resume path)."""
+        if self.rng.random() < 0.5:
+            self.has_timers = True
+            return b.intermediate_catch_timer(self.next_id("timer"), duration="PT5S")
+        name = f"msg_{self.next_id('m')}"
+        self.messages.add(name)
+        return b.intermediate_catch_message(self.next_id("catch"), name,
+                                            correlation_key="mkey")
 
     def task(self, b):
         job_type = self.rng.choice(JOB_TYPES)
@@ -127,24 +142,41 @@ def _random_vars(rng: random.Random, constant: bool = False) -> dict:
     return {name: rng.randint(0, 20) for name in VAR_NAMES if rng.random() < 0.8}
 
 
-def _drive(h: EngineHarness, model, pid: str, job_types: set[str],
-           rng: random.Random, instances: int, constant_vars: bool = False) -> None:
+def _drive(h: EngineHarness, gen: "_Gen", model, rng: random.Random,
+           instances: int, constant_vars: bool = False) -> None:
     h.deploy(model)
-    for _ in range(instances):
-        h.create_instance(pid, variables=_random_vars(rng, constant_vars))
-    # run all jobs to exhaustion; completion payloads are keyed off the job
-    # key so both runs (whose logs must be position/key-identical anyway)
-    # derive the same values
+    for i in range(instances):
+        variables = _random_vars(rng, constant_vars)
+        if gen.messages:
+            # per-instance correlation key — only when the graph has message
+            # catches (it breaks the fingerprint collision the constant-vars
+            # fast-path seeds rely on)
+            variables["mkey"] = f"ck{i}"
+        h.create_instance(gen.pid, variables=variables)
+    # run all jobs/timers/messages to exhaustion; completion payloads are
+    # keyed off the job key so all runs (whose logs must be position/key-
+    # identical anyway) derive the same values
+    idle_rounds = 0
     for _ in range(64):
         worked = 0
-        for job_type in sorted(job_types):
+        for job_type in sorted(gen.job_types_used):
             for job in h.activate_jobs(job_type, max_jobs=50):
                 variables = {}
                 if job["key"] % 3 == 0:
                     variables[VAR_NAMES[job["key"] % len(VAR_NAMES)]] = job["key"] % 23
                 h.complete_job(job["key"], variables or None)
                 worked += 1
-        if not worked:
+        if gen.has_timers:
+            h.advance_time(6_000)
+        for name in sorted(gen.messages):
+            for i in range(instances):
+                # message_id dedupes republication across drive rounds
+                h.publish_message(name, f"ck{i}", message_id=f"{name}-ck{i}",
+                                  request_id=13)
+        # timers/messages may unlock work only on the NEXT round — stop after
+        # two consecutive rounds with nothing to do
+        idle_rounds = idle_rounds + 1 if worked == 0 else 0
+        if idle_rounds >= 2:
             break
     else:
         pytest.fail("job drive loop did not quiesce")
@@ -180,18 +212,20 @@ def _run_one(seed: int) -> None:
     modes = ["seq", "audit"] + (["fast"] if constant_vars else [])
     logs = []
     stats = None
+    fast_hits = 0
     for mode in modes:
         h = EngineHarness(use_kernel_backend=mode != "seq")
         if mode == "fast":
             h.kernel_backend.audit_templates = False
         try:
-            _drive(h, model, gen.pid, gen.job_types_used,
-                   random.Random(seed + 1), instances, constant_vars)
+            _drive(h, gen, model, random.Random(seed + 1), instances, constant_vars)
             logs.append(_fingerprint(h))
             if mode == "audit":
                 stats = (h.kernel_backend.groups_processed,
                          h.kernel_backend.commands_processed,
                          h.kernel_backend.fallbacks)
+            elif mode == "fast":
+                fast_hits = h.kernel_backend.template_hits
         finally:
             h.close()
     seq_log, ker_log = logs[0], logs[1]
@@ -203,7 +237,12 @@ def _run_one(seed: int) -> None:
         assert len(seq_log) == len(ker_log), (
             f"seed {seed}: log lengths differ {len(seq_log)} vs {len(ker_log)}"
         )
-    return stats
+    # template hits are expected whenever fingerprints can collide: constant
+    # variables, >1 instance, and no per-instance correlation keys or
+    # clock-derived timer documents breaking the collision
+    hits_expected = (constant_vars and instances >= 2 and not gen.messages
+                     and not gen.has_timers)
+    return stats, fast_hits, hits_expected
 
 
 SEEDS = list(range(120))
@@ -212,9 +251,16 @@ SEEDS = list(range(120))
 @pytest.mark.parametrize("seed_block", range(0, len(SEEDS), 10))
 def test_random_process_parity(seed_block):
     kernel_commands = 0
+    fast_hits = 0
+    any_hits_expected = False
     for seed in SEEDS[seed_block : seed_block + 10]:
-        stats = _run_one(seed)
+        stats, hits, hits_expected = _run_one(seed)
         if stats:
             kernel_commands += stats[1]
-    # the oracle is only meaningful if the kernel actually executed work
+        fast_hits += hits
+        any_hits_expected = any_hits_expected or hits_expected
+    # the oracle is only meaningful if the kernel actually executed work —
+    # and the fast-path leg only if templates actually served
     assert kernel_commands > 0, "kernel backend never admitted a command in this block"
+    if any_hits_expected:
+        assert fast_hits > 0, "production template path never served in this block"

@@ -117,7 +117,8 @@ _pack_i32 = struct.Struct(">i").pack
 _pack_i64 = struct.Struct(">q").pack
 
 
-def _pack_with_roles(obj: Any, buf: bytearray, patches: list, role_map: dict) -> None:
+def _pack_with_roles(obj: Any, buf: bytearray, patches: list, role_map: dict,
+                     unknown: list | None = None) -> None:
     role = _role_of(obj, role_map)
     if role is not None:
         v = int(obj)
@@ -127,6 +128,9 @@ def _pack_with_roles(obj: Any, buf: bytearray, patches: list, role_map: dict) ->
         patches.append((len(buf), "be_q", role))
         buf += _pack_u64(v)
         return
+    if (unknown is not None and isinstance(obj, int) and not isinstance(obj, bool)
+            and abs(obj) >= _ROLE_VALUE_MIN):
+        unknown.append(int(obj))
     if obj is None:
         buf.append(0xC0)
     elif obj is True:
@@ -177,7 +181,7 @@ def _pack_with_roles(obj: Any, buf: bytearray, patches: list, role_map: dict) ->
             buf.append(0xDD)
             buf += _pack_u32(n)
         for item in obj:
-            _pack_with_roles(item, buf, patches, role_map)
+            _pack_with_roles(item, buf, patches, role_map, unknown)
     elif isinstance(obj, dict):
         n = len(obj)
         if n < 16:
@@ -189,8 +193,8 @@ def _pack_with_roles(obj: Any, buf: bytearray, patches: list, role_map: dict) ->
             buf.append(0xDF)
             buf += _pack_u32(n)
         for k, v in obj.items():
-            _pack_with_roles(k, buf, patches, role_map)
-            _pack_with_roles(v, buf, patches, role_map)
+            _pack_with_roles(k, buf, patches, role_map, unknown)
+            _pack_with_roles(v, buf, patches, role_map, unknown)
     else:
         raise NotTemplatable(f"cannot template msgpack type {type(obj).__name__}")
 
@@ -232,17 +236,20 @@ def _pack_int_plain(v: int, buf: bytearray) -> None:
 # value-object templating (state writes, response record values)
 
 
-def _templatize_value(obj: Any, role_map: dict):
+def _templatize_value(obj: Any, role_map: dict, unknown: list | None = None):
     """Replace role ints with _RoleSlot sentinels; returns (template, n_roles)."""
     role = _role_of(obj, role_map)
     if role is not None:
         return _RoleSlot(role), 1
+    if (unknown is not None and isinstance(obj, int) and not isinstance(obj, bool)
+            and abs(obj) >= _ROLE_VALUE_MIN):
+        unknown.append(int(obj))
     if isinstance(obj, dict):
         n = 0
         out = {}
         for k, v in obj.items():
-            kt, nk = _templatize_value(k, role_map)
-            vt, nv = _templatize_value(v, role_map)
+            kt, nk = _templatize_value(k, role_map, unknown)
+            vt, nv = _templatize_value(v, role_map, unknown)
             out[k if nk == 0 else kt] = vt
             n += nk + nv
         return out, n
@@ -250,7 +257,7 @@ def _templatize_value(obj: Any, role_map: dict):
         items = []
         n = 0
         for v in obj:
-            vt, nv = _templatize_value(v, role_map)
+            vt, nv = _templatize_value(v, role_map, unknown)
             items.append(vt)
             n += nv
         return (items if isinstance(obj, list) else tuple(items)), n
@@ -279,7 +286,8 @@ def _build_value(template: Any, resolve: Callable[[tuple], int]):
 # encoded-db-key templating (keys are self-describing: type-tagged parts)
 
 
-def _templatize_db_key(enc: bytes, role_map: dict) -> tuple[bytes, list]:
+def _templatize_db_key(enc: bytes, role_map: dict,
+                       unknown: list | None = None) -> tuple[bytes, list]:
     """Parse an encoded state key; return (bytes, [(offset, role)]) patching
     int parts whose value is a role. Layout per state/db._encode_part:
     u16 cf | parts, each 0x01+BE-u64(sign-flipped) | 0x02+utf8+NUL |
@@ -298,6 +306,8 @@ def _templatize_db_key(enc: bytes, role_map: dict) -> tuple[bytes, list]:
             role = role_map.get(v) if v >= _ROLE_VALUE_MIN else None
             if role is not None:
                 patches.append((off, role))
+            elif unknown is not None and abs(v) >= _ROLE_VALUE_MIN:
+                unknown.append(v)
             off += 8
         elif tag == 0x02:
             end = enc.index(b"\x00", off)
@@ -430,12 +440,21 @@ def build_template(
     role_map: dict[int, tuple],
     mint_count: int,
     partition_id: int,
+    allowed_ints: frozenset[int] | set[int] = frozenset(),
 ) -> BurstTemplate:
     """Build a BurstTemplate from one slow-path materialization: the result
     builder (records + responses) and the transaction's write capture log.
-    Raises NotTemplatable when anything resists the role model."""
+    Raises NotTemplatable when anything resists the role model.
+
+    ``allowed_ints``: large ints (>= 2^32) that may legitimately appear as
+    CONSTANTS because the cache key's fingerprint pins them (they occur in
+    the admission documents). Any other large non-role int is evidence of
+    hidden variance the role model cannot express (e.g. a clock-derived
+    due date) — baking it in would silently corrupt later instantiations,
+    so the burst is rejected instead."""
     if builder.post_commit_tasks:
         raise NotTemplatable("post-commit tasks cannot be templated")
+    unknown: list[int] = []
 
     # ---- payload: batch header + per-entry header + record frames ----------
     payload = bytearray(_BATCH_HEADER.pack(len(builder.follow_ups), -1, 0))
@@ -448,7 +467,7 @@ def build_template(
             raise NotTemplatable("oversized rejection reason")
         body = bytearray()
         body_patches: list = []
-        _pack_with_roles(dict(rec.value), body, body_patches, role_map)
+        _pack_with_roles(dict(rec.value), body, body_patches, role_map, unknown)
         reason = rec.rejection_reason.encode("utf-8")
         entry_off = len(payload)
         rec_off = entry_off + _ENTRY_HEADER.size
@@ -481,6 +500,8 @@ def build_template(
             role = _role_of(value, role_map)
             if role is not None:
                 role_patches.append((rec_off + off, fmt, role))
+            elif abs(int(value)) >= _ROLE_VALUE_MIN:
+                unknown.append(int(value))
         ts_offsets.append(rec_off + _REC_TS_OFF)
         payload += reason
         payload += struct.pack("<I", len(body))
@@ -505,7 +526,7 @@ def build_template(
         final_ops[enc_key] = (op, value)
     state_ops: list[StateOp] = []
     for enc_key, (op, value) in final_ops.items():
-        key_bytes, key_patches = _templatize_db_key(enc_key, role_map)
+        key_bytes, key_patches = _templatize_db_key(enc_key, role_map, unknown)
         if op != "put":
             state_ops.append(StateOp("del", key_bytes, key_patches))
             continue
@@ -514,14 +535,14 @@ def build_template(
         try:
             vbuf = bytearray()
             vpatches: list = []
-            _pack_with_roles(value, vbuf, vpatches, role_map)
+            _pack_with_roles(value, vbuf, vpatches, role_map, unknown)
             if msgpack.unpackb(bytes(vbuf)) == value:
                 entry.value_bytes = bytes(vbuf)
                 entry.value_byte_patches = vpatches
             else:
                 raise NotTemplatable("value not codec-stable")
         except (NotTemplatable, msgpack.MsgPackError):
-            vt, _n = _templatize_value(value, role_map)
+            vt, _n = _templatize_value(value, role_map, unknown)
             entry.value_template = vt
         state_ops.append(entry)
 
@@ -542,7 +563,7 @@ def build_template(
             v = getattr(rec, name)
             role = _role_of(v, role_map)
             header[name] = _RoleSlot(role) if role is not None else v
-        vt, _ = _templatize_value(dict(rec.value), role_map)
+        vt, _ = _templatize_value(dict(rec.value), role_map, unknown)
         stream_role = _role_of(resp.request_stream_id, role_map)
         req_role = _role_of(resp.request_id, role_map)
         responses.append(
@@ -555,6 +576,12 @@ def build_template(
                 ),
                 req_role=_RoleSlot(req_role) if req_role is not None else int(resp.request_id),
             )
+        )
+
+    stray = [v for v in unknown if v not in allowed_ints]
+    if stray:
+        raise NotTemplatable(
+            f"unexplained large ints (not roles, not fingerprint-pinned): {stray[:4]}"
         )
 
     return BurstTemplate(

@@ -42,6 +42,7 @@ from zeebe_tpu.models.bpmn.executable import ExecutableElement, ExecutableProces
 from zeebe_tpu.ops.tables import (
     _KERNEL_OP,
     ConditionNotCompilable,
+    K_CATCH,
     K_JOIN,
     K_TASK,
     ProcessTables,
@@ -54,6 +55,8 @@ from zeebe_tpu.protocol.intent import (
     JobIntent,
     ProcessInstanceCreationIntent,
     ProcessInstanceIntent as PI,
+    ProcessMessageSubscriptionIntent,
+    TimerIntent,
 )
 
 logger = logging.getLogger("zeebe_tpu.kernel_backend")
@@ -70,6 +73,8 @@ _MISSING = object()
 _CANDIDATE_COMMANDS = {
     (ValueType.PROCESS_INSTANCE_CREATION, int(ProcessInstanceCreationIntent.CREATE)),
     (ValueType.JOB, int(JobIntent.COMPLETE)),
+    (ValueType.TIMER, int(TimerIntent.TRIGGER)),
+    (ValueType.PROCESS_MESSAGE_SUBSCRIPTION, int(ProcessMessageSubscriptionIntent.CORRELATE)),
 }
 
 
@@ -81,14 +86,24 @@ def check_element_eligibility(exe: ExecutableProcess, el: ExecutableElement) -> 
     """True when the sequential engine's behavior for this element is exactly
     the kernel's opcode behavior (engine/…/processing/bpmn element processors
     vs ops/automaton masks)."""
+    if el.inputs or el.outputs or el.boundary_idxs or el.multi_instance is not None:
+        return False
+    if el.native_user_task or el.called_decision_id or el.script_expression is not None:
+        return False
+    if el.element_type == BpmnElementType.INTERMEDIATE_CATCH_EVENT:
+        # timer (fixed duration) and message catches park on device (K_CATCH)
+        # and are resumed by the host's TRIGGER / CORRELATE commands; duration
+        # and correlation-key expressions are evaluated on the host at
+        # emission, so they may reference variables freely
+        if el.signal_name is not None:
+            return False
+        if el.timer_duration is not None:
+            return not el.timer_cycle and el.timer_date is None and el.message_name is None
+        return el.message_name is not None
     op = _KERNEL_OP.get(el.element_type)
     if op is None:
         return False
     if el.event_type not in (BpmnEventType.NONE, BpmnEventType.UNSPECIFIED):
-        return False
-    if el.inputs or el.outputs or el.boundary_idxs or el.multi_instance is not None:
-        return False
-    if el.native_user_task or el.called_decision_id or el.script_expression is not None:
         return False
     if (
         el.timer_duration is not None
@@ -116,6 +131,7 @@ class _DefInfo:
     job_types: dict[int, str]  # element idx → static job type
     job_retries: dict[int, int]
     join_idxs: list[int]  # element idxs of K_JOIN gateways
+    timer_idxs: frozenset[int]  # element idxs of timer catch events
 
 
 class KernelRegistry:
@@ -159,6 +175,10 @@ class KernelRegistry:
                 )
             if solo.kernel_op[0, el.idx] == K_JOIN:
                 join_idxs.append(el.idx)
+        timer_idxs = frozenset(
+            el.idx for el in exe.elements[1:]
+            if solo.kernel_op[0, el.idx] == K_CATCH and el.timer_duration is not None
+        )
         info = _DefInfo(
             index=len(self._infos),
             key=definition_key,
@@ -167,6 +187,7 @@ class KernelRegistry:
             job_types=job_types,
             job_retries=job_retries,
             join_idxs=join_idxs,
+            timer_idxs=timer_idxs,
         )
         self._infos.append(info)
         self._by_key[definition_key] = info
@@ -267,6 +288,11 @@ class KernelBackend:
             return self._admit_creation(cmd, instances)
         if kind == (ValueType.JOB, int(JobIntent.COMPLETE)):
             return self._admit_job_complete(cmd, instances)
+        if kind == (ValueType.TIMER, int(TimerIntent.TRIGGER)):
+            return self._admit_timer_trigger(cmd, instances)
+        if kind == (ValueType.PROCESS_MESSAGE_SUBSCRIPTION,
+                    int(ProcessMessageSubscriptionIntent.CORRELATE)):
+            return self._admit_message_correlate(cmd, instances)
         return None
 
     def _admit_creation(self, cmd, instances) -> _Admitted | None:
@@ -304,29 +330,23 @@ class KernelBackend:
         return _Admitted(cmd=cmd, inst=inst, kind="c",
                          fp_docs=[dict(value), meta], templatable=templatable)
 
-    def _admit_job_complete(self, cmd, instances) -> _Admitted | None:
+    def _reconstruct(self, pi_key: int, info: _DefInfo, resume_key: int):
+        """Rebuild a running instance's device tokens from element-instance
+        state. Every live element instance must be parked in a kernel wait
+        state (task on a job, or catch on a timer/subscription) — anything
+        else (mid-transition, incident) is not reconstructable. Returns
+        (tokens, resume_token, root, wait_docs) or None; wait_docs are the
+        parked wait-state records (for the template fingerprint)."""
         state = self.engine.state
-        job_key = cmd.record.key
-        job = state.jobs.get(job_key)
-        if job is None:
-            return None  # sequential path writes the NOT_FOUND rejection
-        pi_key = job.get("processInstanceKey", -1)
-        if pi_key in (i.pi_key for i in instances.values()):
-            return None  # same-instance conflict: next group
-        def_key = job.get("processDefinitionKey", -1)
-        info = self.registry.lookup(def_key, state.processes.executable(def_key))
-        if info is None:
-            return None
         root = state.element_instances.get(pi_key)
         from zeebe_tpu.engine.engine_state import EI_ACTIVATED
 
         if root is None or root["state"] != EI_ACTIVATED:
             return None
-        # every live element instance must be a task parked on a job — any
-        # other state (mid-transition, incident) is not reconstructable
         exe = info.exe
         tokens: list[_Token] = []
         resume: _Token | None = None
+        wait_docs: list = []
         for child_key in sorted(state.element_instances.children_keys(pi_key)):
             child = state.element_instances.get(child_key)
             if child is None or child["state"] != EI_ACTIVATED:
@@ -335,22 +355,40 @@ class KernelBackend:
             if elem_id not in exe.by_id:
                 return None
             el = exe.element(elem_id)
-            if self.registry.tables.kernel_op[info.index, el.idx] != K_TASK:
-                return None
-            if child.get("jobKey", -1) < 0:
+            op = self.registry.tables.kernel_op[info.index, el.idx]
+            if op == K_TASK:
+                if child.get("jobKey", -1) < 0:
+                    return None
+            elif op == K_CATCH:
+                if el.timer_duration is not None:
+                    timers = state.timers.timers_for_element_instance(child_key)
+                    if not timers:
+                        return None  # incident-parked or already fired
+                    wait_docs.extend(dict(t) for _k, t in timers)
+                else:
+                    sub = state.process_message_subscriptions.get(
+                        child_key, el.message_name
+                    )
+                    if sub is None:
+                        return None
+                    wait_docs.append(dict(sub))
+            else:
                 return None
             tok = _Token(slot=-1, elem_idx=el.idx, key=child_key,
                          value=dict(child["value"]), phase=_PHASE_WAIT)
-            if child_key == job.get("elementInstanceKey", -1):
+            if child_key == resume_key:
                 tok.phase = _PHASE_DONE
                 resume = tok
             tokens.append(tok)
         if resume is None:
             return None
-        # pending parallel-join arrivals → device join counters
+        return tokens, resume, root, wait_docs
+
+    def _join_counts(self, pi_key: int, info: _DefInfo) -> dict[int, int]:
+        state = self.engine.state
+        exe = info.exe
         join_counts: dict[int, int] = {}
         for jidx in info.join_idxs:
-            el = exe.elements[jidx]
             total = sum(
                 state.element_instances.taken_flow_count(pi_key, jidx, f.idx)
                 for f in exe.flows
@@ -358,29 +396,126 @@ class KernelBackend:
             )
             if total:
                 join_counts[jidx] = total
-        # condition variables: post-merge view (scope vars + completion vars)
-        merged = state.variables.collect(pi_key)
-        merged.update(cmd.record.value.get("variables") or {})
+        return join_counts
+
+    def _condition_slots(self, info: _DefInfo, merged: dict) -> dict[str, float] | None:
         slots: dict[str, float] = {}
         for name in info.cond_var_names:
             v = merged.get(name)
             if not _is_numeric(v):
                 return None
             slots[name] = float(v)
+        return slots
+
+    def _admit_resume(self, cmd, instances, pi_key: int, resume_key: int,
+                      kind: str, head_docs: list, extra_variables: dict | None,
+                      require_op: int) -> _Admitted | None:
+        """Shared admission for resume commands (job complete, timer trigger,
+        message correlate): reconstruct the instance, resume one token."""
+        state = self.engine.state
+        if pi_key in (i.pi_key for i in instances.values()):
+            return None  # same-instance conflict: next group
+        root_meta = state.element_instances.get(pi_key)
+        if root_meta is None:
+            return None
+        def_key = root_meta["value"].get("processDefinitionKey", -1)
+        info = self.registry.lookup(def_key, state.processes.executable(def_key))
+        if info is None:
+            return None
+        rebuilt = self._reconstruct(pi_key, info, resume_key)
+        if rebuilt is None:
+            return None
+        tokens, resume, root, wait_docs = rebuilt
+        if self.registry.tables.kernel_op[info.index, resume.elem_idx] != require_op:
+            return None
+        join_counts = self._join_counts(pi_key, info)
+        merged = state.variables.collect(pi_key)
+        merged.update(extra_variables or {})
+        slots = self._condition_slots(info, merged)
+        if slots is None:
+            return None
         inst = _Inst(idx=len(instances), info=info, new=False, pi_key=pi_key,
                      tokens=tokens, join_counts=join_counts, slots=slots)
-        root_value = dict(root["value"])
+        # a timer anywhere in the admission context (the trigger itself, or a
+        # parked timer's record in wait_docs) embeds a clock-derived dueDate
+        # in the fingerprint: under a real clock every such burst would
+        # fingerprint uniquely, so templating it only churns the cache with
+        # dead captures
+        has_timer_doc = kind == "t" or any(
+            isinstance(d, dict) and "dueDate" in d for d in (*head_docs, *wait_docs)
+        )
         return _Admitted(
-            cmd=cmd, inst=inst, resume_token=resume, kind="j",
+            cmd=cmd, inst=inst, resume_token=resume, kind=kind,
             fp_docs=[
                 dict(cmd.record.value),
-                dict(job),
-                root_value,
+                *head_docs,
+                dict(root["value"]),
                 [dict(t.value) for t in tokens],
+                wait_docs,
                 sorted(merged.items()),
                 sorted(join_counts.items()),
             ],
-            templatable=pi_key not in self.engine.await_results,
+            templatable=(pi_key not in self.engine.await_results) and not has_timer_doc,
+        )
+
+    def _admit_job_complete(self, cmd, instances) -> _Admitted | None:
+        state = self.engine.state
+        job = state.jobs.get(cmd.record.key)
+        if job is None:
+            return None  # sequential path writes the NOT_FOUND rejection
+        return self._admit_resume(
+            cmd, instances,
+            pi_key=job.get("processInstanceKey", -1),
+            resume_key=job.get("elementInstanceKey", -1),
+            kind="j",
+            head_docs=[dict(job)],
+            extra_variables=cmd.record.value.get("variables"),
+            require_op=K_TASK,
+        )
+
+    def _admit_timer_trigger(self, cmd, instances) -> _Admitted | None:
+        state = self.engine.state
+        timer = state.timers.get(cmd.record.key)
+        if timer is None:
+            return None  # sequential path writes the NOT_FOUND rejection
+        eik = timer.get("elementInstanceKey", -1)
+        if eik < 0:
+            return None  # timer start event → host path
+        instance = state.element_instances.get(eik)
+        if instance is None:
+            return None  # element gone; host records TRIGGERED only
+        # only the waiting catch element itself (route_trigger's first
+        # branch); boundary / event-based-gateway routing stays on the host
+        if timer.get("targetElementId") != instance["value"].get("elementId"):
+            return None
+        return self._admit_resume(
+            cmd, instances,
+            pi_key=instance["value"].get("processInstanceKey", -1),
+            resume_key=eik,
+            kind="t",
+            head_docs=[dict(timer)],
+            extra_variables=None,
+            require_op=K_CATCH,
+        )
+
+    def _admit_message_correlate(self, cmd, instances) -> _Admitted | None:
+        state = self.engine.state
+        value = cmd.record.value
+        eik = value.get("elementInstanceKey", -1)
+        sub = state.process_message_subscriptions.get(eik, value.get("messageName", ""))
+        instance = state.element_instances.get(eik)
+        if sub is None or instance is None:
+            return None  # at-least-once redelivery → host no-op path
+        if sub.get("targetElementId") != instance["value"].get("elementId"):
+            return None  # boundary / event-based gateway → host
+        return self._admit_resume(
+            cmd, instances,
+            pi_key=instance["value"].get("processInstanceKey", -1),
+            resume_key=eik,
+            kind="m",
+            head_docs=[dict(sub)],
+            extra_variables=value.get("variables"),
+            require_op=K_CATCH,
         )
 
     # -- device run ----------------------------------------------------------
@@ -539,7 +674,14 @@ class KernelBackend:
 
         template = None
         key = None
-        if self.use_templates and adm.templatable:
+        # a burst that ARRIVES at a timer catch writes a clock-derived due
+        # date — un-expressible in the role model (and too small for the
+        # unexplained-int net under test clocks), so never template it
+        timer_idxs = adm.inst.info.timer_idxs
+        creates_timer = bool(timer_idxs) and any(
+            op[0] == "arrive" and op[2] in timer_idxs for op in ops
+        )
+        if self.use_templates and adm.templatable and not creates_timer:
             # request presence is part of the burst SHAPE (Writers.respond
             # only emits a client response when request_id >= 0), so it must
             # be in the key — the ids themselves are patched roles
@@ -582,7 +724,7 @@ class KernelBackend:
             if adm.inst.new:
                 self._materialize_creation(wrapped, adm, ops, writers, builder)
             else:
-                self._materialize_job_complete(wrapped, adm, ops, writers, builder)
+                self._materialize_resume(wrapped, adm, ops, writers, builder)
         finally:
             if capture or (template is not None and self.audit_templates):
                 state.next_key = orig_next_key
@@ -599,6 +741,7 @@ class KernelBackend:
                     tmpl = bt.build_template(
                         builder, cap_log, role_map, len(mints),
                         state.partition_id,
+                        allowed_ints=self._fingerprint_ints(adm),
                     )
                     bt.validate_template(tmpl, builder, self._resolver(adm, mints))
                     self._store_template(key, tmpl)
@@ -653,6 +796,28 @@ class KernelBackend:
             return obj
 
         return packb(norm(adm.fp_docs))
+
+    def _fingerprint_ints(self, adm: _Admitted) -> set[int]:
+        """All large ints present in the admission documents — values the
+        fingerprint pins, so a template may keep them as constants."""
+        out: set[int] = set()
+
+        def walk(obj):
+            if isinstance(obj, bool):
+                return
+            if isinstance(obj, int):
+                if abs(obj) >= _ROLE_VALUE_MIN:
+                    out.add(int(obj))
+            elif isinstance(obj, dict):
+                for k, v in obj.items():
+                    walk(k)
+                    walk(v)
+            elif isinstance(obj, (list, tuple)):
+                for v in obj:
+                    walk(v)
+
+        walk(adm.fp_docs)
+        return out
 
     def _roles_for(self, adm: _Admitted):
         """(value→role map, role-tagged command) for capture/audit runs."""
@@ -831,10 +996,23 @@ class KernelBackend:
         self._mark_last_command_processed(builder)
         self._emit_ops(inst, ops, writers, builder)
 
-    def _materialize_job_complete(self, cmd, adm: _Admitted, ops, writers, builder) -> None:
+    _RESUME_HEADS = {
+        "j": (ValueType.JOB, int(JobIntent.COMPLETE)),
+        "t": (ValueType.TIMER, int(TimerIntent.TRIGGER)),
+        "m": (ValueType.PROCESS_MESSAGE_SUBSCRIPTION,
+              int(ProcessMessageSubscriptionIntent.CORRELATE)),
+    }
+
+    def _materialize_resume(self, cmd, adm: _Admitted, ops, writers, builder) -> None:
+        """Resume commands (job complete / timer trigger / message correlate)
+        share one shape: the sequential head processor writes its own events
+        (JOB COMPLETED + variables, TIMER TRIGGERED, …SUBSCRIPTION CORRELATED
+        + variables + ack side effect) and ends by routing a COMPLETE_ELEMENT
+        command at the parked element; the cascade emits what processing that
+        command would have."""
         engine = self.engine
-        job_complete = engine._processors[(ValueType.JOB, int(JobIntent.COMPLETE))]
-        job_complete(cmd, writers)  # JOB COMPLETED + response + variables
+        head = engine._processors[self._RESUME_HEADS[adm.kind]]
+        head(cmd, writers)
         self._mark_last_command_processed(builder)  # the COMPLETE_ELEMENT cmd
         self._emit_ops(adm.inst, ops, writers, builder)
 
@@ -940,7 +1118,19 @@ class KernelBackend:
                                      PI.ELEMENT_ACTIVATING, value)
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_ACTIVATED, value)
-                self._emit_job_created(inst, tok, element, writers)
+                if element.element_type == BpmnElementType.INTERMEDIATE_CATCH_EVENT:
+                    # mirror BpmnProcessor._activate's catch branch: open the
+                    # wait state (timer / message subscription) on the host —
+                    # expressions evaluate against live variable state, and a
+                    # failure raises the same incident and parks the element
+                    bpmn = self.engine.bpmn
+                    if element.timer_duration is not None:
+                        bpmn._create_timer(tok.key, value, element, element, writers)
+                    else:
+                        bpmn._open_message_subscription(tok.key, value, element,
+                                                        element, writers)
+                else:
+                    self._emit_job_created(inst, tok, element, writers)
             elif kind == "done":
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_COMPLETING, value)

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from zeebe_tpu.ops.tables import (
+    K_CATCH,
     K_END,
     K_EXCLUSIVE,
     K_FORK,
@@ -270,13 +271,14 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
 
     # --- what does each token do this step? ------------------------------
     is_task = op == K_TASK
+    is_wait = is_task | (op == K_CATCH)  # parks until the host resumes it
     executing = live & (phase == PHASE_AT) & ~stalled
-    arriving_task = executing & is_task
-    pass_attempt = executing & ~is_task
+    arriving_task = executing & is_wait
+    pass_attempt = executing & ~is_wait
     if auto_jobs:
-        waiting_done = live & is_task & (phase == PHASE_WAIT)
+        waiting_done = live & is_wait & (phase == PHASE_WAIT)
     else:
-        waiting_done = live & is_task & (phase == PHASE_DONE)
+        waiting_done = live & is_wait & (phase == PHASE_DONE)
 
     # --- exclusive gateway condition evaluation ---------------------------
     out_count = tables.out_count[def_of_tok, jnp.maximum(elem, 0)]
@@ -411,7 +413,7 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
         + flows_taken
         + 2 * newly_done.sum()  # process element completing/completed
     )
-    jobs_created = state["jobs_created"] + arriving_task.sum()
+    jobs_created = state["jobs_created"] + (arriving_task & is_task).sum()
     completed = state["completed"] + newly_done.sum()
 
     new_state = {

@@ -120,6 +120,7 @@ K_EXCLUSIVE = 3  # exclusive gateway: conditional routing
 K_FORK = 4  # parallel gateway, fan-out
 K_JOIN = 5  # parallel gateway, fan-in (in_count > 1)
 K_END = 6  # end event: token dies, instance may complete
+K_CATCH = 7  # intermediate catch (timer/message): wait for host trigger/correlation
 
 _KERNEL_OP = {
     BpmnElementType.START_EVENT: K_PASS,
@@ -227,7 +228,15 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                     "device tables support flat processes (sub-process scopes "
                     "run on the host path for now)"
                 )
-            op = _KERNEL_OP.get(el.element_type)
+            if el.element_type == BpmnElementType.INTERMEDIATE_CATCH_EVENT and (
+                (el.timer_duration is not None and not el.timer_cycle and el.timer_date is None)
+                or el.message_name is not None
+            ):
+                # waits like a task; the host resumes it on TIMER TRIGGER /
+                # message correlation instead of job completion
+                op = K_CATCH
+            else:
+                op = _KERNEL_OP.get(el.element_type)
             if op is None:
                 raise ConditionNotCompilable(f"element type {el.element_type.name}")
             if el.element_type == BpmnElementType.PARALLEL_GATEWAY and el.incoming_count > 1:
