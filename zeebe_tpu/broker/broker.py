@@ -101,7 +101,6 @@ class Broker:
                  disk_min_free_bytes: int = 0) -> None:
         import time
 
-        from zeebe_tpu.broker.backpressure import CommandRateLimiter
         from zeebe_tpu.broker.disk import DiskSpaceMonitor
         from zeebe_tpu.utils.health import CriticalComponentsHealthMonitor
         from zeebe_tpu.utils.metrics import REGISTRY
@@ -149,36 +148,112 @@ class Broker:
         else:
             self.backup_store = None
         self.partitions: dict[int, ZeebePartition] = {}
-        sender = ClusterInterPartitionSender(self)
-        for partition_id, members in partition_distribution(cfg).items():
-            if cfg.node_id not in members:
-                continue
-            limiter = CommandRateLimiter(
-                backpressure_algorithm, clock_millis=self.clock_millis,
-            ) if backpressure_enabled else None
-            self.partitions[partition_id] = ZeebePartition(
-                messaging, partition_id, members,
-                self.directory / f"partition-{partition_id}",
-                self.clock_millis,
-                partition_count=cfg.partition_count,
-                exporters_factory=exporters_factory,
-                inter_partition_sender=sender,
-                response_sink=sink,
-                snapshot_period_ms=cfg.snapshot_period_ms,
-                consistency_checks=cfg.consistency_checks,
-                backup_service=backup_service,
-                on_checkpoint=self._observe_checkpoint,
-                backpressure=limiter,
-            )
-            self.health_monitor.register(f"partition-{partition_id}")
-            messaging.subscribe(
-                f"{INTER_PARTITION_TOPIC}-{partition_id}",
-                lambda s, p, pid=partition_id: self._on_inter_partition_command(pid, s, p),
-            )
-            messaging.subscribe(
-                f"{COMMAND_API_TOPIC}-{partition_id}",
-                lambda s, p, pid=partition_id: self._on_client_command(pid, s, p),
-            )
+        self._sender = ClusterInterPartitionSender(self)
+        self._exporters_factory = exporters_factory
+        self._response_sink = sink
+        self._backup_service = backup_service
+        self._backpressure_algorithm = backpressure_algorithm
+        self._backpressure_enabled = backpressure_enabled
+        distribution = partition_distribution(cfg)
+        for partition_id, members in distribution.items():
+            if cfg.node_id in members:
+                self._create_partition(partition_id, members)
+        # dynamic topology: gossiped versioned document + change plans
+        # (reference: topology/ClusterTopologyManager); bootstrapped from the
+        # static distribution, mutated at runtime through change operations
+        from zeebe_tpu.cluster.topology import TopologyManager
+
+        self.topology = TopologyManager(
+            cfg.node_id, self.membership,
+            start_replica=self._create_partition_for_join,
+            stop_replica=self._stop_partition,
+            raft_of=lambda pid: (
+                self.partitions[pid].raft if pid in self.partitions else None
+            ),
+            request_reconfigure=self._request_reconfigure,
+        )
+        self.topology.bootstrap(distribution, sorted(cfg.cluster_members))
+
+    def _create_partition(self, partition_id: int, members: list[str],
+                          priority: int = 1) -> None:
+        from zeebe_tpu.broker.backpressure import CommandRateLimiter
+
+        limiter = CommandRateLimiter(
+            self._backpressure_algorithm, clock_millis=self.clock_millis,
+        ) if self._backpressure_enabled else None
+        self.partitions[partition_id] = ZeebePartition(
+            self.messaging, partition_id, members,
+            self.directory / f"partition-{partition_id}",
+            self.clock_millis,
+            partition_count=self.cfg.partition_count,
+            exporters_factory=self._exporters_factory,
+            inter_partition_sender=self._sender,
+            response_sink=self._response_sink,
+            snapshot_period_ms=self.cfg.snapshot_period_ms,
+            consistency_checks=self.cfg.consistency_checks,
+            backup_service=self._backup_service,
+            on_checkpoint=self._observe_checkpoint,
+            backpressure=limiter,
+            priority=priority,
+        )
+        self.health_monitor.register(f"partition-{partition_id}")
+        self.messaging.subscribe(
+            f"{INTER_PARTITION_TOPIC}-{partition_id}",
+            lambda s, p, pid=partition_id: self._on_inter_partition_command(pid, s, p),
+        )
+        self.messaging.subscribe(
+            f"{COMMAND_API_TOPIC}-{partition_id}",
+            lambda s, p, pid=partition_id: self._on_client_command(pid, s, p),
+        )
+        self.messaging.subscribe(
+            f"raft-reconfigure-{partition_id}",
+            lambda s, p, pid=partition_id: self._on_reconfigure_request(pid, s, p),
+        )
+        self.messaging.subscribe(
+            f"raft-reconfigure-done-{partition_id}",
+            lambda s, p, pid=partition_id: self._on_reconfigure_confirmed(pid, s, p),
+        )
+
+    def _create_partition_for_join(self, partition_id: int, members: list[str],
+                                   priority: int = 1) -> None:
+        """Topology PARTITION_JOIN: bootstrap a replica that is not yet part
+        of the raft group (it syncs via append/snapshot once the leader adds
+        it through reconfiguration)."""
+        if partition_id not in self.partitions:
+            self._create_partition(partition_id, members, priority)
+
+    def _stop_partition(self, partition_id: int) -> None:
+        partition = self.partitions.pop(partition_id, None)
+        if partition is not None:
+            partition.close()
+
+    def _request_reconfigure(self, partition_id: int, members: list[str]) -> None:
+        leader = self.known_leader(partition_id)
+        if leader is not None and leader != self.cfg.node_id:
+            self.messaging.send(leader, f"raft-reconfigure-{partition_id}",
+                                {"members": members, "from": self.cfg.node_id})
+        elif leader == self.cfg.node_id:
+            self._on_reconfigure_request(partition_id, self.cfg.node_id,
+                                         {"members": members})
+
+    def _on_reconfigure_request(self, partition_id: int, sender: str,
+                                payload: dict) -> None:
+        partition = self.partitions.get(partition_id)
+        if partition is not None and partition.is_leader:
+            partition.raft.reconfigure(payload["members"])
+            # confirm with the authoritative post-change membership so the
+            # requester can complete its topology operation even if the raft
+            # config entry never reaches it (e.g. it was the removed member)
+            requester = payload.get("from", sender)
+            if requester != self.cfg.node_id:
+                self.messaging.send(
+                    requester, f"raft-reconfigure-done-{partition_id}",
+                    {"members": partition.raft.members},
+                )
+
+    def _on_reconfigure_confirmed(self, partition_id: int, sender: str,
+                                  payload: dict) -> None:
+        self.topology.on_reconfigure_confirmed(partition_id, payload["members"])
 
     # -- command ingress -------------------------------------------------------
 
@@ -246,14 +321,15 @@ class Broker:
     def pump(self) -> int:
         """One scheduling round: raft timers, membership, partition work."""
         work = 0
-        for partition in self.partitions.values():
+        for partition in list(self.partitions.values()):
             partition.tick()
         self.membership.tick()
+        self.topology.tick()
         if self.disk_monitor is not None:
             disk_paused = self.disk_monitor.check()
             for partition in self.partitions.values():
                 partition.disk_paused = disk_paused
-        for partition in self.partitions.values():
+        for partition in list(self.partitions.values()):
             work += partition.pump()
         self._update_observability()
         self._gossip_roles()
@@ -424,6 +500,23 @@ class InProcessCluster:
         position = broker.write_command(partition_id, record)
         self.run(300)
         return position
+
+    def add_broker(self, node_id: str) -> Broker:
+        """Start a NEW broker that joins the running cluster with no
+        partitions of its own (the dynamic-topology entry point: move
+        partitions onto it with topology change operations afterwards)."""
+        seeds = sorted(self.brokers)
+        cfg = BrokerCfg(
+            node_id=node_id,
+            partition_count=next(iter(self.brokers.values())).cfg.partition_count,
+            replication_factor=next(iter(self.brokers.values())).cfg.replication_factor,
+            cluster_members=seeds,  # not itself: hosts nothing at bootstrap
+        )
+        broker = Broker(cfg, self.net.join(node_id),
+                        directory=self.directory / node_id,
+                        clock_millis=self.clock)
+        self.brokers[node_id] = broker
+        return broker
 
     def close(self) -> None:
         for broker in self.brokers.values():

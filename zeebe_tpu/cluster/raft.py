@@ -62,6 +62,7 @@ class RaftNode:
         self.member_id = messaging.member_id
         self.partition_id = partition_id
         self.members = sorted(members)
+        self._bootstrap_members = sorted(members)
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.clock_millis = clock_millis
@@ -113,6 +114,7 @@ class RaftNode:
         self._votes: set[str] = set()
         self._prevotes: set[str] = set()
 
+        self.leader_commit_hint = 0
         self.role_listeners: list[Callable[[RaftRole, int], None]] = []
         self.commit_listeners: list[Callable[[int], None]] = []
         # snapshot provider: () -> (index, term, bytes) | None — installed by
@@ -134,6 +136,10 @@ class RaftNode:
             meta = json.loads(self._meta_path.read_text())
             self.current_term = meta["term"]
             self.voted_for = meta["votedFor"]
+            # a reconfigured membership survives restart (the bootstrap list
+            # is only the initial configuration)
+            if meta.get("members"):
+                self.members = sorted(meta["members"])
 
     def _store_meta(self) -> None:
         # temp-file + fsync + atomic rename: a crash mid-write must never
@@ -141,7 +147,8 @@ class RaftNode:
         # (double-vote safety) — reference MetaStore semantics
         tmp = self._meta_path.with_suffix(".json.tmp")
         with open(tmp, "w") as f:
-            f.write(json.dumps({"term": self.current_term, "votedFor": self.voted_for}))
+            f.write(json.dumps({"term": self.current_term, "votedFor": self.voted_for,
+                                "members": self.members}))
             f.flush()
             if self.flush_policy != "none":
                 os.fsync(f.fileno())
@@ -169,10 +176,32 @@ class RaftNode:
         self._flush_dirty = False
 
     def _truncate_after(self, index: int) -> None:
+        had_config_after = any(
+            e.get("config") for e in self._entries_from(index + 1)
+        )
         self.journal.truncate_after(index)
         # conflicting entries re-appended on top of a truncation must be
         # fsynced again even when the log lands back on the old flushed index
         self._flushed_index = min(self._flushed_index, index)
+        if had_config_after:
+            # configs apply on APPEND; truncating one away must revert to the
+            # last surviving configuration (Raft single-step change rule)
+            self._apply_config(self._latest_logged_config())
+
+    def _entries_from(self, from_index: int) -> list[dict]:
+        out = []
+        for rec in self.journal.read_from(from_index):
+            entry = unpackb(rec.data)
+            entry["index"] = rec.index
+            out.append(entry)
+        return out
+
+    def _latest_logged_config(self) -> list[str]:
+        latest = self._bootstrap_members
+        for entry in self._entries_from(self.snapshot_index + 1):
+            if entry.get("config"):
+                latest = entry["config"]
+        return latest
 
     def _reset_journal(self, next_index: int) -> None:
         self.journal.reset(next_index)
@@ -273,6 +302,10 @@ class RaftNode:
             })
 
     def _on_vote_request(self, sender: str, req: dict) -> None:
+        if sender not in self.members:
+            # an ex-member removed by reconfiguration (possibly before it
+            # learned of the removal) must not be able to bump our terms
+            return
         term = req["term"]
         up_to_date = (
             req["lastLogTerm"] > self._last_log_term()
@@ -344,6 +377,50 @@ class RaftNode:
         self._broadcast_appends()
 
     # -- write ingress (ZeebeLogAppender.appendEntry equivalent) ---------------
+
+    def reconfigure(self, new_members: list[str]) -> bool:
+        """Leader-only single-step membership change (reference: Raft §4.1
+        single-server changes; the atomix ConfigurationEntry): appends a
+        config entry and applies it IMMEDIATELY on append — both leader and
+        followers switch to the new configuration as soon as the entry is in
+        their log, not at commit (the Raft paper's rule). One change at a
+        time is the coordinator's job (topology change plans are serialized),
+        which is what makes single-step changes safe."""
+        if self.role != RaftRole.LEADER:
+            return False
+        new_members = sorted(new_members)
+        if new_members == self.members:
+            return True
+        self._append_local({
+            "term": self.current_term, "init": False, "asqn": -1, "data": b"",
+            "config": new_members,
+        })
+        self._after_local_append()
+        # broadcast BEFORE applying: members being removed must still receive
+        # the config entry (it is how they learn they left); only then shrink
+        # the replication targets
+        self._broadcast_appends()
+        self._apply_config(new_members)
+        return True
+
+    def _apply_config(self, members: list[str]) -> None:
+        self.members = sorted(members)
+        self._store_meta()
+        if self.role == RaftRole.LEADER:
+            last = self._last_log_index()
+            for m in self._other_members():
+                self.next_index.setdefault(m, last + 1)
+                self.match_index.setdefault(m, 0)
+            for m in list(self.next_index):
+                if m not in self.members:
+                    del self.next_index[m]
+                    self.match_index.pop(m, None)
+            if self.member_id not in self.members:
+                # removed myself: hand off by reverting to follower; the rest
+                # of the group elects among themselves
+                self._become(RaftRole.FOLLOWER)
+            else:
+                self._advance_commit()  # quorum size may have shrunk
 
     def append(self, data: bytes, asqn: int = -1,
                on_commit: Callable[[int], None] | None = None) -> int | None:
@@ -428,6 +505,9 @@ class RaftNode:
                 self._truncate_after(index - 1)
                 self._append_at(index, entry)
         self._after_local_append()  # flush BEFORE acking (Raft durability)
+        # the leader's commit index as last advertised — lets a joining
+        # replica detect when it has fully caught up (topology PARTITION_JOIN)
+        self.leader_commit_hint = max(self.leader_commit_hint, req["commit"])
         if req["commit"] > self.commit_index:
             self._set_commit(min(req["commit"], self._last_log_index()))
         self._send(sender, "append-resp", {
@@ -444,6 +524,8 @@ class RaftNode:
                 # gap after snapshot install: reset the journal base
                 self._reset_journal(index)
         self._append_local(entry)
+        if entry.get("config"):
+            self._apply_config(entry["config"])
 
     def _on_append_response(self, sender: str, resp: dict) -> None:
         if resp["term"] > self.current_term:
