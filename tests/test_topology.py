@@ -193,6 +193,49 @@ class TestClusterScaleOut:
         finally:
             c.close()
 
+    def test_moved_partition_survives_broker_restart(self, tmp_path):
+        """The topology document persists: a broker restarted after a
+        PARTITION_JOIN must restart the moved replica, not just the static
+        bootstrap distribution."""
+        from zeebe_tpu.broker import Broker, BrokerCfg
+
+        c = InProcessCluster(broker_count=2, partition_count=2,
+                             replication_factor=2, directory=tmp_path)
+        try:
+            c.await_leaders()
+            new = c.add_broker("broker-2")
+            run_until(c, lambda: any(
+                m.member_id == "broker-2"
+                for m in c.brokers["broker-0"].membership.alive_members()
+            ))
+            coordinator = c.brokers["broker-0"].topology
+            assert coordinator.propose([
+                coordinator.join_member("broker-2"),
+                coordinator.join_partition("broker-2", 2, priority=5),
+            ])
+            run_until(c, lambda: (
+                2 in new.partitions
+                and all(b.topology.topology.change is None
+                        for b in c.brokers.values())
+            ), rounds=120)
+
+            # restart broker-2 from its directory: the moved replica returns
+            cfg = new.cfg
+            new.close()
+            del c.brokers["broker-2"]
+            c.net.leave("broker-2") if hasattr(c.net, "leave") else None
+            restarted = Broker(cfg, c.net.join("broker-2"),
+                               directory=tmp_path / "broker-2",
+                               clock_millis=c.clock)
+            c.brokers["broker-2"] = restarted
+            assert 2 in restarted.partitions
+            assert restarted.partitions[2].raft.members == sorted(
+                set(["broker-0", "broker-1", "broker-2"])
+            ) or "broker-2" in restarted.partitions[2].raft.members
+            run_until(c, lambda: c.leader_broker(2) is not None)
+        finally:
+            c.close()
+
     def test_member_leave_requires_empty_member(self):
         c = InProcessCluster(broker_count=2, partition_count=1,
                              replication_factor=1)

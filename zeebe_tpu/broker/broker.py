@@ -112,6 +112,7 @@ class Broker:
             self._tmp = tempfile.TemporaryDirectory()
             directory = self._tmp.name
         self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
         self.clock_millis = clock_millis or (lambda: int(time.time() * 1000))
         self.disk_monitor = (
             DiskSpaceMonitor(self.directory, disk_min_free_bytes,
@@ -154,15 +155,13 @@ class Broker:
         self._backup_service = backup_service
         self._backpressure_algorithm = backpressure_algorithm
         self._backpressure_enabled = backpressure_enabled
-        distribution = partition_distribution(cfg)
-        for partition_id, members in distribution.items():
-            if cfg.node_id in members:
-                self._create_partition(partition_id, members)
         # dynamic topology: gossiped versioned document + change plans
         # (reference: topology/ClusterTopologyManager); bootstrapped from the
-        # static distribution, mutated at runtime through change operations
+        # static distribution on first start, RESTORED from disk afterwards —
+        # a restart must not forget partitions that were moved here at runtime
         from zeebe_tpu.cluster.topology import TopologyManager
 
+        self._topology_path = self.directory / "topology.json"
         self.topology = TopologyManager(
             cfg.node_id, self.membership,
             start_replica=self._create_partition_for_join,
@@ -171,8 +170,36 @@ class Broker:
                 self.partitions[pid].raft if pid in self.partitions else None
             ),
             request_reconfigure=self._request_reconfigure,
+            persist=self._persist_topology,
         )
-        self.topology.bootstrap(distribution, sorted(cfg.cluster_members))
+        saved = self._load_topology()
+        if saved is not None:
+            self.topology.restore(saved)
+            for pid, (members, priority) in self.topology.own_partitions().items():
+                self._create_partition(pid, members, priority)
+        else:
+            distribution = partition_distribution(cfg)
+            for partition_id, members in distribution.items():
+                if cfg.node_id in members:
+                    self._create_partition(partition_id, members)
+            self.topology.bootstrap(distribution, sorted(cfg.cluster_members))
+
+    def _persist_topology(self, doc: dict) -> None:
+        import json
+
+        tmp = self._topology_path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(doc))
+        tmp.replace(self._topology_path)
+
+    def _load_topology(self) -> dict | None:
+        import json
+
+        if not self._topology_path.exists():
+            return None
+        try:
+            return json.loads(self._topology_path.read_text())
+        except (OSError, ValueError):
+            return None
 
     def _create_partition(self, partition_id: int, members: list[str],
                           priority: int = 1) -> None:
@@ -222,34 +249,61 @@ class Broker:
         if partition_id not in self.partitions:
             self._create_partition(partition_id, members, priority)
 
+    _PARTITION_TOPICS = (
+        "{t}-vote", "{t}-vote-resp", "{t}-append", "{t}-append-resp",
+        "{t}-snapshot",
+    )
+
     def _stop_partition(self, partition_id: int) -> None:
         partition = self.partitions.pop(partition_id, None)
-        if partition is not None:
-            partition.close()
+        if partition is None:
+            return
+        # drop every handler first: a straggler raft message must never
+        # dispatch into a replica whose journals are closed
+        raft_topic = f"raft-{partition_id}"
+        for template in self._PARTITION_TOPICS:
+            self.messaging.unsubscribe(template.format(t=raft_topic))
+        for topic in (f"{INTER_PARTITION_TOPIC}-{partition_id}",
+                      f"{COMMAND_API_TOPIC}-{partition_id}",
+                      f"raft-reconfigure-{partition_id}",
+                      f"raft-reconfigure-done-{partition_id}"):
+            self.messaging.unsubscribe(topic)
+        self.health_monitor.deregister(f"partition-{partition_id}")
+        partition.close()
 
-    def _request_reconfigure(self, partition_id: int, members: list[str]) -> None:
+    def _request_reconfigure(self, partition_id: int, change: dict) -> None:
         leader = self.known_leader(partition_id)
+        payload = {**change, "from": self.cfg.node_id}
         if leader is not None and leader != self.cfg.node_id:
-            self.messaging.send(leader, f"raft-reconfigure-{partition_id}",
-                                {"members": members, "from": self.cfg.node_id})
+            self.messaging.send(leader, f"raft-reconfigure-{partition_id}", payload)
         elif leader == self.cfg.node_id:
-            self._on_reconfigure_request(partition_id, self.cfg.node_id,
-                                         {"members": members})
+            self._on_reconfigure_request(partition_id, self.cfg.node_id, payload)
 
     def _on_reconfigure_request(self, partition_id: int, sender: str,
                                 payload: dict) -> None:
+        """Reconfigure INTENT ({"add": m} / {"remove": m}): the leader derives
+        the new member list from its OWN configuration — a requester with a
+        stale view must never shrink the group past its intent."""
         partition = self.partitions.get(partition_id)
-        if partition is not None and partition.is_leader:
-            partition.raft.reconfigure(payload["members"])
-            # confirm with the authoritative post-change membership so the
-            # requester can complete its topology operation even if the raft
-            # config entry never reaches it (e.g. it was the removed member)
-            requester = payload.get("from", sender)
-            if requester != self.cfg.node_id:
-                self.messaging.send(
-                    requester, f"raft-reconfigure-done-{partition_id}",
-                    {"members": partition.raft.members},
-                )
+        if partition is None or not partition.is_leader:
+            return
+        raft = partition.raft
+        members = set(raft.members)
+        if payload.get("add"):
+            members.add(payload["add"])
+        if payload.get("remove"):
+            members.discard(payload["remove"])
+        if len(members) >= 1:
+            raft.reconfigure(sorted(members))
+        # confirm with the authoritative post-change membership so the
+        # requester can complete its topology operation even if the raft
+        # config entry never reaches it (e.g. it was the removed member)
+        requester = payload.get("from", sender)
+        if requester != self.cfg.node_id:
+            self.messaging.send(
+                requester, f"raft-reconfigure-done-{partition_id}",
+                {"members": raft.members},
+            )
 
     def _on_reconfigure_confirmed(self, partition_id: int, sender: str,
                                   payload: dict) -> None:

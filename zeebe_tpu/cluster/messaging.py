@@ -15,12 +15,15 @@ over TCP. Two implementations:
 from __future__ import annotations
 
 import asyncio
+import logging
 import struct
 import threading
 from collections import deque
 from typing import Any, Callable
 
 from zeebe_tpu.protocol.msgpack import packb, unpackb
+
+logger = logging.getLogger("zeebe_tpu.messaging")
 
 # handler(sender_id, payload) -> reply payload | None
 Handler = Callable[[str, Any], Any]
@@ -32,6 +35,11 @@ class MessagingService:
     member_id: str
 
     def subscribe(self, topic: str, handler: Handler) -> None:
+        raise NotImplementedError
+
+    def unsubscribe(self, topic: str) -> None:
+        """Drop a topic's handler (stopping a partition replica must not
+        leave handlers that dispatch into closed journals)."""
         raise NotImplementedError
 
     def send(self, member_id: str, topic: str, payload: Any) -> None:
@@ -47,6 +55,9 @@ class LoopbackMessaging(MessagingService):
 
     def subscribe(self, topic: str, handler: Handler) -> None:
         self.handlers[topic] = handler
+
+    def unsubscribe(self, topic: str) -> None:
+        self.handlers.pop(topic, None)
 
     def send(self, member_id: str, topic: str, payload: Any) -> None:
         self.network.enqueue(self.member_id, member_id, topic, payload)
@@ -148,6 +159,9 @@ class TcpMessagingService(MessagingService):
     def subscribe(self, topic: str, handler: Handler) -> None:
         self.handlers[topic] = handler
 
+    def unsubscribe(self, topic: str) -> None:
+        self.handlers.pop(topic, None)
+
     # -- lifecycle ------------------------------------------------------------
 
     def start(self) -> None:
@@ -201,7 +215,11 @@ class TcpMessagingService(MessagingService):
                 topic, sender, payload = self._inbox.popleft()
             handler = self.handlers.get(topic)
             if handler is not None:
-                handler(sender, payload)
+                try:
+                    handler(sender, payload)
+                except Exception:  # noqa: BLE001 — a bad frame or a handler
+                    # racing a closed component must not kill the pump thread
+                    logger.exception("handler for %s failed", topic)
             count += 1
         return count
 

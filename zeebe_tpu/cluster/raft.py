@@ -63,6 +63,11 @@ class RaftNode:
         self.partition_id = partition_id
         self.members = sorted(members)
         self._bootstrap_members = sorted(members)
+        # configuration in effect at the journal's base (snapshot boundary):
+        # the truncation-rollback fallback when no config entry survives in
+        # the log suffix
+        self._config_base = sorted(members)
+        self._last_config_index = 0
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.clock_millis = clock_millis
@@ -186,7 +191,9 @@ class RaftNode:
         if had_config_after:
             # configs apply on APPEND; truncating one away must revert to the
             # last surviving configuration (Raft single-step change rule)
-            self._apply_config(self._latest_logged_config())
+            members, config_index = self._latest_logged_config()
+            self._last_config_index = config_index
+            self._apply_config(members)
 
     def _entries_from(self, from_index: int) -> list[dict]:
         out = []
@@ -196,16 +203,19 @@ class RaftNode:
             out.append(entry)
         return out
 
-    def _latest_logged_config(self) -> list[str]:
-        latest = self._bootstrap_members
+    def _latest_logged_config(self) -> tuple[list[str], int]:
+        latest, index = self._config_base, 0
         for entry in self._entries_from(self.snapshot_index + 1):
             if entry.get("config"):
-                latest = entry["config"]
-        return latest
+                latest, index = entry["config"], entry["index"]
+        return latest, index
 
     def _reset_journal(self, next_index: int) -> None:
         self.journal.reset(next_index)
         self._flushed_index = min(self._flushed_index, next_index - 1)
+        # the log prefix (and any config entries in it) is gone: the current
+        # membership becomes the configuration base for rollbacks
+        self._config_base = list(self.members)
 
     def close(self) -> None:
         if self.flush_policy != "none":
@@ -391,6 +401,12 @@ class RaftNode:
         new_members = sorted(new_members)
         if new_members == self.members:
             return True
+        if self._last_config_index > self.commit_index:
+            # single-step changes are only safe one at a time: the previous
+            # configuration must commit before the next is appended (callers
+            # retry on their next tick)
+            return False
+        self._last_config_index = self._last_log_index() + 1
         self._append_local({
             "term": self.current_term, "init": False, "asqn": -1, "data": b"",
             "config": new_members,
@@ -525,6 +541,7 @@ class RaftNode:
                 self._reset_journal(index)
         self._append_local(entry)
         if entry.get("config"):
+            self._last_config_index = index
             self._apply_config(entry["config"])
 
     def _on_append_response(self, sender: str, resp: dict) -> None:

@@ -120,8 +120,11 @@ class TopologyManager:
       replica whose raft group is ``members``
     - stop_replica(partition_id): tear down the local replica
     - raft_of(partition_id) -> RaftNode | None
-    - request_reconfigure(partition_id, members): deliver a reconfigure
-      request to the partition's current leader (messaging topic)
+    - request_reconfigure(partition_id, change): deliver a reconfigure
+      intent ({"add": member} or {"remove": member}) to the partition's
+      current leader, which computes the new member list from its OWN
+      configuration (a requester with a stale view must not be able to
+      drop other replicas)
     """
 
     GOSSIP_PROPERTY = "topology"
@@ -130,13 +133,15 @@ class TopologyManager:
                  start_replica: Callable[[int, list[str], int], None],
                  stop_replica: Callable[[int], None],
                  raft_of: Callable[[int], Any],
-                 request_reconfigure: Callable[[int, list[str]], None]) -> None:
+                 request_reconfigure: Callable[[int, dict], None],
+                 persist: Callable[[dict], None] | None = None) -> None:
         self.member_id = member_id
         self.membership = membership
         self.start_replica = start_replica
         self.stop_replica = stop_replica
         self.raft_of = raft_of
         self.request_reconfigure = request_reconfigure
+        self.persist = persist or (lambda doc: None)
         self.topology = ClusterTopology()
         self._dirty = True
         # local progress markers for the in-flight operation (avoid repeating
@@ -151,12 +156,39 @@ class TopologyManager:
         self.topology = ClusterTopology.initial(distribution, members)
         self._dirty = True
 
+    def restore(self, doc: dict) -> None:
+        """Boot from a persisted topology document (a restart must not forget
+        partitions that were moved onto this member at runtime)."""
+        self.topology = ClusterTopology(copy.deepcopy(doc))
+        self._dirty = True
+
+    def own_partitions(self) -> dict[int, tuple[list[str], int]]:
+        """partition id → (replica member list, priority) for every partition
+        this member hosts per the topology document."""
+        me = self.topology.members.get(self.member_id, {})
+        out = {}
+        for pid_str, p in me.get("partitions", {}).items():
+            pid = int(pid_str)
+            out[pid] = (self.topology.partition_members(pid), p.get("priority", 1))
+        return out
+
+    def coordinator(self) -> str | None:
+        """The change coordinator: the lowest active member id (reference
+        designates a single coordinator; enforcing it here is what keeps
+        versions totally ordered under concurrent proposals)."""
+        active = [m for m, s in self.topology.members.items()
+                  if s.get("state") == ACTIVE]
+        return min(active) if active else None
+
     # -- change proposal (coordinator API) ------------------------------------
 
     def propose(self, operations: list[dict]) -> bool:
         """Install a change plan (reference: TopologyChangeCoordinator). One
-        at a time: rejected while another change is in flight."""
+        at a time, and only on the coordinator member — both rejections keep
+        topology versions totally ordered."""
         if self.topology.change is not None:
+            return False
+        if self.coordinator() != self.member_id:
             return False
         topo = self.topology
         topo.doc["change"] = {
@@ -202,6 +234,7 @@ class TopologyManager:
         if self._dirty:
             self.membership.set_property(self.GOSSIP_PROPERTY,
                                          copy.deepcopy(self.topology.doc))
+            self.persist(self.topology.doc)
             self._dirty = False
 
     # -- tick ------------------------------------------------------------------
@@ -272,9 +305,8 @@ class TopologyManager:
             # list already contains us (we bootstrapped with it), so the only
             # reliable join signal is an append from the leader. Keep asking
             # for the reconfiguration until then (idempotent on the leader:
-            # an unchanged member list is a no-op).
-            members = sorted(set(raft.members) | {self.member_id})
-            self.request_reconfigure(pid, members)
+            # adding an existing member is a no-op).
+            self.request_reconfigure(pid, {"add": self.member_id})
             return False
         # in contact: complete once caught up with the leader's commit
         if raft.commit_index < raft.leader_commit_hint:
@@ -298,15 +330,16 @@ class TopologyManager:
         if not removed:
             if len(raft.members) == 1:
                 return False  # refuse to orphan the partition
-            members = sorted(m for m in raft.members if m != self.member_id)
             if raft.role.name == "LEADER":
-                raft.reconfigure(members)
+                raft.reconfigure(sorted(
+                    m for m in raft.members if m != self.member_id
+                ))
             else:
                 # retry every tick (idempotent on the leader): the request is
                 # dropped when no leader is known, and the config entry that
                 # tells us we left can be lost — the leader's confirmation
                 # reply (on_reconfigure_confirmed) is the durable signal
-                self.request_reconfigure(pid, members)
+                self.request_reconfigure(pid, {"remove": self.member_id})
             if str(pid) in me.get("partitions", {}):
                 me["partitions"][str(pid)]["state"] = LEAVING
                 self._dirty = True

@@ -39,7 +39,57 @@ class ResourceExhaustedError(Exception):
     pass
 
 
-class ClusterRuntime:
+class GatewayRuntimeBase:
+    """Shared request plumbing for gateway runtimes (in-process and TCP):
+    the nonce'd request-id sequence, the pending/response correlation table,
+    and the partition-selection helpers."""
+
+    def _init_requests(self) -> None:
+        self._round_robin = itertools.count()
+        # request ids carry a startup nonce in the high bits: a restarted
+        # gateway must never resolve a backlog command's stale request_id
+        # against a fresh in-flight request
+        nonce = int(time.time() * 1000) & 0x3FFFFF
+        self._request_seq = itertools.count((nonce << 32) + 1)
+        self._pending: dict[int, threading.Event] = {}
+        self._responses: dict[int, Record] = {}
+
+    def _register_request(self) -> tuple[int, threading.Event]:
+        request_id = next(self._request_seq)
+        event = threading.Event()
+        self._pending[request_id] = event
+        return request_id, event
+
+    def _resolve_request(self, request_id: int, record: Record) -> None:
+        event = self._pending.get(request_id)
+        if event is not None:
+            self._responses[request_id] = record
+            event.set()
+
+    def _take_response(self, request_id: int, event: threading.Event,
+                       deadline: float, partition_id: int, timeout_s: float) -> Record:
+        try:
+            if not event.wait(max(deadline - time.time(), 0.001)):
+                raise RequestTimeoutError(
+                    f"partition {partition_id} did not respond in {timeout_s}s"
+                )
+            return self._responses.pop(request_id)
+        finally:
+            self._pending.pop(request_id, None)
+            self._responses.pop(request_id, None)
+
+    def partition_for_new_instance(self) -> int:
+        return next(self._round_robin) % self.partition_count + 1
+
+    def partition_for_correlation_key(self, key: str) -> int:
+        return subscription_partition_id(key, self.partition_count)
+
+    @staticmethod
+    def partition_for_key(key: int) -> int:
+        return decode_partition_id(key)
+
+
+class ClusterRuntime(GatewayRuntimeBase):
     """Owns N in-process brokers and the pump thread; thread-safe ingress."""
 
     def __init__(self, broker_count: int = 1, partition_count: int = 1,
@@ -52,14 +102,7 @@ class ClusterRuntime:
         self.partition_count = partition_count
         self.net = LoopbackNetwork()
         self._lock = threading.RLock()
-        self._round_robin = itertools.count()
-        # request ids carry a startup nonce in the high bits: a restarted
-        # gateway must never resolve a backlog command's stale request_id
-        # against a fresh in-flight request
-        nonce = int(time.time() * 1000) & 0x3FFFFF
-        self._request_seq = itertools.count((nonce << 32) + 1)
-        self._pending: dict[int, threading.Event] = {}
-        self._responses: dict[int, Record] = {}
+        self._init_requests()
         members = [f"broker-{i}" for i in range(broker_count)]
         self.brokers: dict[str, Broker] = {}
         from pathlib import Path
@@ -136,12 +179,6 @@ class ClusterRuntime:
 
     # -- partition selection ---------------------------------------------------
 
-    def partition_for_new_instance(self) -> int:
-        return next(self._round_robin) % self.partition_count + 1
-
-    def partition_for_correlation_key(self, key: str) -> int:
-        return subscription_partition_id(key, self.partition_count)
-
     def has_activatable_jobs(self, partition_id: int, job_type: str) -> bool:
         """Long-poll peek: checks the leader's state without writing a
         JOB_BATCH ACTIVATE into the replicated log (reference:
@@ -153,52 +190,37 @@ class ClusterRuntime:
             with leader.db.transaction():
                 return bool(leader.engine.state.jobs.activatable_keys(job_type, 1))
 
-    @staticmethod
-    def partition_for_key(key: int) -> int:
-        return decode_partition_id(key)
-
     # -- request path ----------------------------------------------------------
 
     def submit(self, partition_id: int, record: Record,
                timeout_s: float = 10.0) -> Record:
         """Write a command to the partition leader, await the engine response
         (retrying on leader miss — RequestRetryHandler semantics)."""
-        request_id = next(self._request_seq)
-        event = threading.Event()
-        self._pending[request_id] = event
+        from zeebe_tpu.broker.partition import BackpressureExceeded
+
+        request_id, event = self._register_request()
         rec = record.replace(request_id=request_id, request_stream_id=0)
         deadline = time.time() + timeout_s
-        try:
-            from zeebe_tpu.broker.partition import BackpressureExceeded
-
-            written = False
-            while time.time() < deadline:
-                with self._lock:
-                    leader = self._leader_partition(partition_id)
-                    if leader is not None:
-                        try:
-                            if leader.client_write(rec) is not None:
-                                written = True
-                        except BackpressureExceeded as exc:
-                            raise ResourceExhaustedError(str(exc)) from exc
-                if written:
-                    break
-                time.sleep(0.01)
-            if not written:
-                raise NoLeaderError(f"no leader for partition {partition_id}")
-            if not event.wait(max(deadline - time.time(), 0.001)):
-                raise RequestTimeoutError(
-                    f"partition {partition_id} did not respond in {timeout_s}s"
-                )
-            return self._responses.pop(request_id)
-        finally:
+        written = False
+        while time.time() < deadline:
+            with self._lock:
+                leader = self._leader_partition(partition_id)
+                if leader is not None:
+                    try:
+                        if leader.client_write(rec) is not None:
+                            written = True
+                    except BackpressureExceeded as exc:
+                        self._pending.pop(request_id, None)
+                        raise ResourceExhaustedError(str(exc)) from exc
+            if written:
+                break
+            time.sleep(0.01)
+        if not written:
             self._pending.pop(request_id, None)
-            self._responses.pop(request_id, None)
+            raise NoLeaderError(f"no leader for partition {partition_id}")
+        return self._take_response(request_id, event, deadline, partition_id, timeout_s)
 
     def _resolve(self, response) -> None:
-        event = self._pending.get(response.request_id)
-        if event is not None:
-            self._responses[response.request_id] = response.record
-            event.set()
+        self._resolve_request(response.request_id, response.record)
 
 

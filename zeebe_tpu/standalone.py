@@ -1,9 +1,19 @@
 """Standalone broker+gateway app (reference: dist/…/StandaloneBroker.java with
-embedded gateway): boots an in-process cluster runtime and serves the gRPC
-client API.
+embedded gateway): boots a cluster runtime and serves the gRPC client API.
 
-Usage: python -m zeebe_tpu.standalone [--port 26500] [--partitions 3]
-       [--brokers 1] [--replication 1] [--data-dir DIR]
+Two deployment shapes:
+
+- in-process (default): N brokers in ONE process over the loopback network —
+  the single-machine / dev shape.
+  ``python -m zeebe_tpu.standalone --brokers 3 --partitions 3``
+
+- multi-process over TCP: ONE broker per process; Raft, membership gossip,
+  inter-partition commands, and gateway request routing all ride TCP
+  (reference: a real deployed cluster of StandaloneBroker instances).
+  ``python -m zeebe_tpu.standalone --node-id broker-0 \
+       --bind 127.0.0.1:26601 \
+       --contact broker-0=127.0.0.1:26601,broker-1=127.0.0.1:26602,... \
+       --partitions 3 --replication 3 --port 26500 --data-dir /data/b0``
 """
 
 from __future__ import annotations
@@ -12,6 +22,15 @@ import argparse
 import signal
 import sys
 import threading
+
+
+def _parse_contacts(spec: str) -> dict[str, tuple[str, int]]:
+    out: dict[str, tuple[str, int]] = {}
+    for part in spec.split(","):
+        name, addr = part.split("=", 1)
+        host, port = addr.rsplit(":", 1)
+        out[name.strip()] = (host.strip(), int(port))
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -23,10 +42,56 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--data-dir", default=None)
     parser.add_argument("--management-port", type=int, default=0,
                         help="health/metrics/admin HTTP port (0 = disabled)")
+    parser.add_argument("--node-id", default=None,
+                        help="this broker's member id (enables the "
+                             "multi-process TCP cluster mode)")
+    parser.add_argument("--bind", default=None,
+                        help="host:port for cluster TCP messaging")
+    parser.add_argument("--contact", default=None,
+                        help="comma-separated member=host:port initial "
+                             "contact points (including this node)")
     args = parser.parse_args(argv)
 
     from zeebe_tpu.broker.config import load_broker_cfg
     from zeebe_tpu.gateway import ClusterRuntime, Gateway
+
+    if args.node_id is not None:
+        if not args.bind or not args.contact:
+            parser.error("--node-id requires --bind and --contact")
+        from zeebe_tpu.gateway.tcp_runtime import TcpClusterRuntime
+
+        host, port = args.bind.rsplit(":", 1)
+        contacts = _parse_contacts(args.contact)
+        peers = {m: a for m, a in contacts.items() if m != args.node_id}
+        runtime = TcpClusterRuntime(
+            args.node_id, (host, int(port)), peers,
+            partition_count=args.partitions,
+            replication_factor=args.replication,
+            directory=args.data_dir,
+        )
+        runtime.start()
+        gateway = Gateway(runtime, bind=f"0.0.0.0:{args.port}")
+        gateway.start()
+        print(f"[{args.node_id}] gateway on {gateway.address}, cluster bind "
+              f"{args.bind}", file=sys.stderr, flush=True)
+        management = None
+        if args.management_port:
+            from zeebe_tpu.broker.management import ManagementServer
+
+            management = ManagementServer(
+                runtime.broker, bind=("0.0.0.0", args.management_port),
+            )
+            management.start()
+            print(f"management on :{management.port}", file=sys.stderr, flush=True)
+        stop = threading.Event()
+        signal.signal(signal.SIGINT, lambda *a: stop.set())
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        stop.wait()
+        if management is not None:
+            management.stop()
+        gateway.stop()
+        runtime.stop()
+        return 0
 
     # ZEEBE_BROKER_* env vars bind first; explicit CLI flags override
     overrides = {}

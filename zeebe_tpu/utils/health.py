@@ -48,6 +48,11 @@ class CriticalComponentsHealthMonitor:
     def add_listener(self, listener: Callable[[HealthReport], None]) -> None:
         self._listeners.append(listener)
 
+    def deregister(self, component: str) -> None:
+        """Forget a component (e.g. a partition replica moved off this node);
+        its last report must not pin the aggregate health forever."""
+        self._components.pop(component, None)
+
     def report(self, component: str, status: HealthStatus, message: str = "") -> None:
         previous = self._components.get(component)
         report = HealthReport(component, status, message)
