@@ -149,6 +149,9 @@ class Broker:
         else:
             self.backup_store = None
         self.partitions: dict[int, ZeebePartition] = {}
+        # gateway-facing jobs-available listener (runtime hub); assignable
+        # after construction — partitions route through the indirection below
+        self.jobs_listener: Callable[[int, set], None] | None = None
         self._sender = ClusterInterPartitionSender(self)
         self._exporters_factory = exporters_factory
         self._response_sink = sink
@@ -222,6 +225,7 @@ class Broker:
             on_checkpoint=self._observe_checkpoint,
             backpressure=limiter,
             priority=priority,
+            on_jobs_available=self._on_jobs_available,
         )
         self.health_monitor.register(f"partition-{partition_id}")
         self.messaging.subscribe(
@@ -343,6 +347,10 @@ class Broker:
         if partition is None or not partition.is_leader:
             return None
         return partition.client_write(record)
+
+    def _on_jobs_available(self, partition_id: int, job_types: set) -> None:
+        if self.jobs_listener is not None:
+            self.jobs_listener(partition_id, job_types)
 
     # -- topology --------------------------------------------------------------
 

@@ -75,6 +75,7 @@ class ZeebePartition:
         backup_service=None,
         on_checkpoint=None,
         backpressure=None,
+        on_jobs_available=None,
     ) -> None:
         self.partition_id = partition_id
         self.partition_count = partition_count
@@ -90,6 +91,9 @@ class ZeebePartition:
         self.consistency_checks = consistency_checks
         self.backup_service = backup_service  # BackupService | None
         self.on_checkpoint = on_checkpoint  # broker cache-bump hook
+        # jobs-available side effect: (partition_id, {job types}) → broker →
+        # gateway hub (long-poll wakeup + job push dispatch)
+        self.on_jobs_available = on_jobs_available
         # client-ingress backpressure (CommandRateLimiter | None) and the
         # disk-monitor pause flag; both gate client_write only — follow-ups,
         # scheduled commands, and inter-partition traffic always pass
@@ -165,6 +169,11 @@ class ZeebePartition:
             response_sink=self.response_sink, clock_millis=self.clock_millis,
             writer=_RaftWriter(self),
         )
+        if self.on_jobs_available is not None:
+            listener = self.on_jobs_available
+            self.processor.on_jobs_available = (
+                lambda types, pid=self.partition_id: listener(pid, types)
+            )
         self.processor.start()
         self.checkers = DueDateCheckers(
             self.engine.state, self.processor.schedule_service, self.clock_millis

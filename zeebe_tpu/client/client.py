@@ -96,6 +96,7 @@ class ZeebeTpuClient:
         self._fail = _method(c, "FailJob", pb.FailJobRequest, pb.FailJobResponse)
         self._throw = _method(c, "ThrowError", pb.ThrowErrorRequest, pb.ThrowErrorResponse)
         self._retries = _method(c, "UpdateJobRetries", pb.UpdateJobRetriesRequest, pb.UpdateJobRetriesResponse)
+        self._update_timeout = _method(c, "UpdateJobTimeout", pb.UpdateJobTimeoutRequest, pb.UpdateJobTimeoutResponse)
         self._set_vars = _method(c, "SetVariables", pb.SetVariablesRequest, pb.SetVariablesResponse)
         self._resolve = _method(c, "ResolveIncident", pb.ResolveIncidentRequest, pb.ResolveIncidentResponse)
         self._signal = _method(c, "BroadcastSignal", pb.BroadcastSignalRequest, pb.BroadcastSignalResponse)
@@ -231,6 +232,26 @@ class ZeebeTpuClient:
         )):
             yield _job_of(j)
 
+    def open_job_stream(self, job_type: str, worker: str = "python-client",
+                        timeout_ms: int = 300_000):
+        """StreamActivatedJobs with a cancellation handle: returns
+        ``(call, jobs)`` where ``call.cancel()`` ends the stream and ``jobs``
+        iterates ActivatedJob (the streaming JobWorker's ingress). The
+        iterator ends cleanly on cancellation."""
+        call = self._stream_jobs(pb.StreamActivatedJobsRequest(
+            type=job_type, worker=worker, timeout=timeout_ms,
+        ))
+
+        def _jobs():
+            try:
+                for j in call:
+                    yield _job_of(j)
+            except grpc.RpcError as exc:
+                if exc.code() != grpc.StatusCode.CANCELLED:
+                    raise
+
+        return call, _jobs()
+
     def complete_job(self, job_key: int, variables: dict | None = None) -> None:
         self._complete(pb.CompleteJobRequest(
             jobKey=job_key, variables=json.dumps(variables or {})))
@@ -248,6 +269,10 @@ class ZeebeTpuClient:
 
     def update_job_retries(self, job_key: int, retries: int) -> None:
         self._retries(pb.UpdateJobRetriesRequest(jobKey=job_key, retries=retries))
+
+    def update_job_timeout(self, job_key: int, timeout_ms: int) -> None:
+        self._update_timeout(pb.UpdateJobTimeoutRequest(
+            jobKey=job_key, timeout=timeout_ms))
 
     # -- variables / incidents -------------------------------------------------
 

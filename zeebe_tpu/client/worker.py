@@ -43,7 +43,11 @@ class JobWorker:
 
     ``auto_complete``: a handler return (no exception) completes the job with
     the handler's returned dict (or {}); an exception fails it with
-    retries-1 (the Java client's default error behavior)."""
+    retries-1 (the Java client's default error behavior).
+
+    ``stream_enabled``: use the StreamActivatedJobs push path instead of the
+    ActivateJobs poll loop (reference: JobWorkerBuilderStep1.streamEnabled —
+    jobs arrive as the broker creates them, no polling)."""
 
     def __init__(
         self,
@@ -56,6 +60,7 @@ class JobWorker:
         poll_interval_s: float = 0.05,
         max_backoff_s: float = 1.0,
         auto_complete: bool = True,
+        stream_enabled: bool = False,
     ) -> None:
         self.client = client
         self.job_type = job_type
@@ -66,6 +71,7 @@ class JobWorker:
         self.poll_interval_s = poll_interval_s
         self.max_backoff_s = max_backoff_s
         self.auto_complete = auto_complete
+        self.stream_enabled = stream_enabled
         self._running = False
         self._thread: threading.Thread | None = None
         self.handled_count = 0
@@ -73,13 +79,17 @@ class JobWorker:
 
     def start(self) -> "JobWorker":
         self._running = True
-        self._thread = threading.Thread(target=self._poll_loop, daemon=True,
+        target = self._stream_loop if self.stream_enabled else self._poll_loop
+        self._thread = threading.Thread(target=target, daemon=True,
                                         name=f"worker-{self.job_type}")
         self._thread.start()
         return self
 
     def stop(self) -> None:
         self._running = False
+        call = getattr(self, "_call", None)
+        if call is not None:
+            call.cancel()
         if self._thread is not None:
             self._thread.join(timeout=5)
 
@@ -105,6 +115,27 @@ class JobWorker:
                 if not self._running:
                     return
                 self._dispatch(job_client, job)
+
+    def _stream_loop(self) -> None:
+        job_client = JobClient(self.client)
+        while self._running:
+            try:
+                self._call, jobs = self.client.open_job_stream(
+                    self.job_type, worker=self.worker_name,
+                    timeout_ms=self.timeout_ms,
+                )
+                if not self._running:
+                    # stop() raced the reconnect: its cancel hit the old call
+                    self._call.cancel()
+                    return
+                for job in jobs:
+                    if not self._running:
+                        return
+                    self._dispatch(job_client, job)
+            except Exception:
+                if not self._running:
+                    return
+                time.sleep(self.poll_interval_s)
 
     def _dispatch(self, job_client: JobClient, job: ActivatedJob) -> None:
         try:

@@ -65,6 +65,8 @@ class EventAppliers:
         reg[(ValueType.JOB, int(JobIntent.RETRIES_UPDATED))] = self._job_retries_updated
         reg[(ValueType.JOB, int(JobIntent.CANCELED))] = self._job_canceled
         reg[(ValueType.JOB, int(JobIntent.RECURRED_AFTER_BACKOFF))] = self._job_recurred
+        reg[(ValueType.JOB, int(JobIntent.YIELDED))] = self._job_yielded
+        reg[(ValueType.JOB, int(JobIntent.TIMEOUT_UPDATED))] = self._job_timeout_updated
         reg[(ValueType.JOB, int(JobIntent.ERROR_THROWN))] = self._job_error_thrown
         reg[(ValueType.JOB_BATCH, int(JobBatchIntent.ACTIVATED))] = self._job_batch_activated
         reg[(ValueType.VARIABLE, int(VariableIntent.CREATED))] = self._variable_set
@@ -354,6 +356,14 @@ class EventAppliers:
 
     def _job_recurred(self, record: Record) -> None:
         self.state.jobs.recur_after_backoff(record.key, record.value.get("recurAt", -1))
+
+    def _job_yielded(self, record: Record) -> None:
+        # pushed to a dead client stream: activated → activatable again
+        # (reference: JobYieldedApplier)
+        self.state.jobs.timeout(record.key)
+
+    def _job_timeout_updated(self, record: Record) -> None:
+        self.state.jobs.update_deadline(record.key, record.value["deadline"])
 
     def _job_batch_activated(self, record: Record) -> None:
         v = record.value

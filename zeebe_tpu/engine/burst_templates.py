@@ -42,6 +42,7 @@ from typing import Any, Callable
 
 from zeebe_tpu.protocol import msgpack
 from zeebe_tpu.state.db import ColumnFamilyCode
+from zeebe_tpu.stream.api import activatable_job_types as _activatable_job_types
 
 # record header layout (protocol/record.py _HEADER = "<BBBBqqqiqqH")
 _REC_KEY_OFF = 4
@@ -369,6 +370,7 @@ class PreparedBurst:
     count: int
     responses: list  # [(extra, Record, request_stream_id, request_id)]
     has_pending_commands: bool = False
+    job_types: frozenset = frozenset()  # job types made activatable by the burst
 
 
 @dataclass
@@ -384,6 +386,7 @@ class BurstTemplate:
     state_ops: list[StateOp] = field(default_factory=list)
     responses: list[ResponseTemplate] = field(default_factory=list)
     has_pending_commands: bool = False
+    job_types: frozenset = frozenset()
 
     def instantiate_payload(self, resolve: Callable[[tuple], int]) -> bytearray:
         buf = bytearray(self.payload)
@@ -596,6 +599,7 @@ def build_template(
         has_pending_commands=any(
             f.record.is_command and not f.processed for f in builder.follow_ups
         ),
+        job_types=frozenset(_activatable_job_types(builder.follow_ups)),
     )
 
 

@@ -160,6 +160,8 @@ class Engine(RecordProcessor):
             (ValueType.INCIDENT, int(IncidentIntent.RESOLVE)): incidents.process,
             (ValueType.VARIABLE_DOCUMENT, int(VariableDocumentIntent.UPDATE)): variables.process,
             (ValueType.JOB, int(JobIntent.RECUR_AFTER_BACKOFF)): jobs.recur_after_backoff,
+            (ValueType.JOB, int(JobIntent.YIELD)): jobs.yield_job,
+            (ValueType.JOB, int(JobIntent.UPDATE_TIMEOUT)): jobs.update_timeout,
             (ValueType.TIMER, int(TimerIntent.TRIGGER)): timers.trigger,
             (ValueType.MESSAGE, int(MessageIntent.PUBLISH)): messages.publish,
             (ValueType.MESSAGE, int(MessageIntent.EXPIRE)): messages.expire,

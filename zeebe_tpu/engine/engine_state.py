@@ -333,6 +333,18 @@ class JobState:
         job["retries"] = retries
         self._jobs.put((key,), job)
 
+    def update_deadline(self, key: int, deadline: int) -> None:
+        """UpdateJobTimeout: move the activated job's deadline (reference:
+        JobUpdateTimeoutProcessor / JobTimeoutUpdatedApplier)."""
+        job = self._jobs.get((key,))
+        old = job.get("deadline", -1)
+        if old >= 0 and self._deadlines.exists((old, key)):
+            self._deadlines.delete((old, key))
+        job["deadline"] = deadline
+        self._jobs.put((key,), job)
+        if self._states.get((key,)) == JOB_ACTIVATED:
+            self._deadlines.put((deadline, key), None)
+
     def error_thrown(self, key: int) -> None:
         """The job is consumed by a thrown BPMN error (reference:
         JobErrorThrownApplier removes it from activatable/deadline sets)."""

@@ -893,6 +893,7 @@ class KernelBackend:
             count=template.count,
             responses=responses,
             has_pending_commands=template.has_pending_commands,
+            job_types=template.job_types,
         )
 
     def _audit_template(self, template, adm: _Admitted, builder, cap_log, mints) -> None:

@@ -607,6 +607,41 @@ class JobProcessors:
             {**job, "recurAt": cmd.record.value.get("recurAt", -1)},
         )
 
+    def yield_job(self, cmd: LoggedRecord, writers: Writers) -> None:
+        """Job YIELD: a pushed job's client stream died before delivery; hand
+        the job back to the activatable queue (reference: JobYieldProcessor,
+        YieldingJobStreamErrorHandler)."""
+        job = self._precondition(cmd, writers)
+        if job is None:
+            return
+        if self.state.jobs.state_of(cmd.record.key) != JOB_ACTIVATED:
+            writers.respond_rejection(cmd, RejectionType.INVALID_STATE, "job is not activated")
+            return
+        yielded = writers.append_event(cmd.record.key, ValueType.JOB, JobIntent.YIELDED, job)
+        writers.respond(cmd, yielded)
+
+    def update_timeout(self, cmd: LoggedRecord, writers: Writers) -> None:
+        """UpdateJobTimeout: move an activated job's deadline (reference:
+        JobUpdateTimeoutProcessor)."""
+        job = self._precondition(cmd, writers)
+        if job is None:
+            return
+        if self.state.jobs.state_of(cmd.record.key) != JOB_ACTIVATED:
+            writers.respond_rejection(cmd, RejectionType.INVALID_STATE, "job is not activated")
+            return
+        timeout = cmd.record.value.get("timeout", 0)
+        if timeout <= 0:
+            writers.respond_rejection(
+                cmd, RejectionType.INVALID_ARGUMENT, f"timeout must be >0, got {timeout}"
+            )
+            return
+        deadline = self.clock_millis() + timeout
+        updated = writers.append_event(
+            cmd.record.key, ValueType.JOB, JobIntent.TIMEOUT_UPDATED,
+            {**job, "deadline": deadline},
+        )
+        writers.respond(cmd, updated)
+
     def time_out(self, cmd: LoggedRecord, writers: Writers) -> None:
         job = self._precondition(cmd, writers)
         if job is None:

@@ -44,6 +44,18 @@ class GatewayRuntimeBase:
     the nonce'd request-id sequence, the pending/response correlation table,
     and the partition-selection helpers."""
 
+    def _init_jobstreams(self) -> None:
+        """Jobs-available hub (long-poll wakeup) + push dispatcher (job
+        streams); fed by the brokers' post-commit jobs-available side effect."""
+        from zeebe_tpu.gateway.jobstream import JobNotificationHub, JobStreamDispatcher
+
+        self.jobs_hub = JobNotificationHub()
+        self.job_streams = JobStreamDispatcher(self)
+
+    def _on_jobs_available(self, partition_id: int, job_types: set) -> None:
+        self.jobs_hub.notify(job_types)
+        self.job_streams.on_jobs_available(partition_id, job_types)
+
     def _init_requests(self) -> None:
         self._round_robin = itertools.count()
         # request ids carry a startup nonce in the high bits: a restarted
@@ -103,6 +115,7 @@ class ClusterRuntime(GatewayRuntimeBase):
         self.net = LoopbackNetwork()
         self._lock = threading.RLock()
         self._init_requests()
+        self._init_jobstreams()
         members = [f"broker-{i}" for i in range(broker_count)]
         self.brokers: dict[str, Broker] = {}
         from pathlib import Path
@@ -121,6 +134,7 @@ class ClusterRuntime(GatewayRuntimeBase):
                 disk_min_free_bytes=disk_min_free_bytes,
                 backup_store_directory=backup_store_directory,
             )
+            self.brokers[m].jobs_listener = self._on_jobs_available
         self._running = False
         self._thread: threading.Thread | None = None
 
@@ -131,6 +145,7 @@ class ClusterRuntime(GatewayRuntimeBase):
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="cluster-runtime")
         self._thread.start()
+        self.job_streams.start()
         self.await_leaders()
 
     def _run(self) -> None:
@@ -143,6 +158,7 @@ class ClusterRuntime(GatewayRuntimeBase):
                 time.sleep(0.001)
 
     def stop(self) -> None:
+        self.job_streams.stop()
         self._running = False
         if self._thread is not None:
             self._thread.join(timeout=5)

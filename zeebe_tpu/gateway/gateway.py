@@ -213,13 +213,17 @@ class GatewayService:
 
     def ActivateJobs(self, request, context):
         """Fan out across partitions round-robin until maxJobs or all empty;
-        long-poll until requestTimeout if nothing was activated."""
+        park until requestTimeout if nothing was activated, woken by the
+        jobs-available notification (reference:
+        LongPollingActivateJobsHandler.java:36 — no poll loop)."""
         deadline = time.time() + max((request.requestTimeout or 0), 0) / 1000
         remaining = request.maxJobsToActivate or 32
-        while True:
+        hub = getattr(self.runtime, "jobs_hub", None)
+        while context.is_active():
+            seen_version = hub.version(request.type) if hub is not None else 0
             jobs = []
             for partition_id in range(1, self.runtime.partition_count + 1):
-                if remaining <= 0:
+                if remaining <= 0 or not context.is_active():
                     break
                 # peek before writing: an idle long-poller must not flood the
                 # replicated log with empty JOB_BATCH ACTIVATE commands
@@ -241,32 +245,40 @@ class GatewayService:
             if jobs:
                 yield pb.ActivateJobsResponse(jobs=jobs)
                 return
-            if time.time() >= deadline:
+            now = time.time()
+            if now >= deadline:
                 return
-            time.sleep(0.02)
+            if hub is not None:
+                # bounded wait so client cancellation is noticed promptly
+                hub.wait(request.type, seen_version, min(deadline - now, 1.0))
+            else:
+                time.sleep(0.02)
 
     def StreamActivatedJobs(self, request, context):
-        """Job push: stream jobs as they are created (reference: job push via
-        RemoteJobStreamer; here the gateway polls activatable state — same
-        client-visible contract, server push lands with the transport layer)."""
-        while context.is_active():
-            record = None
-            for partition_id in range(1, self.runtime.partition_count + 1):
-                if not self.runtime.has_activatable_jobs(partition_id, request.type):
+        """Job push: register a client stream with the dispatcher; the broker
+        side's jobs-available side effect activates jobs and feeds them here
+        with no polling (reference: StreamJobsHandler.java:36 →
+        ClientStreamManager → broker RemoteStreamRegistry push)."""
+        import queue as _queue
+
+        streams = self.runtime.job_streams
+        handle = streams.add_stream(
+            request.type, request.worker or "default", request.timeout or 300_000,
+        )
+        in_flight = None
+        try:
+            while context.is_active():
+                try:
+                    in_flight = handle.jobs.get(timeout=0.25)
+                except _queue.Empty:
                     continue
-                record = self._submit(
-                    context, partition_id,
-                    command(ValueType.JOB_BATCH, JobBatchIntent.ACTIVATE, {
-                        "type": request.type,
-                        "worker": request.worker or "default",
-                        "timeout": request.timeout or 300_000,
-                        "maxJobsToActivate": 32,
-                    }),
-                )
-                for key, job in zip(record.value.get("jobKeys", []),
-                                    record.value.get("jobs", [])):
-                    yield self._activated_job(request, key, job)
-            time.sleep(0.05)
+                key, job = in_flight
+                yield self._activated_job(request, key, job)
+                in_flight = None
+        finally:
+            # in_flight: dequeued but the client died before/while receiving
+            # it — hand it to another stream or yield it back
+            streams.remove_stream(handle, in_flight=in_flight)
 
     def _activated_job(self, request, key: int, job: dict) -> "pb.ActivatedJob":
         return pb.ActivatedJob(
@@ -316,7 +328,10 @@ class GatewayService:
         return pb.UpdateJobRetriesResponse()
 
     def UpdateJobTimeout(self, request, context):
-        context.abort(grpc.StatusCode.UNIMPLEMENTED, "UpdateJobTimeout pending")
+        self._job_command(context, request.jobKey, JobIntent.UPDATE_TIMEOUT, {
+            "timeout": request.timeout,
+        })
+        return pb.UpdateJobTimeoutResponse()
 
     def _job_command(self, context, job_key: int, intent, value: dict):
         partition = self.runtime.partition_for_key(job_key)

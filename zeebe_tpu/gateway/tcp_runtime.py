@@ -30,6 +30,7 @@ from zeebe_tpu.gateway.broker_client import (
 from zeebe_tpu.protocol import Record
 
 GATEWAY_RESPONSE_TOPIC = "gateway-response"
+JOBS_AVAILABLE_TOPIC = "jobs-available"
 
 
 class TcpClusterRuntime(GatewayRuntimeBase):
@@ -49,6 +50,7 @@ class TcpClusterRuntime(GatewayRuntimeBase):
         self.messaging = TcpMessagingService(node_id, bind, peers)
         self.messaging.start()
         self.messaging.subscribe(GATEWAY_RESPONSE_TOPIC, self._on_remote_response)
+        self.messaging.subscribe(JOBS_AVAILABLE_TOPIC, self._on_remote_jobs_available)
         cfg = BrokerCfg(
             node_id=node_id, partition_count=partition_count,
             replication_factor=replication_factor, cluster_members=members,
@@ -59,6 +61,8 @@ class TcpClusterRuntime(GatewayRuntimeBase):
         )
         self._lock = threading.RLock()
         self._init_requests()
+        self._init_jobstreams()
+        self.broker.jobs_listener = self._on_local_jobs_available
         self._running = False
         self._thread: threading.Thread | None = None
 
@@ -69,6 +73,7 @@ class TcpClusterRuntime(GatewayRuntimeBase):
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"runtime-{self.node_id}")
         self._thread.start()
+        self.job_streams.start()
 
     def _run(self) -> None:
         while self._running:
@@ -79,6 +84,7 @@ class TcpClusterRuntime(GatewayRuntimeBase):
                 time.sleep(0.001)
 
     def stop(self) -> None:
+        self.job_streams.stop()
         self._running = False
         if self._thread is not None:
             self._thread.join(timeout=5)
@@ -119,6 +125,21 @@ class TcpClusterRuntime(GatewayRuntimeBase):
     def _on_remote_response(self, sender: str, payload: dict) -> None:
         self._resolve_request(payload["requestId"],
                               Record.from_bytes(payload["record"]))
+
+    # -- jobs-available fan-out ------------------------------------------------
+
+    def _on_local_jobs_available(self, partition_id: int, job_types: set) -> None:
+        """A local partition made jobs activatable: wake this gateway AND the
+        peer gateways (their workers may hold the streams/long-polls —
+        reference: the broker gossips jobsAvailable to every gateway)."""
+        self._on_jobs_available(partition_id, job_types)
+        payload = {"partitionId": partition_id, "types": sorted(job_types)}
+        for member in self._members:
+            if member != self.node_id:
+                self.messaging.send(member, JOBS_AVAILABLE_TOPIC, payload)
+
+    def _on_remote_jobs_available(self, sender: str, payload: dict) -> None:
+        self._on_jobs_available(payload["partitionId"], set(payload["types"]))
 
     # -- topology --------------------------------------------------------------
 

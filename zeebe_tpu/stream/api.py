@@ -39,6 +39,32 @@ class ClientResponse:
     request_id: int
 
 
+def activatable_job_types(follow_ups) -> set[str]:
+    """Job types made activatable by a step's follow-up events — the
+    jobs-available notification source (reference: the engine's
+    JobsAvailableCallback wired through BpmnJobActivationBehavior /
+    JobBackoffChecker so gateways can wake parked long-polls and push
+    streams instead of polling)."""
+    from zeebe_tpu.protocol.intent import JobIntent
+
+    available = set()
+    for f in follow_ups:
+        rec = f.record
+        if rec.value_type != ValueType.JOB or not rec.is_event:
+            continue
+        intent = int(rec.intent)
+        if intent in (int(JobIntent.CREATED), int(JobIntent.TIMED_OUT),
+                      int(JobIntent.RECURRED_AFTER_BACKOFF), int(JobIntent.YIELDED)) or (
+            intent == int(JobIntent.FAILED)
+            and rec.value.get("retries", 0) > 0
+            and rec.value.get("retryBackoff", -1) <= 0
+        ):
+            job_type = rec.value.get("type", "")
+            if job_type:
+                available.add(job_type)
+    return available
+
+
 class ProcessingResultBuilder:
     """Collects everything one processing step produces: follow-up records, an
     optional client response, and post-commit tasks (side effects).

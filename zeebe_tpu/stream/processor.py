@@ -40,6 +40,7 @@ from zeebe_tpu.stream.api import (
     ProcessingResultBuilder,
     ProcessingScheduleService,
     RecordProcessor,
+    activatable_job_types,
 )
 
 logger = logging.getLogger("zeebe_tpu.stream")
@@ -89,6 +90,10 @@ class StreamProcessor:
         # per-command sequential path; everything else falls through unchanged
         self.kernel_backend = kernel_backend
         self.response_sink = response_sink or (lambda response: None)
+        # post-commit jobs-available notification (reference: the engine's
+        # jobsAvailable callback → gateway long-poll wakeup / job push);
+        # receives the set of job types a committed step made activatable
+        self.on_jobs_available: Callable[[set], None] | None = None
         self.phase = Phase.INITIAL
         self._positions = db.column_family(ColumnFamilyCode.LAST_PROCESSED_POSITION)
         clock = clock_millis or log_stream.clock_millis
@@ -252,12 +257,16 @@ class StreamProcessor:
             logger.exception("kernel group processing failed; falling back to sequential")
             return 0
         self._reader_position = cmds[-1].position + 1
+        job_types: set = set()
         for result in builders:
             if isinstance(result, PreparedBurst):
                 for _extra, record, stream_id, request_id in result.responses:
                     self.response_sink(ClientResponse(record, stream_id, request_id))
+                job_types |= result.job_types
             else:
                 self._execute_side_effects(result)
+                job_types |= activatable_job_types(result.follow_ups)
+        self._notify_jobs_available(job_types)
         return len(cmds)
 
     def process_next(self) -> bool:
@@ -281,6 +290,7 @@ class StreamProcessor:
             self._on_processing_error(cmd, error)
             return
         self._execute_side_effects(builder)
+        self._notify_jobs_available(activatable_job_types(builder.follow_ups))
 
     def _batch_process(self, cmd: LoggedRecord, builder: ProcessingResultBuilder) -> None:
         """The batchProcessing loop: the input command plus follow-up commands
@@ -330,6 +340,13 @@ class StreamProcessor:
                     builder.with_response(rej, cmd.record.request_stream_id, cmd.record.request_id)
             self._write_and_mark(cmd, builder)
         self._execute_side_effects(builder)
+
+    def _notify_jobs_available(self, job_types: set) -> None:
+        if job_types and self.on_jobs_available is not None:
+            try:
+                self.on_jobs_available(job_types)
+            except Exception:  # noqa: BLE001 — notification must not wedge processing
+                logger.exception("jobs-available notification failed")
 
     def _execute_side_effects(self, builder: ProcessingResultBuilder) -> None:
         if builder.response is not None:
