@@ -154,6 +154,12 @@ class ZeebePartition:
         recover db from the latest snapshot, replay the stream journal, then
         process (leader) or keep replaying (follower)."""
         self._recover_db()
+        # state migrations run between snapshot recovery and the stream
+        # processor opening (reference: MigrationTransitionStep →
+        # DbMigratorImpl.runMigrations)
+        from zeebe_tpu.engine.migration import DbMigrator
+
+        DbMigrator(self.db).run_migrations()
         mode = (
             StreamProcessorMode.PROCESSING
             if self.role == RaftRole.LEADER else StreamProcessorMode.REPLAY
@@ -162,6 +168,13 @@ class ZeebePartition:
             self.db, self.partition_id, clock_millis=self.clock_millis,
             partition_count=self.partition_count,
         )
+        # per-transition query façade (reference: QueryServiceTransitionStep —
+        # closed and replaced with the db on every role change)
+        from zeebe_tpu.engine.query import QueryService
+
+        if getattr(self, "query_service", None) is not None:
+            self.query_service.close()
+        self.query_service = QueryService(self.db, self.engine.state)
         if self.inter_partition_sender is not None:
             self.engine.wire_sender(self.inter_partition_sender)
         self.processor = StreamProcessor(

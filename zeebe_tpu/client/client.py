@@ -77,12 +77,38 @@ def _job_of(j) -> ActivatedJob:
     )
 
 
+class _BearerAuth(grpc.UnaryUnaryClientInterceptor, grpc.UnaryStreamClientInterceptor):
+    """Adds an `authorization: Bearer <token>` header to every call
+    (reference: clients/java CredentialsProvider / OAuthCredentialsProvider —
+    static token here, no token endpoint in this build)."""
+
+    def __init__(self, token: str) -> None:
+        self._md = ("authorization", f"Bearer {token}")
+
+    def _with_md(self, details):
+        md = list(details.metadata or []) + [self._md]
+        return details._replace(metadata=md) if hasattr(details, "_replace") else details
+
+    def intercept_unary_unary(self, continuation, details, request):
+        return continuation(self._with_md(details), request)
+
+    def intercept_unary_stream(self, continuation, details, request):
+        return continuation(self._with_md(details), request)
+
+
 class ZeebeTpuClient:
     """Synchronous client; one instance per gateway address."""
 
-    def __init__(self, address: str, channel: grpc.Channel | None = None) -> None:
+    def __init__(self, address: str, channel: grpc.Channel | None = None,
+                 access_token: str | None = None,
+                 default_tenant: str = "") -> None:
         self.address = address
         self.channel = channel or grpc.insecure_channel(address)
+        if access_token:
+            self.channel = grpc.intercept_channel(
+                self.channel, _BearerAuth(access_token))
+        # tenant stamped on tenant-scoped commands unless overridden per call
+        self.default_tenant = default_tenant
         c = self.channel
         self._topology = _method(c, "Topology", pb.TopologyRequest, pb.TopologyResponse)
         self._deploy = _method(c, "DeployResource", pb.DeployResourceRequest, pb.DeployResourceResponse)
@@ -127,7 +153,8 @@ class ZeebeTpuClient:
 
     # -- deployment ------------------------------------------------------------
 
-    def deploy_resource(self, *resources: tuple[str, str | bytes] | str) -> dict:
+    def deploy_resource(self, *resources: tuple[str, str | bytes] | str,
+                        tenant_id: str = "") -> dict:
         """deploy_resource(("proc.bpmn", xml), …) or a path string."""
         reqs = []
         for res in resources:
@@ -139,7 +166,8 @@ class ZeebeTpuClient:
                 if isinstance(content, str):
                     content = content.encode("utf-8")
                 reqs.append(pb.Resource(name=name, content=content))
-        r = self._deploy(pb.DeployResourceRequest(resources=reqs))
+        r = self._deploy(pb.DeployResourceRequest(
+            resources=reqs, tenantId=tenant_id or self.default_tenant))
         return {
             "key": r.key,
             "processes": [
@@ -156,17 +184,24 @@ class ZeebeTpuClient:
                  "decisionRequirementsKey": d.decision.decisionRequirementsKey}
                 for d in r.deployments if d.WhichOneof("Metadata") == "decision"
             ],
+            "forms": [
+                {"formId": d.form.formId, "version": d.form.version,
+                 "formKey": d.form.formKey}
+                for d in r.deployments if d.WhichOneof("Metadata") == "form"
+            ],
         }
 
     # -- process instances -----------------------------------------------------
 
     def create_instance(self, bpmn_process_id: str = "",
                         process_definition_key: int = 0, version: int = 0,
-                        variables: dict | None = None) -> ProcessInstance:
+                        variables: dict | None = None,
+                        tenant_id: str = "") -> ProcessInstance:
         r = self._create(pb.CreateProcessInstanceRequest(
             bpmnProcessId=bpmn_process_id,
             processDefinitionKey=process_definition_key, version=version,
             variables=json.dumps(variables or {}),
+            tenantId=tenant_id or self.default_tenant,
         ))
         return ProcessInstance(r.processDefinitionKey, r.bpmnProcessId,
                                r.version, r.processInstanceKey)
@@ -176,13 +211,15 @@ class ZeebeTpuClient:
                                     version: int = 0,
                                     variables: dict | None = None,
                                     fetch_variables: list[str] | None = None,
-                                    timeout_s: float = 20.0) -> ProcessInstance:
+                                    timeout_s: float = 20.0,
+                                    tenant_id: str = "") -> ProcessInstance:
         r = self._create_with_result(pb.CreateProcessInstanceWithResultRequest(
             request=pb.CreateProcessInstanceRequest(
                 bpmnProcessId=bpmn_process_id,
                 processDefinitionKey=process_definition_key,
                 version=version,
                 variables=json.dumps(variables or {}),
+                tenantId=tenant_id or self.default_tenant,
             ),
             requestTimeout=int(timeout_s * 1000),
             fetchVariables=fetch_variables or [],
@@ -199,47 +236,62 @@ class ZeebeTpuClient:
 
     def publish_message(self, name: str, correlation_key: str,
                         variables: dict | None = None, ttl_ms: int = 3_600_000,
-                        message_id: str = "") -> int:
+                        message_id: str = "", tenant_id: str = "") -> int:
         r = self._publish(pb.PublishMessageRequest(
             name=name, correlationKey=correlation_key, timeToLive=ttl_ms,
             messageId=message_id, variables=json.dumps(variables or {}),
+            tenantId=tenant_id or self.default_tenant,
         ))
         return r.key
 
     def broadcast_signal(self, signal_name: str,
-                         variables: dict | None = None) -> int:
+                         variables: dict | None = None,
+                         tenant_id: str = "") -> int:
         r = self._signal(pb.BroadcastSignalRequest(
-            signalName=signal_name, variables=json.dumps(variables or {})))
+            signalName=signal_name, variables=json.dumps(variables or {}),
+            tenantId=tenant_id or self.default_tenant))
         return r.key
 
     # -- jobs ------------------------------------------------------------------
 
     def activate_jobs(self, job_type: str, max_jobs: int = 32,
                       worker: str = "python-client", timeout_ms: int = 300_000,
-                      request_timeout_ms: int = 0) -> list[ActivatedJob]:
+                      request_timeout_ms: int = 0,
+                      tenant_ids: list[str] | None = None) -> list[ActivatedJob]:
+        if tenant_ids is None and self.default_tenant:
+            tenant_ids = [self.default_tenant]
         jobs: list[ActivatedJob] = []
         for resp in self._activate(pb.ActivateJobsRequest(
             type=job_type, worker=worker, timeout=timeout_ms,
             maxJobsToActivate=max_jobs, requestTimeout=request_timeout_ms,
+            tenantIds=tenant_ids or [],
         )):
             jobs.extend(_job_of(j) for j in resp.jobs)
         return jobs
 
     def stream_jobs(self, job_type: str, worker: str = "python-client",
-                    timeout_ms: int = 300_000) -> Iterator[ActivatedJob]:
+                    timeout_ms: int = 300_000,
+                    tenant_ids: list[str] | None = None) -> Iterator[ActivatedJob]:
+        if tenant_ids is None and self.default_tenant:
+            tenant_ids = [self.default_tenant]
         for j in self._stream_jobs(pb.StreamActivatedJobsRequest(
             type=job_type, worker=worker, timeout=timeout_ms,
+            tenantIds=tenant_ids or [],
         )):
             yield _job_of(j)
 
     def open_job_stream(self, job_type: str, worker: str = "python-client",
-                        timeout_ms: int = 300_000):
+                        timeout_ms: int = 300_000,
+                        tenant_ids: list[str] | None = None):
         """StreamActivatedJobs with a cancellation handle: returns
         ``(call, jobs)`` where ``call.cancel()`` ends the stream and ``jobs``
         iterates ActivatedJob (the streaming JobWorker's ingress). The
         iterator ends cleanly on cancellation."""
+        if tenant_ids is None and self.default_tenant:
+            tenant_ids = [self.default_tenant]
         call = self._stream_jobs(pb.StreamActivatedJobsRequest(
             type=job_type, worker=worker, timeout=timeout_ms,
+            tenantIds=tenant_ids or [],
         ))
 
         def _jobs():

@@ -23,7 +23,7 @@ from zeebe_tpu.engine.engine_state import (
     EI_TERMINATING,
     EngineState,
 )
-from zeebe_tpu.protocol import Record, ValueType
+from zeebe_tpu.protocol import DEFAULT_TENANT, Record, ValueType
 from zeebe_tpu.protocol.enums import BpmnElementType
 from zeebe_tpu.protocol.intent import (
     DeploymentIntent,
@@ -48,6 +48,16 @@ class EventAppliers:
     def _register(self) -> None:
         reg = self._appliers
         reg[(ValueType.PROCESS, int(ProcessIntent.CREATED))] = self._process_created
+        from zeebe_tpu.protocol.intent import FormIntent
+
+        reg[(ValueType.FORM, int(FormIntent.CREATED))] = self._form_created
+        reg[(ValueType.FORM, int(FormIntent.DELETED))] = self._form_deleted
+        from zeebe_tpu.protocol.intent import ProcessInstanceBatchIntent
+
+        reg[(ValueType.PROCESS_INSTANCE_BATCH,
+             int(ProcessInstanceBatchIntent.ACTIVATED))] = self._pi_batch_activated
+        reg[(ValueType.PROCESS_INSTANCE_BATCH,
+             int(ProcessInstanceBatchIntent.TERMINATED))] = self._noop
         reg[(ValueType.DEPLOYMENT, int(DeploymentIntent.CREATED))] = self._noop
         reg[(ValueType.DEPLOYMENT, int(DeploymentIntent.FULLY_DISTRIBUTED))] = self._noop
         reg[(ValueType.PROCESS_INSTANCE_CREATION, int(ProcessInstanceCreationIntent.CREATED))] = self._noop
@@ -223,6 +233,23 @@ class EventAppliers:
     def _distribution_finished(self, record: Record) -> None:
         self.state.distribution.finish(record.key)
 
+    def _pi_batch_activated(self, record: Record) -> None:
+        """Track chunked multi-instance activation progress on the body
+        instance: completion of the body must wait for the final chunk."""
+        v = record.value
+        body_key = v.get("batchElementInstanceKey", -1)
+        if self.state.element_instances.get(body_key) is not None:
+            self.state.element_instances.update(
+                body_key, miActivationIndex=v.get("index", 0),
+                miTotal=v.get("count", 0),
+            )
+
+    def _form_created(self, record: Record) -> None:
+        self.state.forms.put(record.value)
+
+    def _form_deleted(self, record: Record) -> None:
+        self.state.forms.delete(record.key)
+
     def _process_created(self, record: Record) -> None:
         v = record.value
         self.state.processes.put_process(
@@ -232,6 +259,7 @@ class EventAppliers:
             resource_name=v["resourceName"],
             resource_xml=v["resource"],
             digest=v["checksum"],
+            tenant=v.get("tenantId", DEFAULT_TENANT),
         )
 
     # element lifecycle

@@ -40,7 +40,7 @@ from zeebe_tpu.engine.writers import Writers
 from zeebe_tpu.feel import FeelEvalError
 from zeebe_tpu.logstreams import LoggedRecord
 from zeebe_tpu.models.bpmn import ExecutableElement, ExecutableProcess
-from zeebe_tpu.protocol import RejectionType, ValueType
+from zeebe_tpu.protocol import DEFAULT_TENANT, RejectionType, ValueType
 from zeebe_tpu.protocol.enums import BpmnElementType, BpmnEventType, ErrorType
 from zeebe_tpu.protocol.intent import (
     EscalationIntent,
@@ -56,6 +56,11 @@ from zeebe_tpu.protocol.intent import (
 )
 
 PI = ProcessInstanceIntent
+
+# fan-outs/fan-ins larger than this ride PROCESS_INSTANCE_BATCH chunk
+# commands instead of one unbounded record batch (reference:
+# ProcessInstanceBatch ACTIVATE/TERMINATE, EngineConfiguration batch limits)
+PI_BATCH_CHUNK = 100
 
 
 class BpmnProcessor:
@@ -208,6 +213,11 @@ class BpmnProcessor:
         elif et == BpmnElementType.USER_TASK and element.native_user_task:
             # native user task: lifecycle records instead of a job
             # (reference: zeebe:userTask → UserTaskProcessors)
+            form_key = -1
+            if element.form_id is not None:
+                form_key = self._resolve_form(key, value, element, writers)
+                if form_key is None:
+                    return  # FORM_NOT_FOUND incident raised; stays ACTIVATING
             writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
             task_key = self.state.next_key()
             task_value = {
@@ -222,6 +232,7 @@ class BpmnProcessor:
                 "processInstanceKey": value["processInstanceKey"],
                 "processDefinitionKey": value["processDefinitionKey"],
                 "bpmnProcessId": value["bpmnProcessId"],
+                **({"formKey": form_key} if form_key >= 0 else {}),
             }
             writers.append_event(task_key, ValueType.USER_TASK,
                                  UserTaskIntent.CREATING, task_value)
@@ -247,6 +258,14 @@ class BpmnProcessor:
             except (FeelEvalError, TypeError, ValueError) as exc:
                 self._raise_incident(writers, key, value, ErrorType.EXTRACT_VALUE_ERROR, str(exc))
                 return
+            headers = element.task_headers
+            if element.form_id is not None:
+                # linked form rides the job as the reference's formKey header
+                # (Protocol.USER_TASK_FORM_KEY_HEADER_NAME)
+                form_key = self._resolve_form(key, value, element, writers)
+                if form_key is None:
+                    return  # FORM_NOT_FOUND incident raised; stays ACTIVATING
+                headers = {**headers, "io.camunda.zeebe:formKey": str(form_key)}
             writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
             job_key = self.state.next_key()
             writers.append_event(
@@ -257,7 +276,7 @@ class BpmnProcessor:
                     "worker": "",
                     "deadline": -1,
                     "variables": {},
-                    "customHeaders": element.task_headers,
+                    "customHeaders": headers,
                     "elementId": element.id,
                     "elementInstanceKey": key,
                     "processInstanceKey": value["processInstanceKey"],
@@ -265,6 +284,7 @@ class BpmnProcessor:
                     "processDefinitionVersion": value["version"],
                     "bpmnProcessId": value["bpmnProcessId"],
                     "errorMessage": "",
+                    **({"tenantId": value["tenantId"]} if "tenantId" in value else {}),
                 },
             )
             # wait state: completion comes from the job COMPLETE command
@@ -413,6 +433,21 @@ class BpmnProcessor:
             return
         if mi.is_sequential:
             self._write_mi_inner_activate(writers, key, value, element, items[0], 1)
+        elif len(items) > PI_BATCH_CHUNK:
+            # large fan-out rides PROCESS_INSTANCE_BATCH chunking so no single
+            # step writes an unbounded record batch (reference:
+            # ActivateProcessInstanceBatchProcessor, SURVEY §5.7)
+            from zeebe_tpu.protocol.intent import ProcessInstanceBatchIntent
+
+            writers.append_command(
+                self.state.next_key(), ValueType.PROCESS_INSTANCE_BATCH,
+                ProcessInstanceBatchIntent.ACTIVATE,
+                {
+                    "processInstanceKey": value["processInstanceKey"],
+                    "batchElementInstanceKey": key,
+                    "index": 0,
+                },
+            )
         else:
             for i, item in enumerate(items):
                 self._write_mi_inner_activate(writers, key, value, element, item, i + 1)
@@ -460,6 +495,11 @@ class BpmnProcessor:
                 )
                 return
         if body["activeChildren"] == 0:
+            # chunked fan-out: more ACTIVATE batches pending → not done yet
+            # (miActivationIndex/miTotal maintained by the PI-batch applier)
+            mi_index = body.get("miActivationIndex")
+            if mi_index is not None and mi_index < body.get("miTotal", 0):
+                return
             writers.append_command(
                 body_key, ValueType.PROCESS_INSTANCE, PI.COMPLETE_ELEMENT, {}
             )
@@ -502,7 +542,10 @@ class BpmnProcessor:
         """Reference: processing/bpmn/container/CallActivityProcessor — resolve
         the called process, create a child instance with the parent back-links,
         and copy the call-activity scope variables into the child root."""
-        meta = self.state.processes.get_latest_by_id(element.called_process_id)
+        # the called process resolves within the caller's tenant (reference:
+        # CallActivityProcessor + TenantAuthorizationChecker)
+        meta = self.state.processes.get_latest_by_id(
+            element.called_process_id, value.get("tenantId", DEFAULT_TENANT))
         if meta is None:
             self._raise_incident(
                 writers, key, value, ErrorType.CALLED_ELEMENT_ERROR,
@@ -629,6 +672,7 @@ class BpmnProcessor:
             "bpmnProcessId": value.get("bpmnProcessId", ""),
             "subscriptionPartitionId": self.state.partition_id,
             "messageSubscriptionKey": msg_sub_key,
+            **({"tenantId": value["tenantId"]} if "tenantId" in value else {}),
         }
         writers.append_event(
             host_key, ValueType.PROCESS_MESSAGE_SUBSCRIPTION,
@@ -713,6 +757,7 @@ class BpmnProcessor:
                 "bpmnProcessId": value.get("bpmnProcessId", ""),
                 "processInstanceKey": value.get("processInstanceKey", -1),
                 "interrupting": catching.interrupting,
+                **({"tenantId": value["tenantId"]} if "tenantId" in value else {}),
             },
         )
 
@@ -1174,6 +1219,8 @@ class BpmnProcessor:
             "bpmnElementType": element_type_name,
             "bpmnEventType": element.event_type.name,
         }
+        if "tenantId" in value:
+            child_value["tenantId"] = value["tenantId"]
         if extra:
             child_value.update(extra)
         writers.append_command(new_key, ValueType.PROCESS_INSTANCE, PI.ACTIVATE_ELEMENT, child_value)
@@ -1271,6 +1318,20 @@ class BpmnProcessor:
 
         children = self.state.element_instances.children_keys(key)
         if children:
+            if len(children) > PI_BATCH_CHUNK:
+                # chunked termination of huge scopes (reference:
+                # TerminateProcessInstanceBatchProcessor)
+                from zeebe_tpu.protocol.intent import ProcessInstanceBatchIntent
+
+                writers.append_command(
+                    self.state.next_key(), ValueType.PROCESS_INSTANCE_BATCH,
+                    ProcessInstanceBatchIntent.TERMINATE,
+                    {
+                        "processInstanceKey": value.get("processInstanceKey", -1),
+                        "batchElementInstanceKey": key,
+                    },
+                )
+                return
             for child_key in children:
                 writers.append_command(
                     child_key, ValueType.PROCESS_INSTANCE, PI.TERMINATE_ELEMENT, {}
@@ -1314,6 +1375,22 @@ class BpmnProcessor:
                 )
 
     # -------------------------------------------------------------- incidents
+
+    def _resolve_form(self, key: int, value: dict, element, writers) -> int | None:
+        """Latest deployed form for the element's formId in the instance's
+        tenant; missing → FORM_NOT_FOUND incident and the element stays
+        ACTIVATING so incident resolution retries (reference:
+        BpmnUserTaskBehavior form resolution)."""
+        tenant = value.get("tenantId", DEFAULT_TENANT)
+        form = self.state.forms.get_latest_by_id(element.form_id, tenant)
+        if form is None:
+            self._raise_incident(
+                writers, key, value, ErrorType.FORM_NOT_FOUND,
+                f"Expected to find a form with id '{element.form_id}', "
+                "but no form with this id is found",
+            )
+            return None
+        return form["formKey"]
 
     def _raise_incident(
         self, writers: Writers, element_key: int, value: dict,
@@ -1374,6 +1451,8 @@ def _pi_value(value: dict, element: ExecutableElement) -> dict:
         "parentProcessInstanceKey": value.get("parentProcessInstanceKey", -1),
         "parentElementInstanceKey": value.get("parentElementInstanceKey", -1),
     }
+    if "tenantId" in value:
+        out["tenantId"] = value["tenantId"]
     if "loopCounter" in value:
         out["loopCounter"] = value["loopCounter"]
     if value.get("directActivation"):

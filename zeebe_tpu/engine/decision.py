@@ -87,8 +87,10 @@ class BpmnDecisionBehavior:
                                  writers: Writers) -> bool:
         """Returns True when evaluation succeeded and the result variable was
         written; False when an incident was raised (element stays ACTIVATING)."""
+        from zeebe_tpu.protocol import DEFAULT_TENANT
+
         decision_meta = self.state.decisions.latest_decision_by_id(
-            element.called_decision_id
+            element.called_decision_id, value.get("tenantId", DEFAULT_TENANT)
         )
         if decision_meta is None:
             self._raise_incident(
@@ -137,13 +139,23 @@ class DecisionEvaluationProcessor:
         self.state = state
 
     def process(self, cmd: LoggedRecord, writers: Writers) -> None:
+        from zeebe_tpu.engine.processors import check_tenant_authorized
+        from zeebe_tpu.protocol import DEFAULT_TENANT
+
         value = cmd.record.value
         decision_id = value.get("decisionId", "")
         decision_key = value.get("decisionKey", -1)
+        tenant = value.get("tenantId") or DEFAULT_TENANT
+        if not check_tenant_authorized(cmd, tenant, writers):
+            return
         if decision_key > 0:
             decision_meta = self.state.decisions.decision_by_key(decision_key)
+            if decision_meta is not None and \
+                    decision_meta.get("tenantId", DEFAULT_TENANT) != tenant:
+                decision_meta = None
         else:
-            decision_meta = self.state.decisions.latest_decision_by_id(decision_id)
+            decision_meta = self.state.decisions.latest_decision_by_id(
+                decision_id, tenant)
         if decision_meta is None:
             writers.respond_rejection(
                 cmd, RejectionType.NOT_FOUND,

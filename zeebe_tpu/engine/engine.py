@@ -39,6 +39,7 @@ from zeebe_tpu.protocol.intent import (
     JobIntent,
     MessageIntent,
     MessageSubscriptionIntent,
+    ProcessInstanceBatchIntent,
     ProcessInstanceCreationIntent,
     ProcessInstanceIntent,
     ProcessMessageSubscriptionIntent,
@@ -123,8 +124,10 @@ class Engine(RecordProcessor):
             ResourceDeletionProcessor,
         )
 
+        from zeebe_tpu.engine.processors import ProcessInstanceBatchProcessor
         from zeebe_tpu.engine.user_task import UserTaskProcessors
 
+        pi_batch = ProcessInstanceBatchProcessor(self.state, bpmn)
         user_tasks = UserTaskProcessors(self.state)
         modification = ProcessInstanceModificationProcessor(self.state, bpmn)
         migration = ProcessInstanceMigrationProcessor(self.state)
@@ -176,6 +179,8 @@ class Engine(RecordProcessor):
             (ValueType.PROCESS_INSTANCE_MODIFICATION, int(ProcessInstanceModificationIntent.MODIFY)): modification.process,
             (ValueType.PROCESS_INSTANCE_MIGRATION, int(ProcessInstanceMigrationIntent.MIGRATE)): migration.process,
             (ValueType.RESOURCE_DELETION, int(ResourceDeletionIntent.DELETE)): resource_deletion.process,
+            (ValueType.PROCESS_INSTANCE_BATCH, int(ProcessInstanceBatchIntent.ACTIVATE)): pi_batch.activate,
+            (ValueType.PROCESS_INSTANCE_BATCH, int(ProcessInstanceBatchIntent.TERMINATE)): pi_batch.terminate,
             (ValueType.USER_TASK, int(UserTaskIntent.COMPLETE)): user_tasks.complete,
             (ValueType.USER_TASK, int(UserTaskIntent.ASSIGN)): user_tasks.assign,
             (ValueType.USER_TASK, int(UserTaskIntent.CLAIM)): user_tasks.claim,

@@ -23,7 +23,7 @@ from __future__ import annotations
 from typing import Any, Iterator
 
 from zeebe_tpu.models.bpmn import ExecutableProcess, parse_bpmn_xml, transform
-from zeebe_tpu.protocol import KeyGenerator
+from zeebe_tpu.protocol import DEFAULT_TENANT, KeyGenerator
 from zeebe_tpu.state import ColumnFamilyCode as CF
 from zeebe_tpu.state import ZbDb
 
@@ -42,8 +42,32 @@ JOB_FAILED = 2
 JOB_ERROR_THROWN = 3
 
 
+def _rollback_latest_version(by_id_version, version_cf, digest_cf,
+                             tenant: str, resource_id: str, version: int,
+                             digest_of) -> None:
+    """Shared delete bookkeeping for tenant-scoped versioned resources
+    (processes, forms): drop the (tenant, id, version) index entry and, if it
+    was the latest, repoint latest/digest to the highest remaining version."""
+    if by_id_version.exists((tenant, resource_id, version)):
+        by_id_version.delete((tenant, resource_id, version))
+    if version_cf.get((tenant, resource_id)) == version:
+        for v in range(version - 1, 0, -1):
+            prev_key = by_id_version.get((tenant, resource_id, v))
+            if prev_key is not None:
+                version_cf.put((tenant, resource_id), v)
+                digest_cf.put((tenant, resource_id), digest_of(prev_key))
+                return
+        version_cf.delete((tenant, resource_id))
+        if digest_cf.exists((tenant, resource_id)):
+            digest_cf.delete((tenant, resource_id))
+
+
 class ProcessState:
-    """Deployed process definitions: by key, by (id, version), latest, digest.
+    """Deployed process definitions: by key, by (tenant, id, version), latest,
+    digest. The tenant is the leading component of every id-scoped index
+    (reference: DbTenantAwareKey wrapping in ProcessState /
+    ZbColumnFamilies PROCESS_CACHE_BY_ID_AND_VERSION), so the same BPMN
+    process id deploys and versions independently per tenant.
 
     Caches compiled ExecutableProcess objects outside the db (they are
     deterministic functions of the stored XML)."""
@@ -58,7 +82,8 @@ class ProcessState:
     # mutators (appliers only)
 
     def put_process(self, key: int, bpmn_process_id: str, version: int, resource_name: str,
-                    resource_xml: str, digest: str) -> None:
+                    resource_xml: str, digest: str,
+                    tenant: str = DEFAULT_TENANT) -> None:
         meta = {
             "bpmnProcessId": bpmn_process_id,
             "version": version,
@@ -66,34 +91,39 @@ class ProcessState:
             "resourceName": resource_name,
             "resource": resource_xml,
             "checksum": digest,
+            "tenantId": tenant,
         }
         self._by_key.put((key,), meta)
-        self._by_id_version.put((bpmn_process_id, version), key)
-        self._digest.put((bpmn_process_id,), digest)
-        self._version.put((bpmn_process_id,), version)
+        self._by_id_version.put((tenant, bpmn_process_id, version), key)
+        self._digest.put((tenant, bpmn_process_id), digest)
+        self._version.put((tenant, bpmn_process_id), version)
 
     # queries
 
-    def next_version(self, bpmn_process_id: str) -> int:
-        return (self._version.get((bpmn_process_id,)) or 0) + 1
+    def next_version(self, bpmn_process_id: str, tenant: str = DEFAULT_TENANT) -> int:
+        return (self._version.get((tenant, bpmn_process_id)) or 0) + 1
 
-    def latest_version(self, bpmn_process_id: str) -> int | None:
-        return self._version.get((bpmn_process_id,))
+    def latest_version(self, bpmn_process_id: str,
+                       tenant: str = DEFAULT_TENANT) -> int | None:
+        return self._version.get((tenant, bpmn_process_id))
 
-    def latest_digest(self, bpmn_process_id: str) -> str | None:
-        return self._digest.get((bpmn_process_id,))
+    def latest_digest(self, bpmn_process_id: str,
+                      tenant: str = DEFAULT_TENANT) -> str | None:
+        return self._digest.get((tenant, bpmn_process_id))
 
     def get_by_key(self, key: int) -> dict | None:
         return self._by_key.get((key,))
 
-    def get_key_by_id_version(self, bpmn_process_id: str, version: int) -> int | None:
-        return self._by_id_version.get((bpmn_process_id, version))
+    def get_key_by_id_version(self, bpmn_process_id: str, version: int,
+                              tenant: str = DEFAULT_TENANT) -> int | None:
+        return self._by_id_version.get((tenant, bpmn_process_id, version))
 
-    def get_latest_by_id(self, bpmn_process_id: str) -> dict | None:
-        version = self.latest_version(bpmn_process_id)
+    def get_latest_by_id(self, bpmn_process_id: str,
+                         tenant: str = DEFAULT_TENANT) -> dict | None:
+        version = self.latest_version(bpmn_process_id, tenant)
         if version is None:
             return None
-        key = self.get_key_by_id_version(bpmn_process_id, version)
+        key = self.get_key_by_id_version(bpmn_process_id, version, tenant)
         return None if key is None else self.get_by_key(key)
 
     def delete(self, key: int) -> None:
@@ -106,20 +136,13 @@ class ProcessState:
             return
         process_id = meta["bpmnProcessId"]
         version = meta["version"]
+        tenant = meta.get("tenantId", DEFAULT_TENANT)
         self._by_key.put((key,), {**meta, "deleted": True})
-        if self._by_id_version.exists((process_id, version)):
-            self._by_id_version.delete((process_id, version))
-        if self._version.get((process_id,)) == version:
-            for v in range(version - 1, 0, -1):
-                prev_key = self._by_id_version.get((process_id, v))
-                if prev_key is not None:
-                    prev = self._by_key.get((prev_key,))
-                    self._version.put((process_id,), v)
-                    self._digest.put((process_id,), prev["checksum"])
-                    return
-            self._version.delete((process_id,))
-            if self._digest.exists((process_id,)):
-                self._digest.delete((process_id,))
+        _rollback_latest_version(
+            self._by_id_version, self._version, self._digest,
+            tenant, process_id, version,
+            digest_of=lambda k: self._by_key.get((k,))["checksum"],
+        )
 
     def executable(self, key: int) -> ExecutableProcess | None:
         exe = self._compiled.get(key)
@@ -234,6 +257,61 @@ class ElementInstanceState:
         )
 
 
+class FormState:
+    """Deployed Camunda forms: by key + tenant-scoped (id, version) indexes
+    (reference: engine/state/deployment/DbFormState.java, PersistedForm;
+    ZbColumnFamilies FORMS / FORM_BY_ID_AND_VERSION / FORM_VERSION)."""
+
+    def __init__(self, db: ZbDb) -> None:
+        self._by_key = db.column_family(CF.FORMS)
+        self._by_id_version = db.column_family(CF.FORM_BY_ID_AND_VERSION)
+        self._version = db.column_family(CF.FORM_VERSION)
+        self._digest = db.column_family(CF.FORM_DIGEST)
+
+    # mutators (appliers only)
+
+    def put(self, record_value: dict) -> None:
+        tenant = record_value.get("tenantId", DEFAULT_TENANT)
+        form_id = record_value["formId"]
+        version = record_value["version"]
+        self._by_key.put((record_value["formKey"],), dict(record_value))
+        self._by_id_version.put((tenant, form_id, version), record_value["formKey"])
+        self._version.put((tenant, form_id), version)
+        self._digest.put((tenant, form_id), record_value.get("checksum", ""))
+
+    def delete(self, form_key: int) -> None:
+        meta = self._by_key.get((form_key,))
+        if meta is None:
+            return
+        tenant = meta.get("tenantId", DEFAULT_TENANT)
+        form_id, version = meta["formId"], meta["version"]
+        self._by_key.delete((form_key,))
+        _rollback_latest_version(
+            self._by_id_version, self._version, self._digest,
+            tenant, form_id, version,
+            digest_of=lambda k: self._by_key.get((k,)).get("checksum", ""),
+        )
+
+    # queries
+
+    def next_version(self, form_id: str, tenant: str = DEFAULT_TENANT) -> int:
+        return (self._version.get((tenant, form_id)) or 0) + 1
+
+    def latest_digest(self, form_id: str, tenant: str = DEFAULT_TENANT) -> str | None:
+        return self._digest.get((tenant, form_id))
+
+    def get_by_key(self, form_key: int) -> dict | None:
+        return self._by_key.get((form_key,))
+
+    def get_latest_by_id(self, form_id: str,
+                         tenant: str = DEFAULT_TENANT) -> dict | None:
+        version = self._version.get((tenant, form_id))
+        if version is None:
+            return None
+        key = self._by_id_version.get((tenant, form_id, version))
+        return None if key is None else self._by_key.get((key,))
+
+
 class JobState:
     """Jobs + activatable queue by type + deadlines + retry backoff."""
 
@@ -246,10 +324,16 @@ class JobState:
 
     # mutators
 
+    @staticmethod
+    def _act_key(job: dict, key: int) -> tuple:
+        # tenant inside the index key: tenant-filtered activation peeks are
+        # prefix lookups, not scans (reference: tenant-aware JobState CFs)
+        return (job["type"], job.get("tenantId", DEFAULT_TENANT), key)
+
     def create(self, key: int, record_value: dict) -> None:
         self._jobs.put((key,), dict(record_value))
         self._states.put((key,), JOB_ACTIVATABLE)
-        self._activatable.put((record_value["type"], key), None)
+        self._activatable.put(self._act_key(record_value, key), None)
 
     def activate(self, key: int, worker: str, deadline: int) -> None:
         job = self._jobs.get((key,))
@@ -257,7 +341,7 @@ class JobState:
         job["deadline"] = deadline
         self._jobs.put((key,), job)
         self._states.put((key,), JOB_ACTIVATED)
-        self._activatable.delete((job["type"], key))
+        self._activatable.delete(self._act_key(job, key))
         self._deadlines.put((deadline, key), None)
 
     def complete(self, key: int) -> None:
@@ -278,7 +362,7 @@ class JobState:
             return
         state = self._states.get((key,))
         if state == JOB_ACTIVATABLE:
-            self._activatable.delete((job["type"], key))
+            self._activatable.delete(self._act_key(job, key))
         if state == JOB_ACTIVATED and job.get("deadline", -1) >= 0:
             self._deadlines.delete((job["deadline"], key))
         backoff_until = job.get("backoffUntil", -1)
@@ -303,7 +387,7 @@ class JobState:
                 self._backoff.put((backoff_until, key), None)
             else:
                 self._states.put((key,), JOB_ACTIVATABLE)
-                self._activatable.put((job["type"], key), None)
+                self._activatable.put(self._act_key(job, key), None)
         else:
             self._states.put((key,), JOB_FAILED)
 
@@ -315,7 +399,7 @@ class JobState:
                 self._backoff.delete((until, key))
         self._jobs.put((key,), job)
         self._states.put((key,), JOB_ACTIVATABLE)
-        self._activatable.put((job["type"], key), None)
+        self._activatable.put(self._act_key(job, key), None)
 
     def timeout(self, key: int) -> None:
         """Deadline passed: activated → activatable again."""
@@ -326,7 +410,7 @@ class JobState:
         job["worker"] = ""
         self._jobs.put((key,), job)
         self._states.put((key,), JOB_ACTIVATABLE)
-        self._activatable.put((job["type"], key), None)
+        self._activatable.put(self._act_key(job, key), None)
 
     def update_retries(self, key: int, retries: int) -> None:
         job = self._jobs.get((key,))
@@ -354,7 +438,7 @@ class JobState:
         """After retries updated on a no-retries-failed job + incident resolve."""
         job = self._jobs.get((key,))
         self._states.put((key,), JOB_ACTIVATABLE)
-        self._activatable.put((job["type"], key), None)
+        self._activatable.put(self._act_key(job, key), None)
 
     # queries
 
@@ -364,12 +448,23 @@ class JobState:
     def state_of(self, key: int) -> int | None:
         return self._states.get((key,))
 
-    def activatable_keys(self, job_type: str, limit: int) -> list[int]:
-        out = []
-        for enc_key, _ in self._activatable.items((job_type,)):
-            out.append(_decode_trailing_i64(enc_key))
-            if len(out) >= limit:
-                break
+    def activatable_keys(self, job_type: str, limit: int,
+                         tenant_ids: list[str] | None = None) -> list[int]:
+        """Activatable job keys of a type, optionally restricted to the
+        caller's authorized tenants; each tenant is a prefix range
+        (reference: JobBatchCollector + tenant-aware JobState CFs)."""
+        out: list[int] = []
+        if tenant_ids is None:
+            for enc_key, _ in self._activatable.items((job_type,)):
+                out.append(_decode_trailing_i64(enc_key))
+                if len(out) >= limit:
+                    break
+            return out
+        for tenant in tenant_ids:
+            for enc_key, _ in self._activatable.items((job_type, tenant)):
+                out.append(_decode_trailing_i64(enc_key))
+                if len(out) >= limit:
+                    return out
         return out
 
     def expired_deadlines(self, now_millis: int) -> list[int]:
@@ -564,7 +659,13 @@ class MessageState:
             self._deadlines.put((deadline, key), None)
         message_id = record_value.get("messageId") or ""
         if message_id:
-            self._ids.put((record_value["name"], record_value["correlationKey"], message_id), key)
+            # tenant is part of the dedup key: id reuse across tenants must
+            # not clobber another tenant's entry (reference: tenant-aware
+            # MESSAGE_IDS column family)
+            tenant = record_value.get("tenantId", DEFAULT_TENANT)
+            self._ids.put(
+                (record_value["name"], record_value["correlationKey"],
+                 message_id, tenant), key)
 
     def remove(self, key: int, deadline: int) -> None:
         msg = self._messages.get((key,))
@@ -574,8 +675,11 @@ class MessageState:
         if deadline > 0 and self._deadlines.exists((deadline, key)):
             self._deadlines.delete((deadline, key))
         message_id = msg.get("messageId") or ""
-        if message_id and self._ids.exists((msg["name"], msg["correlationKey"], message_id)):
-            self._ids.delete((msg["name"], msg["correlationKey"], message_id))
+        if message_id:
+            id_key = (msg["name"], msg["correlationKey"], message_id,
+                      msg.get("tenantId", DEFAULT_TENANT))
+            if self._ids.exists(id_key):
+                self._ids.delete(id_key)
         for enc_key, _ in list(self._correlated.items((key,))):
             self._correlated._ctx().delete(enc_key)
         self._messages.delete((key,))
@@ -583,8 +687,9 @@ class MessageState:
     def get(self, key: int) -> dict | None:
         return self._messages.get((key,))
 
-    def is_id_taken(self, name: str, correlation_key: str, message_id: str) -> bool:
-        return self._ids.exists((name, correlation_key, message_id))
+    def is_id_taken(self, name: str, correlation_key: str, message_id: str,
+                    tenant: str = DEFAULT_TENANT) -> bool:
+        return self._ids.exists((name, correlation_key, message_id, tenant))
 
     def buffered_for(self, name: str, correlation_key: str) -> list[int]:
         out = []
@@ -863,33 +968,41 @@ class DecisionState:
         self._by_drg = db.column_family(CF.DMN_DECISIONS_BY_DRG)
         self._parsed: dict[int, object] = {}  # drg_key → ParsedDrg (cache)
 
+    @staticmethod
+    def _tenant_of(meta: dict) -> str:
+        return meta.get("tenantId", DEFAULT_TENANT)
+
     def put_drg(self, drg_key: int, meta: dict) -> None:
         self._drgs.put((drg_key,), dict(meta))
-        latest = self._latest_drg.get((meta["decisionRequirementsId"],))
+        id_key = (self._tenant_of(meta), meta["decisionRequirementsId"])
+        latest = self._latest_drg.get(id_key)
         if latest is None or meta["version"] >= latest.get("version", 0):
-            self._latest_drg.put((meta["decisionRequirementsId"],),
+            self._latest_drg.put(id_key,
                                  {"version": meta["version"], "key": drg_key})
 
     def put_decision(self, decision_key: int, meta: dict) -> None:
         self._decisions.put((decision_key,), dict(meta))
         self._by_drg.put((meta["decisionRequirementsKey"], decision_key), None)
-        latest_key = self._latest_decision.get((meta["decisionId"],))
+        id_key = (self._tenant_of(meta), meta["decisionId"])
+        latest_key = self._latest_decision.get(id_key)
         latest = self._decisions.get((latest_key,)) if latest_key else None
         if latest is None or meta["version"] >= latest.get("version", 0):
-            self._latest_decision.put((meta["decisionId"],), decision_key)
+            self._latest_decision.put(id_key, decision_key)
 
     def decision_by_key(self, decision_key: int) -> dict | None:
         return self._decisions.get((decision_key,))
 
-    def latest_decision_by_id(self, decision_id: str) -> dict | None:
-        key = self._latest_decision.get((decision_id,))
+    def latest_decision_by_id(self, decision_id: str,
+                              tenant: str = DEFAULT_TENANT) -> dict | None:
+        key = self._latest_decision.get((tenant, decision_id))
         return None if key is None else self._decisions.get((key,))
 
     def drg_by_key(self, drg_key: int) -> dict | None:
         return self._drgs.get((drg_key,))
 
-    def latest_drg_meta(self, drg_id: str) -> dict | None:
-        latest = self._latest_drg.get((drg_id,))
+    def latest_drg_meta(self, drg_id: str,
+                        tenant: str = DEFAULT_TENANT) -> dict | None:
+        latest = self._latest_drg.get((tenant, drg_id))
         return None if latest is None else self._drgs.get((latest["key"],))
 
     def decisions_of_drg(self, drg_key: int) -> list[dict]:
@@ -898,15 +1011,17 @@ class DecisionState:
             for enc, _ in self._by_drg.items((drg_key,))
         ]
 
-    def latest_drg_digest(self, drg_id: str) -> str | None:
-        latest = self._latest_drg.get((drg_id,))
+    def latest_drg_digest(self, drg_id: str,
+                          tenant: str = DEFAULT_TENANT) -> str | None:
+        latest = self._latest_drg.get((tenant, drg_id))
         if latest is None:
             return None
         drg = self._drgs.get((latest["key"],))
         return None if drg is None else drg.get("checksum")
 
-    def latest_drg_version(self, drg_id: str) -> int:
-        latest = self._latest_drg.get((drg_id,))
+    def latest_drg_version(self, drg_id: str,
+                           tenant: str = DEFAULT_TENANT) -> int:
+        latest = self._latest_drg.get((tenant, drg_id))
         return 0 if latest is None else latest["version"]
 
     def delete_drg(self, drg_key: int) -> None:
@@ -914,34 +1029,38 @@ class DecisionState:
         drg = self._drgs.get((drg_key,))
         if drg is None:
             return
+        tenant = self._tenant_of(drg)
         for meta in self.decisions_of_drg(drg_key):
             if meta is None:
                 continue
             decision_key = meta["decisionKey"]
             self._decisions.delete((decision_key,))
             self._by_drg.delete((drg_key, decision_key))
-            if self._latest_decision.get((meta["decisionId"],)) == decision_key:
-                self._latest_decision.delete((meta["decisionId"],))
+            dec_key = (tenant, meta["decisionId"])
+            if self._latest_decision.get(dec_key) == decision_key:
+                self._latest_decision.delete(dec_key)
         self._drgs.delete((drg_key,))
         self._parsed.pop(drg_key, None)
         drg_id = drg["decisionRequirementsId"]
-        latest = self._latest_drg.get((drg_id,))
+        latest = self._latest_drg.get((tenant, drg_id))
         if latest is not None and latest.get("key") == drg_key:
-            self._latest_drg.delete((drg_id,))
+            self._latest_drg.delete((tenant, drg_id))
             # repoint latest to the highest remaining version of the same DRG
             best = None
             for remaining in self._drgs.values():
                 if remaining.get("decisionRequirementsId") != drg_id:
                     continue
+                if self._tenant_of(remaining) != tenant:
+                    continue
                 if best is None or remaining["version"] > best["version"]:
                     best = remaining
             if best is not None:
                 best_key = best["decisionRequirementsKey"]
-                self._latest_drg.put((drg_id,),
+                self._latest_drg.put((tenant, drg_id),
                                      {"version": best["version"], "key": best_key})
                 for meta in self.decisions_of_drg(best_key):
                     if meta is not None:
-                        self._latest_decision.put((meta["decisionId"],),
+                        self._latest_decision.put((tenant, meta["decisionId"]),
                                                   meta["decisionKey"])
 
     def parsed_drg(self, drg_key: int):
@@ -998,6 +1117,7 @@ class EngineState:
         self.db = db
         self.partition_id = partition_id
         self.processes = ProcessState(db)
+        self.forms = FormState(db)
         self.element_instances = ElementInstanceState(db)
         self.jobs = JobState(db)
         self.variables = VariableState(db, self.element_instances)

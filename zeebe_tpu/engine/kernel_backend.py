@@ -300,6 +300,12 @@ class KernelBackend:
         value = cmd.record.value
         if value.get("startInstructions"):
             return None
+        from zeebe_tpu.protocol import DEFAULT_TENANT
+
+        if value.get("tenantId", DEFAULT_TENANT) != DEFAULT_TENANT:
+            # non-default tenants ride the sequential path: the kernel's value
+            # builders emit the default tenant's record shape
+            return None
         bpmn_process_id = value.get("bpmnProcessId", "")
         definition_key = value.get("processDefinitionKey", -1)
         version = value.get("version", -1)
@@ -417,6 +423,10 @@ class KernelBackend:
             return None  # same-instance conflict: next group
         root_meta = state.element_instances.get(pi_key)
         if root_meta is None:
+            return None
+        if "tenantId" in root_meta["value"]:
+            # non-default-tenant instances stay on the sequential path end to
+            # end (the kernel's value builders emit default-tenant shapes)
             return None
         def_key = root_meta["value"].get("processDefinitionKey", -1)
         info = self.registry.lookup(def_key, state.processes.executable(def_key))

@@ -24,7 +24,7 @@ from zeebe_tpu.parallel.partitioning import (
     InterPartitionCommandSender,
     subscription_partition_id,
 )
-from zeebe_tpu.protocol import Record, RejectionType, ValueType, command
+from zeebe_tpu.protocol import DEFAULT_TENANT, Record, RejectionType, ValueType, command
 from zeebe_tpu.protocol.enums import BpmnElementType
 from zeebe_tpu.protocol.intent import (
     JobIntent,
@@ -99,6 +99,10 @@ class TimerProcessors:
                 "version": meta["version"],
                 "variables": {},
                 "startElementId": timer["targetElementId"],
+                # the creation must address the definition's own tenant or the
+                # cross-tenant key-lookup guard rejects it
+                **({"tenantId": meta["tenantId"]}
+                   if meta.get("tenantId", DEFAULT_TENANT) != DEFAULT_TENANT else {}),
             },
         )
         reps = timer.get("repetitions", 1)
@@ -139,7 +143,13 @@ class MessageProcessors:
         correlation_key = value.get("correlationKey", "")
         message_id = value.get("messageId", "") or ""
         ttl = value.get("timeToLive", 0)
-        if message_id and self.state.messages.is_id_taken(name, correlation_key, message_id):
+        tenant = value.get("tenantId") or DEFAULT_TENANT
+        from zeebe_tpu.engine.processors import check_tenant_authorized
+
+        if not check_tenant_authorized(cmd, tenant, writers):
+            return
+        if message_id and self.state.messages.is_id_taken(
+                name, correlation_key, message_id, tenant):
             writers.respond_rejection(
                 cmd, RejectionType.ALREADY_EXISTS,
                 f"a message with id '{message_id}' is already published",
@@ -154,21 +164,27 @@ class MessageProcessors:
             "timeToLive": ttl,
             "variables": value.get("variables", {}),
             "deadline": deadline,
+            **({"tenantId": tenant} if tenant != DEFAULT_TENANT else {}),
         }
         published = writers.append_event(
             key, ValueType.MESSAGE, MessageIntent.PUBLISHED, published_value
         )
         writers.respond(cmd, published)
 
-        # correlate to open subscriptions (once per process instance)
+        # correlate to open subscriptions of the SAME tenant (once per
+        # process instance; reference: tenant-aware MessageSubscriptionState)
         for sub_key, sub in self.state.message_subscriptions.find(name, correlation_key):
+            if sub.get("tenantId", DEFAULT_TENANT) != tenant:
+                continue
             pi_key = sub.get("processInstanceKey", -1)
             if self.state.messages.was_correlated_to(key, pi_key):
                 continue
             self._correlate(key, published_value, sub_key, sub, writers)
 
-        # message start events
+        # message start events (tenant-matched)
         for start_sub in self.state.message_start_subscriptions.find(name):
+            if start_sub.get("tenantId", DEFAULT_TENANT) != tenant:
+                continue
             writers.append_event(
                 self.state.next_key(), ValueType.MESSAGE_START_EVENT_SUBSCRIPTION,
                 MessageStartEventSubscriptionIntent.CORRELATED,
@@ -182,6 +198,7 @@ class MessageProcessors:
                     "version": -1,
                     "variables": published_value["variables"],
                     "startElementId": start_sub["startEventId"],
+                    **({"tenantId": tenant} if tenant != DEFAULT_TENANT else {}),
                 },
             )
 
@@ -243,13 +260,17 @@ class MessageSubscriptionProcessors:
         writers.append_event(
             sub_key, ValueType.MESSAGE_SUBSCRIPTION, MessageSubscriptionIntent.CREATED, value
         )
-        # an already-buffered message may correlate immediately
+        # an already-buffered message of the same tenant may correlate
+        # immediately
         name, corr = value["messageName"], value["correlationKey"]
+        tenant = value.get("tenantId", DEFAULT_TENANT)
         pi_key = value.get("processInstanceKey", -1)
         for message_key in self.state.messages.buffered_for(name, corr):
             if self.state.messages.was_correlated_to(message_key, pi_key):
                 continue
             message = self.state.messages.get(message_key)
+            if message.get("tenantId", DEFAULT_TENANT) != tenant:
+                continue
             _correlate_to_subscription(
                 self.state, self.sender, message_key, message, sub_key, value, writers
             )

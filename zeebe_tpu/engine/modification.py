@@ -300,11 +300,12 @@ class ResourceDeletionProcessor:
         resource_key = cmd.record.value.get("resourceKey", -1)
         process_meta = self.state.processes.get_by_key(resource_key)
         drg_meta = self.state.decisions.drg_by_key(resource_key)
-        if process_meta is None and drg_meta is None:
+        form_meta = self.state.forms.get_by_key(resource_key)
+        if process_meta is None and drg_meta is None and form_meta is None:
             writers.respond_rejection(
                 cmd, RejectionType.NOT_FOUND,
                 f"Expected to delete resource {resource_key}, but no deployed "
-                "process definition or decision requirements found",
+                "process definition, decision requirements, or form found",
             )
             return
         value = {"resourceKey": resource_key}
@@ -321,10 +322,16 @@ class ResourceDeletionProcessor:
             )
 
     def _delete(self, value: dict, writers: Writers) -> None:
+        from zeebe_tpu.protocol.intent import FormIntent
+
         resource_key = value["resourceKey"]
         process_meta = self.state.processes.get_by_key(resource_key)
         if process_meta is not None:
             self._close_start_subscriptions(resource_key, process_meta, writers)
+        form_meta = self.state.forms.get_by_key(resource_key)
+        if form_meta is not None:
+            writers.append_event(resource_key, ValueType.FORM, FormIntent.DELETED,
+                                 form_meta)
         writers.append_event(
             self.state.next_key(), ValueType.RESOURCE_DELETION,
             ResourceDeletionIntent.DELETED, {"resourceKey": resource_key},

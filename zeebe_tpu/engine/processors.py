@@ -25,7 +25,7 @@ from zeebe_tpu.engine.writers import Writers
 from zeebe_tpu.logstreams import LoggedRecord
 from zeebe_tpu.dmn import DmnParseError, parse_dmn_xml
 from zeebe_tpu.models.bpmn import BpmnModelError, parse_bpmn_xml, transform
-from zeebe_tpu.protocol import RejectionType, ValueType
+from zeebe_tpu.protocol import DEFAULT_TENANT, RejectionType, ValueType
 from zeebe_tpu.protocol.enums import BpmnElementType, ErrorType
 from zeebe_tpu.protocol.intent import (
     DeploymentIntent,
@@ -38,6 +38,42 @@ from zeebe_tpu.protocol.intent import (
     VariableDocumentIntent,
     VariableIntent,
 )
+
+
+class FormParseError(ValueError):
+    pass
+
+
+def _parse_form(source: str) -> dict:
+    """Parse a Camunda form resource (JSON document with an ``id``).
+    Reference: deployment/transform/FormResourceTransformer — the engine
+    stores the raw resource; only the id is structurally required."""
+    import json
+
+    try:
+        doc = json.loads(source)
+    except ValueError as exc:
+        raise FormParseError(f"form resource is not valid JSON: {exc}") from exc
+    if not isinstance(doc, dict) or not doc.get("id"):
+        raise FormParseError("form resource must be a JSON object with an 'id'")
+    return doc
+
+
+def check_tenant_authorized(cmd: LoggedRecord, tenant: str, writers: Writers) -> bool:
+    """TenantAuthorizationChecker: the gateway stamps the caller's authorized
+    tenants into the command (reference: RecordMetadata authorization claims +
+    engine multitenancy/TenantAuthorizationChecker); a command addressing a
+    tenant outside that list is rejected as NOT_FOUND — unauthorized tenants'
+    resources are invisible, not forbidden (8.4 semantics)."""
+    authorized = cmd.record.value.get("authorizedTenants")
+    if authorized and tenant not in authorized:
+        writers.respond_rejection(
+            cmd, RejectionType.NOT_FOUND,
+            f"Expected to handle command for tenant '{tenant}', but the request "
+            "is not authorized for that tenant",
+        )
+        return False
+    return True
 
 
 class DeploymentProcessor:
@@ -59,11 +95,15 @@ class DeploymentProcessor:
         if not resources:
             writers.respond_rejection(cmd, RejectionType.INVALID_ARGUMENT, "no resources")
             return
+        tenant = value.get("tenantId") or DEFAULT_TENANT
+        if not check_tenant_authorized(cmd, tenant, writers):
+            return
 
         processes_metadata = []
         try:
             parsed = []
             dmn_parsed = []
+            form_parsed = []
             for res in resources:
                 xml = res["resource"]
                 # checksum over the resource bytes (reference: DigestGenerator
@@ -74,27 +114,33 @@ class DeploymentProcessor:
                         (res["resourceName"], xml, parse_dmn_xml(xml), checksum)
                     )
                     continue
+                if res["resourceName"].endswith(".form"):
+                    form_parsed.append(
+                        (res["resourceName"], xml, _parse_form(xml), checksum)
+                    )
+                    continue
                 for model in parse_bpmn_xml(xml):
                     exe = transform(model)  # also rejects bad deployments
                     parsed.append((res["resourceName"], xml, model, checksum, exe))
-        except (BpmnModelError, DmnParseError) as exc:
+        except (BpmnModelError, DmnParseError, FormParseError) as exc:
             writers.respond_rejection(cmd, RejectionType.INVALID_ARGUMENT, str(exc))
             return
 
         deployment_key = self.state.next_key()
         for resource_name, xml, model, checksum, exe in parsed:
-            previous_digest = self.state.processes.latest_digest(model.process_id)
-            previous_version = self.state.processes.latest_version(model.process_id)
+            previous_digest = self.state.processes.latest_digest(model.process_id, tenant)
+            previous_version = self.state.processes.latest_version(model.process_id, tenant)
             previous_key = (
-                self.state.processes.get_key_by_id_version(model.process_id, previous_version)
+                self.state.processes.get_key_by_id_version(
+                    model.process_id, previous_version, tenant)
                 if previous_version is not None else None
             )
             duplicate = previous_digest == checksum
             if duplicate:
-                version = self.state.processes.latest_version(model.process_id)
-                process_key = self.state.processes.get_key_by_id_version(model.process_id, version)
+                version = previous_version
+                process_key = previous_key
             else:
-                version = self.state.processes.next_version(model.process_id)
+                version = self.state.processes.next_version(model.process_id, tenant)
                 process_key = self.state.next_key()
             meta = {
                 "bpmnProcessId": model.process_id,
@@ -103,6 +149,10 @@ class DeploymentProcessor:
                 "resourceName": resource_name,
                 "checksum": checksum,
                 "duplicate": duplicate,
+                # the default tenant's records stay byte-identical to the
+                # pre-tenancy shape (and to the kernel backend's output):
+                # tenantId appears only when it carries information
+                **({"tenantId": tenant} if tenant != DEFAULT_TENANT else {}),
             }
             processes_metadata.append(meta)
             if not duplicate:
@@ -114,7 +164,8 @@ class DeploymentProcessor:
                     writers, exe, meta, previous_key
                 )
 
-        decisions_metadata, drg_metadata = self._deploy_dmn(dmn_parsed, writers)
+        decisions_metadata, drg_metadata = self._deploy_dmn(dmn_parsed, writers, tenant)
+        form_metadata = self._deploy_forms(form_parsed, tenant, writers)
 
         deployment_value = {
             "resources": [
@@ -123,7 +174,8 @@ class DeploymentProcessor:
             "processesMetadata": processes_metadata,
             "decisionsMetadata": decisions_metadata,
             "decisionRequirementsMetadata": drg_metadata,
-            "formMetadata": [],
+            "formMetadata": form_metadata,
+            **({"tenantId": tenant} if tenant != DEFAULT_TENANT else {}),
         }
         created = writers.append_event(
             deployment_key, ValueType.DEPLOYMENT, DeploymentIntent.CREATED, deployment_value
@@ -145,29 +197,31 @@ class DeploymentProcessor:
                 deployment_value,
             )
 
-    def _deploy_dmn(self, dmn_parsed, writers: Writers):
-        """Version DRGs + decisions and write their CREATED events (reference:
-        deployment/transform DmnResourceTransformer)."""
+    def _deploy_dmn(self, dmn_parsed, writers: Writers, tenant: str = DEFAULT_TENANT):
+        """Version DRGs + decisions per tenant and write their CREATED events
+        (reference: deployment/transform DmnResourceTransformer)."""
         from zeebe_tpu.protocol.intent import (
             DecisionIntent,
             DecisionRequirementsIntent,
         )
 
+        tenant_field = {"tenantId": tenant} if tenant != DEFAULT_TENANT else {}
         decisions_metadata: list[dict] = []
         drg_metadata: list[dict] = []
         for resource_name, xml, drg, checksum in dmn_parsed:
-            duplicate = self.state.decisions.latest_drg_digest(drg.drg_id) == checksum
+            duplicate = self.state.decisions.latest_drg_digest(
+                drg.drg_id, tenant) == checksum
             if duplicate:
                 # idempotent redeploy still reports the existing keys/versions
                 # (mirrors the BPMN duplicate path's metadata contract)
-                existing = dict(self.state.decisions.latest_drg_meta(drg.drg_id))
+                existing = dict(self.state.decisions.latest_drg_meta(drg.drg_id, tenant))
                 existing.pop("resource", None)
                 drg_metadata.append({**existing, "duplicate": True})
                 for meta in self.state.decisions.decisions_of_drg(
                         existing["decisionRequirementsKey"]):
                     decisions_metadata.append({**meta, "duplicate": True})
                 continue
-            version = self.state.decisions.latest_drg_version(drg.drg_id) + 1
+            version = self.state.decisions.latest_drg_version(drg.drg_id, tenant) + 1
             drg_key = self.state.next_key()
             drg_meta = {
                 "decisionRequirementsId": drg.drg_id,
@@ -177,6 +231,7 @@ class DeploymentProcessor:
                 "namespace": drg.namespace,
                 "resourceName": resource_name,
                 "checksum": checksum,
+                **tenant_field,
             }
             drg_metadata.append(drg_meta)
             writers.append_event(
@@ -193,12 +248,48 @@ class DeploymentProcessor:
                     "decisionKey": decision_key,
                     "decisionRequirementsKey": drg_key,
                     "decisionRequirementsId": drg.drg_id,
+                    **tenant_field,
                 }
                 decisions_metadata.append(meta)
                 writers.append_event(
                     decision_key, ValueType.DECISION, DecisionIntent.CREATED, meta
                 )
         return decisions_metadata, drg_metadata
+
+    def _deploy_forms(self, form_parsed, tenant: str, writers: Writers) -> list[dict]:
+        """Version forms per (tenant, formId) with digest dedup and write FORM
+        CREATED events (reference: FormResourceTransformer + FormCreatedApplier)."""
+        from zeebe_tpu.protocol.intent import FormIntent
+
+        form_metadata: list[dict] = []
+        for resource_name, source, doc, checksum in form_parsed:
+            form_id = doc["id"]
+            duplicate = self.state.forms.latest_digest(form_id, tenant) == checksum
+            if duplicate:
+                existing = self.state.forms.get_latest_by_id(form_id, tenant)
+                meta = {k: existing[k] for k in
+                        ("formId", "version", "formKey", "resourceName", "checksum")}
+                form_metadata.append({**meta, "duplicate": True,
+                                      **({"tenantId": tenant}
+                                         if tenant != DEFAULT_TENANT else {})})
+                continue
+            version = self.state.forms.next_version(form_id, tenant)
+            form_key = self.state.next_key()
+            meta = {
+                "formId": form_id,
+                "version": version,
+                "formKey": form_key,
+                "resourceName": resource_name,
+                "checksum": checksum,
+                "duplicate": False,
+                **({"tenantId": tenant} if tenant != DEFAULT_TENANT else {}),
+            }
+            form_metadata.append(meta)
+            writers.append_event(
+                form_key, ValueType.FORM, FormIntent.CREATED,
+                {**meta, "resource": source},
+            )
+        return form_metadata
 
     def _process_distributed(self, cmd: LoggedRecord, writers: Writers) -> None:
         """Receiver side of deployment distribution: store the definitions under
@@ -229,18 +320,21 @@ class DeploymentProcessor:
         for meta in value.get("processesMetadata", []):
             if meta.get("duplicate"):
                 continue
+            tenant = meta.get("tenantId", DEFAULT_TENANT)
             # domain-level idempotence: a retry whose dedup marker was already
             # purged must not re-deploy (digest check, same as the origin path)
-            if self.state.processes.latest_digest(meta["bpmnProcessId"]) == meta["checksum"]:
+            if self.state.processes.latest_digest(
+                    meta["bpmnProcessId"], tenant) == meta["checksum"]:
                 continue
             entry = parsed(meta["bpmnProcessId"])
             if entry is None:
                 continue
             xml, exe = entry
-            previous_version = self.state.processes.latest_version(meta["bpmnProcessId"])
+            previous_version = self.state.processes.latest_version(
+                meta["bpmnProcessId"], tenant)
             previous_key = (
                 self.state.processes.get_key_by_id_version(
-                    meta["bpmnProcessId"], previous_version
+                    meta["bpmnProcessId"], previous_version, tenant
                 )
                 if previous_version is not None else None
             )
@@ -261,7 +355,9 @@ class DeploymentProcessor:
             r["resourceName"]: r["resource"] for r in value.get("resources", [])
         }
         for drg_meta in value.get("decisionRequirementsMetadata", []):
-            if (self.state.decisions.latest_drg_digest(drg_meta["decisionRequirementsId"])
+            if (self.state.decisions.latest_drg_digest(
+                    drg_meta["decisionRequirementsId"],
+                    drg_meta.get("tenantId", DEFAULT_TENANT))
                     == drg_meta["checksum"]):
                 continue
             writers.append_event(
@@ -274,6 +370,21 @@ class DeploymentProcessor:
                 continue
             writers.append_event(
                 meta["decisionKey"], ValueType.DECISION, DecisionIntent.CREATED, meta
+            )
+        # forms replicate under the origin-minted keys/versions
+        from zeebe_tpu.protocol.intent import FormIntent
+
+        resource_by_name = {
+            r["resourceName"]: r["resource"] for r in value.get("resources", [])
+        }
+        for meta in value.get("formMetadata", []):
+            if meta.get("duplicate"):
+                continue
+            if self.state.forms.get_by_key(meta["formKey"]) is not None:
+                continue
+            writers.append_event(
+                meta["formKey"], ValueType.FORM, FormIntent.CREATED,
+                {**meta, "resource": resource_by_name.get(meta["resourceName"], "")},
             )
         writers.append_event(
             cmd.record.key, ValueType.DEPLOYMENT, DeploymentIntent.DISTRIBUTED, value
@@ -335,6 +446,8 @@ def register_start_subscriptions(state, clock_millis, writers, exe, meta,
                         "processDefinitionKey": meta["processDefinitionKey"],
                         "bpmnProcessId": meta["bpmnProcessId"],
                         "interrupting": True,
+                        **({"tenantId": meta["tenantId"]}
+                           if meta.get("tenantId", DEFAULT_TENANT) != DEFAULT_TENANT else {}),
                     },
                 )
             elif el.event_type == BpmnEventType.MESSAGE and el.message_name:
@@ -346,6 +459,8 @@ def register_start_subscriptions(state, clock_millis, writers, exe, meta,
                         "bpmnProcessId": meta["bpmnProcessId"],
                         "startEventId": el.id,
                         "messageName": el.message_name,
+                        **({"tenantId": meta["tenantId"]}
+                           if meta.get("tenantId", DEFAULT_TENANT) != DEFAULT_TENANT else {}),
                     },
                 )
             elif el.event_type == BpmnEventType.TIMER and el.timer_cycle and include_timers:
@@ -394,14 +509,21 @@ class ProcessInstanceCreationProcessor:
         bpmn_process_id = value.get("bpmnProcessId", "")
         definition_key = value.get("processDefinitionKey", -1)
         version = value.get("version", -1)
+        tenant = value.get("tenantId") or DEFAULT_TENANT
+        if not check_tenant_authorized(cmd, tenant, writers):
+            return
 
         if definition_key > 0:
             meta = self.state.processes.get_by_key(definition_key)
+            # a key look-up must not cross tenants (reference:
+            # TenantAuthorizationChecker on CreateProcessInstance)
+            if meta is not None and meta.get("tenantId", DEFAULT_TENANT) != tenant:
+                meta = None
         elif version > 0:
-            key = self.state.processes.get_key_by_id_version(bpmn_process_id, version)
+            key = self.state.processes.get_key_by_id_version(bpmn_process_id, version, tenant)
             meta = None if key is None else self.state.processes.get_by_key(key)
         else:
-            meta = self.state.processes.get_latest_by_id(bpmn_process_id)
+            meta = self.state.processes.get_latest_by_id(bpmn_process_id, tenant)
         if meta is None or meta.get("deleted"):
             writers.respond_rejection(
                 cmd, RejectionType.NOT_FOUND,
@@ -418,6 +540,7 @@ class ProcessInstanceCreationProcessor:
             "processInstanceKey": process_instance_key,
             "variables": value.get("variables", {}),
             "startInstructions": value.get("startInstructions", []),
+            **({"tenantId": tenant} if tenant != DEFAULT_TENANT else {}),
         }
         created = writers.append_event(
             process_instance_key, ValueType.PROCESS_INSTANCE_CREATION,
@@ -441,6 +564,7 @@ class ProcessInstanceCreationProcessor:
             "flowScopeKey": -1,
             "bpmnElementType": BpmnElementType.PROCESS.name,
             "bpmnEventType": "UNSPECIFIED",
+            **({"tenantId": tenant} if tenant != DEFAULT_TENANT else {}),
         }
         if value.get("startElementId"):
             pi_value["startElementId"] = value["startElementId"]
@@ -710,7 +834,10 @@ class JobBatchProcessor:
             )
             return
         deadline = self.clock_millis() + timeout
-        keys = self.state.jobs.activatable_keys(job_type, max_jobs)
+        # authorized-tenant restriction: absent/empty means default tenant
+        # only (reference: JobBatchActivateProcessor authorized tenants)
+        tenant_ids = value.get("tenantIds") or [DEFAULT_TENANT]
+        keys = self.state.jobs.activatable_keys(job_type, max_jobs, tenant_ids)
         jobs = []
         for key in keys:
             job = dict(self.state.jobs.get(key))
@@ -734,6 +861,89 @@ class JobBatchProcessor:
             batch_key, ValueType.JOB_BATCH, JobBatchIntent.ACTIVATED, activated_value
         )
         writers.respond(cmd, activated)
+
+
+class ProcessInstanceBatchProcessor:
+    """PROCESS_INSTANCE_BATCH ACTIVATE / TERMINATE: chunk huge fan-outs and
+    fan-ins so no single processing step writes an unbounded record batch
+    (reference: processinstance/ActivateProcessInstanceBatchProcessor.java,
+    TerminateProcessInstanceBatchProcessor.java; SURVEY §5.7)."""
+
+    def __init__(self, state: EngineState, bpmn: BpmnProcessor) -> None:
+        self.state = state
+        self.bpmn = bpmn
+
+    def activate(self, cmd: LoggedRecord, writers: Writers) -> None:
+        from zeebe_tpu.engine.bpmn import PI_BATCH_CHUNK
+        from zeebe_tpu.engine.engine_state import EI_ACTIVATED, EI_ACTIVATING
+        from zeebe_tpu.protocol.intent import ProcessInstanceBatchIntent
+
+        value = cmd.record.value
+        body_key = value.get("batchElementInstanceKey", -1)
+        index = value.get("index", 0)
+        body = self.state.element_instances.get(body_key)
+        if body is None or body["state"] not in (EI_ACTIVATING, EI_ACTIVATED):
+            return  # body gone (terminated meanwhile): drop the chain
+        body_value = body["value"]
+        exe = self.state.processes.executable(body_value["processDefinitionKey"])
+        element = exe.element(body_value["elementId"])
+        items = self.bpmn._eval_input_collection(body_key, body_value, element, writers)
+        if items is None:
+            return  # incident raised on the body
+        end = min(index + PI_BATCH_CHUNK, len(items))
+        for i in range(index, end):
+            self.bpmn._write_mi_inner_activate(
+                writers, body_key, body_value, element, items[i], i + 1
+            )
+        writers.append_event(
+            cmd.record.key, ValueType.PROCESS_INSTANCE_BATCH,
+            ProcessInstanceBatchIntent.ACTIVATED,
+            {"processInstanceKey": value.get("processInstanceKey", -1),
+             "batchElementInstanceKey": body_key,
+             "index": end, "count": len(items)},
+        )
+        if end < len(items):
+            writers.append_command(
+                self.state.next_key(), ValueType.PROCESS_INSTANCE_BATCH,
+                ProcessInstanceBatchIntent.ACTIVATE,
+                {"processInstanceKey": value.get("processInstanceKey", -1),
+                 "batchElementInstanceKey": body_key, "index": end},
+            )
+
+    def terminate(self, cmd: LoggedRecord, writers: Writers) -> None:
+        from zeebe_tpu.engine.bpmn import PI_BATCH_CHUNK
+        from zeebe_tpu.engine.engine_state import EI_TERMINATED, EI_TERMINATING
+        from zeebe_tpu.protocol.intent import ProcessInstanceBatchIntent
+
+        value = cmd.record.value
+        scope_key = value.get("batchElementInstanceKey", -1)
+        scope = self.state.element_instances.get(scope_key)
+        if scope is None:
+            return  # scope finished terminating meanwhile
+        pending = [
+            k for k in self.state.element_instances.children_keys(scope_key)
+            if self.state.element_instances.get(k)["state"]
+            not in (EI_TERMINATING, EI_TERMINATED)
+        ]
+        for child_key in pending[:PI_BATCH_CHUNK]:
+            writers.append_command(
+                child_key, ValueType.PROCESS_INSTANCE,
+                ProcessInstanceIntent.TERMINATE_ELEMENT, {},
+            )
+        writers.append_event(
+            cmd.record.key, ValueType.PROCESS_INSTANCE_BATCH,
+            ProcessInstanceBatchIntent.TERMINATED,
+            {"processInstanceKey": value.get("processInstanceKey", -1),
+             "batchElementInstanceKey": scope_key,
+             "count": min(len(pending), PI_BATCH_CHUNK)},
+        )
+        if len(pending) > PI_BATCH_CHUNK:
+            writers.append_command(
+                self.state.next_key(), ValueType.PROCESS_INSTANCE_BATCH,
+                ProcessInstanceBatchIntent.TERMINATE,
+                {"processInstanceKey": value.get("processInstanceKey", -1),
+                 "batchElementInstanceKey": scope_key},
+            )
 
 
 class IncidentResolveProcessor:

@@ -1,0 +1,46 @@
+"""Query service: point lookups against a partition's engine state.
+
+Reference: engine/src/main/java/io/camunda/zeebe/engine/state/query/
+StateQueryService.java — the QueryService handed to gateway interceptors
+(QueryApiCfg): resolve the bpmnProcessId owning a process definition key, a
+process instance key, or a job key, without going through the record stream.
+"""
+
+from __future__ import annotations
+
+from zeebe_tpu.engine.engine_state import EngineState
+from zeebe_tpu.state import ZbDb
+
+
+class QueryService:
+    def __init__(self, db: ZbDb, state: EngineState) -> None:
+        self._db = db
+        self._state = state
+        self._closed = False
+
+    def close(self) -> None:
+        self._closed = True
+
+    def _ensure_open(self) -> None:
+        if self._closed:
+            raise RuntimeError("query service is closed (partition transitioned)")
+
+    def get_bpmn_process_id_for_process(self, process_definition_key: int) -> str | None:
+        self._ensure_open()
+        with self._db.transaction():
+            meta = self._state.processes.get_by_key(process_definition_key)
+        return None if meta is None else meta["bpmnProcessId"]
+
+    def get_bpmn_process_id_for_process_instance(self, process_instance_key: int) -> str | None:
+        self._ensure_open()
+        with self._db.transaction():
+            instance = self._state.element_instances.get(process_instance_key)
+        if instance is None:
+            return None
+        return instance["value"].get("bpmnProcessId")
+
+    def get_bpmn_process_id_for_job(self, job_key: int) -> str | None:
+        self._ensure_open()
+        with self._db.transaction():
+            job = self._state.jobs.get(job_key)
+        return None if job is None else job.get("bpmnProcessId")

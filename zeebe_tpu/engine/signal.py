@@ -29,7 +29,14 @@ class SignalProcessors:
         self.distribution = distribution  # CommandDistributionBehavior | None
 
     def broadcast(self, cmd: LoggedRecord, writers: Writers) -> None:
+        from zeebe_tpu.engine.processors import check_tenant_authorized
+        from zeebe_tpu.protocol import DEFAULT_TENANT
+
         value = dict(cmd.record.value)
+        value.pop("authorizedTenants", None)  # claim, not broadcast payload
+        if not check_tenant_authorized(
+                cmd, cmd.record.value.get("tenantId") or DEFAULT_TENANT, writers):
+            return
         if self.distribution is not None and self.distribution.is_distributed_command(cmd):
             # receiver: the whole local broadcast (event + subscription
             # triggering) runs once per distribution key, then acks
@@ -47,12 +54,17 @@ class SignalProcessors:
             )
 
     def _broadcast_locally(self, key: int, value: dict, writers: Writers):
+        from zeebe_tpu.protocol import DEFAULT_TENANT
+
         name = value.get("signalName", "")
         variables = value.get("variables") or {}
+        tenant = value.get("tenantId") or DEFAULT_TENANT
         broadcasted = writers.append_event(
             key, ValueType.SIGNAL, SignalIntent.BROADCASTED, value
         )
         for sub in list(self.state.signal_subscriptions.find(name)):
+            if sub.get("tenantId", DEFAULT_TENANT) != tenant:
+                continue
             host_key = sub.get("catchEventInstanceKey", -1)
             if host_key >= 0:
                 instance = self.state.element_instances.get(host_key)
@@ -77,6 +89,7 @@ class SignalProcessors:
                         "processDefinitionKey": sub.get("processDefinitionKey", -1),
                         "variables": variables,
                         "startElementId": sub.get("catchEventId", ""),
+                        **({"tenantId": sub["tenantId"]} if "tenantId" in sub else {}),
                     },
                 )
         return broadcasted

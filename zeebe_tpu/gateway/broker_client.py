@@ -195,16 +195,20 @@ class ClusterRuntime(GatewayRuntimeBase):
 
     # -- partition selection ---------------------------------------------------
 
-    def has_activatable_jobs(self, partition_id: int, job_type: str) -> bool:
+    def has_activatable_jobs(self, partition_id: int, job_type: str,
+                             tenant_ids: list[str] | None = None) -> bool:
         """Long-poll peek: checks the leader's state without writing a
         JOB_BATCH ACTIVATE into the replicated log (reference:
-        LongPollingActivateJobsHandler parks requests until jobsAvailable)."""
+        LongPollingActivateJobsHandler parks requests until jobsAvailable).
+        ``tenant_ids`` keeps a tenant-filtered long-poll from flooding the log
+        with empty activations when only other tenants' jobs exist."""
         with self._lock:
             leader = self._leader_partition(partition_id)
             if leader is None or leader.db is None:
                 return False
             with leader.db.transaction():
-                return bool(leader.engine.state.jobs.activatable_keys(job_type, 1))
+                return bool(leader.engine.state.jobs.activatable_keys(
+                    job_type, 1, tenant_ids))
 
     # -- request path ----------------------------------------------------------
 

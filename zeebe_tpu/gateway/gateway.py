@@ -28,7 +28,8 @@ from zeebe_tpu.gateway.broker_client import (  # noqa: E402
     RequestTimeoutError,
     ResourceExhaustedError,
 )
-from zeebe_tpu.protocol import ValueType, command  # noqa: E402
+from zeebe_tpu.gateway.auth import TenantAuthorizer  # noqa: E402
+from zeebe_tpu.protocol import DEFAULT_TENANT, ValueType, command  # noqa: E402
 from zeebe_tpu.protocol.intent import (  # noqa: E402
     DeploymentIntent,
     IncidentIntent,
@@ -56,8 +57,42 @@ def _vars(json_str: str) -> dict:
 class GatewayService:
     """One method per rpc; raises grpc errors via context.abort."""
 
-    def __init__(self, runtime: ClusterRuntime) -> None:
+    def __init__(self, runtime: ClusterRuntime,
+                 auth: TenantAuthorizer | None = None) -> None:
         self.runtime = runtime
+        self.auth = auth or TenantAuthorizer()
+
+    # -- tenant authorization (IdentityInterceptor equivalent) -----------------
+
+    def _check_tenant(self, context, requested: str) -> str:
+        error, detail = self.auth.check(context.invocation_metadata(), requested)
+        if error == "disabled":
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, detail)
+        elif error == "denied":
+            context.abort(grpc.StatusCode.PERMISSION_DENIED, detail)
+        return detail  # the validated tenant id
+
+    def _tenant_fields(self, context, requested: str) -> dict:
+        """Validated tenant + authorized-tenants claim for a command value.
+        With multi-tenancy off and the default tenant addressed, commands stay
+        in their pre-tenancy shape (no extra fields)."""
+        tenant = self._check_tenant(context, requested)
+        if not self.auth.enabled and tenant == DEFAULT_TENANT:
+            return {}
+        return {
+            "tenantId": tenant,
+            "authorizedTenants": self.auth.authorized_tenants(
+                context.invocation_metadata()),
+        }
+
+    def _tenant_ids_field(self, context, requested_ids) -> dict:
+        """ActivateJobs/StreamActivatedJobs tenantIds filter."""
+        ids = [t for t in (requested_ids or []) if t] or [DEFAULT_TENANT]
+        for tenant in ids:
+            self._check_tenant(context, tenant)
+        if not self.auth.enabled and ids == [DEFAULT_TENANT]:
+            return {}
+        return {"tenantIds": ids}
 
     # -- topology --------------------------------------------------------------
 
@@ -94,17 +129,25 @@ class GatewayService:
         record = self._submit(
             context, DEPLOYMENT_PARTITION,
             command(ValueType.DEPLOYMENT, DeploymentIntent.CREATE,
-                    {"resources": resources}),
+                    {"resources": resources,
+                     **self._tenant_fields(context, request.tenantId)}),
         )
         deployments = [
             pb.Deployment(process=pb.ProcessMetadata(
                 bpmnProcessId=m["bpmnProcessId"], version=m["version"],
                 processDefinitionKey=m["processDefinitionKey"],
                 resourceName=m["resourceName"],
-                tenantId="<default>",
+                tenantId=m.get("tenantId") or DEFAULT_TENANT,
             ))
             for m in record.value.get("processesMetadata", [])
         ]
+        for m in record.value.get("formMetadata", []):
+            deployments.append(pb.Deployment(form=pb.FormMetadata(
+                formId=m.get("formId", ""), version=m.get("version", 1),
+                formKey=m.get("formKey", -1),
+                resourceName=m.get("resourceName", ""),
+                tenantId=m.get("tenantId") or DEFAULT_TENANT,
+            )))
         for m in record.value.get("decisionsMetadata", []):
             deployments.append(pb.Deployment(decision=pb.DecisionMetadata(
                 dmnDecisionId=m.get("decisionId", ""),
@@ -112,10 +155,11 @@ class GatewayService:
                 version=m.get("version", 1), decisionKey=m.get("decisionKey", -1),
                 dmnDecisionRequirementsId=m.get("decisionRequirementsId", ""),
                 decisionRequirementsKey=m.get("decisionRequirementsKey", -1),
-                tenantId="<default>",
+                tenantId=m.get("tenantId") or DEFAULT_TENANT,
             )))
         return pb.DeployResourceResponse(
-            key=record.key, deployments=deployments, tenantId="<default>",
+            key=record.key, deployments=deployments,
+            tenantId=record.value.get("tenantId") or DEFAULT_TENANT,
         )
 
     # -- process instances -----------------------------------------------------
@@ -127,6 +171,7 @@ class GatewayService:
             "processDefinitionKey": request.processDefinitionKey or -1,
             "version": request.version or -1,
             "variables": self._parse_vars(context, request.variables),
+            **self._tenant_fields(context, request.tenantId),
         }
         if request.startInstructions:
             value["startInstructions"] = [
@@ -142,7 +187,7 @@ class GatewayService:
             bpmnProcessId=record.value.get("bpmnProcessId", ""),
             version=record.value.get("version", -1),
             processInstanceKey=record.value.get("processInstanceKey", -1),
-            tenantId="<default>",
+            tenantId=record.value.get("tenantId") or DEFAULT_TENANT,
         )
 
     def CreateProcessInstanceWithResult(self, request, context):
@@ -157,6 +202,7 @@ class GatewayService:
             "variables": self._parse_vars(context, inner.variables),
             "awaitResult": True,
             "fetchVariables": list(request.fetchVariables),
+            **self._tenant_fields(context, inner.tenantId),
         }
         timeout_s = (request.requestTimeout or 10_000) / 1000
         record = self._submit(
@@ -171,7 +217,7 @@ class GatewayService:
             version=record.value.get("version", -1),
             processInstanceKey=record.value.get("processInstanceKey", -1),
             variables=json.dumps(record.value.get("variables", {})),
-            tenantId="<default>",
+            tenantId=record.value.get("tenantId") or DEFAULT_TENANT,
         )
 
     def CancelProcessInstance(self, request, context):
@@ -195,9 +241,12 @@ class GatewayService:
                 "timeToLive": request.timeToLive,
                 "messageId": request.messageId,
                 "variables": self._parse_vars(context, request.variables),
+                **self._tenant_fields(context, request.tenantId),
             }),
         )
-        return pb.PublishMessageResponse(key=record.key, tenantId="<default>")
+        return pb.PublishMessageResponse(
+            key=record.key,
+            tenantId=record.value.get("tenantId") or DEFAULT_TENANT)
 
     def BroadcastSignal(self, request, context):
         record = self._submit(
@@ -205,9 +254,12 @@ class GatewayService:
             command(ValueType.SIGNAL, SignalIntent.BROADCAST, {
                 "signalName": request.signalName,
                 "variables": self._parse_vars(context, request.variables),
+                **self._tenant_fields(context, request.tenantId),
             }),
         )
-        return pb.BroadcastSignalResponse(key=record.key, tenantId="<default>")
+        return pb.BroadcastSignalResponse(
+            key=record.key,
+            tenantId=record.value.get("tenantId") or DEFAULT_TENANT)
 
     # -- jobs ------------------------------------------------------------------
 
@@ -218,6 +270,7 @@ class GatewayService:
         LongPollingActivateJobsHandler.java:36 — no poll loop)."""
         deadline = time.time() + max((request.requestTimeout or 0), 0) / 1000
         remaining = request.maxJobsToActivate or 32
+        tenant_filter = self._tenant_ids_field(context, request.tenantIds)
         hub = getattr(self.runtime, "jobs_hub", None)
         while context.is_active():
             seen_version = hub.version(request.type) if hub is not None else 0
@@ -226,8 +279,11 @@ class GatewayService:
                 if remaining <= 0 or not context.is_active():
                     break
                 # peek before writing: an idle long-poller must not flood the
-                # replicated log with empty JOB_BATCH ACTIVATE commands
-                if not self.runtime.has_activatable_jobs(partition_id, request.type):
+                # replicated log with empty JOB_BATCH ACTIVATE commands —
+                # including when only OTHER tenants' jobs woke the hub
+                if not self.runtime.has_activatable_jobs(
+                        partition_id, request.type,
+                        tenant_filter.get("tenantIds")):
                     continue
                 record = self._submit(
                     context, partition_id,
@@ -236,6 +292,7 @@ class GatewayService:
                         "worker": request.worker or "default",
                         "timeout": request.timeout or 300_000,
                         "maxJobsToActivate": remaining,
+                        **tenant_filter,
                     }),
                 )
                 for key, job in zip(record.value.get("jobKeys", []),
@@ -261,9 +318,11 @@ class GatewayService:
         ClientStreamManager → broker RemoteStreamRegistry push)."""
         import queue as _queue
 
+        tenant_filter = self._tenant_ids_field(context, request.tenantIds)
         streams = self.runtime.job_streams
         handle = streams.add_stream(
             request.type, request.worker or "default", request.timeout or 300_000,
+            tenant_ids=tenant_filter.get("tenantIds"),
         )
         in_flight = None
         try:
@@ -295,7 +354,7 @@ class GatewayService:
             retries=job.get("retries", 3),
             deadline=job.get("deadline", -1),
             variables=json.dumps(job.get("variables", {})),
-            tenantId="<default>",
+            tenantId=job.get("tenantId") or DEFAULT_TENANT,
         )
 
     def CompleteJob(self, request, context):
@@ -427,6 +486,7 @@ class GatewayService:
                 "decisionId": request.decisionId,
                 "decisionKey": request.decisionKey or -1,
                 "variables": self._parse_vars(context, request.variables),
+                **self._tenant_fields(context, request.tenantId),
             }),
         )
         v = record.value
@@ -440,7 +500,7 @@ class GatewayService:
             decisionOutput=json.dumps(v.get("decisionOutput")),
             failedDecisionId=v.get("failedDecisionId", ""),
             failureMessage=v.get("evaluationFailureMessage", ""),
-            tenantId="<default>",
+            tenantId=v.get("tenantId") or DEFAULT_TENANT,
             decisionInstanceKey=record.key,
             evaluatedDecisions=[
                 pb.EvaluatedDecision(
@@ -555,9 +615,10 @@ class Gateway:
     embedded-broker mode in one; reference: dist StandaloneGateway.java)."""
 
     def __init__(self, runtime: ClusterRuntime, bind: str = "127.0.0.1:0",
-                 max_workers: int = 16) -> None:
+                 max_workers: int = 16,
+                 auth: TenantAuthorizer | None = None) -> None:
         self.runtime = runtime
-        self.service = GatewayService(runtime)
+        self.service = GatewayService(runtime, auth=auth)
         handlers = {}
         for name, (req_cls, resp_cls) in _UNARY.items():
             handlers[name] = grpc.unary_unary_rpc_method_handler(

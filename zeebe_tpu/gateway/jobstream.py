@@ -26,7 +26,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-from zeebe_tpu.protocol import ValueType, command
+from zeebe_tpu.protocol import DEFAULT_TENANT, ValueType, command
 from zeebe_tpu.protocol.intent import JobBatchIntent, JobIntent
 
 logger = logging.getLogger("zeebe_tpu.gateway.jobstream")
@@ -75,6 +75,7 @@ class ClientJobStream:
     timeout_ms: int
     jobs: "queue.Queue[tuple[int, dict]]" = field(default_factory=queue.Queue)
     closed: bool = False
+    tenant_ids: list | None = None  # authorized-tenant filter (None = default)
 
 
 class JobStreamDispatcher:
@@ -112,8 +113,10 @@ class JobStreamDispatcher:
 
     # -- stream registry (AddStream / RemoveStream) ----------------------------
 
-    def add_stream(self, job_type: str, worker: str, timeout_ms: int) -> ClientJobStream:
-        stream = ClientJobStream(next(self._ids), job_type, worker, timeout_ms)
+    def add_stream(self, job_type: str, worker: str, timeout_ms: int,
+                   tenant_ids: list | None = None) -> ClientJobStream:
+        stream = ClientJobStream(next(self._ids), job_type, worker, timeout_ms,
+                                 tenant_ids=tenant_ids)
         with self._lock:
             self._streams.setdefault(job_type, []).append(stream)
             # initial sweep: jobs that became activatable before the stream
@@ -186,42 +189,67 @@ class JobStreamDispatcher:
                         self._pending.add((partition_id, job_type))
                 time.sleep(0.05)
 
-    def _pick_stream(self, job_type: str) -> ClientJobStream | None:
+    @staticmethod
+    def _tenant_group(stream: ClientJobStream) -> tuple:
+        return tuple(sorted(stream.tenant_ids or [DEFAULT_TENANT]))
+
+    def _tenant_groups(self, job_type: str) -> list[tuple]:
+        """Distinct tenant filters across the type's streams: each group is
+        pushed separately so one tenant's empty activation cannot starve
+        another's (streams of different tenants see different job sets)."""
+        with self._lock:
+            return sorted({
+                self._tenant_group(s) for s in self._streams.get(job_type, ())
+            })
+
+    def _pick_stream(self, job_type: str,
+                     group: tuple | None = None) -> ClientJobStream | None:
         with self._lock:
             streams = self._streams.get(job_type)
+            if streams and group is not None:
+                streams = [s for s in streams if self._tenant_group(s) == group]
             if not streams:
                 return None
-            idx = self._rr.get(job_type, 0) % len(streams)
-            self._rr[job_type] = idx + 1
+            rr_key = (job_type, group)
+            idx = self._rr.get(rr_key, 0) % len(streams)
+            self._rr[rr_key] = idx + 1
             return streams[idx]
 
     def _push(self, partition_id: int, job_type: str) -> None:
-        """Activate-and-deliver until the partition has no more activatable
-        jobs of the type or every stream is gone."""
+        """Activate-and-deliver, per tenant-filter group, until the partition
+        has no more activatable jobs each group can see or every stream is
+        gone."""
         while self._running:
-            stream = self._pick_stream(job_type)
-            if stream is None:
-                return
-            if not self.runtime.has_activatable_jobs(partition_id, job_type):
-                return
-            record = self.runtime.submit(
-                partition_id,
-                command(ValueType.JOB_BATCH, JobBatchIntent.ACTIVATE, {
-                    "type": job_type,
-                    "worker": stream.worker,
-                    "timeout": stream.timeout_ms,
-                    "maxJobsToActivate": PUSH_BATCH_SIZE,
-                }),
-            )
-            if record.is_rejection:
-                return
-            keys = record.value.get("jobKeys", [])
-            jobs = record.value.get("jobs", [])
-            for key, job in zip(keys, jobs):
-                if not self._deliver(stream, key, job):
-                    if not self._redeliver(job_type, key, job):
-                        self._yield_back(key)
-            if len(keys) < PUSH_BATCH_SIZE:
+            progressed = False
+            for group in self._tenant_groups(job_type):
+                stream = self._pick_stream(job_type, group)
+                if stream is None:
+                    continue
+                if not self.runtime.has_activatable_jobs(
+                        partition_id, job_type, list(group)):
+                    continue
+                record = self.runtime.submit(
+                    partition_id,
+                    command(ValueType.JOB_BATCH, JobBatchIntent.ACTIVATE, {
+                        "type": job_type,
+                        "worker": stream.worker,
+                        "timeout": stream.timeout_ms,
+                        "maxJobsToActivate": PUSH_BATCH_SIZE,
+                        **({"tenantIds": stream.tenant_ids}
+                           if stream.tenant_ids else {}),
+                    }),
+                )
+                if record.is_rejection:
+                    continue
+                keys = record.value.get("jobKeys", [])
+                jobs = record.value.get("jobs", [])
+                for key, job in zip(keys, jobs):
+                    if not self._deliver(stream, key, job):
+                        if not self._redeliver(job_type, key, job):
+                            self._yield_back(key)
+                if len(keys) >= PUSH_BATCH_SIZE:
+                    progressed = True  # this group may have more to drain
+            if not progressed:
                 return
 
     def _deliver(self, stream: ClientJobStream, key: int, job: dict) -> bool:
@@ -234,11 +262,23 @@ class JobStreamDispatcher:
             return True
 
     def _redeliver(self, job_type: str, key: int, job: dict) -> bool:
-        """Route an undeliverable job to another live stream of the type."""
+        """Route an undeliverable job to another live stream of the type that
+        is authorized for the job's tenant (never across tenants)."""
+        tenant = job.get("tenantId", DEFAULT_TENANT)
         for _ in range(8):
             stream = self._pick_stream(job_type)
             if stream is None:
                 return False
+            if tenant not in (stream.tenant_ids or [DEFAULT_TENANT]):
+                # no eligible stream may exist at all; scan once under lock
+                with self._lock:
+                    eligible = [
+                        s for s in self._streams.get(job_type, ())
+                        if tenant in (s.tenant_ids or [DEFAULT_TENANT])
+                    ]
+                if not eligible:
+                    return False
+                stream = eligible[0]
             if self._deliver(stream, key, job):
                 return True
         return False

@@ -152,13 +152,15 @@ class TcpClusterRuntime(GatewayRuntimeBase):
                 "brokers": [self.broker.health()],
             }
 
-    def has_activatable_jobs(self, partition_id: int, job_type: str) -> bool:
+    def has_activatable_jobs(self, partition_id: int, job_type: str,
+                             tenant_ids: list[str] | None = None) -> bool:
         with self._lock:
             partition = self.broker.partitions.get(partition_id)
             if partition is not None and partition.is_leader and partition.db is not None:
                 with partition.db.transaction():
                     return bool(
-                        partition.engine.state.jobs.activatable_keys(job_type, 1)
+                        partition.engine.state.jobs.activatable_keys(
+                            job_type, 1, tenant_ids)
                     )
         # remote leader: no cheap peek — let the long-poll try a real
         # activation (an empty JOB_BATCH comes back quickly)

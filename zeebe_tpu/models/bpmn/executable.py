@@ -79,6 +79,7 @@ class ExecutableElement:
     user_task_assignee: str | None = None
     user_task_candidate_groups: str | None = None
     decision_result_variable: str | None = None
+    form_id: str | None = None
     script_expression: Expression | None = None
     script_result_variable: str | None = None
     multi_instance: "ExecutableMultiInstance | None" = None
@@ -211,6 +212,7 @@ def _lower_element(
     exe.called_process_id = el.called_process_id
     exe.called_decision_id = el.called_decision_id
     exe.native_user_task = el.native_user_task
+    exe.form_id = el.form_id
     exe.user_task_assignee = el.user_task_assignee
     exe.user_task_candidate_groups = el.user_task_candidate_groups
     exe.decision_result_variable = el.decision_result_variable

@@ -88,6 +88,8 @@ class ProcessElement:
     user_task_assignee: str | None = None
     user_task_candidate_groups: str | None = None
     decision_result_variable: str | None = None
+    # linked Camunda form (zeebe:formDefinition formId)
+    form_id: str | None = None
 
 
 @dataclasses.dataclass(slots=True)
@@ -398,10 +400,13 @@ class ProcessBuilder:
 
     def user_task(self, element_id: str | None = None, *,
                   native: bool = False, assignee: str | None = None,
-                  candidate_groups: str | None = None) -> "ProcessBuilder":
+                  candidate_groups: str | None = None,
+                  form_id: str | None = None) -> "ProcessBuilder":
         """Job-based by default (reference 8.4 default worker contract);
-        ``native=True`` uses the zeebe:userTask native lifecycle records."""
+        ``native=True`` uses the zeebe:userTask native lifecycle records;
+        ``form_id`` links a deployed Camunda form (zeebe:formDefinition)."""
         el = ProcessElement(element_id or self._auto_id("user"), BpmnElementType.USER_TASK)
+        el.form_id = form_id
         if native:
             el.native_user_task = True
             el.user_task_assignee = assignee

@@ -192,6 +192,9 @@ def _parse_extensions(el_xml, el: ProcessElement) -> None:
     if script is not None:
         el.script_expression = script.get("expression")
         el.script_result_variable = script.get("resultVariable")
+    form_def = ext.find(f"{_Z}formDefinition")
+    if form_def is not None:
+        el.form_id = form_def.get("formId")
     native_ut = ext.find(f"{_Z}userTask")
     if native_ut is not None:
         el.native_user_task = True
@@ -199,6 +202,11 @@ def _parse_extensions(el_xml, el: ProcessElement) -> None:
         if assignment is not None:
             el.user_task_assignee = assignment.get("assignee")
             el.user_task_candidate_groups = assignment.get("candidateGroups")
+    if (el.element_type == BpmnElementType.USER_TASK and not el.native_user_task
+            and el.job_type is None):
+        # job-based user tasks use the implicit worker contract (reference:
+        # UserTaskTransformer's default zeebe:userTask job type)
+        el.job_type = "io.camunda.zeebe:userTask"
     loop = el_xml.find(f"{_B}multiInstanceLoopCharacteristics")
     if loop is not None:
         mi = MultiInstanceDefinition(is_sequential=loop.get("isSequential", "false") in ("true", "1"))
@@ -334,6 +342,8 @@ def _element_to_xml(parent, el: ProcessElement, message_names, error_codes,
         if el.script_result_variable:
             attrs["resultVariable"] = el.script_result_variable
         ET.SubElement(ext_el(), f"{_Z}script", attrs)
+    if el.form_id:
+        ET.SubElement(ext_el(), f"{_Z}formDefinition", {"formId": el.form_id})
     if el.native_user_task:
         ET.SubElement(ext_el(), f"{_Z}userTask", {})
         assignment = {}

@@ -228,6 +228,10 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                     "device tables support flat processes (sub-process scopes "
                     "run on the host path for now)"
                 )
+            if getattr(el, "form_id", None) is not None:
+                # form resolution reads FormState at activation time (the
+                # formKey header depends on the latest deployed form) — host
+                raise ConditionNotCompilable("form-linked user task")
             if el.element_type == BpmnElementType.INTERMEDIATE_CATCH_EVENT and (
                 (el.timer_duration is not None and not el.timer_cycle and el.timer_date is None)
                 or el.message_name is not None

@@ -1,6 +1,7 @@
 """Protocol layer: record schema, intents, keys, msgpack codec (SURVEY.md §2.9)."""
 
 from zeebe_tpu.protocol.enums import (
+    DEFAULT_TENANT,
     BpmnElementType,
     BpmnEventType,
     ErrorType,
@@ -19,6 +20,7 @@ from zeebe_tpu.protocol.keys import (
 from zeebe_tpu.protocol.record import Record, command, event, rejection
 
 __all__ = [
+    "DEFAULT_TENANT",
     "BpmnElementType",
     "BpmnEventType",
     "ErrorType",

@@ -64,6 +64,11 @@ class ValueType(enum.IntEnum):
     SBE_UNKNOWN = 255
 
 
+# the tenant every record belongs to unless stated otherwise (reference:
+# TenantOwned.DEFAULT_TENANT_IDENTIFIER)
+DEFAULT_TENANT = "<default>"
+
+
 class RejectionType(enum.IntEnum):
     """Why a command was rejected (reference: record/RejectionType.java)."""
 

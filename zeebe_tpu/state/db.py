@@ -95,6 +95,9 @@ class ColumnFamilyCode(enum.IntEnum):
     AWAIT_RESULT_METADATA = 131
     CHECKPOINT = 140
     FORMS = 150
+    FORM_BY_ID_AND_VERSION = 151
+    FORM_VERSION = 152
+    FORM_DIGEST = 153
     DMN_DECISIONS = 160
     DMN_DECISION_REQUIREMENTS = 161
     DMN_LATEST_DECISION_BY_ID = 162
@@ -138,6 +141,35 @@ def encode_key(cf: ColumnFamilyCode, parts: tuple) -> bytes:
     for part in parts:
         _encode_part(part, out)
     return bytes(out)
+
+
+def decode_key(encoded: bytes) -> tuple[ColumnFamilyCode, tuple]:
+    """Inverse of encode_key: used by state migrations to inspect and rewrite
+    keys whose shape changed between versions (reference: DbMigratorImpl
+    migration tasks iterate raw column families)."""
+    cf = ColumnFamilyCode(struct.unpack_from(">H", encoded)[0])
+    parts: list = []
+    i = 2
+    n = len(encoded)
+    while i < n:
+        tag = encoded[i]
+        i += 1
+        if tag == 0x01:
+            raw = _I64.unpack_from(encoded, i)[0] ^ 0x8000000000000000
+            parts.append(raw - (1 << 64) if raw >= (1 << 63) else raw)
+            i += 8
+        elif tag == 0x02:
+            j = encoded.index(0, i)
+            parts.append(encoded[i:j].decode("utf-8"))
+            i = j + 1
+        elif tag == 0x03:
+            length = _I64.unpack_from(encoded, i)[0]
+            i += 8
+            parts.append(encoded[i:i + length])
+            i += length
+        else:
+            raise ValueError(f"unknown key part tag 0x{tag:02x}")
+    return cf, tuple(parts)
 
 
 _DELETED = object()
