@@ -282,3 +282,90 @@ class TestBackpressureGateRejection:
         now[0] += 50  # exceed timeout
         lim.on_processed(1)
         assert lim.limit < 10
+
+
+class TestObservabilityBreadth:
+    """New metric families land in the Prometheus exposition (reference:
+    SURVEY §5.5 — stream_processor_*, journal_*, raft_*, exporter_*,
+    gateway_*, engine metrics)."""
+
+    def test_processing_metrics_populated(self):
+        from zeebe_tpu.testing import EngineHarness
+        from zeebe_tpu.models.bpmn import Bpmn, to_bpmn_xml
+        from zeebe_tpu.utils.metrics import REGISTRY
+
+        h = EngineHarness()
+        try:
+            h.deploy(to_bpmn_xml(
+                Bpmn.create_executable_process("obs").start_event("s")
+                .service_task("t", job_type="ow").end_event("e").done()))
+            h.create_instance("obs")
+            jobs = h.activate_jobs("ow")
+            h.complete_job(jobs[0]["key"])
+        finally:
+            h.close()
+        text = REGISTRY.expose()
+        for family in (
+            "zeebe_stream_processor_records_total",
+            "zeebe_stream_processor_latency_bucket",
+            "zeebe_executed_instances_total",
+            "zeebe_job_events_total",
+            "zeebe_journal_append_total",
+            "zeebe_journal_flush_duration_seconds_bucket",
+        ):
+            assert family in text, f"missing metric family {family}"
+        # engine counters moved: one instance activated+completed, one job
+        # created+completed on partition 1
+        assert 'zeebe_job_events_total{partition="1",action="created"}' in text
+
+    def test_replay_does_not_count_engine_events(self):
+        # follower/restart replay must not inflate processing-side counters
+        # (they are observed from follow-up events at processing time only)
+        from zeebe_tpu.engine.engine import Engine
+        from zeebe_tpu.models.bpmn import Bpmn, to_bpmn_xml
+        from zeebe_tpu.state import ZbDb
+        from zeebe_tpu.stream import StreamProcessor, StreamProcessorMode
+        from zeebe_tpu.testing import EngineHarness
+        from zeebe_tpu.utils.metrics import REGISTRY
+
+        created = REGISTRY.counter(
+            "job_events_total", "", ("partition", "action")).labels("1", "created")
+        h = EngineHarness()
+        try:
+            h.deploy(to_bpmn_xml(
+                Bpmn.create_executable_process("rp").start_event("s")
+                .service_task("t", job_type="rw").end_event("e").done()))
+            h.create_instance("rp")
+            after_processing = created.value
+            # replay the same log into a fresh follower-mode processor
+            db2 = ZbDb()
+            engine2 = Engine(db2, 1, clock_millis=h.clock)
+            follower = StreamProcessor(h.stream, db2, engine2,
+                                       mode=StreamProcessorMode.REPLAY)
+            follower.start()
+            follower.replay_available()
+            assert created.value == after_processing
+        finally:
+            h.close()
+
+    def test_query_service_concurrent_with_open_transaction(self):
+        # gateway-thread lookups must not collide with the processing
+        # transaction slot (committed-store reads)
+        from zeebe_tpu.engine.query import QueryService
+        from zeebe_tpu.models.bpmn import Bpmn, to_bpmn_xml
+        from zeebe_tpu.testing import EngineHarness
+
+        h = EngineHarness()
+        try:
+            h.deploy(to_bpmn_xml(
+                Bpmn.create_executable_process("qc").start_event("s")
+                .service_task("t", job_type="qcw").end_event("e").done()))
+            h.create_instance("qc")
+            with h.db.transaction():
+                meta = h.engine.state.processes.get_latest_by_id("qc")
+            query = QueryService(h.db)
+            with h.db.transaction():  # processing txn is OPEN on this slot
+                assert query.get_bpmn_process_id_for_process(
+                    meta["processDefinitionKey"]) == "qc"
+        finally:
+            h.close()

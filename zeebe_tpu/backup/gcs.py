@@ -1,0 +1,115 @@
+"""GCS backup store: the BackupStore interface over the GCS JSON API.
+
+Reference: backup-stores/gcs/src/main/java/io/camunda/zeebe/backup/gcs/
+GcsBackupStore.java — same object layout and manifest-last semantics as the
+S3 store, addressed through Google Cloud Storage's JSON API
+(``/storage/v1/b/<bucket>/o`` + ``/upload/storage/v1`` media uploads) with a
+bearer token. The endpoint is configurable so fake-gcs-server-style emulators
+work; auth is a static token (no metadata-server round trips in this build).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import urllib.parse
+
+from zeebe_tpu.backup.s3 import BlobBackupStore
+
+
+class GcsError(Exception):
+    def __init__(self, status: int, body: str) -> None:
+        super().__init__(f"GCS request failed: HTTP {status}: {body[:500]}")
+        self.status = status
+
+
+class GcsClient:
+    """Minimal GCS JSON-API client: upload/download/delete/list."""
+
+    def __init__(self, bucket: str, access_token: str = "",
+                 endpoint: str = "https://storage.googleapis.com",
+                 timeout_s: float = 30.0) -> None:
+        parsed = urllib.parse.urlparse(endpoint)
+        if parsed.scheme not in ("http", "https"):
+            raise ValueError(f"endpoint must be http(s)://…, got {endpoint!r}")
+        self._secure = parsed.scheme == "https"
+        self._host = parsed.netloc
+        self.bucket = bucket
+        self.access_token = access_token
+        self.timeout_s = timeout_s
+        self._conn: http.client.HTTPConnection | None = None
+
+    def _connection(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            conn_cls = (http.client.HTTPSConnection if self._secure
+                        else http.client.HTTPConnection)
+            self._conn = conn_cls(self._host, timeout=self.timeout_s)
+        return self._conn
+
+    def _request(self, method: str, target: str,
+                 body: bytes = b"") -> tuple[int, bytes]:
+        headers = {}
+        if self.access_token:
+            headers["Authorization"] = f"Bearer {self.access_token}"
+        # persistent connection; reconnect once on a stale keep-alive
+        for attempt in (0, 1):
+            conn = self._connection()
+            try:
+                conn.request(method, target, body=body, headers=headers)
+                response = conn.getresponse()
+                return response.status, response.read()
+            except (http.client.HTTPException, OSError):
+                self._conn = None
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
+    def _object_path(self, key: str) -> str:
+        return (f"/storage/v1/b/{self.bucket}/o/"
+                f"{urllib.parse.quote(key, safe='')}")
+
+    def put_object(self, key: str, data: bytes) -> None:
+        target = (f"/upload/storage/v1/b/{self.bucket}/o?uploadType=media"
+                  f"&name={urllib.parse.quote(key, safe='')}")
+        status, body = self._request("POST", target, body=data)
+        if status not in (200, 201):
+            raise GcsError(status, body.decode("utf-8", "replace"))
+
+    def get_object(self, key: str) -> bytes | None:
+        status, body = self._request("GET", self._object_path(key) + "?alt=media")
+        if status == 404:
+            return None
+        if status != 200:
+            raise GcsError(status, body.decode("utf-8", "replace"))
+        return body
+
+    def delete_object(self, key: str) -> None:
+        status, body = self._request("DELETE", self._object_path(key))
+        if status not in (200, 204, 404):
+            raise GcsError(status, body.decode("utf-8", "replace"))
+
+    def list_keys(self, prefix: str) -> list[str]:
+        keys: list[str] = []
+        page_token = ""
+        while True:
+            target = (f"/storage/v1/b/{self.bucket}/o"
+                      f"?prefix={urllib.parse.quote(prefix, safe='')}")
+            if page_token:
+                target += f"&pageToken={urllib.parse.quote(page_token, safe='')}"
+            status, body = self._request("GET", target)
+            if status != 200:
+                raise GcsError(status, body.decode("utf-8", "replace"))
+            listing = json.loads(body)
+            keys.extend(item["name"] for item in listing.get("items", []))
+            page_token = listing.get("nextPageToken", "")
+            if not page_token:
+                return keys
+
+
+class GcsBackupStore(BlobBackupStore):
+    """BackupStore over a GcsClient (reference: backup-stores/gcs); all the
+    layout/manifest logic lives in BlobBackupStore, which only depends on the
+    shared blob-client surface."""
+
+    def __init__(self, client: GcsClient, base_path: str = "backups") -> None:
+        super().__init__(client, base_path)

@@ -95,6 +95,7 @@ class Broker:
                  clock_millis: Callable[[], int] | None = None,
                  exporters_factory: Callable[[], dict[str, Any]] | None = None,
                  response_sink: Callable[[Any], None] | None = None,
+                 backup_store: Any | None = None,
                  backup_store_directory: str | Path | None = None,
                  backpressure_algorithm: str = "vegas",
                  backpressure_enabled: bool = True,
@@ -135,13 +136,35 @@ class Broker:
                 "commands appended but not yet processed", ("node", "partition")),
             "role": REGISTRY.gauge(
                 "raft_role", "1=leader 0=follower", ("node", "partition")),
+            "term": REGISTRY.gauge(
+                "raft_term", "current raft term", ("node", "partition")),
+            "commit": REGISTRY.gauge(
+                "raft_commit_index", "raft commit index", ("node", "partition")),
+            "processed": REGISTRY.gauge(
+                "stream_processor_last_processed_position",
+                "last processed record position", ("node", "partition")),
+            "exported": REGISTRY.gauge(
+                "exporter_last_exported_position",
+                "lowest acked exporter position (lag = appended - this)",
+                ("node", "partition")),
+            "snapshot": REGISTRY.gauge(
+                "snapshot_index", "raft index of the latest snapshot",
+                ("node", "partition")),
             "health": REGISTRY.gauge(
                 "health", "0=healthy 1=unhealthy 2=dead", ("node",)),
         }
         self.responses: list = []
         sink = response_sink if response_sink is not None else self.responses.append
         backup_service = None
-        if backup_store_directory is not None:
+        if backup_store is not None:
+            # remote store instance (S3BackupStore / GcsBackupStore) supplied
+            # by the operator shell (reference: backup-stores selection via
+            # zeebe.broker.data.backup.store config)
+            from zeebe_tpu.backup import BackupService
+
+            self.backup_store = backup_store
+            backup_service = BackupService(self.backup_store, cfg.node_id)
+        elif backup_store_directory is not None:
             from zeebe_tpu.backup import BackupService, FileSystemBackupStore
 
             self.backup_store = FileSystemBackupStore(backup_store_directory)
@@ -412,6 +435,20 @@ class Broker:
                 dropped.value = float(partition.limiter.dropped_total)
             self._metrics["written"].labels(node, label).value = float(
                 partition.stream.last_position)
+            self._metrics["term"].labels(node, label).set(
+                float(partition.raft.current_term))
+            self._metrics["commit"].labels(node, label).set(
+                float(partition.raft.commit_index))
+            self._metrics["snapshot"].labels(node, label).set(
+                float(partition.raft.snapshot_index))
+            if partition.processor is not None:
+                self._metrics["processed"].labels(node, label).set(
+                    float(partition.processor.last_processed_position))
+            if partition.exporter_director is not None:
+                exported = partition.exporter_director.lowest_exporter_position()
+                if exported < 2**62:
+                    self._metrics["exported"].labels(node, label).set(
+                        float(exported))
             failed = (
                 partition.processor is not None
                 and partition.processor.phase.value == "failed"

@@ -44,7 +44,6 @@ class EventAppliers:
         self.state = state
         self._appliers: dict[tuple[ValueType, int], Callable[[Record], None]] = {}
         self._register()
-
     def _register(self) -> None:
         reg = self._appliers
         reg[(ValueType.PROCESS, int(ProcessIntent.CREATED))] = self._process_created
@@ -235,14 +234,19 @@ class EventAppliers:
 
     def _pi_batch_activated(self, record: Record) -> None:
         """Track chunked multi-instance activation progress on the body
-        instance: completion of the body must wait for the final chunk."""
+        instance: completion of the body must wait for the final chunk.
+        Monotonic: the index never rewinds and the total is pinned by the
+        first chunk (guards against collection mutation between chunks)."""
         v = record.value
         body_key = v.get("batchElementInstanceKey", -1)
-        if self.state.element_instances.get(body_key) is not None:
-            self.state.element_instances.update(
-                body_key, miActivationIndex=v.get("index", 0),
-                miTotal=v.get("count", 0),
-            )
+        body = self.state.element_instances.get(body_key)
+        if body is None:
+            return
+        index = max(v.get("index", 0), body.get("miActivationIndex", 0))
+        total = body.get("miTotal") or v.get("count", 0)
+        self.state.element_instances.update(
+            body_key, miActivationIndex=index, miTotal=total,
+        )
 
     def _form_created(self, record: Record) -> None:
         self.state.forms.put(record.value)

@@ -4,18 +4,24 @@ Reference: engine/src/main/java/io/camunda/zeebe/engine/state/query/
 StateQueryService.java — the QueryService handed to gateway interceptors
 (QueryApiCfg): resolve the bpmnProcessId owning a process definition key, a
 process instance key, or a job key, without going through the record stream.
-"""
+
+Thread-safety: lookups use ``ZbDb.committed_get`` (committed-store point
+reads that never touch the processing transaction slot), so any thread —
+gateway interceptor, management endpoint — may query concurrently with the
+partition's processing, like the reference's reads against a storage
+snapshot."""
 
 from __future__ import annotations
 
-from zeebe_tpu.engine.engine_state import EngineState
 from zeebe_tpu.state import ZbDb
+from zeebe_tpu.state.db import ColumnFamilyCode as CF
 
 
 class QueryService:
-    def __init__(self, db: ZbDb, state: EngineState) -> None:
+    def __init__(self, db: ZbDb, state=None) -> None:
+        # ``state`` accepted for interface symmetry with other partition
+        # services; lookups go straight to the db's committed store
         self._db = db
-        self._state = state
         self._closed = False
 
     def close(self) -> None:
@@ -27,20 +33,18 @@ class QueryService:
 
     def get_bpmn_process_id_for_process(self, process_definition_key: int) -> str | None:
         self._ensure_open()
-        with self._db.transaction():
-            meta = self._state.processes.get_by_key(process_definition_key)
+        meta = self._db.committed_get(CF.PROCESS_CACHE, (process_definition_key,))
         return None if meta is None else meta["bpmnProcessId"]
 
     def get_bpmn_process_id_for_process_instance(self, process_instance_key: int) -> str | None:
         self._ensure_open()
-        with self._db.transaction():
-            instance = self._state.element_instances.get(process_instance_key)
+        instance = self._db.committed_get(
+            CF.ELEMENT_INSTANCE_KEY, (process_instance_key,))
         if instance is None:
             return None
         return instance["value"].get("bpmnProcessId")
 
     def get_bpmn_process_id_for_job(self, job_key: int) -> str | None:
         self._ensure_open()
-        with self._db.transaction():
-            job = self._state.jobs.get(job_key)
+        job = self._db.committed_get(CF.JOBS, (job_key,))
         return None if job is None else job.get("bpmnProcessId")

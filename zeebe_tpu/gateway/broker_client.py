@@ -110,7 +110,8 @@ class ClusterRuntime(GatewayRuntimeBase):
                  backpressure_algorithm: str = "vegas",
                  backpressure_enabled: bool = True,
                  disk_min_free_bytes: int = 0,
-                 backup_store_directory=None) -> None:
+                 backup_store_directory=None,
+                 backup_store=None) -> None:
         self.partition_count = partition_count
         self.net = LoopbackNetwork()
         self._lock = threading.RLock()
@@ -133,6 +134,7 @@ class ClusterRuntime(GatewayRuntimeBase):
                 backpressure_enabled=backpressure_enabled,
                 disk_min_free_bytes=disk_min_free_bytes,
                 backup_store_directory=backup_store_directory,
+                backup_store=backup_store,
             )
             self.brokers[m].jobs_listener = self._on_jobs_available
         self._running = False

@@ -280,10 +280,13 @@ class GatewayService:
                     break
                 # peek before writing: an idle long-poller must not flood the
                 # replicated log with empty JOB_BATCH ACTIVATE commands —
-                # including when only OTHER tenants' jobs woke the hub
+                # including when only OTHER tenants' jobs woke the hub. The
+                # peek must mirror the engine's filter default ([default
+                # tenant] when the field is omitted), or residual tenant jobs
+                # would make every wakeup write an empty activation.
                 if not self.runtime.has_activatable_jobs(
                         partition_id, request.type,
-                        tenant_filter.get("tenantIds")):
+                        tenant_filter.get("tenantIds", [DEFAULT_TENANT])):
                     continue
                 record = self._submit(
                     context, partition_id,
@@ -650,7 +653,32 @@ class Gateway:
 
 
 def _wrap(method: Callable) -> Callable:
+    """Per-rpc request metrics (reference: the gateway's gRPC Prometheus
+    interceptor — request totals + latency by method)."""
+    import time as _time
+
+    from zeebe_tpu.utils.metrics import REGISTRY
+
+    rpc = method.__name__
+    total = REGISTRY.counter(
+        "gateway_requests_total", "gateway rpc invocations", ("rpc",)
+    ).labels(rpc)
+    failed = REGISTRY.counter(
+        "gateway_requests_failed_total", "gateway rpc failures", ("rpc",)
+    ).labels(rpc)
+    latency = REGISTRY.histogram(
+        "gateway_request_latency", "seconds per gateway rpc", ("rpc",)
+    ).labels(rpc)
+
     def handler(request, context):
-        return method(request, context)
+        total.inc()
+        start = _time.perf_counter()
+        try:
+            return method(request, context)
+        except Exception:
+            failed.inc()
+            raise
+        finally:
+            latency.observe(_time.perf_counter() - start)
 
     return handler

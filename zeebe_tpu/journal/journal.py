@@ -33,6 +33,18 @@ import zlib
 from pathlib import Path
 from typing import Iterator
 
+from zeebe_tpu.utils.metrics import REGISTRY as _REGISTRY
+
+# journal metrics (reference names: journal/ JournalMetrics —
+# zeebe_journal_append_total, flush counts/latency); process-global because a
+# journal only knows its directory, not its partition
+_M_APPENDS = _REGISTRY.counter(
+    "journal_append_total", "records appended across all journals")
+_M_FLUSHES = _REGISTRY.counter(
+    "journal_flush_total", "journal fsyncs across all journals")
+_M_FLUSH_SECONDS = _REGISTRY.histogram(
+    "journal_flush_duration_seconds", "time per journal fsync")
+
 _MAGIC = 0x5A4A4E4C  # "ZJNL"
 _VERSION = 1
 _SEG_HEADER = struct.Struct("<IIQQ")  # magic, version, segment_id, first_index
@@ -315,6 +327,7 @@ class SegmentedJournal:
 
     def append(self, data: bytes, asqn: int = ASQN_IGNORE) -> JournalRecord:
         """Append one record; returns it with its assigned index."""
+        _M_APPENDS.inc()
         if asqn != ASQN_IGNORE and asqn <= self.last_asqn:
             raise InvalidAsqnError(f"asqn {asqn} <= last asqn {self.last_asqn}")
         tail = self.segments[-1]
@@ -344,9 +357,14 @@ class SegmentedJournal:
         recovery re-derives state from segment scans — so it is a plain
         8-byte overwrite, not an fsync'd rename, keeping the hot append path
         at one fsync per flush."""
+        import time as _time
+
+        start = _time.perf_counter()
         self.segments[-1].flush()
         idx = self.last_index
         self._write_flush_marker(max(idx, 0))
+        _M_FLUSHES.inc()
+        _M_FLUSH_SECONDS.observe(_time.perf_counter() - start)
         return idx
 
     def _write_flush_marker(self, idx: int) -> None:

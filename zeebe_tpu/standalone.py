@@ -60,6 +60,8 @@ def main(argv: list[str] | None = None) -> int:
             parser.error("--node-id requires --bind and --contact")
         from zeebe_tpu.gateway.tcp_runtime import TcpClusterRuntime
 
+        from zeebe_tpu.backup import backup_store_from_env
+
         host, port = args.bind.rsplit(":", 1)
         contacts = _parse_contacts(args.contact)
         peers = {m: a for m, a in contacts.items() if m != args.node_id}
@@ -68,6 +70,7 @@ def main(argv: list[str] | None = None) -> int:
             partition_count=args.partitions,
             replication_factor=args.replication,
             directory=args.data_dir,
+            backup_store=backup_store_from_env(),
         )
         runtime.start()
         gateway = Gateway(runtime, bind=f"0.0.0.0:{args.port}")
@@ -99,8 +102,11 @@ def main(argv: list[str] | None = None) -> int:
         overrides["base.partition_count"] = args.partitions
     if "--replication" in (argv or sys.argv):
         overrides["base.replication_factor"] = args.replication
+    from zeebe_tpu.backup import backup_store_from_env
+
     cfg = load_broker_cfg(overrides=overrides)
     runtime = ClusterRuntime(
+        backup_store=backup_store_from_env(),
         broker_count=args.brokers,
         partition_count=(args.partitions if "base.partition_count" in overrides
                          else cfg.base.partition_count),

@@ -399,6 +399,17 @@ class ZbDb:
     def transaction(self) -> "_TxnContext":
         return _TxnContext(self)
 
+    def committed_get(self, code: ColumnFamilyCode, key_parts: tuple) -> Any:
+        """Lock-free point read of the COMMITTED store, bypassing the single
+        processing-owned transaction slot — the cross-thread read path for
+        the QueryService (reference: StateQueryService reads a RocksDB
+        snapshot concurrently with processing). An open processing
+        transaction's uncommitted writes are invisible, exactly as with a
+        storage snapshot; dict point reads are atomic under the GIL."""
+        if not isinstance(key_parts, tuple):
+            key_parts = (key_parts,)
+        return self._data.get(encode_key(code, key_parts))
+
     def require_transaction(self) -> Transaction:
         if self._txn is None or self._txn.closed:
             raise RuntimeError("state access outside a transaction")

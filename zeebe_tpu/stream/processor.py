@@ -31,7 +31,7 @@ import logging
 from typing import Callable
 
 from zeebe_tpu.logstreams import LogAppendEntry, LoggedRecord, LogStream
-from zeebe_tpu.protocol import Record, RecordType, RejectionType, rejection
+from zeebe_tpu.protocol import Record, RecordType, RejectionType, ValueType, rejection
 from zeebe_tpu.state import ColumnFamilyCode, ZbDb
 from zeebe_tpu.stream.api import (
     ClientResponse,
@@ -96,6 +96,65 @@ class StreamProcessor:
         self.on_jobs_available: Callable[[set], None] | None = None
         self.phase = Phase.INITIAL
         self._positions = db.column_family(ColumnFamilyCode.LAST_PROCESSED_POSITION)
+        # hot-path metrics, children pre-resolved (reference names:
+        # stream-platform impl/metrics/StreamProcessorMetrics —
+        # zeebe_stream_processor_records_total, processing latency)
+        from zeebe_tpu.utils.metrics import REGISTRY
+
+        partition_label = str(log_stream.partition_id)
+        records_total = REGISTRY.counter(
+            "stream_processor_records_total",
+            "records handled by the stream processor",
+            ("partition", "action"))
+        self._m_processed = records_total.labels(partition_label, "processed")
+        self._m_replayed = records_total.labels(partition_label, "replayed")
+        self._m_batched = records_total.labels(partition_label, "kernel_batched")
+        self._m_latency = REGISTRY.histogram(
+            "stream_processor_latency",
+            "seconds spent processing one command (or one kernel group)",
+            ("partition",)).labels(partition_label)
+        # engine activity counters, observed PROCESSING-side from the step's
+        # follow-up events — never during replay, so counts are not inflated
+        # by followers or restart recovery (reference: engine/metrics/
+        # ProcessEngineMetrics, JobMetrics, IncidentMetrics count in
+        # processors, not appliers). Kernel burst hits are counted coarsely
+        # via action=kernel_batched instead.
+        instances = REGISTRY.counter(
+            "executed_instances_total",
+            "root process instances by lifecycle action",
+            ("partition", "action"))
+        jobs = REGISTRY.counter(
+            "job_events_total", "job lifecycle events written",
+            ("partition", "action"))
+        incidents = REGISTRY.counter(
+            "incident_events_total", "incident events written",
+            ("partition", "action"))
+        from zeebe_tpu.protocol.intent import (
+            IncidentIntent,
+            JobIntent,
+            ProcessInstanceIntent,
+        )
+
+        self._m_pi_actions = {
+            int(ProcessInstanceIntent.ELEMENT_ACTIVATED):
+                instances.labels(partition_label, "activated"),
+            int(ProcessInstanceIntent.ELEMENT_COMPLETED):
+                instances.labels(partition_label, "completed"),
+            int(ProcessInstanceIntent.ELEMENT_TERMINATED):
+                instances.labels(partition_label, "terminated"),
+        }
+        self._m_job_actions = {
+            int(JobIntent.CREATED): jobs.labels(partition_label, "created"),
+            int(JobIntent.COMPLETED): jobs.labels(partition_label, "completed"),
+            int(JobIntent.FAILED): jobs.labels(partition_label, "failed"),
+            int(JobIntent.TIMED_OUT): jobs.labels(partition_label, "timed_out"),
+            int(JobIntent.CANCELED): jobs.labels(partition_label, "canceled"),
+            int(JobIntent.ERROR_THROWN): jobs.labels(partition_label, "error_thrown"),
+        }
+        self._m_incident_actions = {
+            int(IncidentIntent.CREATED): incidents.labels(partition_label, "created"),
+            int(IncidentIntent.RESOLVED): incidents.labels(partition_label, "resolved"),
+        }
         clock = clock_millis or log_stream.clock_millis
         self.schedule_service = ProcessingScheduleService(clock, self._write_scheduled_commands)
         self._reader_position = 1
@@ -169,6 +228,8 @@ class StreamProcessor:
                     self._store_last_processed(max_source)
             position = batch[-1].position + 1
         self._reader_position = position
+        if applied:
+            self._m_replayed.inc(applied)
         return applied
 
     # -- processing ----------------------------------------------------------
@@ -210,6 +271,9 @@ class StreamProcessor:
         one transaction; returns commands consumed (0 → sequential path)."""
         if self.kernel_backend is None or self.phase != Phase.PROCESSING:
             return 0
+        import time as _time
+
+        group_start = _time.perf_counter()
         from zeebe_tpu.engine.burst_templates import PreparedBurst
 
         cmds: list[LoggedRecord] = []
@@ -267,6 +331,8 @@ class StreamProcessor:
                 self._execute_side_effects(result)
                 job_types |= activatable_job_types(result.follow_ups)
         self._notify_jobs_available(job_types)
+        self._m_batched.inc(len(cmds))
+        self._m_latency.observe(_time.perf_counter() - group_start)
         return len(cmds)
 
     def process_next(self) -> bool:
@@ -280,6 +346,9 @@ class StreamProcessor:
         return True
 
     def _process_command(self, cmd: LoggedRecord) -> None:
+        import time as _time
+
+        start = _time.perf_counter()
         builder = ProcessingResultBuilder()
         try:
             with self.db.transaction():
@@ -291,6 +360,9 @@ class StreamProcessor:
             return
         self._execute_side_effects(builder)
         self._notify_jobs_available(activatable_job_types(builder.follow_ups))
+        self._observe_follow_ups(builder.follow_ups)
+        self._m_processed.inc()
+        self._m_latency.observe(_time.perf_counter() - start)
 
     def _batch_process(self, cmd: LoggedRecord, builder: ProcessingResultBuilder) -> None:
         """The batchProcessing loop: the input command plus follow-up commands
@@ -340,6 +412,26 @@ class StreamProcessor:
                     builder.with_response(rej, cmd.record.request_stream_id, cmd.record.request_id)
             self._write_and_mark(cmd, builder)
         self._execute_side_effects(builder)
+
+    def _observe_follow_ups(self, follow_ups) -> None:
+        for f in follow_ups:
+            rec = f.record
+            if not rec.is_event:
+                continue
+            vt = rec.value_type
+            if vt == ValueType.JOB:
+                child = self._m_job_actions.get(int(rec.intent))
+                if child is not None:
+                    child.inc()
+            elif vt == ValueType.PROCESS_INSTANCE:
+                if rec.value.get("bpmnElementType") == "PROCESS":
+                    child = self._m_pi_actions.get(int(rec.intent))
+                    if child is not None:
+                        child.inc()
+            elif vt == ValueType.INCIDENT:
+                child = self._m_incident_actions.get(int(rec.intent))
+                if child is not None:
+                    child.inc()
 
     def _notify_jobs_available(self, job_types: set) -> None:
         if job_types and self.on_jobs_available is not None:
