@@ -153,7 +153,9 @@ class E2EPartition:
         self.stream = LogStream(self.journal, partition_id=1, clock=clock)
         self.db = ZbDb()
         self.engine = Engine(self.db, partition_id=1, clock_millis=clock)
-        self.kernel = KernelBackend(self.engine, max_group=512)
+        # group/chunk sizing tuned on the tunnel-attached chip: bigger groups
+        # amortize the per-fetch latency, shorter chunks shrink each fetch
+        self.kernel = KernelBackend(self.engine, max_group=2048, chunk_steps=8)
         self.processor = StreamProcessor(
             self.stream, self.db, self.engine, clock_millis=clock,
             kernel_backend=self.kernel,

@@ -41,8 +41,15 @@ class _Gen:
         self.n += 1
         return f"{prefix}{self.n}"
 
+    STR_VALUES = ("new", "active", "done", "weird")
+
     def condition(self) -> str:
         rng = self.rng
+        if rng.random() < 0.3:
+            # string routing rides the kernel via interned ids; values the
+            # tables never saw and non-string runtime values exercise the
+            # unknown-id sentinel and the host fallback respectively
+            return f'status {rng.choice(("=", "!="))} "{rng.choice(self.STR_VALUES)}"'
         var = rng.choice(VAR_NAMES)
         op = rng.choice((">", ">=", "<", "<=", "=", "!="))
         const = rng.randint(0, 20)
@@ -137,9 +144,17 @@ class _Gen:
 def _random_vars(rng: random.Random, constant: bool = False) -> dict:
     if constant:
         # identical variables per instance → burst-template fingerprints
-        # collide → the production fast path actually serves (see _run_one)
-        return {"x": 7, "y": 3, "z": 11}
-    return {name: rng.randint(0, 20) for name in VAR_NAMES if rng.random() < 0.8}
+        # collide → the production fast path actually serves (see _run_one);
+        # a constant string keeps string-condition graphs kernel-admissible
+        return {"x": 7, "y": 3, "z": 11, "status": "active"}
+    variables = {name: rng.randint(0, 20) for name in VAR_NAMES if rng.random() < 0.8}
+    roll = rng.random()
+    if roll < 0.7:
+        variables["status"] = rng.choice(_Gen.STR_VALUES + ("unseen-value",))
+    elif roll < 0.8:
+        variables["status"] = rng.randint(0, 5)  # type mismatch → host path
+    # else: absent → host path (null vs string comparisons)
+    return variables
 
 
 def _drive(h: EngineHarness, gen: "_Gen", model, rng: random.Random,

@@ -51,7 +51,11 @@ class Proc:
         self.popen: subprocess.Popen | None = None
 
     def start(self) -> None:
-        env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+        # kernel off: this test exercises cluster failover, and three broker
+        # subprocesses each paying a JAX compile on the CI box's single core
+        # pushes leader re-election past the test's deadlines
+        env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu",
+                   ZEEBE_BROKER_EXPERIMENTAL_KERNELBACKEND="false")
         self.popen = subprocess.Popen(
             [sys.executable, "-m", "zeebe_tpu.standalone",
              "--node-id", self.node_id,

@@ -10,11 +10,10 @@ work; auth is a static token (no metadata-server round trips in this build).
 
 from __future__ import annotations
 
-import http.client
 import json
 import urllib.parse
 
-from zeebe_tpu.backup.s3 import BlobBackupStore
+from zeebe_tpu.backup.s3 import BlobBackupStore, PersistentHttpClient
 
 
 class GcsError(Exception):
@@ -23,46 +22,22 @@ class GcsError(Exception):
         self.status = status
 
 
-class GcsClient:
+class GcsClient(PersistentHttpClient):
     """Minimal GCS JSON-API client: upload/download/delete/list."""
 
     def __init__(self, bucket: str, access_token: str = "",
                  endpoint: str = "https://storage.googleapis.com",
                  timeout_s: float = 30.0) -> None:
-        parsed = urllib.parse.urlparse(endpoint)
-        if parsed.scheme not in ("http", "https"):
-            raise ValueError(f"endpoint must be http(s)://…, got {endpoint!r}")
-        self._secure = parsed.scheme == "https"
-        self._host = parsed.netloc
+        super().__init__(endpoint, timeout_s)
         self.bucket = bucket
         self.access_token = access_token
-        self.timeout_s = timeout_s
-        self._conn: http.client.HTTPConnection | None = None
-
-    def _connection(self) -> http.client.HTTPConnection:
-        if self._conn is None:
-            conn_cls = (http.client.HTTPSConnection if self._secure
-                        else http.client.HTTPConnection)
-            self._conn = conn_cls(self._host, timeout=self.timeout_s)
-        return self._conn
 
     def _request(self, method: str, target: str,
                  body: bytes = b"") -> tuple[int, bytes]:
         headers = {}
         if self.access_token:
             headers["Authorization"] = f"Bearer {self.access_token}"
-        # persistent connection; reconnect once on a stale keep-alive
-        for attempt in (0, 1):
-            conn = self._connection()
-            try:
-                conn.request(method, target, body=body, headers=headers)
-                response = conn.getresponse()
-                return response.status, response.read()
-            except (http.client.HTTPException, OSError):
-                self._conn = None
-                if attempt:
-                    raise
-        raise AssertionError("unreachable")
+        return self._send(method, target, body, headers)
 
     def _object_path(self, key: str) -> str:
         return (f"/storage/v1/b/{self.bucket}/o/"

@@ -71,21 +71,18 @@ class S3Error(Exception):
         self.status = status
 
 
-class S3Client:
-    """Minimal path-style S3 client: put/get/delete/list with SigV4."""
+class PersistentHttpClient:
+    """Shared blob-client transport: endpoint parsing, one persistent
+    connection (a backup save uploads many objects to the same endpoint and
+    must not pay a handshake per file), reconnect-once on a stale
+    keep-alive."""
 
-    def __init__(self, endpoint: str, bucket: str, access_key: str,
-                 secret_key: str, region: str = "us-east-1",
-                 timeout_s: float = 30.0) -> None:
+    def __init__(self, endpoint: str, timeout_s: float = 30.0) -> None:
         parsed = urllib.parse.urlparse(endpoint)
         if parsed.scheme not in ("http", "https"):
             raise ValueError(f"endpoint must be http(s)://…, got {endpoint!r}")
         self._secure = parsed.scheme == "https"
         self._host = parsed.netloc
-        self.bucket = bucket
-        self.access_key = access_key
-        self.secret_key = secret_key
-        self.region = region
         self.timeout_s = timeout_s
         self._conn: http.client.HTTPConnection | None = None
 
@@ -95,6 +92,33 @@ class S3Client:
                         else http.client.HTTPConnection)
             self._conn = conn_cls(self._host, timeout=self.timeout_s)
         return self._conn
+
+    def _send(self, method: str, target: str, body: bytes,
+              headers: dict[str, str]) -> tuple[int, bytes]:
+        for attempt in (0, 1):
+            conn = self._connection()
+            try:
+                conn.request(method, target, body=body, headers=headers)
+                response = conn.getresponse()
+                return response.status, response.read()
+            except (http.client.HTTPException, OSError):
+                self._conn = None  # stale keep-alive: reconnect once
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
+
+class S3Client(PersistentHttpClient):
+    """Minimal path-style S3 client: put/get/delete/list with SigV4."""
+
+    def __init__(self, endpoint: str, bucket: str, access_key: str,
+                 secret_key: str, region: str = "us-east-1",
+                 timeout_s: float = 30.0) -> None:
+        super().__init__(endpoint, timeout_s)
+        self.bucket = bucket
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
 
     def _request(self, method: str, key: str = "",
                  query: dict[str, str] | None = None,
@@ -122,19 +146,7 @@ class S3Client:
                 f"{urllib.parse.quote(v, safe='')}"
                 for k, v in sorted(query.items())
             )
-        # one persistent connection per client: a backup save uploads many
-        # objects to the same endpoint and must not pay a handshake per file
-        for attempt in (0, 1):
-            conn = self._connection()
-            try:
-                conn.request(method, target, body=body, headers=headers)
-                response = conn.getresponse()
-                return response.status, response.read()
-            except (http.client.HTTPException, OSError):
-                self._conn = None  # stale keep-alive: reconnect once
-                if attempt:
-                    raise
-        raise AssertionError("unreachable")
+        return self._send(method, target, body, headers)
 
     def put_object(self, key: str, data: bytes) -> None:
         status, body = self._request("PUT", key, body=data)
@@ -251,7 +263,25 @@ class BlobBackupStore:
 
     def read(self, checkpoint_id: int, partition_id: int) -> Backup:
         prefix = self._prefix(partition_id, checkpoint_id)
-        manifest = json.loads(self.client.get_object(f"{prefix}/manifest.json"))
+        manifest_bytes = self.client.get_object(f"{prefix}/manifest.json")
+        if manifest_bytes is None:
+            raise FileNotFoundError(
+                f"backup {checkpoint_id} for partition {partition_id} does not "
+                f"exist (no {prefix}/manifest.json)"
+            )
+        manifest = json.loads(manifest_bytes)
+
+        def require(key: str) -> bytes:
+            data = self.client.get_object(key)
+            if data is None:
+                # manifest-last save order makes this impossible for an
+                # intact store: a listed object vanished after completion
+                raise FileNotFoundError(
+                    f"backup {checkpoint_id}/{partition_id} is corrupt: "
+                    f"object {key} listed in the manifest is missing"
+                )
+            return data
+
         return Backup(
             checkpoint_id=manifest["checkpointId"],
             partition_id=manifest["partitionId"],
@@ -259,11 +289,11 @@ class BlobBackupStore:
             checkpoint_position=manifest["checkpointPosition"],
             descriptor=manifest["descriptor"],
             snapshot_files={
-                name: self.client.get_object(f"{prefix}/snapshot/{name}")
+                name: require(f"{prefix}/snapshot/{name}")
                 for name in manifest["snapshotFiles"]
             },
             segment_files={
-                name: self.client.get_object(f"{prefix}/segments/{name}")
+                name: require(f"{prefix}/segments/{name}")
                 for name in manifest["segmentFiles"]
             },
         )

@@ -40,6 +40,11 @@ class BrokerCfg:
     cluster_members: list[str] = dataclasses.field(default_factory=lambda: ["broker-0"])
     snapshot_period_ms: int = 5 * 60 * 1000
     consistency_checks: bool = True
+    # the device-kernel batched execution backend behind the stream processor
+    # (reference: FeatureFlagsCfg-style gate). ON by default: the serving
+    # path IS the kernel path; eligible commands batch onto the device,
+    # everything else falls through to the sequential engine unchanged.
+    kernel_backend: bool = True
 
 
 def partition_distribution(cfg: BrokerCfg) -> dict[int, list[str]]:
@@ -249,6 +254,7 @@ class Broker:
             backpressure=limiter,
             priority=priority,
             on_jobs_available=self._on_jobs_available,
+            kernel_backend_enabled=self.cfg.kernel_backend,
         )
         self.health_monitor.register(f"partition-{partition_id}")
         self.messaging.subscribe(

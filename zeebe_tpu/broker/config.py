@@ -85,6 +85,8 @@ _ENV_BINDINGS: dict[str, tuple[str, str, Any]] = {
         "processing", "max_commands_in_batch", int),
     "ZEEBE_BROKER_EXPERIMENTAL_CONSISTENCYCHECKS": (
         "base", "consistency_checks", lambda v: v.lower() in ("1", "true", "yes")),
+    "ZEEBE_BROKER_EXPERIMENTAL_KERNELBACKEND": (
+        "base", "kernel_backend", lambda v: v.lower() in ("1", "true", "yes")),
 }
 
 

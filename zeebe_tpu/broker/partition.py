@@ -56,6 +56,31 @@ class _RaftWriter:
         result = self.partition.write_entries(list(entries), source_position)
         return result if result is not None else -1
 
+    def append_prepatched(self, buf: bytearray, pos_offsets, ts_offsets,
+                          count: int, has_pending_commands: bool = False) -> int:
+        """Burst-template fast path over Raft: patch positions/timestamps into
+        the pre-serialized batch, replicate the bytes (mirrors
+        LogStreamWriter.append_prepatched; the committed entry materializes
+        into the stream journal like any other batch)."""
+        import struct
+
+        p = self.partition
+        if p.role != RaftRole.LEADER:
+            return -1
+        first_position = p._next_position
+        timestamp = p.clock_millis()
+        for i, off in enumerate(pos_offsets):
+            struct.pack_into("<q", buf, off, first_position + i)
+        for off in ts_offsets:
+            struct.pack_into("<q", buf, off, timestamp)
+        if p.raft.append(bytes(buf), asqn=first_position) is None:
+            return -1
+        # remember the command-scan skip flag until the committed entry
+        # materializes into the stream journal
+        p._prepatched_flags[first_position] = has_pending_commands
+        p._next_position = first_position + count
+        return first_position + count - 1
+
 
 class ZeebePartition:
     def __init__(
@@ -76,6 +101,7 @@ class ZeebePartition:
         on_checkpoint=None,
         backpressure=None,
         on_jobs_available=None,
+        kernel_backend_enabled: bool = True,
     ) -> None:
         self.partition_id = partition_id
         self.partition_count = partition_count
@@ -94,6 +120,7 @@ class ZeebePartition:
         # jobs-available side effect: (partition_id, {job types}) → broker →
         # gateway hub (long-poll wakeup + job push dispatch)
         self.on_jobs_available = on_jobs_available
+        self.kernel_backend_enabled = kernel_backend_enabled
         # client-ingress backpressure (CommandRateLimiter | None) and the
         # disk-monitor pause flag; both gate client_write only — follow-ups,
         # scheduled commands, and inter-partition traffic always pass
@@ -123,6 +150,9 @@ class ZeebePartition:
         self.checkers: DueDateCheckers | None = None
         self.redistributor: CommandRedistributor | None = None
         self._applied_raft_index = 0
+        # asqn → has_pending_commands for burst batches appended via
+        # append_prepatched (consumed at materialization)
+        self._prepatched_flags: dict[int, bool] = {}
         self._next_position = self.stream.last_position + 1
         self._last_snapshot_ms = clock_millis()
         self._transition()  # start as follower (replay mode)
@@ -140,7 +170,10 @@ class ZeebePartition:
             self._applied_raft_index = entry["index"]
             if entry.get("init") or not entry.get("data"):
                 continue
-            self.stream.append_committed_payload(entry["data"], entry["asqn"])
+            self.stream.append_committed_payload(
+                entry["data"], entry["asqn"],
+                has_pending_commands=self._prepatched_flags.pop(entry["asqn"], None),
+            )
         self._next_position = max(self._next_position, self.stream.last_position + 1)
 
     def _on_role_change(self, role: RaftRole, term: int) -> None:
@@ -177,10 +210,20 @@ class ZeebePartition:
         self.query_service = QueryService(self.db, self.engine.state)
         if self.inter_partition_sender is not None:
             self.engine.wire_sender(self.inter_partition_sender)
+        kernel_backend = None
+        if self.kernel_backend_enabled and mode == StreamProcessorMode.PROCESSING:
+            # the partition's batched execution backend (BASELINE.json north
+            # star): groups of kernel-eligible commands ride the device;
+            # construction is lazy — no device work until a candidate arrives
+            from zeebe_tpu.engine.kernel_backend import KernelBackend
+
+            kernel_backend = KernelBackend(self.engine, max_group=2048,
+                                           chunk_steps=8)
         self.processor = StreamProcessor(
             self.stream, self.db, self.engine, mode=mode,
             response_sink=self.response_sink, clock_millis=self.clock_millis,
             writer=_RaftWriter(self),
+            kernel_backend=kernel_backend,
         )
         if self.on_jobs_available is not None:
             listener = self.on_jobs_available

@@ -243,7 +243,11 @@ class EventAppliers:
         if body is None:
             return
         index = max(v.get("index", 0), body.get("miActivationIndex", 0))
-        total = body.get("miTotal") or v.get("count", 0)
+        # the total only ever DECREASES after the pin (the processor lowers it
+        # exactly once, when a shrunken collection terminates the chain) — a
+        # later chunk can never re-raise it and re-block completion
+        stored = body.get("miTotal")
+        total = min(stored, v.get("count", 0)) if stored else v.get("count", 0)
         self.state.element_instances.update(
             body_key, miActivationIndex=index, miTotal=total,
         )

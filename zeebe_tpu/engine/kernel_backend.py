@@ -191,7 +191,19 @@ class KernelRegistry:
         )
         self._infos.append(info)
         self._by_key[definition_key] = info
-        self._tables = None  # recompile shared set lazily
+        # recompile the SHARED set eagerly: definitions that solo-compile can
+        # still conflict jointly (e.g. one uses a variable numerically, the
+        # other in string comparisons — SlotMap kind clash). Registering a
+        # definition that poisons the shared compile must reject IT, not
+        # disable the kernel path for the whole partition.
+        try:
+            self._tables = compile_tables([i.exe for i in self._infos])
+        except ConditionNotCompilable:
+            self._infos.pop()
+            del self._by_key[definition_key]
+            self._ineligible.add(definition_key)
+            self._tables = None  # previous set recompiles lazily
+            return None
         self._device = None
         return info
 
@@ -251,7 +263,7 @@ class KernelBackend:
     sequential-equivalent record stream. One instance per partition."""
 
     def __init__(self, engine, max_group: int = 256, max_steps: int = 4096,
-                 chunk_steps: int = 16, use_templates: bool = True,
+                 chunk_steps: int = 8, use_templates: bool = True,
                  audit_templates: bool = False) -> None:
         self.engine = engine
         self.registry = KernelRegistry()
@@ -281,18 +293,19 @@ class KernelBackend:
 
     # -- admission ----------------------------------------------------------
 
-    def _admit(self, cmd, instances: dict[int, _Inst]) -> _Admitted | None:
+    def _admit(self, cmd, instances: dict[int, _Inst],
+               admitted_pis: set[int]) -> _Admitted | None:
         record = cmd.record
         kind = (record.value_type, int(record.intent))
         if kind == (ValueType.PROCESS_INSTANCE_CREATION, int(ProcessInstanceCreationIntent.CREATE)):
             return self._admit_creation(cmd, instances)
         if kind == (ValueType.JOB, int(JobIntent.COMPLETE)):
-            return self._admit_job_complete(cmd, instances)
+            return self._admit_job_complete(cmd, instances, admitted_pis)
         if kind == (ValueType.TIMER, int(TimerIntent.TRIGGER)):
-            return self._admit_timer_trigger(cmd, instances)
+            return self._admit_timer_trigger(cmd, instances, admitted_pis)
         if kind == (ValueType.PROCESS_MESSAGE_SUBSCRIPTION,
                     int(ProcessMessageSubscriptionIntent.CORRELATE)):
-            return self._admit_message_correlate(cmd, instances)
+            return self._admit_message_correlate(cmd, instances, admitted_pis)
         return None
 
     def _admit_creation(self, cmd, instances) -> _Admitted | None:
@@ -323,14 +336,11 @@ class KernelBackend:
         if info is None:
             return None
         variables = value.get("variables") or {}
-        slots: dict[str, float] = {}
-        for name in info.cond_var_names:
-            v = variables.get(name)
-            if not _is_numeric(v):
-                # a condition could read this variable: the host FEEL path and
-                # the device float path would disagree on null/strings
-                return None
-            slots[name] = float(v)
+        slots = self._condition_slots(info, variables)
+        if slots is None:
+            # a condition could read a variable whose runtime type the device
+            # slot kind cannot represent: host and device would disagree
+            return None
         inst = _Inst(idx=len(instances), info=info, new=True, meta=meta, slots=slots)
         templatable = not (value.get("awaitResult") and cmd.record.request_id >= 0)
         return _Admitted(cmd=cmd, inst=inst, kind="c",
@@ -405,21 +415,33 @@ class KernelBackend:
         return join_counts
 
     def _condition_slots(self, info: _DefInfo, merged: dict) -> dict[str, float] | None:
+        """Prefetch the condition variables into device-slot values: numeric
+        slots carry the float value, string slots the interned id (the host
+        document store ↔ device slot split, SURVEY §7(c)). None = this
+        instance cannot ride the kernel (type mismatch would diverge from
+        host FEEL semantics)."""
+        tables = self.registry.tables
         slots: dict[str, float] = {}
         for name in info.cond_var_names:
             v = merged.get(name)
+            if tables.slot_map.kinds.get(name) == "str":
+                if not isinstance(v, str):
+                    return None
+                slots[name] = tables.interner.id_of(v)
+                continue
             if not _is_numeric(v):
                 return None
             slots[name] = float(v)
         return slots
 
-    def _admit_resume(self, cmd, instances, pi_key: int, resume_key: int,
+    def _admit_resume(self, cmd, instances, admitted_pis: set[int],
+                      pi_key: int, resume_key: int,
                       kind: str, head_docs: list, extra_variables: dict | None,
                       require_op: int) -> _Admitted | None:
         """Shared admission for resume commands (job complete, timer trigger,
         message correlate): reconstruct the instance, resume one token."""
         state = self.engine.state
-        if pi_key in (i.pi_key for i in instances.values()):
+        if pi_key in admitted_pis:
             return None  # same-instance conflict: next group
         root_meta = state.element_instances.get(pi_key)
         if root_meta is None:
@@ -468,13 +490,13 @@ class KernelBackend:
             templatable=(pi_key not in self.engine.await_results) and not has_timer_doc,
         )
 
-    def _admit_job_complete(self, cmd, instances) -> _Admitted | None:
+    def _admit_job_complete(self, cmd, instances, admitted_pis) -> _Admitted | None:
         state = self.engine.state
         job = state.jobs.get(cmd.record.key)
         if job is None:
             return None  # sequential path writes the NOT_FOUND rejection
         return self._admit_resume(
-            cmd, instances,
+            cmd, instances, admitted_pis,
             pi_key=job.get("processInstanceKey", -1),
             resume_key=job.get("elementInstanceKey", -1),
             kind="j",
@@ -483,7 +505,7 @@ class KernelBackend:
             require_op=K_TASK,
         )
 
-    def _admit_timer_trigger(self, cmd, instances) -> _Admitted | None:
+    def _admit_timer_trigger(self, cmd, instances, admitted_pis) -> _Admitted | None:
         state = self.engine.state
         timer = state.timers.get(cmd.record.key)
         if timer is None:
@@ -499,7 +521,7 @@ class KernelBackend:
         if timer.get("targetElementId") != instance["value"].get("elementId"):
             return None
         return self._admit_resume(
-            cmd, instances,
+            cmd, instances, admitted_pis,
             pi_key=instance["value"].get("processInstanceKey", -1),
             resume_key=eik,
             kind="t",
@@ -508,7 +530,7 @@ class KernelBackend:
             require_op=K_CATCH,
         )
 
-    def _admit_message_correlate(self, cmd, instances) -> _Admitted | None:
+    def _admit_message_correlate(self, cmd, instances, admitted_pis) -> _Admitted | None:
         state = self.engine.state
         value = cmd.record.value
         eik = value.get("elementInstanceKey", -1)
@@ -519,7 +541,7 @@ class KernelBackend:
         if sub.get("targetElementId") != instance["value"].get("elementId"):
             return None  # boundary / event-based gateway → host
         return self._admit_resume(
-            cmd, instances,
+            cmd, instances, admitted_pis,
             pi_key=instance["value"].get("processInstanceKey", -1),
             resume_key=eik,
             kind="m",
@@ -616,9 +638,10 @@ class KernelBackend:
         chunk = self.chunk_steps
         steps: list[dict] = []
         overflow = False
+        FO = tables.out_target.shape[2]
         for _ in range(max(1, self.max_steps // chunk)):
             state, packed = run_collect(dt, state, n_steps=chunk, config=config)
-            packed_host = jax.device_get(packed)
+            packed_host = jax.device_get(packed).reshape(chunk, T, 4 + 2 * FO)
             overflow = packed_host[-1, 1, 3]
             active = packed_host[:, 0, 3]
             # steps after quiescence emit nothing — truncate so the host
@@ -651,12 +674,17 @@ class KernelBackend:
 
         Must run inside the partition's open db transaction."""
         instances: dict[int, _Inst] = {}
+        # pi_key conflict index: one command per instance per group; a set
+        # keeps admission O(1) instead of O(group) per command
+        admitted_pis: set[int] = set()
         admitted: list[_Admitted] = []
         for cmd in cmds:
-            adm = self._admit(cmd, instances)
+            adm = self._admit(cmd, instances, admitted_pis)
             if adm is None:
                 break
             instances[adm.inst.idx] = adm.inst
+            if adm.inst.pi_key is not None and adm.inst.pi_key >= 0:
+                admitted_pis.add(adm.inst.pi_key)
             admitted.append(adm)
             if len(admitted) >= self.max_group:
                 break

@@ -351,7 +351,7 @@ class DeploymentProcessor:
             DecisionRequirementsIntent,
         )
 
-        dmn_by_name = {
+        resource_by_name = {
             r["resourceName"]: r["resource"] for r in value.get("resources", [])
         }
         for drg_meta in value.get("decisionRequirementsMetadata", []):
@@ -363,7 +363,7 @@ class DeploymentProcessor:
             writers.append_event(
                 drg_meta["decisionRequirementsKey"], ValueType.DECISION_REQUIREMENTS,
                 DecisionRequirementsIntent.CREATED,
-                {**drg_meta, "resource": dmn_by_name.get(drg_meta["resourceName"], "")},
+                {**drg_meta, "resource": resource_by_name.get(drg_meta["resourceName"], "")},
             )
         for meta in value.get("decisionsMetadata", []):
             if self.state.decisions.decision_by_key(meta["decisionKey"]) is not None:
@@ -374,9 +374,6 @@ class DeploymentProcessor:
         # forms replicate under the origin-minted keys/versions
         from zeebe_tpu.protocol.intent import FormIntent
 
-        resource_by_name = {
-            r["resourceName"]: r["resource"] for r in value.get("resources", [])
-        }
         for meta in value.get("formMetadata", []):
             if meta.get("duplicate"):
                 continue
@@ -887,22 +884,32 @@ class ProcessInstanceBatchProcessor:
         body_value = body["value"]
         exe = self.state.processes.executable(body_value["processDefinitionKey"])
         element = exe.element(body_value["elementId"])
+        # the collection is re-evaluated per chunk; mutating it mid-loop is
+        # documented-unsupported (same stance as sequential multi-instance).
+        # The total is pinned from the FIRST chunk and the index only ever
+        # advances, so a mutated collection can mis-pick items but can never
+        # rewind progress or complete the body while chunks are outstanding.
         items = self.bpmn._eval_input_collection(body_key, body_value, element, writers)
         if items is None:
             return  # incident raised on the body
-        end = min(index + PI_BATCH_CHUNK, len(items))
+        total = body.get("miTotal") or len(items)
+        end = max(index, min(index + PI_BATCH_CHUNK, len(items), total))
         for i in range(index, end):
             self.bpmn._write_mi_inner_activate(
                 writers, body_key, body_value, element, items[i], i + 1
             )
+        # a shrunken collection ends the chain here: report the REACHED count
+        # as the final total so body completion is not gated on chunks that
+        # will never be written (liveness over the pinned target)
+        final_count = total if len(items) >= total else end
         writers.append_event(
             cmd.record.key, ValueType.PROCESS_INSTANCE_BATCH,
             ProcessInstanceBatchIntent.ACTIVATED,
             {"processInstanceKey": value.get("processInstanceKey", -1),
              "batchElementInstanceKey": body_key,
-             "index": end, "count": len(items)},
+             "index": end, "count": final_count},
         )
-        if end < len(items):
+        if end < min(total, len(items)):
             writers.append_command(
                 self.state.next_key(), ValueType.PROCESS_INSTANCE_BATCH,
                 ProcessInstanceBatchIntent.ACTIVATE,

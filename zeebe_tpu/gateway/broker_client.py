@@ -111,7 +111,8 @@ class ClusterRuntime(GatewayRuntimeBase):
                  backpressure_enabled: bool = True,
                  disk_min_free_bytes: int = 0,
                  backup_store_directory=None,
-                 backup_store=None) -> None:
+                 backup_store=None,
+                 kernel_backend: bool = True) -> None:
         self.partition_count = partition_count
         self.net = LoopbackNetwork()
         self._lock = threading.RLock()
@@ -124,7 +125,8 @@ class ClusterRuntime(GatewayRuntimeBase):
         for m in members:
             cfg = BrokerCfg(node_id=m, partition_count=partition_count,
                             replication_factor=replication_factor,
-                            cluster_members=members)
+                            cluster_members=members,
+                            kernel_backend=kernel_backend)
             self.brokers[m] = Broker(
                 cfg, self.net.join(m),
                 directory=(Path(directory) / m if directory else None),

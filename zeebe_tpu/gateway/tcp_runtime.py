@@ -41,7 +41,8 @@ class TcpClusterRuntime(GatewayRuntimeBase):
     def __init__(self, node_id: str, bind: tuple[str, int],
                  peers: dict[str, tuple[str, int]],
                  partition_count: int = 1, replication_factor: int = 1,
-                 directory=None, **broker_kwargs) -> None:
+                 directory=None, kernel_backend: bool = True,
+                 **broker_kwargs) -> None:
         self.node_id = node_id
         self.partition_count = partition_count
         members = sorted(set(peers) | {node_id})
@@ -54,6 +55,7 @@ class TcpClusterRuntime(GatewayRuntimeBase):
         cfg = BrokerCfg(
             node_id=node_id, partition_count=partition_count,
             replication_factor=replication_factor, cluster_members=members,
+            kernel_backend=kernel_backend,
         )
         self.broker = Broker(
             cfg, self.messaging, directory=directory,

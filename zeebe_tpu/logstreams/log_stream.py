@@ -303,7 +303,8 @@ class LogStream:
     def writer(self) -> LogStreamWriter:
         return self._writer
 
-    def append_committed_payload(self, payload: bytes, first_position: int) -> None:
+    def append_committed_payload(self, payload: bytes, first_position: int,
+                                 has_pending_commands: bool | None = None) -> None:
         """Materialize a batch that was sequenced elsewhere (the Raft leader)
         and is now committed: the payload embeds its record positions, assigned
         at ingress. Used by the broker partition on leaders AND followers — the
@@ -314,6 +315,10 @@ class LogStream:
             return  # already materialized (e.g. re-delivered commit)
         jrec = self.journal.append(payload, asqn=first_position)
         self._on_appended(first_position, jrec.index)
+        if has_pending_commands is not None:
+            # burst batches carry the command-scan skip flag from the leader's
+            # append (absent = unknown = decode on demand)
+            self._batch_has_commands[jrec.index] = has_pending_commands
         batch = self._read_batch_at(jrec.index)
         self._next_position = batch[-1].position + 1 if batch else first_position + 1
 

@@ -122,6 +122,13 @@ def _parse_scope(scope_el, model: ProcessModel, parent_id, messages, errors, sig
             el.default_flow_id = child.get("default")
         _parse_event_definitions(child, el, messages, errors, signals, escalations)
         _parse_extensions(child, el)
+        if (el.element_type == BpmnElementType.USER_TASK and not el.native_user_task
+                and el.job_type is None):
+            # job-based user tasks use the implicit worker contract (reference:
+            # UserTaskTransformer's default zeebe:userTask job type); element
+            # level, not extensions level — a plain <userTask/> has no
+            # extensionElements at all
+            el.job_type = "io.camunda.zeebe:userTask"
         model.elements[el.id] = el
         if etype in (BpmnElementType.SUB_PROCESS, BpmnElementType.EVENT_SUB_PROCESS):
             _parse_scope(child, model, parent_id=el.id, messages=messages, errors=errors, signals=signals, escalations=escalations)
@@ -202,11 +209,6 @@ def _parse_extensions(el_xml, el: ProcessElement) -> None:
         if assignment is not None:
             el.user_task_assignee = assignment.get("assignee")
             el.user_task_candidate_groups = assignment.get("candidateGroups")
-    if (el.element_type == BpmnElementType.USER_TASK and not el.native_user_task
-            and el.job_type is None):
-        # job-based user tasks use the implicit worker contract (reference:
-        # UserTaskTransformer's default zeebe:userTask job type)
-        el.job_type = "io.camunda.zeebe:userTask"
     loop = el_xml.find(f"{_B}multiInstanceLoopCharacteristics")
     if loop is not None:
         mi = MultiInstanceDefinition(is_sequential=loop.get("isSequential", "false") in ("true", "1"))

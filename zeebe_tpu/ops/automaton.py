@@ -508,10 +508,14 @@ def run_collect(tables: DeviceTables, state: dict, n_steps: int = 16, config=Non
     point of ``step`` (no executing tokens → all masks false, no counters
     move), so over-running costs idle FLOPs but never wrong events.
 
-    Returns (state', packed) where packed is ONE int32 [n_steps, T, 4+2*FO]
-    tensor (see _pack_events; decode per step with unpack_events). Row 0's
-    col 3 holds the post-step active-token count — the host checks
-    packed[-1, 0, 3] == 0 to decide whether another chunk is needed."""
+    Returns (state', packed) where packed is ONE int32 [n_steps, T*(4+2*FO)]
+    tensor — per-step rows of _pack_events, flattened to 2-D before leaving
+    the device: a [steps, T, 6]-shaped output would be tile-padded on the
+    last axis (lane size 128) and the host fetch would transfer ~20x the
+    real bytes over the TPU tunnel. The host reshapes back to [steps, T, C]
+    and decodes with unpack_events. Per step, row 0's col 3 holds the
+    post-step active-token count — the host checks the last step's value to
+    decide whether another chunk is needed."""
     I = state["def_of"].shape[0]
     T = state["elem"].shape[0]
 
@@ -525,7 +529,7 @@ def run_collect(tables: DeviceTables, state: dict, n_steps: int = 16, config=Non
         # row 1 / col 3 is unused — carry the overflow flag so the host needs
         # exactly one device fetch per chunk
         packed = packed.at[1, 3].set(state["overflow"].astype(jnp.int32))
-        return state, packed
+        return state, packed.reshape(-1)
 
     state, packed = jax.lax.scan(body, state, None, length=n_steps)
     return state, packed

@@ -54,11 +54,21 @@ class ConditionNotCompilable(Exception):
 
 @dataclasses.dataclass
 class SlotMap:
-    """Variable name → device slot assignment (shared across a table set)."""
+    """Variable name → device slot assignment (shared across a table set).
+    Each slot has a kind: ``num`` (the float value itself) or ``str`` (an
+    interned string id, see StringInterner) — a variable used both ways in
+    conditions cannot ride the device path."""
 
     names: dict[str, int] = dataclasses.field(default_factory=dict)
+    kinds: dict[str, str] = dataclasses.field(default_factory=dict)
 
-    def slot(self, name: str) -> int:
+    def slot(self, name: str, kind: str = "num") -> int:
+        existing = self.kinds.get(name)
+        if existing is not None and existing != kind:
+            raise ConditionNotCompilable(
+                f"variable {name!r} used in both numeric and string comparisons"
+            )
+        self.kinds[name] = kind
         if name not in self.names:
             self.names[name] = len(self.names)
         return self.names[name]
@@ -68,10 +78,54 @@ class SlotMap:
         return max(1, len(self.names))
 
 
-def compile_condition(ast, slots: SlotMap) -> list[tuple[int, float]]:
+# interned string ids live at STR_ID_BASE + k: exactly representable in
+# float32 (integers are exact up to 2^24) and far from realistic business
+# numerics; the sentinel marks a runtime string the tables never saw — it
+# compares unequal to every literal, matching host FEEL semantics
+STR_ID_BASE = float(1 << 23)
+STR_ID_UNKNOWN = -STR_ID_BASE
+
+
+@dataclasses.dataclass
+class StringInterner:
+    """String literal → device id (the host variable-store ↔ device-slot
+    split of SURVEY §7 hard part (c): documents stay host-side; conditions
+    read prefetched slots holding either the numeric value or the interned
+    id of the string value)."""
+
+    ids: dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def intern(self, value: str) -> float:
+        if value not in self.ids:
+            self.ids[value] = len(self.ids)
+        return STR_ID_BASE + self.ids[value]
+
+    def id_of(self, value: str) -> float:
+        """Runtime lookup: unseen strings get the never-equal sentinel."""
+        idx = self.ids.get(value)
+        return STR_ID_UNKNOWN if idx is None else STR_ID_BASE + idx
+
+
+def compile_condition(ast, slots: SlotMap,
+                      interner: StringInterner | None = None) -> list[tuple[int, float]]:
     """Lower a FEEL AST to a postfix stack program; raises
-    ConditionNotCompilable for non-numeric constructs."""
+    ConditionNotCompilable for constructs outside the device subset.
+    String equality/inequality compiles via interned ids (``status = "ok"``
+    → EQ(slot, id)); other string operations stay host-side."""
     prog: list[tuple[int, float]] = []
+
+    def is_str_lit(node) -> bool:
+        return isinstance(node, F.Lit) and isinstance(node.value, str)
+
+    def emit_str_operand(node) -> None:
+        if is_str_lit(node):
+            if interner is None:
+                raise ConditionNotCompilable("string literal (no interner)")
+            prog.append((OP_PUSH_CONST, interner.intern(node.value)))
+        elif isinstance(node, F.Var) and len(node.path) == 1:
+            prog.append((OP_PUSH_VAR, float(slots.slot(node.path[0], kind="str"))))
+        else:
+            raise ConditionNotCompilable("string comparison operand")
 
     def emit(node) -> None:
         if isinstance(node, F.Lit):
@@ -85,7 +139,13 @@ def compile_condition(ast, slots: SlotMap) -> list[tuple[int, float]]:
         elif isinstance(node, F.Var):
             if len(node.path) != 1:
                 raise ConditionNotCompilable(f"path {node.path}")
-            prog.append((OP_PUSH_VAR, float(slots.slot(node.path[0]))))
+            prog.append((OP_PUSH_VAR, float(slots.slot(node.path[0], kind="num"))))
+        elif isinstance(node, F.Bin) and node.op in ("=", "!=") and (
+            is_str_lit(node.left) or is_str_lit(node.right)
+        ):
+            emit_str_operand(node.left)
+            emit_str_operand(node.right)
+            prog.append((OP_EQ if node.op == "=" else OP_NE, 0.0))
         elif isinstance(node, F.Unary):
             emit(node.operand)
             prog.append((OP_NEG, 0.0))
@@ -160,6 +220,7 @@ class ProcessTables:
     cond_args: np.ndarray  # [C, P] float32
     # bookkeeping
     slot_map: SlotMap = dataclasses.field(default_factory=SlotMap)
+    interner: StringInterner = dataclasses.field(default_factory=StringInterner)
     job_type_names: list[str] = dataclasses.field(default_factory=list)
     definitions: list[ExecutableProcess] = dataclasses.field(default_factory=list)
 
@@ -203,6 +264,7 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
         )
         max_fanout = max(max_fanout, 1)
     slots = SlotMap()
+    interner = StringInterner()
     job_types: dict[str, int] = {}
     cond_programs: list[list[tuple[int, float]]] = []
 
@@ -272,7 +334,7 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                 if fidx == el.default_flow_idx:
                     default_slot[d, el.idx] = slot_i
                 elif flow.condition is not None and op == K_EXCLUSIVE:
-                    prog = compile_condition(flow.condition.ast, slots)
+                    prog = compile_condition(flow.condition.ast, slots, interner)
                     out_cond[d, el.idx, slot_i] = len(cond_programs)
                     cond_programs.append(prog)
 
@@ -298,6 +360,7 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
         cond_ops=cond_ops,
         cond_args=cond_args,
         slot_map=slots,
+        interner=interner,
         job_type_names=list(job_types),
         definitions=list(processes),
     )

@@ -71,6 +71,7 @@ def main(argv: list[str] | None = None) -> int:
             replication_factor=args.replication,
             directory=args.data_dir,
             backup_store=backup_store_from_env(),
+            kernel_backend=load_broker_cfg().base.kernel_backend,
         )
         runtime.start()
         gateway = Gateway(runtime, bind=f"0.0.0.0:{args.port}")
@@ -107,6 +108,7 @@ def main(argv: list[str] | None = None) -> int:
     cfg = load_broker_cfg(overrides=overrides)
     runtime = ClusterRuntime(
         backup_store=backup_store_from_env(),
+        kernel_backend=cfg.base.kernel_backend,
         broker_count=args.brokers,
         partition_count=(args.partitions if "base.partition_count" in overrides
                          else cfg.base.partition_count),

@@ -173,6 +173,7 @@ def decode_key(encoded: bytes) -> tuple[ColumnFamilyCode, tuple]:
 
 
 _DELETED = object()
+_MISSING_READ = object()
 
 
 def _prefix_successor(prefix: bytes) -> bytes | None:
@@ -197,23 +198,46 @@ class Transaction:
     thousands of events in one transaction, and an O(pending-writes) cost per
     ``iterate`` call turns the group quadratic."""
 
-    __slots__ = ("_db", "_writes", "_sorted_writes", "closed", "capture")
+    __slots__ = ("_db", "_writes", "_sorted_writes", "_reads", "closed", "capture")
 
     def __init__(self, db: "ZbDb") -> None:
         self._db = db
         self._writes: dict[bytes, Any] = {}
         self._sorted_writes: list[bytes] = []
+        # per-transaction read cache of defensively-copied committed values
+        # (one copy per key per transaction; see get)
+        self._reads: dict[bytes, Any] = {}
         self.closed = False
         # optional write-capture log: when a list, every put/delete is also
         # appended as ("put", key, value) / ("del", key, None) — the burst
         # template builder uses this to learn a command's state write-set
         self.capture: list | None = None
 
+    def _committed_read(self, key: bytes) -> Any:
+        """Committed value via the per-transaction copy cache: state code
+        mutates fetched documents in place before put(); handing out the
+        committed object would leak those mutations into the committed store
+        on ROLLBACK (breaking transaction atomicity) and expose mid-mutation
+        values to the lock-free committed readers (ZbDb.committed_get).
+        Shallow copy: mutators only touch top-level fields (deep structures
+        are replaced, not edited). get() and iterate() share the cache so a
+        value mutated after a get() is seen identically by a later scan."""
+        val = self._reads.get(key, _MISSING_READ)
+        if val is not _MISSING_READ:
+            return val
+        val = self._db._data.get(key)
+        if type(val) is dict:
+            val = dict(val)
+        elif type(val) is list:
+            val = list(val)
+        self._reads[key] = val
+        return val
+
     def get(self, key: bytes) -> Any:
         if key in self._writes:
             val = self._writes[key]
             return None if val is _DELETED else val
-        return self._db._data.get(key)
+        return self._committed_read(key)
 
     def put(self, key: bytes, value: Any) -> None:
         if key not in self._writes:
@@ -252,13 +276,13 @@ class Transaction:
         overlay_keys = sw[lo:hi]
         if not overlay_keys:
             for key in db._keys_with_prefix(prefix):
-                snapshot.append((key, db._data[key]))
+                snapshot.append((key, self._committed_read(key)))
             return iter(snapshot)
         overlay = set(overlay_keys)
         for key in db._keys_with_prefix(prefix):
             if key in overlay:
                 continue  # superseded by pending write/delete
-            snapshot.append((key, db._data[key]))
+            snapshot.append((key, self._committed_read(key)))
         for key in overlay_keys:
             val = writes[key]
             if val is not _DELETED:
