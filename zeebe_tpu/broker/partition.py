@@ -29,7 +29,7 @@ from zeebe_tpu.engine.engine import Engine
 from zeebe_tpu.engine.message_timer import DueDateCheckers
 from zeebe_tpu.exporters.director import ExporterDirector
 from zeebe_tpu.journal import SegmentedJournal
-from zeebe_tpu.logstreams import LogAppendEntry, LogStream
+from zeebe_tpu.logstreams import LogAppendEntry, LogStream, patch_prepatched_batch
 from zeebe_tpu.protocol import Record
 from zeebe_tpu.protocol.msgpack import packb, unpackb
 from zeebe_tpu.state import ZbDb
@@ -62,17 +62,13 @@ class _RaftWriter:
         the pre-serialized batch, replicate the bytes (mirrors
         LogStreamWriter.append_prepatched; the committed entry materializes
         into the stream journal like any other batch)."""
-        import struct
-
         p = self.partition
         if p.role != RaftRole.LEADER:
             return -1
         first_position = p._next_position
         timestamp = p.clock_millis()
-        for i, off in enumerate(pos_offsets):
-            struct.pack_into("<q", buf, off, first_position + i)
-        for off in ts_offsets:
-            struct.pack_into("<q", buf, off, timestamp)
+        patch_prepatched_batch(buf, pos_offsets, ts_offsets,
+                               first_position, timestamp)
         if p.raft.append(bytes(buf), asqn=first_position) is None:
             return -1
         # remember the command-scan skip flag until the committed entry
@@ -187,6 +183,11 @@ class ZeebePartition:
         recover db from the latest snapshot, replay the stream journal, then
         process (leader) or keep replaying (follower)."""
         self._recover_db()
+        # flags for appends that never committed under the previous role must
+        # not leak onto a NEW leader's batch at a reused position (raft may
+        # have truncated ours) — wrong flags make the command scan skip real
+        # commands
+        self._prepatched_flags.clear()
         # state migrations run between snapshot recovery and the stream
         # processor opening (reference: MigrationTransitionStep →
         # DbMigratorImpl.runMigrations)

@@ -76,6 +76,7 @@ class LoopbackNetwork:
         self.queue: deque[tuple[str, str, str, Any]] = deque()
         self._partitions: set[frozenset[str]] = set()
         self.dropped: int = 0
+        self._handler_fail_logged: set[str] = set()
 
     def join(self, member_id: str) -> LoopbackMessaging:
         svc = LoopbackMessaging(self, member_id)
@@ -117,7 +118,23 @@ class LoopbackNetwork:
             return True
         handler = self.members[target].handlers.get(topic)
         if handler is not None:
-            handler(sender, payload)
+            try:
+                handler(sender, payload)
+            except Exception:  # noqa: BLE001 — a crashed member whose handler
+                # still dispatches into closed state loses the message (same
+                # observable behavior as a real dead host); delivery to every
+                # healthy member continues
+                self.dropped += 1
+                if target not in self._handler_fail_logged:
+                    self._handler_fail_logged.add(target)
+                    logger.exception(
+                        "dropping message %s -> %s on topic %r: handler failed "
+                        "(further drops for this member logged at debug)",
+                        sender, target, topic,
+                    )
+                else:
+                    logger.debug("dropping message %s -> %s on topic %r",
+                                 sender, target, topic)
         return True
 
     def deliver_all(self, max_messages: int = 100_000) -> int:

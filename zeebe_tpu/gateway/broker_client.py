@@ -13,6 +13,7 @@ resolves futures from each broker's response sink."""
 from __future__ import annotations
 
 import itertools
+import logging
 import threading
 import time
 from typing import Any
@@ -23,6 +24,8 @@ from zeebe_tpu.cluster.messaging import LoopbackNetwork
 from zeebe_tpu.parallel.partitioning import subscription_partition_id
 from zeebe_tpu.protocol import Record
 from zeebe_tpu.protocol.keys import decode_partition_id
+
+logger = logging.getLogger("zeebe_tpu.gateway.runtime")
 
 DEPLOYMENT_PARTITION = 1
 
@@ -153,11 +156,29 @@ class ClusterRuntime(GatewayRuntimeBase):
         self.await_leaders()
 
     def _run(self) -> None:
+        # one broker's pump failure (e.g. crashed/closed but still listed)
+        # must not kill the thread that drives every other broker: keep
+        # pumping the rest and retry the failed one each tick (a transient
+        # cause — momentary disk pressure, a mid-transition race — recovers
+        # by itself); the traceback is logged once per failure streak
+        logged: set[str] = set()
         while self._running:
             with self._lock:
-                for broker in self.brokers.values():
-                    broker.pump()
-                moved = self.net.deliver_all()
+                for name, broker in list(self.brokers.items()):
+                    try:
+                        broker.pump()
+                        logged.discard(name)
+                    except Exception:  # noqa: BLE001
+                        if name not in logged:
+                            logged.add(name)
+                            logger.exception("broker %s pump failed; retrying "
+                                             "(logged once per streak)", name)
+                try:
+                    moved = self.net.deliver_all()
+                except Exception:  # noqa: BLE001 — deliver_one already guards
+                    # handler errors; this guards queue-level corruption
+                    logger.exception("message delivery failed")
+                    moved = 0
             if moved == 0:
                 time.sleep(0.001)
 

@@ -6,6 +6,7 @@ from zeebe_tpu.logstreams.log_stream import (
     LogStream,
     LogStreamReader,
     LogStreamWriter,
+    patch_prepatched_batch,
 )
 
 __all__ = [
@@ -14,4 +15,5 @@ __all__ = [
     "LogStream",
     "LogStreamReader",
     "LogStreamWriter",
+    "patch_prepatched_batch",
 ]

@@ -129,15 +129,24 @@ class LogStreamWriter:
         with self._lock:
             first_position = stream._next_position
             timestamp = stream.clock_millis()
-            for i, off in enumerate(pos_offsets):
-                _PACK_LE_Q.pack_into(buf, off, first_position + i)
-            for off in ts_offsets:
-                _PACK_LE_Q.pack_into(buf, off, timestamp)
+            patch_prepatched_batch(buf, pos_offsets, ts_offsets,
+                                   first_position, timestamp)
             jrec = stream.journal.append(bytes(buf), asqn=first_position)
             stream._on_appended(first_position, jrec.index)
             stream._next_position = first_position + count
             stream._batch_has_commands[jrec.index] = has_pending_commands
         return first_position + count - 1
+
+
+def patch_prepatched_batch(buf: bytearray, pos_offsets, ts_offsets,
+                           first_position: int, timestamp: int) -> None:
+    """Stamp the only two unknowns of a pre-serialized burst batch — record
+    positions and the batch timestamp — at their captured byte offsets
+    (shared by the local LogStreamWriter and the broker's Raft writer)."""
+    for i, off in enumerate(pos_offsets):
+        _PACK_LE_Q.pack_into(buf, off, first_position + i)
+    for off in ts_offsets:
+        _PACK_LE_Q.pack_into(buf, off, timestamp)
 
 
 def _serialize_batch(

@@ -20,6 +20,25 @@ from zeebe_tpu.protocol import msgpack
 from zeebe_tpu.protocol.enums import RecordType, RejectionType, ValueType
 from zeebe_tpu.protocol.intent import Intent
 
+
+def _intent_tables() -> dict[int, dict[int, Intent]]:
+    out: dict[int, dict[int, Intent]] = {}
+    for vt in ValueType:
+        try:
+            cls = Intent.for_value_type(vt)
+        except (KeyError, ValueError):
+            continue
+        out[int(vt)] = {int(member): member for member in cls}
+    return out
+
+
+# decode lookup tables: plain dict gets beat Enum.__call__ 4x per record on
+# the log-scan hot path
+_RT_BY_VALUE = {int(v): v for v in RecordType}
+_VT_BY_VALUE = {int(v): v for v in ValueType}
+_REJ_BY_VALUE = {int(v): v for v in RejectionType}
+_INTENT_BY_VT = _intent_tables()
+
 # Wire layout for the serialized metadata header, preceding the msgpack body
 # (the reference frames this with SBE; we use a fixed little-endian struct —
 # same information, simpler codegen story):
@@ -122,7 +141,9 @@ class Record:
         avoids a per-record replace() on the decode path."""
         try:
             return cls._from_bytes(data, position, partition_id, timestamp)
-        except (struct.error, UnicodeDecodeError, msgpack.MsgPackError) as exc:
+        except (struct.error, UnicodeDecodeError, msgpack.MsgPackError,
+                KeyError) as exc:
+            # KeyError: unknown enum value in the frame header (lookup tables)
             raise ValueError(f"malformed record frame: {exc}") from exc
 
     @classmethod
@@ -151,10 +172,12 @@ class Record:
                 f"record frame length mismatch: header says {off + value_len}, got {len(data)}"
             )
         value = msgpack.unpackb(data[off : off + value_len])
-        vt = ValueType(value_type)
-        intent = Intent.for_value_type(vt)(intent_val)
+        # dict lookups instead of Enum.__call__ (4 enum constructions per
+        # record add up on the log-scan hot path)
+        vt = _VT_BY_VALUE[value_type]
+        intent = _INTENT_BY_VT[value_type][intent_val]
         return cls(
-            record_type=RecordType(record_type),
+            record_type=_RT_BY_VALUE[record_type],
             value_type=vt,
             intent=intent,
             value=value,
@@ -163,7 +186,7 @@ class Record:
             source_record_position=source_pos,
             timestamp=timestamp if timestamp_override is None else timestamp_override,
             partition_id=partition_id,
-            rejection_type=RejectionType(rejection_type),
+            rejection_type=_REJ_BY_VALUE[rejection_type],
             rejection_reason=reason,
             request_stream_id=request_stream_id,
             request_id=request_id,

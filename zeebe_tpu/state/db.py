@@ -226,11 +226,15 @@ class Transaction:
         if val is not _MISSING_READ:
             return val
         val = self._db._data.get(key)
-        if type(val) is dict:
+        # only containers are copied (and cached): scalars are immutable, and
+        # index scans over None/int values must stay allocation-free
+        t = type(val)
+        if t is dict:
             val = dict(val)
-        elif type(val) is list:
+            self._reads[key] = val
+        elif t is list:
             val = list(val)
-        self._reads[key] = val
+            self._reads[key] = val
         return val
 
     def get(self, key: bytes) -> Any:
