@@ -260,22 +260,23 @@ class TestKernelActuallyUsed:
             h.close()
 
     def test_host_only_definition_falls_back(self):
-        # an embedded sub-process has nested scopes — not lowerable to the
-        # flat device tables, so every command takes the sequential path
+        # multi-instance bodies need data-dependent fan-out over a host-side
+        # collection — not lowerable to the device tables, so every command
+        # takes the sequential path
         model = (
-            Bpmn.create_executable_process("sub_proc")
+            Bpmn.create_executable_process("mi_proc")
             .start_event("s")
-            .sub_process("sp")
-            .start_event("inner_s")
-            .end_event("inner_e")
-            .sub_process_done()
+            .service_task("t", job_type="mi_work")
+            .multi_instance(input_collection="= items", input_element="item")
             .end_event("e")
             .done()
         )
         h = EngineHarness(use_kernel_backend=True)
         try:
             h.deploy(model)
-            key = h.create_instance("sub_proc")
+            key = h.create_instance("mi_proc", {"items": [1, 2]})
+            for job in h.activate_jobs("mi_work", max_jobs=10):
+                h.complete_job(job["key"])
             assert h.is_instance_done(key)
             assert h.kernel_backend.commands_processed == 0
         finally:
@@ -499,3 +500,250 @@ class TestStringConditions:
             drive_jobs(h, "more_work")
 
         assert_equivalent(scenario)
+
+
+def timer_boundary_task(pid="tbnd", interrupting=True, duration="PT10S"):
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .service_task("task", job_type="slow_work")
+        .boundary_timer("tb", attached_to="task", duration=duration,
+                        interrupting=interrupting)
+        .service_task("escal", job_type="escalate_work")
+        .end_event("e_b")
+        .move_to_element("task")
+        .end_event("e")
+        .done()
+    )
+
+
+def message_boundary_task(pid="mbnd", interrupting=True):
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .service_task("task", job_type="slow_work")
+        .boundary_message("mb", attached_to="task", message_name="abort",
+                          correlation_key="= orderId", interrupting=interrupting)
+        .end_event("e_b")
+        .move_to_element("task")
+        .end_event("e")
+        .done()
+    )
+
+
+def error_boundary_task(pid="ebnd"):
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .service_task("task", job_type="risky_work")
+        .boundary_error("eb", attached_to="task", error_code="OOPS")
+        .service_task("fix", job_type="fix_work")
+        .end_event("e_b")
+        .move_to_element("task")
+        .end_event("e")
+        .done()
+    )
+
+
+class TestBoundaryEvents:
+    """Tasks carrying boundary events ride the kernel; the wait-state
+    subscriptions open/close in the sequential engine's exact record order,
+    and triggers route through the sequential path (reference:
+    processing/bpmn/behavior/BpmnEventSubscriptionBehavior, route_trigger)."""
+
+    def test_timer_boundary_not_fired_parity(self):
+        """Job completes before the boundary fires: TIMER CREATED on arrival,
+        TIMER CANCELED between COMPLETING and COMPLETED."""
+
+        def scenario(h):
+            h.deploy(timer_boundary_task())
+            h.create_instance("tbnd", request_id=1)
+            drive_jobs(h, "slow_work")
+
+        assert_equivalent(scenario)
+
+    def test_timer_boundary_fires_interrupting_parity(self):
+        """Boundary fires first: trigger routes sequentially (terminate task,
+        cancel job, activate boundary), then the continuation can ride the
+        kernel again."""
+
+        def scenario(h):
+            h.deploy(timer_boundary_task())
+            h.create_instance("tbnd", request_id=1)
+            h.advance_time(11_000)
+            drive_jobs(h, "escalate_work")
+
+        assert_equivalent(scenario)
+
+    def test_timer_boundary_non_interrupting_parity(self):
+        def scenario(h):
+            h.deploy(timer_boundary_task("tbnd2", interrupting=False,
+                                         duration="PT5S"))
+            h.create_instance("tbnd2", request_id=1)
+            h.advance_time(6_000)  # boundary fires; task keeps waiting
+            drive_jobs(h, "escalate_work")
+            drive_jobs(h, "slow_work")
+
+        assert_equivalent(scenario)
+
+    def test_message_boundary_not_fired_parity(self):
+        def scenario(h):
+            h.deploy(message_boundary_task())
+            h.create_instance("mbnd", {"orderId": "o-1"}, request_id=1)
+            drive_jobs(h, "slow_work")
+
+        assert_equivalent(scenario)
+
+    def test_message_boundary_fires_parity(self):
+        def scenario(h):
+            h.deploy(message_boundary_task("mbnd3"))
+            h.create_instance("mbnd3", {"orderId": "o-7"}, request_id=1)
+            h.publish_message("abort", "o-7")
+
+        assert_equivalent(scenario)
+
+    def test_error_boundary_parity(self):
+        def scenario(h):
+            h.deploy(error_boundary_task())
+            h.create_instance("ebnd", request_id=1)
+            jobs = h.activate_jobs("risky_work")
+            h.throw_job_error(jobs[0]["key"], "OOPS")
+            drive_jobs(h, "fix_work")
+
+        assert_equivalent(scenario)
+
+    def test_boundary_definitions_ride_the_kernel(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(timer_boundary_task("ktb"))
+            h.create_instance("ktb", request_id=1)
+            with h.db.transaction():
+                meta = h.engine.state.processes.get_latest_by_id("ktb")
+            info = h.kernel_backend.registry.lookup(
+                meta["processDefinitionKey"], None)
+            assert info is not None, "boundary process must be kernel-eligible"
+            assert drive_jobs(h, "slow_work") == 1
+            assert h.kernel_backend.commands_processed > 0
+        finally:
+            h.close()
+
+
+def subprocess_task(pid="subp"):
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .sub_process("sub")
+        .start_event("inner_s")
+        .service_task("inner_task", job_type="inner_work")
+        .end_event("inner_e")
+        .sub_process_done()
+        .service_task("after", job_type="after_work")
+        .end_event("e")
+        .done()
+    )
+
+
+def nested_subprocess(pid="nest"):
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .sub_process("outer")
+        .start_event("os")
+        .sub_process("innersub")
+        .start_event("is_")
+        .service_task("deep", job_type="deep_work")
+        .end_event("ie")
+        .sub_process_done()
+        .end_event("oe")
+        .sub_process_done()
+        .end_event("e")
+        .done()
+    )
+
+
+def subprocess_fork_join(pid="subfj"):
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .sub_process("sub")
+        .start_event("is_")
+        .parallel_gateway("fork")
+        .service_task("a", job_type="a_work")
+        .parallel_gateway("join")
+        .end_event("ie")
+        .move_to_element("fork")
+        .service_task("b", job_type="b_work")
+        .connect_to("join")
+        .sub_process_done()
+        .end_event("e")
+        .done()
+    )
+
+
+def empty_subprocess(pid="sube"):
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .sub_process("sub")
+        .start_event("is_")
+        .end_event("ie")
+        .sub_process_done()
+        .end_event("e")
+        .done()
+    )
+
+
+class TestSubProcessScopes:
+    """Embedded sub-processes ride the kernel as K_SCOPE tokens: activation
+    spawns the inner start, the scope parks until its tokens drain, and
+    completion routes through COMPLETE_ELEMENT like the process root
+    (reference: bpmn/container/SubProcessProcessor, scope completion)."""
+
+    def test_subprocess_with_task_parity(self):
+        def scenario(h):
+            h.deploy(subprocess_task())
+            h.create_instance("subp", request_id=1)
+            drive_jobs(h, "inner_work")
+            drive_jobs(h, "after_work")
+
+        assert_equivalent(scenario)
+
+    def test_empty_subprocess_parity(self):
+        def scenario(h):
+            h.deploy(empty_subprocess())
+            h.create_instance("sube", request_id=1)
+
+        assert_equivalent(scenario)
+
+    def test_nested_subprocess_parity(self):
+        def scenario(h):
+            h.deploy(nested_subprocess())
+            h.create_instance("nest", request_id=1)
+            drive_jobs(h, "deep_work")
+
+        assert_equivalent(scenario)
+
+    def test_fork_join_inside_subprocess_parity(self):
+        def scenario(h):
+            h.deploy(subprocess_fork_join())
+            h.create_instance("subfj", request_id=1)
+            drive_jobs(h, "a_work")
+            drive_jobs(h, "b_work")
+
+        assert_equivalent(scenario)
+
+    def test_subprocess_definitions_ride_the_kernel(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(subprocess_task("ksub"))
+            h.create_instance("ksub", request_id=1)
+            with h.db.transaction():
+                meta = h.engine.state.processes.get_latest_by_id("ksub")
+            info = h.kernel_backend.registry.lookup(
+                meta["processDefinitionKey"], None)
+            assert info is not None, "subprocess process must be kernel-eligible"
+            assert drive_jobs(h, "inner_work") == 1
+            assert drive_jobs(h, "after_work") == 1
+            assert h.kernel_backend.commands_processed >= 2
+        finally:
+            h.close()

@@ -67,16 +67,26 @@ class _Gen:
         if depth >= 3:
             return self.task(b)
         roll = rng.random()
-        if roll < 0.40:
+        if roll < 0.38:
             return self.task(b)
-        if roll < 0.48:
+        if roll < 0.46:
             return self.catch_event(b)
-        if roll < 0.60:
+        if roll < 0.56:
             b = self.block(b, depth + 1)
             return self.block(b, depth + 1)
+        if roll < 0.66:
+            return self.subprocess(b, depth)
         if roll < 0.85:
             return self.exclusive(b, depth)
         return self.parallel(b, depth)
+
+    def subprocess(self, b, depth: int):
+        sid = self.next_id("sub")
+        b = b.sub_process(sid)
+        b = b.start_event(self.next_id("ss"))
+        b = self.block(b, depth + 1)
+        b = b.end_event(self.next_id("se"))
+        return b.sub_process_done()
 
     def catch_event(self, b):
         """A timer or message intermediate catch (rides the kernel's K_CATCH
@@ -92,7 +102,32 @@ class _Gen:
     def task(self, b):
         job_type = self.rng.choice(JOB_TYPES)
         self.job_types_used.add(job_type)
-        return b.service_task(self.next_id("task"), job_type=job_type)
+        tid = self.next_id("task")
+        b = b.service_task(tid, job_type=job_type)
+        if self.rng.random() < 0.22:
+            b = self.boundary(b, tid)
+        return b
+
+    def boundary(self, b, tid: str):
+        """Attach a timer or message boundary (interrupting or not) with its
+        own continuation branch; triggers route through the sequential path
+        while the parked task stays kernel-reconstructable."""
+        rng = self.rng
+        bid = self.next_id("bnd")
+        interrupting = rng.random() < 0.5
+        if rng.random() < 0.5:
+            self.has_timers = True
+            b = b.boundary_timer(bid, attached_to=tid, duration="PT5S",
+                                 interrupting=interrupting)
+        else:
+            name = f"msg_{self.next_id('bm')}"
+            self.messages.add(name)
+            b = b.boundary_message(bid, attached_to=tid, message_name=name,
+                                   correlation_key="mkey",
+                                   interrupting=interrupting)
+        b = self.task(b)
+        b = b.end_event(self.next_id("be"))
+        return b.move_to_element(tid)
 
     def exclusive(self, b, depth: int):
         rng = self.rng

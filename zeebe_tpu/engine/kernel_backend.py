@@ -44,6 +44,7 @@ from zeebe_tpu.ops.tables import (
     ConditionNotCompilable,
     K_CATCH,
     K_JOIN,
+    K_SCOPE,
     K_TASK,
     ProcessTables,
     compile_tables,
@@ -86,10 +87,30 @@ def check_element_eligibility(exe: ExecutableProcess, el: ExecutableElement) -> 
     """True when the sequential engine's behavior for this element is exactly
     the kernel's opcode behavior (engine/…/processing/bpmn element processors
     vs ops/automaton masks)."""
-    if el.inputs or el.outputs or el.boundary_idxs or el.multi_instance is not None:
+    if el.inputs or el.outputs or el.multi_instance is not None:
         return False
     if el.native_user_task or el.called_decision_id or el.script_expression is not None:
         return False
+    if el.element_type == BpmnElementType.BOUNDARY_EVENT:
+        # triggers route sequentially (route_trigger); the kernel only needs
+        # the attached wait state to be reconstructable, so the boundary's
+        # subscription kind must be one _reconstruct knows how to collect
+        if el.event_type == BpmnEventType.TIMER:
+            return el.timer_duration is not None and el.timer_date is None
+        if el.event_type == BpmnEventType.MESSAGE:
+            return el.message_name is not None
+        # error boundaries carry no wait state at all (the job THROW_ERROR
+        # command routes through _find_catcher on the host)
+        return el.event_type == BpmnEventType.ERROR
+    if el.boundary_idxs and _KERNEL_OP.get(el.element_type) != K_TASK:
+        # boundary wait-state reconstruction is implemented for parked
+        # job-worker tasks only
+        return False
+    if el.element_type == BpmnElementType.SUB_PROCESS:
+        # embedded sub-process with a none start rides the kernel (K_SCOPE);
+        # attached boundaries or event sub-processes would need host-side
+        # trigger state the scope reconstruction does not collect yet
+        return el.child_start_idx >= 0 and not exe.event_sub_processes_of(el.idx)
     if el.element_type == BpmnElementType.INTERMEDIATE_CATCH_EVENT:
         # timer (fixed duration) and message catches park on device (K_CATCH)
         # and are resumed by the host's TRIGGER / CORRELATE commands; duration
@@ -131,7 +152,10 @@ class _DefInfo:
     job_types: dict[int, str]  # element idx → static job type
     job_retries: dict[int, int]
     join_idxs: list[int]  # element idxs of K_JOIN gateways
-    timer_idxs: frozenset[int]  # element idxs of timer catch events
+    timer_idxs: frozenset[int]  # element idxs whose ARRIVAL creates a timer
+    # task element idx → (# timer boundaries, # message boundaries) expected
+    # open while the task is parked (reconstruction integrity check)
+    boundary_waits: dict[int, tuple[int, int]]
 
 
 class KernelRegistry:
@@ -175,9 +199,18 @@ class KernelRegistry:
                 )
             if solo.kernel_op[0, el.idx] == K_JOIN:
                 join_idxs.append(el.idx)
+        boundary_waits: dict[int, tuple[int, int]] = {}
+        for el in exe.elements[1:]:
+            if solo.kernel_op[0, el.idx] == K_TASK and el.boundary_idxs:
+                bs = [exe.elements[b] for b in el.boundary_idxs]
+                boundary_waits[el.idx] = (
+                    sum(1 for b in bs if b.timer_duration is not None),
+                    sum(1 for b in bs if b.message_name is not None),
+                )
         timer_idxs = frozenset(
             el.idx for el in exe.elements[1:]
-            if solo.kernel_op[0, el.idx] == K_CATCH and el.timer_duration is not None
+            if (solo.kernel_op[0, el.idx] == K_CATCH and el.timer_duration is not None)
+            or boundary_waits.get(el.idx, (0, 0))[0] > 0
         )
         info = _DefInfo(
             index=len(self._infos),
@@ -188,6 +221,7 @@ class KernelRegistry:
             job_retries=job_retries,
             join_idxs=join_idxs,
             timer_idxs=timer_idxs,
+            boundary_waits=boundary_waits,
         )
         self._infos.append(info)
         self._by_key[definition_key] = info
@@ -349,10 +383,14 @@ class KernelBackend:
     def _reconstruct(self, pi_key: int, info: _DefInfo, resume_key: int):
         """Rebuild a running instance's device tokens from element-instance
         state. Every live element instance must be parked in a kernel wait
-        state (task on a job, or catch on a timer/subscription) — anything
-        else (mid-transition, incident) is not reconstructable. Returns
-        (tokens, resume_token, root, wait_docs) or None; wait_docs are the
-        parked wait-state records (for the template fingerprint)."""
+        state (task on a job, catch on a timer/subscription, or a sub-process
+        scope whose descendants are parked) — anything else (mid-transition,
+        incident, scope drain in flight) is not reconstructable. Returns
+        (tokens, resume_token, root, wait_docs, scope_keys, join_counts) or
+        None; wait_docs are the parked wait-state records (for the template
+        fingerprint), scope_keys maps scope element idx → instance key
+        (0 → the process instance), join_counts maps join gateway element
+        idx → unconsumed arrivals."""
         state = self.engine.state
         root = state.element_instances.get(pi_key)
         from zeebe_tpu.engine.engine_state import EI_ACTIVATED
@@ -363,7 +401,13 @@ class KernelBackend:
         tokens: list[_Token] = []
         resume: _Token | None = None
         wait_docs: list = []
-        for child_key in sorted(state.element_instances.children_keys(pi_key)):
+        # elem idx of a scope (0 = process root) → its instance key: join
+        # counters and sub-process drain checks key off the scope instance
+        scope_keys: dict[int, int] = {0: pi_key}
+        # depth-first walk of the element-instance tree: K_SCOPE children are
+        # parked tokens whose own children are walked recursively
+        pending_walk = sorted(state.element_instances.children_keys(pi_key))
+        for child_key in pending_walk:
             child = state.element_instances.get(child_key)
             if child is None or child["state"] != EI_ACTIVATED:
                 return None
@@ -372,9 +416,28 @@ class KernelBackend:
                 return None
             el = exe.element(elem_id)
             op = self.registry.tables.kernel_op[info.index, el.idx]
-            if op == K_TASK:
+            if op == K_SCOPE:
+                scope_keys[el.idx] = child_key
+                pending_walk.extend(
+                    sorted(state.element_instances.children_keys(child_key))
+                )
+            elif op == K_TASK:
                 if child.get("jobKey", -1) < 0:
                     return None
+                n_timer_b, n_msg_b = info.boundary_waits.get(el.idx, (0, 0))
+                if n_timer_b or n_msg_b:
+                    # every boundary subscription must be intact: a missing
+                    # timer/sub means a trigger is mid-flight (its internal
+                    # TERMINATE/ACTIVATE commands own this instance now) —
+                    # decline so the sequential path resolves the race
+                    timers = state.timers.timers_for_element_instance(child_key)
+                    subs = state.process_message_subscriptions.subscriptions_of(
+                        child_key
+                    )
+                    if len(timers) != n_timer_b or len(subs) != n_msg_b:
+                        return None
+                    wait_docs.extend(dict(t) for _k, t in timers)
+                    wait_docs.extend(dict(s) for s in subs)
             elif op == K_CATCH:
                 if el.timer_duration is not None:
                     timers = state.timers.timers_for_element_instance(child_key)
@@ -398,15 +461,44 @@ class KernelBackend:
             tokens.append(tok)
         if resume is None:
             return None
-        return tokens, resume, root, wait_docs
+        join_counts = self._join_counts(info, scope_keys)
+        # drain integrity: a scope instance with no parked descendant token
+        # and no pending join arrival inside has its COMPLETE_ELEMENT command
+        # in flight — the device would re-complete it (duplicate records), so
+        # the sequential path must finish that window
+        for scope_idx in scope_keys:
+            if scope_idx == 0:
+                continue
+            if any(self._inside(exe, t.elem_idx, scope_idx) for t in tokens):
+                continue
+            if any(join_counts.get(j) and self._inside(exe, j, scope_idx)
+                   for j in info.join_idxs):
+                continue
+            return None
+        return tokens, resume, root, wait_docs, scope_keys, join_counts
 
-    def _join_counts(self, pi_key: int, info: _DefInfo) -> dict[int, int]:
+    @staticmethod
+    def _inside(exe: ExecutableProcess, elem_idx: int, scope_idx: int) -> bool:
+        """True when elem_idx lies strictly inside scope_idx's scope chain."""
+        anc = exe.elements[elem_idx].parent_idx
+        while anc > 0:
+            if anc == scope_idx:
+                return True
+            anc = exe.elements[anc].parent_idx
+        return False
+
+    def _join_counts(self, info: _DefInfo, scope_keys: dict[int, int]) -> dict[int, int]:
         state = self.engine.state
         exe = info.exe
         join_counts: dict[int, int] = {}
         for jidx in info.join_idxs:
+            # NUMBER_OF_TAKEN_SEQUENCE_FLOWS counters key off the gateway's
+            # flow-scope INSTANCE (process root or sub-process instance)
+            scope_key = scope_keys.get(exe.elements[jidx].parent_idx)
+            if scope_key is None:
+                continue  # scope not instantiated → no arrivals
             total = sum(
-                state.element_instances.taken_flow_count(pi_key, jidx, f.idx)
+                state.element_instances.taken_flow_count(scope_key, jidx, f.idx)
                 for f in exe.flows
                 if f.target_idx == jidx
             )
@@ -457,10 +549,9 @@ class KernelBackend:
         rebuilt = self._reconstruct(pi_key, info, resume_key)
         if rebuilt is None:
             return None
-        tokens, resume, root, wait_docs = rebuilt
+        tokens, resume, root, wait_docs, scope_keys, join_counts = rebuilt
         if self.registry.tables.kernel_op[info.index, resume.elem_idx] != require_op:
             return None
-        join_counts = self._join_counts(pi_key, info)
         merged = state.variables.collect(pi_key)
         merged.update(extra_variables or {})
         slots = self._condition_slots(info, merged)
@@ -817,20 +908,23 @@ class KernelBackend:
             roles[adm.cmd.record.key] = "k"
 
         def norm(obj):
-            if isinstance(obj, bool):
+            # exact-type dispatch (hot path: ~50 nodes per admitted command);
+            # bool/float/None fall through unchanged via the final return
+            t = type(obj)
+            if t is int:
+                if obj >= _ROLE_VALUE_MIN:
+                    r = roles.get(obj)
+                    return ["\x00r", r] if r is not None else obj
                 return obj
-            if isinstance(obj, int) and obj >= _ROLE_VALUE_MIN:
-                r = roles.get(obj)
-                return ["\x00r", r] if r is not None else obj
-            if isinstance(obj, dict):
-                return {norm(k): norm(v) for k, v in obj.items()}
-            if isinstance(obj, (list, tuple)):
-                return [norm(v) for v in obj]
-            if isinstance(obj, str) and obj.startswith("\x00"):
+            if t is str:
                 # escape NUL-prefixed strings so user data can never forge
                 # the ["\x00r", tag] role marker (prefix escaping keeps the
                 # normalization injective)
-                return "\x00s" + obj
+                return ("\x00s" + obj) if obj.startswith("\x00") else obj
+            if t is dict:
+                return {norm(k): norm(v) for k, v in obj.items()}
+            if t is list or t is tuple:
+                return [norm(v) for v in obj]
             return obj
 
         return packb(norm(adm.fp_docs))
@@ -1108,7 +1202,19 @@ class KernelBackend:
                 if ev["inst"][s] != inst.idx or ev["elem"][s] != e:
                     continue  # slot reused after this token died (stale entry)
                 if ev["task_arrive"][s]:
-                    ops.append(("arrive", l, e))
+                    if tables.kernel_op[d, e] == K_SCOPE:
+                        # scope arrival: the inner start token's placement
+                        # rides flow slot 0 (see step()'s spawn channel); the
+                        # scope token itself stays parked
+                        dest = int(ev["dest"][s, 0])
+                        nl = next_l
+                        next_l += 1
+                        additions.append(
+                            [nl, dest, int(tables.scope_start[d, e])]
+                        )
+                        ops.append(("scopearr", l, e, nl))
+                    else:
+                        ops.append(("arrive", l, e))
                 elif ev["task_done"][s] or ev["full_pass"][s]:
                     ops.append(("done" if ev["task_done"][s] else "pass", l, e))
                     for fo in range(ev["take_mask"].shape[1]):
@@ -1155,6 +1261,12 @@ class KernelBackend:
             if kind == "arrive":
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_ACTIVATING, value)
+                if element.boundary_idxs:
+                    # boundary subscriptions attach between ACTIVATING and
+                    # ACTIVATED (mirror BpmnProcessor._activate's ordering)
+                    self.engine.bpmn._open_boundary_subscriptions(
+                        tok.key, value, exe, element, writers
+                    )
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_ACTIVATED, value)
                 if element.element_type == BpmnElementType.INTERMEDIATE_CATCH_EVENT:
@@ -1171,10 +1283,38 @@ class KernelBackend:
                 else:
                     self._emit_job_created(inst, tok, element, writers)
             elif kind == "done":
+                if element.element_type == BpmnElementType.SUB_PROCESS:
+                    # scope drain completes through an internal command, like
+                    # the process root (mirror _check_scope_completion →
+                    # COMPLETE_ELEMENT → _complete)
+                    writers.append_command(tok.key, ValueType.PROCESS_INSTANCE,
+                                           PI.COMPLETE_ELEMENT, {})
+                    self._mark_last_command_processed(builder)
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_COMPLETING, value)
+                if element.boundary_idxs:
+                    # mirror _complete: subscriptions close between COMPLETING
+                    # and COMPLETED (TIMER CANCELED / subscription DELETED)
+                    self.engine.bpmn._close_subscriptions(tok.key, value, writers)
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_COMPLETED, value)
+            elif kind == "scopearr":
+                # embedded sub-process activation: ACTIVATING/ACTIVATED, then
+                # the inner none-start activates via an internal command with
+                # the scope instance as its flow scope (mirror _activate's
+                # SUB_PROCESS branch → _write_activate)
+                writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                     PI.ELEMENT_ACTIVATING, value)
+                writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                     PI.ELEMENT_ACTIVATED, value)
+                start = exe.elements[element.child_start_idx]
+                child_key = state.next_key()
+                child_value = self._child_value(value, start, tok.key)
+                writers.append_command(child_key, ValueType.PROCESS_INSTANCE,
+                                       PI.ACTIVATE_ELEMENT, child_value)
+                self._mark_last_command_processed(builder)
+                toks[op[3]] = _Token(slot=-1, elem_idx=start.idx,
+                                     key=child_key, value=child_value)
             elif kind == "pass":
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_ACTIVATING, value)

@@ -51,6 +51,7 @@ from zeebe_tpu.ops.tables import (
     K_JOIN,
     K_NONE,
     K_PASS,
+    K_SCOPE,
     K_TASK,
     MAX_PROG_LEN,
     OP_ADD,
@@ -94,6 +95,8 @@ class DeviceTables:
     out_flow_idx: jax.Array
     default_slot: jax.Array
     start_elem: jax.Array
+    scope_start: jax.Array
+    in_scope: jax.Array
     cond_ops: jax.Array
     cond_args: jax.Array
 
@@ -109,6 +112,8 @@ class DeviceTables:
             out_flow_idx=jnp.asarray(t.out_flow_idx),
             default_slot=jnp.asarray(t.default_slot),
             start_elem=jnp.asarray(t.start_elem),
+            scope_start=jnp.asarray(t.scope_start),
+            in_scope=jnp.asarray(t.in_scope),
             cond_ops=jnp.asarray(t.cond_ops),
             cond_args=jnp.asarray(t.cond_args),
         )
@@ -241,6 +246,39 @@ def _eval_conditions(cond_ops, cond_args, prog_ids, slot_rows):
 
 
 # ---------------------------------------------------------------------------
+# scope machinery
+
+
+def _scope_drained(tables: "DeviceTables", state: dict) -> jax.Array:
+    """Mask of parked K_SCOPE tokens whose scope holds no live token and no
+    unconsumed parallel-join arrival — they complete on the next step. Used
+    by ``step`` (start-of-step state) and by ``run_collect``'s active count
+    (post-step state), so a drain-pending scope never reads as quiesced."""
+    elem = state["elem"]
+    phase = state["phase"]
+    inst = state["inst"]
+    I, E = state["join_counts"].shape
+    live = elem >= 0
+    def_of_tok = state["def_of"][inst]
+    op = jnp.where(live, tables.kernel_op[def_of_tok, jnp.maximum(elem, 0)], K_NONE)
+    # [T, E] row t = which scopes (transitively) contain token t's element
+    containing = tables.in_scope[def_of_tok, jnp.maximum(elem, 0)].astype(jnp.int32)
+    occ = jnp.zeros((I, E), jnp.int32).at[inst].add(
+        containing * live.astype(jnp.int32)[:, None]
+    )
+    pend = jnp.einsum(
+        "ie,ies->is",
+        state["join_counts"],
+        tables.in_scope[state["def_of"]].astype(jnp.int32),
+    )
+    return (
+        live & (op == K_SCOPE) & (phase == PHASE_WAIT)
+        & (occ[inst, jnp.maximum(elem, 0)] == 0)
+        & (pend[inst, jnp.maximum(elem, 0)] == 0)
+    )
+
+
+# ---------------------------------------------------------------------------
 # the step kernel
 
 
@@ -272,13 +310,26 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     # --- what does each token do this step? ------------------------------
     is_task = op == K_TASK
     is_wait = is_task | (op == K_CATCH)  # parks until the host resumes it
+    is_scope = op == K_SCOPE  # parks until its inner tokens drain
     executing = live & (phase == PHASE_AT) & ~stalled
     arriving_task = executing & is_wait
-    pass_attempt = executing & ~is_wait
+    arriving_scope = executing & is_scope
+    pass_attempt = executing & ~is_wait & ~is_scope
     if auto_jobs:
         waiting_done = live & is_wait & (phase == PHASE_WAIT)
     else:
         waiting_done = live & is_wait & (phase == PHASE_DONE)
+
+    # --- scope drain detection --------------------------------------------
+    # a parked scope token resumes when no live token and no unconsumed
+    # parallel-join arrival remains anywhere inside it (reference: scope
+    # completion requires activeChildren == 0 and activeFlows == 0); both
+    # counts are start-of-step, so a resume lands one step after the last
+    # inner token dies — quiesced states stay fixed points
+    if config.has_scopes:
+        scope_resume = _scope_drained(tables, state)
+    else:
+        scope_resume = jnp.zeros(T, jnp.bool_)
 
     # --- exclusive gateway condition evaluation ---------------------------
     out_count = tables.out_count[def_of_tok, jnp.maximum(elem, 0)]
@@ -315,7 +366,7 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
 
     # no-match raises an incident: the token stalls instead of completing
     full_pass = pass_attempt & ~excl_no_match
-    completing = full_pass | waiting_done  # completes & moves this step
+    completing = full_pass | waiting_done | scope_resume  # completes & moves
 
     take_mask = jnp.where(
         is_excl[:, None],
@@ -330,12 +381,24 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     flows_taken = take_mask.sum()
     per_token = (
         jnp.where(full_pass, 4, 0)
-        + jnp.where(arriving_task, 2, 0)
-        + jnp.where(waiting_done, 2, 0)
+        + jnp.where(arriving_task | arriving_scope, 2, 0)
+        + jnp.where(waiting_done | scope_resume, 2, 0)
     )
 
     # --- movement: flatten taken flows into placement requests ------------
-    req_target = jnp.where(take_mask, targets, -1).reshape(-1)  # [T*FO]
+    req_target_2d = jnp.where(take_mask, targets, -1)
+    if config.has_scopes:
+        # an arriving scope spawns its inner start token; the request rides
+        # the (unused) flow slot 0 of the arriving token, so placement/dest
+        # machinery needs no extra channel — take_mask stays false there
+        # (no SEQUENCE_FLOW_TAKEN), and dest[:, 0] records the child slot
+        spawn_target = jnp.where(
+            arriving_scope,
+            tables.scope_start[def_of_tok, jnp.maximum(elem, 0)],
+            req_target_2d[:, 0],
+        )
+        req_target_2d = req_target_2d.at[:, 0].set(spawn_target)
+    req_target = req_target_2d.reshape(-1)  # [T*FO]
     req_inst = jnp.repeat(inst, FO)
     req_def = jnp.repeat(def_of_tok, FO)
     req_live = req_target >= 0
@@ -391,7 +454,7 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     new_elem = elem_after_exec.at[dest].set(req_target, mode="drop")
     new_inst = inst.at[dest].set(req_inst, mode="drop")
 
-    new_phase = jnp.where(arriving_task, PHASE_WAIT, phase)
+    new_phase = jnp.where(arriving_task | arriving_scope, PHASE_WAIT, phase)
     new_phase = jnp.where(excl_no_match, PHASE_STALLED, new_phase)
     new_phase = new_phase.at[dest].set(PHASE_AT, mode="drop")
 
@@ -435,8 +498,10 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     if emit_events:
         events = {
             "full_pass": full_pass,
-            "task_arrive": arriving_task,
-            "task_done": waiting_done,
+            # scope arrivals/resumes share the task bits: the host decoder
+            # disambiguates by the element's kernel opcode (K_SCOPE)
+            "task_arrive": arriving_task | arriving_scope,
+            "task_done": waiting_done | scope_resume,
             "elem": elem,
             "inst": inst,
             "take_mask": take_mask,
@@ -516,15 +581,25 @@ def run_collect(tables: DeviceTables, state: dict, n_steps: int = 16, config=Non
     and decodes with unpack_events. Per step, row 0's col 3 holds the
     post-step active-token count — the host checks the last step's value to
     decide whether another chunk is needed."""
+    from zeebe_tpu.ops.tables import KernelConfig
+
+    if config is None:
+        config = KernelConfig()  # must mirror step()'s default resolution
     I = state["def_of"].shape[0]
     T = state["elem"].shape[0]
 
     def body(state, _):
         state, ev = step(tables, state, auto_jobs=False, emit_events=True, config=config)
-        ev["active"] = (
+        active = (
             (state["elem"] >= 0)
             & ((state["phase"] == PHASE_AT) | (state["phase"] == PHASE_DONE))
         ).sum()
+        if config.has_scopes:
+            # a parked scope whose inside just drained resumes next step —
+            # it must count as active or the chunk loop would truncate the
+            # decode right before the scope's completion events
+            active = active + _scope_drained(tables, state).sum()
+        ev["active"] = active
         packed = _pack_events(ev, I, T)
         # row 1 / col 3 is unused — carry the overflow flag so the host needs
         # exactly one device fetch per chunk

@@ -181,6 +181,7 @@ K_FORK = 4  # parallel gateway, fan-out
 K_JOIN = 5  # parallel gateway, fan-in (in_count > 1)
 K_END = 6  # end event: token dies, instance may complete
 K_CATCH = 7  # intermediate catch (timer/message): wait for host trigger/correlation
+K_SCOPE = 8  # embedded sub-process: spawn inner token, park until scope drains
 
 _KERNEL_OP = {
     BpmnElementType.START_EVENT: K_PASS,
@@ -215,6 +216,9 @@ class ProcessTables:
     default_slot: np.ndarray  # [D, E] int32 (slot in out_* arrays, -1 none)
     start_elem: np.ndarray  # [D] int32
     elem_count: np.ndarray  # [D] int32
+    # embedded sub-process scopes
+    scope_start: np.ndarray  # [D, E] int32 (inner none-start of a K_SCOPE, -1)
+    in_scope: np.ndarray  # [D, E, E] int8: [d, e, s] = e strictly inside scope s
     # condition programs
     cond_ops: np.ndarray  # [C, P] int32
     cond_args: np.ndarray  # [C, P] float32
@@ -241,17 +245,19 @@ class ProcessTables:
         return KernelConfig(
             has_joins=bool((self.kernel_op == 5).any()),  # K_JOIN
             has_conditions=bool((self.out_cond >= 0).any()),
+            has_scopes=bool((self.kernel_op == 8).any()),  # K_SCOPE
         )
 
 
 @dataclasses.dataclass(frozen=True)
 class KernelConfig:
     """Static (hashable) workload traits; lets XLA drop unused machinery —
-    join ranking sorts and the condition VM cost real time when the deployed
-    process set never exercises them."""
+    join ranking sorts, the condition VM, and the scope-occupancy reduction
+    cost real time when the deployed process set never exercises them."""
 
     has_joins: bool = True
     has_conditions: bool = True
+    has_scopes: bool = True
 
 
 def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = None) -> ProcessTables:
@@ -280,16 +286,25 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
     default_slot = np.full((D, E), -1, np.int32)
     start_elem = np.zeros(D, np.int32)
     elem_count = np.zeros(D, np.int32)
+    scope_start = np.full((D, E), -1, np.int32)
+    in_scope = np.zeros((D, E, E), np.int8)
 
     for d, exe in enumerate(processes):
         elem_count[d] = len(exe.elements)
         start_elem[d] = exe.none_start_of(0)
         for el in exe.elements[1:]:
-            if el.parent_idx != 0:
-                raise ConditionNotCompilable(
-                    "device tables support flat processes (sub-process scopes "
-                    "run on the host path for now)"
-                )
+            # scope chains of embedded sub-processes are supported (K_SCOPE);
+            # any other container (event sub-process, multi-instance body)
+            # keeps the definition on the host path
+            anc = el.parent_idx
+            while anc != 0:
+                parent = exe.elements[anc]
+                if parent.element_type != BpmnElementType.SUB_PROCESS:
+                    raise ConditionNotCompilable(
+                        f"element inside {parent.element_type.name} scope"
+                    )
+                in_scope[d, el.idx, anc] = 1
+                anc = parent.parent_idx
             if getattr(el, "form_id", None) is not None:
                 # form resolution reads FormState at activation time (the
                 # formKey header depends on the latest deployed form) — host
@@ -299,8 +314,20 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                 or el.message_name is not None
             ):
                 # waits like a task; the host resumes it on TIMER TRIGGER /
-                # message correlation instead of job completion
+                # message correlation instead of host completion
                 op = K_CATCH
+            elif el.element_type == BpmnElementType.BOUNDARY_EVENT:
+                # boundary events never receive device tokens spontaneously —
+                # triggers route through the sequential path (route_trigger),
+                # which terminates/continues via internal commands. The element
+                # only needs a valid opcode so definitions carrying boundaries
+                # still lower to tables (the host path takes over on fire).
+                op = K_PASS
+            elif el.element_type == BpmnElementType.SUB_PROCESS:
+                if el.child_start_idx < 0:
+                    raise ConditionNotCompilable("sub-process without none start")
+                op = K_SCOPE
+                scope_start[d, el.idx] = el.child_start_idx
             else:
                 op = _KERNEL_OP.get(el.element_type)
             if op is None:
@@ -357,6 +384,8 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
         default_slot=default_slot,
         start_elem=start_elem,
         elem_count=elem_count,
+        scope_start=scope_start,
+        in_scope=in_scope,
         cond_ops=cond_ops,
         cond_args=cond_args,
         slot_map=slots,
