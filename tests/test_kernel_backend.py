@@ -260,24 +260,24 @@ class TestKernelActuallyUsed:
             h.close()
 
     def test_host_only_definition_falls_back(self):
-        # multi-instance bodies need data-dependent fan-out over a host-side
-        # collection — not lowerable to the device tables, so every command
-        # takes the sequential path
+        # a process with only a timer start has no none start event for the
+        # kernel's creation path to enter through — every instance is created
+        # with an explicit start element and runs sequentially
         model = (
-            Bpmn.create_executable_process("mi_proc")
-            .start_event("s")
-            .service_task("t", job_type="mi_work")
-            .multi_instance(input_collection="= items", input_element="item")
+            Bpmn.create_executable_process("tstart")
+            .timer_start_event("ts", cycle="R1/PT1S")
+            .service_task("t", job_type="ts_work")
             .end_event("e")
             .done()
         )
         h = EngineHarness(use_kernel_backend=True)
         try:
             h.deploy(model)
-            key = h.create_instance("mi_proc", {"items": [1, 2]})
-            for job in h.activate_jobs("mi_work", max_jobs=10):
+            h.advance_time(1_500)  # timer fires; instance starts sequentially
+            jobs = h.activate_jobs("ts_work", max_jobs=10)
+            assert jobs, "timer-start instance did not run"
+            for job in jobs:
                 h.complete_job(job["key"])
-            assert h.is_instance_done(key)
             assert h.kernel_backend.commands_processed == 0
         finally:
             h.close()
@@ -745,5 +745,280 @@ class TestSubProcessScopes:
             assert drive_jobs(h, "inner_work") == 1
             assert drive_jobs(h, "after_work") == 1
             assert h.kernel_backend.commands_processed >= 2
+        finally:
+            h.close()
+
+
+def created_incidents(h):
+    """(key, value) of every INCIDENT CREATED record on the log."""
+    from zeebe_tpu.protocol import ValueType
+    from zeebe_tpu.protocol.intent import IncidentIntent
+
+    out = []
+    for logged in h.stream.new_reader(1):
+        rec = logged.record
+        if rec.value_type == ValueType.INCIDENT and rec.intent == IncidentIntent.CREATED:
+            out.append((rec.key, dict(rec.value)))
+    return out
+
+
+class TestIncidentResolutionBridge:
+    """Incidents raised on the kernel path (CONDITION_ERROR at a no-match
+    gateway) resolve through the normal sequential RESOLVE processor, and the
+    instance continues — on the kernel again once re-admissible (VERDICT:
+    host resolution bridge for stalled device tokens)."""
+
+    def test_resolve_after_kernel_no_match_parity(self):
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("stall")
+                .start_event("s")
+                .service_task("first", job_type="first_work")
+                .exclusive_gateway("gw")
+                .condition_expression("x > 10")
+                .service_task("big", job_type="big_work")
+                .end_event("e1")
+                .done()  # no default flow: x <= 10 raises CONDITION_ERROR
+            )
+            h.create_instance("stall", {"x": 1}, request_id=1)
+            drive_jobs(h, "first_work")  # completes; gateway stalls
+            incidents = created_incidents(h)
+            assert len(incidents) == 1, incidents
+            h.set_variables(incidents[0][1]["variableScopeKey"], {"x": 42})
+            h.resolve_incident(incidents[0][0])
+            drive_jobs(h, "big_work")
+
+        assert_equivalent(scenario)
+
+    def test_instance_rides_kernel_again_after_resolution(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(
+                Bpmn.create_executable_process("stall2")
+                .start_event("s")
+                .exclusive_gateway("gw")
+                .condition_expression("x > 10")
+                .service_task("big", job_type="big_work2")
+                .end_event("e1")
+                .done()
+            )
+            key = h.create_instance("stall2", {"x": 1}, request_id=1)
+            incidents = created_incidents(h)
+            assert len(incidents) == 1
+            h.set_variables(incidents[0][1]["variableScopeKey"], {"x": 42})
+            h.resolve_incident(incidents[0][0])
+            before = h.kernel_backend.commands_processed
+            assert drive_jobs(h, "big_work2") == 1
+            assert h.kernel_backend.commands_processed > before, (
+                "post-resolution job completion should re-admit to the kernel"
+            )
+            assert h.is_instance_done(key)
+        finally:
+            h.close()
+
+
+def ebg_process(pid="ebg"):
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .service_task("first", job_type="first_work")
+        .event_based_gateway("evgw")
+        .intermediate_catch_timer("t_path", duration="PT5S")
+        .service_task("late", job_type="late_work")
+        .end_event("e1")
+        .move_to_element("evgw")
+        .intermediate_catch_message("m_path", "go", correlation_key="= key")
+        .service_task("fast", job_type="fast_work")
+        .end_event("e2")
+        .done()
+    )
+
+
+class TestEventBasedGateway:
+    """Event-based gateways park on the kernel like catch events; the first
+    trigger routes sequentially (COMPLETE_ELEMENT with triggeredElementId)
+    and the chosen branch continues (reference: EventBasedGatewayProcessor)."""
+
+    def test_ebg_timer_wins_parity(self):
+        def scenario(h):
+            h.deploy(ebg_process())
+            h.create_instance("ebg", {"key": "k1"}, request_id=1)
+            drive_jobs(h, "first_work")
+            h.advance_time(6_000)
+            drive_jobs(h, "late_work")
+
+        assert_equivalent(scenario)
+
+    def test_ebg_message_wins_parity(self):
+        def scenario(h):
+            h.deploy(ebg_process("ebg2"))
+            h.create_instance("ebg2", {"key": "k2"}, request_id=1)
+            drive_jobs(h, "first_work")
+            h.publish_message("go", "k2")
+            drive_jobs(h, "fast_work")
+
+        assert_equivalent(scenario)
+
+    def test_ebg_definitions_ride_the_kernel(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(ebg_process("kebg"))
+            h.create_instance("kebg", {"key": "k"}, request_id=1)
+            with h.db.transaction():
+                meta = h.engine.state.processes.get_latest_by_id("kebg")
+            info = h.kernel_backend.registry.lookup(
+                meta["processDefinitionKey"], None)
+            assert info is not None, "EBG process must be kernel-eligible"
+            before = h.kernel_backend.commands_processed
+            assert drive_jobs(h, "first_work") == 1  # arrives AT the gateway
+            assert h.kernel_backend.commands_processed > before
+            h.publish_message("go", "k")
+            drive_jobs(h, "fast_work")
+        finally:
+            h.close()
+
+
+def mi_after_task(pid="mip"):
+    """Device task → multi-instance task (host escape) → device task."""
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .service_task("prep", job_type="prep_work")
+        .service_task("each", job_type="each_work")
+        .multi_instance(input_collection="= items", input_element="item")
+        .service_task("after", job_type="after_mi_work")
+        .end_event("e")
+        .done()
+    )
+
+
+def fork_mi_and_task(pid="fmi"):
+    """Parallel fork: one branch multi-instance (escape), one pure device —
+    the FIFO interleave of escape cascades vs device commands."""
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .parallel_gateway("fork")
+        .service_task("mi_t", job_type="mi_work")
+        .multi_instance(input_collection="= items", input_element="item")
+        .parallel_gateway("join")
+        .end_event("e")
+        .move_to_element("fork")
+        .service_task("dev1", job_type="dev_work")
+        .service_task("dev2", job_type="dev2_work")
+        .connect_to("join")
+        .done()
+    )
+
+
+class TestHostEscape:
+    """Elements outside the device subset (multi-instance here) lower to
+    K_HOST: the device parks any token reaching them and the materializer
+    hands the ACTIVATE to the sequential engine at the exact FIFO position
+    of the sequential batch loop — the definition still rides the kernel
+    for everything else."""
+
+    def test_mi_between_device_tasks_parity(self):
+        def scenario(h):
+            h.deploy(mi_after_task())
+            h.create_instance("mip", {"items": [1, 2, 3]}, request_id=1)
+            drive_jobs(h, "prep_work")
+            drive_jobs(h, "each_work")
+            drive_jobs(h, "after_mi_work")
+
+        assert_equivalent(scenario)
+
+    def test_mi_empty_collection_parity(self):
+        def scenario(h):
+            h.deploy(mi_after_task("mie"))
+            h.create_instance("mie", {"items": []}, request_id=1)
+            drive_jobs(h, "prep_work")
+            drive_jobs(h, "after_mi_work")
+
+        assert_equivalent(scenario)
+
+    def test_fork_mi_vs_device_branch_parity(self):
+        def scenario(h):
+            h.deploy(fork_mi_and_task())
+            h.create_instance("fmi", {"items": ["a", "b"]}, request_id=1)
+            drive_jobs(h, "dev_work")
+            drive_jobs(h, "mi_work")
+            drive_jobs(h, "dev2_work")
+            drive_jobs(h, "mi_work")
+
+        assert_equivalent(scenario)
+
+    def test_escape_definition_rides_kernel(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(mi_after_task("kmi"))
+            h.create_instance("kmi", {"items": [1]}, request_id=1)
+            with h.db.transaction():
+                meta = h.engine.state.processes.get_latest_by_id("kmi")
+            info = h.kernel_backend.registry.lookup(
+                meta["processDefinitionKey"], None)
+            assert info is not None, "MI-carrying process must ride the kernel"
+            assert info.host_idxs, "the MI element must be host-escaped"
+            assert drive_jobs(h, "prep_work") == 1
+            assert drive_jobs(h, "each_work") == 1
+            assert drive_jobs(h, "after_mi_work") == 1
+            assert h.kernel_backend.commands_processed > 0
+        finally:
+            h.close()
+
+
+class TestHostEscapedStarts:
+    """A host-escaped entry element (none start with io mappings, or a
+    sub-process inner start) must leave its ACTIVATE unprocessed so the
+    sequential engine runs it — not hang as a silently-parked token."""
+
+    def test_escaped_none_start_parity(self):
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("esc_start")
+                .start_event("s")
+                .zeebe_input("= 41", "seed")
+                .service_task("t", job_type="esc_work")
+                .end_event("e")
+                .done()
+            )
+            h.create_instance("esc_start", request_id=1)
+            drive_jobs(h, "esc_work")
+
+        assert_equivalent(scenario)
+
+    def test_escaped_inner_start_parity(self):
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("esc_inner")
+                .start_event("s")
+                .sub_process("sub")
+                .start_event("is_")
+                .zeebe_input("= 1", "inner_seed")
+                .service_task("t", job_type="inner_esc_work")
+                .end_event("ie")
+                .sub_process_done()
+                .end_event("e")
+                .done()
+            )
+            h.create_instance("esc_inner", request_id=1)
+            drive_jobs(h, "inner_esc_work")
+
+        assert_equivalent(scenario)
+
+    def test_escaped_start_instance_completes(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(
+                Bpmn.create_executable_process("esc2")
+                .start_event("s")
+                .zeebe_input("= 5", "seed")
+                .service_task("t", job_type="esc2_work")
+                .end_event("e")
+                .done()
+            )
+            key = h.create_instance("esc2", request_id=1)
+            assert drive_jobs(h, "esc2_work") == 1
+            assert h.is_instance_done(key)
         finally:
             h.close()

@@ -76,9 +76,30 @@ class _Gen:
             return self.block(b, depth + 1)
         if roll < 0.66:
             return self.subprocess(b, depth)
+        if roll < 0.73:
+            return self.event_gateway(b, depth)
         if roll < 0.85:
             return self.exclusive(b, depth)
         return self.parallel(b, depth)
+
+    def event_gateway(self, b, depth: int):
+        """Event-based gateway racing a timer against a message; branches
+        merge so the enclosing block can continue."""
+        gw = self.next_id("evg")
+        merge = self.next_id("evm")
+        self.has_timers = True
+        name = f"msg_{self.next_id('em')}"
+        self.messages.add(name)
+        b = b.event_based_gateway(gw)
+        b = b.intermediate_catch_timer(self.next_id("et"), duration="PT5S")
+        b = self.block(b, depth + 1)
+        b = b.exclusive_gateway(merge)
+        b = b.move_to_element(gw)
+        b = b.intermediate_catch_message(self.next_id("ec"), name,
+                                         correlation_key="mkey")
+        b = self.block(b, depth + 1)
+        b = b.connect_to(merge)
+        return b.move_to_element(merge)
 
     def subprocess(self, b, depth: int):
         sid = self.next_id("sub")
@@ -104,7 +125,14 @@ class _Gen:
         self.job_types_used.add(job_type)
         tid = self.next_id("task")
         b = b.service_task(tid, job_type=job_type)
-        if self.rng.random() < 0.22:
+        roll = self.rng.random()
+        if roll < 0.12:
+            # multi-instance tasks host-escape (K_HOST): the device parks at
+            # them and the sequential engine fans out over `items`
+            b = b.multi_instance(input_collection="= items",
+                                 input_element="item",
+                                 sequential=self.rng.random() < 0.4)
+        elif roll < 0.34:
             b = self.boundary(b, tid)
         return b
 
@@ -181,8 +209,13 @@ def _random_vars(rng: random.Random, constant: bool = False) -> dict:
         # identical variables per instance → burst-template fingerprints
         # collide → the production fast path actually serves (see _run_one);
         # a constant string keeps string-condition graphs kernel-admissible
-        return {"x": 7, "y": 3, "z": 11, "status": "active"}
+        return {"x": 7, "y": 3, "z": 11, "status": "active", "items": [1, 2]}
     variables = {name: rng.randint(0, 20) for name in VAR_NAMES if rng.random() < 0.8}
+    # multi-instance input collection (host-escaped elements); sometimes a
+    # non-list to exercise the EXTRACT_VALUE_ERROR incident path
+    variables["items"] = (
+        list(range(rng.randint(0, 3))) if rng.random() < 0.9 else 7
+    )
     roll = rng.random()
     if roll < 0.7:
         variables["status"] = rng.choice(_Gen.STR_VALUES + ("unseen-value",))
@@ -216,13 +249,15 @@ def _drive(h: EngineHarness, gen: "_Gen", model, rng: random.Random,
                     variables[VAR_NAMES[job["key"] % len(VAR_NAMES)]] = job["key"] % 23
                 h.complete_job(job["key"], variables or None)
                 worked += 1
-        if gen.has_timers:
-            h.advance_time(6_000)
+        # publish before advancing time so message-vs-timer races (event-based
+        # gateways) can go either way instead of the timer always winning
         for name in sorted(gen.messages):
             for i in range(instances):
                 # message_id dedupes republication across drive rounds
                 h.publish_message(name, f"ck{i}", message_id=f"{name}-ck{i}",
                                   request_id=13)
+        if gen.has_timers:
+            h.advance_time(6_000)
         # timers/messages may unlock work only on the NEXT round — stop after
         # two consecutive rounds with nothing to do
         idle_rounds = idle_rounds + 1 if worked == 0 else 0

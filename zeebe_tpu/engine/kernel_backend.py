@@ -43,6 +43,7 @@ from zeebe_tpu.ops.tables import (
     _KERNEL_OP,
     ConditionNotCompilable,
     K_CATCH,
+    K_HOST,
     K_JOIN,
     K_SCOPE,
     K_TASK,
@@ -102,15 +103,34 @@ def check_element_eligibility(exe: ExecutableProcess, el: ExecutableElement) -> 
         # error boundaries carry no wait state at all (the job THROW_ERROR
         # command routes through _find_catcher on the host)
         return el.event_type == BpmnEventType.ERROR
-    if el.boundary_idxs and _KERNEL_OP.get(el.element_type) != K_TASK:
+    if el.boundary_idxs:
         # boundary wait-state reconstruction is implemented for parked
-        # job-worker tasks only
-        return False
+        # job-worker tasks only, and every attached boundary must itself be
+        # collectable (an escaped signal boundary would open a subscription
+        # the reconstruction doesn't count — so the host task escapes too)
+        if _KERNEL_OP.get(el.element_type) != K_TASK:
+            return False
+        if not all(check_element_eligibility(exe, exe.elements[b])
+                   for b in el.boundary_idxs):
+            return False
     if el.element_type == BpmnElementType.SUB_PROCESS:
         # embedded sub-process with a none start rides the kernel (K_SCOPE);
         # attached boundaries or event sub-processes would need host-side
         # trigger state the scope reconstruction does not collect yet
         return el.child_start_idx >= 0 and not exe.event_sub_processes_of(el.idx)
+    if el.element_type == BpmnElementType.EVENT_BASED_GATEWAY:
+        # parks on device like a catch; every succeeding catch must hold a
+        # wait state the reconstruction counts (fixed-duration timer or
+        # message) — an escaped target (e.g. signal) would open uncounted
+        # state, so the gateway escapes with it
+        for fidx in el.outgoing:
+            target = exe.elements[exe.flows[fidx].target_idx]
+            if target.timer_duration is not None:
+                if target.timer_cycle or target.timer_date is not None:
+                    return False
+            elif target.message_name is None:
+                return False
+        return bool(el.outgoing)
     if el.element_type == BpmnElementType.INTERMEDIATE_CATCH_EVENT:
         # timer (fixed duration) and message catches park on device (K_CATCH)
         # and are resumed by the host's TRIGGER / CORRELATE commands; duration
@@ -148,7 +168,6 @@ class _DefInfo:
     index: int
     key: int
     exe: ExecutableProcess
-    cond_var_names: frozenset[str]
     job_types: dict[int, str]  # element idx → static job type
     job_retries: dict[int, int]
     join_idxs: list[int]  # element idxs of K_JOIN gateways
@@ -156,6 +175,9 @@ class _DefInfo:
     # task element idx → (# timer boundaries, # message boundaries) expected
     # open while the task is parked (reconstruction integrity check)
     boundary_waits: dict[int, tuple[int, int]]
+    # element idxs lowered to K_HOST in the solo compile (forced again in
+    # shared recompiles so the lowering stays stable across registrations)
+    host_idxs: frozenset[int] = frozenset()
 
 
 class KernelRegistry:
@@ -179,11 +201,19 @@ class KernelRegistry:
             return None
         if len(self._infos) >= self.max_definitions:
             return None
-        if not all(check_element_eligibility(exe, el) for el in exe.elements[1:]):
+        # elements outside the device subset become host escapes (K_HOST):
+        # the device parks any token reaching them and the materializer hands
+        # the continuation to the sequential engine — so the definition rides
+        # the kernel for everything else instead of being rejected outright
+        host = {el.idx for el in exe.elements[1:]
+                if not check_element_eligibility(exe, el)}
+        if exe.none_start_of(0) < 0:
+            # only message/timer starts: every creation carries an explicit
+            # start element — nothing for the kernel's entry path to run
             self._ineligible.add(definition_key)
             return None
         try:
-            solo = compile_tables([exe])
+            solo = compile_tables([exe], host_idxs=[host])
         except ConditionNotCompilable:
             self._ineligible.add(definition_key)
             return None
@@ -199,6 +229,10 @@ class KernelRegistry:
                 )
             if solo.kernel_op[0, el.idx] == K_JOIN:
                 join_idxs.append(el.idx)
+        effective_host = frozenset(
+            el.idx for el in exe.elements[1:]
+            if solo.kernel_op[0, el.idx] == K_HOST
+        )
         boundary_waits: dict[int, tuple[int, int]] = {}
         for el in exe.elements[1:]:
             if solo.kernel_op[0, el.idx] == K_TASK and el.boundary_idxs:
@@ -207,31 +241,41 @@ class KernelRegistry:
                     sum(1 for b in bs if b.timer_duration is not None),
                     sum(1 for b in bs if b.message_name is not None),
                 )
+            elif (el.element_type == BpmnElementType.EVENT_BASED_GATEWAY
+                  and el.idx not in effective_host):
+                # an event-based gateway's wait states live on its own
+                # instance, one per succeeding catch event
+                ts = [exe.elements[exe.flows[f].target_idx] for f in el.outgoing]
+                boundary_waits[el.idx] = (
+                    sum(1 for t in ts if t.timer_duration is not None),
+                    sum(1 for t in ts if t.message_name is not None),
+                )
         timer_idxs = frozenset(
             el.idx for el in exe.elements[1:]
-            if (solo.kernel_op[0, el.idx] == K_CATCH and el.timer_duration is not None)
+            if (solo.kernel_op[0, el.idx] == K_CATCH
+                and el.element_type != BpmnElementType.EVENT_BASED_GATEWAY
+                and el.timer_duration is not None)
             or boundary_waits.get(el.idx, (0, 0))[0] > 0
         )
         info = _DefInfo(
             index=len(self._infos),
             key=definition_key,
             exe=exe,
-            cond_var_names=frozenset(solo.slot_map.names),
             job_types=job_types,
             job_retries=job_retries,
             join_idxs=join_idxs,
             timer_idxs=timer_idxs,
             boundary_waits=boundary_waits,
+            host_idxs=effective_host,
         )
         self._infos.append(info)
         self._by_key[definition_key] = info
         # recompile the SHARED set eagerly: definitions that solo-compile can
         # still conflict jointly (e.g. one uses a variable numerically, the
-        # other in string comparisons — SlotMap kind clash). Registering a
-        # definition that poisons the shared compile must reject IT, not
-        # disable the kernel path for the whole partition.
+        # other in string comparisons — SlotMap kind clash downgrades the
+        # offending gateway to a host escape in the shared lowering).
         try:
-            self._tables = compile_tables([i.exe for i in self._infos])
+            self._tables = self._compile_shared()
         except ConditionNotCompilable:
             self._infos.pop()
             del self._by_key[definition_key]
@@ -241,10 +285,16 @@ class KernelRegistry:
         self._device = None
         return info
 
+    def _compile_shared(self) -> ProcessTables:
+        return compile_tables(
+            [i.exe for i in self._infos],
+            host_idxs=[set(i.host_idxs) for i in self._infos],
+        )
+
     @property
     def tables(self) -> ProcessTables:
         if self._tables is None:
-            self._tables = compile_tables([i.exe for i in self._infos])
+            self._tables = self._compile_shared()
         return self._tables
 
     @property
@@ -263,6 +313,10 @@ class _Token:
     key: int  # element instance key (-1 until minted at materialization)
     value: dict  # the record value the ACTIVATE command carried
     phase: int = _PHASE_AT
+    # follow-up index of this token's ACTIVATE command in the burst being
+    # materialized (-1 = predates the burst); host-escape cascades appended
+    # before it must drain before this token's processing emits (FIFO)
+    act_idx: int = -1
 
 
 @dataclass
@@ -298,12 +352,16 @@ class KernelBackend:
 
     def __init__(self, engine, max_group: int = 256, max_steps: int = 4096,
                  chunk_steps: int = 8, use_templates: bool = True,
-                 audit_templates: bool = False) -> None:
+                 audit_templates: bool = False,
+                 max_commands_in_batch: int = 100) -> None:
         self.engine = engine
         self.registry = KernelRegistry()
         self.max_group = max_group
         self.max_steps = max_steps
         self.chunk_steps = chunk_steps
+        # must match the stream processor's batch budget: the host-escape
+        # drain accounts commands exactly like the sequential batch loop
+        self.max_commands_in_batch = max_commands_in_batch
         # burst templates (engine/burst_templates.py): replay a command's
         # whole record burst by patching a captured byte template. audit mode
         # (tests) shadows every template hit with the slow path and asserts
@@ -346,6 +404,11 @@ class KernelBackend:
         state = self.engine.state
         value = cmd.record.value
         if value.get("startInstructions"):
+            return None
+        if value.get("startElementId"):
+            # message/timer-start creations activate an explicit start element
+            # — the kernel's creation materializer always enters through the
+            # none start, so these stay sequential
             return None
         from zeebe_tpu.protocol import DEFAULT_TENANT
 
@@ -424,22 +487,21 @@ class KernelBackend:
             elif op == K_TASK:
                 if child.get("jobKey", -1) < 0:
                     return None
-                n_timer_b, n_msg_b = info.boundary_waits.get(el.idx, (0, 0))
-                if n_timer_b or n_msg_b:
-                    # every boundary subscription must be intact: a missing
-                    # timer/sub means a trigger is mid-flight (its internal
-                    # TERMINATE/ACTIVATE commands own this instance now) —
-                    # decline so the sequential path resolves the race
-                    timers = state.timers.timers_for_element_instance(child_key)
-                    subs = state.process_message_subscriptions.subscriptions_of(
-                        child_key
-                    )
-                    if len(timers) != n_timer_b or len(subs) != n_msg_b:
-                        return None
-                    wait_docs.extend(dict(t) for _k, t in timers)
-                    wait_docs.extend(dict(s) for s in subs)
+                # boundary subscriptions must be intact: a missing timer/sub
+                # means a trigger is mid-flight (its internal TERMINATE/
+                # ACTIVATE commands own this instance now) — decline so the
+                # sequential path resolves the race
+                if not self._collect_wait_states(info, el.idx, child_key, wait_docs):
+                    return None
             elif op == K_CATCH:
-                if el.timer_duration is not None:
+                if el.element_type == BpmnElementType.EVENT_BASED_GATEWAY:
+                    # every succeeding catch must have its wait state open on
+                    # the gateway instance; anything less means a trigger is
+                    # mid-flight (its COMPLETE_ELEMENT owns this instance)
+                    if not self._collect_wait_states(info, el.idx, child_key,
+                                                     wait_docs):
+                        return None
+                elif el.timer_duration is not None:
                     timers = state.timers.timers_for_element_instance(child_key)
                     if not timers:
                         return None  # incident-parked or already fired
@@ -476,6 +538,24 @@ class KernelBackend:
                 continue
             return None
         return tokens, resume, root, wait_docs, scope_keys, join_counts
+
+    def _collect_wait_states(self, info: _DefInfo, el_idx: int, child_key: int,
+                             wait_docs: list) -> bool:
+        """Verify the expected wait states (boundary subscriptions of a task,
+        or an event-based gateway's per-target subscriptions) are all open on
+        ``child_key``, appending their records to ``wait_docs``. False means
+        a trigger is mid-flight and the instance is not reconstructable."""
+        expected_timers, expected_subs = info.boundary_waits.get(el_idx, (0, 0))
+        if not (expected_timers or expected_subs):
+            return True
+        state = self.engine.state
+        timers = state.timers.timers_for_element_instance(child_key)
+        subs = state.process_message_subscriptions.subscriptions_of(child_key)
+        if len(timers) != expected_timers or len(subs) != expected_subs:
+            return False
+        wait_docs.extend(dict(t) for _k, t in timers)
+        wait_docs.extend(dict(s) for s in subs)
+        return True
 
     @staticmethod
     def _inside(exe: ExecutableProcess, elem_idx: int, scope_idx: int) -> bool:
@@ -514,7 +594,11 @@ class KernelBackend:
         host FEEL semantics)."""
         tables = self.registry.tables
         slots: dict[str, float] = {}
-        for name in info.cond_var_names:
+        # variables read by THIS definition's device-compiled conditions in
+        # the SHARED lowering (a shared-set SlotMap clash may have downgraded
+        # a gateway to K_HOST — its variables then need no prefetch and must
+        # not gate admission)
+        for name in tables.cond_vars_by_def[info.index]:
             v = merged.get(name)
             if tables.slot_map.kinds.get(name) == "str":
                 if not isinstance(v, str):
@@ -854,13 +938,26 @@ class KernelBackend:
                 self._materialize_creation(wrapped, adm, ops, writers, builder)
             else:
                 self._materialize_resume(wrapped, adm, ops, writers, builder)
+            if any(f.record.is_command and not f.processed
+                   for f in builder.follow_ups):
+                self._drain_host_escapes(wrapped.position, builder)
         finally:
             if capture or (template is not None and self.audit_templates):
                 state.next_key = orig_next_key
                 txn.capture = None
         if capture:
             self.template_misses += 1
+            if any(f.record.value_type == ValueType.TIMER
+                   for f in builder.follow_ups):
+                # the host-escape drain (or an uncovered path) wrote a TIMER
+                # record: its clock-derived dueDate would replay stale from a
+                # template (under test clocks the small int slips past the
+                # unexplained-int net) — never template such a burst. The
+                # pre-trace creates_timer guard covers only device arrivals.
+                role_map = None
             for i, v in enumerate(mints):
+                if role_map is None:
+                    break
                 if v in role_map:
                     role_map = None  # role collision → not templatable
                     break
@@ -883,6 +980,51 @@ class KernelBackend:
             self.template_audits += 1
             self._audit_template(template, adm, builder, cap_log, mints)
         return builder
+
+    def _drain_host_escapes(self, source_position: int, builder,
+                            limit: int | None = None,
+                            end_idx: int | None = None) -> None:
+        """Process follow-up commands left unprocessed (flows into K_HOST
+        elements, and whatever those spawn) with the sequential engine, FIFO,
+        within the batch budget — so the flattened burst matches the
+        sequential batch loop (stream/processor.py _batch_process) byte for
+        byte: same record order, same positions, same processed flags, same
+        source position. ``limit=1`` drains exactly one command (the trace
+        interleaves it at the escaped token's arrival position);
+        ``end_idx`` drains only commands appended before that follow-up
+        index (a device token's processing must first flush escape cascades
+        that precede its ACTIVATE in the queue); the final unbounded call
+        flushes whatever remains. Commands beyond the budget stay
+        unprocessed on the log and the stream processor picks them up as
+        the next commands, exactly like a sequential batch that hit its
+        limit."""
+        from zeebe_tpu.logstreams.log_stream import LoggedRecord
+
+        budget = self.max_commands_in_batch - 1 - sum(
+            1 for f in builder.follow_ups if f.record.is_command and f.processed
+        )
+        if limit is not None:
+            budget = min(budget, limit)
+        scan = 0
+        while budget > 0:
+            follow_up = None
+            bound = len(builder.follow_ups) if end_idx is None else end_idx
+            while scan < bound:
+                entry = builder.follow_ups[scan]
+                if entry.record.is_command and not entry.processed:
+                    follow_up = entry
+                    break
+                scan += 1
+            if follow_up is None:
+                return
+            follow_up.processed = True
+            budget -= 1
+            logged = LoggedRecord(
+                record=follow_up.record, position=-1,
+                source_position=source_position, processed=True,
+            )
+            self.engine.process(logged, builder)
+            scan += 1
 
     def _store_template(self, key, template) -> None:
         cache = self._templates
@@ -1126,8 +1268,13 @@ class KernelBackend:
         tok.value = self._child_value(value, start, inst.pi_key)
         writers.append_command(tok.key, ValueType.PROCESS_INSTANCE,
                                PI.ACTIVATE_ELEMENT, tok.value)
+        if self.registry.tables.kernel_op[inst.info.index, start.idx] == K_HOST:
+            # host-escaped none start (e.g. output mappings): the device
+            # token parks silently; _materialize's post-trace drain hands the
+            # whole instance to the sequential engine
+            return
         self._mark_last_command_processed(builder)
-        self._emit_ops(inst, ops, writers, builder)
+        self._emit_ops(inst, ops, writers, builder, cmd.position)
 
     _RESUME_HEADS = {
         "j": (ValueType.JOB, int(JobIntent.COMPLETE)),
@@ -1147,7 +1294,7 @@ class KernelBackend:
         head = engine._processors[self._RESUME_HEADS[adm.kind]]
         head(cmd, writers)
         self._mark_last_command_processed(builder)  # the COMPLETE_ELEMENT cmd
-        self._emit_ops(adm.inst, ops, writers, builder)
+        self._emit_ops(adm.inst, ops, writers, builder, cmd.position)
 
     @staticmethod
     def _child_value(scope_value: dict, element: ExecutableElement, scope_key: int) -> dict:
@@ -1159,7 +1306,13 @@ class KernelBackend:
             "processInstanceKey": scope_value["processInstanceKey"],
             "elementId": element.id,
             "flowScopeKey": scope_key,
-            "bpmnElementType": element.element_type.name,
+            # an element with loop characteristics is entered through its
+            # multi-instance body wrapper (host-escaped on device)
+            "bpmnElementType": (
+                BpmnElementType.MULTI_INSTANCE_BODY.name
+                if element.multi_instance is not None
+                else element.element_type.name
+            ),
             "bpmnEventType": element.event_type.name,
         }
 
@@ -1182,6 +1335,9 @@ class KernelBackend:
           ("nomatch", l, elem)     exclusive gateway with no matching flow
           ("flow", l, elem, fo, new_l)  flow slot fo taken; new_l == -1 when
                                    no token was placed (join arrival merged)
+          ("hostarr", l, elem)     token reached a host-escaped element: the
+                                   emitter drains its ACTIVATE sequentially
+                                   at exactly this FIFO position
           ("complete",)            the process instance completed
         """
         tables = self.registry.tables
@@ -1191,14 +1347,23 @@ class KernelBackend:
         # live: [logical id, slot, elem_idx]
         live = [[l, t.slot, t.elem_idx] for l, t in enumerate(inst.tokens)]
         next_l = len(live)
+        # logical id → step index at which a host-escaped token "arrives"
+        # (the device parks it silently; the trace needs the position)
+        host_arrive: dict[int, int] = {}
         done_emitted = False
-        for ev in steps:
+        for si, ev in enumerate(steps):
             if done_emitted or not live:
                 break
             T = ev["elem"].shape[0]
             additions: list = []
             for tok in list(live):
                 l, s, e = tok
+                if l in host_arrive:
+                    if host_arrive[l] == si:
+                        ops.append(("hostarr", l, e))
+                        del host_arrive[l]
+                        live.remove(tok)
+                    continue
                 if ev["inst"][s] != inst.idx or ev["elem"][s] != e:
                     continue  # slot reused after this token died (stale entry)
                 if ev["task_arrive"][s]:
@@ -1209,10 +1374,11 @@ class KernelBackend:
                         dest = int(ev["dest"][s, 0])
                         nl = next_l
                         next_l += 1
-                        additions.append(
-                            [nl, dest, int(tables.scope_start[d, e])]
-                        )
+                        start_idx = int(tables.scope_start[d, e])
+                        additions.append([nl, dest, start_idx])
                         ops.append(("scopearr", l, e, nl))
+                        if tables.kernel_op[d, start_idx] == K_HOST:
+                            host_arrive[nl] = si + 1
                     else:
                         ops.append(("arrive", l, e))
                 elif ev["task_done"][s] or ev["full_pass"][s]:
@@ -1227,6 +1393,8 @@ class KernelBackend:
                             next_l += 1
                             additions.append([nl, dest, flow.target_idx])
                             ops.append(("flow", l, e, fo, nl))
+                            if tables.kernel_op[d, flow.target_idx] == K_HOST:
+                                host_arrive[nl] = si + 1
                         else:
                             ops.append(("flow", l, e, fo, -1))
                     live.remove(tok)
@@ -1239,7 +1407,8 @@ class KernelBackend:
                 done_emitted = True
         return ops
 
-    def _emit_ops(self, inst: _Inst, ops: list, writers, builder) -> None:
+    def _emit_ops(self, inst: _Inst, ops: list, writers, builder,
+                  source_position: int) -> None:
         """Interpret a trace, writing the instance's record burst in the
         sequential engine's FIFO follow-up order."""
         from zeebe_tpu.engine.bpmn import _pi_value
@@ -1249,16 +1418,45 @@ class KernelBackend:
         exe = inst.info.exe
         d = inst.info.index
         toks: dict[int, _Token] = dict(enumerate(inst.tokens))
+        # pure-device traces (the common case) never need the FIFO drain —
+        # skip its O(follow_ups) scans wholesale
+        has_escapes = any(o[0] == "hostarr" for o in ops)
         for op in ops:
             kind = op[0]
             if kind == "complete":
+                if has_escapes:
+                    self._drain_host_escapes(source_position, builder)
                 self._emit_process_completed(inst, writers, builder)
+                continue
+            if kind == "hostarr":
+                # the escaped element's ACTIVATE is the first unprocessed
+                # command (escapes drain in arrival order): hand it to the
+                # sequential engine at exactly this FIFO position
+                self._drain_host_escapes(source_position, builder, limit=1)
                 continue
             l, e = op[1], op[2]
             tok = toks[l]
             element = exe.elements[e]
             value = _pi_value(tok.value, element)
+            if has_escapes and kind in ("arrive", "pass", "scopearr",
+                                        "nomatch") and tok.act_idx >= 0:
+                # FIFO: escape cascades whose commands were appended before
+                # this token's ACTIVATE must emit first (the sequential batch
+                # loop would have processed them before reaching it)
+                self._drain_host_escapes(source_position, builder,
+                                         end_idx=tok.act_idx)
+            elif has_escapes and kind == "done":
+                # a mid-trace completion (scope drain) appends its COMPLETE
+                # command at the queue's end — everything pending goes first
+                self._drain_host_escapes(source_position, builder)
             if kind == "arrive":
+                if element.element_type == BpmnElementType.EVENT_BASED_GATEWAY:
+                    # delegate to the sequential activation wholesale: its
+                    # pre-validation/incident handling and subscribe-before-
+                    # ACTIVATED ordering must match record for record
+                    self.engine.bpmn._activate(tok.key, dict(tok.value), exe,
+                                               element, writers)
+                    continue
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_ACTIVATING, value)
                 if element.boundary_idxs:
@@ -1312,9 +1510,14 @@ class KernelBackend:
                 child_value = self._child_value(value, start, tok.key)
                 writers.append_command(child_key, ValueType.PROCESS_INSTANCE,
                                        PI.ACTIVATE_ELEMENT, child_value)
+                if tables.kernel_op[d, start.idx] == K_HOST:
+                    # escaped inner start: the spawned device token parks
+                    # silently; the drain owns the scope's inside from here
+                    continue
                 self._mark_last_command_processed(builder)
                 toks[op[3]] = _Token(slot=-1, elem_idx=start.idx,
-                                     key=child_key, value=child_value)
+                                     key=child_key, value=child_value,
+                                     act_idx=len(builder.follow_ups) - 1)
             elif kind == "pass":
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_ACTIVATING, value)
@@ -1347,9 +1550,15 @@ class KernelBackend:
                                                     value.get("flowScopeKey", -1))
                     writers.append_command(child_key, ValueType.PROCESS_INSTANCE,
                                            PI.ACTIVATE_ELEMENT, child_value)
+                    if tables.kernel_op[d, target.idx] == K_HOST:
+                        # host escape: leave the ACTIVATE unprocessed — the
+                        # post-trace drain hands it (and its whole follow-up
+                        # chain) to the sequential engine
+                        continue
                     self._mark_last_command_processed(builder)
                     toks[new_l] = _Token(slot=-1, elem_idx=target.idx,
-                                         key=child_key, value=child_value)
+                                         key=child_key, value=child_value,
+                                         act_idx=len(builder.follow_ups) - 1)
             elif kind == "nomatch":
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_ACTIVATING, value)

@@ -48,6 +48,7 @@ from zeebe_tpu.ops.tables import (
     K_END,
     K_EXCLUSIVE,
     K_FORK,
+    K_HOST,
     K_JOIN,
     K_NONE,
     K_PASS,
@@ -311,10 +312,12 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     is_task = op == K_TASK
     is_wait = is_task | (op == K_CATCH)  # parks until the host resumes it
     is_scope = op == K_SCOPE  # parks until its inner tokens drain
+    is_host = op == K_HOST  # parks forever: the sequential engine owns it
     executing = live & (phase == PHASE_AT) & ~stalled
     arriving_task = executing & is_wait
     arriving_scope = executing & is_scope
-    pass_attempt = executing & ~is_wait & ~is_scope
+    arriving_host = executing & is_host
+    pass_attempt = executing & ~is_wait & ~is_scope & ~is_host
     if auto_jobs:
         waiting_done = live & is_wait & (phase == PHASE_WAIT)
     else:
@@ -454,7 +457,8 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     new_elem = elem_after_exec.at[dest].set(req_target, mode="drop")
     new_inst = inst.at[dest].set(req_inst, mode="drop")
 
-    new_phase = jnp.where(arriving_task | arriving_scope, PHASE_WAIT, phase)
+    new_phase = jnp.where(arriving_task | arriving_scope | arriving_host,
+                          PHASE_WAIT, phase)
     new_phase = jnp.where(excl_no_match, PHASE_STALLED, new_phase)
     new_phase = new_phase.at[dest].set(PHASE_AT, mode="drop")
 

@@ -182,6 +182,8 @@ K_JOIN = 5  # parallel gateway, fan-in (in_count > 1)
 K_END = 6  # end event: token dies, instance may complete
 K_CATCH = 7  # intermediate catch (timer/message): wait for host trigger/correlation
 K_SCOPE = 8  # embedded sub-process: spawn inner token, park until scope drains
+K_HOST = 9  # host escape: parks forever; the sequential engine owns the element
+#            (multi-instance, call activities, script/io-mapping tasks, …)
 
 _KERNEL_OP = {
     BpmnElementType.START_EVENT: K_PASS,
@@ -222,6 +224,9 @@ class ProcessTables:
     # condition programs
     cond_ops: np.ndarray  # [C, P] int32
     cond_args: np.ndarray  # [C, P] float32
+    # per definition: variable names its DEVICE-compiled conditions read
+    # (host-escaped gateways excluded — their variables need no prefetch)
+    cond_vars_by_def: list = dataclasses.field(default_factory=list)
     # bookkeeping
     slot_map: SlotMap = dataclasses.field(default_factory=SlotMap)
     interner: StringInterner = dataclasses.field(default_factory=StringInterner)
@@ -260,10 +265,17 @@ class KernelConfig:
     has_scopes: bool = True
 
 
-def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = None) -> ProcessTables:
+def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = None,
+                   host_idxs: list[set[int]] | None = None) -> ProcessTables:
     """Compile process definitions into one shared table set. ``max_fanout``
     defaults to the actual maximum across the definitions (smaller FO keeps
-    the kernel's flattened placement arrays tight)."""
+    the kernel's flattened placement arrays tight).
+
+    ``host_idxs`` (one set of element idxs per definition) turns on the host
+    escape: listed elements — and any element that fails to lower — compile
+    to K_HOST instead of failing the whole definition. Without it, any
+    non-lowerable element raises ConditionNotCompilable (the all-device
+    contract the benchmarks and the bare-kernel tests rely on)."""
     if max_fanout is None:
         max_fanout = max(
             (len(el.outgoing) for p in processes for el in p.elements), default=1
@@ -289,81 +301,127 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
     scope_start = np.full((D, E), -1, np.int32)
     in_scope = np.zeros((D, E, E), np.int8)
 
+    cond_vars_by_def: list[set[str]] = []
     for d, exe in enumerate(processes):
         elem_count[d] = len(exe.elements)
         start_elem[d] = exe.none_start_of(0)
+        def_vars: set[str] = set()
+        cond_vars_by_def.append(def_vars)
+        host = set(host_idxs[d]) if host_idxs is not None else None
         for el in exe.elements[1:]:
-            # scope chains of embedded sub-processes are supported (K_SCOPE);
-            # any other container (event sub-process, multi-instance body)
-            # keeps the definition on the host path
-            anc = el.parent_idx
-            while anc != 0:
-                parent = exe.elements[anc]
-                if parent.element_type != BpmnElementType.SUB_PROCESS:
-                    raise ConditionNotCompilable(
-                        f"element inside {parent.element_type.name} scope"
-                    )
-                in_scope[d, el.idx, anc] = 1
-                anc = parent.parent_idx
-            if getattr(el, "form_id", None) is not None:
-                # form resolution reads FormState at activation time (the
-                # formKey header depends on the latest deployed form) — host
-                raise ConditionNotCompilable("form-linked user task")
-            if el.element_type == BpmnElementType.INTERMEDIATE_CATCH_EVENT and (
-                (el.timer_duration is not None and not el.timer_cycle and el.timer_date is None)
-                or el.message_name is not None
-            ):
-                # waits like a task; the host resumes it on TIMER TRIGGER /
-                # message correlation instead of host completion
-                op = K_CATCH
-            elif el.element_type == BpmnElementType.BOUNDARY_EVENT:
-                # boundary events never receive device tokens spontaneously —
-                # triggers route through the sequential path (route_trigger),
-                # which terminates/continues via internal commands. The element
-                # only needs a valid opcode so definitions carrying boundaries
-                # still lower to tables (the host path takes over on fire).
-                op = K_PASS
-            elif el.element_type == BpmnElementType.SUB_PROCESS:
-                if el.child_start_idx < 0:
-                    raise ConditionNotCompilable("sub-process without none start")
-                op = K_SCOPE
-                scope_start[d, el.idx] = el.child_start_idx
-            else:
-                op = _KERNEL_OP.get(el.element_type)
-            if op is None:
-                raise ConditionNotCompilable(f"element type {el.element_type.name}")
-            if el.element_type == BpmnElementType.PARALLEL_GATEWAY and el.incoming_count > 1:
-                op = K_JOIN
-            if (
-                op == K_EXCLUSIVE
-                and len(el.outgoing) == 1
-                and el.default_flow_idx < 0
-                and all(exe.flows[f].condition is None for f in el.outgoing)
-            ):
-                # a single unconditional outgoing flow routes like a pass-through
-                # (the engine's generic completion path takes it; K_EXCLUSIVE
-                # with no true condition and no default would stall instead)
-                op = K_PASS
-            kernel_op[d, el.idx] = op
+            # structural info fills unconditionally: flows INTO a host-escaped
+            # element still resolve their target through these arrays, and a
+            # parked host token's incoming count is never read
             in_count[d, el.idx] = el.incoming_count
             if len(el.outgoing) > max_fanout:
                 raise ConditionNotCompilable(f"fan-out {len(el.outgoing)} > {max_fanout}")
             out_count[d, el.idx] = len(el.outgoing)
+            for slot_i, fidx in enumerate(el.outgoing):
+                flow = exe.flows[fidx]
+                out_target[d, el.idx, slot_i] = flow.target_idx
+                out_flow_idx[d, el.idx, slot_i] = flow.idx
+            # scope chains of embedded sub-processes are supported (K_SCOPE);
+            # a chain through any other container (event sub-process) means
+            # the element is only reachable host-side
+            chain: list[int] = []
+            anc = el.parent_idx
+            chain_ok = True
+            while anc != 0:
+                parent = exe.elements[anc]
+                if parent.element_type != BpmnElementType.SUB_PROCESS:
+                    chain_ok = False
+                    break
+                chain.append(anc)
+                anc = parent.parent_idx
+            if chain_ok:
+                # committed even for host-escaped elements: a parked host
+                # token inside a device scope must block that scope's drain
+                for a in chain:
+                    in_scope[d, el.idx, a] = 1
+            try:
+                if not chain_ok:
+                    raise ConditionNotCompilable(
+                        f"element inside {exe.elements[anc].element_type.name} scope"
+                    )
+                if host is not None and el.idx in host:
+                    raise ConditionNotCompilable("host-escaped element")
+                if getattr(el, "form_id", None) is not None:
+                    # form resolution reads FormState at activation time (the
+                    # formKey header depends on the latest deployed form)
+                    raise ConditionNotCompilable("form-linked user task")
+                if el.element_type == BpmnElementType.INTERMEDIATE_CATCH_EVENT and (
+                    (el.timer_duration is not None and not el.timer_cycle
+                     and el.timer_date is None)
+                    or el.message_name is not None
+                ):
+                    # waits like a task; the host resumes it on TIMER TRIGGER /
+                    # message correlation instead of job completion
+                    op = K_CATCH
+                elif el.element_type == BpmnElementType.BOUNDARY_EVENT:
+                    # boundary events never receive device tokens spontaneously —
+                    # triggers route through the sequential path (route_trigger),
+                    # which terminates/continues via internal commands. The
+                    # element only needs a valid opcode so definitions carrying
+                    # boundaries still lower to tables.
+                    op = K_PASS
+                elif el.element_type == BpmnElementType.SUB_PROCESS:
+                    if el.child_start_idx < 0:
+                        raise ConditionNotCompilable("sub-process without none start")
+                    op = K_SCOPE
+                elif el.element_type == BpmnElementType.EVENT_BASED_GATEWAY:
+                    # parks like a catch; the first trigger routes through the
+                    # sequential path (route_trigger → COMPLETE_ELEMENT with
+                    # triggeredElementId), so the device never takes its flows
+                    op = K_CATCH
+                else:
+                    op = _KERNEL_OP.get(el.element_type)
+                if op is None:
+                    raise ConditionNotCompilable(f"element type {el.element_type.name}")
+                if el.element_type == BpmnElementType.PARALLEL_GATEWAY and el.incoming_count > 1:
+                    op = K_JOIN
+                if (
+                    op == K_EXCLUSIVE
+                    and len(el.outgoing) == 1
+                    and el.default_flow_idx < 0
+                    and all(exe.flows[f].condition is None for f in el.outgoing)
+                ):
+                    # a single unconditional outgoing flow routes like a
+                    # pass-through (the engine's generic completion path takes
+                    # it; K_EXCLUSIVE with no true condition and no default
+                    # would stall instead)
+                    op = K_PASS
+                for slot_i, fidx in enumerate(el.outgoing):
+                    flow = exe.flows[fidx]
+                    if fidx == el.default_flow_idx:
+                        default_slot[d, el.idx] = slot_i
+                    elif flow.condition is not None and op == K_EXCLUSIVE:
+                        prog = compile_condition(flow.condition.ast, slots, interner)
+                        out_cond[d, el.idx, slot_i] = len(cond_programs)
+                        cond_programs.append(prog)
+                        id_to_name = {v: k for k, v in slots.names.items()}
+                        def_vars.update(
+                            id_to_name[int(arg)] for opc, arg in prog
+                            if opc == OP_PUSH_VAR
+                        )
+            except ConditionNotCompilable:
+                if host is None:
+                    raise
+                # host escape: the device parks any token that reaches this
+                # element and the sequential engine owns it from there —
+                # the rest of the definition still rides the kernel
+                host.add(el.idx)
+                kernel_op[d, el.idx] = K_HOST
+                out_cond[d, el.idx, :] = -1
+                default_slot[d, el.idx] = -1
+                continue
+            kernel_op[d, el.idx] = op
+            if op == K_SCOPE:
+                scope_start[d, el.idx] = el.child_start_idx
             if op == K_TASK and el.job_type is not None and el.job_type.is_static:
                 name = el.job_type.source
                 if name not in job_types:
                     job_types[name] = len(job_types)
                 job_type[d, el.idx] = job_types[name]
-            for slot_i, fidx in enumerate(el.outgoing):
-                flow = exe.flows[fidx]
-                out_target[d, el.idx, slot_i] = flow.target_idx
-                out_flow_idx[d, el.idx, slot_i] = flow.idx
-                if fidx == el.default_flow_idx:
-                    default_slot[d, el.idx] = slot_i
-                elif flow.condition is not None and op == K_EXCLUSIVE:
-                    prog = compile_condition(flow.condition.ast, slots, interner)
-                    out_cond[d, el.idx, slot_i] = len(cond_programs)
-                    cond_programs.append(prog)
 
     C = max(1, len(cond_programs))
     cond_ops = np.zeros((C, MAX_PROG_LEN), np.int32)
@@ -388,6 +446,7 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
         in_scope=in_scope,
         cond_ops=cond_ops,
         cond_args=cond_args,
+        cond_vars_by_def=cond_vars_by_def,
         slot_map=slots,
         interner=interner,
         job_type_names=list(job_types),

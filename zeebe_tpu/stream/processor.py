@@ -89,6 +89,11 @@ class StreamProcessor:
         # of eligible commands ride the automaton kernel instead of the
         # per-command sequential path; everything else falls through unchanged
         self.kernel_backend = kernel_backend
+        if kernel_backend is not None:
+            # single source of truth: the backend's host-escape drain must
+            # account commands against the SAME budget as _batch_process, or
+            # the flattened bursts' processed flags diverge from sequential
+            kernel_backend.max_commands_in_batch = max_commands_in_batch
         self.response_sink = response_sink or (lambda response: None)
         # post-commit jobs-available notification (reference: the engine's
         # jobsAvailable callback → gateway long-poll wakeup / job push);
