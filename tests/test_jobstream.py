@@ -143,7 +143,9 @@ def stack():
     runtime.start()
     gateway = Gateway(runtime)
     gateway.start()
-    client = ZeebeTpuClient(gateway.address)
+    from zeebe_tpu.testing import distributing_client
+
+    client = distributing_client(ZeebeTpuClient(gateway.address), runtime)
     yield client, runtime
     client.close()
     gateway.stop()

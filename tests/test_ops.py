@@ -312,11 +312,16 @@ class TestObservabilityBreadth:
             "zeebe_job_events_total",
             "zeebe_journal_append_total",
             "zeebe_journal_flush_duration_seconds_bucket",
+            "zeebe_element_instance_events_total",
         ):
             assert family in text, f"missing metric family {family}"
         # engine counters moved: one instance activated+completed, one job
         # created+completed on partition 1
         assert 'zeebe_job_events_total{partition="1",action="created"}' in text
+        # element transitions labelled by BPMN element type (reference:
+        # ProcessEngineMetrics element_instance_events_total)
+        assert ('zeebe_element_instance_events_total{partition="1",'
+                'action="completed",type="SERVICE_TASK"}') in text
 
     def test_replay_does_not_count_engine_events(self):
         # follower/restart replay must not inflate processing-side counters

@@ -159,6 +159,10 @@ class Broker:
                 "health", "0=healthy 1=unhealthy 2=dead", ("node",)),
         }
         self.responses: list = []
+        # per-partition ownership guard (set by ClusterRuntime): topology-
+        # driven partition lifecycle must not close journals under a pump
+        # running on that partition's ownership thread
+        self._partition_guard: Callable[[int], Any] | None = None
         sink = response_sink if response_sink is not None else self.responses.append
         backup_service = None
         if backup_store is not None:
@@ -232,6 +236,24 @@ class Broker:
         except (OSError, ValueError):
             return None
 
+    def _partition_lifecycle_guard(self, partition_id: int):
+        from contextlib import nullcontext
+
+        if self.partition_guard is None:
+            return nullcontext()
+        return self.partition_guard(partition_id)
+
+    @property
+    def partition_guard(self):
+        return self._partition_guard
+
+    @partition_guard.setter
+    def partition_guard(self, guard) -> None:
+        # the topology manager applies partition-scoped operations
+        # (reconfigure, replica lifecycle) under the same ownership guard
+        self._partition_guard = guard
+        self.topology.partition_guard = guard
+
     def _create_partition(self, partition_id: int, members: list[str],
                           priority: int = 1) -> None:
         from zeebe_tpu.broker.backpressure import CommandRateLimiter
@@ -288,6 +310,10 @@ class Broker:
     )
 
     def _stop_partition(self, partition_id: int) -> None:
+        with self._partition_lifecycle_guard(partition_id):
+            self._stop_partition_locked(partition_id)
+
+    def _stop_partition_locked(self, partition_id: int) -> None:
         partition = self.partitions.pop(partition_id, None)
         if partition is None:
             return
@@ -392,7 +418,7 @@ class Broker:
                 return self.cfg.node_id
             if local.raft.leader_id is not None:
                 return local.raft.leader_id
-        for member in self.membership.members.values():
+        for member in list(self.membership.members.values()):
             roles = member.properties.get("partitions", {})
             if roles.get(str(partition_id)) == "leader":
                 return member.member_id
@@ -411,20 +437,36 @@ class Broker:
 
     def pump(self) -> int:
         """One scheduling round: raft timers, membership, partition work."""
-        work = 0
-        for partition in list(self.partitions.values()):
-            partition.tick()
+        work = self.pump_control()
+        for pid in list(self.partitions):
+            work += self.pump_partition(pid)
+        return work
+
+    def pump_partition(self, partition_id: int) -> int:
+        """Advance ONE partition replica (raft timers + processing) — the
+        per-partition ownership thread's slice of pump(). The partition may
+        disappear mid-call under a concurrent topology change; the owning
+        runtime's pump guard absorbs the resulting error for one tick."""
+        partition = self.partitions.get(partition_id)
+        if partition is None:
+            return 0
+        partition.tick()
+        return partition.pump()
+
+    def pump_control(self) -> int:
+        """Advance the broker-level services (membership, topology, disk
+        monitor, observability, role gossip) — the control thread's slice.
+        Reads of partition state here are lock-free attribute reads; they may
+        lag a partition thread by a tick, which gossip tolerates by design."""
         self.membership.tick()
         self.topology.tick()
         if self.disk_monitor is not None:
             disk_paused = self.disk_monitor.check()
-            for partition in self.partitions.values():
+            for partition in list(self.partitions.values()):
                 partition.disk_paused = disk_paused
-        for partition in list(self.partitions.values()):
-            work += partition.pump()
         self._update_observability()
         self._gossip_roles()
-        return work
+        return 0
 
     def _update_observability(self) -> None:
         from zeebe_tpu.utils.health import HealthStatus
@@ -496,7 +538,7 @@ class Broker:
         bumped by the partitions' checkpoint-created listeners."""
         if self._checkpoint_cache == 0:
             self._checkpoint_cache = max(
-                (p.latest_checkpoint_id() for p in self.partitions.values()),
+                (p.latest_checkpoint_id() for p in list(self.partitions.values())),
                 default=0,
             )
         return self._checkpoint_cache

@@ -149,6 +149,7 @@ class ZeebePartition:
         # asqn → has_pending_commands for burst batches appended via
         # append_prepatched (consumed at materialization)
         self._prepatched_flags: dict[int, bool] = {}
+        self._latest_checkpoint = 0
         self._next_position = self.stream.last_position + 1
         self._last_snapshot_ms = clock_millis()
         self._transition()  # start as follower (replay mode)
@@ -245,6 +246,13 @@ class ZeebePartition:
             self.stream, self.db, self.exporters_factory(),
         )
         self.engine.checkpoint.listeners.append(self._on_checkpoint_created)
+        # lock-free checkpoint-id cache: refreshed here on the owner thread
+        # and bumped by the applier hook on BOTH leader processing and
+        # follower replay (the cross-partition send path reads it without
+        # touching this db)
+        self.engine.appliers.on_checkpoint_applied = self._observe_checkpoint_applied
+        with self.db.transaction():
+            self._latest_checkpoint = self.engine.checkpoint_state.latest_id()
         if self.role == RaftRole.LEADER:
             # leader sequencer continues after the last position in the raft
             # log (committed or not — uncommitted entries still own positions)
@@ -362,6 +370,11 @@ class ZeebePartition:
         # IS the committed prefix, so written-but-unmaterialized means wait
         if self.processor.last_written_position > self.stream.last_position:
             return False
+        import time as _time
+
+        from zeebe_tpu.utils.metrics import REGISTRY
+
+        snapshot_started = _time.perf_counter()
         exported = self.exporter_director.lowest_exporter_position()
         term = self.raft.current_term
         raft_index = self.raft.journal.seek_to_asqn(processed)
@@ -379,6 +392,15 @@ class ZeebePartition:
             "lastPosition": self.stream.last_position,
         }))
         snapshot = transient.persist()
+        REGISTRY.counter(
+            "snapshot_count", "snapshots persisted", ("partition",)
+        ).labels(str(self.partition_id)).inc()
+        REGISTRY.histogram(
+            "snapshot_duration_seconds", "time to persist a snapshot",
+            ("partition",)
+        ).labels(str(self.partition_id)).observe(
+            _time.perf_counter() - snapshot_started
+        )
         # raft log compaction bound: nothing above the snapshot index, nothing
         # unexported, nothing unmaterialized
         compact_position = min(processed, exported)
@@ -448,12 +470,21 @@ class ZeebePartition:
         self.stream_journal.close()
 
     def latest_checkpoint_id(self) -> int:
-        if self.engine is None:
-            return 0
-        with self.db.transaction():
-            return self.engine.checkpoint_state.latest_id()
+        """Lock-free: read by OTHER partitions' ownership threads on every
+        inter-partition send — must never open this partition's db (the owner
+        thread may be mid-transaction). The cache refreshes at transition and
+        on every checkpoint-created apply."""
+        return self._latest_checkpoint
+
+    def _observe_checkpoint_applied(self, checkpoint_id: int) -> None:
+        self._latest_checkpoint = max(self._latest_checkpoint, checkpoint_id)
+        if self.on_checkpoint is not None:
+            # broker-level cache (max over local replicas) follows along —
+            # on followers too, which the processing listener never covers
+            self.on_checkpoint(checkpoint_id)
 
     def _on_checkpoint_created(self, checkpoint_id: int, position: int) -> None:
+        self._latest_checkpoint = max(self._latest_checkpoint, checkpoint_id)
         if self.on_checkpoint is not None:
             self.on_checkpoint(checkpoint_id)
         if self.backup_service is not None:

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import re
 import struct
 import threading
 from collections import deque
@@ -24,6 +25,11 @@ from typing import Any, Callable
 from zeebe_tpu.protocol.msgpack import packb, unpackb
 
 logger = logging.getLogger("zeebe_tpu.messaging")
+
+# a topic's first embedded integer is its partition id (raft-3-append,
+# inter-partition-3, command-api-3, raft-reconfigure-3); control topics
+# (swim-probe, gateway-response, …) carry none
+_TOPIC_PARTITION = re.compile(r"(\d+)")
 
 # handler(sender_id, payload) -> reply payload | None
 Handler = Callable[[str, Any], Any]
@@ -69,14 +75,44 @@ class LoopbackNetwork:
     Messages are queued and delivered only on ``deliver_all`` / ``deliver_one``
     so tests control interleaving exactly. ``partition(a, b)`` drops traffic
     between two members (both directions) until ``heal()``.
+
+    With ``lanes=N`` the queue splits by partition: a topic's first embedded
+    integer selects its lane (raft-3-append, command-api-3 → lane 3; topics
+    with no partition id → the control lane 0), and ``deliver_lane`` drains
+    one lane — the per-partition ownership threads' delivery path (each lane's
+    handlers touch only that partition's state, so lanes never need a shared
+    lock). ``lanes=0`` (default) keeps the single deterministic queue.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, lanes: int = 0) -> None:
         self.members: dict[str, LoopbackMessaging] = {}
-        self.queue: deque[tuple[str, str, str, Any]] = deque()
+        self.lanes = lanes
+        self._queues: list[deque[tuple[str, str, str, Any]]] = [
+            deque() for _ in range(lanes + 1)
+        ]
         self._partitions: set[frozenset[str]] = set()
         self.dropped: int = 0
         self._handler_fail_logged: set[str] = set()
+
+    @property
+    def queue(self):
+        """All pending messages (compat view; prefer per-lane delivery)."""
+        if self.lanes == 0:
+            return self._queues[0]
+        return [m for q in self._queues for m in q]
+
+    def lane_of(self, topic: str) -> int:
+        if self.lanes == 0:
+            return 0
+        if topic.startswith("raft-reconfigure-done-"):
+            # topology-plane confirmation: its handler mutates the topology
+            # manager's state, which the control thread owns
+            return 0
+        m = _TOPIC_PARTITION.search(topic)
+        if m is None:
+            return 0
+        lane = int(m.group(1))
+        return lane if 1 <= lane <= self.lanes else 0
 
     def join(self, member_id: str) -> LoopbackMessaging:
         svc = LoopbackMessaging(self, member_id)
@@ -107,12 +143,16 @@ class LoopbackNetwork:
     # -- delivery -------------------------------------------------------------
 
     def enqueue(self, sender: str, target: str, topic: str, payload: Any) -> None:
-        self.queue.append((sender, target, topic, payload))
+        self._queues[self.lane_of(topic)].append((sender, target, topic, payload))
 
-    def deliver_one(self) -> bool:
-        if not self.queue:
+    def deliver_one(self, lane: int = 0) -> bool:
+        q = self._queues[lane]
+        if not q:
             return False
-        sender, target, topic, payload = self.queue.popleft()
+        try:
+            sender, target, topic, payload = q.popleft()
+        except IndexError:  # raced with another consumer of this lane
+            return False
         if self._blocked(sender, target) or target not in self.members:
             self.dropped += 1
             return True
@@ -137,11 +177,20 @@ class LoopbackNetwork:
                                  sender, target, topic)
         return True
 
+    def deliver_lane(self, lane: int, max_messages: int = 100_000) -> int:
+        count = 0
+        while self._queues[lane] and count < max_messages:
+            if not self.deliver_one(lane):
+                break
+            count += 1
+        return count
+
     def deliver_all(self, max_messages: int = 100_000) -> int:
         count = 0
-        while self.queue and count < max_messages:
-            self.deliver_one()
-            count += 1
+        for lane in range(len(self._queues)):
+            count += self.deliver_lane(lane, max_messages - count)
+            if count >= max_messages:
+                break
         return count
 
 

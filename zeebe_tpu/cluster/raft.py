@@ -61,6 +61,11 @@ class RaftNode:
         self.messaging = messaging
         self.member_id = messaging.member_id
         self.partition_id = partition_id
+        from zeebe_tpu.utils.metrics import REGISTRY
+
+        self._m_elections = REGISTRY.counter(
+            "raft_elections_total", "elections started", ("partition",)
+        ).labels(str(partition_id))
         self.members = sorted(members)
         self._bootstrap_members = sorted(members)
         # configuration in effect at the journal's base (snapshot boundary):
@@ -295,6 +300,7 @@ class RaftNode:
 
     def _start_election(self) -> None:
         self._prevotes = set()  # stale grants must not re-trigger elections
+        self._m_elections.inc()
         self._set_term(self.current_term + 1, vote_for=self.member_id)
         self._become(RaftRole.CANDIDATE)
         self._votes = {self.member_id}

@@ -139,6 +139,9 @@ class TopologyManager:
         self.membership = membership
         self.start_replica = start_replica
         self.stop_replica = stop_replica
+        # optional per-partition ownership guard (context-manager factory),
+        # wired by the broker when ownership threads exist
+        self.partition_guard = None
         self.raft_of = raft_of
         self.request_reconfigure = request_reconfigure
         self.persist = persist or (lambda doc: None)
@@ -257,7 +260,17 @@ class TopologyManager:
         if op["member"] != self.member_id:
             return  # someone else's move
         marker = (change["id"], change["index"])
-        done = self._execute(op, first=self._op_started != marker)
+        guard = (self.partition_guard(op["partition"])
+                 if self.partition_guard is not None and "partition" in op
+                 else None)
+        if guard is None:
+            done = self._execute(op, first=self._op_started != marker)
+        else:
+            # partition-scoped operations mutate that partition's raft state
+            # (reconfigure, replica bootstrap/teardown) — they must hold the
+            # partition's ownership lock so they never race its pump thread
+            with guard:
+                done = self._execute(op, first=self._op_started != marker)
         self._op_started = marker
         if done:
             change["index"] += 1

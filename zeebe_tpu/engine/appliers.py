@@ -40,6 +40,8 @@ from zeebe_tpu.protocol.intent import (
 
 
 class EventAppliers:
+    on_checkpoint_applied = None  # set by the owning partition (cache hook)
+
     def __init__(self, state: EngineState) -> None:
         self.state = state
         self._appliers: dict[tuple[ValueType, int], Callable[[Record], None]] = {}
@@ -205,6 +207,11 @@ class EventAppliers:
         self.state.checkpoints.put(
             record.value["checkpointId"], record.value["checkpointPosition"]
         )
+        # cache hook: partitions keep a lock-free latest-checkpoint-id for
+        # the cross-thread inter-partition send path; the applier is the one
+        # place both leader processing AND follower replay pass through
+        if self.on_checkpoint_applied is not None:
+            self.on_checkpoint_applied(record.value["checkpointId"])
 
     def _drg_created(self, record: Record) -> None:
         self.state.decisions.put_drg(record.key, record.value)

@@ -25,7 +25,8 @@ from zeebe_tpu.state.db import ColumnFamilyCode as CF
 class ExporterContainer:
     def __init__(self, exporter_id: str, exporter: Exporter,
                  state: "ExportersState",
-                 configuration: dict | None = None) -> None:
+                 configuration: dict | None = None,
+                 partition_id: int = 0) -> None:
         self.exporter_id = exporter_id
         self.exporter = exporter
         self.state = state
@@ -37,10 +38,20 @@ class ExporterContainer:
         self.last_delivered = self.position
         exporter.configure(ExporterContext(exporter_id, configuration or {}))
         exporter.open(ExporterController(self._update_position))
+        from zeebe_tpu.utils.metrics import REGISTRY
+
+        # labeled per (exporter, partition): each child is incremented by
+        # exactly one partition ownership thread, so the non-atomic
+        # Counter.inc never races
+        self._m_exported = REGISTRY.counter(
+            "exporter_events_exported_total",
+            "records handed to an exporter", ("exporter", "partition")
+        ).labels(exporter_id, str(partition_id))
 
     def deliver(self, record) -> None:
         self.last_delivered = record.position
         self.exporter.export(record)
+        self._m_exported.inc()
 
     def skip(self, position: int) -> None:
         if self.last_delivered <= self.position:  # nothing unacked in flight
@@ -89,7 +100,8 @@ class ExporterDirector:
         self.state = ExportersState(db)
         self.containers = [
             ExporterContainer(eid, exp, self.state,
-                              (configurations or {}).get(eid))
+                              (configurations or {}).get(eid),
+                              partition_id=stream.partition_id)
             for eid, exp in exporters.items()
         ]
         # committed-position supplier: records past it are not yet safe to

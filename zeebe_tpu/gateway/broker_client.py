@@ -117,8 +117,15 @@ class ClusterRuntime(GatewayRuntimeBase):
                  backup_store=None,
                  kernel_backend: bool = True) -> None:
         self.partition_count = partition_count
-        self.net = LoopbackNetwork()
+        self.net = LoopbackNetwork(lanes=partition_count)
         self._lock = threading.RLock()
+        # per-partition ownership locks: partition p's replicas (across all
+        # brokers) advance only under _plocks[p] — the single-writer
+        # guarantee the reference gets from partition actors, here extended
+        # so one partition's slow step (a kernel compile) no longer stalls
+        # the other partitions' raft heartbeats and processing
+        self._plocks = {p: threading.RLock()
+                        for p in range(1, partition_count + 1)}
         self._init_requests()
         self._init_jobstreams()
         members = [f"broker-{i}" for i in range(broker_count)]
@@ -142,42 +149,78 @@ class ClusterRuntime(GatewayRuntimeBase):
                 backup_store=backup_store,
             )
             self.brokers[m].jobs_listener = self._on_jobs_available
+            # topology-driven partition add/remove must hold the partition's
+            # ownership lock so lifecycle never races that partition's pump
+            self.brokers[m].partition_guard = self._partition_guard
         self._running = False
-        self._thread: threading.Thread | None = None
+        self._threads: list[threading.Thread] = []
+
+    def _partition_guard(self, partition_id: int):
+        import contextlib
+
+        lock = self._plocks.get(partition_id)
+        return lock if lock is not None else contextlib.nullcontext()
 
     # -- pump thread -----------------------------------------------------------
 
     def start(self) -> None:
         self._running = True
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="cluster-runtime")
-        self._thread.start()
+        # one ownership thread per partition + one control thread (membership,
+        # topology, gossip, observability) — the reference's partition actors,
+        # as threads over the same single-writer discipline
+        self._threads = [
+            threading.Thread(target=self._run_partition, args=(pid,),
+                             daemon=True, name=f"partition-{pid}")
+            for pid in range(1, self.partition_count + 1)
+        ]
+        self._threads.append(
+            threading.Thread(target=self._run_control, daemon=True,
+                             name="cluster-control")
+        )
+        for t in self._threads:
+            t.start()
         self.job_streams.start()
         self.await_leaders()
 
-    def _run(self) -> None:
+    def _pump_brokers(self, pump, logged: set) -> None:
         # one broker's pump failure (e.g. crashed/closed but still listed)
         # must not kill the thread that drives every other broker: keep
         # pumping the rest and retry the failed one each tick (a transient
         # cause — momentary disk pressure, a mid-transition race — recovers
         # by itself); the traceback is logged once per failure streak
+        for name, broker in list(self.brokers.items()):
+            try:
+                pump(broker)
+                logged.discard(name)
+            except Exception:  # noqa: BLE001
+                if name not in logged:
+                    logged.add(name)
+                    logger.exception("broker %s pump failed; retrying "
+                                     "(logged once per streak)", name)
+
+    def _run_partition(self, pid: int) -> None:
+        logged: set[str] = set()
+        while self._running:
+            with self._plocks[pid]:
+                self._pump_brokers(lambda b: b.pump_partition(pid), logged)
+                try:
+                    moved = self.net.deliver_lane(pid)
+                except Exception:  # noqa: BLE001 — deliver_one already guards
+                    # handler errors; this guards queue-level corruption
+                    logger.exception("partition %s delivery failed", pid)
+                    moved = 0
+            if moved == 0:
+                time.sleep(0.001)
+
+    def _run_control(self) -> None:
         logged: set[str] = set()
         while self._running:
             with self._lock:
-                for name, broker in list(self.brokers.items()):
-                    try:
-                        broker.pump()
-                        logged.discard(name)
-                    except Exception:  # noqa: BLE001
-                        if name not in logged:
-                            logged.add(name)
-                            logger.exception("broker %s pump failed; retrying "
-                                             "(logged once per streak)", name)
+                self._pump_brokers(lambda b: b.pump_control(), logged)
                 try:
-                    moved = self.net.deliver_all()
-                except Exception:  # noqa: BLE001 — deliver_one already guards
-                    # handler errors; this guards queue-level corruption
-                    logger.exception("message delivery failed")
+                    moved = self.net.deliver_lane(0)
+                except Exception:  # noqa: BLE001
+                    logger.exception("control delivery failed")
                     moved = 0
             if moved == 0:
                 time.sleep(0.001)
@@ -185,8 +228,8 @@ class ClusterRuntime(GatewayRuntimeBase):
     def stop(self) -> None:
         self.job_streams.stop()
         self._running = False
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        for t in getattr(self, "_threads", []):
+            t.join(timeout=5)
         with self._lock:
             for broker in self.brokers.values():
                 broker.close()
@@ -194,11 +237,12 @@ class ClusterRuntime(GatewayRuntimeBase):
     def await_leaders(self, timeout_s: float = 30.0) -> None:
         deadline = time.time() + timeout_s
         while time.time() < deadline:
-            with self._lock:
-                ready = all(
-                    self._leader_partition(p) is not None
-                    for p in range(1, self.partition_count + 1)
-                )
+            # lock-free role reads: leadership claims are plain attributes
+            # maintained by the partition threads
+            ready = all(
+                self._leader_partition(p) is not None
+                for p in range(1, self.partition_count + 1)
+            )
             if ready:
                 return
             time.sleep(0.01)
@@ -227,13 +271,20 @@ class ClusterRuntime(GatewayRuntimeBase):
         LongPollingActivateJobsHandler parks requests until jobsAvailable).
         ``tenant_ids`` keeps a tenant-filtered long-poll from flooding the log
         with empty activations when only other tenants' jobs exist."""
-        with self._lock:
+        lock = self._plocks.get(partition_id)
+        if lock is None or not lock.acquire(timeout=1.0):
+            # unknown partition, or its ownership thread is stalled: report
+            # "no jobs" — long-polls and the push dispatcher both retry
+            return False
+        try:
             leader = self._leader_partition(partition_id)
             if leader is None or leader.db is None:
                 return False
             with leader.db.transaction():
                 return bool(leader.engine.state.jobs.activatable_keys(
                     job_type, 1, tenant_ids))
+        finally:
+            lock.release()
 
     # -- request path ----------------------------------------------------------
 
@@ -247,16 +298,27 @@ class ClusterRuntime(GatewayRuntimeBase):
         rec = record.replace(request_id=request_id, request_stream_id=0)
         deadline = time.time() + timeout_s
         written = False
+        lock = self._plocks.get(partition_id)
+        if lock is None:
+            # a stale/crafted key can decode to a partition this cluster
+            # never had — the same UNAVAILABLE surface as a leaderless one
+            self._pending.pop(request_id, None)
+            raise NoLeaderError(f"unknown partition {partition_id}")
         while time.time() < deadline:
-            with self._lock:
-                leader = self._leader_partition(partition_id)
-                if leader is not None:
-                    try:
-                        if leader.client_write(rec) is not None:
-                            written = True
-                    except BackpressureExceeded as exc:
-                        self._pending.pop(request_id, None)
-                        raise ResourceExhaustedError(str(exc)) from exc
+            # bounded acquire: a stalled partition (held ownership lock) must
+            # time this request out, not block the gRPC handler forever
+            if lock.acquire(timeout=0.05):
+                try:
+                    leader = self._leader_partition(partition_id)
+                    if leader is not None:
+                        try:
+                            if leader.client_write(rec) is not None:
+                                written = True
+                        except BackpressureExceeded as exc:
+                            self._pending.pop(request_id, None)
+                            raise ResourceExhaustedError(str(exc)) from exc
+                finally:
+                    lock.release()
             if written:
                 break
             time.sleep(0.01)

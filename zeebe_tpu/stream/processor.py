@@ -43,6 +43,16 @@ from zeebe_tpu.stream.api import (
     activatable_job_types,
 )
 
+from zeebe_tpu.protocol.intent import ProcessInstanceIntent as _PI
+
+# ELEMENT_* lifecycle intents → metric action label (reference:
+# ProcessEngineMetrics.ExecutedInstanceAction)
+_ELEMENT_ACTIONS = {
+    int(_PI.ELEMENT_ACTIVATED): "activated",
+    int(_PI.ELEMENT_COMPLETED): "completed",
+    int(_PI.ELEMENT_TERMINATED): "terminated",
+}
+
 logger = logging.getLogger("zeebe_tpu.stream")
 
 
@@ -156,6 +166,13 @@ class StreamProcessor:
             int(JobIntent.CANCELED): jobs.labels(partition_label, "canceled"),
             int(JobIntent.ERROR_THROWN): jobs.labels(partition_label, "error_thrown"),
         }
+        # element transitions by BPMN element type (reference:
+        # ProcessEngineMetrics zeebe_element_instance_events_total)
+        self._m_element_events = REGISTRY.counter(
+            "element_instance_events_total",
+            "element instance lifecycle events by element type",
+            ("partition", "action", "type"))
+        self._m_element_children: dict = {}
         self._m_incident_actions = {
             int(IncidentIntent.CREATED): incidents.labels(partition_label, "created"),
             int(IncidentIntent.RESOLVED): incidents.labels(partition_label, "resolved"),
@@ -429,10 +446,22 @@ class StreamProcessor:
                 if child is not None:
                     child.inc()
             elif vt == ValueType.PROCESS_INSTANCE:
-                if rec.value.get("bpmnElementType") == "PROCESS":
-                    child = self._m_pi_actions.get(int(rec.intent))
+                intent = int(rec.intent)
+                element_type = rec.value.get("bpmnElementType")
+                if element_type == "PROCESS":
+                    child = self._m_pi_actions.get(intent)
                     if child is not None:
                         child.inc()
+                action = _ELEMENT_ACTIONS.get(intent)
+                if action is not None and element_type:
+                    key = (action, element_type)
+                    child = self._m_element_children.get(key)
+                    if child is None:
+                        child = self._m_element_events.labels(
+                            str(self.log_stream.partition_id), action,
+                            element_type)
+                        self._m_element_children[key] = child
+                    child.inc()
             elif vt == ValueType.INCIDENT:
                 child = self._m_incident_actions.get(int(rec.intent))
                 if child is not None:

@@ -408,3 +408,51 @@ class MultiPartitionHarness:
         for h in self.partitions.values():
             out.extend(h.exporter.all().to_list())
         return out
+
+
+def await_deployment_distributed(runtime, process_ids, timeout_s: float = 10.0) -> None:
+    """Block until every partition leader of an in-process ClusterRuntime can
+    resolve the given process ids. Deployment distribution is asynchronous by
+    design (the reference's DeploymentCreateProcessor responds on partition-1
+    commit and distributes afterwards — DeploymentCreateProcessor.java:166),
+    so a create-by-id racing the distribution to another partition is
+    legitimate NOT_FOUND behavior; tests that deploy-then-create on a
+    multi-partition cluster should wait this race out the same way the
+    reference's own tests await the RecordingExporter."""
+    import time as _time
+
+    deadline = _time.time() + timeout_s
+    remaining = None
+    while _time.time() < deadline:
+        remaining = []
+        for pid in range(1, runtime.partition_count + 1):
+            with runtime._plocks[pid]:
+                leader = runtime._leader_partition(pid)
+                if leader is None or leader.engine is None:
+                    remaining.append((pid, "*"))
+                    continue
+                with leader.db.transaction():
+                    for process_id in process_ids:
+                        if leader.engine.state.processes.get_latest_by_id(
+                                process_id) is None:
+                            remaining.append((pid, process_id))
+        if not remaining:
+            return
+        _time.sleep(0.01)
+    raise TimeoutError(f"deployment not distributed: {remaining}")
+
+
+def distributing_client(client, runtime):
+    """Wrap a ZeebeTpuClient so deploy_resource also awaits distribution to
+    every partition (see await_deployment_distributed)."""
+    original = client.deploy_resource
+
+    def deploy_and_await(*resources, **kw):
+        result = original(*resources, **kw)
+        ids = [p["bpmnProcessId"] for p in result.get("processes", [])]
+        if ids:
+            await_deployment_distributed(runtime, ids)
+        return result
+
+    client.deploy_resource = deploy_and_await
+    return client
