@@ -100,6 +100,34 @@ def fork_join(pid="fork_join"):
     )
 
 
+def ten_tasks(pid="ten_tasks"):
+    """10 sequential service tasks (reference fixture:
+    benchmarks/project/src/main/resources/bpmn/ten_tasks.bpmn)."""
+    b = Bpmn.create_executable_process(pid).start_event("s")
+    for i in range(10):
+        b = b.service_task(f"t{i}", job_type=f"work_{pid}")
+    return b.end_event("e").done()
+
+
+def subprocess_boundary(pid="sub_bnd"):
+    """Embedded sub-process + timer-boundary task (kernel scope + boundary
+    wait-state paths under load)."""
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .sub_process("sub")
+        .start_event("is_")
+        .service_task("inner", job_type=f"inner_{pid}")
+        .boundary_timer("tb", attached_to="inner", duration="PT1H")
+        .end_event("bnd_e")
+        .move_to_element("inner")
+        .end_event("ie")
+        .sub_process_done()
+        .end_event("e")
+        .done()
+    )
+
+
 def mixed_definitions():
     """8 ragged definitions (config #5): varying task counts and routing."""
     out = [one_task("mx_one"), exclusive_chain("mx_excl"), fork_join("mx_fj")]
@@ -321,7 +349,32 @@ def run_kernel_ceiling() -> dict:
     return {"transitions_per_sec": round(rounds * per_run / elapsed, 1)}
 
 
+def _ensure_backend() -> str:
+    """Pick the JAX platform for this run. The TPU tunnel can hang
+    indefinitely at first device use (observed: jax.devices() never
+    returns); probe it in a killable subprocess and fall back to CPU with
+    an explicit marker rather than hanging the whole bench run."""
+    import os
+    import subprocess
+    import sys
+
+    if os.environ.get("ZB_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu-forced"
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=240, check=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        return jax.devices()[0].platform
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu-fallback(tpu-unreachable)"
+
+
 def main() -> None:
+    platform = _ensure_backend()
     e2e_one_task = run_e2e_workload([one_task()], drives=1, n_instances=4000,
                                     variables={})
     e2e_excl = run_e2e_workload([exclusive_chain()], drives=0, n_instances=4000,
@@ -330,6 +383,10 @@ def main() -> None:
                                 variables={})
     e2e_mixed = run_e2e_workload(mixed_definitions(), drives=4, n_instances=2400,
                                  variables={"x": 15})
+    e2e_ten = run_e2e_workload([ten_tasks()], drives=10, n_instances=800,
+                               variables={})
+    e2e_scope = run_e2e_workload([subprocess_boundary()], drives=1,
+                                 n_instances=2000, variables={})
     ceiling = run_kernel_ceiling()
 
     value = e2e_one_task["transitions_per_sec"]
@@ -343,7 +400,10 @@ def main() -> None:
             "e2e_exclusive_chain": e2e_excl,
             "e2e_fork_join": e2e_fork,
             "e2e_mixed_8_definitions": e2e_mixed,
+            "e2e_ten_tasks": e2e_ten,
+            "e2e_subprocess_boundary": e2e_scope,
             "kernel_ceiling_transitions_per_sec": ceiling["transitions_per_sec"],
+            "platform": platform,
             "note": (
                 "e2e = commands on the committed log -> stream processor -> "
                 "device kernel + burst templates -> events appended + state "

@@ -77,36 +77,34 @@ def _job_of(j) -> ActivatedJob:
     )
 
 
-class _BearerAuth(grpc.UnaryUnaryClientInterceptor, grpc.UnaryStreamClientInterceptor):
-    """Adds an `authorization: Bearer <token>` header to every call
-    (reference: clients/java CredentialsProvider / OAuthCredentialsProvider —
-    static token here, no token endpoint in this build)."""
-
-    def __init__(self, token: str) -> None:
-        self._md = ("authorization", f"Bearer {token}")
-
-    def _with_md(self, details):
-        md = list(details.metadata or []) + [self._md]
-        return details._replace(metadata=md) if hasattr(details, "_replace") else details
-
-    def intercept_unary_unary(self, continuation, details, request):
-        return continuation(self._with_md(details), request)
-
-    def intercept_unary_stream(self, continuation, details, request):
-        return continuation(self._with_md(details), request)
-
-
 class ZeebeTpuClient:
     """Synchronous client; one instance per gateway address."""
 
     def __init__(self, address: str, channel: grpc.Channel | None = None,
                  access_token: str | None = None,
-                 default_tenant: str = "") -> None:
+                 default_tenant: str = "",
+                 credentials_provider=None) -> None:
+        """Credential precedence (mirrors the reference client):
+        an explicit ``credentials_provider`` wins; else an explicit
+        ``access_token`` (static bearer); else the ZEEBE_CLIENT_ID /
+        ZEEBE_CLIENT_SECRET / ZEEBE_AUTHORIZATION_SERVER_URL environment.
+        Pass ``credentials_provider=False`` to force anonymous calls."""
+        from zeebe_tpu.client.credentials import (
+            OAuthCredentialsProvider,
+            StaticCredentialsProvider,
+            authenticated_channel,
+        )
+
         self.address = address
         self.channel = channel or grpc.insecure_channel(address)
-        if access_token:
-            self.channel = grpc.intercept_channel(
-                self.channel, _BearerAuth(access_token))
+        if credentials_provider is None:
+            if access_token:
+                credentials_provider = StaticCredentialsProvider(access_token)
+            else:
+                credentials_provider = OAuthCredentialsProvider.from_env()
+        if credentials_provider:
+            self.channel = authenticated_channel(self.channel,
+                                                 credentials_provider)
         # tenant stamped on tenant-scoped commands unless overridden per call
         self.default_tenant = default_tenant
         c = self.channel

@@ -15,6 +15,7 @@ over TCP. Two implementations:
 from __future__ import annotations
 
 import asyncio
+import dataclasses as _dataclasses
 import logging
 import re
 import struct
@@ -197,6 +198,52 @@ class LoopbackNetwork:
 _FRAME = struct.Struct("<I")
 
 
+@_dataclasses.dataclass
+class TlsConfig:
+    """Cluster-messaging TLS (reference: atomix Netty TLS — zeebe.broker.
+    network.security.*): every member presents cert_file/key_file; with
+    ca_file set, peers are verified against it in BOTH directions (mutual
+    TLS). Hostname checks are off — cluster certs are per-node identities
+    verified by the shared CA, not by DNS names."""
+
+    cert_file: str
+    key_file: str
+    ca_file: str | None = None
+
+    def server_context(self):
+        if getattr(self, "_server_ctx", None) is None:
+            self._server_ctx = self._build_server_context()
+        return self._server_ctx
+
+    def client_context(self):
+        if getattr(self, "_client_ctx", None) is None:
+            self._client_ctx = self._build_client_context()
+        return self._client_ctx
+
+    def _build_server_context(self):
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.cert_file, self.key_file)
+        if self.ca_file:
+            ctx.load_verify_locations(self.ca_file)
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        return ctx
+
+    def _build_client_context(self):
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.load_cert_chain(self.cert_file, self.key_file)
+        if self.ca_file:
+            ctx.load_verify_locations(self.ca_file)
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        else:
+            ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+
+
 class TcpMessagingService(MessagingService):
     """asyncio TCP messaging: one connection per peer, frames are
     ``len | msgpack{topic, sender, payload}`` (the NettyMessagingService
@@ -209,10 +256,12 @@ class TcpMessagingService(MessagingService):
     partition, the same discipline the reference enforces with actors)."""
 
     def __init__(self, member_id: str, bind: tuple[str, int],
-                 peers: dict[str, tuple[str, int]]) -> None:
+                 peers: dict[str, tuple[str, int]],
+                 tls: "TlsConfig | None" = None) -> None:
         self.member_id = member_id
         self.bind = bind
         self.peers = dict(peers)
+        self.tls = tls
         self.handlers: dict[str, Handler] = {}
         self._server: asyncio.AbstractServer | None = None
         self._writers: dict[str, asyncio.StreamWriter] = {}
@@ -246,7 +295,8 @@ class TcpMessagingService(MessagingService):
 
     async def _serve(self) -> None:
         self._server = await asyncio.start_server(
-            self._on_connection, self.bind[0], self.bind[1]
+            self._on_connection, self.bind[0], self.bind[1],
+            ssl=self.tls.server_context() if self.tls else None,
         )
         self._started.set()
 
@@ -303,7 +353,10 @@ class TcpMessagingService(MessagingService):
                 if member_id not in self.peers:
                     return
                 host, port = self.peers[member_id]
-                _, writer = await asyncio.open_connection(host, port)
+                _, writer = await asyncio.open_connection(
+                    host, port,
+                    ssl=self.tls.client_context() if self.tls else None,
+                )
                 self._writers[member_id] = writer
             data = packb({"topic": topic, "sender": self.member_id, "payload": payload})
             writer.write(_FRAME.pack(len(data)) + data)

@@ -33,8 +33,13 @@ class GatewayAuthConfig:
 
 
 class TenantAuthorizer:
-    def __init__(self, config: GatewayAuthConfig | None = None) -> None:
+    def __init__(self, config: GatewayAuthConfig | None = None,
+                 oauth=None) -> None:
         self.config = config or GatewayAuthConfig()
+        # optional OAuthValidator: with JWT authentication on, the caller's
+        # tenants come from the validated token's authorized_tenants claim
+        # (the reference reads the same claim via Identity)
+        self.oauth = oauth
 
     @property
     def enabled(self) -> bool:
@@ -44,11 +49,22 @@ class TenantAuthorizer:
         """The caller's authorized tenants, resolved from gRPC metadata."""
         if not self.config.multi_tenancy_enabled:
             return [DEFAULT_TENANT]
-        token = ""
-        for key, value in invocation_metadata or ():
-            if key.lower() == "authorization":
-                token = value.removeprefix("Bearer ").strip()
-                break
+        if self.oauth is not None and self.oauth.enabled:
+            from zeebe_tpu.gateway.oauth import InvalidToken
+
+            try:
+                claims = self.oauth.validate(invocation_metadata)
+            except InvalidToken:
+                # the server interceptor rejects unauthenticated calls before
+                # handlers run; reaching here means a race on config — deny
+                return []
+            tenants = claims.get("authorized_tenants")
+            if tenants:
+                return list(tenants)
+            return list(self.config.anonymous_tenants)
+        from zeebe_tpu.gateway.oauth import bearer_token
+
+        token = bearer_token(invocation_metadata)
         if token and token in self.config.token_tenants:
             return list(self.config.token_tenants[token])
         return list(self.config.anonymous_tenants)

@@ -619,8 +619,14 @@ class Gateway:
 
     def __init__(self, runtime: ClusterRuntime, bind: str = "127.0.0.1:0",
                  max_workers: int = 16,
-                 auth: TenantAuthorizer | None = None) -> None:
+                 auth: TenantAuthorizer | None = None,
+                 oauth: "OAuthValidator | None" = None) -> None:
         self.runtime = runtime
+        if auth is None:
+            auth = TenantAuthorizer(oauth=oauth)
+        elif oauth is not None and auth.oauth is None:
+            # the JWT's authorized_tenants claim feeds tenant authorization
+            auth.oauth = oauth
         self.service = GatewayService(runtime, auth=auth)
         handlers = {}
         for name, (req_cls, resp_cls) in _UNARY.items():
@@ -635,7 +641,16 @@ class Gateway:
                 request_deserializer=req_cls.FromString,
                 response_serializer=resp_cls.SerializeToString,
             )
-        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        interceptors = ()
+        if oauth is not None and oauth.enabled:
+            # authenticate before any handler runs (IdentityInterceptor seam)
+            from zeebe_tpu.gateway.oauth import auth_server_interceptor
+
+            interceptors = (auth_server_interceptor(oauth),)
+        self.server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            interceptors=interceptors,
+        )
         self.server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
         )

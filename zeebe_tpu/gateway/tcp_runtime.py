@@ -42,13 +42,13 @@ class TcpClusterRuntime(GatewayRuntimeBase):
                  peers: dict[str, tuple[str, int]],
                  partition_count: int = 1, replication_factor: int = 1,
                  directory=None, kernel_backend: bool = True,
-                 **broker_kwargs) -> None:
+                 tls=None, **broker_kwargs) -> None:
         self.node_id = node_id
         self.partition_count = partition_count
         members = sorted(set(peers) | {node_id})
         self._members = members
         self._node_index = members.index(node_id)
-        self.messaging = TcpMessagingService(node_id, bind, peers)
+        self.messaging = TcpMessagingService(node_id, bind, peers, tls=tls)
         self.messaging.start()
         self.messaging.subscribe(GATEWAY_RESPONSE_TOPIC, self._on_remote_response)
         self.messaging.subscribe(JOBS_AVAILABLE_TOPIC, self._on_remote_jobs_available)

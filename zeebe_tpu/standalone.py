@@ -24,6 +24,30 @@ import sys
 import threading
 
 
+def _gateway_oauth():
+    """ZEEBE_GATEWAY_SECURITY_AUTHENTICATION_* → OAuthValidator (mode
+    `identity` enables the JWT interceptor; reference: gateway security
+    authentication config + IdentityInterceptor)."""
+    import os
+
+    mode = os.environ.get("ZEEBE_GATEWAY_SECURITY_AUTHENTICATION_MODE", "none")
+    if mode != "identity":
+        return None
+    from zeebe_tpu.gateway.oauth import OAuthValidator, OAuthValidatorConfig
+
+    secret = os.environ.get("ZEEBE_GATEWAY_SECURITY_AUTHENTICATION_SECRET")
+    if not secret:
+        raise SystemExit(
+            "ZEEBE_GATEWAY_SECURITY_AUTHENTICATION_MODE=identity requires "
+            "ZEEBE_GATEWAY_SECURITY_AUTHENTICATION_SECRET")
+    return OAuthValidator(OAuthValidatorConfig(
+        mode="identity",
+        secret=secret,
+        audience=os.environ.get("ZEEBE_GATEWAY_SECURITY_AUTHENTICATION_AUDIENCE"),
+    ))
+
+
+
 def _parse_contacts(spec: str) -> dict[str, tuple[str, int]]:
     out: dict[str, tuple[str, int]] = {}
     for part in spec.split(","):
@@ -65,8 +89,28 @@ def main(argv: list[str] | None = None) -> int:
         host, port = args.bind.rsplit(":", 1)
         contacts = _parse_contacts(args.contact)
         peers = {m: a for m, a in contacts.items() if m != args.node_id}
+        # cluster-messaging TLS (reference: zeebe.broker.network.security.*)
+        tls = None
+        import os as _os
+
+        if _os.environ.get("ZEEBE_BROKER_NETWORK_SECURITY_ENABLED", "").lower() in (
+                "1", "true", "yes"):
+            from zeebe_tpu.cluster.messaging import TlsConfig
+
+            cert = _os.environ.get("ZEEBE_BROKER_NETWORK_SECURITY_CERTIFICATECHAINPATH")
+            key = _os.environ.get("ZEEBE_BROKER_NETWORK_SECURITY_PRIVATEKEYPATH")
+            if not cert or not key:
+                raise SystemExit(
+                    "ZEEBE_BROKER_NETWORK_SECURITY_ENABLED requires "
+                    "ZEEBE_BROKER_NETWORK_SECURITY_CERTIFICATECHAINPATH and "
+                    "ZEEBE_BROKER_NETWORK_SECURITY_PRIVATEKEYPATH")
+            tls = TlsConfig(
+                cert_file=cert, key_file=key,
+                ca_file=_os.environ.get(
+                    "ZEEBE_BROKER_NETWORK_SECURITY_CERTIFICATEAUTHORITYPATH"),
+            )
         runtime = TcpClusterRuntime(
-            args.node_id, (host, int(port)), peers,
+            args.node_id, (host, int(port)), peers, tls=tls,
             partition_count=args.partitions,
             replication_factor=args.replication,
             directory=args.data_dir,
@@ -74,7 +118,8 @@ def main(argv: list[str] | None = None) -> int:
             kernel_backend=load_broker_cfg().base.kernel_backend,
         )
         runtime.start()
-        gateway = Gateway(runtime, bind=f"0.0.0.0:{args.port}")
+        gateway = Gateway(runtime, bind=f"0.0.0.0:{args.port}",
+                      oauth=_gateway_oauth())
         gateway.start()
         print(f"[{args.node_id}] gateway on {gateway.address}, cluster bind "
               f"{args.bind}", file=sys.stderr, flush=True)
@@ -121,7 +166,8 @@ def main(argv: list[str] | None = None) -> int:
                              if cfg.disk.enable_monitoring and args.data_dir else 0),
     )
     runtime.start()
-    gateway = Gateway(runtime, bind=f"0.0.0.0:{args.port}")
+    gateway = Gateway(runtime, bind=f"0.0.0.0:{args.port}",
+                  oauth=_gateway_oauth())
     gateway.start()
     print(f"gateway listening on {gateway.address} "
           f"({args.brokers} broker(s), {runtime.partition_count} partition(s))",
