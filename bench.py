@@ -51,7 +51,7 @@ from zeebe_tpu.protocol.intent import (
 )
 from zeebe_tpu.protocol.record import command
 from zeebe_tpu.state import ZbDb
-from zeebe_tpu.stream import StreamProcessor
+from zeebe_tpu.stream import StreamProcessor, StreamProcessorMode
 
 NORTH_STAR = 50_000.0
 
@@ -314,6 +314,34 @@ def run_e2e_workload(models, drives, n_instances: int, variables: dict) -> dict:
         }
 
 
+def run_replay_recovery(tmpdir_records: int = 4000) -> dict:
+    """Restart recovery: replay a committed one_task log into a fresh state
+    store (the follower/restart path — reference anchor: snapshot+replay
+    recovery throughput, LargeStateControllerPerformanceTest)."""
+    with tempfile.TemporaryDirectory() as tmpdir:
+        part = E2EPartition(tmpdir)
+        part.deploy([one_task()])
+        part.inject_creations("one_task", tmpdir_records, {})
+        part.pump()
+        jobs = part.pending_job_keys(0)
+        part.complete_in_type_waves(jobs)
+        total_records = sum(1 for _ in part.stream.new_reader(1))
+
+        db = ZbDb()
+        engine = Engine(db, partition_id=1, clock_millis=lambda: 0)
+        replayer = StreamProcessor(part.stream, db, engine,
+                                   mode=StreamProcessorMode.REPLAY)
+        t0 = time.perf_counter()
+        replayer.start()
+        replayer.run_until_idle()
+        elapsed = time.perf_counter() - t0
+        part.journal.close()
+        return {
+            "records_replayed": total_records,
+            "records_per_sec": round(total_records / elapsed, 1),
+        }
+
+
 # ---------------------------------------------------------------------------
 # kernel ceiling (device-only, auto jobs)
 
@@ -387,6 +415,7 @@ def main() -> None:
                                variables={})
     e2e_scope = run_e2e_workload([subprocess_boundary()], drives=1,
                                  n_instances=2000, variables={})
+    recovery = run_replay_recovery()
     ceiling = run_kernel_ceiling()
 
     value = e2e_one_task["transitions_per_sec"]
@@ -403,6 +432,7 @@ def main() -> None:
             "e2e_ten_tasks": e2e_ten,
             "e2e_subprocess_boundary": e2e_scope,
             "kernel_ceiling_transitions_per_sec": ceiling["transitions_per_sec"],
+            "replay_recovery": recovery,
             "platform": platform,
             "note": (
                 "e2e = commands on the committed log -> stream processor -> "

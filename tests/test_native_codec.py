@@ -153,3 +153,46 @@ def test_prefix_boundary_keys_visible_in_iterate():
         cf.put((big, "b"), 2)
         keys = [k for k, _ in cf.items(())]
         assert len(keys) == 2
+
+
+class TestNativeRecordFrameDecode:
+    """decode_record_frame (native/codec.c): one C call parses header +
+    reason + msgpack body; must agree with the pure-Python decoder on every
+    frame, including edge shapes."""
+
+    def _roundtrip_cases(self):
+        from zeebe_tpu.protocol import ValueType, command, event, rejection
+        from zeebe_tpu.protocol.enums import RejectionType
+        from zeebe_tpu.protocol.intent import JobIntent, ProcessInstanceIntent
+
+        yield command(ValueType.JOB, JobIntent.COMPLETE,
+                      {"variables": {"a": [1, 2.5, None, True, "s"]}}, key=7)
+        yield event(ValueType.PROCESS_INSTANCE, ProcessInstanceIntent.ELEMENT_ACTIVATED,
+                    {"elementId": "x" * 300, "nested": {"deep": [{"k": -1}]}},
+                    key=(3 << 51) | 42)
+        cmd = command(ValueType.JOB, JobIntent.FAIL, {}, key=1)
+        yield rejection(cmd, RejectionType.INVALID_STATE, "рфé unicode ✓ reason")
+
+    def test_parity_with_python_decoder(self):
+        import pytest
+
+        from zeebe_tpu.protocol import record as R
+
+        if R._decode_frame is R._py_decode_frame:
+            pytest.skip("native codec unavailable")
+        for rec in self._roundtrip_cases():
+            data = rec.to_bytes()
+            assert R._decode_frame(data) == R._py_decode_frame(data)
+
+    def test_truncated_frame_raises(self):
+        import pytest
+
+        from zeebe_tpu.protocol import Record
+        from zeebe_tpu.protocol import record as R
+
+        rec = next(iter(self._roundtrip_cases()))
+        data = rec.to_bytes()
+        # the public wrapper always surfaces truncation as ValueError
+        for cut in (0, 10, len(data) - 1):
+            with pytest.raises(ValueError):
+                Record.from_bytes(data[:cut])

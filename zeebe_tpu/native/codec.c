@@ -507,9 +507,109 @@ static PyObject *codec_set_error_class(PyObject *self, PyObject *cls)
     Py_RETURN_NONE;
 }
 
+/* Record wire frame (protocol/record.py _HEADER, little endian):
+ *   u8 recordType | u8 valueType | u8 intent | u8 rejectionType
+ *   i64 key | i64 sourceRecordPosition | i64 timestamp
+ *   i32 requestStreamId | i64 requestId | i64 operationReference
+ *   u16 rejectionReasonLen | reason utf-8 | u32 valueLen | value msgpack
+ * decode_record_frame(data) -> 12-tuple mirroring that order with the
+ * reason as str and the value as the decoded msgpack object — one C call
+ * replaces struct.unpack_from + two slices + a separate unpackb on the
+ * log-scan hot path. */
+#define FRAME_HEADER_SIZE (4 + 8 * 3 + 4 + 8 * 2 + 2)
+
+static int64_t rd_i64(const uint8_t *p) { int64_t v; memcpy(&v, p, 8); return v; }
+static int32_t rd_i32(const uint8_t *p) { int32_t v; memcpy(&v, p, 4); return v; }
+
+static PyObject *codec_decode_record_frame(PyObject *self, PyObject *arg)
+{
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0)
+        return NULL;
+    const uint8_t *p = (const uint8_t *)view.buf;
+    Py_ssize_t len = view.len;
+    PyObject *out = NULL, *reason = NULL, *value = NULL;
+    if (len < FRAME_HEADER_SIZE) {
+        codec_error("record frame truncated: %zd bytes", len);
+        goto done;
+    }
+    unsigned record_type = p[0], value_type = p[1], intent = p[2], rejection = p[3];
+    int64_t key = rd_i64(p + 4);
+    int64_t source_pos = rd_i64(p + 12);
+    int64_t timestamp = rd_i64(p + 20);
+    int32_t request_stream_id = rd_i32(p + 28);
+    int64_t request_id = rd_i64(p + 32);
+    int64_t operation_reference = rd_i64(p + 40);
+    unsigned reason_len = (unsigned)p[48] | ((unsigned)p[49] << 8);
+    Py_ssize_t off = FRAME_HEADER_SIZE;
+    if (off + (Py_ssize_t)reason_len + 4 > len) {
+        codec_error("record frame truncated in reason/value length");
+        goto done;
+    }
+    reason = PyUnicode_DecodeUTF8((const char *)p + off, reason_len, NULL);
+    if (!reason)
+        goto done;
+    off += reason_len;
+    uint32_t value_len = (uint32_t)p[off] | ((uint32_t)p[off + 1] << 8)
+        | ((uint32_t)p[off + 2] << 16) | ((uint32_t)p[off + 3] << 24);
+    off += 4;
+    if (off + (Py_ssize_t)value_len != len) {
+        codec_error("record frame length mismatch: header says %zd, got %zd",
+                    off + (Py_ssize_t)value_len, len);
+        goto done;
+    }
+    Reader r = {p + off, (Py_ssize_t)value_len, 0};
+    value = read_obj(&r, 0);
+    if (!value)
+        goto done;
+    if (r.pos != r.len) {
+        codec_error("trailing bytes after record value: %zd", r.len - r.pos);
+        goto done;
+    }
+    out = PyTuple_New(12);
+    if (!out)
+        goto done;
+    {
+        PyObject *items[12];
+        items[0] = PyLong_FromUnsignedLong(record_type);
+        items[1] = PyLong_FromUnsignedLong(value_type);
+        items[2] = PyLong_FromUnsignedLong(intent);
+        items[3] = PyLong_FromUnsignedLong(rejection);
+        items[4] = PyLong_FromLongLong(key);
+        items[5] = PyLong_FromLongLong(source_pos);
+        items[6] = PyLong_FromLongLong(timestamp);
+        items[7] = PyLong_FromLong(request_stream_id);
+        items[8] = PyLong_FromLongLong(request_id);
+        items[9] = PyLong_FromLongLong(operation_reference);
+        items[10] = reason;
+        items[11] = value;
+        for (int i = 0; i < 12; i++) {
+            if (!items[i]) { /* an int alloc failed: free the rest */
+                for (int j = 0; j < 12; j++)
+                    if (j != 10 && j != 11)
+                        Py_XDECREF(items[j]);
+                Py_CLEAR(out);
+                goto done;
+            }
+        }
+        for (int i = 0; i < 12; i++)
+            PyTuple_SET_ITEM(out, i, items[i]);
+        /* the tuple now owns reason/value */
+        reason = NULL;
+        value = NULL;
+    }
+done:
+    Py_XDECREF(reason);
+    Py_XDECREF(value);
+    PyBuffer_Release(&view);
+    return out;
+}
+
 static PyMethodDef codec_methods[] = {
     {"packb", codec_packb, METH_O, "Serialize an object to msgpack bytes."},
     {"unpackb", codec_unpackb, METH_O, "Deserialize one msgpack value (consumes all bytes)."},
+    {"decode_record_frame", codec_decode_record_frame, METH_O,
+     "Parse one record wire frame into a 12-tuple (header fields, reason, value)."},
     {"set_error_class", codec_set_error_class, METH_O, "Register the exception class raised on malformed input."},
     {NULL, NULL, 0, NULL},
 };

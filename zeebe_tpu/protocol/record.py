@@ -49,6 +49,33 @@ _INTENT_BY_VT = _intent_tables()
 #   u32 valueLen | value (msgpack)
 _HEADER = struct.Struct("<BBBBqqqiqqH")
 
+
+def _py_decode_frame(data: bytes) -> tuple:
+    """Pure-Python frame decode; same 12-tuple as the native fast path."""
+    fields = _HEADER.unpack_from(data, 0)
+    reason_len = fields[10]
+    off = _HEADER.size
+    reason = data[off : off + reason_len].decode("utf-8")
+    off += reason_len
+    (value_len,) = struct.unpack_from("<I", data, off)
+    off += 4
+    if off + value_len != len(data):
+        raise ValueError(
+            f"record frame length mismatch: header says {off + value_len}, got {len(data)}"
+        )
+    value = msgpack.unpackb(data[off : off + value_len])
+    return (*fields[:10], reason, value)
+
+
+from zeebe_tpu import native as _native  # noqa: E402  (cycle-free leaf package)
+
+_codec = _native.load_codec()
+_decode_frame = (
+    _codec.decode_record_frame
+    if _codec is not None and hasattr(_codec, "decode_record_frame")
+    else _py_decode_frame
+)
+
 NO_POSITION = -1
 NO_KEY = -1
 NO_REQUEST = -1
@@ -149,6 +176,9 @@ class Record:
     @classmethod
     def _from_bytes(cls, data: bytes, position: int, partition_id: int,
                     timestamp_override: int | None = None) -> "Record":
+        # one native call parses the fixed header, the rejection reason, and
+        # the msgpack body together (native/codec.c decode_record_frame);
+        # _py_decode_frame is the pure-Python fallback with identical output
         (
             record_type,
             value_type,
@@ -160,18 +190,9 @@ class Record:
             request_stream_id,
             request_id,
             operation_reference,
-            reason_len,
-        ) = _HEADER.unpack_from(data, 0)
-        off = _HEADER.size
-        reason = data[off : off + reason_len].decode("utf-8")
-        off += reason_len
-        (value_len,) = struct.unpack_from("<I", data, off)
-        off += 4
-        if off + value_len != len(data):
-            raise ValueError(
-                f"record frame length mismatch: header says {off + value_len}, got {len(data)}"
-            )
-        value = msgpack.unpackb(data[off : off + value_len])
+            reason,
+            value,
+        ) = _decode_frame(data)
         # dict lookups instead of Enum.__call__ (4 enum constructions per
         # record add up on the log-scan hot path)
         vt = _VT_BY_VALUE[value_type]
