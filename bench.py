@@ -386,6 +386,9 @@ def _ensure_backend() -> str:
     import subprocess
     import sys
 
+    from zeebe_tpu.utils.xla_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     if os.environ.get("ZB_BENCH_CPU"):
         jax.config.update("jax_platforms", "cpu")
         return "cpu-forced"

@@ -86,6 +86,9 @@ class _Segment:
         self.last_index = first_index - 1
         self.last_asqn = ASQN_IGNORE
         self.sparse: list[tuple[int, int]] = []  # (index, offset)
+        # (next_index, its_offset) after the last read_entry — log scans are
+        # sequential, so most reads jump straight here
+        self._read_hint: tuple[int, int] | None = None
         if create:
             self.file = open(path, "w+b")
             self.file.write(_SEG_HEADER.pack(_MAGIC, _VERSION, segment_id, first_index))
@@ -116,6 +119,7 @@ class _Segment:
         offset = _SEG_HEADER.size
         expected = self.first_index
         self.sparse.clear()
+        self._read_hint = None
         mv = None
         f.seek(0)
         mv = memoryview(f.read())
@@ -153,19 +157,25 @@ class _Segment:
         if asqn != ASQN_IGNORE:
             self.last_asqn = asqn
 
+    def _sparse_span(self, index: int) -> tuple[int, int]:
+        """(start_offset, end_offset) of the sparse span holding ``index`` —
+        O(1): record indexes are consecutive, so sparse entry k covers
+        records [first_index + k*N, first_index + (k+1)*N)."""
+        k = (index - self.first_index) // _SPARSE_EVERY
+        if k < 0 or not self.sparse:
+            return _SEG_HEADER.size, self.size
+        k = min(k, len(self.sparse) - 1)
+        start = self.sparse[k][1]
+        end = self.sparse[k + 1][1] if k + 1 < len(self.sparse) else self.size
+        return start, end
+
     def read_from(self, index: int) -> Iterator[JournalRecord]:
         """Yield records from ``index`` (clamped to first_index) to the end."""
         if index < self.first_index:
             index = self.first_index
         if index > self.last_index:
             return
-        # sparse seek: greatest indexed offset <= index
-        offset = _SEG_HEADER.size
-        for idx, off in self.sparse:
-            if idx <= index:
-                offset = off
-            else:
-                break
+        offset, _ = self._sparse_span(index)
         self.file.flush()
         self.file.seek(offset)
         mv = memoryview(self.file.read(self.size - offset))
@@ -188,13 +198,15 @@ class _Segment:
         without materializing the rest of the segment."""
         if index < self.first_index or index > self.last_index:
             return None
-        # nearest sparse offset at or before index
-        offset = _SEG_HEADER.size
-        for idx, off in self.sparse:
-            if idx <= index:
-                offset = off
-            else:
-                break
+        # sequential-read hint: log scans read index, index+1, … — the hint
+        # jumps straight to the frame with no sparse walk at all; otherwise
+        # the O(1) sparse floor bounds the walk to < _SPARSE_EVERY frames,
+        # skipped header-by-header (seek past bodies, never reading them)
+        hint = self._read_hint
+        if hint is not None and hint[0] == index:
+            offset = hint[1]
+        else:
+            offset, _ = self._sparse_span(index)
         f = self.file
         f.flush()
         while offset < self.size:
@@ -209,6 +221,7 @@ class _Segment:
                     raise CorruptedJournalError(
                         f"checksum mismatch reading record {rec_index} in {self.path}"
                     )
+                self._read_hint = (index + 1, offset + _FRAME.size + length)
                 return JournalRecord(rec_index, asqn, data)
             offset += _FRAME.size + length
         return None
@@ -233,6 +246,7 @@ class _Segment:
         self.last_index = new_last
         self.last_asqn = new_asqn
         self.sparse = [(i, o) for i, o in self.sparse if i <= new_last]
+        self._read_hint = None
 
     def flush(self) -> None:
         self.file.flush()

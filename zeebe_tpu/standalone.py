@@ -76,6 +76,9 @@ def main(argv: list[str] | None = None) -> int:
                              "contact points (including this node)")
     args = parser.parse_args(argv)
 
+    from zeebe_tpu.utils.xla_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     from zeebe_tpu.broker.config import load_broker_cfg
     from zeebe_tpu.gateway import ClusterRuntime, Gateway
 

@@ -1,0 +1,25 @@
+"""Persistent XLA compilation-cache setup, shared by every entry point.
+
+Kernel-backend compiles over a TPU tunnel cost tens of seconds per geometry;
+caching compiled executables on disk makes broker restarts, benchmark runs,
+and redeploys start warm. Harmless on CPU. The cache is an optimization
+only — any failure (read-only home, old jax) leaves compilation uncached.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_persistent_cache() -> None:
+    try:
+        import jax
+
+        cache_dir = os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.expanduser("~/.cache/zeebe_tpu_xla"))
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001
+        pass
