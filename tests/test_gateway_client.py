@@ -236,6 +236,12 @@ class TestModificationRpcs:
         )
         delete(pb.DeleteResourceRequest(
             resourceKey=deployed["processes"][0]["processDefinitionKey"]))
+        # deletion distributes asynchronously, like deployment: wait until no
+        # partition resolves the id before asserting the NOT_FOUND rejection
+        from zeebe_tpu.testing import await_resource_absent
+
+        _client, runtime = stack
+        await_resource_absent(runtime, ["modp"])
         with pytest.raises(grpc.RpcError) as err:
             client.create_instance("modp")
         assert err.value.code() == grpc.StatusCode.NOT_FOUND

@@ -192,6 +192,17 @@ class TestManagementServer:
         server.stop()
         broker.close()
 
+    def test_profile_endpoint_samples_threads(self, broker_stack):
+        """/profile: the sampling profiler aggregates thread stacks (the
+        management-surface profiling story; reference: actuator + JFR)."""
+        _broker, server, _clock, _net = broker_stack
+        status, body = self._get(server, "/profile?seconds=0.3")
+        assert status == 200
+        prof = json.loads(body)
+        assert prof["samples"] > 0
+        assert prof["threads"], prof
+        assert all(f["pct"] <= 100.0 for f in prof["hot_frames"])
+
     def _get(self, server, path):
         with urllib.request.urlopen(
             f"http://127.0.0.1:{server.port}{path}"

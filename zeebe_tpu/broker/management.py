@@ -9,6 +9,8 @@ Endpoints:
   GET  /ready     → 200 when every local partition has a role and a processor
   GET  /metrics   → Prometheus text exposition
   GET  /partitions → per-partition health dicts
+  GET  /profile   → sampling profiler over all runtime threads
+                    (?seconds=N, capped at 30; pump/kernel/io time split)
   POST /backups/<id> → trigger a cluster-consistent checkpoint
   GET  /backups   → backup store listing (when a store is configured)
   POST /pause | /resume → pause/resume stream processing (BrokerAdminService)
@@ -76,6 +78,19 @@ class ManagementServer:
             handler._send(200, json.dumps(
                 [p.health() for p in self.broker.partitions.values()]
             ))
+        elif path == "/profile":
+            from urllib.parse import parse_qs, urlsplit
+
+            params = parse_qs(urlsplit(handler.path).query)
+            try:
+                seconds = min(float(params.get("seconds", ["2.0"])[0]), 30.0)
+            except ValueError:
+                seconds = -1.0
+            if not 0 < seconds:  # also rejects NaN
+                handler._send(400, json.dumps(
+                    {"error": "seconds must be a positive number"}))
+                return
+            handler._send(200, json.dumps(sample_profile(seconds)))
         elif path == "/backups":
             if self.broker.backup_store is None:
                 handler._send(404, json.dumps({"error": "no backup store configured"}))
@@ -115,3 +130,52 @@ class ManagementServer:
         self.server.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=5)
+
+def sample_profile(seconds: float, hz: float = 100.0) -> dict:
+    """Sampling profiler over every runtime thread (the management
+    /profile endpoint — the reference exposes JFR/async-profiler through its
+    actuator; this is the in-process equivalent): snapshots all thread
+    stacks at ``hz`` for ``seconds`` and aggregates by frame, so hot
+    functions and per-thread time split (pump vs kernel vs io) read
+    straight off the response without attaching a debugger."""
+    import sys
+    import time as _time
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    samples = 0
+    by_frame: dict[str, int] = {}
+    by_thread: dict[str, int] = {}
+    deadline = _time.monotonic() + seconds
+    interval = 1.0 / hz
+    own = threading.get_ident()
+    while _time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == own:  # never profile the profiler's own stack
+                continue
+            name = names.get(ident, str(ident))
+            by_thread[name] = by_thread.get(name, 0) + 1
+            depth = 0
+            seen: set[str] = set()  # recursion must not inflate a frame
+            while frame is not None and depth < 40:
+                code = frame.f_code
+                key = f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}:{frame.f_lineno})"
+                if key not in seen:
+                    seen.add(key)
+                    by_frame[key] = by_frame.get(key, 0) + 1
+                frame = frame.f_back
+                depth += 1
+        samples += 1
+        _time.sleep(interval)
+    top = sorted(by_frame.items(), key=lambda kv: -kv[1])[:50]
+    total_stacks = max(sum(by_thread.values()), 1)
+    return {
+        "seconds": seconds,
+        "samples": samples,
+        "threads": dict(sorted(by_thread.items(), key=lambda kv: -kv[1])),
+        # pct = share of all sampled thread-stacks that contain the frame
+        "hot_frames": [
+            {"frame": k, "samples": v,
+             "pct": round(100.0 * v / total_stacks, 1)}
+            for k, v in top
+        ],
+    }

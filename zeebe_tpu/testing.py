@@ -410,6 +410,41 @@ class MultiPartitionHarness:
         return out
 
 
+def _await_partition_resources(runtime, process_ids, want_present: bool,
+                               what: str, timeout_s: float) -> None:
+    import time as _time
+
+    deadline = _time.time() + timeout_s
+    mismatched: list = [("*", "*")]
+    while _time.time() < deadline:
+        mismatched = []
+        for pid in range(1, runtime.partition_count + 1):
+            with runtime._plocks[pid]:
+                leader = runtime._leader_partition(pid)
+                if leader is None or leader.engine is None:
+                    mismatched.append((pid, "*"))
+                    continue
+                with leader.db.transaction():
+                    for process_id in process_ids:
+                        found = leader.engine.state.processes.get_latest_by_id(
+                            process_id) is not None
+                        if found != want_present:
+                            mismatched.append((pid, process_id))
+        if not mismatched:
+            return
+        _time.sleep(0.01)
+    raise TimeoutError(f"{what}: {mismatched}")
+
+
+def await_resource_absent(runtime, process_ids, timeout_s: float = 10.0) -> None:
+    """Inverse of await_deployment_distributed: block until NO partition
+    leader resolves the given process ids (resource DELETION distributes
+    asynchronously exactly like deployment)."""
+    _await_partition_resources(runtime, process_ids, want_present=False,
+                               what="resource deletion not distributed",
+                               timeout_s=timeout_s)
+
+
 def await_deployment_distributed(runtime, process_ids, timeout_s: float = 10.0) -> None:
     """Block until every partition leader of an in-process ClusterRuntime can
     resolve the given process ids. Deployment distribution is asynchronous by
@@ -419,27 +454,9 @@ def await_deployment_distributed(runtime, process_ids, timeout_s: float = 10.0) 
     legitimate NOT_FOUND behavior; tests that deploy-then-create on a
     multi-partition cluster should wait this race out the same way the
     reference's own tests await the RecordingExporter."""
-    import time as _time
-
-    deadline = _time.time() + timeout_s
-    remaining = None
-    while _time.time() < deadline:
-        remaining = []
-        for pid in range(1, runtime.partition_count + 1):
-            with runtime._plocks[pid]:
-                leader = runtime._leader_partition(pid)
-                if leader is None or leader.engine is None:
-                    remaining.append((pid, "*"))
-                    continue
-                with leader.db.transaction():
-                    for process_id in process_ids:
-                        if leader.engine.state.processes.get_latest_by_id(
-                                process_id) is None:
-                            remaining.append((pid, process_id))
-        if not remaining:
-            return
-        _time.sleep(0.01)
-    raise TimeoutError(f"deployment not distributed: {remaining}")
+    _await_partition_resources(runtime, process_ids, want_present=True,
+                               what="deployment not distributed",
+                               timeout_s=timeout_s)
 
 
 def distributing_client(client, runtime):
