@@ -740,7 +740,12 @@ class KernelBackend:
         import jax
         import jax.numpy as jnp
 
-        from zeebe_tpu.ops.automaton import run_collect, unpack_events
+        from zeebe_tpu.ops.automaton import (
+            PACK_MAX_ELEMENTS,
+            PACK_MAX_TOKENS,
+            run_collect,
+            unpack_events,
+        )
 
         tables = self.registry.tables
         insts = [a.inst for a in admitted]
@@ -757,6 +762,13 @@ class KernelBackend:
         T = self._pow2(max(4 * I, 4 * n_tokens))
         E = tables.max_elements
         S = tables.num_slots
+        if T > PACK_MAX_TOKENS or E >= PACK_MAX_ELEMENTS:
+            # the bit-packed event tensor carries dest in 16 bits and elem in
+            # 14 — geometries beyond that (absurd for real workloads) take
+            # the sequential path instead of corrupting the decode
+            logger.warning("kernel geometry T=%d E=%d exceeds event packing "
+                           "bounds; falling back", T, E)
+            return None
 
         elem = np.full(T, -1, np.int32)
         phase = np.zeros(T, np.int32)
@@ -816,15 +828,17 @@ class KernelBackend:
         FO = tables.out_target.shape[2]
         for _ in range(max(1, self.max_steps // chunk)):
             state, packed = run_collect(dt, state, n_steps=chunk, config=config)
-            packed_host = jax.device_get(packed).reshape(chunk, T, 4 + 2 * FO)
-            overflow = packed_host[-1, 1, 3]
-            active = packed_host[:, 0, 3]
+            flat = jax.device_get(packed)
+            # per row: T*(2+FO) packed event ints + (active, overflow) tail
+            events_host = flat[:, :-2].reshape(chunk, T, 2 + FO)
+            active = flat[:, -2]
+            overflow = flat[-1, -1]
             # steps after quiescence emit nothing — truncate so the host
             # decoder never walks empty tail steps
             quiesced = np.flatnonzero(active == 0)
             keep = int(quiesced[0]) + 1 if quiesced.size else chunk
             for s in range(keep):
-                steps.append(unpack_events(packed_host[s], I))
+                steps.append(unpack_events(events_host[s], I))
             if quiesced.size:
                 break
         else:

@@ -519,18 +519,28 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     return new_state, events
 
 
-def _pack_events(ev: dict, I: int, T: int) -> jax.Array:
-    """Pack one step's event pytree into a single int32 [T, 4 + 2*FO] tensor
-    (one device buffer per chunk transfer instead of ~11 — each host fetch
-    over the TPU tunnel pays per-buffer latency):
+# bit-packed event layout bounds: elem rides col 0 in 14 bits, and dest
+# (with its == T "no placement" sentinel) rides 16 bits of a dest|take
+# column; callers must fall back beyond these (realistic pools sit far
+# below both). The active count is NOT bound — it travels as a full int32
+# tail scalar.
+PACK_MAX_ELEMENTS = 1 << 14
+PACK_MAX_TOKENS = (1 << 16) - 1
 
-      col 0: flags — bit0 full_pass, bit1 task_arrive, bit2 task_done,
-             bit3 no_match, bit4 newly_done (row t < I = instance t)
-      col 1: elem, col 2: inst, col 3: active count (row 0 only)
-      cols 4..4+FO: dest per flow slot (T = none)
-      cols 4+FO..4+2*FO: take_mask per flow slot
+
+def _pack_events(ev: dict, I: int, T: int) -> jax.Array:
+    """Pack one step's event pytree into a single int32 [T, 2 + FO] tensor —
+    one device buffer per chunk transfer, bit-packed to halve the bytes the
+    host fetches over the TPU tunnel (per-buffer latency AND bandwidth both
+    bound the serving path):
+
+      col 0: flags(5b) | elem << 5 — bit0 full_pass, bit1 task_arrive,
+             bit2 task_done, bit3 no_match, bit4 newly_done (row t < I =
+             instance t)
+      col 1: inst
+      cols 2..2+FO: dest(16b) | take_mask << 16 per flow slot (dest == T
+                    means no token placed)
     """
-    FO = ev["take_mask"].shape[1]
     flags = (
         ev["full_pass"].astype(jnp.int32)
         | (ev["task_arrive"].astype(jnp.int32) << 1)
@@ -538,34 +548,28 @@ def _pack_events(ev: dict, I: int, T: int) -> jax.Array:
         | (ev["no_match"].astype(jnp.int32) << 3)
     )
     newly = jnp.zeros(T, jnp.int32).at[:I].set(ev["newly_done"].astype(jnp.int32))
-    flags = flags | (newly << 4)
+    flags = flags | (newly << 4) | (ev["elem"].astype(jnp.int32) << 5)
+    dest_take = ev["dest"].astype(jnp.int32) | (ev["take_mask"].astype(jnp.int32) << 16)
     return jnp.concatenate(
-        [
-            flags[:, None],
-            ev["elem"][:, None],
-            ev["inst"][:, None],
-            jnp.zeros((T, 1), jnp.int32).at[0, 0].set(ev["active"]),
-            ev["dest"].astype(jnp.int32),
-            ev["take_mask"].astype(jnp.int32),
-        ],
+        [flags[:, None], ev["inst"][:, None], dest_take],
         axis=1,
     )
 
 
 def unpack_events(packed: np.ndarray, I: int) -> dict:
-    """Host-side inverse of _pack_events for one step row ([T, 4+2*FO])."""
-    FO = (packed.shape[1] - 4) // 2
+    """Host-side inverse of _pack_events for one step row ([T, 2+FO])."""
     flags = packed[:, 0]
+    dest_take = packed[:, 2:]
     return {
         "full_pass": (flags & 1).astype(bool),
         "task_arrive": (flags & 2).astype(bool),
         "task_done": (flags & 4).astype(bool),
         "no_match": (flags & 8).astype(bool),
         "newly_done": (flags[:I] & 16).astype(bool),
-        "elem": packed[:, 1],
-        "inst": packed[:, 2],
-        "dest": packed[:, 4 : 4 + FO],
-        "take_mask": packed[:, 4 + FO :].astype(bool),
+        "elem": flags >> 5,
+        "inst": packed[:, 1],
+        "dest": dest_take & 0xFFFF,
+        "take_mask": (dest_take >> 16).astype(bool),
     }
 
 
@@ -577,14 +581,14 @@ def run_collect(tables: DeviceTables, state: dict, n_steps: int = 16, config=Non
     point of ``step`` (no executing tokens → all masks false, no counters
     move), so over-running costs idle FLOPs but never wrong events.
 
-    Returns (state', packed) where packed is ONE int32 [n_steps, T*(4+2*FO)]
-    tensor — per-step rows of _pack_events, flattened to 2-D before leaving
-    the device: a [steps, T, 6]-shaped output would be tile-padded on the
-    last axis (lane size 128) and the host fetch would transfer ~20x the
-    real bytes over the TPU tunnel. The host reshapes back to [steps, T, C]
-    and decodes with unpack_events. Per step, row 0's col 3 holds the
-    post-step active-token count — the host checks the last step's value to
-    decide whether another chunk is needed."""
+    Returns (state', packed) where packed is ONE int32
+    [n_steps, T*(2+FO) + 2] tensor — per-step rows of _pack_events flattened
+    to 2-D before leaving the device (a [steps, T, C] output would be
+    tile-padded on the last axis — lane size 128 — and the host fetch would
+    transfer ~20x the real bytes over the TPU tunnel), with the post-step
+    active-token count and the overflow flag appended as the final two
+    scalars of each row. The host splits those off, reshapes to
+    [steps, T, 2+FO], and decodes with unpack_events."""
     from zeebe_tpu.ops.tables import KernelConfig
 
     if config is None:
@@ -603,12 +607,12 @@ def run_collect(tables: DeviceTables, state: dict, n_steps: int = 16, config=Non
             # it must count as active or the chunk loop would truncate the
             # decode right before the scope's completion events
             active = active + _scope_drained(tables, state).sum()
-        ev["active"] = active
-        packed = _pack_events(ev, I, T)
-        # row 1 / col 3 is unused — carry the overflow flag so the host needs
-        # exactly one device fetch per chunk
-        packed = packed.at[1, 3].set(state["overflow"].astype(jnp.int32))
-        return state, packed.reshape(-1)
+        packed = _pack_events(ev, I, T).reshape(-1)
+        # append (active, overflow) so the host needs exactly one device
+        # fetch per chunk
+        tail = jnp.stack([active.astype(jnp.int32),
+                          state["overflow"].astype(jnp.int32)])
+        return state, jnp.concatenate([packed, tail])
 
     state, packed = jax.lax.scan(body, state, None, length=n_steps)
     return state, packed
