@@ -1022,3 +1022,50 @@ class TestHostEscapedStarts:
             assert h.is_instance_done(key)
         finally:
             h.close()
+
+
+def signal_catch_process(pid="sigp"):
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .service_task("before", job_type="sig_before")
+        .intermediate_catch_signal("wait_sig", "go_signal")
+        .service_task("after", job_type="sig_after")
+        .end_event("e")
+        .done()
+    )
+
+
+class TestSignalCatchOnKernel:
+    """Signal catch events park on the device like timer/message catches;
+    the broadcast resumes them through the sequential COMPLETE_ELEMENT path
+    (reference: SignalBroadcastProcessor → route_trigger)."""
+
+    def test_signal_catch_parity(self):
+        def scenario(h):
+            h.deploy(signal_catch_process())
+            h.create_instance("sigp", request_id=1)
+            drive_jobs(h, "sig_before")
+            h.broadcast_signal("go_signal")
+            drive_jobs(h, "sig_after")
+
+        assert_equivalent(scenario)
+
+    def test_signal_definitions_ride_the_kernel(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(signal_catch_process("ksig"))
+            h.create_instance("ksig", request_id=1)
+            with h.db.transaction():
+                meta = h.engine.state.processes.get_latest_by_id("ksig")
+            info = h.kernel_backend.registry.lookup(
+                meta["processDefinitionKey"], None)
+            assert info is not None
+            assert not info.host_idxs, "signal catch must not be escaped"
+            before = h.kernel_backend.commands_processed
+            assert drive_jobs(h, "sig_before") == 1  # arrives AT the catch
+            assert h.kernel_backend.commands_processed > before
+            h.broadcast_signal("go_signal")
+            assert drive_jobs(h, "sig_after") == 1
+        finally:
+            h.close()

@@ -36,6 +36,7 @@ class _Gen:
         self.has_no_default_gateway = False
         self.has_timers = False
         self.messages: set[str] = set()
+        self.signals: set[str] = set()
 
     def next_id(self, prefix: str) -> str:
         self.n += 1
@@ -110,11 +111,16 @@ class _Gen:
         return b.sub_process_done()
 
     def catch_event(self, b):
-        """A timer or message intermediate catch (rides the kernel's K_CATCH
-        park/resume path)."""
-        if self.rng.random() < 0.5:
+        """A timer, message, or signal intermediate catch (all ride the
+        kernel's K_CATCH park path; resumes differ per kind)."""
+        roll = self.rng.random()
+        if roll < 0.4:
             self.has_timers = True
             return b.intermediate_catch_timer(self.next_id("timer"), duration="PT5S")
+        if roll < 0.6:
+            name = f"sig_{self.next_id('sg')}"
+            self.signals.add(name)
+            return b.intermediate_catch_signal(self.next_id("scatch"), name)
         name = f"msg_{self.next_id('m')}"
         self.messages.add(name)
         return b.intermediate_catch_message(self.next_id("catch"), name,
@@ -249,6 +255,13 @@ def _drive(h: EngineHarness, gen: "_Gen", model, rng: random.Random,
                     variables[VAR_NAMES[job["key"] % len(VAR_NAMES)]] = job["key"] % 23
                 h.complete_job(job["key"], variables or None)
                 worked += 1
+        # broadcast each signal repeatedly within the round: chained catches
+        # (catch → catch → …) advance one catch per broadcast, and a single
+        # sweep would read as an idle round and abandon the tail. All runs
+        # issue the identical broadcast sequence, so parity is unaffected.
+        for _ in range(3):
+            for name in sorted(gen.signals):
+                h.broadcast_signal(name)
         # publish before advancing time so message-vs-timer races (event-based
         # gateways) can go either way instead of the timer always winning
         for name in sorted(gen.messages):

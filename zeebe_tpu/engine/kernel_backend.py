@@ -120,9 +120,11 @@ def check_element_eligibility(exe: ExecutableProcess, el: ExecutableElement) -> 
         return el.child_start_idx >= 0 and not exe.event_sub_processes_of(el.idx)
     if el.element_type == BpmnElementType.EVENT_BASED_GATEWAY:
         # parks on device like a catch; every succeeding catch must hold a
-        # wait state the reconstruction counts (fixed-duration timer or
-        # message) — an escaped target (e.g. signal) would open uncounted
-        # state, so the gateway escapes with it
+        # wait state the reconstruction counts — and _collect_wait_states
+        # counts ONLY fixed-duration timers and message subscriptions, so a
+        # signal target (kernel-eligible as a standalone catch) still forces
+        # the gateway host-side: its subscription would be open-but-uncounted
+        # state, defeating the trigger-mid-flight integrity check
         for fidx in el.outgoing:
             target = exe.elements[exe.flows[fidx].target_idx]
             if target.timer_duration is not None:
@@ -132,15 +134,15 @@ def check_element_eligibility(exe: ExecutableProcess, el: ExecutableElement) -> 
                 return False
         return bool(el.outgoing)
     if el.element_type == BpmnElementType.INTERMEDIATE_CATCH_EVENT:
-        # timer (fixed duration) and message catches park on device (K_CATCH)
-        # and are resumed by the host's TRIGGER / CORRELATE commands; duration
-        # and correlation-key expressions are evaluated on the host at
-        # emission, so they may reference variables freely
-        if el.signal_name is not None:
-            return False
+        # timer (fixed duration), message, and signal catches park on device
+        # (K_CATCH); the host resumes them via TRIGGER / CORRELATE /
+        # COMPLETE_ELEMENT commands — duration and correlation-key
+        # expressions are evaluated on the host at emission, so they may
+        # reference variables freely
         if el.timer_duration is not None:
-            return not el.timer_cycle and el.timer_date is None and el.message_name is None
-        return el.message_name is not None
+            return (not el.timer_cycle and el.timer_date is None
+                    and el.message_name is None and el.signal_name is None)
+        return el.message_name is not None or el.signal_name is not None
     op = _KERNEL_OP.get(el.element_type)
     if op is None:
         return False
@@ -506,6 +508,11 @@ class KernelBackend:
                     if not timers:
                         return None  # incident-parked or already fired
                     wait_docs.extend(dict(t) for _k, t in timers)
+                elif el.signal_name is not None:
+                    subs = state.signal_subscriptions.subscriptions_of(child_key)
+                    if not subs:
+                        return None  # broadcast mid-flight owns the instance
+                    wait_docs.extend(dict(s) for s in subs)
                 else:
                     sub = state.process_message_subscriptions.get(
                         child_key, el.message_name
@@ -1489,6 +1496,9 @@ class KernelBackend:
                     bpmn = self.engine.bpmn
                     if element.timer_duration is not None:
                         bpmn._create_timer(tok.key, value, element, element, writers)
+                    elif element.signal_name is not None:
+                        bpmn._open_signal_subscription(tok.key, value, element,
+                                                       writers)
                     else:
                         bpmn._open_message_subscription(tok.key, value, element,
                                                         element, writers)

@@ -353,6 +353,7 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                     (el.timer_duration is not None and not el.timer_cycle
                      and el.timer_date is None)
                     or el.message_name is not None
+                    or el.signal_name is not None
                 ):
                     # waits like a task; the host resumes it on TIMER TRIGGER /
                     # message correlation instead of job completion
