@@ -264,3 +264,28 @@ class TestTransform:
         assert exe.element("tmr").attached_to_idx == exe.element("t").idx
         assert exe.element("t").boundary_idxs == [exe.element("tmr").idx]
         assert exe.element("tmr").event_type == BpmnEventType.TIMER
+
+
+def test_receive_task_xml_round_trip():
+    """Receive tasks carry their message by ATTRIBUTE (messageRef) in BPMN;
+    the round trip must preserve both the message name and the subscription
+    correlation key, or an XML-deployed receive task waits forever."""
+    from zeebe_tpu.models.bpmn import Bpmn, parse_bpmn_xml, to_bpmn_xml
+
+    model = (
+        Bpmn.create_executable_process("rt")
+        .start_event("s")
+        .receive_task("wait", "order_msg", "= orderId")
+        .end_event("e")
+        .done()
+    )
+    xml = to_bpmn_xml(model)
+    assert 'messageRef=' in xml
+    assert "<bpmn:messageEventDefinition" not in xml.split("receiveTask")[1].split(">")[0]
+    parsed = next(m for m in parse_bpmn_xml(xml) if m.process_id == "rt")
+    el = parsed.elements["wait"]
+    assert el.message is not None
+    assert el.message.name == "order_msg"
+    assert el.message.correlation_key == "= orderId"
+    # and the round trip is stable
+    assert to_bpmn_xml(parsed) == xml

@@ -1069,3 +1069,50 @@ class TestSignalCatchOnKernel:
             assert drive_jobs(h, "sig_after") == 1
         finally:
             h.close()
+
+
+class TestReceiveTaskOnKernel:
+    def test_receive_task_parity(self):
+        """Receive tasks wait on a message like a catch event and ride the
+        same device park (reference: ReceiveTaskProcessor shares the catch
+        behavior)."""
+
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("rcv")
+                .start_event("s")
+                .service_task("first", job_type="rcv_first")
+                .receive_task("wait_msg", "order_placed", "= orderId")
+                .service_task("after", job_type="rcv_after")
+                .end_event("e")
+                .done()
+            )
+            h.create_instance("rcv", {"orderId": "o-9"}, request_id=1)
+            drive_jobs(h, "rcv_first")
+            h.publish_message("order_placed", "o-9")
+            drive_jobs(h, "rcv_after")
+
+        assert_equivalent(scenario)
+
+    def test_receive_task_rides_kernel(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(
+                Bpmn.create_executable_process("krcv")
+                .start_event("s")
+                .receive_task("wait_msg", "go_msg", "= k")
+                .end_event("e")
+                .done()
+            )
+            h.create_instance("krcv", {"k": "c1"}, request_id=1)
+            with h.db.transaction():
+                meta = h.engine.state.processes.get_latest_by_id("krcv")
+            info = h.kernel_backend.registry.lookup(
+                meta["processDefinitionKey"], None)
+            assert info is not None and not info.host_idxs
+            before = h.kernel_backend.commands_processed
+            h.publish_message("go_msg", "c1")
+            assert h.kernel_backend.commands_processed > before, (
+                "correlate resume should ride the kernel")
+        finally:
+            h.close()

@@ -133,7 +133,8 @@ def check_element_eligibility(exe: ExecutableProcess, el: ExecutableElement) -> 
             elif target.message_name is None:
                 return False
         return bool(el.outgoing)
-    if el.element_type == BpmnElementType.INTERMEDIATE_CATCH_EVENT:
+    if el.element_type in (BpmnElementType.INTERMEDIATE_CATCH_EVENT,
+                           BpmnElementType.RECEIVE_TASK):
         # timer (fixed duration), message, and signal catches park on device
         # (K_CATCH); the host resumes them via TRIGGER / CORRELATE /
         # COMPLETE_ELEMENT commands — duration and correlation-key
@@ -1488,7 +1489,8 @@ class KernelBackend:
                     )
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_ACTIVATED, value)
-                if element.element_type == BpmnElementType.INTERMEDIATE_CATCH_EVENT:
+                if element.element_type in (BpmnElementType.INTERMEDIATE_CATCH_EVENT,
+                                            BpmnElementType.RECEIVE_TASK):
                     # mirror BpmnProcessor._activate's catch branch: open the
                     # wait state (timer / message subscription) on the host —
                     # expressions evaluate against live variable state, and a

@@ -448,7 +448,8 @@ class ProcessBuilder:
         return self._add_element(el)
 
     def receive_task(self, element_id: str, message_name: str, correlation_key: str) -> "ProcessBuilder":
-        el = ProcessElement(element_id, BpmnElementType.RECEIVE_TASK)
+        el = ProcessElement(element_id, BpmnElementType.RECEIVE_TASK,
+                            event_type=BpmnEventType.MESSAGE)
         el.message = MessageDefinition(name=message_name, correlation_key=correlation_key)
         return self._add_element(el)
 

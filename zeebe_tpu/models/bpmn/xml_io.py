@@ -145,10 +145,14 @@ def _parse_event_definitions(el_xml, el: ProcessElement, messages, errors, signa
                 setattr(t, field, node.text.strip())
         el.timer = t
     msg = el_xml.find(f"{_B}messageEventDefinition")
-    if msg is not None:
+    # receive tasks reference their message by ATTRIBUTE (BPMN), events by a
+    # nested messageEventDefinition — same resolution either way
+    msg_ref = (msg.get("messageRef", "") if msg is not None
+               else el_xml.get("messageRef")
+               if el.element_type == BpmnElementType.RECEIVE_TASK else None)
+    if msg_ref is not None:
         el.event_type = BpmnEventType.MESSAGE
-        ref = msg.get("messageRef", "")
-        el.message = MessageDefinition(name=messages.get(ref, ref))
+        el.message = MessageDefinition(name=messages.get(msg_ref, msg_ref))
     err = el_xml.find(f"{_B}errorEventDefinition")
     if err is not None:
         el.event_type = BpmnEventType.ERROR
@@ -306,6 +310,10 @@ def _element_to_xml(parent, el: ProcessElement, message_names, error_codes,
         attrs["triggeredByEvent"] = "true"
     if el.default_flow_id:
         attrs["default"] = el.default_flow_id
+    if el.element_type == BpmnElementType.RECEIVE_TASK and el.message is not None:
+        # receive tasks reference their message by ATTRIBUTE in BPMN (unlike
+        # events, which nest a messageEventDefinition)
+        attrs["messageRef"] = message_names[el.message.name]
     node = ET.SubElement(parent, f"{_B}{_TYPE_TO_TAG[el.element_type]}", attrs)
 
     ext = None
@@ -364,7 +372,8 @@ def _element_to_xml(parent, el: ProcessElement, message_names, error_codes,
             ET.SubElement(timer, f"{_B}timeCycle").text = el.timer.cycle
         if el.timer.date:
             ET.SubElement(timer, f"{_B}timeDate").text = el.timer.date
-    elif el.event_type == BpmnEventType.MESSAGE and el.message is not None:
+    elif (el.event_type == BpmnEventType.MESSAGE and el.message is not None
+          and el.element_type != BpmnElementType.RECEIVE_TASK):
         ET.SubElement(
             node, f"{_B}messageEventDefinition", {"messageRef": message_names[el.message.name]}
         )

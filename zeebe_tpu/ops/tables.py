@@ -349,7 +349,8 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                     # form resolution reads FormState at activation time (the
                     # formKey header depends on the latest deployed form)
                     raise ConditionNotCompilable("form-linked user task")
-                if el.element_type == BpmnElementType.INTERMEDIATE_CATCH_EVENT and (
+                if (el.element_type in (BpmnElementType.INTERMEDIATE_CATCH_EVENT,
+                                        BpmnElementType.RECEIVE_TASK)) and (
                     (el.timer_duration is not None and not el.timer_cycle
                      and el.timer_date is None)
                     or el.message_name is not None
