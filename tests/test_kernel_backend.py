@@ -1116,3 +1116,54 @@ class TestReceiveTaskOnKernel:
                 "correlate resume should ride the kernel")
         finally:
             h.close()
+
+
+class TestEventSubProcessStaysSequential:
+    def test_root_esp_process_parity(self):
+        """Root-level event sub-processes need scope subscriptions at PROCESS
+        activation — out of the kernel's creation materializer's scope, so
+        the definition must run sequentially (and byte-identically)."""
+
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("espk")
+                .start_event("s")
+                .service_task("work", job_type="esp_work")
+                .end_event("e")
+                .event_sub_process("esp")
+                .message_start_event("esp_start", "interrupt_msg",
+                                     correlation_key="= key",
+                                     interrupting=True)
+                .service_task("handle", job_type="esp_handle")
+                .end_event("esp_e")
+                .sub_process_done()
+                .done()
+            )
+            h.create_instance("espk", {"key": "k1"}, request_id=1)
+            h.publish_message("interrupt_msg", "k1")
+            drive_jobs(h, "esp_handle")
+
+        assert_equivalent(scenario)
+
+    def test_root_esp_definition_not_admitted(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(
+                Bpmn.create_executable_process("espi")
+                .start_event("s")
+                .service_task("t", job_type="espi_w")
+                .end_event("e")
+                .event_sub_process("esp2")
+                .timer_start_event("ts", duration="PT1H", interrupting=False)
+                .end_event("ee")
+                .sub_process_done()
+                .done()
+            )
+            h.create_instance("espi", request_id=1)
+            with h.db.transaction():
+                meta = h.engine.state.processes.get_latest_by_id("espi")
+            assert h.kernel_backend.registry.lookup(
+                meta["processDefinitionKey"], None) is None
+            assert drive_jobs(h, "espi_w") == 1
+        finally:
+            h.close()

@@ -215,6 +215,15 @@ class KernelRegistry:
             # start element — nothing for the kernel's entry path to run
             self._ineligible.add(definition_key)
             return None
+        if exe.event_sub_processes_of(0):
+            # root-level event sub-processes open start-event subscriptions
+            # during PROCESS activation and their triggers interrupt root
+            # scope state — neither the creation materializer nor the
+            # reconstruction collects that, so these definitions stay
+            # sequential end to end (nested-scope ESPs already force their
+            # sub-process host-side via element eligibility)
+            self._ineligible.add(definition_key)
+            return None
         try:
             solo = compile_tables([exe], host_idxs=[host])
         except ConditionNotCompilable:
