@@ -1167,3 +1167,45 @@ class TestEventSubProcessStaysSequential:
             assert drive_jobs(h, "espi_w") == 1
         finally:
             h.close()
+
+
+class TestMoreHostEscapeShapes:
+    def test_call_activity_escape_parity(self):
+        """A call activity host-escapes; the drain spawns the CHILD process
+        instance mid-burst and the parent resumes on the kernel afterward."""
+
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("child_p")
+                .start_event("cs").service_task("ct", job_type="child_work")
+                .end_event("ce").done(),
+                Bpmn.create_executable_process("parent_p")
+                .start_event("s").service_task("pre", job_type="pre_work")
+                .call_activity("call", "child_p")
+                .service_task("post", job_type="post_work")
+                .end_event("e").done(),
+            )
+            h.create_instance("parent_p", request_id=1)
+            assert drive_jobs(h, "pre_work") == 1
+            assert drive_jobs(h, "child_work") == 1
+            assert drive_jobs(h, "post_work") == 1
+
+        assert_equivalent(scenario)
+
+    def test_script_task_escape_parity(self):
+        """Script tasks evaluate FEEL host-side; the escape drain runs the
+        expression and writes the result variable in sequential order."""
+
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("scr")
+                .start_event("s").service_task("a", job_type="scr_a")
+                .script_task("calc", expression="= x * 2",
+                             result_variable="doubled")
+                .service_task("b", job_type="scr_b").end_event("e").done()
+            )
+            h.create_instance("scr", {"x": 21}, request_id=1)
+            assert drive_jobs(h, "scr_a") == 1
+            assert drive_jobs(h, "scr_b") == 1
+
+        assert_equivalent(scenario)
