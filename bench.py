@@ -380,28 +380,22 @@ def run_kernel_ceiling() -> dict:
 def _ensure_backend() -> str:
     """Pick the JAX platform for this run. The TPU tunnel can hang
     indefinitely at first device use (observed: jax.devices() never
-    returns); probe it in a killable subprocess and fall back to CPU with
-    an explicit marker rather than hanging the whole bench run."""
+    returns); probe it with the shared killable-subprocess helper and fall
+    back to CPU with an explicit marker rather than hanging the bench run."""
     import os
-    import subprocess
-    import sys
 
+    from zeebe_tpu.utils.backend_probe import probe_default_backend
     from zeebe_tpu.utils.xla_cache import enable_persistent_cache
 
     enable_persistent_cache()
     if os.environ.get("ZB_BENCH_CPU"):
         jax.config.update("jax_platforms", "cpu")
         return "cpu-forced"
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=240, check=True,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-        )
-        return jax.devices()[0].platform
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+    probed = probe_default_backend()
+    if probed is None:
         jax.config.update("jax_platforms", "cpu")
         return "cpu-fallback(tpu-unreachable)"
+    return probed[0]
 
 
 def main() -> None:

@@ -1,0 +1,68 @@
+"""Killable probe of the default jax backend, shared by every entry point.
+
+On this host the TPU tunnel can hang *forever* at first device use
+(``jax.devices()`` never returns), so no driver may initialize the default
+backend in-process before knowing it answers. The probe runs the device query
+in a subprocess with a timeout — the one place the hazard is handled, so
+``bench.py`` and ``__graft_entry__.py`` cannot drift apart on timeout or
+interpretation (they did in round 2: the dryrun had no probe at all and
+recorded rc=124).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+#: one shared timeout so all drivers agree on whether the backend is up
+PROBE_TIMEOUT_SECS = 240
+
+
+def probe_default_backend(
+    cwd: str | None = None, timeout: int = PROBE_TIMEOUT_SECS
+) -> tuple[str, int] | None:
+    """(platform, device_count) of the default jax backend, or None.
+
+    None means the backend did not come up inside ``timeout`` (wedged tunnel)
+    or the probe subprocess failed — callers must pin the CPU platform before
+    their first in-process backend use. A ``("cpu", n)`` result may reflect
+    ``JAX_PLATFORMS=cpu`` / ``--xla_force_host_platform_device_count`` in the
+    inherited env; callers that need *real* chips must check the platform,
+    not just the count.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # caller already pinned cpu; don't burn the timeout on a subprocess
+        # (the TPU plugin on this host ignores the env var and would hang —
+        # only jax.config.update('jax_platforms', 'cpu') truly pins it)
+        flags = os.environ.get("XLA_FLAGS", "")
+        count = 1
+        for flag in flags.split():
+            if flag.startswith("--xla_force_host_platform_device_count="):
+                try:
+                    count = int(flag.split("=", 1)[1])
+                except ValueError:
+                    pass
+        return "cpu", count
+    code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout, capture_output=True, text=True,
+            cwd=cwd, env=dict(os.environ),
+        )
+        if proc.returncode != 0:
+            return None
+        platform, count = proc.stdout.split()[-2:]
+        return platform, int(count)
+    except (subprocess.TimeoutExpired, ValueError, IndexError):
+        return None
+
+
+def real_device_count(cwd: str | None = None,
+                      timeout: int = PROBE_TIMEOUT_SECS) -> int:
+    """Number of real (non-CPU) devices, or 0 if none/unreachable."""
+    res = probe_default_backend(cwd, timeout)
+    if res is None or res[0] == "cpu":
+        return 0
+    return res[1]
