@@ -215,11 +215,16 @@ class E2EPartition:
             pass
 
     def pending_job_keys(self, after_position: int) -> list[tuple[str, int, int]]:
+        """Worker-side job discovery over the log — a header-only scan that
+        decodes the value of JOB CREATED records only (LogStream.scan)."""
+        vt_job, created = int(ValueType.JOB), int(JobIntent.CREATED)
         jobs = []
-        for logged in self.stream.new_reader(after_position + 1):
-            rec = logged.record
-            if rec.value_type == ValueType.JOB and rec.is_event and int(rec.intent) == int(JobIntent.CREATED):
-                jobs.append((rec.value.get("type", ""), rec.value.get("processInstanceKey", -1), rec.key))
+        for view in self.stream.scan(after_position + 1):
+            if (view.value_type == vt_job and view.intent == created
+                    and view.is_event):
+                value = view.value
+                jobs.append((value.get("type", ""),
+                             value.get("processInstanceKey", -1), view.key))
         return jobs
 
     def complete_in_type_waves(self, jobs: list[tuple[str, int, int]]) -> float:
@@ -249,10 +254,10 @@ class E2EPartition:
         return elapsed
 
     def count_transitions(self, after_position: int) -> int:
+        vt_pi = int(ValueType.PROCESS_INSTANCE)
         n = 0
-        for logged in self.stream.new_reader(after_position + 1):
-            rec = logged.record
-            if rec.value_type == ValueType.PROCESS_INSTANCE and rec.is_event:
+        for view in self.stream.scan(after_position + 1):
+            if view.value_type == vt_pi and view.is_event:
                 n += 1
         return n
 

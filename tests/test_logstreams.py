@@ -92,3 +92,45 @@ class TestRecovery:
         p = s2.writer.try_write([LogAppendEntry(make_cmd())])
         assert p == 3
         journal2.close()
+
+
+class TestScan:
+    """Header-only lazy scan (LogStream.scan / RecordView)."""
+
+    def test_scan_matches_reader(self, stream):
+        for i in range(4):
+            stream.writer.try_write(
+                [LogAppendEntry(make_cmd(i)), LogAppendEntry(make_ev(i), processed=True)],
+                source_position=i,
+            )
+        full = list(stream.new_reader())
+        views = list(stream.scan())
+        assert len(views) == len(full)
+        for view, logged in zip(views, full):
+            assert view.position == logged.position
+            assert bool(view.processed) == logged.processed
+            assert view.source_position == logged.source_position
+            assert view.record_type == int(logged.record.record_type)
+            assert view.value_type == int(logged.record.value_type)
+            assert view.intent == int(logged.record.intent)
+            assert view.key == logged.record.key
+            assert view.is_event == logged.record.is_event
+            assert view.is_command == logged.record.is_command
+            # lazy record decode equals the eager reader's record
+            assert view.record == logged.record
+            assert view.value == logged.record.value
+
+    def test_scan_from_mid_batch_position(self, stream):
+        stream.writer.try_write([LogAppendEntry(make_cmd(i)) for i in range(3)])
+        stream.writer.try_write([LogAppendEntry(make_ev(9))])
+        assert [v.position for v in stream.scan(2)] == [2, 3, 4]
+        assert [v.position for v in stream.scan(5)] == []
+
+    def test_scan_uncached_batch(self, stream, tmp_path):
+        """A reopened stream (empty decode cache) scans via raw payloads."""
+        stream.writer.try_write([LogAppendEntry(make_cmd(7))])
+        reopened = LogStream(stream.journal, partition_id=1, clock=lambda: 1)
+        views = list(reopened.scan())
+        assert len(views) == 1
+        assert views[0].value["elementId"] == "el7"
+        assert views[0].record.timestamp == 12345

@@ -196,3 +196,61 @@ class TestNativeRecordFrameDecode:
         for cut in (0, 10, len(data) - 1):
             with pytest.raises(ValueError):
                 Record.from_bytes(data[:cut])
+
+
+class TestScanBatchHeaders:
+    """Native scan_batch_headers vs the pure-Python mirror."""
+
+    def _batch(self):
+        from zeebe_tpu.logstreams.log_stream import LogAppendEntry, _serialize_batch
+        from zeebe_tpu.protocol import ValueType
+        from zeebe_tpu.protocol.intent import JobIntent
+        from zeebe_tpu.protocol.record import command, event
+
+        entries = [
+            LogAppendEntry(command(ValueType.JOB, JobIntent.COMPLETE,
+                                   {"variables": {"x": [1, "s"]}}, key=(1 << 51) + 3)),
+            LogAppendEntry(event(ValueType.JOB, JobIntent.CREATED,
+                                 {"type": "w"}, key=(1 << 51) + 4), processed=True),
+            LogAppendEntry(command(ValueType.PROCESS_INSTANCE, JobIntent.COMPLETE,
+                                   {}, key=-1)),
+        ]
+        return _serialize_batch(entries, 500, 77, 1_699_999_999_001)
+
+    def test_parity_with_python_scanner(self):
+        from zeebe_tpu.logstreams.log_stream import _py_scan_batch_headers
+        from zeebe_tpu.native import load_codec
+
+        codec = load_codec()
+        assert codec is not None and hasattr(codec, "scan_batch_headers")
+        payload = self._batch()
+        py = _py_scan_batch_headers(payload)
+        nat = codec.scan_batch_headers(payload)
+        assert py[0] == nat[0] and py[1] == nat[1]
+        assert [tuple(r) for r in py[2]] == [tuple(r) for r in nat[2]]
+
+    def test_truncated_batch_raises_both_paths(self):
+        from zeebe_tpu.logstreams.log_stream import _py_scan_batch_headers
+        from zeebe_tpu.native import load_codec
+
+        codec = load_codec()
+        payload = self._batch()
+        for scanner in (codec.scan_batch_headers, _py_scan_batch_headers):
+            for cut in (3, 15, 25, len(payload) - 1):
+                with pytest.raises(msgpack.MsgPackError):
+                    scanner(payload[:cut])
+            with pytest.raises(msgpack.MsgPackError):
+                scanner(payload + b"\x00\x01\x02")  # trailing garbage
+
+    def test_corrupt_count_rejected_without_allocation(self):
+        import struct as _struct
+
+        from zeebe_tpu.logstreams.log_stream import _py_scan_batch_headers
+        from zeebe_tpu.native import load_codec
+
+        codec = load_codec()
+        payload = bytearray(self._batch())
+        _struct.pack_into("<I", payload, 0, 0xFFFFFFF0)
+        for scanner in (codec.scan_batch_headers, _py_scan_batch_headers):
+            with pytest.raises(msgpack.MsgPackError):
+                scanner(bytes(payload))

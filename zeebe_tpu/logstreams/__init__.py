@@ -6,6 +6,7 @@ from zeebe_tpu.logstreams.log_stream import (
     LogStream,
     LogStreamReader,
     LogStreamWriter,
+    RecordView,
     patch_prepatched_batch,
 )
 
@@ -15,5 +16,6 @@ __all__ = [
     "LogStream",
     "LogStreamReader",
     "LogStreamWriter",
+    "RecordView",
     "patch_prepatched_batch",
 ]

@@ -21,13 +21,111 @@ import threading
 import time
 from typing import Iterator
 
+from zeebe_tpu import native as _native
 from zeebe_tpu.journal import SegmentedJournal
 from zeebe_tpu.protocol import Record
+from zeebe_tpu.protocol.enums import RecordType
 from zeebe_tpu.protocol.msgpack import unpackb as msgpack_unpackb
 
 _BATCH_HEADER = struct.Struct("<IqQ")  # record count, source position, timestamp ms
 _ENTRY_HEADER = struct.Struct("<BqI")  # processed flag, position, record length
 _PACK_LE_Q = struct.Struct("<q")
+_FRAME_KEY = struct.Struct("<q")
+_FRAME_HEADER_SIZE = 50  # protocol/record.py _HEADER.size
+# hoisted for the scan hot loop (RecordView.is_event/is_command)
+_RT_EVENT = int(RecordType.EVENT)
+_RT_COMMAND = int(RecordType.COMMAND)
+
+
+def _py_scan_batch_headers(payload: bytes):
+    """Pure-Python mirror of the native scan_batch_headers: same tuples, and
+    the same MsgPackError on every malformed-input shape the C scanner
+    rejects (truncation, impossible lengths, trailing bytes)."""
+    from zeebe_tpu.protocol.msgpack import MsgPackError
+
+    n = len(payload)
+    if n < _BATCH_HEADER.size:
+        raise MsgPackError(f"batch payload truncated: {n} bytes")
+    count, source_position, timestamp = _BATCH_HEADER.unpack_from(payload, 0)
+    off = _BATCH_HEADER.size
+    records = []
+    for i in range(count):
+        if off + _ENTRY_HEADER.size > n:
+            raise MsgPackError(f"batch entry {i} truncated")
+        processed, position, length = _ENTRY_HEADER.unpack_from(payload, off)
+        off += _ENTRY_HEADER.size
+        if off + length > n or length < _FRAME_HEADER_SIZE:
+            raise MsgPackError(f"batch record {i} truncated")
+        records.append((
+            processed, position, payload[off], payload[off + 1],
+            payload[off + 2], _FRAME_KEY.unpack_from(payload, off + 4)[0],
+            off, length,
+        ))
+        off += length
+    if off != n:
+        raise MsgPackError(f"trailing bytes after batch: {n - off}")
+    return source_position, timestamp, records
+
+
+_codec = _native.load_codec()
+_scan_batch_headers = (
+    _codec.scan_batch_headers
+    if _codec is not None and hasattr(_codec, "scan_batch_headers")
+    else _py_scan_batch_headers
+)
+
+
+class RecordView:
+    """Header-only view of one record inside a sequenced batch.
+
+    A filtering scan (job discovery, export filters, command scans) reads the
+    fixed header fields — ``record_type``/``value_type``/``intent`` are the
+    raw wire ints, comparable to the IntEnums by value — and pays for the full
+    ``Record`` (rejection reason + msgpack value) only on first ``.record``
+    access."""
+
+    __slots__ = ("position", "processed", "source_position", "record_type",
+                 "value_type", "intent", "key", "_payload", "_off", "_len",
+                 "_timestamp", "_partition_id", "_record")
+
+    def __init__(self, position, processed, source_position, record_type,
+                 value_type, intent, key, payload, off, length, timestamp,
+                 partition_id, record=None):
+        self.position = position
+        self.processed = processed
+        self.source_position = source_position
+        self.record_type = record_type
+        self.value_type = value_type
+        self.intent = intent
+        self.key = key
+        self._payload = payload
+        self._off = off
+        self._len = length
+        self._timestamp = timestamp
+        self._partition_id = partition_id
+        self._record = record
+
+    @property
+    def is_event(self) -> bool:
+        return self.record_type == _RT_EVENT
+
+    @property
+    def is_command(self) -> bool:
+        return self.record_type == _RT_COMMAND
+
+    @property
+    def record(self) -> Record:
+        if self._record is None:
+            self._record = Record.from_bytes(
+                self._payload[self._off : self._off + self._len],
+                position=self.position, partition_id=self._partition_id,
+                timestamp=self._timestamp,
+            )
+        return self._record
+
+    @property
+    def value(self):
+        return self.record.value
 
 
 @dataclasses.dataclass(frozen=True, slots=True)
@@ -417,6 +515,51 @@ class LogStream:
             if nxt:
                 return nxt[0], slot + 1
         return None, slot
+
+    def scan(self, from_position: int = 1) -> Iterator[RecordView]:
+        """Header-only forward scan from ``from_position``: yields
+        ``RecordView``s whose full records (msgpack values) decode lazily on
+        first access. The cheap path for filtering consumers — job discovery,
+        export filters, metrics sweeps — that inspect header fields of every
+        record but need the value of few. Batches already decoded in the cache
+        are served from it; undecoded batches are scanned natively without
+        populating the cache."""
+        from_position = max(from_position, 1)
+        if from_position > self.last_position:
+            return
+        slot = self._batch_slot_for(from_position)
+        if slot < 0:
+            slot = 0
+        pid = self.partition_id
+        for s in range(slot, len(self._batch_indexes)):
+            jindex = self._batch_indexes[s]
+            cached = self._batch_cache.get(jindex)
+            if cached is not None:
+                for logged in cached:
+                    if logged.position < from_position:
+                        continue
+                    rec = logged.record
+                    yield RecordView(
+                        logged.position, logged.processed,
+                        logged.source_position, int(rec.record_type),
+                        int(rec.value_type), int(rec.intent), rec.key,
+                        None, 0, 0, rec.timestamp, pid, record=rec,
+                    )
+                continue
+            jrec = self.journal.read_entry(jindex)
+            if jrec is None:
+                continue
+            payload = jrec.data
+            source_position, timestamp, headers = _scan_batch_headers(payload)
+            for (processed, position, record_type, value_type, intent, key,
+                 off, length) in headers:
+                if position < from_position:
+                    continue
+                yield RecordView(
+                    position, bool(processed), source_position, record_type,
+                    value_type, intent, key, payload, off, length, timestamp,
+                    pid,
+                )
 
     def read_batch_containing(self, position: int) -> list[LoggedRecord]:
         """The whole sequenced batch holding ``position`` (for batch replay)."""

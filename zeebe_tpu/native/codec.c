@@ -605,11 +605,85 @@ done:
     return out;
 }
 
+/* Sequenced-batch header scan (logstreams/log_stream.py framing):
+ *   batch header:  u32 count | i64 sourcePosition | u64 timestamp
+ *   per entry:     u8 processed | i64 position | u32 recordLen | frame
+ * scan_batch_headers(payload) -> (source_position, timestamp,
+ *   [(processed, position, record_type, value_type, intent, key,
+ *     frame_off, frame_len), ...])
+ * Only the fixed frame prefix is touched — rejection reason and msgpack
+ * value stay raw bytes, so a filtering scan (job discovery, command scan,
+ * export filters) pays nothing for records it skips. */
+#define BATCH_HEADER_SIZE (4 + 8 + 8)
+#define ENTRY_HEADER_SIZE (1 + 8 + 4)
+
+static PyObject *codec_scan_batch_headers(PyObject *self, PyObject *arg)
+{
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0)
+        return NULL;
+    const uint8_t *p = (const uint8_t *)view.buf;
+    Py_ssize_t len = view.len;
+    PyObject *out = NULL, *records = NULL;
+    if (len < BATCH_HEADER_SIZE) {
+        codec_error("batch payload truncated: %zd bytes", len);
+        goto done;
+    }
+    uint32_t count = (uint32_t)rd_i32(p);
+    int64_t source_position = rd_i64(p + 4);
+    int64_t timestamp = rd_i64(p + 12);
+    /* a corrupted count must not drive a huge allocation: every entry needs
+     * at least its header, so this bound holds for any valid payload */
+    if ((Py_ssize_t)count > (len - BATCH_HEADER_SIZE) / ENTRY_HEADER_SIZE) {
+        codec_error("batch count %u impossible for %zd-byte payload", count, len);
+        goto done;
+    }
+    records = PyList_New((Py_ssize_t)count);
+    if (!records)
+        goto done;
+    Py_ssize_t off = BATCH_HEADER_SIZE;
+    for (uint32_t i = 0; i < count; i++) {
+        if (off + ENTRY_HEADER_SIZE > len) {
+            codec_error("batch entry %u truncated", i);
+            goto done;
+        }
+        unsigned processed = p[off];
+        int64_t position = rd_i64(p + off + 1);
+        uint32_t rec_len = (uint32_t)rd_i32(p + off + 9);
+        off += ENTRY_HEADER_SIZE;
+        if (off + (Py_ssize_t)rec_len > len || rec_len < FRAME_HEADER_SIZE) {
+            codec_error("batch record %u truncated", i);
+            goto done;
+        }
+        const uint8_t *f = p + off;
+        PyObject *tup = Py_BuildValue(
+            "(iLiiiLnn)", (int)processed, (long long)position,
+            (int)f[0], (int)f[1], (int)f[2], (long long)rd_i64(f + 4),
+            (Py_ssize_t)off, (Py_ssize_t)rec_len);
+        if (!tup)
+            goto done;
+        PyList_SET_ITEM(records, (Py_ssize_t)i, tup);
+        off += rec_len;
+    }
+    if (off != len) {
+        codec_error("trailing bytes after batch: %zd", len - off);
+        goto done;
+    }
+    out = Py_BuildValue("(LLO)", (long long)source_position,
+                        (long long)timestamp, records);
+done:
+    Py_XDECREF(records);
+    PyBuffer_Release(&view);
+    return out;
+}
+
 static PyMethodDef codec_methods[] = {
     {"packb", codec_packb, METH_O, "Serialize an object to msgpack bytes."},
     {"unpackb", codec_unpackb, METH_O, "Deserialize one msgpack value (consumes all bytes)."},
     {"decode_record_frame", codec_decode_record_frame, METH_O,
      "Parse one record wire frame into a 12-tuple (header fields, reason, value)."},
+    {"scan_batch_headers", codec_scan_batch_headers, METH_O,
+     "Parse a sequenced batch into per-record header tuples without decoding values."},
     {"set_error_class", codec_set_error_class, METH_O, "Register the exception class raised on malformed input."},
     {NULL, NULL, 0, NULL},
 };
