@@ -87,8 +87,11 @@ def log_fingerprint(harness):
     return out
 
 
-def run_scenario(use_kernel: bool, scenario) -> tuple[list, list]:
-    h = EngineHarness(use_kernel_backend=use_kernel)
+def run_scenario(use_kernel: bool, scenario, clock_start: int | None = None) -> tuple[list, list]:
+    from zeebe_tpu.testing import ControlledClock
+
+    clock = None if clock_start is None else ControlledClock(clock_start)
+    h = EngineHarness(use_kernel_backend=use_kernel, clock=clock)
     try:
         scenario(h)
         return log_fingerprint(h), list(h.responses)
@@ -96,9 +99,9 @@ def run_scenario(use_kernel: bool, scenario) -> tuple[list, list]:
         h.close()
 
 
-def assert_equivalent(scenario):
-    seq_log, seq_resp = run_scenario(False, scenario)
-    ker_log, ker_resp = run_scenario(True, scenario)
+def assert_equivalent(scenario, clock_start: int | None = None):
+    seq_log, seq_resp = run_scenario(False, scenario, clock_start)
+    ker_log, ker_resp = run_scenario(True, scenario, clock_start)
     assert ker_log == seq_log
     # responses: same records to the same requests (order may interleave
     # identically here since the harness is single-threaded)
@@ -406,22 +409,180 @@ class TestCatchEventsOnKernel:
         finally:
             h.close()
 
-    def test_timer_fast_path_not_templated(self):
-        # clock-derived due dates are unexplained large ints — the capture
-        # safety net must reject the template rather than bake a stale due
-        # date into later instantiations
+    def test_timer_bursts_rejected_under_small_clock(self):
+        # under a small (test) clock, a clock-derived due date is
+        # indistinguishable from a plain constant — TIMER-writing bursts must
+        # store a rejected (None) template rather than bake a stale due date
         h = EngineHarness(use_kernel_backend=True)
         h.kernel_backend.audit_templates = False
         try:
             h.deploy(timer_catch_process())
             for _ in range(4):
                 h.create_instance("timerProcess")
-            # creation bursts arrive at the timer catch (clock-derived due
-            # date) — never templated, not even attempted
             assert h.kernel_backend.template_hits == 0
-            assert not [k for k in h.kernel_backend._templates if k[0] == "c"]
+            creation_templates = [
+                v for k, v in h.kernel_backend._templates.items() if k[0] == "c"
+            ]
+            assert creation_templates and all(t is None for t in creation_templates)
         finally:
             h.close()
+
+    EPOCH = 1_700_000_000_000
+
+    def test_timer_bursts_template_under_epoch_clock(self):
+        # epoch-scaled clocks express fresh due dates as ("clock", delta)
+        # roles and parked due dates as fingerprint-extracted ("fp", i) roles:
+        # timer workloads template across instances AND across clock advance.
+        # audit_templates stays ON, so every hit is byte/state/response
+        # shadow-checked against the slow path.
+        from zeebe_tpu.testing import ControlledClock
+
+        h = EngineHarness(use_kernel_backend=True,
+                          clock=ControlledClock(self.EPOCH))
+        try:
+            h.deploy(timer_catch_process())
+            for _ in range(4):
+                h.create_instance("timerProcess")
+                h.advance_time(7)  # due dates differ per instance
+            kb = h.kernel_backend
+            assert kb.template_audits >= 3, (
+                kb.template_hits, kb.template_misses, kb.template_audits)
+            # the created timers carry distinct clock-derived due dates
+            from zeebe_tpu.protocol import ValueType
+            from zeebe_tpu.protocol.intent import TimerIntent
+
+            dues = [
+                v.record.value["dueDate"] for v in h.stream.scan()
+                if v.value_type == int(ValueType.TIMER) and v.is_event
+                and v.intent == int(TimerIntent.CREATED)
+            ]
+            assert len(dues) == 4 and len(set(dues)) == 4
+            assert all(d >= self.EPOCH + 10_000 for d in dues)
+            # trigger + complete: resume bursts with dueDate in the admission
+            # docs template via fp roles (audited equally)
+            audits_before = kb.template_audits
+            h.advance_time(11_000)
+            drive_jobs(h, "after_timer")
+            assert kb.template_audits > audits_before
+        finally:
+            h.close()
+
+    def test_boundary_timer_templating_under_epoch_clock(self):
+        # the bench subprocess_boundary shape: an embedded sub-process whose
+        # inner task carries a timer boundary. Completing the task cancels
+        # the boundary timer — its dueDate reaches the burst via the parked
+        # wait doc and must resolve as an ("fp", i) role, so instances with
+        # different due dates share one template (audited hits).
+        from zeebe_tpu.testing import ControlledClock
+
+        def sub_bnd(pid="sub_bnd"):
+            return (
+                Bpmn.create_executable_process(pid)
+                .start_event("s")
+                .sub_process("sub")
+                .start_event("is_")
+                .service_task("inner", job_type="inner_w")
+                .boundary_timer("tb", attached_to="inner", duration="PT1H")
+                .end_event("bnd_e")
+                .move_to_element("inner")
+                .end_event("ie")
+                .sub_process_done()
+                .end_event("e")
+                .done()
+            )
+
+        h = EngineHarness(use_kernel_backend=True,
+                          clock=ControlledClock(self.EPOCH))
+        try:
+            h.deploy(sub_bnd())
+            for _ in range(5):
+                h.create_instance("sub_bnd")
+                h.advance_time(9)  # distinct boundary-timer due dates
+            kb = h.kernel_backend
+            drive_jobs(h, "inner_w")
+            # creations after the first and completes after the first hit
+            # (audited); distinct due dates must NOT split the cache
+            assert kb.template_audits >= 7, (
+                kb.template_hits, kb.template_misses, kb.template_audits)
+        finally:
+            h.close()
+
+    def test_variable_duration_templates_under_epoch_clock(self):
+        # duration "= wait_ms" is clock-free: delta is a pure function of the
+        # fingerprint-pinned variables, so ("clock", delta) roles are exact
+        # and the bursts template (audited)
+        from zeebe_tpu.testing import ControlledClock
+
+        def proc(pid="vardur"):
+            return (
+                Bpmn.create_executable_process(pid)
+                .start_event("s")
+                .intermediate_catch_timer("wait", duration="= wait_ms")
+                .end_event("e")
+                .done()
+            )
+
+        h = EngineHarness(use_kernel_backend=True,
+                          clock=ControlledClock(self.EPOCH))
+        try:
+            h.deploy(proc())
+            for _ in range(3):
+                h.create_instance("vardur", variables={"wait_ms": 5000})
+                h.advance_time(3)
+            kb = h.kernel_backend
+            assert kb.template_audits >= 2, (
+                kb.template_hits, kb.template_misses, kb.template_audits)
+        finally:
+            h.close()
+
+    def test_now_entangled_duration_never_templates(self):
+        # duration referencing now() makes the due date NOT clock+constant:
+        # the creation site poisons the capture, so the burst must store a
+        # declined (None) template — baking a ("clock", delta) role here
+        # would silently drift the due date on every later hit
+        from zeebe_tpu.testing import ControlledClock
+
+        def proc(pid="nowdur"):
+            return (
+                Bpmn.create_executable_process(pid)
+                .start_event("s")
+                .intermediate_catch_timer("wait", duration="= 1000 + now() - now()")
+                .end_event("e")
+                .done()
+            )
+
+        h = EngineHarness(use_kernel_backend=True,
+                          clock=ControlledClock(self.EPOCH))
+        try:
+            h.deploy(proc())
+            for _ in range(3):
+                h.create_instance("nowdur")
+                h.advance_time(3)
+            kb = h.kernel_backend
+            assert kb.template_hits == 0 and kb.template_audits == 0
+            creation_templates = [
+                v for k, v in kb._templates.items() if k[0] == "c"
+            ]
+            assert creation_templates and all(t is None for t in creation_templates)
+        finally:
+            h.close()
+
+    def test_timer_process_epoch_clock_parity(self):
+        # full-log byte equality vs the sequential engine with an epoch clock
+        # (the configuration where clock/fp roles are live)
+        def scenario(h):
+            h.deploy(timer_catch_process())
+            for _ in range(4):
+                h.create_instance("timerProcess")
+                h.advance_time(13)
+            h.advance_time(11_000)
+            drive_jobs(h, "after_timer")
+            h.advance_time(50)
+            h.create_instance("timerProcess")
+            h.advance_time(11_000)
+            drive_jobs(h, "after_timer")
+
+        assert_equivalent(scenario, clock_start=self.EPOCH)
 
 
 def string_routing(pid="strp"):

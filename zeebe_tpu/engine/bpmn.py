@@ -606,10 +606,19 @@ class BpmnProcessor:
     def _create_timer(self, host_key: int, value: dict, catching: ExecutableElement,
                       host: ExecutableElement, writers: Writers,
                       repetitions: int = 1, interval: int = -1) -> None:
+        from zeebe_tpu.engine.burst_templates import (
+            note_clock_poison,
+            note_clock_value,
+        )
+
+        clock_free = True
         try:
             if catching.timer_duration is not None:
                 context = self.state.variables.collect(host_key)
                 duration = self._eval_duration_millis(catching.timer_duration, context)
+                # a now()-referencing duration makes the due date NOT
+                # clock + constant — template captures must decline
+                clock_free = not catching.timer_duration.references_clock()
             elif catching.timer_cycle:
                 # R<n>/<duration> cycle (non-interrupting repeating events)
                 from zeebe_tpu.utils import parse_cycle
@@ -622,6 +631,11 @@ class BpmnProcessor:
             self._raise_incident(writers, host_key, value, ErrorType.EXTRACT_VALUE_ERROR, str(exc))
             return
         timer_key = self.state.next_key()
+        due_date = self.clock_millis() + duration
+        if clock_free:
+            note_clock_value(due_date, duration)
+        else:
+            note_clock_poison()
         writers.append_event(
             timer_key, ValueType.TIMER, TimerIntent.CREATED,
             {
@@ -630,7 +644,7 @@ class BpmnProcessor:
                 "elementInstanceKey": host_key,
                 "processInstanceKey": value.get("processInstanceKey", -1),
                 "processDefinitionKey": value.get("processDefinitionKey", -1),
-                "dueDate": self.clock_millis() + duration,
+                "dueDate": due_date,
                 "repetitions": repetitions,
                 "interval": interval if interval > 0 else duration,
             },

@@ -95,12 +95,83 @@ class NotTemplatable(Exception):
 # role resolution
 
 
-def _role_of(v: Any, role_map: dict[int, tuple]) -> tuple | None:
-    if isinstance(v, RoleInt):
-        return v.role
-    if isinstance(v, int) and not isinstance(v, bool) and v >= _ROLE_VALUE_MIN:
-        return role_map.get(int(v))
-    return None
+class Roles:
+    """Capture-time role context: which template input does an int stand for.
+
+    - ``role_map``: exact value → role. Keys (pi/tok/cmd/mint/wait), request
+      ids, fingerprint-extracted document fields (("fp", i) — dueDate /
+      deadline values read from admission docs, normalized out of the cache
+      fingerprint and re-extracted per command at the same canonical
+      position), and clock-note values (("clock", delta) — due dates the
+      engine computed as clock + clock-free-duration during this capture,
+      recorded by the ``clock_note`` hooks below).
+    - ``allowed``: large ints the fingerprint pins byte-for-byte — they may
+      appear as constants (the slow path copies them verbatim).
+
+    There is deliberately NO range-based clock detection: an unexplained
+    value near the clock could be an engine-computed quantity that is NOT
+    clock + fixed-delta (e.g. a now()-entangled FEEL result), and patching
+    it as one would silently corrupt later instantiations. Clock roles come
+    only from provenance (the notes), everything else unexplained rejects.
+    """
+
+    __slots__ = ("role_map", "allowed")
+
+    def __init__(self, role_map: dict[int, tuple],
+                 allowed: frozenset[int] | set[int] = frozenset()) -> None:
+        self.role_map = role_map
+        self.allowed = allowed
+
+    def of(self, v: Any) -> tuple | None:
+        if isinstance(v, RoleInt):
+            return v.role
+        if not isinstance(v, int) or isinstance(v, bool) or v < _ROLE_VALUE_MIN:
+            return None
+        return self.role_map.get(int(v))
+
+
+# ---------------------------------------------------------------------------
+# clock-value provenance notes
+#
+# The engine's timer machinery computes clock-derived values (dueDate =
+# clock + duration). During a template capture/audit run the kernel backend
+# activates this collector; the computing site reports each value together
+# with its clock-free delta — or poisons the run when the delta itself reads
+# the clock (a now()-referencing duration expression), because such a value
+# cannot be expressed as clock + constant. Inactive outside capture runs
+# (plain attribute check), so the hot sequential path pays ~nothing.
+
+import threading as _threading
+
+_clock_notes = _threading.local()
+
+
+def clock_note_begin() -> None:
+    _clock_notes.items = []
+    _clock_notes.poison = False
+
+
+def clock_note_end() -> tuple[list[tuple[int, int]], bool]:
+    items = getattr(_clock_notes, "items", None) or []
+    poison = getattr(_clock_notes, "poison", False)
+    _clock_notes.items = None
+    _clock_notes.poison = False
+    return items, poison
+
+
+def note_clock_value(value: int, delta: int) -> None:
+    """Report ``value = clock + delta`` with ``delta`` a pure function of
+    the (fingerprint-pinned) variable context."""
+    items = getattr(_clock_notes, "items", None)
+    if items is not None:
+        items.append((int(value), int(delta)))
+
+
+def note_clock_poison() -> None:
+    """Report a clock-derived value whose delta is NOT clock-free — the
+    enclosing burst must not be templated."""
+    if getattr(_clock_notes, "items", None) is not None:
+        _clock_notes.poison = True
 
 
 # ---------------------------------------------------------------------------
@@ -118,9 +189,9 @@ _pack_i32 = struct.Struct(">i").pack
 _pack_i64 = struct.Struct(">q").pack
 
 
-def _pack_with_roles(obj: Any, buf: bytearray, patches: list, role_map: dict,
+def _pack_with_roles(obj: Any, buf: bytearray, patches: list, roles: Roles,
                      unknown: list | None = None) -> None:
-    role = _role_of(obj, role_map)
+    role = roles.of(obj)
     if role is not None:
         v = int(obj)
         if not (0 <= v < 1 << 64) or v < _ROLE_VALUE_MIN:
@@ -182,7 +253,7 @@ def _pack_with_roles(obj: Any, buf: bytearray, patches: list, role_map: dict,
             buf.append(0xDD)
             buf += _pack_u32(n)
         for item in obj:
-            _pack_with_roles(item, buf, patches, role_map, unknown)
+            _pack_with_roles(item, buf, patches, roles, unknown)
     elif isinstance(obj, dict):
         n = len(obj)
         if n < 16:
@@ -194,8 +265,8 @@ def _pack_with_roles(obj: Any, buf: bytearray, patches: list, role_map: dict,
             buf.append(0xDF)
             buf += _pack_u32(n)
         for k, v in obj.items():
-            _pack_with_roles(k, buf, patches, role_map, unknown)
-            _pack_with_roles(v, buf, patches, role_map, unknown)
+            _pack_with_roles(k, buf, patches, roles, unknown)
+            _pack_with_roles(v, buf, patches, roles, unknown)
     else:
         raise NotTemplatable(f"cannot template msgpack type {type(obj).__name__}")
 
@@ -237,9 +308,9 @@ def _pack_int_plain(v: int, buf: bytearray) -> None:
 # value-object templating (state writes, response record values)
 
 
-def _templatize_value(obj: Any, role_map: dict, unknown: list | None = None):
+def _templatize_value(obj: Any, roles: Roles, unknown: list | None = None):
     """Replace role ints with _RoleSlot sentinels; returns (template, n_roles)."""
-    role = _role_of(obj, role_map)
+    role = roles.of(obj)
     if role is not None:
         return _RoleSlot(role), 1
     if (unknown is not None and isinstance(obj, int) and not isinstance(obj, bool)
@@ -249,8 +320,8 @@ def _templatize_value(obj: Any, role_map: dict, unknown: list | None = None):
         n = 0
         out = {}
         for k, v in obj.items():
-            kt, nk = _templatize_value(k, role_map, unknown)
-            vt, nv = _templatize_value(v, role_map, unknown)
+            kt, nk = _templatize_value(k, roles, unknown)
+            vt, nv = _templatize_value(v, roles, unknown)
             out[k if nk == 0 else kt] = vt
             n += nk + nv
         return out, n
@@ -258,7 +329,7 @@ def _templatize_value(obj: Any, role_map: dict, unknown: list | None = None):
         items = []
         n = 0
         for v in obj:
-            vt, nv = _templatize_value(v, role_map, unknown)
+            vt, nv = _templatize_value(v, roles, unknown)
             items.append(vt)
             n += nv
         return (items if isinstance(obj, list) else tuple(items)), n
@@ -287,7 +358,7 @@ def _build_value(template: Any, resolve: Callable[[tuple], int]):
 # encoded-db-key templating (keys are self-describing: type-tagged parts)
 
 
-def _templatize_db_key(enc: bytes, role_map: dict,
+def _templatize_db_key(enc: bytes, roles: Roles,
                        unknown: list | None = None) -> tuple[bytes, list]:
     """Parse an encoded state key; return (bytes, [(offset, role)]) patching
     int parts whose value is a role. Layout per state/db._encode_part:
@@ -304,7 +375,7 @@ def _templatize_db_key(enc: bytes, role_map: dict,
             v = raw ^ 0x8000000000000000
             if v >= 1 << 63:
                 v -= 1 << 64
-            role = role_map.get(v) if v >= _ROLE_VALUE_MIN else None
+            role = roles.of(v)
             if role is not None:
                 patches.append((off, role))
             elif unknown is not None and abs(v) >= _ROLE_VALUE_MIN:
@@ -440,21 +511,21 @@ class BurstTemplate:
 def build_template(
     builder,
     state_log: list,
-    role_map: dict[int, tuple],
+    roles: Roles,
     mint_count: int,
     partition_id: int,
-    allowed_ints: frozenset[int] | set[int] = frozenset(),
 ) -> BurstTemplate:
     """Build a BurstTemplate from one slow-path materialization: the result
     builder (records + responses) and the transaction's write capture log.
     Raises NotTemplatable when anything resists the role model.
 
-    ``allowed_ints``: large ints (>= 2^32) that may legitimately appear as
-    CONSTANTS because the cache key's fingerprint pins them (they occur in
-    the admission documents). Any other large non-role int is evidence of
-    hidden variance the role model cannot express (e.g. a clock-derived
-    due date) — baking it in would silently corrupt later instantiations,
-    so the burst is rejected instead."""
+    ``roles`` carries the full role context: exact value→role map (keys,
+    mints, fingerprint-extracted fields), the fingerprint-pinned constants
+    (``roles.allowed`` — large ints that may legitimately be baked in because
+    the cache key's fingerprint pins them), and the capture clock base for
+    clock-derived detection. Any other large non-role int is evidence of
+    hidden variance the role model cannot express — baking it in would
+    silently corrupt later instantiations, so the burst is rejected."""
     if builder.post_commit_tasks:
         raise NotTemplatable("post-commit tasks cannot be templated")
     unknown: list[int] = []
@@ -470,7 +541,7 @@ def build_template(
             raise NotTemplatable("oversized rejection reason")
         body = bytearray()
         body_patches: list = []
-        _pack_with_roles(dict(rec.value), body, body_patches, role_map, unknown)
+        _pack_with_roles(dict(rec.value), body, body_patches, roles, unknown)
         reason = rec.rejection_reason.encode("utf-8")
         entry_off = len(payload)
         rec_off = entry_off + _ENTRY_HEADER.size
@@ -500,7 +571,7 @@ def build_template(
             (rec.request_id, _REC_REQ_OFF, "le_q"),
             (rec.operation_reference, _REC_OPREF_OFF, "le_q"),
         ):
-            role = _role_of(value, role_map)
+            role = roles.of(value)
             if role is not None:
                 role_patches.append((rec_off + off, fmt, role))
             elif abs(int(value)) >= _ROLE_VALUE_MIN:
@@ -529,7 +600,7 @@ def build_template(
         final_ops[enc_key] = (op, value)
     state_ops: list[StateOp] = []
     for enc_key, (op, value) in final_ops.items():
-        key_bytes, key_patches = _templatize_db_key(enc_key, role_map, unknown)
+        key_bytes, key_patches = _templatize_db_key(enc_key, roles, unknown)
         if op != "put":
             state_ops.append(StateOp("del", key_bytes, key_patches))
             continue
@@ -538,14 +609,14 @@ def build_template(
         try:
             vbuf = bytearray()
             vpatches: list = []
-            _pack_with_roles(value, vbuf, vpatches, role_map, unknown)
+            _pack_with_roles(value, vbuf, vpatches, roles, unknown)
             if msgpack.unpackb(bytes(vbuf)) == value:
                 entry.value_bytes = bytes(vbuf)
                 entry.value_byte_patches = vpatches
             else:
                 raise NotTemplatable("value not codec-stable")
         except (NotTemplatable, msgpack.MsgPackError):
-            vt, _n = _templatize_value(value, role_map, unknown)
+            vt, _n = _templatize_value(value, roles, unknown)
             entry.value_template = vt
         state_ops.append(entry)
 
@@ -564,11 +635,11 @@ def build_template(
             "request_id", "operation_reference",
         ):
             v = getattr(rec, name)
-            role = _role_of(v, role_map)
+            role = roles.of(v)
             header[name] = _RoleSlot(role) if role is not None else v
-        vt, _ = _templatize_value(dict(rec.value), role_map, unknown)
-        stream_role = _role_of(resp.request_stream_id, role_map)
-        req_role = _role_of(resp.request_id, role_map)
+        vt, _ = _templatize_value(dict(rec.value), roles, unknown)
+        stream_role = roles.of(resp.request_stream_id)
+        req_role = roles.of(resp.request_id)
         responses.append(
             ResponseTemplate(
                 extra=extra,
@@ -581,7 +652,7 @@ def build_template(
             )
         )
 
-    stray = [v for v in unknown if v not in allowed_ints]
+    stray = [v for v in unknown if v not in roles.allowed]
     if stray:
         raise NotTemplatable(
             f"unexplained large ints (not roles, not fingerprint-pinned): {stray[:4]}"

@@ -174,7 +174,6 @@ class _DefInfo:
     job_types: dict[int, str]  # element idx → static job type
     job_retries: dict[int, int]
     join_idxs: list[int]  # element idxs of K_JOIN gateways
-    timer_idxs: frozenset[int]  # element idxs whose ARRIVAL creates a timer
     # task element idx → (# timer boundaries, # message boundaries) expected
     # open while the task is parked (reconstruction integrity check)
     boundary_waits: dict[int, tuple[int, int]]
@@ -262,13 +261,6 @@ class KernelRegistry:
                     sum(1 for t in ts if t.timer_duration is not None),
                     sum(1 for t in ts if t.message_name is not None),
                 )
-        timer_idxs = frozenset(
-            el.idx for el in exe.elements[1:]
-            if (solo.kernel_op[0, el.idx] == K_CATCH
-                and el.element_type != BpmnElementType.EVENT_BASED_GATEWAY
-                and el.timer_duration is not None)
-            or boundary_waits.get(el.idx, (0, 0))[0] > 0
-        )
         info = _DefInfo(
             index=len(self._infos),
             key=definition_key,
@@ -276,7 +268,6 @@ class KernelRegistry:
             job_types=job_types,
             job_retries=job_retries,
             join_idxs=join_idxs,
-            timer_idxs=timer_idxs,
             boundary_waits=boundary_waits,
             host_idxs=effective_host,
         )
@@ -356,6 +347,14 @@ class _Admitted:
     # False → this command must not ride a burst template (e.g. it touches
     # engine.await_results, which lives outside the captured state store)
     templatable: bool = True
+    # clock-derived document fields (dueDate/deadline) extracted by the
+    # fingerprint walk, in canonical order — resolved per command for the
+    # template's ("fp", i) roles
+    fp_values: list | None = None
+    # minted keys of parked wait states (timer keys), in reconstruction
+    # order — role ("wait", j); they appear in cancel/trigger bursts but not
+    # in any admission doc, so they need their own role kind
+    wait_keys: list | None = None
 
 
 class KernelBackend:
@@ -389,6 +388,7 @@ class KernelBackend:
         self.template_hits = 0
         self.template_misses = 0
         self.template_audits = 0
+        self.template_audit_skips = 0
 
     # -- candidate test (no state access) ----------------------------------
 
@@ -476,6 +476,7 @@ class KernelBackend:
         tokens: list[_Token] = []
         resume: _Token | None = None
         wait_docs: list = []
+        wait_keys: list[int] = []
         # elem idx of a scope (0 = process root) → its instance key: join
         # counters and sub-process drain checks key off the scope instance
         scope_keys: dict[int, int] = {0: pi_key}
@@ -503,7 +504,8 @@ class KernelBackend:
                 # means a trigger is mid-flight (its internal TERMINATE/
                 # ACTIVATE commands own this instance now) — decline so the
                 # sequential path resolves the race
-                if not self._collect_wait_states(info, el.idx, child_key, wait_docs):
+                if not self._collect_wait_states(info, el.idx, child_key,
+                                                 wait_docs, wait_keys):
                     return None
             elif op == K_CATCH:
                 if el.element_type == BpmnElementType.EVENT_BASED_GATEWAY:
@@ -511,13 +513,14 @@ class KernelBackend:
                     # the gateway instance; anything less means a trigger is
                     # mid-flight (its COMPLETE_ELEMENT owns this instance)
                     if not self._collect_wait_states(info, el.idx, child_key,
-                                                     wait_docs):
+                                                     wait_docs, wait_keys):
                         return None
                 elif el.timer_duration is not None:
                     timers = state.timers.timers_for_element_instance(child_key)
                     if not timers:
                         return None  # incident-parked or already fired
                     wait_docs.extend(dict(t) for _k, t in timers)
+                    wait_keys.extend(k for k, _t in timers)
                 elif el.signal_name is not None:
                     subs = state.signal_subscriptions.subscriptions_of(child_key)
                     if not subs:
@@ -554,14 +557,15 @@ class KernelBackend:
                    for j in info.join_idxs):
                 continue
             return None
-        return tokens, resume, root, wait_docs, scope_keys, join_counts
+        return tokens, resume, root, wait_docs, wait_keys, scope_keys, join_counts
 
     def _collect_wait_states(self, info: _DefInfo, el_idx: int, child_key: int,
-                             wait_docs: list) -> bool:
+                             wait_docs: list, wait_keys: list) -> bool:
         """Verify the expected wait states (boundary subscriptions of a task,
         or an event-based gateway's per-target subscriptions) are all open on
-        ``child_key``, appending their records to ``wait_docs``. False means
-        a trigger is mid-flight and the instance is not reconstructable."""
+        ``child_key``, appending their records to ``wait_docs`` and the
+        timers' minted keys to ``wait_keys``. False means a trigger is
+        mid-flight and the instance is not reconstructable."""
         expected_timers, expected_subs = info.boundary_waits.get(el_idx, (0, 0))
         if not (expected_timers or expected_subs):
             return True
@@ -571,6 +575,7 @@ class KernelBackend:
         if len(timers) != expected_timers or len(subs) != expected_subs:
             return False
         wait_docs.extend(dict(t) for _k, t in timers)
+        wait_keys.extend(k for k, _t in timers)
         wait_docs.extend(dict(s) for s in subs)
         return True
 
@@ -650,7 +655,8 @@ class KernelBackend:
         rebuilt = self._reconstruct(pi_key, info, resume_key)
         if rebuilt is None:
             return None
-        tokens, resume, root, wait_docs, scope_keys, join_counts = rebuilt
+        (tokens, resume, root, wait_docs, wait_keys, scope_keys,
+         join_counts) = rebuilt
         if self.registry.tables.kernel_op[info.index, resume.elem_idx] != require_op:
             return None
         merged = state.variables.collect(pi_key)
@@ -660,14 +666,11 @@ class KernelBackend:
             return None
         inst = _Inst(idx=len(instances), info=info, new=False, pi_key=pi_key,
                      tokens=tokens, join_counts=join_counts, slots=slots)
-        # a timer anywhere in the admission context (the trigger itself, or a
-        # parked timer's record in wait_docs) embeds a clock-derived dueDate
-        # in the fingerprint: under a real clock every such burst would
-        # fingerprint uniquely, so templating it only churns the cache with
-        # dead captures
-        has_timer_doc = kind == "t" or any(
-            isinstance(d, dict) and "dueDate" in d for d in (*head_docs, *wait_docs)
-        )
+        # timer-touching bursts ARE templatable: clock-derived dueDate /
+        # deadline fields in the admission docs are extracted as ("fp", i)
+        # roles by the fingerprint walk (so instances with different due
+        # dates share a template), and freshly computed due dates in the
+        # burst itself resolve as ("clock", delta) roles
         return _Admitted(
             cmd=cmd, inst=inst, resume_token=resume, kind=kind,
             fp_docs=[
@@ -679,7 +682,8 @@ class KernelBackend:
                 sorted(merged.items()),
                 sorted(join_counts.items()),
             ],
-            templatable=(pi_key not in self.engine.await_results) and not has_timer_doc,
+            templatable=pi_key not in self.engine.await_results,
+            wait_keys=wait_keys,
         )
 
     def _admit_job_complete(self, cmd, instances, admitted_pis) -> _Admitted | None:
@@ -918,20 +922,13 @@ class KernelBackend:
 
         template = None
         key = None
-        # a burst that ARRIVES at a timer catch writes a clock-derived due
-        # date — un-expressible in the role model (and too small for the
-        # unexplained-int net under test clocks), so never template it
-        timer_idxs = adm.inst.info.timer_idxs
-        creates_timer = bool(timer_idxs) and any(
-            op[0] == "arrive" and op[2] in timer_idxs for op in ops
-        )
-        if self.use_templates and adm.templatable and not creates_timer:
+        if self.use_templates and adm.templatable:
             # request presence is part of the burst SHAPE (Writers.respond
             # only emits a client response when request_id >= 0), so it must
             # be in the key — the ids themselves are patched roles
+            fp_bytes, adm.fp_values = self._fingerprint(adm)
             key = (adm.kind, adm.inst.info.index,
-                   adm.cmd.record.request_id >= 0, tuple(ops),
-                   self._fingerprint(adm))
+                   adm.cmd.record.request_id >= 0, tuple(ops), fp_bytes)
             template = self._templates.get(key, _MISSING)
             if template is _MISSING:
                 template = None
@@ -950,18 +947,24 @@ class KernelBackend:
 
         # slow path (also: template capture on first miss, audit on hit)
         capture = self.use_templates and adm.templatable and miss
+        auditing = template is not None and self.audit_templates
         txn = self.engine.state.db.require_transaction()
         state = self.engine.state
         role_map, wrapped = self._roles_for(adm)
         mints: list[int] = []
         orig_next_key = state.next_key
-        if capture or (template is not None and self.audit_templates):
+        if capture or auditing:
             def tagged_next_key():
                 v = orig_next_key()
                 mints.append(v)
                 return v
             state.next_key = tagged_next_key
             txn.capture = cap_log = []
+            # collect clock-derived values (dueDate = clock + clock-free
+            # delta) the engine computes during this run — they become
+            # ("clock", delta) roles; a poison note (now()-entangled delta)
+            # declines the template
+            bt.clock_note_begin()
         builder = make_builder()
         writers = Writers(builder, self.engine.appliers)
         try:
@@ -973,18 +976,14 @@ class KernelBackend:
                    for f in builder.follow_ups):
                 self._drain_host_escapes(wrapped.position, builder)
         finally:
-            if capture or (template is not None and self.audit_templates):
+            if capture or auditing:
                 state.next_key = orig_next_key
                 txn.capture = None
+                clock_notes, clock_poison = bt.clock_note_end()
         if capture:
             self.template_misses += 1
-            if any(f.record.value_type == ValueType.TIMER
-                   for f in builder.follow_ups):
-                # the host-escape drain (or an uncovered path) wrote a TIMER
-                # record: its clock-derived dueDate would replay stale from a
-                # template (under test clocks the small int slips past the
-                # unexplained-int net) — never template such a burst. The
-                # pre-trace creates_timer guard covers only device arrivals.
+            allowed = self._fingerprint_ints(adm)
+            if clock_poison:
                 role_map = None
             for i, v in enumerate(mints):
                 if role_map is None:
@@ -993,23 +992,73 @@ class KernelBackend:
                     role_map = None  # role collision → not templatable
                     break
                 role_map[v] = ("mint", i)
+            for i, v in enumerate(adm.fp_values or ()):
+                if role_map is None:
+                    break
+                if v in role_map:
+                    # a clock-field value colliding with a key/mint would
+                    # patch the wrong quantity — decline instead
+                    role_map = None
+                    break
+                role_map[v] = ("fp", i)
+            # delta → value of this run's clock notes: capture validation
+            # resolves against the exact values the slow path wrote (immune
+            # to a clock tick mid-run)
+            clock_values: dict[int, int] = {}
+            for v, delta in clock_notes:
+                if role_map is None:
+                    break
+                if v < _ROLE_VALUE_MIN:
+                    # a small (test-clock) due date cannot be a patchable
+                    # role and would bake stale — decline the template
+                    role_map = None
+                    break
+                existing = role_map.get(v)
+                if existing is not None and existing != ("clock", delta):
+                    role_map = None  # same value, conflicting meaning
+                    break
+                if v in allowed or clock_values.get(delta, v) != v:
+                    # fingerprint-pinned elsewhere, or two different values
+                    # for one delta (clock ticked between two same-duration
+                    # timers): ambiguous — decline
+                    role_map = None
+                    break
+                role_map[v] = ("clock", delta)
+                clock_values[delta] = v
             if role_map is not None:
+                roles_ctx = bt.Roles(role_map, allowed=allowed)
                 try:
                     tmpl = bt.build_template(
-                        builder, cap_log, role_map, len(mints),
+                        builder, cap_log, roles_ctx, len(mints),
                         state.partition_id,
-                        allowed_ints=self._fingerprint_ints(adm),
                     )
-                    bt.validate_template(tmpl, builder, self._resolver(adm, mints))
+                    bt.validate_template(
+                        tmpl, builder,
+                        self._resolver(adm, mints, clock_values))
                     self._store_template(key, tmpl)
                 except bt.NotTemplatable as exc:
                     logger.debug("trace not templatable: %s", exc)
                     self._store_template(key, None)
             else:
                 self._store_template(key, None)
-        elif template is not None and self.audit_templates:
-            self.template_audits += 1
-            self._audit_template(template, adm, builder, cap_log, mints)
+        elif auditing:
+            audit_clock_values: dict[int, int] = {}
+            conflict = clock_poison
+            for v, delta in clock_notes:
+                if audit_clock_values.setdefault(delta, v) != v:
+                    # the wall clock ticked between two same-duration timer
+                    # creations in this run: the single delta→value map can't
+                    # represent both, so the audit would assert spuriously —
+                    # skip it (capture declines this shape, so no template
+                    # was ever built from such a run)
+                    conflict = True
+                    break
+            if conflict:
+                self.template_audit_skips += 1
+            else:
+                self.template_audits += 1
+                self._audit_template(template, adm, builder, cap_log, mints,
+                                     audit_clock_values)
         return builder
 
     def _drain_host_escapes(self, source_position: int, builder,
@@ -1064,10 +1113,19 @@ class KernelBackend:
                 del cache[k]
         cache[key] = template
 
-    def _fingerprint(self, adm: _Admitted) -> bytes:
-        """Byte image of the instance-scoped documents the slow path reads,
-        with role values (keys known at admission) normalized away so two
-        commands differing only in key identity fingerprint equal."""
+    # document fields whose int values are clock-derived and copied verbatim
+    # by the slow path (never transformed into non-int outputs): they are
+    # extracted as per-command template inputs (("fp", i) roles) instead of
+    # pinned in the fingerprint, so e.g. timer-carrying instances with
+    # different due dates share one burst template
+    _FP_FIELDS = frozenset(("dueDate", "deadline"))
+
+    def _fingerprint(self, adm: _Admitted) -> tuple[bytes, list[int]]:
+        """(byte image, extracted clock-field values) of the instance-scoped
+        documents the slow path reads. Role values (keys known at admission)
+        and whitelisted clock-derived fields are normalized away so two
+        commands differing only in key identity / due dates fingerprint
+        equal; everything else is pinned byte-for-byte."""
         from zeebe_tpu.protocol.msgpack import packb
 
         roles = {}
@@ -1079,28 +1137,67 @@ class KernelBackend:
                 roles[tok.key] = f"t{j}"
         if adm.cmd.record.key >= _ROLE_VALUE_MIN:
             roles[adm.cmd.record.key] = "k"
+        for j, wk in enumerate(adm.wait_keys or ()):
+            if wk >= _ROLE_VALUE_MIN:
+                roles.setdefault(wk, f"w{j}")
 
-        def norm(obj):
+        # pass 1: large ints at NON-whitelisted positions are pinned — a
+        # value that also occurs pinned elsewhere must not be extracted (the
+        # slow path may copy it from the pinned position, and patching every
+        # value-equal occurrence would corrupt that copy)
+        fp_fields = self._FP_FIELDS
+        pinned: set[int] = set()
+
+        def scan(obj, field=None):
+            t = type(obj)
+            if t is int:
+                if obj >= _ROLE_VALUE_MIN and obj not in roles and field is None:
+                    pinned.add(obj)
+            elif t is dict:
+                for k, v in obj.items():
+                    scan(k)
+                    scan(v, k if type(k) is str and k in fp_fields else None)
+            elif t is list or t is tuple:
+                for v in obj:
+                    scan(v)
+
+        scan(adm.fp_docs)
+
+        fp_values: list[int] = []
+        fp_ordinal: dict[int, int] = {}
+
+        def norm(obj, field=None):
             # exact-type dispatch (hot path: ~50 nodes per admitted command);
             # bool/float/None fall through unchanged via the final return
             t = type(obj)
             if t is int:
                 if obj >= _ROLE_VALUE_MIN:
                     r = roles.get(obj)
-                    return ["\x00r", r] if r is not None else obj
+                    if r is not None:
+                        return ["\x00r", r]
+                    if field is not None and obj not in pinned:
+                        i = fp_ordinal.get(obj)
+                        if i is None:
+                            i = len(fp_values)
+                            fp_ordinal[obj] = i
+                            fp_values.append(obj)
+                        return ["\x00f", i]
                 return obj
             if t is str:
                 # escape NUL-prefixed strings so user data can never forge
-                # the ["\x00r", tag] role marker (prefix escaping keeps the
-                # normalization injective)
+                # the ["\x00r", tag] / ["\x00f", i] markers (prefix escaping
+                # keeps the normalization injective)
                 return ("\x00s" + obj) if obj.startswith("\x00") else obj
             if t is dict:
-                return {norm(k): norm(v) for k, v in obj.items()}
+                return {
+                    norm(k): norm(v, k if type(k) is str and k in fp_fields else None)
+                    for k, v in obj.items()
+                }
             if t is list or t is tuple:
                 return [norm(v) for v in obj]
             return obj
 
-        return packb(norm(adm.fp_docs))
+        return packb(norm(adm.fp_docs)), fp_values
 
     def _fingerprint_ints(self, adm: _Admitted) -> set[int]:
         """All large ints present in the admission documents — values the
@@ -1135,6 +1232,9 @@ class KernelBackend:
         for j, tok in enumerate(inst.tokens):
             if tok.key >= _ROLE_VALUE_MIN:
                 role_map[tok.key] = ("tok", j)
+        for j, wk in enumerate(adm.wait_keys or ()):
+            if wk >= _ROLE_VALUE_MIN:
+                role_map.setdefault(wk, ("wait", j))
         cmd = adm.cmd
         rec = cmd.record
         if rec.key >= _ROLE_VALUE_MIN:
@@ -1154,15 +1254,39 @@ class KernelBackend:
         )
         return role_map, wrapped
 
-    def _resolver(self, adm: _Admitted, mints: list[int]):
+    def _resolver(self, adm: _Admitted, mints: list[int],
+                  clock_values: dict[int, int] | None = None):
+        """``clock_values`` (delta → value) is passed on capture-validation
+        and audit runs so ("clock", delta) roles resolve to the exact values
+        the slow path just wrote; live instantiation recomputes them from
+        the engine clock."""
         cmd = adm.cmd
         inst = adm.inst
         toks = inst.tokens
+        fp_values = adm.fp_values or ()
+        wait_keys = adm.wait_keys or ()
+        # one clock snapshot per resolver: a burst's payload, state rows, and
+        # responses must all carry the SAME dueDate for one logical timer
+        # even if the wall clock ticks mid-instantiation
+        clock_base = (self.engine.clock_millis() if clock_values is None
+                      else None)
 
         def resolve(role: tuple) -> int:
             kind = role[0]
             if kind == "mint":
                 return mints[role[1]]
+            if kind == "fp":
+                return fp_values[role[1]]
+            if kind == "clock":
+                delta = role[1]
+                if clock_values is not None:
+                    v = clock_values.get(delta)
+                    if v is not None:
+                        return v
+                    return self.engine.clock_millis() + delta
+                return clock_base + delta
+            if kind == "wait":
+                return wait_keys[role[1]]
             if kind == "source_position":
                 return cmd.position
             if kind == "req_id":
@@ -1201,7 +1325,8 @@ class KernelBackend:
             job_types=template.job_types,
         )
 
-    def _audit_template(self, template, adm: _Admitted, builder, cap_log, mints) -> None:
+    def _audit_template(self, template, adm: _Admitted, builder, cap_log,
+                        mints, clock_values: dict[int, int]) -> None:
         """Shadow-check a template hit against the slow path just executed."""
         from zeebe_tpu.engine import burst_templates as bt
         from zeebe_tpu.state.db import ColumnFamilyCode
@@ -1211,7 +1336,7 @@ class KernelBackend:
             raise AssertionError(
                 f"template audit: mint count {template.mint_count} != slow path {len(mints)}"
             )
-        resolve = self._resolver(adm, mints)
+        resolve = self._resolver(adm, mints, clock_values)
         bt.validate_template(template, builder, resolve)
         # state ops: template replay vs the slow path's capture log, collapsed
         # to the final op per key exactly as build_template does (minus the

@@ -77,12 +77,16 @@ class TimerProcessors:
             reps = timer.get("repetitions", 1)
             interval = timer.get("interval", -1)
             if (reps == -1 or reps > 1) and interval > 0:
+                from zeebe_tpu.engine.burst_templates import note_clock_value
+
                 timer_key = self.state.next_key()
+                due_date = self.clock_millis() + interval
+                note_clock_value(due_date, interval)
                 writers.append_event(
                     timer_key, ValueType.TIMER, TimerIntent.CREATED,
                     {
                         **timer,
-                        "dueDate": self.clock_millis() + interval,
+                        "dueDate": due_date,
                         "repetitions": reps - 1 if reps > 0 else -1,
                     },
                 )
@@ -108,12 +112,16 @@ class TimerProcessors:
         reps = timer.get("repetitions", 1)
         interval = timer.get("interval", -1)
         if (reps == -1 or reps > 1) and interval > 0:
+            from zeebe_tpu.engine.burst_templates import note_clock_value
+
             timer_key = self.state.next_key()
+            due_date = self.clock_millis() + interval
+            note_clock_value(due_date, interval)
             writers.append_event(
                 timer_key, ValueType.TIMER, TimerIntent.CREATED,
                 {
                     **timer,
-                    "dueDate": self.clock_millis() + interval,
+                    "dueDate": due_date,
                     "repetitions": reps - 1 if reps > 0 else -1,
                 },
             )

@@ -462,6 +462,10 @@ def register_start_subscriptions(state, clock_millis, writers, exe, meta,
                 )
             elif el.event_type == BpmnEventType.TIMER and el.timer_cycle and include_timers:
                 reps, interval = parse_cycle(el.timer_cycle)
+                from zeebe_tpu.engine.burst_templates import note_clock_value
+
+                due_date = clock_millis() + interval
+                note_clock_value(due_date, interval)
                 writers.append_event(
                     state.next_key(), ValueType.TIMER, TimerIntent.CREATED,
                     {
@@ -470,7 +474,7 @@ def register_start_subscriptions(state, clock_millis, writers, exe, meta,
                         "elementInstanceKey": -1,
                         "processInstanceKey": -1,
                         "processDefinitionKey": meta["processDefinitionKey"],
-                        "dueDate": clock_millis() + interval,
+                        "dueDate": due_date,
                         "repetitions": reps,
                         "interval": interval,
                     },

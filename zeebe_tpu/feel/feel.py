@@ -551,6 +551,21 @@ class Evaluator:
 # Public API (the ExpressionLanguage facade)
 
 
+def _ast_references_clock(node: Any) -> bool:
+    """True when the AST calls now() anywhere — the expression's value then
+    depends on the evaluation clock, not only on its variable context."""
+    if isinstance(node, (list, tuple)):
+        return any(_ast_references_clock(x) for x in node)
+    if isinstance(node, Call):
+        return node.name == "now" or _ast_references_clock(node.args)
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        return any(
+            _ast_references_clock(getattr(node, f.name))
+            for f in dataclasses.fields(node)
+        )
+    return False
+
+
 @dataclasses.dataclass(frozen=True, slots=True)
 class Expression:
     """A parsed expression: static string or FEEL AST (reference:
@@ -564,6 +579,12 @@ class Expression:
         if self.is_static:
             return self.source
         return Evaluator(context, clock_millis).eval(self.ast)
+
+    def references_clock(self) -> bool:
+        """True when evaluation reads the clock (now() in the AST): the value
+        is not a pure function of the variable context, so consumers that
+        cache or template derived values must not assume clock+constant."""
+        return not self.is_static and _ast_references_clock(self.ast)
 
 
 _parse_cache: dict[str, Expression] = {}
