@@ -254,3 +254,143 @@ class TestScanBatchHeaders:
         for scanner in (codec.scan_batch_headers, _py_scan_batch_headers):
             with pytest.raises(msgpack.MsgPackError):
                 scanner(bytes(payload))
+
+
+class TestPackFingerprint:
+    """Native pack_fingerprint vs the pure-Python spec
+    (kernel_backend._py_pack_fingerprint)."""
+
+    FP = frozenset(("dueDate", "deadline"))
+
+    def _impls(self):
+        from zeebe_tpu.engine.kernel_backend import (
+            _native_pack_fingerprint,
+            _py_pack_fingerprint,
+        )
+
+        assert _native_pack_fingerprint is not None
+        return _py_pack_fingerprint, _native_pack_fingerprint
+
+    def test_randomized_parity(self):
+        py_fp, c_fp = self._impls()
+        rng = random.Random(20260730)
+
+        def rand_doc(depth=0):
+            t = rng.randint(0, 8 if depth < 3 else 5)
+            if t == 0:
+                return None
+            if t == 1:
+                return rng.choice([True, False])
+            if t == 2:
+                return rng.choice([
+                    rng.randint(-100, 100), rng.randint(2**32, 2**53),
+                    (1 << 51) + rng.randint(0, 20),
+                    1_700_000_000_000 + rng.randint(0, 10**9),
+                ])
+            if t == 3:
+                return rng.random() * 1e6
+            if t == 4:
+                return rng.choice(["plain", "\x00evil", "\x00r", "x" * 40, ""])
+            if t == 5:
+                return rng.choice(["dueDate", "deadline", "elementId"])
+            if t == 6:
+                return [rand_doc(depth + 1) for _ in range(rng.randint(0, 5))]
+            if t == 7:
+                return tuple(rand_doc(depth + 1) for _ in range(rng.randint(0, 4)))
+            return {
+                rng.choice(["dueDate", "deadline", f"k{rng.randint(0, 5)}",
+                            "\x00weird"]): rand_doc(depth + 1)
+                for _ in range(rng.randint(0, 6))
+            }
+
+        for trial in range(800):
+            docs = [rand_doc() for _ in range(rng.randint(1, 5))]
+            roles = {}
+
+            def collect(o):
+                if isinstance(o, bool):
+                    return
+                if isinstance(o, int) and o >= 2**32 and rng.random() < 0.4:
+                    roles[o] = rng.choice(["p", "k", "t0", "w1"])
+                elif isinstance(o, dict):
+                    for k, v in o.items():
+                        collect(k)
+                        collect(v)
+                elif isinstance(o, (list, tuple)):
+                    for v in o:
+                        collect(v)
+
+            collect(docs)
+            a = py_fp(docs, roles, self.FP)
+            b = c_fp(docs, roles, self.FP)
+            assert a[0] == b[0], (trial, docs, roles)
+            assert a[1] == list(b[1]), (trial, a[1], b[1])
+
+    def test_role_int_as_dict_key(self):
+        py_fp, c_fp = self._impls()
+        docs = [{(1 << 51) + 7: "x", "dueDate": 1_700_000_000_500}]
+        roles = {(1 << 51) + 7: "p"}
+        a = py_fp(docs, roles, self.FP)
+        b = c_fp(docs, roles, self.FP)
+        assert a[0] == b[0] and a[1] == list(b[1])
+
+    def test_pinned_elsewhere_not_extracted(self):
+        py_fp, c_fp = self._impls()
+        due = 1_700_000_000_999
+        docs = [{"dueDate": due}, {"other": due}]  # pinned at "other"
+        for fp in self._impls():
+            payload, values = fp(docs, {}, self.FP)
+            assert values == [] or list(values) == []
+        assert py_fp(docs, {}, self.FP)[0] == c_fp(docs, {}, self.FP)[0]
+
+
+class TestApplyPatchesAndStamp:
+    def test_apply_patches_matches_python_loop(self):
+        import struct as _struct
+
+        from zeebe_tpu.native import codec_fn
+
+        apply_patches = codec_fn("apply_patches")
+        assert apply_patches is not None
+        base = bytes(range(200)) * 2
+        plan = b"".join(
+            _struct.pack("<IBB", off, fmt, idx)
+            for off, fmt, idx in [(0, 0, 0), (16, 1, 1), (32, 2, 2), (48, 3, 2)]
+        )
+        values = [-7, 123456, (1 << 51) + 9]
+        buf = bytearray(base)
+        apply_patches(buf, plan, values)
+        exp = bytearray(base)
+        _struct.pack_into("<q", exp, 0, -7)
+        _struct.pack_into("<i", exp, 16, 123456)
+        _struct.pack_into(">Q", exp, 32, ((1 << 51) + 9) & 0xFFFFFFFFFFFFFFFF)
+        _struct.pack_into(">Q", exp, 48, (((1 << 51) + 9) & 0xFFFFFFFFFFFFFFFF) ^ (1 << 63))
+        assert bytes(buf) == bytes(exp)
+
+    def test_stamp_batch_matches_python_loop(self):
+        import struct as _struct
+
+        from zeebe_tpu.native import codec_fn
+
+        stamp = codec_fn("stamp_batch")
+        assert stamp is not None
+        buf = bytearray(120)
+        stamp(buf, [0, 8, 16], [40, 48], 1000, 1_700_000_000_001)
+        exp = bytearray(120)
+        for i, off in enumerate([0, 8, 16]):
+            _struct.pack_into("<q", exp, off, 1000 + i)
+        for off in [40, 48]:
+            _struct.pack_into("<q", exp, off, 1_700_000_000_001)
+        assert bytes(buf) == bytes(exp)
+
+    def test_apply_patches_bounds_checked(self):
+        import struct as _struct
+
+        from zeebe_tpu.native import codec_fn
+
+        apply_patches = codec_fn("apply_patches")
+        buf = bytearray(8)
+        with pytest.raises(ValueError):
+            apply_patches(buf, _struct.pack("<IBB", 4, 0, 0), [1])
+        with pytest.raises(IndexError):
+            apply_patches(buf, _struct.pack("<IBB", 0, 0, 3), [1])

@@ -444,6 +444,15 @@ class PreparedBurst:
     job_types: frozenset = frozenset()  # job types made activatable by the burst
 
 
+_FMT_CODES = {"le_q": 0, "le_i": 1, "be_q": 2}
+_PLAN_ENTRY = struct.Struct("<IBB")
+
+
+from zeebe_tpu.native import codec_fn as _codec_fn
+
+_apply_patches = _codec_fn("apply_patches")
+
+
 @dataclass
 class BurstTemplate:
     """Everything needed to replay one command's burst by patching."""
@@ -458,9 +467,36 @@ class BurstTemplate:
     responses: list[ResponseTemplate] = field(default_factory=list)
     has_pending_commands: bool = False
     job_types: frozenset = frozenset()
+    # compiled payload patch plan (native apply_patches): entry bytes +
+    # distinct role list; False = not compilable (fallback loop)
+    _plan: Any = field(default=None, repr=False, compare=False)
+
+    def _compiled_plan(self):
+        """(plan bytes, distinct roles) for the native patcher, or None.
+        Each distinct role resolves ONCE per instantiation; the C pass
+        applies every offset."""
+        plan = self._plan
+        if plan is None:
+            role_idx: dict[tuple, int] = {}
+            entries = bytearray()
+            for off, fmt, role in self.role_patches:
+                idx = role_idx.setdefault(role, len(role_idx))
+                if idx > 0xFF or off > 0xFFFFFFFF:
+                    self._plan = plan = False
+                    break
+                entries += _PLAN_ENTRY.pack(off, _FMT_CODES[fmt], idx)
+            else:
+                self._plan = plan = (bytes(entries), list(role_idx))
+        return None if plan is False else plan
 
     def instantiate_payload(self, resolve: Callable[[tuple], int]) -> bytearray:
         buf = bytearray(self.payload)
+        if _apply_patches is not None:
+            plan = self._compiled_plan()
+            if plan is not None:
+                entries, roles = plan
+                _apply_patches(buf, entries, [resolve(r) for r in roles])
+                return buf
         for off, fmt, role in self.role_patches:
             v = resolve(role)
             if fmt == "be_q":

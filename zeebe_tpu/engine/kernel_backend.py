@@ -63,6 +63,78 @@ from zeebe_tpu.protocol.intent import (
 
 logger = logging.getLogger("zeebe_tpu.kernel_backend")
 
+
+def _py_pack_fingerprint(docs, roles: dict[int, str],
+                         fp_fields: frozenset[str]) -> tuple[bytes, list[int]]:
+    """Pure-Python fingerprint walk — the specification the native
+    ``pack_fingerprint`` (native/codec.c) is byte-equality-tested against.
+
+    Pass 1 collects large ints pinned at NON-whitelisted positions — a value
+    that also occurs pinned elsewhere must not be extracted (the slow path
+    may copy it from the pinned position, and patching every value-equal
+    occurrence would corrupt that copy). Pass 2 emits msgpack with role
+    markers ["\\x00r", tag], extraction markers ["\\x00f", ordinal], and
+    "\\x00s" escaping of NUL-prefixed user strings (so user data can never
+    forge a marker — prefix escaping keeps the normalization injective)."""
+    from zeebe_tpu.protocol.msgpack import py_packb
+
+    pinned: set[int] = set()
+
+    def scan(obj, field=None):
+        t = type(obj)
+        if t is int:
+            if obj >= _ROLE_VALUE_MIN and obj not in roles and field is None:
+                pinned.add(obj)
+        elif t is dict:
+            for k, v in obj.items():
+                scan(k)
+                scan(v, k if type(k) is str and k in fp_fields else None)
+        elif t is list or t is tuple:
+            for v in obj:
+                scan(v)
+
+    scan(docs)
+
+    fp_values: list[int] = []
+    fp_ordinal: dict[int, int] = {}
+
+    def norm(obj, field=None):
+        # exact-type dispatch; bool/float/None fall through unchanged
+        t = type(obj)
+        if t is int:
+            if obj >= _ROLE_VALUE_MIN:
+                r = roles.get(obj)
+                if r is not None:
+                    # tuple, not list: markers must stay hashable so a
+                    # role-valued int used as a dict KEY normalizes instead
+                    # of crashing (packs to the same msgpack array bytes)
+                    return ("\x00r", r)
+                if field is not None and obj not in pinned:
+                    i = fp_ordinal.get(obj)
+                    if i is None:
+                        i = len(fp_values)
+                        fp_ordinal[obj] = i
+                        fp_values.append(obj)
+                    return ("\x00f", i)
+            return obj
+        if t is str:
+            return ("\x00s" + obj) if obj.startswith("\x00") else obj
+        if t is dict:
+            return {
+                norm(k): norm(v, k if type(k) is str and k in fp_fields else None)
+                for k, v in obj.items()
+            }
+        if t is list or t is tuple:
+            return [norm(v) for v in obj]
+        return obj
+
+    return py_packb(norm(docs)), fp_values
+
+
+from zeebe_tpu.native import codec_fn as _codec_fn
+
+_native_pack_fingerprint = _codec_fn("pack_fingerprint")
+
 # token phases (mirrors zeebe_tpu.ops.automaton)
 _PHASE_AT = 0
 _PHASE_WAIT = 1
@@ -1126,8 +1198,6 @@ class KernelBackend:
         and whitelisted clock-derived fields are normalized away so two
         commands differing only in key identity / due dates fingerprint
         equal; everything else is pinned byte-for-byte."""
-        from zeebe_tpu.protocol.msgpack import packb
-
         roles = {}
         inst = adm.inst
         if inst.pi_key >= _ROLE_VALUE_MIN:
@@ -1140,64 +1210,9 @@ class KernelBackend:
         for j, wk in enumerate(adm.wait_keys or ()):
             if wk >= _ROLE_VALUE_MIN:
                 roles.setdefault(wk, f"w{j}")
-
-        # pass 1: large ints at NON-whitelisted positions are pinned — a
-        # value that also occurs pinned elsewhere must not be extracted (the
-        # slow path may copy it from the pinned position, and patching every
-        # value-equal occurrence would corrupt that copy)
-        fp_fields = self._FP_FIELDS
-        pinned: set[int] = set()
-
-        def scan(obj, field=None):
-            t = type(obj)
-            if t is int:
-                if obj >= _ROLE_VALUE_MIN and obj not in roles and field is None:
-                    pinned.add(obj)
-            elif t is dict:
-                for k, v in obj.items():
-                    scan(k)
-                    scan(v, k if type(k) is str and k in fp_fields else None)
-            elif t is list or t is tuple:
-                for v in obj:
-                    scan(v)
-
-        scan(adm.fp_docs)
-
-        fp_values: list[int] = []
-        fp_ordinal: dict[int, int] = {}
-
-        def norm(obj, field=None):
-            # exact-type dispatch (hot path: ~50 nodes per admitted command);
-            # bool/float/None fall through unchanged via the final return
-            t = type(obj)
-            if t is int:
-                if obj >= _ROLE_VALUE_MIN:
-                    r = roles.get(obj)
-                    if r is not None:
-                        return ["\x00r", r]
-                    if field is not None and obj not in pinned:
-                        i = fp_ordinal.get(obj)
-                        if i is None:
-                            i = len(fp_values)
-                            fp_ordinal[obj] = i
-                            fp_values.append(obj)
-                        return ["\x00f", i]
-                return obj
-            if t is str:
-                # escape NUL-prefixed strings so user data can never forge
-                # the ["\x00r", tag] / ["\x00f", i] markers (prefix escaping
-                # keeps the normalization injective)
-                return ("\x00s" + obj) if obj.startswith("\x00") else obj
-            if t is dict:
-                return {
-                    norm(k): norm(v, k if type(k) is str and k in fp_fields else None)
-                    for k, v in obj.items()
-                }
-            if t is list or t is tuple:
-                return [norm(v) for v in obj]
-            return obj
-
-        return packb(norm(adm.fp_docs)), fp_values
+        if _native_pack_fingerprint is not None:
+            return _native_pack_fingerprint(adm.fp_docs, roles, self._FP_FIELDS)
+        return _py_pack_fingerprint(adm.fp_docs, roles, self._FP_FIELDS)
 
     def _fingerprint_ints(self, adm: _Admitted) -> set[int]:
         """All large ints present in the admission documents — values the

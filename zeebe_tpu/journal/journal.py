@@ -89,6 +89,9 @@ class _Segment:
         # (next_index, its_offset) after the last read_entry — log scans are
         # sequential, so most reads jump straight here
         self._read_hint: tuple[int, int] | None = None
+        # file position tracker: -1 = unknown (a read moved it); append only
+        # seeks when the position is not already at the segment tail
+        self._file_pos = -1
         if create:
             self.file = open(path, "w+b")
             self.file.write(_SEG_HEADER.pack(_MAGIC, _VERSION, segment_id, first_index))
@@ -114,6 +117,7 @@ class _Segment:
     def scan(self) -> None:
         """Rebuild in-memory state from disk; truncate at first corrupt frame."""
         f = self.file
+        self._file_pos = -1
         f.seek(0, os.SEEK_END)
         file_len = f.tell()
         offset = _SEG_HEADER.size
@@ -147,12 +151,16 @@ class _Segment:
 
     def append(self, index: int, asqn: int, data: bytes) -> None:
         frame = _FRAME.pack(len(data), _checksum(index, asqn, data), index, asqn)
-        self.file.seek(self.size)
-        self.file.write(frame)
-        self.file.write(data)
+        if self._file_pos != self.size:
+            self.file.seek(self.size)
+        # invalidate across the write: if it tears mid-way (ENOSPC), the next
+        # append must re-seek to self.size and overwrite the torn bytes
+        self._file_pos = -1
+        self.file.write(frame + data)
         if (index - self.first_index) % _SPARSE_EVERY == 0:
             self.sparse.append((index, self.size))
         self.size += _FRAME.size + len(data)
+        self._file_pos = self.size
         self.last_index = index
         if asqn != ASQN_IGNORE:
             self.last_asqn = asqn
@@ -178,6 +186,7 @@ class _Segment:
         offset, _ = self._sparse_span(index)
         self.file.flush()
         self.file.seek(offset)
+        self._file_pos = -1
         mv = memoryview(self.file.read(self.size - offset))
         pos = 0
         while pos + _FRAME.size <= len(mv):
@@ -209,6 +218,7 @@ class _Segment:
             offset, _ = self._sparse_span(index)
         f = self.file
         f.flush()
+        self._file_pos = -1
         while offset < self.size:
             f.seek(offset)
             head = f.read(_FRAME.size)
@@ -242,6 +252,7 @@ class _Segment:
                 new_asqn = rec.asqn
         self.file.truncate(offset)
         self.file.flush()
+        self._file_pos = -1
         self.size = offset
         self.last_index = new_last
         self.last_asqn = new_asqn
@@ -416,6 +427,7 @@ class SegmentedJournal:
         for seg in self.segments:
             f = seg.file
             f.flush()
+            seg._file_pos = -1
             offset = _SEG_HEADER.size
             while offset < seg.size:
                 f.seek(offset)

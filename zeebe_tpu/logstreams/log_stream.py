@@ -236,11 +236,18 @@ class LogStreamWriter:
         return first_position + count - 1
 
 
+_native_stamp_batch = _native.codec_fn("stamp_batch")
+
+
 def patch_prepatched_batch(buf: bytearray, pos_offsets, ts_offsets,
                            first_position: int, timestamp: int) -> None:
     """Stamp the only two unknowns of a pre-serialized burst batch — record
     positions and the batch timestamp — at their captured byte offsets
     (shared by the local LogStreamWriter and the broker's Raft writer)."""
+    if _native_stamp_batch is not None and type(buf) is bytearray:
+        _native_stamp_batch(buf, pos_offsets, ts_offsets, first_position,
+                            timestamp)
+        return
     for i, off in enumerate(pos_offsets):
         _PACK_LE_Q.pack_into(buf, off, first_position + i)
     for off in ts_offsets:
@@ -525,15 +532,22 @@ class LogStream:
         are served from it; undecoded batches are scanned natively without
         populating the cache."""
         from_position = max(from_position, 1)
-        if from_position > self.last_position:
+        last = self.last_position
+        if from_position > last:
             return
         slot = self._batch_slot_for(from_position)
         if slot < 0:
             slot = 0
         pid = self.partition_id
-        for s in range(slot, len(self._batch_indexes)):
-            jindex = self._batch_indexes[s]
-            cached = self._batch_cache.get(jindex)
+        cache = self._batch_cache
+        # one streaming journal read (a single seek + bulk read per segment)
+        # instead of a random-access read per batch
+        for jrec in self.journal.read_from(self._batch_indexes[slot]):
+            if jrec.asqn < 0:
+                continue
+            if jrec.asqn > last:
+                return  # appended after this scan started
+            cached = cache.get(jrec.index)
             if cached is not None:
                 for logged in cached:
                     if logged.position < from_position:
@@ -545,9 +559,6 @@ class LogStream:
                         int(rec.value_type), int(rec.intent), rec.key,
                         None, 0, 0, rec.timestamp, pid, record=rec,
                     )
-                continue
-            jrec = self.journal.read_entry(jindex)
-            if jrec is None:
                 continue
             payload = jrec.data
             source_position, timestamp, headers = _scan_batch_headers(payload)

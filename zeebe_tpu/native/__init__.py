@@ -78,3 +78,10 @@ def load(module_name: str, source: str):
 
 def load_codec():
     return load("_zb_codec", "codec.c")
+
+
+def codec_fn(name: str):
+    """A named function from the codec module, or None when the native
+    build is unavailable or predates the function (stale .so)."""
+    codec = load_codec()
+    return getattr(codec, name, None) if codec is not None else None

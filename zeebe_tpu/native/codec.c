@@ -85,6 +85,7 @@ static inline int put_be64(Writer *w, uint64_t v)
 }
 
 static int pack_obj(Writer *w, PyObject *obj, int depth);
+static int pack_ll(Writer *w, long long v);
 
 static int pack_long(Writer *w, PyObject *obj)
 {
@@ -105,6 +106,11 @@ static int pack_long(Writer *w, PyObject *obj)
     }
     if (v == -1 && PyErr_Occurred())
         return -1;
+    return pack_ll(w, v);
+}
+
+static int pack_ll(Writer *w, long long v)
+{
     if (v >= 0) {
         if (v < 0x80)
             return put1(w, (uint8_t)v);
@@ -677,7 +683,383 @@ done:
     return out;
 }
 
+/* ------------------------------------------------------------------------
+ * Fingerprint packer (spec: kernel_backend._fingerprint's pure-Python walk).
+ *
+ * pack_fingerprint(docs, roles, fp_fields) -> (bytes, fp_values)
+ *   roles:     dict int -> str tag (keys known at admission)
+ *   fp_fields: set of dict-key names whose large-int values are extracted
+ * Two passes: collect large ints pinned at non-whitelisted positions, then
+ * emit msgpack with role markers ["\x00r", tag], extraction markers
+ * ["\x00f", ordinal], and "\x00s" string escaping — byte-identical to
+ * packb(norm(docs)) from the Python implementation. */
+
+typedef struct {
+    PyObject *roles;      /* borrowed: dict int -> str */
+    PyObject *fp_fields;  /* borrowed: set/frozenset of str */
+    PyObject *pinned;     /* owned: set of ints */
+    PyObject *fp_ordinal; /* owned: dict int -> int */
+    PyObject *fp_values;  /* owned: list of ints */
+    PyObject *min_obj;    /* owned: 2^32 */
+} FpCtx;
+
+static int fp_large(FpCtx *c, PyObject *obj, int *large)
+{
+    int r = PyObject_RichCompareBool(obj, c->min_obj, Py_GE);
+    if (r < 0)
+        return -1;
+    *large = r;
+    return 0;
+}
+
+static int fp_field_match(FpCtx *c, PyObject *key)
+{
+    if (!PyUnicode_CheckExact(key))
+        return 0;
+    return PySet_Contains(c->fp_fields, key);
+}
+
+static int fp_scan(FpCtx *c, PyObject *obj, int in_fp_field, int depth)
+{
+    if (depth > MAX_DEPTH) {
+        codec_error("fingerprint nesting exceeds %d", MAX_DEPTH);
+        return -1;
+    }
+    if (PyLong_CheckExact(obj)) {
+        int large;
+        if (fp_large(c, obj, &large) < 0)
+            return -1;
+        if (large && !in_fp_field) {
+            int in_roles = PyDict_Contains(c->roles, obj);
+            if (in_roles < 0)
+                return -1;
+            if (!in_roles && PySet_Add(c->pinned, obj) < 0)
+                return -1;
+        }
+        return 0;
+    }
+    if (PyDict_CheckExact(obj)) {
+        PyObject *k, *v;
+        Py_ssize_t pos = 0;
+        while (PyDict_Next(obj, &pos, &k, &v)) {
+            if (fp_scan(c, k, 0, depth + 1) < 0)
+                return -1;
+            int fp = fp_field_match(c, k);
+            if (fp < 0 || fp_scan(c, v, fp, depth + 1) < 0)
+                return -1;
+        }
+        return 0;
+    }
+    if (PyList_CheckExact(obj) || PyTuple_CheckExact(obj)) {
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(obj);
+        for (Py_ssize_t i = 0; i < n; i++)
+            if (fp_scan(c, PySequence_Fast_GET_ITEM(obj, i), 0, depth + 1) < 0)
+                return -1;
+        return 0;
+    }
+    return 0;
+}
+
+static const uint8_t FP_ROLE_MARK[4] = {0x92, 0xA2, 0x00, 'r'};
+static const uint8_t FP_EXTRACT_MARK[4] = {0x92, 0xA2, 0x00, 'f'};
+
+static int fp_emit(FpCtx *c, Writer *w, PyObject *obj, int in_fp_field, int depth)
+{
+    if (depth > MAX_DEPTH) {
+        codec_error("fingerprint nesting exceeds %d", MAX_DEPTH);
+        return -1;
+    }
+    if (PyLong_CheckExact(obj)) {
+        int large;
+        if (fp_large(c, obj, &large) < 0)
+            return -1;
+        if (large) {
+            PyObject *tag = PyDict_GetItemWithError(c->roles, obj);
+            if (!tag && PyErr_Occurred())
+                return -1;
+            if (tag) {
+                if (put(w, FP_ROLE_MARK, 4) < 0)
+                    return -1;
+                return pack_str(w, tag);
+            }
+            if (in_fp_field) {
+                int pinned = PySet_Contains(c->pinned, obj);
+                if (pinned < 0)
+                    return -1;
+                if (!pinned) {
+                    PyObject *ord = PyDict_GetItemWithError(c->fp_ordinal, obj);
+                    long long ordv;
+                    if (!ord && PyErr_Occurred())
+                        return -1;
+                    if (ord) {
+                        ordv = PyLong_AsLongLong(ord);
+                    } else {
+                        ordv = PyList_GET_SIZE(c->fp_values);
+                        PyObject *o = PyLong_FromLongLong(ordv);
+                        if (!o)
+                            return -1;
+                        int rc = PyDict_SetItem(c->fp_ordinal, obj, o);
+                        if (rc == 0)
+                            rc = PyList_Append(c->fp_values, obj);
+                        Py_DECREF(o);
+                        if (rc < 0)
+                            return -1;
+                    }
+                    if (put(w, FP_EXTRACT_MARK, 4) < 0)
+                        return -1;
+                    return pack_ll(w, ordv);
+                }
+            }
+        }
+        return pack_long(w, obj);
+    }
+    if (PyUnicode_CheckExact(obj)) {
+        Py_ssize_t n;
+        const char *raw = PyUnicode_AsUTF8AndSize(obj, &n);
+        if (!raw)
+            return -1;
+        if (n > 0 && raw[0] == 0) {
+            /* "\x00"-prefixed user string: escape as "\x00s" + original so
+             * it can never forge a role/extract marker */
+            Py_ssize_t total = n + 2;
+            if (total < 32) {
+                if (put1(w, (uint8_t)(0xA0 | total)) < 0)
+                    return -1;
+            } else if (total < 0x100) {
+                if (put1(w, 0xD9) < 0 || put1(w, (uint8_t)total) < 0)
+                    return -1;
+            } else if (total < 0x10000) {
+                if (put1(w, 0xDA) < 0 || put_be16(w, (uint16_t)total) < 0)
+                    return -1;
+            } else {
+                if (put1(w, 0xDB) < 0 || put_be32(w, (uint32_t)total) < 0)
+                    return -1;
+            }
+            static const uint8_t esc[2] = {0x00, 's'};
+            return put(w, esc, 2) < 0 || put(w, raw, n) < 0 ? -1 : 0;
+        }
+        return pack_str(w, obj);
+    }
+    if (PyDict_CheckExact(obj)) {
+        Py_ssize_t n = PyDict_GET_SIZE(obj);
+        if (n < 16) {
+            if (put1(w, (uint8_t)(0x80 | n)) < 0)
+                return -1;
+        } else if (n < 0x10000) {
+            if (put1(w, 0xDE) < 0 || put_be16(w, (uint16_t)n) < 0)
+                return -1;
+        } else {
+            if (put1(w, 0xDF) < 0 || put_be32(w, (uint32_t)n) < 0)
+                return -1;
+        }
+        PyObject *k, *v;
+        Py_ssize_t pos = 0;
+        while (PyDict_Next(obj, &pos, &k, &v)) {
+            if (fp_emit(c, w, k, 0, depth + 1) < 0)
+                return -1;
+            int fp = fp_field_match(c, k);
+            if (fp < 0 || fp_emit(c, w, v, fp, depth + 1) < 0)
+                return -1;
+        }
+        return 0;
+    }
+    if (PyList_CheckExact(obj) || PyTuple_CheckExact(obj)) {
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(obj);
+        if (n < 16) {
+            if (put1(w, (uint8_t)(0x90 | n)) < 0)
+                return -1;
+        } else if (n < 0x10000) {
+            if (put1(w, 0xDC) < 0 || put_be16(w, (uint16_t)n) < 0)
+                return -1;
+        } else {
+            if (put1(w, 0xDD) < 0 || put_be32(w, (uint32_t)n) < 0)
+                return -1;
+        }
+        for (Py_ssize_t i = 0; i < n; i++)
+            if (fp_emit(c, w, PySequence_Fast_GET_ITEM(obj, i), 0, depth + 1) < 0)
+                return -1;
+        return 0;
+    }
+    return pack_obj(w, obj, depth);
+}
+
+static PyObject *codec_pack_fingerprint(PyObject *self, PyObject *args)
+{
+    PyObject *docs, *roles, *fp_fields;
+    if (!PyArg_ParseTuple(args, "OOO", &docs, &roles, &fp_fields))
+        return NULL;
+    if (!PyDict_Check(roles) || !PyAnySet_Check(fp_fields)) {
+        PyErr_SetString(PyExc_TypeError, "roles must be dict, fp_fields a set");
+        return NULL;
+    }
+    FpCtx c = {roles, fp_fields, NULL, NULL, NULL, NULL};
+    PyObject *out = NULL, *payload = NULL;
+    Writer w = {NULL, 0, 0};
+    c.pinned = PySet_New(NULL);
+    c.fp_ordinal = PyDict_New();
+    c.fp_values = PyList_New(0);
+    c.min_obj = PyLong_FromUnsignedLongLong(1ULL << 32);
+    if (!c.pinned || !c.fp_ordinal || !c.fp_values || !c.min_obj)
+        goto done;
+    if (fp_scan(&c, docs, 0, 0) < 0)
+        goto done;
+    if (fp_emit(&c, &w, docs, 0, 0) < 0)
+        goto done;
+    payload = PyBytes_FromStringAndSize((const char *)w.data, w.len);
+    if (!payload)
+        goto done;
+    out = PyTuple_Pack(2, payload, c.fp_values);
+done:
+    PyMem_Free(w.data);
+    Py_XDECREF(payload);
+    Py_XDECREF(c.pinned);
+    Py_XDECREF(c.fp_ordinal);
+    Py_XDECREF(c.fp_values);
+    Py_XDECREF(c.min_obj);
+    return out;
+}
+
+/* ------------------------------------------------------------------------
+ * Bulk patch applier (burst-template instantiation fast path).
+ *
+ * apply_patches(buf, plan, values) -> None
+ *   buf:    bytearray to patch in place
+ *   plan:   bytes of little-endian entries {u32 offset; u8 fmt; u8 value_idx}
+ *           fmt 0 = i64 LE, 1 = i32 LE, 2 = u64 BE (masked),
+ *           fmt 3 = u64 BE with the state-key sign flip (v ^ 2^63)
+ *   values: sequence of ints, indexed by value_idx */
+#define PATCH_ENTRY_SIZE 6
+
+static PyObject *codec_apply_patches(PyObject *self, PyObject *args)
+{
+    PyObject *buf, *plan, *values;
+    if (!PyArg_ParseTuple(args, "OOO", &buf, &plan, &values))
+        return NULL;
+    if (!PyByteArray_CheckExact(buf) || !PyBytes_CheckExact(plan)
+        || !PyList_CheckExact(values)) {
+        PyErr_SetString(PyExc_TypeError,
+                        "apply_patches(bytearray, bytes, list) expected");
+        return NULL;
+    }
+    uint8_t *b = (uint8_t *)PyByteArray_AS_STRING(buf);
+    Py_ssize_t blen = PyByteArray_GET_SIZE(buf);
+    const uint8_t *p = (const uint8_t *)PyBytes_AS_STRING(plan);
+    Py_ssize_t plen = PyBytes_GET_SIZE(plan);
+    if (plen % PATCH_ENTRY_SIZE) {
+        PyErr_SetString(PyExc_ValueError, "malformed patch plan");
+        return NULL;
+    }
+    Py_ssize_t nvals = PyList_GET_SIZE(values);
+    int64_t cache[256];
+    uint8_t cached[256] = {0};
+    for (Py_ssize_t e = 0; e < plen; e += PATCH_ENTRY_SIZE) {
+        uint32_t off = (uint32_t)p[e] | ((uint32_t)p[e + 1] << 8)
+            | ((uint32_t)p[e + 2] << 16) | ((uint32_t)p[e + 3] << 24);
+        uint8_t fmt = p[e + 4];
+        uint8_t idx = p[e + 5];
+        if (idx >= nvals) {
+            PyErr_SetString(PyExc_IndexError, "patch value index out of range");
+            return NULL;
+        }
+        int64_t v;
+        if (cached[idx]) {
+            v = cache[idx];
+        } else {
+            int overflow = 0;
+            v = PyLong_AsLongLongAndOverflow(PyList_GET_ITEM(values, idx), &overflow);
+            if (v == -1 && PyErr_Occurred())
+                return NULL;
+            if (overflow) {
+                PyErr_SetString(PyExc_OverflowError, "patch value out of i64 range");
+                return NULL;
+            }
+            cache[idx] = v;
+            cached[idx] = 1;
+        }
+        Py_ssize_t width = (fmt == 1) ? 4 : 8;
+        if ((Py_ssize_t)off + width > blen) {
+            PyErr_SetString(PyExc_ValueError, "patch offset out of range");
+            return NULL;
+        }
+        switch (fmt) {
+        case 0:
+            memcpy(b + off, &v, 8);
+            break;
+        case 1: {
+            int32_t v32 = (int32_t)v;
+            memcpy(b + off, &v32, 4);
+            break;
+        }
+        case 2:
+        case 3: {
+            uint64_t u = (uint64_t)v;
+            if (fmt == 3)
+                u ^= 0x8000000000000000ULL;
+            for (int i = 0; i < 8; i++)
+                b[off + i] = (uint8_t)(u >> (56 - 8 * i));
+            break;
+        }
+        default:
+            PyErr_SetString(PyExc_ValueError, "unknown patch format");
+            return NULL;
+        }
+    }
+    Py_RETURN_NONE;
+}
+
+/* stamp_batch(buf, pos_offsets, ts_offsets, first_position, timestamp):
+ * write first_position+i LE at pos_offsets[i] and timestamp LE at every
+ * ts_offset — the only two unknowns of a pre-serialized burst batch,
+ * patched under the append lock. */
+static PyObject *codec_stamp_batch(PyObject *self, PyObject *args)
+{
+    PyObject *buf, *pos_offsets, *ts_offsets;
+    long long first_position, timestamp;
+    if (!PyArg_ParseTuple(args, "OOOLL", &buf, &pos_offsets, &ts_offsets,
+                          &first_position, &timestamp))
+        return NULL;
+    if (!PyByteArray_CheckExact(buf) || !PyList_CheckExact(pos_offsets)
+        || !PyList_CheckExact(ts_offsets)) {
+        PyErr_SetString(PyExc_TypeError,
+                        "stamp_batch(bytearray, list, list, int, int) expected");
+        return NULL;
+    }
+    uint8_t *b = (uint8_t *)PyByteArray_AS_STRING(buf);
+    Py_ssize_t blen = PyByteArray_GET_SIZE(buf);
+    Py_ssize_t n = PyList_GET_SIZE(pos_offsets);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        long long off = PyLong_AsLongLong(PyList_GET_ITEM(pos_offsets, i));
+        if (off == -1 && PyErr_Occurred())
+            return NULL;
+        if (off < 0 || off + 8 > blen) {
+            PyErr_SetString(PyExc_ValueError, "position offset out of range");
+            return NULL;
+        }
+        int64_t v = first_position + i;
+        memcpy(b + off, &v, 8);
+    }
+    n = PyList_GET_SIZE(ts_offsets);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        long long off = PyLong_AsLongLong(PyList_GET_ITEM(ts_offsets, i));
+        if (off == -1 && PyErr_Occurred())
+            return NULL;
+        if (off < 0 || off + 8 > blen) {
+            PyErr_SetString(PyExc_ValueError, "timestamp offset out of range");
+            return NULL;
+        }
+        int64_t v = timestamp;
+        memcpy(b + off, &v, 8);
+    }
+    Py_RETURN_NONE;
+}
+
 static PyMethodDef codec_methods[] = {
+    {"stamp_batch", codec_stamp_batch, METH_VARARGS,
+     "Stamp record positions and the batch timestamp into a pre-serialized burst."},
+    {"pack_fingerprint", codec_pack_fingerprint, METH_VARARGS,
+     "Role-normalizing fingerprint packer: (docs, roles, fp_fields) -> (bytes, fp_values)."},
+    {"apply_patches", codec_apply_patches, METH_VARARGS,
+     "Apply a compiled patch plan to a bytearray in place."},
     {"packb", codec_packb, METH_O, "Serialize an object to msgpack bytes."},
     {"unpackb", codec_unpackb, METH_O, "Deserialize one msgpack value (consumes all bytes)."},
     {"decode_record_frame", codec_decode_record_frame, METH_O,

@@ -110,6 +110,7 @@ class ColumnFamilyCode(enum.IntEnum):
 
 
 _I64 = struct.Struct(">Q")
+_INT_PART = struct.Struct(">BQ")  # tag 0x01 + sign-flipped u64, fused
 
 
 def _encode_part(part: Any, out: bytearray) -> None:
@@ -118,9 +119,8 @@ def _encode_part(part: Any, out: bytearray) -> None:
     if isinstance(part, bool):
         raise TypeError("bool key parts are ambiguous; use int 0/1")
     if isinstance(part, int):
-        out.append(0x01)
         # flip sign bit: two's-complement int64 → lexicographically ordered u64
-        out += _I64.pack((part & 0xFFFFFFFFFFFFFFFF) ^ 0x8000000000000000)
+        out += _INT_PART.pack(0x01, (part & 0xFFFFFFFFFFFFFFFF) ^ 0x8000000000000000)
     elif isinstance(part, str):
         raw = part.encode("utf-8")
         if b"\x00" in raw:
@@ -136,8 +136,27 @@ def _encode_part(part: Any, out: bytearray) -> None:
         raise TypeError(f"unsupported key part type {type(part).__name__}")
 
 
+# per-CF 2-byte prefixes, precomputed (encode_key runs several times per
+# command on the admission/processing hot path)
+_CF_PREFIX = {code: struct.pack(">H", int(code)) for code in ColumnFamilyCode}
+
+
 def encode_key(cf: ColumnFamilyCode, parts: tuple) -> bytes:
-    out = bytearray(struct.pack(">H", int(cf)))
+    prefix = _CF_PREFIX[cf]
+    n = len(parts)
+    # fast paths for the dominant shapes: (int,) and (int, int)
+    if n == 1:
+        p0 = parts[0]
+        if type(p0) is int:
+            return prefix + _INT_PART.pack(
+                0x01, (p0 & 0xFFFFFFFFFFFFFFFF) ^ 0x8000000000000000)
+    elif n == 2:
+        p0, p1 = parts
+        if type(p0) is int and type(p1) is int:
+            return (prefix
+                    + _INT_PART.pack(0x01, (p0 & 0xFFFFFFFFFFFFFFFF) ^ 0x8000000000000000)
+                    + _INT_PART.pack(0x01, (p1 & 0xFFFFFFFFFFFFFFFF) ^ 0x8000000000000000))
+    out = bytearray(prefix)
     for part in parts:
         _encode_part(part, out)
     return bytes(out)
