@@ -1,0 +1,198 @@
+"""Partitions as mesh shards in the SERVING stack (SURVEY.md §2.13 row 1).
+
+The reference scales by adding Raft partitions (atomix/…/raft/partition/
+RaftPartition.java:44); here N partitions' admitted command groups run as
+shard blocks of ONE device-mesh dispatch (parallel/mesh_runner.py). The
+oracle everywhere is byte-equality: a partition's log must be identical
+whether its groups ran on the default device, alone on the mesh, or
+coalesced with other partitions' groups in one dispatch."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from zeebe_tpu.models.bpmn import Bpmn
+from zeebe_tpu.parallel.mesh_runner import MeshKernelRunner
+from zeebe_tpu.testing import EngineHarness, MultiPartitionHarness
+
+
+def one_task(pid="one_task"):
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("start").service_task("task", job_type="work")
+        .end_event("end").done()
+    )
+
+
+def fork_join(pid="fork_join"):
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .parallel_gateway("fork")
+        .service_task("a", job_type="a")
+        .parallel_gateway("join")
+        .end_event("e")
+        .move_to_element("fork")
+        .service_task("b", job_type="b")
+        .connect_to("join")
+        .done()
+    )
+
+
+def log_bytes(h: EngineHarness) -> list[bytes]:
+    return [
+        (v.position, v.record.to_bytes(), v.processed, v.source_position)
+        for v in h.stream.scan()
+    ]
+
+
+def drive_scenario(h: EngineHarness) -> None:
+    h.deploy(one_task(), fork_join())
+    for i in range(6):
+        h.create_instance("one_task", variables={"n": i})
+    for _ in range(2):
+        h.create_instance("fork_join")
+    for job_type in ("work", "a", "b"):
+        jobs = h.activate_jobs(job_type, max_jobs=20)
+        for job in jobs:
+            h.complete_job(job["key"], None)
+
+
+class TestMeshBackedPartition:
+    def test_log_byte_identical_to_default_device(self):
+        baseline = EngineHarness(use_kernel_backend=True)
+        drive_scenario(baseline)
+        base_log = log_bytes(baseline)
+        baseline.close()
+
+        runner = MeshKernelRunner(n_shards=8)
+        meshed = EngineHarness(use_kernel_backend=True, mesh_runner=runner)
+        drive_scenario(meshed)
+        mesh_log = log_bytes(meshed)
+        assert meshed.kernel_backend.groups_processed > 0
+        meshed.close()
+
+        assert runner.dispatches > 0
+        assert mesh_log == base_log
+
+    def test_multipartition_cluster_on_one_mesh(self):
+        # the §2.13 thesis end-to-end: 3 partitions, creations routed to
+        # each, every partition's kernel group served by the SHARED runner;
+        # logs must equal the non-mesh kernel cluster's byte for byte
+        def run(mesh_runner):
+            c = MultiPartitionHarness(partition_count=3,
+                                      use_kernel_backend=True,
+                                      mesh_runner=mesh_runner)
+            p1 = c.partitions[1]
+            p1.deploy(one_task())  # deployment distribution → all partitions
+            for pid in (1, 2, 3):
+                for i in range(4):
+                    c.partitions[pid].create_instance(
+                        "one_task", variables={"p": pid, "i": i})
+            for pid in (1, 2, 3):
+                jobs = c.partitions[pid].activate_jobs("work", max_jobs=10)
+                for job in jobs:
+                    c.partitions[pid].complete_job(job["key"], None)
+            logs = {pid: log_bytes(c.partitions[pid]) for pid in (1, 2, 3)}
+            groups = {pid: c.partitions[pid].kernel_backend.groups_processed
+                      for pid in (1, 2, 3)}
+            c.close()
+            return logs, groups
+
+        base_logs, base_groups = run(None)
+        runner = MeshKernelRunner(n_shards=8)
+        mesh_logs, mesh_groups = run(runner)
+        assert runner.dispatches > 0 and runner.groups_dispatched > 0
+        assert mesh_groups == base_groups
+        for pid in (1, 2, 3):
+            assert mesh_logs[pid] == base_logs[pid], f"partition {pid} diverged"
+            assert mesh_groups[pid] > 0
+
+    def test_concurrent_submissions_coalesce_and_stay_byte_identical(self):
+        # two independent partitions submitting from their own ownership
+        # threads: the leader-follower queue coalesces them into ONE sharded
+        # dispatch (the batch window makes the race deterministic), and each
+        # partition's log still equals its solo-run log byte for byte
+        from zeebe_tpu.logstreams import LogAppendEntry
+        from zeebe_tpu.protocol import ValueType, command
+        from zeebe_tpu.protocol.intent import ProcessInstanceCreationIntent
+
+        def write_creations(h, seed: int) -> None:
+            # raw writes, ONE pump: the same ingress shape the threaded run
+            # uses, so the baseline log interleaves identically
+            for i in range(5):
+                rec = command(
+                    ValueType.PROCESS_INSTANCE_CREATION,
+                    ProcessInstanceCreationIntent.CREATE,
+                    {"bpmnProcessId": f"proc{seed}", "version": -1,
+                     "variables": {"i": i}},
+                ).replace(request_id=2, request_stream_id=0)
+                h.stream.writer.try_write([LogAppendEntry(rec)])
+
+        def solo(seed: int):
+            h = EngineHarness(use_kernel_backend=True)
+            h.deploy(one_task(f"proc{seed}"))
+            write_creations(h, seed)
+            h.pump()
+            jobs = h.activate_jobs("work", max_jobs=10)
+            for job in jobs:
+                h.complete_job(job["key"], None)
+            out = log_bytes(h)
+            h.close()
+            return out
+
+        base = {seed: solo(seed) for seed in (1, 2)}
+
+        runner = MeshKernelRunner(n_shards=8, batch_window_s=0.35)
+        harnesses = {
+            seed: EngineHarness(use_kernel_backend=True, mesh_runner=runner)
+            for seed in (1, 2)
+        }
+        for seed, h in harnesses.items():
+            h.deploy(one_task(f"proc{seed}"))
+        barrier = threading.Barrier(2)
+        errors: list[BaseException] = []
+
+        def drive(seed: int):
+            try:
+                h = harnesses[seed]
+                write_creations(h, seed)
+                barrier.wait(timeout=10)
+                h.pump()  # both threads hit the runner together
+                jobs = h.activate_jobs("work", max_jobs=10)
+                for job in jobs:
+                    h.complete_job(job["key"], None)
+            except BaseException as exc:  # noqa: BLE001 — surfaced below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=drive, args=(s,)) for s in (1, 2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        for seed, h in harnesses.items():
+            assert log_bytes(h) == base[seed], f"partition {seed} diverged"
+            h.close()
+        # the barrier + batch window force at least one coalesced dispatch
+        assert runner.coalesced_dispatches >= 1, (
+            runner.dispatches, runner.groups_dispatched)
+
+
+class TestRunnerUnit:
+    def test_groups_by_tables_fingerprint(self):
+        # different table sets must not share a dispatch; same sets must
+        runner = MeshKernelRunner(n_shards=8)
+        h1 = EngineHarness(use_kernel_backend=True, mesh_runner=runner)
+        h2 = EngineHarness(use_kernel_backend=True, mesh_runner=runner)
+        try:
+            h1.deploy(one_task("pa"))
+            h2.deploy(one_task("pb"))  # different process id → different tables
+            h1.create_instance("pa")
+            h2.create_instance("pb")
+            assert runner.dispatches >= 2  # fingerprints differ → no sharing
+        finally:
+            h1.close()
+            h2.close()

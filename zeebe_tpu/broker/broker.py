@@ -45,6 +45,11 @@ class BrokerCfg:
     # path IS the kernel path; eligible commands batch onto the device,
     # everything else falls through to the sequential engine unchanged.
     kernel_backend: bool = True
+    # > 0: the partitions' kernel groups run as shards of ONE device mesh
+    # (parallel/mesh_runner.py) — partition = shard of the device batch.
+    # A shared MeshKernelRunner may also be injected by the hosting runtime
+    # (ClusterRuntime) so in-process brokers share a single mesh.
+    kernel_mesh_shards: int = 0
 
 
 def partition_distribution(cfg: BrokerCfg) -> dict[int, list[str]]:
@@ -104,7 +109,8 @@ class Broker:
                  backup_store_directory: str | Path | None = None,
                  backpressure_algorithm: str = "vegas",
                  backpressure_enabled: bool = True,
-                 disk_min_free_bytes: int = 0) -> None:
+                 disk_min_free_bytes: int = 0,
+                 mesh_runner=None) -> None:
         import time
 
         from zeebe_tpu.broker.disk import DiskSpaceMonitor
@@ -113,6 +119,8 @@ class Broker:
 
         self.cfg = cfg
         self.messaging = messaging
+        self._injected_mesh_runner = mesh_runner
+        self._owned_mesh_runner = None
         self._tmp = None
         if directory is None:
             self._tmp = tempfile.TemporaryDirectory()
@@ -254,6 +262,22 @@ class Broker:
         self._partition_guard = guard
         self.topology.partition_guard = guard
 
+    def _mesh_runner(self):
+        """The shared kernel mesh runner: injected by the hosting runtime
+        (one mesh per process), or lazily created from
+        ``cfg.kernel_mesh_shards`` for a standalone broker. None = the
+        kernel runs single-device."""
+        if not self.cfg.kernel_backend:
+            return None  # the kernel backend is the runner's only consumer
+        if self._injected_mesh_runner is not None:
+            return self._injected_mesh_runner
+        if self.cfg.kernel_mesh_shards > 0 and self._owned_mesh_runner is None:
+            from zeebe_tpu.parallel.mesh_runner import MeshKernelRunner
+
+            self._owned_mesh_runner = MeshKernelRunner(
+                n_shards=self.cfg.kernel_mesh_shards)
+        return self._owned_mesh_runner
+
     def _create_partition(self, partition_id: int, members: list[str],
                           priority: int = 1) -> None:
         from zeebe_tpu.broker.backpressure import CommandRateLimiter
@@ -277,6 +301,7 @@ class Broker:
             priority=priority,
             on_jobs_available=self._on_jobs_available,
             kernel_backend_enabled=self.cfg.kernel_backend,
+            mesh_runner=self._mesh_runner(),
         )
         self.health_monitor.register(f"partition-{partition_id}")
         self.messaging.subscribe(

@@ -87,6 +87,8 @@ _ENV_BINDINGS: dict[str, tuple[str, str, Any]] = {
         "base", "consistency_checks", lambda v: v.lower() in ("1", "true", "yes")),
     "ZEEBE_BROKER_EXPERIMENTAL_KERNELBACKEND": (
         "base", "kernel_backend", lambda v: v.lower() in ("1", "true", "yes")),
+    "ZEEBE_BROKER_EXPERIMENTAL_KERNELMESHSHARDS": (
+        "base", "kernel_mesh_shards", int),
 }
 
 

@@ -98,6 +98,7 @@ class ZeebePartition:
         backpressure=None,
         on_jobs_available=None,
         kernel_backend_enabled: bool = True,
+        mesh_runner=None,
     ) -> None:
         self.partition_id = partition_id
         self.partition_count = partition_count
@@ -117,6 +118,7 @@ class ZeebePartition:
         # gateway hub (long-poll wakeup + job push dispatch)
         self.on_jobs_available = on_jobs_available
         self.kernel_backend_enabled = kernel_backend_enabled
+        self.mesh_runner = mesh_runner
         # client-ingress backpressure (CommandRateLimiter | None) and the
         # disk-monitor pause flag; both gate client_write only — follow-ups,
         # scheduled commands, and inter-partition traffic always pass
@@ -220,7 +222,8 @@ class ZeebePartition:
             from zeebe_tpu.engine.kernel_backend import KernelBackend
 
             kernel_backend = KernelBackend(self.engine, max_group=2048,
-                                           chunk_steps=8)
+                                           chunk_steps=8,
+                                           mesh_runner=self.mesh_runner)
         self.processor = StreamProcessor(
             self.stream, self.db, self.engine, mode=mode,
             response_sink=self.response_sink, clock_millis=self.clock_millis,

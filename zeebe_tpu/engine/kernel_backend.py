@@ -380,6 +380,16 @@ class KernelRegistry:
             self._device = DeviceTables.from_tables(self.tables)
         return self._device
 
+    @property
+    def tables_fingerprint(self) -> tuple:
+        """Identity of the compiled table set ACROSS partitions: two
+        registries that registered the same definitions (same keys, order,
+        and host lowerings) compile identical tables, so their groups may
+        share one mesh dispatch (the sharded program takes one replicated
+        DeviceTables argument). Deployment distribution applies deployments
+        in the same order on every partition, so this matches in practice."""
+        return tuple((i.key, i.index, i.host_idxs) for i in self._infos)
+
 
 @dataclass
 class _Token:
@@ -436,12 +446,17 @@ class KernelBackend:
     def __init__(self, engine, max_group: int = 256, max_steps: int = 4096,
                  chunk_steps: int = 8, use_templates: bool = True,
                  audit_templates: bool = False,
-                 max_commands_in_batch: int = 100) -> None:
+                 max_commands_in_batch: int = 100,
+                 mesh_runner=None) -> None:
         self.engine = engine
         self.registry = KernelRegistry()
         self.max_group = max_group
         self.max_steps = max_steps
         self.chunk_steps = chunk_steps
+        # shared MeshKernelRunner (parallel/mesh_runner.py): when set, this
+        # partition's groups run as shards of ONE mesh dispatch, coalescing
+        # with other partitions' concurrently submitted groups
+        self.mesh_runner = mesh_runner
         # must match the stream processor's batch budget: the host-escape
         # drain accounts commands exactly like the sequential batch loop
         self.max_commands_in_batch = max_commands_in_batch
@@ -827,18 +842,12 @@ class KernelBackend:
             p *= 2
         return p
 
-    def _run_kernel(self, admitted: list[_Admitted]) -> list[dict] | None:
-        """Build the group batch, step to quiescence, return per-step host
-        events (None → caller must fall back)."""
-        import jax
-        import jax.numpy as jnp
-
-        from zeebe_tpu.ops.automaton import (
-            PACK_MAX_ELEMENTS,
-            PACK_MAX_TOKENS,
-            run_collect,
-            unpack_events,
-        )
+    def _build_group_arrays(self, admitted: list[_Admitted]):
+        """Host (numpy) arrays for one admitted group, padded to the shape
+        bucket: (arrays dict, I, T), or None when the geometry exceeds the
+        event-packing bounds. Shared by the single-device path and the
+        mesh-runner path (which treats the group as one shard block)."""
+        from zeebe_tpu.ops.automaton import PACK_MAX_ELEMENTS, PACK_MAX_TOKENS
 
         tables = self.registry.tables
         insts = [a.inst for a in admitted]
@@ -893,7 +902,56 @@ class KernelBackend:
                     phase[slot] = tok.phase
                     inst_arr[slot] = i.idx
                     slot += 1
+        arrays = {
+            "elem": elem, "phase": phase, "inst": inst_arr, "def_of": def_of,
+            "var_slots": var_slots, "join_counts": join_counts, "done": done,
+        }
+        return arrays, I, T
 
+    def _run_kernel(self, admitted: list[_Admitted]) -> list[dict] | None:
+        """Build the group batch, step to quiescence, return per-step host
+        events (None → caller must fall back). With a mesh runner configured
+        the group runs as one shard of a mesh dispatch (possibly coalesced
+        with other partitions' groups); otherwise on the default device."""
+        import jax
+        import jax.numpy as jnp
+
+        from zeebe_tpu.ops.automaton import run_collect, unpack_events
+
+        built = self._build_group_arrays(admitted)
+        if built is None:
+            return None
+        arrays, I, T = built
+        tables = self.registry.tables
+
+        if self.mesh_runner is not None:
+            from zeebe_tpu.parallel.mesh_runner import GroupRequest
+
+            result = self.mesh_runner.submit(GroupRequest(
+                device_tables=self.registry.device_tables,
+                config=tables.kernel_config,
+                tables_fingerprint=self.registry.tables_fingerprint,
+                arrays=arrays,
+                num_instances=I,
+                num_tokens=T,
+                max_steps=self.max_steps,
+                chunk_steps=self.chunk_steps,
+            ))
+            if result.steps is None or not result.quiesced:
+                logger.warning("mesh kernel group did not complete; falling back")
+                return None
+            if result.overflow:
+                logger.warning("mesh kernel token pool overflow (T=%d); falling back", T)
+                return None
+            return result.steps
+
+        elem = arrays["elem"]
+        phase = arrays["phase"]
+        inst_arr = arrays["inst"]
+        def_of = arrays["def_of"]
+        var_slots = arrays["var_slots"]
+        join_counts = arrays["join_counts"]
+        done = arrays["done"]
         state = {
             "elem": jnp.asarray(elem),
             "phase": jnp.asarray(phase),

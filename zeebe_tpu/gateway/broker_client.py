@@ -115,7 +115,8 @@ class ClusterRuntime(GatewayRuntimeBase):
                  disk_min_free_bytes: int = 0,
                  backup_store_directory=None,
                  backup_store=None,
-                 kernel_backend: bool = True) -> None:
+                 kernel_backend: bool = True,
+                 kernel_mesh_shards: int = 0) -> None:
         self.partition_count = partition_count
         self.net = LoopbackNetwork(lanes=partition_count)
         self._lock = threading.RLock()
@@ -130,6 +131,14 @@ class ClusterRuntime(GatewayRuntimeBase):
         self._init_jobstreams()
         members = [f"broker-{i}" for i in range(broker_count)]
         self.brokers: dict[str, Broker] = {}
+        # one mesh per process: every in-process broker's partitions submit
+        # kernel groups to the SAME runner, so the whole cluster's batch
+        # coalesces onto one device mesh (partition = shard, SURVEY §2.13)
+        self.mesh_runner = None
+        if kernel_mesh_shards > 0:
+            from zeebe_tpu.parallel.mesh_runner import MeshKernelRunner
+
+            self.mesh_runner = MeshKernelRunner(n_shards=kernel_mesh_shards)
         from pathlib import Path
 
         for m in members:
@@ -147,6 +156,7 @@ class ClusterRuntime(GatewayRuntimeBase):
                 disk_min_free_bytes=disk_min_free_bytes,
                 backup_store_directory=backup_store_directory,
                 backup_store=backup_store,
+                mesh_runner=self.mesh_runner,
             )
             self.brokers[m].jobs_listener = self._on_jobs_available
             # topology-driven partition add/remove must hold the partition's

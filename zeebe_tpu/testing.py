@@ -59,6 +59,7 @@ class EngineHarness:
         sender=None,
         clock: ControlledClock | None = None,
         use_kernel_backend: bool = False,
+        mesh_runner=None,
     ) -> None:
         self._tmp = None
         if directory is None:
@@ -82,7 +83,8 @@ class EngineHarness:
             # small group bucket: tests drive few instances at a time, and
             # the kernel pads every group to the max-group geometry
             kernel_backend = KernelBackend(self.engine, max_group=64,
-                                           audit_templates=True)
+                                           audit_templates=True,
+                                           mesh_runner=mesh_runner)
         self.kernel_backend = kernel_backend
         self.processor = StreamProcessor(
             self.stream,
@@ -314,7 +316,8 @@ class MultiPartitionHarness:
     one process, no Raft, no network."""
 
     def __init__(self, partition_count: int = 3, directory: str | Path | None = None,
-                 consistency_checks: bool = True) -> None:
+                 consistency_checks: bool = True,
+                 use_kernel_backend: bool = False, mesh_runner=None) -> None:
         from zeebe_tpu.parallel.partitioning import InProcessClusterSender
 
         self._tmp = None
@@ -325,6 +328,7 @@ class MultiPartitionHarness:
         self.clock = ControlledClock()
         self.sender = InProcessClusterSender()
         self.partitions: dict[int, EngineHarness] = {}
+        self.mesh_runner = mesh_runner
         self._pumping = False
         for pid in range(1, partition_count + 1):
             h = EngineHarness(
@@ -334,6 +338,8 @@ class MultiPartitionHarness:
                 sender=self.sender,
                 clock=self.clock,
                 consistency_checks=consistency_checks,
+                use_kernel_backend=use_kernel_backend,
+                mesh_runner=mesh_runner,
             )
             h.cluster = self
             self.partitions[pid] = h
