@@ -109,6 +109,19 @@ def ten_tasks(pid="ten_tasks"):
     return b.end_event("e").done()
 
 
+def ten_tasks_io(pid="ten_tasks_io"):
+    """ten_tasks with input+output mappings on every task — the io-mapped
+    elements ride the kernel (VERDICT r2 item 5) instead of host-escaping."""
+    b = Bpmn.create_executable_process(pid).start_event("s")
+    for i in range(10):
+        b = (
+            b.service_task(f"t{i}", job_type=f"work_{pid}")
+            .zeebe_input("= base", f"local{i}")
+            .zeebe_output(f"= local{i}", f"result{i}")
+        )
+    return b.end_event("e").done()
+
+
 def subprocess_boundary(pid="sub_bnd"):
     """Embedded sub-process + timer-boundary task (kernel scope + boundary
     wait-state paths under load)."""
@@ -415,6 +428,8 @@ def main() -> None:
                                  variables={"x": 15})
     e2e_ten = run_e2e_workload([ten_tasks()], drives=10, n_instances=800,
                                variables={})
+    e2e_ten_io = run_e2e_workload([ten_tasks_io()], drives=10, n_instances=800,
+                                  variables={"base": 5})
     e2e_scope = run_e2e_workload([subprocess_boundary()], drives=1,
                                  n_instances=2000, variables={})
     recovery = run_replay_recovery()
@@ -432,6 +447,7 @@ def main() -> None:
             "e2e_fork_join": e2e_fork,
             "e2e_mixed_8_definitions": e2e_mixed,
             "e2e_ten_tasks": e2e_ten,
+            "e2e_ten_tasks_io_mapped": e2e_ten_io,
             "e2e_subprocess_boundary": e2e_scope,
             "kernel_ceiling_transitions_per_sec": ceiling["transitions_per_sec"],
             "replay_recovery": recovery,

@@ -173,17 +173,10 @@ class BpmnProcessor:
                 self._write_variable(writers, key, value, mi.input_element, mi_item)
 
         # input mappings create a local variable scope on the element instance
-        if element.inputs:
-            context = self.state.variables.collect(
-                key if is_mi_inner else value.get("flowScopeKey", -1)
-            )
-            try:
-                for expr, target in element.inputs:
-                    result = expr.evaluate(context, self.clock_millis)
-                    self._write_variable(writers, key, value, target, result)
-            except FeelEvalError as exc:
-                self._raise_incident(writers, key, value, ErrorType.IO_MAPPING_ERROR, str(exc))
-                return
+        if element.inputs and not self._apply_input_mappings(
+                key, value, element, writers,
+                context_key=key if is_mi_inner else value.get("flowScopeKey", -1)):
+            return
 
         # boundary-event subscriptions attach when the host activity activates
         if element.boundary_idxs and not is_mi_inner and not retrying:
@@ -991,6 +984,42 @@ class BpmnProcessor:
         self._execute_catch(("boundary", exe, target, host_key, pi_value), writers)
         return True
 
+    def _apply_input_mappings(self, key: int, value: dict,
+                              element, writers: Writers,
+                              context_key: int) -> bool:
+        """Evaluate zeebe:input mappings against the given scope context and
+        write them as locals on the element instance. False = IO_MAPPING_ERROR
+        incident raised (element stays in its current state). Shared by the
+        sequential activate path and the kernel materializer (byte parity by
+        construction)."""
+        context = self.state.variables.collect(context_key)
+        try:
+            for expr, target in element.inputs:
+                result = expr.evaluate(context, self.clock_millis)
+                self._write_variable(writers, key, value, target, result)
+        except FeelEvalError as exc:
+            self._raise_incident(writers, key, value, ErrorType.IO_MAPPING_ERROR, str(exc))
+            return False
+        return True
+
+    def _apply_output_mappings(self, key: int, value: dict,
+                               element, writers: Writers) -> bool:
+        """Evaluate zeebe:output mappings against the element scope and write
+        the targets to the flow scope. False = IO_MAPPING_ERROR incident
+        raised (element stays COMPLETING). Shared with the kernel
+        materializer."""
+        context = self.state.variables.collect(key)
+        try:
+            for expr, target in element.outputs:
+                result = expr.evaluate(context, self.clock_millis)
+                self._write_variable(
+                    writers, value.get("flowScopeKey", -1), value, target, result
+                )
+        except FeelEvalError as exc:
+            self._raise_incident(writers, key, value, ErrorType.IO_MAPPING_ERROR, str(exc))
+            return False
+        return True
+
     # -------------------------------------------------------------- completion
 
     def _complete(
@@ -1013,15 +1042,7 @@ class BpmnProcessor:
         # With multi-instance they apply on the body (which sees the output
         # collection), not on each inner instance (reference docs).
         if element.outputs and not is_mi_inner:
-            context = self.state.variables.collect(key)
-            try:
-                for expr, target in element.outputs:
-                    result = expr.evaluate(context, self.clock_millis)
-                    self._write_variable(
-                        writers, value.get("flowScopeKey", -1), value, target, result
-                    )
-            except FeelEvalError as exc:
-                self._raise_incident(writers, key, value, ErrorType.IO_MAPPING_ERROR, str(exc))
+            if not self._apply_output_mappings(key, value, element, writers):
                 return
 
         # boundary/catch subscriptions close when the element leaves ACTIVATED

@@ -156,12 +156,78 @@ def _is_numeric(v: Any) -> bool:
     return isinstance(v, (bool, int, float)) and not isinstance(v, str)
 
 
+def _safe_mapping_expr(expr) -> bool:
+    """True when evaluating the expression can NEVER raise: a static string
+    or a bare variable/literal FEEL AST (a missing variable evaluates to
+    null). The kernel's trace decoder routes tokens BEFORE the materializer
+    evaluates mappings, so an element may ride the device only when its
+    mappings cannot fail mid-burst (an IO_MAPPING_ERROR incident after the
+    device already took the outgoing flows would diverge from the
+    sequential engine)."""
+    from zeebe_tpu.feel.feel import Lit, Var
+
+    return expr.is_static or isinstance(expr.ast, (Lit, Var))
+
+
+_COND_VAR_CACHE: dict[str, frozenset[str]] = {}
+
+
+def _condition_var_names(exe: ExecutableProcess) -> frozenset[str]:
+    """Variable names read by ANY flow condition of the definition —
+    computed statically from the FEEL ASTs, once per content digest (the
+    digest covers every flow's condition source). Output mappings targeting
+    these must stay host-side: device condition slots are prefetched at
+    admission, so a mid-burst write the device cannot see would
+    mis-route."""
+    import dataclasses as _dc
+
+    from zeebe_tpu.feel.feel import Var
+
+    cached = _COND_VAR_CACHE.get(exe.digest)
+    if cached is not None:
+        return cached
+
+    names: set[str] = set()
+
+    def walk(node):
+        if isinstance(node, (list, tuple)):
+            for x in node:
+                walk(x)
+        elif isinstance(node, Var):
+            names.add(node.path[0])  # the root name owns the slot
+        elif _dc.is_dataclass(node) and not isinstance(node, type):
+            for f in _dc.fields(node):
+                walk(getattr(node, f.name))
+
+    for flow in exe.flows:
+        if flow.condition is not None and not flow.condition.is_static:
+            walk(flow.condition.ast)
+    out = frozenset(names)
+    if len(_COND_VAR_CACHE) > 4096:
+        _COND_VAR_CACHE.clear()
+    _COND_VAR_CACHE[exe.digest] = out
+    return out
+
+
 def check_element_eligibility(exe: ExecutableProcess, el: ExecutableElement) -> bool:
     """True when the sequential engine's behavior for this element is exactly
     the kernel's opcode behavior (engine/…/processing/bpmn element processors
     vs ops/automaton masks)."""
-    if el.inputs or el.outputs or el.multi_instance is not None:
+    if el.multi_instance is not None:
         return False
+    if el.inputs or el.outputs:
+        # io-mappings ride the kernel on job-worker tasks only, and only
+        # when they cannot fail mid-burst (safe expressions) and their
+        # outputs cannot invalidate prefetched device condition slots
+        if _KERNEL_OP.get(el.element_type) != K_TASK:
+            return False
+        if not all(_safe_mapping_expr(e) for e, _t in el.inputs):
+            return False
+        if el.outputs:
+            if not all(_safe_mapping_expr(e) for e, _t in el.outputs):
+                return False
+            if {t for _e, t in el.outputs} & _condition_var_names(exe):
+                return False
     if el.native_user_task or el.called_decision_id or el.script_expression is not None:
         return False
     if el.element_type == BpmnElementType.BOUNDARY_EVENT:
@@ -744,6 +810,25 @@ class KernelBackend:
             return None
         (tokens, resume, root, wait_docs, wait_keys, scope_keys,
          join_counts) = rebuilt
+        resume_el = info.exe.elements[resume.elem_idx]
+        if extra_variables:
+            if kind == "j" and resume_el.outputs:
+                # the sequential job-complete merges ALL completion variables
+                # into the element's LOCAL scope when the element has output
+                # mappings (processors.py merge_local) — they die with the
+                # element and must never reach the root condition slots
+                extra_variables = None
+            else:
+                # default propagation: each variable lands on the nearest
+                # scope that already holds it locally, else the root. A
+                # mid-chain local (input-mapped element scope, or a
+                # sub-process scope written by an inner output mapping)
+                # would absorb the variable where the device's root-slot
+                # prefetch cannot see it — decline those resumes
+                for name in extra_variables:
+                    scope = state.variables.find_scope_with(resume_key, name)
+                    if scope is not None and scope != pi_key:
+                        return None
         if self.registry.tables.kernel_op[info.index, resume.elem_idx] != require_op:
             return None
         merged = state.variables.collect(pi_key)
@@ -758,6 +843,22 @@ class KernelBackend:
         # roles by the fingerprint walk (so instances with different due
         # dates share a template), and freshly computed due dates in the
         # burst itself resolve as ("clock", delta) roles
+        # locals of input-mapped parked tasks: the slow path's output
+        # mappings read them, so the template fingerprint must pin them
+        # (root-scope variables are pinned via ``merged`` already)
+        exe_elements = info.exe.elements
+        mapped_locals = [
+            sorted(state.variables.locals_of(t.key).items())
+            if exe_elements[t.elem_idx].inputs else None
+            for t in tokens
+        ]
+        # sub-process scope locals (written e.g. by inner output mappings):
+        # mapping/condition evaluation reads them through collect(), so two
+        # instances differing only there must fingerprint apart
+        scope_locals = [
+            (idx, sorted(state.variables.locals_of(k).items()))
+            for idx, k in sorted(scope_keys.items()) if idx != 0
+        ]
         return _Admitted(
             cmd=cmd, inst=inst, resume_token=resume, kind=kind,
             fp_docs=[
@@ -768,6 +869,8 @@ class KernelBackend:
                 wait_docs,
                 sorted(merged.items()),
                 sorted(join_counts.items()),
+                mapped_locals,
+                scope_locals,
             ],
             templatable=pi_key not in self.engine.await_results,
             wait_keys=wait_keys,
@@ -1688,6 +1791,17 @@ class KernelBackend:
                     continue
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_ACTIVATING, value)
+                if element.inputs:
+                    # input mappings create the element's local scope between
+                    # ACTIVATING and the boundary subscriptions (mirror
+                    # _activate's ordering; eligibility admits only safe
+                    # expressions, so failure is unreachable — handled
+                    # defensively by parking the element ACTIVATING exactly
+                    # like the sequential incident path)
+                    if not self.engine.bpmn._apply_input_mappings(
+                            tok.key, value, element, writers,
+                            context_key=value.get("flowScopeKey", -1)):
+                        continue
                 if element.boundary_idxs:
                     # boundary subscriptions attach between ACTIVATING and
                     # ACTIVATED (mirror BpmnProcessor._activate's ordering)
@@ -1723,6 +1837,20 @@ class KernelBackend:
                     self._mark_last_command_processed(builder)
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_COMPLETING, value)
+                if element.outputs:
+                    # output mappings run between COMPLETING and the
+                    # subscription close (mirror _complete's ordering).
+                    # Eligibility admits only safe expressions, so failure
+                    # is unreachable; if it ever happened the element stays
+                    # COMPLETING with the incident, and the already-routed
+                    # downstream tokens would diverge — log loudly.
+                    if not self.engine.bpmn._apply_output_mappings(
+                            tok.key, value, element, writers):
+                        logger.error(
+                            "output mapping failed on kernel path for %s — "
+                            "routing already committed; incident raised",
+                            element.id)
+                        continue
                 if element.boundary_idxs:
                     # mirror _complete: subscriptions close between COMPLETING
                     # and COMPLETED (TIMER CANCELED / subscription DELETED)

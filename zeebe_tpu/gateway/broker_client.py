@@ -135,7 +135,7 @@ class ClusterRuntime(GatewayRuntimeBase):
         # kernel groups to the SAME runner, so the whole cluster's batch
         # coalesces onto one device mesh (partition = shard, SURVEY §2.13)
         self.mesh_runner = None
-        if kernel_mesh_shards > 0:
+        if kernel_mesh_shards > 0 and kernel_backend:
             from zeebe_tpu.parallel.mesh_runner import MeshKernelRunner
 
             self.mesh_runner = MeshKernelRunner(n_shards=kernel_mesh_shards)
