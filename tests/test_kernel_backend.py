@@ -1536,3 +1536,29 @@ class TestIoMappingsOnKernel:
             drive_jobs(h, "sc_w2")  # outputs must be out=1 (A) and out=2 (B)
 
         assert_equivalent(scenario)
+
+    def test_set_variables_local_splits_fingerprints(self):
+        # review regression: SetVariables(local=true) creates locals on a
+        # parked task WITHOUT input mappings; its output mappings read them,
+        # so instances differing only in that local must not share a
+        # template (sequential out values must survive byte-for-byte)
+        def proc(pid="setvar_local"):
+            return (
+                Bpmn.create_executable_process(pid)
+                .start_event("s")
+                .service_task("t", job_type="sv_w")
+                .zeebe_output("= v", "out")
+                .end_event("e")
+                .done()
+            )
+
+        def scenario(h):
+            h.deploy(proc())
+            keys = [h.create_instance("setvar_local") for _ in range(3)]
+            jobs = {j["processInstanceKey"]: j for j in h.activate_jobs("sv_w", max_jobs=10)}
+            for k, v in zip(keys, (100, 100, 999)):
+                h.set_variables(jobs[k]["elementInstanceKey"], {"v": v}, local=True)
+            for k in keys:
+                h.complete_job(jobs[k]["key"], {})
+
+        assert_equivalent(scenario)

@@ -1,0 +1,144 @@
+"""Rolling-upgrade fixture generator (reference: qa/update-tests/…/
+RollingUpdateTest.java:51 — verify log/state compatibility across versions).
+
+``build_fixture(out_dir)`` runs a breadth scenario with the CURRENT code and
+freezes the produced artifacts: the journal segments, a state snapshot, and
+an ``expected.json`` describing the in-flight work. The artifacts are
+committed under ``tests/fixtures/upgrade/<tag>/``; every FUTURE round's CI
+replays them with its own code (tests/test_update.py) and must (a) rebuild
+the same state, (b) restore the old snapshot through its migrations, and
+(c) drive the in-flight instances to completion — the update-tests contract.
+
+Regenerate with  ``python -m tests.upgrade_fixture <tag>``  (run from the
+repo root) whenever a new round wants to freeze its own artifacts. Never
+regenerate an EXISTING tag: the committed bytes are the compatibility
+surface.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+from pathlib import Path
+
+EPOCH = 1_750_000_000_000
+
+FIXTURES_DIR = Path(__file__).parent / "fixtures" / "upgrade"
+
+
+def _models():
+    from zeebe_tpu.models.bpmn import Bpmn
+
+    one_task = (
+        Bpmn.create_executable_process("one_task")
+        .start_event("start").service_task("task", job_type="up_work")
+        .end_event("end").done()
+    )
+    timer_wait = (
+        Bpmn.create_executable_process("timer_wait")
+        .start_event("s")
+        .intermediate_catch_timer("wait", duration="PT30S")
+        .service_task("after", job_type="up_after_timer")
+        .end_event("e").done()
+    )
+    msg_wait = (
+        Bpmn.create_executable_process("msg_wait")
+        .start_event("s")
+        .intermediate_catch_message("catch", "up_go", correlation_key="key")
+        .end_event("e").done()
+    )
+    sub_bnd = (
+        Bpmn.create_executable_process("sub_bnd")
+        .start_event("s")
+        .sub_process("sub")
+        .start_event("is_")
+        .service_task("inner", job_type="up_inner")
+        .boundary_timer("tb", attached_to="inner", duration="PT1H")
+        .end_event("bnd_e")
+        .move_to_element("inner")
+        .end_event("ie")
+        .sub_process_done()
+        .end_event("e").done()
+    )
+    io_chain = (
+        Bpmn.create_executable_process("io_chain")
+        .start_event("s")
+        .service_task("t0", job_type="up_io")
+        .zeebe_input("= base", "local0")
+        .zeebe_output("= local0", "result0")
+        .service_task("t1", job_type="up_io2")
+        .end_event("e").done()
+    )
+    nomatch = (
+        Bpmn.create_executable_process("nomatch")
+        .start_event("s")
+        .exclusive_gateway("gw")
+        .condition_expression("x > 100")
+        .end_event("e").done()
+    )
+    return [one_task, timer_wait, msg_wait, sub_bnd, io_chain, nomatch]
+
+
+def run_scenario(h) -> dict:
+    """Drive the breadth scenario; returns the expected.json payload."""
+    h.deploy(*_models())
+    done_keys = []
+    for i in range(2):  # completed end to end
+        k = h.create_instance("one_task", variables={"i": i})
+        done_keys.append(k)
+    for job in h.activate_jobs("up_work", max_jobs=10):
+        h.complete_job(job["key"], {"done": True})
+    running = {}
+    for i in range(2):  # mid-flight: job pending
+        running[h.create_instance("one_task", variables={"i": 10 + i})] = "one_task"
+    running[h.create_instance("timer_wait")] = "timer_wait"
+    running[h.create_instance("msg_wait", variables={"key": "k-up"})] = "msg_wait"
+    running[h.create_instance("sub_bnd")] = "sub_bnd"
+    running[h.create_instance("io_chain", variables={"base": 9})] = "io_chain"
+    incident_key = h.create_instance("nomatch", variables={"x": 1})
+    return {
+        "tag_clock_millis": h.clock(),
+        "completed_keys": done_keys,
+        "running": {str(k): v for k, v in running.items()},
+        "incident_instance": incident_key,
+        "pending_jobs": {"up_work": 2, "up_inner": 1, "up_io": 1},
+        "message": {"name": "up_go", "correlation_key": "k-up"},
+        "timer_advance_ms": 31_000,
+        "last_position": h.stream.last_position,
+    }
+
+
+def build_fixture(tag: str) -> Path:
+    import tempfile
+
+    from zeebe_tpu.testing import ControlledClock, EngineHarness
+
+    out = FIXTURES_DIR / tag
+    if out.exists():
+        raise SystemExit(f"fixture {tag} already exists — never regenerate "
+                         "a committed tag")
+    with tempfile.TemporaryDirectory() as tmp:
+        h = EngineHarness(directory=tmp, clock=ControlledClock(EPOCH))
+        try:
+            expected = run_scenario(h)
+            snapshot = h.db.to_snapshot_bytes()
+            h.journal.close()
+            out.mkdir(parents=True)
+            shutil.copytree(Path(tmp) / "log", out / "log")
+            (out / "state.snapshot").write_bytes(snapshot)
+            (out / "expected.json").write_text(json.dumps(expected, indent=2))
+        finally:
+            h._tmp = None  # the caller's tempdir context cleans up
+            try:
+                h.close()
+            except Exception:  # noqa: BLE001 — journal already closed above
+                pass
+    return out
+
+
+if __name__ == "__main__":
+    import sys
+
+    tag = sys.argv[1] if len(sys.argv) > 1 else "r3"
+    path = build_fixture(tag)
+    print(f"fixture written to {path}")

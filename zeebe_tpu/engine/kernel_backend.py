@@ -843,14 +843,13 @@ class KernelBackend:
         # roles by the fingerprint walk (so instances with different due
         # dates share a template), and freshly computed due dates in the
         # burst itself resolve as ("clock", delta) roles
-        # locals of input-mapped parked tasks: the slow path's output
-        # mappings read them, so the template fingerprint must pin them
-        # (root-scope variables are pinned via ``merged`` already)
-        exe_elements = info.exe.elements
+        # locals of EVERY parked token: input mappings create them, but so
+        # can SetVariables(local=true) on any element instance — and output
+        # mappings / variable propagation read them, so the template
+        # fingerprint must pin them all (root-scope variables are pinned
+        # via ``merged`` already)
         mapped_locals = [
-            sorted(state.variables.locals_of(t.key).items())
-            if exe_elements[t.elem_idx].inputs else None
-            for t in tokens
+            sorted(state.variables.locals_of(t.key).items()) for t in tokens
         ]
         # sub-process scope locals (written e.g. by inner output mappings):
         # mapping/condition evaluation reads them through collect(), so two
