@@ -33,6 +33,13 @@ class CheckpointState:
         return self._cf.get(("latest",))
 
     def put(self, checkpoint_id: int, position: int) -> None:
+        from zeebe_tpu.utils.metrics import REGISTRY
+
+        REGISTRY.gauge("checkpoint_id", "latest checkpoint id").set(checkpoint_id)
+        REGISTRY.gauge("checkpoint_position",
+                       "latest checkpoint position").set(position)
+        REGISTRY.counter("checkpoint_records_total",
+                         "checkpoint records applied").inc()
         self._cf.put(("latest",), {"checkpointId": checkpoint_id,
                                    "position": position})
 

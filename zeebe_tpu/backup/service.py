@@ -14,6 +14,18 @@ from pathlib import Path
 from zeebe_tpu.backup.store import Backup, BackupStatus, FileSystemBackupStore
 
 
+from zeebe_tpu.utils.metrics import REGISTRY as _REG
+
+_M_BACKUP_TOTAL = _REG.counter(
+    "backup_operations_total", "backup operations by outcome",
+    ("operation", "outcome"))
+_M_BACKUP_LATENCY = _REG.histogram(
+    "backup_operations_latency", "seconds per backup operation",
+    ("operation",))
+_M_BACKUP_IN_PROGRESS = _REG.gauge(
+    "backup_operations_in_progress", "backup operations running").labels()
+
+
 class BackupService:
     """Takes one partition's backup at a checkpoint."""
 
@@ -23,6 +35,24 @@ class BackupService:
 
     def take_backup(self, partition, checkpoint_id: int,
                     checkpoint_position: int) -> BackupStatus:
+        import time as _time
+
+        start = _time.perf_counter()
+        _M_BACKUP_IN_PROGRESS.inc()
+        try:
+            status = self._take_backup(partition, checkpoint_id,
+                                       checkpoint_position)
+            _M_BACKUP_TOTAL.labels("take", "completed").inc()
+            return status
+        except Exception:
+            _M_BACKUP_TOTAL.labels("take", "failed").inc()
+            raise
+        finally:
+            _M_BACKUP_IN_PROGRESS.dec()
+            _M_BACKUP_LATENCY.labels("take").observe(_time.perf_counter() - start)
+
+    def _take_backup(self, partition, checkpoint_id: int,
+                     checkpoint_position: int) -> BackupStatus:
         """Backup = current persisted snapshot + the stream journal suffix
         (events after the snapshot up to the checkpoint). The partition keeps
         processing — the checkpoint record already fixed the logical cut."""

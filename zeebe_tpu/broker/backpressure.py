@@ -106,6 +106,31 @@ class CommandRateLimiter:
                            else getattr(self.algorithm, "timeout_ms", 10_000))
         self.in_flight: dict[int, int] = {}  # position → acquire time ms
         self.dropped_total = 0
+        from zeebe_tpu.utils.metrics import REGISTRY
+
+        self._m_limit = REGISTRY.gauge(
+            "backpressure_requests_limit",
+            "current adaptive in-flight request limit").labels()
+        self._m_received = REGISTRY.counter(
+            "received_request_count_total",
+            "commands received at the ingress limiter").labels()
+        self._m_dropped = REGISTRY.counter(
+            "dropped_request_count_total",
+            "commands rejected by backpressure").labels()
+        # the appender-side limits exist in the reference as a separate flow
+        # control; here the sequencer/appender path is synchronous, so the
+        # append limit equals the request limit and in-flight appends equal
+        # in-flight requests
+        self._m_append_limit = REGISTRY.gauge(
+            "backpressure_append_limit",
+            "current in-flight append limit (synchronous appender: equals "
+            "the request limit)").labels()
+        self._m_inflight_appends = REGISTRY.gauge(
+            "backpressure_inflight_append_count",
+            "appends in flight (synchronous appender: equals in-flight "
+            "requests)").labels()
+        self._m_limit.set(self.algorithm.limit)
+        self._m_append_limit.set(self.algorithm.limit)
 
     @property
     def limit(self) -> int:
@@ -114,6 +139,7 @@ class CommandRateLimiter:
     def try_acquire(self, record: Record) -> bool:
         if not self.enabled:
             return True
+        self._m_received.inc()
         if (record.value_type, int(record.intent)) in WHITELIST:
             return True
         if len(self.in_flight) >= self.algorithm.limit:
@@ -122,11 +148,13 @@ class CommandRateLimiter:
             # out in-flight requests, and multiplicative-decrease per rejected
             # request collapses the limit to min under a burst (death spiral)
             self.dropped_total += 1
+            self._m_dropped.inc()
             return False
         return True
 
     def on_appended(self, position: int) -> None:
         self.in_flight[position] = self.clock_millis()
+        self._m_inflight_appends.set(len(self.in_flight))
 
     def on_processed(self, position: int) -> None:
         started = self.in_flight.pop(position, None)
@@ -135,3 +163,7 @@ class CommandRateLimiter:
             # drop samples come only from in-flight RTTs exceeding the timeout
             self.algorithm.on_sample(rtt, len(self.in_flight),
                                      dropped=rtt > self.timeout_ms)
+            # the adaptive limit only moves on samples — update gauges here,
+            # off the per-command ingress path
+            self._m_limit.set(self.algorithm.limit)
+            self._m_append_limit.set(self.algorithm.limit)

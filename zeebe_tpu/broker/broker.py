@@ -215,9 +215,16 @@ class Broker:
             request_reconfigure=self._request_reconfigure,
             persist=self._persist_topology,
         )
+        start_steps = REGISTRY.histogram(
+            "broker_start_step_latency",
+            "seconds per broker startup step", ("step",))
+        step_start = time.perf_counter()
         saved = self._load_topology()
         if saved is not None:
             self.topology.restore(saved)
+            start_steps.labels("topology-restore").observe(
+                time.perf_counter() - step_start)
+            step_start = time.perf_counter()
             for pid, (members, priority) in self.topology.own_partitions().items():
                 self._create_partition(pid, members, priority)
         else:
@@ -226,6 +233,8 @@ class Broker:
                 if cfg.node_id in members:
                     self._create_partition(partition_id, members)
             self.topology.bootstrap(distribution, sorted(cfg.cluster_members))
+        start_steps.labels("partition-manager").observe(
+            time.perf_counter() - step_start)
 
     def _persist_topology(self, doc: dict) -> None:
         import json
@@ -280,7 +289,11 @@ class Broker:
 
     def _create_partition(self, partition_id: int, members: list[str],
                           priority: int = 1) -> None:
+        import time as _time
+
         from zeebe_tpu.broker.backpressure import CommandRateLimiter
+
+        bootstrap_start = _time.perf_counter()
 
         limiter = CommandRateLimiter(
             self._backpressure_algorithm, clock_millis=self.clock_millis,
@@ -304,6 +317,13 @@ class Broker:
             mesh_runner=self._mesh_runner(),
         )
         self.health_monitor.register(f"partition-{partition_id}")
+        from zeebe_tpu.utils.metrics import REGISTRY as _REG
+
+        _REG.histogram(
+            "partition_server_bootstrap_time",
+            "seconds to bootstrap a partition server", ("partition",)
+        ).labels(str(partition_id)).observe(
+            _time.perf_counter() - bootstrap_start)
         self.messaging.subscribe(
             f"{INTER_PARTITION_TOPIC}-{partition_id}",
             lambda s, p, pid=partition_id: self._on_inter_partition_command(pid, s, p),
@@ -327,7 +347,17 @@ class Broker:
         of the raft group (it syncs via append/snapshot once the leader adds
         it through reconfiguration)."""
         if partition_id not in self.partitions:
+            import time as _time
+
+            join_start = _time.perf_counter()
             self._create_partition(partition_id, members, priority)
+            from zeebe_tpu.utils.metrics import REGISTRY as _REG
+
+            _REG.histogram(
+                "partition_server_join_time",
+                "seconds to join a partition at runtime", ("partition",)
+            ).labels(str(partition_id)).observe(
+                _time.perf_counter() - join_start)
 
     _PARTITION_TOPICS = (
         "{t}-vote", "{t}-vote-resp", "{t}-append", "{t}-append-resp",
@@ -534,8 +564,18 @@ class Broker:
             float(self.health_monitor.status()))
 
     def close(self) -> None:
-        for partition in self.partitions.values():
+        import time as _time
+
+        from zeebe_tpu.utils.metrics import REGISTRY as _REG
+
+        close_latency = _REG.histogram(
+            "broker_close_step_latency",
+            "seconds per broker shutdown step", ("step",))
+        for pid, partition in self.partitions.items():
+            step_start = _time.perf_counter()
             partition.close()
+            close_latency.labels(f"partition-{pid}").observe(
+                _time.perf_counter() - step_start)
         if self._tmp is not None:
             self._tmp.cleanup()
 

@@ -394,16 +394,45 @@ class ZeebePartition:
             "lastProcessed": processed,
             "lastPosition": self.stream.last_position,
         }))
+        persist_started = _time.perf_counter()
         snapshot = transient.persist()
+        pid = str(self.partition_id)
         REGISTRY.counter(
             "snapshot_count", "snapshots persisted", ("partition",)
-        ).labels(str(self.partition_id)).inc()
+        ).labels(pid).inc()
+        elapsed = _time.perf_counter() - snapshot_started
         REGISTRY.histogram(
             "snapshot_duration_seconds", "time to persist a snapshot",
             ("partition",)
-        ).labels(str(self.partition_id)).observe(
-            _time.perf_counter() - snapshot_started
-        )
+        ).labels(pid).observe(elapsed)
+        REGISTRY.histogram(
+            "snapshot_duration", "time to take+persist a snapshot, seconds",
+            ("partition",)).labels(pid).observe(elapsed)
+        REGISTRY.histogram(
+            "snapshot_persist_duration",
+            "time to persist the transient snapshot, seconds",
+            ("partition",)).labels(pid).observe(
+            _time.perf_counter() - persist_started)
+        try:
+            size = 0
+            chunks = 0
+            for f in snapshot.path.rglob("*"):
+                if f.is_file():
+                    size += f.stat().st_size
+                    chunks += 1
+            REGISTRY.gauge(
+                "snapshot_size_bytes", "bytes of the latest snapshot",
+                ("partition",)).labels(pid).set(size)
+            REGISTRY.gauge(
+                "snapshot_file_size_megabytes",
+                "megabytes of the latest snapshot", ("partition",)
+            ).labels(pid).set(size / 1e6)
+            REGISTRY.gauge(
+                "snapshot_chunks_count",
+                "files in the latest snapshot", ("partition",)
+            ).labels(pid).set(chunks)
+        except OSError:
+            pass
         # raft log compaction bound: nothing above the snapshot index, nothing
         # unexported, nothing unmaterialized
         compact_position = min(processed, exported)

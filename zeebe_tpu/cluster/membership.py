@@ -53,6 +53,12 @@ class MembershipService:
         self.member_id = messaging.member_id
         self.clock_millis = clock_millis
         self.incarnation = 0
+        from zeebe_tpu.utils.metrics import REGISTRY
+
+        self._m_incarnation = REGISTRY.gauge(
+            "smp_members_incarnation_number",
+            "this member's SWIM incarnation number", ("member",)
+        ).labels(self.member_id)
         self.properties: dict[str, Any] = {}
         self.members: dict[str, Member] = {
             m: Member(m, last_heard_ms=clock_millis()) for m in seed_members
@@ -75,6 +81,7 @@ class MembershipService:
         (BrokerInfo updates propagate this way)."""
         self.properties[key] = value
         self.incarnation += 1
+        self._m_incarnation.set(self.incarnation)
         self._broadcast_gossip()
 
     def alive_members(self) -> list[Member]:
@@ -141,6 +148,7 @@ class MembershipService:
         rumored = digest.get("members", {}).get(self.member_id)
         if rumored and rumored.get("state") != MemberState.ALIVE.value:
             self.incarnation = max(self.incarnation, rumored.get("incarnation", 0)) + 1
+            self._m_incarnation.set(self.incarnation)
             self._broadcast_gossip()
 
     def _broadcast_gossip(self) -> None:

@@ -63,9 +63,74 @@ class RaftNode:
         self.partition_id = partition_id
         from zeebe_tpu.utils.metrics import REGISTRY
 
+        pid = str(partition_id)
         self._m_elections = REGISTRY.counter(
             "raft_elections_total", "elections started", ("partition",)
-        ).labels(str(partition_id))
+        ).labels(pid)
+        self._m_election_latency = REGISTRY.histogram(
+            "election_latency_in_ms", "candidate -> leader in ms",
+            ("partition",), buckets=(1, 5, 10, 50, 100, 500, 1000, 5000),
+        ).labels(pid)
+        self._m_leader_transition = REGISTRY.histogram(
+            "leader_transition_latency",
+            "leader election to first commit, seconds", ("partition",)
+        ).labels(pid)
+        self._m_role = REGISTRY.gauge(
+            "role", "raft role (3=leader 2=candidate 1=follower)", ("partition",)
+        ).labels(pid)
+        self._m_heartbeat_miss = REGISTRY.counter(
+            "heartbeat_miss_count", "election timeouts from missed heartbeats",
+            ("partition",)).labels(pid)
+        self._m_heartbeat_time = REGISTRY.gauge(
+            "heartbeat_time_in_s", "last heartbeat seen, epoch seconds",
+            ("partition",)).labels(pid)
+        self._m_msg_send = REGISTRY.counter(
+            "raft_messages_send", "raft rpcs sent", ("partition", "type"))
+        self._m_msg_recv = REGISTRY.counter(
+            "raft_messages_received", "raft rpcs received", ("partition", "type"))
+        self._m_append_index = REGISTRY.gauge(
+            "partition_raft_append_index", "last raft log index", ("partition",)
+        ).labels(pid)
+        self._m_commit_index = REGISTRY.gauge(
+            "partition_raft_commit_index", "raft commit index", ("partition",)
+        ).labels(pid)
+        self._m_non_committed = REGISTRY.gauge(
+            "non_committed_entries", "entries appended but not committed",
+            ("partition",)).labels(pid)
+        self._m_non_replicated = REGISTRY.gauge(
+            "non_replicated_entries",
+            "entries not yet replicated to the slowest follower",
+            ("partition",)).labels(pid)
+        self._m_append_rate = REGISTRY.counter(
+            "append_entries_rate", "AppendEntries rpcs sent", ("partition",)
+        ).labels(pid)
+        self._m_append_data = REGISTRY.counter(
+            "append_entries_data_rate", "entry bytes shipped in AppendEntries",
+            ("partition",)).labels(pid)
+        self._m_append_latency = REGISTRY.histogram(
+            "append_entries_latency", "local leader append seconds",
+            ("partition",)).labels(pid)
+        self._m_commit_rate = REGISTRY.counter(
+            "commit_entries_rate", "entries committed", ("partition",)
+        ).labels(pid)
+        self._m_snapshot_repl = REGISTRY.counter(
+            "snapshot_replication_count",
+            "snapshot installs sent to lagging followers", ("partition",)
+        ).labels(pid)
+        self._m_snapshot_repl_ms = REGISTRY.histogram(
+            "snapshot_replication_duration_milliseconds",
+            "ms to build+send one snapshot install", ("partition",),
+            buckets=(1, 5, 10, 50, 100, 500, 1000, 5000),
+        ).labels(pid)
+        self._m_flush_duration = REGISTRY.histogram(
+            "flush_duration_seconds",
+            "seconds per raft journal fsync", ("partition",)).labels(pid)
+        self._m_deferred_appends = REGISTRY.counter(
+            "deferred_append_count_total",
+            "appends acked before fsync (delayed flush policy)",
+            ("partition",)).labels(pid)
+        self._election_started_ms: int | None = None
+        self._leader_since_ms: int | None = None
         self.members = sorted(members)
         self._bootstrap_members = sorted(members)
         # configuration in effect at the journal's base (snapshot boundary):
@@ -133,11 +198,21 @@ class RaftNode:
         self.snapshot_receiver: Callable[[bytes], None] | None = None
 
         t = f"raft-{partition_id}"
-        messaging.subscribe(f"{t}-vote", self._on_vote_request)
-        messaging.subscribe(f"{t}-vote-resp", self._on_vote_response)
-        messaging.subscribe(f"{t}-append", self._on_append_request)
-        messaging.subscribe(f"{t}-append-resp", self._on_append_response)
-        messaging.subscribe(f"{t}-snapshot", self._on_install_snapshot)
+
+        def _counted(suffix, handler):
+            child = self._m_msg_recv.labels(str(partition_id), suffix)
+
+            def wrapped(sender, payload):
+                child.inc()
+                handler(sender, payload)
+
+            return wrapped
+
+        messaging.subscribe(f"{t}-vote", _counted("vote", self._on_vote_request))
+        messaging.subscribe(f"{t}-vote-resp", _counted("vote-resp", self._on_vote_response))
+        messaging.subscribe(f"{t}-append", _counted("append", self._on_append_request))
+        messaging.subscribe(f"{t}-append-resp", _counted("append-resp", self._on_append_response))
+        messaging.subscribe(f"{t}-snapshot", _counted("snapshot", self._on_install_snapshot))
 
     # -- persistence ----------------------------------------------------------
 
@@ -178,10 +253,15 @@ class RaftNode:
             self._flush_journal()
         elif self.flush_policy == "delayed":
             self._flush_dirty = True
+            self._m_deferred_appends.inc()
 
     def _flush_journal(self) -> None:
         if self.journal.last_index != self._flushed_index:
+            import time as _time
+
+            start = _time.perf_counter()
             self.journal.flush()
+            self._m_flush_duration.observe(_time.perf_counter() - start)
             self._flushed_index = self.journal.last_index
         self._flush_dirty = False
 
@@ -301,6 +381,8 @@ class RaftNode:
     def _start_election(self) -> None:
         self._prevotes = set()  # stale grants must not re-trigger elections
         self._m_elections.inc()
+        self._m_heartbeat_miss.inc()
+        self._election_started_ms = self.clock_millis()
         self._set_term(self.current_term + 1, vote_for=self.member_id)
         self._become(RaftRole.CANDIDATE)
         self._votes = {self.member_id}
@@ -380,6 +462,11 @@ class RaftNode:
                 self._become_leader()
 
     def _become_leader(self) -> None:
+        now = self.clock_millis()
+        if self._election_started_ms is not None:
+            self._m_election_latency.observe(now - self._election_started_ms)
+            self._election_started_ms = None
+        self._leader_since_ms = now
         self._become(RaftRole.LEADER)
         self.leader_id = self.member_id
         last = self._last_log_index()
@@ -461,11 +548,16 @@ class RaftNode:
         return index
 
     def _append_local(self, entry: dict) -> int:
+        import time as _time
+
+        start = _time.perf_counter()
         asqn = entry.get("asqn", -1)
         rec = self.journal.append(
             packb({k: v for k, v in entry.items() if k != "index"}),
             asqn=asqn if asqn is not None and asqn >= 0 else -1,  # ASQN_IGNORE
         )
+        self._m_append_latency.observe(_time.perf_counter() - start)
+        self._m_append_index.set(rec.index)
         return rec.index
 
     # -- replication ----------------------------------------------------------
@@ -484,6 +576,13 @@ class RaftNode:
         prev_index = next_idx - 1
         prev_term = self._entry_term(prev_index)
         entries = self._read_entries(next_idx, MAX_ENTRIES_PER_APPEND)
+        self._m_append_rate.inc()
+        self._m_append_data.inc(sum(len(e.get("data", b"") or b"") for e in entries))
+        others = self._other_members()
+        if others:
+            slowest = min(self.match_index.get(m, 0) for m in others)
+            self._m_non_replicated.set(
+                max(0, self._last_log_index() - slowest))
         self._send(member, "append", {
             "term": self.current_term,
             "leader": self.member_id,
@@ -506,6 +605,7 @@ class RaftNode:
             self._become(RaftRole.FOLLOWER)
         self.leader_id = req["leader"]
         self._last_heartbeat_ms = self.clock_millis()
+        self._m_heartbeat_time.set(self._last_heartbeat_ms / 1000.0)
         self._election_deadline_ms = self._next_election_deadline()
 
         prev_index, prev_term = req["prevIndex"], req["prevTerm"]
@@ -583,7 +683,14 @@ class RaftNode:
     def _set_commit(self, index: int) -> None:
         if index <= self.commit_index:
             return
+        self._m_commit_rate.inc(index - self.commit_index)
         self.commit_index = index
+        self._m_commit_index.set(index)
+        self._m_non_committed.set(max(0, self._last_log_index() - index))
+        if self._leader_since_ms is not None:
+            self._m_leader_transition.observe(
+                (self.clock_millis() - self._leader_since_ms) / 1000.0)
+            self._leader_since_ms = None
         for pending_index in sorted(self._pending_appends):
             if pending_index <= index:
                 self._pending_appends.pop(pending_index)(pending_index)
@@ -612,6 +719,10 @@ class RaftNode:
         if now - last_sent < ELECTION_TIMEOUT_MS:
             return
         self._snapshot_sent_ms[member] = now
+        import time as _time
+
+        _repl_start = _time.perf_counter()
+        self._m_snapshot_repl.inc()
         snap = None
         if self.snapshot_provider is not None:
             snap = self.snapshot_provider()
@@ -628,6 +739,7 @@ class RaftNode:
                 "offset": offset, "chunk": chunk,
                 "done": offset + SNAPSHOT_CHUNK_BYTES >= len(data),
             })
+        self._m_snapshot_repl_ms.observe((_time.perf_counter() - _repl_start) * 1000.0)
 
     def _on_install_snapshot(self, sender: str, req: dict) -> None:
         if req["term"] < self.current_term:
@@ -683,12 +795,18 @@ class RaftNode:
         if self.role is role:
             return
         self.role = role
+        self._m_role.set({RaftRole.LEADER: 3, RaftRole.CANDIDATE: 2}.get(role, 1))
+        if role != RaftRole.LEADER:
+            # a stepped-down leader must not emit leader_transition_latency
+            # samples from follower-side commit advances
+            self._leader_since_ms = None
         if role != RaftRole.LEADER:
             self._pending_appends.clear()
         for listener in self.role_listeners:
             listener(role, self.current_term)
 
     def _send(self, member: str, suffix: str, payload: dict) -> None:
+        self._m_msg_send.labels(str(self.partition_id), suffix).inc()
         self.messaging.send(member, f"raft-{self.partition_id}-{suffix}", payload)
 
     # -- committed-entry reader (log storage integration) ----------------------

@@ -62,8 +62,14 @@ def evaluation_record_value(decision_meta: dict,
 
 def evaluate_decision(state: EngineState, decision_meta: dict,
                       context: dict) -> DecisionEvaluationResult:
+    from zeebe_tpu.utils.metrics import REGISTRY
+
+    counter = REGISTRY.counter(
+        "evaluated_dmn_elements_total", "DMN decisions evaluated by outcome",
+        ("action",))
     drg = state.decisions.parsed_drg(decision_meta["decisionRequirementsKey"])
     if drg is None:
+        counter.labels("failed").inc()
         result = DecisionEvaluationResult()
         result.failed = True
         result.failed_decision_id = decision_meta["decisionId"]
@@ -72,7 +78,9 @@ def evaluate_decision(state: EngineState, decision_meta: dict,
             "not found in state"
         )
         return result
-    return _ENGINE.evaluate(drg, decision_meta["decisionId"], context)
+    result = _ENGINE.evaluate(drg, decision_meta["decisionId"], context)
+    counter.labels("failed" if result.failed else "evaluated").inc()
+    return result
 
 
 class BpmnDecisionBehavior:

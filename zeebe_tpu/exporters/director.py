@@ -22,6 +22,104 @@ from zeebe_tpu.state import ZbDb
 from zeebe_tpu.state.db import ColumnFamilyCode as CF
 
 
+class ExecutionLatencyObserver:
+    """Creation→completion latency metrics, computed on the committed
+    record stream like the reference's broker exporter metrics (reference:
+    broker/…/exporter/metrics/ExecutionLatencyMetrics.java) — so the kernel
+    burst-template path is counted exactly like the sequential path."""
+
+    _MAX_TRACKED = 32_768
+
+    def __init__(self, partition_id: int) -> None:
+        from zeebe_tpu.utils.metrics import REGISTRY
+
+        pid = str(partition_id)
+        self._partition = pid
+        buckets = (0.005, 0.025, 0.1, 0.5, 1, 5, 30, 120, 600)
+        self._m_pi_time = REGISTRY.histogram(
+            "process_instance_execution_time",
+            "seconds from instance activation to completion",
+            ("partition",), buckets=buckets).labels(pid)
+        self._m_creations = REGISTRY.counter(
+            "process_instance_creations_total",
+            "process instances created", ("partition",)).labels(pid)
+        self._m_job_life = REGISTRY.histogram(
+            "job_life_time", "seconds from job creation to completion",
+            ("partition",), buckets=buckets).labels(pid)
+        self._m_job_activation = REGISTRY.histogram(
+            "job_activation_time", "seconds from job creation to activation",
+            ("partition",), buckets=buckets).labels(pid)
+        self._pi_started: dict[int, int] = {}
+        self._job_created: dict[int, int] = {}
+        self._m_pending_incidents = REGISTRY.gauge(
+            "pending_incidents_total", "incidents created minus resolved",
+            ("partition",)).labels(pid)
+        self._m_buffered_messages = REGISTRY.gauge(
+            "buffered_messages_count", "published messages minus expired",
+            ("partition",)).labels(pid)
+
+    def _remember(self, store: dict, key: int, ts: int) -> None:
+        if len(store) >= self._MAX_TRACKED:
+            store.pop(next(iter(store)))
+        store[key] = ts
+
+    def observe(self, logged) -> None:
+        from zeebe_tpu.protocol import ValueType
+        from zeebe_tpu.protocol.intent import (
+            JobBatchIntent,
+            JobIntent,
+            ProcessInstanceCreationIntent,
+            ProcessInstanceIntent,
+        )
+
+        rec = logged.record
+        if not rec.is_event:
+            return
+        vt = rec.value_type
+        intent = int(rec.intent)
+        if vt == ValueType.PROCESS_INSTANCE:
+            if rec.value.get("bpmnElementType") != "PROCESS":
+                return
+            if intent == int(ProcessInstanceIntent.ELEMENT_ACTIVATING):
+                self._remember(self._pi_started, rec.key, rec.timestamp)
+            elif intent in (int(ProcessInstanceIntent.ELEMENT_COMPLETED),
+                            int(ProcessInstanceIntent.ELEMENT_TERMINATED)):
+                started = self._pi_started.pop(rec.key, None)
+                if started is not None:
+                    self._m_pi_time.observe((rec.timestamp - started) / 1000.0)
+        elif vt == ValueType.PROCESS_INSTANCE_CREATION:
+            if intent == int(ProcessInstanceCreationIntent.CREATED):
+                self._m_creations.inc()
+        elif vt == ValueType.JOB:
+            if intent == int(JobIntent.CREATED):
+                self._remember(self._job_created, rec.key, rec.timestamp)
+            elif intent in (int(JobIntent.COMPLETED), int(JobIntent.CANCELED)):
+                created = self._job_created.pop(rec.key, None)
+                if created is not None:
+                    self._m_job_life.observe((rec.timestamp - created) / 1000.0)
+        elif vt == ValueType.JOB_BATCH:
+            if intent == int(JobBatchIntent.ACTIVATED):
+                for job_key in rec.value.get("jobKeys", ()) or ():
+                    created = self._job_created.get(job_key)
+                    if created is not None:
+                        self._m_job_activation.observe(
+                            (rec.timestamp - created) / 1000.0)
+        elif vt == ValueType.INCIDENT:
+            from zeebe_tpu.protocol.intent import IncidentIntent
+
+            if intent == int(IncidentIntent.CREATED):
+                self._m_pending_incidents.inc()
+            elif intent == int(IncidentIntent.RESOLVED):
+                self._m_pending_incidents.dec()
+        elif vt == ValueType.MESSAGE:
+            from zeebe_tpu.protocol.intent import MessageIntent
+
+            if intent == int(MessageIntent.PUBLISHED):
+                self._m_buffered_messages.inc()
+            elif intent == int(MessageIntent.EXPIRED):
+                self._m_buffered_messages.dec()
+
+
 class ExporterContainer:
     def __init__(self, exporter_id: str, exporter: Exporter,
                  state: "ExportersState",
@@ -112,6 +210,18 @@ class ExporterDirector:
         self._next_position = min(
             (c.position for c in self.containers), default=0
         ) + 1
+        from zeebe_tpu.utils.metrics import REGISTRY
+
+        pid = str(stream.partition_id)
+        self._latency = ExecutionLatencyObserver(stream.partition_id)
+        self._m_events = REGISTRY.counter(
+            "exporter_events_total", "records visited by the director",
+            ("partition",)).labels(pid)
+        # exporter_last_exported_position is owned by the broker metrics
+        # (node+partition labels) — not re-registered here
+        self._m_last_updated = REGISTRY.gauge(
+            "exporter_last_updated_exported_position",
+            "lowest acknowledged exporter position", ("partition",)).labels(pid)
 
     def export_available(self, max_records: int = 10_000) -> int:
         """Export committed records not yet seen; returns how many."""
@@ -128,10 +238,15 @@ class ExporterDirector:
                     container.skip(logged.position)
                     continue
                 container.deliver(logged)
+            self._latency.observe(logged)
+            self._m_events.inc()
             self._next_position = logged.position + 1
             count += 1
             if count >= max_records:
                 break
+        if count:
+            self._m_last_updated.set(
+                min((c.position for c in self.containers), default=-1))
         return count
 
     def lowest_exporter_position(self) -> int:

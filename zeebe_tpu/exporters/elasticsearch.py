@@ -65,6 +65,15 @@ class ElasticsearchExporter(Exporter):
         if not self._bulk:
             return
         payload = "\n".join(self._bulk) + "\n"
+        from zeebe_tpu.utils.metrics import REGISTRY
+
+        REGISTRY.histogram(
+            "bulk_size", "records per exporter bulk flush",
+            buckets=(1, 10, 100, 500, 1000, 5000)).observe(len(self._bulk) // 2)
+        REGISTRY.histogram(
+            "bulk_memory_size", "bytes per exporter bulk flush",
+            buckets=(1024, 16384, 262144, 1 << 20, 16 << 20)
+        ).observe(len(payload))
         if self._sink is not None:
             self._sink(payload)
         if self._directory is not None:

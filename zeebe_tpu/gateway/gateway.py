@@ -45,6 +45,16 @@ from zeebe_tpu.protocol.intent import (  # noqa: E402
 VERSION = "8.4.0-tpu"
 
 
+from zeebe_tpu.utils.metrics import REGISTRY as _REG  # noqa: E402
+
+_M_LONG_POLL_QUEUED = _REG.gauge(
+    "long_polling_queued_current",
+    "ActivateJobs requests parked waiting for jobs").labels()
+_M_TOPOLOGY_ROLES = _REG.gauge(
+    "gateway_topology_partition_roles",
+    "known partition roles (3=leader 1=follower)", ("node", "partition"))
+
+
 def _vars(json_str: str) -> dict:
     if not json_str:
         return {}
@@ -109,6 +119,9 @@ class GatewayService:
                 )
                 for p in b["partitions"]
             ]
+            for p in b["partitions"]:
+                _M_TOPOLOGY_ROLES.labels(str(i), str(p["partitionId"])).set(
+                    3 if p["role"] == "leader" else 1)
             brokers.append(pb.BrokerInfo(
                 nodeId=i, host="127.0.0.1", port=0, partitions=partitions,
                 version=VERSION,
@@ -308,11 +321,15 @@ class GatewayService:
             now = time.time()
             if now >= deadline:
                 return
-            if hub is not None:
-                # bounded wait so client cancellation is noticed promptly
-                hub.wait(request.type, seen_version, min(deadline - now, 1.0))
-            else:
-                time.sleep(0.02)
+            _M_LONG_POLL_QUEUED.inc()
+            try:
+                if hub is not None:
+                    # bounded wait so client cancellation is noticed promptly
+                    hub.wait(request.type, seen_version, min(deadline - now, 1.0))
+                else:
+                    time.sleep(0.02)
+            finally:
+                _M_LONG_POLL_QUEUED.dec()
 
     def StreamActivatedJobs(self, request, context):
         """Job push: register a client stream with the dispatcher; the broker
@@ -676,10 +693,10 @@ def _wrap(method: Callable) -> Callable:
 
     rpc = method.__name__
     total = REGISTRY.counter(
-        "gateway_requests_total", "gateway rpc invocations", ("rpc",)
+        "gateway_total_requests", "gateway rpc invocations", ("rpc",)
     ).labels(rpc)
     failed = REGISTRY.counter(
-        "gateway_requests_failed_total", "gateway rpc failures", ("rpc",)
+        "gateway_failed_requests", "gateway rpc failures", ("rpc",)
     ).labels(rpc)
     latency = REGISTRY.histogram(
         "gateway_request_latency", "seconds per gateway rpc", ("rpc",)

@@ -78,6 +78,34 @@ class ClientJobStream:
     tenant_ids: list | None = None  # authorized-tenant filter (None = default)
 
 
+from time import perf_counter as _perf_counter
+
+from zeebe_tpu.utils.metrics import REGISTRY as _REG
+
+# job-stream registry metrics (reference: transport/stream metrics — clients,
+# servers, streams, aggregated_stream_clients; broker jobstream metrics —
+# broker_open_job_stream_count, broker_jobs_pushed_count,
+# broker_jobs_push_fail_count, push)
+_M_STREAMS = _REG.gauge(
+    "streams", "open job streams in the registry").labels()
+_M_CLIENTS = _REG.gauge(
+    "clients", "connected stream clients").labels()
+_M_SERVERS = _REG.gauge(
+    "servers", "stream servers (one per dispatcher)").labels()
+_M_AGG_CLIENTS = _REG.gauge(
+    "aggregated_stream_clients",
+    "clients aggregated over logically equal streams").labels()
+_M_OPEN_STREAMS = _REG.gauge(
+    "broker_open_job_stream_count", "open job streams, broker view").labels()
+_M_PUSHED = _REG.counter(
+    "broker_jobs_pushed_count", "jobs pushed to client streams").labels()
+_M_PUSH_FAIL = _REG.counter(
+    "broker_jobs_push_fail_count",
+    "jobs that failed delivery and were re-routed/yielded").labels()
+_M_PUSH_LATENCY = _REG.histogram(
+    "push", "seconds per pushed job delivery").labels()
+
+
 class JobStreamDispatcher:
     """RemoteStreamRegistry + RemoteJobStreamer, runtime-side: registered
     client streams per job type and a dispatcher thread turning notifications
@@ -99,6 +127,7 @@ class JobStreamDispatcher:
 
     def start(self) -> None:
         self._running = True
+        _M_SERVERS.inc()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="job-stream-dispatcher"
         )
@@ -106,6 +135,7 @@ class JobStreamDispatcher:
 
     def stop(self) -> None:
         self._running = False
+        _M_SERVERS.dec()
         with self._lock:
             self._lock.notify_all()
         if self._thread is not None:
@@ -117,6 +147,8 @@ class JobStreamDispatcher:
                    tenant_ids: list | None = None) -> ClientJobStream:
         stream = ClientJobStream(next(self._ids), job_type, worker, timeout_ms,
                                  tenant_ids=tenant_ids)
+        for g in (_M_STREAMS, _M_CLIENTS, _M_AGG_CLIENTS, _M_OPEN_STREAMS):
+            g.inc()
         with self._lock:
             self._streams.setdefault(job_type, []).append(stream)
             # initial sweep: jobs that became activatable before the stream
@@ -140,6 +172,9 @@ class JobStreamDispatcher:
             streams = self._streams.get(stream.job_type, [])
             if stream in streams:
                 streams.remove(stream)
+                for g in (_M_STREAMS, _M_CLIENTS, _M_AGG_CLIENTS,
+                          _M_OPEN_STREAMS):
+                    g.dec()
             if not streams:
                 self._streams.pop(stream.job_type, None)
             while True:
@@ -244,7 +279,12 @@ class JobStreamDispatcher:
                 keys = record.value.get("jobKeys", [])
                 jobs = record.value.get("jobs", [])
                 for key, job in zip(keys, jobs):
-                    if not self._deliver(stream, key, job):
+                    _t0 = _perf_counter()
+                    if self._deliver(stream, key, job):
+                        _M_PUSHED.inc()
+                        _M_PUSH_LATENCY.observe(_perf_counter() - _t0)
+                    else:
+                        _M_PUSH_FAIL.inc()
                         if not self._redeliver(job_type, key, job):
                             self._yield_back(key)
                 if len(keys) >= PUSH_BATCH_SIZE:

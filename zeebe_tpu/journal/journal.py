@@ -40,10 +40,50 @@ from zeebe_tpu.utils.metrics import REGISTRY as _REGISTRY
 # journal only knows its directory, not its partition
 _M_APPENDS = _REGISTRY.counter(
     "journal_append_total", "records appended across all journals")
+_M_APPEND_RATE = _REGISTRY.counter(
+    "journal_append_rate", "records appended (rate source)")
+_M_APPEND_BYTES = _REGISTRY.counter(
+    "journal_append_data_rate", "bytes appended (rate source)")
+_M_APPEND_LATENCY = _REGISTRY.histogram(
+    "journal_append_latency", "seconds per journal append")
+_M_TRY_APPEND = _REGISTRY.counter(
+    "try_to_append_total", "append attempts incl. rejected asqn")
 _M_FLUSHES = _REGISTRY.counter(
     "journal_flush_total", "journal fsyncs across all journals")
 _M_FLUSH_SECONDS = _REGISTRY.histogram(
     "journal_flush_duration_seconds", "time per journal fsync")
+_M_FLUSH_TIME = _REGISTRY.histogram(
+    "journal_flush_time", "time per journal fsync (reference name)")
+_M_FAILED_FLUSH = _REGISTRY.counter(
+    "failed_flush", "journal fsyncs that raised")
+_M_OPEN_TIME = _REGISTRY.histogram(
+    "journal_open_time", "seconds to open+scan a journal")
+_M_SEEK_LATENCY = _REGISTRY.histogram(
+    "journal_seek_latency", "seconds per random-access journal read/seek")
+_M_SEGMENT_COUNT = _REGISTRY.gauge(
+    "segment_count", "live segment files across all journals")
+_M_SEGMENT_CREATION = _REGISTRY.histogram(
+    "segment_creation_time", "seconds to roll/create a segment")
+_M_SEGMENT_FLUSH = _REGISTRY.histogram(
+    "segment_flush_time", "seconds to fsync one segment")
+_M_SEGMENT_TRUNCATE = _REGISTRY.histogram(
+    "segment_truncate_time", "seconds to truncate a segment")
+_M_LAST_FLUSHED = _REGISTRY.gauge(
+    "last_flushed_index_update", "last index recorded as flushed")
+_M_COMPACTION_MS = _REGISTRY.histogram(
+    "compaction_time_ms", "ms per journal compaction pass",
+    buckets=(0.1, 0.5, 1, 5, 10, 50, 100, 1000))
+_M_SEGMENT_ALLOC = _REGISTRY.histogram(
+    "segment_allocation_time", "seconds to allocate a new segment file")
+# cached label-less children: the append path is hot, and Metric.inc() pays a
+# lock + dict lookup per call that the child skips
+_C_APPENDS = _M_APPENDS.labels()
+_C_APPEND_RATE = _M_APPEND_RATE.labels()
+_C_APPEND_BYTES = _M_APPEND_BYTES.labels()
+_C_APPEND_LATENCY = _M_APPEND_LATENCY.labels()
+_C_TRY_APPEND = _M_TRY_APPEND.labels()
+
+from time import perf_counter as _perf
 
 _MAGIC = 0x5A4A4E4C  # "ZJNL"
 _VERSION = 1
@@ -93,10 +133,12 @@ class _Segment:
         # seeks when the position is not already at the segment tail
         self._file_pos = -1
         if create:
+            start = _perf()
             self.file = open(path, "w+b")
             self.file.write(_SEG_HEADER.pack(_MAGIC, _VERSION, segment_id, first_index))
             self.file.flush()
             self.size = _SEG_HEADER.size
+            _M_SEGMENT_ALLOC.observe(_perf() - start)
         else:
             self.file = open(path, "r+b")
             self.size = _SEG_HEADER.size  # recomputed by scan()
@@ -250,18 +292,22 @@ class _Segment:
             new_last = rec.index
             if rec.asqn != ASQN_IGNORE:
                 new_asqn = rec.asqn
+        start = _perf()
         self.file.truncate(offset)
         self.file.flush()
         self._file_pos = -1
         self.size = offset
         self.last_index = new_last
+        _M_SEGMENT_TRUNCATE.observe(_perf() - start)
         self.last_asqn = new_asqn
         self.sparse = [(i, o) for i, o in self.sparse if i <= new_last]
         self._read_hint = None
 
     def flush(self) -> None:
+        start = _perf()
         self.file.flush()
         os.fsync(self.file.fileno())
+        _M_SEGMENT_FLUSH.observe(_perf() - start)
 
     def close(self) -> None:
         self.file.close()
@@ -291,7 +337,20 @@ class SegmentedJournal:
         self._meta_path = self.dir / f"{name}.meta"
         self._meta_fd: int | None = None
         self.segments: list[_Segment] = []
+        # this journal's contribution to the global segment_count gauge —
+        # updated by delta whenever the segment list changes, and returned
+        # on close, so reopen cycles and resets can never drift the gauge
+        self._counted_segments = 0
+        start = _perf()
         self._open_or_create()
+        _M_OPEN_TIME.observe(_perf() - start)
+        self._update_segment_gauge()
+
+    def _update_segment_gauge(self) -> None:
+        n = len(self.segments)
+        if n != self._counted_segments:
+            _M_SEGMENT_COUNT.inc(n - self._counted_segments)
+            self._counted_segments = n
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -322,6 +381,9 @@ class SegmentedJournal:
             self.segments.pop().delete()
 
     def close(self) -> None:
+        if self._counted_segments:
+            _M_SEGMENT_COUNT.inc(-self._counted_segments)
+            self._counted_segments = 0
         for seg in self.segments:
             seg.close()
         if self._meta_fd is not None:
@@ -352,7 +414,8 @@ class SegmentedJournal:
 
     def append(self, data: bytes, asqn: int = ASQN_IGNORE) -> JournalRecord:
         """Append one record; returns it with its assigned index."""
-        _M_APPENDS.inc()
+        start = _perf()
+        _C_TRY_APPEND.inc()
         if asqn != ASQN_IGNORE and asqn <= self.last_asqn:
             raise InvalidAsqnError(f"asqn {asqn} <= last asqn {self.last_asqn}")
         tail = self.segments[-1]
@@ -360,9 +423,14 @@ class SegmentedJournal:
             tail = self._roll_segment()
         index = tail.last_index + 1
         tail.append(index, asqn, data)
+        _C_APPENDS.inc()
+        _C_APPEND_RATE.inc()
+        _C_APPEND_BYTES.inc(_FRAME.size + len(data))
+        _C_APPEND_LATENCY.observe(_perf() - start)
         return JournalRecord(index, asqn, data)
 
     def _roll_segment(self) -> _Segment:
+        start = _perf()
         prev = self.segments[-1]
         prev.flush()
         seg = _Segment(
@@ -372,6 +440,8 @@ class SegmentedJournal:
             create=True,
         )
         self.segments.append(seg)
+        self._update_segment_gauge()
+        _M_SEGMENT_CREATION.observe(_perf() - start)
         return seg
 
     def flush(self) -> int:
@@ -382,14 +452,19 @@ class SegmentedJournal:
         recovery re-derives state from segment scans — so it is a plain
         8-byte overwrite, not an fsync'd rename, keeping the hot append path
         at one fsync per flush."""
-        import time as _time
-
-        start = _time.perf_counter()
-        self.segments[-1].flush()
+        start = _perf()
+        try:
+            self.segments[-1].flush()
+        except OSError:
+            _M_FAILED_FLUSH.inc()
+            raise
         idx = self.last_index
         self._write_flush_marker(max(idx, 0))
+        _M_LAST_FLUSHED.set(max(idx, 0))
         _M_FLUSHES.inc()
-        _M_FLUSH_SECONDS.observe(_time.perf_counter() - start)
+        elapsed = _perf() - start
+        _M_FLUSH_SECONDS.observe(elapsed)
+        _M_FLUSH_TIME.observe(elapsed)
         return idx
 
     def _write_flush_marker(self, idx: int) -> None:
@@ -416,10 +491,14 @@ class SegmentedJournal:
     def read_entry(self, index: int) -> JournalRecord | None:
         """Random-access read of one record by index (O(segment count) + one
         sparse-bounded walk; no whole-segment materialization)."""
-        for seg in self.segments:
-            if seg.first_index <= index <= seg.last_index:
-                return seg.read_entry(index)
-        return None
+        start = _perf()
+        try:
+            for seg in self.segments:
+                if seg.first_index <= index <= seg.last_index:
+                    return seg.read_entry(index)
+            return None
+        finally:
+            _M_SEEK_LATENCY.observe(_perf() - start)
 
     def entries_meta(self) -> Iterator[tuple[int, int]]:
         """Yield (index, asqn) for every record — header-only scan used to
@@ -461,13 +540,20 @@ class SegmentedJournal:
         """Delete whole segments whose records are all < ``index`` (snapshot
         compaction; reference: SegmentedJournal.deleteUntil). Never deletes the
         tail segment."""
+        start = _perf()
+        compacted = False
         while len(self.segments) > 1 and self.segments[0].last_index < index:
             self.segments.pop(0).delete()
+            compacted = True
+        if compacted:
+            self._update_segment_gauge()
+            _M_COMPACTION_MS.observe((_perf() - start) * 1000.0)
 
     def reset(self, next_index: int) -> None:
         """Discard everything and restart at ``next_index`` (snapshot install)."""
         for seg in self.segments:
             seg.delete()
         self.segments = [_Segment(self._segment_path(1), 1, next_index, create=True)]
+        self._update_segment_gauge()
         # invalidate the stale flushed-index marker from the pre-reset log
         self._write_flush_marker(max(next_index - 1, 0))

@@ -67,6 +67,34 @@ def _py_scan_batch_headers(payload: bytes):
     return source_position, timestamp, records
 
 
+from zeebe_tpu.utils.metrics import REGISTRY as _METRICS
+
+# sequencer/appender metrics (reference: logstreams impl/Sequencer +
+# LogStorageAppender metrics); label-less children cached — the writer is hot
+_M_SEQ_BATCH_SIZE = _METRICS.histogram(
+    "sequencer_batch_size", "records per sequenced batch",
+    (), buckets=(1, 2, 4, 8, 16, 32, 64, 128, 512)).labels()
+_M_SEQ_BATCH_BYTES = _METRICS.histogram(
+    "sequencer_batch_length_bytes", "bytes per sequenced batch",
+    (), buckets=(256, 1024, 4096, 16384, 65536, 262144)).labels()
+_M_APPEND_LATENCY = _METRICS.histogram(
+    "log_appender_append_latency", "seconds per log append").labels()
+_M_LAST_APPENDED = _METRICS.gauge(
+    "log_appender_last_appended_position",
+    "last record position appended").labels()
+_M_LAST_COMMITTED = _METRICS.gauge(
+    "log_appender_last_committed_position",
+    "last record position committed/visible").labels()
+_M_COMMIT_LATENCY = _METRICS.histogram(
+    "log_appender_commit_latency",
+    "seconds from sequencing to committed visibility").labels()
+# the writer is synchronous (no sequencer ring buffer between ingress and
+# the appender), so the queue depth is structurally 0 — registered for
+# dashboard parity with the reference's sequencer_queue_size
+_METRICS.gauge(
+    "sequencer_queue_size",
+    "sequenced batches queued for append (synchronous writer: 0)").set(0)
+
 _codec = _native.load_codec()
 _scan_batch_headers = (
     _codec.scan_batch_headers
@@ -170,14 +198,23 @@ class LogStreamWriter:
             return -1
         stream = self._stream
         with self._lock:
+            start = time.perf_counter()
             first_position = stream._next_position
             timestamp = stream.clock_millis()
             payload, stamped, bodies = _serialize_batch_with_bodies(
                 entries, first_position, source_position, timestamp
             )
+            _M_SEQ_BATCH_SIZE.observe(len(entries))
+            _M_SEQ_BATCH_BYTES.observe(len(payload))
             jrec = stream.journal.append(payload, asqn=first_position)
             stream._on_appended(first_position, jrec.index)
             stream._next_position = first_position + len(entries)
+            last = first_position + len(entries) - 1
+            _M_LAST_APPENDED.set(last)
+            _M_LAST_COMMITTED.set(last)  # local log: visible on append
+            elapsed = time.perf_counter() - start
+            _M_APPEND_LATENCY.observe(elapsed)
+            _M_COMMIT_LATENCY.observe(elapsed)
             stream._batch_has_commands[jrec.index] = any(
                 e.record.is_command and not e.processed for e in entries
             )
@@ -225,15 +262,24 @@ class LogStreamWriter:
         decode on demand — but the command-scan skip index is."""
         stream = self._stream
         with self._lock:
+            start = time.perf_counter()
             first_position = stream._next_position
             timestamp = stream.clock_millis()
             patch_prepatched_batch(buf, pos_offsets, ts_offsets,
                                    first_position, timestamp)
+            _M_SEQ_BATCH_SIZE.observe(count)
+            _M_SEQ_BATCH_BYTES.observe(len(buf))
             jrec = stream.journal.append(bytes(buf), asqn=first_position)
             stream._on_appended(first_position, jrec.index)
             stream._next_position = first_position + count
+            last = first_position + count - 1
+            _M_LAST_APPENDED.set(last)
+            _M_LAST_COMMITTED.set(last)
+            elapsed = time.perf_counter() - start
+            _M_APPEND_LATENCY.observe(elapsed)
+            _M_COMMIT_LATENCY.observe(elapsed)
             stream._batch_has_commands[jrec.index] = has_pending_commands
-        return first_position + count - 1
+        return last
 
 
 _native_stamp_batch = _native.codec_fn("stamp_batch")

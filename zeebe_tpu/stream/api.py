@@ -162,6 +162,23 @@ class ProcessingScheduleService:
         self._write = write_commands
         self._heap: list[tuple[int, int, ScheduledTaskHandle]] = []
         self._seq = 0
+        # actor-analogue metrics (reference: scheduler/ ActorMetrics —
+        # actor_job_scheduling_latency etc.): the schedule service is the
+        # runtime's deferred-task executor, the closest analogue of the
+        # reference's actor task queues
+        from zeebe_tpu.utils.metrics import REGISTRY
+
+        self._m_sched_latency = REGISTRY.histogram(
+            "actor_job_scheduling_latency",
+            "ms a due task waited past its due time",
+            buckets=(1, 5, 10, 50, 100, 500, 1000, 5000)).labels()
+        self._m_exec_count = REGISTRY.counter(
+            "actor_task_execution_count", "scheduled tasks executed").labels()
+        self._m_exec_latency = REGISTRY.histogram(
+            "actor_task_execution_latency",
+            "seconds per scheduled task execution").labels()
+        self._m_queue_len = REGISTRY.gauge(
+            "actor_task_queue_length", "scheduled tasks pending").labels()
 
     def run_delayed(self, delay_millis: int, task: Callable[[], list[Record]]) -> ScheduledTaskHandle:
         return self.run_at(self._clock() + delay_millis, task)
@@ -175,16 +192,23 @@ class ProcessingScheduleService:
     def run_due_tasks(self) -> int:
         """Run tasks whose due time has passed; their returned commands are
         written to the log. Returns number of tasks run."""
+        import time as _time
+
         now = self._clock()
         ran = 0
         while self._heap and self._heap[0][0] <= now:
-            _, _, handle = heapq.heappop(self._heap)
+            due, _, handle = heapq.heappop(self._heap)
             if handle.cancelled:
                 continue
+            self._m_sched_latency.observe(max(0, now - due))
+            start = _time.perf_counter()
             commands = handle.task() or []
             if commands:
                 self._write(commands)
+            self._m_exec_count.inc()
+            self._m_exec_latency.observe(_time.perf_counter() - start)
             ran += 1
+        self._m_queue_len.set(len(self._heap))
         return ran
 
     @property

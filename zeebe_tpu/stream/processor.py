@@ -177,6 +177,46 @@ class StreamProcessor:
             int(IncidentIntent.CREATED): incidents.labels(partition_label, "created"),
             int(IncidentIntent.RESOLVED): incidents.labels(partition_label, "resolved"),
         }
+        self._m_batch_commands = REGISTRY.histogram(
+            "stream_processor_batch_processing_commands",
+            "commands processed in one batch/group", ("partition",),
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 512, 2048),
+        ).labels(partition_label)
+        self._m_batch_duration = REGISTRY.histogram(
+            "stream_processor_batch_processing_duration",
+            "seconds per processed batch/group", ("partition",)
+        ).labels(partition_label)
+        self._m_processing_duration = REGISTRY.histogram(
+            "stream_processor_processing_duration",
+            "seconds per processed command incl. write+commit",
+            ("partition",)).labels(partition_label)
+        self._m_post_commit = REGISTRY.histogram(
+            "stream_processor_batch_processing_post_commit_tasks",
+            "post-commit side effects per step", ("partition",),
+            buckets=(0, 1, 2, 4, 8, 16, 64),
+        ).labels(partition_label)
+        self._m_batch_retry = REGISTRY.counter(
+            "stream_processor_batch_processing_retry",
+            "batches retried after an error rollback", ("partition",)
+        ).labels(partition_label)
+        # stream_processor_last_processed_position is owned by the broker
+        # metrics (node+partition labels); here we only keep a no-label twin
+        # out of the registry to avoid a label-shape collision
+        self._m_recovery_time = REGISTRY.gauge(
+            "stream_processor_startup_recovery_time",
+            "seconds spent in startup replay recovery", ("partition",)
+        ).labels(partition_label)
+        self._m_replay_duration = REGISTRY.histogram(
+            "replay_event_batch_replay_duration",
+            "seconds per replayed event batch", ("partition",)
+        ).labels(partition_label)
+        self._m_replay_events = REGISTRY.counter(
+            "replay_events_total", "events applied during replay",
+            ("partition",)).labels(partition_label)
+        self._m_replay_last_source = REGISTRY.gauge(
+            "replay_last_source_position",
+            "source position of the last replayed batch", ("partition",)
+        ).labels(partition_label)
         clock = clock_millis or log_stream.clock_millis
         self.schedule_service = ProcessingScheduleService(clock, self._write_scheduled_commands)
         self._reader_position = 1
@@ -200,10 +240,14 @@ class StreamProcessor:
     def start(self) -> None:
         """Recover: replay from the last processed position, then (in
         PROCESSING mode) become ready to process commands."""
+        import time as _time
+
+        recovery_start = _time.perf_counter()
         self.phase = Phase.REPLAY
         self.last_processed_position = self._load_last_processed()
         self._reader_position = 1 if self.last_processed_position < 0 else self.last_processed_position + 1
         self.replay_available()
+        self._m_recovery_time.set(_time.perf_counter() - recovery_start)
         if self.mode == StreamProcessorMode.PROCESSING:
             self.phase = Phase.PROCESSING
             # processing scans from the start of the unreplayed suffix
@@ -216,6 +260,8 @@ class StreamProcessor:
     def replay_available(self) -> int:
         """Apply committed events not yet reflected in state. Returns number of
         events applied. In REPLAY mode this is the follower's steady state."""
+        import time as _time
+
         applied = 0
         position = self._reader_position
         while True:
@@ -223,6 +269,7 @@ class StreamProcessor:
             if logged is None:
                 break
             batch = self.log_stream.read_batch_containing(logged.position)
+            batch_start = _time.perf_counter()
             with self.db.transaction():
                 max_source = -1
                 for rec in batch:
@@ -248,10 +295,14 @@ class StreamProcessor:
                 if max_source > self.last_processed_position:
                     self.last_processed_position = max_source
                     self._store_last_processed(max_source)
+            self._m_replay_duration.observe(_time.perf_counter() - batch_start)
+            if max_source >= 0:
+                self._m_replay_last_source.set(max_source)
             position = batch[-1].position + 1
         self._reader_position = position
         if applied:
             self._m_replayed.inc(applied)
+            self._m_replay_events.inc(applied)
         return applied
 
     # -- processing ----------------------------------------------------------
@@ -354,7 +405,10 @@ class StreamProcessor:
                 job_types |= activatable_job_types(result.follow_ups)
         self._notify_jobs_available(job_types)
         self._m_batched.inc(len(cmds))
-        self._m_latency.observe(_time.perf_counter() - group_start)
+        elapsed = _time.perf_counter() - group_start
+        self._m_latency.observe(elapsed)
+        self._m_batch_commands.observe(len(cmds))
+        self._m_batch_duration.observe(elapsed)
         return len(cmds)
 
     def process_next(self) -> bool:
@@ -378,13 +432,21 @@ class StreamProcessor:
                 self._write_and_mark(cmd, builder)
         except Exception as error:  # noqa: BLE001 — the rollback/onError seam
             logger.debug("processing error at position %s: %s", cmd.position, error, exc_info=True)
+            self._m_batch_retry.inc()
             self._on_processing_error(cmd, error)
             return
         self._execute_side_effects(builder)
         self._notify_jobs_available(activatable_job_types(builder.follow_ups))
         self._observe_follow_ups(builder.follow_ups)
         self._m_processed.inc()
-        self._m_latency.observe(_time.perf_counter() - start)
+        elapsed = _time.perf_counter() - start
+        self._m_latency.observe(elapsed)
+        self._m_processing_duration.observe(elapsed)
+        self._m_batch_commands.observe(
+            1 + sum(1 for f in builder.follow_ups
+                    if f.record.is_command and f.processed))
+        self._m_batch_duration.observe(elapsed)
+        self._m_post_commit.observe(len(builder.post_commit_tasks))
 
     def _batch_process(self, cmd: LoggedRecord, builder: ProcessingResultBuilder) -> None:
         """The batchProcessing loop: the input command plus follow-up commands
