@@ -228,16 +228,18 @@ class E2EPartition:
             pass
 
     def pending_job_keys(self, after_position: int) -> list[tuple[str, int, int]]:
-        """Worker-side job discovery over the log — a header-only scan that
-        decodes the value of JOB CREATED records only (LogStream.scan)."""
-        vt_job, created = int(ValueType.JOB), int(JobIntent.CREATED)
+        """Worker-side job discovery over the log — a header-filtered scan
+        that builds views and decodes values for JOB CREATED records only
+        (LogStream.scan_filtered)."""
+        from zeebe_tpu.protocol import RecordType
+
         jobs = []
-        for view in self.stream.scan(after_position + 1):
-            if (view.value_type == vt_job and view.intent == created
-                    and view.is_event):
-                value = view.value
-                jobs.append((value.get("type", ""),
-                             value.get("processInstanceKey", -1), view.key))
+        for view in self.stream.scan_filtered(
+                after_position + 1, int(RecordType.EVENT), int(ValueType.JOB),
+                int(JobIntent.CREATED)):
+            value = view.value
+            jobs.append((value.get("type", ""),
+                         value.get("processInstanceKey", -1), view.key))
         return jobs
 
     def complete_in_type_waves(self, jobs: list[tuple[str, int, int]]) -> float:
@@ -267,12 +269,11 @@ class E2EPartition:
         return elapsed
 
     def count_transitions(self, after_position: int) -> int:
-        vt_pi = int(ValueType.PROCESS_INSTANCE)
-        n = 0
-        for view in self.stream.scan(after_position + 1):
-            if view.value_type == vt_pi and view.is_event:
-                n += 1
-        return n
+        from zeebe_tpu.protocol import RecordType
+
+        return sum(1 for _ in self.stream.scan_filtered(
+            after_position + 1, int(RecordType.EVENT),
+            int(ValueType.PROCESS_INSTANCE)))
 
 
 def run_e2e_workload(models, drives, n_instances: int, variables: dict) -> dict:

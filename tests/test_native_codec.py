@@ -325,6 +325,7 @@ class TestPackFingerprint:
             b = c_fp(docs, roles, self.FP)
             assert a[0] == b[0], (trial, docs, roles)
             assert a[1] == list(b[1]), (trial, a[1], b[1])
+            assert a[2] == set(b[2]), (trial, a[2], b[2])
 
     def test_role_int_as_dict_key(self):
         py_fp, c_fp = self._impls()
@@ -339,7 +340,7 @@ class TestPackFingerprint:
         due = 1_700_000_000_999
         docs = [{"dueDate": due}, {"other": due}]  # pinned at "other"
         for fp in self._impls():
-            payload, values = fp(docs, {}, self.FP)
+            payload, values, pinned = fp(docs, {}, self.FP)
             assert values == [] or list(values) == []
         assert py_fp(docs, {}, self.FP)[0] == c_fp(docs, {}, self.FP)[0]
 

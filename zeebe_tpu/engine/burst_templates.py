@@ -41,7 +41,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from zeebe_tpu.protocol import msgpack
-from zeebe_tpu.state.db import ColumnFamilyCode
+from zeebe_tpu.state.db import ColumnFamilyCode, _DELETED as _DB_DELETED
 from zeebe_tpu.stream.api import activatable_job_types as _activatable_job_types
 
 # record header layout (protocol/record.py _HEADER = "<BBBBqqqiqqH")
@@ -451,6 +451,8 @@ _PLAN_ENTRY = struct.Struct("<IBB")
 from zeebe_tpu.native import codec_fn as _codec_fn
 
 _apply_patches = _codec_fn("apply_patches")
+_apply_state_plan = _codec_fn("apply_state_plan")
+_STATE_PATCH = struct.Struct("<IB")
 
 
 @dataclass
@@ -470,6 +472,9 @@ class BurstTemplate:
     # compiled payload patch plan (native apply_patches): entry bytes +
     # distinct role list; False = not compilable (fallback loop)
     _plan: Any = field(default=None, repr=False, compare=False)
+    # compiled state-op plan (native apply_state_plan): per-op tuples +
+    # distinct role list; False = not compilable (fallback loop)
+    _state_plan: Any = field(default=None, repr=False, compare=False)
 
     def _compiled_plan(self):
         """(plan bytes, distinct roles) for the native patcher, or None.
@@ -507,7 +512,55 @@ class BurstTemplate:
                 _PACK_LE_I.pack_into(buf, off, v)
         return buf
 
+    def _compiled_state_plan(self):
+        """(per-op tuples, distinct roles) for the native state applier, or
+        None. Compilable iff every put carries codec-stable value bytes and
+        role/offset widths fit the packed patch format. Each distinct role
+        resolves ONCE per instantiation."""
+        plan = self._state_plan
+        if plan is None:
+            role_idx: dict[tuple, int] = {}
+            ops: list[tuple] = []
+
+            def pack_patches(patches) -> bytes | None:
+                out = bytearray()
+                for entry in patches:
+                    off, role = entry[0], entry[-1]
+                    idx = role_idx.setdefault(role, len(role_idx))
+                    if idx > 0xFF or off > 0xFFFFFFFF:
+                        return None
+                    out += _STATE_PATCH.pack(off, idx)
+                return bytes(out)
+
+            for op in self.state_ops:
+                kp = pack_patches(op.key_patches)
+                if kp is None:
+                    ops = None
+                    break
+                if op.op != "put":
+                    ops.append((0, op.key, kp, None, b""))
+                    continue
+                if op.value_bytes is None:
+                    ops = None  # template-object value: python fallback
+                    break
+                vp = pack_patches(op.value_byte_patches)
+                if vp is None:
+                    ops = None
+                    break
+                ops.append((1, op.key, kp, op.value_bytes, vp))
+            self._state_plan = plan = (
+                False if ops is None else (ops, list(role_idx)))
+        return None if plan is False else plan
+
     def apply_state(self, txn, resolve: Callable[[tuple], int]) -> None:
+        if (_apply_state_plan is not None and getattr(txn, "capture", True) is None
+                and getattr(txn, "_writes", None) is not None):
+            plan = self._compiled_state_plan()
+            if plan is not None:
+                ops, roles = plan
+                _apply_state_plan(ops, [resolve(r) for r in roles],
+                                  txn._writes, txn._sorted_writes, _DB_DELETED)
+                return
         for op in self.state_ops:
             if op.key_patches:
                 key = bytearray(op.key)

@@ -65,7 +65,8 @@ logger = logging.getLogger("zeebe_tpu.kernel_backend")
 
 
 def _py_pack_fingerprint(docs, roles: dict[int, str],
-                         fp_fields: frozenset[str]) -> tuple[bytes, list[int]]:
+                         fp_fields: frozenset[str]
+                         ) -> tuple[bytes, list[int], set[int]]:
     """Pure-Python fingerprint walk — the specification the native
     ``pack_fingerprint`` (native/codec.c) is byte-equality-tested against.
 
@@ -75,7 +76,12 @@ def _py_pack_fingerprint(docs, roles: dict[int, str],
     occurrence would corrupt that copy). Pass 2 emits msgpack with role
     markers ["\\x00r", tag], extraction markers ["\\x00f", ordinal], and
     "\\x00s" escaping of NUL-prefixed user strings (so user data can never
-    forge a marker — prefix escaping keeps the normalization injective)."""
+    forge a marker — prefix escaping keeps the normalization injective).
+
+    The returned pinned set is EXACTLY the ints the fingerprint pins
+    byte-for-byte — the sound ``Roles.allowed`` constant set for template
+    capture (an int the fingerprint normalized away varies per command and
+    must never be baked into a template as a constant)."""
     from zeebe_tpu.protocol.msgpack import py_packb
 
     pinned: set[int] = set()
@@ -128,7 +134,7 @@ def _py_pack_fingerprint(docs, roles: dict[int, str],
             return [norm(v) for v in obj]
         return obj
 
-    return py_packb(norm(docs)), fp_values
+    return py_packb(norm(docs)), fp_values, pinned
 
 
 from zeebe_tpu.native import codec_fn as _codec_fn
@@ -491,6 +497,9 @@ class _Admitted:
     kind: str = "c"  # "c" creation | "j" job complete
     # instance-scoped documents the head processors will read — the burst
     # template's context fingerprint is computed over these (role-normalized)
+    # at ADMISSION time (the docs are guaranteed unmutated there; holding
+    # references past admission would race the group's own state writes),
+    # then released
     fp_docs: list | None = None
     # False → this command must not ride a burst template (e.g. it touches
     # engine.await_results, which lives outside the captured state store)
@@ -499,6 +508,11 @@ class _Admitted:
     # fingerprint walk, in canonical order — resolved per command for the
     # template's ("fp", i) roles
     fp_values: list | None = None
+    # the role-normalized byte image (template cache key component) and the
+    # exact set of large ints the fingerprint pinned (the sound
+    # Roles.allowed set) — both computed at admission
+    fp_bytes: bytes | None = None
+    fp_pinned: set | None = None
     # minted keys of parked wait states (timer keys), in reconstruction
     # order — role ("wait", j); they appear in cancel/trigger bursts but not
     # in any admission doc, so they need their own role kind
@@ -555,15 +569,24 @@ class KernelBackend:
         record = cmd.record
         kind = (record.value_type, int(record.intent))
         if kind == (ValueType.PROCESS_INSTANCE_CREATION, int(ProcessInstanceCreationIntent.CREATE)):
-            return self._admit_creation(cmd, instances)
-        if kind == (ValueType.JOB, int(JobIntent.COMPLETE)):
-            return self._admit_job_complete(cmd, instances, admitted_pis)
-        if kind == (ValueType.TIMER, int(TimerIntent.TRIGGER)):
-            return self._admit_timer_trigger(cmd, instances, admitted_pis)
-        if kind == (ValueType.PROCESS_MESSAGE_SUBSCRIPTION,
-                    int(ProcessMessageSubscriptionIntent.CORRELATE)):
-            return self._admit_message_correlate(cmd, instances, admitted_pis)
-        return None
+            adm = self._admit_creation(cmd, instances)
+        elif kind == (ValueType.JOB, int(JobIntent.COMPLETE)):
+            adm = self._admit_job_complete(cmd, instances, admitted_pis)
+        elif kind == (ValueType.TIMER, int(TimerIntent.TRIGGER)):
+            adm = self._admit_timer_trigger(cmd, instances, admitted_pis)
+        elif kind == (ValueType.PROCESS_MESSAGE_SUBSCRIPTION,
+                      int(ProcessMessageSubscriptionIntent.CORRELATE)):
+            adm = self._admit_message_correlate(cmd, instances, admitted_pis)
+        else:
+            return None
+        if adm is not None and self.use_templates and adm.templatable:
+            # fingerprint NOW, over the live documents: nothing has mutated
+            # them yet (materialization of earlier group members runs later
+            # and only touches other instances), and doing it here lets the
+            # admission docs be referenced instead of defensively copied
+            adm.fp_bytes, adm.fp_values, adm.fp_pinned = self._fingerprint(adm)
+            adm.fp_docs = None
+        return adm
 
     def _admit_creation(self, cmd, instances) -> _Admitted | None:
         state = self.engine.state
@@ -606,7 +629,7 @@ class KernelBackend:
         inst = _Inst(idx=len(instances), info=info, new=True, meta=meta, slots=slots)
         templatable = not (value.get("awaitResult") and cmd.record.request_id >= 0)
         return _Admitted(cmd=cmd, inst=inst, kind="c",
-                         fp_docs=[dict(value), meta], templatable=templatable)
+                         fp_docs=[value, meta], templatable=templatable)
 
     def _reconstruct(self, pi_key: int, info: _DefInfo, resume_key: int):
         """Rebuild a running instance's device tokens from element-instance
@@ -672,20 +695,20 @@ class KernelBackend:
                     timers = state.timers.timers_for_element_instance(child_key)
                     if not timers:
                         return None  # incident-parked or already fired
-                    wait_docs.extend(dict(t) for _k, t in timers)
+                    wait_docs.extend(t for _k, t in timers)
                     wait_keys.extend(k for k, _t in timers)
                 elif el.signal_name is not None:
                     subs = state.signal_subscriptions.subscriptions_of(child_key)
                     if not subs:
                         return None  # broadcast mid-flight owns the instance
-                    wait_docs.extend(dict(s) for s in subs)
+                    wait_docs.extend(subs)
                 else:
                     sub = state.process_message_subscriptions.get(
                         child_key, el.message_name
                     )
                     if sub is None:
                         return None
-                    wait_docs.append(dict(sub))
+                    wait_docs.append(sub)
             else:
                 return None
             tok = _Token(slot=-1, elem_idx=el.idx, key=child_key,
@@ -727,9 +750,9 @@ class KernelBackend:
         subs = state.process_message_subscriptions.subscriptions_of(child_key)
         if len(timers) != expected_timers or len(subs) != expected_subs:
             return False
-        wait_docs.extend(dict(t) for _k, t in timers)
+        wait_docs.extend(t for _k, t in timers)
         wait_keys.extend(k for k, _t in timers)
-        wait_docs.extend(dict(s) for s in subs)
+        wait_docs.extend(subs)
         return True
 
     @staticmethod
@@ -861,10 +884,10 @@ class KernelBackend:
         return _Admitted(
             cmd=cmd, inst=inst, resume_token=resume, kind=kind,
             fp_docs=[
-                dict(cmd.record.value),
+                cmd.record.value,
                 *head_docs,
-                dict(root["value"]),
-                [dict(t.value) for t in tokens],
+                root["value"],
+                [t.value for t in tokens],
                 wait_docs,
                 sorted(merged.items()),
                 sorted(join_counts.items()),
@@ -885,7 +908,7 @@ class KernelBackend:
             pi_key=job.get("processInstanceKey", -1),
             resume_key=job.get("elementInstanceKey", -1),
             kind="j",
-            head_docs=[dict(job)],
+            head_docs=[job],
             extra_variables=cmd.record.value.get("variables"),
             require_op=K_TASK,
         )
@@ -910,7 +933,7 @@ class KernelBackend:
             pi_key=instance["value"].get("processInstanceKey", -1),
             resume_key=eik,
             kind="t",
-            head_docs=[dict(timer)],
+            head_docs=[timer],
             extra_variables=None,
             require_op=K_CATCH,
         )
@@ -930,7 +953,7 @@ class KernelBackend:
             pi_key=instance["value"].get("processInstanceKey", -1),
             resume_key=eik,
             kind="m",
-            head_docs=[dict(sub)],
+            head_docs=[sub],
             extra_variables=value.get("variables"),
             require_op=K_CATCH,
         )
@@ -1158,7 +1181,9 @@ class KernelBackend:
             # request presence is part of the burst SHAPE (Writers.respond
             # only emits a client response when request_id >= 0), so it must
             # be in the key — the ids themselves are patched roles
-            fp_bytes, adm.fp_values = self._fingerprint(adm)
+            fp_bytes = adm.fp_bytes
+            if fp_bytes is None:  # admission-time fingerprint unavailable
+                fp_bytes, adm.fp_values, adm.fp_pinned = self._fingerprint(adm)
             key = (adm.kind, adm.inst.info.index,
                    adm.cmd.record.request_id >= 0, tuple(ops), fp_bytes)
             template = self._templates.get(key, _MISSING)
@@ -1214,7 +1239,7 @@ class KernelBackend:
                 clock_notes, clock_poison = bt.clock_note_end()
         if capture:
             self.template_misses += 1
-            allowed = self._fingerprint_ints(adm)
+            allowed = adm.fp_pinned if adm.fp_pinned is not None else set()
             if clock_poison:
                 role_map = None
             for i, v in enumerate(mints):
@@ -1352,12 +1377,14 @@ class KernelBackend:
     # different due dates share one burst template
     _FP_FIELDS = frozenset(("dueDate", "deadline"))
 
-    def _fingerprint(self, adm: _Admitted) -> tuple[bytes, list[int]]:
-        """(byte image, extracted clock-field values) of the instance-scoped
-        documents the slow path reads. Role values (keys known at admission)
-        and whitelisted clock-derived fields are normalized away so two
-        commands differing only in key identity / due dates fingerprint
-        equal; everything else is pinned byte-for-byte."""
+    def _fingerprint(self, adm: _Admitted) -> tuple[bytes, list[int], set[int]]:
+        """(byte image, extracted clock-field values, pinned large ints) of
+        the instance-scoped documents the slow path reads. Role values (keys
+        known at admission) and whitelisted clock-derived fields are
+        normalized away so two commands differing only in key identity / due
+        dates fingerprint equal; everything else is pinned byte-for-byte —
+        the returned pinned set is exactly the template's sound constant
+        allowance (Roles.allowed)."""
         roles = {}
         inst = adm.inst
         if inst.pi_key >= _ROLE_VALUE_MIN:
@@ -1373,28 +1400,6 @@ class KernelBackend:
         if _native_pack_fingerprint is not None:
             return _native_pack_fingerprint(adm.fp_docs, roles, self._FP_FIELDS)
         return _py_pack_fingerprint(adm.fp_docs, roles, self._FP_FIELDS)
-
-    def _fingerprint_ints(self, adm: _Admitted) -> set[int]:
-        """All large ints present in the admission documents — values the
-        fingerprint pins, so a template may keep them as constants."""
-        out: set[int] = set()
-
-        def walk(obj):
-            if isinstance(obj, bool):
-                return
-            if isinstance(obj, int):
-                if abs(obj) >= _ROLE_VALUE_MIN:
-                    out.add(int(obj))
-            elif isinstance(obj, dict):
-                for k, v in obj.items():
-                    walk(k)
-                    walk(v)
-            elif isinstance(obj, (list, tuple)):
-                for v in obj:
-                    walk(v)
-
-        walk(adm.fp_docs)
-        return out
 
     def _roles_for(self, adm: _Admitted):
         """(value→role map, role-tagged command) for capture/audit runs."""

@@ -103,6 +103,22 @@ _scan_batch_headers = (
 )
 
 
+def _py_scan_batch_headers_filtered(payload, record_type, value_type, intent):
+    src, ts, headers = _scan_batch_headers(payload)
+    return src, ts, [
+        h for h in headers
+        if h[2] == record_type and h[3] == value_type
+        and (intent < 0 or h[4] == intent)
+    ]
+
+
+_scan_batch_headers_filtered = (
+    _codec.scan_batch_headers_filtered
+    if _codec is not None and hasattr(_codec, "scan_batch_headers_filtered")
+    else _py_scan_batch_headers_filtered
+)
+
+
 class RecordView:
     """Header-only view of one record inside a sequenced batch.
 
@@ -188,6 +204,9 @@ class LogStreamWriter:
     def __init__(self, stream: "LogStream") -> None:
         self._stream = stream
         self._lock = threading.Lock()
+        # histogram sampling tick (1-in-16): per-writer, mutated under
+        # self._lock — a module global would race across partitions' writers
+        self._m_tick = 0
 
     def try_write(
         self, entries: list[LogAppendEntry], source_position: int = -1
@@ -198,23 +217,28 @@ class LogStreamWriter:
             return -1
         stream = self._stream
         with self._lock:
-            start = time.perf_counter()
+            # histograms see a 1-in-16 sample (the reference's hot appenders
+            # amortize metric updates the same way); position gauges stay exact
+            self._m_tick += 1
+            sampled = not (self._m_tick & 15)
+            start = time.perf_counter() if sampled else 0.0
             first_position = stream._next_position
             timestamp = stream.clock_millis()
             payload, stamped, bodies = _serialize_batch_with_bodies(
                 entries, first_position, source_position, timestamp
             )
-            _M_SEQ_BATCH_SIZE.observe(len(entries))
-            _M_SEQ_BATCH_BYTES.observe(len(payload))
             jrec = stream.journal.append(payload, asqn=first_position)
             stream._on_appended(first_position, jrec.index)
             stream._next_position = first_position + len(entries)
             last = first_position + len(entries) - 1
             _M_LAST_APPENDED.set(last)
             _M_LAST_COMMITTED.set(last)  # local log: visible on append
-            elapsed = time.perf_counter() - start
-            _M_APPEND_LATENCY.observe(elapsed)
-            _M_COMMIT_LATENCY.observe(elapsed)
+            if sampled:
+                _M_SEQ_BATCH_SIZE.observe(len(entries))
+                _M_SEQ_BATCH_BYTES.observe(len(payload))
+                elapsed = time.perf_counter() - start
+                _M_APPEND_LATENCY.observe(elapsed)
+                _M_COMMIT_LATENCY.observe(elapsed)
             stream._batch_has_commands[jrec.index] = any(
                 e.record.is_command and not e.processed for e in entries
             )
@@ -262,22 +286,25 @@ class LogStreamWriter:
         decode on demand — but the command-scan skip index is."""
         stream = self._stream
         with self._lock:
-            start = time.perf_counter()
+            self._m_tick += 1
+            sampled = not (self._m_tick & 15)
+            start = time.perf_counter() if sampled else 0.0
             first_position = stream._next_position
             timestamp = stream.clock_millis()
             patch_prepatched_batch(buf, pos_offsets, ts_offsets,
                                    first_position, timestamp)
-            _M_SEQ_BATCH_SIZE.observe(count)
-            _M_SEQ_BATCH_BYTES.observe(len(buf))
             jrec = stream.journal.append(bytes(buf), asqn=first_position)
             stream._on_appended(first_position, jrec.index)
             stream._next_position = first_position + count
             last = first_position + count - 1
             _M_LAST_APPENDED.set(last)
             _M_LAST_COMMITTED.set(last)
-            elapsed = time.perf_counter() - start
-            _M_APPEND_LATENCY.observe(elapsed)
-            _M_COMMIT_LATENCY.observe(elapsed)
+            if sampled:
+                _M_SEQ_BATCH_SIZE.observe(count)
+                _M_SEQ_BATCH_BYTES.observe(len(buf))
+                elapsed = time.perf_counter() - start
+                _M_APPEND_LATENCY.observe(elapsed)
+                _M_COMMIT_LATENCY.observe(elapsed)
             stream._batch_has_commands[jrec.index] = has_pending_commands
         return last
 
@@ -569,6 +596,27 @@ class LogStream:
                 return nxt[0], slot + 1
         return None, slot
 
+    def _scan_batches(self, from_position: int):
+        """Shared scan skeleton: yields (cached_records, payload) per
+        sequenced batch from the one holding ``from_position`` — exactly one
+        of the two is non-None. One streaming journal read (a single seek +
+        bulk read per segment) instead of a random-access read per batch;
+        batches appended after the scan started are excluded."""
+        last = self.last_position
+        if from_position > last:
+            return
+        slot = self._batch_slot_for(from_position)
+        if slot < 0:
+            slot = 0
+        cache = self._batch_cache
+        for jrec in self.journal.read_from(self._batch_indexes[slot]):
+            if jrec.asqn < 0:
+                continue
+            if jrec.asqn > last:
+                return  # appended after this scan started
+            cached = cache.get(jrec.index)
+            yield (cached, None) if cached is not None else (None, jrec.data)
+
     def scan(self, from_position: int = 1) -> Iterator[RecordView]:
         """Header-only forward scan from ``from_position``: yields
         ``RecordView``s whose full records (msgpack values) decode lazily on
@@ -578,22 +626,8 @@ class LogStream:
         are served from it; undecoded batches are scanned natively without
         populating the cache."""
         from_position = max(from_position, 1)
-        last = self.last_position
-        if from_position > last:
-            return
-        slot = self._batch_slot_for(from_position)
-        if slot < 0:
-            slot = 0
         pid = self.partition_id
-        cache = self._batch_cache
-        # one streaming journal read (a single seek + bulk read per segment)
-        # instead of a random-access read per batch
-        for jrec in self.journal.read_from(self._batch_indexes[slot]):
-            if jrec.asqn < 0:
-                continue
-            if jrec.asqn > last:
-                return  # appended after this scan started
-            cached = cache.get(jrec.index)
+        for cached, payload in self._scan_batches(from_position):
             if cached is not None:
                 for logged in cached:
                     if logged.position < from_position:
@@ -606,7 +640,6 @@ class LogStream:
                         None, 0, 0, rec.timestamp, pid, record=rec,
                     )
                 continue
-            payload = jrec.data
             source_position, timestamp, headers = _scan_batch_headers(payload)
             for (processed, position, record_type, value_type, intent, key,
                  off, length) in headers:
@@ -616,6 +649,44 @@ class LogStream:
                     position, bool(processed), source_position, record_type,
                     value_type, intent, key, payload, off, length, timestamp,
                     pid,
+                )
+
+    def scan_filtered(self, from_position: int, record_type: int,
+                      value_type: int, intent: int | None = None
+                      ) -> Iterator[RecordView]:
+        """``scan`` that filters on the raw header ints BEFORE building a
+        ``RecordView`` — a discovery sweep (job scan, transition count) over
+        N records with k matches costs k view objects, not N (uncached
+        batches filter inside the native scanner). ``intent=None`` matches
+        any intent."""
+        from_position = max(from_position, 1)
+        pid = self.partition_id
+        for cached, payload in self._scan_batches(from_position):
+            if cached is not None:
+                for logged in cached:
+                    if logged.position < from_position:
+                        continue
+                    rec = logged.record
+                    if (int(rec.record_type) != record_type
+                            or int(rec.value_type) != value_type
+                            or (intent is not None and int(rec.intent) != intent)):
+                        continue
+                    yield RecordView(
+                        logged.position, logged.processed,
+                        logged.source_position, record_type,
+                        value_type, int(rec.intent), rec.key,
+                        None, 0, 0, rec.timestamp, pid, record=rec,
+                    )
+                continue
+            source_position, timestamp, headers = _scan_batch_headers_filtered(
+                payload, record_type, value_type,
+                -1 if intent is None else intent)
+            for (processed, position, rt, vt, it, key, off, length) in headers:
+                if position < from_position:
+                    continue
+                yield RecordView(
+                    position, bool(processed), source_position, rt,
+                    vt, it, key, payload, off, length, timestamp, pid,
                 )
 
     def read_batch_containing(self, position: int) -> list[LoggedRecord]:

@@ -908,7 +908,7 @@ static PyObject *codec_pack_fingerprint(PyObject *self, PyObject *args)
     payload = PyBytes_FromStringAndSize((const char *)w.data, w.len);
     if (!payload)
         goto done;
-    out = PyTuple_Pack(2, payload, c.fp_values);
+    out = PyTuple_Pack(3, payload, c.fp_values, c.pinned);
 done:
     PyMem_Free(w.data);
     Py_XDECREF(payload);
@@ -1053,11 +1053,285 @@ static PyObject *codec_stamp_batch(PyObject *self, PyObject *args)
     Py_RETURN_NONE;
 }
 
+/* ------------------------------------------------------------------------
+ * scan_batch_headers_filtered(payload, record_type, value_type, intent):
+ * scan_batch_headers that keeps only entries matching the given header ints
+ * (intent < 0 matches any intent) — a discovery sweep over N records with k
+ * matches allocates k tuples, not N. Same framing as scan_batch_headers. */
+static PyObject *codec_scan_batch_headers_filtered(PyObject *self, PyObject *args)
+{
+    PyObject *arg;
+    int want_rt, want_vt, want_intent;
+    if (!PyArg_ParseTuple(args, "Oiii", &arg, &want_rt, &want_vt, &want_intent))
+        return NULL;
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0)
+        return NULL;
+    const uint8_t *p = (const uint8_t *)view.buf;
+    Py_ssize_t len = view.len;
+    PyObject *out = NULL, *records = NULL;
+    if (len < BATCH_HEADER_SIZE) {
+        codec_error("batch payload truncated: %zd bytes", len);
+        goto done;
+    }
+    uint32_t count = (uint32_t)rd_i32(p);
+    int64_t source_position = rd_i64(p + 4);
+    int64_t timestamp = rd_i64(p + 12);
+    if ((Py_ssize_t)count > (len - BATCH_HEADER_SIZE) / ENTRY_HEADER_SIZE) {
+        codec_error("batch count %u impossible for %zd-byte payload", count, len);
+        goto done;
+    }
+    records = PyList_New(0);
+    if (!records)
+        goto done;
+    Py_ssize_t off = BATCH_HEADER_SIZE;
+    for (uint32_t i = 0; i < count; i++) {
+        if (off + ENTRY_HEADER_SIZE > len) {
+            codec_error("batch entry %u truncated", i);
+            goto done;
+        }
+        unsigned processed = p[off];
+        int64_t position = rd_i64(p + off + 1);
+        uint32_t rec_len = (uint32_t)rd_i32(p + off + 9);
+        off += ENTRY_HEADER_SIZE;
+        if (off + (Py_ssize_t)rec_len > len || rec_len < FRAME_HEADER_SIZE) {
+            codec_error("batch record %u truncated", i);
+            goto done;
+        }
+        const uint8_t *f = p + off;
+        if ((int)f[0] == want_rt && (int)f[1] == want_vt
+            && (want_intent < 0 || (int)f[2] == want_intent)) {
+            PyObject *tup = Py_BuildValue(
+                "(iLiiiLnn)", (int)processed, (long long)position,
+                (int)f[0], (int)f[1], (int)f[2], (long long)rd_i64(f + 4),
+                (Py_ssize_t)off, (Py_ssize_t)rec_len);
+            if (!tup || PyList_Append(records, tup) < 0) {
+                Py_XDECREF(tup);
+                goto done;
+            }
+            Py_DECREF(tup);
+        }
+        off += rec_len;
+    }
+    if (off != len) {
+        codec_error("trailing bytes after batch: %zd", len - off);
+        goto done;
+    }
+    out = Py_BuildValue("(LLO)", (long long)source_position,
+                        (long long)timestamp, records);
+done:
+    Py_XDECREF(records);
+    PyBuffer_Release(&view);
+    return out;
+}
+
+/* ------------------------------------------------------------------------
+ * apply_state_plan: a burst template's state write-set applied natively,
+ * with Transaction.put/delete semantics (state/db.py): a key not yet in the
+ * overlay dict is insorted into the sorted-keys list; the dict then maps
+ * key -> fresh value object (puts) or the _DELETED sentinel (deletes).
+ *
+ * apply_state_plan(plan, values, writes, sorted_writes, deleted)
+ *   plan: list of (op:int 0=del/1=put, key:bytes, key_patches:bytes,
+ *                  value_bytes:bytes|None, value_patches:bytes)
+ *     patches are packed (u32 LE offset, u8 role index), 5 bytes each;
+ *     key patches write BE u64 sign-flipped (db key int encoding), value
+ *     patches write BE u64 raw (msgpack uint64 body) — matching
+ *     StateOp.build_value / BurstTemplate.apply_state exactly.
+ *   values: list of resolved role ints (one resolve per distinct role)
+ * Every put unpacks a FRESH value object (the engine mutates state values
+ * in place, so object sharing across instantiations would corrupt state). */
+#define STATE_PATCH_SIZE 5
+
+static int apply_packed_patches(uint8_t *buf, Py_ssize_t blen,
+                                const uint8_t *patches, Py_ssize_t plen,
+                                const int64_t *vals, Py_ssize_t nvals,
+                                int sign_flip)
+{
+    if (plen % STATE_PATCH_SIZE) {
+        PyErr_SetString(PyExc_ValueError, "malformed state patch plan");
+        return -1;
+    }
+    for (Py_ssize_t e = 0; e < plen; e += STATE_PATCH_SIZE) {
+        uint32_t off = (uint32_t)patches[e] | ((uint32_t)patches[e + 1] << 8)
+            | ((uint32_t)patches[e + 2] << 16) | ((uint32_t)patches[e + 3] << 24);
+        uint8_t idx = patches[e + 4];
+        if (idx >= nvals || (Py_ssize_t)off + 8 > blen) {
+            PyErr_SetString(PyExc_ValueError, "state patch out of range");
+            return -1;
+        }
+        uint64_t u = (uint64_t)vals[idx];
+        if (sign_flip)
+            u ^= 0x8000000000000000ULL;
+        for (int i = 0; i < 8; i++)
+            buf[off + i] = (uint8_t)(u >> (56 - 8 * i));
+    }
+    return 0;
+}
+
+/* ascending-bytes insort (Transaction._sorted_writes invariant) */
+static int insort_bytes(PyObject *list, PyObject *key)
+{
+    Py_ssize_t lo = 0, hi = PyList_GET_SIZE(list);
+    const char *kbuf = PyBytes_AS_STRING(key);
+    Py_ssize_t klen = PyBytes_GET_SIZE(key);
+    while (lo < hi) {
+        Py_ssize_t mid = (lo + hi) / 2;
+        PyObject *item = PyList_GET_ITEM(list, mid);
+        int lt;
+        if (PyBytes_CheckExact(item)) {
+            Py_ssize_t ilen = PyBytes_GET_SIZE(item);
+            Py_ssize_t n = ilen < klen ? ilen : klen;
+            int c = memcmp(PyBytes_AS_STRING(item), kbuf, (size_t)n);
+            lt = c < 0 || (c == 0 && ilen < klen);
+        } else {
+            lt = PyObject_RichCompareBool(item, key, Py_LT);
+            if (lt < 0)
+                return -1;
+        }
+        if (lt)
+            lo = mid + 1;
+        else
+            hi = mid;
+    }
+    return PyList_Insert(list, lo, key);
+}
+
+static PyObject *codec_apply_state_plan(PyObject *self, PyObject *args)
+{
+    PyObject *plan, *values, *writes, *sorted_writes, *deleted;
+    if (!PyArg_ParseTuple(args, "OOOOO", &plan, &values, &writes,
+                          &sorted_writes, &deleted))
+        return NULL;
+    if (!PyList_CheckExact(plan) || !PyList_CheckExact(values)
+        || !PyDict_CheckExact(writes) || !PyList_CheckExact(sorted_writes)) {
+        PyErr_SetString(PyExc_TypeError,
+                        "apply_state_plan(list, list, dict, list, obj) expected");
+        return NULL;
+    }
+    Py_ssize_t nvals = PyList_GET_SIZE(values);
+    if (nvals > 256) {
+        PyErr_SetString(PyExc_ValueError, "too many roles in state plan");
+        return NULL;
+    }
+    int64_t vals[256];
+    for (Py_ssize_t i = 0; i < nvals; i++) {
+        int overflow = 0;
+        vals[i] = PyLong_AsLongLongAndOverflow(PyList_GET_ITEM(values, i), &overflow);
+        if (vals[i] == -1 && PyErr_Occurred())
+            return NULL;
+        if (overflow) {
+            PyErr_SetString(PyExc_OverflowError, "role value out of i64 range");
+            return NULL;
+        }
+    }
+    Py_ssize_t nops = PyList_GET_SIZE(plan);
+    for (Py_ssize_t i = 0; i < nops; i++) {
+        PyObject *op = PyList_GET_ITEM(plan, i);
+        if (!PyTuple_CheckExact(op) || PyTuple_GET_SIZE(op) != 5) {
+            PyErr_SetString(PyExc_TypeError, "malformed state plan op");
+            return NULL;
+        }
+        long code = PyLong_AsLong(PyTuple_GET_ITEM(op, 0));
+        PyObject *key_tmpl = PyTuple_GET_ITEM(op, 1);
+        PyObject *kp = PyTuple_GET_ITEM(op, 2);
+        PyObject *vb = PyTuple_GET_ITEM(op, 3);
+        PyObject *vp = PyTuple_GET_ITEM(op, 4);
+        if ((code == -1 && PyErr_Occurred()) || !PyBytes_CheckExact(key_tmpl)
+            || !PyBytes_CheckExact(kp) || !PyBytes_CheckExact(vp)) {
+            PyErr_SetString(PyExc_TypeError, "malformed state plan op");
+            return NULL;
+        }
+        /* key: reuse the template bytes when patch-free (immutable) */
+        PyObject *key;
+        Py_ssize_t kplen = PyBytes_GET_SIZE(kp);
+        if (kplen == 0) {
+            key = key_tmpl;
+            Py_INCREF(key);
+        } else {
+            key = PyBytes_FromStringAndSize(PyBytes_AS_STRING(key_tmpl),
+                                            PyBytes_GET_SIZE(key_tmpl));
+            if (!key)
+                return NULL;
+            if (apply_packed_patches((uint8_t *)PyBytes_AS_STRING(key),
+                                     PyBytes_GET_SIZE(key),
+                                     (const uint8_t *)PyBytes_AS_STRING(kp),
+                                     kplen, vals, nvals, 1) < 0) {
+                Py_DECREF(key);
+                return NULL;
+            }
+        }
+        /* value: fresh unpack per op (deletes store the sentinel) */
+        PyObject *value;
+        if (code == 0) {
+            value = deleted;
+            Py_INCREF(value);
+        } else {
+            if (!PyBytes_CheckExact(vb)) {
+                Py_DECREF(key);
+                PyErr_SetString(PyExc_TypeError, "state plan put without value bytes");
+                return NULL;
+            }
+            Py_ssize_t vlen = PyBytes_GET_SIZE(vb);
+            Py_ssize_t vplen = PyBytes_GET_SIZE(vp);
+            if (vplen == 0) {
+                Reader r = {(const uint8_t *)PyBytes_AS_STRING(vb), vlen, 0};
+                value = read_obj(&r, 0);
+                if (value && r.pos != r.len) {
+                    Py_DECREF(value);
+                    value = codec_error("trailing bytes in state value");
+                }
+            } else {
+                uint8_t stack_buf[512];
+                uint8_t *vbuf = vlen <= (Py_ssize_t)sizeof stack_buf
+                    ? stack_buf : PyMem_Malloc(vlen);
+                if (!vbuf) {
+                    Py_DECREF(key);
+                    return PyErr_NoMemory();
+                }
+                memcpy(vbuf, PyBytes_AS_STRING(vb), vlen);
+                if (apply_packed_patches(vbuf, vlen,
+                                         (const uint8_t *)PyBytes_AS_STRING(vp),
+                                         vplen, vals, nvals, 0) < 0) {
+                    if (vbuf != stack_buf)
+                        PyMem_Free(vbuf);
+                    Py_DECREF(key);
+                    return NULL;
+                }
+                Reader r = {vbuf, vlen, 0};
+                value = read_obj(&r, 0);
+                if (value && r.pos != r.len) {
+                    Py_DECREF(value);
+                    value = codec_error("trailing bytes in state value");
+                }
+                if (vbuf != stack_buf)
+                    PyMem_Free(vbuf);
+            }
+            if (!value) {
+                Py_DECREF(key);
+                return NULL;
+            }
+        }
+        /* Transaction.put/delete: insort on first write of the key */
+        int present = PyDict_Contains(writes, key);
+        if (present < 0 || (present == 0 && insort_bytes(sorted_writes, key) < 0)
+            || PyDict_SetItem(writes, key, value) < 0) {
+            Py_DECREF(key);
+            Py_DECREF(value);
+            return NULL;
+        }
+        Py_DECREF(key);
+        Py_DECREF(value);
+    }
+    Py_RETURN_NONE;
+}
+
 static PyMethodDef codec_methods[] = {
     {"stamp_batch", codec_stamp_batch, METH_VARARGS,
      "Stamp record positions and the batch timestamp into a pre-serialized burst."},
     {"pack_fingerprint", codec_pack_fingerprint, METH_VARARGS,
-     "Role-normalizing fingerprint packer: (docs, roles, fp_fields) -> (bytes, fp_values)."},
+     "Role-normalizing fingerprint packer: (docs, roles, fp_fields) -> "
+     "(bytes, fp_values, pinned_ints)."},
     {"apply_patches", codec_apply_patches, METH_VARARGS,
      "Apply a compiled patch plan to a bytearray in place."},
     {"packb", codec_packb, METH_O, "Serialize an object to msgpack bytes."},
@@ -1066,6 +1340,10 @@ static PyMethodDef codec_methods[] = {
      "Parse one record wire frame into a 12-tuple (header fields, reason, value)."},
     {"scan_batch_headers", codec_scan_batch_headers, METH_O,
      "Parse a sequenced batch into per-record header tuples without decoding values."},
+    {"scan_batch_headers_filtered", codec_scan_batch_headers_filtered, METH_VARARGS,
+     "scan_batch_headers keeping only entries matching (record_type, value_type, intent)."},
+    {"apply_state_plan", codec_apply_state_plan, METH_VARARGS,
+     "Apply a compiled burst-template state plan to a transaction overlay."},
     {"set_error_class", codec_set_error_class, METH_O, "Register the exception class raised on malformed input."},
     {NULL, NULL, 0, NULL},
 };
