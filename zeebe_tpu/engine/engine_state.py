@@ -1162,11 +1162,16 @@ class EngineState:
     def bulk_mint(self, count: int) -> list[int]:
         """Mint ``count`` keys with a single generator-state write (the burst
         template fast path: same final generator state as ``count`` next_key
-        calls, one CF put instead of ``count``)."""
+        calls, one CF put instead of ``count``). Keys are computed as one
+        range over the partition-encoded base — identical to ``count``
+        next_key calls (encode_partition_id is base + local counter)."""
+        if not count:
+            return []
         gen = self.key_generator
-        mints = [gen.next_key() for _ in range(count)]
-        if count:
-            self._key_cf.put(("next",), gen.current)
+        first = gen.next_key()
+        mints = list(range(first, first + count))
+        gen.set_current(gen.current + count - 1)
+        self._key_cf.put(("next",), gen.current)
         return mints
 
     def observe_key(self, key: int) -> None:

@@ -89,7 +89,13 @@ def _py_pack_fingerprint(docs, roles: dict[int, str],
     def scan(obj, field=None):
         t = type(obj)
         if t is int:
-            if obj >= _ROLE_VALUE_MIN and obj not in roles and field is None:
+            if obj >= _ROLE_VALUE_MIN:
+                if obj not in roles and field is None:
+                    pinned.add(obj)
+            elif obj <= -_ROLE_VALUE_MIN:
+                # large negatives are never roles and never extracted —
+                # norm() emits them unchanged at every position, so they are
+                # fingerprint-pinned and sound template constants
                 pinned.add(obj)
         elif t is dict:
             for k, v in obj.items():
@@ -986,7 +992,18 @@ class KernelBackend:
         # to the next power of two (rare; costs one extra compile).
         small = min(64, self._pow2(self.max_group))
         I = small if n_real <= small else self._pow2(self.max_group)
-        T = self._pow2(max(4 * I, 4 * n_tokens))
+        # token pool: the set's static live-width bound (tables.token_width)
+        # sizes it exactly — a one-token-per-instance set runs at T == I
+        # instead of 4x, which is pure device-time savings; with no sound
+        # bound (parallel split on a cycle) keep the legacy 4x factor.
+        # Overflow is detected and falls back, so an undersized pool is a
+        # perf bug, not a correctness one — but the bound is sound, so it
+        # cannot happen for bounded sets.
+        width = tables.token_width
+        if width > 0:
+            T = self._pow2(max(width * I, n_tokens))
+        else:
+            T = self._pow2(max(4 * I, 4 * n_tokens))
         E = tables.max_elements
         S = tables.num_slots
         if T > PACK_MAX_TOKENS or E >= PACK_MAX_ELEMENTS:

@@ -224,7 +224,7 @@ class LogStreamWriter:
             start = time.perf_counter() if sampled else 0.0
             first_position = stream._next_position
             timestamp = stream.clock_millis()
-            payload, stamped, bodies = _serialize_batch_with_bodies(
+            payload, bodies = _serialize_batch_with_bodies(
                 entries, first_position, source_position, timestamp
             )
             jrec = stream.journal.append(payload, asqn=first_position)
@@ -252,28 +252,29 @@ class LogStreamWriter:
             # the cached view never diverges from disk (cheap codepoint-count
             # precheck before paying for the utf-8 encode).
             if any(
-                len(r.rejection_reason) > 0x3FFF
-                and len(r.rejection_reason.encode("utf-8")) > 0xFFFF
-                for r in stamped
+                len(e.record.rejection_reason) > 0x3FFF
+                and len(e.record.rejection_reason.encode("utf-8")) > 0xFFFF
+                for e in entries
             ):
-                return first_position + len(entries) - 1
+                return last
             stream._cache_batch(
                 jrec.index,
                 [
                     LoggedRecord(
-                        record=record.replace(
+                        record=entry.record.replace(
                             position=first_position + i,
                             partition_id=stream.partition_id,
+                            timestamp=timestamp,
                             value=msgpack_unpackb(bodies[i]),
                         ),
                         position=first_position + i,
                         source_position=source_position,
-                        processed=entries[i].processed,
+                        processed=entry.processed,
                     )
-                    for i, record in enumerate(stamped)
+                    for i, entry in enumerate(entries)
                 ],
             )
-        return first_position + len(entries) - 1
+        return last
 
     def append_prepatched(
         self, buf: bytearray, pos_offsets: list[int], ts_offsets: list[int],
@@ -335,21 +336,18 @@ def _serialize_batch(
 
 def _serialize_batch_with_bodies(
     entries: list[LogAppendEntry], first_position: int, source_position: int, timestamp: int
-) -> tuple[bytes, list[Record], list[bytes]]:
-    """Serialize; also returns the timestamp-stamped records and each record's
-    msgpack value body so the writer can seed the decode cache without
-    re-encoding anything."""
+) -> tuple[bytes, list[bytes]]:
+    """Serialize; also returns each record's msgpack value body so the writer
+    can seed the decode cache without re-encoding anything. The timestamp is
+    passed straight into ``Record.encode`` — no per-record replace()."""
     parts = [_BATCH_HEADER.pack(len(entries), source_position, timestamp)]
-    stamped: list[Record] = []
     bodies: list[bytes] = []
     for i, entry in enumerate(entries):
-        record = entry.record.replace(timestamp=timestamp)
-        rec_bytes, body = record.encode()
-        stamped.append(record)
+        rec_bytes, body = entry.record.encode(timestamp)
         bodies.append(body)
         parts.append(_ENTRY_HEADER.pack(1 if entry.processed else 0, first_position + i, len(rec_bytes)))
         parts.append(rec_bytes)
-    return b"".join(parts), stamped, bodies
+    return b"".join(parts), bodies
 
 
 def _deserialize_batch(payload: bytes, partition_id: int) -> list[LoggedRecord]:

@@ -623,13 +623,17 @@ done:
 #define BATCH_HEADER_SIZE (4 + 8 + 8)
 #define ENTRY_HEADER_SIZE (1 + 8 + 4)
 
-static PyObject *codec_scan_batch_headers(PyObject *self, PyObject *arg)
+/* shared worker: want_rt/want_vt/want_intent of -1 match anything (the
+ * unfiltered entry point passes -1,-1,-1 and preallocates the list) */
+static PyObject *scan_batch_headers_impl(PyObject *arg, int want_rt,
+                                         int want_vt, int want_intent)
 {
     Py_buffer view;
     if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0)
         return NULL;
     const uint8_t *p = (const uint8_t *)view.buf;
     Py_ssize_t len = view.len;
+    int filtered = want_rt >= 0 || want_vt >= 0 || want_intent >= 0;
     PyObject *out = NULL, *records = NULL;
     if (len < BATCH_HEADER_SIZE) {
         codec_error("batch payload truncated: %zd bytes", len);
@@ -644,7 +648,7 @@ static PyObject *codec_scan_batch_headers(PyObject *self, PyObject *arg)
         codec_error("batch count %u impossible for %zd-byte payload", count, len);
         goto done;
     }
-    records = PyList_New((Py_ssize_t)count);
+    records = filtered ? PyList_New(0) : PyList_New((Py_ssize_t)count);
     if (!records)
         goto done;
     Py_ssize_t off = BATCH_HEADER_SIZE;
@@ -662,13 +666,24 @@ static PyObject *codec_scan_batch_headers(PyObject *self, PyObject *arg)
             goto done;
         }
         const uint8_t *f = p + off;
-        PyObject *tup = Py_BuildValue(
-            "(iLiiiLnn)", (int)processed, (long long)position,
-            (int)f[0], (int)f[1], (int)f[2], (long long)rd_i64(f + 4),
-            (Py_ssize_t)off, (Py_ssize_t)rec_len);
-        if (!tup)
-            goto done;
-        PyList_SET_ITEM(records, (Py_ssize_t)i, tup);
+        if ((want_rt < 0 || (int)f[0] == want_rt)
+            && (want_vt < 0 || (int)f[1] == want_vt)
+            && (want_intent < 0 || (int)f[2] == want_intent)) {
+            PyObject *tup = Py_BuildValue(
+                "(iLiiiLnn)", (int)processed, (long long)position,
+                (int)f[0], (int)f[1], (int)f[2], (long long)rd_i64(f + 4),
+                (Py_ssize_t)off, (Py_ssize_t)rec_len);
+            if (!tup)
+                goto done;
+            if (filtered) {
+                int rc = PyList_Append(records, tup);
+                Py_DECREF(tup);
+                if (rc < 0)
+                    goto done;
+            } else {
+                PyList_SET_ITEM(records, (Py_ssize_t)i, tup);
+            }
+        }
         off += rec_len;
     }
     if (off != len) {
@@ -681,6 +696,11 @@ done:
     Py_XDECREF(records);
     PyBuffer_Release(&view);
     return out;
+}
+
+static PyObject *codec_scan_batch_headers(PyObject *self, PyObject *arg)
+{
+    return scan_batch_headers_impl(arg, -1, -1, -1);
 }
 
 /* ------------------------------------------------------------------------
@@ -701,6 +721,7 @@ typedef struct {
     PyObject *fp_ordinal; /* owned: dict int -> int */
     PyObject *fp_values;  /* owned: list of ints */
     PyObject *min_obj;    /* owned: 2^32 */
+    PyObject *neg_min_obj; /* owned: -(2^32) */
 } FpCtx;
 
 static int fp_large(FpCtx *c, PyObject *obj, int *large)
@@ -729,11 +750,22 @@ static int fp_scan(FpCtx *c, PyObject *obj, int in_fp_field, int depth)
         int large;
         if (fp_large(c, obj, &large) < 0)
             return -1;
-        if (large && !in_fp_field) {
-            int in_roles = PyDict_Contains(c->roles, obj);
-            if (in_roles < 0)
+        if (large) {
+            if (!in_fp_field) {
+                int in_roles = PyDict_Contains(c->roles, obj);
+                if (in_roles < 0)
+                    return -1;
+                if (!in_roles && PySet_Add(c->pinned, obj) < 0)
+                    return -1;
+            }
+        } else {
+            /* large negatives are never roles and never extracted — the
+             * emit pass copies them unchanged everywhere, so they are
+             * fingerprint-pinned (sound template constants) */
+            int neg = PyObject_RichCompareBool(obj, c->neg_min_obj, Py_LE);
+            if (neg < 0)
                 return -1;
-            if (!in_roles && PySet_Add(c->pinned, obj) < 0)
+            if (neg && PySet_Add(c->pinned, obj) < 0)
                 return -1;
         }
         return 0;
@@ -892,14 +924,16 @@ static PyObject *codec_pack_fingerprint(PyObject *self, PyObject *args)
         PyErr_SetString(PyExc_TypeError, "roles must be dict, fp_fields a set");
         return NULL;
     }
-    FpCtx c = {roles, fp_fields, NULL, NULL, NULL, NULL};
+    FpCtx c = {roles, fp_fields, NULL, NULL, NULL, NULL, NULL};
     PyObject *out = NULL, *payload = NULL;
     Writer w = {NULL, 0, 0};
     c.pinned = PySet_New(NULL);
     c.fp_ordinal = PyDict_New();
     c.fp_values = PyList_New(0);
     c.min_obj = PyLong_FromUnsignedLongLong(1ULL << 32);
-    if (!c.pinned || !c.fp_ordinal || !c.fp_values || !c.min_obj)
+    c.neg_min_obj = PyLong_FromLongLong(-(1LL << 32));
+    if (!c.pinned || !c.fp_ordinal || !c.fp_values || !c.min_obj
+        || !c.neg_min_obj)
         goto done;
     if (fp_scan(&c, docs, 0, 0) < 0)
         goto done;
@@ -916,6 +950,7 @@ done:
     Py_XDECREF(c.fp_ordinal);
     Py_XDECREF(c.fp_values);
     Py_XDECREF(c.min_obj);
+    Py_XDECREF(c.neg_min_obj);
     return out;
 }
 
@@ -1064,65 +1099,7 @@ static PyObject *codec_scan_batch_headers_filtered(PyObject *self, PyObject *arg
     int want_rt, want_vt, want_intent;
     if (!PyArg_ParseTuple(args, "Oiii", &arg, &want_rt, &want_vt, &want_intent))
         return NULL;
-    Py_buffer view;
-    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0)
-        return NULL;
-    const uint8_t *p = (const uint8_t *)view.buf;
-    Py_ssize_t len = view.len;
-    PyObject *out = NULL, *records = NULL;
-    if (len < BATCH_HEADER_SIZE) {
-        codec_error("batch payload truncated: %zd bytes", len);
-        goto done;
-    }
-    uint32_t count = (uint32_t)rd_i32(p);
-    int64_t source_position = rd_i64(p + 4);
-    int64_t timestamp = rd_i64(p + 12);
-    if ((Py_ssize_t)count > (len - BATCH_HEADER_SIZE) / ENTRY_HEADER_SIZE) {
-        codec_error("batch count %u impossible for %zd-byte payload", count, len);
-        goto done;
-    }
-    records = PyList_New(0);
-    if (!records)
-        goto done;
-    Py_ssize_t off = BATCH_HEADER_SIZE;
-    for (uint32_t i = 0; i < count; i++) {
-        if (off + ENTRY_HEADER_SIZE > len) {
-            codec_error("batch entry %u truncated", i);
-            goto done;
-        }
-        unsigned processed = p[off];
-        int64_t position = rd_i64(p + off + 1);
-        uint32_t rec_len = (uint32_t)rd_i32(p + off + 9);
-        off += ENTRY_HEADER_SIZE;
-        if (off + (Py_ssize_t)rec_len > len || rec_len < FRAME_HEADER_SIZE) {
-            codec_error("batch record %u truncated", i);
-            goto done;
-        }
-        const uint8_t *f = p + off;
-        if ((int)f[0] == want_rt && (int)f[1] == want_vt
-            && (want_intent < 0 || (int)f[2] == want_intent)) {
-            PyObject *tup = Py_BuildValue(
-                "(iLiiiLnn)", (int)processed, (long long)position,
-                (int)f[0], (int)f[1], (int)f[2], (long long)rd_i64(f + 4),
-                (Py_ssize_t)off, (Py_ssize_t)rec_len);
-            if (!tup || PyList_Append(records, tup) < 0) {
-                Py_XDECREF(tup);
-                goto done;
-            }
-            Py_DECREF(tup);
-        }
-        off += rec_len;
-    }
-    if (off != len) {
-        codec_error("trailing bytes after batch: %zd", len - off);
-        goto done;
-    }
-    out = Py_BuildValue("(LLO)", (long long)source_position,
-                        (long long)timestamp, records);
-done:
-    Py_XDECREF(records);
-    PyBuffer_Release(&view);
-    return out;
+    return scan_batch_headers_impl(arg, want_rt, want_vt, want_intent);
 }
 
 /* ------------------------------------------------------------------------
@@ -1169,17 +1146,18 @@ static int apply_packed_patches(uint8_t *buf, Py_ssize_t blen,
     return 0;
 }
 
-/* ascending-bytes insort (Transaction._sorted_writes invariant) */
-static int insort_bytes(PyObject *list, PyObject *key)
+/* bisect_left over an ascending list of bytes keys (memcmp fast path,
+ * RichCompare fallback for non-bytes items); -1 on comparison error */
+static Py_ssize_t bisect_left_bytes(PyObject *list, PyObject *key)
 {
     Py_ssize_t lo = 0, hi = PyList_GET_SIZE(list);
-    const char *kbuf = PyBytes_AS_STRING(key);
-    Py_ssize_t klen = PyBytes_GET_SIZE(key);
+    const char *kbuf = PyBytes_CheckExact(key) ? PyBytes_AS_STRING(key) : NULL;
+    Py_ssize_t klen = kbuf ? PyBytes_GET_SIZE(key) : 0;
     while (lo < hi) {
         Py_ssize_t mid = (lo + hi) / 2;
         PyObject *item = PyList_GET_ITEM(list, mid);
         int lt;
-        if (PyBytes_CheckExact(item)) {
+        if (kbuf && PyBytes_CheckExact(item)) {
             Py_ssize_t ilen = PyBytes_GET_SIZE(item);
             Py_ssize_t n = ilen < klen ? ilen : klen;
             int c = memcmp(PyBytes_AS_STRING(item), kbuf, (size_t)n);
@@ -1194,7 +1172,65 @@ static int insort_bytes(PyObject *list, PyObject *key)
         else
             hi = mid;
     }
+    return lo;
+}
+
+/* ascending-bytes insort (Transaction._sorted_writes invariant) */
+static int insort_bytes(PyObject *list, PyObject *key)
+{
+    Py_ssize_t lo = bisect_left_bytes(list, key);
+    if (lo < 0)
+        return -1;
     return PyList_Insert(list, lo, key);
+}
+
+/* commit_overlay(writes, data, sorted_keys, deleted):
+ * Transaction.commit's apply loop, natively — for each (key, val) in the
+ * overlay dict: a deleted-sentinel val removes the key from the committed
+ * dict and its sorted-keys list; any other val upserts (insort on first
+ * insert). Mirrors ZbDb._put_committed/_delete_committed exactly. */
+static PyObject *codec_commit_overlay(PyObject *self, PyObject *args)
+{
+    PyObject *writes, *data, *sorted_keys, *deleted;
+    if (!PyArg_ParseTuple(args, "OOOO", &writes, &data, &sorted_keys, &deleted))
+        return NULL;
+    if (!PyDict_CheckExact(writes) || !PyDict_CheckExact(data)
+        || !PyList_CheckExact(sorted_keys)) {
+        PyErr_SetString(PyExc_TypeError,
+                        "commit_overlay(dict, dict, list, obj) expected");
+        return NULL;
+    }
+    PyObject *key, *val;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(writes, &pos, &key, &val)) {
+        int present = PyDict_Contains(data, key);
+        if (present < 0)
+            return NULL;
+        if (val == deleted) {
+            if (!present)
+                continue;
+            if (PyDict_DelItem(data, key) < 0)
+                return NULL;
+            /* locate the key in the sorted list (bisect_left + equality) */
+            Py_ssize_t lo = bisect_left_bytes(sorted_keys, key);
+            if (lo < 0)
+                return NULL;
+            if (lo < PyList_GET_SIZE(sorted_keys)) {
+                int eq = PyObject_RichCompareBool(
+                    PyList_GET_ITEM(sorted_keys, lo), key, Py_EQ);
+                if (eq < 0)
+                    return NULL;
+                if (eq && PySequence_DelItem(sorted_keys, lo) < 0)
+                    return NULL;
+            }
+        } else {
+            if (!present && insort_bytes(sorted_keys, key) < 0)
+                return NULL;
+            if (PyDict_SetItem(data, key, val) < 0)
+                return NULL;
+        }
+    }
+    Py_RETURN_NONE;
 }
 
 static PyObject *codec_apply_state_plan(PyObject *self, PyObject *args)
@@ -1344,6 +1380,8 @@ static PyMethodDef codec_methods[] = {
      "scan_batch_headers keeping only entries matching (record_type, value_type, intent)."},
     {"apply_state_plan", codec_apply_state_plan, METH_VARARGS,
      "Apply a compiled burst-template state plan to a transaction overlay."},
+    {"commit_overlay", codec_commit_overlay, METH_VARARGS,
+     "Apply a transaction overlay dict to the committed store (dict + sorted keys)."},
     {"set_error_class", codec_set_error_class, METH_O, "Register the exception class raised on malformed input."},
     {NULL, NULL, 0, NULL},
 };

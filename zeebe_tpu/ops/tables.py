@@ -232,6 +232,11 @@ class ProcessTables:
     interner: StringInterner = dataclasses.field(default_factory=StringInterner)
     job_type_names: list[str] = dataclasses.field(default_factory=list)
     definitions: list[ExecutableProcess] = dataclasses.field(default_factory=list)
+    # static bound on live tokens per instance, max over the set's
+    # definitions; 0 = no sound bound (a parallel split on a cycle can
+    # multiply tokens per iteration) — callers then size the token pool
+    # with the legacy 4x safety factor
+    token_width: int = 0
 
     @property
     def num_definitions(self) -> int:
@@ -263,6 +268,54 @@ class KernelConfig:
     has_joins: bool = True
     has_conditions: bool = True
     has_scopes: bool = True
+
+
+def _live_token_width(exe: ExecutableProcess) -> int | None:
+    """Sound static bound on concurrently live device tokens per instance of
+    ``exe``: 1, plus (fanout-1) per parallel split, plus 1 per sub-process
+    scope (the parked scope token coexists with its inner token). Additive,
+    so nesting is covered.
+
+    The per-element +1 assumes at most one concurrent activation of each
+    element, which only holds when concurrency is structured. So the bound
+    is claimed (non-None) only when, in the presence of parallel splits,
+    every convergent element (incoming > 1) is a parallel join — an XOR
+    merge downstream of a split can funnel two live tokens through one
+    element (twice-activated sub-process / split), breaking the additive
+    count. A parallel split on a cycle can mint tokens every iteration, so
+    that also yields None. The kernel falls back to the 4x pool on None; an
+    undersized pool would only cost a fallback (overflow is detected), but
+    fallbacks re-run the whole group sequentially, so the bound must hold."""
+    targets_of: dict[int, list[int]] = {}
+    splits: list[ExecutableElement] = []
+    for el in exe.elements:
+        targets_of[el.idx] = [exe.flows[f].target_idx for f in el.outgoing]
+        if (el.element_type == BpmnElementType.PARALLEL_GATEWAY
+                and len(el.outgoing) > 1):
+            splits.append(el)
+    if splits:
+        for el in exe.elements:
+            if (el.incoming_count > 1
+                    and el.element_type != BpmnElementType.PARALLEL_GATEWAY):
+                return None  # unstructured convergence: element may run twice
+    width = 1
+    for el in exe.elements:
+        if el.element_type == BpmnElementType.SUB_PROCESS:
+            width += 1
+    for el in splits:
+        # cycle check: DFS from the split's targets back to the split
+        seen: set[int] = set()
+        stack = list(targets_of[el.idx])
+        while stack:
+            n = stack.pop()
+            if n == el.idx:
+                return None
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(targets_of.get(n, ()))
+        width += len(el.outgoing) - 1
+    return width
 
 
 def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = None,
@@ -453,4 +506,10 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
         interner=interner,
         job_type_names=list(job_types),
         definitions=list(processes),
+        token_width=_set_token_width(processes),
     )
+
+
+def _set_token_width(processes: list[ExecutableProcess]) -> int:
+    widths = [_live_token_width(p) for p in processes]
+    return 0 if None in widths else max(widths, default=1)

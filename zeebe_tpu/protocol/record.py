@@ -131,10 +131,12 @@ class Record:
     def to_bytes(self) -> bytes:
         return self.encode()[0]
 
-    def encode(self) -> tuple[bytes, bytes]:
+    def encode(self, timestamp: int | None = None) -> tuple[bytes, bytes]:
         """Serialize; returns (frame, value_body) — the msgpack value bytes
         are exposed so the append path can seed its decode cache without
-        re-packing the value."""
+        re-packing the value. ``timestamp`` (when given) is packed instead of
+        ``self.timestamp`` — the append path stamps one batch timestamp, and
+        passing it here avoids a per-record replace()."""
         reason = self.rejection_reason.encode("utf-8")
         if len(reason) > 0xFFFF:
             # the wire field is u16; truncate on a codepoint boundary so an
@@ -144,7 +146,8 @@ class Record:
                 reason = reason[:-1]
             if reason and reason[-1] >= 0xC0:  # dangling lead byte
                 reason = reason[:-1]
-        body = msgpack.packb(dict(self.value))
+        value = self.value
+        body = msgpack.packb(value if type(value) is dict else dict(value))
         header = _HEADER.pack(
             int(self.record_type),
             int(self.value_type),
@@ -152,7 +155,7 @@ class Record:
             int(self.rejection_type),
             self.key,
             self.source_record_position,
-            self.timestamp,
+            self.timestamp if timestamp is None else timestamp,
             self.request_stream_id,
             self.request_id,
             self.operation_reference,

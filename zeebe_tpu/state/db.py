@@ -28,7 +28,10 @@ import zlib
 from bisect import bisect_left, insort
 from typing import Any, Callable, Iterator
 
+from zeebe_tpu.native import codec_fn as _codec_fn
 from zeebe_tpu.protocol import msgpack
+
+_commit_overlay = _codec_fn("commit_overlay")
 
 
 class ZbDbInconsistentError(Exception):
@@ -315,11 +318,17 @@ class Transaction:
 
     def commit(self) -> None:
         db = self._db
-        for key, val in self._writes.items():
-            if val is _DELETED:
-                db._delete_committed(key)
-            else:
-                db._put_committed(key, val)
+        if _commit_overlay is not None:
+            # one native pass (codec.c commit_overlay) applying the overlay
+            # to the committed dict + sorted-keys list — identical semantics
+            # to the per-key loop below
+            _commit_overlay(self._writes, db._data, db._sorted_keys, _DELETED)
+        else:
+            for key, val in self._writes.items():
+                if val is _DELETED:
+                    db._delete_committed(key)
+                else:
+                    db._put_committed(key, val)
         self._writes.clear()
         self.closed = True
 
