@@ -183,16 +183,51 @@ class TestMeshBackedPartition:
 
 class TestRunnerUnit:
     def test_groups_by_tables_fingerprint(self):
-        # different table sets must not share a dispatch; same sets must
+        # genuinely different table sets (different job type names reach the
+        # digest via job_type_names) must not share a dispatch
         runner = MeshKernelRunner(n_shards=8)
         h1 = EngineHarness(use_kernel_backend=True, mesh_runner=runner)
         h2 = EngineHarness(use_kernel_backend=True, mesh_runner=runner)
         try:
             h1.deploy(one_task("pa"))
-            h2.deploy(one_task("pb"))  # different process id → different tables
+            b = (Bpmn.create_executable_process("pb").start_event("start")
+                 .service_task("task", job_type="other_work")
+                 .end_event("end").done())
+            h2.deploy(b)
             h1.create_instance("pa")
             h2.create_instance("pb")
+            # registries are populated now; the digests must differ (the
+            # job type name reaches the content hash)
+            fp1 = h1.kernel_backend.registry.tables_fingerprint
+            fp2 = h2.kernel_backend.registry.tables_fingerprint
+            assert fp1 != fp2
             assert runner.dispatches >= 2  # fingerprints differ → no sharing
+        finally:
+            h1.close()
+            h2.close()
+
+    def test_content_equal_tables_fingerprint_across_partitions(self):
+        # partitions that deployed the SAME resources (different minted keys
+        # — each partition's keys carry its id in the high bits) must still
+        # fingerprint equal: the digest is content-based, which is what lets
+        # independently-applied distributed deployments coalesce (VERDICT r3
+        # item 2; reference: deployment distribution applies identical
+        # resources on every partition)
+        h1 = EngineHarness(use_kernel_backend=True)
+        h2 = EngineHarness(use_kernel_backend=True, partition_id=2)
+        try:
+            h1.deploy(one_task("pa"))
+            h2.deploy(one_task("pa"))
+            h1.create_instance("pa")
+            h2.create_instance("pa")
+            fp1 = h1.kernel_backend.registry.tables_fingerprint
+            fp2 = h2.kernel_backend.registry.tables_fingerprint
+            assert fp1 == fp2
+            # ...and the minted definition keys really did differ (the
+            # content digest, not key identity, is what matched)
+            k1 = next(iter(h1.kernel_backend.registry._by_key))
+            k2 = next(iter(h2.kernel_backend.registry._by_key))
+            assert k1 != k2
         finally:
             h1.close()
             h2.close()

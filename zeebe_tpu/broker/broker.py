@@ -47,9 +47,35 @@ class BrokerCfg:
     kernel_backend: bool = True
     # > 0: the partitions' kernel groups run as shards of ONE device mesh
     # (parallel/mesh_runner.py) — partition = shard of the device batch.
+    # -1 (default) = auto: shard over jax.devices() when more than one
+    # device is attached, single-device otherwise. 0 = explicitly off.
     # A shared MeshKernelRunner may also be injected by the hosting runtime
     # (ClusterRuntime) so in-process brokers share a single mesh.
-    kernel_mesh_shards: int = 0
+    kernel_mesh_shards: int = -1
+
+
+_AUTO_DEVICE_COUNT: int | None = None
+
+
+def _auto_device_count() -> int:
+    """Device count for kernel_mesh_shards auto mode, resolved ONCE per
+    process. When the platform is already pinned to cpu (tests, drive
+    scripts) the in-process query is safe; otherwise the default backend is
+    probed in a killable subprocess — on this host class a wedged TPU
+    tunnel can hang jax.devices() forever (see utils/backend_probe.py), and
+    broker startup must never block on it. Probe failure = 0 (no mesh)."""
+    global _AUTO_DEVICE_COUNT
+    if _AUTO_DEVICE_COUNT is None:
+        import jax
+
+        if str(jax.config.jax_platforms or "").startswith("cpu"):
+            _AUTO_DEVICE_COUNT = len(jax.devices())
+        else:
+            from zeebe_tpu.utils.backend_probe import probe_default_backend
+
+            probed = probe_default_backend()
+            _AUTO_DEVICE_COUNT = 0 if probed is None else probed[1]
+    return _AUTO_DEVICE_COUNT
 
 
 def partition_distribution(cfg: BrokerCfg) -> dict[int, list[str]]:
@@ -280,11 +306,19 @@ class Broker:
             return None  # the kernel backend is the runner's only consumer
         if self._injected_mesh_runner is not None:
             return self._injected_mesh_runner
-        if self.cfg.kernel_mesh_shards > 0 and self._owned_mesh_runner is None:
+        shards = self.cfg.kernel_mesh_shards
+        if shards < 0:
+            # auto: shard over the attached devices, capped at the broker's
+            # partition count (extra shards would be permanent dummy-block
+            # padding and larger per-chunk transfers); below 2 the direct
+            # single-device dispatch path wins (no runner indirection)
+            shards = min(_auto_device_count(), self.cfg.partition_count)
+            if shards < 2:
+                shards = 0
+        if shards > 0 and self._owned_mesh_runner is None:
             from zeebe_tpu.parallel.mesh_runner import MeshKernelRunner
 
-            self._owned_mesh_runner = MeshKernelRunner(
-                n_shards=self.cfg.kernel_mesh_shards)
+            self._owned_mesh_runner = MeshKernelRunner(n_shards=shards)
         return self._owned_mesh_runner
 
     def _create_partition(self, partition_id: int, members: list[str],

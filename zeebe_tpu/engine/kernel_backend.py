@@ -344,6 +344,7 @@ class KernelRegistry:
         self._infos: list[_DefInfo] = []
         self._tables: ProcessTables | None = None
         self._device = None
+        self._tables_fp: tuple | None = None  # (tables identity, digest)
 
     def lookup(self, definition_key: int, exe: ExecutableProcess | None) -> _DefInfo | None:
         info = self._by_key.get(definition_key)
@@ -459,14 +460,37 @@ class KernelRegistry:
         return self._device
 
     @property
-    def tables_fingerprint(self) -> tuple:
-        """Identity of the compiled table set ACROSS partitions: two
-        registries that registered the same definitions (same keys, order,
-        and host lowerings) compile identical tables, so their groups may
-        share one mesh dispatch (the sharded program takes one replicated
-        DeviceTables argument). Deployment distribution applies deployments
-        in the same order on every partition, so this matches in practice."""
-        return tuple((i.key, i.index, i.host_idxs) for i in self._infos)
+    def tables_fingerprint(self) -> str:
+        """Identity of the compiled table set ACROSS partitions — a CONTENT
+        digest of everything that shapes the sharded device program (table
+        arrays, slot/interner assignments incl. order, job types): two
+        partitions whose groups carry equal digests behave identically under
+        the lead shard's replicated DeviceTables, so they may share one mesh
+        dispatch. Content-based (not definition-key-based) so independently
+        deployed copies of the same definitions coalesce too — the common
+        case, since deployment distribution applies the same resources in
+        the same order on every partition."""
+        tables = self.tables
+        fp = self._tables_fp
+        if fp is None or fp[0] is not tables:
+            import hashlib
+
+            h = hashlib.sha256()
+            for arr in (tables.kernel_op, tables.in_count, tables.job_type,
+                        tables.out_count, tables.out_target, tables.out_cond,
+                        tables.out_flow_idx, tables.default_slot,
+                        tables.start_elem, tables.elem_count,
+                        tables.scope_start, tables.in_scope,
+                        tables.cond_ops, tables.cond_args):
+                h.update(arr.tobytes())
+            h.update(repr(tables.job_type_names).encode())
+            h.update(repr(list(tables.slot_map.names.items())).encode())
+            h.update(repr(sorted(tables.slot_map.kinds.items())).encode())
+            h.update(repr(list(tables.interner.ids.items())).encode())
+            h.update(repr([sorted(v) for v in tables.cond_vars_by_def]).encode())
+            fp = (tables, h.hexdigest())
+            self._tables_fp = fp
+        return fp[1]
 
 
 @dataclass
