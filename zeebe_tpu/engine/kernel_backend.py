@@ -344,6 +344,7 @@ class KernelRegistry:
         self._infos: list[_DefInfo] = []
         self._tables: ProcessTables | None = None
         self._device = None
+        self._device_by_dev: dict = {}  # router-chosen backend → DeviceTables
         self._tables_fp: tuple | None = None  # (tables identity, digest)
 
     def lookup(self, definition_key: int, exe: ExecutableProcess | None) -> _DefInfo | None:
@@ -437,6 +438,7 @@ class KernelRegistry:
             self._tables = None  # previous set recompiles lazily
             return None
         self._device = None
+        self._device_by_dev.clear()
         return info
 
     def _compile_shared(self) -> ProcessTables:
@@ -459,6 +461,22 @@ class KernelRegistry:
             self._device = DeviceTables.from_tables(self.tables)
         return self._device
 
+    def device_tables_for(self, device):
+        """Device tables committed to ``device`` (router-chosen backend).
+        ``None`` = the process default device (the plain property)."""
+        if device is None:
+            return self.device_tables
+        cached = self._device_by_dev.get(device)
+        if cached is None:
+            import jax
+
+            from zeebe_tpu.ops.automaton import DeviceTables
+
+            with jax.default_device(device):
+                cached = DeviceTables.from_tables(self.tables)
+            self._device_by_dev[device] = cached
+        return cached
+
     @property
     def tables_fingerprint(self) -> str:
         """Identity of the compiled table set ACROSS partitions — a CONTENT
@@ -476,12 +494,19 @@ class KernelRegistry:
             import hashlib
 
             h = hashlib.sha256()
-            for arr in (tables.kernel_op, tables.in_count, tables.job_type,
-                        tables.out_count, tables.out_target, tables.out_cond,
-                        tables.out_flow_idx, tables.default_slot,
-                        tables.start_elem, tables.elem_count,
-                        tables.scope_start, tables.in_scope,
-                        tables.cond_ops, tables.cond_args):
+            for tag, arr in (("op", tables.kernel_op), ("ic", tables.in_count),
+                             ("jt", tables.job_type), ("oc", tables.out_count),
+                             ("ot", tables.out_target), ("oco", tables.out_cond),
+                             ("ofi", tables.out_flow_idx),
+                             ("ds", tables.default_slot),
+                             ("se", tables.start_elem), ("ec", tables.elem_count),
+                             ("ss", tables.scope_start), ("is", tables.in_scope),
+                             ("cop", tables.cond_ops), ("ca", tables.cond_args)):
+                # field tag + shape + dtype delimit each array: without them
+                # raw byte streams could alias across array boundaries and two
+                # different table sets could digest equal — and this digest
+                # alone gates mesh-dispatch coalescing
+                h.update(f"{tag}:{arr.shape}:{arr.dtype}".encode())
                 h.update(arr.tobytes())
             h.update(repr(tables.job_type_names).encode())
             h.update(repr(list(tables.slot_map.names.items())).encode())
@@ -557,12 +582,25 @@ class KernelBackend:
                  chunk_steps: int = 8, use_templates: bool = True,
                  audit_templates: bool = False,
                  max_commands_in_batch: int = 100,
-                 mesh_runner=None) -> None:
+                 mesh_runner=None, router="shared") -> None:
         self.engine = engine
         self.registry = KernelRegistry()
         self.max_group = max_group
         self.max_steps = max_steps
         self.chunk_steps = chunk_steps
+        # link-aware backend routing (utils/device_link.py): each group runs
+        # on the accelerator only when the measured host↔device link
+        # amortizes; behind a slow tunnel groups ride the host XLA backend
+        # (the identical program). "shared" = the process-wide router.
+        if router == "shared":
+            from zeebe_tpu.utils.device_link import shared_router
+
+            router = shared_router()
+        self.router = router
+        # (bucket, device) pairs already executed once by THIS backend — the
+        # first run's wall time includes XLA compilation and is excluded from
+        # the router's steady-state cost model
+        self._runs_seen: set = set()
         # shared MeshKernelRunner (parallel/mesh_runner.py): when set, this
         # partition's groups run as shards of ONE mesh dispatch, coalescing
         # with other partitions' concurrently submitted groups
@@ -1078,11 +1116,9 @@ class KernelBackend:
         """Build the group batch, step to quiescence, return per-step host
         events (None → caller must fall back). With a mesh runner configured
         the group runs as one shard of a mesh dispatch (possibly coalesced
-        with other partitions' groups); otherwise on the default device."""
+        with other partitions' groups); otherwise on the router-chosen
+        backend (utils/device_link.py)."""
         import jax
-        import jax.numpy as jnp
-
-        from zeebe_tpu.ops.automaton import run_collect, unpack_events
 
         built = self._build_group_arrays(admitted)
         if built is None:
@@ -1111,6 +1147,38 @@ class KernelBackend:
                 return None
             return result.steps
 
+        import contextlib
+        import time as _time
+
+        # link-aware backend choice: the identical program, on the device
+        # where (link + compute) is cheapest for this shape bucket. The
+        # bucket carries the table-set CONTENT digest: different deployed
+        # sets are different programs with different compute costs (and
+        # compiles), and the digest — unlike id() — cannot alias a reused
+        # allocation after a redeploy recompile, and lets partitions with
+        # equal sets share cost observations through the shared router.
+        bucket = (self.registry.tables_fingerprint, I, T)
+        dev = self.router.choose(bucket) if self.router is not None else None
+        ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
+        t_group = _time.perf_counter()
+        with ctx:
+            steps = self._run_group_on_device(arrays, I, T, tables, dev)
+        if self.router is not None and dev is not None and steps is not None:
+            # failed runs (non-quiescence, pool overflow) fall back to the
+            # sequential path; their pathological wall times say nothing
+            # about the backend's steady-state group cost
+            run_key = (bucket, dev)
+            self.router.record(bucket, dev, _time.perf_counter() - t_group,
+                               first_run=run_key not in self._runs_seen)
+            self._runs_seen.add(run_key)
+        return steps
+
+    def _run_group_on_device(self, arrays, I: int, T: int, tables, dev):
+        import jax
+        import jax.numpy as jnp
+
+        from zeebe_tpu.ops.automaton import run_collect, unpack_events
+
         elem = arrays["elem"]
         phase = arrays["phase"]
         inst_arr = arrays["inst"]
@@ -1133,12 +1201,12 @@ class KernelBackend:
             "overflow": jnp.zeros((), jnp.bool_),
         }
         config = tables.kernel_config
-        dt = self.registry.device_tables
+        dt = self.registry.device_tables_for(dev)
         # chunked device loop: one dispatch + ONE host transfer per chunk of
-        # lock-steps (vs two transfers per step) — over the TPU tunnel a
-        # transfer costs ~30ms, so this is the difference between ~2s and
-        # ~60ms per group. Quiesced states are fixed points of step(), so a
-        # chunk may harmlessly over-run past quiescence.
+        # lock-steps (vs two transfers per step). Quiesced states are fixed
+        # points of step(), so a chunk may harmlessly over-run past
+        # quiescence. (The router keeps this path off accelerators whose
+        # measured link floor would dominate the chunk fetches.)
         chunk = self.chunk_steps
         steps: list[dict] = []
         overflow = False

@@ -1,0 +1,198 @@
+"""Link-aware kernel dispatch routing.
+
+The automaton kernel is ONE XLA program; *where* a group of commands runs is
+a deployment decision dominated by the host↔accelerator link, not by the
+program. On a properly attached accelerator (PCIe/ICI) a transfer costs
+microseconds and any serving-sized group amortizes it; over a network tunnel
+(development attach, e.g. a remote TPU) every transfer pays a latency floor
+of tens to hundreds of milliseconds *regardless of size*, so the same group
+finishes orders of magnitude sooner on the host XLA backend (the identical
+program, compiled for CPU).
+
+Rather than hard-coding either assumption, the router MEASURES the link once
+(a tiny put+get round trip against the accelerator) and predicts each
+backend's per-group cost: accelerator = transfers × measured link floor
+(+ negligible compute), host = EMA of observed group wall times per shape
+bucket. Each group routes to the cheaper backend, so a broker deployed next
+to its accelerator uses it and a broker behind a slow tunnel degrades
+gracefully — with the measurement exposed for observability instead of a
+silent assumption. (The reference pins engine work to CPU threads and has no
+analogue of accelerator placement; this router is the TPU-native design's
+answer to heterogeneous attach topologies.)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+__all__ = ["BackendRouter", "shared_router"]
+
+
+class BackendRouter:
+    """Chooses the execution device for one kernel group.
+
+    ``choose(bucket)`` returns the device to run on (or None = process
+    default, when routing is disabled because the default backend already IS
+    the host). ``record(bucket, device, seconds)`` feeds observed group wall
+    times back so the host-cost model tracks reality.
+    """
+
+    #: transfers per group on the accelerator path: the group arrays upload
+    #: (elem/phase/inst/def_of/var_slots/join_counts/done) plus the typical
+    #: two chunk fetches of the packed event tensor
+    UPLOADS_PER_GROUP = 7
+    FETCHES_PER_GROUP = 2
+    #: below this predicted link cost the accelerator is effectively local
+    #: and wins by default (host EMA not yet seated)
+    LOCAL_LINK_S = 2e-3
+    _EMA_ALPHA = 0.3
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._measured = False
+        self._accel = None
+        self._host = None
+        self.enabled = False
+        self.link_put_s: float | None = None
+        self.link_get_s: float | None = None
+        self._host_ema: dict[Any, float] = {}
+        self._accel_ema: dict[Any, float] = {}
+        self.host_groups = 0
+        self.accel_groups = 0
+
+    # -- link measurement ---------------------------------------------------
+
+    def _measure(self) -> None:
+        """Measure the accelerator link in a KILLABLE SUBPROCESS. The tunnel
+        hazard utils/backend_probe.py documents — first device use hanging
+        forever — applies to the measurement itself: an in-process
+        device_put against a wedged tunnel would block every partition
+        sharing this router. A timed-out or failed probe leaves routing
+        disabled (groups run on the process default device, the pre-router
+        behavior)."""
+        import jax
+
+        self._measured = True
+        try:
+            # devices() is safe iff the default backend is already up —
+            # every caller reaches the router from inside a kernel group,
+            # after the entry point's own backend probe-and-pin
+            accel = jax.devices()[0]
+            host = jax.devices("cpu")[0]
+        except Exception:  # noqa: BLE001 — no backend: routing stays off
+            return
+        self._accel = accel
+        self._host = host
+        if accel.platform == "cpu":
+            return  # default backend already the host: nothing to route
+        measured = _measure_link_subprocess()
+        if measured is None:
+            return
+        self.link_put_s, self.link_get_s = measured
+        self.enabled = True
+
+    def link_cost_s(self) -> float | None:
+        """Predicted accelerator link cost for one group (None = unmeasured)."""
+        if self.link_put_s is None or self.link_get_s is None:
+            return None
+        return (self.UPLOADS_PER_GROUP * self.link_put_s
+                + self.FETCHES_PER_GROUP * self.link_get_s)
+
+    # -- routing --------------------------------------------------------------
+
+    def choose(self, bucket: Any):
+        """Device for this group (None = process default device)."""
+        with self._lock:
+            if not self._measured:
+                self._measure()
+            if not self.enabled:
+                return None
+            link = self.link_cost_s()
+            host_ema = self._host_ema.get(bucket)
+            accel_total = link + self._accel_ema.get(bucket, 0.0)
+            if host_ema is None:
+                # un-seated host model: only an effectively-local accelerator
+                # skips the host trial run
+                return self._accel if accel_total < self.LOCAL_LINK_S else self._host
+            return self._accel if accel_total < host_ema else self._host
+
+    def record(self, bucket: Any, device, seconds: float,
+               first_run: bool = False) -> None:
+        """``first_run``: first execution of this (program, shape) on this
+        device — the observation includes XLA compilation, which is paid once
+        and must not poison the steady-state cost model."""
+        with self._lock:
+            if device is self._accel:
+                self.accel_groups += 1
+                ema = self._accel_ema
+                # observed accel time includes the link; keep the compute
+                # residue so repeat predictions track real runs
+                link = self.link_cost_s() or 0.0
+                seconds = max(0.0, seconds - link)
+            else:
+                self.host_groups += 1
+                ema = self._host_ema
+            if first_run:
+                return
+            prev = ema.get(bucket)
+            ema[bucket] = (seconds if prev is None
+                           else prev + self._EMA_ALPHA * (seconds - prev))
+
+    def stats(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "link_put_ms": None if self.link_put_s is None else round(1e3 * self.link_put_s, 2),
+            "link_get_ms": None if self.link_get_s is None else round(1e3 * self.link_get_s, 2),
+            "host_groups": self.host_groups,
+            "accel_groups": self.accel_groups,
+        }
+
+
+def _measure_link_subprocess(timeout: int = 120) -> tuple[float, float] | None:
+    """(put_s, get_s) link floor measured in a killable subprocess, or None
+    (wedged/failed probe). min-of-2 trials each way, tiny (8KB) payload — the
+    floor, not the bandwidth, is what dominates serving-sized groups."""
+    import subprocess
+    import sys
+
+    code = (
+        "import time, numpy as np, jax\n"
+        "d = jax.devices()[0]\n"
+        "probe = np.zeros(2048, np.int32)\n"
+        "puts, gets = [], []\n"
+        "for _ in range(2):\n"
+        "    t0 = time.perf_counter(); x = jax.device_put(probe, d); "
+        "jax.block_until_ready(x); puts.append(time.perf_counter() - t0)\n"
+        "    t0 = time.perf_counter(); jax.device_get(x); "
+        "gets.append(time.perf_counter() - t0)\n"
+        "print(min(puts), min(gets))\n"
+    )
+    try:
+        import os
+
+        proc = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout,
+            capture_output=True, text=True, env=dict(os.environ),
+        )
+        if proc.returncode != 0:
+            return None
+        put_s, get_s = (float(v) for v in proc.stdout.split()[-2:])
+        return put_s, get_s
+    except Exception:  # noqa: BLE001 — timeout/parse: routing stays off
+        return None
+
+
+_shared: BackendRouter | None = None
+_shared_lock = threading.Lock()
+
+
+def shared_router() -> BackendRouter:
+    """Process-wide router: the link measurement is paid once, shared by
+    every partition's kernel backend."""
+    global _shared
+    with _shared_lock:
+        if _shared is None:
+            _shared = BackendRouter()
+        return _shared
