@@ -589,9 +589,18 @@ class BpmnProcessor:
     # ------------------------------------------------- event subscriptions
 
     def _eval_duration_millis(self, expr, context) -> int:
+        from zeebe_tpu.feel.temporal import Duration, YearMonthDuration, temporal_add
+        from zeebe_tpu.feel.temporal import FeelDateTime
         from zeebe_tpu.utils import parse_duration_millis
 
         raw = expr.evaluate(context, self.clock_millis)
+        if isinstance(raw, Duration):
+            return raw.millis
+        if isinstance(raw, YearMonthDuration):
+            # calendar span: anchor at the current clock (P1M from Jan 31
+            # lands on Feb 28/29, not +30d)
+            now = FeelDateTime.from_epoch_millis(self.clock_millis())
+            return temporal_add(now, raw).epoch_millis - now.epoch_millis
         if isinstance(raw, (int, float)):
             return int(raw)
         return parse_duration_millis(str(raw))
@@ -605,6 +614,7 @@ class BpmnProcessor:
         )
 
         clock_free = True
+        absolute_due: int | None = None
         try:
             if catching.timer_duration is not None:
                 context = self.state.variables.collect(host_key)
@@ -612,23 +622,44 @@ class BpmnProcessor:
                 # a now()-referencing duration makes the due date NOT
                 # clock + constant — template captures must decline
                 clock_free = not catching.timer_duration.references_clock()
-            elif catching.timer_cycle:
-                # R<n>/<duration> cycle (non-interrupting repeating events)
+            elif catching.timer_date is not None:
+                # absolute due date (FEEL temporal or ISO string); the due
+                # date is a pure function of the variable context, so it is
+                # a sound template CONSTANT — unless the expression reads
+                # the clock, which poisons the burst
+                context = self.state.variables.collect(host_key)
+                absolute_due = _eval_date_millis(
+                    catching.timer_date, context, self.clock_millis
+                )
+                duration = 0
+                clock_free = not catching.timer_date.references_clock()
+            elif catching.timer_cycle is not None:
+                # R<n>/<duration> cycle (non-interrupting repeating events);
+                # the cycle itself is a FEEL expression (reference: timer
+                # definitions are expressions, Timer.java transform)
                 from zeebe_tpu.utils import parse_cycle
 
-                repetitions, duration = parse_cycle(catching.timer_cycle)
+                context = self.state.variables.collect(host_key)
+                cycle_text = catching.timer_cycle.evaluate(context, self.clock_millis)
+                repetitions, duration = parse_cycle(str(cycle_text))
                 interval = duration
+                clock_free = not catching.timer_cycle.references_clock()
             else:
                 raise ValueError(f"timer '{catching.id}' has no duration or cycle")
         except Exception as exc:  # noqa: BLE001 — bad timer → incident
             self._raise_incident(writers, host_key, value, ErrorType.EXTRACT_VALUE_ERROR, str(exc))
             return
         timer_key = self.state.next_key()
-        due_date = self.clock_millis() + duration
-        if clock_free:
-            note_clock_value(due_date, duration)
+        if absolute_due is not None:
+            due_date = absolute_due
+            if not clock_free:
+                note_clock_poison()
         else:
-            note_clock_poison()
+            due_date = self.clock_millis() + duration
+            if clock_free:
+                note_clock_value(due_date, duration)
+            else:
+                note_clock_poison()
         writers.append_event(
             timer_key, ValueType.TIMER, TimerIntent.CREATED,
             {
@@ -699,7 +730,11 @@ class BpmnProcessor:
                                      writers: Writers) -> None:
         for bidx in host.boundary_idxs:
             boundary = exe.elements[bidx]
-            if boundary.event_type == BpmnEventType.TIMER and boundary.timer_duration is not None:
+            if boundary.event_type == BpmnEventType.TIMER and (
+                boundary.timer_duration is not None
+                or boundary.timer_cycle is not None
+                or boundary.timer_date is not None
+            ):
                 reps = 1 if boundary.interrupting else -1
                 self._create_timer(host_key, value, boundary, host, writers, repetitions=reps)
             elif boundary.event_type == BpmnEventType.MESSAGE and boundary.message_name:
@@ -740,7 +775,9 @@ class BpmnProcessor:
         for esp in esps:
             start = exe.elements[esp.child_start_idx]
             if start.event_type == BpmnEventType.TIMER and (
-                start.timer_duration is not None or start.timer_cycle
+                start.timer_duration is not None
+                or start.timer_cycle is not None
+                or start.timer_date is not None
             ):
                 reps = 1 if start.interrupting else -1
                 self._create_timer(key, value, start, element, writers, repetitions=reps)
@@ -1452,6 +1489,9 @@ class BpmnProcessor:
     def _write_variable(
         self, writers: Writers, scope_key: int, pi_value: dict, name: str, result: Any
     ) -> None:
+        from zeebe_tpu.feel.temporal import normalize_value
+
+        result = normalize_value(result)
         exists = self.state.variables.has_local(scope_key, name)
         var_key = self.state.next_key()
         writers.append_event(
@@ -1466,6 +1506,29 @@ class BpmnProcessor:
                 "bpmnProcessId": pi_value.get("bpmnProcessId", ""),
             },
         )
+
+
+def _eval_date_millis(expr, context, clock_millis) -> int:
+    """Evaluate a timer timeDate expression → absolute epoch millis.
+    Accepts FEEL date-and-time / date values, ISO-8601 strings, or raw
+    epoch millis (reference: timer timeDate is evaluated via FEEL to a
+    zoned date-time)."""
+    from zeebe_tpu.feel.temporal import (
+        FeelDate,
+        FeelDateTime,
+        parse_date_time,
+    )
+
+    raw = expr.evaluate(context, clock_millis)
+    if isinstance(raw, FeelDateTime):
+        return raw.epoch_millis
+    if isinstance(raw, FeelDate):
+        return parse_date_time(str(raw)).epoch_millis
+    if isinstance(raw, (int, float)) and not isinstance(raw, bool):
+        return int(raw)
+    if isinstance(raw, str):
+        return parse_date_time(raw).epoch_millis
+    raise ValueError(f"timer date evaluated to {type(raw).__name__}")
 
 
 def _pi_value(value: dict, element: ExecutableElement) -> dict:

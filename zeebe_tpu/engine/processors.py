@@ -460,12 +460,31 @@ def register_start_subscriptions(state, clock_millis, writers, exe, meta,
                            if meta.get("tenantId", DEFAULT_TENANT) != DEFAULT_TENANT else {}),
                     },
                 )
-            elif el.event_type == BpmnEventType.TIMER and el.timer_cycle and include_timers:
-                reps, interval = parse_cycle(el.timer_cycle)
-                from zeebe_tpu.engine.burst_templates import note_clock_value
+            elif el.event_type == BpmnEventType.TIMER and include_timers and (
+                el.timer_cycle is not None or el.timer_date is not None
+            ):
+                from zeebe_tpu.engine.burst_templates import (
+                    note_clock_poison,
+                    note_clock_value,
+                )
 
-                due_date = clock_millis() + interval
-                note_clock_value(due_date, interval)
+                if el.timer_cycle is not None:
+                    # cycle expressions evaluate against an empty context at
+                    # deploy time (no instance exists yet)
+                    cycle_text = el.timer_cycle.evaluate({}, clock_millis)
+                    reps, interval = parse_cycle(str(cycle_text))
+                    due_date = clock_millis() + interval
+                    if el.timer_cycle.references_clock():
+                        note_clock_poison()
+                    else:
+                        note_clock_value(due_date, interval)
+                else:
+                    from zeebe_tpu.engine.bpmn import _eval_date_millis
+
+                    reps, interval = 1, 0
+                    due_date = _eval_date_millis(el.timer_date, {}, clock_millis)
+                    if el.timer_date.references_clock():
+                        note_clock_poison()
                 writers.append_event(
                     state.next_key(), ValueType.TIMER, TimerIntent.CREATED,
                     {

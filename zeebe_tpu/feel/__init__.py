@@ -9,13 +9,29 @@ from zeebe_tpu.feel.feel import (
     parse_expression,
     parse_feel,
 )
+from zeebe_tpu.feel.temporal import (
+    Duration,
+    FeelDate,
+    FeelDateTime,
+    FeelTime,
+    TemporalParseError,
+    YearMonthDuration,
+    normalize_value,
+)
 
 __all__ = [
+    "Duration",
     "Evaluator",
     "Expression",
+    "FeelDate",
+    "FeelDateTime",
     "FeelError",
     "FeelEvalError",
     "FeelParseError",
+    "FeelTime",
+    "TemporalParseError",
+    "YearMonthDuration",
+    "normalize_value",
     "parse_expression",
     "parse_feel",
 ]

@@ -16,6 +16,10 @@ workloads use (S-FEEL + common extensions):
   ends with(), upper case(), lower case(), count(), sum(), min(), max(),
   floor(), ceiling(), abs(), modulo(), not(), is defined(), string length(),
   append(), list contains(), now() (from an injected clock)
+- temporal types (zeebe_tpu.feel.temporal): @"…" literals, date(), time(),
+  date and time(), duration(), years and months duration(), now()/today(),
+  day of week()/day of year()/month of year()/week of year(), calendar
+  arithmetic and comparisons, component properties (d.year, t.hour, …)
 
 Expressions come in two forms (reference semantics): a plain attribute value is
 a *static* string; a value starting with ``=`` is a FEEL expression. Parsing
@@ -32,6 +36,16 @@ import dataclasses
 import math
 import re
 from typing import Any, Callable
+
+from zeebe_tpu.feel import temporal as _temporal
+from zeebe_tpu.feel.temporal import (
+    Duration,
+    FeelDate,
+    FeelDateTime,
+    FeelTime,
+    TemporalParseError,
+    YearMonthDuration,
+)
 
 # ---------------------------------------------------------------------------
 # AST
@@ -117,14 +131,22 @@ _TOKEN_RE = re.compile(
     (?P<ws>\s+)
   | (?P<number>\d+(?:\.\d+)?)
   | (?P<string>"(?:[^"\\]|\\.)*")
-  | (?P<op><=|>=|!=|\.\.|[=<>+\-*/(),\[\]{}.:])
+  | (?P<op><=|>=|!=|\.\.|[=<>+\-*/(),\[\]{}.:@])
   | (?P<name>[A-Za-z_][A-Za-z_0-9]*)
     """,
     re.VERBOSE,
 )
 
-# multi-word builtin names (FEEL allows spaces in function names)
+# multi-word builtin names (FEEL allows spaces in function names);
+# fused longest-match-first over consecutive name tokens
 _MULTIWORD = {
+    ("years", "and", "months", "duration"): "years and months duration",
+    ("date", "and", "time"): "date and time",
+    ("day", "of", "week"): "day of week",
+    ("day", "of", "year"): "day of year",
+    ("month", "of", "year"): "month of year",
+    ("week", "of", "year"): "week of year",
+    ("time", "offset"): "time offset",
     ("starts", "with"): "starts with",
     ("ends", "with"): "ends with",
     ("upper", "case"): "upper case",
@@ -133,6 +155,7 @@ _MULTIWORD = {
     ("string", "length"): "string length",
     ("list", "contains"): "list contains",
 }
+_MULTIWORD_MAX = max(len(k) for k in _MULTIWORD)
 
 _KEYWORDS = {"if", "then", "else", "and", "or", "true", "false", "null", "in", "not"}
 
@@ -150,19 +173,24 @@ def _tokenize(src: str) -> list[tuple[str, str]]:
             continue
         text = m.group()
         tokens.append((kind, text))
-    # fuse multi-word names
+    # fuse multi-word names (longest match first)
     fused: list[tuple[str, str]] = []
     i = 0
     while i < len(tokens):
-        if (
-            i + 1 < len(tokens)
-            and tokens[i][0] == "name"
-            and tokens[i + 1][0] == "name"
-            and (tokens[i][1], tokens[i + 1][1]) in _MULTIWORD
-        ):
-            fused.append(("name", _MULTIWORD[(tokens[i][1], tokens[i + 1][1])]))
-            i += 2
-        else:
+        matched = False
+        if tokens[i][0] == "name":
+            for width in range(_MULTIWORD_MAX, 1, -1):
+                if i + width > len(tokens):
+                    continue
+                window = tokens[i : i + width]
+                if all(t[0] == "name" for t in window):
+                    key = tuple(t[1] for t in window)
+                    if key in _MULTIWORD:
+                        fused.append(("name", _MULTIWORD[key]))
+                        i += width
+                        matched = True
+                        break
+        if not matched:
             fused.append(tokens[i])
             i += 1
     return fused
@@ -316,6 +344,14 @@ class _Parser:
             return Lit(value)
         if kind == "string":
             return Lit(_unescape(text[1:-1]))
+        if text == "@":
+            kind2, text2 = self.next()
+            if kind2 != "string":
+                raise FeelParseError(f"expected string after '@' in {self.src!r}")
+            try:
+                return Lit(_temporal.parse_temporal_literal(_unescape(text2[1:-1])))
+            except TemporalParseError as exc:
+                raise FeelParseError(f"bad temporal literal in {self.src!r}: {exc}")
         if text == "(":
             node = self.expr()
             self.expect(")")
@@ -401,13 +437,120 @@ _BUILTINS: dict[str, Callable[..., Any]] = {
     "max": lambda *xs: max(xs[0] if len(xs) == 1 and isinstance(xs[0], list) else xs),
     "floor": lambda v: math.floor(_num(v)),
     "ceiling": lambda v: math.ceil(_num(v)),
-    "abs": lambda v: abs(_num(v)),
+    "abs": lambda v: abs(v) if isinstance(v, (Duration, YearMonthDuration)) else abs(_num(v)),
     "modulo": lambda a, b: _num(a) % _num(b),
     "sqrt": lambda v: math.sqrt(_num(v)),
     "not": lambda v: (not v) if isinstance(v, bool) else None,
     "append": lambda xs, *vs: list(xs) + list(vs),
     "list contains": lambda xs, v: v in xs,
+    "date": lambda *a: _builtin_date(*a),
+    "time": lambda *a: _builtin_time(*a),
+    "date and time": lambda *a: _builtin_date_time(*a),
+    "duration": lambda s: _null_on_temporal_error(_temporal.parse_duration, s)
+    if isinstance(s, str) else (s if isinstance(s, (Duration, YearMonthDuration)) else None),
+    "years and months duration": lambda a, b: _builtin_ym_duration(a, b),
+    "day of week": lambda v: _WEEKDAY_NAMES[v.weekday - 1]
+    if isinstance(v, (FeelDate, FeelDateTime)) else None,
+    "day of year": lambda v: (v.d if isinstance(v, FeelDate) else v.dt).timetuple().tm_yday
+    if isinstance(v, (FeelDate, FeelDateTime)) else None,
+    "month of year": lambda v: _MONTH_NAMES[v.month - 1]
+    if isinstance(v, (FeelDate, FeelDateTime)) else None,
+    "week of year": lambda v: (v.d if isinstance(v, FeelDate) else v.dt).isocalendar()[1]
+    if isinstance(v, (FeelDate, FeelDateTime)) else None,
 }
+
+_WEEKDAY_NAMES = ("Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+                  "Saturday", "Sunday")
+_MONTH_NAMES = ("January", "February", "March", "April", "May", "June", "July",
+                "August", "September", "October", "November", "December")
+
+
+def _null_on_temporal_error(fn, *args):
+    """camunda-feel returns null (with a warning) when a temporal constructor
+    cannot parse its input; invalid input must not fail the expression."""
+    try:
+        return fn(*args)
+    except TemporalParseError:
+        return None
+
+
+def _builtin_date(*args):
+    if len(args) == 3:
+        try:
+            import datetime as _dt
+
+            return FeelDate(_dt.date(int(args[0]), int(args[1]), int(args[2])))
+        except (ValueError, TypeError):
+            return None
+    (v,) = args
+    if isinstance(v, str):
+        return _null_on_temporal_error(_temporal.parse_date, v)
+    if isinstance(v, FeelDateTime):
+        return v.date()
+    if isinstance(v, FeelDate):
+        return v
+    return None
+
+
+def _builtin_time(*args):
+    import datetime as _dt
+
+    if len(args) in (3, 4):
+        try:
+            tz = None
+            if len(args) == 4 and isinstance(args[3], Duration):
+                tz = _dt.timezone(_dt.timedelta(milliseconds=args[3].millis))
+            sec = float(args[2])
+            micros = int(round((sec - int(sec)) * 1e6))
+            return FeelTime(_dt.time(int(args[0]), int(args[1]), int(sec), micros, tzinfo=tz))
+        except (ValueError, TypeError):
+            return None
+    (v,) = args
+    if isinstance(v, str):
+        return _null_on_temporal_error(_temporal.parse_time, v)
+    if isinstance(v, FeelDateTime):
+        return v.time()
+    if isinstance(v, FeelTime):
+        return v
+    return None
+
+
+def _builtin_date_time(*args):
+    import datetime as _dt
+
+    if len(args) == 2:
+        date_part, time_part = args
+        if isinstance(date_part, FeelDateTime):
+            date_part = date_part.date()
+        if isinstance(date_part, FeelDate) and isinstance(time_part, FeelTime):
+            return FeelDateTime(
+                _dt.datetime.combine(date_part.d, time_part.t), zone=time_part.zone
+            )
+        return None
+    (v,) = args
+    if isinstance(v, str):
+        return _null_on_temporal_error(_temporal.parse_date_time, v)
+    if isinstance(v, FeelDateTime):
+        return v
+    if isinstance(v, FeelDate):
+        return _builtin_date_time(str(v))
+    return None
+
+
+def _builtin_ym_duration(a, b):
+    if isinstance(a, FeelDateTime):
+        a = a.date()
+    if isinstance(b, FeelDateTime):
+        b = b.date()
+    if not (isinstance(a, FeelDate) and isinstance(b, FeelDate)):
+        return None
+    months = (b.year - a.year) * 12 + (b.month - a.month)
+    # truncate toward zero on partial months (FEEL spec)
+    if months > 0 and b.day < a.day:
+        months -= 1
+    elif months < 0 and b.day > a.day:
+        months += 1
+    return YearMonthDuration(months)
 
 
 class Evaluator:
@@ -427,12 +570,17 @@ class Evaluator:
         for part in node.path:
             if isinstance(value, dict) and part in value:
                 value = value[part]
+            elif _temporal.is_temporal(value):
+                value = _temporal.temporal_property(value, part)
             else:
                 return None  # FEEL: missing variable evaluates to null
         return value
 
     def _eval_Unary(self, node: Unary) -> Any:
-        return -_num(self.eval(node.operand))
+        v = self.eval(node.operand)
+        if isinstance(v, (Duration, YearMonthDuration)):
+            return -v
+        return -_num(v)
 
     def _eval_Bin(self, node: Bin) -> Any:
         op = node.op
@@ -455,7 +603,11 @@ class Evaluator:
         left = self.eval(node.left)
         right = self.eval(node.right)
         if op == "access":
-            return left.get(right) if isinstance(left, dict) else None
+            if isinstance(left, dict):
+                return left.get(right)
+            if _temporal.is_temporal(left):
+                return _temporal.temporal_property(left, right)
+            return None
         if op == "index":
             if isinstance(left, list):
                 i = int(_num(right))
@@ -485,6 +637,21 @@ class Evaluator:
                 raise FeelEvalError(f"cannot compare {type(left).__name__} and {type(right).__name__}")
         if left is None or right is None:
             return None
+        if op in ("+", "-", "*", "/") and (
+            _temporal.is_temporal(left) or _temporal.is_temporal(right)
+        ):
+            fn = {
+                "+": _temporal.temporal_add,
+                "-": _temporal.temporal_sub,
+                "*": _temporal.temporal_mul,
+                "/": _temporal.temporal_div,
+            }[op]
+            result = fn(left, right)
+            if result is NotImplemented:
+                raise FeelEvalError(
+                    f"cannot apply {op!r} to {type(left).__name__} and {type(right).__name__}"
+                )
+            return result
         if op == "+":
             if isinstance(left, str) and isinstance(right, str):
                 return left + right
@@ -506,10 +673,11 @@ class Evaluator:
     def _eval_Call(self, node: Call) -> Any:
         if node.name == "is defined":
             return self.eval(node.args[0]) is not None
-        if node.name == "now":
+        if node.name in ("now", "today"):
             if self.clock_millis is None:
-                raise FeelEvalError("now() requires a clock")
-            return self.clock_millis()
+                raise FeelEvalError(f"{node.name}() requires a clock")
+            dt = FeelDateTime.from_epoch_millis(self.clock_millis())
+            return dt if node.name == "now" else dt.date()
         fn = _BUILTINS.get(node.name)
         if fn is None:
             raise FeelEvalError(f"unknown function {node.name!r}")
@@ -557,7 +725,7 @@ def _ast_references_clock(node: Any) -> bool:
     if isinstance(node, (list, tuple)):
         return any(_ast_references_clock(x) for x in node)
     if isinstance(node, Call):
-        return node.name == "now" or _ast_references_clock(node.args)
+        return node.name in ("now", "today") or _ast_references_clock(node.args)
     if dataclasses.is_dataclass(node) and not isinstance(node, type):
         return any(
             _ast_references_clock(getattr(node, f.name))

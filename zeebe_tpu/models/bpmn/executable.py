@@ -57,7 +57,7 @@ class ExecutableElement:
     task_headers: dict[str, str] = dataclasses.field(default_factory=dict)
     # events
     timer_duration: Expression | None = None
-    timer_cycle: str | None = None
+    timer_cycle: Expression | None = None
     timer_date: Expression | None = None
     message_name: str | None = None
     correlation_key: Expression | None = None
@@ -235,7 +235,7 @@ def _lower_element(
         )
     if el.timer is not None:
         exe.timer_duration = _parse(el.timer.duration, errors, where)
-        exe.timer_cycle = el.timer.cycle
+        exe.timer_cycle = _parse(el.timer.cycle, errors, where)
         exe.timer_date = _parse(el.timer.date, errors, where)
     if el.message is not None:
         exe.message_name = el.message.name

@@ -248,11 +248,14 @@ class ProcessBuilder:
             )
         )
 
-    def intermediate_catch_timer(self, element_id: str, duration: str) -> "ProcessBuilder":
+    def intermediate_catch_timer(
+        self, element_id: str, duration: str | None = None, date: str | None = None,
+        cycle: str | None = None,
+    ) -> "ProcessBuilder":
         el = ProcessElement(
             element_id, BpmnElementType.INTERMEDIATE_CATCH_EVENT, event_type=BpmnEventType.TIMER
         )
-        el.timer = TimerDefinition(duration=duration)
+        el.timer = TimerDefinition(duration=duration, date=date, cycle=cycle)
         return self._add_element(el)
 
     def intermediate_catch_message(
@@ -265,7 +268,8 @@ class ProcessBuilder:
         return self._add_element(el)
 
     def boundary_timer(
-        self, element_id: str, attached_to: str, duration: str, interrupting: bool = True
+        self, element_id: str, attached_to: str, duration: str | None = None,
+        interrupting: bool = True, date: str | None = None, cycle: str | None = None,
     ) -> "ProcessBuilder":
         el = ProcessElement(
             element_id,
@@ -274,7 +278,7 @@ class ProcessBuilder:
             interrupting=interrupting,
             attached_to_id=attached_to,
         )
-        el.timer = TimerDefinition(duration=duration)
+        el.timer = TimerDefinition(duration=duration, date=date, cycle=cycle)
         return self._add_element(el, connect=False)
 
     def boundary_message(

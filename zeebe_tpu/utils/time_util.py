@@ -9,11 +9,6 @@ from __future__ import annotations
 
 import re
 
-_DURATION_RE = re.compile(
-    r"^P(?:(?P<days>\d+(?:\.\d+)?)D)?"
-    r"(?:T(?:(?P<hours>\d+(?:\.\d+)?)H)?(?:(?P<minutes>\d+(?:\.\d+)?)M)?"
-    r"(?:(?P<seconds>\d+(?:\.\d+)?)S)?)?$"
-)
 _CYCLE_RE = re.compile(r"^R(?P<reps>\d*)/(?P<dur>.+)$")
 
 
@@ -22,18 +17,18 @@ class InvalidTimerError(ValueError):
 
 
 def parse_duration_millis(text: str) -> int:
-    """'PT5S' → 5000. Supports D/H/M/S components (weeks/months are rejected,
-    matching the engine's interval subset)."""
-    m = _DURATION_RE.match(text.strip())
-    if not m or text.strip() in ("P", "PT"):
-        raise InvalidTimerError(f"invalid ISO-8601 duration: {text!r}")
-    days = float(m.group("days") or 0)
-    hours = float(m.group("hours") or 0)
-    minutes = float(m.group("minutes") or 0)
-    seconds = float(m.group("seconds") or 0)
-    if days == hours == minutes == seconds == 0 and "0" not in text:
-        raise InvalidTimerError(f"empty duration: {text!r}")
-    return int(((days * 24 + hours) * 60 + minutes) * 60000 + seconds * 1000)
+    """'PT5S' → 5000. Timer intervals are non-negative days-time spans
+    (years/months and negative spans are rejected — the engine's interval
+    subset). Delegates to the single ISO-duration parser in feel.temporal."""
+    from zeebe_tpu.feel.temporal import Duration, TemporalParseError, parse_duration
+
+    try:
+        d = parse_duration(text)
+    except TemporalParseError as exc:
+        raise InvalidTimerError(f"invalid ISO-8601 duration: {text!r}") from exc
+    if not isinstance(d, Duration) or d.millis < 0:
+        raise InvalidTimerError(f"not a timer interval: {text!r}")
+    return d.millis
 
 
 def parse_cycle(text: str) -> tuple[int, int]:
