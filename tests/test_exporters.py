@@ -147,3 +147,151 @@ class TestElasticsearchExporter:
         assert any("deployment" in i for i in indices)
         assert any(i.startswith("zeebe-record_process_") for i in indices)
         assert all(i.split("_")[-1].count("-") == 2 for i in indices)  # date suffix
+
+
+class TestExporterDepth:
+    """Auth, templating, retention/ILM, and the OpenSearch variant
+    (reference: ElasticsearchExporterConfiguration.java:26-33,305-333,
+    TemplateReader.java, ElasticsearchClient.java:210,
+    exporters/opensearch-exporter/)."""
+
+    def _drive(self, harness, es):
+        director = ExporterDirector(harness.stream, harness.db, {"es": es})
+        harness.deploy(one_task())
+        harness.create_instance("p")
+        director.export_available()
+        es.flush()
+        return es
+
+    def test_templates_put_before_first_export(self, harness):
+        from zeebe_tpu.exporters import RetentionConfiguration
+
+        es = self._drive(harness, ElasticsearchExporter(
+            sink=lambda p: None,
+            retention=RetentionConfiguration(enabled=True, minimum_age="7d"),
+        ))
+        paths = [p for (m, p, b) in es.requests if m == "PUT"]
+        # ILM policy first, then component template, then per-value-type
+        assert paths[0] == "/_ilm/policy/zeebe-record-retention-policy"
+        assert paths[1] == "/_component_template/zeebe-record"
+        assert any(p.startswith("/_index_template/zeebe-record_process-instance")
+                   for p in paths)
+        policy_body = json.loads(
+            next(b for (m, p, b) in es.requests if "/_ilm/" in p))
+        assert policy_body["policy"]["phases"]["delete"]["min_age"] == "7d"
+        assert policy_body["policy"]["phases"]["delete"]["actions"] == {"delete": {}}
+
+    def test_index_templates_reference_policy_and_alias(self, harness):
+        from zeebe_tpu.exporters import IndexConfiguration, RetentionConfiguration
+
+        es = self._drive(harness, ElasticsearchExporter(
+            sink=lambda p: None,
+            index=IndexConfiguration(number_of_shards=3, number_of_replicas=1),
+            retention=RetentionConfiguration(enabled=True),
+        ))
+        tpl = json.loads(next(
+            b for (m, p, b) in es.requests
+            if p == "/_index_template/zeebe-record_process-instance"))
+        assert tpl["index_patterns"] == ["zeebe-record_process-instance_*"]
+        assert tpl["composed_of"] == ["zeebe-record"]
+        assert tpl["template"]["aliases"] == {"zeebe-record-process-instance": {}}
+        settings = tpl["template"]["settings"]
+        assert settings["number_of_shards"] == 3
+        assert settings["number_of_replicas"] == 1
+        assert settings["index.lifecycle.name"] == "zeebe-record-retention-policy"
+
+    def test_create_template_off_skips_setup(self, harness):
+        from zeebe_tpu.exporters import IndexConfiguration
+
+        es = self._drive(harness, ElasticsearchExporter(
+            sink=lambda p: None, index=IndexConfiguration(create_template=False)))
+        assert not [p for (m, p, b) in es.requests if m == "PUT"]
+
+    def test_basic_auth_header_on_bulk(self, harness):
+        from zeebe_tpu.exporters import AuthenticationConfiguration
+
+        sent = []
+        es = ElasticsearchExporter(
+            transport=lambda m, p, h, b: sent.append((m, p, h)),
+            authentication=AuthenticationConfiguration(
+                username="zeebe", password="secret"),
+        )
+        self._drive(harness, es)
+        bulks = [(m, p, h) for (m, p, h) in sent if p == "/_bulk"]
+        assert bulks
+        import base64
+
+        expected = "Basic " + base64.b64encode(b"zeebe:secret").decode()
+        assert bulks[0][2]["Authorization"] == expected
+
+    def test_api_key_auth_header(self, harness):
+        from zeebe_tpu.exporters import AuthenticationConfiguration
+
+        sent = []
+        es = ElasticsearchExporter(
+            transport=lambda m, p, h, b: sent.append(h),
+            authentication=AuthenticationConfiguration(api_key="abc123"),
+        )
+        self._drive(harness, es)
+        assert any(h.get("Authorization") == "ApiKey abc123" for h in sent)
+
+    def test_config_map_binds_auth_and_retention(self):
+        from zeebe_tpu.exporters import ExporterContext
+
+        es = ElasticsearchExporter(sink=lambda p: None)
+        es.configure(ExporterContext("es", {
+            "authentication": {"username": "u", "password": "p"},
+            "retention": {"enabled": True, "minimumAge": "14d",
+                          "policyName": "keep-two-weeks"},
+            "bulkMemoryLimit": 1024,
+        }))
+        assert es.authentication.is_present()
+        assert es.retention.enabled and es.retention.minimum_age == "14d"
+        assert es.retention.policy_name == "keep-two-weeks"
+        assert es.bulk.memory_limit == 1024
+
+    def test_record_type_filter_default_events_only(self, harness):
+        es = self._drive(harness, ElasticsearchExporter(sink=lambda p: None))
+        # _bulk payload: every source line is an EVENT (commands off by default)
+        for payload in (b for (m, p, b) in es.requests if p == "/_bulk"):
+            for line in payload.strip().split("\n")[1::2]:
+                assert json.loads(line)["recordType"] == "EVENT"
+
+    def test_sequence_field_partition_shifted(self, harness):
+        es = self._drive(harness, ElasticsearchExporter(sink=lambda p: None))
+        payload = next(b for (m, p, b) in es.requests if p == "/_bulk")
+        lines = payload.strip().split("\n")
+        doc = json.loads(lines[1])
+        assert doc["sequence"] == (doc["partitionId"] << 51) + 1
+        doc2 = json.loads(lines[3])
+        # second record of the same value type increments; of a new type restarts
+        assert doc2["sequence"] >> 51 == doc2["partitionId"]
+
+    def test_memory_limit_triggers_flush(self, harness):
+        payloads = []
+        es = ElasticsearchExporter(sink=payloads.append, bulk_size=10_000)
+        es.bulk.memory_limit = 512
+        self._drive(harness, es)
+        assert len(payloads) > 1  # flushed mid-stream by bytes, not by count
+
+    def test_opensearch_variant(self, harness):
+        from zeebe_tpu.exporters import OpensearchExporter
+
+        os_exp = self._drive(harness, OpensearchExporter(sink=lambda p: None))
+        paths = [p for (m, p, b) in os_exp.requests if m == "PUT"]
+        assert not any("/_ilm/" in p for p in paths)  # ISM, not ILM, in OpenSearch
+        assert any(p.startswith("/_index_template/") for p in paths)
+
+    def test_opensearch_aws_signing(self, harness):
+        from zeebe_tpu.exporters import AwsConfiguration, OpensearchExporter
+
+        sent = []
+        os_exp = OpensearchExporter(
+            transport=lambda m, p, h, b: sent.append((p, h)),
+            aws=AwsConfiguration(enabled=True, region="us-east-1",
+                                 access_key="AK", secret_key="SK"),
+        )
+        self._drive(harness, os_exp)
+        bulk_headers = next(h for (p, h) in sent if p == "/_bulk")
+        assert bulk_headers["Authorization"].startswith("AWS4-HMAC-SHA256 Credential=AK/")
+        assert "x-amz-date" in bulk_headers and "x-amz-content-sha256" in bulk_headers

@@ -337,3 +337,51 @@ class TestDmnTemporal:
         assert engine.evaluate(
             drg, "sla", {"receivedAt": "2026-06-30T12:00:00Z"}
         ).output == "current"
+
+
+class TestReviewRegressions:
+    """Pinned behaviors from review findings."""
+
+    def test_zone_names_containing_T(self):
+        t = ev('@"10:00:00@Asia/Tokyo"')
+        assert isinstance(t, FeelTime)
+        assert t.time_offset == Duration(9 * 3600 * 1000)
+        assert str(t) == "10:00:00@Asia/Tokyo"
+
+    def test_zoned_time_compares_by_instant(self):
+        assert ev('time("10:00:00@Asia/Tokyo") = time("01:00:00Z")') is True
+        assert ev('@"10:00:00@Europe/Paris" < @"10:00:00Z"') is True
+
+    def test_datetime_zone_resolves_dst_at_date(self):
+        # Berlin is +02:00 in July (DST), +01:00 in January
+        july = ev('@"2026-07-15T12:00:00@Europe/Berlin"')
+        jan = ev('@"2026-01-15T12:00:00@Europe/Berlin"')
+        assert july.time_offset == Duration(2 * 3600 * 1000)
+        assert jan.time_offset == Duration(1 * 3600 * 1000)
+
+    def test_variables_named_date_and_time_conjunction(self):
+        assert ev("date and time", date=True, time=True) is True
+        assert ev("years and months", years=1, months=2) is None  # non-bool and
+
+    def test_multiword_still_fuses_in_call_and_property_position(self):
+        assert ev('date and time("2026-07-31T00:00:00Z")') is not None
+        assert ev('@"14:30:00+02:00".time offset') == Duration(2 * 3600 * 1000)
+
+    def test_ym_timer_duration_poisons_template(self, harness):
+        from zeebe_tpu.engine import burst_templates as bt
+
+        harness.deploy(
+            Bpmn.create_executable_process("ym")
+            .start_event("s")
+            .intermediate_catch_timer("wait", duration='= duration("P1M")')
+            .end_event("e")
+            .done()
+        )
+        captured = []
+        orig = bt.note_clock_poison
+        bt.note_clock_poison = lambda: captured.append(True) or orig()
+        try:
+            harness.create_instance("ym")
+        finally:
+            bt.note_clock_poison = orig
+        assert captured, "P1M due date must poison the burst template"

@@ -589,21 +589,27 @@ class BpmnProcessor:
     # ------------------------------------------------- event subscriptions
 
     def _eval_duration_millis(self, expr, context) -> int:
+        millis, _ = self._eval_duration_millis_ex(expr, context)
+        return millis
+
+    def _eval_duration_millis_ex(self, expr, context) -> tuple[int, bool]:
+        """→ (millis, calendar_dependent). A years-and-months span's
+        millisecond delta depends on the current clock DATE (P1M from Jan 31
+        is 28d, from Mar 31 is 30d), so it is NOT a pure function of the
+        variable context even without now() — burst templates must decline."""
         from zeebe_tpu.feel.temporal import Duration, YearMonthDuration, temporal_add
         from zeebe_tpu.feel.temporal import FeelDateTime
         from zeebe_tpu.utils import parse_duration_millis
 
         raw = expr.evaluate(context, self.clock_millis)
         if isinstance(raw, Duration):
-            return raw.millis
+            return raw.millis, False
         if isinstance(raw, YearMonthDuration):
-            # calendar span: anchor at the current clock (P1M from Jan 31
-            # lands on Feb 28/29, not +30d)
             now = FeelDateTime.from_epoch_millis(self.clock_millis())
-            return temporal_add(now, raw).epoch_millis - now.epoch_millis
+            return temporal_add(now, raw).epoch_millis - now.epoch_millis, True
         if isinstance(raw, (int, float)):
-            return int(raw)
-        return parse_duration_millis(str(raw))
+            return int(raw), False
+        return parse_duration_millis(str(raw)), False
 
     def _create_timer(self, host_key: int, value: dict, catching: ExecutableElement,
                       host: ExecutableElement, writers: Writers,
@@ -618,10 +624,13 @@ class BpmnProcessor:
         try:
             if catching.timer_duration is not None:
                 context = self.state.variables.collect(host_key)
-                duration = self._eval_duration_millis(catching.timer_duration, context)
-                # a now()-referencing duration makes the due date NOT
-                # clock + constant — template captures must decline
-                clock_free = not catching.timer_duration.references_clock()
+                duration, calendar_dep = self._eval_duration_millis_ex(
+                    catching.timer_duration, context
+                )
+                # a now()-referencing or calendar-anchored duration makes the
+                # due date NOT clock + constant — template captures must decline
+                clock_free = (not catching.timer_duration.references_clock()
+                              and not calendar_dep)
             elif catching.timer_date is not None:
                 # absolute due date (FEEL temporal or ISO string); the due
                 # date is a pure function of the variable context, so it is

@@ -2,16 +2,30 @@
 
 from zeebe_tpu.exporters.api import Exporter, ExporterContext, ExporterController
 from zeebe_tpu.exporters.director import ExporterDirector, ExportersState
-from zeebe_tpu.exporters.elasticsearch import ElasticsearchExporter
+from zeebe_tpu.exporters.elasticsearch import (
+    AuthenticationConfiguration,
+    AwsConfiguration,
+    BulkConfiguration,
+    ElasticsearchExporter,
+    IndexConfiguration,
+    OpensearchExporter,
+    RetentionConfiguration,
+)
 from zeebe_tpu.exporters.recording import RecordingExporter, RecordStream
 
 __all__ = [
+    "AuthenticationConfiguration",
+    "AwsConfiguration",
+    "BulkConfiguration",
     "Exporter",
     "ExporterContext",
     "ExporterController",
     "ExporterDirector",
     "ExportersState",
     "ElasticsearchExporter",
+    "IndexConfiguration",
+    "OpensearchExporter",
+    "RetentionConfiguration",
     "RecordingExporter",
     "RecordStream",
 ]

@@ -173,23 +173,32 @@ def _tokenize(src: str) -> list[tuple[str, str]]:
             continue
         text = m.group()
         tokens.append((kind, text))
-    # fuse multi-word names (longest match first)
+    # fuse multi-word names, longest match first — but ONLY in call position
+    # (followed by "(") or property position (preceded by "."): variables
+    # named date/time must keep working in conjunctions like `date and time`
     fused: list[tuple[str, str]] = []
     i = 0
     while i < len(tokens):
         matched = False
         if tokens[i][0] == "name":
+            after_dot = bool(fused) and fused[-1][1] == "."
             for width in range(_MULTIWORD_MAX, 1, -1):
                 if i + width > len(tokens):
                     continue
                 window = tokens[i : i + width]
-                if all(t[0] == "name" for t in window):
-                    key = tuple(t[1] for t in window)
-                    if key in _MULTIWORD:
-                        fused.append(("name", _MULTIWORD[key]))
-                        i += width
-                        matched = True
-                        break
+                if not all(t[0] == "name" for t in window):
+                    continue
+                key = tuple(t[1] for t in window)
+                if key not in _MULTIWORD:
+                    continue
+                before_call = (i + width < len(tokens)
+                               and tokens[i + width][1] == "(")
+                if not (after_dot or before_call):
+                    continue
+                fused.append(("name", _MULTIWORD[key]))
+                i += width
+                matched = True
+                break
         if not matched:
             fused.append(tokens[i])
             i += 1

@@ -329,6 +329,12 @@ def parse_time(text: str) -> FeelTime:
     frac = m.group(4) or ""
     micros = int((frac + "000000")[:6]) if frac else 0
     tz, zone = _tz_from_suffix(m.group(5) or "")
+    if zone is not None and tz is not None:
+        # a bare time has no date for DST resolution: pin the named zone's
+        # offset at a fixed anchor date so utcoffset()/comparisons work
+        # (times are instant-compared on a shared anchor day anyway)
+        anchor = _dt.datetime(2000, 1, 1, hh, mm, ss, tzinfo=tz)
+        tz = _dt.timezone(anchor.utcoffset() or _dt.timedelta())
     try:
         return FeelTime(_dt.time(hh, mm, ss, micros, tzinfo=tz), zone=zone)
     except ValueError as exc:
@@ -337,14 +343,22 @@ def parse_time(text: str) -> FeelTime:
 
 def parse_date_time(text: str) -> FeelDateTime:
     text = text.strip()
-    if "T" not in text:
+    if not _DT_PREFIX_RE.match(text):
         # a bare date is a valid date-and-time at midnight (camunda-feel)
         d = parse_date(text)
         return FeelDateTime(_dt.datetime.combine(d.d, _dt.time(0, 0, 0)))
     date_part, time_part = text.split("T", 1)
     d = parse_date(date_part)
     t = parse_time(time_part)
-    return FeelDateTime(_dt.datetime.combine(d.d, t.t), zone=t.zone)
+    tzinfo = t.t.tzinfo
+    if t.zone is not None:
+        # named zone: resolve DST at the actual date, not parse_time's
+        # fixed anchor day
+        tz, _ = _tz_from_suffix("@" + t.zone)
+        tzinfo = tz
+    return FeelDateTime(
+        _dt.datetime.combine(d.d, t.t.replace(tzinfo=tzinfo)), zone=t.zone
+    )
 
 
 def parse_duration(text: str) -> Duration | YearMonthDuration:
@@ -366,12 +380,17 @@ def parse_duration(text: str) -> Duration | YearMonthDuration:
     raise TemporalParseError(f"invalid duration: {text!r}")
 
 
+_DT_PREFIX_RE = re.compile(r"^\d{4}-\d{2}-\d{2}T")
+
+
 def parse_temporal_literal(text: str) -> Any:
-    """Classify an ``@"…"`` literal body by shape (the four FEEL kinds)."""
+    """Classify an ``@"…"`` literal body by shape (the four FEEL kinds).
+    Date-and-time is recognized by its ``YYYY-MM-DDT`` prefix, not by a bare
+    'T' search — zone names like Asia/Tokyo contain a T."""
     s = text.strip()
     if s.startswith("P") or s.startswith("-P"):
         return parse_duration(s)
-    if "T" in s:
+    if _DT_PREFIX_RE.match(s):
         return parse_date_time(s)
     if _DATE_RE.match(s):
         return parse_date(s)
