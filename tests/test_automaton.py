@@ -74,9 +74,32 @@ class TestConditionCompiler:
         assert len(prog) > 5
         assert slots.count == 2
 
+    def test_string_var_pair_never_lowers(self):
+        # `a = b` types both vars numeric; combined with a string-literal
+        # comparison on `a` the slot-kind conflict rejects the program —
+        # two string slots never meet on device (their unknown insertion-
+        # rank keys could collide)
+        from zeebe_tpu.ops.tables import StringInterner
+
+        interner = StringInterner()
+        interner.intern_sorted({"anchor"})
+        with pytest.raises(ConditionNotCompilable):
+            compile_condition(
+                parse_feel('a != "anchor" and a = b').ast, SlotMap(), interner)
+
     def test_string_condition_rejected(self):
         with pytest.raises(ConditionNotCompilable):
             compile_condition(parse_feel('name = "alice"').ast, SlotMap())
+
+    def test_arithmetic_rejected(self):
+        # arithmetic cannot run in order-key space: the gateway host-escapes
+        # instead, keeping device comparisons bit-exact vs host float64
+        with pytest.raises(ConditionNotCompilable):
+            compile_condition(parse_feel("x + 1 > 2").ast, SlotMap())
+
+    def test_non_boolean_root_rejected(self):
+        with pytest.raises(ConditionNotCompilable):
+            compile_condition(parse_feel("x").ast, SlotMap())
 
 
 class TestKernelBasics:

@@ -251,21 +251,84 @@ class TestExporterDepth:
         assert es.bulk.memory_limit == 1024
 
     def test_record_type_filter_default_events_only(self, harness):
-        es = self._drive(harness, ElasticsearchExporter(sink=lambda p: None))
-        # _bulk payload: every source line is an EVENT (commands off by default)
-        for payload in (b for (m, p, b) in es.requests if p == "/_bulk"):
+        payloads = []
+        self._drive(harness, ElasticsearchExporter(sink=payloads.append))
+        assert payloads
+        # _bulk payload: every source line is an EVENT (commands off by
+        # default; the director-side filter still ACKS skipped positions)
+        for payload in payloads:
             for line in payload.strip().split("\n")[1::2]:
                 assert json.loads(line)["recordType"] == "EVENT"
 
+    def test_filtered_records_still_advance_position(self, harness):
+        from zeebe_tpu.exporters import IndexConfiguration
+
+        # filter EVERYTHING: the exporter position must still advance via
+        # director-side skips (no stalled compaction on filtered runs)
+        es = ElasticsearchExporter(
+            sink=lambda p: None,
+            index=IndexConfiguration(command=False, event=False, rejection=False),
+        )
+        director = ExporterDirector(harness.stream, harness.db, {"es": es})
+        harness.deploy(one_task())
+        harness.create_instance("p")
+        director.export_available()
+        es.flush()
+        assert ExportersState(harness.db).position("es") > 0
+
     def test_sequence_field_partition_shifted(self, harness):
-        es = self._drive(harness, ElasticsearchExporter(sink=lambda p: None))
-        payload = next(b for (m, p, b) in es.requests if p == "/_bulk")
-        lines = payload.strip().split("\n")
+        payloads = []
+        self._drive(harness, ElasticsearchExporter(sink=payloads.append))
+        lines = payloads[0].strip().split("\n")
         doc = json.loads(lines[1])
         assert doc["sequence"] == (doc["partitionId"] << 51) + 1
         doc2 = json.loads(lines[3])
         # second record of the same value type increments; of a new type restarts
         assert doc2["sequence"] >> 51 == doc2["partitionId"]
+
+    def test_sequence_counters_survive_restart(self, harness):
+        payloads = []
+        es = ElasticsearchExporter(sink=payloads.append)
+        director = ExporterDirector(harness.stream, harness.db, {"es": es})
+        harness.deploy(one_task())
+        harness.create_instance("p")
+        director.export_available()
+        es.flush()
+        def by_type(payload_list):
+            out = {}
+            for payload in payload_list:
+                for line in payload.strip().split("\n")[1::2]:
+                    doc = json.loads(line)
+                    out.setdefault(doc["valueType"], []).append(doc["sequence"])
+            return out
+
+        first = by_type(payloads)
+        # new exporter + director over the same db = restart; counters
+        # restore from persisted metadata, so per-type sequences continue
+        payloads2 = []
+        es2 = ElasticsearchExporter(sink=payloads2.append)
+        director2 = ExporterDirector(harness.stream, harness.db, {"es": es2})
+        harness.create_instance("p")
+        director2.export_available()
+        es2.flush()
+        second = by_type(payloads2)
+        assert second
+        for vt, seqs in second.items():
+            if vt in first:
+                assert min(seqs) > max(first[vt]), vt
+
+    def test_opensearch_rejects_retention_config(self):
+        from zeebe_tpu.exporters import ExporterContext, OpensearchExporter
+
+        os_exp = OpensearchExporter(sink=lambda p: None)
+        with pytest.raises(ValueError):
+            os_exp.configure(ExporterContext("os", {"retention": {"enabled": True}}))
+        from zeebe_tpu.exporters import RetentionConfiguration
+
+        with pytest.raises(ValueError):
+            OpensearchExporter(
+                sink=lambda p: None,
+                retention=RetentionConfiguration(enabled=True))
 
     def test_memory_limit_triggers_flush(self, harness):
         payloads = []

@@ -1562,3 +1562,162 @@ class TestIoMappingsOnKernel:
                 h.complete_job(jobs[k]["key"], {})
 
         assert_equivalent(scenario)
+
+
+class TestExactConditionParity:
+    """Device conditions evaluate over IEEE-754 total-order keys: routing is
+    bit-exact against the host float64 FEEL evaluator even for values inside
+    float32 rounding of the boundary (the old f32 caveat is gone), and
+    string conditions order lexicographically via sorted interned ids."""
+
+    def test_float64_boundary_values_route_identically(self):
+        # 2^24 + 1 is not representable in float32; under the old f32 slots
+        # x > 16777216 with x = 16777217 could round to the boundary
+        boundary = (1 << 24) + 1
+
+        def proc():
+            return (
+                Bpmn.create_executable_process("bnd")
+                .start_event("s")
+                .exclusive_gateway("gw")
+                .condition_expression(f"x > {1 << 24}")
+                .service_task("big", job_type="big")
+                .end_event("e1")
+                .move_to_element("gw")
+                .default_flow()
+                .service_task("small", job_type="small")
+                .end_event("e2")
+                .done()
+            )
+
+        def scenario(h):
+            h.deploy(proc())
+            # straddle the boundary within one float32 ulp
+            for i, x in enumerate(
+                [boundary, 1 << 24, (1 << 24) - 1, 16777216.000000002,
+                 0.1, 0.30000000000000004, 0.3, 1e-300, -0.0, 0.0]
+            ):
+                h.create_instance("bnd", {"x": x}, request_id=500 + i)
+            drive_jobs(h, "big")
+            drive_jobs(h, "small")
+
+        assert_equivalent(scenario)
+
+    def test_kernel_actually_used_for_boundary_process(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(exclusive_chain("bnd_used"))
+            for i in range(8):
+                h.create_instance("bnd_used", {"x": 10.000000001 if i % 2 else 10.0})
+            assert h.kernel_backend.commands_processed >= 8
+        finally:
+            h.close()
+
+    def test_string_ordering_conditions(self):
+        def proc():
+            return (
+                Bpmn.create_executable_process("strord")
+                .start_event("s")
+                .exclusive_gateway("gw")
+                .condition_expression('name < "m"')
+                .service_task("low", job_type="low")
+                .end_event("e1")
+                .move_to_element("gw")
+                .default_flow()
+                .service_task("high", job_type="high")
+                .end_event("e2")
+                .done()
+            )
+
+        def scenario(h):
+            h.deploy(proc())
+            for i, name in enumerate(["alice", "m", "mallory", "zoe", "", "m" * 5]):
+                h.create_instance("strord", {"name": name}, request_id=700 + i)
+            drive_jobs(h, "low")
+            drive_jobs(h, "high")
+
+        assert_equivalent(scenario)
+
+    def test_unknown_strings_order_exactly_against_literals(self):
+        # "zeta"/"aardvark" are not in the interner ("m" is): their odd
+        # insertion-rank keys sit on the correct side of every literal, so
+        # ordering rides the kernel and stays byte-equal
+        def proc():
+            return (
+                Bpmn.create_executable_process("strunk")
+                .start_event("s")
+                .exclusive_gateway("gw")
+                .condition_expression('name <= "m"')
+                .end_event("e1")
+                .move_to_element("gw")
+                .default_flow()
+                .end_event("e2")
+                .done()
+            )
+
+        def scenario(h):
+            h.deploy(proc())
+            for i, name in enumerate(["zeta", "aardvark", "m", "l", "n", ""]):
+                h.create_instance("strunk", {"name": name}, request_id=800 + i)
+
+        assert_equivalent(scenario)
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(proc())
+            for name in ("zeta", "aardvark"):
+                h.create_instance("strunk", {"name": name})
+            assert h.kernel_backend.commands_processed >= 2
+        finally:
+            h.close()
+
+    def test_string_var_pair_stays_off_device_with_parity(self):
+        # a = b compares two string VARIABLES: two different unknown strings
+        # between the same literal neighbors would collide on one odd key.
+        # The compiler never types a slot "str" without a literal opposite,
+        # so this gateway host-escapes (kind conflict) or its instances
+        # decline (string in a numeric slot) — parity must hold either way
+        def proc():
+            return (
+                Bpmn.create_executable_process("strpair")
+                .start_event("s")
+                .exclusive_gateway("gw")
+                .condition_expression('a = b and a != "anchor"')
+                .end_event("e1")
+                .move_to_element("gw")
+                .default_flow()
+                .end_event("e2")
+                .done()
+            )
+
+        def scenario(h):
+            h.deploy(proc())
+            # "x" and "y" are both unknown and adjacent between literals:
+            # a collision would wrongly route to e1
+            h.create_instance("strpair", {"a": "x", "b": "y"}, request_id=810)
+            h.create_instance("strpair", {"a": "x", "b": "x"}, request_id=811)
+            h.create_instance("strpair", {"a": "anchor", "b": "anchor"}, request_id=812)
+
+        assert_equivalent(scenario)
+
+    def test_arithmetic_condition_falls_back_with_parity(self):
+        # + cannot run in order-key space: the gateway host-escapes; byte
+        # parity must hold regardless
+        def proc():
+            return (
+                Bpmn.create_executable_process("arith")
+                .start_event("s")
+                .exclusive_gateway("gw")
+                .condition_expression("x + 0.1 > 0.3")
+                .end_event("e1")
+                .move_to_element("gw")
+                .default_flow()
+                .end_event("e2")
+                .done()
+            )
+
+        def scenario(h):
+            h.deploy(proc())
+            for i, x in enumerate([0.2, 0.19999999999999998, 0.2000000001]):
+                h.create_instance("arith", {"x": x}, request_id=900 + i)
+
+        assert_equivalent(scenario)

@@ -82,12 +82,19 @@ def test_reference_name_coverage_after_full_scenario(tmp_path):
         gw.stop()
         runtime.stop()
 
+    # the exporter and DMN metrics register at component construction
+    # (reference: static collectors), so touching the components is enough
+    from zeebe_tpu.exporters import ElasticsearchExporter
+
+    ElasticsearchExporter(sink=lambda p: None)
+    import zeebe_tpu.engine.decision  # noqa: F401 — registers the DMN counter
+
     ours = registered_names()
     matched = ours & REFERENCE_NAMES
     missing = sorted(REFERENCE_NAMES - ours)
-    assert len(matched) >= 80, (
-        f"only {len(matched)}/111 reference metric names registered; "
-        f"missing: {missing}")
+    assert len(matched) == len(REFERENCE_NAMES), (
+        f"only {len(matched)}/{len(REFERENCE_NAMES)} reference metric names "
+        f"registered; missing: {missing}")
 
 
 def test_metrics_endpoint_exposes_reference_names():

@@ -191,6 +191,9 @@ class Broker:
                 ("node", "partition")),
             "health": REGISTRY.gauge(
                 "health", "0=healthy 1=unhealthy 2=dead", ("node",)),
+            "join_time": REGISTRY.histogram(
+                "partition_server_join_time",
+                "seconds to join a partition at runtime", ("partition",)),
         }
         self.responses: list = []
         # per-partition ownership guard (set by ClusterRuntime): topology-
@@ -385,12 +388,7 @@ class Broker:
 
             join_start = _time.perf_counter()
             self._create_partition(partition_id, members, priority)
-            from zeebe_tpu.utils.metrics import REGISTRY as _REG
-
-            _REG.histogram(
-                "partition_server_join_time",
-                "seconds to join a partition at runtime", ("partition",)
-            ).labels(str(partition_id)).observe(
+            self._metrics["join_time"].labels(str(partition_id)).observe(
                 _time.perf_counter() - join_start)
 
     _PARTITION_TOPICS = (

@@ -60,13 +60,22 @@ def evaluation_record_value(decision_meta: dict,
     }
 
 
-def evaluate_decision(state: EngineState, decision_meta: dict,
-                      context: dict) -> DecisionEvaluationResult:
+def _dmn_counter():
+    """Registered at import (reference: ProcessEngineMetrics registers its
+    collectors statically, not on first evaluation)."""
     from zeebe_tpu.utils.metrics import REGISTRY
 
-    counter = REGISTRY.counter(
+    return REGISTRY.counter(
         "evaluated_dmn_elements_total", "DMN decisions evaluated by outcome",
         ("action",))
+
+
+_DMN_COUNTER = _dmn_counter()
+
+
+def evaluate_decision(state: EngineState, decision_meta: dict,
+                      context: dict) -> DecisionEvaluationResult:
+    counter = _DMN_COUNTER
     drg = state.decisions.parsed_drg(decision_meta["decisionRequirementsKey"])
     if drg is None:
         counter.labels("failed").inc()

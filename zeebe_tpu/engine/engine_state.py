@@ -870,17 +870,21 @@ class BannedInstanceState:
 
     def __init__(self, db: ZbDb) -> None:
         self._banned = db.column_family(CF.BANNED_INSTANCE)
+        from zeebe_tpu.utils.metrics import REGISTRY
+
+        # registered at state construction, not first ban (reference:
+        # BannedInstanceMetrics is a static collector)
+        self._banned_counter = REGISTRY.counter(
+            "banned_instances_total",
+            "process instances quarantined after processing errors",
+            ("partition",))
 
     def ban(self, process_instance_key: int) -> None:
         self._banned.put((process_instance_key,), True)
         from zeebe_tpu.protocol.keys import decode_partition_id
-        from zeebe_tpu.utils.metrics import REGISTRY
 
-        REGISTRY.counter(
-            "banned_instances_total",
-            "process instances quarantined after processing errors",
-            ("partition",)
-        ).labels(str(decode_partition_id(process_instance_key))).inc()
+        self._banned_counter.labels(
+            str(decode_partition_id(process_instance_key))).inc()
 
     def is_banned(self, process_instance_key: int) -> bool:
         return process_instance_key >= 0 and self._banned.exists((process_instance_key,))

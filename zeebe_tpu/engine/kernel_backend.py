@@ -24,10 +24,11 @@ Commands of other definitions — and commands whose instances are not in a
 reconstructable state — fall back to the sequential engine, command by
 command, preserving exact semantics.
 
-Known float caveat: condition programs evaluate in float32 on device while the
-host FEEL evaluator uses float64 — comparisons within ~1e-7 of the boundary
-can diverge. The reference has no analogous dual path; boundary-exact process
-conditions should use integers.
+Condition evaluation on device is BIT-EXACT against the host float64 FEEL
+evaluator: slots carry IEEE-754 total-order keys as two int32 planes
+(zeebe_tpu.ops.tables.f64_key_planes), comparisons are lexicographic over the
+planes, and arithmetic inside conditions host-escapes at compile time — so no
+float32 rounding exists anywhere on the device path.
 """
 
 from __future__ import annotations
@@ -852,14 +853,16 @@ class KernelBackend:
                 join_counts[jidx] = total
         return join_counts
 
-    def _condition_slots(self, info: _DefInfo, merged: dict) -> dict[str, float] | None:
-        """Prefetch the condition variables into device-slot values: numeric
-        slots carry the float value, string slots the interned id (the host
-        document store ↔ device slot split, SURVEY §7(c)). None = this
-        instance cannot ride the kernel (type mismatch would diverge from
-        host FEEL semantics)."""
+    def _condition_slots(self, info: _DefInfo, merged: dict) -> dict[str, tuple] | None:
+        """Prefetch the condition variables into device-slot key planes:
+        numeric slots carry the float64 order key, string slots the interned
+        id (the host document store ↔ device slot split, SURVEY §7(c)).
+        None = this instance cannot ride the kernel (type mismatch or
+        order-unsafe unknown string would diverge from host FEEL)."""
+        from zeebe_tpu.ops.tables import f64_key_planes
+
         tables = self.registry.tables
-        slots: dict[str, float] = {}
+        slots: dict[str, tuple] = {}
         # variables read by THIS definition's device-compiled conditions in
         # the SHARED lowering (a shared-set SlotMap clash may have downgraded
         # a gateway to K_HOST — its variables then need no prefetch and must
@@ -869,11 +872,20 @@ class KernelBackend:
             if tables.slot_map.kinds.get(name) == "str":
                 if not isinstance(v, str):
                     return None
-                slots[name] = tables.interner.id_of(v)
+                key_hi, _known = tables.interner.order_key_of(v)
+                # unknown strings get odd insertion-rank keys — exact
+                # against every literal, and device programs never compare
+                # two string slots (compile_condition types "str" only
+                # opposite a literal), so collisions between two unknown
+                # keys are unreachable
+                slots[name] = (key_hi, 0)
                 continue
             if not _is_numeric(v):
                 return None
-            slots[name] = float(v)
+            value = float(v)
+            if value != value:  # NaN has no order key
+                return None
+            slots[name] = f64_key_planes(value)
         return slots
 
     def _admit_resume(self, cmd, instances, admitted_pis: set[int],
@@ -1080,7 +1092,7 @@ class KernelBackend:
         phase = np.zeros(T, np.int32)
         inst_arr = np.zeros(T, np.int32)
         def_of = np.zeros(I, np.int32)
-        var_slots = np.zeros((I, S), np.float32)
+        var_slots = np.zeros((I, S, 2), np.int32)
         join_counts = np.zeros((I, E), np.int32)
         done = np.zeros(I, np.bool_)
         done[n_real:] = True  # padding rows must never report newly_done

@@ -27,16 +27,31 @@ class ExporterContext:
 
 
 class ExporterController:
-    """Hands the exporter its position-acknowledgement and task scheduling
-    (reference: exporter-api Controller; ExporterContainer implements it)."""
+    """Hands the exporter its position-acknowledgement, durable metadata, and
+    task scheduling (reference: exporter-api Controller —
+    updateLastExportedRecordPosition(position, metadata) and readMetadata;
+    ExporterContainer implements it)."""
 
     def __init__(self, on_position: Callable[[int], None],
-                 schedule: Callable[[int, Callable[[], None]], Any] | None = None) -> None:
+                 schedule: Callable[[int, Callable[[], None]], Any] | None = None,
+                 on_metadata: Callable[[bytes], None] | None = None,
+                 read_metadata: Callable[[], bytes | None] | None = None) -> None:
         self._on_position = on_position
         self._schedule = schedule
+        self._on_metadata = on_metadata
+        self._read_metadata = read_metadata
 
-    def update_last_exported_position(self, position: int) -> None:
+    def update_last_exported_position(self, position: int,
+                                      metadata: bytes | None = None) -> None:
+        if metadata is not None and self._on_metadata is not None:
+            self._on_metadata(metadata)
         self._on_position(position)
+
+    def read_metadata(self) -> bytes | None:
+        """Durable exporter-private state persisted with the position acks
+        (reference: Controller#readMetadata — the ES exporter keeps its
+        record-sequence counters here so restarts do not reset sequences)."""
+        return self._read_metadata() if self._read_metadata is not None else None
 
     def schedule_task(self, delay_millis: int, task: Callable[[], None]) -> Any:
         if self._schedule is None:

@@ -135,7 +135,11 @@ class ExporterContainer:
         # (reference: ExporterContainer.updateLastExportedRecordPosition)
         self.last_delivered = self.position
         exporter.configure(ExporterContext(exporter_id, configuration or {}))
-        exporter.open(ExporterController(self._update_position))
+        exporter.open(ExporterController(
+            self._update_position,
+            on_metadata=lambda data: state.set_metadata(exporter_id, data),
+            read_metadata=lambda: state.metadata(exporter_id),
+        ))
         from zeebe_tpu.utils.metrics import REGISTRY
 
         # labeled per (exporter, partition): each child is incremented by
@@ -178,14 +182,26 @@ class ExportersState:
         with self.db.transaction():
             self._cf.put((exporter_id,), position)
 
+    def metadata(self, exporter_id: str) -> bytes | None:
+        with self.db.transaction():
+            return self._cf.get(("__meta__", exporter_id))
+
+    def set_metadata(self, exporter_id: str, data: bytes) -> None:
+        with self.db.transaction():
+            self._cf.put(("__meta__", exporter_id), data)
+
     def remove(self, exporter_id: str) -> None:
         with self.db.transaction():
             if self._cf.exists((exporter_id,)):
                 self._cf.delete((exporter_id,))
+            if self._cf.exists(("__meta__", exporter_id)):
+                self._cf.delete(("__meta__", exporter_id))
 
     def lowest_position(self) -> int:
         with self.db.transaction():
-            positions = list(self._cf.values())
+            # metadata rows (key prefix "__meta__") share the CF; only the
+            # single-part position keys carry int positions
+            positions = [v for v in self._cf.values() if isinstance(v, int)]
         return min(positions) if positions else -1
 
 

@@ -169,7 +169,22 @@ class ElasticsearchExporter(Exporter):
         # (reference: ElasticsearchRecordCounters + RecordSequence —
         # sequence = (partitionId << 51) + counter)
         self._counters: dict[str, int] = {}
-        self.requests: list[tuple[str, str, str]] = []  # (method, path, body) capture
+        # bounded request capture for tests/diagnostics: bulk BODIES are
+        # elided (they already reach the sink/directory/transport) so a
+        # long-running broker does not accumulate payload strings
+        from collections import deque
+
+        self.requests: deque[tuple[str, str, str]] = deque(maxlen=256)
+        from zeebe_tpu.utils.metrics import REGISTRY
+
+        # registered at construction (reference: ElasticsearchMetrics is
+        # created with the exporter, not on first flush)
+        self._bulk_size_metric = REGISTRY.histogram(
+            "bulk_size", "records per exporter bulk flush",
+            buckets=(1, 10, 100, 500, 1000, 5000))
+        self._bulk_memory_metric = REGISTRY.histogram(
+            "bulk_memory_size", "bytes per exporter bulk flush",
+            buckets=(1024, 16384, 262144, 1 << 20, 16 << 20))
 
     # convenience alias kept for existing callers/tests
     @property
@@ -184,6 +199,11 @@ class ElasticsearchExporter(Exporter):
 
     def configure(self, context: ExporterContext) -> None:
         super().configure(context)
+        # filtering happens DIRECTOR-side via the context filter (reference:
+        # ElasticsearchExporter.configure → context.setFilter): skipped
+        # records still advance the exporter position, so compaction and
+        # re-delivery never stall on a run of filtered records
+        context.record_filter = self._should_index
         cfg = context.configuration
         self.bulk.size = cfg.get("bulkSize", self.bulk.size)
         self.bulk.delay_seconds = cfg.get("bulkDelay", self.bulk.delay_seconds)
@@ -204,6 +224,17 @@ class ElasticsearchExporter(Exporter):
 
     def open(self, controller) -> None:
         super().open(controller)
+        # restore the per-value-type sequence counters persisted alongside
+        # position acks, so a restart continues sequences instead of
+        # restarting at 1 (reference: ElasticsearchExporterMetadata)
+        meta = controller.read_metadata()
+        if meta:
+            try:
+                self._counters = {
+                    str(k): int(v) for k, v in json.loads(meta.decode()).items()
+                }
+            except (ValueError, AttributeError):
+                pass  # unreadable metadata: keep fresh counters
         self._schedule_delayed_flush()
 
     def _schedule_delayed_flush(self) -> None:
@@ -223,13 +254,20 @@ class ElasticsearchExporter(Exporter):
         finally:
             self._schedule_delayed_flush()
 
+    def _should_index(self, record: LoggedRecord) -> bool:
+        rec = record.record
+        return (self.index.should_index_record_type(rec.record_type)
+                and self.index.should_index_value_type(rec.value_type))
+
     def export(self, record: LoggedRecord) -> None:
         if not self._setup_done:
             self._setup()
         rec = record.record
-        if not self.index.should_index_record_type(rec.record_type):
-            return
-        if not self.index.should_index_value_type(rec.value_type):
+        if not self._should_index(record):
+            # direct callers without a director-side filter: drop but ack
+            self._bulk_last_position = record.position
+            if not self._bulk:
+                self.controller.update_last_exported_position(record.position)
             return
         doc = rec.to_json_dict()
         doc["position"] = record.position
@@ -257,15 +295,8 @@ class ElasticsearchExporter(Exporter):
         if not self._bulk:
             return
         payload = "\n".join(self._bulk) + "\n"
-        from zeebe_tpu.utils.metrics import REGISTRY
-
-        REGISTRY.histogram(
-            "bulk_size", "records per exporter bulk flush",
-            buckets=(1, 10, 100, 500, 1000, 5000)).observe(len(self._bulk) // 2)
-        REGISTRY.histogram(
-            "bulk_memory_size", "bytes per exporter bulk flush",
-            buckets=(1024, 16384, 262144, 1 << 20, 16 << 20)
-        ).observe(len(payload))
+        self._bulk_size_metric.observe(len(self._bulk) // 2)
+        self._bulk_memory_metric.observe(len(payload))
         if self._sink is not None:
             self._sink(payload)
         if self._directory is not None:
@@ -275,7 +306,10 @@ class ElasticsearchExporter(Exporter):
         self._flush_count += 1
         self._bulk.clear()
         self._bulk_bytes = 0
-        self.controller.update_last_exported_position(self._bulk_last_position)
+        self.controller.update_last_exported_position(
+            self._bulk_last_position,
+            metadata=json.dumps(self._counters, separators=(",", ":")).encode(),
+        )
 
     def close(self) -> None:
         self.flush()
@@ -348,7 +382,7 @@ class ElasticsearchExporter(Exporter):
         self._request("PUT", path, payload)
 
     def _request(self, method: str, path: str, body: str) -> None:
-        self.requests.append((method, path, body))
+        self.requests.append((method, path, "" if path == "/_bulk" else body))
         if self._transport is not None:
             self._transport(method, path, self._headers(method, path, body), body)
 
@@ -373,12 +407,22 @@ class OpensearchExporter(ElasticsearchExporter):
     SigV4 request signing for Amazon OpenSearch Service."""
 
     def __init__(self, *args, aws: AwsConfiguration | None = None, **kw) -> None:
-        kw.setdefault("retention", RetentionConfiguration(enabled=False))
+        if kw.get("retention") is not None and kw["retention"].enabled:
+            raise ValueError(
+                "OpenSearch retention is managed by ISM plugins, not ILM; "
+                "the opensearch exporter accepts no retention configuration"
+            )
+        kw["retention"] = RetentionConfiguration(enabled=False)
         super().__init__(*args, **kw)
         self.aws = aws or AwsConfiguration()
 
-    def _put_retention_policy(self) -> None:  # pragma: no cover - defensive
-        raise NotImplementedError("OpenSearch retention is managed by ISM plugins")
+    def configure(self, context: ExporterContext) -> None:
+        if context.configuration.get("retention", {}).get("enabled"):
+            raise ValueError(
+                "OpenSearch retention is managed by ISM plugins, not ILM; "
+                "remove the retention block from the exporter configuration"
+            )
+        super().configure(context)
 
     def _headers(self, method: str, path: str, body: str) -> dict[str, str]:
         headers = super()._headers(method, path, body)

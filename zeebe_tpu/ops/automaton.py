@@ -19,10 +19,11 @@ lock-step on a TPU. Design notes:
   prefix-sum, parallel-join arrivals are ranked with a stable sort so exactly
   the completing arrival proceeds — the NUMBER_OF_TAKEN_SEQUENCE_FLOWS
   counters live in a dense [instances, elements] array.
-- **Conditions** run on a vectorized stack VM over per-instance float32
-  variable slots (compile_condition), so exclusive-gateway routing needs no
-  host round trip.
-- **TPU mapping**: everything is static-shaped, int32/float32, and fuses into
+- **Conditions** run on a vectorized stack VM over per-instance order-key
+  variable slots (compile_condition) — two int32 planes carrying IEEE-754
+  total-order keys, bit-exact against the host float64 evaluator — so
+  exclusive-gateway routing needs no host round trip.
+- **TPU mapping**: everything is static-shaped, pure int32, and fuses into
   a handful of XLA kernels; gathers/scatters ride the VPU while the MXU stays
   free for future DMN/decision-table batch evaluation. Scaling over a mesh is
   data-parallel over instances (see zeebe_tpu.parallel.mesh) — the partition
@@ -160,11 +161,17 @@ def make_state(
         block = slice(s * Tl, s * Tl + Il)
         elem[block] = tables.start_elem[def_of[s * Il : (s + 1) * Il]]
         inst[block] = np.arange(Il, dtype=np.int32)
-    slots = (
-        np.asarray(initial_slots, np.float32)
-        if initial_slots is not None
-        else np.zeros((I, S), np.float32)
-    )
+    if initial_slots is None:
+        slots = np.zeros((I, S, 2), np.int32)
+    else:
+        arr = np.asarray(initial_slots)
+        if arr.ndim == 3 and arr.dtype == np.int32:
+            slots = arr  # pre-packed (hi, lo) order-key planes
+        else:
+            # float convenience input: pack to order-key planes
+            from zeebe_tpu.ops.tables import pack_slot_values
+
+            slots = pack_slot_values(arr)
     return {
         "elem": jnp.asarray(elem),
         "phase": jnp.asarray(phase),
@@ -186,36 +193,51 @@ def make_state(
 
 
 def _eval_program(ops: jax.Array, args: jax.Array, slots: jax.Array) -> jax.Array:
-    """Evaluate one condition program against one instance's slots → bool."""
+    """Evaluate one condition program against one instance's slots → bool.
+
+    Values are 64-bit order keys carried as (hi, lo) int32 planes
+    (tables.f64_key_planes): comparisons are lexicographic over the planes,
+    hence BIT-EXACT against the host's float64 FEEL evaluator. Booleans are
+    (0|1, 0). Arithmetic never reaches the device (compile_condition
+    host-escapes it), so the VM has only push/compare/bool/negate ops."""
 
     def body(i, carry):
-        stack, sp = carry
+        stack, sp = carry  # stack: [DEPTH, 2] int32
         op = ops[i]
-        arg = args[i]
-        push_val = jnp.where(op == OP_PUSH_VAR, slots[arg.astype(jnp.int32)], arg)
+        arg = args[i]  # (hi, lo)
+        push_val = jnp.where(op == OP_PUSH_VAR, slots[arg[0]], arg)
         a = stack[jnp.maximum(sp - 2, 0)]
         b = stack[jnp.maximum(sp - 1, 0)]
-        bin_val = jnp.select(
+        # lexicographic order over (hi, lo); both planes are sign-biased so
+        # plain signed int32 comparison gives the unsigned half order
+        lt = (a[0] < b[0]) | ((a[0] == b[0]) & (a[1] < b[1]))
+        eq = (a[0] == b[0]) & (a[1] == b[1])
+        bool_hi = jnp.select(
             [
                 op == OP_LT, op == OP_LE, op == OP_GT, op == OP_GE,
                 op == OP_EQ, op == OP_NE, op == OP_AND, op == OP_OR,
-                op == OP_ADD, op == OP_SUB, op == OP_MUL, op == OP_DIV,
             ],
             [
-                (a < b).astype(jnp.float32), (a <= b).astype(jnp.float32),
-                (a > b).astype(jnp.float32), (a >= b).astype(jnp.float32),
-                (jnp.abs(a - b) < 1e-9).astype(jnp.float32),
-                (jnp.abs(a - b) >= 1e-9).astype(jnp.float32),
-                jnp.minimum(a, b), jnp.maximum(a, b),
-                a + b, a - b, a * b,
-                jnp.where(b != 0, a / jnp.where(b == 0, 1.0, b), 0.0),
+                lt, lt | eq, ~(lt | eq), ~lt,
+                eq, ~eq,
+                (a[0] > 0) & (b[0] > 0), (a[0] > 0) | (b[0] > 0),
             ],
-            default=jnp.float32(0.0),
+            default=False,
+        ).astype(jnp.int32)
+        bin_val = jnp.stack([bool_hi, jnp.int32(0)])
+        # NOT flips a boolean; NEG negates an order key (bitwise NOT of the
+        # unbiased halves = -1 - x in the sign-biased planes). Zero stays
+        # zero: key(+0.0) is (0, INT32_MIN) — the sign bit of the f64 maps
+        # to hi's bias and the empty mantissa to lo's — and negating it
+        # would mint key(-0.0), which compares strictly below it.
+        is_zero = (b[0] == 0) & (b[1] == jnp.int32(-(2**31)))
+        neg_val = jnp.where(
+            is_zero, b, jnp.stack([-1 - b[0], -1 - b[1]])
         )
-        un_val = jnp.select(
-            [op == OP_NOT, op == OP_NEG],
-            [1.0 - jnp.minimum(b, 1.0), -b],
-            default=jnp.float32(0.0),
+        un_val = jnp.where(
+            op == OP_NOT,
+            jnp.stack([1 - jnp.minimum(b[0], 1), jnp.int32(0)]),
+            neg_val,
         )
         is_push = (op == OP_PUSH_CONST) | (op == OP_PUSH_VAR)
         is_un = (op == OP_NOT) | (op == OP_NEG)
@@ -230,9 +252,9 @@ def _eval_program(ops: jax.Array, args: jax.Array, slots: jax.Array) -> jax.Arra
         sp = sp + jnp.where(is_push, 1, jnp.where(is_bin, -1, 0))
         return stack, sp
 
-    stack0 = jnp.zeros(STACK_DEPTH, jnp.float32)
+    stack0 = jnp.zeros((STACK_DEPTH, 2), jnp.int32)
     stack, sp = jax.lax.fori_loop(0, MAX_PROG_LEN, body, (stack0, jnp.int32(0)))
-    return stack[jnp.maximum(sp - 1, 0)] > 0.5
+    return stack[jnp.maximum(sp - 1, 0), 0] > 0
 
 
 # vmapped over (program_id per request, slots per request)
@@ -645,6 +667,11 @@ def complete_jobs(state: dict, token_slots: jax.Array, result_slots: jax.Array |
     new_state = dict(state)
     new_state["phase"] = phase
     if result_slots is not None and result_values is not None:
+        vals = np.asarray(result_values)
+        if vals.dtype != np.int32 or vals.ndim != 2:
+            from zeebe_tpu.ops.tables import pack_slot_values
+
+            vals = pack_slot_values(vals)  # float convenience → key planes
         inst = state["inst"][token_slots]
-        new_state["var_slots"] = state["var_slots"].at[inst, result_slots].set(result_values)
+        new_state["var_slots"] = state["var_slots"].at[inst, result_slots].set(vals)
     return new_state

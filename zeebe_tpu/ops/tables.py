@@ -4,7 +4,9 @@ This is the TPU-native re-expression of the reference's per-record interpreter
 (BASELINE.json north star): at deploy time each process graph is lowered to
 static int32 arrays — element opcodes, CSR flow adjacency, join arities — and
 every FEEL sequence-flow condition is compiled to a fixed-length stack program
-over per-instance float32 variable slots. The automaton kernel
+over per-instance variable slots holding 64-bit IEEE-754 total-order keys as
+two int32 planes — device comparisons are bit-exact against the host's
+float64 FEEL evaluator. The automaton kernel
 (zeebe_tpu.ops.automaton) then advances thousands of instances lock-step with
 no Python in the loop: a token's behavior is a predicated gather over these
 tables, the BpmnElementProcessor switch becomes masked vector ops.
@@ -78,95 +80,227 @@ class SlotMap:
         return max(1, len(self.names))
 
 
-# interned string ids live at STR_ID_BASE + k: exactly representable in
-# float32 (integers are exact up to 2^24) and far from realistic business
-# numerics; the sentinel marks a runtime string the tables never saw — it
-# compares unequal to every literal, matching host FEEL semantics
-STR_ID_BASE = float(1 << 23)
-STR_ID_UNKNOWN = -STR_ID_BASE
+# ---------------------------------------------------------------------------
+# Exact slot encoding: every slot value is a 64-bit ORDER KEY split into two
+# int32 planes (hi, lo). Numeric values use the IEEE-754 total-order key of
+# their float64 bits, so device comparisons are BIT-EXACT against the host's
+# float64 FEEL evaluator — there is no float32 rounding anywhere on the
+# device path. String values use their interned id (assigned in sorted
+# order, so id order == lexicographic order for strings the tables know).
+# Arithmetic inside conditions cannot run in key space and host-escapes the
+# gateway instead (ConditionNotCompilable), which is what deletes the old
+# "float32 within ~1e-7 of the boundary" divergence.
+
+_U64 = np.uint64
+_SIGN64 = _U64(1) << _U64(63)
+_BIAS32 = np.uint32(0x80000000)
+
+# String encoding: literal j (sorted order) → key 2j; a runtime string the
+# tables never saw → 2·bisect(literals, s) − 1, i.e. an ODD key strictly
+# between its lexicographic neighbors. Every comparison of a variable
+# against a LITERAL is then exact (EQ: odd keys never equal even literal
+# keys; order: insertion rank sits on the correct side of every literal).
+# Var-vs-var string comparisons never lower: the compiler only types a slot
+# "str" when the comparison's other side is a string literal, so `a = b`
+# types both as numeric — admission then declines string values (or the
+# gateway host-escapes on a kind conflict). Two unknown strings therefore
+# never meet on device, where their colliding odd keys would diverge.
+
+
+def f64_key_planes(x: float) -> tuple[int, int]:
+    """float64 → (hi, lo) int32 planes of its total-order key. Monotone:
+    x < y  ⟺  (hi_x, lo_x) < (hi_y, lo_y) lexicographically (signed)."""
+    v = np.float64(x)
+    if np.isnan(v):
+        raise ValueError("NaN has no order key")
+    if v == 0.0:
+        v = np.float64(0.0)  # canonicalize -0.0
+    b = v.view(_U64)
+    k = ~b if (b & _SIGN64) else (b | _SIGN64)
+    hi = np.int32((np.uint32(k >> _U64(32)) ^ _BIAS32).astype(np.int32))
+    lo = np.int32((np.uint32(k & _U64(0xFFFFFFFF)) ^ _BIAS32).astype(np.int32))
+    return int(hi), int(lo)
+
+
+def pack_slot_values(values: np.ndarray) -> np.ndarray:
+    """Vectorized ``f64_key_planes``: float array [...] → int32 [..., 2]."""
+    v = np.asarray(values, np.float64)
+    v = np.where(v == 0.0, 0.0, v)  # canonicalize -0.0
+    b = v.view(_U64)
+    neg = (b & _SIGN64).astype(bool)
+    k = np.where(neg, ~b, b | _SIGN64)
+    hi = ((k >> _U64(32)).astype(np.uint32) ^ _BIAS32).astype(np.int32)
+    lo = ((k & _U64(0xFFFFFFFF)).astype(np.uint32) ^ _BIAS32).astype(np.int32)
+    return np.stack([hi, lo], axis=-1)
+
+
+def str_key_planes(interned_id: int) -> tuple[int, int]:
+    """Interned string id → (hi, lo) planes: literal j maps to key 2j (the
+    odd keys in between belong to unknown runtime strings)."""
+    return 2 * int(interned_id), 0
 
 
 @dataclasses.dataclass
 class StringInterner:
     """String literal → device id (the host variable-store ↔ device-slot
     split of SURVEY §7 hard part (c): documents stay host-side; conditions
-    read prefetched slots holding either the numeric value or the interned
-    id of the string value)."""
+    read prefetched slots holding either the numeric order key or the
+    interned id of the string value). Ids are assigned in SORTED order over
+    the full literal set (compile_tables pre-pass), so id comparisons agree
+    with lexicographic string comparisons for known strings."""
 
     ids: dict[str, int] = dataclasses.field(default_factory=dict)
+    _sorted: list[str] = dataclasses.field(default_factory=list)
 
-    def intern(self, value: str) -> float:
-        if value not in self.ids:
-            self.ids[value] = len(self.ids)
-        return STR_ID_BASE + self.ids[value]
+    def intern_sorted(self, values: set[str]) -> None:
+        """Assign ids for the whole literal set at once, lexicographically."""
+        self._sorted = sorted(values | set(self.ids))
+        for i, v in enumerate(self._sorted):
+            self.ids[v] = i
 
-    def id_of(self, value: str) -> float:
-        """Runtime lookup: unseen strings get the never-equal sentinel."""
+    def intern(self, value: str) -> int:
         idx = self.ids.get(value)
-        return STR_ID_UNKNOWN if idx is None else STR_ID_BASE + idx
+        if idx is None:
+            raise ConditionNotCompilable(
+                f"string literal {value!r} missing from the interner pre-pass"
+            )
+        return idx
+
+    def id_of(self, value: str) -> int | None:
+        """Runtime lookup: None = the tables never saw this string."""
+        return self.ids.get(value)
+
+    def order_key_of(self, value: str) -> tuple[int, bool]:
+        """Runtime string → (order-key hi plane, known). Known literal j →
+        2j; unknown → the odd insertion-rank key between its neighbors."""
+        import bisect
+
+        idx = self.ids.get(value)
+        if idx is not None:
+            return 2 * idx, True
+        return 2 * bisect.bisect_left(self._sorted, value) - 1, False
+
+
+def collect_condition_strings(ast) -> set[str]:
+    """Pre-pass: every string literal in a condition AST (the interner
+    assigns sorted ids over the union before compilation)."""
+    out: set[str] = set()
+
+    def walk(node) -> None:
+        if isinstance(node, F.Lit) and isinstance(node.value, str):
+            out.add(node.value)
+        elif isinstance(node, F.Bin):
+            walk(node.left)
+            walk(node.right)
+        elif isinstance(node, F.Unary):
+            walk(node.operand)
+        elif isinstance(node, F.Call):
+            for a in node.args:
+                walk(a)
+
+    walk(ast)
+    return out
 
 
 def compile_condition(ast, slots: SlotMap,
-                      interner: StringInterner | None = None) -> list[tuple[int, float]]:
-    """Lower a FEEL AST to a postfix stack program; raises
-    ConditionNotCompilable for constructs outside the device subset.
-    String equality/inequality compiles via interned ids (``status = "ok"``
-    → EQ(slot, id)); other string operations stay host-side."""
-    prog: list[tuple[int, float]] = []
+                      interner: StringInterner | None = None,
+                      ) -> list[tuple[int, int, int]]:
+    """Lower a FEEL AST to a postfix stack program over (hi, lo) order-key
+    planes. Raises ConditionNotCompilable for constructs outside the device
+    subset.
+
+    The compile is TYPED: comparisons take value operands (variable slots,
+    numeric/string/bool literals) and produce booleans; and/or/not take
+    booleans only (matching host FEEL semantics, where `1.0 and true` is
+    null — the old untyped min/max lowering silently diverged there).
+    Arithmetic (+ - * /) cannot run in order-key space and host-escapes —
+    which is exactly what makes every device comparison bit-exact against
+    the host float64 evaluator."""
+    prog: list[tuple[int, int, int]] = []
 
     def is_str_lit(node) -> bool:
         return isinstance(node, F.Lit) and isinstance(node.value, str)
 
-    def emit_str_operand(node) -> None:
-        if is_str_lit(node):
-            if interner is None:
-                raise ConditionNotCompilable("string literal (no interner)")
-            prog.append((OP_PUSH_CONST, interner.intern(node.value)))
-        elif isinstance(node, F.Var) and len(node.path) == 1:
-            prog.append((OP_PUSH_VAR, float(slots.slot(node.path[0], kind="str"))))
-        else:
-            raise ConditionNotCompilable("string comparison operand")
-
-    def emit(node) -> None:
+    def emit_value(node) -> str:
+        """Emit a value operand; returns its kind: 'num' or 'str'."""
         if isinstance(node, F.Lit):
             v = node.value
             if isinstance(v, bool):
-                prog.append((OP_PUSH_CONST, 1.0 if v else 0.0))
-            elif isinstance(v, (int, float)):
-                prog.append((OP_PUSH_CONST, float(v)))
-            else:
-                raise ConditionNotCompilable(f"literal {v!r}")
-        elif isinstance(node, F.Var):
+                prog.append((OP_PUSH_CONST, *f64_key_planes(1.0 if v else 0.0)))
+                return "num"
+            if isinstance(v, (int, float)):
+                prog.append((OP_PUSH_CONST, *f64_key_planes(float(v))))
+                return "num"
+            if isinstance(v, str):
+                if interner is None:
+                    raise ConditionNotCompilable("string literal (no interner)")
+                prog.append((OP_PUSH_CONST, *str_key_planes(interner.intern(v))))
+                return "str"
+            raise ConditionNotCompilable(f"literal {v!r}")
+        if isinstance(node, F.Var):
             if len(node.path) != 1:
                 raise ConditionNotCompilable(f"path {node.path}")
-            prog.append((OP_PUSH_VAR, float(slots.slot(node.path[0], kind="num"))))
-        elif isinstance(node, F.Bin) and node.op in ("=", "!=") and (
-            is_str_lit(node.left) or is_str_lit(node.right)
-        ):
-            emit_str_operand(node.left)
-            emit_str_operand(node.right)
-            prog.append((OP_EQ if node.op == "=" else OP_NE, 0.0))
-        elif isinstance(node, F.Unary):
-            emit(node.operand)
-            prog.append((OP_NEG, 0.0))
-        elif isinstance(node, F.Call) and node.name == "not" and len(node.args) == 1:
-            emit(node.args[0])
-            prog.append((OP_NOT, 0.0))
-        elif isinstance(node, F.Bin):
-            ops = {
-                "<": OP_LT, "<=": OP_LE, ">": OP_GT, ">=": OP_GE,
-                "=": OP_EQ, "!=": OP_NE, "and": OP_AND, "or": OP_OR,
-                "+": OP_ADD, "-": OP_SUB, "*": OP_MUL, "/": OP_DIV,
-            }
-            if node.op not in ops:
-                raise ConditionNotCompilable(f"operator {node.op}")
-            emit(node.left)
-            emit(node.right)
-            prog.append((ops[node.op], 0.0))
-        else:
-            raise ConditionNotCompilable(type(node).__name__)
+            # kind is fixed by the comparison partner via _slot_kind below;
+            # a bare var defaults to numeric
+            prog.append((OP_PUSH_VAR, slots.slot(node.path[0], kind="num"), 0))
+            return "num"
+        if isinstance(node, F.Unary):
+            operand = node.operand
+            if isinstance(operand, F.Lit) and isinstance(operand.value, (int, float)) \
+                    and not isinstance(operand.value, bool):
+                # constant-fold: push the key of the negated literal
+                prog.append((OP_PUSH_CONST, *f64_key_planes(-float(operand.value))))
+                return "num"
+            kind = emit_value(operand)
+            if kind != "num":
+                raise ConditionNotCompilable("unary minus on non-number")
+            prog.append((OP_NEG, 0, 0))
+            return "num"
+        raise ConditionNotCompilable(type(node).__name__)
 
-    emit(ast)
+    def emit_comparison(node) -> None:
+        # a slot is typed "str" ONLY opposite a string literal, so device
+        # programs never compare two string slots with each other (see the
+        # string-encoding note above — unknown odd keys must not meet)
+        str_side = is_str_lit(node.left) or is_str_lit(node.right)
+        if str_side:
+            if interner is None:
+                raise ConditionNotCompilable("string literal (no interner)")
+            for operand in (node.left, node.right):
+                if is_str_lit(operand):
+                    prog.append((OP_PUSH_CONST, *str_key_planes(interner.intern(operand.value))))
+                elif isinstance(operand, F.Var) and len(operand.path) == 1:
+                    prog.append((OP_PUSH_VAR, slots.slot(operand.path[0], kind="str"), 0))
+                else:
+                    raise ConditionNotCompilable("string comparison operand")
+        else:
+            emit_value(node.left)
+            emit_value(node.right)
+        cmp_ops = {"<": OP_LT, "<=": OP_LE, ">": OP_GT, ">=": OP_GE,
+                   "=": OP_EQ, "!=": OP_NE}
+        prog.append((cmp_ops[node.op], 0, 0))
+
+    def emit_bool(node) -> None:
+        if isinstance(node, F.Lit) and isinstance(node.value, bool):
+            prog.append((OP_PUSH_CONST, 1 if node.value else 0, 0))
+            return
+        if isinstance(node, F.Call) and node.name == "not" and len(node.args) == 1:
+            emit_bool(node.args[0])
+            prog.append((OP_NOT, 0, 0))
+            return
+        if isinstance(node, F.Bin):
+            if node.op in ("and", "or"):
+                emit_bool(node.left)
+                emit_bool(node.right)
+                prog.append((OP_AND if node.op == "and" else OP_OR, 0, 0))
+                return
+            if node.op in ("<", "<=", ">", ">=", "=", "!="):
+                emit_comparison(node)
+                return
+            raise ConditionNotCompilable(f"operator {node.op}")
+        raise ConditionNotCompilable(f"non-boolean condition {type(node).__name__}")
+
+    emit_bool(ast)
     if len(prog) > MAX_PROG_LEN:
         raise ConditionNotCompilable(f"program too long ({len(prog)})")
     return prog
@@ -221,9 +355,9 @@ class ProcessTables:
     # embedded sub-process scopes
     scope_start: np.ndarray  # [D, E] int32 (inner none-start of a K_SCOPE, -1)
     in_scope: np.ndarray  # [D, E, E] int8: [d, e, s] = e strictly inside scope s
-    # condition programs
+    # condition programs (order-key planes: args carry (hi, lo) per step)
     cond_ops: np.ndarray  # [C, P] int32
-    cond_args: np.ndarray  # [C, P] float32
+    cond_args: np.ndarray  # [C, P, 2] int32
     # per definition: variable names its DEVICE-compiled conditions read
     # (host-escaped gateways excluded — their variables need no prefetch)
     cond_vars_by_def: list = dataclasses.field(default_factory=list)
@@ -336,8 +470,18 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
         max_fanout = max(max_fanout, 1)
     slots = SlotMap()
     interner = StringInterner()
+    # pre-pass: intern ALL condition string literals in sorted order so id
+    # comparisons agree with lexicographic string order
+    all_strings: set[str] = set()
+    for p in processes:
+        for el in p.elements[1:]:
+            for fidx in el.outgoing:
+                cond = p.flows[fidx].condition
+                if cond is not None:
+                    all_strings |= collect_condition_strings(cond.ast)
+    interner.intern_sorted(all_strings)
     job_types: dict[str, int] = {}
-    cond_programs: list[list[tuple[int, float]]] = []
+    cond_programs: list[list[tuple[int, int, int]]] = []
 
     D = len(processes)
     E = max(len(p.elements) for p in processes)
@@ -455,7 +599,7 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                         cond_programs.append(prog)
                         id_to_name = {v: k for k, v in slots.names.items()}
                         def_vars.update(
-                            id_to_name[int(arg)] for opc, arg in prog
+                            id_to_name[int(hi)] for opc, hi, lo in prog
                             if opc == OP_PUSH_VAR
                         )
             except ConditionNotCompilable:
@@ -480,11 +624,12 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
 
     C = max(1, len(cond_programs))
     cond_ops = np.zeros((C, MAX_PROG_LEN), np.int32)
-    cond_args = np.zeros((C, MAX_PROG_LEN), np.float32)
+    cond_args = np.zeros((C, MAX_PROG_LEN, 2), np.int32)
     for ci, prog in enumerate(cond_programs):
-        for pi, (op, arg) in enumerate(prog):
+        for pi, (op, hi, lo) in enumerate(prog):
             cond_ops[ci, pi] = op
-            cond_args[ci, pi] = arg
+            cond_args[ci, pi, 0] = hi
+            cond_args[ci, pi, 1] = lo
 
     return ProcessTables(
         kernel_op=kernel_op,

@@ -58,6 +58,11 @@ def _parse_contacts(spec: str) -> dict[str, tuple[str, int]]:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from zeebe_tpu.utils.zlogging import configure_logging
+
+    # ZEEBE_LOG_APPENDER=stackdriver selects the JSON layout; ZEEBE_LOG_LEVEL
+    # binds the zeebe_tpu logger hierarchy (reference: dist log4j2.xml)
+    configure_logging()
     parser = argparse.ArgumentParser(prog="zeebe-tpu-broker")
     parser.add_argument("--port", type=int, default=26500)
     parser.add_argument("--partitions", type=int, default=1)
