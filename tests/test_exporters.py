@@ -358,3 +358,46 @@ class TestExporterDepth:
         bulk_headers = next(h for (p, h) in sent if p == "/_bulk")
         assert bulk_headers["Authorization"].startswith("AWS4-HMAC-SHA256 Credential=AK/")
         assert "x-amz-date" in bulk_headers and "x-amz-content-sha256" in bulk_headers
+
+
+class TestAtomicPositionMetadata:
+    def test_position_and_metadata_persist_in_one_transaction(self, harness):
+        """A crash between the metadata write and the position write would
+        leave sequence counters ahead of the acked position — the controller
+        must hand both to the host in ONE call, persisted in one txn."""
+        state = ExportersState(harness.db)
+        txn_spans = []
+        real_txn = harness.db.transaction
+
+        def spying_txn(*a, **kw):
+            txn_spans.append(0)
+            return real_txn(*a, **kw)
+
+        harness.db.transaction = spying_txn
+        try:
+            state.set_position_and_metadata("x", 7, b"meta")
+        finally:
+            harness.db.transaction = real_txn
+        assert len(txn_spans) == 1
+        assert state.position("x") == 7
+        assert state.metadata("x") == b"meta"
+
+    def test_exporter_ack_with_metadata_lands_atomically(self, harness):
+        class MetaExporter(Exporter):
+            def export(self, record):
+                self.controller.update_last_exported_position(
+                    record.position, metadata=b"seq-state")
+
+        state = ExportersState(harness.db)
+        calls = []
+        orig = state.set_position_and_metadata
+        state.set_position_and_metadata = lambda *a: (calls.append(a), orig(*a))
+        director = ExporterDirector(harness.stream, harness.db, {"m": MetaExporter()})
+        # the director builds its own ExportersState; patch the container's
+        for c in director.containers:
+            c.state.set_position_and_metadata = state.set_position_and_metadata
+        harness.deploy(one_task())
+        harness.create_instance("p")
+        director.export_available()
+        assert calls  # the combined path was used, not split writes
+        assert all(a[2] == b"seq-state" for a in calls)

@@ -108,3 +108,21 @@ class TestLoggerHierarchy:
         Loggers.exporter_logger("es").warning("lag")
         assert json.loads(buf.getvalue().strip())["context"]["loggerName"] \
             == "zeebe_tpu.broker.exporter.es"
+
+
+class TestLevelMapping:
+    def test_trace_maps_to_debug(self):
+        buf = io.StringIO()
+        configure_logging(appender="console", level="trace", stream=buf)
+        Loggers.SYSTEM.debug("trace shown")
+        assert "trace shown" in buf.getvalue()
+
+    def test_unknown_level_falls_back_to_info(self):
+        # getattr-based resolution once mapped arbitrary logging-module
+        # attributes (e.g. raiseExceptions → setLevel(True)); unknown names
+        # must fall back to INFO instead
+        buf = io.StringIO()
+        configure_logging(appender="console", level="raiseExceptions", stream=buf)
+        import logging as _logging
+
+        assert _logging.getLogger("zeebe_tpu").level == _logging.INFO

@@ -32,17 +32,43 @@ class ExporterController:
     updateLastExportedRecordPosition(position, metadata) and readMetadata;
     ExporterContainer implements it)."""
 
-    def __init__(self, on_position: Callable[[int], None],
+    def __init__(self, on_position: Callable[..., None],
                  schedule: Callable[[int, Callable[[], None]], Any] | None = None,
                  on_metadata: Callable[[bytes], None] | None = None,
                  read_metadata: Callable[[], bytes | None] | None = None) -> None:
+        import inspect
+
         self._on_position = on_position
+        # a two-parameter on_position receives (position, metadata) in ONE
+        # call so the host can persist both atomically; single-parameter
+        # callbacks (tests / custom hosts) keep the split delivery
+        try:
+            params = inspect.signature(on_position).parameters.values()
+            positional = [
+                p for p in params
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD,
+                              p.VAR_POSITIONAL)
+            ]
+            # only positional capacity counts: `lambda p, **kw` is still a
+            # one-arg callback under the old Callable[[int], None] contract
+            self._atomic = (len(positional) >= 2
+                            or any(p.kind == p.VAR_POSITIONAL for p in positional))
+        except (TypeError, ValueError):
+            self._atomic = False
         self._schedule = schedule
         self._on_metadata = on_metadata
         self._read_metadata = read_metadata
 
     def update_last_exported_position(self, position: int,
                                       metadata: bytes | None = None) -> None:
+        # position and metadata must land atomically where the host supports
+        # it: a crash between two separate writes would leave sequence
+        # counters ahead of the acked position, and re-exported records would
+        # re-index with different sequences (reference: ExporterContainer
+        # persists both in the ExportersState in one transaction)
+        if self._atomic:
+            self._on_position(position, metadata)
+            return
         if metadata is not None and self._on_metadata is not None:
             self._on_metadata(metadata)
         self._on_position(position)

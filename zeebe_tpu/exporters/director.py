@@ -136,7 +136,7 @@ class ExporterContainer:
         self.last_delivered = self.position
         exporter.configure(ExporterContext(exporter_id, configuration or {}))
         exporter.open(ExporterController(
-            self._update_position,
+            self._update_position,  # (position, metadata): atomic persist
             on_metadata=lambda data: state.set_metadata(exporter_id, data),
             read_metadata=lambda: state.metadata(exporter_id),
         ))
@@ -160,10 +160,14 @@ class ExporterContainer:
             self._update_position(position)
         self.last_delivered = max(self.last_delivered, position)
 
-    def _update_position(self, position: int) -> None:
+    def _update_position(self, position: int,
+                         metadata: bytes | None = None) -> None:
         if position > self.position:
             self.position = position
-            self.state.set_position(self.exporter_id, position)
+            self.state.set_position_and_metadata(
+                self.exporter_id, position, metadata)
+        elif metadata is not None:
+            self.state.set_metadata(self.exporter_id, metadata)
 
 
 class ExportersState:
@@ -181,6 +185,15 @@ class ExportersState:
     def set_position(self, exporter_id: str, position: int) -> None:
         with self.db.transaction():
             self._cf.put((exporter_id,), position)
+
+    def set_position_and_metadata(self, exporter_id: str, position: int,
+                                  metadata: bytes | None) -> None:
+        """Both rows in ONE transaction: a crash must never persist advanced
+        sequence counters without the position they were advanced for."""
+        with self.db.transaction():
+            self._cf.put((exporter_id,), position)
+            if metadata is not None:
+                self._cf.put(("__meta__", exporter_id), metadata)
 
     def metadata(self, exporter_id: str) -> bytes | None:
         with self.db.transaction():

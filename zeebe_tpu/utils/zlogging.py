@@ -77,6 +77,20 @@ _CONSOLE_PATTERN = (
     "%(asctime)s.%(msecs)03d [%(threadName)s] %(levelname)-5s %(name)s - %(message)s"
 )
 
+# explicit name → level map (reference log4j2 accepts TRACE; and resolving
+# arbitrary env strings via getattr(logging, …) could hit unrelated module
+# attributes like raiseExceptions). Unknown names fall back to INFO.
+_LEVELS = {
+    "TRACE": logging.DEBUG,  # python logging has no TRACE tier
+    "DEBUG": logging.DEBUG,
+    "INFO": logging.INFO,
+    "WARN": logging.WARNING,
+    "WARNING": logging.WARNING,
+    "ERROR": logging.ERROR,
+    "FATAL": logging.CRITICAL,
+    "CRITICAL": logging.CRITICAL,
+}
+
 
 def configure_logging(appender: str | None = None, level: str | None = None,
                       service_name: str | None = None,
@@ -102,7 +116,7 @@ def configure_logging(appender: str | None = None, level: str | None = None,
     for old in list(root.handlers):
         root.removeHandler(old)
     root.addHandler(handler)
-    root.setLevel(getattr(logging, level_name, logging.INFO))
+    root.setLevel(_LEVELS.get(level_name.upper(), logging.INFO))
     root.propagate = False
     return handler
 
