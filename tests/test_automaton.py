@@ -364,3 +364,46 @@ class TestConditionVmRegressions:
 
         with _pytest.raises(ValueError, match="devices are available"):
             make_mesh(len(jax.devices()) + 1)
+
+
+class TestSlotPlaneCoercion:
+    def test_int64_prepacked_planes_coerce(self):
+        """Python-int plane tuples build int64 arrays on Linux; they must
+        coerce to int32 planes, not fall into the float packer (which would
+        reinterpret plane integers as float values)."""
+        from zeebe_tpu.ops import automaton
+        from zeebe_tpu.ops.tables import f64_key_planes
+
+        exe = transform(
+            Bpmn.create_executable_process("coerce")
+            .start_event("s")
+            .exclusive_gateway("gw")
+            .condition_expression("x > 5")
+            .end_event("hi")
+            .move_to_element("gw")
+            .default_flow()
+            .end_event("lo")
+            .done()
+        )
+        tables = compile_tables([exe])
+        planes = [[list(f64_key_planes(9.0))]]  # int64 when np.asarray'd
+        import numpy as np
+
+        assert np.asarray(planes).dtype != np.int32  # the trap being tested
+        state = automaton.make_state(tables, 1, np.zeros(1, np.int32),
+                                     initial_slots=planes)
+        dt = automaton.DeviceTables.from_tables(tables)
+        state, _ = automaton.run_to_completion(dt, state)
+        # x = 9 > 5 routes to "hi": exactly one pass through element "hi"
+        assert int(state["completed"]) == 1
+
+    def test_float_planes_rejected(self):
+        import numpy as np
+        import pytest as _pytest
+
+        from zeebe_tpu.ops.automaton import _coerce_slot_planes
+
+        with _pytest.raises(ValueError):
+            _coerce_slot_planes(np.zeros((1, 1, 2), np.float64))
+        with _pytest.raises(ValueError):
+            _coerce_slot_planes(np.zeros((1, 1, 3), np.int64))

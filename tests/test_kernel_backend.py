@@ -1721,3 +1721,60 @@ class TestExactConditionParity:
                 h.create_instance("arith", {"x": x}, request_id=900 + i)
 
         assert_equivalent(scenario)
+
+
+class TestBigIntParity:
+    def test_ints_beyond_f64_precision_route_identically(self):
+        # host FEEL compares Python ints exactly; 2^53 and 2^53+1 collapse
+        # to ONE float64 — such values must decline admission (variable) or
+        # host-escape (literal) instead of riding a rounded order key
+        big = (1 << 53) + 1
+
+        def proc():
+            return (
+                Bpmn.create_executable_process("bigint")
+                .start_event("s")
+                .exclusive_gateway("gw")
+                .condition_expression(f"x = {big}")
+                .service_task("hit", job_type="hit")
+                .end_event("e1")
+                .move_to_element("gw")
+                .default_flow()
+                .service_task("miss", job_type="miss")
+                .end_event("e2")
+                .done()
+            )
+
+        def scenario(h):
+            h.deploy(proc())
+            for i, x in enumerate([big, 1 << 53, (1 << 53) - 1, float(1 << 53)]):
+                h.create_instance("bigint", {"x": x}, request_id=900 + i)
+            drive_jobs(h, "hit")
+            drive_jobs(h, "miss")
+
+        assert_equivalent(scenario)
+
+    def test_big_int_variable_declines_even_with_small_literal(self):
+        # literal fits f64, variable does not: the admission check (not the
+        # compiler) must catch it — x > 10 with x = 2^53 + 1 is exact either
+        # way, but x = 2^53+1 vs a 2^53+1 neighbor comparison would not be;
+        # decline is by value, so parity holds for every mixture
+        def proc():
+            return (
+                Bpmn.create_executable_process("bigvar")
+                .start_event("s")
+                .exclusive_gateway("gw")
+                .condition_expression("x > 10")
+                .end_event("e1")
+                .move_to_element("gw")
+                .default_flow()
+                .end_event("e2")
+                .done()
+            )
+
+        def scenario(h):
+            h.deploy(proc())
+            for i, x in enumerate([(1 << 53) + 1, -((1 << 53) + 1), 11, 10]):
+                h.create_instance("bigvar", {"x": x}, request_id=910 + i)
+
+        assert_equivalent(scenario)

@@ -1,0 +1,308 @@
+"""Device-side call activities (VERDICT r3 item 3): statically-resolvable
+call activities inline into the caller's table set as scope regions
+(kernel_backend._inline_call_activities) — the call executes on the device
+with byte parity against the sequential engine (reference:
+engine/…/processing/bpmn/container/CallActivityProcessor.java)."""
+
+from __future__ import annotations
+
+from zeebe_tpu.models.bpmn import Bpmn
+from zeebe_tpu.testing import EngineHarness
+
+from tests.test_kernel_backend import (
+    assert_equivalent,
+    drive_jobs,
+    log_fingerprint,
+    run_scenario,
+)
+
+
+def child_tasks(pid="child", job="cw"):
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("cs")
+        .service_task("ct", job_type=job)
+        .end_event("ce")
+        .done()
+    )
+
+
+def child_passthrough(pid="child_pass"):
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("cs")
+        .manual_task("cm")
+        .end_event("ce")
+        .done()
+    )
+
+
+def caller(pid="caller", called="child"):
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .service_task("before", job_type="bw")
+        .call_activity("call", process_id=called)
+        .service_task("after", job_type="aw")
+        .end_event("e")
+        .done()
+    )
+
+
+def caller_chain(pid="chain"):
+    """Two call activities in sequence (a call-activity chain)."""
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .call_activity("call1", process_id="child")
+        .call_activity("call2", process_id="child_pass")
+        .end_event("e")
+        .done()
+    )
+
+
+class TestCallInlineParity:
+    def test_passthrough_child_creation_burst(self):
+        # child with no wait states: the whole call (activation, child run,
+        # propagation, completion, continuation) lands in ONE creation burst
+        def scenario(h):
+            h.deploy(child_passthrough())
+            h.deploy(
+                Bpmn.create_executable_process("p")
+                .start_event("s")
+                .call_activity("call", process_id="child_pass")
+                .end_event("e")
+                .done()
+            )
+            for i in range(3):
+                h.create_instance("p", {"v": i}, request_id=10 + i)
+
+        assert_equivalent(scenario)
+
+    def test_call_with_job_in_child(self):
+        # the child parks at a job; its completion resumes the TOP instance
+        # and the call return (propagation + caller continuation) rides the
+        # device
+        def scenario(h):
+            h.deploy(child_tasks())
+            h.deploy(caller())
+            h.create_instance("caller", {"x": 1}, request_id=1)
+            drive_jobs(h, "bw")
+            drive_jobs(h, "cw", {"result": 41})
+            drive_jobs(h, "aw")
+
+        assert_equivalent(scenario)
+
+    def test_call_activity_chain(self):
+        def scenario(h):
+            h.deploy(child_tasks())
+            h.deploy(child_passthrough())
+            h.deploy(caller_chain())
+            h.create_instance("chain", request_id=5)
+            drive_jobs(h, "cw")
+
+        assert_equivalent(scenario)
+
+    def test_nested_calls(self):
+        # A calls B calls C: two levels of inlining in one table set
+        def scenario(h):
+            h.deploy(child_tasks("leaf", job="leafw"))
+            h.deploy(
+                Bpmn.create_executable_process("mid")
+                .start_event("ms")
+                .call_activity("mcall", process_id="leaf")
+                .end_event("me")
+                .done()
+            )
+            h.deploy(
+                Bpmn.create_executable_process("top")
+                .start_event("ts")
+                .call_activity("tcall", process_id="mid")
+                .end_event("te")
+                .done()
+            )
+            h.create_instance("top", request_id=7)
+            drive_jobs(h, "leafw", {"out": 3})
+
+        assert_equivalent(scenario)
+
+    def test_variable_propagation_both_ways(self):
+        # caller variables propagate into the child root at activation;
+        # child-root locals (job results) propagate back at completion
+        def scenario(h):
+            h.deploy(child_tasks())
+            h.deploy(caller())
+            h.create_instance("caller", {"inp": "seed"}, request_id=2)
+            drive_jobs(h, "bw", {"mid": 10})
+            drive_jobs(h, "cw", {"childout": True})
+            drive_jobs(h, "aw")
+
+        assert_equivalent(scenario)
+
+    def test_parallel_callers_interleaved(self):
+        def scenario(h):
+            h.deploy(child_tasks())
+            h.deploy(caller())
+            for i in range(6):
+                h.create_instance("caller", {"i": i}, request_id=100 + i)
+            drive_jobs(h, "bw")
+            drive_jobs(h, "cw")
+            drive_jobs(h, "aw")
+
+        assert_equivalent(scenario)
+
+    def test_fork_with_call_branch(self):
+        # a parallel branch runs beside the call; join after both
+        def scenario(h):
+            h.deploy(child_tasks())
+            h.deploy(
+                Bpmn.create_executable_process("forked")
+                .start_event("s")
+                .parallel_gateway("split")
+                .call_activity("call", process_id="child")
+                .parallel_gateway("join")
+                .end_event("e")
+                .move_to_element("split")
+                .service_task("side", job_type="sidew")
+                .connect_to("join")
+                .done()
+            )
+            h.create_instance("forked", request_id=3)
+            drive_jobs(h, "sidew")
+            drive_jobs(h, "cw")
+
+        assert_equivalent(scenario)
+
+    def test_sub_process_inside_child(self):
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("subchild")
+                .start_event("cs")
+                .sub_process("sub")
+                .start_event("is_")
+                .service_task("inner", job_type="iw")
+                .end_event("ie")
+                .sub_process_done()
+                .end_event("ce")
+                .done()
+            )
+            h.deploy(
+                Bpmn.create_executable_process("p")
+                .start_event("s")
+                .call_activity("call", process_id="subchild")
+                .end_event("e")
+                .done()
+            )
+            h.create_instance("p", request_id=4)
+            drive_jobs(h, "iw")
+
+        assert_equivalent(scenario)
+
+
+class TestCallInlineMechanics:
+    def test_kernel_actually_executes_the_call(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(child_tasks())
+            h.deploy(caller())
+            h.create_instance("caller")  # populates the registry
+            with h.db.transaction():
+                meta = h.engine.state.processes.get_latest_by_id("caller")
+            info = h.kernel_backend.registry.lookup(
+                meta["processDefinitionKey"], None)
+            assert info is not None and info.segments, "call was not inlined"
+            drive_jobs(h, "bw")
+            before = h.kernel_backend.commands_processed
+            drive_jobs(h, "cw")  # child job: resumes the TOP instance
+            drive_jobs(h, "aw")
+            assert h.kernel_backend.commands_processed >= before + 2
+            # no sequential fallback was needed for the child resume
+        finally:
+            h.close()
+
+    def test_stale_segment_falls_back(self):
+        # redeploying the called id after inlining makes segments stale:
+        # commands take the sequential path (correctness preserved)
+        def scenario(h):
+            h.deploy(child_passthrough())
+            h.deploy(
+                Bpmn.create_executable_process("p")
+                .start_event("s")
+                .call_activity("call", process_id="child_pass")
+                .end_event("e")
+                .done()
+            )
+            h.create_instance("p", request_id=1)  # binds v1, inlines
+            # redeploy a CHANGED child (new version); the old inlining is stale
+            h.deploy(
+                Bpmn.create_executable_process("child_pass")
+                .start_event("cs")
+                .manual_task("cm2")
+                .end_event("ce")
+                .done()
+            )
+            h.create_instance("p", request_id=2)  # must run v2 sequentially
+
+        assert_equivalent(scenario)
+
+    def test_caller_with_conditions_keeps_call_host_side(self):
+        # the propagation-taint guard: a caller with flow conditions does not
+        # inline — parity must hold through the host-escape path
+        def scenario(h):
+            h.deploy(child_tasks())
+            h.deploy(
+                Bpmn.create_executable_process("cond_caller")
+                .start_event("s")
+                .exclusive_gateway("gw")
+                .condition_expression("x > 5")
+                .call_activity("call", process_id="child")
+                .end_event("e1")
+                .move_to_element("gw")
+                .default_flow()
+                .end_event("e2")
+                .done()
+            )
+            h.create_instance("cond_caller", {"x": 10}, request_id=1)
+            h.create_instance("cond_caller", {"x": 1}, request_id=2)
+            drive_jobs(h, "cw")
+
+        assert_equivalent(scenario)
+
+    def test_unresolvable_called_id_stays_host(self):
+        def scenario(h):
+            h.deploy(caller(called="nowhere"))
+            h.create_instance("caller", request_id=1)
+            drive_jobs(h, "bw")
+            # incident raised at the call activity (CALLED_ELEMENT_ERROR)
+
+        assert_equivalent(scenario)
+
+    def test_recursive_call_not_inlined(self):
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("rec")
+                .start_event("s")
+                .exclusive_gateway("gw")  # conditions force no-inline anyway,
+                .condition_expression("depth < 1")
+                .call_activity("self_call", process_id="rec")
+                .end_event("e1")
+                .move_to_element("gw")
+                .default_flow()
+                .end_event("e2")
+                .done()
+            )
+            h.create_instance("rec", {"depth": 5}, request_id=1)
+
+        assert_equivalent(scenario)
+
+    def test_terminate_instance_with_inlined_call(self):
+        # cancellation routes sequentially; the call frame's child terminates
+        # through the back-link — state must stay consistent either way
+        def scenario(h):
+            h.deploy(child_tasks())
+            h.deploy(caller())
+            k = h.create_instance("caller", request_id=1)
+            drive_jobs(h, "bw")  # now parked at the child's job
+            h.cancel_instance(k)
+
+        assert_equivalent(scenario)

@@ -169,6 +169,9 @@ def _is_numeric(v: Any) -> bool:
     return isinstance(v, (bool, int, float)) and not isinstance(v, str)
 
 
+from zeebe_tpu.ops.tables import f64_exact as _f64_exact
+
+
 def _safe_mapping_expr(expr) -> bool:
     """True when evaluating the expression can NEVER raise: a static string
     or a bare variable/literal FEEL AST (a missing variable evaluates to
@@ -269,6 +272,12 @@ def check_element_eligibility(exe: ExecutableProcess, el: ExecutableElement) -> 
         # attached boundaries or event sub-processes would need host-side
         # trigger state the scope reconstruction does not collect yet
         return el.child_start_idx >= 0 and not exe.event_sub_processes_of(el.idx)
+    if el.element_type in (BpmnElementType.CALL_ACTIVITY,
+                           BpmnElementType.PROCESS):
+        # only synthetic inlined rows carry a child_start here (the call
+        # activity scope and its child-root placeholder); a plain call
+        # activity host-escapes (_inline_call_activities decides which)
+        return el.child_start_idx >= 0
     if el.element_type == BpmnElementType.EVENT_BASED_GATEWAY:
         # parks on device like a catch; every succeeding catch must hold a
         # wait state the reconstruction counts — and _collect_wait_states
@@ -317,6 +326,157 @@ def check_element_eligibility(exe: ExecutableProcess, el: ExecutableElement) -> 
     return True
 
 
+@dataclass(frozen=True)
+class _CallSegment:
+    """One inlined called process inside a synthetic definition (VERDICT r3
+    item 3; reference: engine/…/processing/bpmn/container/CallActivityProcessor
+    .java — here the called definition's rows are co-resident in the caller's
+    table set, the call activity and a child-root placeholder both lower to
+    K_SCOPE, and the whole call executes on the device)."""
+
+    call_row: int  # synthetic row of the call activity element
+    root_row: int  # synthetic row of the child-root placeholder (= offset)
+    offset: int  # child element idx c → synthetic row offset + c
+    flow_offset: int  # child flow idx f → synthetic flow idx flow_offset + f
+    child_def_key: int  # definition bound at compile (latest at inline time)
+    child_process_id: str
+    child_exe: ExecutableProcess  # the REAL child executable (local idxs)
+
+
+def _shifted_child_elements(child: ExecutableProcess, d_elem: int,
+                            d_flow: int, call_row: int):
+    """Copies of a child definition's elements/flows with indices shifted
+    into the synthetic parent's row space. The child ROOT (idx 0) becomes the
+    child-root placeholder at row d_elem: a non-root PROCESS element whose
+    parent is the call activity row — it parks as a K_SCOPE token standing
+    for the child process instance, so activation/completion decode can
+    delegate to the sequential PROCESS element handlers verbatim."""
+    import dataclasses as _dc
+
+    elements = []
+    for el in child.elements:
+        elements.append(_dc.replace(
+            el,
+            idx=el.idx + d_elem,
+            parent_idx=(call_row if el.idx == 0
+                        else el.parent_idx + d_elem if el.parent_idx >= 0
+                        else -1),
+            outgoing=([] if el.idx == 0 else [f + d_flow for f in el.outgoing]),
+            default_flow_idx=(el.default_flow_idx + d_flow
+                              if el.default_flow_idx >= 0 else -1),
+            attached_to_idx=(el.attached_to_idx + d_elem
+                             if el.attached_to_idx >= 0 else -1),
+            boundary_idxs=[b + d_elem for b in el.boundary_idxs],
+            child_start_idx=(el.child_start_idx + d_elem
+                             if el.child_start_idx >= 0 else -1),
+        ))
+    flows = [
+        _dc.replace(f, idx=f.idx + d_flow, source_idx=f.source_idx + d_elem,
+                    target_idx=f.target_idx + d_elem)
+        for f in child.flows
+    ]
+    return elements, flows
+
+
+_INLINE_MAX_DEPTH = 3
+
+
+def _inline_call_activities(exe: ExecutableProcess, processes,
+                            _depth: int = 0,
+                            _chain: frozenset = frozenset(),
+                            ) -> tuple[ExecutableProcess, list[_CallSegment]]:
+    """Build a synthetic definition with statically-resolvable call
+    activities inlined as scope regions. Returns (exe, []) unchanged when
+    nothing inlines. ``processes`` is the partition's ProcessState.
+
+    A call inlines only when: the called id resolves to a deployed latest
+    version whose executable has a none start and no root-level event
+    sub-processes; the call element itself carries no io mappings, boundary
+    events, or multi-instance marker (those shapes stay host-escaped); and
+    the CALLER has no flow conditions at all — a device-compiled parent
+    condition could mis-route after a child completion propagates variables
+    the admission-time slot prefetch cannot see. Recursion is depth-capped
+    and self-recursive chains stay host-side. Version binding follows the
+    reference (activation-time latest): admission re-checks that each
+    segment's bound key is still the latest and declines to the sequential
+    path otherwise."""
+    import dataclasses as _dc
+    import hashlib as _hashlib
+
+    has_calls = any(
+        el.element_type == BpmnElementType.CALL_ACTIVITY
+        and el.called_process_id is not None
+        for el in exe.elements[1:]
+    )
+    if not has_calls or _depth >= _INLINE_MAX_DEPTH:
+        return exe, []
+    if any(f.condition is not None for f in exe.flows):
+        return exe, []  # propagation-taint guard (see docstring)
+
+    elements = list(exe.elements)
+    flows = list(exe.flows)
+    segments: list[_CallSegment] = []
+    for el in exe.elements[1:]:
+        if (el.element_type != BpmnElementType.CALL_ACTIVITY
+                or el.called_process_id is None
+                or el.called_process_id in _chain
+                or el.multi_instance is not None
+                or el.inputs or el.outputs or el.boundary_idxs):
+            continue
+        meta = processes.get_latest_by_id(el.called_process_id)
+        if meta is None or meta.get("deleted"):
+            continue
+        child = processes.executable(meta["processDefinitionKey"])
+        if child is None or child.none_start_of(0) < 0:
+            continue
+        if child.event_sub_processes_of(0):
+            continue  # root ESP subscriptions need sequential activation
+        if any(f.condition is not None for f in child.flows):
+            # child conditions read CHILD-scope variables the shared slot
+            # prefetch cannot represent — a whole-child decline keeps the
+            # lowering simple (the call stays host-escaped)
+            continue
+        child_syn, child_segs = _inline_call_activities(
+            child, processes, _depth + 1,
+            _chain | {exe.process_id, el.called_process_id},
+        )
+        d_elem, d_flow = len(elements), len(flows)
+        seg_elements, seg_flows = _shifted_child_elements(
+            child_syn, d_elem, d_flow, el.idx)
+        elements.extend(seg_elements)
+        flows.extend(seg_flows)
+        # the call element itself becomes a scope whose inner start is the
+        # placeholder row (the child root), which in turn scopes the child's
+        # none start — the K_SCOPE spawn chain mirrors ACTIVATE(child root)
+        # → ACTIVATE(child none start) exactly
+        elements[el.idx] = _dc.replace(el, child_start_idx=d_elem)
+        segments.append(_CallSegment(
+            call_row=el.idx, root_row=d_elem, offset=d_elem,
+            flow_offset=d_flow,
+            child_def_key=meta["processDefinitionKey"],
+            child_process_id=el.called_process_id,
+            child_exe=child,
+        ))
+        # nested segments shift into this synthetic's row space
+        for s in child_segs:
+            segments.append(_dc.replace(
+                s, call_row=s.call_row + d_elem, root_row=s.root_row + d_elem,
+                offset=s.offset + d_elem, flow_offset=s.flow_offset + d_flow,
+            ))
+    if not segments:
+        return exe, []
+    digest = _hashlib.sha256(
+        (exe.digest + "|" + "|".join(
+            f"{s.child_def_key}:{s.child_exe.digest}" for s in segments
+        )).encode()
+    ).hexdigest()
+    synthetic = ExecutableProcess(
+        process_id=exe.process_id, elements=elements, flows=flows,
+        by_id=exe.by_id, digest=digest,
+    )
+    return synthetic, segments
+
+
 @dataclass
 class _DefInfo:
     index: int
@@ -331,6 +491,33 @@ class _DefInfo:
     # element idxs lowered to K_HOST in the solo compile (forced again in
     # shared recompiles so the lowering stays stable across registrations)
     host_idxs: frozenset[int] = frozenset()
+    # inlined called processes (exe is then SYNTHETIC: parent rows first,
+    # then each segment's child rows); empty for plain definitions
+    segments: tuple = ()
+
+    def segment_of_row(self, row: int):
+        """The segment whose inlined region contains ``row`` (call_row and
+        root_row included), or None for parent rows. Nested segments lie
+        inside their parent's span; the MOST specific (highest offset ≤ row)
+        wins, except that a call_row belongs to the OUTER region (the call
+        element is part of the caller's graph)."""
+        best = None
+        for s in self.segments:
+            if s.call_row == row:
+                # the call element row: governed by the segment that inlined
+                # it (an outer segment with offset ≤ row), not by itself
+                continue
+            if s.offset <= row < s.offset + len(s.child_exe.elements):
+                if best is None or s.offset > best.offset:
+                    best = s
+        return best
+
+    def call_segment(self, row: int):
+        """The segment whose call activity element sits at ``row``, if any."""
+        for s in self.segments:
+            if s.call_row == row:
+                return s
+        return None
 
 
 class KernelRegistry:
@@ -348,7 +535,8 @@ class KernelRegistry:
         self._device_by_dev: dict = {}  # router-chosen backend → DeviceTables
         self._tables_fp: tuple | None = None  # (tables identity, digest)
 
-    def lookup(self, definition_key: int, exe: ExecutableProcess | None) -> _DefInfo | None:
+    def lookup(self, definition_key: int, exe: ExecutableProcess | None,
+               processes=None) -> _DefInfo | None:
         info = self._by_key.get(definition_key)
         if info is not None:
             return info
@@ -356,6 +544,65 @@ class KernelRegistry:
             return None
         if len(self._infos) >= self.max_definitions:
             return None
+        info = self._build_info(definition_key, exe, processes, len(self._infos))
+        if info is None:
+            self._ineligible.add(definition_key)
+            return None
+        self._infos.append(info)
+        self._by_key[definition_key] = info
+        # recompile the SHARED set eagerly: definitions that solo-compile can
+        # still conflict jointly (e.g. one uses a variable numerically, the
+        # other in string comparisons — SlotMap kind clash downgrades the
+        # offending gateway to a host escape in the shared lowering).
+        try:
+            self._tables = self._compile_shared()
+        except ConditionNotCompilable:
+            self._infos.pop()
+            del self._by_key[definition_key]
+            self._ineligible.add(definition_key)
+            self._tables = None  # previous set recompiles lazily
+            return None
+        self._device = None
+        self._device_by_dev.clear()
+        return info
+
+    def refresh_segments(self, definition_key: int, exe, processes):
+        """Re-inline a cached definition whose call segments went stale (a
+        called id was redeployed). In place — the index, which any in-flight
+        group arrays reference, is preserved. On failure the old info stays
+        and admission keeps declining via the freshness check."""
+        old = self._by_key.get(definition_key)
+        if old is None or exe is None:
+            return None
+        new = self._build_info(definition_key, exe, processes, old.index)
+        if new is None:
+            return None
+        self._infos[old.index] = new
+        self._by_key[definition_key] = new
+        try:
+            self._tables = self._compile_shared()
+        except ConditionNotCompilable:
+            self._infos[old.index] = old
+            self._by_key[definition_key] = old
+            self._tables = None
+            return None
+        self._device = None
+        self._device_by_dev.clear()
+        return new
+
+    def _build_info(self, definition_key: int, exe: ExecutableProcess,
+                    processes, index: int) -> _DefInfo | None:
+        """Compile one definition's solo lowering (with call activities
+        inlined when resolvable) into a _DefInfo at ``index``. Returns None
+        when it cannot ride the kernel; callers decide whether that marks
+        the key ineligible (lookup) or keeps the old info (refresh)."""
+        segments: tuple = ()
+        if processes is not None:
+            # statically-resolvable call activities inline as scope regions
+            # (device-side call execution); the synthetic exe replaces the
+            # real one for this definition's tables and trace decode
+            exe, seg_list = _inline_call_activities(exe, processes)
+            segments = tuple(seg_list)
         # elements outside the device subset become host escapes (K_HOST):
         # the device parks any token reaching them and the materializer hands
         # the continuation to the sequential engine — so the definition rides
@@ -365,7 +612,6 @@ class KernelRegistry:
         if exe.none_start_of(0) < 0:
             # only message/timer starts: every creation carries an explicit
             # start element — nothing for the kernel's entry path to run
-            self._ineligible.add(definition_key)
             return None
         if exe.event_sub_processes_of(0):
             # root-level event sub-processes open start-event subscriptions
@@ -374,12 +620,10 @@ class KernelRegistry:
             # reconstruction collects that, so these definitions stay
             # sequential end to end (nested-scope ESPs already force their
             # sub-process host-side via element eligibility)
-            self._ineligible.add(definition_key)
             return None
         try:
             solo = compile_tables([exe], host_idxs=[host])
         except ConditionNotCompilable:
-            self._ineligible.add(definition_key)
             return None
         clock = lambda: 0  # noqa: E731 — static expressions ignore the clock
         job_types: dict[int, str] = {}
@@ -414,8 +658,8 @@ class KernelRegistry:
                     sum(1 for t in ts if t.timer_duration is not None),
                     sum(1 for t in ts if t.message_name is not None),
                 )
-        info = _DefInfo(
-            index=len(self._infos),
+        return _DefInfo(
+            index=index,
             key=definition_key,
             exe=exe,
             job_types=job_types,
@@ -423,24 +667,8 @@ class KernelRegistry:
             join_idxs=join_idxs,
             boundary_waits=boundary_waits,
             host_idxs=effective_host,
+            segments=segments,
         )
-        self._infos.append(info)
-        self._by_key[definition_key] = info
-        # recompile the SHARED set eagerly: definitions that solo-compile can
-        # still conflict jointly (e.g. one uses a variable numerically, the
-        # other in string comparisons — SlotMap kind clash downgrades the
-        # offending gateway to a host escape in the shared lowering).
-        try:
-            self._tables = self._compile_shared()
-        except ConditionNotCompilable:
-            self._infos.pop()
-            del self._by_key[definition_key]
-            self._ineligible.add(definition_key)
-            self._tables = None  # previous set recompiles lazily
-            return None
-        self._device = None
-        self._device_by_dev.clear()
-        return info
 
     def _compile_shared(self) -> ProcessTables:
         return compile_tables(
@@ -543,6 +771,10 @@ class _Inst:
     join_counts: dict[int, int] = field(default_factory=dict)  # elem idx → arrivals
     slots: dict[str, float] = field(default_factory=dict)  # condition variables
     done_emitted: bool = False
+    # every process-instance key this device instance spans (self + call-
+    # activity child frames + ancestors); the group conflict set must cover
+    # them all so one family never resumes twice in one group
+    family_pis: list[int] = field(default_factory=list)
 
 
 @dataclass
@@ -686,8 +918,9 @@ class KernelBackend:
         if meta is None or meta.get("deleted"):
             return None  # sequential path writes the NOT_FOUND rejection
         def_key = meta["processDefinitionKey"]
-        info = self.registry.lookup(def_key, state.processes.executable(def_key))
-        if info is None:
+        info = self.registry.lookup(def_key, state.processes.executable(def_key),
+                                    processes=state.processes)
+        if info is None or not self._segments_fresh(info):
             return None
         variables = value.get("variables") or {}
         slots = self._condition_slots(info, variables)
@@ -699,6 +932,27 @@ class KernelBackend:
         templatable = not (value.get("awaitResult") and cmd.record.request_id >= 0)
         return _Admitted(cmd=cmd, inst=inst, kind="c",
                          fp_docs=[value, meta], templatable=templatable)
+
+    def _segments_fresh(self, info: _DefInfo) -> bool:
+        """Inlined call segments bind the latest called version at compile
+        time; activation resolves latest at ACTIVATION time (reference:
+        CallActivityProcessor) — a newer deploy of a called id makes the
+        inlining stale, so such commands take the sequential path until the
+        registry recompiles."""
+        if not info.segments:
+            return True
+        processes = self.engine.state.processes
+        for seg in info.segments:
+            meta = processes.get_latest_by_id(seg.child_process_id)
+            if meta is None or meta["processDefinitionKey"] != seg.child_def_key:
+                # re-inline against the new latest so FUTURE commands ride
+                # the kernel again; the current command still declines (its
+                # caller already resolved the stale info)
+                self.registry.refresh_segments(
+                    info.key, self.engine.state.processes.executable(info.key),
+                    processes)
+                return False
+        return True
 
     def _reconstruct(self, pi_key: int, info: _DefInfo, resume_key: int):
         """Rebuild a running instance's device tokens from element-instance
@@ -722,26 +976,52 @@ class KernelBackend:
         resume: _Token | None = None
         wait_docs: list = []
         wait_keys: list[int] = []
+        family: list[int] = []  # call-child process instance keys
         # elem idx of a scope (0 = process root) → its instance key: join
         # counters and sub-process drain checks key off the scope instance
         scope_keys: dict[int, int] = {0: pi_key}
         # depth-first walk of the element-instance tree: K_SCOPE children are
-        # parked tokens whose own children are walked recursively
-        pending_walk = sorted(state.element_instances.children_keys(pi_key))
-        for child_key in pending_walk:
+        # parked tokens whose own children are walked recursively. Entries
+        # carry the call segment whose inlined region the instance lives in
+        # (None = the caller's own rows); ids resolve through the segment's
+        # child executable, offset into synthetic rows.
+        pending_walk = [
+            (k, None) for k in sorted(state.element_instances.children_keys(pi_key))
+        ]
+        for child_key, seg in pending_walk:
             child = state.element_instances.get(child_key)
             if child is None or child["state"] != EI_ACTIVATED:
                 return None
             elem_id = child["value"].get("elementId", "")
-            if elem_id not in exe.by_id:
+            id_map = exe.by_id if seg is None else seg.child_exe.by_id
+            if elem_id not in id_map:
                 return None
-            el = exe.element(elem_id)
-            op = self.registry.tables.kernel_op[info.index, el.idx]
+            row = id_map[elem_id] + (0 if seg is None else seg.offset)
+            el = exe.elements[row]
+            op = self.registry.tables.kernel_op[info.index, row]
             if op == K_SCOPE:
-                scope_keys[el.idx] = child_key
-                pending_walk.extend(
-                    sorted(state.element_instances.children_keys(child_key))
-                )
+                call_seg = info.call_segment(row)
+                if call_seg is not None:
+                    # call activity frame: descend into the called child
+                    # instance through the back-link; the child ROOT walks as
+                    # the placeholder row (its elementId — the process id —
+                    # maps to the segment's row 0)
+                    child_pi = child.get("calledChildInstanceKey", -1)
+                    child_root = state.element_instances.get(child_pi)
+                    if child_root is None:
+                        return None
+                    if (child_root["value"].get("processDefinitionKey")
+                            != call_seg.child_def_key):
+                        return None  # instance bound an older called version
+                    family.append(child_pi)
+                    scope_keys[row] = child_key
+                    pending_walk.append((child_pi, call_seg))
+                else:
+                    scope_keys[row] = child_key
+                    pending_walk.extend(
+                        (k, seg)
+                        for k in sorted(state.element_instances.children_keys(child_key))
+                    )
             elif op == K_TASK:
                 if child.get("jobKey", -1) < 0:
                     return None
@@ -802,7 +1082,8 @@ class KernelBackend:
                    for j in info.join_idxs):
                 continue
             return None
-        return tokens, resume, root, wait_docs, wait_keys, scope_keys, join_counts
+        return (tokens, resume, root, wait_docs, wait_keys, scope_keys,
+                join_counts, family)
 
     def _collect_wait_states(self, info: _DefInfo, el_idx: int, child_key: int,
                              wait_docs: list, wait_keys: list) -> bool:
@@ -844,8 +1125,16 @@ class KernelBackend:
             scope_key = scope_keys.get(exe.elements[jidx].parent_idx)
             if scope_key is None:
                 continue  # scope not instantiated → no arrivals
+            # the state's counters were written by the sequential appliers,
+            # which resolve elements/flows through the CHILD executable for
+            # call-frame records — translate inlined synthetic rows back to
+            # the segment-local index space before reading
+            seg = info.segment_of_row(jidx)
+            d_elem = 0 if seg is None else seg.offset
+            d_flow = 0 if seg is None else seg.flow_offset
             total = sum(
-                state.element_instances.taken_flow_count(scope_key, jidx, f.idx)
+                state.element_instances.taken_flow_count(
+                    scope_key, jidx - d_elem, f.idx - d_flow)
                 for f in exe.flows
                 if f.target_idx == jidx
             )
@@ -882,6 +1171,11 @@ class KernelBackend:
                 continue
             if not _is_numeric(v):
                 return None
+            if type(v) is int and not _f64_exact(v):
+                # host FEEL compares Python ints exactly; an int beyond 2^53
+                # would round into its float64 neighbor's order key and the
+                # device could diverge (e.g. EQ against the neighbor)
+                return None
             value = float(v)
             if value != value:  # NaN has no order key
                 return None
@@ -893,27 +1187,75 @@ class KernelBackend:
                       kind: str, head_docs: list, extra_variables: dict | None,
                       require_op: int) -> _Admitted | None:
         """Shared admission for resume commands (job complete, timer trigger,
-        message correlate): reconstruct the instance, resume one token."""
+        message correlate). A command whose instance is a call-activity child
+        first tries the TOP ancestor instance — when the caller's definition
+        inlines the child, the resume reconstructs the WHOLE family as one
+        device instance and the call return executes on the device; otherwise
+        it falls back to the child-frame instance (the child's own tables,
+        with a sequential continuation into the parent)."""
         state = self.engine.state
-        if pi_key in admitted_pis:
-            return None  # same-instance conflict: next group
         root_meta = state.element_instances.get(pi_key)
         if root_meta is None:
             return None
+        top_pi, top_meta, ancestors = pi_key, root_meta, []
+        for _ in range(_INLINE_MAX_DEPTH + 1):
+            ppi = top_meta["value"].get("parentProcessInstanceKey", -1)
+            if ppi < 0:
+                break
+            m = state.element_instances.get(ppi)
+            if m is None:
+                break
+            top_pi, top_meta = ppi, m
+            ancestors.append(ppi)
+        if top_pi != pi_key:
+            adm = self._admit_resume_at(
+                cmd, instances, admitted_pis, top_pi, top_meta, resume_key,
+                kind, head_docs, extra_variables, require_op,
+                require_segments=True)
+            if adm is not None:
+                return adm
+        return self._admit_resume_at(
+            cmd, instances, admitted_pis, pi_key, root_meta, resume_key,
+            kind, head_docs, extra_variables, require_op,
+            extra_family=ancestors)
+
+    def _admit_resume_at(self, cmd, instances, admitted_pis: set[int],
+                         pi_key: int, root_meta, resume_key: int,
+                         kind: str, head_docs: list,
+                         extra_variables: dict | None, require_op: int,
+                         require_segments: bool = False,
+                         extra_family: list | None = None,
+                         ) -> _Admitted | None:
+        state = self.engine.state
+        if pi_key in admitted_pis:
+            return None  # same-instance conflict: next group
         if "tenantId" in root_meta["value"]:
             # non-default-tenant instances stay on the sequential path end to
             # end (the kernel's value builders emit default-tenant shapes)
             return None
         def_key = root_meta["value"].get("processDefinitionKey", -1)
-        info = self.registry.lookup(def_key, state.processes.executable(def_key))
+        info = self.registry.lookup(def_key, state.processes.executable(def_key),
+                                    processes=state.processes)
         if info is None:
+            return None
+        if require_segments and not info.segments:
+            # the hop to the top ancestor only pays off when the caller
+            # inlines its call activities — otherwise the call element is a
+            # host escape and reconstruction would decline at it anyway
+            return None
+        if not self._segments_fresh(info):
             return None
         rebuilt = self._reconstruct(pi_key, info, resume_key)
         if rebuilt is None:
             return None
         (tokens, resume, root, wait_docs, wait_keys, scope_keys,
-         join_counts) = rebuilt
+         join_counts, family) = rebuilt
+        family = [pi_key, *family, *(extra_family or ())]
+        if any(p in admitted_pis for p in family):
+            return None  # a family member is already resumed in this group
         resume_el = info.exe.elements[resume.elem_idx]
+        has_cond_slots = bool(
+            self.registry.tables.cond_vars_by_def[info.index])
         if extra_variables:
             if kind == "j" and resume_el.outputs:
                 # the sequential job-complete merges ALL completion variables
@@ -921,13 +1263,15 @@ class KernelBackend:
                 # mappings (processors.py merge_local) — they die with the
                 # element and must never reach the root condition slots
                 extra_variables = None
-            else:
+            elif has_cond_slots:
                 # default propagation: each variable lands on the nearest
                 # scope that already holds it locally, else the root. A
                 # mid-chain local (input-mapped element scope, or a
                 # sub-process scope written by an inner output mapping)
                 # would absorb the variable where the device's root-slot
-                # prefetch cannot see it — decline those resumes
+                # prefetch cannot see it — decline those resumes. With no
+                # device-compiled conditions (always the case for inlined
+                # call definitions) there are no slots to invalidate.
                 for name in extra_variables:
                     scope = state.variables.find_scope_with(resume_key, name)
                     if scope is not None and scope != pi_key:
@@ -940,7 +1284,8 @@ class KernelBackend:
         if slots is None:
             return None
         inst = _Inst(idx=len(instances), info=info, new=False, pi_key=pi_key,
-                     tokens=tokens, join_counts=join_counts, slots=slots)
+                     tokens=tokens, join_counts=join_counts, slots=slots,
+                     family_pis=family)
         # timer-touching bursts ARE templatable: clock-derived dueDate /
         # deadline fields in the admission docs are extracted as ("fp", i)
         # roles by the fingerprint walk (so instances with different due
@@ -1271,6 +1616,7 @@ class KernelBackend:
             instances[adm.inst.idx] = adm.inst
             if adm.inst.pi_key is not None and adm.inst.pi_key >= 0:
                 admitted_pis.add(adm.inst.pi_key)
+            admitted_pis.update(adm.inst.family_pis)
             admitted.append(adm)
             if len(admitted) >= self.max_group:
                 break
@@ -1305,7 +1651,11 @@ class KernelBackend:
             fp_bytes = adm.fp_bytes
             if fp_bytes is None:  # admission-time fingerprint unavailable
                 fp_bytes, adm.fp_values, adm.fp_pinned = self._fingerprint(adm)
+            # segment child-def keys are in the key: a refresh_segments swap
+            # reuses the info index, and a stale template would patch the OLD
+            # child definition's baked constants into new-binding bursts
             key = (adm.kind, adm.inst.info.index,
+                   tuple(s.child_def_key for s in adm.inst.info.segments),
                    adm.cmd.record.request_id >= 0, tuple(ops), fp_bytes)
             template = self._templates.get(key, _MISSING)
             if template is _MISSING:
@@ -1361,6 +1711,14 @@ class KernelBackend:
         if capture:
             self.template_misses += 1
             allowed = adm.fp_pinned if adm.fp_pinned is not None else set()
+            if adm.inst.info.segments:
+                # called-definition keys resolve mid-burst (CallActivity
+                # latest-binding) and are sound template constants: the
+                # admission freshness check pins the binding, and the keys
+                # are part of the template cache key
+                allowed = allowed | {
+                    s.child_def_key for s in adm.inst.info.segments
+                }
             if clock_poison:
                 role_map = None
             for i, v in enumerate(mints):
@@ -1953,6 +2311,21 @@ class KernelBackend:
                 else:
                     self._emit_job_created(inst, tok, element, writers)
             elif kind == "done":
+                if element.element_type == BpmnElementType.PROCESS:
+                    # child-root placeholder drained: the called process
+                    # instance completes. Delegate to the sequential PROCESS
+                    # completion wholesale — COMPLETING, subscription close,
+                    # child locals, COMPLETED, then _on_process_completed's
+                    # variable propagation into the caller plus the call
+                    # activity's COMPLETE_ELEMENT command (which the call
+                    # row's own "done" op pairs with one step later)
+                    writers.append_command(tok.key, ValueType.PROCESS_INSTANCE,
+                                           PI.COMPLETE_ELEMENT, {})
+                    self._mark_last_command_processed(builder)
+                    self.engine.bpmn._complete(tok.key, dict(tok.value), exe,
+                                               element, writers)
+                    self._mark_last_command_processed(builder)
+                    continue
                 if element.element_type == BpmnElementType.SUB_PROCESS:
                     # scope drain completes through an internal command, like
                     # the process root (mirror _check_scope_completion →
@@ -1983,10 +2356,41 @@ class KernelBackend:
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_COMPLETED, value)
             elif kind == "scopearr":
+                seg = inst.info.call_segment(e)
+                if seg is not None:
+                    # call activity activation: delegate to the sequential
+                    # CALL_ACTIVITY handler wholesale (ACTIVATING, ACTIVATED,
+                    # the child root's ACTIVATE command, variable propagation
+                    # events — CallActivityProcessor parity), then bind the
+                    # spawned device token to the child-root command
+                    mark = len(builder.follow_ups)
+                    self.engine.bpmn._activate(tok.key, dict(tok.value), exe,
+                                               element, writers)
+                    child_entry = None
+                    child_at = -1
+                    for i in range(mark, len(builder.follow_ups)):
+                        entry = builder.follow_ups[i]
+                        if (entry.record.is_command
+                                and entry.record.value_type == ValueType.PROCESS_INSTANCE):
+                            child_entry, child_at = entry, i
+                            break
+                    if child_entry is None:
+                        # incident (called definition vanished — admission
+                        # freshness makes this unreachable): the device token
+                        # parks forever and the sequential path owns the call
+                        continue
+                    child_entry.processed = True
+                    toks[op[3]] = _Token(slot=-1, elem_idx=seg.root_row,
+                                         key=child_entry.record.key,
+                                         value=dict(child_entry.record.value),
+                                         act_idx=child_at)
+                    continue
                 # embedded sub-process activation: ACTIVATING/ACTIVATED, then
                 # the inner none-start activates via an internal command with
                 # the scope instance as its flow scope (mirror _activate's
-                # SUB_PROCESS branch → _write_activate)
+                # SUB_PROCESS branch → _write_activate). Child-root
+                # placeholder rows (non-root PROCESS elements) share this
+                # path: their element copy stamps the child process shape
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_ACTIVATING, value)
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,

@@ -56,15 +56,12 @@ from zeebe_tpu.ops.tables import (
     K_SCOPE,
     K_TASK,
     MAX_PROG_LEN,
-    OP_ADD,
     OP_AND,
-    OP_DIV,
     OP_EQ,
     OP_GE,
     OP_GT,
     OP_LE,
     OP_LT,
-    OP_MUL,
     OP_NE,
     OP_NEG,
     OP_NOP,
@@ -72,7 +69,6 @@ from zeebe_tpu.ops.tables import (
     OP_OR,
     OP_PUSH_CONST,
     OP_PUSH_VAR,
-    OP_SUB,
     STACK_DEPTH,
     ProcessTables,
 )
@@ -128,6 +124,30 @@ jax.tree_util.register_pytree_node(
 )
 
 
+def _coerce_slot_planes(values) -> np.ndarray:
+    """Slot input → int32 (hi, lo) plane array. A 3-D INTEGER array (any
+    width) is pre-packed planes — int64 Python-int inputs must coerce, not
+    silently fall into the float packer, which would reinterpret plane
+    integers as float *values* and mint garbage keys. Floats pack."""
+    arr = np.asarray(values)
+    if arr.ndim == 3:
+        if arr.shape[-1] != 2:
+            raise ValueError(f"pre-packed slot planes must have trailing dim 2, "
+                             f"got {arr.shape}")
+        if not np.issubdtype(arr.dtype, np.integer):
+            raise ValueError("3-D slot input must be integer (hi, lo) planes; "
+                             "pass floats as a 2-D [instances, slots] array")
+        if arr.dtype != np.int32:
+            out_of_range = (arr < np.iinfo(np.int32).min) | (arr > np.iinfo(np.int32).max)
+            if out_of_range.any():
+                raise ValueError("slot planes exceed int32 range")
+            arr = arr.astype(np.int32)
+        return arr
+    from zeebe_tpu.ops.tables import pack_slot_values
+
+    return pack_slot_values(arr)
+
+
 def make_state(
     tables: ProcessTables,
     num_instances: int,
@@ -164,14 +184,7 @@ def make_state(
     if initial_slots is None:
         slots = np.zeros((I, S, 2), np.int32)
     else:
-        arr = np.asarray(initial_slots)
-        if arr.ndim == 3 and arr.dtype == np.int32:
-            slots = arr  # pre-packed (hi, lo) order-key planes
-        else:
-            # float convenience input: pack to order-key planes
-            from zeebe_tpu.ops.tables import pack_slot_values
-
-            slots = pack_slot_values(arr)
+        slots = _coerce_slot_planes(initial_slots)
     return {
         "elem": jnp.asarray(elem),
         "phase": jnp.asarray(phase),
@@ -241,8 +254,10 @@ def _eval_program(ops: jax.Array, args: jax.Array, slots: jax.Array) -> jax.Arra
         )
         is_push = (op == OP_PUSH_CONST) | (op == OP_PUSH_VAR)
         is_un = (op == OP_NOT) | (op == OP_NEG)
-        # note: OP_NOT sits inside the 3..15 numeric range — exclude unaries
-        is_bin = (op >= OP_LT) & (op <= OP_DIV) & ~is_un
+        # binary = comparisons (3..8) + AND/OR (9..10); arithmetic never
+        # reaches the device (compile_condition host-escapes it), so there
+        # are no opcodes above OP_OR other than the unaries
+        is_bin = (op >= OP_LT) & (op <= OP_OR)
         new_top = jnp.where(is_push, push_val, jnp.where(is_bin, bin_val, un_val))
         write_pos = jnp.where(is_push, sp, jnp.where(is_bin, sp - 2, sp - 1))
         do_write = is_push | is_bin | is_un
@@ -668,7 +683,16 @@ def complete_jobs(state: dict, token_slots: jax.Array, result_slots: jax.Array |
     new_state["phase"] = phase
     if result_slots is not None and result_values is not None:
         vals = np.asarray(result_values)
-        if vals.dtype != np.int32 or vals.ndim != 2:
+        if vals.ndim == 2 and np.issubdtype(vals.dtype, np.integer):
+            if vals.dtype != np.int32:
+                # pre-packed planes in a wider dtype: coerce with the same
+                # range check as _coerce_slot_planes — silent wraparound
+                # would mint garbage order keys and mis-route conditions
+                info = np.iinfo(np.int32)
+                if ((vals < info.min) | (vals > info.max)).any():
+                    raise ValueError("slot planes exceed int32 range")
+                vals = vals.astype(np.int32)
+        else:
             from zeebe_tpu.ops.tables import pack_slot_values
 
             vals = pack_slot_values(vals)  # float convenience → key planes

@@ -39,10 +39,9 @@ OP_NE = 8
 OP_AND = 9
 OP_OR = 10
 OP_NOT = 11
-OP_ADD = 12
-OP_SUB = 13
-OP_MUL = 14
-OP_DIV = 15
+# 12..15 were arithmetic (ADD/SUB/MUL/DIV) before the order-key plane
+# encoding; arithmetic cannot run in key space and host-escapes at compile
+# time, so the opcodes are retired — the VM treats the gap as invalid
 OP_NEG = 16
 
 MAX_PROG_LEN = 24
@@ -105,6 +104,18 @@ _BIAS32 = np.uint32(0x80000000)
 # types both as numeric — admission then declines string values (or the
 # gateway host-escapes on a kind conflict). Two unknown strings therefore
 # never meet on device, where their colliding odd keys would diverge.
+
+
+def f64_exact(v) -> bool:
+    """True when ``v`` is exactly representable as a float64 (ints beyond
+    2^53 collapse into a neighbor; host FEEL compares Python ints exactly,
+    so such values must never be lowered to an order key)."""
+    if type(v) is not int:
+        return True
+    try:
+        return int(float(v)) == v
+    except OverflowError:
+        return False
 
 
 def f64_key_planes(x: float) -> tuple[int, int]:
@@ -229,6 +240,11 @@ def compile_condition(ast, slots: SlotMap,
                 prog.append((OP_PUSH_CONST, *f64_key_planes(1.0 if v else 0.0)))
                 return "num"
             if isinstance(v, (int, float)):
+                if not f64_exact(v):
+                    # not float64-representable (beyond 2^53): the key would
+                    # be the rounded neighbor's and EQ against the true value
+                    # would diverge from the host's exact int comparison
+                    raise ConditionNotCompilable(f"int literal {v} beyond f64")
                 prog.append((OP_PUSH_CONST, *f64_key_planes(float(v))))
                 return "num"
             if isinstance(v, str):
@@ -248,8 +264,11 @@ def compile_condition(ast, slots: SlotMap,
             operand = node.operand
             if isinstance(operand, F.Lit) and isinstance(operand.value, (int, float)) \
                     and not isinstance(operand.value, bool):
+                ov = operand.value
+                if not f64_exact(ov):
+                    raise ConditionNotCompilable(f"int literal {ov} beyond f64")
                 # constant-fold: push the key of the negated literal
-                prog.append((OP_PUSH_CONST, *f64_key_planes(-float(operand.value))))
+                prog.append((OP_PUSH_CONST, *f64_key_planes(-float(ov))))
                 return "num"
             kind = emit_value(operand)
             if kind != "num":
@@ -433,8 +452,15 @@ def _live_token_width(exe: ExecutableProcess) -> int | None:
                     and el.element_type != BpmnElementType.PARALLEL_GATEWAY):
                 return None  # unstructured convergence: element may run twice
     width = 1
-    for el in exe.elements:
-        if el.element_type == BpmnElementType.SUB_PROCESS:
+    for el in exe.elements[1:]:
+        # every scope container parks one token while its inside runs: embedded
+        # sub-processes, and (synthetic inlined definitions) call activities
+        # plus their child-root placeholder rows
+        if el.element_type == BpmnElementType.SUB_PROCESS or (
+            el.element_type in (BpmnElementType.CALL_ACTIVITY,
+                                BpmnElementType.PROCESS)
+            and el.child_start_idx >= 0
+        ):
             width += 1
     for el in splits:
         # cycle check: DFS from the split's targets back to the split
@@ -517,15 +543,20 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                 flow = exe.flows[fidx]
                 out_target[d, el.idx, slot_i] = flow.target_idx
                 out_flow_idx[d, el.idx, slot_i] = flow.idx
-            # scope chains of embedded sub-processes are supported (K_SCOPE);
-            # a chain through any other container (event sub-process) means
-            # the element is only reachable host-side
+            # scope chains of embedded sub-processes are supported (K_SCOPE),
+            # and — in synthetic inlined definitions (kernel_backend
+            # _inline_call_activities) — chains through CALL_ACTIVITY rows
+            # and their non-root PROCESS placeholder rows; a chain through
+            # any other container (event sub-process) means the element is
+            # only reachable host-side
             chain: list[int] = []
             anc = el.parent_idx
             chain_ok = True
-            while anc != 0:
+            while anc > 0:
                 parent = exe.elements[anc]
-                if parent.element_type != BpmnElementType.SUB_PROCESS:
+                if parent.element_type not in (BpmnElementType.SUB_PROCESS,
+                                               BpmnElementType.CALL_ACTIVITY,
+                                               BpmnElementType.PROCESS):
                     chain_ok = False
                     break
                 chain.append(anc)
@@ -563,9 +594,15 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                     # element only needs a valid opcode so definitions carrying
                     # boundaries still lower to tables.
                     op = K_PASS
-                elif el.element_type == BpmnElementType.SUB_PROCESS:
+                elif el.element_type in (BpmnElementType.SUB_PROCESS,
+                                         BpmnElementType.CALL_ACTIVITY,
+                                         BpmnElementType.PROCESS):
+                    # CALL_ACTIVITY / non-root PROCESS rows exist only in
+                    # synthetic inlined definitions: the call activity and
+                    # its child-root placeholder both park as scopes over the
+                    # inlined child rows (kernel_backend._inline_call_activities)
                     if el.child_start_idx < 0:
-                        raise ConditionNotCompilable("sub-process without none start")
+                        raise ConditionNotCompilable("scope without none start")
                     op = K_SCOPE
                 elif el.element_type == BpmnElementType.EVENT_BASED_GATEWAY:
                     # parks like a catch; the first trigger routes through the
