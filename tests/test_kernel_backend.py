@@ -1119,7 +1119,9 @@ class TestHostEscape:
             info = h.kernel_backend.registry.lookup(
                 meta["processDefinitionKey"], None)
             assert info is not None, "MI-carrying process must ride the kernel"
-            assert info.host_idxs, "the MI element must be host-escaped"
+            # round 4: eligible MI bodies ride the DEVICE (synthetic inner
+            # row) instead of host-escaping (tests/test_kernel_mi.py)
+            assert info.mi_inner, "the MI body must be device-inlined"
             assert drive_jobs(h, "prep_work") == 1
             assert drive_jobs(h, "each_work") == 1
             assert drive_jobs(h, "after_mi_work") == 1

@@ -1,0 +1,333 @@
+"""Device-side multi-instance bodies (VERDICT r3 item 3): eligible MI tasks
+lower to K_MI — the body parks like a scope, the device spawns/counts child
+tokens and detects completion, while child activations ride the sequential
+FIFO drain for byte parity (reference: engine/…/processing/bpmn/container/
+MultiInstanceBodyProcessor.java)."""
+
+from __future__ import annotations
+
+from zeebe_tpu.models.bpmn import Bpmn
+from zeebe_tpu.testing import EngineHarness
+
+from tests.test_kernel_backend import assert_equivalent, drive_jobs
+
+
+def mi_proc(pid="mi", seq=False, collection="= items", out=False):
+    b = (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .service_task("work", job_type="w")
+        .multi_instance(
+            input_collection=collection,
+            input_element="item",
+            sequential=seq,
+            **({"output_collection": "results", "output_element": "= r"}
+               if out else {}),
+        )
+        .end_event("e")
+    )
+    return b.done()
+
+
+def mi_after_task(pid="mi_after", seq=False):
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .service_task("prep", job_type="prep")
+        .service_task("work", job_type="w")
+        .multi_instance(input_collection="= items", input_element="item",
+                        sequential=seq)
+        .service_task("after", job_type="aw")
+        .end_event("e")
+        .done()
+    )
+
+
+class TestMiParity:
+    def test_parallel_mi_three_way(self):
+        def scenario(h):
+            h.deploy(mi_proc())
+            h.create_instance("mi", {"items": [10, 20, 30]}, request_id=1)
+            drive_jobs(h, "w", {"r": 1})
+
+        assert_equivalent(scenario)
+
+    def test_sequential_mi_three_way(self):
+        def scenario(h):
+            h.deploy(mi_proc("mis", seq=True))
+            h.create_instance("mis", {"items": ["a", "b", "c"]}, request_id=2)
+            # each completion spawns the next child
+            while drive_jobs(h, "w"):
+                pass
+
+        assert_equivalent(scenario)
+
+    def test_mi_with_output_collection(self):
+        def scenario(h):
+            h.deploy(mi_proc("mio", out=True))
+            h.create_instance("mio", {"items": [1, 2]}, request_id=3)
+            jobs = h.activate_jobs("w", max_jobs=10)
+            for i, j in enumerate(jobs):
+                h.complete_job(j["key"], {"r": 100 + i})
+
+        assert_equivalent(scenario)
+
+    def test_collection_produced_by_upstream_job(self):
+        # the creation burst parks at `prep`; the MI body is only reached in
+        # the job-complete burst whose doc carries the collection
+        def scenario(h):
+            h.deploy(mi_after_task())
+            h.create_instance("mi_after", request_id=4)
+            drive_jobs(h, "prep", {"items": [5, 6, 7]})
+            drive_jobs(h, "w")
+            drive_jobs(h, "aw")
+
+        assert_equivalent(scenario)
+
+    def test_single_item_collection(self):
+        def scenario(h):
+            h.deploy(mi_proc("mi1"))
+            h.create_instance("mi1", {"items": [42]}, request_id=5)
+            drive_jobs(h, "w")
+
+        assert_equivalent(scenario)
+
+    def test_empty_collection_falls_back(self):
+        # empty bodies complete during activation (declined by admission);
+        # parity must hold through the sequential path
+        def scenario(h):
+            h.deploy(mi_proc("mi0"))
+            h.create_instance("mi0", {"items": []}, request_id=6)
+
+        assert_equivalent(scenario)
+
+    def test_invalid_collection_falls_back(self):
+        def scenario(h):
+            h.deploy(mi_proc("mibad"))
+            h.create_instance("mibad", {"items": "oops"}, request_id=7)
+            h.create_instance("mibad", {}, request_id=8)  # missing
+
+        assert_equivalent(scenario)
+
+    def test_large_collection_falls_back(self):
+        def scenario(h):
+            h.deploy(mi_proc("mibig"))
+            h.create_instance("mibig", {"items": list(range(40))}, request_id=9)
+            drive_jobs(h, "w")
+
+        assert_equivalent(scenario)
+
+    def test_mi_beside_parallel_branch(self):
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("mifork")
+                .start_event("s")
+                .parallel_gateway("split")
+                .service_task("work", job_type="w")
+                .multi_instance(input_collection="= items", input_element="item")
+                .parallel_gateway("join")
+                .end_event("e")
+                .move_to_element("split")
+                .service_task("side", job_type="sidew")
+                .connect_to("join")
+                .done()
+            )
+            h.create_instance("mifork", {"items": [1, 2]}, request_id=10)
+            drive_jobs(h, "sidew")
+            drive_jobs(h, "w")
+
+        assert_equivalent(scenario)
+
+    def test_mi_inside_called_child(self):
+        # MI body inside an inlined call-activity region
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("michild")
+                .start_event("cs")
+                .service_task("cw", job_type="cw")
+                .multi_instance(input_collection="= items", input_element="it")
+                .end_event("ce")
+                .done()
+            )
+            h.deploy(
+                Bpmn.create_executable_process("micaller")
+                .start_event("s")
+                .call_activity("call", process_id="michild")
+                .end_event("e")
+                .done()
+            )
+            h.create_instance("micaller", {"items": [1, 2, 3]}, request_id=11)
+            drive_jobs(h, "cw")
+
+        assert_equivalent(scenario)
+
+    def test_two_mi_bodies_in_sequence(self):
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("mi2")
+                .start_event("s")
+                .service_task("a", job_type="aw")
+                .multi_instance(input_collection="= xs", input_element="x")
+                .service_task("b", job_type="bw")
+                .multi_instance(input_collection="= ys", input_element="y",
+                                sequential=True)
+                .end_event("e")
+                .done()
+            )
+            h.create_instance("mi2", {"xs": [1, 2], "ys": [3, 4]}, request_id=12)
+            drive_jobs(h, "aw")
+            while drive_jobs(h, "bw"):
+                pass
+
+        assert_equivalent(scenario)
+
+    def test_partial_completions_across_bursts(self):
+        # complete children one at a time: each resume reconstructs the
+        # parked body + remaining children
+        def scenario(h):
+            h.deploy(mi_proc("mipart"))
+            h.create_instance("mipart", {"items": [1, 2, 3]}, request_id=13)
+            jobs = h.activate_jobs("w", max_jobs=10)
+            for j in jobs:  # one command per group (same-instance conflict)
+                h.complete_job(j["key"], {"out": j["key"] % 7})
+
+        assert_equivalent(scenario)
+
+    def test_mi_with_condition_downstream(self):
+        # MI defs may carry device conditions; the collection variable is
+        # distinct from the condition variable
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("micond")
+                .start_event("s")
+                .service_task("work", job_type="w")
+                .multi_instance(input_collection="= items", input_element="item")
+                .exclusive_gateway("gw")
+                .condition_expression("x > 5")
+                .end_event("hi")
+                .move_to_element("gw")
+                .default_flow()
+                .end_event("lo")
+                .done()
+            )
+            h.create_instance("micond", {"items": [1, 2], "x": 10}, request_id=14)
+            h.create_instance("micond", {"items": [1], "x": 1}, request_id=15)
+            drive_jobs(h, "w")
+
+        assert_equivalent(scenario)
+
+
+class TestMiMechanics:
+    def test_kernel_actually_executes_mi(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(mi_proc())
+            h.create_instance("mi", {"items": [1, 2, 3]})
+            with h.db.transaction():
+                meta = h.engine.state.processes.get_latest_by_id("mi")
+            info = h.kernel_backend.registry.lookup(
+                meta["processDefinitionKey"], None)
+            assert info is not None and info.mi_inner, "MI body not inlined"
+            before = h.kernel_backend.commands_processed
+            assert before >= 1  # the creation rode the kernel
+            for j in h.activate_jobs("w", max_jobs=10):
+                h.complete_job(j["key"])
+            assert h.kernel_backend.commands_processed >= before + 3
+        finally:
+            h.close()
+
+    def test_collection_written_by_output_mapping_stays_host(self):
+        # an output mapping targeting the collection variable makes the
+        # admission prediction unsound → the body must not be device-inlined
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("mitaint")
+                .start_event("s")
+                .service_task("prep", job_type="p")
+                .zeebe_output("= raw", "items")
+                .service_task("work", job_type="w")
+                .multi_instance(input_collection="= items", input_element="item")
+                .end_event("e")
+                .done()
+            )
+            h.create_instance("mitaint", request_id=20)
+            drive_jobs(h, "p", {"raw": [1, 2]})
+            drive_jobs(h, "w")
+
+        assert_equivalent(scenario)
+
+    def test_mi_on_cycle_stays_host(self):
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("miloop")
+                .start_event("s")
+                .exclusive_gateway("back")
+                .service_task("work", job_type="w")
+                .multi_instance(input_collection="= items", input_element="item")
+                .exclusive_gateway("gw")
+                .condition_expression("again = 1")
+                .connect_to("back")
+                .move_to_element("gw")
+                .default_flow()
+                .end_event("e")
+                .done()
+            )
+            h.create_instance("miloop", {"items": [1], "again": 0},
+                              request_id=21)
+            drive_jobs(h, "w")
+
+        assert_equivalent(scenario)
+
+    def test_cancel_instance_with_parked_mi(self):
+        def scenario(h):
+            h.deploy(mi_proc("micancel"))
+            k = h.create_instance("micancel", {"items": [1, 2]}, request_id=22)
+            h.cancel_instance(k)
+
+        assert_equivalent(scenario)
+
+    def test_script_result_rewriting_collection_stays_host(self):
+        # a script task's result variable aliasing the collection could
+        # rewrite it mid-burst (host-escaped, drained FIFO) — the body must
+        # not be device-inlined (review finding r4)
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("miscript")
+                .start_event("s")
+                .parallel_gateway("split")
+                .script_task("sc", expression='= ["x"]',
+                             result_variable="items")
+                .parallel_gateway("join")
+                .end_event("e")
+                .move_to_element("split")
+                .service_task("work", job_type="w")
+                .multi_instance(input_collection="= items", input_element="it")
+                .connect_to("join")
+                .done()
+            )
+            h.create_instance("miscript", {"items": [1, 2]}, request_id=30)
+            drive_jobs(h, "w")
+
+        assert_equivalent(scenario)
+
+    def test_sibling_call_propagation_keeps_mi_host(self):
+        # a non-ancestor call activity's completion propagates arbitrary
+        # child variables mid-burst — the body must not be device-inlined
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("writer_child")
+                .start_event("cs").manual_task("cm").end_event("ce").done()
+            )
+            h.deploy(
+                Bpmn.create_executable_process("misib")
+                .start_event("s")
+                .call_activity("call", process_id="writer_child")
+                .service_task("work", job_type="w")
+                .multi_instance(input_collection="= items", input_element="it")
+                .end_event("e")
+                .done()
+            )
+            h.create_instance("misib", {"items": [7, 8]}, request_id=31)
+            drive_jobs(h, "w")
+
+        assert_equivalent(scenario)

@@ -40,16 +40,20 @@ from typing import Any, Callable
 import numpy as np
 
 from zeebe_tpu.models.bpmn.executable import ExecutableElement, ExecutableProcess
+from zeebe_tpu.feel.feel import Lit as _FeelLit, Var as _FeelVar
 from zeebe_tpu.ops.tables import (
     _KERNEL_OP,
+    _MI_BODY_TYPES,
     ConditionNotCompilable,
     K_CATCH,
     K_HOST,
     K_JOIN,
+    K_MI,
     K_SCOPE,
     K_TASK,
     ProcessTables,
     compile_tables,
+    f64_exact as _f64_exact,
 )
 from zeebe_tpu.protocol import ValueType
 from zeebe_tpu.protocol.enums import BpmnElementType, BpmnEventType, ErrorType
@@ -148,6 +152,11 @@ from zeebe_tpu.native import codec_fn as _codec_fn
 
 _native_pack_fingerprint = _codec_fn("pack_fingerprint")
 
+# admission cap on a device MI body's cardinality: bigger collections take
+# the sequential path (also far below the PI-batch chunking threshold, so
+# the chunked-activation shape never reaches the device)
+_MI_MAX_CARD = 16
+
 # token phases (mirrors zeebe_tpu.ops.automaton)
 _PHASE_AT = 0
 _PHASE_WAIT = 1
@@ -169,7 +178,6 @@ def _is_numeric(v: Any) -> bool:
     return isinstance(v, (bool, int, float)) and not isinstance(v, str)
 
 
-from zeebe_tpu.ops.tables import f64_exact as _f64_exact
 
 
 def _safe_mapping_expr(expr) -> bool:
@@ -230,7 +238,9 @@ def check_element_eligibility(exe: ExecutableProcess, el: ExecutableElement) -> 
     the kernel's opcode behavior (engine/…/processing/bpmn element processors
     vs ops/automaton masks)."""
     if el.multi_instance is not None:
-        return False
+        # only synthetic K_MI bodies (_inline_mi_bodies sets child_start on a
+        # task-type element) ride the device; real loop elements host-escape
+        return el.child_start_idx >= 0 and el.element_type in _MI_BODY_TYPES
     if el.inputs or el.outputs:
         # io-mappings ride the kernel on job-worker tasks only, and only
         # when they cannot fail mid-burst (safe expressions) and their
@@ -477,6 +487,229 @@ def _inline_call_activities(exe: ExecutableProcess, processes,
     return synthetic, segments
 
 
+def _mi_body_device_eligible(exe: ExecutableProcess, el) -> bool:
+    """True when a multi-instance activity may become a device K_MI body
+    (kernel parity restrictions; anything else host-escapes):
+
+    - the activity is a job-worker task with a static type (the inner
+      instance parks at a job; containers stay host-side),
+    - no boundary events, no io mappings on the body,
+    - the input collection is a bare variable or a literal (admission
+      predicts its cardinality; evaluation cannot fail mid-burst),
+    - a bare-variable collection is not written mid-burst by ANY other
+      writer (output mappings, script/decision result variables, another
+      body's outputCollection, or a non-ancestor call activity's completion
+      propagation) nor shadowed by any ancestor scope's input mappings —
+      the admission prediction must equal the value the sequential engine
+      reads at body activation,
+    - the output element, when collected, is a safe expression (cannot
+      raise mid-burst)."""
+    mi = el.multi_instance
+    if el.element_type not in _MI_BODY_TYPES:
+        return False
+    if el.job_type is None or not el.job_type.is_static:
+        return False
+    if el.job_retries is not None and not el.job_retries.is_static:
+        return False
+    if el.boundary_idxs or el.inputs or el.outputs:
+        return False
+    if el.form_id is not None or el.native_user_task or el.called_decision_id:
+        return False
+    if el.script_expression is not None:
+        return False
+    if mi.input_collection.is_static:
+        # a static string never evaluates to a list: the sequential path
+        # owns the guaranteed incident (host-escape keeps the REST of the
+        # definition on the kernel instead of declining every command)
+        return False
+    ast = mi.input_collection.ast
+    if isinstance(ast, _FeelLit):
+        pass
+    elif isinstance(ast, _FeelVar) and len(ast.path) == 1:
+        v = ast.path[0]
+
+        def is_ancestor(a_idx: int) -> bool:
+            anc = el.parent_idx
+            while anc > 0:
+                if anc == a_idx:
+                    return True
+                anc = exe.elements[anc].parent_idx
+            return False
+
+        for other in exe.elements[1:]:
+            if any(t == v for _e, t in other.outputs):
+                return False  # an output mapping could rewrite it mid-burst
+            if other.script_result_variable == v or other.decision_result_variable == v:
+                # engine-computed results (script / business-rule tasks,
+                # host-escaped or not) write mid-burst too
+                return False
+            if (other.multi_instance is not None
+                    and other.multi_instance.output_collection == v):
+                return False  # MI completion writes it to the parent scope
+            if (other.element_type == BpmnElementType.CALL_ACTIVITY
+                    and not is_ancestor(other.idx)):
+                # a call's COMPLETION propagates arbitrary child variables
+                # upward mid-burst; only an ANCESTOR call is safe (its
+                # completion strictly postdates this body). Its ACTIVATION
+                # propagation copies the very values admission predicted.
+                return False
+        # ancestor-scope input mappings could shadow it for collect(body)
+        anc = el.parent_idx
+        while anc > 0:
+            if any(t == v for _e, t in exe.elements[anc].inputs):
+                return False
+            anc = exe.elements[anc].parent_idx
+    else:
+        return False  # computed collections re-evaluate; host-side only
+    if mi.output_collection and mi.output_element is not None:
+        if not _safe_mapping_expr(mi.output_element):
+            return False
+    return True
+
+
+def _inline_mi_bodies(exe: ExecutableProcess,
+                      ) -> tuple[ExecutableProcess, dict[int, int]]:
+    """Append a synthetic INNER row per device-eligible multi-instance task:
+    the body element keeps its row (child_start_idx → the inner row, lowered
+    to K_MI by compile_tables), the inner copy drops the loop marker and
+    lowers as a plain job-worker task whose parent scope is the body.
+    Returns (exe', {body_row: inner_row}); unchanged when nothing qualifies.
+    Reference: engine/…/processing/bpmn/container/MultiInstanceBodyProcessor
+    .java — here spawn/completion counting runs on the device."""
+    import dataclasses as _dc
+    import hashlib as _hashlib
+
+    bodies = [
+        el for el in exe.elements[1:]
+        if el.multi_instance is not None and el.child_start_idx < 0
+        and _mi_body_device_eligible(exe, el)
+    ]
+    if bodies:
+        # a body that can activate twice concurrently (unstructured merge
+        # under a parallel split) or iteratively (cycle through the body)
+        # would share its per-(instance, row) mi_left cell — exclude
+        has_split = any(
+            el.element_type == BpmnElementType.PARALLEL_GATEWAY
+            and len(el.outgoing) > 1
+            for el in exe.elements[1:]
+        )
+        unstructured = has_split and any(
+            el.incoming_count > 1
+            and el.element_type != BpmnElementType.PARALLEL_GATEWAY
+            for el in exe.elements[1:]
+        )
+        if unstructured:
+            bodies = []
+        else:
+            targets_of = {
+                el.idx: [exe.flows[f].target_idx for f in el.outgoing]
+                for el in exe.elements
+            }
+
+            def on_cycle(el) -> bool:
+                seen: set[int] = set()
+                stack = list(targets_of[el.idx])
+                while stack:
+                    n = stack.pop()
+                    if n == el.idx:
+                        return True
+                    if n in seen:
+                        continue
+                    seen.add(n)
+                    stack.extend(targets_of.get(n, ()))
+                return False
+
+            bodies = [el for el in bodies if not on_cycle(el)]
+    if not bodies:
+        return exe, {}
+    elements = list(exe.elements)
+    mi_inner: dict[int, int] = {}
+    for el in bodies:
+        inner_row = len(elements)
+        elements.append(_dc.replace(
+            el,
+            idx=inner_row,
+            parent_idx=el.idx,
+            outgoing=[],
+            default_flow_idx=-1,
+            boundary_idxs=[],
+            multi_instance=None,
+        ))
+        elements[el.idx] = _dc.replace(el, child_start_idx=inner_row)
+        mi_inner[el.idx] = inner_row
+    digest = _hashlib.sha256(
+        (exe.digest + "|mi:" + ",".join(map(str, sorted(mi_inner)))).encode()
+    ).hexdigest()
+    return ExecutableProcess(
+        process_id=exe.process_id, elements=elements, flows=list(exe.flows),
+        by_id=exe.by_id, digest=digest,
+    ), mi_inner
+
+
+def _mi_burst_reach(exe: ExecutableProcess, ops_row,
+                    mi_inner: dict[int, int]) -> dict[int, tuple]:
+    """Per entry row, the K_MI body rows a single burst starting there can
+    reach without crossing another wait state — over-approximate (scopes are
+    both entered and crossed, since a waitless inside drains in-burst).
+    Key -1 is the creation entry (the definition's none start); wait rows
+    (tasks/catches) key their resume continuation, which also includes every
+    ancestor scope's exit (a resume can drain ancestors) and, for an MI
+    inner row, its own body (a sequential respawn re-reads the collection)."""
+    targets_of = {
+        el.idx: [exe.flows[f].target_idx for f in el.outgoing]
+        for el in exe.elements
+    }
+    parking = {K_TASK, K_CATCH, K_HOST, K_MI}
+
+    def closure(frontier) -> tuple:
+        seen: set[int] = set()
+        found: set[int] = set()
+        stack = [x for x in frontier if x >= 0]
+        while stack:
+            x = stack.pop()
+            if x in seen:
+                continue
+            seen.add(x)
+            op = int(ops_row[x])
+            if op == K_MI:
+                found.add(x)
+                continue  # the body parks; its children park at jobs
+            el = exe.elements[x]
+            if el.child_start_idx >= 0 and op == K_SCOPE:
+                stack.append(el.child_start_idx)
+                stack.extend(targets_of[x])  # may drain in-burst: cross it
+                continue
+            if op in parking:
+                continue
+            stack.extend(targets_of[x])
+        return tuple(sorted(found))
+
+    reach: dict[int, tuple] = {}
+    start = exe.none_start_of(0)
+    reach[-1] = closure([start] if start >= 0 else [])
+    inner_to_body = {v: k for k, v in mi_inner.items()}
+    for el in exe.elements[1:]:
+        op = int(ops_row[el.idx])
+        if op not in (K_TASK, K_CATCH):
+            continue
+        frontier = list(targets_of[el.idx])
+        extra: set[int] = set()
+        anc = el.parent_idx
+        while anc > 0:
+            if int(ops_row[anc]) == K_MI:
+                extra.add(anc)
+            frontier.extend(targets_of[anc])
+            anc = exe.elements[anc].parent_idx
+        body = inner_to_body.get(el.idx)
+        if body is not None:
+            extra.add(body)
+            frontier.extend(targets_of[body])
+        r = set(closure(frontier)) | extra
+        if r:
+            reach[el.idx] = tuple(sorted(r))
+    return reach
+
+
 @dataclass
 class _DefInfo:
     index: int
@@ -494,6 +727,12 @@ class _DefInfo:
     # inlined called processes (exe is then SYNTHETIC: parent rows first,
     # then each segment's child rows); empty for plain definitions
     segments: tuple = ()
+    # device multi-instance bodies: body row → synthetic inner row
+    mi_inner: dict = field(default_factory=dict)
+    # entry row → K_MI body rows a burst from that entry can reach without
+    # crossing another wait state (-1 = the creation entry); admission must
+    # predict those bodies' cardinalities before the group runs
+    mi_reach: dict = field(default_factory=dict)
 
     def segment_of_row(self, row: int):
         """The segment whose inlined region contains ``row`` (call_row and
@@ -603,6 +842,8 @@ class KernelRegistry:
             # real one for this definition's tables and trace decode
             exe, seg_list = _inline_call_activities(exe, processes)
             segments = tuple(seg_list)
+        # device multi-instance bodies (incl. inside inlined call regions)
+        exe, mi_inner = _inline_mi_bodies(exe)
         # elements outside the device subset become host escapes (K_HOST):
         # the device parks any token reaching them and the materializer hands
         # the continuation to the sequential engine — so the definition rides
@@ -668,6 +909,9 @@ class KernelRegistry:
             boundary_waits=boundary_waits,
             host_idxs=effective_host,
             segments=segments,
+            mi_inner=mi_inner,
+            mi_reach=(_mi_burst_reach(exe, solo.kernel_op[0], mi_inner)
+                      if mi_inner else {}),
         )
 
     def _compile_shared(self) -> ProcessTables:
@@ -730,6 +974,7 @@ class KernelRegistry:
                              ("ds", tables.default_slot),
                              ("se", tables.start_elem), ("ec", tables.elem_count),
                              ("ss", tables.scope_start), ("is", tables.in_scope),
+                             ("mis", tables.mi_sequential),
                              ("cop", tables.cond_ops), ("ca", tables.cond_args)):
                 # field tag + shape + dtype delimit each array: without them
                 # raw byte streams could alias across array boundaries and two
@@ -775,6 +1020,13 @@ class _Inst:
     # activity child frames + ancestors); the group conflict set must cover
     # them all so one family never resumes twice in one group
     family_pis: list[int] = field(default_factory=list)
+    # K_MI bodies: body row → children left to spawn on device (admission-
+    # predicted cardinality for unspawned bodies; reconstruction remainder
+    # for parked sequential bodies; 0 for fully-spawned parallel bodies)
+    mi_left: dict = field(default_factory=dict)
+    # predicted cardinality per body row (the decoder's spawn-count oracle
+    # is the sequential delegation itself; this sizes the token pool)
+    mi_cards: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -928,10 +1180,44 @@ class KernelBackend:
             # a condition could read a variable whose runtime type the device
             # slot kind cannot represent: host and device would disagree
             return None
-        inst = _Inst(idx=len(instances), info=info, new=True, meta=meta, slots=slots)
+        mi_cards: dict[int, int] = {}
+        if info.mi_inner:
+            needed = info.mi_reach.get(-1, ())
+            if needed:
+                cards = self._predict_mi_cards(info, needed, variables)
+                if cards is None:
+                    return None
+                mi_cards = cards
+        inst = _Inst(idx=len(instances), info=info, new=True, meta=meta,
+                     slots=slots, mi_left=dict(mi_cards), mi_cards=mi_cards)
         templatable = not (value.get("awaitResult") and cmd.record.request_id >= 0)
         return _Admitted(cmd=cmd, inst=inst, kind="c",
                          fp_docs=[value, meta], templatable=templatable)
+
+    def _predict_mi_cards(self, info: _DefInfo, needed,
+                          merged: dict) -> dict[int, int] | None:
+        """Cardinality of each needed K_MI body's input collection, evaluated
+        over the admission-time variable view. Eligibility guarantees no
+        other writer can change the collection before the body activates
+        mid-burst, so this equals what the sequential delegation will read.
+        None = a needed collection is missing/invalid/empty/too large — the
+        command declines to the sequential path (which raises the proper
+        incident or runs the large fan-out chunked)."""
+        cards: dict[int, int] = {}
+        for row in needed:
+            mi = info.exe.elements[row].multi_instance
+            try:
+                items = mi.input_collection.evaluate(merged, lambda: 0)
+            except Exception:  # noqa: BLE001 — any eval failure → sequential
+                return None
+            if not isinstance(items, list):
+                return None
+            if not items or len(items) > _MI_MAX_CARD:
+                # empty bodies complete during activation (a different burst
+                # shape than park-and-drain); big fan-outs ride chunking
+                return None
+            cards[row] = len(items)
+        return cards
 
     def _segments_fresh(self, info: _DefInfo) -> bool:
         """Inlined call segments bind the latest called version at compile
@@ -977,6 +1263,7 @@ class KernelBackend:
         wait_docs: list = []
         wait_keys: list[int] = []
         family: list[int] = []  # call-child process instance keys
+        mi_parked: dict[int, int | None] = {}  # K_MI body row → live inner lc
         # elem idx of a scope (0 = process root) → its instance key: join
         # counters and sub-process drain checks key off the scope instance
         scope_keys: dict[int, int] = {0: pi_key}
@@ -998,8 +1285,29 @@ class KernelBackend:
                 return None
             row = id_map[elem_id] + (0 if seg is None else seg.offset)
             el = exe.elements[row]
+            if (el.multi_instance is not None and el.child_start_idx >= 0
+                    and child["value"].get("bpmnElementType")
+                    != BpmnElementType.MULTI_INSTANCE_BODY.name):
+                # an MI element id names BOTH the body and its inner
+                # instances; the inner rides the synthetic inner row
+                row = info.mi_inner[row]
+                el = exe.elements[row]
             op = self.registry.tables.kernel_op[info.index, row]
-            if op == K_SCOPE:
+            if op == K_MI:
+                if child.get("miActivationIndex") is not None:
+                    return None  # chunked fan-out: sequential path owns it
+                lc = None
+                for k in state.element_instances.children_keys(child_key):
+                    inner = state.element_instances.get(k)
+                    if inner is not None:
+                        lc = max(lc or 0, inner["value"].get("loopCounter", 0))
+                mi_parked[row] = lc  # None = no live inner (drain mid-flight)
+                scope_keys[row] = child_key
+                pending_walk.extend(
+                    (k, seg)
+                    for k in sorted(state.element_instances.children_keys(child_key))
+                )
+            elif op == K_SCOPE:
                 call_seg = info.call_segment(row)
                 if call_seg is not None:
                     # call activity frame: descend into the called child
@@ -1083,7 +1391,7 @@ class KernelBackend:
                 continue
             return None
         return (tokens, resume, root, wait_docs, wait_keys, scope_keys,
-                join_counts, family)
+                join_counts, family, mi_parked)
 
     def _collect_wait_states(self, info: _DefInfo, el_idx: int, child_key: int,
                              wait_docs: list, wait_keys: list) -> bool:
@@ -1249,7 +1557,7 @@ class KernelBackend:
         if rebuilt is None:
             return None
         (tokens, resume, root, wait_docs, wait_keys, scope_keys,
-         join_counts, family) = rebuilt
+         join_counts, family, mi_parked) = rebuilt
         family = [pi_key, *family, *(extra_family or ())]
         if any(p in admitted_pis for p in family):
             return None  # a family member is already resumed in this group
@@ -1283,9 +1591,51 @@ class KernelBackend:
         slots = self._condition_slots(info, merged)
         if slots is None:
             return None
+        mi_left: dict[int, int] = {}
+        mi_cards: dict[int, int] = {}
+        if info.mi_inner:
+            tables = self.registry.tables
+            seq_rows = {
+                row for row in info.mi_inner
+                if tables.mi_sequential[info.index, row]
+            }
+            # cards are needed for burst-reachable unspawned bodies AND for
+            # parked sequential bodies (the respawn remainder); parallel
+            # parked bodies are fully spawned (mi_left 0, no card needed)
+            needed = set(info.mi_reach.get(resume.elem_idx, ()))
+            needed |= {r for r in mi_parked if r in seq_rows}
+            if needed:
+                # a collection variable shadowed by ANY live scope/token
+                # local would make the root-merged prediction diverge from
+                # the sequential collect(body) — decline those
+                local_names: set[str] = set()
+                for t in tokens:
+                    local_names.update(state.variables.locals_of(t.key))
+                for _idx, k in scope_keys.items():
+                    if k != pi_key:
+                        local_names.update(state.variables.locals_of(k))
+                for row in needed:
+                    ast = info.exe.elements[row].multi_instance.input_collection.ast
+                    if isinstance(ast, _FeelVar) and ast.path[0] in local_names:
+                        return None
+                cards = self._predict_mi_cards(info, needed, merged)
+                if cards is None:
+                    return None
+                mi_cards = cards
+            for row, lc in mi_parked.items():
+                if row in seq_rows:
+                    card = mi_cards.get(row)
+                    if card is None or lc is None or lc > card:
+                        return None
+                    mi_left[row] = card - lc
+                else:
+                    mi_left[row] = 0  # parallel: fully spawned at rest
+            for row in needed:
+                if row not in mi_parked:
+                    mi_left[row] = mi_cards[row]
         inst = _Inst(idx=len(instances), info=info, new=False, pi_key=pi_key,
                      tokens=tokens, join_counts=join_counts, slots=slots,
-                     family_pis=family)
+                     family_pis=family, mi_left=mi_left, mi_cards=mi_cards)
         # timer-touching bursts ARE templatable: clock-derived dueDate /
         # deadline fields in the admission docs are extracted as ("fp", i)
         # roles by the fingerprint walk (so instances with different due
@@ -1419,10 +1769,13 @@ class KernelBackend:
         # perf bug, not a correctness one — but the bound is sound, so it
         # cannot happen for bounded sets.
         width = tables.token_width
+        # parallel MI fan-out is dynamic: admission-predicted cardinalities
+        # bound the extra live tokens beyond the static analysis
+        mi_extra = sum(sum(i.mi_cards.values()) for i in insts if i.mi_cards)
         if width > 0:
             T = self._pow2(max(width * I, n_tokens))
         else:
-            T = self._pow2(max(4 * I, 4 * n_tokens))
+            T = self._pow2(max(4 * I, 4 * n_tokens, n_tokens + mi_extra + I))
         E = tables.max_elements
         S = tables.num_slots
         if T > PACK_MAX_TOKENS or E >= PACK_MAX_ELEMENTS:
@@ -1439,6 +1792,7 @@ class KernelBackend:
         def_of = np.zeros(I, np.int32)
         var_slots = np.zeros((I, S, 2), np.int32)
         join_counts = np.zeros((I, E), np.int32)
+        mi_left = np.zeros((I, E), np.int32)
         done = np.zeros(I, np.bool_)
         done[n_real:] = True  # padding rows must never report newly_done
 
@@ -1449,6 +1803,8 @@ class KernelBackend:
                 var_slots[i.idx, tables.slot_map.names[name]] = v
             for jidx, count in i.join_counts.items():
                 join_counts[i.idx, jidx] = count
+            for row, n in i.mi_left.items():
+                mi_left[i.idx, row] = n
             if i.new:
                 i.tokens = [_Token(slot=slot, elem_idx=int(tables.start_elem[i.info.index]),
                                    key=-1, value={})]
@@ -1465,7 +1821,8 @@ class KernelBackend:
                     slot += 1
         arrays = {
             "elem": elem, "phase": phase, "inst": inst_arr, "def_of": def_of,
-            "var_slots": var_slots, "join_counts": join_counts, "done": done,
+            "var_slots": var_slots, "join_counts": join_counts,
+            "mi_left": mi_left, "done": done,
         }
         return arrays, I, T
 
@@ -1550,6 +1907,7 @@ class KernelBackend:
             "def_of": jnp.asarray(def_of),
             "var_slots": jnp.asarray(var_slots),
             "join_counts": jnp.asarray(join_counts),
+            "mi_left": jnp.asarray(arrays["mi_left"]),
             "done": jnp.asarray(done),
             "incident": jnp.zeros(I, jnp.bool_),
             "transitions": jnp.zeros((), jnp.int32),
@@ -1799,7 +2157,8 @@ class KernelBackend:
 
     def _drain_host_escapes(self, source_position: int, builder,
                             limit: int | None = None,
-                            end_idx: int | None = None) -> None:
+                            end_idx: int | None = None,
+                            reserved_keys: set | None = None) -> None:
         """Process follow-up commands left unprocessed (flows into K_HOST
         elements, and whatever those spawn) with the sequential engine, FIFO,
         within the batch budget — so the flattened burst matches the
@@ -1828,6 +2187,15 @@ class KernelBackend:
             while scan < bound:
                 entry = builder.follow_ups[scan]
                 if entry.record.is_command and not entry.processed:
+                    if (reserved_keys
+                            and entry.record.value_type == ValueType.PROCESS_INSTANCE
+                            and int(entry.record.intent) == int(PI.COMPLETE_ELEMENT)
+                            and entry.record.key in reserved_keys):
+                        # a device MI body's completion command: its "done"
+                        # op pairs with it (device-side drain detection) —
+                        # draining it here would double-complete the body
+                        scan += 1
+                        continue
                     follow_up = entry
                     break
                 scan += 1
@@ -2194,6 +2562,14 @@ class KernelBackend:
                         ops.append(("scopearr", l, e, nl))
                         if tables.kernel_op[d, start_idx] == K_HOST:
                             host_arrive[nl] = si + 1
+                    elif tables.kernel_op[d, e] == K_MI:
+                        # MI body arrival: the device spawns child tokens (one
+                        # per step) purely for occupancy/drain tracking; their
+                        # activation records ride the sequential FIFO drain
+                        # (the body's _activate delegation queues the inner
+                        # ACTIVATE commands unprocessed), so the spawned
+                        # device tokens are NOT tracked here — only the body
+                        ops.append(("miarr", l, e))
                     else:
                         ops.append(("arrive", l, e))
                 elif ev["task_done"][s] or ev["full_pass"][s]:
@@ -2233,37 +2609,66 @@ class KernelBackend:
         exe = inst.info.exe
         d = inst.info.index
         toks: dict[int, _Token] = dict(enumerate(inst.tokens))
+        mi_inner_rows = {v: k for k, v in inst.info.mi_inner.items()}
+        # device MI body keys whose COMPLETE_ELEMENT commands the drain must
+        # leave for the body's own "done" op (reconstructed bodies up front;
+        # in-burst activations join at their miarr)
+        reserved_keys: set[int] = {
+            t.key for t in inst.tokens
+            if tables.kernel_op[d, t.elem_idx] == K_MI and t.key >= 0
+        }
         # pure-device traces (the common case) never need the FIFO drain —
-        # skip its O(follow_ups) scans wholesale
-        has_escapes = any(o[0] == "hostarr" for o in ops)
+        # skip its O(follow_ups) scans wholesale. MI traces always drain:
+        # inner-child activations and respawns ride the sequential FIFO.
+        has_escapes = any(
+            o[0] in ("hostarr", "miarr")
+            or (o[0] == "done" and o[2] in mi_inner_rows)
+            for o in ops
+        )
         for op in ops:
             kind = op[0]
             if kind == "complete":
                 if has_escapes:
-                    self._drain_host_escapes(source_position, builder)
+                    self._drain_host_escapes(source_position, builder,
+                                             reserved_keys=reserved_keys)
                 self._emit_process_completed(inst, writers, builder)
                 continue
             if kind == "hostarr":
                 # the escaped element's ACTIVATE is the first unprocessed
                 # command (escapes drain in arrival order): hand it to the
                 # sequential engine at exactly this FIFO position
-                self._drain_host_escapes(source_position, builder, limit=1)
+                self._drain_host_escapes(source_position, builder, limit=1,
+                                         reserved_keys=reserved_keys)
                 continue
             l, e = op[1], op[2]
             tok = toks[l]
             element = exe.elements[e]
             value = _pi_value(tok.value, element)
-            if has_escapes and kind in ("arrive", "pass", "scopearr",
+            if has_escapes and kind in ("arrive", "pass", "scopearr", "miarr",
                                         "nomatch") and tok.act_idx >= 0:
                 # FIFO: escape cascades whose commands were appended before
                 # this token's ACTIVATE must emit first (the sequential batch
                 # loop would have processed them before reaching it)
                 self._drain_host_escapes(source_position, builder,
-                                         end_idx=tok.act_idx)
+                                         end_idx=tok.act_idx,
+                                         reserved_keys=reserved_keys)
             elif has_escapes and kind == "done":
                 # a mid-trace completion (scope drain) appends its COMPLETE
                 # command at the queue's end — everything pending goes first
-                self._drain_host_escapes(source_position, builder)
+                self._drain_host_escapes(source_position, builder,
+                                         reserved_keys=reserved_keys)
+            if kind == "miarr":
+                # MI body activation: delegate to the sequential activation
+                # wholesale (MultiInstanceBodyProcessor parity) — ACTIVATING,
+                # collection evaluation, ACTIVATED, output-collection seed,
+                # and the inner ACTIVATE commands, which stay UNPROCESSED:
+                # the FIFO drain activates each child at its exact sequential
+                # position while the device's spawned tokens (untracked here)
+                # park at the inner row for drain accounting
+                reserved_keys.add(tok.key)
+                self.engine.bpmn._activate(tok.key, dict(tok.value), exe,
+                                           element, writers)
+                continue
             if kind == "arrive":
                 if element.element_type == BpmnElementType.EVENT_BASED_GATEWAY:
                     # delegate to the sequential activation wholesale: its
@@ -2311,6 +2716,57 @@ class KernelBackend:
                 else:
                     self._emit_job_created(inst, tok, element, writers)
             elif kind == "done":
+                if e in mi_inner_rows:
+                    # MI inner completion (job-complete resume): delegate to
+                    # the sequential completion with the BODY element (it
+                    # carries the loop characteristics) — COMPLETING, output
+                    # collection element, sequential-collection validation,
+                    # COMPLETED, and _on_mi_inner_completed's follow-up (the
+                    # next inner ACTIVATE, or the body's COMPLETE_ELEMENT —
+                    # both unprocessed: the respawn drains FIFO and the body
+                    # command is reserved for the body's own "done" op)
+                    body_el = exe.elements[mi_inner_rows[e]]
+                    ei = state.element_instances.get(tok.key)
+                    ivalue = dict(ei["value"]) if ei is not None else dict(tok.value)
+                    self.engine.bpmn._complete(tok.key, ivalue, exe, body_el,
+                                               writers)
+                    continue
+                if element.multi_instance is not None:
+                    # MI body completion: the COMPLETE_ELEMENT command was
+                    # appended by the last inner's completion cascade and
+                    # reserved from the drain — pair with it here, then
+                    # mirror _complete's is_mi_body tail (COMPLETING, output
+                    # collection propagation, COMPLETED); the outgoing flows
+                    # ride the device ("flow" ops)
+                    for entry in builder.follow_ups:
+                        if (entry.record.is_command and not entry.processed
+                                and entry.record.value_type == ValueType.PROCESS_INSTANCE
+                                and int(entry.record.intent) == int(PI.COMPLETE_ELEMENT)
+                                and entry.record.key == tok.key):
+                            entry.processed = True
+                            break
+                    else:
+                        logger.error(
+                            "MI body %s done on device without a pending "
+                            "COMPLETE_ELEMENT — decode divergence", element.id)
+                        continue
+                    ei = state.element_instances.get(tok.key)
+                    bvalue = _pi_value(
+                        dict(ei["value"]) if ei is not None else dict(tok.value),
+                        element)
+                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                         PI.ELEMENT_COMPLETING, bvalue)
+                    mi = element.multi_instance
+                    if mi.output_collection:
+                        collection = state.variables.get_local(
+                            tok.key, mi.output_collection)
+                        if collection is not None:
+                            self.engine.bpmn._write_variable(
+                                writers, bvalue.get("flowScopeKey", -1),
+                                bvalue, mi.output_collection, collection)
+                    writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
+                                         PI.ELEMENT_COMPLETED, bvalue)
+                    continue
                 if element.element_type == BpmnElementType.PROCESS:
                     # child-root placeholder drained: the called process
                     # instance completes. Delegate to the sequential PROCESS

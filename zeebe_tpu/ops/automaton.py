@@ -51,6 +51,7 @@ from zeebe_tpu.ops.tables import (
     K_FORK,
     K_HOST,
     K_JOIN,
+    K_MI,
     K_NONE,
     K_PASS,
     K_SCOPE,
@@ -97,6 +98,7 @@ class DeviceTables:
     in_scope: jax.Array
     cond_ops: jax.Array
     cond_args: jax.Array
+    mi_sequential: jax.Array
 
     @classmethod
     def from_tables(cls, t: ProcessTables) -> "DeviceTables":
@@ -114,6 +116,7 @@ class DeviceTables:
             in_scope=jnp.asarray(t.in_scope),
             cond_ops=jnp.asarray(t.cond_ops),
             cond_args=jnp.asarray(t.cond_args),
+            mi_sequential=jnp.asarray(t.mi_sequential),
         )
 
 
@@ -192,6 +195,7 @@ def make_state(
         "def_of": jnp.asarray(def_of),
         "var_slots": jnp.asarray(slots),
         "join_counts": jnp.zeros((I, E), jnp.int32),
+        "mi_left": jnp.zeros((I, E), jnp.int32),
         "done": jnp.zeros(I, jnp.bool_),
         "incident": jnp.zeros(I, jnp.bool_),
         "transitions": jnp.zeros((), jnp.int32),
@@ -287,18 +291,14 @@ def _eval_conditions(cond_ops, cond_args, prog_ids, slot_rows):
 # scope machinery
 
 
-def _scope_drained(tables: "DeviceTables", state: dict) -> jax.Array:
-    """Mask of parked K_SCOPE tokens whose scope holds no live token and no
-    unconsumed parallel-join arrival — they complete on the next step. Used
-    by ``step`` (start-of-step state) and by ``run_collect``'s active count
-    (post-step state), so a drain-pending scope never reads as quiesced."""
+def _scope_occupancy(tables: "DeviceTables", state: dict):
+    """(occ, pend): per (instance, scope element) counts of live tokens and
+    unconsumed parallel-join arrivals strictly inside each scope."""
     elem = state["elem"]
-    phase = state["phase"]
     inst = state["inst"]
     I, E = state["join_counts"].shape
     live = elem >= 0
     def_of_tok = state["def_of"][inst]
-    op = jnp.where(live, tables.kernel_op[def_of_tok, jnp.maximum(elem, 0)], K_NONE)
     # [T, E] row t = which scopes (transitively) contain token t's element
     containing = tables.in_scope[def_of_tok, jnp.maximum(elem, 0)].astype(jnp.int32)
     occ = jnp.zeros((I, E), jnp.int32).at[inst].add(
@@ -309,10 +309,51 @@ def _scope_drained(tables: "DeviceTables", state: dict) -> jax.Array:
         state["join_counts"],
         tables.in_scope[state["def_of"]].astype(jnp.int32),
     )
+    return occ, pend
+
+
+def _scope_drained(tables: "DeviceTables", state: dict,
+                   include_mi: bool = False) -> jax.Array:
+    """Mask of parked K_SCOPE tokens whose scope holds no live token and no
+    unconsumed parallel-join arrival — they complete on the next step. Used
+    by ``step`` (start-of-step state) and by ``run_collect``'s active count
+    (post-step state), so a drain-pending scope never reads as quiesced.
+    With ``include_mi`` the mask also covers fully-spawned K_MI bodies whose
+    children all drained (body completion)."""
+    elem = state["elem"]
+    phase = state["phase"]
+    inst = state["inst"]
+    live = elem >= 0
+    def_of_tok = state["def_of"][inst]
+    op = jnp.where(live, tables.kernel_op[def_of_tok, jnp.maximum(elem, 0)], K_NONE)
+    occ, pend = _scope_occupancy(tables, state)
+    scope_like = op == K_SCOPE
+    if include_mi:
+        spawned_out = state["mi_left"][inst, jnp.maximum(elem, 0)] == 0
+        scope_like = scope_like | ((op == K_MI) & spawned_out)
     return (
-        live & (op == K_SCOPE) & (phase == PHASE_WAIT)
+        live & scope_like & (phase == PHASE_WAIT)
         & (occ[inst, jnp.maximum(elem, 0)] == 0)
         & (pend[inst, jnp.maximum(elem, 0)] == 0)
+    )
+
+
+def _mi_spawnable(tables: "DeviceTables", state: dict) -> jax.Array:
+    """Mask of parked K_MI body tokens that spawn a child next step: children
+    left, and (sequential bodies only) the previous child fully drained."""
+    elem = state["elem"]
+    phase = state["phase"]
+    inst = state["inst"]
+    live = elem >= 0
+    def_of_tok = state["def_of"][inst]
+    e = jnp.maximum(elem, 0)
+    op = jnp.where(live, tables.kernel_op[def_of_tok, e], K_NONE)
+    occ, pend = _scope_occupancy(tables, state)
+    seq = tables.mi_sequential[def_of_tok, e] > 0
+    gate = ~seq | ((occ[inst, e] == 0) & (pend[inst, e] == 0))
+    return (
+        live & (op == K_MI) & (phase == PHASE_WAIT)
+        & (state["mi_left"][inst, e] > 0) & gate
     )
 
 
@@ -350,11 +391,13 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     is_wait = is_task | (op == K_CATCH)  # parks until the host resumes it
     is_scope = op == K_SCOPE  # parks until its inner tokens drain
     is_host = op == K_HOST  # parks forever: the sequential engine owns it
+    is_mi = op == K_MI  # parks like a scope; spawns mi_left children
     executing = live & (phase == PHASE_AT) & ~stalled
     arriving_task = executing & is_wait
     arriving_scope = executing & is_scope
     arriving_host = executing & is_host
-    pass_attempt = executing & ~is_wait & ~is_scope & ~is_host
+    arriving_mi = executing & is_mi
+    pass_attempt = executing & ~is_wait & ~is_scope & ~is_host & ~is_mi
     if auto_jobs:
         waiting_done = live & is_wait & (phase == PHASE_WAIT)
     else:
@@ -365,11 +408,19 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     # parallel-join arrival remains anywhere inside it (reference: scope
     # completion requires activeChildren == 0 and activeFlows == 0); both
     # counts are start-of-step, so a resume lands one step after the last
-    # inner token dies — quiesced states stay fixed points
-    if config.has_scopes:
-        scope_resume = _scope_drained(tables, state)
+    # inner token dies — quiesced states stay fixed points. K_MI bodies join
+    # the mask once fully spawned (mi_left == 0): the body completes when
+    # its children drain.
+    if config.has_scopes or config.has_mi:
+        scope_resume = _scope_drained(tables, state, include_mi=config.has_mi)
     else:
         scope_resume = jnp.zeros(T, jnp.bool_)
+    # parked MI bodies spawn one child per step (parallel: every step until
+    # mi_left == 0; sequential: only when the previous child drained)
+    if config.has_mi:
+        mi_spawn = _mi_spawnable(tables, state)
+    else:
+        mi_spawn = jnp.zeros(T, jnp.bool_)
 
     # --- exclusive gateway condition evaluation ---------------------------
     out_count = tables.out_count[def_of_tok, jnp.maximum(elem, 0)]
@@ -421,19 +472,21 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     flows_taken = take_mask.sum()
     per_token = (
         jnp.where(full_pass, 4, 0)
-        + jnp.where(arriving_task | arriving_scope, 2, 0)
+        + jnp.where(arriving_task | arriving_scope | arriving_mi, 2, 0)
         + jnp.where(waiting_done | scope_resume, 2, 0)
     )
 
     # --- movement: flatten taken flows into placement requests ------------
     req_target_2d = jnp.where(take_mask, targets, -1)
-    if config.has_scopes:
-        # an arriving scope spawns its inner start token; the request rides
-        # the (unused) flow slot 0 of the arriving token, so placement/dest
+    spawning = arriving_scope | arriving_mi | mi_spawn
+    if config.has_scopes or config.has_mi:
+        # an arriving scope (or an MI body, on arrival and on each later
+        # spawn step while parked) spawns its inner token; the request rides
+        # the (unused) flow slot 0 of the spawner, so placement/dest
         # machinery needs no extra channel — take_mask stays false there
         # (no SEQUENCE_FLOW_TAKEN), and dest[:, 0] records the child slot
         spawn_target = jnp.where(
-            arriving_scope,
+            spawning,
             tables.scope_start[def_of_tok, jnp.maximum(elem, 0)],
             req_target_2d[:, 0],
         )
@@ -494,10 +547,19 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     new_elem = elem_after_exec.at[dest].set(req_target, mode="drop")
     new_inst = inst.at[dest].set(req_inst, mode="drop")
 
-    new_phase = jnp.where(arriving_task | arriving_scope | arriving_host,
-                          PHASE_WAIT, phase)
+    new_phase = jnp.where(
+        arriving_task | arriving_scope | arriving_host | arriving_mi,
+        PHASE_WAIT, phase)
     new_phase = jnp.where(excl_no_match, PHASE_STALLED, new_phase)
     new_phase = new_phase.at[dest].set(PHASE_AT, mode="drop")
+
+    if config.has_mi:
+        spawned = arriving_mi | mi_spawn
+        mi_left = state["mi_left"].at[inst, jnp.maximum(elem, 0)].add(
+            -spawned.astype(jnp.int32)
+        )
+    else:
+        mi_left = state["mi_left"]
 
     # --- instance completion ----------------------------------------------
     live_after = new_elem >= 0
@@ -527,6 +589,7 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
         "def_of": state["def_of"],
         "var_slots": state["var_slots"],
         "join_counts": join_counts,
+        "mi_left": mi_left,
         "done": done,
         "incident": incident,
         "transitions": transitions,
@@ -539,9 +602,11 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     if emit_events:
         events = {
             "full_pass": full_pass,
-            # scope arrivals/resumes share the task bits: the host decoder
-            # disambiguates by the element's kernel opcode (K_SCOPE)
-            "task_arrive": arriving_task | arriving_scope,
+            # scope/MI arrivals and resumes share the task bits: the host
+            # decoder disambiguates by the element's kernel opcode; mid-park
+            # MI spawns carry no flag at all — the decoder reads dest[:, 0]
+            # of parked K_MI rows
+            "task_arrive": arriving_task | arriving_scope | arriving_mi,
             "task_done": waiting_done | scope_resume,
             "elem": elem,
             "inst": inst,
@@ -639,11 +704,15 @@ def run_collect(tables: DeviceTables, state: dict, n_steps: int = 16, config=Non
             (state["elem"] >= 0)
             & ((state["phase"] == PHASE_AT) | (state["phase"] == PHASE_DONE))
         ).sum()
-        if config.has_scopes:
+        if config.has_scopes or config.has_mi:
             # a parked scope whose inside just drained resumes next step —
             # it must count as active or the chunk loop would truncate the
             # decode right before the scope's completion events
-            active = active + _scope_drained(tables, state).sum()
+            active = active + _scope_drained(
+                tables, state, include_mi=config.has_mi).sum()
+        if config.has_mi:
+            # a parked MI body with children left to spawn acts next step
+            active = active + _mi_spawnable(tables, state).sum()
         packed = _pack_events(ev, I, T).reshape(-1)
         # append (active, overflow) so the host needs exactly one device
         # fetch per chunk

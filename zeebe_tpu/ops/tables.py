@@ -336,7 +336,19 @@ K_END = 6  # end event: token dies, instance may complete
 K_CATCH = 7  # intermediate catch (timer/message): wait for host trigger/correlation
 K_SCOPE = 8  # embedded sub-process: spawn inner token, park until scope drains
 K_HOST = 9  # host escape: parks forever; the sequential engine owns the element
-#            (multi-instance, call activities, script/io-mapping tasks, …)
+#            (script/io-mapping tasks, unresolvable call activities, …)
+K_MI = 10  # multi-instance body: parks like a scope, spawns mi_left children
+#           at its inner row (scope_start); sequential bodies respawn on drain
+
+# task types a synthetic device MI body may wrap (the inner instance is a
+# job-worker task; MI on containers stays host-side)
+_MI_BODY_TYPES = frozenset((
+    BpmnElementType.SERVICE_TASK,
+    BpmnElementType.SEND_TASK,
+    BpmnElementType.SCRIPT_TASK,
+    BpmnElementType.BUSINESS_RULE_TASK,
+    BpmnElementType.USER_TASK,
+))
 
 _KERNEL_OP = {
     BpmnElementType.START_EVENT: K_PASS,
@@ -372,8 +384,12 @@ class ProcessTables:
     start_elem: np.ndarray  # [D] int32
     elem_count: np.ndarray  # [D] int32
     # embedded sub-process scopes
-    scope_start: np.ndarray  # [D, E] int32 (inner none-start of a K_SCOPE, -1)
+    scope_start: np.ndarray  # [D, E] int32 (inner none-start of a K_SCOPE, -1;
+    #                          for K_MI bodies: the synthetic inner row)
     in_scope: np.ndarray  # [D, E, E] int8: [d, e, s] = e strictly inside scope s
+    # multi-instance bodies: 1 = sequential (spawn next child only after the
+    # previous drains); 0 = parallel (spawn every step until mi_left == 0)
+    mi_sequential: np.ndarray  # [D, E] int8
     # condition programs (order-key planes: args carry (hi, lo) per step)
     cond_ops: np.ndarray  # [C, P] int32
     cond_args: np.ndarray  # [C, P, 2] int32
@@ -409,6 +425,7 @@ class ProcessTables:
             has_joins=bool((self.kernel_op == 5).any()),  # K_JOIN
             has_conditions=bool((self.out_cond >= 0).any()),
             has_scopes=bool((self.kernel_op == 8).any()),  # K_SCOPE
+            has_mi=bool((self.kernel_op == 10).any()),  # K_MI
         )
 
 
@@ -421,6 +438,7 @@ class KernelConfig:
     has_joins: bool = True
     has_conditions: bool = True
     has_scopes: bool = True
+    has_mi: bool = False
 
 
 def _live_token_width(exe: ExecutableProcess) -> int | None:
@@ -451,6 +469,11 @@ def _live_token_width(exe: ExecutableProcess) -> int | None:
             if (el.incoming_count > 1
                     and el.element_type != BpmnElementType.PARALLEL_GATEWAY):
                 return None  # unstructured convergence: element may run twice
+    for el in exe.elements[1:]:
+        if el.multi_instance is not None and el.child_start_idx >= 0:
+            # a parallel MI body spawns cardinality-many children — no
+            # static bound; callers size the pool from the predicted cards
+            return None
     width = 1
     for el in exe.elements[1:]:
         # every scope container parks one token while its inside runs: embedded
@@ -523,6 +546,7 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
     elem_count = np.zeros(D, np.int32)
     scope_start = np.full((D, E), -1, np.int32)
     in_scope = np.zeros((D, E, E), np.int8)
+    mi_seq = np.zeros((D, E), np.int8)
 
     cond_vars_by_def: list[set[str]] = []
     for d, exe in enumerate(processes):
@@ -556,7 +580,11 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                 parent = exe.elements[anc]
                 if parent.element_type not in (BpmnElementType.SUB_PROCESS,
                                                BpmnElementType.CALL_ACTIVITY,
-                                               BpmnElementType.PROCESS):
+                                               BpmnElementType.PROCESS) \
+                        and not (parent.multi_instance is not None
+                                 and parent.child_start_idx >= 0):
+                    # synthetic K_MI bodies (kernel_backend._inline_mi_bodies)
+                    # contain their inner row like a scope
                     chain_ok = False
                     break
                 chain.append(anc)
@@ -594,6 +622,19 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                     # element only needs a valid opcode so definitions carrying
                     # boundaries still lower to tables.
                     op = K_PASS
+                elif el.multi_instance is not None:
+                    # synthetic MI body (kernel_backend._inline_mi_bodies):
+                    # a TASK-type element whose child_start_idx names the
+                    # synthetic inner row; parks like a scope and spawns
+                    # mi_left children (ops/automaton K_MI). Real elements
+                    # with loop characteristics (incl. MI sub-processes,
+                    # whose child_start is their own scope start) are
+                    # outside the device subset.
+                    if (el.child_start_idx < 0
+                            or el.element_type not in _MI_BODY_TYPES):
+                        raise ConditionNotCompilable("multi-instance body")
+                    op = K_MI
+                    mi_seq[d, el.idx] = 1 if el.multi_instance.is_sequential else 0
                 elif el.element_type in (BpmnElementType.SUB_PROCESS,
                                          BpmnElementType.CALL_ACTIVITY,
                                          BpmnElementType.PROCESS):
@@ -651,7 +692,7 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                 default_slot[d, el.idx] = -1
                 continue
             kernel_op[d, el.idx] = op
-            if op == K_SCOPE:
+            if op == K_SCOPE or op == K_MI:
                 scope_start[d, el.idx] = el.child_start_idx
             if op == K_TASK and el.job_type is not None and el.job_type.is_static:
                 name = el.job_type.source
@@ -681,6 +722,7 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
         elem_count=elem_count,
         scope_start=scope_start,
         in_scope=in_scope,
+        mi_sequential=mi_seq,
         cond_ops=cond_ops,
         cond_args=cond_args,
         cond_vars_by_def=cond_vars_by_def,

@@ -38,7 +38,8 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.array(devices), ("data",))
 
 
-_SHARDED_KEYS = ("elem", "phase", "inst", "def_of", "var_slots", "join_counts", "done", "incident")
+_SHARDED_KEYS = ("elem", "phase", "inst", "def_of", "var_slots", "join_counts",
+                 "mi_left", "done", "incident")
 _REPLICATED_KEYS = ("transitions", "jobs_created", "completed", "overflow")
 
 

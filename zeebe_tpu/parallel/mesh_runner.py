@@ -149,6 +149,7 @@ class MeshKernelRunner:
         def_of = shard_arrays("def_of", 0)
         var_slots = shard_arrays("var_slots", 0.0)
         join_counts = shard_arrays("join_counts", 0)
+        mi_left = shard_arrays("mi_left", 0)
         # padding instances are done upfront so they never report newly_done
         done = shard_arrays("done", True)
 
@@ -166,6 +167,7 @@ class MeshKernelRunner:
             "def_of": put("def_of", def_of),
             "var_slots": put("var_slots", var_slots),
             "join_counts": put("join_counts", join_counts),
+            "mi_left": put("mi_left", mi_left),
             "done": put("done", done),
             "incident": put("incident", np.zeros(S * I_c, np.bool_)),
             # counters/overflow are per-shard rows (NOT psum'd: a partition's
