@@ -207,10 +207,14 @@ class E2EPartition:
         self.db = ZbDb()
         self.engine = Engine(self.db, partition_id=partition_id,
                              clock_millis=clock)
-        # group/chunk sizing tuned on the tunnel-attached chip: bigger groups
-        # amortize the per-fetch latency, shorter chunks shrink each fetch
-        self.kernel = KernelBackend(self.engine, max_group=2048, chunk_steps=8,
-                                    mesh_runner=mesh_runner)
+        # group sizing is LINK-dependent: behind the TPU tunnel (~30ms per
+        # fetch) big groups amortize the per-chunk fetch; on a local backend
+        # the fetch is free and a big group only pays shape padding — a
+        # 300-command wave padded into the 2048/8192 bucket costs ~7x the
+        # device compute of the 256/1024 one (measured: mixed_8 38k -> 61k
+        # transitions/s at cap 256 on the CPU host)
+        self.kernel = KernelBackend(self.engine, max_group=_group_cap(),
+                                    chunk_steps=8, mesh_runner=mesh_runner)
         self.processor = StreamProcessor(
             self.stream, self.db, self.engine, clock_millis=clock,
             kernel_backend=self.kernel,
@@ -522,6 +526,18 @@ def run_kernel_ceiling() -> dict:
     return {"transitions_per_sec": round(rounds * per_run / elapsed, 1)}
 
 
+# resolved by _ensure_backend(); "cpu" until probed
+_PLATFORM = "cpu"
+
+
+def _group_cap() -> int:
+    """Kernel group cap for the resolved backend: remote accelerators
+    amortize their per-fetch link latency with big groups; local backends
+    prefer tight shape buckets (see E2EPartition.__init__)."""
+    return 2048 if _PLATFORM not in ("cpu", "cpu-forced",
+                                     "cpu-fallback(tpu-unreachable)") else 256
+
+
 def _ensure_backend() -> str:
     """Pick the JAX platform for this run. The TPU tunnel can hang
     indefinitely at first device use (observed: jax.devices() never
@@ -532,15 +548,19 @@ def _ensure_backend() -> str:
     from zeebe_tpu.utils.backend_probe import probe_default_backend
     from zeebe_tpu.utils.xla_cache import enable_persistent_cache
 
+    global _PLATFORM
     enable_persistent_cache()
     if os.environ.get("ZB_BENCH_CPU"):
         jax.config.update("jax_platforms", "cpu")
+        _PLATFORM = "cpu-forced"
         return "cpu-forced"
     probed = probe_default_backend()
     if probed is None:
         jax.config.update("jax_platforms", "cpu")
-        return "cpu-fallback(tpu-unreachable)"
-    return probed[0]
+        _PLATFORM = "cpu-fallback(tpu-unreachable)"
+        return _PLATFORM
+    _PLATFORM = probed[0]
+    return _PLATFORM
 
 
 def _router_stats() -> dict:

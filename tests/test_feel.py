@@ -164,3 +164,141 @@ class TestExpressionFacade:
     def test_trailing_junk_rejected(self):
         with pytest.raises(FeelParseError):
             parse_expression("= 1 2")
+
+
+class TestStringBuiltins:
+    """camunda-feel StringBuiltinFunctions parity (the DMN FEEL spec set)."""
+
+    @pytest.mark.parametrize(
+        "src,expected",
+        [
+            ('substring("foobar", 3)', "obar"),
+            ('substring("foobar", 3, 3)', "oba"),
+            ('substring("foobar", -2, 1)', "a"),
+            ('substring("foobar", 0)', None),
+            ('substring before("foobar", "bar")', "foo"),
+            ('substring before("foobar", "xyz")', ""),
+            ('substring after("foobar", "ob")', "ar"),
+            ('substring after("foobar", "")', "foobar"),
+            ('replace("abcd", "(ab)|(a)", "[1=$1][2=$2]")', "[1=ab][2=]cd"),
+            ('replace("ABC", "b", "x", "i")', "AxC"),
+            ('split("John Doe", "\\s")', ["John", "Doe"]),
+            ('split("a;b;;c", ";")', ["a", "b", "", "c"]),
+            ('matches("foobar", "^fo*b")', True),
+            ('matches("foobar", "^Fo*b")', False),
+            ('matches("foobar", "^Fo*b", "i")', True),
+            ('string join(["a", "b", "c"])', "abc"),
+            ('string join(["a", "b"], ", ")', "a, b"),
+            ('string join(["a", null, "c"], "-")', "a-c"),
+            ('string join(["a"], "X", "<", ">")', "<a>"),
+        ],
+    )
+    def test_string_fn(self, src, expected):
+        assert ev(src) == expected
+
+
+class TestListBuiltins:
+    @pytest.mark.parametrize(
+        "src,expected",
+        [
+            ("concatenate([1, 2], [3])", [1, 2, 3]),
+            ("insert before([1, 3], 2, 2)", [1, 2, 3]),
+            ("insert before([1], 1, 0)", [0, 1]),
+            ("remove([1, 2, 3], 2)", [1, 3]),
+            ("reverse([1, 2, 3])", [3, 2, 1]),
+            ('index of([1, 2, 3, 2], 2)', [2, 4]),
+            ("union([1, 2], [2, 3])", [1, 2, 3]),
+            ("distinct values([1, 2, 3, 2, 1])", [1, 2, 3]),
+            ("duplicate values([1, 2, 3, 2, 1])", [1, 2]),
+            ("flatten([[1, 2], [[3]], 4])", [1, 2, 3, 4]),
+            ("sort([3, 1, 2])", [1, 2, 3]),
+            ("sublist([1, 2, 3], 2)", [2, 3]),
+            ("sublist([1, 2, 3], 1, 2)", [1, 2]),
+            ("sublist([1, 2, 3], -2, 1)", [2]),
+            ("partition([1, 2, 3, 4, 5], 2)", [[1, 2], [3, 4], [5]]),
+            ("partition([], 2)", []),
+            ("product([2, 3, 4])", 24),
+            ("mean([1, 2, 3])", 2),
+            ("median([8, 2, 5, 3, 4])", 4),
+            ("median([6, 1, 2, 3])", 2.5),
+            ("mode([6, 3, 9, 6, 6])", [6]),
+            ("mode([6, 1, 9, 6, 1])", [1, 6]),
+            ("all([true, true])", True),
+            ("all([true, false])", False),
+            ("all([])", True),
+            ("any([false, true])", True),
+            ("any([false, false])", False),
+            ("any([])", False),
+            ("count([1, 2])", 2),
+        ],
+    )
+    def test_list_fn(self, src, expected):
+        assert ev(src) == expected
+
+    def test_stddev(self):
+        assert abs(ev("stddev([2, 4, 7, 5])") - 2.0816659994661326) < 1e-12
+
+    def test_all_null_poisoning(self):
+        # ternary logic: an undecided all/any with non-boolean members → null
+        assert ev("all([true, null])") is None
+        assert ev("all([false, null])") is False
+        assert ev("any([true, null])") is True
+        assert ev("any([false, null])") is None
+
+
+class TestNumericBuiltins:
+    @pytest.mark.parametrize(
+        "src,expected",
+        [
+            ("round up(5.5)", 6),
+            ("round up(-5.5)", -6),
+            ("round up(1.121, 2)", 1.13),
+            ("round down(5.5)", 5),
+            ("round down(-1.126, 2)", -1.12),
+            ("round half up(5.5)", 6),
+            ("round half up(-5.5)", -6),
+            ("round half down(5.5)", 5),
+            ("round half down(-5.5, 0)", -5),
+            ("decimal(1/3, 2)", 0.33),
+            ("decimal(2.515, 2)", 2.52),  # exact-literal tie, half-even
+            ("decimal(2.525, 2)", 2.52),  # half-even: ties go to even
+            ("odd(5)", True),
+            ("odd(2)", False),
+            ("even(2)", True),
+            ("log(1)", 0),
+        ],
+    )
+    def test_numeric_fn(self, src, expected):
+        got = ev(src)
+        assert got == expected, f"{src} -> {got}"
+
+    def test_exp(self):
+        import math
+
+        assert abs(ev("exp(1)") - math.e) < 1e-12
+
+    def test_log_of_nonpositive_is_null(self):
+        assert ev("log(0)") is None
+
+
+class TestContextBuiltins:
+    @pytest.mark.parametrize(
+        "src,expected",
+        [
+            ('get value({a: 1}, "a")', 1),
+            ('get value({a: 1}, "b")', None),
+            ('context put({a: 1}, "b", 2)', {"a": 1, "b": 2}),
+            ('context put({a: 1}, "a", 9)', {"a": 9}),
+            ("context merge({a: 1}, {b: 2}, {a: 3})", {"a": 3, "b": 2}),
+            ("context merge([{a: 1}, {b: 2}])", {"a": 1, "b": 2}),
+        ],
+    )
+    def test_context_fn(self, src, expected):
+        assert ev(src) == expected
+
+    def test_get_entries(self):
+        assert ev("get entries({a: 1})") == [{"key": "a", "value": 1}]
+
+    def test_substring_before_empty_match(self):
+        # camunda-feel: an empty match string yields "" (review finding r4)
+        assert ev('substring before("foobar", "")') == ""

@@ -1932,7 +1932,10 @@ class KernelBackend:
             # per row: T*(2+FO) packed event ints + (active, overflow) tail
             events_host = flat[:, :-2].reshape(chunk, T, 2 + FO)
             active = flat[:, -2]
-            overflow = flat[-1, -1]
+            # overflow is cumulative in device state; with run_collect's
+            # early exit the rows past quiescence are unwritten zeros, so
+            # any written row carrying the bit is the signal
+            overflow = overflow or bool(flat[:, -1].any())
             # steps after quiescence emit nothing — truncate so the host
             # decoder never walks empty tail steps
             quiesced = np.flatnonzero(active == 0)

@@ -12,7 +12,10 @@ workloads use (S-FEEL + common extensions):
 - boolean ``and`` / ``or`` / ``not(x)``, parentheses
 - ``if <c> then <a> else <b>``
 - ``x in [a..b]`` ranges and ``in`` list membership
-- a pragmatic builtin set: string(), number(), contains(), starts with(),
+- the camunda-feel builtin library surface: string/list/numeric/context/
+  temporal functions (substring, replace/matches/split over XPath-flag
+  regexes, sort, flatten, partition, round half up/down, decimal,
+  context put/merge, …) plus string(), number(), contains(), starts with(),
   ends with(), upper case(), lower case(), count(), sum(), min(), max(),
   floor(), ceiling(), abs(), modulo(), not(), is defined(), string length(),
   append(), list contains(), now() (from an injected clock)
@@ -154,6 +157,21 @@ _MULTIWORD = {
     ("is", "defined"): "is defined",
     ("string", "length"): "string length",
     ("list", "contains"): "list contains",
+    ("substring", "before"): "substring before",
+    ("substring", "after"): "substring after",
+    ("string", "join"): "string join",
+    ("insert", "before"): "insert before",
+    ("index", "of"): "index of",
+    ("distinct", "values"): "distinct values",
+    ("duplicate", "values"): "duplicate values",
+    ("round", "up"): "round up",
+    ("round", "down"): "round down",
+    ("round", "half", "up"): "round half up",
+    ("round", "half", "down"): "round half down",
+    ("get", "value"): "get value",
+    ("get", "entries"): "get entries",
+    ("context", "put"): "context put",
+    ("context", "merge"): "context merge",
 }
 _MULTIWORD_MAX = max(len(k) for k in _MULTIWORD)
 
@@ -466,7 +484,211 @@ _BUILTINS: dict[str, Callable[..., Any]] = {
     if isinstance(v, (FeelDate, FeelDateTime)) else None,
     "week of year": lambda v: (v.d if isinstance(v, FeelDate) else v.dt).isocalendar()[1]
     if isinstance(v, (FeelDate, FeelDateTime)) else None,
+    # -- string functions (camunda-feel StringBuiltinFunctions) -------------
+    "substring": lambda s, start, length=None: _substring(s, start, length),
+    "substring before": lambda s, m: (
+        s.split(m, 1)[0] if isinstance(s, str) and isinstance(m, str)
+        and m and m in s else ("" if isinstance(s, str) else None)),
+    "substring after": lambda s, m: s.split(m, 1)[1] if isinstance(s, str)
+    and isinstance(m, str) and m and m in s
+    else (s if isinstance(s, str) and m == "" else
+          ("" if isinstance(s, str) else None)),
+    "replace": lambda s, pattern, repl, flags="": _regex(
+        lambda rx: rx.sub(_feel_replacement(repl), s), pattern, flags
+    ) if isinstance(s, str) else None,
+    "split": lambda s, delim: _regex(lambda rx: rx.split(s), delim)
+    if isinstance(s, str) else None,
+    "matches": lambda s, pattern, flags="": _regex(
+        lambda rx: rx.search(s) is not None, pattern, flags
+    ) if isinstance(s, str) else None,
+    "string join": lambda xs, delim="", prefix=None, suffix=None: _string_join(
+        xs, delim, prefix, suffix),
+    # -- list functions (ListBuiltinFunctions) ------------------------------
+    "concatenate": lambda *ls: [x for l in ls for x in l]
+    if all(isinstance(l, list) for l in ls) else None,
+    "insert before": lambda xs, pos, item: (
+        xs[: int(pos) - 1] + [item] + xs[int(pos) - 1:]
+        if isinstance(xs, list) and 1 <= int(pos) <= len(xs) + 1 else None),
+    "remove": lambda xs, pos: (
+        xs[: int(pos) - 1] + xs[int(pos):]
+        if isinstance(xs, list) and 1 <= int(pos) <= len(xs) else None),
+    "reverse": lambda xs: list(reversed(xs)) if isinstance(xs, list) else None,
+    "index of": lambda xs, match: [i + 1 for i, x in enumerate(xs) if x == match]
+    if isinstance(xs, list) else None,
+    "union": lambda *ls: _distinct([x for l in ls for x in l])
+    if all(isinstance(l, list) for l in ls) else None,
+    "distinct values": lambda xs: _distinct(xs) if isinstance(xs, list) else None,
+    "duplicate values": lambda xs: _distinct(
+        [x for x in xs if xs.count(x) > 1]  # first-appearance order
+    ) if isinstance(xs, list) else None,
+    "flatten": lambda xs: _flatten(xs) if isinstance(xs, list) else None,
+    "sort": lambda xs: sorted(xs) if isinstance(xs, list) else None,
+    "sublist": lambda xs, start, length=None: _sublist(xs, start, length),
+    "partition": lambda xs, size: (
+        [xs[i: i + int(size)] for i in range(0, len(xs), int(size))]
+        if isinstance(xs, list) and int(size) > 0 else None),
+    "product": lambda xs: math.prod(_num(x) for x in xs)
+    if isinstance(xs, list) and xs else None,
+    "mean": lambda xs: sum(_num(x) for x in xs) / len(xs)
+    if isinstance(xs, list) and xs else None,
+    "median": lambda xs: _median(xs) if isinstance(xs, list) and xs else None,
+    "stddev": lambda xs: _stddev(xs) if isinstance(xs, list) and len(xs) > 1 else None,
+    "mode": lambda xs: _mode(xs) if isinstance(xs, list) else None,
+    "all": lambda xs: _all_bool(xs, True) if isinstance(xs, list) else None,
+    "any": lambda xs: _all_bool(xs, False) if isinstance(xs, list) else None,
+    # -- numeric functions (NumericBuiltinFunctions) ------------------------
+    "round up": lambda n, scale=0: _scaled_round(n, scale, "up"),
+    "round down": lambda n, scale=0: _scaled_round(n, scale, "down"),
+    "round half up": lambda n, scale=0: _scaled_round(n, scale, "half_up"),
+    "round half down": lambda n, scale=0: _scaled_round(n, scale, "half_down"),
+    "decimal": lambda n, scale: _scaled_round(n, scale, "half_even"),
+    "exp": lambda v: math.exp(_num(v)),
+    "log": lambda v: math.log(_num(v)) if _num(v) > 0 else None,
+    "odd": lambda v: _num(v) % 2 != 0,
+    "even": lambda v: _num(v) % 2 == 0,
+    # -- context functions (ContextBuiltinFunctions) ------------------------
+    "get value": lambda ctx, key: ctx.get(key) if isinstance(ctx, dict) else None,
+    "get entries": lambda ctx: [{"key": k, "value": v} for k, v in ctx.items()]
+    if isinstance(ctx, dict) else None,
+    "context put": lambda ctx, key, value: {**ctx, key: value}
+    if isinstance(ctx, dict) and isinstance(key, str) else None,
+    "context merge": lambda *cs: (
+        {k: v for c in (cs[0] if len(cs) == 1 and isinstance(cs[0], list) else cs)
+         for k, v in c.items()}
+        if all(isinstance(c, dict)
+               for c in (cs[0] if len(cs) == 1 and isinstance(cs[0], list) else cs))
+        else None),
 }
+
+
+def _substring(s, start, length):
+    if not isinstance(s, str):
+        return None
+    start = int(start)
+    if start == 0:
+        return None  # FEEL positions are 1-based
+    i = start - 1 if start > 0 else len(s) + start
+    if i < 0:
+        i = 0
+    end = len(s) if length is None else i + int(length)
+    return s[i:end]
+
+
+def _sublist(xs, start, length):
+    if not isinstance(xs, list):
+        return None
+    start = int(start)
+    if start == 0 or abs(start) > len(xs):
+        return None
+    i = start - 1 if start > 0 else len(xs) + start
+    end = len(xs) if length is None else i + int(length)
+    return xs[i:end]
+
+
+def _regex(apply, pattern, flags=""):
+    """camunda-feel regex builtins: XPath-style flags; invalid patterns are
+    null, not errors."""
+    f = 0
+    for ch in flags or "":
+        f |= {"i": re.IGNORECASE, "s": re.DOTALL, "m": re.MULTILINE,
+              "x": re.VERBOSE}.get(ch, 0)
+    try:
+        return apply(re.compile(pattern, f))
+    except re.error:
+        return None
+
+
+def _feel_replacement(repl: str) -> str:
+    """XPath replacement syntax ($1 groups) → Python (\\1)."""
+    return re.sub(r"\$(\d)", r"\\\1", repl)
+
+
+def _string_join(xs, delim, prefix, suffix):
+    if not isinstance(xs, list):
+        return None
+    parts = [x for x in xs if x is not None]
+    if not all(isinstance(x, str) for x in parts):
+        return None
+    joined = (delim or "").join(parts)
+    if prefix is not None or suffix is not None:
+        return (prefix or "") + joined + (suffix or "")
+    return joined
+
+
+def _distinct(xs: list) -> list:
+    out: list = []
+    for x in xs:
+        if x not in out:
+            out.append(x)
+    return out
+
+
+def _flatten(xs):
+    out: list = []
+    for x in xs:
+        if isinstance(x, list):
+            out.extend(_flatten(x))
+        else:
+            out.append(x)
+    return out
+
+
+def _median(xs: list):
+    vals = sorted(_num(x) for x in xs)
+    n = len(vals)
+    mid = n // 2
+    return vals[mid] if n % 2 else (vals[mid - 1] + vals[mid]) / 2
+
+
+def _stddev(xs: list):
+    vals = [_num(x) for x in xs]
+    m = sum(vals) / len(vals)
+    return math.sqrt(sum((v - m) ** 2 for v in vals) / (len(vals) - 1))
+
+
+def _mode(xs: list):
+    if not xs:
+        return []
+    counts: dict = {}
+    for x in xs:
+        counts[_num(x)] = counts.get(_num(x), 0) + 1
+    best = max(counts.values())
+    return sorted(v for v, c in counts.items() if c == best)
+
+
+def _all_bool(xs: list, conjunctive: bool):
+    """all()/any() ternary logic: non-boolean members poison to null unless
+    the result is already decided by a False (all) / True (any)."""
+    saw_null = False
+    for x in xs:
+        if not isinstance(x, bool):
+            saw_null = True
+        elif x is not conjunctive:
+            return not conjunctive
+    return None if saw_null else conjunctive
+
+
+def _scaled_round(n, scale, mode: str):
+    import decimal
+
+    try:
+        # str() recovers the shortest decimal literal of the float —
+        # matching camunda-feel, whose number literals are exact BigDecimals
+        # (decimal(2.515, 2) is a true tie there and half-even gives 2.52)
+        d = decimal.Decimal(str(_num(n)))
+    except FeelEvalError:
+        return None
+    exp = decimal.Decimal(1).scaleb(-int(scale))
+    rounding = {
+        "up": decimal.ROUND_UP,
+        "down": decimal.ROUND_DOWN,
+        "half_up": decimal.ROUND_HALF_UP,
+        "half_down": decimal.ROUND_HALF_DOWN,
+        "half_even": decimal.ROUND_HALF_EVEN,
+    }[mode]
+    q = d.quantize(exp, rounding=rounding)
+    f = float(q)
+    return int(f) if f.is_integer() else f
 
 _WEEKDAY_NAMES = ("Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
                   "Saturday", "Sunday")

@@ -313,7 +313,7 @@ def _scope_occupancy(tables: "DeviceTables", state: dict):
 
 
 def _scope_drained(tables: "DeviceTables", state: dict,
-                   include_mi: bool = False) -> jax.Array:
+                   include_mi: bool = False, occ_pend=None) -> jax.Array:
     """Mask of parked K_SCOPE tokens whose scope holds no live token and no
     unconsumed parallel-join arrival — they complete on the next step. Used
     by ``step`` (start-of-step state) and by ``run_collect``'s active count
@@ -326,7 +326,7 @@ def _scope_drained(tables: "DeviceTables", state: dict,
     live = elem >= 0
     def_of_tok = state["def_of"][inst]
     op = jnp.where(live, tables.kernel_op[def_of_tok, jnp.maximum(elem, 0)], K_NONE)
-    occ, pend = _scope_occupancy(tables, state)
+    occ, pend = occ_pend if occ_pend is not None else _scope_occupancy(tables, state)
     scope_like = op == K_SCOPE
     if include_mi:
         spawned_out = state["mi_left"][inst, jnp.maximum(elem, 0)] == 0
@@ -338,7 +338,8 @@ def _scope_drained(tables: "DeviceTables", state: dict,
     )
 
 
-def _mi_spawnable(tables: "DeviceTables", state: dict) -> jax.Array:
+def _mi_spawnable(tables: "DeviceTables", state: dict,
+                  occ_pend=None) -> jax.Array:
     """Mask of parked K_MI body tokens that spawn a child next step: children
     left, and (sequential bodies only) the previous child fully drained."""
     elem = state["elem"]
@@ -348,7 +349,7 @@ def _mi_spawnable(tables: "DeviceTables", state: dict) -> jax.Array:
     def_of_tok = state["def_of"][inst]
     e = jnp.maximum(elem, 0)
     op = jnp.where(live, tables.kernel_op[def_of_tok, e], K_NONE)
-    occ, pend = _scope_occupancy(tables, state)
+    occ, pend = occ_pend if occ_pend is not None else _scope_occupancy(tables, state)
     seq = tables.mi_sequential[def_of_tok, e] > 0
     gate = ~seq | ((occ[inst, e] == 0) & (pend[inst, e] == 0))
     return (
@@ -412,13 +413,16 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     # the mask once fully spawned (mi_left == 0): the body completes when
     # its children drain.
     if config.has_scopes or config.has_mi:
-        scope_resume = _scope_drained(tables, state, include_mi=config.has_mi)
+        occ_pend = _scope_occupancy(tables, state)
+        scope_resume = _scope_drained(tables, state, include_mi=config.has_mi,
+                                      occ_pend=occ_pend)
     else:
+        occ_pend = None
         scope_resume = jnp.zeros(T, jnp.bool_)
     # parked MI bodies spawn one child per step (parallel: every step until
     # mi_left == 0; sequential: only when the previous child drained)
     if config.has_mi:
-        mi_spawn = _mi_spawnable(tables, state)
+        mi_spawn = _mi_spawnable(tables, state, occ_pend=occ_pend)
     else:
         mi_spawn = jnp.zeros(T, jnp.bool_)
 
@@ -503,14 +507,32 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
             req_live, tables.kernel_op[req_def, jnp.maximum(req_target, 0)], K_NONE
         )
         is_join_req = req_op == K_JOIN
-        join_key = jnp.where(is_join_req, req_inst * E + req_target, jnp.int32(2**30))
-        order = jnp.argsort(join_key, stable=True)
-        sorted_key = join_key[order]
-        new_run = jnp.concatenate([jnp.ones(1, jnp.bool_), sorted_key[1:] != sorted_key[:-1]])
-        idxs = jnp.arange(T * FO, dtype=jnp.int32)
-        run_start = jax.lax.associative_scan(jnp.maximum, jnp.where(new_run, idxs, 0))
-        rank_sorted = idxs - run_start
-        rank = jnp.zeros(T * FO, jnp.int32).at[order].set(rank_sorted)
+        flat_key = jnp.where(is_join_req, req_inst * E + req_target, 0)
+        arrivals_flat = jnp.zeros((I * E,), jnp.int32).at[flat_key].add(
+            jnp.where(is_join_req, 1, 0)
+        )
+
+        # the stable argsort only matters when TWO arrivals hit the same
+        # (instance, join) in one step; most steps have at most one, so the
+        # whole ranking machinery rides a scalar-predicated cond (real
+        # control flow, like the condition VM's skip)
+        def ranked(_):
+            join_key = jnp.where(is_join_req, req_inst * E + req_target,
+                                 jnp.int32(2**30))
+            order = jnp.argsort(join_key, stable=True)
+            sorted_key = join_key[order]
+            new_run = jnp.concatenate(
+                [jnp.ones(1, jnp.bool_), sorted_key[1:] != sorted_key[:-1]])
+            idxs = jnp.arange(T * FO, dtype=jnp.int32)
+            run_start = jax.lax.associative_scan(
+                jnp.maximum, jnp.where(new_run, idxs, 0))
+            rank_sorted = idxs - run_start
+            return jnp.zeros(T * FO, jnp.int32).at[order].set(rank_sorted)
+
+        rank = jax.lax.cond(
+            jnp.any(arrivals_flat > 1), ranked,
+            lambda _: jnp.zeros(T * FO, jnp.int32), operand=None,
+        )
 
         prior = state["join_counts"][req_inst, jnp.maximum(req_target, 0)]
         arity = jnp.maximum(tables.in_count[req_def, jnp.maximum(req_target, 0)], 1)
@@ -518,10 +540,6 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
         join_completes = is_join_req & (count_after % arity == 0)
         proceeds = req_live & (~is_join_req | join_completes)
 
-        flat_key = jnp.where(is_join_req, req_inst * E + req_target, 0)
-        arrivals_flat = jnp.zeros((I * E,), jnp.int32).at[flat_key].add(
-            jnp.where(is_join_req, 1, 0)
-        )
         consumed_flat = jnp.zeros((I * E,), jnp.int32).at[flat_key].add(
             jnp.where(join_completes, arity, 0)
         )
@@ -698,7 +716,11 @@ def run_collect(tables: DeviceTables, state: dict, n_steps: int = 16, config=Non
     I = state["def_of"].shape[0]
     T = state["elem"].shape[0]
 
-    def body(state, _):
+    FO = tables.out_target.shape[2]
+    row_len = T * (2 + FO) + 2
+
+    def body(carry):
+        state, out, i, _ = carry
         state, ev = step(tables, state, auto_jobs=False, emit_events=True, config=config)
         active = (
             (state["elem"] >= 0)
@@ -708,19 +730,35 @@ def run_collect(tables: DeviceTables, state: dict, n_steps: int = 16, config=Non
             # a parked scope whose inside just drained resumes next step —
             # it must count as active or the chunk loop would truncate the
             # decode right before the scope's completion events
+            op2 = _scope_occupancy(tables, state)
             active = active + _scope_drained(
-                tables, state, include_mi=config.has_mi).sum()
-        if config.has_mi:
-            # a parked MI body with children left to spawn acts next step
-            active = active + _mi_spawnable(tables, state).sum()
+                tables, state, include_mi=config.has_mi, occ_pend=op2).sum()
+            if config.has_mi:
+                # a parked MI body with children left to spawn acts next step
+                active = active + _mi_spawnable(tables, state,
+                                                occ_pend=op2).sum()
         packed = _pack_events(ev, I, T).reshape(-1)
         # append (active, overflow) so the host needs exactly one device
         # fetch per chunk
         tail = jnp.stack([active.astype(jnp.int32),
                           state["overflow"].astype(jnp.int32)])
-        return state, jnp.concatenate([packed, tail])
+        out = jax.lax.dynamic_update_index_in_dim(
+            out, jnp.concatenate([packed, tail]), i, 0)
+        return state, out, i + 1, active > 0
 
-    state, packed = jax.lax.scan(body, state, None, length=n_steps)
+    def cond(carry):
+        _state, _out, i, go = carry
+        return go & (i < n_steps)
+
+    # early-exit loop (not scan): a quiesced state is a fixed point, so the
+    # remaining steps of the chunk would only burn device FLOPs — short
+    # cascades (a job completion advancing 2-3 steps) skip most of the chunk.
+    # Unwritten rows stay zero; their active==0 tail is exactly the host's
+    # truncation signal, and the host reads overflow from the LAST WRITTEN
+    # row (cumulative in state), not the final buffer row.
+    out0 = jnp.zeros((n_steps, row_len), jnp.int32)
+    state, packed, _, _ = jax.lax.while_loop(
+        cond, body, (state, out0, jnp.int32(0), jnp.bool_(True)))
     return state, packed
 
 

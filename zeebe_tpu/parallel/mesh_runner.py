@@ -194,7 +194,10 @@ class MeshKernelRunner:
                 block = flat[:, ri * row_len : (ri + 1) * row_len]
                 events = block[:, :-2].reshape(chunk, T_c, 2 + FO)
                 active = block[:, -2]
-                overflow[ri] = bool(block[-1, -1])
+                # overflow is cumulative in device state; run_collect's
+                # early-exit loop leaves rows past quiescence as zeros, so
+                # any written row carrying the bit is the signal
+                overflow[ri] = overflow[ri] or bool(block[:, -1].any())
                 qs = np.flatnonzero(active == 0)
                 keep = int(qs[0]) + 1 if qs.size else chunk
                 for s in range(keep):
