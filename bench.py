@@ -277,11 +277,13 @@ class E2EPartition:
         elapsed = 0.0
         for wave in sorted(waves):
             t0 = time.perf_counter()
-            for key in waves[wave]:
-                writer.try_write([
-                    LogAppendEntry(command(ValueType.JOB, JobIntent.COMPLETE,
-                                           {"variables": {}}, key=key))
-                ])
+            # one append batch per wave (one frame encode pass + one fsync),
+            # as a real gateway's request batching would write it
+            writer.try_write([
+                LogAppendEntry(command(ValueType.JOB, JobIntent.COMPLETE,
+                                       {"variables": {}}, key=key))
+                for key in waves[wave]
+            ])
             self.pump()
             elapsed += time.perf_counter() - t0
         return elapsed
@@ -450,7 +452,7 @@ def run_mesh_serving(n_partitions: int, per_partition: int = 800,
         )
         for p in parts:
             p.journal.close()
-    return {
+    out = {
         "partitions": n_partitions,
         "aggregate_transitions_per_sec": round(transitions / elapsed, 1),
         "transitions": transitions,
@@ -461,6 +463,15 @@ def run_mesh_serving(n_partitions: int, per_partition: int = 800,
             runner.coalesced_dispatches / max(1, runner.dispatches), 3),
         "fallbacks": sum(p.kernel.fallbacks for p in parts),
     }
+    if n_partitions > 1 and _PLATFORM.startswith("cpu"):
+        # every virtual mesh device shares ONE physical core here: N
+        # partitions' Python AND their shards' compute serialize, so the
+        # aggregate cannot exceed the single-partition rate — the curve
+        # measures dispatch-coalescing overhead, not hardware scaling
+        # (which needs N real chips; see __graft_entry__.dryrun_multichip
+        # for the sharding-correctness evidence)
+        out["note"] = "single-core host: shards serialize; not a scaling measurement"
+    return out
 
 
 def run_replay_recovery(tmpdir_records: int = 4000) -> dict:
@@ -534,8 +545,7 @@ def _group_cap() -> int:
     """Kernel group cap for the resolved backend: remote accelerators
     amortize their per-fetch link latency with big groups; local backends
     prefer tight shape buckets (see E2EPartition.__init__)."""
-    return 2048 if _PLATFORM not in ("cpu", "cpu-forced",
-                                     "cpu-fallback(tpu-unreachable)") else 256
+    return 256 if _PLATFORM.startswith("cpu") else 2048
 
 
 def _ensure_backend() -> str:

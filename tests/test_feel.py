@@ -302,3 +302,16 @@ class TestContextBuiltins:
     def test_substring_before_empty_match(self):
         # camunda-feel: an empty match string yields "" (review finding r4)
         assert ev('substring before("foobar", "")') == ""
+
+    def test_replace_whole_match_and_multidigit_groups(self):
+        # $0 is the whole match (not an octal NUL escape)
+        assert ev('replace("abc", "b", "[$0]")') == "a[b]c"
+
+    def test_substring_out_of_range_negative_start(self):
+        assert ev('substring("abc", -5, 2)') is None
+
+    def test_aggregates_accept_varargs(self):
+        assert ev("mean(1, 2, 3)") == 2
+        assert ev("product(2, 3)") == 6
+        assert ev("median(3, 1, 2)") == 2
+        assert ev("mode(6, 6, 1)") == [6]

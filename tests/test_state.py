@@ -248,3 +248,79 @@ class TestSnapshotStore:
         dst = FileBasedSnapshotStore(tmp_path / "follower")
         with pytest.raises(InvalidSnapshotError):
             dst.receive_snapshot(iter(bad))
+
+
+class TestIterateSnapshotNativeParity:
+    """The native iterate_snapshot must match the Python merge exactly —
+    ordering, overlay supersession, deleted hiding, and the defensive
+    copy-and-cache of committed container values."""
+
+    def _fill(self, db):
+        from zeebe_tpu.state.db import ColumnFamilyCode as CF
+
+        cf = db.column_family(CF.VARIABLES)
+        with db.transaction():
+            for i in range(6):
+                cf.put((7, f"k{i}"), {"v": i})
+            cf.put((8, "other"), {"v": 99})
+            cf.put((7, "scalar"), 42)
+            cf.put((7, "lst"), [1, 2])
+        return cf
+
+    def test_merge_matches_python_path(self):
+        import zeebe_tpu.state.db as dbm
+        from zeebe_tpu.state.db import ZbDb
+
+        db = ZbDb()
+        cf = self._fill(db)
+        with db.transaction():
+            cf.put((7, "k1"), {"v": 100})   # overlay supersedes
+            cf.delete((7, "k2"))             # overlay hides
+            cf.put((7, "zz"), {"v": 7})      # overlay-only key
+            txn = db.require_transaction()
+            native = list(txn.iterate(cf._key((7,))))
+            orig = dbm._iterate_snapshot
+            dbm._iterate_snapshot = None
+            try:
+                txn._reads.clear()  # fresh copy-cache for the pure path
+                pure = list(txn.iterate(cf._key((7,))))
+            finally:
+                dbm._iterate_snapshot = orig
+            assert [k for k, _ in native] == [k for k, _ in pure]
+            assert [v for _, v in native] == [v for _, v in pure]
+
+    def test_committed_values_copy_cached(self):
+        from zeebe_tpu.state.db import ZbDb
+
+        db = ZbDb()
+        cf = self._fill(db)
+
+        class _Boom(Exception):
+            pass
+
+        try:
+            with db.transaction():
+                txn = db.require_transaction()
+                snap = dict(txn.iterate(cf._key((7,))))
+                key = cf._key((7, "k0"))
+                # same transaction: get() must hand back the SAME cached copy
+                # so in-place mutations stay coherent within the txn
+                got = txn.get(key)
+                assert got is snap[key]
+                got["v"] = 1234
+                raise _Boom  # roll the transaction back
+        except _Boom:
+            pass
+        with db.transaction():
+            # rollback never leaked the mutation into the committed store
+            assert cf.get((7, "k0")) == {"v": 0}
+
+    def test_all_ff_prefix_unbounded(self):
+        from zeebe_tpu.state.db import ZbDb
+
+        db = ZbDb()
+        with db.transaction():
+            txn = db.require_transaction()
+            txn.put(b"\xff\xff\x01", 1)
+            txn.put(b"\xff\xff\x02", 2)
+            assert [v for _, v in txn.iterate(b"\xff\xff")] == [1, 2]

@@ -527,13 +527,16 @@ _BUILTINS: dict[str, Callable[..., Any]] = {
     "partition": lambda xs, size: (
         [xs[i: i + int(size)] for i in range(0, len(xs), int(size))]
         if isinstance(xs, list) and int(size) > 0 else None),
-    "product": lambda xs: math.prod(_num(x) for x in xs)
-    if isinstance(xs, list) and xs else None,
-    "mean": lambda xs: sum(_num(x) for x in xs) / len(xs)
-    if isinstance(xs, list) and xs else None,
-    "median": lambda xs: _median(xs) if isinstance(xs, list) and xs else None,
-    "stddev": lambda xs: _stddev(xs) if isinstance(xs, list) and len(xs) > 1 else None,
-    "mode": lambda xs: _mode(xs) if isinstance(xs, list) else None,
+    "product": lambda *xs: (lambda v: math.prod(_num(x) for x in v)
+                            if isinstance(v, list) and v else None)(_listify(xs)),
+    "mean": lambda *xs: (lambda v: sum(_num(x) for x in v) / len(v)
+                         if isinstance(v, list) and v else None)(_listify(xs)),
+    "median": lambda *xs: (lambda v: _median(v)
+                           if isinstance(v, list) and v else None)(_listify(xs)),
+    "stddev": lambda *xs: (lambda v: _stddev(v)
+                           if isinstance(v, list) and len(v) > 1 else None)(_listify(xs)),
+    "mode": lambda *xs: (lambda v: _mode(v)
+                         if isinstance(v, list) else None)(_listify(xs)),
     "all": lambda xs: _all_bool(xs, True) if isinstance(xs, list) else None,
     "any": lambda xs: _all_bool(xs, False) if isinstance(xs, list) else None,
     # -- numeric functions (NumericBuiltinFunctions) ------------------------
@@ -565,11 +568,9 @@ def _substring(s, start, length):
     if not isinstance(s, str):
         return None
     start = int(start)
-    if start == 0:
-        return None  # FEEL positions are 1-based
+    if start == 0 or (start < 0 and -start > len(s)):
+        return None  # FEEL positions are 1-based; out of range → null
     i = start - 1 if start > 0 else len(s) + start
-    if i < 0:
-        i = 0
     end = len(s) if length is None else i + int(length)
     return s[i:end]
 
@@ -599,8 +600,9 @@ def _regex(apply, pattern, flags=""):
 
 
 def _feel_replacement(repl: str) -> str:
-    """XPath replacement syntax ($1 groups) → Python (\\1)."""
-    return re.sub(r"\$(\d)", r"\\\1", repl)
+    """XPath replacement syntax ($N groups) → Python \\g<N> (the \\N form
+    would read $0 as an octal NUL escape and mangle multi-digit groups)."""
+    return re.sub(r"\$(\d+)", r"\\g<\1>", repl)
 
 
 def _string_join(xs, delim, prefix, suffix):
@@ -613,6 +615,14 @@ def _string_join(xs, delim, prefix, suffix):
     if prefix is not None or suffix is not None:
         return (prefix or "") + joined + (suffix or "")
     return joined
+
+
+def _listify(xs: tuple):
+    """camunda-feel aggregate builtins accept both a single list and
+    varargs (mean([1,2,3]) == mean(1,2,3)), like min/max here."""
+    if len(xs) == 1 and isinstance(xs[0], list):
+        return xs[0]
+    return list(xs)
 
 
 def _distinct(xs: list) -> list:

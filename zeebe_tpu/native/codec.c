@@ -1233,6 +1233,168 @@ static PyObject *codec_commit_overlay(PyObject *self, PyObject *args)
     Py_RETURN_NONE;
 }
 
+/* iterate_snapshot(sorted_keys, data, prefix, sorted_writes, writes,
+ *                  deleted, reads_cache):
+ * Transaction.iterate's merge, natively — one pass building the ordered
+ * committed-union-overlay snapshot list for a prefix range. Committed
+ * values go through the same defensive-copy-and-cache discipline as
+ * Transaction._committed_read (dict/list values are shallow-copied once
+ * per transaction via reads_cache); overlay values are returned verbatim
+ * with deleted-sentinel entries dropped. Both inputs are sorted, so the
+ * output merges in order with no final sort. */
+static PyObject *codec_iterate_snapshot(PyObject *self, PyObject *args)
+{
+    PyObject *sorted_keys, *data, *prefix, *sorted_writes, *writes, *deleted,
+        *reads;
+    if (!PyArg_ParseTuple(args, "OOOOOOO", &sorted_keys, &data, &prefix,
+                          &sorted_writes, &writes, &deleted, &reads))
+        return NULL;
+    if (!PyList_CheckExact(sorted_keys) || !PyDict_CheckExact(data)
+        || !PyBytes_CheckExact(prefix) || !PyList_CheckExact(sorted_writes)
+        || !PyDict_CheckExact(writes) || !PyDict_CheckExact(reads)) {
+        PyErr_SetString(PyExc_TypeError,
+                        "iterate_snapshot(list, dict, bytes, list, dict, obj, "
+                        "dict) expected");
+        return NULL;
+    }
+    /* range bounds: [prefix, successor(prefix)) on both sorted lists */
+    Py_ssize_t plen = PyBytes_GET_SIZE(prefix);
+    PyObject *end = NULL; /* NULL = unbounded */
+    {
+        const char *p = PyBytes_AS_STRING(prefix);
+        Py_ssize_t n = plen;
+        while (n > 0 && (unsigned char)p[n - 1] == 0xFF)
+            n--;
+        if (n > 0) {
+            end = PyBytes_FromStringAndSize(p, n);
+            if (!end)
+                return NULL;
+            ((unsigned char *)PyBytes_AS_STRING(end))[n - 1]++;
+        }
+    }
+    Py_ssize_t clo = bisect_left_bytes(sorted_keys, prefix);
+    Py_ssize_t chi = end ? bisect_left_bytes(sorted_keys, end)
+                         : PyList_GET_SIZE(sorted_keys);
+    Py_ssize_t wlo = bisect_left_bytes(sorted_writes, prefix);
+    Py_ssize_t whi = end ? bisect_left_bytes(sorted_writes, end)
+                         : PyList_GET_SIZE(sorted_writes);
+    Py_XDECREF(end);
+    if (clo < 0 || chi < 0 || wlo < 0 || whi < 0)
+        return NULL;
+    PyObject *out = PyList_New(0);
+    if (!out)
+        return NULL;
+    Py_ssize_t ci = clo, wi = wlo;
+    while (ci < chi || wi < whi) {
+        PyObject *key;
+        PyObject *val;
+        int from_overlay;
+        if (wi >= whi) {
+            from_overlay = 0;
+            key = PyList_GET_ITEM(sorted_keys, ci);
+            ci++;
+        } else if (ci >= chi) {
+            from_overlay = 1;
+            key = PyList_GET_ITEM(sorted_writes, wi);
+            wi++;
+        } else {
+            PyObject *ck = PyList_GET_ITEM(sorted_keys, ci);
+            PyObject *wk = PyList_GET_ITEM(sorted_writes, wi);
+            int cmp;
+            if (PyBytes_CheckExact(ck) && PyBytes_CheckExact(wk)) {
+                Py_ssize_t cl = PyBytes_GET_SIZE(ck), wl = PyBytes_GET_SIZE(wk);
+                Py_ssize_t n = cl < wl ? cl : wl;
+                int c = memcmp(PyBytes_AS_STRING(ck), PyBytes_AS_STRING(wk),
+                               (size_t)n);
+                cmp = c != 0 ? c : (cl < wl ? -1 : (cl > wl ? 1 : 0));
+            } else {
+                int lt = PyObject_RichCompareBool(ck, wk, Py_LT);
+                if (lt < 0)
+                    goto fail;
+                cmp = lt ? -1 : 1;
+                if (!lt) {
+                    int eq = PyObject_RichCompareBool(ck, wk, Py_EQ);
+                    if (eq < 0)
+                        goto fail;
+                    if (eq)
+                        cmp = 0;
+                }
+            }
+            if (cmp < 0) {
+                from_overlay = 0;
+                key = ck;
+                ci++;
+            } else if (cmp > 0) {
+                from_overlay = 1;
+                key = wk;
+                wi++;
+            } else {
+                /* overlay supersedes the committed entry */
+                from_overlay = 1;
+                key = wk;
+                ci++;
+                wi++;
+            }
+        }
+        if (from_overlay) {
+            val = PyDict_GetItemWithError(writes, key);
+            if (!val) {
+                if (PyErr_Occurred())
+                    goto fail;
+                continue; /* raced away — cannot happen on these dicts */
+            }
+            if (val == deleted)
+                continue;
+            Py_INCREF(val);
+        } else {
+            /* _committed_read: copy-and-cache containers, scalars verbatim */
+            val = PyDict_GetItemWithError(reads, key);
+            if (!val && PyErr_Occurred())
+                goto fail;
+            if (!val) {
+                val = PyDict_GetItemWithError(data, key);
+                if (!val) {
+                    if (PyErr_Occurred())
+                        goto fail;
+                    continue; /* deleted between index and dict — unreachable */
+                }
+                if (PyDict_CheckExact(val)) {
+                    val = PyDict_Copy(val);
+                    if (!val || PyDict_SetItem(reads, key, val) < 0)
+                        goto fail_val;
+                } else if (PyList_CheckExact(val)) {
+                    val = PyList_GetSlice(val, 0, PyList_GET_SIZE(val));
+                    if (!val || PyDict_SetItem(reads, key, val) < 0)
+                        goto fail_val;
+                } else {
+                    Py_INCREF(val);
+                }
+            } else {
+                Py_INCREF(val);
+            }
+        }
+        {
+            PyObject *pair = PyTuple_Pack(2, key, val);
+            Py_DECREF(val);
+            if (!pair)
+                goto fail;
+            if (PyList_Append(out, pair) < 0) {
+                Py_DECREF(pair);
+                goto fail;
+            }
+            Py_DECREF(pair);
+        }
+        continue;
+    fail_val:
+        Py_XDECREF(val);
+        goto fail;
+    }
+    return out;
+fail:
+    Py_DECREF(out);
+    return NULL;
+}
+
 static PyObject *codec_apply_state_plan(PyObject *self, PyObject *args)
 {
     PyObject *plan, *values, *writes, *sorted_writes, *deleted;
@@ -1380,6 +1542,8 @@ static PyMethodDef codec_methods[] = {
      "scan_batch_headers keeping only entries matching (record_type, value_type, intent)."},
     {"apply_state_plan", codec_apply_state_plan, METH_VARARGS,
      "Apply a compiled burst-template state plan to a transaction overlay."},
+    {"iterate_snapshot", codec_iterate_snapshot, METH_VARARGS,
+     "Transaction.iterate committed-union-overlay merge in one native pass"},
     {"commit_overlay", codec_commit_overlay, METH_VARARGS,
      "Apply a transaction overlay dict to the committed store (dict + sorted keys)."},
     {"set_error_class", codec_set_error_class, METH_O, "Register the exception class raised on malformed input."},

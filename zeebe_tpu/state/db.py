@@ -32,6 +32,7 @@ from zeebe_tpu.native import codec_fn as _codec_fn
 from zeebe_tpu.protocol import msgpack
 
 _commit_overlay = _codec_fn("commit_overlay")
+_iterate_snapshot = _codec_fn("iterate_snapshot")
 
 
 class ZbDbInconsistentError(Exception):
@@ -293,6 +294,13 @@ class Transaction:
         mid-iteration.
         """
         db = self._db
+        if _iterate_snapshot is not None:
+            # one native merge pass (codec.c iterate_snapshot) — identical
+            # semantics to the Python path below, including the defensive
+            # copy-and-cache of committed container values
+            return iter(_iterate_snapshot(
+                db._sorted_keys, db._data, prefix, self._sorted_writes,
+                self._writes, _DELETED, self._reads))
         snapshot: list[tuple[bytes, Any]] = []
         writes = self._writes
         sw = self._sorted_writes

@@ -11,6 +11,27 @@ from __future__ import annotations
 import os
 
 
+def _host_fingerprint() -> str:
+    """Cache entries embed AOT code compiled for the build host's CPU
+    features; loading them on a different machine type is slow (XLA falls
+    back feature by feature) or outright unsafe (SIGILL). Partition the
+    cache per host so a reused home directory never serves foreign code."""
+    import hashlib
+    import platform
+
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    raw = f"{platform.machine()}|{feats}"
+    return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+
 def enable_persistent_cache() -> None:
     try:
         import jax
@@ -18,6 +39,7 @@ def enable_persistent_cache() -> None:
         cache_dir = os.environ.get(
             "JAX_COMPILATION_CACHE_DIR",
             os.path.expanduser("~/.cache/zeebe_tpu_xla"))
+        cache_dir = os.path.join(cache_dir, _host_fingerprint())
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
