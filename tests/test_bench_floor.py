@@ -38,6 +38,7 @@ import bench  # noqa: E402
 FLOORS = {
     "one_task": 30_000.0,
     "exclusive_chain": 80_000.0,
+    "mixed_8": 18_000.0,
 }
 RUNS = 3
 
@@ -106,4 +107,43 @@ class TestBenchFloor:
         assert rate >= floor, (
             f"exclusive_chain e2e regressed: {rate:,.0f} transitions/s < "
             f"floor {floor:,.0f} (best of {RUNS})."
+        )
+
+
+def _mixed_burst() -> float:
+    """mixed_8 burst (the workload VERDICT r3 item 3 gates at >= 50k/s in the
+    full bench; the floor here is set far below to absorb CI machine
+    variance while still catching order-of-magnitude regressions)."""
+    import tempfile
+
+    names = ("mx_one", "mx_excl", "mx_fj", "mx_chain2", "mx_chain3",
+             "mx_chain4", "mx_route", "mx_par3")
+    with tempfile.TemporaryDirectory() as tmpdir:
+        part = bench.E2EPartition(tmpdir)
+        part.deploy(bench.mixed_definitions())
+        for m in names:
+            part.inject_creations(m, 8, {"x": 5})
+        part.pump()
+        part.complete_in_type_waves(part.pending_job_keys(1))
+        best = 0.0
+        for _ in range(RUNS):
+            start_position = part.stream.last_position
+            t0 = time.perf_counter()
+            for m in names:
+                part.inject_creations(m, 40, {"x": 5})
+            part.pump()
+            part.complete_in_type_waves(part.pending_job_keys(start_position))
+            elapsed = time.perf_counter() - t0
+            best = max(best, part.count_transitions(start_position) / elapsed)
+        part.journal.close()
+        return best
+
+
+class TestMixedFloor:
+    def test_mixed_8_floor(self):
+        rate = _mixed_burst()
+        floor = FLOORS["mixed_8"]
+        assert rate >= floor, (
+            f"mixed_8 e2e regressed: {rate:,.0f} transitions/s < floor "
+            f"{floor:,.0f} (best of {RUNS})."
         )

@@ -315,3 +315,15 @@ class TestContextBuiltins:
         assert ev("product(2, 3)") == 6
         assert ev("median(3, 1, 2)") == 2
         assert ev("mode(6, 6, 1)") == [6]
+
+    def test_aggregates_null_members_are_null(self):
+        assert ev("mean(x)", x=None) is None
+        assert ev('mean(["a"])') is None
+        assert ev("product([1, null])") is None
+        assert ev("sum(1, 2, 3)") == 6
+        assert ev("sum([1, null])") is None
+
+    def test_replace_overlong_group_reference(self):
+        # XPath: the longest digit prefix not exceeding the group count
+        assert ev('replace("ab", "(a)(b)", "$12")') == "a2"
+        assert ev('replace("ab", "(a)", "$12")') == "a2b"

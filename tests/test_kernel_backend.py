@@ -1780,3 +1780,73 @@ class TestBigIntParity:
                 h.create_instance("bigvar", {"x": x}, request_id=910 + i)
 
         assert_equivalent(scenario)
+
+
+class TestInclusiveGatewayOnDevice:
+    """Inclusive gateways (fork-only, like the reference) lower to
+    K_INCLUSIVE: every true-condition flow is taken on device, the default
+    only when none hold, no-match raises the same CONDITION_ERROR."""
+
+    def _proc(self, pid="kincl", with_default=True):
+        b = (
+            Bpmn.create_executable_process(pid)
+            .start_event("s")
+            .inclusive_gateway("split")
+            .sequence_flow_id("fa")
+            .condition_expression("x > 10")
+            .service_task("a", job_type="ia")
+            .end_event("ea")
+            .move_to_element("split")
+            .sequence_flow_id("fb")
+            .condition_expression("y > 10")
+            .service_task("b", job_type="ib")
+            .end_event("eb")
+            .move_to_element("split")
+        )
+        if with_default:
+            b = b.default_flow().service_task("d", job_type="id").end_event("ed")
+        else:
+            b = (b.sequence_flow_id("fc").condition_expression("z > 10")
+                 .service_task("c", job_type="ic").end_event("ec"))
+        return b.done()
+
+    def test_inclusive_fork_parity(self):
+        def scenario(h):
+            h.deploy(self._proc())
+            h.create_instance("kincl", {"x": 20, "y": 20}, request_id=1)  # both
+            h.create_instance("kincl", {"x": 20, "y": 1}, request_id=2)   # a
+            h.create_instance("kincl", {"x": 1, "y": 20}, request_id=3)   # b
+            h.create_instance("kincl", {"x": 1, "y": 1}, request_id=4)    # default
+            for jt in ("ia", "ib", "id"):
+                drive_jobs(h, jt)
+
+        assert_equivalent(scenario)
+
+    def test_inclusive_no_match_incident_parity(self):
+        def scenario(h):
+            h.deploy(self._proc("kincl_nm", with_default=False))
+            h.create_instance("kincl_nm", {"x": 1, "y": 1, "z": 1}, request_id=5)
+            h.create_instance("kincl_nm", {"x": 99, "y": 1, "z": 99}, request_id=6)
+            for jt in ("ia", "ic"):
+                drive_jobs(h, jt)
+
+        assert_equivalent(scenario)
+
+    def test_inclusive_actually_on_device(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(self._proc("kincl_dev"))
+            h.create_instance("kincl_dev", {"x": 20, "y": 20})
+            assert h.kernel_backend.commands_processed >= 1
+            with h.db.transaction():
+                meta = h.engine.state.processes.get_latest_by_id("kincl_dev")
+            info = h.kernel_backend.registry.lookup(meta["processDefinitionKey"], None)
+            from zeebe_tpu.ops.tables import K_INCLUSIVE
+
+            tables = h.kernel_backend.registry.tables
+            split_idx = info.exe.by_id["split"]
+            assert tables.kernel_op[info.index, split_idx] == K_INCLUSIVE
+            assert drive_jobs(h, "ia") == 1
+            assert drive_jobs(h, "ib") == 1
+        finally:
+            h.close()

@@ -79,8 +79,10 @@ class _Gen:
             return self.subprocess(b, depth)
         if roll < 0.73:
             return self.event_gateway(b, depth)
-        if roll < 0.85:
+        if roll < 0.83:
             return self.exclusive(b, depth)
+        if roll < 0.91:
+            return self.inclusive(b, depth)
         return self.parallel(b, depth)
 
     def event_gateway(self, b, depth: int):
@@ -189,6 +191,24 @@ class _Gen:
             b = self.block(b, depth + 1)
             b = b.connect_to(merge)
         return b.move_to_element(merge)
+
+    def inclusive(self, b, depth: int):
+        """Inclusive fork (fork-only, like the reference): side branches with
+        conditions end in their own end events; the default rides a side
+        branch and the MAIN continuation is a conditional branch — when its
+        condition is false the instance still completes through the sides."""
+        rng = self.rng
+        gw = self.next_id("igw")
+        b = b.inclusive_gateway(gw)
+        for i in range(rng.randint(1, 2)):
+            if i == 0:
+                b = b.default_flow()
+            else:
+                b = b.condition_expression(self.condition())
+            b = self.block(b, depth + 1)
+            b = b.end_event(self.next_id("ie"))
+            b = b.move_to_element(gw)
+        return b.condition_expression(self.condition())
 
     def parallel(self, b, depth: int):
         rng = self.rng

@@ -459,9 +459,9 @@ _BUILTINS: dict[str, Callable[..., Any]] = {
     "lower case": lambda s: s.lower(),
     "string length": lambda s: len(s),
     "count": lambda xs: len(xs),
-    "sum": lambda xs: sum(xs),
-    "min": lambda *xs: min(xs[0] if len(xs) == 1 and isinstance(xs[0], list) else xs),
-    "max": lambda *xs: max(xs[0] if len(xs) == 1 and isinstance(xs[0], list) else xs),
+    "sum": lambda *xs: (lambda v: sum(v) if v else None)(_nums_or_none(_listify(xs))),
+    "min": lambda *xs: min(_listify(xs)),
+    "max": lambda *xs: max(_listify(xs)),
     "floor": lambda v: math.floor(_num(v)),
     "ceiling": lambda v: math.ceil(_num(v)),
     "abs": lambda v: abs(v) if isinstance(v, (Duration, YearMonthDuration)) else abs(_num(v)),
@@ -494,7 +494,7 @@ _BUILTINS: dict[str, Callable[..., Any]] = {
     else (s if isinstance(s, str) and m == "" else
           ("" if isinstance(s, str) else None)),
     "replace": lambda s, pattern, repl, flags="": _regex(
-        lambda rx: rx.sub(_feel_replacement(repl), s), pattern, flags
+        lambda rx: rx.sub(_feel_replacement(repl, rx.groups), s), pattern, flags
     ) if isinstance(s, str) else None,
     "split": lambda s, delim: _regex(lambda rx: rx.split(s), delim)
     if isinstance(s, str) else None,
@@ -527,16 +527,16 @@ _BUILTINS: dict[str, Callable[..., Any]] = {
     "partition": lambda xs, size: (
         [xs[i: i + int(size)] for i in range(0, len(xs), int(size))]
         if isinstance(xs, list) and int(size) > 0 else None),
-    "product": lambda *xs: (lambda v: math.prod(_num(x) for x in v)
-                            if isinstance(v, list) and v else None)(_listify(xs)),
-    "mean": lambda *xs: (lambda v: sum(_num(x) for x in v) / len(v)
-                         if isinstance(v, list) and v else None)(_listify(xs)),
-    "median": lambda *xs: (lambda v: _median(v)
-                           if isinstance(v, list) and v else None)(_listify(xs)),
-    "stddev": lambda *xs: (lambda v: _stddev(v)
-                           if isinstance(v, list) and len(v) > 1 else None)(_listify(xs)),
-    "mode": lambda *xs: (lambda v: _mode(v)
-                         if isinstance(v, list) else None)(_listify(xs)),
+    "product": lambda *xs: (lambda v: math.prod(v) if v else None)(
+        _nums_or_none(_listify(xs))),
+    "mean": lambda *xs: (lambda v: sum(v) / len(v) if v else None)(
+        _nums_or_none(_listify(xs))),
+    "median": lambda *xs: (lambda v: _median(v) if v else None)(
+        _nums_or_none(_listify(xs))),
+    "stddev": lambda *xs: (lambda v: _stddev(v) if v and len(v) > 1 else None)(
+        _nums_or_none(_listify(xs))),
+    "mode": lambda *xs: (lambda v: _mode(v) if v is not None else None)(
+        _nums_or_none(_listify(xs))),
     "all": lambda xs: _all_bool(xs, True) if isinstance(xs, list) else None,
     "any": lambda xs: _all_bool(xs, False) if isinstance(xs, list) else None,
     # -- numeric functions (NumericBuiltinFunctions) ------------------------
@@ -599,10 +599,20 @@ def _regex(apply, pattern, flags=""):
         return None
 
 
-def _feel_replacement(repl: str) -> str:
-    """XPath replacement syntax ($N groups) → Python \\g<N> (the \\N form
-    would read $0 as an octal NUL escape and mangle multi-digit groups)."""
-    return re.sub(r"\$(\d+)", r"\\g<\1>", repl)
+def _feel_replacement(repl: str, ngroups: int) -> str:
+    """XPath replacement syntax → Python: $N takes the LONGEST digit prefix
+    not exceeding the pattern's group count (so "$12" with one group is
+    group 1 followed by a literal '2'); $0 is the whole match. A reference
+    no prefix satisfies replaces with nothing, leaving trailing digits."""
+    def sub(m):
+        digits = m.group(1)
+        for k in range(len(digits), 0, -1):
+            n = int(digits[:k])
+            if n <= ngroups:
+                return f"\\g<{n}>{digits[k:]}"
+        return digits[1:]  # $9 with fewer groups: drop the unresolvable digit
+
+    return re.sub(r"\$(\d+)", sub, repl)
 
 
 def _string_join(xs, delim, prefix, suffix):
@@ -623,6 +633,18 @@ def _listify(xs: tuple):
     if len(xs) == 1 and isinstance(xs[0], list):
         return xs[0]
     return list(xs)
+
+
+def _nums_or_none(v) -> list | None:
+    """All-numbers view of a list, or None — numeric aggregates return null
+    (not an evaluation error) when any member is null/non-numeric, like
+    camunda-feel."""
+    if not isinstance(v, list):
+        return None
+    for x in v:
+        if isinstance(x, bool) or not isinstance(x, (int, float)):
+            return None
+    return v
 
 
 def _distinct(xs: list) -> list:

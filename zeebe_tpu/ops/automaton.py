@@ -50,6 +50,7 @@ from zeebe_tpu.ops.tables import (
     K_EXCLUSIVE,
     K_FORK,
     K_HOST,
+    K_INCLUSIVE,
     K_JOIN,
     K_MI,
     K_NONE,
@@ -433,7 +434,8 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     slot_idx = jnp.arange(FO)[None, :]
 
     is_excl = op == K_EXCLUSIVE
-    need_eval = (is_excl & pass_attempt)[:, None] & (conds >= 0)
+    is_incl = op == K_INCLUSIVE
+    need_eval = ((is_excl | is_incl) & pass_attempt)[:, None] & (conds >= 0)
     if config.has_conditions:
         # scalar-predicated skip: in steps where no executing token sits on a
         # conditional gateway (most steps of job-completion cascades), the
@@ -457,16 +459,22 @@ def step(tables: DeviceTables, state: dict, auto_jobs: bool = True, emit_events:
     any_true = jnp.any(cond_true, axis=1)
     default = tables.default_slot[def_of_tok, jnp.maximum(elem, 0)]
     excl_choice = jnp.where(any_true, first_true, default)  # -1 if no default
-    excl_no_match = is_excl & pass_attempt & ~any_true & (default < 0)
+    excl_no_match = (is_excl | is_incl) & pass_attempt & ~any_true & (default < 0)
 
     # no-match raises an incident: the token stalls instead of completing
     full_pass = pass_attempt & ~excl_no_match
     completing = full_pass | waiting_done | scope_resume  # completes & moves
 
+    # inclusive fork: EVERY true-condition flow; the default only when none
+    # hold (reference: InclusiveGatewayProcessor.findSequenceFlowsToTake)
+    incl_take = cond_true | (
+        (slot_idx == default[:, None]) & ~any_true[:, None]
+        & (default >= 0)[:, None]
+    )
     take_mask = jnp.where(
         is_excl[:, None],
         (slot_idx == excl_choice[:, None]) & (excl_choice >= 0)[:, None],
-        slot_idx < out_count[:, None],
+        jnp.where(is_incl[:, None], incl_take, slot_idx < out_count[:, None]),
     )
     take_mask = take_mask & completing[:, None] & (targets >= 0)
 

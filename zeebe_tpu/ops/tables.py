@@ -339,6 +339,8 @@ K_HOST = 9  # host escape: parks forever; the sequential engine owns the element
 #            (script/io-mapping tasks, unresolvable call activities, …)
 K_MI = 10  # multi-instance body: parks like a scope, spawns mi_left children
 #           at its inner row (scope_start); sequential bodies respawn on drain
+K_INCLUSIVE = 11  # inclusive gateway (fork-only, like the reference): takes
+#                  EVERY true-condition flow; default only when none hold
 
 # task types a synthetic device MI body may wrap (the inner instance is a
 # job-worker task; MI on containers stays host-side)
@@ -362,6 +364,7 @@ _KERNEL_OP = {
     BpmnElementType.BUSINESS_RULE_TASK: K_TASK,
     BpmnElementType.USER_TASK: K_TASK,
     BpmnElementType.EXCLUSIVE_GATEWAY: K_EXCLUSIVE,
+    BpmnElementType.INCLUSIVE_GATEWAY: K_INCLUSIVE,
     BpmnElementType.PARALLEL_GATEWAY: K_FORK,  # switched to K_JOIN if in_count > 1
 }
 
@@ -461,8 +464,11 @@ def _live_token_width(exe: ExecutableProcess) -> int | None:
     splits: list[ExecutableElement] = []
     for el in exe.elements:
         targets_of[el.idx] = [exe.flows[f].target_idx for f in el.outgoing]
-        if (el.element_type == BpmnElementType.PARALLEL_GATEWAY
+        if (el.element_type in (BpmnElementType.PARALLEL_GATEWAY,
+                                BpmnElementType.INCLUSIVE_GATEWAY)
                 and len(el.outgoing) > 1):
+            # an inclusive fork may take every branch — bound like a
+            # parallel split
             splits.append(el)
     if splits:
         for el in exe.elements:
@@ -657,21 +663,22 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                 if el.element_type == BpmnElementType.PARALLEL_GATEWAY and el.incoming_count > 1:
                     op = K_JOIN
                 if (
-                    op == K_EXCLUSIVE
+                    op in (K_EXCLUSIVE, K_INCLUSIVE)
                     and len(el.outgoing) == 1
                     and el.default_flow_idx < 0
                     and all(exe.flows[f].condition is None for f in el.outgoing)
                 ):
                     # a single unconditional outgoing flow routes like a
                     # pass-through (the engine's generic completion path takes
-                    # it; K_EXCLUSIVE with no true condition and no default
-                    # would stall instead)
+                    # it; a conditional gateway with no true condition and no
+                    # default would stall instead)
                     op = K_PASS
                 for slot_i, fidx in enumerate(el.outgoing):
                     flow = exe.flows[fidx]
                     if fidx == el.default_flow_idx:
                         default_slot[d, el.idx] = slot_i
-                    elif flow.condition is not None and op == K_EXCLUSIVE:
+                    elif flow.condition is not None and op in (K_EXCLUSIVE,
+                                                               K_INCLUSIVE):
                         prog = compile_condition(flow.condition.ast, slots, interner)
                         out_cond[d, el.idx, slot_i] = len(cond_programs)
                         cond_programs.append(prog)
