@@ -460,8 +460,8 @@ _BUILTINS: dict[str, Callable[..., Any]] = {
     "string length": lambda s: len(s),
     "count": lambda xs: len(xs),
     "sum": lambda *xs: (lambda v: sum(v) if v else None)(_nums_or_none(_listify(xs))),
-    "min": lambda *xs: min(_listify(xs)),
-    "max": lambda *xs: max(_listify(xs)),
+    "min": lambda *xs: _minmax(min, _listify(xs)),
+    "max": lambda *xs: _minmax(max, _listify(xs)),
     "floor": lambda v: math.floor(_num(v)),
     "ceiling": lambda v: math.ceil(_num(v)),
     "abs": lambda v: abs(v) if isinstance(v, (Duration, YearMonthDuration)) else abs(_num(v)),
@@ -633,6 +633,17 @@ def _listify(xs: tuple):
     if len(xs) == 1 and isinstance(xs[0], list):
         return xs[0]
     return list(xs)
+
+
+def _minmax(fn, v):
+    """min/max return null on empty lists and incomparable/null members,
+    like camunda-feel (instead of an evaluation incident)."""
+    if not v:
+        return None
+    try:
+        return fn(v)
+    except TypeError:
+        return None
 
 
 def _nums_or_none(v) -> list | None:
