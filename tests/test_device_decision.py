@@ -206,3 +206,23 @@ class TestReviewRegressions:
         dt = compile_decision_table(dec)
         got = batch_evaluate(dt, [{"membership": "silver"}])
         assert got == [0.1]  # float64 exactly, no f32 drift
+
+    def test_aggregation_contract_declines(self):
+        # aggregation outside COLLECT, multi-output aggregation, and cells
+        # the batch lexer cannot parse (host-supported '?') all decline
+        for bad in (
+            COLLECT_DMN.replace('hitPolicy="COLLECT" aggregation="SUM"',
+                                'hitPolicy="FIRST" aggregation="SUM"'),
+            COLLECT_DMN.replace('<output id="o1" name="fee"/>',
+                                '<output id="o1" name="fee"/>'
+                                '<output id="o2" name="x"/>'
+                                ).replace("<outputEntry><text>10</text></outputEntry>",
+                                          "<outputEntry><text>10</text></outputEntry>"
+                                          "<outputEntry><text>1</text></outputEntry>"
+                                ).replace("<outputEntry><text>5</text></outputEntry>",
+                                          "<outputEntry><text>5</text></outputEntry>"
+                                          "<outputEntry><text>2</text></outputEntry>"),
+            COLLECT_DMN.replace("<text>-</text>", "<text>? * 2 &gt; 1</text>"),
+        ):
+            with pytest.raises(NotDeviceCompilable):
+                compile_decision_table(_table(bad, "fees"))

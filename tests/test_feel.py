@@ -327,3 +327,42 @@ class TestContextBuiltins:
         # XPath: the longest digit prefix not exceeding the group count
         assert ev('replace("ab", "(a)(b)", "$12")') == "a2"
         assert ev('replace("ab", "(a)", "$12")') == "a2b"
+
+
+class TestForQuantFilter:
+    """Core FEEL constructs: filters, for..return, some/every..satisfies
+    (reference: the camunda-feel engine's FEEL 1.2 surface)."""
+
+    @pytest.mark.parametrize(
+        "src,ctx,expected",
+        [
+            ("xs[item > 2]", {"xs": [1, 2, 3, 4]}, [3, 4]),
+            ("xs[item > 9]", {"xs": [1, 2]}, []),
+            ("people[age > 30]", {"people": [{"age": 25}, {"age": 40}]},
+             [{"age": 40}]),
+            ("people[age > 30][1].age",
+             {"people": [{"age": 25}, {"age": 40}]}, 40),
+            ("5[1]", {}, 5),  # singleton semantics
+            ("5[2]", {}, None),
+            ("for x in xs return x * 2", {"xs": [1, 2, 3]}, [2, 4, 6]),
+            ("for x in 1..4 return x", {}, [1, 2, 3, 4]),
+            ("for x in 3..1 return x", {}, [3, 2, 1]),
+            ("for x in [1,2], y in [10,20] return x + y", {},
+             [11, 21, 12, 22]),
+            ("for x in xs return x + count(partial)", {"xs": [1, 2, 3]},
+             [1, 3, 5]),
+            ("some x in xs satisfies x > 3", {"xs": [1, 2, 3, 4]}, True),
+            ("some x in xs satisfies x > 9", {"xs": [1, 2]}, False),
+            ("every x in xs satisfies x > 0", {"xs": [1, 2]}, True),
+            ("every x in xs satisfies x > 1", {"xs": [1, 2]}, False),
+            ("some x in [1, null] satisfies x > 5", {}, None),
+            ("every x in [] satisfies x > 5", {}, True),
+            ("some x in [] satisfies x > 5", {}, False),
+            ("some x in [1,2], y in [3,4] satisfies x + y > 5", {}, True),
+            ("xs[1]", {"xs": [9, 8]}, 9),  # numeric selector stays an index
+            ("xs[-1]", {"xs": [9, 8]}, 8),
+            ("xs[i]", {"xs": [9, 8], "i": 2}, 8),
+        ],
+    )
+    def test_construct(self, src, ctx, expected):
+        assert ev(src, **ctx) == expected

@@ -12,6 +12,9 @@ workloads use (S-FEEL + common extensions):
 - boolean ``and`` / ``or`` / ``not(x)``, parentheses
 - ``if <c> then <a> else <b>``
 - ``x in [a..b]`` ranges and ``in`` list membership
+- list filters ``xs[item > 2]`` (context entries in scope for contexts),
+  1-based indexing with singleton semantics, ``for x in xs return …`` with
+  ``partial``, and ``some/every x in xs satisfies …`` with ternary logic
 - the camunda-feel builtin library surface: string/list/numeric/context/
   temporal functions (substring, replace/matches/split over XPath-flag
   regexes, sort, flatten, partition, round half up/down, decimal,
@@ -112,6 +115,24 @@ class Range:
 class In:
     needle: Any
     haystack: Any
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class For:
+    """``for x in xs[, y in ys…] return expr`` — cartesian iteration with
+    ``partial`` bound to the results so far (camunda-feel extension)."""
+
+    iterators: tuple  # of (name, source_expr, hi_expr | None) — hi = range
+    body: Any
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class Quant:
+    """``some|every x in xs[, …] satisfies cond`` with ternary logic."""
+
+    kind: str  # "some" | "every"
+    iterators: tuple
+    cond: Any
 
 
 class FeelError(Exception):
@@ -267,7 +288,35 @@ class _Parser:
             self.expect("else")
             orelse = self.expr()
             return If(cond, then, orelse)
+        if self.at("for"):
+            self.next()
+            iterators = self.iterators("return")
+            return For(iterators, self.expr())
+        if self.at("some") or self.at("every"):
+            kind = self.next()[1]
+            iterators = self.iterators("satisfies")
+            return Quant(kind, iterators, self.expr())
         return self.or_expr()
+
+    def iterators(self, terminator: str) -> tuple:
+        """``x in <src>[..hi][, y in …] <terminator>`` iterator clauses."""
+        out = []
+        while True:
+            kind, name = self.next()
+            if kind != "name":
+                raise FeelParseError(f"expected iterator name in {self.src!r}")
+            self.expect("in")
+            src = self.add_expr()
+            hi = None
+            if self.at(".."):
+                self.next()
+                hi = self.add_expr()
+            out.append((name, src, hi))
+            if self.at(","):
+                self.next()
+                continue
+            self.expect(terminator)
+            return tuple(out)
 
     def or_expr(self) -> Any:
         node = self.and_expr()
@@ -850,6 +899,125 @@ class Evaluator:
                 return None  # FEEL: missing variable evaluates to null
         return value
 
+    def _index_or_filter(self, node: Bin) -> Any:
+        """``a[e]``: a number selects (1-based, negative from the end, with
+        FEEL's singleton semantics on non-lists); anything else filters with
+        ``item`` — and, for context elements, their entries — in scope."""
+        left = self.eval(node.left)
+        try:
+            sel = self.eval(node.right)
+        except FeelEvalError:
+            sel = None  # e.g. `item` arithmetic unbound here → filter below
+        if isinstance(sel, (int, float)) and not isinstance(sel, bool):
+            items = left if isinstance(left, list) else (
+                [] if left is None else [left])
+            i = int(sel)
+            if 1 <= i <= len(items):
+                return items[i - 1]
+            if -len(items) <= i <= -1:
+                return items[i]
+            return None
+        src = left if isinstance(left, list) else ([] if left is None else [left])
+        out = []
+        # ONE scope dict reused across elements (a per-element full-context
+        # merge would be O(n·|ctx|)); dict elements still merge — their
+        # entries enter the scope and must not leak between elements
+        scope = dict(self.ctx)
+        ev = Evaluator(scope, self.clock_millis)
+        for el in src:
+            if isinstance(el, dict):
+                ev.ctx = {**self.ctx, **el, "item": el}
+            else:
+                ev.ctx = scope
+                scope["item"] = el
+            try:
+                keep = ev.eval(node.right)
+            except FeelEvalError:
+                keep = None
+            if keep is True:
+                out.append(el)
+        return out
+
+    @staticmethod
+    def _iter_bound(ev: "Evaluator", iterator) -> list:
+        """An iterator clause's values, evaluated under ``ev``'s scope (which
+        carries the bindings of the clauses to its left:
+        ``for x in xs, y in x.ys …``)."""
+        _name, src, hi = iterator
+        if hi is not None:
+            lo_v = ev.eval(src)
+            hi_v = ev.eval(hi)
+            if not all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                       for v in (lo_v, hi_v)):
+                return []
+            lo_i, hi_i = int(lo_v), int(hi_v)
+            step = 1 if hi_i >= lo_i else -1
+            return list(range(lo_i, hi_i + step, step))
+        v = ev.eval(src)
+        if isinstance(v, list):
+            return v
+        return [] if v is None else [v]
+
+    def _eval_For(self, node: For) -> list:
+        results: list = []
+        # one shared scope, mutated per binding (save/restore is unnecessary:
+        # inner clauses may only shadow ctx names, and the scope dies with
+        # this evaluation). ``partial`` is the LIVE results list — FEEL
+        # evaluation never mutates values in place, so no defensive copies.
+        scope = dict(self.ctx)
+        scope["partial"] = results
+        ev = Evaluator(scope, self.clock_millis)
+
+        def rec(i: int) -> None:
+            if i == len(node.iterators):
+                results.append(ev.eval(node.body))
+                return
+            name = node.iterators[i][0]
+            for v in self._iter_bound(ev, node.iterators[i]):
+                scope[name] = v
+                rec(i + 1)
+
+        rec(0)
+        return results
+
+    def _eval_Quant(self, node: Quant) -> Any:
+        """some/every with ternary logic: an undecided quantifier poisoned by
+        a non-boolean condition result is null, like all()/any()."""
+        saw_null = False
+        decided = None
+        scope = dict(self.ctx)
+        ev = Evaluator(scope, self.clock_millis)
+
+        def rec(i: int) -> bool:
+            nonlocal saw_null, decided
+            if i == len(node.iterators):
+                try:
+                    r = ev.eval(node.cond)
+                except FeelEvalError:
+                    r = None
+                if not isinstance(r, bool):
+                    saw_null = True
+                elif node.kind == "some" and r:
+                    decided = True
+                    return True
+                elif node.kind == "every" and not r:
+                    decided = False
+                    return True
+                return False
+            name = node.iterators[i][0]
+            for v in self._iter_bound(ev, node.iterators[i]):
+                scope[name] = v
+                if rec(i + 1):
+                    return True
+            return False
+
+        rec(0)
+        if decided is not None:
+            return decided
+        if saw_null:
+            return None
+        return node.kind == "every"
+
     def _eval_Unary(self, node: Unary) -> Any:
         v = self.eval(node.operand)
         if isinstance(v, (Duration, YearMonthDuration)):
@@ -874,6 +1042,8 @@ class Evaluator:
             if right is True:
                 return True
             return False if (left is False and right is False) else None
+        if op == "index":
+            return self._index_or_filter(node)
         left = self.eval(node.left)
         right = self.eval(node.right)
         if op == "access":
@@ -881,16 +1051,6 @@ class Evaluator:
                 return left.get(right)
             if _temporal.is_temporal(left):
                 return _temporal.temporal_property(left, right)
-            return None
-        if op == "index":
-            if isinstance(left, list):
-                i = int(_num(right))
-                # FEEL is 1-based; negative indexes count from the end
-                if 1 <= i <= len(left):
-                    return left[i - 1]
-                if -len(left) <= i <= -1:
-                    return left[i]
-                return None
             return None
         if op == "=":
             return left == right

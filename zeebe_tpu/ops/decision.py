@@ -153,8 +153,16 @@ def compile_decision_table(decision, max_atoms: int = 4) -> DeviceDecisionTable:
     agg = (decision.aggregation or "").upper()
     if hit not in ("UNIQUE", "FIRST", "ANY", "RULE ORDER", "COLLECT"):
         raise NotDeviceCompilable(f"hit policy {hit}")
+    if agg and hit != "COLLECT":
+        # the host applies aggregation only under COLLECT; compiling it here
+        # would aggregate where the host selects
+        raise NotDeviceCompilable(f"aggregation {agg} under {hit}")
     if agg and agg not in ("SUM", "MIN", "MAX", "COUNT"):
         raise NotDeviceCompilable(f"aggregation {agg}")
+    if agg and len(decision.outputs) > 1:
+        # the host raises a DmnEvalError for aggregated multi-output tables
+        # (a modeling error must surface, not a partial aggregate)
+        raise NotDeviceCompilable("aggregation over multiple outputs")
 
     input_names: list[str] = []
     for inp in inputs:
@@ -163,13 +171,21 @@ def compile_decision_table(decision, max_atoms: int = 4) -> DeviceDecisionTable:
             raise NotDeviceCompilable(f"input expression {src!r}")
         input_names.append(src)
 
-    # pre-pass: every string literal across all cells, interned sorted
+    # pre-pass: every string literal across all cells, interned sorted.
+    # ANY parse failure (cells the host supports but this lexer cannot, e.g.
+    # '?'-expressions) must surface as NotDeviceCompilable — the documented
+    # keep-the-host-path contract
+    from zeebe_tpu.feel.feel import FeelError
+
     strings: set[str] = set()
     parsed_cells: list[list[list]] = []  # [rule][input] -> list of atom specs
     for rule in rules:
         row: list[list] = []
         for text in rule.input_entries:
-            row.append(_parse_cell_atoms(text, strings, _split_top_level))
+            try:
+                row.append(_parse_cell_atoms(text, strings, _split_top_level))
+            except FeelError as exc:
+                raise NotDeviceCompilable(f"cell {text!r}: {exc}") from exc
         parsed_cells.append(row)
     str_ids = {s: i for i, s in enumerate(sorted(strings))}
 
@@ -223,7 +239,9 @@ def compile_decision_table(decision, max_atoms: int = 4) -> DeviceDecisionTable:
         for r, rule in enumerate(rules):
             try:
                 v = _literal_of(parse_feel(rule.output_entries[0]))
-            except Exception as exc:  # noqa: BLE001
+            except NotDeviceCompilable:
+                raise
+            except Exception as exc:  # noqa: BLE001 — parse errors included
                 raise NotDeviceCompilable(f"aggregated output: {exc}") from exc
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 raise NotDeviceCompilable("non-numeric aggregated output")
