@@ -366,3 +366,19 @@ class TestForQuantFilter:
     )
     def test_construct(self, src, ctx, expected):
         assert ev(src, **ctx) == expected
+
+    def test_partial_snapshots_not_aliased(self):
+        import json
+
+        r = ev("for x in [1, 2] return partial")
+        assert r == [[], [[]]]
+        json.dumps(r)  # no circular reference
+
+    def test_non_integer_index_is_null(self):
+        assert ev("xs[1.9]", xs=[10, 20, 30]) is None
+
+    def test_bare_field_filter(self):
+        # a bare-variable selector is a FIELD filter for context elements
+        assert ev("people[active]",
+                  people=[{"active": True, "n": 1},
+                          {"active": False, "n": 2}]) == [{"active": True, "n": 1}]

@@ -82,6 +82,14 @@ class TestRollingUpgrade:
             for job_type in ("up_io2",):
                 for job in h.activate_jobs(job_type, max_jobs=50):
                     h.complete_job(job["key"], {})
+            # respawning types (sequential MI): drain until silent
+            for job_type in expected.get("drain_loop_types", ()):
+                for _ in range(20):
+                    jobs = h.activate_jobs(job_type, max_jobs=50)
+                    if not jobs:
+                        break
+                    for job in jobs:
+                        h.complete_job(job["key"], {})
             msg = expected["message"]
             h.publish_message(msg["name"], msg["correlation_key"],
                               variables={"resumed": 1})

@@ -76,7 +76,52 @@ def _models():
         .condition_expression("x > 100")
         .end_event("e").done()
     )
-    return [one_task, timer_wait, msg_wait, sub_bnd, io_chain, nomatch]
+    # round-4 kernel shapes: parked multi-instance bodies (parallel and
+    # sequential), an inlined call-activity frame, and an inclusive fork —
+    # future rounds must reconstruct these exact state shapes
+    mi_par = (
+        Bpmn.create_executable_process("mi_par")
+        .start_event("s")
+        .service_task("work", job_type="up_mi")
+        .multi_instance(input_collection="= items", input_element="item")
+        .end_event("e").done()
+    )
+    mi_seq = (
+        Bpmn.create_executable_process("mi_seq")
+        .start_event("s")
+        .service_task("work", job_type="up_mi_seq")
+        .multi_instance(input_collection="= items", input_element="item",
+                        sequential=True)
+        .end_event("e").done()
+    )
+    call_child = (
+        Bpmn.create_executable_process("up_child_proc")
+        .start_event("cs")
+        .service_task("cw", job_type="up_child")
+        .end_event("ce").done()
+    )
+    caller = (
+        Bpmn.create_executable_process("up_caller")
+        .start_event("s")
+        .call_activity("call", process_id="up_child_proc")
+        .end_event("e").done()
+    )
+    incl = (
+        Bpmn.create_executable_process("up_incl")
+        .start_event("s")
+        .inclusive_gateway("gw")
+        .condition_expression("a > 0")
+        .service_task("ta", job_type="up_inc")
+        .end_event("ea")
+        .move_to_element("gw")
+        .condition_expression("b > 0")
+        .service_task("tb", job_type="up_inc")
+        .end_event("eb")
+        .move_to_element("gw").default_flow().end_event("ed")
+        .done()
+    )
+    return [one_task, timer_wait, msg_wait, sub_bnd, io_chain, nomatch,
+            mi_par, mi_seq, call_child, caller, incl]
 
 
 def run_scenario(h) -> dict:
@@ -95,13 +140,22 @@ def run_scenario(h) -> dict:
     running[h.create_instance("msg_wait", variables={"key": "k-up"})] = "msg_wait"
     running[h.create_instance("sub_bnd")] = "sub_bnd"
     running[h.create_instance("io_chain", variables={"base": 9})] = "io_chain"
+    running[h.create_instance("mi_par", variables={"items": [1, 2, 3]})] = "mi_par"
+    running[h.create_instance("mi_seq", variables={"items": ["a", "b"]})] = "mi_seq"
+    running[h.create_instance("up_caller")] = "up_caller"
+    running[h.create_instance("up_incl", variables={"a": 1, "b": 1})] = "up_incl"
     incident_key = h.create_instance("nomatch", variables={"x": 1})
     return {
         "tag_clock_millis": h.clock(),
         "completed_keys": done_keys,
         "running": {str(k): v for k, v in running.items()},
         "incident_instance": incident_key,
-        "pending_jobs": {"up_work": 2, "up_inner": 1, "up_io": 1},
+        "pending_jobs": {"up_work": 2, "up_inner": 1, "up_io": 1,
+                         "up_mi": 3, "up_mi_seq": 1, "up_child": 1,
+                         "up_inc": 2},
+        # job types that respawn after completion (sequential MI): the drive
+        # test keeps completing until the type is silent
+        "drain_loop_types": ["up_mi_seq"],
         "message": {"name": "up_go", "correlation_key": "k-up"},
         "timer_advance_ms": 31_000,
         "last_position": h.stream.last_position,

@@ -909,6 +909,8 @@ class Evaluator:
         except FeelEvalError:
             sel = None  # e.g. `item` arithmetic unbound here → filter below
         if isinstance(sel, (int, float)) and not isinstance(sel, bool):
+            if float(sel) != int(sel):
+                return None  # FEEL: a non-integer index is null, not truncated
             items = left if isinstance(left, list) else (
                 [] if left is None else [left])
             i = int(sel)
@@ -962,14 +964,15 @@ class Evaluator:
         results: list = []
         # one shared scope, mutated per binding (save/restore is unnecessary:
         # inner clauses may only shadow ctx names, and the scope dies with
-        # this evaluation). ``partial`` is the LIVE results list — FEEL
-        # evaluation never mutates values in place, so no defensive copies.
+        # this evaluation). ``partial`` rebinds to a SNAPSHOT per iteration —
+        # aliasing the live list would let a body that returns ``partial``
+        # build a self-referential list (circular JSON on persistence)
         scope = dict(self.ctx)
-        scope["partial"] = results
         ev = Evaluator(scope, self.clock_millis)
 
         def rec(i: int) -> None:
             if i == len(node.iterators):
+                scope["partial"] = list(results)
                 results.append(ev.eval(node.body))
                 return
             name = node.iterators[i][0]

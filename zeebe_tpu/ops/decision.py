@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from zeebe_tpu.feel.feel import Lit, Unary, parse_feel
+from zeebe_tpu.feel.feel import FeelError, Lit, Unary, parse_feel
 from zeebe_tpu.ops.tables import f64_key_planes, pack_slot_values
 
 # atom kinds
@@ -175,8 +175,6 @@ def compile_decision_table(decision, max_atoms: int = 4) -> DeviceDecisionTable:
     # ANY parse failure (cells the host supports but this lexer cannot, e.g.
     # '?'-expressions) must surface as NotDeviceCompilable — the documented
     # keep-the-host-path contract
-    from zeebe_tpu.feel.feel import FeelError
-
     strings: set[str] = set()
     parsed_cells: list[list[list]] = []  # [rule][input] -> list of atom specs
     for rule in rules:
