@@ -1109,6 +1109,9 @@ class KernelBackend:
         self.template_misses = 0
         self.template_audits = 0
         self.template_audit_skips = 0
+        # per-I-bucket cached zero planes for _run_group_on_device (jax
+        # arrays are immutable, so sharing across groups is safe)
+        self._zero_state: dict = {}
 
     # -- candidate test (no state access) ----------------------------------
 
@@ -1893,27 +1896,35 @@ class KernelBackend:
 
         from zeebe_tpu.ops.automaton import run_collect, unpack_events
 
-        elem = arrays["elem"]
-        phase = arrays["phase"]
-        inst_arr = arrays["inst"]
-        def_of = arrays["def_of"]
-        var_slots = arrays["var_slots"]
-        join_counts = arrays["join_counts"]
-        done = arrays["done"]
+        # fresh per-group zero planes are IDENTICAL every group: cache the
+        # immutable device constants per shape bucket — each jnp.zeros call
+        # otherwise costs a dispatch (~0.1ms × 5 per group adds up at small
+        # group sizes). The real (host-filled) arrays convert inside the jit
+        # call itself.
+        # keyed by (device, I): the link router alternates a bucket between
+        # host and accelerator, and planes cached on one device must not
+        # leak into a group running on the other (cross-device transfers at
+        # best, a placement error at worst)
+        zeros = self._zero_state.get((dev, I))
+        if zeros is None:
+            zeros = {
+                "incident": jnp.zeros(I, jnp.bool_),
+                "transitions": jnp.zeros((), jnp.int32),
+                "jobs_created": jnp.zeros((), jnp.int32),
+                "completed": jnp.zeros((), jnp.int32),
+                "overflow": jnp.zeros((), jnp.bool_),
+            }
+            self._zero_state[(dev, I)] = zeros
         state = {
-            "elem": jnp.asarray(elem),
-            "phase": jnp.asarray(phase),
-            "inst": jnp.asarray(inst_arr),
-            "def_of": jnp.asarray(def_of),
-            "var_slots": jnp.asarray(var_slots),
-            "join_counts": jnp.asarray(join_counts),
-            "mi_left": jnp.asarray(arrays["mi_left"]),
-            "done": jnp.asarray(done),
-            "incident": jnp.zeros(I, jnp.bool_),
-            "transitions": jnp.zeros((), jnp.int32),
-            "jobs_created": jnp.zeros((), jnp.int32),
-            "completed": jnp.zeros((), jnp.int32),
-            "overflow": jnp.zeros((), jnp.bool_),
+            "elem": arrays["elem"],
+            "phase": arrays["phase"],
+            "inst": arrays["inst"],
+            "def_of": arrays["def_of"],
+            "var_slots": arrays["var_slots"],
+            "join_counts": arrays["join_counts"],
+            "mi_left": arrays["mi_left"],
+            "done": arrays["done"],
+            **zeros,
         }
         config = tables.kernel_config
         dt = self.registry.device_tables_for(dev)

@@ -966,13 +966,17 @@ class Evaluator:
         # inner clauses may only shadow ctx names, and the scope dies with
         # this evaluation). ``partial`` rebinds to a SNAPSHOT per iteration —
         # aliasing the live list would let a body that returns ``partial``
-        # build a self-referential list (circular JSON on persistence)
+        # build a self-referential list (circular JSON on persistence) — but
+        # only when the body actually reads it: a per-iteration copy would
+        # make every plain for-loop O(n²)
         scope = dict(self.ctx)
         ev = Evaluator(scope, self.clock_millis)
+        wants_partial = _references_name(node.body, "partial")
 
         def rec(i: int) -> None:
             if i == len(node.iterators):
-                scope["partial"] = list(results)
+                if wants_partial:
+                    scope["partial"] = list(results)
                 results.append(ev.eval(node.body))
                 return
             name = node.iterators[i][0]
@@ -1154,6 +1158,20 @@ class Evaluator:
 
 # ---------------------------------------------------------------------------
 # Public API (the ExpressionLanguage facade)
+
+
+def _references_name(node: Any, name: str) -> bool:
+    """True when the AST reads the given root variable name anywhere."""
+    if isinstance(node, (list, tuple)):
+        return any(_references_name(x, name) for x in node)
+    if isinstance(node, Var):
+        return node.path[0] == name
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        return any(
+            _references_name(getattr(node, f.name), name)
+            for f in dataclasses.fields(node)
+        )
+    return False
 
 
 def _ast_references_clock(node: Any) -> bool:
