@@ -210,3 +210,90 @@ class TestRestartRecovery:
             assert len(jobs) == 3
         finally:
             c2.close()
+
+
+class TestFailoverWithRound4Shapes:
+    """Leader failover with the round-4 device shapes parked in flight —
+    a multi-instance body mid-fan-out and an inlined call-activity frame —
+    must replicate their state and complete on the new leader (reference:
+    qa/…/clustering/FailOverReplicationTest)."""
+
+    @pytest.fixture()
+    def cluster(self):
+        c = InProcessCluster(broker_count=3, partition_count=1,
+                             replication_factor=3)
+        c.await_leaders()
+        yield c
+        c.close()
+
+    def _deploy_r4(self, cluster):
+        mi = (
+            Bpmn.create_executable_process("fmi")
+            .start_event("s")
+            .service_task("work", job_type="fw")
+            .multi_instance(input_collection="= items", input_element="item")
+            .end_event("e")
+            .done()
+        )
+        child = (
+            Bpmn.create_executable_process("fchild")
+            .start_event("cs").service_task("ct", job_type="fcw")
+            .end_event("ce").done()
+        )
+        caller = (
+            Bpmn.create_executable_process("fcaller")
+            .start_event("s")
+            .call_activity("call", process_id="fchild")
+            .end_event("e")
+            .done()
+        )
+        for m, name in ((child, "c"), (mi, "m"), (caller, "p")):
+            cluster.write_command(1, command(
+                ValueType.DEPLOYMENT, DeploymentIntent.CREATE, {
+                    "resources": [{"resourceName": f"{name}.bpmn",
+                                   "resource": to_bpmn_xml(m)}],
+                }))
+        cluster.run(500)
+
+    def test_failover_completes_parked_mi_and_call(self, cluster):
+        self._deploy_r4(cluster)
+        cluster.write_command(1, create_cmd("fmi", {"items": [1, 2, 3]}))
+        cluster.write_command(1, create_cmd("fcaller"))
+        cluster.run(1_000)
+        old_broker = cluster.leader_broker(1)
+        with cluster.leader(1).db.transaction():
+            state = cluster.leader(1).engine.state
+            mi_jobs = state.jobs.activatable_keys("fw", 10)
+            call_jobs = state.jobs.activatable_keys("fcw", 10)
+        assert len(mi_jobs) == 3, "MI children not fanned out before failover"
+        assert len(call_jobs) == 1, "call child job missing before failover"
+
+        cluster.net.isolate(old_broker.cfg.node_id)
+        new_leaders = []
+        for _ in range(20):
+            cluster.run(3_000)
+            survivors = [b for b in cluster.brokers.values() if b is not old_broker]
+            new_leaders = [b.partitions[1] for b in survivors
+                           if b.partitions[1].is_leader]
+            if new_leaders:
+                break
+        assert new_leaders, "no new leader after failover"
+        new_leader = new_leaders[0]
+
+        # the replicated state carries the parked MI body + call frame: the
+        # new leader completes every child job and both instances finish
+        with new_leader.db.transaction():
+            state = new_leader.engine.state
+            mi_jobs = state.jobs.activatable_keys("fw", 10)
+            call_jobs = state.jobs.activatable_keys("fcw", 10)
+        assert len(mi_jobs) == 3
+        assert len(call_jobs) == 1
+        for key in [*mi_jobs, *call_jobs]:
+            cluster.write_command(1, command(
+                ValueType.JOB, JobIntent.COMPLETE, {"variables": {}}, key=key))
+        cluster.run(2_000)
+        with new_leader.db.transaction():
+            state = new_leader.engine.state
+            live = [k for k, _v in state.element_instances._instances.items(())]
+        # every process/element instance drained (both roots completed)
+        assert not live, f"instances still live after completion: {live}"

@@ -382,3 +382,8 @@ class TestForQuantFilter:
         assert ev("people[active]",
                   people=[{"active": True, "n": 1},
                           {"active": False, "n": 2}]) == [{"active": True, "n": 1}]
+
+    def test_partial_in_iterator_source(self):
+        # a later clause's SOURCE reading partial still sees results so far
+        r = ev("for x in [1, 2, 3], y in (if x <= 2 then [x] else partial) return y")
+        assert r == [1, 2, 1, 2]

@@ -231,3 +231,59 @@ class TestRunnerUnit:
         finally:
             h1.close()
             h2.close()
+
+
+def mi_and_call_defs():
+    mi = (
+        Bpmn.create_executable_process("mesh_mi")
+        .start_event("s")
+        .service_task("work", job_type="mw")
+        .multi_instance(input_collection="= items", input_element="item")
+        .end_event("e")
+        .done()
+    )
+    child = (
+        Bpmn.create_executable_process("mesh_child")
+        .start_event("cs").service_task("ct", job_type="cw")
+        .end_event("ce").done()
+    )
+    caller = (
+        Bpmn.create_executable_process("mesh_caller")
+        .start_event("s")
+        .call_activity("call", process_id="mesh_child")
+        .end_event("e")
+        .done()
+    )
+    return child, mi, caller
+
+
+def drive_r4_scenario(h: EngineHarness) -> None:
+    child, mi, caller = mi_and_call_defs()
+    h.deploy(child)
+    h.deploy(mi, caller)
+    for i in range(3):
+        h.create_instance("mesh_mi", variables={"items": [i, i + 1]})
+        h.create_instance("mesh_caller")
+    for job_type in ("mw", "cw"):
+        for job in h.activate_jobs(job_type, max_jobs=50):
+            h.complete_job(job["key"], None)
+
+
+class TestMeshRound4Shapes:
+    def test_mi_and_call_groups_byte_identical_on_mesh(self):
+        """The mesh path shards mi_left and the inlined call rows; groups
+        carrying round-4 shapes must stay byte-identical to the default
+        device."""
+        baseline = EngineHarness(use_kernel_backend=True)
+        drive_r4_scenario(baseline)
+        base_log = log_bytes(baseline)
+        baseline.close()
+
+        runner = MeshKernelRunner(n_shards=8)
+        meshed = EngineHarness(use_kernel_backend=True, mesh_runner=runner)
+        drive_r4_scenario(meshed)
+        mesh_log = log_bytes(meshed)
+        assert meshed.kernel_backend.groups_processed > 0
+        meshed.close()
+        assert runner.dispatches > 0
+        assert mesh_log == base_log

@@ -971,12 +971,16 @@ class Evaluator:
         # make every plain for-loop O(n²)
         scope = dict(self.ctx)
         ev = Evaluator(scope, self.clock_millis)
-        wants_partial = _references_name(node.body, "partial")
+        # scan body AND iterator sources: a later clause's source may read
+        # the results so far (`for x in xs, y in partial return …`)
+        wants_partial = _references_name((node.body, node.iterators), "partial")
 
         def rec(i: int) -> None:
+            if wants_partial:
+                # fresh snapshot for the body AND for iterator sources (a
+                # later clause may iterate the results so far)
+                scope["partial"] = list(results)
             if i == len(node.iterators):
-                if wants_partial:
-                    scope["partial"] = list(results)
                 results.append(ev.eval(node.body))
                 return
             name = node.iterators[i][0]
@@ -1160,33 +1164,30 @@ class Evaluator:
 # Public API (the ExpressionLanguage facade)
 
 
-def _references_name(node: Any, name: str) -> bool:
-    """True when the AST reads the given root variable name anywhere."""
+def _ast_any(node: Any, pred) -> bool:
+    """Generic AST walk: True when ``pred`` holds for any node."""
+    if pred(node):
+        return True
     if isinstance(node, (list, tuple)):
-        return any(_references_name(x, name) for x in node)
-    if isinstance(node, Var):
-        return node.path[0] == name
+        return any(_ast_any(x, pred) for x in node)
     if dataclasses.is_dataclass(node) and not isinstance(node, type):
         return any(
-            _references_name(getattr(node, f.name), name)
+            _ast_any(getattr(node, f.name), pred)
             for f in dataclasses.fields(node)
         )
     return False
+
+
+def _references_name(node: Any, name: str) -> bool:
+    """True when the AST reads the given root variable name anywhere."""
+    return _ast_any(node, lambda n: isinstance(n, Var) and n.path[0] == name)
 
 
 def _ast_references_clock(node: Any) -> bool:
     """True when the AST calls now() anywhere — the expression's value then
     depends on the evaluation clock, not only on its variable context."""
-    if isinstance(node, (list, tuple)):
-        return any(_ast_references_clock(x) for x in node)
-    if isinstance(node, Call):
-        return node.name in ("now", "today") or _ast_references_clock(node.args)
-    if dataclasses.is_dataclass(node) and not isinstance(node, type):
-        return any(
-            _ast_references_clock(getattr(node, f.name))
-            for f in dataclasses.fields(node)
-        )
-    return False
+    return _ast_any(
+        node, lambda n: isinstance(n, Call) and n.name in ("now", "today"))
 
 
 @dataclasses.dataclass(frozen=True, slots=True)
