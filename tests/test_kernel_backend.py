@@ -1850,3 +1850,47 @@ class TestInclusiveGatewayOnDevice:
             assert drive_jobs(h, "ib") == 1
         finally:
             h.close()
+
+
+class TestWidenedSafeMappings:
+    """Round-4 widening of the never-raises mapping subset: context/list
+    literals, if-then-else, equality, and and/or now ride the kernel."""
+
+    def _proc(self, pid="wmap"):
+        return (
+            Bpmn.create_executable_process(pid)
+            .start_event("s")
+            .service_task("t0", job_type="wm")
+            .zeebe_input('= {n: amount, tags: [amount, "x"]}', "doc")
+            .zeebe_output('= if doc.n = 5 then "five" else "other"', "label")
+            .service_task("t1", job_type="wm2")
+            .zeebe_input("= label = \"five\" or missing", "flag")
+            .end_event("e")
+            .done()
+        )
+
+    def test_parity(self):
+        def scenario(h):
+            h.deploy(self._proc())
+            h.create_instance("wmap", {"amount": 5}, request_id=1)
+            h.create_instance("wmap", {"amount": 7}, request_id=2)
+            drive_jobs(h, "wm")
+            drive_jobs(h, "wm2")
+
+        assert_equivalent(scenario)
+
+    def test_rides_kernel_without_host_escape(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(self._proc("wmap_dev"))
+            h.create_instance("wmap_dev", {"amount": 5})
+            with h.db.transaction():
+                meta = h.engine.state.processes.get_latest_by_id("wmap_dev")
+            info = h.kernel_backend.registry.lookup(meta["processDefinitionKey"], None)
+            assert info is not None
+            assert not info.host_idxs, (
+                f"mappings host-escaped: {sorted(info.host_idxs)}")
+            assert drive_jobs(h, "wm") == 1
+            assert drive_jobs(h, "wm2") == 1
+        finally:
+            h.close()

@@ -181,16 +181,37 @@ def _is_numeric(v: Any) -> bool:
 
 
 def _safe_mapping_expr(expr) -> bool:
-    """True when evaluating the expression can NEVER raise: a static string
-    or a bare variable/literal FEEL AST (a missing variable evaluates to
-    null). The kernel's trace decoder routes tokens BEFORE the materializer
-    evaluates mappings, so an element may ride the device only when its
-    mappings cannot fail mid-burst (an IO_MAPPING_ERROR incident after the
-    device already took the outgoing flows would diverge from the
-    sequential engine)."""
-    from zeebe_tpu.feel.feel import Lit, Var
+    """True when evaluating the expression can NEVER raise: the kernel's
+    trace decoder routes tokens BEFORE the materializer evaluates mappings,
+    so an element may ride the device only when its mappings cannot fail
+    mid-burst (an IO_MAPPING_ERROR incident after the device already took
+    the outgoing flows would diverge from the sequential engine).
 
-    return expr.is_static or isinstance(expr.ast, (Lit, Var))
+    The never-raises subset: static strings; variables (missing → null);
+    literals; list/context literals, if-then-else, equality, and/or, and
+    member access over safe operands — all null-tolerant in the evaluator
+    (access in particular: the parser guarantees a string literal on the
+    right, and dict.get / temporal_property / non-container all yield null
+    for unknown names). Arithmetic and ordered comparisons raise on type
+    mismatches; function calls raise through the builtin wrapper — both
+    stay host-side."""
+    from zeebe_tpu.feel.feel import Bin, ContextLit, If, Lit, ListLit, Var
+
+    def safe(node) -> bool:
+        if isinstance(node, (Lit, Var)):
+            return True
+        if isinstance(node, ListLit):
+            return all(safe(x) for x in node.items)
+        if isinstance(node, ContextLit):
+            return all(safe(v) for _k, v in node.entries)
+        if isinstance(node, If):
+            return safe(node.cond) and safe(node.then) and safe(node.orelse)
+        if isinstance(node, Bin) and node.op in ("=", "!=", "and", "or",
+                                                 "access"):
+            return safe(node.left) and safe(node.right)
+        return False
+
+    return expr.is_static or safe(expr.ast)
 
 
 _COND_VAR_CACHE: dict[str, frozenset[str]] = {}
