@@ -297,3 +297,64 @@ class TestFailoverWithRound4Shapes:
             live = [k for k, _v in state.element_instances._instances.items(())]
         # every process/element instance drained (both roots completed)
         assert not live, f"instances still live after completion: {live}"
+
+
+class TestRebalancing:
+    """Leadership rebalancing (reference: RebalancingEndpoint.java backed by
+    priority-aware leadership transfer)."""
+
+    def test_skewed_leadership_rebalances(self):
+        c = InProcessCluster(broker_count=3, partition_count=3, replication_factor=3)
+        try:
+            c.await_leaders()
+            # force the skew: transfer every partition's leadership to broker-0
+            for pid in (1, 2, 3):
+                leader_part = c.leader(pid)
+                leader_broker = next(
+                    b for b in c.brokers.values()
+                    if pid in b.partitions and b.partitions[pid].is_leader
+                )
+                if leader_broker.cfg.node_id != "broker-0":
+                    assert leader_part.raft.transfer_leadership("broker-0")
+            for _ in range(20):
+                c.run(500)
+                if all(
+                    c.leader(pid) is not None
+                    and c.brokers["broker-0"].partitions[pid].is_leader
+                    for pid in (1, 2, 3)
+                ):
+                    break
+            counts = {
+                m: sum(1 for p in b.partitions.values() if p.is_leader)
+                for m, b in c.brokers.items()
+            }
+            assert counts["broker-0"] == 3, counts  # fully skewed
+
+            # rebalance: every broker steps down where it isn't preferred
+            for b in c.brokers.values():
+                b.rebalance()
+            for _ in range(30):
+                c.run(500)
+                leaders = {
+                    pid: next((m for m, b in c.brokers.items()
+                               if b.partitions[pid].is_leader), None)
+                    for pid in (1, 2, 3)
+                }
+                if None not in leaders.values() and len(set(leaders.values())) == 3:
+                    break
+                # retry: transfers are best-effort, a busy target may lose one
+                for b in c.brokers.values():
+                    b.rebalance()
+            counts = {
+                m: sum(1 for p in b.partitions.values() if p.is_leader)
+                for m, b in c.brokers.items()
+            }
+            assert max(counts.values()) - min(counts.values()) <= 1, counts
+            # each partition's leader is its highest-priority replica
+            any_broker = next(iter(c.brokers.values()))
+            for pid in (1, 2, 3):
+                preferred = any_broker.preferred_leader(pid)
+                assert c.brokers[preferred].partitions[pid].is_leader, (
+                    pid, preferred, counts)
+        finally:
+            c.close()

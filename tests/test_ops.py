@@ -242,6 +242,18 @@ class TestManagementServer:
         assert any(e["checkpointId"] == 3 and e["status"] == "COMPLETED"
                    for e in entries)
 
+    def test_rebalance_endpoint(self, broker_stack):
+        """POST /rebalance (reference: RebalancingEndpoint.java). Single
+        broker: it already leads its only partition AND is the preferred
+        replica, so the endpoint reports no transfers."""
+        broker, server, clock, net = broker_stack
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/rebalance", method="POST")
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 202
+            assert json.loads(resp.read())["transferred"] == {}
+        assert broker.preferred_leader(1) == "b0"
+
     def test_pause_resume(self, broker_stack):
         broker, server, clock, net = broker_stack
         req = urllib.request.Request(

@@ -348,3 +348,42 @@ class TestFlushPolicy:
         assert not node._flush_dirty
         assert node._flushed_index == node.journal.last_index
         node.close()
+
+
+class TestLeadershipTransfer:
+    """Raft leadership-transfer extension (reference: RaftContext
+    transferLeadership behind the actuator RebalancingEndpoint)."""
+
+    def test_transfer_moves_leadership(self, cluster):
+        leader = cluster.elect()
+        target = next(m for m in cluster.nodes if m != leader.member_id)
+        assert leader.transfer_leadership(target)
+        cluster.run(4 * ELECTION_TIMEOUT_MS)
+        new_leader = cluster.leader()
+        assert new_leader is not None
+        assert new_leader.member_id == target
+        assert leader.role == RaftRole.FOLLOWER
+
+    def test_transfer_rejected_off_leader(self, cluster):
+        leader = cluster.elect()
+        follower = next(n for n in cluster.nodes.values() if n is not leader)
+        assert not follower.transfer_leadership(leader.member_id)
+        # self-transfer and unknown members are rejected too
+        assert not leader.transfer_leadership(leader.member_id)
+        assert not leader.transfer_leadership("node-99")
+
+    def test_transfer_preserves_committed_log(self, cluster):
+        leader = cluster.elect()
+        for i in range(5):
+            leader.append(f"entry-{i}".encode(), asqn=i + 1)
+        cluster.run(10 * HEARTBEAT_INTERVAL_MS)
+        committed_before = leader.commit_index
+        target = next(m for m in cluster.nodes if m != leader.member_id)
+        assert leader.transfer_leadership(target)
+        cluster.run(4 * ELECTION_TIMEOUT_MS)
+        new_leader = cluster.leader()
+        assert new_leader.member_id == target
+        assert new_leader.commit_index >= committed_before
+        data = [e["data"] for e in new_leader.committed_entries(1)
+                if e.get("data") and not e.get("init")]
+        assert [f"entry-{i}".encode() for i in range(5)] == data[:5]

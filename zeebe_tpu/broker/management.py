@@ -14,6 +14,8 @@ Endpoints:
   POST /backups/<id> → trigger a cluster-consistent checkpoint
   GET  /backups   → backup store listing (when a store is configured)
   POST /pause | /resume → pause/resume stream processing (BrokerAdminService)
+  POST /rebalance → transfer partition leadership to the highest-priority
+       replicas (reference: dist/…/management/RebalancingEndpoint.java)
 """
 
 from __future__ import annotations
@@ -118,6 +120,12 @@ class ManagementServer:
         elif path == "/resume":
             self.broker.resume_processing()
             handler._send(200, json.dumps({"paused": False}))
+        elif path == "/rebalance":
+            # leadership rebalancing (reference: actuator RebalancingEndpoint)
+            transferred = self.broker.rebalance()
+            handler._send(202, json.dumps(
+                {"transferred": {str(k): v for k, v in transferred.items()}}
+            ))
         else:
             handler._send(404, json.dumps({"error": f"unknown path {path}"}))
 

@@ -213,6 +213,7 @@ class RaftNode:
         messaging.subscribe(f"{t}-append", _counted("append", self._on_append_request))
         messaging.subscribe(f"{t}-append-resp", _counted("append-resp", self._on_append_response))
         messaging.subscribe(f"{t}-snapshot", _counted("snapshot", self._on_install_snapshot))
+        messaging.subscribe(f"{t}-timeout-now", _counted("timeout-now", self._on_timeout_now))
 
     # -- persistence ----------------------------------------------------------
 
@@ -461,6 +462,30 @@ class RaftNode:
             if self._quorum(len(self._votes)):
                 self._become_leader()
 
+    def transfer_leadership(self, target: str) -> bool:
+        """Best-effort leadership transfer (raft leadership-transfer
+        extension; reference: RaftContext#transferLeadership backing the
+        actuator's RebalancingEndpoint): replicate to the target, then tell
+        it to start an election IMMEDIATELY (timeout-now). If the target's
+        log is behind it simply loses and we stay leader; if it wins, its
+        higher term deposes us on the next message."""
+        if (self.role != RaftRole.LEADER or target == self.member_id
+                or target not in self.members):
+            return False
+        self._send_append(target)  # close any replication gap first
+        self._send(target, "timeout-now", {"term": self.current_term})
+        return True
+
+    def _on_timeout_now(self, sender: str, req: dict) -> None:
+        """The current leader asked us to depose it: skip the pre-vote phase
+        (the leader itself initiated this, so stickiness must not block it)
+        and start an election at once."""
+        if sender not in self.members or req["term"] < self.current_term:
+            return
+        if self.role == RaftRole.LEADER:
+            return
+        self._start_election()
+
     def _become_leader(self) -> None:
         now = self.clock_millis()
         if self._election_started_ms is not None:
@@ -699,9 +724,13 @@ class RaftNode:
 
     # -- snapshot install ------------------------------------------------------
 
-    def set_snapshot(self, index: int, term: int, data: bytes) -> None:
+    def set_snapshot(self, index: int, term: int,
+                     data: bytes | None) -> None:
         """Owner took a state snapshot: the log up to ``index`` can compact
-        (reference: snapshot → Raft compacts log up to snapshot index)."""
+        (reference: snapshot → Raft compacts log up to snapshot index).
+        ``data=None``: no stored fallback payload — installs are served only
+        by the live ``snapshot_provider`` (durable-state mode), and when it
+        declines, nothing is sent."""
         self.snapshot_index = index
         self.snapshot_term = term
         self._snapshot_bytes = data

@@ -85,6 +85,7 @@ class EventAppliers:
         reg[(ValueType.INCIDENT, int(IncidentIntent.CREATED))] = self._incident_created
         reg[(ValueType.INCIDENT, int(IncidentIntent.RESOLVED))] = self._incident_resolved
         from zeebe_tpu.protocol.intent import (
+            MessageBatchIntent,
             MessageIntent,
             MessageStartEventSubscriptionIntent,
             MessageSubscriptionIntent,
@@ -98,6 +99,7 @@ class EventAppliers:
         reg[(ValueType.TIMER, int(TimerIntent.CANCELED))] = self._timer_removed
         reg[(ValueType.MESSAGE, int(MessageIntent.PUBLISHED))] = self._message_published
         reg[(ValueType.MESSAGE, int(MessageIntent.EXPIRED))] = self._message_removed
+        reg[(ValueType.MESSAGE_BATCH, int(MessageBatchIntent.EXPIRED))] = self._message_batch_expired
         reg[(ValueType.MESSAGE_SUBSCRIPTION, int(MessageSubscriptionIntent.CREATED))] = self._msg_sub_created
         reg[(ValueType.MESSAGE_SUBSCRIPTION, int(MessageSubscriptionIntent.CORRELATING))] = self._msg_sub_correlating
         reg[(ValueType.MESSAGE_SUBSCRIPTION, int(MessageSubscriptionIntent.CORRELATED))] = self._msg_sub_correlated
@@ -443,6 +445,14 @@ class EventAppliers:
 
     def _message_removed(self, record: Record) -> None:
         self.state.messages.remove(record.key, record.value.get("deadline", -1))
+
+    def _message_batch_expired(self, record: Record) -> None:
+        """One MESSAGE_BATCH EXPIRED record removes every named message —
+        the O(batches) expiry path (reference: MessageBatchExpireProcessor)."""
+        for key in record.value.get("messageKeys", []):
+            msg = self.state.messages.get(key)
+            if msg is not None:
+                self.state.messages.remove(key, msg.get("deadline", -1))
 
     def _msg_sub_created(self, record: Record) -> None:
         self.state.message_subscriptions.put(record.key, record.value)

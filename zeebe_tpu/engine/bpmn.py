@@ -300,6 +300,11 @@ class BpmnProcessor:
             if event_triggered:
                 # the event already fired at the event-based gateway; pass through
                 self._complete(key, value, exe, element, writers)
+            elif element.event_type == BpmnEventType.LINK:
+                # a catch link is a pass-through: entered by the matching
+                # throw, it completes immediately and takes its outgoing
+                # flows (reference: IntermediateCatchEventProcessor link)
+                self._complete(key, value, exe, element, writers)
             elif element.event_type == BpmnEventType.TIMER or element.timer_duration is not None:
                 self._create_timer(key, value, element, element, writers)
             elif element.message_name is not None:
@@ -1165,6 +1170,20 @@ class BpmnProcessor:
             writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_COMPLETED, value)
             for flow in taken_flows:
                 self._take_flow(writers, exe, flow, value)
+        elif (
+            element.element_type == BpmnElementType.INTERMEDIATE_THROW_EVENT
+            and element.event_type == BpmnEventType.LINK
+            and element.link_target_idx >= 0
+        ):
+            # link throw: the token jumps to the same-scope catch link — no
+            # sequence flow is taken and the scope stays alive through the
+            # pending catch activation (reference:
+            # IntermediateThrowEventProcessor.java:201-208 link routing)
+            writers.append_event(key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_COMPLETED, value)
+            target = exe.elements[element.link_target_idx]
+            self._write_activate(writers, exe, target,
+                                 value.get("flowScopeKey", -1), value)
+            return
         elif element.element_type == BpmnElementType.EVENT_BASED_GATEWAY and triggered_element_id:
             # per the BPMN spec the sequence flow to the triggered event is NOT
             # taken — the event activates directly (reference:

@@ -37,6 +37,7 @@ from zeebe_tpu.protocol.intent import (
     IncidentIntent,
     JobBatchIntent,
     JobIntent,
+    MessageBatchIntent,
     MessageIntent,
     MessageSubscriptionIntent,
     ProcessInstanceBatchIntent,
@@ -168,6 +169,7 @@ class Engine(RecordProcessor):
             (ValueType.TIMER, int(TimerIntent.TRIGGER)): timers.trigger,
             (ValueType.MESSAGE, int(MessageIntent.PUBLISH)): messages.publish,
             (ValueType.MESSAGE, int(MessageIntent.EXPIRE)): messages.expire,
+            (ValueType.MESSAGE_BATCH, int(MessageBatchIntent.EXPIRE)): messages.expire_batch,
             (ValueType.MESSAGE_SUBSCRIPTION, int(MessageSubscriptionIntent.CREATE)): msg_subs.create,
             (ValueType.MESSAGE_SUBSCRIPTION, int(MessageSubscriptionIntent.CORRELATE)): msg_subs.correlate_ack,
             (ValueType.MESSAGE_SUBSCRIPTION, int(MessageSubscriptionIntent.DELETE)): msg_subs.delete,

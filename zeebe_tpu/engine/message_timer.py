@@ -28,6 +28,7 @@ from zeebe_tpu.protocol import DEFAULT_TENANT, Record, RejectionType, ValueType,
 from zeebe_tpu.protocol.enums import BpmnElementType
 from zeebe_tpu.protocol.intent import (
     JobIntent,
+    MessageBatchIntent,
     MessageIntent,
     MessageStartEventSubscriptionIntent,
     MessageSubscriptionIntent,
@@ -36,6 +37,10 @@ from zeebe_tpu.protocol.intent import (
     ProcessMessageSubscriptionIntent,
     TimerIntent,
 )
+
+#: max message keys per MESSAGE_BATCH EXPIRE command — bounds the record size
+#: like the reference's batch-size cap (MessageBatchExpireProcessor)
+MESSAGE_EXPIRE_BATCH_MAX = 3000
 
 
 class TimerProcessors:
@@ -223,6 +228,19 @@ class MessageProcessors:
             return
         writers.append_event(key, ValueType.MESSAGE, MessageIntent.EXPIRED, msg)
 
+    def expire_batch(self, cmd: LoggedRecord, writers: Writers) -> None:
+        """MESSAGE_BATCH EXPIRE: one EXPIRED event removes every named
+        message still present — O(batches) records for an N-message backlog
+        (reference: MessageBatchExpireProcessor.java; VERDICT r4 item 7)."""
+        keys = cmd.record.value.get("messageKeys") or []
+        still = [k for k in keys if self.state.messages.get(k) is not None]
+        if not still:
+            return
+        writers.append_event(
+            self.state.next_key(), ValueType.MESSAGE_BATCH,
+            MessageBatchIntent.EXPIRED, {"messageKeys": still},
+        )
+
 
 def _correlate_to_subscription(
     state: EngineState, sender, message_key: int, message: dict,
@@ -409,9 +427,17 @@ class DueDateCheckers:
                 commands.append(
                     command(ValueType.TIMER, TimerIntent.TRIGGER, {}, key=timer_key)
                 )
-            for _deadline, message_key in self.state.messages.expired(now):
+            # batched expiry: ONE MESSAGE_BATCH command expires the whole due
+            # backlog (chunked to bound record size) — per-message EXPIRE is
+            # exactly the per-record overhead this framework exists to kill
+            # (reference: protocol.xml MESSAGE_BATCH,
+            # MessageBatchExpireProcessor.java)
+            expired_keys = [mk for _d, mk in self.state.messages.expired(now)]
+            for i in range(0, len(expired_keys), MESSAGE_EXPIRE_BATCH_MAX):
                 commands.append(
-                    command(ValueType.MESSAGE, MessageIntent.EXPIRE, {}, key=message_key)
+                    command(ValueType.MESSAGE_BATCH, MessageBatchIntent.EXPIRE,
+                            {"messageKeys":
+                             expired_keys[i:i + MESSAGE_EXPIRE_BATCH_MAX]})
                 )
             for job_key in self.state.jobs.expired_deadlines(now):
                 commands.append(

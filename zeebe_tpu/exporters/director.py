@@ -118,6 +118,12 @@ class ExecutionLatencyObserver:
                 self._m_buffered_messages.inc()
             elif intent == int(MessageIntent.EXPIRED):
                 self._m_buffered_messages.dec()
+        elif vt == ValueType.MESSAGE_BATCH:
+            from zeebe_tpu.protocol.intent import MessageBatchIntent
+
+            if intent == int(MessageBatchIntent.EXPIRED):
+                self._m_buffered_messages.dec(
+                    len(rec.value.get("messageKeys", ()) or ()))
 
 
 class ExporterContainer:

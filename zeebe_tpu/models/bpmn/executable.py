@@ -83,6 +83,9 @@ class ExecutableElement:
     script_expression: Expression | None = None
     script_result_variable: str | None = None
     multi_instance: "ExecutableMultiInstance | None" = None
+    # link events: the throw's matching same-scope catch (element idx)
+    link_name: str | None = None
+    link_target_idx: int = -1
 
 
 @dataclasses.dataclass(slots=True)
@@ -211,6 +214,7 @@ def _lower_element(
     exe.task_headers = dict(el.task_headers)
     exe.called_process_id = el.called_process_id
     exe.called_decision_id = el.called_decision_id
+    exe.link_name = el.link_name
     exe.native_user_task = el.native_user_task
     exe.form_id = el.form_id
     exe.user_task_assignee = el.user_task_assignee
@@ -353,6 +357,45 @@ def _validate(
             exe.event_type = start.event_type
             exe.interrupting = start.interrupting
 
+    # link events: every throw routes to THE same-scope catch with its name
+    # (reference: bpmn-model/…/validation/zeebe/LinkEventValidator — catch
+    # names unique per scope, each throw has exactly one matching catch;
+    # engine/…/bpmn/event/IntermediateThrowEventProcessor.java:201-208)
+    catch_links: dict[tuple[int, str], list[int]] = {}
+    for exe in elements[1:]:
+        if (
+            exe.element_type == BpmnElementType.INTERMEDIATE_CATCH_EVENT
+            and exe.event_type == BpmnEventType.LINK
+        ):
+            if not exe.link_name:
+                errors.append(f"element {exe.id!r}: link event needs a name")
+                continue
+            catch_links.setdefault((exe.parent_idx, exe.link_name), []).append(exe.idx)
+    for (scope_idx, name), idxs in catch_links.items():
+        if len(idxs) > 1:
+            errors.append(
+                f"multiple catch link events named {name!r} in scope "
+                f"{elements[scope_idx].id!r}"
+            )
+    for exe in elements[1:]:
+        if (
+            exe.element_type == BpmnElementType.INTERMEDIATE_THROW_EVENT
+            and exe.event_type == BpmnEventType.LINK
+        ):
+            where = f"element {exe.id!r}"
+            if not exe.link_name:
+                errors.append(f"{where}: link event needs a name")
+                continue
+            if exe.outgoing:
+                errors.append(f"{where}: link throw event cannot have outgoing flows")
+            targets = catch_links.get((exe.parent_idx, exe.link_name), [])
+            if not targets:
+                errors.append(
+                    f"{where}: no catch link event named {exe.link_name!r} in its scope"
+                )
+            else:
+                exe.link_target_idx = targets[0]
+
     for exe in elements[1:]:
         where = f"element {exe.id!r}"
         et = exe.element_type
@@ -428,6 +471,11 @@ def _validate(
                 BpmnElementType.START_EVENT,
                 BpmnElementType.BOUNDARY_EVENT,
                 BpmnElementType.EVENT_SUB_PROCESS,
+            )
+            # catch link events are entered via the matching throw, not a flow
+            and not (
+                et == BpmnElementType.INTERMEDIATE_CATCH_EVENT
+                and exe.event_type == BpmnEventType.LINK
             )
         ):
             errors.append(f"{where}: unreachable (no incoming sequence flow)")

@@ -90,6 +90,8 @@ class ProcessElement:
     decision_result_variable: str | None = None
     # linked Camunda form (zeebe:formDefinition formId)
     form_id: str | None = None
+    # link events (linkEventDefinition name; throw routes to same-scope catch)
+    link_name: str | None = None
 
 
 @dataclasses.dataclass(slots=True)
@@ -311,6 +313,24 @@ class ProcessBuilder:
         return self._add_element(
             ProcessElement(element_id or self._auto_id("throw"), BpmnElementType.INTERMEDIATE_THROW_EVENT)
         )
+
+    def intermediate_throw_link(self, element_id: str, link_name: str) -> "ProcessBuilder":
+        """Link throw: the token jumps to the same-scope catch link with this
+        name (reference: builder IntermediateThrowEventBuilder.link)."""
+        el = ProcessElement(
+            element_id, BpmnElementType.INTERMEDIATE_THROW_EVENT,
+            event_type=BpmnEventType.LINK, link_name=link_name,
+        )
+        return self._add_element(el)
+
+    def intermediate_catch_link(self, element_id: str, link_name: str) -> "ProcessBuilder":
+        """Link catch: entered only via a matching link throw — no incoming
+        sequence flow; the cursor moves here so the continuation chains on."""
+        el = ProcessElement(
+            element_id, BpmnElementType.INTERMEDIATE_CATCH_EVENT,
+            event_type=BpmnEventType.LINK, link_name=link_name,
+        )
+        return self._add_element(el, connect=False)
 
     def boundary_signal(
         self, element_id: str, attached_to: str, signal_name: str, interrupting: bool = True

@@ -166,6 +166,10 @@ def _parse_event_definitions(el_xml, el: ProcessElement, messages, errors, signa
         el.event_type = BpmnEventType.ESCALATION
         ref = esc.get("escalationRef")
         el.escalation_code = escalations.get(ref, ref) if ref else None
+    link = el_xml.find(f"{_B}linkEventDefinition")
+    if link is not None:
+        el.event_type = BpmnEventType.LINK
+        el.link_name = link.get("name", "")
     if el_xml.find(f"{_B}terminateEventDefinition") is not None:
         el.event_type = BpmnEventType.TERMINATE
 
@@ -391,6 +395,9 @@ def _element_to_xml(parent, el: ProcessElement, message_names, error_codes,
         ET.SubElement(node, f"{_B}escalationEventDefinition", esc_attrs)
     elif el.event_type == BpmnEventType.TERMINATE:
         ET.SubElement(node, f"{_B}terminateEventDefinition")
+    elif el.event_type == BpmnEventType.LINK and el.link_name is not None:
+        ET.SubElement(node, f"{_B}linkEventDefinition",
+                      {"name": el.link_name})
 
     if el.multi_instance is not None:
         mi = el.multi_instance

@@ -54,6 +54,8 @@ def decode_step_events(tables: ProcessTables, state_before: dict, events: dict) 
         for s in range(take_mask.shape[1]):
             if take_mask[t, s]:
                 fidx = int(tables.out_flow_idx[d, int(e), s])
+                if fidx < 0:
+                    continue  # synthetic link-jump edge: no sequence flow
                 emit(i, exe.flows[fidx].id, "SEQUENCE_FLOW_TAKEN")
     for i in np.nonzero(newly_done)[0]:
         d = int(def_of[i])

@@ -24,7 +24,7 @@ import numpy as np
 
 from zeebe_tpu.feel import feel as F
 from zeebe_tpu.models.bpmn import ExecutableProcess
-from zeebe_tpu.protocol.enums import BpmnElementType
+from zeebe_tpu.protocol.enums import BpmnElementType, BpmnEventType
 
 # condition VM opcodes
 OP_NOP = 0
@@ -464,6 +464,10 @@ def _live_token_width(exe: ExecutableProcess) -> int | None:
     splits: list[ExecutableElement] = []
     for el in exe.elements:
         targets_of[el.idx] = [exe.flows[f].target_idx for f in el.outgoing]
+        if el.link_target_idx >= 0:
+            # link jumps continue the token like a flow — a backward link
+            # closes a cycle the flow graph alone would not show
+            targets_of[el.idx].append(el.link_target_idx)
         if (el.element_type in (BpmnElementType.PARALLEL_GATEWAY,
                                 BpmnElementType.INCLUSIVE_GATEWAY)
                 and len(el.outgoing) > 1):
@@ -573,6 +577,19 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                 flow = exe.flows[fidx]
                 out_target[d, el.idx, slot_i] = flow.target_idx
                 out_flow_idx[d, el.idx, slot_i] = flow.idx
+            if (
+                el.element_type == BpmnElementType.INTERMEDIATE_THROW_EVENT
+                and el.event_type == BpmnEventType.LINK
+                and el.link_target_idx >= 0
+                and not el.outgoing
+            ):
+                # link throw: synthetic edge to the same-scope catch link.
+                # out_flow_idx = -1 marks it as a link jump — no sequence
+                # flow exists, so decode emits the catch ACTIVATE without a
+                # SEQUENCE_FLOW_TAKEN (engine _complete link branch parity)
+                out_count[d, el.idx] = 1
+                out_target[d, el.idx, 0] = el.link_target_idx
+                out_flow_idx[d, el.idx, 0] = -1
             # scope chains of embedded sub-processes are supported (K_SCOPE),
             # and — in synthetic inlined definitions (kernel_backend
             # _inline_call_activities) — chains through CALL_ACTIVITY rows
@@ -611,8 +628,16 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                     # form resolution reads FormState at activation time (the
                     # formKey header depends on the latest deployed form)
                     raise ConditionNotCompilable("form-linked user task")
-                if (el.element_type in (BpmnElementType.INTERMEDIATE_CATCH_EVENT,
-                                        BpmnElementType.RECEIVE_TASK)) and (
+                if el.event_type == BpmnEventType.LINK and el.element_type in (
+                    BpmnElementType.INTERMEDIATE_THROW_EVENT,
+                    BpmnElementType.INTERMEDIATE_CATCH_EVENT,
+                ):
+                    # link events are device pass-throughs: the throw rides
+                    # its synthetic edge (filled above), the catch completes
+                    # immediately and takes its real outgoing flows
+                    op = K_PASS
+                elif (el.element_type in (BpmnElementType.INTERMEDIATE_CATCH_EVENT,
+                                          BpmnElementType.RECEIVE_TASK)) and (
                     (el.timer_duration is not None and not el.timer_cycle
                      and el.timer_date is None)
                     or el.message_name is not None

@@ -61,6 +61,7 @@ class ValueType(enum.IntEnum):
     USER_TASK = 30
     PROCESS_INSTANCE_RESULT = 31
     PROCESS_INSTANCE_MIGRATION = 32
+    MESSAGE_BATCH = 33
     SBE_UNKNOWN = 255
 
 

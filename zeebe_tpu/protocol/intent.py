@@ -183,6 +183,17 @@ class MessageIntent(Intent):
     _EVENT_NAMES = enum.nonmember(frozenset({"PUBLISHED", "EXPIRED"}))
 
 
+class MessageBatchIntent(Intent):
+    """One record expiring N messages (reference: protocol.xml:52
+    MESSAGE_BATCH, engine/…/message/MessageBatchExpireProcessor.java) — the
+    TTL sweep plans one batch command instead of per-message EXPIREs."""
+
+    EXPIRE = 0
+    EXPIRED = 1
+
+    _EVENT_NAMES = enum.nonmember(frozenset({"EXPIRED"}))
+
+
 class MessageSubscriptionIntent(Intent):
     CREATE = 0
     CREATED = 1
@@ -381,6 +392,7 @@ _INTENTS_BY_VALUE_TYPE: dict[ValueType, type[Intent]] = {
     ValueType.PROCESS_INSTANCE: ProcessInstanceIntent,
     ValueType.INCIDENT: IncidentIntent,
     ValueType.MESSAGE: MessageIntent,
+    ValueType.MESSAGE_BATCH: MessageBatchIntent,
     ValueType.MESSAGE_SUBSCRIPTION: MessageSubscriptionIntent,
     ValueType.PROCESS_MESSAGE_SUBSCRIPTION: ProcessMessageSubscriptionIntent,
     ValueType.JOB_BATCH: JobBatchIntent,
