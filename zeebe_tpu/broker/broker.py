@@ -52,6 +52,10 @@ class BrokerCfg:
     # A shared MeshKernelRunner may also be injected by the hosting runtime
     # (ClusterRuntime) so in-process brokers share a single mesh.
     kernel_mesh_shards: int = -1
+    # disk-backed state with O(delta) checkpoints (state/durable.py) — the
+    # large-state backend (reference: RocksDB zb-db + its checkpoint story).
+    # Off by default: the in-memory store wins below ~100 MB of state.
+    durable_state: bool = False
 
 
 _AUTO_DEVICE_COUNT: int | None = None
@@ -352,6 +356,7 @@ class Broker:
             on_jobs_available=self._on_jobs_available,
             kernel_backend_enabled=self.cfg.kernel_backend,
             mesh_runner=self._mesh_runner(),
+            durable_state=self.cfg.durable_state,
         )
         self.health_monitor.register(f"partition-{partition_id}")
         from zeebe_tpu.utils.metrics import REGISTRY as _REG
@@ -495,6 +500,45 @@ class Broker:
             self.jobs_listener(partition_id, job_types)
 
     # -- topology --------------------------------------------------------------
+
+    def preferred_leader(self, partition_id: int) -> str | None:
+        """The replica with the highest topology priority for a partition —
+        the target leadership rebalancing converges to (reference:
+        PartitionLeaderElection priorities; priorities are assigned
+        round-robin at bootstrap, ClusterTopology.initial)."""
+        from zeebe_tpu.cluster.topology import ACTIVE
+
+        best: str | None = None
+        best_prio = -1
+        for member_id, mstate in self.topology.topology.members.items():
+            if mstate.get("state") != ACTIVE:
+                continue  # leaving/left members must not attract leadership
+            p = mstate.get("partitions", {}).get(str(partition_id))
+            if p is None or p.get("state", ACTIVE) != ACTIVE:
+                continue  # joining replicas may still be catching up
+            prio = p.get("priority", 1)
+            if prio > best_prio or (prio == best_prio and (best is None or member_id < best)):
+                best, best_prio = member_id, prio
+        return best
+
+    def rebalance(self) -> dict[int, str]:
+        """Leadership rebalancing (reference: dist/…/management/
+        RebalancingEndpoint.java): for every LOCAL partition this broker
+        leads whose preferred (highest-priority) replica is someone else,
+        transfer raft leadership there. Returns partition → transfer target
+        for the transfers actually initiated (best-effort, like the
+        reference's actuator)."""
+        transferred: dict[int, str] = {}
+        # list(): served on the management HTTP thread while topology changes
+        # may add/remove partitions concurrently
+        for pid, partition in list(self.partitions.items()):
+            if not partition.is_leader:
+                continue
+            preferred = self.preferred_leader(pid)
+            if (preferred is not None and preferred != self.cfg.node_id
+                    and partition.raft.transfer_leadership(preferred)):
+                transferred[pid] = preferred
+        return transferred
 
     def known_leader(self, partition_id: int) -> str | None:
         """Leader member for a partition: local raft knowledge first, then
@@ -669,7 +713,8 @@ class InProcessCluster:
                  replication_factor: int = 3,
                  directory: str | Path | None = None,
                  exporters_factory: Callable[[], dict[str, Any]] | None = None,
-                 snapshot_period_ms: int = 5 * 60 * 1000) -> None:
+                 snapshot_period_ms: int = 5 * 60 * 1000,
+                 durable_state: bool = False) -> None:
         from zeebe_tpu.testing import ControlledClock
 
         self._tmp = None
@@ -686,6 +731,7 @@ class InProcessCluster:
                 node_id=m, partition_count=partition_count,
                 replication_factor=replication_factor, cluster_members=members,
                 snapshot_period_ms=snapshot_period_ms,
+                durable_state=durable_state,
             )
             self.brokers[m] = Broker(
                 cfg, self.net.join(m), directory=self.directory / m,

@@ -89,6 +89,8 @@ _ENV_BINDINGS: dict[str, tuple[str, str, Any]] = {
         "base", "kernel_backend", lambda v: v.lower() in ("1", "true", "yes")),
     "ZEEBE_BROKER_EXPERIMENTAL_KERNELMESHSHARDS": (
         "base", "kernel_mesh_shards", int),
+    "ZEEBE_BROKER_EXPERIMENTAL_DURABLESTATE": (
+        "base", "durable_state", lambda v: v.lower() in ("1", "true", "yes")),
 }
 
 

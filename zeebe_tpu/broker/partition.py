@@ -99,6 +99,7 @@ class ZeebePartition:
         on_jobs_available=None,
         kernel_backend_enabled: bool = True,
         mesh_runner=None,
+        durable_state: bool = False,
     ) -> None:
         self.partition_id = partition_id
         self.partition_count = partition_count
@@ -119,6 +120,7 @@ class ZeebePartition:
         self.on_jobs_available = on_jobs_available
         self.kernel_backend_enabled = kernel_backend_enabled
         self.mesh_runner = mesh_runner
+        self.durable_state = durable_state
         # client-ingress backpressure (CommandRateLimiter | None) and the
         # disk-monitor pause flag; both gate client_write only — follow-ups,
         # scheduled commands, and inter-partition traffic always pass
@@ -264,11 +266,55 @@ class ZeebePartition:
             )
 
     def _recover_db(self) -> None:
-        """StateControllerImpl.recover: latest valid snapshot → runtime db."""
+        """StateControllerImpl.recover: latest valid snapshot → runtime db.
+
+        Durable mode: the on-disk delta log (state/durable.py) recovers to
+        its last checkpoint in O(bytes); a full snapshot from the store only
+        overrides it when NEWER (a received raft INSTALL persisted one)."""
+        if self.durable_state:
+            from zeebe_tpu.state import ColumnFamilyCode
+            from zeebe_tpu.state.durable import DurableZbDb
+
+            if isinstance(self.db, DurableZbDb):
+                self.db.close()
+            db = DurableZbDb.open(self.directory / "state",
+                                  consistency_checks=self.consistency_checks)
+            snapshot = self.snapshot_store.latest_snapshot()
+            if snapshot is not None:
+                try:
+                    state_bin = snapshot.read_file("state.bin")
+                except (FileNotFoundError, OSError):
+                    state_bin = None  # durable-marker snapshot: disk is current
+                if state_bin is not None:
+                    snap_processed = unpackb(
+                        snapshot.read_file("meta.bin")).get("lastProcessed", -1)
+                    durable_processed = db.committed_get(
+                        ColumnFamilyCode.LAST_PROCESSED_POSITION, ("last",))
+                    if snap_processed > (durable_processed
+                                         if durable_processed is not None else -1):
+                        db.install_snapshot_bytes(state_bin)
+            self.db = db
+            return
         snapshot = self.snapshot_store.latest_snapshot()
         if snapshot is not None:
+            try:
+                state_bin = snapshot.read_file("state.bin")
+            except (FileNotFoundError, OSError):
+                state_bin = None
+            if state_bin is None:
+                # durable-marker snapshot (taken while the DURABLESTATE flag
+                # was on) with the flag now OFF: recover from the durable
+                # disk this once — the next snapshot writes state.bin and
+                # the migration back to in-memory completes (flag must stay
+                # reversible; reference config flags are)
+                from zeebe_tpu.state.durable import DurableZbDb
+
+                self.db = DurableZbDb.open(
+                    self.directory / "state",
+                    consistency_checks=self.consistency_checks)
+                return
             self.db = ZbDb.from_snapshot_bytes(
-                snapshot.read_file("state.bin"),
+                state_bin,
                 consistency_checks=self.consistency_checks,
             )
         else:
@@ -389,7 +435,15 @@ class ZeebePartition:
             )
         except Exception:
             return False  # not newer than the latest snapshot
-        transient.write_file("state.bin", self.db.to_snapshot_bytes())
+        if self.durable_state:
+            # O(delta): fsync the durable delta log + manifest; the snapshot
+            # entry only carries bookkeeping (positions for recovery-ordering
+            # and the raft compaction boundary) — reference: RocksDB
+            # checkpoints are hard links, not value copies
+            manifest = self.db.checkpoint()
+            transient.write_file("durable.bin", packb({"manifest": manifest}))
+        else:
+            transient.write_file("state.bin", self.db.to_snapshot_bytes())
         transient.write_file("meta.bin", packb({
             "lastProcessed": processed,
             "lastPosition": self.stream.last_position,
@@ -442,9 +496,13 @@ class ZeebePartition:
             # (not the current term) or _entry_term answers wrongly at the
             # boundary and replication backs up into a needless snapshot install
             boundary_term = self.raft.entry_term(compact_index - 1)
+            # durable mode: no state.bin exists and the install payload is
+            # built LIVE by the snapshot_provider — pass None so raft skips
+            # the send entirely when the provider declines (b"" would ship a
+            # torn install: journal reset + unpackb crash on the receiver)
             self.raft.set_snapshot(
                 compact_index - 1, boundary_term,
-                self._install_payload(snapshot),
+                None if self.durable_state else self._install_payload(snapshot),
             )
         return True
 
@@ -457,6 +515,21 @@ class ZeebePartition:
         })
 
     def _provide_install_snapshot(self):
+        if self.durable_state:
+            # build the payload live from the durable store (rare path: a
+            # follower fell behind the compacted log). Meta must describe the
+            # LIVE state dump, not the last checkpoint — the receiver aligns
+            # its stream to meta.lastPosition and the state's own
+            # lastProcessed marker
+            if self.db is None or self.processor is None or self.db.in_transaction:
+                return None
+            return (self.raft.snapshot_index, self.raft.snapshot_term, packb({
+                "state": self.db.to_snapshot_bytes(),
+                "meta": packb({
+                    "lastProcessed": self.processor.last_processed_position,
+                    "lastPosition": self.stream.last_position,
+                }),
+            }))
         snapshot = self.snapshot_store.latest_snapshot()
         if snapshot is None:
             return None
@@ -500,6 +573,11 @@ class ZeebePartition:
             self.exporter_director.close()
         self.raft.close()
         self.stream_journal.close()
+        if self.durable_state and self.db is not None:
+            from zeebe_tpu.state.durable import DurableZbDb
+
+            if isinstance(self.db, DurableZbDb):
+                self.db.close()
 
     def latest_checkpoint_id(self) -> int:
         """Lock-free: read by OTHER partitions' ownership threads on every

@@ -1524,7 +1524,111 @@ static PyObject *codec_apply_state_plan(PyObject *self, PyObject *args)
     Py_RETURN_NONE;
 }
 
+/* -- durable-state base-segment indexing ---------------------------------- */
+
+static uint32_t crc32_tab[256];
+static int crc32_ready = 0;
+
+static void crc32_build(void)
+{
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        crc32_tab[i] = c;
+    }
+    crc32_ready = 1;
+}
+
+static uint32_t crc32_buf(const unsigned char *p, Py_ssize_t n)
+{
+    uint32_t c = 0xFFFFFFFFu;
+    for (Py_ssize_t i = 0; i < n; i++)
+        c = crc32_tab[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+/* index_base_segment(view, data) -> [keys in file order]
+ * Scan a durable base segment (state/durable.py layout: per entry a <HII>
+ * header = key len, value len, key crc — then key bytes, then the cold
+ * slice [value crc u32 | value bytes]). Key crcs verify eagerly; values
+ * install as raw zero-copy memoryview slices of the caller's mmap view
+ * (crc-checked lazily at resolution, _resolve_view). A torn or corrupt
+ * entry truncates the scan (journal discipline). File order == sorted. */
+static PyObject *codec_index_base_segment(PyObject *self, PyObject *args)
+{
+    PyObject *view, *data;
+    if (!PyArg_ParseTuple(args, "OO", &view, &data))
+        return NULL;
+    if (!PyDict_CheckExact(data)) {
+        PyErr_SetString(PyExc_TypeError, "data must be a dict");
+        return NULL;
+    }
+    Py_buffer buf;
+    if (PyObject_GetBuffer(view, &buf, PyBUF_SIMPLE) < 0)
+        return NULL;
+    if (!crc32_ready)
+        crc32_build();
+    const unsigned char *p = (const unsigned char *)buf.buf;
+    Py_ssize_t n = buf.len;
+    PyObject *keys = PyList_New(0);
+    if (!keys) {
+        PyBuffer_Release(&buf);
+        return NULL;
+    }
+    Py_ssize_t off = 0;
+    while (off + 10 <= n) {
+        uint16_t klen = (uint16_t)(p[off] | (p[off + 1] << 8));
+        uint32_t vlen = (uint32_t)p[off + 2] | ((uint32_t)p[off + 3] << 8)
+            | ((uint32_t)p[off + 4] << 16) | ((uint32_t)p[off + 5] << 24);
+        uint32_t kcrc = (uint32_t)p[off + 6] | ((uint32_t)p[off + 7] << 8)
+            | ((uint32_t)p[off + 8] << 16) | ((uint32_t)p[off + 9] << 24);
+        Py_ssize_t kstart = off + 10;
+        Py_ssize_t vstart = kstart + klen; /* [vcrc|value] slice start */
+        Py_ssize_t vend = vstart + 4 + (Py_ssize_t)vlen;
+        if (vend > n)
+            break;
+        if (crc32_buf(p + kstart, klen) != kcrc)
+            break;
+        PyObject *key = PyBytes_FromStringAndSize((const char *)p + kstart, klen);
+        if (!key)
+            goto fail;
+        /* zero-copy cold slice narrowed to [vcrc|value]. obj stays NULL:
+         * the view does NOT pin the mmap — DurableZbDb owns the map for
+         * the db's lifetime (self._maps) and drops _data before unmapping,
+         * and cold views never escape the db (every read path resolves
+         * them to fresh objects). This keeps indexing to ONE allocation
+         * per value. */
+        Py_buffer vb = buf;
+        vb.obj = NULL;
+        vb.buf = (char *)buf.buf + vstart;
+        vb.len = vend - vstart;
+        PyObject *vview = PyMemoryView_FromBuffer(&vb);
+        if (!vview) {
+            Py_DECREF(key);
+            goto fail;
+        }
+        if (PyDict_SetItem(data, key, vview) < 0
+            || PyList_Append(keys, key) < 0) {
+            Py_DECREF(vview);
+            Py_DECREF(key);
+            goto fail;
+        }
+        Py_DECREF(vview);
+        Py_DECREF(key);
+        off = vend;
+    }
+    PyBuffer_Release(&buf);
+    return keys;
+fail:
+    PyBuffer_Release(&buf);
+    Py_DECREF(keys);
+    return NULL;
+}
+
 static PyMethodDef codec_methods[] = {
+    {"index_base_segment", codec_index_base_segment, METH_VARARGS,
+     "Index a durable-state base segment: keys eager, values as lazy cold slices."},
     {"stamp_batch", codec_stamp_batch, METH_VARARGS,
      "Stamp record positions and the batch timestamp into a pre-serialized burst."},
     {"pack_fingerprint", codec_pack_fingerprint, METH_VARARGS,

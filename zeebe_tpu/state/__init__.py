@@ -8,6 +8,7 @@ from zeebe_tpu.state.db import (
     ZbDbInconsistentError,
     encode_key,
 )
+from zeebe_tpu.state.durable import DurableZbDb
 from zeebe_tpu.state.snapshot import (
     FileBasedSnapshotStore,
     InvalidSnapshotError,
@@ -20,6 +21,7 @@ from zeebe_tpu.state.snapshot import (
 __all__ = [
     "ColumnFamily",
     "ColumnFamilyCode",
+    "DurableZbDb",
     "FileBasedSnapshotStore",
     "InvalidSnapshotError",
     "PersistedSnapshot",

@@ -248,7 +248,7 @@ class Transaction:
         val = self._reads.get(key, _MISSING_READ)
         if val is not _MISSING_READ:
             return val
-        val = self._db._data.get(key)
+        val = self._db._committed_value(key)
         # only containers are copied (and cached): scalars are immutable, and
         # index scans over None/int values must stay allocation-free
         t = type(val)
@@ -294,11 +294,11 @@ class Transaction:
         mid-iteration.
         """
         db = self._db
-        if _iterate_snapshot is not None:
+        if db._native_iterate is not None:
             # one native merge pass (codec.c iterate_snapshot) — identical
             # semantics to the Python path below, including the defensive
             # copy-and-cache of committed container values
-            return iter(_iterate_snapshot(
+            return iter(db._native_iterate(
                 db._sorted_keys, db._data, prefix, self._sorted_writes,
                 self._writes, _DELETED, self._reads))
         snapshot: list[tuple[bytes, Any]] = []
@@ -326,11 +326,12 @@ class Transaction:
 
     def commit(self) -> None:
         db = self._db
-        if _commit_overlay is not None:
+        db._pre_commit(self._writes)
+        if db._native_commit is not None:
             # one native pass (codec.c commit_overlay) applying the overlay
             # to the committed dict + sorted-keys list — identical semantics
             # to the per-key loop below
-            _commit_overlay(self._writes, db._data, db._sorted_keys, _DELETED)
+            db._native_commit(self._writes, db._data, db._sorted_keys, _DELETED)
         else:
             for key, val in self._writes.items():
                 if val is _DELETED:
@@ -437,8 +438,24 @@ class ZbDb:
         self._txn: Transaction | None = None
         self.consistency_checks = consistency_checks
         self._foreign_key_checkers: dict[ColumnFamilyCode, Callable[["ZbDb", Any], None]] = {}
+        # subclass hooks: the durable backend (state/durable.py) swaps the
+        # native iterate/commit out (its cold values need per-read
+        # resolution and its key index is a blocked sorted structure, not
+        # the flat list the C pass mutates) and journals commit overlays
+        # through _pre_commit
+        self._native_iterate = _iterate_snapshot
+        self._native_commit = _commit_overlay
 
     # -- committed-store internals ------------------------------------------
+
+    def _committed_value(self, key: bytes) -> Any:
+        """Committed read hook — overridden by backends whose stored
+        representation needs resolving (durable cold values)."""
+        return self._data.get(key)
+
+    def _pre_commit(self, writes: dict[bytes, Any]) -> None:
+        """Called with the overlay just before it applies — the durable
+        backend appends it to the write-ahead delta log here."""
 
     def _put_committed(self, key: bytes, value: Any) -> None:
         if key not in self._data:
@@ -461,7 +478,12 @@ class ZbDb:
     # -- transactions --------------------------------------------------------
 
     def transaction(self) -> "_TxnContext":
+        self._before_transaction()
         return _TxnContext(self)
+
+    def _before_transaction(self) -> None:
+        """Hook before a transaction opens — the durable backend finishes
+        lazy recovery (base-segment indexing) here."""
 
     def committed_get(self, code: ColumnFamilyCode, key_parts: tuple) -> Any:
         """Lock-free point read of the COMMITTED store, bypassing the single
