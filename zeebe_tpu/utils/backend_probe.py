@@ -14,27 +14,32 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import time
 
 #: one shared timeout so all drivers agree on whether the backend is up
 PROBE_TIMEOUT_SECS = 240
 
 
-def probe_default_backend(
+def probe_with_diagnostics(
     cwd: str | None = None, timeout: int = PROBE_TIMEOUT_SECS
-) -> tuple[str, int] | None:
-    """(platform, device_count) of the default jax backend, or None.
+) -> tuple[tuple[str, int] | None, dict]:
+    """((platform, device_count) | None, diagnostics) of the default backend.
 
-    None means the backend did not come up inside ``timeout`` (wedged tunnel)
-    or the probe subprocess failed — callers must pin the CPU platform before
-    their first in-process backend use. A ``("cpu", n)`` result may reflect
-    ``JAX_PLATFORMS=cpu`` / ``--xla_force_host_platform_device_count`` in the
-    inherited env; callers that need *real* chips must check the platform,
-    not just the count.
+    THE probe implementation — every other entry point delegates here.
+    None means the backend did not come up inside ``timeout`` (wedged
+    tunnel) or the probe subprocess failed — callers must pin the CPU
+    platform before their first in-process backend use. The diagnostics
+    dict carries the failure evidence (rc, stderr tail, elapsed) so bench
+    runs can record WHY the tunnel was unreachable, not just that it was.
+
+    A ``("cpu", n)`` result may reflect ``JAX_PLATFORMS=cpu`` /
+    ``--xla_force_host_platform_device_count`` in the inherited env — that
+    case short-circuits without a subprocess (the TPU plugin on this host
+    ignores the env var and would hang; only
+    ``jax.config.update('jax_platforms', 'cpu')`` truly pins it). Callers
+    that need *real* chips must check the platform, not just the count.
     """
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        # caller already pinned cpu; don't burn the timeout on a subprocess
-        # (the TPU plugin on this host ignores the env var and would hang —
-        # only jax.config.update('jax_platforms', 'cpu') truly pins it)
         flags = os.environ.get("XLA_FLAGS", "")
         count = 1
         for flag in flags.split():
@@ -43,20 +48,69 @@ def probe_default_backend(
                     count = int(flag.split("=", 1)[1])
                 except ValueError:
                     pass
-        return "cpu", count
+        return ("cpu", count), {"outcome": "env-pinned-cpu"}
     code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+    t0 = time.monotonic()
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code],
             timeout=timeout, capture_output=True, text=True,
             cwd=cwd, env=dict(os.environ),
         )
-        if proc.returncode != 0:
-            return None
+    except subprocess.TimeoutExpired as exc:
+        stderr = exc.stderr or b""
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode("utf-8", "replace")
+        return None, {
+            "outcome": "timeout",
+            "timeout_s": timeout,
+            "elapsed_s": round(time.monotonic() - t0, 1),
+            "stderr_tail": stderr[-800:],
+        }
+    diag = {
+        "outcome": "ok" if proc.returncode == 0 else "nonzero-exit",
+        "rc": proc.returncode,
+        "elapsed_s": round(time.monotonic() - t0, 1),
+        "stderr_tail": (proc.stderr or "")[-800:],
+    }
+    if proc.returncode != 0:
+        return None, diag
+    try:
         platform, count = proc.stdout.split()[-2:]
-        return platform, int(count)
-    except (subprocess.TimeoutExpired, ValueError, IndexError):
-        return None
+        return (platform, int(count)), diag
+    except (ValueError, IndexError):
+        diag["outcome"] = "unparseable-stdout"
+        diag["stdout_tail"] = (proc.stdout or "")[-200:]
+        return None, diag
+
+
+def probe_default_backend(
+    cwd: str | None = None, timeout: int = PROBE_TIMEOUT_SECS
+) -> tuple[str, int] | None:
+    """(platform, device_count) of the default jax backend, or None."""
+    return probe_with_diagnostics(cwd, timeout)[0]
+
+
+def probe_with_retries(
+    attempts: int = 3,
+    backoff_s: float = 20.0,
+    timeout: int = PROBE_TIMEOUT_SECS,
+    log: list | None = None,
+    cwd: str | None = None,
+) -> tuple[str, int] | None:
+    """Bounded-retry probe with backoff for the flaky tunnel (VERDICT r4
+    item 1). Each attempt's diagnostics are appended to ``log``. Returns the
+    first successful (platform, device_count), else None after ``attempts``."""
+    for i in range(attempts):
+        res, diag = probe_with_diagnostics(cwd, timeout)
+        diag["attempt"] = i + 1
+        if log is not None:
+            log.append(diag)
+        if res is not None:
+            return res
+        if i + 1 < attempts:
+            time.sleep(backoff_s)
+    return None
 
 
 def real_device_count(cwd: str | None = None,

@@ -20,15 +20,29 @@ def _host_fingerprint() -> str:
     import platform
 
     feats = ""
+    model = ""
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
-                if line.startswith("flags"):
+                if line.startswith("flags") and not feats:
                     feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                elif line.startswith("model name") and not model:
+                    model = line.split(":", 1)[1].strip()
+                if feats and model:
                     break
     except OSError:
         pass
-    raw = f"{platform.machine()}|{feats}"
+    # jaxlib version is part of the key: XLA's target-feature tuning (e.g.
+    # prefer-no-scatter) changes across releases, and a same-flags host
+    # still mis-loads entries compiled under a different tuning (observed:
+    # cpu_aot_loader "machine type doesn't match" warnings on every run)
+    try:
+        import jaxlib
+
+        jl = getattr(jaxlib, "__version__", "")
+    except Exception:  # noqa: BLE001
+        jl = ""
+    raw = f"{platform.machine()}|{model}|{feats}|jaxlib={jl}"
     return hashlib.sha256(raw.encode()).hexdigest()[:16]
 
 
