@@ -387,3 +387,122 @@ class TestForQuantFilter:
         # a later clause's SOURCE reading partial still sees results so far
         r = ev("for x in [1, 2, 3], y in (if x <= 2 then [x] else partial) return y")
         assert r == [1, 2, 1, 2]
+
+
+class TestIntervalAlgebra:
+    """First-class ranges + the 14 interval functions (DMN 1.3
+    §10.3.2.3.2; reference: camunda-feel builtin RangeBuiltinFunctions).
+    VERDICT r4 weak 8: FEEL conformance breadth."""
+
+    CASES = [
+        # (expression, expected)
+        ("before(1, 10)", True),
+        ("before(10, 1)", False),
+        ("before([1..5], [6..10])", True),
+        ("before([1..5], [5..10])", False),
+        ("before([1..5), [5..10])", True),
+        ("before(1, [2..10])", True),
+        ("before([1..5], 6)", True),
+        ("after(10, 1)", True),
+        ("after([6..10], [1..5])", True),
+        ("meets([1..5], [5..10])", True),
+        ("meets([1..5), [5..10])", False),
+        ("met by([5..10], [1..5])", True),
+        ("overlaps([1..5], [4..8])", True),
+        ("overlaps([1..5], [6..8])", False),
+        ("overlaps([1..5], [5..8])", True),
+        ("overlaps([1..5), [5..8])", False),
+        ("overlaps before([1..5], [3..8])", True),
+        ("overlaps after([3..8], [1..5])", True),
+        ("finishes(10, [1..10])", True),
+        ("finishes([5..10], [1..10])", True),
+        ("finished by([1..10], [5..10])", True),
+        ("includes([1..10], 5)", True),
+        ("includes([1..10], [4..6])", True),
+        ("during(5, [1..10])", True),
+        ("during([4..6], [1..10])", True),
+        ("starts(1, [1..10])", True),
+        ("starts([1..5], [1..10])", True),
+        ("started by([1..10], [1..5])", True),
+        ("coincides([1..5], [1..5])", True),
+        ("coincides([1..5], [1..5))", False),
+        ("coincides(4, 4)", True),
+    ]
+
+    def test_interval_functions(self):
+        for src, want in self.CASES:
+            got = parse_feel(src).evaluate({}, lambda: 0)
+            assert got == want, f"{src} -> {got!r}, want {want!r}"
+
+    def test_range_value_binding(self):
+        # a range bound through a variable still answers `in`
+        from zeebe_tpu.feel.feel import RangeVal
+
+        expr = parse_feel("x in r")
+        rng = RangeVal(10, 20, True, True)
+        assert expr.evaluate({"x": 15, "r": rng}, lambda: 0) is True
+        assert expr.evaluate({"x": 25, "r": rng}, lambda: 0) is False
+
+    def test_range_results_cannot_escape_to_variables(self):
+        # a range RESULT is not a storable variable document — eval error
+        # (resolvable incident), exactly like the pre-range behavior
+        import pytest
+
+        from zeebe_tpu.feel.feel import FeelEvalError
+
+        for src in ("[1..5]", "[[1..5], [6..9]]", "{\"r\": (1..2]}"):
+            with pytest.raises(FeelEvalError, match="range"):
+                parse_feel(src).evaluate({}, lambda: 0)
+
+    def test_leading_bracket_open_range_everywhere(self):
+        assert parse_feel("includes(]1..5], 3)").evaluate({}, lambda: 0) is True
+        assert parse_feel("includes(]1..5], 1)").evaluate({}, lambda: 0) is False
+
+    def test_misuse_raises(self):
+        import pytest
+
+        from zeebe_tpu.feel.feel import FeelEvalError
+
+        with pytest.raises(FeelEvalError):
+            parse_feel("meets(1, 2)").evaluate({}, lambda: 0)
+
+
+class TestNewListContextBuiltins:
+    def test_last_context_get_or_else_list_replace(self):
+        cases = [
+            ("last([1,2,3])", 3),
+            ("last([])", None),
+            ('get or else(null, "d")', "d"),
+            ("get or else(7, 1)", 7),
+            ('context([{"key":"a","value":1},{"key":"b","value":2}])',
+             {"a": 1, "b": 2}),
+            ("list replace([1,2,3], 2, 9)", [1, 9, 3]),
+            ("list replace([1,2,3], 9, 9)", None),
+            ('number("not a number")', None),
+            ('number("41")', 41),
+        ]
+        for src, want in cases:
+            got = parse_feel(src).evaluate({}, lambda: 0)
+            assert got == want, f"{src} -> {got!r}, want {want!r}"
+
+
+class TestRangeTernaryAndParsing:
+    def test_null_and_type_mismatch_membership_is_null(self):
+        assert parse_feel("includes([1..10], null)").evaluate({}, lambda: 0) is None
+        from zeebe_tpu.feel.feel import RangeVal
+
+        assert parse_feel("x in r").evaluate(
+            {"x": "abc", "r": RangeVal(10, 20, True, True)},
+            lambda: 0) is None
+
+    def test_open_close_range_forms_parse_everywhere(self):
+        assert parse_feel("5 in [1..5)").evaluate({}, lambda: 0) is False
+        assert parse_feel("5 in (1..5]").evaluate({}, lambda: 0) is True
+        assert parse_feel("5 in ]1..5]").evaluate({}, lambda: 0) is True
+        assert parse_feel("1 in ]1..5]").evaluate({}, lambda: 0) is False
+
+    def test_list_replace_coerced_positions(self):
+        assert parse_feel("list replace([1,2,3], 3.0, 9)").evaluate(
+            {}, lambda: 0) == [1, 2, 9]
+        assert parse_feel("list replace([1,2,3], 1.5, 9)").evaluate(
+            {}, lambda: 0) is None

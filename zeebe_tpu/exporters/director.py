@@ -226,16 +226,26 @@ class ExportersState:
 
 class ExporterDirector:
     def __init__(self, stream: LogStream, db: ZbDb,
-                 exporters: dict[str, Exporter],
+                 exporters: dict[str, "Exporter | tuple[Exporter, dict]"],
                  configurations: dict[str, dict] | None = None,
                  commit_position: Callable[[], int] | None = None) -> None:
         self.stream = stream
         self.state = ExportersState(db)
+        # an entry may be (exporter, configuration) — the shape the
+        # env-driven external-artifact loader produces (utils/external_code);
+        # normalizing HERE keeps every construction site shape-agnostic
+        configurations = dict(configurations or {})
+        normalized: dict[str, Exporter] = {}
+        for eid, entry in exporters.items():
+            if isinstance(entry, tuple):
+                normalized[eid], configurations[eid] = entry
+            else:
+                normalized[eid] = entry
         self.containers = [
             ExporterContainer(eid, exp, self.state,
-                              (configurations or {}).get(eid),
+                              configurations.get(eid),
                               partition_id=stream.partition_id)
-            for eid, exp in exporters.items()
+            for eid, exp in normalized.items()
         ]
         # committed-position supplier: records past it are not yet safe to
         # export (Raft quorum); None = everything in the log is committed

@@ -135,6 +135,29 @@ class Quant:
     cond: Any
 
 
+@dataclasses.dataclass(frozen=True, slots=True)
+class RangeVal:
+    """A first-class FEEL range value ([a..b] etc.) — the operand type of
+    the spec's interval-algebra builtins (before/after/meets/overlaps/…,
+    DMN 1.3 §10.3.2.3.2; reference: camunda-feel ValRange)."""
+
+    lo: Any
+    hi: Any
+    lo_closed: bool
+    hi_closed: bool
+
+
+def _contains_range(v: Any) -> bool:
+    t = type(v)
+    if t is RangeVal:
+        return True
+    if t is list:
+        return any(_contains_range(x) for x in v)
+    if t is dict:
+        return any(_contains_range(x) for x in v.values())
+    return False
+
+
 class FeelError(Exception):
     pass
 
@@ -193,6 +216,13 @@ _MULTIWORD = {
     ("get", "entries"): "get entries",
     ("context", "put"): "context put",
     ("context", "merge"): "context merge",
+    ("list", "replace"): "list replace",
+    ("get", "or", "else"): "get or else",
+    ("met", "by"): "met by",
+    ("overlaps", "before"): "overlaps before",
+    ("overlaps", "after"): "overlaps after",
+    ("started", "by"): "started by",
+    ("finished", "by"): "finished by",
 }
 _MULTIWORD_MAX = max(len(k) for k in _MULTIWORD)
 
@@ -344,26 +374,18 @@ class _Parser:
         return node
 
     def in_target(self) -> Any:
-        if self.at("["):
-            # could be a range [a..b] or a list [a, b, c]
-            save = self.pos
+        # only the leading-']' open-low form (]a..b]) needs special casing —
+        # [a..b], [a..b), (a..b], (a..b) all parse as first-class range
+        # literals in primary now (one grammar, one evaluation path)
+        if self.at("]"):
             self.next()
-            lo = self.expr()
-            if self.at(".."):
-                self.next()
-                hi = self.expr()
-                self.expect("]")
-                return Range(lo, hi, True, True)
-            self.pos = save
-            return self.primary()
-        if self.at("(") or self.at("]"):
-            # open ranges like (a..b) — parse as range with open bounds
-            open_lo = self.next()[1] in ("(", "]")
             lo = self.expr()
             self.expect("..")
             hi = self.expr()
             closing = self.next()[1]
-            return Range(lo, hi, not open_lo, closing == "]")
+            if closing not in ("]", ")"):
+                raise FeelParseError(f"bad range close {closing!r} in {self.src!r}")
+            return Range(lo, hi, False, closing == "]")
         return self.add_expr()
 
     def add_expr(self) -> Any:
@@ -428,14 +450,39 @@ class _Parser:
                 return Lit(_temporal.parse_temporal_literal(_unescape(text2[1:-1])))
             except TemporalParseError as exc:
                 raise FeelParseError(f"bad temporal literal in {self.src!r}: {exc}")
+        if text == "]":
+            # open-low range literal ]a..b] / ]a..b) — same value as (a..b]
+            lo = self.expr()
+            self.expect("..")
+            hi = self.expr()
+            closing = self.next()[1]
+            if closing not in ("]", ")"):
+                raise FeelParseError(f"bad range close {closing!r} in {self.src!r}")
+            return Range(lo, hi, False, closing == "]")
         if text == "(":
             node = self.expr()
+            if self.at(".."):
+                # open-low range literal (a..b] / (a..b)
+                self.next()
+                hi = self.expr()
+                closing = self.next()[1]
+                if closing not in ("]", ")"):
+                    raise FeelParseError(f"bad range close {closing!r} in {self.src!r}")
+                return Range(node, hi, False, closing == "]")
             self.expect(")")
             return node
         if text == "[":
             items = []
             if not self.at("]"):
                 items.append(self.expr())
+                if self.at(".."):
+                    # range literal [a..b] / [a..b) as a first-class value
+                    self.next()
+                    hi = self.expr()
+                    closing = self.next()[1]
+                    if closing not in ("]", ")"):
+                        raise FeelParseError(f"bad range close {closing!r} in {self.src!r}")
+                    return Range(items[0], hi, True, closing == "]")
                 while self.at(","):
                     self.next()
                     items.append(self.expr())
@@ -498,9 +545,133 @@ def _num(v: Any) -> float | int:
     return v
 
 
+def _range_contains(r: "RangeVal", p: Any) -> Any:
+    if p is None or r.lo is None or r.hi is None:
+        return None
+    try:
+        ok_lo = p >= r.lo if r.lo_closed else p > r.lo
+        ok_hi = p <= r.hi if r.hi_closed else p < r.hi
+    except TypeError:
+        return None  # type-mismatched membership is null, not a crash
+    return ok_lo and ok_hi
+
+
+def _iv_before(a, b):
+    """DMN 1.3 §10.3.2.3.2 interval algebra, point/range polymorphic."""
+    if isinstance(a, RangeVal) and isinstance(b, RangeVal):
+        return a.hi < b.lo or (a.hi == b.lo and (not a.hi_closed or not b.lo_closed))
+    if isinstance(a, RangeVal):
+        return a.hi < b or (a.hi == b and not a.hi_closed)
+    if isinstance(b, RangeVal):
+        return a < b.lo or (a == b.lo and not b.lo_closed)
+    return a < b
+
+
+def _iv_meets(a, b):
+    _iv_ranges(a, b, "meets")
+    return a.hi_closed and b.lo_closed and a.hi == b.lo
+
+
+def _iv_overlaps(a, b):
+    _iv_ranges(a, b, "overlaps")
+    left = a.hi > b.lo or (a.hi == b.lo and a.hi_closed and b.lo_closed)
+    right = a.lo < b.hi or (a.lo == b.hi and a.lo_closed and b.hi_closed)
+    return left and right
+
+
+def _iv_overlaps_before(a, b):
+    _iv_ranges(a, b, "overlaps before")
+    starts_first = a.lo < b.lo or (a.lo == b.lo and a.lo_closed and not b.lo_closed)
+    reaches = a.hi > b.lo or (a.hi == b.lo and a.hi_closed and b.lo_closed)
+    ends_first = a.hi < b.hi or (a.hi == b.hi and (not a.hi_closed or b.hi_closed))
+    return starts_first and reaches and ends_first
+
+
+def _iv_finishes(a, b):
+    _iv_range(b, "finishes")
+    if not isinstance(a, RangeVal):
+        return b.hi_closed and a == b.hi
+    return (a.hi == b.hi and a.hi_closed == b.hi_closed
+            and (a.lo > b.lo or (a.lo == b.lo and (not a.lo_closed or b.lo_closed))))
+
+
+def _iv_includes(a, b):
+    _iv_range(a, "includes")
+    if not isinstance(b, RangeVal):
+        return _range_contains(a, b)  # null point stays null (ternary logic)
+    lo_ok = b.lo > a.lo or (b.lo == a.lo and (a.lo_closed or not b.lo_closed))
+    hi_ok = b.hi < a.hi or (b.hi == a.hi and (a.hi_closed or not b.hi_closed))
+    return lo_ok and hi_ok
+
+
+def _iv_starts(a, b):
+    _iv_range(b, "starts")
+    if not isinstance(a, RangeVal):
+        return b.lo_closed and a == b.lo
+    return (a.lo == b.lo and a.lo_closed == b.lo_closed
+            and (a.hi < b.hi or (a.hi == b.hi and (not a.hi_closed or b.hi_closed))))
+
+
+def _iv_coincides(a, b):
+    if isinstance(a, RangeVal) and isinstance(b, RangeVal):
+        return (a.lo == b.lo and a.hi == b.hi
+                and a.lo_closed == b.lo_closed and a.hi_closed == b.hi_closed)
+    if isinstance(a, RangeVal) or isinstance(b, RangeVal):
+        raise FeelEvalError("coincides() needs two points or two ranges")
+    return a == b
+
+
+def _iv_range(x, fn):
+    if not isinstance(x, RangeVal):
+        raise FeelEvalError(f"{fn}() expects a range operand")
+
+
+def _iv_ranges(a, b, fn):
+    if not isinstance(a, RangeVal) or not isinstance(b, RangeVal):
+        raise FeelEvalError(f"{fn}() expects two range operands")
+
+
+def _feel_number(v):
+    """number(): null on an unparseable string (spec: conversion failure
+    yields null, not an error)."""
+    if isinstance(v, str):
+        try:
+            return float(v) if "." in v or "e" in v.lower() else int(v)
+        except ValueError:
+            return None
+    return _num(v)
+
+
 _BUILTINS: dict[str, Callable[..., Any]] = {
+    # interval algebra over points and ranges (DMN 1.3 §10.3.2.3.2)
+    "before": _iv_before,
+    "after": lambda a, b: _iv_before(b, a),
+    "meets": _iv_meets,
+    "met by": lambda a, b: _iv_meets(b, a),
+    "overlaps": _iv_overlaps,
+    "overlaps before": _iv_overlaps_before,
+    "overlaps after": lambda a, b: _iv_overlaps_before(b, a),
+    "finishes": _iv_finishes,
+    "finished by": lambda a, b: _iv_finishes(b, a),
+    "includes": _iv_includes,
+    "during": lambda a, b: _iv_includes(b, a),
+    "starts": _iv_starts,
+    "started by": lambda a, b: _iv_starts(b, a),
+    "coincides": _iv_coincides,
+    "last": lambda xs: xs[-1] if isinstance(xs, list) and xs else None,
+    "get or else": lambda v, default: default if v is None else v,
+    "context": lambda entries: {
+        e["key"]: e.get("value") for e in entries
+        if isinstance(e, dict) and "key" in e
+    } if isinstance(entries, list) else None,
+    "list replace": lambda xs, pos, new: (
+        [new if i == int(pos) - 1 else x for i, x in enumerate(xs)]
+        if isinstance(xs, list) and isinstance(pos, (int, float))
+        and not isinstance(pos, bool) and float(pos).is_integer()
+        and 1 <= int(pos) <= len(xs) else None
+    ),
     "string": lambda v: "null" if v is None else (str(v).lower() if isinstance(v, bool) else str(v)),
-    "number": lambda v: float(v) if isinstance(v, str) and "." in v else (int(v) if isinstance(v, str) else _num(v)),
+    "number": _feel_number,
     "contains": lambda s, sub: isinstance(s, str) and sub in s,
     "starts with": lambda s, p: isinstance(s, str) and s.startswith(p),
     "ends with": lambda s, p: isinstance(s, str) and s.endswith(p),
@@ -1141,22 +1312,16 @@ class Evaluator:
         return {name: self.eval(expr) for name, expr in node.entries}
 
     def _eval_Range(self, node: Range) -> Any:
-        raise FeelEvalError("range is only valid on the right of 'in'")
+        return RangeVal(self.eval(node.lo), self.eval(node.hi),
+                        node.lo_closed, node.hi_closed)
 
     def _eval_In(self, node: In) -> Any:
         needle = self.eval(node.needle)
-        target = node.haystack
-        if isinstance(target, Range):
-            lo = self.eval(target.lo)
-            hi = self.eval(target.hi)
-            if needle is None or lo is None or hi is None:
-                return None
-            ok_lo = needle >= lo if target.lo_closed else needle > lo
-            ok_hi = needle <= hi if target.hi_closed else needle < hi
-            return ok_lo and ok_hi
-        hay = self.eval(target)
+        hay = self.eval(node.haystack)
         if isinstance(hay, list):
             return needle in hay
+        if isinstance(hay, RangeVal):
+            return _range_contains(hay, needle)
         return None
 
 
@@ -1202,7 +1367,15 @@ class Expression:
     def evaluate(self, context: dict[str, Any], clock_millis: Callable[[], int] | None = None) -> Any:
         if self.is_static:
             return self.source
-        return Evaluator(context, clock_millis).eval(self.ast)
+        result = Evaluator(context, clock_millis).eval(self.ast)
+        if _contains_range(result):
+            # ranges are evaluation-internal values (interval builtins);
+            # a range RESULT cannot serialize into a variable document —
+            # fail as an eval error so callers raise a resolvable incident
+            raise FeelEvalError(
+                f"expression {self.source!r} evaluated to a range, which "
+                "cannot be stored as a variable")
+        return result
 
     def references_clock(self) -> bool:
         """True when evaluation reads the clock (now() in the AST): the value

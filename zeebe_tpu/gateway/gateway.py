@@ -637,7 +637,8 @@ class Gateway:
     def __init__(self, runtime: ClusterRuntime, bind: str = "127.0.0.1:0",
                  max_workers: int = 16,
                  auth: TenantAuthorizer | None = None,
-                 oauth: "OAuthValidator | None" = None) -> None:
+                 oauth: "OAuthValidator | None" = None,
+                 extra_interceptors: tuple = ()) -> None:
         self.runtime = runtime
         if auth is None:
             auth = TenantAuthorizer(oauth=oauth)
@@ -664,6 +665,9 @@ class Gateway:
             from zeebe_tpu.gateway.oauth import auth_server_interceptor
 
             interceptors = (auth_server_interceptor(oauth),)
+        # externally-loaded interceptors run AFTER auth, like the
+        # reference's InterceptorRepository chain (utils/external_code)
+        interceptors = interceptors + tuple(extra_interceptors or ())
         self.server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             interceptors=interceptors,

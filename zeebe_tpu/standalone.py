@@ -117,6 +117,11 @@ def main(argv: list[str] | None = None) -> int:
                 ca_file=_os.environ.get(
                     "ZEEBE_BROKER_NETWORK_SECURITY_CERTIFICATEAUTHORITYPATH"),
             )
+        from zeebe_tpu.utils.external_code import (
+            exporters_factory_from_env,
+            gateway_interceptors_from_env,
+        )
+
         runtime = TcpClusterRuntime(
             args.node_id, (host, int(port)), peers, tls=tls,
             partition_count=args.partitions,
@@ -124,10 +129,12 @@ def main(argv: list[str] | None = None) -> int:
             directory=args.data_dir,
             backup_store=backup_store_from_env(),
             kernel_backend=load_broker_cfg().base.kernel_backend,
+            exporters_factory=exporters_factory_from_env(),
         )
         runtime.start()
         gateway = Gateway(runtime, bind=f"0.0.0.0:{args.port}",
-                      oauth=_gateway_oauth())
+                      oauth=_gateway_oauth(),
+                      extra_interceptors=gateway_interceptors_from_env())
         gateway.start()
         print(f"[{args.node_id}] gateway on {gateway.address}, cluster bind "
               f"{args.bind}", file=sys.stderr, flush=True)
@@ -158,9 +165,15 @@ def main(argv: list[str] | None = None) -> int:
         overrides["base.replication_factor"] = args.replication
     from zeebe_tpu.backup import backup_store_from_env
 
+    from zeebe_tpu.utils.external_code import (
+        exporters_factory_from_env,
+        gateway_interceptors_from_env,
+    )
+
     cfg = load_broker_cfg(overrides=overrides)
     runtime = ClusterRuntime(
         backup_store=backup_store_from_env(),
+        exporters_factory=exporters_factory_from_env(),
         kernel_backend=cfg.base.kernel_backend,
         broker_count=args.brokers,
         partition_count=(args.partitions if "base.partition_count" in overrides
@@ -175,7 +188,8 @@ def main(argv: list[str] | None = None) -> int:
     )
     runtime.start()
     gateway = Gateway(runtime, bind=f"0.0.0.0:{args.port}",
-                  oauth=_gateway_oauth())
+                  oauth=_gateway_oauth(),
+                  extra_interceptors=gateway_interceptors_from_env())
     gateway.start()
     print(f"gateway listening on {gateway.address} "
           f"({args.brokers} broker(s), {runtime.partition_count} partition(s))",
