@@ -1283,9 +1283,9 @@ class TestReceiveTaskOnKernel:
 
 class TestEventSubProcessStaysSequential:
     def test_root_esp_process_parity(self):
-        """Root-level event sub-processes need scope subscriptions at PROCESS
-        activation — out of the kernel's creation materializer's scope, so
-        the definition must run sequentially (and byte-identically)."""
+        """Root-level ESP definitions now ride the kernel (the creation
+        materializer opens the start subscriptions); a TRIGGERED instance is
+        owned by the sequential path — byte parity either way."""
 
         def scenario(h):
             h.deploy(
@@ -1308,7 +1308,10 @@ class TestEventSubProcessStaysSequential:
 
         assert_equivalent(scenario)
 
-    def test_root_esp_definition_not_admitted(self):
+    def test_root_esp_definition_now_admitted(self):
+        """Fixed-duration-timer root ESPs are kernel-eligible since round 5
+        (tests/test_kernel_root_esp.py holds the parity suite); cycle-timer
+        starts still decline there."""
         h = EngineHarness(use_kernel_backend=True)
         try:
             h.deploy(
@@ -1326,7 +1329,7 @@ class TestEventSubProcessStaysSequential:
             with h.db.transaction():
                 meta = h.engine.state.processes.get_latest_by_id("espi")
             assert h.kernel_backend.registry.lookup(
-                meta["processDefinitionKey"], None) is None
+                meta["processDefinitionKey"], None) is not None
             assert drive_jobs(h, "espi_w") == 1
         finally:
             h.close()

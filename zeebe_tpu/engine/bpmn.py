@@ -772,20 +772,12 @@ class BpmnProcessor:
         # the scope instance's own context — the same one the subscription
         # open evaluates in (input mappings have already written to `key`)
         context = self.state.variables.collect(key)
-        for esp in esps:
-            start = exe.elements[esp.child_start_idx]
-            try:
-                if start.event_type == BpmnEventType.TIMER and start.timer_duration is not None:
-                    self._eval_duration_millis(start.timer_duration, context)
-                elif start.event_type == BpmnEventType.MESSAGE:
-                    ck = start.correlation_key.evaluate(context, self.clock_millis)
-                    if ck is None:
-                        raise FeelEvalError(
-                            f"correlation key of '{start.id}' evaluated to null"
-                        )
-            except (FeelEvalError, TypeError, ValueError) as exc:
-                self._raise_incident(writers, key, value, ErrorType.EXTRACT_VALUE_ERROR, str(exc))
-                return False
+        problem = self.prevalidate_scope_event_subscriptions(
+            (esp.child_start_idx for esp in esps), exe, context)
+        if problem is not None:
+            self._raise_incident(writers, key, value,
+                                 ErrorType.EXTRACT_VALUE_ERROR, problem)
+            return False
         for esp in esps:
             start = exe.elements[esp.child_start_idx]
             if start.event_type == BpmnEventType.TIMER and (
@@ -801,6 +793,29 @@ class BpmnProcessor:
             elif start.event_type == BpmnEventType.SIGNAL and start.signal_name:
                 self._open_signal_subscription(key, value, start, writers)
         return True
+
+    def prevalidate_scope_event_subscriptions(
+        self, start_idxs, exe: ExecutableProcess, context: dict,
+    ) -> str | None:
+        """Evaluate the event-sub-process start expressions that opening the
+        subscriptions will evaluate; the error message or None. ONE
+        implementation shared by the sequential open (incident on failure)
+        and kernel admission (decline on failure) so the two can never
+        diverge on what counts as valid."""
+        for sidx in start_idxs:
+            start = exe.elements[sidx]
+            try:
+                if start.event_type == BpmnEventType.TIMER and start.timer_duration is not None:
+                    self._eval_duration_millis(start.timer_duration, context)
+                elif start.event_type == BpmnEventType.MESSAGE:
+                    ck = start.correlation_key.evaluate(context, self.clock_millis)
+                    if ck is None:
+                        raise FeelEvalError(
+                            f"correlation key of '{start.id}' evaluated to null"
+                        )
+            except (FeelEvalError, TypeError, ValueError) as exc:
+                return str(exc)
+        return None
 
     def _open_signal_subscription(self, host_key: int, value: dict,
                                   catching: ExecutableElement, writers: Writers) -> None:

@@ -764,6 +764,12 @@ class _DefInfo:
     # crossing another wait state (-1 = the creation entry); admission must
     # predict those bodies' cardinalities before the group runs
     mi_reach: dict = field(default_factory=dict)
+    # ROOT-level event sub-processes (their bodies host-escape; the ROOT
+    # instance carries their start subscriptions): start-event element idxs
+    # for admission pre-validation, and the expected open-subscription counts
+    # (timers, message subs, signal subs) for reconstruction integrity
+    root_esp_start_idxs: tuple = ()
+    root_esp_waits: tuple = (0, 0, 0)
 
     def segment_of_row(self, row: int):
         """The segment whose inlined region contains ``row`` (call_row and
@@ -885,14 +891,33 @@ class KernelRegistry:
             # only message/timer starts: every creation carries an explicit
             # start element — nothing for the kernel's entry path to run
             return None
-        if exe.event_sub_processes_of(0):
-            # root-level event sub-processes open start-event subscriptions
-            # during PROCESS activation and their triggers interrupt root
-            # scope state — neither the creation materializer nor the
-            # reconstruction collects that, so these definitions stay
-            # sequential end to end (nested-scope ESPs already force their
-            # sub-process host-side via element eligibility)
-            return None
+        root_esp_start_idxs: list[int] = []
+        esp_timers = esp_msgs = esp_signals = 0
+        for esp in exe.event_sub_processes_of(0):
+            # root ESP bodies host-escape (their rows are outside the device
+            # subset), but the DEFINITION rides the kernel: the creation
+            # materializer opens the start subscriptions via the sequential
+            # behavior verbatim, reconstruction counts them as root wait
+            # state, and triggers route sequentially (a live ESP instance
+            # makes resumes decline until it drains). Only subscription
+            # shapes the reconstruction can count are eligible.
+            start = exe.elements[esp.child_start_idx]
+            if start.event_type in (BpmnEventType.ERROR,
+                                    BpmnEventType.ESCALATION):
+                pass  # stateless: triggered via _find_catcher at throw time
+            elif (start.event_type == BpmnEventType.TIMER
+                  and start.timer_duration is not None
+                  and start.timer_cycle is None and start.timer_date is None):
+                esp_timers += 1
+            elif (start.event_type == BpmnEventType.MESSAGE
+                  and start.message_name):
+                esp_msgs += 1
+            elif (start.event_type == BpmnEventType.SIGNAL
+                  and start.signal_name):
+                esp_signals += 1
+            else:
+                return None  # cycle/date timers: sequential end to end
+            root_esp_start_idxs.append(esp.child_start_idx)
         try:
             solo = compile_tables([exe], host_idxs=[host])
         except ConditionNotCompilable:
@@ -943,6 +968,8 @@ class KernelRegistry:
             mi_inner=mi_inner,
             mi_reach=(_mi_burst_reach(exe, solo.kernel_op[0], mi_inner)
                       if mi_inner else {}),
+            root_esp_start_idxs=tuple(root_esp_start_idxs),
+            root_esp_waits=(esp_timers, esp_msgs, esp_signals),
         )
 
     def _compile_shared(self) -> ProcessTables:
@@ -1219,6 +1246,9 @@ class KernelBackend:
             # a condition could read a variable whose runtime type the device
             # slot kind cannot represent: host and device would disagree
             return None
+        if info.root_esp_start_idxs and not self._esp_exprs_admit(
+                info, variables):
+            return None  # sequential path raises the proper incident
         mi_cards: dict[int, int] = {}
         if info.mi_inner:
             needed = info.mi_reach.get(-1, ())
@@ -1298,9 +1328,14 @@ class KernelBackend:
             return None
         exe = info.exe
         tokens: list[_Token] = []
+        root_wait_docs: list = []
+        root_wait_keys: list[int] = []
+        if not self._root_esp_waits_ok(info, pi_key, root_wait_docs,
+                                       root_wait_keys):
+            return None
         resume: _Token | None = None
-        wait_docs: list = []
-        wait_keys: list[int] = []
+        wait_docs: list = list(root_wait_docs)
+        wait_keys: list[int] = list(root_wait_keys)
         family: list[int] = []  # call-child process instance keys
         mi_parked: dict[int, int | None] = {}  # K_MI body row → live inner lc
         # elem idx of a scope (0 = process root) → its instance key: join
@@ -1431,6 +1466,37 @@ class KernelBackend:
             return None
         return (tokens, resume, root, wait_docs, wait_keys, scope_keys,
                 join_counts, family, mi_parked)
+
+    def _esp_exprs_admit(self, info: _DefInfo, variables: dict) -> bool:
+        """Pre-validate root event-sub-process start expressions over the
+        creation variables (the same values _open_scope_event_subscriptions
+        will read from the seeded root scope) — THE SAME shared helper the
+        sequential open uses, so admission and emission cannot diverge; an
+        eval failure takes the sequential path for the engine's own
+        incident shape."""
+        return self.engine.bpmn.prevalidate_scope_event_subscriptions(
+            info.root_esp_start_idxs, info.exe, variables) is None
+
+    def _root_esp_waits_ok(self, info: _DefInfo, pi_key: int,
+                           wait_docs: list, wait_keys: list) -> bool:
+        """Root ESP start subscriptions must ALL be open on the process
+        instance — anything less means a trigger owns the instance right now
+        (mirror of _collect_wait_states for the root scope)."""
+        expected_timers, expected_subs, expected_signals = info.root_esp_waits
+        if not (expected_timers or expected_subs or expected_signals):
+            return True
+        state = self.engine.state
+        timers = state.timers.timers_for_element_instance(pi_key)
+        subs = state.process_message_subscriptions.subscriptions_of(pi_key)
+        signals = state.signal_subscriptions.subscriptions_of(pi_key)
+        if (len(timers) != expected_timers or len(subs) != expected_subs
+                or len(signals) != expected_signals):
+            return False
+        wait_docs.extend(t for _k, t in timers)
+        wait_keys.extend(k for k, _t in timers)
+        wait_docs.extend(subs)
+        wait_docs.extend(signals)
+        return True
 
     def _collect_wait_states(self, info: _DefInfo, el_idx: int, child_key: int,
                              wait_docs: list, wait_keys: list) -> bool:
@@ -2506,6 +2572,16 @@ class KernelBackend:
         process_el = exe.root
         value = _pi_value(dict(activate_cmd.record.value), process_el)
         writers.append_event(inst.pi_key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATING, value)
+        if inst.info.root_esp_start_idxs:
+            # root event-sub-process start subscriptions open between
+            # ACTIVATING and ACTIVATED — the sequential behavior runs
+            # verbatim (byte parity by construction). A pre-validation
+            # failure (admission raced a variable change — can't happen for
+            # creations, defensive) leaves the root ACTIVATING with the
+            # incident written, same as the sequential path.
+            if not engine.bpmn._open_scope_event_subscriptions(
+                    inst.pi_key, value, exe, process_el, writers):
+                return
         writers.append_event(inst.pi_key, ValueType.PROCESS_INSTANCE, PI.ELEMENT_ACTIVATED, value)
         # ACTIVATE(start) — mirror BpmnProcessor._write_activate
         start = exe.elements[exe.none_start_of(0)]
@@ -3047,6 +3123,10 @@ class KernelBackend:
         value = _pi_value(dict(root["value"]), process_el)
         writers.append_event(inst.pi_key, ValueType.PROCESS_INSTANCE,
                              PI.ELEMENT_COMPLETING, value)
+        if inst.info.root_esp_start_idxs:
+            # mirror _complete: root ESP start subscriptions close when the
+            # process leaves ACTIVATED
+            bpmn._close_subscriptions(inst.pi_key, value, writers)
         child_locals = state.variables.locals_of(inst.pi_key)
         writers.append_event(inst.pi_key, ValueType.PROCESS_INSTANCE,
                              PI.ELEMENT_COMPLETED, value)
