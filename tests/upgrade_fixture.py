@@ -120,13 +120,42 @@ def _models():
         .move_to_element("gw").default_flow().end_event("ed")
         .done()
     )
+    # round-5 shapes: link events (throw jumps to the same-scope catch) and
+    # a root-level event sub-process definition (start subscription on the
+    # process instance) — future rounds must replay their records and
+    # reconstruct their state shapes
+    link = (
+        Bpmn.create_executable_process("up_link")
+        .start_event("s")
+        .service_task("before", job_type="up_link_a")
+        .intermediate_throw_link("jump", "L")
+        .intermediate_catch_link("land", "L")
+        .service_task("after", job_type="up_link_b")
+        .end_event("e").done()
+    )
+    esp_root = (
+        Bpmn.create_executable_process("up_esp")
+        .start_event("s")
+        .service_task("work", job_type="up_esp_w")
+        .end_event("e")
+        .event_sub_process("esp")
+        .message_start_event("ms", "up_alarm", correlation_key="= key")
+        .end_event("esp_e")
+        .sub_process_done()
+        .done()
+    )
     return [one_task, timer_wait, msg_wait, sub_bnd, io_chain, nomatch,
-            mi_par, mi_seq, call_child, caller, incl]
+            mi_par, mi_seq, call_child, caller, incl, link, esp_root]
 
 
 def run_scenario(h) -> dict:
     """Drive the breadth scenario; returns the expected.json payload."""
     h.deploy(*_models())
+    # a short-TTL message expires during the build: the frozen log then
+    # carries a MESSAGE_BATCH EXPIRED record (round-5 batched expiry) that
+    # every future round must replay
+    h.publish_message("up_ephemeral", "gone", ttl=1_000)
+    h.advance_time(1_100)
     done_keys = []
     for i in range(2):  # completed end to end
         k = h.create_instance("one_task", variables={"i": i})
@@ -144,6 +173,17 @@ def run_scenario(h) -> dict:
     running[h.create_instance("mi_seq", variables={"items": ["a", "b"]})] = "mi_seq"
     running[h.create_instance("up_caller")] = "up_caller"
     running[h.create_instance("up_incl", variables={"a": 1, "b": 1})] = "up_incl"
+    # link events: one instance COMPLETES during the build (link lifecycle
+    # records land in the frozen log), one parks mid-flight before the jump
+    done_link = h.create_instance("up_link")
+    for job in h.activate_jobs("up_link_a", max_jobs=5):
+        h.complete_job(job["key"])
+    for job in h.activate_jobs("up_link_b", max_jobs=5):
+        h.complete_job(job["key"])
+    done_keys.append(done_link)
+    running[h.create_instance("up_link")] = "up_link"
+    # root-ESP instance: parked with its start subscription open on the root
+    running[h.create_instance("up_esp", variables={"key": "esp-k"})] = "up_esp"
     incident_key = h.create_instance("nomatch", variables={"x": 1})
     return {
         "tag_clock_millis": h.clock(),
@@ -152,7 +192,8 @@ def run_scenario(h) -> dict:
         "incident_instance": incident_key,
         "pending_jobs": {"up_work": 2, "up_inner": 1, "up_io": 1,
                          "up_mi": 3, "up_mi_seq": 1, "up_child": 1,
-                         "up_inc": 2},
+                         "up_inc": 2, "up_link_a": 1, "up_link_b": 0,
+                         "up_esp_w": 1},
         # job types that respawn after completion (sequential MI): the drive
         # test keeps completing until the type is silent
         "drain_loop_types": ["up_mi_seq"],

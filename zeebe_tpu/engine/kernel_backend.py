@@ -1328,14 +1328,11 @@ class KernelBackend:
             return None
         exe = info.exe
         tokens: list[_Token] = []
-        root_wait_docs: list = []
-        root_wait_keys: list[int] = []
-        if not self._root_esp_waits_ok(info, pi_key, root_wait_docs,
-                                       root_wait_keys):
-            return None
         resume: _Token | None = None
-        wait_docs: list = list(root_wait_docs)
-        wait_keys: list[int] = list(root_wait_keys)
+        wait_docs: list = []
+        wait_keys: list[int] = []
+        if not self._root_esp_waits_ok(info, pi_key, wait_docs, wait_keys):
+            return None
         family: list[int] = []  # call-child process instance keys
         mi_parked: dict[int, int | None] = {}  # K_MI body row → live inner lc
         # elem idx of a scope (0 = process root) → its instance key: join
