@@ -1897,3 +1897,61 @@ class TestWidenedSafeMappings:
             assert drive_jobs(h, "wm2") == 1
         finally:
             h.close()
+
+
+class TestSignalBoundaryEligibility:
+    """Signal boundaries no longer force their host task off the kernel
+    (round 5 eligibility widening; signal subscriptions count in the
+    reconstruction integrity check like timers and message subs).
+    Escalation boundaries stay host-side: they only fire from child scopes,
+    whose hosts are outside the K_TASK reconstruction anyway."""
+
+    @staticmethod
+    def _signal_bnd(pid="sig_bnd"):
+        return (
+            Bpmn.create_executable_process(pid)
+            .start_event("s")
+            .service_task("work", job_type="sb_w")
+            .boundary_signal("bs", attached_to="work",
+                             signal_name="halt", interrupting=True)
+            .end_event("be")
+            .move_to_element("work")
+            .end_event("e")
+            .done()
+        )
+
+    def test_signal_boundary_task_rides_kernel(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(self._signal_bnd())
+            for i in range(6):
+                h.create_instance("sig_bnd", {"n": i}, request_id=300 + i)
+            k = h.kernel_backend
+            assert k.commands_processed >= 6, dict(k.fallback_reasons)
+            before = k.commands_processed
+            for job in h.activate_jobs("sb_w", max_jobs=10):
+                h.complete_job(job["key"])
+            # resumes reconstruct (signal sub counted) and ride the kernel
+            assert k.commands_processed > before, dict(k.fallback_reasons)
+        finally:
+            h.close()
+
+    def test_signal_boundary_untriggered_parity(self):
+        def scenario(h):
+            h.deploy(self._signal_bnd())
+            for i in range(5):
+                h.create_instance("sig_bnd", {"n": i}, request_id=320 + i)
+            drive_jobs(h, "sb_w")
+
+        assert_equivalent(scenario)
+
+    def test_signal_boundary_triggered_parity(self):
+        def scenario(h):
+            h.deploy(self._signal_bnd())
+            h.create_instance("sig_bnd", request_id=340)
+            h.create_instance("sig_bnd", request_id=341)
+            jobs = h.activate_jobs("sb_w", max_jobs=5)
+            h.complete_job(jobs[0]["key"])  # one completes normally
+            h.broadcast_signal("halt")      # the other's boundary interrupts
+
+        assert_equivalent(scenario)

@@ -68,8 +68,10 @@ class _Gen:
         if depth >= 3:
             return self.task(b)
         roll = rng.random()
-        if roll < 0.38:
+        if roll < 0.34:
             return self.task(b)
+        if roll < 0.38:
+            return self.link_jump(b)
         if roll < 0.46:
             return self.catch_event(b)
         if roll < 0.56:
@@ -112,6 +114,13 @@ class _Gen:
         b = b.end_event(self.next_id("se"))
         return b.sub_process_done()
 
+    def link_jump(self, b):
+        """A throw link jumping to its same-scope catch (round-5 shape):
+        rides the kernel as a synthetic K_PASS edge with no sequence flow."""
+        name = self.next_id("lnk")
+        b = b.intermediate_throw_link(self.next_id("lt"), name)
+        return b.intermediate_catch_link(self.next_id("lc"), name)
+
     def catch_event(self, b):
         """A timer, message, or signal intermediate catch (all ride the
         kernel's K_CATCH park path; resumes differ per kind)."""
@@ -151,16 +160,24 @@ class _Gen:
         rng = self.rng
         bid = self.next_id("bnd")
         interrupting = rng.random() < 0.5
-        if rng.random() < 0.5:
+        kind = rng.random()
+        if kind < 0.4:
             self.has_timers = True
             b = b.boundary_timer(bid, attached_to=tid, duration="PT5S",
                                  interrupting=interrupting)
-        else:
+        elif kind < 0.75:
             name = f"msg_{self.next_id('bm')}"
             self.messages.add(name)
             b = b.boundary_message(bid, attached_to=tid, message_name=name,
                                    correlation_key="mkey",
                                    interrupting=interrupting)
+        else:
+            # round-5 eligibility: signal boundaries count in the
+            # reconstruction integrity check like timers/messages
+            name = f"sig_{self.next_id('bs')}"
+            self.signals.add(name)
+            b = b.boundary_signal(bid, attached_to=tid, signal_name=name,
+                                  interrupting=interrupting)
         b = self.task(b)
         b = b.end_event(self.next_id("be"))
         return b.move_to_element(tid)

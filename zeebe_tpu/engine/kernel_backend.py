@@ -285,8 +285,15 @@ def check_element_eligibility(exe: ExecutableProcess, el: ExecutableElement) -> 
             return el.timer_duration is not None and el.timer_date is None
         if el.event_type == BpmnEventType.MESSAGE:
             return el.message_name is not None
+        if el.event_type == BpmnEventType.SIGNAL:
+            # signal subscriptions count in the reconstruction integrity
+            # check like timers/messages (boundary_waits third slot)
+            return el.signal_name is not None
         # error boundaries carry no wait state at all (the job THROW_ERROR
-        # command routes through _find_catcher on the host)
+        # command routes through _find_catcher on the host). Escalation
+        # boundaries only fire from a CHILD SCOPE (call activity /
+        # sub-process host) — and scope hosts fail the K_TASK host check
+        # below anyway, so admitting them here would be dead eligibility
         return el.event_type == BpmnEventType.ERROR
     if el.boundary_idxs:
         # boundary wait-state reconstruction is implemented for parked
@@ -751,7 +758,7 @@ class _DefInfo:
     join_idxs: list[int]  # element idxs of K_JOIN gateways
     # task element idx → (# timer boundaries, # message boundaries) expected
     # open while the task is parked (reconstruction integrity check)
-    boundary_waits: dict[int, tuple[int, int]]
+    boundary_waits: dict[int, tuple[int, int, int]]
     # element idxs lowered to K_HOST in the solo compile (forced again in
     # shared recompiles so the lowering stays stable across registrations)
     host_idxs: frozenset[int] = frozenset()
@@ -938,22 +945,25 @@ class KernelRegistry:
             el.idx for el in exe.elements[1:]
             if solo.kernel_op[0, el.idx] == K_HOST
         )
-        boundary_waits: dict[int, tuple[int, int]] = {}
+        boundary_waits: dict[int, tuple[int, int, int]] = {}
         for el in exe.elements[1:]:
             if solo.kernel_op[0, el.idx] == K_TASK and el.boundary_idxs:
                 bs = [exe.elements[b] for b in el.boundary_idxs]
                 boundary_waits[el.idx] = (
                     sum(1 for b in bs if b.timer_duration is not None),
                     sum(1 for b in bs if b.message_name is not None),
+                    sum(1 for b in bs if b.signal_name is not None),
                 )
             elif (el.element_type == BpmnElementType.EVENT_BASED_GATEWAY
                   and el.idx not in effective_host):
                 # an event-based gateway's wait states live on its own
-                # instance, one per succeeding catch event
+                # instance, one per succeeding catch event (never signals:
+                # gateway eligibility only admits timer/message targets)
                 ts = [exe.elements[exe.flows[f].target_idx] for f in el.outgoing]
                 boundary_waits[el.idx] = (
                     sum(1 for t in ts if t.timer_duration is not None),
                     sum(1 for t in ts if t.message_name is not None),
+                    0,
                 )
         return _DefInfo(
             index=index,
@@ -1502,17 +1512,21 @@ class KernelBackend:
         ``child_key``, appending their records to ``wait_docs`` and the
         timers' minted keys to ``wait_keys``. False means a trigger is
         mid-flight and the instance is not reconstructable."""
-        expected_timers, expected_subs = info.boundary_waits.get(el_idx, (0, 0))
-        if not (expected_timers or expected_subs):
+        expected_timers, expected_subs, expected_signals = (
+            info.boundary_waits.get(el_idx, (0, 0, 0)))
+        if not (expected_timers or expected_subs or expected_signals):
             return True
         state = self.engine.state
         timers = state.timers.timers_for_element_instance(child_key)
         subs = state.process_message_subscriptions.subscriptions_of(child_key)
-        if len(timers) != expected_timers or len(subs) != expected_subs:
+        signals = state.signal_subscriptions.subscriptions_of(child_key)
+        if (len(timers) != expected_timers or len(subs) != expected_subs
+                or len(signals) != expected_signals):
             return False
         wait_docs.extend(t for _k, t in timers)
         wait_keys.extend(k for k, _t in timers)
         wait_docs.extend(subs)
+        wait_docs.extend(signals)
         return True
 
     @staticmethod
