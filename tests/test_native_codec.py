@@ -395,3 +395,54 @@ class TestApplyPatchesAndStamp:
             apply_patches(buf, _struct.pack("<IBB", 4, 0, 0), [1])
         with pytest.raises(IndexError):
             apply_patches(buf, _struct.pack("<IBB", 0, 0, 3), [1])
+
+
+class TestNativeEncodeKey:
+    """codec.c encode_key vs the Python spec (state/db._encode_key_py):
+    byte-equality over fuzzed key shapes and identical error behavior."""
+
+    def test_fuzz_byte_equality(self):
+        import random
+
+        from zeebe_tpu.state import db as D
+
+        if D._encode_key_native is None:
+            import pytest
+
+            pytest.skip("native codec unavailable")
+        rng = random.Random(11)
+        cfs = list(D.ColumnFamilyCode)
+
+        def rand_part(r):
+            roll = r.random()
+            if roll < 0.45:
+                return r.choice([0, 1, -1, 2**31, -2**31, 2**63 - 1,
+                                 -2**63, 2**64 + 5,
+                                 r.randint(-10**18, 10**18)])
+            if roll < 0.8:
+                return "".join(r.choice("abcXYZ09_é中")
+                               for _ in range(r.randint(0, 40)))
+            # full byte range: 0x00 and 0xFF inside bytes parts are legal
+            # and are exactly the values a C truncation bug would hide on
+            return bytes(r.randrange(256)
+                         for _ in range(r.randint(0, 64)))
+
+        for _ in range(5000):
+            cf = rng.choice(cfs)
+            parts = tuple(rand_part(rng) for _ in range(rng.randint(0, 4)))
+            assert D.encode_key(cf, parts) == D._encode_key_py(cf, parts), (
+                cf, parts)
+
+    def test_error_parity(self):
+        import pytest
+
+        from zeebe_tpu.state import db as D
+
+        if D._encode_key_native is None:
+            pytest.skip("native codec unavailable")
+        for bad, exc in (((True,), TypeError), (("x\x00y",), ValueError),
+                         ((1.5,), TypeError)):
+            with pytest.raises(exc):
+                D.encode_key(D.ColumnFamilyCode.JOBS, bad)
+            with pytest.raises(exc):
+                D._encode_key_py(D.ColumnFamilyCode.JOBS, bad)

@@ -1626,7 +1626,109 @@ fail:
     return NULL;
 }
 
+/* encode_key(prefix, parts) -> bytes
+ * Order-preserving state-key encoding (spec: state/db.py encode_key /
+ * _encode_part — that Python implementation is the contract; tests assert
+ * byte-equality). prefix is the 2-byte column-family prefix; parts is a
+ * tuple of int | str | bytes. */
+static PyObject *codec_encode_key(PyObject *self, PyObject *args)
+{
+    PyObject *prefix, *parts;
+    if (!PyArg_ParseTuple(args, "SO!", &prefix, &PyTuple_Type, &parts))
+        return NULL;
+    unsigned char stack_buf[256];
+    Py_ssize_t cap = sizeof(stack_buf);
+    unsigned char *buf = stack_buf;
+    Py_ssize_t n = PyBytes_GET_SIZE(prefix);
+    if (n > cap)
+        return PyErr_Format(PyExc_ValueError, "oversized cf prefix");
+    memcpy(buf, PyBytes_AS_STRING(prefix), n);
+    PyObject *heap = NULL; /* switch-over for long keys */
+    Py_ssize_t count = PyTuple_GET_SIZE(parts);
+    for (Py_ssize_t i = 0; i < count; i++) {
+        PyObject *part = PyTuple_GET_ITEM(parts, i);
+        const void *src = NULL;
+        Py_ssize_t need, slen = 0;
+        uint64_t flipped = 0;
+        int kind;
+        if (PyBool_Check(part)) {
+            Py_XDECREF(heap);
+            PyErr_SetString(PyExc_TypeError,
+                            "bool key parts are ambiguous; use int 0/1");
+            return NULL;
+        } else if (PyLong_Check(part)) {
+            /* wrap to 64 bits like the Python spec's `& 0xFFFF…` mask */
+            uint64_t v = (uint64_t)PyLong_AsUnsignedLongLongMask(part);
+            if (v == (uint64_t)-1 && PyErr_Occurred()) {
+                Py_XDECREF(heap);
+                return NULL;
+            }
+            flipped = v ^ 0x8000000000000000ULL;
+            kind = 1;
+            need = 9;
+        } else if (PyUnicode_Check(part)) {
+            src = PyUnicode_AsUTF8AndSize(part, &slen);
+            if (!src) {
+                Py_XDECREF(heap);
+                return NULL;
+            }
+            if (memchr(src, 0, (size_t)slen)) {
+                Py_XDECREF(heap);
+                PyErr_SetString(PyExc_ValueError, "NUL byte in string key part");
+                return NULL;
+            }
+            kind = 2;
+            need = slen + 2;
+        } else if (PyBytes_Check(part)) {
+            src = PyBytes_AS_STRING(part);
+            slen = PyBytes_GET_SIZE(part);
+            kind = 3;
+            need = slen + 9;
+        } else {
+            Py_XDECREF(heap);
+            return PyErr_Format(PyExc_TypeError,
+                                "unsupported key part type %.100s",
+                                Py_TYPE(part)->tp_name);
+        }
+        if (n + need > cap) {
+            Py_ssize_t newcap = (cap * 2 > n + need + 64) ? cap * 2 : n + need + 64;
+            PyObject *nh = PyBytes_FromStringAndSize(NULL, newcap);
+            if (!nh) {
+                Py_XDECREF(heap);
+                return NULL;
+            }
+            memcpy(PyBytes_AS_STRING(nh), buf, (size_t)n);
+            Py_XDECREF(heap);
+            heap = nh;
+            buf = (unsigned char *)PyBytes_AS_STRING(nh);
+            cap = newcap;
+        }
+        if (kind == 1) {
+            buf[n++] = 0x01;
+            for (int b = 7; b >= 0; b--)
+                buf[n++] = (unsigned char)(flipped >> (8 * b));
+        } else if (kind == 2) {
+            buf[n++] = 0x02;
+            memcpy(buf + n, src, (size_t)slen);
+            n += slen;
+            buf[n++] = 0x00;
+        } else {
+            buf[n++] = 0x03;
+            uint64_t ulen = (uint64_t)slen;
+            for (int b = 7; b >= 0; b--)
+                buf[n++] = (unsigned char)(ulen >> (8 * b));
+            memcpy(buf + n, src, (size_t)slen);
+            n += slen;
+        }
+    }
+    PyObject *out = PyBytes_FromStringAndSize((const char *)buf, n);
+    Py_XDECREF(heap);
+    return out;
+}
+
 static PyMethodDef codec_methods[] = {
+    {"encode_key", codec_encode_key, METH_VARARGS,
+     "Order-preserving state-key encoding (spec: state/db.py encode_key)."},
     {"index_base_segment", codec_index_base_segment, METH_VARARGS,
      "Index a durable-state base segment: keys eager, values as lazy cold slices."},
     {"stamp_batch", codec_stamp_batch, METH_VARARGS,

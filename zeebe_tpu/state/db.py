@@ -145,7 +145,12 @@ def _encode_part(part: Any, out: bytearray) -> None:
 _CF_PREFIX = {code: struct.pack(">H", int(code)) for code in ColumnFamilyCode}
 
 
-def encode_key(cf: ColumnFamilyCode, parts: tuple) -> bytes:
+_encode_key_native = _codec_fn("encode_key")
+
+
+def _encode_key_py(cf: ColumnFamilyCode, parts: tuple) -> bytes:
+    """Pure-Python encoding — THE SPEC the native pass must byte-match
+    (tests/test_native_codec.py TestNativeEncodeKey fuzzes equality)."""
     prefix = _CF_PREFIX[cf]
     n = len(parts)
     # fast paths for the dominant shapes: (int,) and (int, int)
@@ -164,6 +169,13 @@ def encode_key(cf: ColumnFamilyCode, parts: tuple) -> bytes:
     for part in parts:
         _encode_part(part, out)
     return bytes(out)
+
+
+if _encode_key_native is not None:
+    def encode_key(cf: ColumnFamilyCode, parts: tuple) -> bytes:
+        return _encode_key_native(_CF_PREFIX[cf], parts)
+else:
+    encode_key = _encode_key_py
 
 
 def decode_key(encoded: bytes) -> tuple[ColumnFamilyCode, tuple]:
