@@ -487,7 +487,7 @@ def run_one_task_warm_large_state(n_warm: int = 200_000) -> dict:
     with tempfile.TemporaryDirectory() as tmpdir:
         part = E2EPartition(tmpdir, durable=True)
         part.deploy([one_task("one_task_warm")])
-        payload = "y" * 2200
+        payload = "y" * 2600  # 3 entries/instance x 200k -> >= 0.5 GB serialized
         base_key = 1 << 40  # far above the engine's key space
         for start in range(0, n_warm, 10_000):
             with part.db.transaction():

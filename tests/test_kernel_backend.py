@@ -1955,3 +1955,64 @@ class TestSignalBoundaryEligibility:
             h.broadcast_signal("halt")      # the other's boundary interrupts
 
         assert_equivalent(scenario)
+
+
+class TestEventGatewaySignalTargets:
+    """Event-based gateways with signal-catch targets ride the kernel
+    (round-5 widening: signal subscriptions count in the reconstruction
+    integrity check, so a signal target is collectable wait state)."""
+
+    @staticmethod
+    def _gw(pid="ebg_sig"):
+        return (
+            Bpmn.create_executable_process(pid)
+            .start_event("s")
+            .event_based_gateway("ebg")
+            .intermediate_catch_signal("sc", "go_signal")
+            .service_task("sig_path", job_type="eg_sig")
+            .end_event("e1")
+            .move_to_element("ebg")
+            .intermediate_catch_timer("tc", duration="PT1H")
+            .end_event("e2")
+            .done()
+        )
+
+    def test_gateway_rides_kernel(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(self._gw())
+            for i in range(6):
+                h.create_instance("ebg_sig", {"n": i}, request_id=400 + i)
+            k = h.kernel_backend
+            assert k.commands_processed >= 6, dict(k.fallback_reasons)
+        finally:
+            h.close()
+
+    def test_signal_trigger_parity_and_completion(self):
+        def scenario(h):
+            h.deploy(self._gw())
+            h.create_instance("ebg_sig", request_id=420)
+            h.create_instance("ebg_sig", request_id=421)
+            h.broadcast_signal("go_signal")
+            drive_jobs(h, "eg_sig")
+
+        assert_equivalent(scenario, clock_start=1_700_000_000_000)
+
+        # and the instances actually complete through the signal branch
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(self._gw())
+            pi = h.create_instance("ebg_sig", request_id=430)
+            h.broadcast_signal("go_signal")
+            drive_jobs(h, "eg_sig")
+            assert h.is_instance_done(pi)
+        finally:
+            h.close()
+
+    def test_timer_trigger_while_signal_sub_open_parity(self):
+        def scenario(h):
+            h.deploy(self._gw())
+            h.create_instance("ebg_sig", request_id=440)
+            h.advance_time(3600 * 1000 + 1)  # timer wins; signal sub closes
+
+        assert_equivalent(scenario, clock_start=1_700_000_000_000)

@@ -318,17 +318,17 @@ def check_element_eligibility(exe: ExecutableProcess, el: ExecutableElement) -> 
         return el.child_start_idx >= 0
     if el.element_type == BpmnElementType.EVENT_BASED_GATEWAY:
         # parks on device like a catch; every succeeding catch must hold a
-        # wait state the reconstruction counts — and _collect_wait_states
-        # counts ONLY fixed-duration timers and message subscriptions, so a
-        # signal target (kernel-eligible as a standalone catch) still forces
-        # the gateway host-side: its subscription would be open-but-uncounted
-        # state, defeating the trigger-mid-flight integrity check
+        # wait state the reconstruction counts — fixed-duration timers,
+        # message subscriptions, and (since round 5) signal subscriptions
+        # all count in _collect_wait_states, so any mix of those targets
+        # keeps the gateway kernel-reconstructable; cycle/date timers stay
+        # host-side (their wait state is not collectable)
         for fidx in el.outgoing:
             target = exe.elements[exe.flows[fidx].target_idx]
             if target.timer_duration is not None:
                 if target.timer_cycle or target.timer_date is not None:
                     return False
-            elif target.message_name is None:
+            elif target.message_name is None and target.signal_name is None:
                 return False
         return bool(el.outgoing)
     if (el.element_type == BpmnElementType.INTERMEDIATE_THROW_EVENT
@@ -957,13 +957,12 @@ class KernelRegistry:
             elif (el.element_type == BpmnElementType.EVENT_BASED_GATEWAY
                   and el.idx not in effective_host):
                 # an event-based gateway's wait states live on its own
-                # instance, one per succeeding catch event (never signals:
-                # gateway eligibility only admits timer/message targets)
+                # instance, one per succeeding catch event
                 ts = [exe.elements[exe.flows[f].target_idx] for f in el.outgoing]
                 boundary_waits[el.idx] = (
                     sum(1 for t in ts if t.timer_duration is not None),
                     sum(1 for t in ts if t.message_name is not None),
-                    0,
+                    sum(1 for t in ts if t.signal_name is not None),
                 )
         return _DefInfo(
             index=index,
