@@ -306,3 +306,160 @@ class TestCallInlineMechanics:
             h.cancel_instance(k)
 
         assert_equivalent(scenario)
+
+
+class TestInlinedChildRootEsp:
+    """Called definitions with ROOT event sub-processes inline when their ESP
+    starts need no runtime expression evaluation (signal / error /
+    escalation / static-duration timer): the child-root placeholder opens
+    the start subscriptions mid-burst via the sequential behavior, frames
+    count them as wait state, and a triggered frame declines resumes."""
+
+    @staticmethod
+    def _defs():
+        child = (
+            Bpmn.create_executable_process("esp_child")
+            .start_event("cs")
+            .service_task("cw", job_type="esp_cw")
+            .end_event("ce")
+            .event_sub_process("cesp")
+            .signal_start_event("css", "child_alarm")
+            .end_event("cee")
+            .sub_process_done()
+            .done()
+        )
+        caller = (
+            Bpmn.create_executable_process("esp_caller")
+            .start_event("s")
+            .call_activity("call", process_id="esp_child")
+            .end_event("e")
+            .done()
+        )
+        return child, caller
+
+    def test_child_with_signal_esp_inlines(self):
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            child, caller = self._defs()
+            h.deploy(child, caller)
+            h.create_instance("esp_caller", request_id=1)
+            k = h.kernel_backend
+            with h.db.transaction():
+                meta = h.engine.state.processes.get_latest_by_id("esp_caller")
+                info = k.registry.lookup(
+                    meta["processDefinitionKey"],
+                    h.engine.state.processes.executable(meta["processDefinitionKey"]),
+                    h.engine.state.processes)
+            assert info is not None and info.segments, "child did not inline"
+            assert info.scope_esp_waits, "placeholder ESP waits missing"
+            # the creation rode the kernel and the child's ESP signal
+            # subscription is open on the CHILD process instance
+            assert k.commands_processed >= 1, dict(k.fallback_reasons)
+            before = k.commands_processed
+            for job in h.activate_jobs("esp_cw", max_jobs=5):
+                h.complete_job(job["key"])
+            # the resume reconstructed THROUGH the frame (sub counted)
+            assert k.commands_processed > before, dict(k.fallback_reasons)
+        finally:
+            h.close()
+
+    def test_untriggered_byte_parity(self):
+        def scenario(h):
+            child, caller = self._defs()
+            h.deploy(child, caller)
+            for i in range(5):
+                h.create_instance("esp_caller", {"n": i}, request_id=10 + i)
+            drive_jobs(h, "esp_cw")
+
+        assert_equivalent(scenario)
+
+    def test_triggered_byte_parity(self):
+        def scenario(h):
+            child, caller = self._defs()
+            h.deploy(child, caller)
+            h.create_instance("esp_caller", request_id=30)
+            h.create_instance("esp_caller", request_id=31)
+            jobs = h.activate_jobs("esp_cw", max_jobs=5)
+            h.complete_job(jobs[0]["key"])   # one frame completes first
+            h.broadcast_signal("child_alarm")  # interrupts the other's child
+            drive_jobs(h, "esp_cw")
+
+        assert_equivalent(scenario)
+
+    def test_timer_esp_child_inlines_and_parity(self):
+        def scenario(h):
+            child = (
+                Bpmn.create_executable_process("tesp_child")
+                .start_event("cs")
+                .service_task("cw", job_type="tesp_cw")
+                .end_event("ce")
+                .event_sub_process("cesp")
+                .timer_start_event("cts", duration="PT3H")
+                .end_event("cee")
+                .sub_process_done()
+                .done()
+            )
+            caller = (
+                Bpmn.create_executable_process("tesp_caller")
+                .start_event("s")
+                .call_activity("call", process_id="tesp_child")
+                .end_event("e")
+                .done()
+            )
+            h.deploy(child, caller)
+            # the timer-ESP child really INLINED (static duration admits)
+            k = getattr(h, "kernel_backend", None)
+            if k is not None:
+                with h.db.transaction():
+                    meta = h.engine.state.processes.get_latest_by_id("tesp_caller")
+                    info = k.registry.lookup(
+                        meta["processDefinitionKey"],
+                        h.engine.state.processes.executable(
+                            meta["processDefinitionKey"]),
+                        h.engine.state.processes)
+                assert info is not None and info.segments
+                assert info.scope_esp_waits
+            for i in range(4):
+                h.create_instance("tesp_caller", {"n": i}, request_id=50 + i)
+            drive_jobs(h, "tesp_cw")
+
+        assert_equivalent(scenario, clock_start=1_700_000_000_000)
+
+    def test_message_esp_child_stays_sequential(self):
+        """Correlation-key ESP starts need runtime eval — the child must NOT
+        inline, and execution stays correct via the host escape."""
+        def scenario(h):
+            child = (
+                Bpmn.create_executable_process("mesp_child")
+                .start_event("cs")
+                .service_task("cw", job_type="mesp_cw")
+                .end_event("ce")
+                .event_sub_process("cesp")
+                .message_start_event("cms", "m_alarm", correlation_key="=key")
+                .end_event("cee")
+                .sub_process_done()
+                .done()
+            )
+            caller = (
+                Bpmn.create_executable_process("mesp_caller")
+                .start_event("s")
+                .call_activity("call", process_id="mesp_child")
+                .end_event("e")
+                .done()
+            )
+            h.deploy(child, caller)
+            # the message-ESP child must NOT inline (correlation-key eval)
+            k = getattr(h, "kernel_backend", None)
+            if k is not None:
+                with h.db.transaction():
+                    meta = h.engine.state.processes.get_latest_by_id("mesp_caller")
+                    info = k.registry.lookup(
+                        meta["processDefinitionKey"],
+                        h.engine.state.processes.executable(
+                            meta["processDefinitionKey"]),
+                        h.engine.state.processes)
+                assert info is None or not info.segments
+            h.create_instance("mesp_caller", {"key": "k1"}, request_id=70)
+            drive_jobs(h, "mesp_cw")
+
+        assert_equivalent(scenario)

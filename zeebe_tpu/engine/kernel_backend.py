@@ -477,8 +477,27 @@ def _inline_call_activities(exe: ExecutableProcess, processes,
         child = processes.executable(meta["processDefinitionKey"])
         if child is None or child.none_start_of(0) < 0:
             continue
-        if child.event_sub_processes_of(0):
-            continue  # root ESP subscriptions need sequential activation
+        if any(
+            # child-root ESP starts are openable mid-burst only when their
+            # subscriptions need NO runtime expression evaluation: static
+            # timer durations and signal/error/escalation starts. Message
+            # starts evaluate correlation keys against the CHILD scope at
+            # activation time — a mid-burst variable write before the call
+            # activates would diverge from any admission-time prediction
+            not (
+                (esp_start := child.elements[esp.child_start_idx]).event_type
+                in (BpmnEventType.ERROR, BpmnEventType.ESCALATION)
+                or (esp_start.event_type == BpmnEventType.SIGNAL
+                    and esp_start.signal_name)
+                or (esp_start.event_type == BpmnEventType.TIMER
+                    and esp_start.timer_duration is not None
+                    and esp_start.timer_duration.is_static
+                    and esp_start.timer_cycle is None
+                    and esp_start.timer_date is None)
+            )
+            for esp in child.event_sub_processes_of(0)
+        ):
+            continue  # ESP needing runtime eval: sequential activation
         if any(f.condition is not None for f in child.flows):
             # child conditions read CHILD-scope variables the shared slot
             # prefetch cannot represent — a whole-child decline keeps the
@@ -748,6 +767,18 @@ def _mi_burst_reach(exe: ExecutableProcess, ops_row,
     return reach
 
 
+def _esp_wait_counts(exe: ExecutableProcess, scope_row: int) -> tuple:
+    """(timers, message subs, signal subs) a scope row's event
+    sub-processes hold open on its instance."""
+    starts = [exe.elements[esp.child_start_idx]
+              for esp in exe.event_sub_processes_of(scope_row)]
+    return (
+        sum(1 for s in starts if s.timer_duration is not None),
+        sum(1 for s in starts if s.message_name is not None),
+        sum(1 for s in starts if s.signal_name is not None),
+    )
+
+
 @dataclass
 class _DefInfo:
     index: int
@@ -777,6 +808,10 @@ class _DefInfo:
     # (timers, message subs, signal subs) for reconstruction integrity
     root_esp_start_idxs: tuple = ()
     root_esp_waits: tuple = (0, 0, 0)
+    # ditto for inlined child-root placeholder rows whose called definition
+    # carries root ESPs: scope row -> (timers, msgs, signals) expected open
+    # on that call frame's child process instance
+    scope_esp_waits: dict = field(default_factory=dict)
 
     def segment_of_row(self, row: int):
         """The segment whose inlined region contains ``row`` (call_row and
@@ -899,7 +934,6 @@ class KernelRegistry:
             # start element — nothing for the kernel's entry path to run
             return None
         root_esp_start_idxs: list[int] = []
-        esp_timers = esp_msgs = esp_signals = 0
         for esp in exe.event_sub_processes_of(0):
             # root ESP bodies host-escape (their rows are outside the device
             # subset), but the DEFINITION rides the kernel: the creation
@@ -909,20 +943,18 @@ class KernelRegistry:
             # makes resumes decline until it drains). Only subscription
             # shapes the reconstruction can count are eligible.
             start = exe.elements[esp.child_start_idx]
-            if start.event_type in (BpmnEventType.ERROR,
-                                    BpmnEventType.ESCALATION):
-                pass  # stateless: triggered via _find_catcher at throw time
-            elif (start.event_type == BpmnEventType.TIMER
-                  and start.timer_duration is not None
-                  and start.timer_cycle is None and start.timer_date is None):
-                esp_timers += 1
-            elif (start.event_type == BpmnEventType.MESSAGE
-                  and start.message_name):
-                esp_msgs += 1
-            elif (start.event_type == BpmnEventType.SIGNAL
-                  and start.signal_name):
-                esp_signals += 1
-            else:
+            if not (
+                start.event_type in (BpmnEventType.ERROR,
+                                     BpmnEventType.ESCALATION)
+                or (start.event_type == BpmnEventType.TIMER
+                    and start.timer_duration is not None
+                    and start.timer_cycle is None
+                    and start.timer_date is None)
+                or (start.event_type == BpmnEventType.MESSAGE
+                    and start.message_name)
+                or (start.event_type == BpmnEventType.SIGNAL
+                    and start.signal_name)
+            ):
                 return None  # cycle/date timers: sequential end to end
             root_esp_start_idxs.append(esp.child_start_idx)
         try:
@@ -978,7 +1010,13 @@ class KernelRegistry:
             mi_reach=(_mi_burst_reach(exe, solo.kernel_op[0], mi_inner)
                       if mi_inner else {}),
             root_esp_start_idxs=tuple(root_esp_start_idxs),
-            root_esp_waits=(esp_timers, esp_msgs, esp_signals),
+            root_esp_waits=(_esp_wait_counts(exe, 0)
+                            if root_esp_start_idxs else (0, 0, 0)),
+            scope_esp_waits={
+                seg.root_row: waits
+                for seg in segments
+                if (waits := _esp_wait_counts(exe, seg.root_row)) != (0, 0, 0)
+            },
         )
 
     def _compile_shared(self) -> ProcessTables:
@@ -1340,7 +1378,8 @@ class KernelBackend:
         resume: _Token | None = None
         wait_docs: list = []
         wait_keys: list[int] = []
-        if not self._root_esp_waits_ok(info, pi_key, wait_docs, wait_keys):
+        if not self._esp_waits_ok(info.root_esp_waits, pi_key, wait_docs,
+                                  wait_keys):
             return None
         family: list[int] = []  # call-child process instance keys
         mi_parked: dict[int, int | None] = {}  # K_MI body row → live inner lc
@@ -1405,6 +1444,10 @@ class KernelBackend:
                     scope_keys[row] = child_key
                     pending_walk.append((child_pi, call_seg))
                 else:
+                    esp_expected = info.scope_esp_waits.get(row)
+                    if esp_expected is not None and not self._esp_waits_ok(
+                            esp_expected, child_key, wait_docs, wait_keys):
+                        return None  # an ESP trigger owns this call frame
                     scope_keys[row] = child_key
                     pending_walk.extend(
                         (k, seg)
@@ -1483,18 +1526,20 @@ class KernelBackend:
         return self.engine.bpmn.prevalidate_scope_event_subscriptions(
             info.root_esp_start_idxs, info.exe, variables) is None
 
-    def _root_esp_waits_ok(self, info: _DefInfo, pi_key: int,
-                           wait_docs: list, wait_keys: list) -> bool:
-        """Root ESP start subscriptions must ALL be open on the process
+    def _esp_waits_ok(self, expected: tuple, instance_key: int,
+                      wait_docs: list, wait_keys: list) -> bool:
+        """A scope's ESP start subscriptions must ALL be open on its
         instance — anything less means a trigger owns the instance right now
-        (mirror of _collect_wait_states for the root scope)."""
-        expected_timers, expected_subs, expected_signals = info.root_esp_waits
+        (mirror of _collect_wait_states for scope instances). Applies to
+        the process root (root_esp_waits) and to inlined call frames' child
+        roots (scope_esp_waits)."""
+        expected_timers, expected_subs, expected_signals = expected
         if not (expected_timers or expected_subs or expected_signals):
             return True
         state = self.engine.state
-        timers = state.timers.timers_for_element_instance(pi_key)
-        subs = state.process_message_subscriptions.subscriptions_of(pi_key)
-        signals = state.signal_subscriptions.subscriptions_of(pi_key)
+        timers = state.timers.timers_for_element_instance(instance_key)
+        subs = state.process_message_subscriptions.subscriptions_of(instance_key)
+        signals = state.signal_subscriptions.subscriptions_of(instance_key)
         if (len(timers) != expected_timers or len(subs) != expected_subs
                 or len(signals) != expected_signals):
             return False
@@ -3002,6 +3047,19 @@ class KernelBackend:
                 # path: their element copy stamps the child process shape
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_ACTIVATING, value)
+                if element.idx in inst.info.scope_esp_waits:
+                    # child-root placeholder with root ESPs: open the start
+                    # subscriptions between ACTIVATING and ACTIVATED via the
+                    # sequential behavior verbatim (inlining admits only
+                    # expression-free/static starts, so failure is
+                    # unreachable on state identical to the sequential run)
+                    if not self.engine.bpmn._open_scope_event_subscriptions(
+                            tok.key, value, exe, element, writers):
+                        logger.error(
+                            "inlined child ESP subscription open failed for "
+                            "%s — instance %s left ACTIVATING",
+                            element.id, tok.key)
+                        continue
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_ACTIVATED, value)
                 start = exe.elements[element.child_start_idx]
