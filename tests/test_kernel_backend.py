@@ -2016,3 +2016,115 @@ class TestEventGatewaySignalTargets:
             h.advance_time(3600 * 1000 + 1)  # timer wins; signal sub closes
 
         assert_equivalent(scenario, clock_start=1_700_000_000_000)
+
+
+class TestExpressionScriptTasksOnKernel:
+    """Expression-flavor script tasks ride the kernel as K_PASS: the
+    evaluation and result write emit between ACTIVATED and COMPLETING,
+    mirroring the sequential script branch (round-5 eligibility widening)."""
+
+    @staticmethod
+    def _script(pid="scr"):
+        # the expression must sit in the never-raises safe subset
+        # (_safe_mapping_expr): variable refs, literals, context literals,
+        # equality, if/else — NOT arithmetic (it can raise on bad types)
+        return (
+            Bpmn.create_executable_process(pid)
+            .start_event("s")
+            .service_task("t", job_type="scr_w")
+            .script_task("calc",
+                         expression='= if n = 41 then "match" else n',
+                         result_variable="verdict")
+            .end_event("e")
+            .done()
+        )
+
+    def test_rides_kernel_and_writes_result(self):
+        from zeebe_tpu.engine.kernel_backend import check_element_eligibility
+        from zeebe_tpu.models.bpmn import transform
+
+        exe = transform(self._script())
+        assert check_element_eligibility(exe, exe.element("calc"))
+
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(self._script())
+            h.create_instance("scr", {"n": 41}, request_id=500)
+            for job in h.activate_jobs("scr_w", max_jobs=5):
+                h.complete_job(job["key"])
+            k = h.kernel_backend
+            # the script element genuinely compiled onto the device
+            with h.db.transaction():
+                meta = h.engine.state.processes.get_latest_by_id("scr")
+                info = k.registry.lookup(
+                    meta["processDefinitionKey"],
+                    h.engine.state.processes.executable(
+                        meta["processDefinitionKey"]),
+                    h.engine.state.processes)
+            calc_idx = info.exe.by_id["calc"]
+            assert calc_idx not in info.host_idxs
+            assert k.commands_processed >= 2, dict(k.fallback_reasons)
+            # the DEVICE path evaluated with the real context: concrete value
+            recs = (h.exporter.variable_records()
+                    .with_value(name="verdict").to_list())
+            assert recs and recs[-1].record.value["value"] == "match"
+        finally:
+            h.close()
+
+    def test_byte_parity(self):
+        def scenario(h):
+            h.deploy(self._script())
+            for i in range(6):
+                # mix of the then/else arms, concrete non-null results
+                h.create_instance("scr", {"n": 41 if i % 2 else i * 10},
+                                  request_id=520 + i)
+            drive_jobs(h, "scr_w")
+
+        assert_equivalent(scenario)
+
+    def test_condition_feeding_script_result_stays_host(self):
+        """A script result feeding a device condition would invalidate the
+        prefetched slots — the script task must host-escape, and execution
+        stays correct via the fallback. The expression is SAFE (= n), so
+        the operative rejection is exactly the condition-variable guard."""
+        from zeebe_tpu.engine.kernel_backend import check_element_eligibility
+        from zeebe_tpu.models.bpmn import transform
+
+        def _model():
+            return (
+                Bpmn.create_executable_process("scr_gate")
+                .start_event("s")
+                .script_task("calc", expression="= n",
+                             result_variable="doubled")
+                .exclusive_gateway("gw")
+                .condition_expression("doubled > 10")
+                .end_event("hi")
+                .move_to_element("gw")
+                .default_flow()
+                .end_event("lo")
+                .done()
+            )
+
+        exe = transform(_model())
+        assert not check_element_eligibility(exe, exe.element("calc"))
+
+        def scenario(h):
+            h.deploy(_model())
+            h.create_instance("scr_gate", {"n": 19}, request_id=540)
+            h.create_instance("scr_gate", {"n": 1}, request_id=541)
+
+        assert_equivalent(scenario)
+
+    def test_unknown_variable_evaluates_to_null_parity(self):
+        def scenario(h):
+            h.deploy(
+                Bpmn.create_executable_process("scr_null")
+                .start_event("s")
+                .script_task("calc", expression="= missing_var",
+                             result_variable="out")
+                .end_event("e")
+                .done()
+            )
+            h.create_instance("scr_null", request_id=560)
+
+        assert_equivalent(scenario)

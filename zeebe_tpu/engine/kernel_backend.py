@@ -40,7 +40,11 @@ from typing import Any, Callable
 import numpy as np
 
 from zeebe_tpu.models.bpmn.executable import ExecutableElement, ExecutableProcess
-from zeebe_tpu.feel.feel import Lit as _FeelLit, Var as _FeelVar
+from zeebe_tpu.feel.feel import (
+    FeelEvalError,
+    Lit as _FeelLit,
+    Var as _FeelVar,
+)
 from zeebe_tpu.ops.tables import (
     _KERNEL_OP,
     _MI_BODY_TYPES,
@@ -275,8 +279,24 @@ def check_element_eligibility(exe: ExecutableProcess, el: ExecutableElement) -> 
                 return False
             if {t for _e, t in el.outputs} & _condition_var_names(exe):
                 return False
-    if el.native_user_task or el.called_decision_id or el.script_expression is not None:
+    if el.native_user_task or el.called_decision_id:
         return False
+    if el.script_expression is not None:
+        # expression-flavor script tasks ride as K_PASS with the evaluation
+        # and result write emitted between ACTIVATED and COMPLETING: the
+        # expression must be a never-raises safe expression, and the result
+        # variable must not invalidate prefetched device condition slots
+        # (same discipline as io-mapping outputs). Every value the script
+        # can read is a function of fingerprinted inputs (creation/completion
+        # variables, parked locals), so templates stay sound.
+        return (el.element_type == BpmnElementType.SCRIPT_TASK
+                and el.job_type is None
+                and not el.inputs and not el.outputs
+                and not el.boundary_idxs
+                and _safe_mapping_expr(el.script_expression)
+                and (el.script_result_variable is None
+                     or el.script_result_variable
+                     not in _condition_var_names(exe)))
     if el.element_type == BpmnElementType.BOUNDARY_EVENT:
         # triggers route sequentially (route_trigger); the kernel only needs
         # the attached wait state to be reconstructable, so the boundary's
@@ -3080,6 +3100,28 @@ class KernelBackend:
                                      PI.ELEMENT_ACTIVATING, value)
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_ACTIVATED, value)
+                if element.script_expression is not None:
+                    # expression script task: evaluate + write the result
+                    # between ACTIVATED and COMPLETING, mirroring
+                    # BpmnProcessor._activate's script branch. Eligibility
+                    # admits only never-raises expressions, so failure is
+                    # unreachable; if it ever happened the sequential path
+                    # would raise an incident and the element would stay
+                    # ACTIVATED — log loudly, since downstream device ops
+                    # would then diverge.
+                    context = state.variables.collect(tok.key)
+                    try:
+                        result = element.script_expression.evaluate(
+                            context, self.engine.clock_millis)
+                    except FeelEvalError:
+                        logger.error(
+                            "safe script expression raised for %s — "
+                            "instance %s left ACTIVATED", element.id, tok.key)
+                        continue
+                    if element.script_result_variable:
+                        self.engine.bpmn._write_variable(
+                            writers, value.get("flowScopeKey", -1), value,
+                            element.script_result_variable, result)
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,
                                      PI.ELEMENT_COMPLETING, value)
                 writers.append_event(tok.key, ValueType.PROCESS_INSTANCE,

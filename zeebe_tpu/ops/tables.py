@@ -628,7 +628,13 @@ def compile_tables(processes: list[ExecutableProcess], max_fanout: int | None = 
                     # form resolution reads FormState at activation time (the
                     # formKey header depends on the latest deployed form)
                     raise ConditionNotCompilable("form-linked user task")
-                if el.event_type == BpmnEventType.LINK and el.element_type in (
+                if (el.element_type == BpmnElementType.SCRIPT_TASK
+                        and el.script_expression is not None):
+                    # expression-flavor script task: pass-through on device,
+                    # evaluation + result write happen at decode (the
+                    # job-worker flavor keeps K_TASK via _KERNEL_OP)
+                    op = K_PASS
+                elif el.event_type == BpmnEventType.LINK and el.element_type in (
                     BpmnElementType.INTERMEDIATE_THROW_EVENT,
                     BpmnElementType.INTERMEDIATE_CATCH_EVENT,
                 ):
