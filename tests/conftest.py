@@ -16,9 +16,53 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running integration tests")
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection tests (zeebe_tpu.testing.chaos); "
+        "failures print the active fault seed for reproduction",
+    )
+
+
+@pytest.fixture(autouse=True)
+def _reset_chaos_seed(request):
+    """A chaos test failing BEFORE it builds its ChaosNetwork must not report
+    the previous test's seed — clear the global at setup."""
+    if request.node.get_closest_marker("chaos") is not None:
+        try:
+            from zeebe_tpu.testing import chaos
+
+            chaos._ACTIVE_SEED = None
+        except Exception:
+            pass
+    yield
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """On a chaos-test failure, print the active fault seed so the randomized
+    run is reproducible: FaultPlan(seed=<printed seed>). Gated on the marker —
+    a stale seed from an earlier chaos test must not decorate unrelated
+    failures."""
+    outcome = yield
+    report = outcome.get_result()
+    if (report.when == "call" and report.failed
+            and item.get_closest_marker("chaos") is not None):
+        try:
+            from zeebe_tpu.testing.chaos import active_fault_seed
+
+            seed = active_fault_seed()
+        except Exception:
+            seed = None
+        if seed is not None:
+            report.sections.append((
+                "chaos fault seed",
+                f"active fault seed: {seed} — reproduce with "
+                f"FaultPlan(seed={seed})",
+            ))

@@ -95,14 +95,90 @@ class TestExporterDirector:
         assert all(r.record.is_event for r in filtered.records)
 
 
+class TestExporterCrashRestartResume:
+    """Crash + ExporterDirector rebuild: each exporter resumes from its own
+    persisted position — no duplicate deliveries below the ack, no gap above
+    it (reference: ExporterDirectorTest restart cases)."""
+
+    def test_each_exporter_resumes_from_its_own_ack(self, harness):
+        eager, lazy = CollectingExporter(), SlowAckExporter()
+        director = ExporterDirector(harness.stream, harness.db,
+                                    {"eager": eager, "lazy": lazy})
+        harness.deploy(one_task())
+        harness.create_instance("p")
+        director.export_available()
+        state = ExportersState(harness.db)
+        eager_ack = state.position("eager")
+        lazy_ack = state.position("lazy")
+        assert lazy_ack < eager_ack  # lazy acks every other record
+
+        # "crash": directors and exporter instances dropped without close;
+        # rebuild over the same db and keep the log moving
+        eager2, lazy2 = CollectingExporter(), SlowAckExporter()
+        director2 = ExporterDirector(harness.stream, harness.db,
+                                     {"eager": eager2, "lazy": lazy2})
+        harness.create_instance("p")
+        director2.export_available()
+
+        # no duplicates below the ack: the new instances never see a record
+        # at or below their persisted position
+        assert all(r.position > eager_ack for r in eager2.records)
+        assert lazy2.seen and all(p > lazy_ack for p in lazy2.seen)
+        # no gap above it: every committed position above the ack reaches the
+        # restarted exporter exactly once (at-least-once resume, and within
+        # one director lifetime exactly-once)
+        log_positions = [lr.position for lr in harness.stream.new_reader(1)]
+        expected_eager = [p for p in log_positions if p > eager_ack]
+        assert [r.position for r in eager2.records] == expected_eager
+        expected_lazy = [p for p in log_positions if p > lazy_ack]
+        assert lazy2.seen == expected_lazy
+
+    def test_failed_export_does_not_advance_pending_watermark(self, harness):
+        """A failed export must leave last_delivered untouched: otherwise a
+        later skip() believes the record was handed over and acks past it
+        (the satellite bug: deliver() advanced the watermark BEFORE export)."""
+
+        class FailingExporter(Exporter):
+            def __init__(self):
+                self.fail = True
+
+            def export(self, record):
+                if self.fail:
+                    raise RuntimeError("sink down")
+                self.controller.update_last_exported_position(record.position)
+
+        failing = FailingExporter()
+        clock = harness.clock
+        director = ExporterDirector(harness.stream, harness.db,
+                                    {"x": failing}, clock_millis=clock)
+        harness.deploy(one_task())
+        director.export_available()
+        container = director.containers[0]
+        # the watermark did NOT advance for the failed record: nothing was
+        # handed over, so skip()'s pending-ack accounting stays truthful and
+        # the read cursor is pinned on the failed record for retry
+        assert container.last_delivered == container.position == 0
+        assert container.next_position == 1
+        assert container.paused
+        # recover: the same record is retried and the stream drains
+        failing.fail = False
+        clock.advance(60_000)
+        director.export_available()
+        last = harness.stream.last_position
+        assert container.position == last
+        assert not container.paused
+
+
 class SlowAckExporter(Exporter):
     """Acks only every other record — leaves its position behind."""
 
     def __init__(self):
         self.count = 0
+        self.seen = []
 
     def export(self, record):
         self.count += 1
+        self.seen.append(record.position)
         if self.count % 2 == 0:
             self.controller.update_last_exported_position(record.position)
 

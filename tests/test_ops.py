@@ -60,6 +60,37 @@ class TestHealthMonitor:
         mon.report("x", HealthStatus.HEALTHY)
         assert events == [("x", HealthStatus.UNHEALTHY), ("x", HealthStatus.HEALTHY)]
 
+    def test_degraded_keeps_probes_green_but_shows_in_aggregate(self):
+        mon = CriticalComponentsHealthMonitor()
+        mon.register("exporter")
+        mon.report("exporter", HealthStatus.DEGRADED, "backing off")
+        assert mon.status() == HealthStatus.DEGRADED
+        assert mon.is_healthy()  # degraded still serves
+        mon.report("exporter", HealthStatus.UNHEALTHY)
+        assert not mon.is_healthy()
+
+    def test_throwing_listener_does_not_starve_later_listeners(self):
+        mon = CriticalComponentsHealthMonitor()
+        events = []
+
+        def bad(report):
+            raise RuntimeError("listener bug")
+
+        mon.add_listener(bad)
+        mon.add_listener(lambda r: events.append((r.component, r.status)))
+        mon.report("x", HealthStatus.UNHEALTHY)
+        # the later listener saw the change and the monitor is consistent
+        assert events == [("x", HealthStatus.UNHEALTHY)]
+        assert mon.status() == HealthStatus.UNHEALTHY
+
+    def test_deregister_matching_drops_subcomponents(self):
+        mon = CriticalComponentsHealthMonitor()
+        mon.report("partition-1", HealthStatus.HEALTHY)
+        mon.report("partition-1.exporter-es", HealthStatus.DEGRADED)
+        mon.deregister("partition-1")
+        mon.deregister_matching("partition-1.")
+        assert mon.status() == HealthStatus.HEALTHY
+
 
 def _cmd():
     return command(ValueType.PROCESS_INSTANCE_CREATION,
@@ -151,6 +182,48 @@ class TestDiskMonitor:
         clock["now"] = 400
         assert not monitor.check()
         assert events == [True, False]
+
+    def test_stat_failure_treated_as_out_of_space(self, tmp_path):
+        """The data directory vanishing mid-run must pause ingestion, not
+        kill the tick loop with an OSError."""
+        import shutil as _shutil
+
+        clock = {"now": 0}
+        data = tmp_path / "data"
+        data.mkdir()
+        monitor = DiskSpaceMonitor(data, min_free_bytes=1, interval_ms=100,
+                                   clock_millis=lambda: clock["now"])
+        events = []
+        monitor.listeners.append(events.append)
+        assert not monitor.check(0)
+        _shutil.rmtree(data)
+        clock["now"] = 200
+        assert monitor.check()  # paused, no crash
+        assert monitor.free_bytes() == -1
+        assert events == [True]
+        data.mkdir()  # volume comes back: ingestion resumes
+        clock["now"] = 400
+        assert not monitor.check()
+        assert events == [True, False]
+
+    def test_throwing_pause_listener_does_not_block_others(self, tmp_path):
+        clock = {"now": 0}
+        monitor = DiskSpaceMonitor(tmp_path, min_free_bytes=1,
+                                   interval_ms=100,
+                                   clock_millis=lambda: clock["now"])
+        events = []
+
+        def bad(paused):
+            raise RuntimeError("listener bug")
+
+        monitor.listeners.append(bad)
+        monitor.listeners.append(events.append)
+        monitor.min_free_bytes = 2**62
+        clock["now"] = 200
+        assert monitor.check()
+        # the flag flipped and the later listener still heard about it
+        assert monitor.out_of_space
+        assert events == [True]
 
     def test_rate_limited(self, tmp_path):
         clock = {"now": 0}

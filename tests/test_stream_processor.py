@@ -199,6 +199,59 @@ class TestReplay:
         assert proc2.counter(db2) == 8
         journal2.close()
 
+    def test_poison_record_fails_processor_without_propagating(self, tmp_path):
+        """A throwing applier during replay must FAIL this processor (health
+        turns unhealthy, replay stops) — not raise out of the pump and take
+        every co-hosted partition down with it."""
+        journal, stream, db, proc, sp, _ = make_env(tmp_path)
+        sp.start()
+        write_cmd(stream, amount=2)
+        sp.run_until_idle()
+
+        follower_db = ZbDb()
+        follower_proc = CounterProcessor(follower_db)
+        follower = StreamProcessor(stream, follower_db, follower_proc,
+                                   mode=StreamProcessorMode.REPLAY)
+        follower.start()
+        assert follower_proc.counter(follower_db) == 2
+
+        def poison_replay(logged):
+            raise RuntimeError("poison record")
+
+        follower_proc.replay = poison_replay
+        write_cmd(stream, amount=3)
+        sp.run_until_idle()
+        applied = follower.replay_available()  # must not raise
+        assert applied == 0
+        assert follower.phase == Phase.FAILED
+        # failed processor stays down (no retry storm) and state is unchanged
+        assert follower.replay_available() == 0
+        assert follower_proc.counter(follower_db) == 2
+        journal.close()
+
+    def test_poison_record_during_recovery_blocks_processing(self, tmp_path):
+        """A poison record hit during start()'s recovery replay must leave the
+        processor FAILED — becoming a leader over half-replayed state would
+        reprocess logged commands and duplicate their events."""
+        journal, stream, db, proc, sp, _ = make_env(tmp_path)
+        sp.start()
+        write_cmd(stream, amount=2)
+        sp.run_until_idle()
+        journal.close()
+
+        journal2 = SegmentedJournal(tmp_path / "log")
+        stream2 = LogStream(journal2, partition_id=1)
+        db2 = ZbDb()
+        proc2 = CounterProcessor(db2)
+        proc2.replay = lambda logged: (_ for _ in ()).throw(
+            RuntimeError("poison record"))
+        sp2 = StreamProcessor(stream2, db2, proc2)
+        sp2.start()  # must not raise
+        assert sp2.phase == Phase.FAILED
+        with pytest.raises(RuntimeError, match="cannot process"):
+            sp2.process_next()
+        journal2.close()
+
     def test_follower_mode_applies_continuously(self, tmp_path):
         journal, stream, db, proc, sp, _ = make_env(tmp_path)
         sp.start()

@@ -194,7 +194,7 @@ class Broker:
                 "snapshot_index", "raft index of the latest snapshot",
                 ("node", "partition")),
             "health": REGISTRY.gauge(
-                "health", "0=healthy 1=unhealthy 2=dead", ("node",)),
+                "health", "0=healthy 1=degraded 2=unhealthy 3=dead", ("node",)),
             "join_time": REGISTRY.histogram(
                 "partition_server_join_time",
                 "seconds to join a partition at runtime", ("partition",)),
@@ -357,6 +357,7 @@ class Broker:
             kernel_backend_enabled=self.cfg.kernel_backend,
             mesh_runner=self._mesh_runner(),
             durable_state=self.cfg.durable_state,
+            health_monitor=self.health_monitor,
         )
         self.health_monitor.register(f"partition-{partition_id}")
         from zeebe_tpu.utils.metrics import REGISTRY as _REG
@@ -420,6 +421,8 @@ class Broker:
                       f"raft-reconfigure-done-{partition_id}"):
             self.messaging.unsubscribe(topic)
         self.health_monitor.deregister(f"partition-{partition_id}")
+        # per-exporter sub-components ("partition-N.exporter-…") go with it
+        self.health_monitor.deregister_matching(f"partition-{partition_id}.")
         partition.close()
 
     def _request_reconfigure(self, partition_id: int, change: dict) -> None:
@@ -714,7 +717,8 @@ class InProcessCluster:
                  directory: str | Path | None = None,
                  exporters_factory: Callable[[], dict[str, Any]] | None = None,
                  snapshot_period_ms: int = 5 * 60 * 1000,
-                 durable_state: bool = False) -> None:
+                 durable_state: bool = False,
+                 network: LoopbackNetwork | None = None) -> None:
         from zeebe_tpu.testing import ControlledClock
 
         self._tmp = None
@@ -723,9 +727,15 @@ class InProcessCluster:
             directory = self._tmp.name
         self.directory = Path(directory)
         self.clock = ControlledClock()
-        self.net = LoopbackNetwork()
+        # injectable network: the chaos harness passes a fault-injecting
+        # ChaosNetwork; default stays the plain deterministic loopback
+        self.net = network if network is not None else LoopbackNetwork()
         members = [f"broker-{i}" for i in range(broker_count)]
         self.brokers: dict[str, Broker] = {}
+        self._exporters_factory = exporters_factory
+        # crashed brokers' configs, kept for restart_broker (snapshot period
+        # and durable-state settings ride along inside the BrokerCfg)
+        self._stopped_cfgs: dict[str, BrokerCfg] = {}
         for m in members:
             cfg = BrokerCfg(
                 node_id=m, partition_count=partition_count,
@@ -790,6 +800,31 @@ class InProcessCluster:
         position = broker.write_command(partition_id, record)
         self.run(300)
         return position
+
+    def stop_broker(self, node_id: str) -> None:
+        """Crash a broker mid-run: close its journals (durable state stays on
+        disk), drop it from the network so in-flight traffic to it is lost,
+        and forget it until ``restart_broker``."""
+        broker = self.brokers.pop(node_id, None)
+        if broker is None:
+            raise KeyError(f"unknown broker {node_id}")
+        self._stopped_cfgs[node_id] = broker.cfg
+        self.net.leave(node_id)
+        broker.close()
+
+    def restart_broker(self, node_id: str) -> Broker:
+        """Rebuild a crashed broker over its on-disk directory: raft journal,
+        stream journal, and snapshots recover exactly as a real process
+        restart would (reference: ClusteringRule.restartBroker)."""
+        cfg = self._stopped_cfgs.pop(node_id, None)
+        if cfg is None:
+            raise KeyError(f"broker {node_id} was not stopped")
+        broker = Broker(
+            cfg, self.net.join(node_id), directory=self.directory / node_id,
+            clock_millis=self.clock, exporters_factory=self._exporters_factory,
+        )
+        self.brokers[node_id] = broker
+        return broker
 
     def add_broker(self, node_id: str) -> Broker:
         """Start a NEW broker that joins the running cluster with no

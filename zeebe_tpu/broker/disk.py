@@ -13,6 +13,8 @@ import shutil
 from pathlib import Path
 from typing import Callable
 
+from zeebe_tpu.utils.zlogging import Loggers
+
 
 class DiskSpaceMonitor:
     def __init__(self, directory: str | Path, min_free_bytes: int,
@@ -29,7 +31,16 @@ class DiskSpaceMonitor:
         self.listeners: list[Callable[[bool], None]] = []
 
     def free_bytes(self) -> int:
-        return shutil.disk_usage(self.directory).free
+        """Free bytes on the data volume; -1 when the directory cannot be
+        statted (vanished / unmounted mid-run) — the caller must treat that
+        as out-of-space, not crash the tick loop."""
+        try:
+            return shutil.disk_usage(self.directory).free
+        except OSError:
+            Loggers.SYSTEM.exception(
+                "disk usage check failed for %s — treating as out of space",
+                self.directory)
+            return -1
 
     def check(self, now_millis: int | None = None) -> bool:
         """Returns True when ingestion must pause. Rate-limited by interval."""
@@ -39,7 +50,14 @@ class DiskSpaceMonitor:
         self._last_check_ms = now
         below = self.free_bytes() < self.min_free_bytes
         if below != self.out_of_space:
+            # flip the flag BEFORE notifying: a throwing listener must not
+            # leave the monitor claiming the old state
             self.out_of_space = below
             for listener in self.listeners:
-                listener(below)
+                try:
+                    listener(below)
+                except Exception:  # noqa: BLE001 — pause/resume must reach
+                    # every remaining listener even if one throws
+                    Loggers.SYSTEM.exception(
+                        "disk-space listener failed (out_of_space=%s)", below)
         return self.out_of_space

@@ -100,6 +100,7 @@ class ZeebePartition:
         kernel_backend_enabled: bool = True,
         mesh_runner=None,
         durable_state: bool = False,
+        health_monitor=None,
     ) -> None:
         self.partition_id = partition_id
         self.partition_count = partition_count
@@ -121,6 +122,9 @@ class ZeebePartition:
         self.kernel_backend_enabled = kernel_backend_enabled
         self.mesh_runner = mesh_runner
         self.durable_state = durable_state
+        # broker health monitor (CriticalComponentsHealthMonitor | None): the
+        # exporter director reports per-exporter DEGRADED/HEALTHY through it
+        self.health_monitor = health_monitor
         # client-ingress backpressure (CommandRateLimiter | None) and the
         # disk-monitor pause flag; both gate client_write only — follow-ups,
         # scheduled commands, and inter-partition traffic always pass
@@ -247,8 +251,16 @@ class ZeebePartition:
         )
         if self.exporter_director is not None:
             self.exporter_director.close()  # flush partial bulks, run Exporter.close
+        if self.health_monitor is not None:
+            # fresh containers know nothing of the old ones' failures: a
+            # stale DEGRADED report must not outlive the director it came
+            # from (the new director re-reports on its own first failure)
+            self.health_monitor.deregister_matching(
+                f"partition-{self.partition_id}.exporter-")
         self.exporter_director = ExporterDirector(
             self.stream, self.db, self.exporters_factory(),
+            clock_millis=self.clock_millis,
+            on_health=self._report_exporter_health,
         )
         self.engine.checkpoint.listeners.append(self._on_checkpoint_created)
         # lock-free checkpoint-id cache: refreshed here on the owner thread
@@ -599,6 +611,15 @@ class ZeebePartition:
             self.on_checkpoint(checkpoint_id)
         if self.backup_service is not None:
             self.backup_service.take_backup(self, checkpoint_id, position)
+
+    def _report_exporter_health(self, exporter_id: str, status,
+                                message: str = "") -> None:
+        """Per-exporter health sub-component under this partition (a backing-
+        off exporter degrades the broker without taking the partition down)."""
+        if self.health_monitor is not None:
+            self.health_monitor.report(
+                f"partition-{self.partition_id}.exporter-{exporter_id}",
+                status, message)
 
     @property
     def is_leader(self) -> bool:

@@ -120,6 +120,12 @@ class LoopbackNetwork:
         self.members[member_id] = svc
         return svc
 
+    def leave(self, member_id: str) -> None:
+        """Remove a member (crashed broker): in-flight traffic to it drops
+        like to a dead host, and its stale handlers can never dispatch into
+        closed journals. A later ``join`` re-registers fresh handlers."""
+        self.members.pop(member_id, None)
+
     # -- fault injection ------------------------------------------------------
 
     def partition(self, a: str, b: str) -> None:

@@ -1,7 +1,11 @@
 """Exporter SPI + built-in exporters (SURVEY.md §2.13 exporters)."""
 
 from zeebe_tpu.exporters.api import Exporter, ExporterContext, ExporterController
-from zeebe_tpu.exporters.director import ExporterDirector, ExportersState
+from zeebe_tpu.exporters.director import (
+    ExporterContainer,
+    ExporterDirector,
+    ExportersState,
+)
 from zeebe_tpu.exporters.elasticsearch import (
     AuthenticationConfiguration,
     AwsConfiguration,
@@ -18,6 +22,7 @@ __all__ = [
     "AwsConfiguration",
     "BulkConfiguration",
     "Exporter",
+    "ExporterContainer",
     "ExporterContext",
     "ExporterController",
     "ExporterDirector",

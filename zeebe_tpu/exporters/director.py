@@ -8,11 +8,18 @@ family (ExportersState), and reporting the minimum acknowledged position so
 log compaction never deletes unexported records.
 
 Here the director is pump-driven like the stream processor (the broker pump
-calls ``export_available()`` after each processing round); an exporter that
-throws is retried on the same record forever (reference behavior: export is
-at-least-once, the director does not skip)."""
+calls ``export_available()`` after each processing round). Fault isolation is
+per exporter: a throwing exporter pauses ITSELF with exponential retry backoff
+(position pinned on the failed record — export stays at-least-once, the
+director never skips), reports DEGRADED to the health monitor, and the other
+exporters keep draining; each container owns its own read cursor so one
+failing sink never stalls the rest (reference behavior: ExporterContainer
+retries forever, but the reference runs one actor per exporter — isolation is
+what the shared pump must reproduce)."""
 
 from __future__ import annotations
+
+import time as _time_mod
 
 from typing import Callable
 
@@ -20,6 +27,13 @@ from zeebe_tpu.exporters.api import Exporter, ExporterContext, ExporterControlle
 from zeebe_tpu.logstreams import LogStream
 from zeebe_tpu.state import ZbDb
 from zeebe_tpu.state.db import ColumnFamilyCode as CF
+from zeebe_tpu.utils.health import HealthStatus
+from zeebe_tpu.utils.zlogging import Loggers
+
+# exponential retry backoff for a failing exporter (reference: the ES
+# exporter's own client retries; here the seam is generic per container)
+INITIAL_BACKOFF_MS = 100
+MAX_BACKOFF_MS = 10_000
 
 
 class ExecutionLatencyObserver:
@@ -130,16 +144,28 @@ class ExporterContainer:
     def __init__(self, exporter_id: str, exporter: Exporter,
                  state: "ExportersState",
                  configuration: dict | None = None,
-                 partition_id: int = 0) -> None:
+                 partition_id: int = 0,
+                 on_health: Callable[[str, HealthStatus, str], None] | None = None) -> None:
         self.exporter_id = exporter_id
         self.exporter = exporter
         self.state = state
         self.position = state.position(exporter_id)
-        # highest position handed to the exporter but not yet acked; a skip may
-        # only advance the persisted position when nothing is pending, or a
-        # crash-before-flush loses the buffered records to compaction
-        # (reference: ExporterContainer.updateLastExportedRecordPosition)
+        # highest position handed to the exporter AND exported without error
+        # but not yet acked; a skip may only advance the persisted position
+        # when nothing is pending, or a crash-before-flush loses the buffered
+        # records to compaction (reference:
+        # ExporterContainer.updateLastExportedRecordPosition)
         self.last_delivered = self.position
+        # per-container read cursor: restart resumes after the last ack
+        # (at-least-once — unacked records are re-seen), and a backing-off
+        # container catches up from here without stalling its siblings
+        self.next_position = self.position + 1
+        # retry-with-backoff state: consecutive failures and the millis
+        # timestamp before which deliveries are suspended
+        self.consecutive_failures = 0
+        self.paused_until_ms: int | None = None
+        self.last_error = ""
+        self._on_health = on_health
         exporter.configure(ExporterContext(exporter_id, configuration or {}))
         exporter.open(ExporterController(
             self._update_position,  # (position, metadata): atomic persist
@@ -155,16 +181,69 @@ class ExporterContainer:
             "exporter_events_exported_total",
             "records handed to an exporter", ("exporter", "partition")
         ).labels(exporter_id, str(partition_id))
+        self._m_failures = REGISTRY.counter(
+            "exporter_failures_total",
+            "export calls that raised", ("exporter", "partition")
+        ).labels(exporter_id, str(partition_id))
 
-    def deliver(self, record) -> None:
+    @property
+    def paused(self) -> bool:
+        return self.paused_until_ms is not None
+
+    def maybe_resume(self, now_millis: int) -> None:
+        """Open the retry window once the backoff expired; the failure count
+        is kept so the NEXT failure backs off longer."""
+        if self.paused_until_ms is not None and now_millis >= self.paused_until_ms:
+            self.paused_until_ms = None
+
+    def deliver(self, record, now_millis: int = 0) -> bool:
+        """Hand one record to the exporter. On failure the position is pinned
+        (``last_delivered``/``next_position`` stay put so the SAME record is
+        retried), the container backs off exponentially, and health goes
+        DEGRADED; returns False so the director moves on to the siblings."""
+        try:
+            self.exporter.export(record)
+        except Exception as exc:  # noqa: BLE001 — exporter plugins are
+            # third-party code; one bad sink must not poison the export loop
+            self.consecutive_failures += 1
+            backoff = min(
+                INITIAL_BACKOFF_MS * (2 ** (self.consecutive_failures - 1)),
+                MAX_BACKOFF_MS,
+            )
+            self.paused_until_ms = now_millis + backoff
+            self.last_error = f"{type(exc).__name__}: {exc}"
+            self._m_failures.inc()
+            Loggers.exporter_logger(self.exporter_id).exception(
+                "exporter %s failed on record %d (failure #%d) — backing off "
+                "%d ms", self.exporter_id, record.position,
+                self.consecutive_failures, backoff)
+            self._report_health(
+                HealthStatus.DEGRADED,
+                f"retry #{self.consecutive_failures} in {backoff}ms after "
+                f"{self.last_error}",
+            )
+            return False
+        # the watermark advances ONLY after a successful export: a failed
+        # export must not let skip() treat the record as pending-acked (the
+        # stale watermark would corrupt the pending-ack accounting)
         self.last_delivered = record.position
-        self.exporter.export(record)
+        self.next_position = record.position + 1
         self._m_exported.inc()
+        if self.consecutive_failures:
+            self.consecutive_failures = 0
+            self.last_error = ""
+            self._report_health(HealthStatus.HEALTHY, "recovered")
+        return True
 
     def skip(self, position: int) -> None:
         if self.last_delivered <= self.position:  # nothing unacked in flight
             self._update_position(position)
         self.last_delivered = max(self.last_delivered, position)
+        self.next_position = max(self.next_position, position + 1)
+
+    def _report_health(self, status: HealthStatus, message: str) -> None:
+        if self._on_health is not None:
+            self._on_health(self.exporter_id, status, message)
 
     def _update_position(self, position: int,
                          metadata: bytes | None = None) -> None:
@@ -228,9 +307,13 @@ class ExporterDirector:
     def __init__(self, stream: LogStream, db: ZbDb,
                  exporters: dict[str, "Exporter | tuple[Exporter, dict]"],
                  configurations: dict[str, dict] | None = None,
-                 commit_position: Callable[[], int] | None = None) -> None:
+                 commit_position: Callable[[], int] | None = None,
+                 clock_millis: Callable[[], int] | None = None,
+                 on_health: Callable[[str, HealthStatus, str], None] | None = None) -> None:
         self.stream = stream
         self.state = ExportersState(db)
+        self.clock_millis = clock_millis or (
+            lambda: int(_time_mod.time() * 1000))
         # an entry may be (exporter, configuration) — the shape the
         # env-driven external-artifact loader produces (utils/external_code);
         # normalizing HERE keeps every construction site shape-agnostic
@@ -244,14 +327,17 @@ class ExporterDirector:
         self.containers = [
             ExporterContainer(eid, exp, self.state,
                               configurations.get(eid),
-                              partition_id=stream.partition_id)
+                              partition_id=stream.partition_id,
+                              on_health=on_health)
             for eid, exp in normalized.items()
         ]
         # committed-position supplier: records past it are not yet safe to
         # export (Raft quorum); None = everything in the log is committed
         self.commit_position = commit_position
-        # resume from the lowest acknowledged position (a restarted exporter
-        # re-sees records after its last ack — at-least-once)
+        # director-level bookkeeping cursor (latency metrics observe each
+        # record once); starts at the lowest acknowledged position — a
+        # restarted exporter re-sees records after its last ack
+        # (at-least-once)
         self._next_position = min(
             (c.position for c in self.containers), default=0
         ) + 1
@@ -268,31 +354,74 @@ class ExporterDirector:
             "exporter_last_updated_exported_position",
             "lowest acknowledged exporter position", ("partition",)).labels(pid)
 
+    def _offer(self, container: "ExporterContainer", logged, now: int) -> None:
+        """Hand one due record to a container (filter-skip or deliver; a
+        failed delivery pauses the container and pins its cursor)."""
+        if logged.position <= container.position:
+            # already acked (restart resume): advance the cursor only
+            container.next_position = logged.position + 1
+            return
+        ctx = container.exporter.context
+        if ctx.record_filter is not None and not ctx.record_filter(logged):
+            container.skip(logged.position)
+        else:
+            container.deliver(logged, now)
+
     def export_available(self, max_records: int = 10_000) -> int:
-        """Export committed records not yet seen; returns how many."""
-        count = 0
+        """Export committed records not yet seen; returns the work done this
+        round (max of new records visited and per-container catch-up
+        deliveries — a container draining backlog after backoff is work even
+        when the director cursor is already at the head, or drain loops would
+        stop pumping with backlog still pending). A failing exporter backs
+        off alone while the rest advance. Steady state (all cursors at the
+        head) is ONE reader pass; a lagging container (resumed from backoff
+        or restart) gets its own bounded catch-up scan."""
+        now = self.clock_millis()
         limit = self.commit_position() if self.commit_position else None
+        for container in self.containers:
+            container.maybe_resume(now)
+        # catch-up: containers whose cursor fell behind the director cursor
+        max_catch_up = 0
+        for container in self.containers:
+            if container.paused or container.next_position >= self._next_position:
+                continue
+            n = 0
+            for logged in self.stream.new_reader(container.next_position):
+                if logged.position >= self._next_position:
+                    break  # reached the head: the shared pass takes over
+                if limit is not None and logged.position > limit:
+                    break
+                self._offer(container, logged, now)
+                if container.paused:
+                    break
+                n += 1
+                if n >= max_records:
+                    break
+            max_catch_up = max(max_catch_up, n)
+        # shared head pass: containers at (or beyond) the head when the pass
+        # starts, plus the director-level bookkeeping (latency observation +
+        # event count, once per record). Cursor comparisons are ranges, not
+        # exact matches — materialized positions may gap where a position
+        # range was consumed by a raft entry that never committed
+        eligible = [c for c in self.containers
+                    if not c.paused and c.next_position >= self._next_position]
+        count = 0
         for logged in self.stream.new_reader(self._next_position):
             if limit is not None and logged.position > limit:
                 break
-            for container in self.containers:
-                if logged.position <= container.position:
-                    continue  # already acked by this exporter (restart resume)
-                ctx = container.exporter.context
-                if ctx.record_filter is not None and not ctx.record_filter(logged):
-                    container.skip(logged.position)
-                    continue
-                container.deliver(logged)
+            for container in eligible:
+                if not container.paused and container.next_position <= logged.position:
+                    self._offer(container, logged, now)
             self._latency.observe(logged)
             self._m_events.inc()
             self._next_position = logged.position + 1
             count += 1
             if count >= max_records:
                 break
-        if count:
+        if count or max_catch_up:
             self._m_last_updated.set(
                 min((c.position for c in self.containers), default=-1))
-        return count
+        return max(count, max_catch_up)
 
     def lowest_exporter_position(self) -> int:
         """Log compaction bound (reference: min exporter position vs snapshot
@@ -305,4 +434,9 @@ class ExporterDirector:
 
     def close(self) -> None:
         for container in self.containers:
-            container.exporter.close()
+            try:
+                container.exporter.close()
+            except Exception:  # noqa: BLE001 — one exporter's close failure
+                # must not leak the remaining exporters' buffered flushes
+                Loggers.exporter_logger(container.exporter_id).exception(
+                    "exporter %s failed to close", container.exporter_id)

@@ -24,6 +24,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from zeebe_tpu.ops.automaton import DeviceTables, step
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """jax.shard_map across JAX versions: older releases ship it as
+    jax.experimental.shard_map with the replication check named ``check_rep``
+    instead of ``check_vma``."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)
+
+
 def make_mesh(n_devices: int | None = None) -> Mesh:
     devices = jax.devices()
     if n_devices is not None:
@@ -77,7 +90,7 @@ def make_sharded_step(mesh: Mesh, auto_jobs: bool = True, config=None):
         new_state["overflow"] = overflow_any
         return new_state
 
-    sharded = jax.shard_map(
+    sharded = shard_map_compat(
         local_step,
         mesh=mesh,
         in_specs=(

@@ -34,7 +34,7 @@ from typing import Any
 
 import numpy as np
 
-from zeebe_tpu.parallel.mesh import make_mesh, state_specs
+from zeebe_tpu.parallel.mesh import make_mesh, shard_map_compat, state_specs
 
 
 @dataclass
@@ -253,7 +253,7 @@ class MeshKernelRunner:
                     new_state[name] = new_state[name][None]
                 return new_state, packed
 
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(shard_map_compat(
                 local,
                 mesh=self.mesh,
                 in_specs=(

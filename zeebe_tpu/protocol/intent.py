@@ -15,6 +15,25 @@ import enum
 
 from zeebe_tpu.protocol.enums import ValueType
 
+try:
+    _nonmember = enum.nonmember
+except AttributeError:  # Python < 3.11
+    class _NonMember:
+        """Descriptor stand-in for enum.nonmember, local to this module (the
+        stdlib is not patched): the EnumDict skips descriptors when
+        collecting members, and attribute access unwraps to the original
+        value — the same observable behavior the declarations below rely on."""
+
+        __slots__ = ("_value",)
+
+        def __init__(self, value):
+            self._value = value
+
+        def __get__(self, obj, objtype=None):
+            return self._value
+
+    _nonmember = _NonMember
+
 
 class Intent(enum.IntEnum):
     """Base class marker; all concrete intents subclass this via IntEnum idiom."""
@@ -52,7 +71,7 @@ class ProcessInstanceIntent(Intent):
     COMPLETE_ELEMENT = 9
     TERMINATE_ELEMENT = 10
 
-    _EVENT_NAMES = enum.nonmember(frozenset(
+    _EVENT_NAMES = _nonmember(frozenset(
         {
             "SEQUENCE_FLOW_TAKEN",
             "ELEMENT_ACTIVATING",
@@ -70,27 +89,27 @@ class ProcessInstanceCreationIntent(Intent):
     CREATED = 1
     CREATE_WITH_AWAITING_RESULT = 2
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"CREATED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"CREATED"}))
 
 
 class ProcessInstanceResultIntent(Intent):
     COMPLETED = 0
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"COMPLETED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"COMPLETED"}))
 
 
 class ProcessInstanceModificationIntent(Intent):
     MODIFY = 0
     MODIFIED = 1
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"MODIFIED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"MODIFIED"}))
 
 
 class ProcessInstanceMigrationIntent(Intent):
     MIGRATE = 0
     MIGRATED = 1
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"MIGRATED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"MIGRATED"}))
 
 
 class ProcessInstanceBatchIntent(Intent):
@@ -99,7 +118,7 @@ class ProcessInstanceBatchIntent(Intent):
     TERMINATE = 2
     TERMINATED = 3
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"ACTIVATED", "TERMINATED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"ACTIVATED", "TERMINATED"}))
 
 
 class JobIntent(Intent):
@@ -125,7 +144,7 @@ class JobIntent(Intent):
     UPDATE_TIMEOUT = 17
     TIMEOUT_UPDATED = 18
 
-    _EVENT_NAMES = enum.nonmember(frozenset(
+    _EVENT_NAMES = _nonmember(frozenset(
         {
             "CREATED",
             "COMPLETED",
@@ -145,7 +164,7 @@ class JobBatchIntent(Intent):
     ACTIVATE = 0
     ACTIVATED = 1
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"ACTIVATED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"ACTIVATED"}))
 
 
 class DeploymentIntent(Intent):
@@ -155,7 +174,7 @@ class DeploymentIntent(Intent):
     DISTRIBUTED = 3
     FULLY_DISTRIBUTED = 4
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"CREATED", "DISTRIBUTED", "FULLY_DISTRIBUTED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"CREATED", "DISTRIBUTED", "FULLY_DISTRIBUTED"}))
 
 
 class DeploymentDistributionIntent(Intent):
@@ -163,7 +182,7 @@ class DeploymentDistributionIntent(Intent):
     COMPLETE = 1
     COMPLETED = 2
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"DISTRIBUTING", "COMPLETED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"DISTRIBUTING", "COMPLETED"}))
 
 
 class ProcessIntent(Intent):
@@ -171,7 +190,7 @@ class ProcessIntent(Intent):
     DELETING = 1
     DELETED = 2
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"CREATED", "DELETING", "DELETED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"CREATED", "DELETING", "DELETED"}))
 
 
 class MessageIntent(Intent):
@@ -180,7 +199,7 @@ class MessageIntent(Intent):
     EXPIRE = 2
     EXPIRED = 3
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"PUBLISHED", "EXPIRED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"PUBLISHED", "EXPIRED"}))
 
 
 class MessageBatchIntent(Intent):
@@ -191,7 +210,7 @@ class MessageBatchIntent(Intent):
     EXPIRE = 0
     EXPIRED = 1
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"EXPIRED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"EXPIRED"}))
 
 
 class MessageSubscriptionIntent(Intent):
@@ -205,7 +224,7 @@ class MessageSubscriptionIntent(Intent):
     DELETE = 7
     DELETED = 8
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"CREATED", "CORRELATING", "CORRELATED", "REJECTED", "DELETED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"CREATED", "CORRELATING", "CORRELATED", "REJECTED", "DELETED"}))
 
 
 class ProcessMessageSubscriptionIntent(Intent):
@@ -218,7 +237,7 @@ class ProcessMessageSubscriptionIntent(Intent):
     DELETE = 6
     DELETED = 7
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"CREATING", "CREATED", "CORRELATED", "DELETING", "DELETED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"CREATING", "CREATED", "CORRELATED", "DELETING", "DELETED"}))
 
 
 class MessageStartEventSubscriptionIntent(Intent):
@@ -226,7 +245,7 @@ class MessageStartEventSubscriptionIntent(Intent):
     CORRELATED = 1
     DELETED = 2
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"CREATED", "CORRELATED", "DELETED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"CREATED", "CORRELATED", "DELETED"}))
 
 
 class TimerIntent(Intent):
@@ -235,7 +254,7 @@ class TimerIntent(Intent):
     TRIGGERED = 2
     CANCELED = 3
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"CREATED", "TRIGGERED", "CANCELED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"CREATED", "TRIGGERED", "CANCELED"}))
 
 
 class IncidentIntent(Intent):
@@ -243,7 +262,7 @@ class IncidentIntent(Intent):
     RESOLVE = 1
     RESOLVED = 2
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"CREATED", "RESOLVED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"CREATED", "RESOLVED"}))
 
 
 class VariableIntent(Intent):
@@ -251,41 +270,41 @@ class VariableIntent(Intent):
     UPDATED = 1
     MIGRATED = 2
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"CREATED", "UPDATED", "MIGRATED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"CREATED", "UPDATED", "MIGRATED"}))
 
 
 class VariableDocumentIntent(Intent):
     UPDATE = 0
     UPDATED = 1
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"UPDATED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"UPDATED"}))
 
 
 class ErrorIntent(Intent):
     CREATED = 0
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"CREATED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"CREATED"}))
 
 
 class ProcessEventIntent(Intent):
     TRIGGERING = 0
     TRIGGERED = 1
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"TRIGGERING", "TRIGGERED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"TRIGGERING", "TRIGGERED"}))
 
 
 class DecisionIntent(Intent):
     CREATED = 0
     DELETED = 1
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"CREATED", "DELETED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"CREATED", "DELETED"}))
 
 
 class DecisionRequirementsIntent(Intent):
     CREATED = 0
     DELETED = 1
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"CREATED", "DELETED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"CREATED", "DELETED"}))
 
 
 class DecisionEvaluationIntent(Intent):
@@ -293,28 +312,28 @@ class DecisionEvaluationIntent(Intent):
     FAILED = 1
     EVALUATE = 2  # standalone evaluation command (gateway EvaluateDecision rpc)
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"EVALUATED", "FAILED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"EVALUATED", "FAILED"}))
 
 
 class EscalationIntent(Intent):
     ESCALATED = 0
     NOT_ESCALATED = 1
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"ESCALATED", "NOT_ESCALATED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"ESCALATED", "NOT_ESCALATED"}))
 
 
 class SignalIntent(Intent):
     BROADCAST = 0
     BROADCASTED = 1
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"BROADCASTED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"BROADCASTED"}))
 
 
 class SignalSubscriptionIntent(Intent):
     CREATED = 0
     DELETED = 1
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"CREATED", "DELETED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"CREATED", "DELETED"}))
 
 
 class ResourceDeletionIntent(Intent):
@@ -322,7 +341,7 @@ class ResourceDeletionIntent(Intent):
     DELETING = 1
     DELETED = 2
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"DELETING", "DELETED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"DELETING", "DELETED"}))
 
 
 class CommandDistributionIntent(Intent):
@@ -335,7 +354,7 @@ class CommandDistributionIntent(Intent):
     ACKNOWLEDGED = 3
     FINISHED = 4
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"STARTED", "DISTRIBUTING", "ACKNOWLEDGED", "FINISHED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"STARTED", "DISTRIBUTING", "ACKNOWLEDGED", "FINISHED"}))
 
 
 class CheckpointIntent(Intent):
@@ -343,14 +362,14 @@ class CheckpointIntent(Intent):
     CREATED = 1
     IGNORED = 2
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"CREATED", "IGNORED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"CREATED", "IGNORED"}))
 
 
 class FormIntent(Intent):
     CREATED = 0
     DELETED = 1
 
-    _EVENT_NAMES = enum.nonmember(frozenset({"CREATED", "DELETED"}))
+    _EVENT_NAMES = _nonmember(frozenset({"CREATED", "DELETED"}))
 
 
 class UserTaskIntent(Intent):
@@ -369,7 +388,7 @@ class UserTaskIntent(Intent):
     UPDATING = 12
     UPDATED = 13
 
-    _EVENT_NAMES = enum.nonmember(frozenset(
+    _EVENT_NAMES = _nonmember(frozenset(
         {
             "CREATING",
             "CREATED",

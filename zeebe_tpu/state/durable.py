@@ -47,6 +47,102 @@ from zeebe_tpu.native import codec_fn as _codec_fn
 from zeebe_tpu.protocol import msgpack
 from zeebe_tpu.state.db import ZbDb, _DELETED
 
+try:
+    from sortedcontainers import SortedList
+except ImportError:
+    from bisect import bisect_left, bisect_right, insort
+
+    class SortedList:  # type: ignore[no-redef]
+        """Blocked sorted list fallback for environments without
+        sortedcontainers: the surface this module touches (add / discard /
+        irange / iter / len) with the same O(sqrt n) insert bound — keys live
+        in ≤2·LOAD blocks indexed by a bisect over per-block maxima, so an
+        insert memmoves one block, never the whole key set."""
+
+        __slots__ = ("_lists", "_maxes", "_len")
+
+        LOAD = 512
+
+        def __init__(self, iterable=()) -> None:
+            keys = sorted(iterable)
+            self._lists = [keys[i:i + self.LOAD]
+                           for i in range(0, len(keys), self.LOAD)]
+            self._maxes = [blk[-1] for blk in self._lists]
+            self._len = len(keys)
+
+        def add(self, key) -> None:
+            if not self._lists:
+                self._lists.append([key])
+                self._maxes.append(key)
+                self._len = 1
+                return
+            i = bisect_left(self._maxes, key)
+            if i == len(self._lists):
+                i -= 1
+            blk = self._lists[i]
+            insort(blk, key)
+            self._len += 1
+            if len(blk) > 2 * self.LOAD:
+                half = len(blk) // 2
+                self._lists[i:i + 1] = [blk[:half], blk[half:]]
+                self._maxes[i:i + 1] = [blk[half - 1], blk[-1]]
+            else:
+                self._maxes[i] = blk[-1]
+
+        def discard(self, key) -> None:
+            i = bisect_left(self._maxes, key)
+            if i == len(self._lists):
+                return
+            blk = self._lists[i]
+            j = bisect_left(blk, key)
+            if j == len(blk) or blk[j] != key:
+                return
+            del blk[j]
+            self._len -= 1
+            if blk:
+                self._maxes[i] = blk[-1]
+            else:
+                del self._lists[i]
+                del self._maxes[i]
+
+        def irange(self, minimum=None, maximum=None,
+                   inclusive=(True, True)):
+            lists, maxes = self._lists, self._maxes
+
+            def gen():
+                if not lists:
+                    return
+                if minimum is None:
+                    bi, ki = 0, 0
+                else:
+                    bi = bisect_left(maxes, minimum)
+                    if bi == len(lists):
+                        return
+                    cut = bisect_left if inclusive[0] else bisect_right
+                    ki = cut(lists[bi], minimum)
+                while bi < len(lists):
+                    blk = lists[bi]
+                    while ki < len(blk):
+                        key = blk[ki]
+                        if maximum is not None and (
+                                key > maximum
+                                or (not inclusive[1] and key == maximum)):
+                            return
+                        yield key
+                        ki += 1
+                    bi += 1
+                    ki = 0
+
+            return gen()
+
+        def __iter__(self):
+            for blk in self._lists:
+                yield from blk
+
+        def __len__(self) -> int:
+            return self._len
+
+
 _index_base_segment = _codec_fn("index_base_segment")
 
 _FRAME = struct.Struct("<II")  # WAL frame: length, crc32
@@ -137,8 +233,6 @@ class DurableZbDb(ZbDb):
         """Field setup shared by the constructor and ``open()`` (which
         bypasses ``__init__`` to stage recovery lazily)."""
         import threading
-
-        from sortedcontainers import SortedList
 
         # cold values need per-read resolution, which the native iterate
         # cannot do — use the (identical-semantics) Python merge path; and
@@ -456,8 +550,6 @@ class DurableZbDb(ZbDb):
             # key order: the base arrives sorted (SortedList construction
             # from sorted input is a cheap O(n) pass); patch the (typically
             # tiny) WAL key-set delta in with O(sqrt n) adds/discards
-            from sortedcontainers import SortedList
-
             keys = SortedList(base_keys)
             base_set = set(base_keys) if touched else None
             for key in touched:
@@ -570,8 +662,6 @@ class DurableZbDb(ZbDb):
         reflect it."""
         self._ensure_recovered()  # settle staged work before wholesale replace
         restored = ZbDb.from_snapshot_bytes(raw)
-        from sortedcontainers import SortedList
-
         self._data = restored._data
         self._sorted_keys = SortedList(restored._sorted_keys)
         self._hot.clear()

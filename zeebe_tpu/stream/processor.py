@@ -248,6 +248,11 @@ class StreamProcessor:
         self._reader_position = 1 if self.last_processed_position < 0 else self.last_processed_position + 1
         self.replay_available()
         self._m_recovery_time.set(_time.perf_counter() - recovery_start)
+        if self.phase == Phase.FAILED:
+            # a poison record during recovery replay FAILED the processor;
+            # becoming a leader over half-replayed state would silently
+            # reprocess logged commands and duplicate their events
+            return
         if self.mode == StreamProcessorMode.PROCESSING:
             self.phase = Phase.PROCESSING
             # processing scans from the start of the unreplayed suffix
@@ -259,9 +264,16 @@ class StreamProcessor:
 
     def replay_available(self) -> int:
         """Apply committed events not yet reflected in state. Returns number of
-        events applied. In REPLAY mode this is the follower's steady state."""
+        events applied. In REPLAY mode this is the follower's steady state.
+
+        A throwing applier (poison record, applier bug) FAILS this processor —
+        replay stops, the partition reports unhealthy — instead of propagating
+        into the broker pump and taking every co-hosted partition down with it
+        (reference: StreamProcessor onFailure → Phase.FAILED + health DEAD)."""
         import time as _time
 
+        if self.phase == Phase.FAILED:
+            return 0
         applied = 0
         position = self._reader_position
         while True:
@@ -270,31 +282,43 @@ class StreamProcessor:
                 break
             batch = self.log_stream.read_batch_containing(logged.position)
             batch_start = _time.perf_counter()
-            with self.db.transaction():
-                max_source = -1
-                for rec in batch:
-                    if rec.position < position:
-                        continue
-                    # Skip events already reflected in state: their producing
-                    # command's position (source backlink) is <= the recovered
-                    # last-processed position. This is what makes snapshot +
-                    # replay idempotent (reference: ReplayStateMachine skips
-                    # up to the snapshot's processed position).
-                    if rec.source_position > self.last_processed_position:
-                        if rec.record.is_event:
-                            self.processor.replay(rec)
-                            applied += 1
-                            if rec.source_position > max_source:
-                                max_source = rec.source_position
-                        elif rec.record.is_rejection:
-                            # a rejection-only step still marks its command
-                            # processed, else restart reprocesses it and
-                            # duplicates the rejection + client response
-                            if rec.source_position > max_source:
-                                max_source = rec.source_position
-                if max_source > self.last_processed_position:
-                    self.last_processed_position = max_source
-                    self._store_last_processed(max_source)
+            try:
+                with self.db.transaction():
+                    max_source = -1
+                    batch_applied = 0
+                    for rec in batch:
+                        if rec.position < position:
+                            continue
+                        # Skip events already reflected in state: their
+                        # producing command's position (source backlink) is <=
+                        # the recovered last-processed position. This is what
+                        # makes snapshot + replay idempotent (reference:
+                        # ReplayStateMachine skips up to the snapshot's
+                        # processed position).
+                        if rec.source_position > self.last_processed_position:
+                            if rec.record.is_event:
+                                self.processor.replay(rec)
+                                batch_applied += 1
+                                if rec.source_position > max_source:
+                                    max_source = rec.source_position
+                            elif rec.record.is_rejection:
+                                # a rejection-only step still marks its command
+                                # processed, else restart reprocesses it and
+                                # duplicates the rejection + client response
+                                if rec.source_position > max_source:
+                                    max_source = rec.source_position
+                    if max_source > self.last_processed_position:
+                        self.last_processed_position = max_source
+                        self._store_last_processed(max_source)
+                applied += batch_applied
+            except Exception:  # noqa: BLE001 — the transaction rolled back
+                # (the failed batch's events count for nothing); retrying the
+                # same batch would throw forever
+                self.phase = Phase.FAILED
+                logger.exception(
+                    "replay failed in batch at position %d; partition marked "
+                    "unhealthy (restart or failover to recover)", position)
+                return applied
             self._m_replay_duration.observe(_time.perf_counter() - batch_start)
             if max_source >= 0:
                 self._m_replay_last_source.set(max_source)

@@ -12,12 +12,17 @@ from __future__ import annotations
 import enum
 from typing import Callable
 
+from zeebe_tpu.utils.zlogging import Loggers
+
 
 class HealthStatus(enum.IntEnum):
     # ordered by severity so aggregation is max()
     HEALTHY = 0
-    UNHEALTHY = 1
-    DEAD = 2
+    # a component is limping but self-healing (e.g. an exporter in retry
+    # backoff): the node keeps serving, probes stay green, operators see it
+    DEGRADED = 1
+    UNHEALTHY = 2
+    DEAD = 3
 
 
 class HealthReport:
@@ -53,13 +58,28 @@ class CriticalComponentsHealthMonitor:
         its last report must not pin the aggregate health forever."""
         self._components.pop(component, None)
 
+    def deregister_matching(self, prefix: str) -> None:
+        """Forget every component under a prefix (a stopped partition takes
+        its exporter sub-components with it)."""
+        for component in [c for c in self._components if c.startswith(prefix)]:
+            self._components.pop(component, None)
+
     def report(self, component: str, status: HealthStatus, message: str = "") -> None:
+        # the component map is updated BEFORE listeners fire: a listener that
+        # throws (or reads back status()) must observe the new report, never
+        # a half-applied monitor
         previous = self._components.get(component)
         report = HealthReport(component, status, message)
         self._components[component] = report
         if previous is None or previous.status != status:
             for listener in self._listeners:
-                listener(report)
+                try:
+                    listener(report)
+                except Exception:  # noqa: BLE001 — one bad listener must not
+                    # starve the rest (probes, metrics) of the status change
+                    Loggers.SYSTEM.exception(
+                        "health listener failed for %s -> %s",
+                        component, status.name)
 
     def status(self) -> HealthStatus:
         if not self._components:
@@ -67,7 +87,9 @@ class CriticalComponentsHealthMonitor:
         return max(r.status for r in self._components.values())
 
     def is_healthy(self) -> bool:
-        return self.status() == HealthStatus.HEALTHY
+        # DEGRADED keeps serving: probes must not evict a node whose only
+        # problem is a backing-off exporter
+        return self.status() <= HealthStatus.DEGRADED
 
     def to_dict(self) -> dict:
         return {
