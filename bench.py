@@ -779,9 +779,7 @@ def run_replay_recovery(tmpdir_records: int = 4000) -> dict:
 # kernel ceiling (device-only, auto jobs)
 
 
-def run_kernel_ceiling() -> dict:
-    num_instances = 1 << 20
-    rounds = 5
+def run_kernel_ceiling(num_instances: int = 1 << 20, rounds: int = 5) -> dict:
     exe = transform(one_task())
     tables = compile_tables([exe])
     dt = DeviceTables.from_tables(tables)
@@ -812,6 +810,91 @@ def run_kernel_ceiling() -> dict:
 
 # resolved by _ensure_backend(); "cpu" until probed
 _PLATFORM = "cpu"
+
+# XLA:CPU logs a multi-kilobyte machine-feature-mismatch warning every time
+# it loads a persistent-cache executable compiled under a different feature
+# canonicalization ("Machine type used for XLA:CPU compilation doesn't match
+# … This could lead to execution errors such as SIGILL." — tail of
+# BENCH_r05.json). It can fire dozens of times per run and buries the JSON
+# summary line the driver tails for.
+_XLA_MISMATCH_MARKER = b"Machine type used for XLA:CPU compilation doesn't match"
+_XLA_SPAM = {"machine_type_mismatch_lines": 0}
+
+
+def _install_stderr_spam_filter() -> None:
+    """Detect the XLA machine-type-mismatch condition ONCE, emit one concise
+    warning in its place, and drop the repeats — fd-level, because the
+    message comes from C++ (absl) directly on fd 2, bypassing sys.stderr.
+    Everything else passes through untouched, so real errors stay visible
+    and the stdout JSON summary line stays clean. An atexit hook restores
+    fd 2 and joins the pump so a crashing bench run's final traceback —
+    written to the pipe — still reaches the real stderr."""
+    import atexit
+    import threading
+
+    saved = os.dup(2)
+    rfd, wfd = os.pipe()
+    os.dup2(wfd, 2)
+    os.close(wfd)
+    out = os.fdopen(saved, "wb", 0)
+
+    def pump() -> None:
+        buf = b""
+        with os.fdopen(rfd, "rb", 0) as r:
+            while True:
+                chunk = r.read(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                *lines, buf = buf.split(b"\n")
+                for line in lines:
+                    if _XLA_MISMATCH_MARKER in line:
+                        _XLA_SPAM["machine_type_mismatch_lines"] += 1
+                        if _XLA_SPAM["machine_type_mismatch_lines"] == 1:
+                            out.write(
+                                b"[bench] XLA:CPU machine-type mismatch "
+                                b"detected (persistent cache compiled under "
+                                b"a different CPU feature canonicalization); "
+                                b"suppressing further occurrences\n")
+                        continue
+                    out.write(line + b"\n")
+        if buf:
+            out.write(buf)
+
+    pump_thread = threading.Thread(target=pump, daemon=True,
+                                   name="bench-stderr-filter")
+    pump_thread.start()
+
+    def _restore() -> None:
+        try:
+            # puts the real stderr back on fd 2 AND closes the pipe's only
+            # write end, so the pump sees EOF, drains the tail, and exits
+            os.dup2(out.fileno(), 2)
+        except OSError:
+            pass
+        pump_thread.join(timeout=5)
+
+    atexit.register(_restore)
+
+
+def _pipeline_stage_summary() -> dict:
+    """Aggregate the stream_processor_pipeline_* stage histograms (count +
+    total seconds per stage across partitions) for the BENCH extra — the
+    before/after breakdown of where host time goes on the batch path."""
+    from zeebe_tpu.utils.metrics import REGISTRY, Histogram
+
+    prefix = "zeebe_stream_processor_pipeline_"
+    out: dict = {}
+    for name, metric in REGISTRY._metrics.items():
+        if not name.startswith(prefix) or not isinstance(metric, Histogram):
+            continue
+        stage = name[len(prefix):]
+        count, total = 0, 0.0
+        for child in metric._children.values():
+            count += child.count
+            total += child.sum
+        out[stage] = {"count": count, "sum_s": round(total, 4)}
+    return out
 
 
 def _group_cap() -> int:
@@ -858,8 +941,61 @@ def _router_stats() -> dict:
     return shared_router().stats()
 
 
-def main() -> None:
+def _quick_main(platform: str) -> None:
+    """--quick: the two headline workloads at small instance counts plus a
+    reduced kernel ceiling — a <60s smoke of the full pipeline (log →
+    processor → kernel backend → log) with the same JSON summary shape.
+    Writes BENCH_quick.json so a quick run never clobbers the real
+    BENCH.json artifact."""
+    e2e_one_task = run_e2e_workload([one_task()], drives=1, n_instances=600,
+                                    variables={})
+    e2e_ten = run_e2e_workload([ten_tasks()], drives=10, n_instances=120,
+                               variables={})
+    ceiling = run_kernel_ceiling(num_instances=1 << 17, rounds=2)
+    value = e2e_one_task["transitions_per_sec"]
+    full = {
+        "metric": "e2e_process_instance_transitions_per_sec_per_chip",
+        "value": value,
+        "unit": "transitions/s",
+        "vs_baseline": round(value / NORTH_STAR, 3),
+        "extra": {
+            "quick": True,
+            "e2e_one_task": e2e_one_task,
+            "e2e_ten_tasks": e2e_ten,
+            "kernel_ceiling_transitions_per_sec": ceiling["transitions_per_sec"],
+            "pipeline_stages": _pipeline_stage_summary(),
+            "platform": platform,
+            "probe_attempts": _PROBE_LOG,
+            "xla_spam": dict(_XLA_SPAM),
+        },
+    }
+    bench_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_quick.json")
+    with open(bench_path, "w") as f:
+        json.dump(full, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "metric": full["metric"],
+        "value": value,
+        "unit": full["unit"],
+        "vs_baseline": full["vs_baseline"],
+        "platform": platform,
+        "quick": True,
+        "ten_tasks_transitions_per_sec": e2e_ten["transitions_per_sec"],
+        "kernel_ceiling_transitions_per_sec": ceiling["transitions_per_sec"],
+        "full_results": "BENCH_quick.json",
+    }))
+
+
+def main(quick: bool = False) -> None:
+    # install the filter BEFORE any backend use: the mismatch warning fires
+    # whenever a persistent-cache executable loads, including the probe's
+    # subprocess (which inherits the filtered fd 2)
+    _install_stderr_spam_filter()
     platform = _ensure_backend()
+    if quick:
+        _quick_main(platform)
+        return
     e2e_one_task = run_e2e_workload([one_task()], drives=1, n_instances=4000,
                                     variables={})
     e2e_excl = run_e2e_workload([exclusive_chain()], drives=0, n_instances=4000,
@@ -919,6 +1055,11 @@ def main() -> None:
                              "p8_windowed_300ms": mesh_8w},
             "platform": platform,
             "probe_attempts": _PROBE_LOG,
+            # per-stage host-path breakdown of the pipelined batch loop
+            # (stream_processor_pipeline_* histograms, aggregated)
+            "pipeline_stages": _pipeline_stage_summary(),
+            # once-detected-then-suppressed XLA cpu-fallback stderr spam
+            "xla_spam": dict(_XLA_SPAM),
             # link-aware routing (utils/device_link.py): measured per-transfer
             # link cost and where groups actually ran — the e2e workloads ride
             # the accelerator only when the link amortizes (VERDICT r3 weak 3:
@@ -955,4 +1096,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="small instance counts, <60s; writes BENCH_quick.json")
+    main(quick=ap.parse_args().quick)

@@ -434,6 +434,209 @@ class TestCrashRestartRecovery:
             h.close()
 
 
+class TestFlushBoundaryCrash:
+    """Group-commit flush-boundary faults: a crash between a buffered
+    journal append and its covering flush must not lose an acked command
+    and must replay cleanly."""
+
+    def test_power_loss_between_append_and_flush_keeps_acked_prefix(self, tmp_path):
+        """Journal + stream level: acked = covered by ``flush()``. After a
+        simulated power loss, the acked prefix survives byte-for-byte and
+        replays to the same state; the unflushed buffered suffix is cleanly
+        truncated (no corruption), and processing can resume on top."""
+        from zeebe_tpu.engine import Engine
+        from zeebe_tpu.journal import SegmentedJournal
+        from zeebe_tpu.logstreams import LogAppendEntry, LogStream
+        from zeebe_tpu.state import ZbDb
+        from zeebe_tpu.stream import StreamProcessor, StreamProcessorMode
+
+        clock = lambda: 1_700_000_000_000  # noqa: E731
+
+        def replay_into_fresh_db(stream):
+            db = ZbDb()
+            engine = Engine(db, 1, clock_millis=clock)
+            replayer = StreamProcessor(stream, db, engine,
+                                       mode=StreamProcessorMode.REPLAY)
+            replayer.start()
+            replayer.run_until_idle()
+            assert replayer.phase.value != "failed"
+            return db
+
+        # huge interval/threshold: nothing fsyncs unless asked — the crash
+        # window between buffered append and covering flush stays open
+        journal = SegmentedJournal(tmp_path / "log", flush_interval=1e9,
+                                   max_unflushed_bytes=1 << 30)
+        stream = LogStream(journal, 1, clock=clock)
+        db = ZbDb()
+        engine = Engine(db, 1, clock_millis=clock)
+        processor = StreamProcessor(stream, db, engine, clock_millis=clock)
+        processor.start()
+
+        stream.writer.try_write([LogAppendEntry(deploy_cmd(one_task()))])
+        for i in range(4):
+            stream.writer.try_write([
+                LogAppendEntry(create_cmd("p", {"chaosTag": f"acked-{i}"}))])
+        processor.run_until_idle()
+        journal.flush()  # the ack point: everything so far is durable
+        acked_last = stream.last_position
+        durable_replay = replay_into_fresh_db(stream)
+
+        # unflushed traffic past the ack point: process WITHOUT reaching the
+        # idle boundary (run_until_idle would force the covering group-commit
+        # fsync before acking) — the crash lands between the buffered appends
+        # and their covering flush, with nothing past acked_last acked
+        for i in range(3):
+            stream.writer.try_write([
+                LogAppendEntry(create_cmd("p", {"chaosTag": f"lost-{i}"}))])
+        while processor.process_next():
+            pass
+        assert stream.last_position > acked_last
+        assert journal.unflushed_bytes > 0, "fault window never opened"
+
+        journal.simulate_power_loss()
+
+        # restart: reopen the directory like a fresh process would
+        journal2 = SegmentedJournal(tmp_path / "log", flush_interval=1e9)
+        stream2 = LogStream(journal2, 1, clock=clock)
+        # exactly the acked prefix survived — nothing more, nothing less
+        assert stream2.last_position == acked_last
+        tags = {}
+        for logged in stream2.new_reader(1):
+            mirror = next(iter(stream.new_reader(logged.position)))
+            assert mirror.record.to_bytes() == logged.record.to_bytes()
+            tag = logged.record.value.get("variables", {}).get("chaosTag") \
+                if isinstance(logged.record.value, dict) else None
+            if tag is not None and logged.record.is_command:
+                tags[tag] = tags.get(tag, 0) + 1
+        for i in range(4):
+            assert tags.get(f"acked-{i}") == 1, f"acked-{i} lost or duplicated"
+
+        # replay of the recovered journal ≡ replay of the durable prefix
+        recovered_replay = replay_into_fresh_db(stream2)
+        assert recovered_replay.content_equals(durable_replay)
+
+        # and a fresh processor resumes cleanly on top of the recovery
+        db2 = ZbDb()
+        engine2 = Engine(db2, 1, clock_millis=clock)
+        proc2 = StreamProcessor(stream2, db2, engine2, clock_millis=clock)
+        proc2.start()
+        stream2.writer.try_write([
+            LogAppendEntry(create_cmd("p", {"chaosTag": "post-crash"}))])
+        proc2.run_until_idle()
+        assert proc2.phase.value == "processing"
+        journal2.close()
+        journal.close()
+
+    def test_kernel_batch_acks_wait_for_covering_flush(self, tmp_path):
+        """The pipelined batch path defers client responses until the
+        group-commit fsync covers their appends: when a response is out, the
+        journal has no unflushed backlog (acked ⇒ durable)."""
+        from zeebe_tpu.engine import Engine
+        from zeebe_tpu.engine.kernel_backend import KernelBackend
+        from zeebe_tpu.journal import SegmentedJournal
+        from zeebe_tpu.logstreams import LogAppendEntry, LogStream
+        from zeebe_tpu.state import ZbDb
+        from zeebe_tpu.stream import StreamProcessor
+
+        clock = lambda: 1_700_000_000_000  # noqa: E731
+        journal = SegmentedJournal(tmp_path / "log", flush_interval=1e9,
+                                   max_unflushed_bytes=1 << 30)
+        stream = LogStream(journal, 1, clock=clock)
+        db = ZbDb()
+        engine = Engine(db, 1, clock_millis=clock)
+        responses = []
+        kernel = KernelBackend(engine, max_group=64)
+        processor = StreamProcessor(stream, db, engine, clock_millis=clock,
+                                    kernel_backend=kernel,
+                                    response_sink=responses.append)
+        processor.start()
+        stream.writer.try_write([LogAppendEntry(deploy_cmd(one_task()))])
+        processor.run_until_idle()
+        journal.flush()
+
+        create = create_cmd("p", {"n": 1}).replace(request_stream_id=7,
+                                                   request_id=99)
+        stream.writer.try_write([LogAppendEntry(create)])
+        processor.run_until_idle()
+        assert kernel.commands_processed >= 1, "command did not ride the kernel"
+        assert any(r.request_id == 99 for r in responses), "no response acked"
+        # the ack implies the covering group-commit flush already happened
+        assert journal.unflushed_bytes == 0
+        assert journal.last_flushed_index == journal.last_index
+        journal.close()
+
+    def test_cluster_hard_crash_at_flush_boundary(self, tmp_path):
+        """Cluster level: the leader hard-crashes (power loss — journals keep
+        only the fsync-covered prefix; the stream journal's buffered
+        group-commit suffix is LOST and must be rebuilt from the raft
+        journal, whose ack barrier fsyncs before acknowledging). No acked
+        command is lost, replay ≡ live state, exporter positions stay
+        bounded by commit."""
+        plan = FaultPlan(seed=31)
+        h = ChaosHarness(plan, broker_count=3, partition_count=1,
+                         replication_factor=3, directory=tmp_path / "c")
+        c = h.cluster
+        acked: dict[str, int] = {}
+        try:
+            c.await_leaders()
+            c.write_command(1, deploy_cmd(one_task()))
+
+            def create(tag: str) -> None:
+                pos = c.write_command(1, create_cmd("p", {"chaosTag": tag}))
+                leader = c.leader(1)
+                if pos is not None and leader is not None \
+                        and leader.stream.last_position >= pos:
+                    acked[tag] = pos
+
+            for i in range(6):
+                create(f"pre-{i}")
+                h.run_ticks(1)
+
+            victim = c.leader_broker(1).cfg.node_id
+            c.hard_crash_broker(victim)
+            h.clear_exporter_watermarks(victim)
+            new_leader = None
+            for _ in range(40):
+                h.run_ticks(5)
+                leaders = [b for b in c.brokers.values()
+                           if b.partitions[1].is_leader]
+                if leaders:
+                    new_leader = leaders[0]
+                    break
+            assert new_leader is not None, "no leader after hard crash"
+            for i in range(4):
+                create(f"post-{i}")
+                h.run_ticks(1)
+            c.restart_broker(victim)
+            h.clear_exporter_watermarks(victim)
+            h.quiesce(60)
+
+            leader = c.leader(1)
+            assert leader is not None
+            tags: dict[str, int] = {}
+            for logged in leader.stream.new_reader(1):
+                rec = logged.record
+                if (rec.value_type == ValueType.PROCESS_INSTANCE_CREATION
+                        and rec.is_command):
+                    tag = rec.value.get("variables", {}).get("chaosTag")
+                    if tag is not None:
+                        tags[tag] = tags.get(tag, 0) + 1
+            for tag in acked:
+                assert tags.get(tag) == 1, (
+                    f"acked command {tag} appears {tags.get(tag, 0)} times "
+                    f"after flush-boundary crash")
+            assert acked, "no command was ever acked — vacuous run"
+
+            h.check_exactly_once_materialization(1)
+            h.check_replay_equivalence(1)
+            h.assert_no_violations()
+            # the restarted broker rebuilt its stream journal to the leader's
+            restarted = c.brokers[victim].partitions[1]
+            assert restarted.stream.last_position == leader.stream.last_position
+        finally:
+            h.close()
+
+
 @pytest.mark.slow
 class TestChaosSweep:
     """Long randomized sweep over many seeds (tier-2): any failure prints its

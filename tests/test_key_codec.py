@@ -1,0 +1,195 @@
+"""Property/roundtrip tests for the state/db.py key codec.
+
+The codec has three implementations that must agree byte for byte: the
+pure-Python spec (``_encode_key_py`` with its struct-packed fast paths), the
+native codec (when built), and the cached front (``_encode_key_cached``).
+Properties checked on ALL of them:
+
+- encode → decode identity over the full part-type space (int/str/bytes,
+  including i64 boundaries, empty strings/bytes, multi-byte utf-8),
+- lexicographic order of encoded keys matches the documented tuple order
+  (ints sort before strings before bytes; ints by value, strings by utf-8
+  lexicographic order, bytes by (length, content)),
+- ``_prefix_successor`` edge cases (empty, all-``0xff``, trailing-``0xff``
+  prefixes) and its range-bound contract,
+- type rejection (bool, float, None) raises on every path — including cache
+  aliasing hazards (``True == 1``, ``1.0 == 1`` must not serve an int
+  entry's bytes).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from zeebe_tpu.state.db import (
+    ColumnFamilyCode,
+    _encode_key_cached,
+    _encode_key_py,
+    _prefix_successor,
+    _raw_encode_key,
+    decode_key,
+    encode_key,
+)
+
+CF = ColumnFamilyCode.JOBS
+
+CODECS = [
+    pytest.param(_encode_key_py, id="python-spec"),
+    pytest.param(_raw_encode_key, id="raw (native when built)"),
+    pytest.param(_encode_key_cached, id="cached"),
+    pytest.param(encode_key, id="active"),
+]
+
+I64_MIN, I64_MAX = -(1 << 63), (1 << 63) - 1
+
+BOUNDARY_INTS = [I64_MIN, I64_MIN + 1, -1, 0, 1, 2**32, 2**32 + 1, I64_MAX - 1, I64_MAX]
+SAMPLE_STRS = ["", "a", "ab", "z", "é", "变量", "a" * 100]
+SAMPLE_BYTES = [b"", b"\x01", b"\xff", b"\x00\x00", b"\xff" * 9]
+
+
+def _rand_part(rng: random.Random):
+    kind = rng.randrange(3)
+    if kind == 0:
+        return rng.choice(BOUNDARY_INTS + [rng.randint(I64_MIN, I64_MAX)])
+    if kind == 1:
+        return rng.choice(SAMPLE_STRS + ["s%d" % rng.randrange(1000)])
+    return rng.choice(SAMPLE_BYTES + [bytes([rng.randrange(256) or 1, rng.randrange(256)])])
+
+
+def _rand_parts(rng: random.Random) -> tuple:
+    return tuple(_rand_part(rng) for _ in range(rng.randrange(1, 4)))
+
+
+def _order_key(parts: tuple):
+    """The documented sort order as a Python comparison key: type tag first
+    (int < str < bytes), then value — strings by utf-8 bytes, bytes by
+    (length, content) because the wire encoding is length-prefixed."""
+    out = []
+    for p in parts:
+        if type(p) is int:
+            out.append((1, p))
+        elif type(p) is str:
+            out.append((2, p.encode("utf-8")))
+        else:
+            out.append((3, (len(p), p)))
+    return out
+
+
+@pytest.mark.parametrize("codec", CODECS)
+class TestRoundtrip:
+    def test_boundary_ints_roundtrip(self, codec):
+        for v in BOUNDARY_INTS:
+            for parts in [(v,), (v, 7), (7, v), (v, "s"), (v, b"\x01")]:
+                assert decode_key(codec(CF, parts)) == (CF, parts)
+
+    def test_randomized_roundtrip_identity(self, codec):
+        rng = random.Random(20260803)
+        for _ in range(500):
+            parts = _rand_parts(rng)
+            assert decode_key(codec(CF, parts)) == (CF, parts), parts
+
+    def test_all_implementations_byte_equal(self, codec):
+        rng = random.Random(42)
+        for _ in range(500):
+            parts = _rand_parts(rng)
+            assert codec(CF, parts) == _encode_key_py(CF, parts), parts
+
+    def test_cf_prefix_is_two_byte_big_endian(self, codec):
+        for cf in (ColumnFamilyCode.DEFAULT, ColumnFamilyCode.JOBS,
+                   ColumnFamilyCode.PROCESS_INSTANCE_RESULT):
+            assert codec(cf, (1,))[:2] == int(cf).to_bytes(2, "big")
+
+    def test_type_rejection(self, codec):
+        # True == 1 and 1.0 == 1: prime a real int entry first so a cache
+        # that keyed on equality alone would serve it for the bad types
+        codec(CF, (1,))
+        codec(CF, (1, 1))
+        for bad in [(True,), (1.0,), (1, True), (1, 1.0), (None,), ((1,),)]:
+            with pytest.raises((TypeError, ValueError)):
+                codec(CF, bad)
+
+    def test_nul_byte_in_str_rejected(self, codec):
+        with pytest.raises(ValueError):
+            codec(CF, ("a\x00b",))
+        with pytest.raises(ValueError):
+            codec(CF, (1, "a\x00b"))
+
+
+@pytest.mark.parametrize("codec", CODECS)
+class TestLexicographicOrder:
+    def test_same_shape_int_order(self, codec):
+        vals = sorted(set(BOUNDARY_INTS + [random.Random(7).randint(I64_MIN, I64_MAX)
+                                           for _ in range(50)]))
+        encoded = [codec(CF, (v,)) for v in vals]
+        assert encoded == sorted(encoded)
+
+    def test_randomized_tuple_order_matches_encoded_order(self, codec):
+        rng = random.Random(99)
+        tuples = [_rand_parts(rng) for _ in range(300)]
+        by_rule = sorted(tuples, key=_order_key)
+        by_bytes = sorted(tuples, key=lambda t: codec(CF, t))
+        assert by_rule == by_bytes
+
+    def test_prefix_tuple_sorts_first(self, codec):
+        # (a,) is a strict byte-prefix of (a, b): it must sort before it
+        rng = random.Random(5)
+        for _ in range(100):
+            head = _rand_parts(rng)
+            longer = head + (_rand_part(rng),)
+            assert codec(CF, head) < codec(CF, longer)
+            assert codec(CF, longer).startswith(codec(CF, head))
+
+
+class TestPrefixSuccessor:
+    def test_plain_prefix_increments_last_byte(self):
+        assert _prefix_successor(b"\x00\x10") == b"\x00\x11"
+        assert _prefix_successor(b"ab") == b"ac"
+
+    def test_trailing_ff_pops_then_increments(self):
+        assert _prefix_successor(b"a\xff") == b"b"
+        assert _prefix_successor(b"a\xff\xff\xff") == b"b"
+
+    def test_all_ff_has_no_successor(self):
+        assert _prefix_successor(b"\xff") is None
+        assert _prefix_successor(b"\xff" * 8) is None
+
+    def test_empty_prefix_has_no_successor(self):
+        assert _prefix_successor(b"") is None
+
+    def test_bound_contract_over_random_keys(self):
+        """successor(p) is > every key starting with p and <= every key not
+        starting with p that is > p — the exact range-bound contract the
+        sorted-key bisects rely on."""
+        rng = random.Random(11)
+        keys = sorted(encode_key(CF, _rand_parts(rng)) for _ in range(300))
+        for _ in range(100):
+            probe = rng.choice(keys)
+            for cut in (2, 3, len(probe)):
+                prefix = probe[:cut]
+                succ = _prefix_successor(prefix)
+                for k in keys:
+                    if k.startswith(prefix):
+                        assert succ is None or k < succ
+                    elif k > prefix:
+                        assert succ is None or succ <= k or k.startswith(prefix)
+
+
+class TestCacheSemantics:
+    def test_cache_returns_identical_bytes_across_calls(self):
+        a = _encode_key_cached(CF, (123456789, 42))
+        b = _encode_key_cached(CF, (123456789, 42))
+        assert a == b == _encode_key_py(CF, (123456789, 42))
+
+    def test_cache_distinguishes_column_families(self):
+        a = _encode_key_cached(ColumnFamilyCode.JOBS, (9,))
+        b = _encode_key_cached(ColumnFamilyCode.TIMERS, (9,))
+        assert a != b and a[2:] == b[2:]
+
+    def test_cache_eviction_keeps_correctness(self):
+        from zeebe_tpu.state import db as dbmod
+
+        for i in range(dbmod._KEY_CACHE_LIMIT + 100):
+            assert _encode_key_cached(CF, (i,)) == _encode_key_py(CF, (i,))
+        assert len(dbmod._key_cache) <= dbmod._KEY_CACHE_LIMIT + 1

@@ -812,6 +812,22 @@ class InProcessCluster:
         self.net.leave(node_id)
         broker.close()
 
+    def hard_crash_broker(self, node_id: str) -> None:
+        """Power-loss crash: like ``stop_broker`` but journals lose every
+        byte not covered by an fsync (the chaos suite's flush-boundary fault
+        — a crash between a buffered append and its covering flush). Raft's
+        ack barrier fsyncs before acknowledging, so acked entries survive;
+        the unacked buffered suffix is legitimately gone."""
+        broker = self.brokers.pop(node_id, None)
+        if broker is None:
+            raise KeyError(f"unknown broker {node_id}")
+        self._stopped_cfgs[node_id] = broker.cfg
+        self.net.leave(node_id)
+        for partition in broker.partitions.values():
+            partition.hard_crash()
+        # the data directory stays intact (cluster brokers always get one
+        # from the cluster): restart_broker recovers the fsynced prefix
+
     def restart_broker(self, node_id: str) -> Broker:
         """Rebuild a crashed broker over its on-disk directory: raft journal,
         stream journal, and snapshots recover exactly as a real process

@@ -591,6 +591,16 @@ class ZeebePartition:
             if isinstance(self.db, DurableZbDb):
                 self.db.close()
 
+    def hard_crash(self) -> None:
+        """Power-loss crash simulation (chaos harness flush-boundary fault):
+        unlike ``close``, nothing flushes — both journals discard every byte
+        not covered by an fsync (buffered appends AND file bytes written
+        since the last flush), exactly what surviving hardware would hold
+        after losing power between a buffered append and its covering flush.
+        Exporters/state are simply abandoned; recovery rebuilds them."""
+        self.raft.journal.simulate_power_loss()
+        self.stream_journal.simulate_power_loss()
+
     def latest_checkpoint_id(self) -> int:
         """Lock-free: read by OTHER partitions' ownership threads on every
         inter-partition send — must never open this partition's db (the owner

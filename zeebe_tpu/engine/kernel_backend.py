@@ -1184,6 +1184,50 @@ class _Admitted:
     wait_keys: list | None = None
 
 
+def _device_ctx(dev):
+    """Fresh placement context per dispatch (jax.default_device context
+    managers are single-use)."""
+    if dev is None:
+        import contextlib
+
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.default_device(dev)
+
+
+@dataclass
+class _PendingGroup:
+    """One admitted command group with its device run in flight — the
+    double-buffered unit of the pipelined execution path (stream/processor
+    .py process_available_batch): while this group's first chunk computes on
+    the device, the processor runs the PREVIOUS group's deferred host work.
+    Carries per-stage wall times for the stream_processor_pipeline_* stage
+    histograms."""
+
+    admitted: list
+    failed: bool = False
+    mesh: bool = False
+    arrays: dict | None = None
+    I: int = 0
+    T: int = 0
+    tables: Any = None
+    config: Any = None
+    dt: Any = None
+    dev: Any = None
+    bucket: Any = None
+    run: Any = None  # (carry state, packed events) of the in-flight chunk
+    # chunk k+1 prefetch is a win only on a REAL accelerator (device compute
+    # overlaps host decode for free); on a host XLA backend the prefetched
+    # chunk's threads compete with the decoding host thread for the same
+    # cores (measured: ten_tasks regression on a 2-vCPU box)
+    pipeline_chunks: bool = False
+    # stage wall times (seconds), observed by the stream processor
+    t_admit: float = 0.0
+    device_elapsed: float = 0.0
+    t_materialize: float = 0.0
+
+
 class KernelBackend:
     """Admits groups of commands, runs the automaton kernel, materializes the
     sequential-equivalent record stream. One instance per partition."""
@@ -1239,7 +1283,7 @@ class KernelBackend:
         self.template_misses = 0
         self.template_audits = 0
         self.template_audit_skips = 0
-        # per-I-bucket cached zero planes for _run_group_on_device (jax
+        # per-I-bucket cached zero planes for _dispatch_first_chunk (jax
         # arrays are immutable, so sharing across groups is safe)
         self._zero_state: dict = {}
 
@@ -2007,33 +2051,69 @@ class KernelBackend:
         }
         return arrays, I, T
 
-    def _run_kernel(self, admitted: list[_Admitted]) -> list[dict] | None:
-        """Build the group batch, step to quiescence, return per-step host
-        events (None → caller must fall back). With a mesh runner configured
-        the group runs as one shard of a mesh dispatch (possibly coalesced
-        with other partitions' groups); otherwise on the router-chosen
-        backend (utils/device_link.py)."""
-        import jax
-
-        built = self._build_group_arrays(admitted)
+    def _start_kernel(self, pg: "_PendingGroup") -> None:
+        """Stage 1 of the split device run: build the group arrays and
+        DISPATCH the first chunk asynchronously (JAX async dispatch) — the
+        caller overlaps host work with the device compute before calling
+        ``_await_kernel``. Mesh groups stay synchronous (the runner's submit
+        blocks), so they only record the build."""
+        built = self._build_group_arrays(pg.admitted)
         if built is None:
-            return None
-        arrays, I, T = built
-        tables = self.registry.tables
-
+            pg.failed = True
+            return
+        pg.arrays, pg.I, pg.T = built
+        pg.tables = self.registry.tables
         if self.mesh_runner is not None:
+            pg.mesh = True
+            return
+
+        import time as _time
+
+        # link-aware backend choice: the identical program, on the device
+        # where (link + compute) is cheapest for this shape bucket. The
+        # bucket carries the table-set CONTENT digest: different deployed
+        # sets are different programs with different compute costs (and
+        # compiles), and the digest — unlike id() — cannot alias a reused
+        # allocation after a redeploy recompile, and lets partitions with
+        # equal sets share cost observations through the shared router.
+        pg.bucket = (self.registry.tables_fingerprint, pg.I, pg.T)
+        dev = self.router.choose(pg.bucket) if self.router is not None else None
+        pg.dev = dev
+        if dev is not None:
+            pg.pipeline_chunks = getattr(dev, "platform", "cpu") != "cpu"
+        else:
+            import jax
+
+            pg.pipeline_chunks = jax.default_backend() != "cpu"
+        t0 = _time.perf_counter()
+        self._dispatch_first_chunk(pg)
+        # device_elapsed feeds the router's cost model: it must cover only
+        # dispatch + fetch/decode windows, never the host work the caller
+        # overlaps between them
+        pg.device_elapsed = _time.perf_counter() - t0
+
+    def _await_kernel(self, pg: "_PendingGroup") -> list[dict] | None:
+        """Stage 2: block on the in-flight device run (or submit the mesh
+        request) and return the decoded per-step events, None on fallback."""
+        import time as _time
+
+        if pg.failed:
+            return None
+        if pg.mesh:
             from zeebe_tpu.parallel.mesh_runner import GroupRequest
 
+            t0 = _time.perf_counter()
             result = self.mesh_runner.submit(GroupRequest(
                 device_tables=self.registry.device_tables,
-                config=tables.kernel_config,
+                config=pg.tables.kernel_config,
                 tables_fingerprint=self.registry.tables_fingerprint,
-                arrays=arrays,
-                num_instances=I,
-                num_tokens=T,
+                arrays=pg.arrays,
+                num_instances=pg.I,
+                num_tokens=pg.T,
                 max_steps=self.max_steps,
                 chunk_steps=self.chunk_steps,
             ))
+            pg.device_elapsed += _time.perf_counter() - t0
             if result.steps is None:
                 self.fallback_reasons["mesh-dispatch-error"] += 1
                 logger.warning("mesh kernel dispatch errored; falling back")
@@ -2044,42 +2124,30 @@ class KernelBackend:
                 return None
             if result.overflow:
                 self.fallback_reasons["mesh-token-overflow"] += 1
-                logger.warning("mesh kernel token pool overflow (T=%d); falling back", T)
+                logger.warning("mesh kernel token pool overflow (T=%d); falling back", pg.T)
                 return None
             return result.steps
 
-        import contextlib
-        import time as _time
-
-        # link-aware backend choice: the identical program, on the device
-        # where (link + compute) is cheapest for this shape bucket. The
-        # bucket carries the table-set CONTENT digest: different deployed
-        # sets are different programs with different compute costs (and
-        # compiles), and the digest — unlike id() — cannot alias a reused
-        # allocation after a redeploy recompile, and lets partitions with
-        # equal sets share cost observations through the shared router.
-        bucket = (self.registry.tables_fingerprint, I, T)
-        dev = self.router.choose(bucket) if self.router is not None else None
-        ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
-        t_group = _time.perf_counter()
-        with ctx:
-            steps = self._run_group_on_device(arrays, I, T, tables, dev)
-        if self.router is not None and dev is not None and steps is not None:
+        t0 = _time.perf_counter()
+        steps = self._complete_device_run(pg)
+        pg.device_elapsed += _time.perf_counter() - t0
+        if self.router is not None and pg.dev is not None and steps is not None:
             # failed runs (non-quiescence, pool overflow) fall back to the
             # sequential path; their pathological wall times say nothing
             # about the backend's steady-state group cost
-            run_key = (bucket, dev)
-            self.router.record(bucket, dev, _time.perf_counter() - t_group,
+            run_key = (pg.bucket, pg.dev)
+            self.router.record(pg.bucket, pg.dev, pg.device_elapsed,
                                first_run=run_key not in self._runs_seen)
             self._runs_seen.add(run_key)
         return steps
 
-    def _run_group_on_device(self, arrays, I: int, T: int, tables, dev):
-        import jax
+    def _dispatch_first_chunk(self, pg: "_PendingGroup") -> None:
         import jax.numpy as jnp
 
-        from zeebe_tpu.ops.automaton import run_collect, unpack_events
+        from zeebe_tpu.ops.automaton import run_collect
 
+        dev, I = pg.dev, pg.I
+        arrays = pg.arrays
         # fresh per-group zero planes are IDENTICAL every group: cache the
         # immutable device constants per shape bucket — each jnp.zeros call
         # otherwise costs a dispatch (~0.1ms × 5 per group adds up at small
@@ -2089,40 +2157,69 @@ class KernelBackend:
         # host and accelerator, and planes cached on one device must not
         # leak into a group running on the other (cross-device transfers at
         # best, a placement error at worst)
-        zeros = self._zero_state.get((dev, I))
-        if zeros is None:
-            zeros = {
-                "incident": jnp.zeros(I, jnp.bool_),
-                "transitions": jnp.zeros((), jnp.int32),
-                "jobs_created": jnp.zeros((), jnp.int32),
-                "completed": jnp.zeros((), jnp.int32),
-                "overflow": jnp.zeros((), jnp.bool_),
+        pg.config = pg.tables.kernel_config
+        pg.dt = self.registry.device_tables_for(dev)
+        with _device_ctx(dev):
+            # the zero planes must materialize INSIDE the placement context,
+            # or a routed accelerator's cache entry would hold default-device
+            # arrays and pay the transfer this cache exists to eliminate
+            zeros = self._zero_state.get((dev, I))
+            if zeros is None:
+                zeros = {
+                    "incident": jnp.zeros(I, jnp.bool_),
+                    "transitions": jnp.zeros((), jnp.int32),
+                    "jobs_created": jnp.zeros((), jnp.int32),
+                    "completed": jnp.zeros((), jnp.int32),
+                    "overflow": jnp.zeros((), jnp.bool_),
+                }
+                self._zero_state[(dev, I)] = zeros
+            state = {
+                "elem": arrays["elem"],
+                "phase": arrays["phase"],
+                "inst": arrays["inst"],
+                "def_of": arrays["def_of"],
+                "var_slots": arrays["var_slots"],
+                "join_counts": arrays["join_counts"],
+                "mi_left": arrays["mi_left"],
+                "done": arrays["done"],
+                **zeros,
             }
-            self._zero_state[(dev, I)] = zeros
-        state = {
-            "elem": arrays["elem"],
-            "phase": arrays["phase"],
-            "inst": arrays["inst"],
-            "def_of": arrays["def_of"],
-            "var_slots": arrays["var_slots"],
-            "join_counts": arrays["join_counts"],
-            "mi_left": arrays["mi_left"],
-            "done": arrays["done"],
-            **zeros,
-        }
-        config = tables.kernel_config
-        dt = self.registry.device_tables_for(dev)
+            # JAX async dispatch: the call returns with the device still
+            # computing; the first host transfer (in _complete_device_run)
+            # is the synchronization point
+            pg.run = run_collect(pg.dt, state, n_steps=self.chunk_steps,
+                                 config=pg.config)
+
+    def _complete_device_run(self, pg: "_PendingGroup"):
+        import jax
+
+        from zeebe_tpu.ops.automaton import run_collect, unpack_events
+
         # chunked device loop: one dispatch + ONE host transfer per chunk of
         # lock-steps (vs two transfers per step). Quiesced states are fixed
         # points of step(), so a chunk may harmlessly over-run past
         # quiescence. (The router keeps this path off accelerators whose
         # measured link floor would dominate the chunk fetches.)
+        # Double-buffered from the second chunk on (accelerators only — see
+        # _PendingGroup.pipeline_chunks): chunk k+1 dispatches off chunk k's
+        # device-side carry BEFORE chunk k's host transfer, so the device
+        # computes while the host decodes. The first chunk never prefetches —
+        # groups that quiesce immediately (the common case for small resume
+        # bursts) would pay a wasted chunk of device compute.
         chunk = self.chunk_steps
+        T, I = pg.T, pg.I
         steps: list[dict] = []
         overflow = False
-        FO = tables.out_target.shape[2]
-        for _ in range(max(1, self.max_steps // chunk)):
-            state, packed = run_collect(dt, state, n_steps=chunk, config=config)
+        FO = pg.tables.out_target.shape[2]
+        state, packed = pg.run
+        nxt = None
+        max_chunks = max(1, self.max_steps // chunk)
+        hit_quiescence = False
+        for k in range(max_chunks):
+            if pg.pipeline_chunks and k >= 1 and k + 1 < max_chunks:
+                with _device_ctx(pg.dev):
+                    nxt = run_collect(pg.dt, state, n_steps=chunk,
+                                      config=pg.config)
             flat = jax.device_get(packed)
             # per row: T*(2+FO) packed event ints + (active, overflow) tail
             events_host = flat[:, :-2].reshape(chunk, T, 2 + FO)
@@ -2138,8 +2235,18 @@ class KernelBackend:
             for s in range(keep):
                 steps.append(unpack_events(events_host[s], I))
             if quiesced.size:
-                break
-        else:
+                hit_quiescence = True
+                break  # a prefetched over-run chunk is simply never fetched
+            if nxt is not None:
+                state, packed = nxt
+                nxt = None
+            elif k + 1 < max_chunks:
+                # last iteration dispatches nothing: a non-quiescing group is
+                # about to fall back, and the chunk would never be fetched
+                with _device_ctx(pg.dev):
+                    state, packed = run_collect(pg.dt, state, n_steps=chunk,
+                                                config=pg.config)
+        if not hit_quiescence:
             self.fallback_reasons["no-quiesce"] += 1
             logger.warning("kernel group did not quiesce in %d steps; falling back", self.max_steps)
             return None
@@ -2161,7 +2268,19 @@ class KernelBackend:
         ProcessingResultBuilder or a PreparedBurst; empty lists mean the
         caller should process the head command sequentially.
 
-        Must run inside the partition's open db transaction."""
+        Must run inside the partition's open db transaction. The synchronous
+        begin+finish composition; the pipelined processor calls the halves
+        itself and overlaps host work between them."""
+        return self.finish_group(self.begin_group(cmds), make_builder)
+
+    def begin_group(self, cmds) -> _PendingGroup | None:
+        """Admit a group and dispatch its first device chunk asynchronously.
+        Returns None when the head command is not admittable (sequential
+        traffic). Must run inside the partition's open db transaction, and
+        the same transaction must stay open through ``finish_group``."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         instances: dict[int, _Inst] = {}
         # pi_key conflict index: one command per instance per group; a set
         # keeps admission O(1) instead of O(group) per command
@@ -2184,16 +2303,32 @@ class KernelBackend:
             # counted so BENCH can separate it from real kernel failures
             self.fallbacks += 1
             self.fallback_reasons["head-not-admittable"] += 1
+            return None
+        pg = _PendingGroup(admitted)
+        pg.t_admit = _time.perf_counter() - t0
+        self._start_kernel(pg)
+        return pg
+
+    def finish_group(self, pg: _PendingGroup | None,
+                     make_builder: Callable[[], Any]) -> tuple[list, list]:
+        """Block on the in-flight device run and materialize the bursts.
+        ([], []) → the caller should process the head command sequentially."""
+        import time as _time
+
+        if pg is None:
             return [], []
-        steps = self._run_kernel(admitted)
+        steps = self._await_kernel(pg)
         if steps is None:
             self.fallbacks += 1
             return [], []
 
+        t0 = _time.perf_counter()
+        admitted = pg.admitted
         results = []
         for adm in admitted:
             ops = self._cascade_ops(adm.inst, steps)
             results.append(self._materialize(adm, ops, make_builder))
+        pg.t_materialize = _time.perf_counter() - t0
         self.groups_processed += 1
         self.commands_processed += len(admitted)
         return [a.cmd for a in admitted], results

@@ -75,6 +75,12 @@ _M_COMPACTION_MS = _REGISTRY.histogram(
     buckets=(0.1, 0.5, 1, 5, 10, 50, 100, 1000))
 _M_SEGMENT_ALLOC = _REGISTRY.histogram(
     "segment_allocation_time", "seconds to allocate a new segment file")
+_M_DRAINS = _REGISTRY.counter(
+    "journal_buffer_drain_total",
+    "group-commit write-buffer drains (one file write each)")
+_M_DRAIN_BYTES = _REGISTRY.histogram(
+    "journal_buffer_drain_bytes", "bytes per write-buffer drain",
+    buckets=(1024, 4096, 16384, 65536, 262144, 1048576, 4194304))
 # cached label-less children: the append path is hot, and Metric.inc() pays a
 # lock + dict lookup per call that the child skips
 _C_APPENDS = _M_APPENDS.labels()
@@ -82,6 +88,8 @@ _C_APPEND_RATE = _M_APPEND_RATE.labels()
 _C_APPEND_BYTES = _M_APPEND_BYTES.labels()
 _C_APPEND_LATENCY = _M_APPEND_LATENCY.labels()
 _C_TRY_APPEND = _M_TRY_APPEND.labels()
+_C_DRAINS = _M_DRAINS.labels()
+_C_DRAIN_BYTES = _M_DRAIN_BYTES.labels()
 
 from time import perf_counter as _perf
 
@@ -117,7 +125,15 @@ def _checksum(index: int, asqn: int, data: bytes) -> int:
 
 class _Segment:
     """One segment file: header + frames. Keeps an in-memory sparse index of
-    (record index → file offset) for every ``_SPARSE_EVERY``-th record."""
+    (record index → file offset) for every ``_SPARSE_EVERY``-th record.
+
+    Appends land in an in-memory write buffer (``_pending``) and reach the
+    file in one bulk write per ``_drain()`` — interleaved per-append
+    seek+write on a BufferedRandom thrashes its read buffer into a syscall
+    per record (measured ~13% of e2e wall time), while group-commit drains
+    pay one write per processed group. ``size`` is the LOGICAL size (file +
+    pending); every read path drains first. ``durable_size`` tracks the
+    fsync-covered prefix for power-loss simulation."""
 
     def __init__(self, path: Path, segment_id: int, first_index: int, create: bool) -> None:
         self.path = path
@@ -129,19 +145,23 @@ class _Segment:
         # (next_index, its_offset) after the last read_entry — log scans are
         # sequential, so most reads jump straight here
         self._read_hint: tuple[int, int] | None = None
-        # file position tracker: -1 = unknown (a read moved it); append only
-        # seeks when the position is not already at the segment tail
+        # file position tracker: -1 = unknown (a read moved it); the drain
+        # only seeks when the position is not already at the file tail
         self._file_pos = -1
+        self._pending: list[bytes] = []
+        self._pending_bytes = 0
         if create:
             start = _perf()
             self.file = open(path, "w+b")
             self.file.write(_SEG_HEADER.pack(_MAGIC, _VERSION, segment_id, first_index))
             self.file.flush()
             self.size = _SEG_HEADER.size
+            self.durable_size = _SEG_HEADER.size
             _M_SEGMENT_ALLOC.observe(_perf() - start)
         else:
             self.file = open(path, "r+b")
             self.size = _SEG_HEADER.size  # recomputed by scan()
+            self.durable_size = _SEG_HEADER.size
 
     @classmethod
     def open_existing(cls, path: Path) -> "_Segment":
@@ -159,6 +179,8 @@ class _Segment:
     def scan(self) -> None:
         """Rebuild in-memory state from disk; truncate at first corrupt frame."""
         f = self.file
+        self._pending.clear()
+        self._pending_bytes = 0
         self._file_pos = -1
         f.seek(0, os.SEEK_END)
         file_len = f.tell()
@@ -190,22 +212,38 @@ class _Segment:
             f.truncate(offset)
             f.flush()
         self.size = offset
+        self.durable_size = offset
 
     def append(self, index: int, asqn: int, data: bytes) -> None:
         frame = _FRAME.pack(len(data), _checksum(index, asqn, data), index, asqn)
-        if self._file_pos != self.size:
-            self.file.seek(self.size)
-        # invalidate across the write: if it tears mid-way (ENOSPC), the next
-        # append must re-seek to self.size and overwrite the torn bytes
-        self._file_pos = -1
-        self.file.write(frame + data)
+        self._pending.append(frame + data)
+        self._pending_bytes += _FRAME.size + len(data)
         if (index - self.first_index) % _SPARSE_EVERY == 0:
             self.sparse.append((index, self.size))
         self.size += _FRAME.size + len(data)
-        self._file_pos = self.size
         self.last_index = index
         if asqn != ASQN_IGNORE:
             self.last_asqn = asqn
+
+    def _drain(self) -> None:
+        """Write buffered appends to the file in one bulk write. Every read,
+        fsync, truncation, and close goes through here first, so the file
+        view is complete whenever anything other than append looks at it."""
+        if not self._pending:
+            return
+        file_size = self.size - self._pending_bytes
+        if self._file_pos != file_size:
+            self.file.seek(file_size)
+        # invalidate across the write: if it tears mid-way (ENOSPC), the next
+        # drain must re-seek and overwrite the torn bytes
+        self._file_pos = -1
+        chunk = b"".join(self._pending)
+        self.file.write(chunk)
+        self._pending.clear()
+        self._pending_bytes = 0
+        self._file_pos = self.size
+        _C_DRAINS.inc()
+        _C_DRAIN_BYTES.observe(len(chunk))
 
     def _sparse_span(self, index: int) -> tuple[int, int]:
         """(start_offset, end_offset) of the sparse span holding ``index`` —
@@ -225,8 +263,8 @@ class _Segment:
             index = self.first_index
         if index > self.last_index:
             return
+        self._drain()
         offset, _ = self._sparse_span(index)
-        self.file.flush()
         self.file.seek(offset)
         self._file_pos = -1
         mv = memoryview(self.file.read(self.size - offset))
@@ -259,7 +297,7 @@ class _Segment:
         else:
             offset, _ = self._sparse_span(index)
         f = self.file
-        f.flush()
+        self._drain()
         self._file_pos = -1
         while offset < self.size:
             f.seek(offset)
@@ -282,6 +320,7 @@ class _Segment:
         """Delete all records with index > ``index``."""
         if index >= self.last_index:
             return
+        self._drain()
         offset = _SEG_HEADER.size
         new_last = self.first_index - 1
         new_asqn = ASQN_IGNORE
@@ -297,6 +336,7 @@ class _Segment:
         self.file.flush()
         self._file_pos = -1
         self.size = offset
+        self.durable_size = min(self.durable_size, offset)
         self.last_index = new_last
         _M_SEGMENT_TRUNCATE.observe(_perf() - start)
         self.last_asqn = new_asqn
@@ -305,14 +345,21 @@ class _Segment:
 
     def flush(self) -> None:
         start = _perf()
+        self._drain()
         self.file.flush()
         os.fsync(self.file.fileno())
+        self.durable_size = self.size
         _M_SEGMENT_FLUSH.observe(_perf() - start)
 
     def close(self) -> None:
+        # clean shutdown: buffered appends reach the OS (matching the old
+        # behavior where the file object's own buffer flushed on close)
+        self._drain()
         self.file.close()
 
     def delete(self) -> None:
+        self._pending.clear()  # no point writing out a file being unlinked
+        self._pending_bytes = 0
         self.close()
         self.path.unlink(missing_ok=True)
 
@@ -329,11 +376,24 @@ class SegmentedJournal:
         directory: str | Path,
         name: str = "journal",
         max_segment_size: int = 8 * 1024 * 1024,
+        flush_interval: float | None = None,
+        max_unflushed_bytes: int = 1 << 20,
     ) -> None:
         self.dir = Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.name = name
         self.max_segment_size = max_segment_size
+        # group-commit knobs: appends buffer in memory and reach the file in
+        # one write per drain (at ``max_unflushed_bytes``, or whenever a read
+        # or fsync needs the file view); ``maybe_flush`` — called by the
+        # stream processor at group boundaries — fsyncs only when
+        # ``flush_interval`` seconds elapsed since the last fsync or the
+        # unflushed backlog exceeds ``max_unflushed_bytes``. ``flush()``
+        # itself stays an unconditional drain + fsync (Raft ack barriers).
+        self.flush_interval = flush_interval
+        self.max_unflushed_bytes = max_unflushed_bytes
+        self._unflushed_bytes = 0
+        self._last_flush_t = _perf()
         self._meta_path = self.dir / f"{name}.meta"
         self._meta_fd: int | None = None
         self.segments: list[_Segment] = []
@@ -434,6 +494,9 @@ class SegmentedJournal:
             tail = self._roll_segment()
         index = tail.last_index + 1
         tail.append(index, asqn, data)
+        self._unflushed_bytes += _FRAME.size + len(data)
+        if tail._pending_bytes >= self.max_unflushed_bytes:
+            tail._drain()
         self._m_pending += 1
         self._m_pending_bytes += _FRAME.size + len(data)
         if sampled:
@@ -484,12 +547,54 @@ class SegmentedJournal:
             raise
         idx = self.last_index
         self._write_flush_marker(max(idx, 0))
+        self._unflushed_bytes = 0
+        self._last_flush_t = _perf()
         _M_LAST_FLUSHED.set(max(idx, 0))
         _M_FLUSHES.inc()
         elapsed = _perf() - start
         _M_FLUSH_SECONDS.observe(elapsed)
         _M_FLUSH_TIME.observe(elapsed)
         return idx
+
+    def maybe_flush(self) -> int | None:
+        """Group-commit flush point: called once per processed group (not per
+        append). fsyncs — and returns the covered index — only when there is
+        an unflushed backlog AND the configured cadence says so: either
+        ``flush_interval`` seconds passed since the last fsync, or the
+        backlog exceeds ``max_unflushed_bytes``. With ``flush_interval=None``
+        (the default) it never fsyncs on its own — durability stays owned by
+        explicit ``flush()`` callers (Raft ack barriers, backups) exactly as
+        before."""
+        if self.flush_interval is None or not self._unflushed_bytes:
+            return None
+        if (self._unflushed_bytes >= self.max_unflushed_bytes
+                or _perf() - self._last_flush_t >= self.flush_interval):
+            return self.flush()
+        return None
+
+    @property
+    def unflushed_bytes(self) -> int:
+        return self._unflushed_bytes
+
+    def simulate_power_loss(self) -> None:
+        """Crash simulation for tests: discard every byte not covered by an
+        fsync — in-memory append buffers AND file bytes written after the
+        last ``flush()`` — then close the files. The caller reopens a fresh
+        journal over the directory, exactly like a process restart after the
+        machine lost power between a buffered append and its covering
+        flush."""
+        self._flush_append_metrics()
+        if self._counted_segments:
+            _M_SEGMENT_COUNT.inc(-self._counted_segments)
+            self._counted_segments = 0
+        for seg in self.segments:
+            seg._pending.clear()
+            seg._pending_bytes = 0
+            seg.file.truncate(seg.durable_size)
+            seg.file.close()
+        if self._meta_fd is not None:
+            os.close(self._meta_fd)
+            self._meta_fd = None
 
     def _write_flush_marker(self, idx: int) -> None:
         if self._meta_fd is None:
@@ -529,7 +634,7 @@ class SegmentedJournal:
         rebuild derived indexes on open (e.g. the log stream's position map)."""
         for seg in self.segments:
             f = seg.file
-            f.flush()
+            seg._drain()
             seg._file_pos = -1
             offset = _SEG_HEADER.size
             while offset < seg.size:
@@ -555,7 +660,13 @@ class SegmentedJournal:
     # -- admin ---------------------------------------------------------------
 
     def truncate_after(self, index: int) -> None:
-        """Remove all records after ``index`` (Raft conflict resolution)."""
+        """Remove all records after ``index`` (Raft conflict resolution).
+
+        ``_unflushed_bytes`` intentionally keeps counting the discarded
+        suffix: the counter must never UNDER-report (maybe_flush skipping a
+        needed fsync would ack without durability), and the truncated
+        segment's surviving prefix may itself still be un-fsynced — the
+        worst case of the conservative choice is one spurious fsync."""
         while len(self.segments) > 1 and self.segments[-1].first_index > index:
             self.segments.pop().delete()
         self.segments[-1].truncate_after(index)
@@ -578,6 +689,7 @@ class SegmentedJournal:
         for seg in self.segments:
             seg.delete()
         self.segments = [_Segment(self._segment_path(1), 1, next_index, create=True)]
+        self._unflushed_bytes = 0  # the pre-reset backlog no longer exists
         self._update_segment_gauge()
         # invalidate the stale flushed-index marker from the pre-reset log
         self._write_flush_marker(max(next_index - 1, 0))

@@ -23,6 +23,7 @@ from typing import Iterator
 
 from zeebe_tpu import native as _native
 from zeebe_tpu.journal import SegmentedJournal
+from zeebe_tpu.utils import evict_oldest_half as _evict_oldest_half
 from zeebe_tpu.protocol import Record
 from zeebe_tpu.protocol.enums import RecordType
 from zeebe_tpu.protocol.msgpack import unpackb as msgpack_unpackb
@@ -257,23 +258,27 @@ class LogStreamWriter:
                 for e in entries
             ):
                 return last
-            stream._cache_batch(
-                jrec.index,
-                [
-                    LoggedRecord(
-                        record=entry.record.replace(
-                            position=first_position + i,
-                            partition_id=stream.partition_id,
-                            timestamp=timestamp,
-                            value=msgpack_unpackb(bodies[i]),
-                        ),
-                        position=first_position + i,
-                        source_position=source_position,
-                        processed=entry.processed,
-                    )
-                    for i, entry in enumerate(entries)
-                ],
-            )
+            pid = stream.partition_id
+            seeded = []
+            for i, entry in enumerate(entries):
+                rec = entry.record
+                # positional Record construction (field order = dataclass
+                # order; arity drift fails loudly): replace()'s per-field
+                # getattr/index plumbing is measurable at wave sizes
+                seeded.append(LoggedRecord(
+                    record=Record(
+                        rec.record_type, rec.value_type, rec.intent,
+                        msgpack_unpackb(bodies[i]), rec.key,
+                        first_position + i, rec.source_record_position,
+                        timestamp, pid, rec.rejection_type,
+                        rec.rejection_reason, rec.request_stream_id,
+                        rec.request_id, rec.operation_reference,
+                    ),
+                    position=first_position + i,
+                    source_position=source_position,
+                    processed=entry.processed,
+                ))
+            stream._cache_batch(jrec.index, seeded)
         return last
 
     def append_prepatched(
@@ -334,20 +339,59 @@ def _serialize_batch(
     return _serialize_batch_with_bodies(entries, first_position, source_position, timestamp)[0]
 
 
+# record-frame encode cache, keyed by Record object identity (stored records
+# pin their ids against reuse): a record appended more than once — gateway
+# command fan-out, retried scheduled commands, bench injection — serializes
+# exactly once; the batch builder patches the timestamp into its own copy at
+# the captured offset. Sound because Record is frozen and values are never
+# mutated after reaching a writer (the same contract the decode-cache seeding
+# in try_write already depends on). Frames are cached only on the SECOND
+# encode of the same object: the dominant path appends each record exactly
+# once, and caching those would pin thousands of dead frame copies for zero
+# hits (the _frame_seen stage pins only the records themselves).
+_TS_OFFSET = 20  # timestamp field offset inside the record frame header
+_FRAME_CACHE_LIMIT = 4096
+_FRAME_SEEN_LIMIT = 4096
+_frame_cache: dict[int, tuple[Record, bytes, bytes]] = {}
+_frame_seen: dict[int, Record] = {}
+
+
+def _encoded_frame(record: Record) -> tuple[bytes, bytes]:
+    rid = id(record)
+    hit = _frame_cache.get(rid)
+    if hit is not None and hit[0] is record:
+        return hit[1], hit[2]
+    frame, body = record.encode(0)  # timestamp patched per batch
+    if _frame_seen.get(rid) is record:
+        del _frame_seen[rid]
+        _evict_oldest_half(_frame_cache, _FRAME_CACHE_LIMIT)
+        _frame_cache[rid] = (record, frame, body)
+    else:
+        _evict_oldest_half(_frame_seen, _FRAME_SEEN_LIMIT)
+        _frame_seen[rid] = record
+    return frame, body
+
+
 def _serialize_batch_with_bodies(
     entries: list[LogAppendEntry], first_position: int, source_position: int, timestamp: int
 ) -> tuple[bytes, list[bytes]]:
-    """Serialize; also returns each record's msgpack value body so the writer
-    can seed the decode cache without re-encoding anything. The timestamp is
-    passed straight into ``Record.encode`` — no per-record replace()."""
-    parts = [_BATCH_HEADER.pack(len(entries), source_position, timestamp)]
+    """Serialize into ONE growing buffer; also returns each record's msgpack
+    value body so the writer can seed the decode cache without re-encoding
+    anything. Record frames come pre-encoded from the identity cache (with
+    the batch timestamp patched in place at its fixed header offset) instead
+    of a per-``LogAppendEntry`` encode per append."""
+    buf = bytearray(_BATCH_HEADER.pack(len(entries), source_position, timestamp))
     bodies: list[bytes] = []
+    pack_entry = _ENTRY_HEADER.pack
+    pack_ts = _PACK_LE_Q.pack_into
     for i, entry in enumerate(entries):
-        rec_bytes, body = entry.record.encode(timestamp)
+        frame, body = _encoded_frame(entry.record)
         bodies.append(body)
-        parts.append(_ENTRY_HEADER.pack(1 if entry.processed else 0, first_position + i, len(rec_bytes)))
-        parts.append(rec_bytes)
-    return b"".join(parts), bodies
+        buf += pack_entry(1 if entry.processed else 0, first_position + i, len(frame))
+        off = len(buf)
+        buf += frame
+        pack_ts(buf, off + _TS_OFFSET, timestamp)
+    return bytes(buf), bodies
 
 
 def _deserialize_batch(payload: bytes, partition_id: int) -> list[LoggedRecord]:
@@ -371,6 +415,31 @@ def _deserialize_batch(payload: bytes, partition_id: int) -> list[LoggedRecord]:
             )
         )
     return out
+
+
+def _record_at_or_after(batch: list["LoggedRecord"], position: int):
+    """First record with record.position >= ``position`` in one decoded
+    batch, or None past its end. Record positions within a sequenced batch
+    are contiguous (first_position + i by construction), so this is direct
+    indexing — the command scan over a wave-sized batch (thousands of
+    commands in one append) would otherwise rescan the list per command and
+    go quadratic. A non-contiguous batch (defensive: never produced by any
+    writer) falls back to the linear walk."""
+    if not batch:
+        return None
+    idx = position - batch[0].position
+    if idx <= 0:
+        return batch[0]
+    if idx < len(batch):
+        logged = batch[idx]
+        if logged.position == position:
+            return logged
+    elif batch[-1].position < position:
+        return None  # truly past the batch even if non-contiguous
+    for logged in batch:
+        if logged.position >= position:
+            return logged
+    return None
 
 
 class LogStreamReader:
@@ -476,13 +545,8 @@ class LogStream:
         self._batch_indexes.append(journal_index)
 
     def _cache_batch(self, journal_index: int, batch: list[LoggedRecord]) -> None:
-        cache = self._batch_cache
-        if len(cache) >= self._batch_cache_limit:
-            # evict the oldest-decoded half in one sweep (dicts iterate in
-            # insertion order); cheaper than per-hit LRU bookkeeping
-            for key in list(cache)[: self._batch_cache_limit // 2]:
-                del cache[key]
-        cache[journal_index] = batch
+        _evict_oldest_half(self._batch_cache, self._batch_cache_limit)
+        self._batch_cache[journal_index] = batch
 
     @property
     def writer(self) -> LogStreamWriter:
@@ -555,9 +619,9 @@ class LogStream:
                     continue
                 return None, slot, self.last_position + 1
             batch = self._read_batch_at(self._batch_indexes[slot])
-            for logged in batch:
-                if logged.position >= position:
-                    return logged, slot, logged.position
+            logged = _record_at_or_after(batch, position)
+            if logged is not None:
+                return logged, slot, logged.position
             if slot + 1 < len(self._batch_indexes):
                 position = self._batch_positions[slot + 1]
                 hint = slot + 1
@@ -584,9 +648,9 @@ class LogStream:
             return None, hint
         slot = self._locate_slot(position, hint)
         batch = self._read_batch_at(self._batch_indexes[slot])
-        for logged in batch:
-            if logged.position >= position:
-                return logged, slot
+        logged = _record_at_or_after(batch, position)
+        if logged is not None:
+            return logged, slot
         # position falls in a gap after this batch; first record of the next
         if slot + 1 < len(self._batch_indexes):
             nxt = self._read_batch_at(self._batch_indexes[slot + 1])

@@ -119,12 +119,14 @@ class Record:
     def replace(self, **kw: Any) -> "Record":
         # hand-rolled dataclasses.replace: this runs once per record on the
         # append path (timestamp/request stamping) and dataclasses.replace's
-        # signature re-validation is ~4x the cost of the constructor call.
-        # _FIELDS is derived from the dataclass below so new fields can
-        # never be silently dropped.
-        current = {name: getattr(self, name) for name in _FIELDS}
-        current.update(kw)
-        return Record(**current)
+        # signature re-validation is ~4x the cost of the constructor call;
+        # positional construction skips the kwargs dict plumbing on top.
+        # _FIELDS/_FIELD_INDEX are derived from the dataclass below so new
+        # fields can never be silently dropped.
+        current = [getattr(self, name) for name in _FIELDS]
+        for name, value in kw.items():
+            current[_FIELD_INDEX[name]] = value
+        return Record(*current)
 
     # -- serialization -------------------------------------------------------
 
@@ -238,6 +240,7 @@ class Record:
 
 
 _FIELDS = tuple(f.name for f in dataclasses.fields(Record))
+_FIELD_INDEX = {name: i for i, name in enumerate(_FIELDS)}
 
 
 def command(value_type: ValueType, intent: Intent, value: Mapping[str, Any], **kw: Any) -> Record:

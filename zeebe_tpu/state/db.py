@@ -30,6 +30,7 @@ from typing import Any, Callable, Iterator
 
 from zeebe_tpu.native import codec_fn as _codec_fn
 from zeebe_tpu.protocol import msgpack
+from zeebe_tpu.utils import evict_oldest_half as _evict_oldest_half
 
 _commit_overlay = _codec_fn("commit_overlay")
 _iterate_snapshot = _codec_fn("iterate_snapshot")
@@ -148,34 +149,81 @@ _CF_PREFIX = {code: struct.pack(">H", int(code)) for code in ColumnFamilyCode}
 _encode_key_native = _codec_fn("encode_key")
 
 
+_INT2_PART = struct.Struct(">BQBQ")  # two fused int parts (tag+payload ×2)
+_SIGN_FLIP = 0x8000000000000000
+_U64_MASK = 0xFFFFFFFFFFFFFFFF
+
+
 def _encode_key_py(cf: ColumnFamilyCode, parts: tuple) -> bytes:
     """Pure-Python encoding — THE SPEC the native pass must byte-match
     (tests/test_native_codec.py TestNativeEncodeKey fuzzes equality)."""
     prefix = _CF_PREFIX[cf]
     n = len(parts)
-    # fast paths for the dominant shapes: (int,) and (int, int)
+    # preallocated struct-packed fast paths for the dominant shapes:
+    # (int,), (int, int), and (int, str)
     if n == 1:
         p0 = parts[0]
         if type(p0) is int:
             return prefix + _INT_PART.pack(
-                0x01, (p0 & 0xFFFFFFFFFFFFFFFF) ^ 0x8000000000000000)
+                0x01, (p0 & _U64_MASK) ^ _SIGN_FLIP)
     elif n == 2:
         p0, p1 = parts
-        if type(p0) is int and type(p1) is int:
-            return (prefix
-                    + _INT_PART.pack(0x01, (p0 & 0xFFFFFFFFFFFFFFFF) ^ 0x8000000000000000)
-                    + _INT_PART.pack(0x01, (p1 & 0xFFFFFFFFFFFFFFFF) ^ 0x8000000000000000))
+        if type(p0) is int:
+            if type(p1) is int:
+                return prefix + _INT2_PART.pack(
+                    0x01, (p0 & _U64_MASK) ^ _SIGN_FLIP,
+                    0x01, (p1 & _U64_MASK) ^ _SIGN_FLIP)
+            if type(p1) is str:
+                raw = p1.encode("utf-8")
+                if b"\x00" not in raw:
+                    return b"".join((
+                        prefix,
+                        _INT_PART.pack(0x01, (p0 & _U64_MASK) ^ _SIGN_FLIP),
+                        b"\x02", raw, b"\x00"))
     out = bytearray(prefix)
     for part in parts:
         _encode_part(part, out)
     return bytes(out)
 
 
-if _encode_key_native is not None:
-    def encode_key(cf: ColumnFamilyCode, parts: tuple) -> bytes:
-        return _encode_key_native(_CF_PREFIX[cf], parts)
-else:
-    encode_key = _encode_key_py
+_raw_encode_key = (
+    (lambda cf, parts: _encode_key_native(_CF_PREFIX[cf], parts))
+    if _encode_key_native is not None
+    else _encode_key_py
+)
+
+# encoded-key LRU keyed by (cf, parts): the admission/processing hot path
+# re-derives the same handful of keys several times per command (element
+# instance by key, job by key, variables by (scope, name), …). Measured: a
+# dict hit beats the pure-Python encoder ~2-8x (most for multi-part/str
+# keys) but LOSES to the native codec's direct call — so the cache fronts
+# only the Python fallback; with the native codec loaded, encode_key stays
+# the direct native call. Only int/str/bytes parts are cacheable: Python
+# equality would otherwise alias 1.0/True onto an int entry and silently
+# bypass the codec's type rejection (int, str, and bytes never compare
+# equal across types, so the tuple key is collision-free within that set).
+_KEY_CACHE_LIMIT = 16384
+_key_cache: dict[tuple, bytes] = {}
+
+
+def _encode_key_cached(cf: ColumnFamilyCode, parts: tuple) -> bytes:
+    for p in parts:
+        t = type(p)
+        if t is not int and t is not str and t is not bytes:
+            return _raw_encode_key(cf, parts)
+    key = (int(cf), parts)
+    cached = _key_cache.get(key)
+    if cached is not None:
+        return cached
+    encoded = _raw_encode_key(cf, parts)
+    _evict_oldest_half(_key_cache, _KEY_CACHE_LIMIT)
+    _key_cache[key] = encoded
+    return encoded
+
+
+encode_key = (
+    _raw_encode_key if _encode_key_native is not None else _encode_key_cached
+)
 
 
 def decode_key(encoded: bytes) -> tuple[ColumnFamilyCode, tuple]:
@@ -417,12 +465,9 @@ class ColumnFamily:
 
     def items(self, prefix: tuple = ()) -> Iterator[tuple[bytes, Any]]:
         """Iterate (encoded_key, value) pairs under a key-part prefix, ordered."""
-        pfx = self._prefix
-        if prefix:
-            out = bytearray(pfx)
-            for part in prefix:
-                _encode_part(part, out)
-            pfx = bytes(out)
+        # a key-part prefix encodes exactly like a key of those parts, so the
+        # scan prefix rides the same fast path (native/cached) as point keys
+        pfx = encode_key(self.code, prefix) if prefix else self._prefix
         yield from self._ctx().iterate(pfx)
 
     def values(self, prefix: tuple = ()) -> Iterator[Any]:

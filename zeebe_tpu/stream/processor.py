@@ -217,12 +217,41 @@ class StreamProcessor:
             "replay_last_source_position",
             "source position of the last replayed batch", ("partition",)
         ).labels(partition_label)
+        # pipelined-batch stage histograms: the before/after breakdown of the
+        # host-path gap (decode/admission, device run, burst materialization,
+        # log append, group-commit flush, deferred side effects) — children
+        # pre-resolved, the group loop is hot
+        self._m_pipeline = {
+            stage: REGISTRY.histogram(
+                f"stream_processor_pipeline_{stage}",
+                f"seconds per kernel group in the {stage} stage of the "
+                "pipelined batch-execution path",
+                ("partition",)).labels(partition_label)
+            for stage in ("decode", "device", "materialize", "append",
+                          "flush", "side_effects")
+        }
         clock = clock_millis or log_stream.clock_millis
         self.schedule_service = ProcessingScheduleService(clock, self._write_scheduled_commands)
         self._reader_position = 1
         self._scan_hint = -1  # batch-slot cursor for the sequential scans
         self.last_processed_position = -1
         self.last_written_position = -1
+        # double-buffered pipeline state: each processed group's post-commit
+        # side effects (client responses, jobs-available notifications) are
+        # deferred and run while the NEXT group's device chunk computes.
+        # Entries are (last_written_position, builders); with a journal
+        # flush_interval configured they additionally wait for the covering
+        # group-commit fsync before acking (no-acked-command-lost invariant)
+        self._deferred_effects: list[tuple[int, list]] = []
+        self._acked_position = -1
+        # acks gated on the covering group-commit fsync: only meaningful when
+        # this processor appends to the local stream journal AND that journal
+        # has a flush cadence configured (broker partitions pass a Raft
+        # writer — durability is raft's ack barrier there, never gated here)
+        self._ack_gated = (
+            self.writer is log_stream.writer
+            and getattr(log_stream.journal, "flush_interval", None) is not None
+        )
 
     # -- bookkeeping ---------------------------------------------------------
 
@@ -365,7 +394,14 @@ class StreamProcessor:
 
     def process_available_batch(self) -> int:
         """Process a group of kernel-eligible commands in one device run and
-        one transaction; returns commands consumed (0 → sequential path)."""
+        one transaction; returns commands consumed (0 → sequential path).
+
+        Pipelined: the group's first device chunk is dispatched
+        asynchronously (KernelBackend.begin_group), the PREVIOUS group's
+        deferred post-commit side effects run in that window, and only then
+        does the host block on the device (finish_group). This group's own
+        side effects are deferred in turn, so device and host work run
+        concurrently instead of in strict alternation."""
         if self.kernel_backend is None or self.phase != Phase.PROCESSING:
             return 0
         import time as _time
@@ -373,16 +409,26 @@ class StreamProcessor:
         group_start = _time.perf_counter()
         from zeebe_tpu.engine.burst_templates import PreparedBurst
 
+        pipeline = self._m_pipeline
         cmds: list[LoggedRecord] = []
         builders: list[ProcessingResultBuilder] = []
+        pending = None
         write_failed = False
+        # out-of-transaction drain point: deferred groups carrying post-commit
+        # tasks (skipped by the in-transaction overlap drain below) go out here
+        self._run_deferred_effects()
         try:
             with self.db.transaction():
-                cmds, builders = self.kernel_backend.process_group(
-                    self._iter_candidate_commands(), ProcessingResultBuilder
-                )
+                pending = self.kernel_backend.begin_group(
+                    self._iter_candidate_commands())
+                # the device is computing the first chunk: run the previous
+                # group's deferred host work in the gap
+                self._run_deferred_effects()
+                cmds, builders = self.kernel_backend.finish_group(
+                    pending, ProcessingResultBuilder)
                 if not cmds:
                     return 0
+                t_append = _time.perf_counter()
                 try:
                     for cmd, result in zip(cmds, builders):
                         if isinstance(result, PreparedBurst):
@@ -405,6 +451,7 @@ class StreamProcessor:
                     raise
                 self.last_processed_position = cmds[-1].position
                 self._store_last_processed(self.last_processed_position)
+                pipeline["append"].observe(_time.perf_counter() - t_append)
         except Exception:  # noqa: BLE001 — the fallback/rollback seam
             if write_failed:
                 # a partial group append is already in the log; reprocessing
@@ -418,6 +465,26 @@ class StreamProcessor:
             logger.exception("kernel group processing failed; falling back to sequential")
             return 0
         self._reader_position = cmds[-1].position + 1
+        # defer this group's post-commit side effects: they run while the
+        # NEXT group's device chunk computes (or at the next sequential
+        # command / idle boundary, whichever comes first)
+        self._deferred_effects.append((self.last_written_position, builders))
+        t_flush = _time.perf_counter()
+        self._group_commit_point()
+        pipeline["flush"].observe(_time.perf_counter() - t_flush)
+        pipeline["decode"].observe(pending.t_admit)
+        pipeline["device"].observe(pending.device_elapsed)
+        pipeline["materialize"].observe(pending.t_materialize)
+        self._m_batched.inc(len(cmds))
+        elapsed = _time.perf_counter() - group_start
+        self._m_latency.observe(elapsed)
+        self._m_batch_commands.observe(len(cmds))
+        self._m_batch_duration.observe(elapsed)
+        return len(cmds)
+
+    def _emit_group_effects(self, builders: list) -> None:
+        from zeebe_tpu.engine.burst_templates import PreparedBurst
+
         job_types: set = set()
         for result in builders:
             if isinstance(result, PreparedBurst):
@@ -428,12 +495,62 @@ class StreamProcessor:
                 self._execute_side_effects(result)
                 job_types |= activatable_job_types(result.follow_ups)
         self._notify_jobs_available(job_types)
-        self._m_batched.inc(len(cmds))
-        elapsed = _time.perf_counter() - group_start
-        self._m_latency.observe(elapsed)
-        self._m_batch_commands.observe(len(cmds))
-        self._m_batch_duration.observe(elapsed)
-        return len(cmds)
+
+    def _group_commit_point(self) -> None:
+        """Per-step flush point: advance the acked position — immediately
+        when acks are not flush-gated (append = visible, the pre-pipeline
+        semantics), else only when ``maybe_flush``'s cadence fsyncs."""
+        if not self._ack_gated:
+            self._acked_position = self.last_written_position
+        elif self.log_stream.journal.maybe_flush() is not None:
+            # the group-commit fsync covered everything appended so far
+            self._acked_position = self.last_written_position
+
+    def _run_deferred_effects(self) -> None:
+        """Emit deferred group side effects whose appends are acked (always
+        the whole queue unless a journal flush_interval gates acks on the
+        covering group-commit fsync)."""
+        dq = self._deferred_effects
+        if not dq:
+            return
+        import time as _time
+
+        from zeebe_tpu.engine.burst_templates import PreparedBurst
+
+        t0 = _time.perf_counter()
+        acked = self._acked_position
+        in_txn = self.db.in_transaction
+        emitted = 0
+        while dq and dq[0][0] <= acked:
+            if in_txn and any(
+                not isinstance(b, PreparedBurst) and b.post_commit_tasks
+                for b in dq[0][1]
+            ):
+                # post-commit tasks are an API allowed to open their own db
+                # transaction — they only run at out-of-transaction drain
+                # points (FIFO preserved: the queue stops at the first
+                # task-bearing group; responses never overtake it)
+                break
+            _position, builders = dq.pop(0)
+            self._emit_group_effects(builders)
+            emitted += 1
+        if emitted:
+            # observed only when work happened: the stage breakdown stays a
+            # per-group view, not inflated by empty drain attempts
+            self._m_pipeline["side_effects"].observe(_time.perf_counter() - t0)
+
+    def _flush_deferred_effects(self) -> None:
+        """Pipeline boundary (idle, or a sequential command interleaving):
+        everything still deferred must go out, forcing the covering
+        group-commit fsync first when acks are gated on one."""
+        dq = self._deferred_effects
+        if not dq:
+            return
+        if dq[-1][0] > self._acked_position:
+            # acks gated on durability: this IS the group-commit flush point
+            self.log_stream.journal.flush()
+            self._acked_position = self.last_written_position
+        self._run_deferred_effects()
 
     def process_next(self) -> bool:
         """Process one command; returns False when no command is pending."""
@@ -448,6 +565,15 @@ class StreamProcessor:
     def _process_command(self, cmd: LoggedRecord) -> None:
         import time as _time
 
+        # sequential interleaving: responses stay in log order across the
+        # batched and sequential paths. Flush-gated mode keeps the sequential
+        # command's OWN effects in the deferred queue too (its ack must also
+        # wait for the covering fsync), so order holds without forcing an
+        # fsync per command; ungated mode drains everything immediately.
+        if self._ack_gated:
+            self._run_deferred_effects()
+        else:
+            self._flush_deferred_effects()
         start = _time.perf_counter()
         builder = ProcessingResultBuilder()
         try:
@@ -459,8 +585,15 @@ class StreamProcessor:
             self._m_batch_retry.inc()
             self._on_processing_error(cmd, error)
             return
-        self._execute_side_effects(builder)
-        self._notify_jobs_available(activatable_job_types(builder.follow_ups))
+        if self._ack_gated:
+            # acked ⇒ durable: the response waits for the covering fsync
+            # (maybe_flush cadence, or the idle-boundary flush)
+            self._deferred_effects.append((self.last_written_position, [builder]))
+            self._group_commit_point()
+            self._run_deferred_effects()
+        else:
+            self._execute_side_effects(builder)
+            self._notify_jobs_available(activatable_job_types(builder.follow_ups))
         self._observe_follow_ups(builder.follow_ups)
         self._m_processed.inc()
         elapsed = _time.perf_counter() - start
@@ -519,6 +652,12 @@ class StreamProcessor:
                 if cmd.record.request_id >= 0:
                     builder.with_response(rej, cmd.record.request_stream_id, cmd.record.request_id)
             self._write_and_mark(cmd, builder)
+        if self._ack_gated:
+            # rejections ack like any response: after the covering fsync
+            self._deferred_effects.append((self.last_written_position, [builder]))
+            self._group_commit_point()
+            self._run_deferred_effects()
+            return
         self._execute_side_effects(builder)
 
     def _observe_follow_ups(self, follow_ups) -> None:
@@ -593,4 +732,7 @@ class StreamProcessor:
                 if self.schedule_service.run_due_tasks() == 0:
                     break
             steps += 1
+        # idle boundary: the last group's deferred side effects (and, when
+        # acks are flush-gated, the covering group-commit fsync) go out now
+        self._flush_deferred_effects()
         return steps

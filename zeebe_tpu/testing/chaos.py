@@ -56,6 +56,10 @@ class FaultPlan:
     reorder_p: float = 0.0
     delay_p: float = 0.0
     max_delay_ticks: int = 3
+    # event kinds: ("partition", a, b), ("isolate", m), ("heal",) /
+    # ("heal", m), ("crash", m) (clean close), ("hard-crash", m) (power
+    # loss at the flush boundary: unflushed journal bytes are lost),
+    # ("restart", m)
     events: dict[int, list[tuple]] = dataclasses.field(default_factory=dict)
 
     def at(self, tick: int, *event: Any) -> "FaultPlan":
@@ -237,6 +241,11 @@ class ChaosHarness:
             self.net.heal(*args)
         elif kind == "crash":
             self.cluster.stop_broker(args[0])
+            self.clear_exporter_watermarks(args[0])
+        elif kind == "hard-crash":
+            # power loss at the flush boundary: journals keep only the
+            # fsync-covered prefix (buffered group-commit appends are lost)
+            self.cluster.hard_crash_broker(args[0])
             self.clear_exporter_watermarks(args[0])
         elif kind == "restart":
             self.cluster.restart_broker(args[0])

@@ -22,6 +22,11 @@ class _Metric:
         self.label_names = label_names
         self._children: dict[tuple, "_Child"] = {}
         self._lock = threading.Lock()
+        # cached default child: label-less Metric.inc()/observe()/set() calls
+        # would otherwise pay the labels() lock + dict lookup per call — too
+        # hot for append/processing loops (journal/journal.py documents the
+        # same cost for its cached children)
+        self._default_child: "_Child" | None = None
 
     def labels(self, *values: str) -> "_Child":
         if len(values) != len(self.label_names):
@@ -37,7 +42,12 @@ class _Metric:
             return child
 
     def _default(self) -> "_Child":
-        return self.labels(*([] if not self.label_names else [""] * len(self.label_names)))
+        child = self._default_child
+        if child is None:
+            child = self.labels(
+                *([] if not self.label_names else [""] * len(self.label_names)))
+            self._default_child = child
+        return child
 
 
 class _Child:
