@@ -941,7 +941,35 @@ def _router_stats() -> dict:
     return shared_router().stats()
 
 
-def _quick_main(platform: str) -> None:
+# bench tracing: 1-in-100 head sampling bounds span volume (the span ring
+# buffer is bounded anyway); the append→ack reservoir sees EVERY command, so
+# the p50/p99 are over the full run, not the sampled traces
+TRACE_SAMPLE_RATE = 0.01
+
+
+def _enable_tracing() -> None:
+    from zeebe_tpu.observability import configure_tracing
+
+    configure_tracing(enabled=True, seed=0, sample_rate=TRACE_SAMPLE_RATE,
+                      capacity=1 << 16)
+
+
+def _tracing_extra() -> dict:
+    """End-to-end latency attribution for the BENCH extra: p50/p99 of the
+    command append→ack latency plus span accounting (--trace only)."""
+    from zeebe_tpu.observability import get_tracer
+
+    tracer = get_tracer()
+    return {
+        "sample_rate": tracer.sampler.rate,
+        "sample_seed": tracer.sampler.seed,
+        "spans_collected": len(tracer.collector),
+        "spans_emitted": tracer.collector.emitted,
+        **tracer.latency_percentiles(),
+    }
+
+
+def _quick_main(platform: str, trace: bool = False) -> None:
     """--quick: the two headline workloads at small instance counts plus a
     reduced kernel ceiling — a <60s smoke of the full pipeline (log →
     processor → kernel backend → log) with the same JSON summary shape.
@@ -967,6 +995,7 @@ def _quick_main(platform: str) -> None:
             "platform": platform,
             "probe_attempts": _PROBE_LOG,
             "xla_spam": dict(_XLA_SPAM),
+            **({"tracing": _tracing_extra()} if trace else {}),
         },
     }
     bench_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -987,14 +1016,16 @@ def _quick_main(platform: str) -> None:
     }))
 
 
-def main(quick: bool = False) -> None:
+def main(quick: bool = False, trace: bool = False) -> None:
     # install the filter BEFORE any backend use: the mismatch warning fires
     # whenever a persistent-cache executable loads, including the probe's
     # subprocess (which inherits the filtered fd 2)
     _install_stderr_spam_filter()
     platform = _ensure_backend()
+    if trace:
+        _enable_tracing()
     if quick:
-        _quick_main(platform)
+        _quick_main(platform, trace=trace)
         return
     e2e_one_task = run_e2e_workload([one_task()], drives=1, n_instances=4000,
                                     variables={})
@@ -1060,6 +1091,8 @@ def main(quick: bool = False) -> None:
             "pipeline_stages": _pipeline_stage_summary(),
             # once-detected-then-suppressed XLA cpu-fallback stderr spam
             "xla_spam": dict(_XLA_SPAM),
+            # --trace: append→ack p50/p99 + span accounting (observability)
+            **({"tracing": _tracing_extra()} if trace else {}),
             # link-aware routing (utils/device_link.py): measured per-transfer
             # link cost and where groups actually ran — the e2e workloads ride
             # the accelerator only when the link amortizes (VERDICT r3 weak 3:
@@ -1101,4 +1134,8 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
                     help="small instance counts, <60s; writes BENCH_quick.json")
-    main(quick=ap.parse_args().quick)
+    ap.add_argument("--trace", action="store_true",
+                    help="enable the observability tracer (seeded sampling) "
+                         "and fold append→ack p50/p99 into the BENCH extra")
+    _args = ap.parse_args()
+    main(quick=_args.quick, trace=_args.trace)

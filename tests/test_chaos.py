@@ -637,6 +637,117 @@ class TestFlushBoundaryCrash:
             h.close()
 
 
+class TestTracingUnderChaos:
+    """Observability contract under faults: spans are minted only on live
+    processing, so a hard-crash (power loss) + replay recovery must add ZERO
+    duplicate spans — replay emits nothing, and the exporter's at-least-once
+    re-delivery after restart is deduped by the tracer. The seeded sampler
+    keeps the traced set identical run to run."""
+
+    def _span_identities(self, tracer):
+        from collections import Counter
+
+        return Counter(
+            (s.name, s.trace_id, (s.attrs or {}).get("position"),
+             (s.attrs or {}).get("exporter"))
+            for s in tracer.collector.snapshot()
+            # infra spans (journal flushes) are legitimately repeated events,
+            # not per-record spans — identity applies to the record-lineage
+            # span kinds
+            if not s.trace_id.startswith("infra:")
+        )
+
+    def test_hard_crash_replay_emits_zero_duplicate_spans(self, tmp_path):
+        from zeebe_tpu.observability import configure_tracing
+
+        tracer = configure_tracing(enabled=True, seed=20260803,
+                                   sample_rate=1.0, capacity=1 << 16)
+        plan = FaultPlan(seed=47)
+        h = ChaosHarness(plan, broker_count=3, partition_count=1,
+                         replication_factor=3, directory=tmp_path / "c",
+                         exporters_factory=lambda: {
+                             "good": CollectingExporter()})
+        c = h.cluster
+        try:
+            c.await_leaders()
+            c.write_command(1, deploy_cmd(one_task()))
+            for i in range(6):
+                c.write_command(1, create_cmd("p", {"chaosTag": f"t-{i}"}))
+                h.run_ticks(2)
+            h.quiesce(60)
+
+            before = self._span_identities(tracer)
+            assert before, "live processing emitted no spans — vacuous run"
+            assert max(before.values()) == 1, (
+                "duplicate spans before any fault: "
+                f"{[k for k, v in before.items() if v > 1]}")
+            processing_before = {k for k in before if k[0].startswith("processor.")}
+            assert processing_before
+
+            # power-loss the leader, elect a new one, restart the victim —
+            # its recovery replays the journal and its exporter re-sees the
+            # records after the last ack (at-least-once)
+            victim = c.leader_broker(1).cfg.node_id
+            c.hard_crash_broker(victim)
+            h.clear_exporter_watermarks(victim)
+            new_leader = None
+            for _ in range(40):
+                h.run_ticks(5)
+                leaders = [b for b in c.brokers.values()
+                           if b.partitions[1].is_leader]
+                if leaders:
+                    new_leader = leaders[0]
+                    break
+            assert new_leader is not None, "no leader after hard crash"
+            c.restart_broker(victim)
+            h.clear_exporter_watermarks(victim)
+            h.quiesce(60)
+
+            after = self._span_identities(tracer)
+            dupes = [k for k, v in after.items() if v > 1]
+            assert not dupes, f"crash-restart replay duplicated spans: {dupes}"
+            # replay re-applied the whole log but minted no NEW processing
+            # spans for already-processed commands
+            processing_after = {k for k in after if k[0].startswith("processor.")}
+            assert processing_after == processing_before
+        finally:
+            h.close()
+            configure_tracing(enabled=False, reset=True)
+
+    def test_same_seed_samples_identical_trace_set(self, tmp_path):
+        """Seeded-sampling reproducibility at the harness level: two
+        identical runs under the same fault seed + sampler seed collect the
+        same processor-span trace ids (the chaos-replay property tracing
+        must not break)."""
+        from zeebe_tpu.observability import configure_tracing
+
+        def run(directory):
+            tracer = configure_tracing(enabled=True, seed=11,
+                                       sample_rate=0.5, capacity=1 << 16)
+            plan = FaultPlan(seed=13, drop_p=0.02, reorder_p=0.05)
+            h = ChaosHarness(plan, broker_count=3, partition_count=1,
+                             replication_factor=3, directory=directory)
+            c = h.cluster
+            try:
+                c.await_leaders()
+                c.write_command(1, deploy_cmd(one_task()))
+                for i in range(8):
+                    c.write_command(1, create_cmd("p", {"n": i}))
+                    h.run_ticks(2)
+                h.quiesce(60)
+                return sorted({
+                    s.trace_id for s in tracer.collector.snapshot()
+                    if s.name.startswith("processor.")})
+            finally:
+                h.close()
+                configure_tracing(enabled=False, reset=True)
+
+        first = run(tmp_path / "r1")
+        second = run(tmp_path / "r2")
+        assert first, "no processor spans collected — vacuous"
+        assert first == second
+
+
 @pytest.mark.slow
 class TestChaosSweep:
     """Long randomized sweep over many seeds (tier-2): any failure prints its

@@ -9,6 +9,9 @@ Endpoints:
   GET  /ready     → 200 when every local partition has a role and a processor
   GET  /metrics   → Prometheus text exposition
   GET  /partitions → per-partition health dicts
+  GET  /traces    → collected tracing spans (observability subsystem);
+                    ?format=chrome returns Chrome-trace-event JSON that opens
+                    directly in Perfetto, ?limit=N tails the newest N spans
   GET  /profile   → sampling profiler over all runtime threads
                     (?seconds=N, capped at 30; pump/kernel/io time split)
   POST /backups/<id> → trigger a cluster-consistent checkpoint
@@ -80,6 +83,30 @@ class ManagementServer:
             handler._send(200, json.dumps(
                 [p.health() for p in self.broker.partitions.values()]
             ))
+        elif path == "/traces":
+            from urllib.parse import parse_qs, urlsplit
+
+            from zeebe_tpu.observability import chrome_trace, get_tracer
+
+            params = parse_qs(urlsplit(handler.path).query)
+            tracer = get_tracer()
+            spans = tracer.collector.snapshot()
+            try:
+                limit = int(params.get("limit", ["0"])[0])
+            except ValueError:
+                limit = 0
+            if limit > 0:
+                spans = spans[-limit:]
+            if params.get("format", ["json"])[0] == "chrome":
+                handler._send(200, json.dumps(chrome_trace(spans)))
+            else:
+                handler._send(200, json.dumps({
+                    "enabled": tracer.enabled,
+                    "sampleRate": tracer.sampler.rate,
+                    "seed": tracer.sampler.seed,
+                    "emitted": tracer.collector.emitted,
+                    "spans": [s.to_dict() for s in spans],
+                }))
         elif path == "/profile":
             from urllib.parse import parse_qs, urlsplit
 

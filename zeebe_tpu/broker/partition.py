@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import shutil
 from pathlib import Path
+from time import perf_counter as _perf_counter
 from typing import Any, Callable
 
 from zeebe_tpu.cluster.messaging import MessagingService
@@ -30,6 +31,7 @@ from zeebe_tpu.engine.message_timer import DueDateCheckers
 from zeebe_tpu.exporters.director import ExporterDirector
 from zeebe_tpu.journal import SegmentedJournal
 from zeebe_tpu.logstreams import LogAppendEntry, LogStream, patch_prepatched_batch
+from zeebe_tpu.observability.tracer import get_tracer as _get_tracer
 from zeebe_tpu.protocol import Record
 from zeebe_tpu.protocol.msgpack import packb, unpackb
 from zeebe_tpu.state import ZbDb
@@ -37,6 +39,10 @@ from zeebe_tpu.state.snapshot import FileBasedSnapshotStore
 from zeebe_tpu.stream import StreamProcessor, StreamProcessorMode
 
 DEFAULT_SNAPSHOT_PERIOD_MS = 5 * 60 * 1000
+
+# command-ingress tracing (singleton mutated in place; one enabled-check per
+# client_write when tracing is off)
+_TRACER = _get_tracer()
 
 
 class BackpressureExceeded(Exception):
@@ -355,14 +361,36 @@ class ZeebePartition:
         rate limiter check before LogStreamWriter.tryWrite)."""
         if self.paused or self.disk_paused:
             return None
+        tracer = _TRACER
+        # capture the enabled flag once: a mid-flight configure_tracing must
+        # not pair a real perf_counter with the 0.0 sentinel
+        traced = tracer.enabled
+        t0 = _perf_counter() if traced else 0.0
         if self.limiter is not None and not self.limiter.try_acquire(record):
             raise BackpressureExceeded(
                 f"partition {self.partition_id} has reached its in-flight "
                 f"command limit ({self.limiter.limit})"
             )
+        t_acquired = _perf_counter() if traced else 0.0
         position = self.write_commands([record])
         if position is not None and self.limiter is not None:
             self.limiter.on_appended(position)
+        if traced and position is not None:
+            # the Raft path bypasses the local LogStreamWriter, so the ack
+            # stamp is taken here; the trace root is the command's own
+            # position — the same id the processor and exporter spans use
+            tracer.note_append(self.partition_id, position)
+            trace_id = f"{self.partition_id}:{position}"
+            if tracer.sampled(trace_id):
+                if self.limiter is not None:
+                    tracer.emit(trace_id, "broker.backpressure_acquire",
+                                t_acquired - t0, self.partition_id,
+                                attrs={"position": position})
+                tracer.emit(trace_id, "broker.command_append",
+                            _perf_counter() - t_acquired, self.partition_id,
+                            attrs={"position": position,
+                                   "valueType": record.value_type.name,
+                                   "intent": record.intent.name})
         return position
 
     def write_commands(self, records: list[Record],

@@ -88,7 +88,31 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--variables", required=True)
     p.add_argument("--local", action="store_true")
 
+    p = sub.add_parser(
+        "trace",
+        help="reconstruct a process instance's causal record tree from a "
+             "journal (offline; no gateway needed)")
+    p.add_argument("key", type=int, help="process instance key")
+    p.add_argument("--journal-dir", default=None,
+                   help="path to a partition's stream journal directory "
+                        "(e.g. <data>/partition-1/stream, or a harness's "
+                        "<dir>/log)")
+    p.add_argument("--data-dir", default=None,
+                   help="broker data directory; the partition is derived "
+                        "from the key unless --partition is given")
+    p.add_argument("--partition", type=int, default=0,
+                   help="partition id override (default: decoded from key)")
+    p.add_argument("--exported-position", type=int, default=None,
+                   help="an exporter's acked position; annotates each node "
+                        "with whether it was exported")
+    p.add_argument("--pretty", action="store_true",
+                   help="ASCII tree instead of JSON")
+
     args = parser.parse_args(argv)
+
+    if args.cmd == "trace":
+        # offline journal walk — no gateway connection
+        return _trace(args)
 
     from zeebe_tpu.client import JobWorker, ZeebeTpuClient
 
@@ -97,6 +121,49 @@ def main(argv: list[str] | None = None) -> int:
         return _dispatch(client, args)
     finally:
         client.close()
+
+
+def _trace(args) -> int:
+    from pathlib import Path
+
+    from zeebe_tpu.journal import SegmentedJournal
+    from zeebe_tpu.logstreams import LogStream
+    from zeebe_tpu.observability import collect_lineage, format_lineage
+    from zeebe_tpu.protocol.keys import decode_partition_id
+
+    partition_id = args.partition or decode_partition_id(args.key) or 1
+    if args.journal_dir:
+        journal_dir = Path(args.journal_dir)
+    elif args.data_dir:
+        journal_dir = (Path(args.data_dir)
+                       / f"partition-{partition_id}" / "stream")
+        if not journal_dir.exists():
+            # EngineHarness/bench layout: one partition, journal at <dir>/log
+            fallback = Path(args.data_dir) / "log"
+            if fallback.exists():
+                journal_dir = fallback
+    else:
+        print("trace requires --journal-dir or --data-dir", file=sys.stderr)
+        return 2
+    if not journal_dir.exists():
+        print(f"no journal at {journal_dir}", file=sys.stderr)
+        return 2
+    journal = SegmentedJournal(journal_dir)
+    try:
+        stream = LogStream(journal, partition_id)
+        lineage = collect_lineage(stream, args.key,
+                                  exported_position=args.exported_position)
+        if not lineage["roots"]:
+            print(f"no records for instance {args.key} in {journal_dir}",
+                  file=sys.stderr)
+            return 1
+        if args.pretty:
+            print(format_lineage(lineage))
+        else:
+            _out(lineage)
+    finally:
+        journal.close()
+    return 0
 
 
 def _dispatch(client, args) -> int:

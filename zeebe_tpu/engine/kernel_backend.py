@@ -1196,6 +1196,23 @@ def _device_ctx(dev):
     return jax.default_device(dev)
 
 
+def _profiler_annotation(name: str):
+    """``jax.profiler.TraceAnnotation`` around one kernel-chunk dispatch —
+    the device-side counterpart of the observability spans: a
+    ``jax.profiler.trace()`` capture taken while tracing is enabled shows the
+    chunk boundaries by name in Perfetto/TensorBoard. A no-op context when
+    tracing is off, so the dispatch hot path pays one attribute read."""
+    from zeebe_tpu.observability.tracer import get_tracer
+
+    if not get_tracer().enabled:
+        import contextlib
+
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
 @dataclass
 class _PendingGroup:
     """One admitted command group with its device run in flight — the
@@ -2187,8 +2204,9 @@ class KernelBackend:
             # JAX async dispatch: the call returns with the device still
             # computing; the first host transfer (in _complete_device_run)
             # is the synchronization point
-            pg.run = run_collect(pg.dt, state, n_steps=self.chunk_steps,
-                                 config=pg.config)
+            with _profiler_annotation("zeebe.kernel_chunk.first"):
+                pg.run = run_collect(pg.dt, state, n_steps=self.chunk_steps,
+                                     config=pg.config)
 
     def _complete_device_run(self, pg: "_PendingGroup"):
         import jax
@@ -2217,7 +2235,8 @@ class KernelBackend:
         hit_quiescence = False
         for k in range(max_chunks):
             if pg.pipeline_chunks and k >= 1 and k + 1 < max_chunks:
-                with _device_ctx(pg.dev):
+                with _device_ctx(pg.dev), \
+                        _profiler_annotation("zeebe.kernel_chunk.prefetch"):
                     nxt = run_collect(pg.dt, state, n_steps=chunk,
                                       config=pg.config)
             flat = jax.device_get(packed)
@@ -2243,7 +2262,8 @@ class KernelBackend:
             elif k + 1 < max_chunks:
                 # last iteration dispatches nothing: a non-quiescing group is
                 # about to fall back, and the chunk would never be fetched
-                with _device_ctx(pg.dev):
+                with _device_ctx(pg.dev), \
+                        _profiler_annotation("zeebe.kernel_chunk"):
                     state, packed = run_collect(pg.dt, state, n_steps=chunk,
                                                 config=pg.config)
         if not hit_quiescence:

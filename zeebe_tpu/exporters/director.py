@@ -353,6 +353,20 @@ class ExporterDirector:
         self._m_last_updated = REGISTRY.gauge(
             "exporter_last_updated_exported_position",
             "lowest acknowledged exporter position", ("partition",)).labels(pid)
+        # per-container lag (log end - acked position): the quantitative
+        # face of a DEGRADED/backing-off exporter — a paused container's lag
+        # grows on /metrics while its siblings' stays ~0
+        lag = REGISTRY.gauge(
+            "exporter_container_lag_records",
+            "records between the log end and this exporter's acked position",
+            ("exporter", "partition"))
+        self._lag_children = {
+            c.exporter_id: lag.labels(c.exporter_id, pid)
+            for c in self.containers
+        }
+        from zeebe_tpu.observability.tracer import get_tracer
+
+        self._tracer = get_tracer()
 
     def _offer(self, container: "ExporterContainer", logged, now: int) -> None:
         """Hand one due record to a container (filter-skip or deliver; a
@@ -364,8 +378,32 @@ class ExporterDirector:
         ctx = container.exporter.context
         if ctx.record_filter is not None and not ctx.record_filter(logged):
             container.skip(logged.position)
-        else:
+            return
+        tracer = self._tracer
+        if not tracer.enabled:
             container.deliver(logged, now)
+            return
+        # sample FIRST: at low rates the common case must not pay the span
+        # timing — only the trace-id resolution + one crc32
+        pid = self.stream.partition_id
+        fallback = (logged.source_position if logged.source_position >= 0
+                    else logged.position)
+        root = tracer.resolve_root(pid, logged.position, fallback)
+        trace_id = f"{pid}:{root}"
+        if not tracer.sampled(trace_id):
+            container.deliver(logged, now)
+            return
+        t0 = _time_mod.perf_counter()
+        ok = container.deliver(logged, now)
+        dur = _time_mod.perf_counter() - t0
+        # mark_exported dedupes re-delivery — export is at-least-once across
+        # restarts, but the span stream must stay exactly-once; marked only
+        # on SUCCESS so a retried failure still gets its span
+        if ok and tracer.mark_exported(
+                (container.exporter_id, pid, logged.position)):
+            tracer.emit(trace_id, "exporter.export", dur, pid,
+                        attrs={"position": logged.position,
+                               "exporter": container.exporter_id})
 
     def export_available(self, max_records: int = 10_000) -> int:
         """Export committed records not yet seen; returns the work done this
@@ -421,6 +459,10 @@ class ExporterDirector:
         if count or max_catch_up:
             self._m_last_updated.set(
                 min((c.position for c in self.containers), default=-1))
+        log_end = self.stream.last_position
+        for container in self.containers:
+            self._lag_children[container.exporter_id].set(
+                log_end - container.position)
         return max(count, max_catch_up)
 
     def lowest_exporter_position(self) -> int:

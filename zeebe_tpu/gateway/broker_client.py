@@ -301,13 +301,25 @@ class ClusterRuntime(GatewayRuntimeBase):
     def submit(self, partition_id: int, record: Record,
                timeout_s: float = 10.0) -> Record:
         """Write a command to the partition leader, await the engine response
-        (retrying on leader miss — RequestRetryHandler semantics)."""
+        (retrying on leader miss — RequestRetryHandler semantics). Mints the
+        trace's ROOT span: ``client_write`` returns the command's assigned
+        stream position, which IS the trace id the broker-side spans
+        (processing, export) key on — the gateway request joins its causal
+        tree with no extra wire fields."""
         from zeebe_tpu.broker.partition import BackpressureExceeded
+        from zeebe_tpu.observability.tracer import get_tracer
 
+        tracer = get_tracer()
+        # capture the enabled flag ONCE: enabling tracing while this request
+        # is in flight must not feed perf_counter() minus the 0.0 sentinel
+        # into the latency histogram
+        traced = tracer.enabled
+        t_submit = time.perf_counter() if traced else 0.0
         request_id, event = self._register_request()
         rec = record.replace(request_id=request_id, request_stream_id=0)
         deadline = time.time() + timeout_s
         written = False
+        command_position = -1
         lock = self._plocks.get(partition_id)
         if lock is None:
             # a stale/crafted key can decode to a partition this cluster
@@ -322,8 +334,10 @@ class ClusterRuntime(GatewayRuntimeBase):
                     leader = self._leader_partition(partition_id)
                     if leader is not None:
                         try:
-                            if leader.client_write(rec) is not None:
+                            position = leader.client_write(rec)
+                            if position is not None:
                                 written = True
+                                command_position = position
                         except BackpressureExceeded as exc:
                             self._pending.pop(request_id, None)
                             raise ResourceExhaustedError(str(exc)) from exc
@@ -335,7 +349,22 @@ class ClusterRuntime(GatewayRuntimeBase):
         if not written:
             self._pending.pop(request_id, None)
             raise NoLeaderError(f"no leader for partition {partition_id}")
-        return self._take_response(request_id, event, deadline, partition_id, timeout_s)
+        response = self._take_response(request_id, event, deadline,
+                                       partition_id, timeout_s)
+        if traced:
+            latency = time.perf_counter() - t_submit
+            tracer.observe_ack("gateway", latency)
+            trace_id = f"{partition_id}:{command_position}"
+            if tracer.sampled(trace_id):
+                attrs = {"position": command_position,
+                         "requestId": request_id,
+                         "valueType": record.value_type.name,
+                         "intent": record.intent.name}
+                if response.is_rejection:
+                    attrs["rejection"] = response.rejection_type.name
+                tracer.emit(trace_id, "gateway.request", latency, partition_id,
+                            attrs=attrs)
+        return response
 
     def _resolve(self, response) -> None:
         self._resolve_request(response.request_id, response.record)

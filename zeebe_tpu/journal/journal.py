@@ -33,7 +33,12 @@ import zlib
 from pathlib import Path
 from typing import Iterator
 
+from zeebe_tpu.observability.tracer import get_tracer as _get_tracer
 from zeebe_tpu.utils.metrics import REGISTRY as _REGISTRY
+
+# group-flush tracing (singleton mutated in place; one enabled-check per
+# flush when tracing is off)
+_TRACER = _get_tracer()
 
 # journal metrics (reference names: journal/ JournalMetrics —
 # zeebe_journal_append_total, flush counts/latency); process-global because a
@@ -539,6 +544,7 @@ class SegmentedJournal:
         8-byte overwrite, not an fsync'd rename, keeping the hot append path
         at one fsync per flush."""
         self._flush_append_metrics()
+        covered_bytes = self._unflushed_bytes
         start = _perf()
         try:
             self.segments[-1].flush()
@@ -554,6 +560,12 @@ class SegmentedJournal:
         elapsed = _perf() - start
         _M_FLUSH_SECONDS.observe(elapsed)
         _M_FLUSH_TIME.observe(elapsed)
+        if _TRACER.enabled:
+            # group-flush span: the durability edge every gated ack waits on
+            # (flushes are group-commit cadence, not per-append — cheap)
+            _TRACER.emit("infra:journal", "journal.flush", elapsed,
+                         attrs={"coveredBytes": covered_bytes,
+                                "lastIndex": idx})
         return idx
 
     def maybe_flush(self) -> int | None:

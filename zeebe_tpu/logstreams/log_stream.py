@@ -96,6 +96,12 @@ _METRICS.gauge(
     "sequencer_queue_size",
     "sequenced batches queued for append (synchronous writer: 0)").set(0)
 
+# append→ack latency stamping: one enabled-check per append when tracing is
+# off (the singleton is mutated in place, never replaced)
+from zeebe_tpu.observability.tracer import get_tracer as _get_tracer
+
+_TRACER = _get_tracer()
+
 _codec = _native.load_codec()
 _scan_batch_headers = (
     _codec.scan_batch_headers
@@ -243,6 +249,16 @@ class LogStreamWriter:
             stream._batch_has_commands[jrec.index] = any(
                 e.record.is_command and not e.processed for e in entries
             )
+            if _TRACER.enabled:
+                # stamp unprocessed commands' append time (resolved into
+                # command_ack_latency at commit) and register the batch's
+                # transitive trace roots so multi-hop chains keep one trace id
+                pid = stream.partition_id
+                _TRACER.register_batch(pid, first_position, len(entries),
+                                       source_position)
+                for i, e in enumerate(entries):
+                    if e.record.is_command and not e.processed:
+                        _TRACER.note_append(pid, first_position + i)
             # seed the decode cache from the in-memory entries: every local
             # append is read back at least twice (processing scan + export),
             # and the bytes round-trip is pure waste for records we hold.
@@ -570,6 +586,11 @@ class LogStream:
             self._batch_has_commands[jrec.index] = has_pending_commands
         batch = self._read_batch_at(jrec.index)
         self._next_position = batch[-1].position + 1 if batch else first_position + 1
+        if _TRACER.enabled and batch:
+            # the broker materialization path (leader AND follower): register
+            # trace roots so processor/exporter spans resolve transitively
+            _TRACER.register_batch(self.partition_id, first_position,
+                                   len(batch), batch[0].source_position)
 
     def serialize_batch(self, entries: list[LogAppendEntry], first_position: int,
                         source_position: int = -1) -> bytes:

@@ -1,0 +1,43 @@
+"""Distributed tracing & record-lineage observability.
+
+What the reference broker never had (SURVEY §5.1): a Dapper-style span layer
+over the engine's own causal substrate. Three pieces:
+
+- ``span``: the span model, the seeded deterministic sampler, and the
+  bounded per-process collector with JSONL / Chrome-trace (Perfetto) export.
+- ``tracer``: the process-global tracer every instrumentation point guards
+  on; also owns the ``command_ack_latency`` end-to-end histogram and the
+  export-span dedupe that keeps crash-restart replay duplicate-free.
+- ``lineage``: the offline causal-tree walker — reconstructs a process
+  instance's full record lineage from a journal alone, via the
+  ``source_record_position`` backlinks every sequenced batch already carries.
+
+Spans are emitted ONLY on live processing (gateway request, command append,
+backpressure acquire, journal group-flush, PROCESSING-phase steps and their
+pipeline stages, exporter delivery). Replay emits nothing, by construction.
+"""
+
+from zeebe_tpu.observability.lineage import collect_lineage, format_lineage
+from zeebe_tpu.observability.span import (
+    DeterministicSampler,
+    Span,
+    SpanCollector,
+    chrome_trace,
+)
+from zeebe_tpu.observability.tracer import (
+    Tracer,
+    configure_tracing,
+    get_tracer,
+)
+
+__all__ = [
+    "DeterministicSampler",
+    "Span",
+    "SpanCollector",
+    "Tracer",
+    "chrome_trace",
+    "collect_lineage",
+    "configure_tracing",
+    "format_lineage",
+    "get_tracer",
+]

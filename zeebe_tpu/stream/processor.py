@@ -230,6 +230,13 @@ class StreamProcessor:
             for stage in ("decode", "device", "materialize", "append",
                           "flush", "side_effects")
         }
+        # tracing: spans are minted ONLY on the PROCESSING-phase paths below —
+        # replay_available has no tracing hooks, so crash-restart replay is
+        # structurally unable to emit (duplicate) spans. The singleton is
+        # mutated in place by configure_tracing; caching it here is safe.
+        from zeebe_tpu.observability.tracer import get_tracer
+
+        self._tracer = get_tracer()
         clock = clock_millis or log_stream.clock_millis
         self.schedule_service = ProcessingScheduleService(clock, self._write_scheduled_commands)
         self._reader_position = 1
@@ -451,7 +458,8 @@ class StreamProcessor:
                     raise
                 self.last_processed_position = cmds[-1].position
                 self._store_last_processed(self.last_processed_position)
-                pipeline["append"].observe(_time.perf_counter() - t_append)
+                append_dur = _time.perf_counter() - t_append
+                pipeline["append"].observe(append_dur)
         except Exception:  # noqa: BLE001 — the fallback/rollback seam
             if write_failed:
                 # a partial group append is already in the log; reprocessing
@@ -471,7 +479,8 @@ class StreamProcessor:
         self._deferred_effects.append((self.last_written_position, builders))
         t_flush = _time.perf_counter()
         self._group_commit_point()
-        pipeline["flush"].observe(_time.perf_counter() - t_flush)
+        flush_dur = _time.perf_counter() - t_flush
+        pipeline["flush"].observe(flush_dur)
         pipeline["decode"].observe(pending.t_admit)
         pipeline["device"].observe(pending.device_elapsed)
         pipeline["materialize"].observe(pending.t_materialize)
@@ -480,7 +489,53 @@ class StreamProcessor:
         self._m_latency.observe(elapsed)
         self._m_batch_commands.observe(len(cmds))
         self._m_batch_duration.observe(elapsed)
+        if self._tracer.enabled:
+            self._trace_group(cmds, elapsed, {
+                "decode": pending.t_admit, "device": pending.device_elapsed,
+                "materialize": pending.t_materialize, "append": append_dur,
+                "flush": flush_dur,
+            })
         return len(cmds)
+
+    def _trace_group(self, cmds: list[LoggedRecord], elapsed: float,
+                     stages: dict[str, float]) -> None:
+        """Spans for one kernel group: a group span with one child per
+        pipeline stage (the per-trace view of the stream_processor_pipeline_*
+        histograms), plus a latency-attributed span per sampled command —
+        Canopy-style: the group's wall time split evenly across its commands.
+        Also resolves each command's append stamp into the append→ack
+        histogram. Only called from the live PROCESSING path."""
+        import time as _time
+
+        tracer = self._tracer
+        pid = self.log_stream.partition_id
+        now = _time.perf_counter()
+        group_trace = f"{pid}:g{cmds[0].position}"
+        if tracer.sampled(group_trace):
+            tracer.emit(group_trace, "processor.kernel_group", elapsed, pid,
+                        attrs={"commands": len(cmds),
+                               "firstPosition": cmds[0].position,
+                               "lastPosition": cmds[-1].position})
+            for stage, dur in stages.items():
+                tracer.emit(group_trace, f"processor.stage.{stage}", dur, pid,
+                            parent="processor.kernel_group")
+        share = elapsed / len(cmds)
+        for cmd in cmds:
+            t_append = tracer.take_append(pid, cmd.position)
+            if t_append is not None:
+                tracer.observe_ack("processor", now - t_append)
+            fallback = (cmd.source_position if cmd.source_position >= 0
+                        else cmd.position)
+            root = tracer.resolve_root(pid, cmd.position, fallback)
+            trace_id = f"{pid}:{root}"
+            if tracer.sampled(trace_id):
+                rec = cmd.record
+                tracer.emit(trace_id, "processor.kernel_command", share, pid,
+                            attrs={"position": cmd.position,
+                                   "valueType": rec.value_type.name,
+                                   "intent": rec.intent.name,
+                                   "group": group_trace,
+                                   "attributed": True})
 
     def _emit_group_effects(self, builders: list) -> None:
         from zeebe_tpu.engine.burst_templates import PreparedBurst
@@ -597,6 +652,8 @@ class StreamProcessor:
         self._observe_follow_ups(builder.follow_ups)
         self._m_processed.inc()
         elapsed = _time.perf_counter() - start
+        if self._tracer.enabled:
+            self._trace_command(cmd, builder, elapsed)
         self._m_latency.observe(elapsed)
         self._m_processing_duration.observe(elapsed)
         self._m_batch_commands.observe(
@@ -604,6 +661,30 @@ class StreamProcessor:
                     if f.record.is_command and f.processed))
         self._m_batch_duration.observe(elapsed)
         self._m_post_commit.observe(len(builder.post_commit_tasks))
+
+    def _trace_command(self, cmd: LoggedRecord,
+                       builder: ProcessingResultBuilder, elapsed: float) -> None:
+        """Span + append→ack observation for one sequentially processed
+        command. The trace id is the root command's position (follow-up
+        commands inherit their producer's root via the batch source
+        backlink), so the span stream joins to the lineage walker's trees."""
+        import time as _time
+
+        tracer = self._tracer
+        pid = self.log_stream.partition_id
+        t_append = tracer.take_append(pid, cmd.position)
+        if t_append is not None:
+            tracer.observe_ack("processor", _time.perf_counter() - t_append)
+        fallback = cmd.source_position if cmd.source_position >= 0 else cmd.position
+        root = tracer.resolve_root(pid, cmd.position, fallback)
+        trace_id = f"{pid}:{root}"
+        if tracer.sampled(trace_id):
+            rec = cmd.record
+            tracer.emit(trace_id, "processor.command", elapsed, pid,
+                        attrs={"position": cmd.position,
+                               "valueType": rec.value_type.name,
+                               "intent": rec.intent.name,
+                               "followUps": len(builder.follow_ups)})
 
     def _batch_process(self, cmd: LoggedRecord, builder: ProcessingResultBuilder) -> None:
         """The batchProcessing loop: the input command plus follow-up commands

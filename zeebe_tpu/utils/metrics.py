@@ -15,6 +15,23 @@ import threading
 from typing import Iterable
 
 
+def _escape_label_value(value: str) -> str:
+    """Prometheus text-exposition escaping for label VALUES: backslash,
+    double-quote, and line-feed must be escaped or a single adversarial
+    label (an exporter id with a quote, an element id with a newline)
+    corrupts the whole scrape. Backslash first — escaping is not
+    commutative."""
+    return (value.replace("\\", "\\\\")
+                 .replace('"', '\\"')
+                 .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping per the exposition format: backslash and
+    line-feed only (quotes are legal in HELP text)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class _Metric:
     def __init__(self, name: str, help_text: str, label_names: tuple[str, ...]) -> None:
         self.name = name
@@ -59,7 +76,8 @@ class _Child:
         if not self.parent.label_names:
             return ""
         pairs = ",".join(
-            f'{n}="{v}"' for n, v in zip(self.parent.label_names, self.label_values)
+            f'{n}="{_escape_label_value(v)}"'
+            for n, v in zip(self.parent.label_names, self.label_values)
         )
         return "{" + pairs + "}"
 
@@ -199,7 +217,7 @@ class MetricsRegistry:
         """Prometheus text exposition format."""
         lines = []
         for metric in self._metrics.values():
-            lines.append(f"# HELP {metric.name} {metric.help}")
+            lines.append(f"# HELP {metric.name} {_escape_help(metric.help)}")
             lines.append(f"# TYPE {metric.name} {metric.type_name}")
             lines.extend(metric.collect())
         return "\n".join(lines) + "\n"
