@@ -56,6 +56,10 @@ class BrokerCfg:
     # large-state backend (reference: RocksDB zb-db + its checkpoint story).
     # Off by default: the in-memory store wins below ~100 MB of state.
     durable_state: bool = False
+    # metrics plane (observability/timeseries.py): registry sampling cadence
+    # for the in-memory time-series store + alert evaluation. 0 disables the
+    # whole plane — no store, no sampler, one is-None check per control pump.
+    metrics_sampling_ms: int = 250
 
 
 _AUTO_DEVICE_COUNT: int | None = None
@@ -167,6 +171,39 @@ class Broker:
             messaging, cfg.cluster_members, self.clock_millis
         )
         self.health_monitor = CriticalComponentsHealthMonitor(cfg.node_id)
+        # metrics plane: flight recorder always on (recording is O(1) deque
+        # appends); time-series store + sampler + alerts gated by cfg
+        from zeebe_tpu.observability.flight_recorder import (
+            FlightRecorder,
+            install_journal_stall_listener,
+        )
+
+        self.flight_recorder = FlightRecorder(
+            cfg.node_id, self.directory, clock_millis=self.clock_millis)
+        install_journal_stall_listener(self.flight_recorder)
+        if cfg.metrics_sampling_ms > 0:
+            from zeebe_tpu.observability.alerts import AlertEvaluator
+            from zeebe_tpu.observability.timeseries import (
+                MetricsSampler,
+                TimeSeriesStore,
+            )
+
+            self.timeseries: TimeSeriesStore | None = TimeSeriesStore()
+            self.sampler: MetricsSampler | None = MetricsSampler(
+                REGISTRY, self.timeseries,
+                interval_ms=cfg.metrics_sampling_ms,
+                clock_millis=self.clock_millis)
+            self.alerts: AlertEvaluator | None = AlertEvaluator(
+                self.timeseries, node_id=cfg.node_id,
+                on_transition=self._on_alert_transition)
+            # dumps carry the alert state alongside the event rings
+            self.flight_recorder.add_context_provider(
+                lambda: {"alerts": self.alerts.snapshot()})
+        else:
+            self.timeseries = None
+            self.sampler = None
+            self.alerts = None
+        self.health_monitor.add_listener(self._on_health_transition)
         self._metrics = {
             "written": REGISTRY.counter(
                 "log_appender_record_appended_total",
@@ -269,6 +306,52 @@ class Broker:
         start_steps.labels("partition-manager").observe(
             time.perf_counter() - step_start)
 
+    # -- metrics plane ---------------------------------------------------------
+
+    def _on_health_transition(self, report) -> None:
+        """Health changes land in the flight recorder; a transition to
+        UNHEALTHY/DEAD dumps the rings to disk — the postmortem must exist
+        BEFORE anyone asks for it."""
+        from zeebe_tpu.utils.health import HealthStatus
+
+        component = report.component
+        partition_id = 0
+        if component.startswith("partition-"):
+            try:
+                partition_id = int(component[len("partition-"):].split(".")[0])
+            except ValueError:
+                pass
+        self.flight_recorder.record(
+            partition_id, "health", component=component,
+            status=report.status.name, message=report.message)
+        if report.status >= HealthStatus.UNHEALTHY:
+            self.flight_recorder.dump(f"unhealthy:{component}")
+
+    def _on_alert_transition(self, rule, labels: str, old: str,
+                             new: str) -> None:
+        self.flight_recorder.record(
+            0, "alert", rule=rule.name, labels=labels, state=new,
+            previous=old, expr=rule.describe())
+
+    def hard_crash(self) -> None:
+        """Power-loss crash for the whole broker (chaos harness): dump the
+        flight rings FIRST — the dump is the black box a real crash handler
+        would flush — then lose every unfsynced byte."""
+        for pid in self.partitions:
+            self.flight_recorder.record(
+                pid, "crash", detail="power-loss (hard crash)")
+        self.flight_recorder.dump("hard-crash", force=True)
+        self._remove_journal_listener()
+        for partition in self.partitions.values():
+            partition.hard_crash()
+
+    def _remove_journal_listener(self) -> None:
+        from zeebe_tpu.observability.flight_recorder import (
+            remove_journal_stall_listener,
+        )
+
+        remove_journal_stall_listener(self.flight_recorder)
+
     def _persist_topology(self, doc: dict) -> None:
         import json
 
@@ -358,6 +441,7 @@ class Broker:
             mesh_runner=self._mesh_runner(),
             durable_state=self.cfg.durable_state,
             health_monitor=self.health_monitor,
+            flight_recorder=self.flight_recorder,
         )
         self.health_monitor.register(f"partition-{partition_id}")
         from zeebe_tpu.utils.metrics import REGISTRY as _REG
@@ -599,6 +683,8 @@ class Broker:
             for partition in list(self.partitions.values()):
                 partition.disk_paused = disk_paused
         self._update_observability()
+        if self.sampler is not None and self.sampler.maybe_sample():
+            self.alerts.evaluate(self.clock_millis())
         self._gossip_roles()
         return 0
 
@@ -650,6 +736,7 @@ class Broker:
         close_latency = _REG.histogram(
             "broker_close_step_latency",
             "seconds per broker shutdown step", ("step",))
+        self._remove_journal_listener()
         for pid, partition in self.partitions.items():
             step_start = _time.perf_counter()
             partition.close()
@@ -823,8 +910,8 @@ class InProcessCluster:
             raise KeyError(f"unknown broker {node_id}")
         self._stopped_cfgs[node_id] = broker.cfg
         self.net.leave(node_id)
-        for partition in broker.partitions.values():
-            partition.hard_crash()
+        # dumps the flight rings (the black box), then loses unfsynced bytes
+        broker.hard_crash()
         # the data directory stays intact (cluster brokers always get one
         # from the cluster): restart_broker recovers the fsynced prefix
 

@@ -91,6 +91,10 @@ _ENV_BINDINGS: dict[str, tuple[str, str, Any]] = {
         "base", "kernel_mesh_shards", int),
     "ZEEBE_BROKER_EXPERIMENTAL_DURABLESTATE": (
         "base", "durable_state", lambda v: v.lower() in ("1", "true", "yes")),
+    # metrics plane: registry→time-series sampling cadence (0 disables the
+    # store, the sampler, and alert evaluation)
+    "ZEEBE_BROKER_METRICS_SAMPLINGINTERVALMS": (
+        "base", "metrics_sampling_ms", int),
 }
 
 

@@ -5,10 +5,19 @@ probes wired to BrokerHealthCheckService, Prometheus servlet, /actuator/backups
 trigger, pause/resume processing via BrokerAdminService).
 
 Endpoints:
-  GET  /health    → aggregated component health (liveness)
+  GET  /health    → aggregated component health (liveness) + firing alerts
   GET  /ready     → 200 when every local partition has a role and a processor
   GET  /metrics   → Prometheus text exposition
   GET  /partitions → per-partition health dicts
+  GET  /timeseries → retained metric history from the in-memory store
+                    (?name= series or histogram base name — no name lists
+                    the names; ?since= unix ms; ?step= ms downsampling)
+  GET  /flight    → the flight recorder's live event rings (the same payload
+                    a crash dumps to <data-dir>/flight-<ts>.json)
+  GET  /alerts    → alert evaluator state (pending + firing)
+  GET  /cluster/status → topology + per-broker health/alerts/headline rates,
+                    aggregated across all brokers when the server is given
+                    the hosting runtime (in-process fan-out), else local
   GET  /traces    → collected tracing spans (observability subsystem);
                     ?format=chrome returns Chrome-trace-event JSON that opens
                     directly in Perfetto, ?limit=N tails the newest N spans
@@ -32,9 +41,12 @@ from zeebe_tpu.utils.metrics import REGISTRY
 
 class ManagementServer:
     def __init__(self, broker, bind: tuple[str, int] = ("127.0.0.1", 0),
-                 registry=None) -> None:
+                 registry=None, runtime=None) -> None:
         self.broker = broker
         self.registry = registry or REGISTRY
+        # hosting ClusterRuntime (optional): enables the /cluster/status
+        # all-broker fan-out for the in-process deployment shape
+        self.runtime = runtime
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -72,6 +84,12 @@ class ManagementServer:
             handler._send(200, self.registry.expose(), "text/plain; version=0.0.4")
         elif path == "/health":
             health = self.broker.health_monitor.to_dict()
+            alerts = getattr(self.broker, "alerts", None)
+            if alerts is not None:
+                # alert details ride the health payload so one probe answers
+                # both "is it up" and "is anything on fire"
+                health["alerts"] = alerts.snapshot()
+                health["alertsFiring"] = len(alerts.firing())
             code = 200 if self.broker.health_monitor.is_healthy() else 503
             handler._send(code, json.dumps(health))
         elif path == "/ready":
@@ -83,6 +101,59 @@ class ManagementServer:
             handler._send(200, json.dumps(
                 [p.health() for p in self.broker.partitions.values()]
             ))
+        elif path == "/timeseries":
+            from urllib.parse import parse_qs, urlsplit
+
+            store = getattr(self.broker, "timeseries", None)
+            if store is None:
+                handler._send(404, json.dumps(
+                    {"error": "time-series sampling disabled "
+                              "(metrics_sampling_ms=0)"}))
+                return
+            params = parse_qs(urlsplit(handler.path).query)
+            name = params.get("name", [""])[0]
+            if not name:
+                stats = store.stats()
+                stats.pop("series", None)  # the count would shadow the list
+                handler._send(200, json.dumps({
+                    "series": store.series_names(), **stats,
+                    "seriesCount": len(store.series_names())}))
+                return
+            try:
+                since = int(params.get("since", ["0"])[0])
+                step = int(params.get("step", ["0"])[0])
+            except ValueError:
+                handler._send(400, json.dumps(
+                    {"error": "since and step must be integers (ms)"}))
+                return
+            handler._send(200, json.dumps({
+                "name": name, "since": since, "step": step,
+                "series": store.query(name, since_ms=since, step_ms=step),
+            }))
+        elif path == "/flight":
+            recorder = getattr(self.broker, "flight_recorder", None)
+            if recorder is None:
+                handler._send(404, json.dumps(
+                    {"error": "no flight recorder"}))
+                return
+            handler._send(200, json.dumps(recorder.snapshot(), default=str))
+        elif path == "/alerts":
+            alerts = getattr(self.broker, "alerts", None)
+            if alerts is None:
+                handler._send(404, json.dumps(
+                    {"error": "alert evaluation disabled"}))
+                return
+            handler._send(200, json.dumps({
+                "alerts": alerts.snapshot(),
+                "firing": len(alerts.firing()),
+                "rules": [r.describe() for r in alerts.rules],
+            }))
+        elif path == "/cluster/status":
+            if self.runtime is not None:
+                status = self.runtime.cluster_status()
+            else:
+                status = cluster_status([self.broker])
+            handler._send(200, json.dumps(status))
         elif path == "/traces":
             from urllib.parse import parse_qs, urlsplit
 
@@ -111,11 +182,8 @@ class ManagementServer:
             from urllib.parse import parse_qs, urlsplit
 
             params = parse_qs(urlsplit(handler.path).query)
-            try:
-                seconds = min(float(params.get("seconds", ["2.0"])[0]), 30.0)
-            except ValueError:
-                seconds = -1.0
-            if not 0 < seconds:  # also rejects NaN
+            seconds = parse_profile_seconds(params.get("seconds", ["2.0"])[0])
+            if seconds is None:
                 handler._send(400, json.dumps(
                     {"error": "seconds must be a positive number"}))
                 return
@@ -165,6 +233,97 @@ class ManagementServer:
         self.server.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=5)
+
+
+PROFILE_MAX_SECONDS = 30.0
+
+
+def parse_profile_seconds(raw: str) -> float | None:
+    """``?seconds=`` validation for /profile: positive finite number, capped
+    at :data:`PROFILE_MAX_SECONDS` (the profiler blocks a handler thread for
+    the whole window — an uncapped value is a free DoS). None = reject 400."""
+    try:
+        seconds = min(float(raw), PROFILE_MAX_SECONDS)
+    except ValueError:
+        return None
+    if not 0 < seconds:  # also rejects NaN
+        return None
+    return seconds
+
+
+# -- cluster status aggregation ------------------------------------------------
+
+_RATE_WINDOW_MS = 10_000
+
+
+def broker_status(broker) -> dict:
+    """One broker's row in /cluster/status: health, roles, alert state, and
+    headline rates read from its time-series store (appends/s from the
+    counter-as-rate series, processing/s from the processed-position gauge's
+    trailing-window increase, export lag from the per-container lag gauge)."""
+    node = broker.cfg.node_id
+    status: dict = {
+        "nodeId": node,
+        "health": broker.health_monitor.status().name,
+        "partitions": {
+            str(pid): {"role": p.role.value, "term": p.raft.current_term,
+                       "lastPosition": p.stream.last_position}
+            for pid, p in sorted(broker.partitions.items())
+        },
+    }
+    alerts = getattr(broker, "alerts", None)
+    if alerts is not None:
+        firing = alerts.firing()
+        status["alertsFiring"] = len(firing)
+        status["alerts"] = firing
+    store = getattr(broker, "timeseries", None)
+    if store is not None:
+        now = broker.clock_millis()
+        node_label = f'node="{node}"'
+        append_rate = sum(
+            e["value"] for e in store.latest(
+                "zeebe_log_appender_record_appended_total")
+            if node_label in e["labels"])
+        status["rates"] = {
+            "appendPerSec": round(append_rate, 1),
+            "processedPerSec": round(store.rate(
+                "zeebe_stream_processor_last_processed_position",
+                _RATE_WINDOW_MS, now, labels_contains=node_label), 1),
+        }
+        lag = [e["value"] for e in store.latest(
+            "zeebe_exporter_container_lag_records")]
+        if lag:
+            status["rates"]["exportLagRecords"] = max(lag)
+    return status
+
+
+def cluster_status(brokers) -> dict:
+    """Aggregate /cluster/status over a set of (in-process) brokers: the
+    gossiped topology document (cluster-wide by construction — any broker's
+    copy serves), per-broker status rows, and the cluster-level headline."""
+    brokers = list(brokers)
+    rows = [broker_status(b) for b in brokers]
+    topology = brokers[0].topology.topology.summary() if brokers else {}
+    partition_ids = {
+        pid for member in topology.get("members", {}).values()
+        for pid in member.get("partitions", {})
+    }
+    firing = sum(r.get("alertsFiring", 0) for r in rows)
+    worst = max((r["health"] for r in rows), default="HEALTHY",
+                key=lambda name: ["HEALTHY", "DEGRADED", "UNHEALTHY",
+                                  "DEAD"].index(name))
+    return {
+        "clusterSize": len(rows),
+        "partitionsCount": len(partition_ids),
+        "health": worst,
+        "alertsFiring": firing,
+        "appendPerSec": round(sum(
+            r.get("rates", {}).get("appendPerSec", 0.0) for r in rows), 1),
+        "processedPerSec": round(sum(
+            r.get("rates", {}).get("processedPerSec", 0.0) for r in rows), 1),
+        "topology": topology,
+        "brokers": rows,
+    }
 
 def sample_profile(seconds: float, hz: float = 100.0) -> dict:
     """Sampling profiler over every runtime thread (the management

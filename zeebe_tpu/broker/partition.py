@@ -107,6 +107,7 @@ class ZeebePartition:
         mesh_runner=None,
         durable_state: bool = False,
         health_monitor=None,
+        flight_recorder=None,
     ) -> None:
         self.partition_id = partition_id
         self.partition_count = partition_count
@@ -131,6 +132,10 @@ class ZeebePartition:
         # broker health monitor (CriticalComponentsHealthMonitor | None): the
         # exporter director reports per-exporter DEGRADED/HEALTHY through it
         self.health_monitor = health_monitor
+        # flight recorder (observability/flight_recorder.py | None): this
+        # partition's bounded black-box ring of operational events
+        self.flight = flight_recorder
+        self._exporter_flight_status: dict[str, Any] = {}
         # client-ingress backpressure (CommandRateLimiter | None) and the
         # disk-monitor pause flag; both gate client_write only — follow-ups,
         # scheduled commands, and inter-partition traffic always pass
@@ -185,10 +190,21 @@ class ZeebePartition:
                 entry["data"], entry["asqn"],
                 has_pending_commands=self._prepatched_flags.pop(entry["asqn"], None),
             )
+            if self.flight is not None:
+                # last-K committed-batch summaries (one ring entry per BATCH,
+                # not per record — count is the payload's leading u32)
+                import struct as _struct
+
+                self.flight.record(
+                    self.partition_id, "records", first=entry["asqn"],
+                    count=_struct.unpack_from("<I", entry["data"], 0)[0])
         self._next_position = max(self._next_position, self.stream.last_position + 1)
 
     def _on_role_change(self, role: RaftRole, term: int) -> None:
         self.role = role
+        if self.flight is not None:
+            self.flight.record(self.partition_id, "role_change",
+                               role=role.value, term=term)
         self._transition()
 
     # -- transition steps (reference: PartitionTransitionImpl) -----------------
@@ -367,6 +383,12 @@ class ZeebePartition:
         traced = tracer.enabled
         t0 = _perf_counter() if traced else 0.0
         if self.limiter is not None and not self.limiter.try_acquire(record):
+            if self.flight is not None:
+                # on the rejection (exception) path only — never on admits
+                self.flight.record(
+                    self.partition_id, "backpressure_reject",
+                    limit=self.limiter.limit,
+                    valueType=record.value_type.name)
             raise BackpressureExceeded(
                 f"partition {self.partition_id} has reached its in-flight "
                 f"command limit ({self.limiter.limit})"
@@ -654,6 +676,14 @@ class ZeebePartition:
                                 message: str = "") -> None:
         """Per-exporter health sub-component under this partition (a backing-
         off exporter degrades the broker without taking the partition down)."""
+        if (self.flight is not None
+                and self._exporter_flight_status.get(exporter_id) != status):
+            # transitions only: a backing-off exporter re-reports DEGRADED on
+            # every retry, which would crowd everything else out of the ring
+            self._exporter_flight_status[exporter_id] = status
+            self.flight.record(self.partition_id, "exporter_state",
+                               exporter=exporter_id, status=status.name,
+                               message=message)
         if self.health_monitor is not None:
             self.health_monitor.report(
                 f"partition-{self.partition_id}.exporter-{exporter_id}",

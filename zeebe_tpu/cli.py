@@ -4,6 +4,13 @@ Reference: clients/go/cmd/zbctl/internal/commands/*.go — status, deploy,
 create instance/worker, activate jobs, complete/fail job, publish message,
 broadcast signal, resolve incident, set variables. JSON in, JSON out.
 
+Beyond zbctl parity:
+  trace        — offline causal-tree reconstruction from a journal
+  top          — htop-style live cluster view over GET /cluster/status
+                 (``--once`` prints a single frame for scripting)
+  metrics-doc  — generate docs/metrics.md from the live metric registry
+                 (``--check`` fails on drift; wired into CI)
+
 Usage: python -m zeebe_tpu.cli --address host:port <command> …
 """
 
@@ -108,11 +115,35 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--pretty", action="store_true",
                    help="ASCII tree instead of JSON")
 
+    p = sub.add_parser(
+        "top",
+        help="live cluster view (health, roles, rates, alerts) over the "
+             "management server's /cluster/status")
+    p.add_argument("--management", default="http://127.0.0.1:9600",
+                   help="management server base URL")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period, seconds")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit (scripting)")
+
+    p = sub.add_parser(
+        "metrics-doc",
+        help="generate the metrics reference (docs/metrics.md) from a "
+             "representative broker scenario's live registry")
+    p.add_argument("--output", default="docs/metrics.md")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 if the committed file drifted from the "
+                        "generated content (CI gate)")
+
     args = parser.parse_args(argv)
 
     if args.cmd == "trace":
         # offline journal walk — no gateway connection
         return _trace(args)
+    if args.cmd == "top":
+        return _top(args)
+    if args.cmd == "metrics-doc":
+        return _metrics_doc(args)
 
     from zeebe_tpu.client import JobWorker, ZeebeTpuClient
 
@@ -163,6 +194,209 @@ def _trace(args) -> int:
             _out(lineage)
     finally:
         journal.close()
+    return 0
+
+
+# -- top: live cluster view ----------------------------------------------------
+
+
+def _render_top(status: dict) -> str:
+    """One frame of the `top` view from a /cluster/status payload. Pure
+    (testable): no terminal control, no I/O."""
+    lines = []
+    topo = status.get("topology", {})
+    lines.append(
+        f"zeebe-tpu cluster · {status.get('clusterSize', 0)} broker(s) · "
+        f"{status.get('partitionsCount', '?')} partition(s) · "
+        f"health {status.get('health', '?')} · "
+        f"{status.get('alertsFiring', 0)} alert(s) firing")
+    lines.append(
+        f"append {status.get('appendPerSec', 0.0)}/s · "
+        f"processed {status.get('processedPerSec', 0.0)}/s · "
+        f"topology v{topo.get('version', '?')}"
+        + (" · change in progress" if topo.get("changeInProgress") else ""))
+    lines.append("")
+    header = (f"{'NODE':<14} {'HEALTH':<10} {'ROLES':<22} "
+              f"{'APPEND/S':>9} {'PROC/S':>9} {'EXPLAG':>7} {'ALERTS':>6}")
+    lines.append(header)
+    for row in status.get("brokers", []):
+        roles = " ".join(
+            f"{pid}:{info['role'][:1].upper()}"
+            for pid, info in sorted(row.get("partitions", {}).items(),
+                                    key=lambda kv: int(kv[0]))
+        ) or "-"
+        rates = row.get("rates", {})
+        lines.append(
+            f"{row.get('nodeId', '?'):<14} {row.get('health', '?'):<10} "
+            f"{roles:<22} "
+            f"{rates.get('appendPerSec', 0.0):>9} "
+            f"{rates.get('processedPerSec', 0.0):>9} "
+            f"{int(rates.get('exportLagRecords', 0)):>7} "
+            f"{row.get('alertsFiring', 0):>6}")
+    firing = [a for row in status.get("brokers", [])
+              for a in row.get("alerts", [])]
+    if firing:
+        lines.append("")
+        lines.append("firing alerts:")
+        for alert in firing:
+            lines.append(
+                f"  [{alert.get('severity', '?')}] {alert.get('rule', '?')} "
+                f"{alert.get('labels', '')} value={alert.get('value', '?')} "
+                f"({alert.get('expr', '')})")
+    return "\n".join(lines)
+
+
+def _fetch_cluster_status(base_url: str) -> dict:
+    import urllib.request
+
+    url = base_url.rstrip("/") + "/cluster/status"
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _top(args) -> int:
+    # ValueError covers json.JSONDecodeError: a proxy error page or a wrong
+    # port answering 200 with HTML must not become a raw traceback
+    try:
+        frame = _render_top(_fetch_cluster_status(args.management))
+    except (OSError, ValueError) as exc:
+        print(f"cannot reach {args.management}: {exc}", file=sys.stderr)
+        return 2
+    if args.once:
+        print(frame)
+        return 0
+    try:
+        while True:
+            # \x1b[H home + \x1b[2J clear: classic full-repaint refresh; \x1b[J
+            # after the frame clears any leftover tail from a taller frame
+            sys.stdout.write(f"\x1b[H\x1b[2J{frame}\n\x1b[J")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+            frame = _render_top(_fetch_cluster_status(args.management))
+    except KeyboardInterrupt:
+        return 0
+    except (OSError, ValueError) as exc:
+        print(f"\nlost {args.management}: {exc}", file=sys.stderr)
+        return 2
+
+
+# -- metrics-doc: generated metric reference -----------------------------------
+
+_METRICS_DOC_HEADER = """\
+# Metrics reference
+
+> Auto-generated by `python -m zeebe_tpu.cli metrics-doc` from the live
+> metric registry after a representative single-broker scenario (boot,
+> deploy, process, snapshot, checkpoint, exporter/gateway/DMN component
+> construction). **Do not edit by hand** — regenerate with
+> `python -m zeebe_tpu.cli metrics-doc` and commit; CI fails on drift.
+>
+> Conventions: histograms additionally expose `_bucket`/`_sum`/`_count`
+> series on `/metrics`; every series is retained as history by the
+> in-memory time-series store (`GET /timeseries`, counters as rates,
+> histograms as p50/p99) while the broker's sampler is enabled.
+"""
+
+
+def _register_metrics_scenario() -> None:
+    """Run the representative scenario whose side effect is registering
+    every metric family: a single-broker deterministic cluster processing a
+    deployment, a snapshot, a checkpoint, plus the components that register
+    at construction (ES exporter, gateway rpc wrappers, DMN counter,
+    process self-metrics)."""
+    import tempfile
+
+    from zeebe_tpu.backup.checkpoint import CheckpointState
+    from zeebe_tpu.broker.broker import InProcessCluster
+    from zeebe_tpu.exporters import ElasticsearchExporter
+    from zeebe_tpu.exporters.api import Exporter
+    from zeebe_tpu.models.bpmn import Bpmn, to_bpmn_xml
+    from zeebe_tpu.protocol import ValueType, command
+    from zeebe_tpu.protocol.intent import DeploymentIntent
+    from zeebe_tpu.utils.metrics import install_process_metrics
+
+    class _SinkExporter(Exporter):
+        def export(self, record) -> None:
+            self.controller.update_last_exported_position(record.position)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        cluster = InProcessCluster(
+            broker_count=1, partition_count=1, replication_factor=1,
+            directory=tmp,
+            exporters_factory=lambda: {"recording": _SinkExporter()})
+        try:
+            cluster.await_leaders()
+            model = (Bpmn.create_executable_process("metrics_doc")
+                     .start_event("s").end_event("e").done())
+            cluster.write_command(1, command(
+                ValueType.DEPLOYMENT, DeploymentIntent.CREATE,
+                {"resources": [{"resourceName": "m.bpmn",
+                                "resource": to_bpmn_xml(model)}]}))
+            cluster.run(500)
+            partition = cluster.leader(1)
+            partition.take_snapshot()
+            with partition.db.transaction():
+                CheckpointState(partition.db).put(1, 1)
+        finally:
+            cluster.close()
+    ElasticsearchExporter(sink=lambda payload: None)
+    import zeebe_tpu.engine.decision  # noqa: F401 — registers the DMN counter
+    from zeebe_tpu.gateway.gateway import _wrap
+
+    def Topology(request, context):  # noqa: N802 — rpc-shaped name
+        return None
+
+    _wrap(Topology)
+    install_process_metrics()
+
+
+def _render_metrics_doc() -> str:
+    from zeebe_tpu.utils.metrics import REGISTRY
+
+    def cell(text: str) -> str:
+        return text.replace("|", "\\|").replace("\n", " ")
+
+    families = REGISTRY.describe()
+    lines = [_METRICS_DOC_HEADER]
+    lines.append(f"{len(families)} metric families.\n")
+    lines.append("| name | type | labels | help |")
+    lines.append("| --- | --- | --- | --- |")
+    for fam in families:
+        labels = ", ".join(f"`{n}`" for n in fam["labels"]) or "—"
+        lines.append(
+            f"| `{fam['name']}` | {fam['type']} | {labels} "
+            f"| {cell(fam['help']) or '—'} |")
+    return "\n".join(lines) + "\n"
+
+
+def _metrics_doc(args) -> int:
+    import os
+    from pathlib import Path
+
+    # the scenario boots a broker, which may initialize JAX: never let the
+    # doc generator hang on an unreachable accelerator tunnel
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _register_metrics_scenario()
+    content = _render_metrics_doc()
+    path = Path(args.output)
+    if args.check:
+        committed = path.read_text() if path.exists() else ""
+        if committed != content:
+            print(f"{path} drifted from the registry — regenerate with "
+                  f"`python -m zeebe_tpu.cli metrics-doc`", file=sys.stderr)
+            import difflib
+
+            diff = difflib.unified_diff(
+                committed.splitlines(), content.splitlines(),
+                fromfile=str(path), tofile="generated", lineterm="", n=1)
+            for line in list(diff)[:40]:
+                print(line, file=sys.stderr)
+            return 1
+        print(f"{path} is up to date ({content.count(chr(10))} lines)")
+        return 0
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(content)
+    print(f"wrote {path}")
     return 0
 
 

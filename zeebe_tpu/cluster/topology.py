@@ -91,6 +91,27 @@ class ClusterTopology:
         idx = change["index"]
         return ops[idx] if idx < len(ops) else None
 
+    def summary(self) -> dict:
+        """Compact read-only view for ``GET /cluster/status``: version,
+        member/replica states and priorities, and whether a change plan is
+        mid-flight (operators care that a move is in progress, not about the
+        operation list's internals)."""
+        members = {}
+        for member_id, member in sorted(self.members.items()):
+            members[member_id] = {
+                "state": member.get("state", ACTIVE),
+                "partitions": {
+                    pid: {"state": p.get("state", ACTIVE),
+                          "priority": p.get("priority", 1)}
+                    for pid, p in sorted(member.get("partitions", {}).items())
+                },
+            }
+        return {
+            "version": self.version,
+            "members": members,
+            "changeInProgress": self.change is not None,
+        }
+
     # -- construction ---------------------------------------------------------
 
     @classmethod

@@ -272,6 +272,20 @@ class ClusterRuntime(GatewayRuntimeBase):
                 "brokers": [b.health() for b in self.brokers.values()],
             }
 
+    def cluster_status(self) -> dict:
+        """Cluster-wide health/alert/rate aggregation for the management
+        ``GET /cluster/status`` and ``zbctl top`` — the in-process fan-out
+        over every hosted broker (reference analog: the gateway's topology
+        view, widened with the metrics plane)."""
+        from zeebe_tpu.broker.management import cluster_status
+
+        # lock-free reads: broker_status only touches plain attributes and
+        # the thread-safe time-series store, so a stalled partition thread
+        # cannot wedge the status endpoint behind the control lock
+        status = cluster_status(list(self.brokers.values()))
+        status["partitionsCount"] = self.partition_count
+        return status
+
     # -- partition selection ---------------------------------------------------
 
     def has_activatable_jobs(self, partition_id: int, job_type: str,

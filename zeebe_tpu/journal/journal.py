@@ -96,6 +96,13 @@ _C_TRY_APPEND = _M_TRY_APPEND.labels()
 _C_DRAINS = _M_DRAINS.labels()
 _C_DRAIN_BYTES = _M_DRAIN_BYTES.labels()
 
+# flight-recorder seam (observability/flight_recorder.py): listeners called
+# with (directory, seconds) when a flush exceeds the stall threshold. Module
+# level because a journal knows only its directory, not its partition; the
+# empty-list common case costs one truthiness check per fsync (not per append)
+SLOW_FLUSH_THRESHOLD_S = 0.25
+slow_flush_listeners: list = []
+
 from time import perf_counter as _perf
 
 _MAGIC = 0x5A4A4E4C  # "ZJNL"
@@ -560,6 +567,12 @@ class SegmentedJournal:
         elapsed = _perf() - start
         _M_FLUSH_SECONDS.observe(elapsed)
         _M_FLUSH_TIME.observe(elapsed)
+        if slow_flush_listeners and elapsed >= SLOW_FLUSH_THRESHOLD_S:
+            for listener in list(slow_flush_listeners):
+                try:
+                    listener(str(self.dir), elapsed)
+                except Exception:  # noqa: BLE001 — diagnostics must never
+                    pass           # fail the durability path
         if _TRACER.enabled:
             # group-flush span: the durability edge every gated ack waits on
             # (flushes are group-commit cadence, not per-append — cheap)

@@ -1,7 +1,8 @@
-"""Distributed tracing & record-lineage observability.
+"""Distributed tracing, record lineage & the cluster metrics plane.
 
 What the reference broker never had (SURVEY §5.1): a Dapper-style span layer
-over the engine's own causal substrate. Three pieces:
+over the engine's own causal substrate — and what it outsourced to
+Prometheus: retained metric history. Six pieces:
 
 - ``span``: the span model, the seeded deterministic sampler, and the
   bounded per-process collector with JSONL / Chrome-trace (Perfetto) export.
@@ -11,18 +12,38 @@ over the engine's own causal substrate. Three pieces:
 - ``lineage``: the offline causal-tree walker — reconstructs a process
   instance's full record lineage from a journal alone, via the
   ``source_record_position`` backlinks every sequenced batch already carries.
+- ``timeseries``: Gorilla-style in-memory bounded-retention time-series
+  store + the registry sampler (counters→rates, histograms→p50/p99) behind
+  ``GET /timeseries`` and the alert evaluator.
+- ``flight_recorder``: per-partition bounded event rings (role changes,
+  errors, backpressure, flush stalls, exporter transitions, batch
+  summaries), dumped to ``<data-dir>/flight-<ts>.json`` on crash/unhealthy.
+- ``alerts``: threshold + for-duration rules over the time-series store
+  (default set: lag / backpressure / flush latency / role flapping),
+  surfaced in ``/health`` and the ``zeebe_alerts_firing`` gauge.
 
 Spans are emitted ONLY on live processing (gateway request, command append,
 backpressure acquire, journal group-flush, PROCESSING-phase steps and their
 pipeline stages, exporter delivery). Replay emits nothing, by construction.
 """
 
+from zeebe_tpu.observability.alerts import (
+    AlertEvaluator,
+    AlertRule,
+    default_rules,
+)
+from zeebe_tpu.observability.flight_recorder import FlightRecorder
 from zeebe_tpu.observability.lineage import collect_lineage, format_lineage
 from zeebe_tpu.observability.span import (
     DeterministicSampler,
     Span,
     SpanCollector,
     chrome_trace,
+)
+from zeebe_tpu.observability.timeseries import (
+    MetricsSampler,
+    TimeSeriesStore,
+    summarize_store,
 )
 from zeebe_tpu.observability.tracer import (
     Tracer,
@@ -31,13 +52,20 @@ from zeebe_tpu.observability.tracer import (
 )
 
 __all__ = [
+    "AlertEvaluator",
+    "AlertRule",
     "DeterministicSampler",
+    "FlightRecorder",
+    "MetricsSampler",
     "Span",
     "SpanCollector",
+    "TimeSeriesStore",
     "Tracer",
     "chrome_trace",
     "collect_lineage",
     "configure_tracing",
+    "default_rules",
     "format_lineage",
     "get_tracer",
+    "summarize_store",
 ]

@@ -201,6 +201,7 @@ def main(argv: list[str] | None = None) -> int:
         management = ManagementServer(
             next(iter(runtime.brokers.values())),
             bind=("0.0.0.0", args.management_port),
+            runtime=runtime,  # /cluster/status fans out over every broker
         )
         management.start()
         print(f"management on :{management.port}", file=sys.stderr)

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import bisect
 import threading
-from typing import Iterable
+from typing import Callable, Iterable
 
 
 def _escape_label_value(value: str) -> str:
@@ -66,6 +66,14 @@ class _Metric:
             self._default_child = child
         return child
 
+    def _children_snapshot(self) -> list["_Child"]:
+        """Children list captured under the lock: ``collect()`` runs on the
+        management scrape thread while hot paths call ``labels()`` — iterating
+        the live dict can raise ``RuntimeError: dictionary changed size
+        during iteration`` mid-scrape."""
+        with self._lock:
+            return list(self._children.values())
+
 
 class _Child:
     def __init__(self, parent: _Metric, label_values: tuple) -> None:
@@ -100,7 +108,7 @@ class Counter(_Metric):
         self._default().inc(amount)
 
     def collect(self) -> Iterable[str]:
-        for child in self._children.values():
+        for child in self._children_snapshot():
             yield f"{self.name}{child._label_str()} {child.value}"
 
 
@@ -134,7 +142,7 @@ class Gauge(_Metric):
         self._default().dec(amount)
 
     def collect(self) -> Iterable[str]:
-        for child in self._children.values():
+        for child in self._children_snapshot():
             yield f"{self.name}{child._label_str()} {child.value}"
 
 
@@ -169,7 +177,7 @@ class Histogram(_Metric):
         self._default().observe(value)
 
     def collect(self) -> Iterable[str]:
-        for child in self._children.values():
+        for child in self._children_snapshot():
             labels = child._label_str()
             base = labels[1:-1] if labels else ""
             cumulative = 0
@@ -186,14 +194,38 @@ class Histogram(_Metric):
             yield f"{self.name}_count{labels} {child.count}"
 
 
+def estimate_quantile(buckets: tuple, bucket_counts: list, q: float) -> float:
+    """Quantile estimate from cumulative histogram buckets, Prometheus
+    ``histogram_quantile`` style: find the bucket the q-th observation lands
+    in and interpolate linearly inside it. The +Inf bucket clamps to the
+    highest finite bound (there is no upper edge to interpolate toward)."""
+    total = sum(bucket_counts)
+    if total == 0:
+        return 0.0
+    rank = q * total
+    cumulative = 0.0
+    for i, count in enumerate(bucket_counts[:-1]):
+        prev_cumulative = cumulative
+        cumulative += count
+        if cumulative >= rank and count:
+            lower = buckets[i - 1] if i > 0 else 0.0
+            upper = buckets[i]
+            return lower + (upper - lower) * (rank - prev_cumulative) / count
+    return float(buckets[-1]) if buckets else 0.0
+
+
 class MetricsRegistry:
     def __init__(self, namespace: str = "zeebe") -> None:
         self.namespace = namespace
         self._metrics: dict[str, _Metric] = {}
         self._lock = threading.Lock()
+        # hooks run at scrape/snapshot time to refresh pull-style values
+        # (process CPU/RSS/GC) that nothing in the hot path updates
+        self._collect_hooks: list[Callable[[], None]] = []
 
-    def _register(self, cls, name: str, help_text: str, labels: tuple, **kw) -> _Metric:
-        full = f"{self.namespace}_{name}"
+    def _register(self, cls, name: str, help_text: str, labels: tuple,
+                  raw: bool = False, **kw) -> _Metric:
+        full = name if raw else f"{self.namespace}_{name}"
         with self._lock:
             metric = self._metrics.get(full)
             if metric is None:
@@ -202,26 +234,154 @@ class MetricsRegistry:
             return metric
 
     def counter(self, name: str, help_text: str = "",
-                labels: tuple[str, ...] = ()) -> Counter:
-        return self._register(Counter, name, help_text, labels)
+                labels: tuple[str, ...] = (), raw: bool = False) -> Counter:
+        return self._register(Counter, name, help_text, labels, raw=raw)
 
     def gauge(self, name: str, help_text: str = "",
-              labels: tuple[str, ...] = ()) -> Gauge:
-        return self._register(Gauge, name, help_text, labels)
+              labels: tuple[str, ...] = (), raw: bool = False) -> Gauge:
+        return self._register(Gauge, name, help_text, labels, raw=raw)
 
     def histogram(self, name: str, help_text: str = "",
-                  labels: tuple[str, ...] = (), buckets=_DEFAULT_BUCKETS) -> Histogram:
-        return self._register(Histogram, name, help_text, labels, buckets=buckets)
+                  labels: tuple[str, ...] = (), buckets=_DEFAULT_BUCKETS,
+                  raw: bool = False) -> Histogram:
+        return self._register(Histogram, name, help_text, labels, raw=raw,
+                              buckets=buckets)
+
+    def add_collect_hook(self, hook: Callable[[], None]) -> None:
+        """Register a pre-scrape refresh hook (idempotent by identity)."""
+        with self._lock:
+            if hook not in self._collect_hooks:
+                self._collect_hooks.append(hook)
+
+    def _run_collect_hooks(self) -> None:
+        with self._lock:
+            hooks = list(self._collect_hooks)
+        for hook in hooks:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — a failing refresh hook must
+                pass           # never take the scrape (or the sampler) down
+
+    def _metrics_snapshot(self) -> list[_Metric]:
+        # registration happens on hot paths (labels()/first use); both the
+        # scrape and the time-series sampler iterate a frozen list
+        with self._lock:
+            return list(self._metrics.values())
 
     def expose(self) -> str:
         """Prometheus text exposition format."""
+        self._run_collect_hooks()
         lines = []
-        for metric in self._metrics.values():
+        for metric in self._metrics_snapshot():
             lines.append(f"# HELP {metric.name} {_escape_help(metric.help)}")
             lines.append(f"# TYPE {metric.name} {metric.type_name}")
             lines.extend(metric.collect())
         return "\n".join(lines) + "\n"
 
+    def snapshot(self) -> list[tuple]:
+        """Structured point-in-time view for the time-series sampler, one
+        tuple per child series — cheaper to consume than re-parsing the text
+        exposition, and taken under the same locks as ``expose``:
+
+        - counter/gauge: ``(name, type, label_str, value)``
+        - histogram:     ``(name, 'histogram', label_str,
+                            (count, sum, bucket_counts_copy, buckets))``
+        """
+        self._run_collect_hooks()
+        out: list[tuple] = []
+        for metric in self._metrics_snapshot():
+            kind = metric.type_name
+            for child in metric._children_snapshot():
+                if kind == "histogram":
+                    out.append((metric.name, kind, child._label_str(),
+                                (child.count, child.sum,
+                                 list(child.bucket_counts), metric.buckets)))
+                else:
+                    out.append((metric.name, kind, child._label_str(),
+                                child.value))
+        return out
+
+    def describe(self) -> list[dict]:
+        """Name/type/labels/HELP of every registered metric family, sorted —
+        the ``metrics-doc`` generator's source of truth."""
+        return sorted(
+            ({"name": m.name, "type": m.type_name,
+              "labels": list(m.label_names), "help": m.help}
+             for m in self._metrics_snapshot()),
+            key=lambda d: d["name"])
+
 
 # process-global default registry (the reference's CollectorRegistry.default)
 REGISTRY = MetricsRegistry()
+
+
+# -- process self-metrics ------------------------------------------------------
+
+_PAGE_SIZE = 4096
+
+
+def _read_rss_bytes() -> float:
+    """Resident set size. /proc is authoritative on Linux; the ru_maxrss
+    fallback (peak, in KiB) keeps the gauge meaningful elsewhere."""
+    try:
+        with open("/proc/self/statm", "rb") as f:
+            return float(int(f.read().split()[1]) * _PAGE_SIZE)
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+
+            return float(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024)
+        except Exception:  # noqa: BLE001 — platform without getrusage
+            return 0.0
+
+
+def install_process_metrics(registry: MetricsRegistry | None = None) -> None:
+    """Register the standard Prometheus process/Python self-metrics
+    (``process_cpu_seconds_total``, ``process_resident_memory_bytes``,
+    ``python_gc_*``) as pull-style gauges refreshed by a collect hook, so
+    ``/metrics`` and the time-series store can correlate engine stalls with
+    host pressure (a flush-latency alert next to a climbing RSS curve reads
+    very differently from one next to a flat line). Idempotent; names follow
+    the prometheus_client conventions, un-namespaced."""
+    import gc
+    import resource
+
+    reg = registry or REGISTRY
+    # a fresh refresh-closure per call would defeat add_collect_hook's
+    # identity dedupe, stacking a redundant rusage/statm/gc pass onto every
+    # scrape and sampler tick
+    if getattr(reg, "_process_metrics_installed", False):
+        return
+    reg._process_metrics_installed = True
+    cpu = reg.counter(
+        "process_cpu_seconds_total",
+        "Total user and system CPU time spent in seconds.", raw=True)
+    rss = reg.gauge(
+        "process_resident_memory_bytes",
+        "Resident memory size in bytes.", raw=True)
+    gc_collections = reg.counter(
+        "python_gc_collections_total",
+        "Number of times this generation was collected",
+        ("generation",), raw=True)
+    gc_collected = reg.counter(
+        "python_gc_objects_collected_total",
+        "Objects collected during gc", ("generation",), raw=True)
+    gc_uncollectable = reg.gauge(
+        "python_gc_objects_uncollectable_total",
+        "Uncollectable objects found during GC", ("generation",), raw=True)
+
+    def refresh() -> None:
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        # counters are cumulative by contract: assign, don't inc — rusage is
+        # already the monotonic total
+        cpu._default().value = ru.ru_utime + ru.ru_stime
+        rss.set(_read_rss_bytes())
+        for gen, stats in enumerate(gc.get_stats()):
+            g = str(gen)
+            gc_collections.labels(g).value = float(stats.get("collections", 0))
+            gc_collected.labels(g).value = float(stats.get("collected", 0))
+            gc_uncollectable.labels(g).set(
+                float(stats.get("uncollectable", 0)))
+
+    reg.add_collect_hook(refresh)
+    refresh()
