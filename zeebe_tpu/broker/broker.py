@@ -60,6 +60,11 @@ class BrokerCfg:
     # for the in-memory time-series store + alert evaluation. 0 disables the
     # whole plane — no store, no sampler, one is-None check per control pump.
     metrics_sampling_ms: int = 250
+    # continuous profiling plane (observability/profiler.py): stack sampling
+    # rate of the always-on folded-stack profiler. 0 disables it (one is-None
+    # check); the ~19 Hz default is a prime rate (GWP-style: cannot alias
+    # against millisecond-periodic work) cheap enough to leave on.
+    profiling_hz: float = 19.0
 
 
 _AUTO_DEVICE_COUNT: int | None = None
@@ -181,6 +186,30 @@ class Broker:
         self.flight_recorder = FlightRecorder(
             cfg.node_id, self.directory, clock_millis=self.clock_millis)
         install_journal_stall_listener(self.flight_recorder)
+        # continuous profiling plane: always-on folded-stack sampler (gated
+        # by cfg like the metrics plane), alert-triggered capture into the
+        # flight recorder, and the single-flight on-demand device capture.
+        # Importing the module also registers the xla-compile / device-memory
+        # metric families the kernel seam and the pump sampler feed.
+        from zeebe_tpu.observability import profiler as profiler_mod
+
+        self._profiler_mod = profiler_mod
+        if cfg.profiling_hz > 0:
+            # process-global sampler, leased: an in-process multi-broker
+            # cluster shares ONE sampling daemon instead of stacking N
+            self.profiler, self._profiler_lease = (
+                profiler_mod.acquire_profiler(
+                    hz=cfg.profiling_hz, clock_millis=self.clock_millis))
+            # dumps carry the recent hot stacks alongside the event rings
+            self.flight_recorder.add_context_provider(
+                lambda: {"profile": self.profiler.snapshot_summary()})
+        else:
+            self.profiler: profiler_mod.ContinuousProfiler | None = None
+            self._profiler_lease: object | None = None
+        self._alert_profile_capture = profiler_mod.AlertProfileCapture(
+            self.flight_recorder, self.profiler,
+            clock_millis=self.clock_millis)
+        self.device_capture = profiler_mod.DeviceTraceCapture(self.directory)
         if cfg.metrics_sampling_ms > 0:
             from zeebe_tpu.observability.alerts import AlertEvaluator
             from zeebe_tpu.observability.timeseries import (
@@ -332,6 +361,11 @@ class Broker:
         self.flight_recorder.record(
             0, "alert", rule=rule.name, labels=labels, state=new,
             previous=old, expr=rule.describe())
+        if new == "firing":
+            # attach what the threads were doing when the rule fired (short
+            # folded-stack profile, throttled per rule) — a dump then
+            # explains the *why* next to the *what*
+            self._alert_profile_capture.on_firing(rule.name, labels)
 
     def hard_crash(self) -> None:
         """Power-loss crash for the whole broker (chaos harness): dump the
@@ -342,6 +376,9 @@ class Broker:
                 pid, "crash", detail="power-loss (hard crash)")
         self.flight_recorder.dump("hard-crash", force=True)
         self._remove_journal_listener()
+        self._profiler_mod.release_profiler(self._profiler_lease)
+        self._profiler_lease = None
+        self.device_capture.cancel()
         for partition in self.partitions.values():
             partition.hard_crash()
 
@@ -684,6 +721,10 @@ class Broker:
                 partition.disk_paused = disk_paused
         self._update_observability()
         if self.sampler is not None and self.sampler.maybe_sample():
+            # device memory rides the metrics cadence: stats read straight
+            # off already-initialized devices (profiler._resolve_devices
+            # never touches an unpinned, uninitialized accelerator backend)
+            self._profiler_mod.sample_device_memory()
             self.alerts.evaluate(self.clock_millis())
         self._gossip_roles()
         return 0
@@ -737,6 +778,11 @@ class Broker:
             "broker_close_step_latency",
             "seconds per broker shutdown step", ("step",))
         self._remove_journal_listener()
+        self._profiler_mod.release_profiler(self._profiler_lease)
+        self._profiler_lease = None
+        # an in-flight device trace would otherwise keep jax's global
+        # profiler occupied and write into a directory about to disappear
+        self.device_capture.cancel()
         for pid, partition in self.partitions.items():
             step_start = _time.perf_counter()
             partition.close()

@@ -95,6 +95,8 @@ _ENV_BINDINGS: dict[str, tuple[str, str, Any]] = {
     # store, the sampler, and alert evaluation)
     "ZEEBE_BROKER_METRICS_SAMPLINGINTERVALMS": (
         "base", "metrics_sampling_ms", int),
+    # continuous profiler: stack sampling rate (0 disables the plane)
+    "ZEEBE_BROKER_PROFILING_HZ": ("base", "profiling_hz", float),
 }
 
 

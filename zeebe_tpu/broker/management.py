@@ -21,8 +21,16 @@ Endpoints:
   GET  /traces    → collected tracing spans (observability subsystem);
                     ?format=chrome returns Chrome-trace-event JSON that opens
                     directly in Perfetto, ?limit=N tails the newest N spans
-  GET  /profile   → sampling profiler over all runtime threads
-                    (?seconds=N, capped at 30; pump/kernel/io time split)
+  GET  /profile   → one-shot sampling profiler over all runtime threads
+                    (?seconds=N, capped at 30; pump/kernel/io time split;
+                    ?format=folded returns flamegraph.pl/speedscope-
+                    compatible collapsed stacks as text/plain)
+  GET  /profile/continuous → the always-on continuous profiler's retained
+                    folded-stack windows (?since= unix ms,
+                    ?format=folded|json); 404 when profiling_hz=0
+  POST /profile/device → single-flight jax.profiler.trace() capture into
+                    <data-dir>/jax-trace-<ts>/ (?seconds=N, capped at 30);
+                    202 with the trace dir, 409 while one is in flight
   POST /backups/<id> → trigger a cluster-consistent checkpoint
   GET  /backups   → backup store listing (when a store is configured)
   POST /pause | /resume → pause/resume stream processing (BrokerAdminService)
@@ -187,7 +195,43 @@ class ManagementServer:
                 handler._send(400, json.dumps(
                     {"error": "seconds must be a positive number"}))
                 return
-            handler._send(200, json.dumps(sample_profile(seconds)))
+            folded = params.get("format", ["json"])[0] == "folded"
+            result = sample_profile(seconds, fold=folded)
+            if folded:
+                from zeebe_tpu.observability.profiler import folded_text
+
+                handler._send(200, folded_text(result["folded"]),
+                              "text/plain; charset=utf-8")
+            else:
+                handler._send(200, json.dumps(result))
+        elif path == "/profile/continuous":
+            from urllib.parse import parse_qs, urlsplit
+
+            profiler = getattr(self.broker, "profiler", None)
+            if profiler is None:
+                handler._send(404, json.dumps(
+                    {"error": "continuous profiler disabled "
+                              "(profiling_hz=0)"}))
+                return
+            params = parse_qs(urlsplit(handler.path).query)
+            try:
+                since = int(params.get("since", ["0"])[0])
+            except ValueError:
+                handler._send(400, json.dumps(
+                    {"error": "since must be an integer (unix ms)"}))
+                return
+            if params.get("format", ["json"])[0] == "folded":
+                handler._send(200, profiler.folded(since_ms=since),
+                              "text/plain; charset=utf-8")
+            else:
+                handler._send(200, json.dumps({
+                    "hz": profiler.hz,
+                    "achievedHz": profiler.achieved_hz,
+                    "samples": profiler.samples_taken,
+                    "windowMs": profiler.window_ms,
+                    "since": since,
+                    "windows": profiler.windows(since_ms=since),
+                }))
         elif path == "/backups":
             if self.broker.backup_store is None:
                 handler._send(404, json.dumps({"error": "no backup store configured"}))
@@ -221,6 +265,30 @@ class ManagementServer:
             handler._send(202, json.dumps(
                 {"transferred": {str(k): v for k, v in transferred.items()}}
             ))
+        elif path == "/profile/device":
+            from urllib.parse import parse_qs, urlsplit
+
+            from zeebe_tpu.observability.profiler import CaptureInFlight
+
+            capture = getattr(self.broker, "device_capture", None)
+            if capture is None:
+                handler._send(404, json.dumps(
+                    {"error": "no device capture (broker has no data dir)"}))
+                return
+            params = parse_qs(urlsplit(handler.path).query)
+            seconds = parse_profile_seconds(params.get("seconds", ["3.0"])[0])
+            if seconds is None:
+                handler._send(400, json.dumps(
+                    {"error": "seconds must be a positive number"}))
+                return
+            try:
+                trace_dir = capture.start(seconds)
+            except CaptureInFlight as exc:
+                # single-flight: jax.profiler supports one trace at a time
+                handler._send(409, json.dumps({"error": str(exc)}))
+                return
+            handler._send(202, json.dumps(
+                {"traceDir": str(trace_dir), "seconds": seconds}))
         else:
             handler._send(404, json.dumps({"error": f"unknown path {path}"}))
 
@@ -325,46 +393,71 @@ def cluster_status(brokers) -> dict:
         "brokers": rows,
     }
 
-def sample_profile(seconds: float, hz: float = 100.0) -> dict:
-    """Sampling profiler over every runtime thread (the management
+def sample_profile(seconds: float, hz: float = 100.0,
+                   fold: bool = False) -> dict:
+    """One-shot sampling profiler over every runtime thread (the management
     /profile endpoint — the reference exposes JFR/async-profiler through its
     actuator; this is the in-process equivalent): snapshots all thread
     stacks at ``hz`` for ``seconds`` and aggregates by frame, so hot
     functions and per-thread time split (pump vs kernel vs io) read
-    straight off the response without attaching a debugger."""
-    import sys
+    straight off the response without attaching a debugger.
+
+    Sampling rides the shared :mod:`zeebe_tpu.observability.profiler`
+    helper, so the thread-name map refreshes every tick (threads spawned
+    mid-profile report by name, not raw ident), and pacing is deadline-based
+    (sleep-only pacing undershoots ``hz`` by the per-tick work — the
+    response carries the *achieved* rate either way). ``fold=True``
+    additionally aggregates folded stacks (the same collapsed-stack format
+    the continuous profiler serves), so both endpoints feed the same
+    flamegraph tooling."""
     import time as _time
 
-    names = {t.ident: t.name for t in threading.enumerate()}
+    from zeebe_tpu.observability.profiler import (
+        PROFILER_THREAD_NAME,
+        fold_stacks,
+        sample_threads,
+    )
+
     samples = 0
     by_frame: dict[str, int] = {}
     by_thread: dict[str, int] = {}
-    deadline = _time.monotonic() + seconds
+    folded: dict[str, int] = {}
+    start = _time.monotonic()
+    deadline = start + seconds
     interval = 1.0 / hz
+    next_tick = start + interval
     own = threading.get_ident()
     while _time.monotonic() < deadline:
-        for ident, frame in sys._current_frames().items():
-            if ident == own:  # never profile the profiler's own stack
-                continue
-            name = names.get(ident, str(ident))
+        # never profile the profilers: not this handler's own stack, and
+        # not the continuous sampler's wait loop (default-on — it would
+        # otherwise show in ~100% of samples); names refresh inside
+        # sample_threads each tick, and so does this ident set
+        skip = {own} | {t.ident for t in threading.enumerate()
+                        if t.name == PROFILER_THREAD_NAME}
+        stacks = sample_threads(exclude_idents=skip, max_depth=40)
+        for name, frames in stacks:
             by_thread[name] = by_thread.get(name, 0) + 1
-            depth = 0
-            seen: set[str] = set()  # recursion must not inflate a frame
-            while frame is not None and depth < 40:
-                code = frame.f_code
-                key = f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}:{frame.f_lineno})"
-                if key not in seen:
-                    seen.add(key)
-                    by_frame[key] = by_frame.get(key, 0) + 1
-                frame = frame.f_back
-                depth += 1
+            for key in set(frames):  # recursion must not inflate a frame
+                by_frame[key] = by_frame.get(key, 0) + 1
+        if fold:
+            for key, count in fold_stacks(stacks).items():
+                folded[key] = folded.get(key, 0) + count
         samples += 1
-        _time.sleep(interval)
+        delay = next_tick - _time.monotonic()
+        if delay > 0:
+            _time.sleep(delay)
+            next_tick += interval
+        else:
+            next_tick = _time.monotonic() + interval  # overran: no burst
+    elapsed = max(_time.monotonic() - start, 1e-9)
     top = sorted(by_frame.items(), key=lambda kv: -kv[1])[:50]
     total_stacks = max(sum(by_thread.values()), 1)
-    return {
+    out = {
         "seconds": seconds,
         "samples": samples,
+        # sleep/walk overhead means the requested hz is an upper bound;
+        # report what the window actually achieved so pct math is honest
+        "achievedHz": round(samples / elapsed, 1),
         "threads": dict(sorted(by_thread.items(), key=lambda kv: -kv[1])),
         # pct = share of all sampled thread-stacks that contain the frame
         "hot_frames": [
@@ -373,3 +466,6 @@ def sample_profile(seconds: float, hz: float = 100.0) -> dict:
             for k, v in top
         ],
     }
+    if fold:
+        out["folded"] = folded
+    return out

@@ -8,6 +8,10 @@ Beyond zbctl parity:
   trace        — offline causal-tree reconstruction from a journal
   top          — htop-style live cluster view over GET /cluster/status
                  (``--once`` prints a single frame for scripting)
+  profile      — sample a live node's threads via the management server
+                 (``--folded -o out.txt`` writes flamegraph.pl/speedscope
+                 collapsed stacks; ``--continuous`` reads the always-on
+                 profiler's retained windows instead of blocking)
   metrics-doc  — generate docs/metrics.md from the live metric registry
                  (``--check`` fails on drift; wired into CI)
 
@@ -127,6 +131,28 @@ def main(argv: list[str] | None = None) -> int:
                    help="print one frame and exit (scripting)")
 
     p = sub.add_parser(
+        "profile",
+        help="profile a live node over the management server's /profile "
+             "endpoints (one-shot by default; --continuous reads the "
+             "always-on profiler without blocking)")
+    p.add_argument("--management", default="http://127.0.0.1:9600",
+                   help="management server base URL")
+    p.add_argument("--seconds", type=float, default=2.0,
+                   help="one-shot sampling window (server-capped at 30)")
+    p.add_argument("--folded", action="store_true",
+                   help="collapsed-stack output (flamegraph.pl/speedscope) "
+                        "instead of JSON")
+    p.add_argument("-o", "--output", default=None,
+                   help="write the profile to a file instead of stdout")
+    p.add_argument("--continuous", action="store_true",
+                   help="read the continuous profiler's retained windows "
+                        "(GET /profile/continuous) instead of taking a "
+                        "blocking one-shot sample")
+    p.add_argument("--since", type=int, default=0,
+                   help="with --continuous: only windows ending after this "
+                        "unix-ms timestamp")
+
+    p = sub.add_parser(
         "metrics-doc",
         help="generate the metrics reference (docs/metrics.md) from a "
              "representative broker scenario's live registry")
@@ -142,6 +168,8 @@ def main(argv: list[str] | None = None) -> int:
         return _trace(args)
     if args.cmd == "top":
         return _top(args)
+    if args.cmd == "profile":
+        return _profile(args)
     if args.cmd == "metrics-doc":
         return _metrics_doc(args)
 
@@ -278,6 +306,49 @@ def _top(args) -> int:
     except (OSError, ValueError) as exc:
         print(f"\nlost {args.management}: {exc}", file=sys.stderr)
         return 2
+
+
+# -- profile: live-node profiling over the management server -------------------
+
+
+def _profile(args) -> int:
+    import urllib.error
+    import urllib.request
+
+    base = args.management.rstrip("/")
+    if args.continuous:
+        url = f"{base}/profile/continuous?since={args.since}"
+    else:
+        url = f"{base}/profile?seconds={args.seconds}"
+    if args.folded:
+        url += "&format=folded"
+    # one-shot blocks server-side for the whole window: time the client
+    # timeout off the requested seconds, not a constant
+    timeout = 10.0 + (0 if args.continuous else args.seconds)
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            body = resp.read().decode()
+    except urllib.error.HTTPError as exc:
+        # the server WAS reached and its JSON body says what went wrong
+        # (e.g. 404 "continuous profiler disabled (profiling_hz=0)") —
+        # surface that, not a generic unreachable message
+        detail = exc.read().decode(errors="replace").strip() or exc.reason
+        print(f"{args.management} answered {exc.code}: {detail}",
+              file=sys.stderr)
+        return 2
+    except (OSError, ValueError) as exc:
+        print(f"cannot reach {args.management}: {exc}", file=sys.stderr)
+        return 2
+    if args.output:
+        from pathlib import Path
+
+        out_path = Path(args.output)
+        out_path.write_text(body if body.endswith("\n") else body + "\n")
+        lines = body.count("\n") + 1
+        print(f"wrote {out_path} ({lines} line(s))", file=sys.stderr)
+    else:
+        print(body)
+    return 0
 
 
 # -- metrics-doc: generated metric reference -----------------------------------

@@ -1303,6 +1303,11 @@ class KernelBackend:
         # per-I-bucket cached zero planes for _dispatch_first_chunk (jax
         # arrays are immutable, so sharing across groups is safe)
         self._zero_state: dict = {}
+        # compile seam (observability/profiler.py): (bucket, device) pairs
+        # whose first dispatch — the one that traces + lowers + compiles (or
+        # loads the persistent-cache executable) — was already timed into
+        # xla_compile_seconds / xla_compiles_total{cache=hit|miss}
+        self._compiles_seen: set = set()
 
     # -- candidate test (no state access) ----------------------------------
 
@@ -2158,6 +2163,19 @@ class KernelBackend:
             self._runs_seen.add(run_key)
         return steps
 
+    @staticmethod
+    def _observe_compile(I: int, T: int, seconds: float) -> None:
+        """Feed one first-dispatch wall time into the XLA compile telemetry
+        (observability/profiler.py): the histogram is labeled by geometry
+        bucket, the counter classifies hit/miss against the persistent-cache
+        threshold. Telemetry must never take a dispatch down."""
+        try:
+            from zeebe_tpu.observability.profiler import observe_compile
+
+            observe_compile(f"I{I}xT{T}", seconds)
+        except Exception:  # noqa: BLE001
+            pass
+
     def _dispatch_first_chunk(self, pg: "_PendingGroup") -> None:
         import jax.numpy as jnp
 
@@ -2204,9 +2222,25 @@ class KernelBackend:
             # JAX async dispatch: the call returns with the device still
             # computing; the first host transfer (in _complete_device_run)
             # is the synchronization point
+            # compile seam: the FIRST dispatch per (table-set content, shape
+            # bucket, device) is where jit tracing + lowering + XLA compile
+            # (or the persistent-cache load) happen synchronously — time
+            # that call; later dispatches of the same geometry are tracing-
+            # cache hits and stay untimed
+            compile_key = (pg.bucket, None if dev is None
+                           else getattr(dev, "id", dev))
+            first_dispatch = compile_key not in self._compiles_seen
+            if first_dispatch:
+                import time as _time
+
+                t_compile = _time.perf_counter()
             with _profiler_annotation("zeebe.kernel_chunk.first"):
                 pg.run = run_collect(pg.dt, state, n_steps=self.chunk_steps,
                                      config=pg.config)
+            if first_dispatch:
+                self._compiles_seen.add(compile_key)
+                self._observe_compile(pg.I, pg.T,
+                                      _time.perf_counter() - t_compile)
 
     def _complete_device_run(self, pg: "_PendingGroup"):
         import jax

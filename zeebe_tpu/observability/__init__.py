@@ -19,8 +19,14 @@ Prometheus: retained metric history. Six pieces:
   errors, backpressure, flush stalls, exporter transitions, batch
   summaries), dumped to ``<data-dir>/flight-<ts>.json`` on crash/unhealthy.
 - ``alerts``: threshold + for-duration rules over the time-series store
-  (default set: lag / backpressure / flush latency / role flapping),
-  surfaced in ``/health`` and the ``zeebe_alerts_firing`` gauge.
+  (default set: lag / backpressure / flush latency / role flapping /
+  XLA recompile storms), surfaced in ``/health`` and the
+  ``zeebe_alerts_firing`` gauge.
+- ``profiler``: the continuous profiling plane — an always-on low-rate
+  folded-stack sampler (``GET /profile/continuous``), the kernel backend's
+  XLA compile telemetry sink, device-memory gauges, alert-triggered profile
+  capture into the flight recorder, and single-flight on-demand
+  ``jax.profiler.trace()`` captures (``POST /profile/device``).
 
 Spans are emitted ONLY on live processing (gateway request, command append,
 backpressure acquire, journal group-flush, PROCESSING-phase steps and their
@@ -34,6 +40,16 @@ from zeebe_tpu.observability.alerts import (
 )
 from zeebe_tpu.observability.flight_recorder import FlightRecorder
 from zeebe_tpu.observability.lineage import collect_lineage, format_lineage
+from zeebe_tpu.observability.profiler import (
+    AlertProfileCapture,
+    CaptureInFlight,
+    ContinuousProfiler,
+    DeviceTraceCapture,
+    acquire_profiler,
+    observe_compile,
+    release_profiler,
+    sample_device_memory,
+)
 from zeebe_tpu.observability.span import (
     DeterministicSampler,
     Span,
@@ -53,19 +69,27 @@ from zeebe_tpu.observability.tracer import (
 
 __all__ = [
     "AlertEvaluator",
+    "AlertProfileCapture",
     "AlertRule",
+    "CaptureInFlight",
+    "ContinuousProfiler",
     "DeterministicSampler",
+    "DeviceTraceCapture",
     "FlightRecorder",
     "MetricsSampler",
     "Span",
     "SpanCollector",
     "TimeSeriesStore",
     "Tracer",
+    "acquire_profiler",
     "chrome_trace",
     "collect_lineage",
     "configure_tracing",
     "default_rules",
     "format_lineage",
     "get_tracer",
+    "observe_compile",
+    "release_profiler",
+    "sample_device_memory",
     "summarize_store",
 ]

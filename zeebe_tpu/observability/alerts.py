@@ -64,9 +64,10 @@ class AlertRule:
 
 
 def default_rules() -> list[AlertRule]:
-    """The out-of-the-box rule set (ISSUE 4): exporter lag, backpressure
-    drops, flush latency, raft role flapping. Thresholds are deliberately
-    conservative — a firing default alert should always be worth a look."""
+    """The out-of-the-box rule set (ISSUE 4 + 5): exporter lag, backpressure
+    drops, flush latency, raft role flapping, XLA recompile storms.
+    Thresholds are deliberately conservative — a firing default alert should
+    always be worth a look."""
     return [
         AlertRule(
             name="exporter_lag",
@@ -85,6 +86,22 @@ def default_rules() -> list[AlertRule]:
             series="zeebe_raft_role",
             kind="changes", threshold=4.0, window_ms=10_000,
             severity="critical"),
+        AlertRule(
+            # the compile seam stores xla_compiles_total{cache="miss"} as a
+            # rate: each cold compile is a 0→spike→0 episode (≤2 value
+            # changes). Threshold 6 = ≥3 cold compiles inside a minute — a
+            # recompile storm (geometry churn / redeploy loop), while the
+            # expected process warmup (the two shape buckets compiling once)
+            # contributes at most 4 changes and stays below it. The series
+            # is process-scoped (no node label — the seam sits below the
+            # broker), so like exporter lag it passes every evaluator's
+            # _mine(); in an in-process multi-broker test cluster each
+            # broker reports the shared storm.
+            name="xla_recompile_storm",
+            series="zeebe_xla_compiles_total",
+            labels_contains='cache="miss"',
+            kind="changes", threshold=6.0, window_ms=60_000,
+            severity="warning"),
     ]
 
 
