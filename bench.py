@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import tempfile
 import threading
 import time
@@ -1137,13 +1138,79 @@ def _quick_main(platform: str, trace: bool = False,
     }))
 
 
+def _soak_main(quick: bool) -> None:
+    """--soak: the crash-recovery endurance gate (ISSUE 6). Runs sustained
+    traffic with parked instances over an aggressive snapshot cadence,
+    fires seeded power-loss crash-restarts mid-flush and mid-snapshot, and
+    asserts the durability invariants after every restart. Writes
+    SOAK[_quick].json (violations fail the run) and lists the per-recovery
+    flight dumps so CI can upload them as artifacts."""
+    import shutil
+    import time as _time
+
+    from zeebe_tpu.testing.soak import SoakConfig, run_soak
+
+    cfg = (SoakConfig() if quick else
+           SoakConfig(rounds=10, traffic_per_round=40,
+                      snapshot_chain_length=6))
+    started = _time.perf_counter()
+    work_dir = tempfile.mkdtemp(prefix="zeebe-soak-")
+    try:
+        report = run_soak(cfg, directory=work_dir)
+        # the per-recovery flight dumps are the reviewable artifacts the
+        # soak exists to leave behind — copy them out of the work dir (CI
+        # uploads SOAK_dumps/) before it is deleted
+        repo_dir = os.path.dirname(os.path.abspath(__file__))
+        dumps_dir = os.path.join(repo_dir, "SOAK_dumps")
+        shutil.rmtree(dumps_dir, ignore_errors=True)
+        os.makedirs(dumps_dir, exist_ok=True)
+        copied = []
+        for dump in report["flightDumps"]:
+            rel = os.path.relpath(dump, work_dir).replace(os.sep, "__")
+            target = os.path.join(dumps_dir, rel)
+            try:
+                shutil.copyfile(dump, target)
+                copied.append(os.path.relpath(target, repo_dir))
+            except OSError:
+                pass
+        report["flightDumps"] = copied
+    finally:
+        shutil.rmtree(work_dir, ignore_errors=True)
+    report["wallSeconds"] = round(_time.perf_counter() - started, 2)
+    report["quick"] = quick
+    name = "SOAK_quick.json" if quick else "SOAK.json"
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "soak": True, "quick": quick, "seed": report["seed"],
+        "restarts": report["restarts"],
+        "ackedCommands": report["ackedCommands"],
+        "withinBudget": report["withinBudget"],
+        "maxRecoveryMs": report["recoveryMs"]["max"],
+        "maxChainLength": report["maxChainLength"],
+        "snapshotKinds": report["snapshotKinds"],
+        "violations": len(report["violations"]),
+        "full_results": name,
+    }))
+    if report["violations"]:
+        for v in report["violations"][:20]:
+            print(f"soak violation: {v}", file=sys.stderr)
+        raise SystemExit(1)
+
+
 def main(quick: bool = False, trace: bool = False,
-         sample_metrics: bool = False, profile: bool = False) -> None:
+         sample_metrics: bool = False, profile: bool = False,
+         soak: bool = False) -> None:
     # install the filter BEFORE any backend use: the mismatch warning fires
     # whenever a persistent-cache executable loads, including the probe's
     # subprocess (which inherits the filtered fd 2)
     _install_stderr_spam_filter()
     platform = _ensure_backend()
+    if soak:
+        _soak_main(quick)
+        return
     if trace:
         _enable_tracing()
     if sample_metrics:
@@ -1279,6 +1346,15 @@ if __name__ == "__main__":
                          "over the bench, fold top-10 hot frames + XLA "
                          "compile telemetry into the BENCH extra, and write "
                          "the full folded profile to PROFILE[_quick].folded")
+    ap.add_argument("--soak", action="store_true",
+                    help="crash-recovery soak gate: sustained traffic + "
+                         "seeded power-loss crash-restarts mid-flush and "
+                         "mid-snapshot; asserts no acked record lost, no "
+                         "duplicate exports, replay bounded by snapshot "
+                         "cadence, recovery within budget. Writes "
+                         "SOAK[_quick].json; --quick bounds it to a few "
+                         "minutes")
     _args = ap.parse_args()
     main(quick=_args.quick, trace=_args.trace,
-         sample_metrics=_args.sample_metrics, profile=_args.profile)
+         sample_metrics=_args.sample_metrics, profile=_args.profile,
+         soak=_args.soak)

@@ -583,7 +583,7 @@ class TestManagementEndpoints:
         assert status == 200
         assert "alerts" in body and "alertsFiring" in body
         status, body = _http_get(server.port, "/alerts")
-        assert status == 200 and len(body["rules"]) == 5
+        assert status == 200 and len(body["rules"]) == 6
 
     def test_cluster_status_local(self, management):
         server, cluster = management

@@ -56,13 +56,32 @@ class BackupService:
         """Backup = current persisted snapshot + the stream journal suffix
         (events after the snapshot up to the checkpoint). The partition keeps
         processing — the checkpoint record already fixed the logical cut."""
-        partition.take_snapshot()
-        snapshot = partition.snapshot_store.latest_snapshot()
+        # force_full: a backup must be self-contained — a delta tip would
+        # reference a base snapshot that exists only in the live data dir
+        partition.take_snapshot(force_full=True)
         snapshot_files = {}
         descriptor = {"snapshotId": None}
-        if snapshot is not None:
-            descriptor["snapshotId"] = str(snapshot.id)
-            snapshot_files = {p.name: p.read_bytes() for p in snapshot.files()}
+        chain = partition.snapshot_store.latest_valid_chain()
+        if chain is not None:
+            tip = chain[-1]
+            descriptor["snapshotId"] = str(tip.id)
+            if len(chain) == 1:
+                snapshot_files = {p.name: p.read_bytes() for p in tip.files()}
+            else:
+                # the force_full above declined (nothing newer to snapshot)
+                # and the tip is still a delta: materialize base+deltas into
+                # one self-contained snapshot, manifest recomputed to match
+                from zeebe_tpu.state.snapshot import (
+                    STATE_FILE,
+                    load_chain_db,
+                    manifest_bytes,
+                )
+
+                snapshot_files = {
+                    STATE_FILE: load_chain_db(chain).to_snapshot_bytes(),
+                    "meta.bin": tip.read_file("meta.bin"),
+                }
+                snapshot_files["CHECKSUM.sfv"] = manifest_bytes(snapshot_files)
         partition.stream_journal.flush()
         segment_files = {
             p.name: p.read_bytes()

@@ -65,6 +65,15 @@ class BrokerCfg:
     # check); the ~19 Hz default is a prime rate (GWP-style: cannot alias
     # against millisecond-periodic work) cheap enough to leave on.
     profiling_hz: float = 19.0
+    # recovery-time budget (ISSUE 6): a partition rebuild (snapshot install +
+    # replay) slower than this increments recovery_budget_exceeded_total
+    # (default alert rule recovery_budget_exceeded) and the snapshot
+    # scheduler snapshots early when projected replay debt threatens the
+    # budget. <= 0 disables budget enforcement (metrics still emit).
+    recovery_budget_ms: int = 60_000
+    # max incremental-snapshot chain length (base + deltas) before the next
+    # snapshot rebases to a full one; 1 = every snapshot is full
+    snapshot_chain_length: int = 8
 
 
 _AUTO_DEVICE_COUNT: int | None = None
@@ -479,6 +488,8 @@ class Broker:
             durable_state=self.cfg.durable_state,
             health_monitor=self.health_monitor,
             flight_recorder=self.flight_recorder,
+            recovery_budget_ms=self.cfg.recovery_budget_ms,
+            snapshot_chain_length=self.cfg.snapshot_chain_length,
         )
         self.health_monitor.register(f"partition-{partition_id}")
         from zeebe_tpu.utils.metrics import REGISTRY as _REG
@@ -851,7 +862,9 @@ class InProcessCluster:
                  exporters_factory: Callable[[], dict[str, Any]] | None = None,
                  snapshot_period_ms: int = 5 * 60 * 1000,
                  durable_state: bool = False,
-                 network: LoopbackNetwork | None = None) -> None:
+                 network: LoopbackNetwork | None = None,
+                 recovery_budget_ms: int = 60_000,
+                 snapshot_chain_length: int = 8) -> None:
         from zeebe_tpu.testing import ControlledClock
 
         self._tmp = None
@@ -875,6 +888,8 @@ class InProcessCluster:
                 replication_factor=replication_factor, cluster_members=members,
                 snapshot_period_ms=snapshot_period_ms,
                 durable_state=durable_state,
+                recovery_budget_ms=recovery_budget_ms,
+                snapshot_chain_length=snapshot_chain_length,
             )
             self.brokers[m] = Broker(
                 cfg, self.net.join(m), directory=self.directory / m,

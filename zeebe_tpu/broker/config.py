@@ -64,6 +64,8 @@ class ExtendedBrokerCfg:
                              f"{self.backpressure.algorithm!r}")
         if self.processing.max_commands_in_batch < 1:
             raise ValueError("maxCommandsInBatch must be >= 1")
+        if self.base.snapshot_chain_length < 1:
+            raise ValueError("snapshotChainLength must be >= 1")
 
 
 # env var → (section, field, type); relaxed-binding names follow the
@@ -97,6 +99,14 @@ _ENV_BINDINGS: dict[str, tuple[str, str, Any]] = {
         "base", "metrics_sampling_ms", int),
     # continuous profiler: stack sampling rate (0 disables the plane)
     "ZEEBE_BROKER_PROFILING_HZ": ("base", "profiling_hz", float),
+    # recovery-time budget: recoveries slower than this fire the
+    # recovery_budget_exceeded alert; the snapshot scheduler adapts its
+    # cadence to keep projected replay debt under it (<= 0 disables)
+    "ZEEBE_BROKER_DATA_RECOVERYBUDGETMS": ("base", "recovery_budget_ms", int),
+    # incremental snapshots: base+delta chain length before a full rebase
+    # (1 = every snapshot is a full snapshot)
+    "ZEEBE_BROKER_DATA_SNAPSHOTCHAINLENGTH": (
+        "base", "snapshot_chain_length", int),
 }
 
 

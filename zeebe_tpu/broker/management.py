@@ -98,6 +98,17 @@ class ManagementServer:
                 # both "is it up" and "is anything on fire"
                 health["alerts"] = alerts.snapshot()
                 health["alertsFiring"] = len(alerts.firing())
+            # recovery-budget plane: the last rebuild's cost per partition
+            # (duration, replay length, budget verdict) rides the same probe
+            # — after a kill+restart, /health alone answers "what did the
+            # recovery cost and did it fit the budget"
+            recoveries = {
+                str(pid): p.last_recovery
+                for pid, p in self.broker.partitions.items()
+                if getattr(p, "last_recovery", None) is not None
+            }
+            if recoveries:
+                health["recoveries"] = recoveries
             code = 200 if self.broker.health_monitor.is_healthy() else 503
             handler._send(code, json.dumps(health))
         elif path == "/ready":

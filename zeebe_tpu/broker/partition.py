@@ -35,14 +35,65 @@ from zeebe_tpu.observability.tracer import get_tracer as _get_tracer
 from zeebe_tpu.protocol import Record
 from zeebe_tpu.protocol.msgpack import packb, unpackb
 from zeebe_tpu.state import ZbDb
-from zeebe_tpu.state.snapshot import FileBasedSnapshotStore
+from zeebe_tpu.state.snapshot import (
+    DELTA_FILE,
+    STATE_FILE,
+    FileBasedSnapshotStore,
+    load_chain_db,
+)
+from zeebe_tpu.stream import Phase as _Phase
 from zeebe_tpu.stream import StreamProcessor, StreamProcessorMode
+from zeebe_tpu.utils.metrics import REGISTRY as _REG
 
 DEFAULT_SNAPSHOT_PERIOD_MS = 5 * 60 * 1000
+# recovery-time budget (ISSUE 6): recoveries slower than this increment the
+# exceeded counter (default alert rule recovery_budget_exceeded) and the
+# snapshot scheduler snapshots early when projected replay debt threatens it
+DEFAULT_RECOVERY_BUDGET_MS = 60_000
+# max base+delta chain length before the next snapshot rebases to a full one
+DEFAULT_SNAPSHOT_CHAIN_LENGTH = 8
+# replay throughput assumed before the first measured recovery (records/s);
+# deliberately conservative so the adaptive scheduler errs toward snapshotting
+DEFAULT_REPLAY_RATE_RPS = 10_000.0
+# snapshot early once projected replay time passes this fraction of the budget
+REPLAY_DEBT_BUDGET_FRACTION = 0.5
 
 # command-ingress tracing (singleton mutated in place; one enabled-check per
 # client_write when tracing is off)
 _TRACER = _get_tracer()
+
+# recovery-budget plane metrics (module-level so the families exist from
+# first partition construction — the metrics-doc scenario and the sampler
+# both see them without waiting for a slow recovery)
+_M_RECOVERY_DURATION = _REG.histogram(
+    "recovery_duration_seconds",
+    "seconds to rebuild a partition's vertical (snapshot install + replay) "
+    "on a restart or role transition", ("partition",),
+    buckets=(0.01, 0.05, 0.1, 0.5, 1, 5, 15, 60, 300))
+_M_RECOVERY_REPLAYED = _REG.counter(
+    "recovery_replay_records_total",
+    "records replayed during partition recoveries", ("partition",))
+_M_RECOVERY_SNAPSHOT_AGE = _REG.gauge(
+    "recovery_snapshot_age_records",
+    "records between the recovered snapshot's processed position and the "
+    "log end at recovery time", ("partition",))
+_M_RECOVERY_EXCEEDED = _REG.counter(
+    "recovery_budget_exceeded_total",
+    "recoveries that blew recovery_budget_ms", ("partition",))
+_M_SNAPSHOT_KIND = _REG.counter(
+    "snapshot_kind_total", "snapshots persisted by kind (full/delta/durable)",
+    ("partition", "kind"))
+_M_SNAPSHOT_CHAIN_LEN = _REG.gauge(
+    "snapshot_chain_length",
+    "length of the latest snapshot chain (1 = full snapshot)", ("partition",))
+_M_REPLAY_DEBT = _REG.gauge(
+    "snapshot_replay_debt_records",
+    "records appended since the latest snapshot (recovery replay upper "
+    "bound)", ("partition",))
+_M_ADAPTIVE_SNAPSHOTS = _REG.counter(
+    "snapshot_adaptive_triggers_total",
+    "snapshots taken early because projected replay debt threatened the "
+    "recovery budget", ("partition",))
 
 
 class BackpressureExceeded(Exception):
@@ -108,6 +159,8 @@ class ZeebePartition:
         durable_state: bool = False,
         health_monitor=None,
         flight_recorder=None,
+        recovery_budget_ms: int = DEFAULT_RECOVERY_BUDGET_MS,
+        snapshot_chain_length: int = DEFAULT_SNAPSHOT_CHAIN_LENGTH,
     ) -> None:
         self.partition_id = partition_id
         self.partition_count = partition_count
@@ -143,6 +196,32 @@ class ZeebePartition:
         self.paused = False        # admin pause (BrokerAdminService)
         self.disk_paused = False   # disk watermark pause — independent source
 
+        # recovery-budget plane (ISSUE 6): budget knob, incremental-snapshot
+        # chain state, and the last recovery's observations (served on
+        # /health and asserted by the soak harness)
+        self.recovery_budget_ms = recovery_budget_ms
+        self.snapshot_chain_length = max(1, snapshot_chain_length)
+        self.last_recovery: dict | None = None
+        # leader replay barrier: raft position materialization+replay must
+        # reach before processing may start (None = no barrier pending);
+        # the flag records a budget blown WHILE the barrier was pending so
+        # the eventual _record_recovery doesn't double-count it
+        self._replay_barrier: int | None = None
+        self._barrier_budget_flagged = False
+        self._recovery_started = 0.0
+        self._snapshot_anchor = None   # chain-tip SnapshotId deltas build on
+        self._chain_len = 0
+        self._last_snapshot_processed = -1
+        self._observed_replay_rate = DEFAULT_REPLAY_RATE_RPS
+        self._last_debt_check_ms = 0
+        # compaction-bound memo keyed by the newest snapshot id: chain
+        # validation re-reads and CRCs every chain member (the base is the
+        # whole state), and the guards run several times per snapshot — only
+        # a new persist can change the store's answer in-process, so cache
+        # until the newest id moves (crash-tampering always restarts the
+        # partition, which rebuilds this object)
+        self._compact_bound_memo: tuple = (None, -1)
+
         self.snapshot_store = FileBasedSnapshotStore(self.directory / "snapshots")
         self.raft = RaftNode(
             messaging, partition_id, members, self.directory / "raft",
@@ -152,9 +231,14 @@ class ZeebePartition:
         self.raft.role_listeners.append(self._on_role_change)
         self.raft.snapshot_provider = self._provide_install_snapshot
         self.raft.snapshot_receiver = self._receive_install_snapshot
+        # compaction safety: segment deletion in EITHER journal is clamped to
+        # min(latest snapshot position, all exporter container cursors) —
+        # enforced below every caller, inside the journals themselves
+        self.raft.journal.compact_guard = self._raft_compact_guard
 
         self._stream_dir = self.directory / "stream"
         self.stream_journal = SegmentedJournal(self._stream_dir)
+        self.stream_journal.compact_guard = self._stream_compact_guard
         self.stream = LogStream(self.stream_journal, partition_id, clock=clock_millis)
 
         self.role = RaftRole.FOLLOWER
@@ -211,8 +295,16 @@ class ZeebePartition:
 
     def _transition(self) -> None:
         """Tear down and rebuild the processing vertical for the current role:
-        recover db from the latest snapshot, replay the stream journal, then
-        process (leader) or keep replaying (follower)."""
+        recover db from the latest snapshot chain, replay the stream journal,
+        then process (leader) or keep replaying (follower). The whole
+        rebuild is timed against ``recovery_budget_ms`` (ISSUE 6): duration,
+        replay length, and snapshot age land in the metrics plane and the
+        flight recorder."""
+        recovery_start = _perf_counter()
+        self._replay_barrier = None  # a re-transition supersedes any barrier
+        # ...and so does its blown-budget flag: left set, it would suppress
+        # the exceeded counter for this (distinct) rebuild's own verdict
+        self._barrier_budget_flagged = False
         self._recover_db()
         # flags for appends that never committed under the previous role must
         # not leak onto a NEW leader's batch at a reused position (raft may
@@ -295,16 +387,146 @@ class ZeebePartition:
         if self.role == RaftRole.LEADER:
             # leader sequencer continues after the last position in the raft
             # log (committed or not — uncommitted entries still own positions)
-            self._next_position = max(
-                self._next_position, self._last_raft_position() + 1
-            )
+            raft_end = self._last_raft_position()
+            self._next_position = max(self._next_position, raft_end + 1)
+            if (raft_end > self.stream.last_position
+                    and self.processor.phase != _Phase.FAILED):
+                # (a FAILED processor — poison record contained during
+                # start()'s replay — must STAY failed: flipping it to REPLAY
+                # here would re-attempt the poison batch on the next pump)
+                # REPLAY BARRIER (ISSUE 6): the raft log holds entries not
+                # yet re-materialized into the stream journal (a power loss
+                # wiped the derived journal's unfsynced bytes, or this
+                # leader was elected before its commit index recovered).
+                # Processing now would RE-process client commands whose
+                # result events only exist in the unmaterialized suffix —
+                # duplicating their effects (instances created twice). Hold
+                # the processor in REPLAY until materialization + replay
+                # reach the barrier (leader completeness guarantees every
+                # entry in our log eventually commits); pump() flips to
+                # PROCESSING and finalizes the recovery accounting there.
+                self._replay_barrier = raft_end
+                self._recovery_started = recovery_start
+                self._barrier_budget_flagged = False
+                self.processor.phase = _Phase.REPLAY
+                return
+        self._record_recovery(_perf_counter() - recovery_start,
+                              self.processor.replayed_records)
+
+    def _finish_leader_recovery(self) -> None:
+        """Replay barrier cleared: the stream re-materialized through the
+        raft log end known at election and replay applied it. Processing
+        starts exactly where an uninterrupted recovery would have — after
+        the last command whose events are reflected in state."""
+        self._replay_barrier = None
+        processor = self.processor
+        processor.phase = _Phase.PROCESSING
+        # commands between last_processed and the barrier that never got
+        # processed pre-crash still need processing: scan from the front of
+        # the unreplayed suffix (the command scan skips processed ones)
+        processor._reader_position = (
+            1 if processor.last_processed_position < 0
+            else processor.last_processed_position + 1
+        )
+        self._record_recovery(_perf_counter() - self._recovery_started,
+                              processor.replayed_records)
+
+    # -- recovery accounting (recovery-time budget, ISSUE 6) -------------------
+
+    def _record_recovery(self, duration_s: float, replayed: int) -> None:
+        pid = str(self.partition_id)
+        age = max(
+            self.stream.last_position - max(self._last_snapshot_processed, 0),
+            0)
+        duration_ms = duration_s * 1000.0
+        budget = self.recovery_budget_ms
+        within = budget <= 0 or duration_ms <= budget
+        _M_RECOVERY_DURATION.labels(pid).observe(duration_s)
+        _M_RECOVERY_REPLAYED.labels(pid).inc(replayed)
+        _M_RECOVERY_SNAPSHOT_AGE.labels(pid).set(float(age))
+        if replayed >= 64 and duration_s > 0:
+            # measured replay throughput feeds the adaptive snapshot
+            # scheduler's replay-debt projection
+            self._observed_replay_rate = max(replayed / duration_s, 1.0)
+        info = {
+            "role": self.role.value,
+            "durationMs": round(duration_ms, 3),
+            "replayRecords": replayed,
+            "snapshotId": (str(self._snapshot_anchor)
+                           if self._snapshot_anchor is not None else None),
+            "chainLength": self._chain_len,
+            "snapshotAgeRecords": age,
+            "budgetMs": budget,
+            "withinBudget": within,
+            "atMs": self.clock_millis(),
+        }
+        self.last_recovery = info
+        if not within and not self._barrier_budget_flagged:
+            # (already counted at the barrier the moment the budget blew)
+            _M_RECOVERY_EXCEEDED.labels(pid).inc()
+        self._barrier_budget_flagged = False
+        if self.flight is not None:
+            self.flight.record(self.partition_id, "recovery", **info)
+            # every recovery leaves a reviewable artifact while the event is
+            # still in the ring (per-batch records evict it fast under
+            # load). Leader recoveries (the time-to-leader number the budget
+            # is about) and blown budgets always force a dump; follower
+            # transitions ride the 5s per-reason-class throttle
+            self.flight.dump(
+                f"recovery:partition-{pid}",
+                force=not within or self.role == RaftRole.LEADER)
+
+    # -- compaction safety gate ------------------------------------------------
+
+    def _compaction_position_bound(self) -> int:
+        """Highest stream position whose records may be deleted: covered by
+        the latest persisted snapshot AND acknowledged by every exporter
+        container (a DEGRADED/backing-off exporter pins this until it
+        recovers — its growing ``exporter_container_lag_records`` gauge is
+        the observable). -1 = nothing is compactable."""
+        latest = self.snapshot_store.latest_snapshot()
+        if latest is None:
+            return -1
+        memo_id, bound = self._compact_bound_memo
+        if memo_id != latest.id:
+            # the newest VALID chain's tip, not the newest directory: a torn
+            # tip (power loss during commit) will be skipped by recovery,
+            # which then needs the log back to the chain it actually falls
+            # back to
+            chain = self.snapshot_store.latest_valid_chain()
+            bound = -1 if chain is None else chain[-1].id.processed_position
+            self._compact_bound_memo = (latest.id, bound)
+        if bound < 0:
+            return -1
+        director = getattr(self, "exporter_director", None)
+        if director is not None:
+            bound = min(bound, director.lowest_exporter_position())
+        return bound
+
+    def _raft_compact_guard(self) -> int:
+        bound = self._compaction_position_bound()
+        if bound < 0:
+            return 0
+        return max(self.raft.journal.seek_to_asqn(bound), 0)
+
+    def _stream_compact_guard(self) -> int:
+        bound = self._compaction_position_bound()
+        if bound < 0:
+            return 0
+        return max(self.stream_journal.seek_to_asqn(bound), 0)
 
     def _recover_db(self) -> None:
-        """StateControllerImpl.recover: latest valid snapshot → runtime db.
+        """StateControllerImpl.recover: newest fully-valid snapshot *chain*
+        (base + deltas) → runtime db, falling back chain by chain on
+        corruption — a torn tip (power loss during commit) recovers from its
+        last fully-valid ancestor instead of crashing.
 
         Durable mode: the on-disk delta log (state/durable.py) recovers to
-        its last checkpoint in O(bytes); a full snapshot from the store only
+        its last checkpoint in O(bytes); a snapshot chain from the store only
         overrides it when NEWER (a received raft INSTALL persisted one)."""
+        self._snapshot_anchor = None
+        self._chain_len = 0
+        self._last_snapshot_processed = -1
         if self.durable_state:
             from zeebe_tpu.state import ColumnFamilyCode
             from zeebe_tpu.state.durable import DurableZbDb
@@ -313,46 +535,70 @@ class ZeebePartition:
                 self.db.close()
             db = DurableZbDb.open(self.directory / "state",
                                   consistency_checks=self.consistency_checks)
-            snapshot = self.snapshot_store.latest_snapshot()
-            if snapshot is not None:
+            chain = self.snapshot_store.latest_valid_chain()
+            state_bin = None
+            if chain is not None and chain[0].has_file("state.bin"):
+                # a received raft INSTALL persisted a full snapshot — or the
+                # DURABLESTATE flag was just flipped ON over a non-durable
+                # delta chain: materialize it so nothing is lost
                 try:
-                    state_bin = snapshot.read_file("state.bin")
-                except (FileNotFoundError, OSError):
-                    state_bin = None  # durable-marker snapshot: disk is current
-                if state_bin is not None:
-                    snap_processed = unpackb(
-                        snapshot.read_file("meta.bin")).get("lastProcessed", -1)
-                    durable_processed = db.committed_get(
-                        ColumnFamilyCode.LAST_PROCESSED_POSITION, ("last",))
-                    if snap_processed > (durable_processed
-                                         if durable_processed is not None else -1):
-                        db.install_snapshot_bytes(state_bin)
+                    if len(chain) == 1:
+                        state_bin = chain[0].read_file("state.bin")
+                    else:
+                        state_bin = load_chain_db(chain).to_snapshot_bytes()
+                except (OSError, ValueError):
+                    state_bin = None
+            if state_bin is not None:
+                snap_processed = unpackb(
+                    chain[-1].read_file("meta.bin")).get("lastProcessed", -1)
+                durable_processed = db.committed_get(
+                    ColumnFamilyCode.LAST_PROCESSED_POSITION, ("last",))
+                if snap_processed > (durable_processed
+                                     if durable_processed is not None else -1):
+                    db.install_snapshot_bytes(state_bin)
             self.db = db
             return
-        snapshot = self.snapshot_store.latest_snapshot()
-        if snapshot is not None:
-            try:
-                state_bin = snapshot.read_file("state.bin")
-            except (FileNotFoundError, OSError):
-                state_bin = None
-            if state_bin is None:
-                # durable-marker snapshot (taken while the DURABLESTATE flag
-                # was on) with the flag now OFF: recover from the durable
-                # disk this once — the next snapshot writes state.bin and
-                # the migration back to in-memory completes (flag must stay
-                # reversible; reference config flags are)
-                from zeebe_tpu.state.durable import DurableZbDb
+        for chain in self.snapshot_store.iter_valid_chains():
+            base, tip = chain[0], chain[-1]
+            if not base.has_file("state.bin"):
+                if base.has_file("durable.bin"):
+                    # durable-marker snapshot (taken while the DURABLESTATE
+                    # flag was on) with the flag now OFF: recover from the
+                    # durable disk this once — the next snapshot writes
+                    # state.bin and the migration back to in-memory completes
+                    # (flag must stay reversible; reference config flags are)
+                    from zeebe_tpu.state.durable import DurableZbDb
 
-                self.db = DurableZbDb.open(
-                    self.directory / "state",
-                    consistency_checks=self.consistency_checks)
-                return
-            self.db = ZbDb.from_snapshot_bytes(
-                state_bin,
-                consistency_checks=self.consistency_checks,
-            )
-        else:
-            self.db = ZbDb(consistency_checks=self.consistency_checks)
+                    self.db = DurableZbDb.open(
+                        self.directory / "state",
+                        consistency_checks=self.consistency_checks)
+                    return
+                continue
+            try:
+                db = load_chain_db(chain,
+                                   consistency_checks=self.consistency_checks)
+            except (OSError, ValueError):
+                continue  # corruption the manifest missed: next-older chain
+            self.db = db
+            db.begin_delta_tracking()
+            self._snapshot_anchor = tip.id
+            self._chain_len = len(chain)
+            try:
+                self._last_snapshot_processed = unpackb(
+                    tip.read_file("meta.bin")).get(
+                    "lastProcessed", tip.id.processed_position)
+            except (OSError, ValueError):
+                self._last_snapshot_processed = tip.id.processed_position
+            # the chain we just validated and loaded IS the recovery
+            # anchor: prime the compaction-bound memo so the first guard
+            # pass doesn't re-CRC it (keyed on the newest DIR — if a
+            # newer broken-chain dir exists the key misses and the guard
+            # conservatively re-walks)
+            self._compact_bound_memo = (tip.id, tip.id.processed_position)
+            return
+        db = ZbDb(consistency_checks=self.consistency_checks)
+        db.begin_delta_tracking()
+        self.db = db
 
     def _last_raft_position(self) -> int:
         """Highest stream position assigned in the raft log (scan the suffix
@@ -450,6 +696,21 @@ class ZeebePartition:
                 work += 1  # scheduled commands were written; next pump processes
         else:
             work += self.processor.replay_available()
+            if (self._replay_barrier is not None
+                    and self.role == RaftRole.LEADER
+                    and self.processor.phase == _Phase.REPLAY):
+                if self.stream.last_position >= self._replay_barrier:
+                    self._finish_leader_recovery()
+                elif (self.recovery_budget_ms > 0
+                      and not self._barrier_budget_flagged
+                      and (_perf_counter() - self._recovery_started) * 1000.0
+                      > self.recovery_budget_ms):
+                    # the WORST recoveries are ones that never finish (a
+                    # barrier stuck on a lost quorum): blow the budget the
+                    # moment it is blown, not when/if the barrier clears —
+                    # the exceeded counter drives the CRITICAL default alert
+                    self._barrier_budget_flagged = True
+                    _M_RECOVERY_EXCEEDED.labels(str(self.partition_id)).inc()
         work += self.exporter_director.export_available()
         if self.limiter is not None and self.limiter.in_flight:
             processed = self.processor.last_processed_position
@@ -462,15 +723,52 @@ class ZeebePartition:
 
     def _maybe_snapshot(self) -> None:
         now = self.clock_millis()
-        if now - self._last_snapshot_ms < self.snapshot_period_ms:
+        if now - self._last_snapshot_ms >= self.snapshot_period_ms:
+            self._last_snapshot_ms = now
+            self.take_snapshot()
             return
-        self._last_snapshot_ms = now
-        self.take_snapshot()
+        # adaptive cadence (ISSUE 6): between periodic snapshots, project the
+        # replay debt (records a restart would replay) against the recovery
+        # budget at the last MEASURED replay rate; snapshot early when the
+        # projection passes REPLAY_DEBT_BUDGET_FRACTION of the budget.
+        # Throttled to one projection per second — the pump is hot.
+        if self.recovery_budget_ms <= 0:
+            return
+        if now - self._last_debt_check_ms < 1000:
+            return
+        self._last_debt_check_ms = now
+        debt = self.stream.last_position - max(self._last_snapshot_processed, 0)
+        pid = str(self.partition_id)
+        _M_REPLAY_DEBT.labels(pid).set(float(max(debt, 0)))
+        if debt <= 0:
+            return
+        projected_ms = debt * 1000.0 / self._observed_replay_rate
+        if projected_ms <= self.recovery_budget_ms * REPLAY_DEBT_BUDGET_FRACTION:
+            return
+        if self.take_snapshot():
+            # reset the period clock only on success: a transiently-declined
+            # attempt (mid-pipeline, not-newer) must not push the next
+            # PERIODIC snapshot out a full period while debt keeps growing
+            self._last_snapshot_ms = now
+            _M_ADAPTIVE_SNAPSHOTS.labels(pid).inc()
+            if self.flight is not None:
+                self.flight.record(
+                    self.partition_id, "adaptive_snapshot",
+                    debtRecords=debt,
+                    projectedReplayMs=round(projected_ms, 1),
+                    budgetMs=self.recovery_budget_ms)
 
-    def take_snapshot(self) -> bool:
+    def take_snapshot(self, force_full: bool = False) -> bool:
         """Snapshot the db at lastProcessedPosition, then compact both logs up
         to min(processed, exported) (reference: AsyncSnapshotDirector.java:37 —
-        wait for commit, persist, then Raft compacts)."""
+        wait for commit, persist, then Raft compacts).
+
+        Incremental mode (non-durable state): when the db's changed-key set
+        is anchored on the store's current tip and the chain is short enough,
+        the snapshot is a DELTA (changed keys since the tip) — O(delta)
+        instead of O(state). The chain rebases to a full snapshot every
+        ``snapshot_chain_length`` links, when the delta would approach the
+        full state's size, or on ``force_full`` (backups)."""
         if self.processor is None or self.db is None:
             return False
         processed = self.processor.last_processed_position
@@ -497,22 +795,63 @@ class ZeebePartition:
             )
         except Exception:
             return False  # not newer than the latest snapshot
+        kind = "full"
         if self.durable_state:
             # O(delta): fsync the durable delta log + manifest; the snapshot
             # entry only carries bookkeeping (positions for recovery-ordering
             # and the raft compaction boundary) — reference: RocksDB
             # checkpoints are hard links, not value copies
+            kind = "durable"
             manifest = self.db.checkpoint()
             transient.write_file("durable.bin", packb({"manifest": manifest}))
         else:
-            transient.write_file("state.bin", self.db.to_snapshot_bytes())
+            anchor = (self.snapshot_store.snapshot_at(self._snapshot_anchor)
+                      if self._snapshot_anchor is not None else None)
+            dirty = getattr(self.db, "dirty_key_count", 0)
+            # a delta at least as large (in entries) as the full state saves
+            # nothing — rebase; likewise when the chain is at its length cap,
+            # the anchor vanished (purge race / manual cleanup), or the
+            # caller wants a self-contained snapshot (backups, installs)
+            if (not force_full
+                    and anchor is not None
+                    and self.db.supports_delta_snapshots
+                    and getattr(self.db, "delta_tracking", False)
+                    and self._chain_len >= 1
+                    and self._chain_len < self.snapshot_chain_length
+                    and dirty < max(self.db.key_count, 1)):
+                kind = "delta"
+                transient.write_file(DELTA_FILE, self.db.to_delta_bytes())
+                transient.link_parent(anchor, self._chain_len + 1)
+            else:
+                transient.write_file(STATE_FILE, self.db.to_snapshot_bytes())
         transient.write_file("meta.bin", packb({
             "lastProcessed": processed,
             "lastPosition": self.stream.last_position,
         }))
         persist_started = _time.perf_counter()
         snapshot = transient.persist()
+        # chain bookkeeping only after the snapshot is durably committed: an
+        # aborted persist must not clear the changed-key window (those keys
+        # would silently fall out of the next delta)
+        self._chain_len = self._chain_len + 1 if kind == "delta" else 1
+        self._snapshot_anchor = snapshot.id
+        self._last_snapshot_processed = processed
+        # prime the compaction-bound memo: we just validated this tip by
+        # persisting it — without this, every guard invocation after a
+        # persist re-reads and CRCs the whole chain (the base is O(state))
+        self._compact_bound_memo = (snapshot.id, processed)
+        if not self.durable_state and self.db.supports_delta_snapshots:
+            # the new tip covers everything up to `processed`; the next delta
+            # records exactly the writes after it. The durable store opts
+            # out: its _data holds _Packed/memoryview cold values a delta
+            # could not serialize (DURABLESTATE-flag-flipped migrations
+            # recover a DurableZbDb even with durable_state now False)
+            self.db.begin_delta_tracking()
         pid = str(self.partition_id)
+        _M_SNAPSHOT_KIND.labels(pid, kind).inc()
+        _M_SNAPSHOT_CHAIN_LEN.labels(pid).set(float(self._chain_len))
+        _M_REPLAY_DEBT.labels(pid).set(
+            float(max(self.stream.last_position - processed, 0)))
         REGISTRY.counter(
             "snapshot_count", "snapshots persisted", ("partition",)
         ).labels(pid).inc()
@@ -558,14 +897,22 @@ class ZeebePartition:
             # (not the current term) or _entry_term answers wrongly at the
             # boundary and replication backs up into a needless snapshot install
             boundary_term = self.raft.entry_term(compact_index - 1)
-            # durable mode: no state.bin exists and the install payload is
-            # built LIVE by the snapshot_provider — pass None so raft skips
-            # the send entirely when the provider declines (b"" would ship a
-            # torn install: journal reset + unpackb crash on the receiver)
+            # durable mode and delta snapshots have no self-contained
+            # state.bin to store as a fallback install payload — pass None so
+            # installs are served only by the live ``snapshot_provider``
+            # (which materializes the chain), and when it declines, nothing
+            # is sent (b"" would ship a torn install: journal reset + unpackb
+            # crash on the receiver)
             self.raft.set_snapshot(
                 compact_index - 1, boundary_term,
-                None if self.durable_state else self._install_payload(snapshot),
+                self._install_payload(snapshot)
+                if kind == "full" else None,
             )
+        # the materialized stream journal compacts to the same bound (whole
+        # segments only); its compact_guard re-derives the invariant from the
+        # store + exporter cursors below this caller, so a stale `exported`
+        # here can never over-delete
+        self.stream.compact_to_position(compact_position)
         return True
 
     # -- snapshot replication (leader → lagging follower) ----------------------
@@ -592,11 +939,23 @@ class ZeebePartition:
                     "lastPosition": self.stream.last_position,
                 }),
             }))
-        snapshot = self.snapshot_store.latest_snapshot()
-        if snapshot is None:
+        chain = self.snapshot_store.latest_valid_chain()
+        if chain is None or not chain[0].has_file(STATE_FILE):
             return None
-        return (self.raft.snapshot_index, self.raft.snapshot_term,
-                self._install_payload(snapshot))
+        if len(chain) == 1:
+            payload = self._install_payload(chain[0])
+        else:
+            # delta tip: the receiver installs a SELF-CONTAINED state blob
+            # (followers know nothing about the leader's local chain), so
+            # materialize base+deltas into one state.bin equivalent
+            try:
+                payload = packb({
+                    "state": load_chain_db(chain).to_snapshot_bytes(),
+                    "meta": chain[-1].read_file("meta.bin"),
+                })
+            except (OSError, ValueError):
+                return None
+        return (self.raft.snapshot_index, self.raft.snapshot_term, payload)
 
     def _receive_install_snapshot(self, data: bytes) -> None:
         """Follower fell behind the leader's compacted log: replace local state
@@ -619,6 +978,9 @@ class ZeebePartition:
         self.stream_journal.close()
         shutil.rmtree(self._stream_dir, ignore_errors=True)
         self.stream_journal = SegmentedJournal(self._stream_dir)
+        # the rebuilt journal must keep the compaction safety guard — losing
+        # it here would leave every later compact() on this node unguarded
+        self.stream_journal.compact_guard = self._stream_compact_guard
         self.stream = LogStream(self.stream_journal, self.partition_id,
                                 clock=self.clock_millis)
         self.stream._next_position = meta["lastPosition"] + 1
@@ -702,4 +1064,9 @@ class ZeebePartition:
             "lastPosition": self.stream.last_position,
             "lastProcessed": self.processor.last_processed_position
             if self.processor else -1,
+            # recovery-budget plane: the last rebuild's cost (duration,
+            # replay length, chain, budget verdict) — the soak harness and
+            # operators read this off /health after every restart
+            "lastRecovery": self.last_recovery,
+            "snapshotChainLength": self._chain_len,
         }

@@ -153,6 +153,17 @@ def main(argv: list[str] | None = None) -> int:
                         "unix-ms timestamp")
 
     p = sub.add_parser(
+        "snapshots",
+        help="list snapshot chains (positions, sizes, validity, projected "
+             "replay debt) from a data directory — offline, read-only, safe "
+             "on a live or postmortem broker dir")
+    p.add_argument("data_dir",
+                   help="a broker data dir (partition-*/ children), one "
+                        "partition's dir, or a snapshot store root")
+    p.add_argument("--pretty", action="store_true",
+                   help="human-readable table instead of JSON")
+
+    p = sub.add_parser(
         "metrics-doc",
         help="generate the metrics reference (docs/metrics.md) from a "
              "representative broker scenario's live registry")
@@ -172,6 +183,9 @@ def main(argv: list[str] | None = None) -> int:
         return _profile(args)
     if args.cmd == "metrics-doc":
         return _metrics_doc(args)
+    if args.cmd == "snapshots":
+        # offline store walk — no gateway connection
+        return _snapshots(args)
 
     from zeebe_tpu.client import JobWorker, ZeebeTpuClient
 
@@ -468,6 +482,127 @@ def _metrics_doc(args) -> int:
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(content)
     print(f"wrote {path}")
+    return 0
+
+
+# -- snapshots: offline chain inspection ---------------------------------------
+
+
+# mirror of broker/partition.py DEFAULT_REPLAY_RATE_RPS (kept local: the
+# partition module pulls the engine/jax stack, which an offline inspection
+# tool must never initialize)
+_REPLAY_RATE_RPS = 10_000.0
+
+
+def _snapshot_stores(root) -> list[tuple[str, "Path", "Path | None"]]:
+    """Resolve ``(label, store_root, stream_journal_dir)`` triples from any
+    of the accepted layouts: a broker data dir (``partition-*/`` children),
+    one partition's dir, or a bare snapshot store root."""
+    partitions = sorted(p for p in root.glob("partition-*") if p.is_dir())
+    if partitions:
+        return [(p.name, p / "snapshots", p / "stream") for p in partitions
+                if (p / "snapshots").is_dir()]
+    # a partition dir holds the store root at <dir>/snapshots (which itself
+    # holds the committed snapshots at <store>/snapshots/<id>/)
+    if (root / "snapshots" / "snapshots").is_dir():
+        return [(root.name, root / "snapshots", root / "stream")]
+    if (root / "snapshots").is_dir():
+        return [(root.name, root, None)]
+    return []
+
+
+def _inspect_partition(label: str, store_root, stream_dir) -> dict:
+    from zeebe_tpu.journal import read_only_records
+    from zeebe_tpu.logstreams.log_stream import _py_scan_batch_headers
+    from zeebe_tpu.state.snapshot import inspect_store
+
+    snapshots = inspect_store(store_root)
+    # the recovery anchor is the NEWEST snapshot whose whole chain
+    # validates — exactly what partition recovery would install
+    anchor = next((s for s in reversed(snapshots) if s["chainValid"]), None)
+    anchor_processed = anchor["processedPosition"] if anchor else -1
+    journal_end = None
+    debt = None
+    if stream_dir is not None and stream_dir.is_dir():
+        journal_end, debt = -1, 0
+        for jrec in read_only_records(stream_dir):
+            try:
+                _, _, records = _py_scan_batch_headers(jrec.data)
+            except Exception:  # noqa: BLE001 — stop at the torn tail
+                break
+            for rec in records:
+                position = rec[1]
+                journal_end = max(journal_end, position)
+                if position > anchor_processed:
+                    debt += 1
+    out = {
+        "partition": label,
+        "store": str(store_root),
+        "snapshots": snapshots,
+        "recoveryAnchor": None if anchor is None else {
+            "id": anchor["id"],
+            "chainLength": anchor["chainLength"],
+            "processedPosition": anchor["processedPosition"],
+        },
+        "journalEndPosition": journal_end,
+        "replayDebtRecords": debt,
+    }
+    if debt is not None:
+        out["projectedReplayMs"] = round(debt * 1000.0 / _REPLAY_RATE_RPS, 1)
+    return out
+
+
+def _render_snapshots(report: dict) -> str:
+    lines = []
+    for part in report["partitions"]:
+        anchor = part["recoveryAnchor"]
+        lines.append(f"{part['partition']} · {part['store']}")
+        lines.append(
+            f"  recovery anchor: "
+            + (f"{anchor['id']} (chain {anchor['chainLength']})"
+               if anchor else "none — full replay from log start"))
+        if part["replayDebtRecords"] is not None:
+            lines.append(
+                f"  journal end {part['journalEndPosition']} · replay debt "
+                f"{part['replayDebtRecords']} records "
+                f"(~{part['projectedReplayMs']}ms at "
+                f"{int(_REPLAY_RATE_RPS)} rec/s)")
+        header = (f"  {'id':<24} {'kind':<14} {'processed':>9} "
+                  f"{'exported':>9} {'bytes':>10} {'chain':>5} valid")
+        lines.append(header)
+        for s in part["snapshots"]:
+            valid = ("ok" if s["chainValid"]
+                     else ("torn" if not s["valid"] else "broken-chain"))
+            lines.append(
+                f"  {s['id']:<24} {s['kind']:<14} "
+                f"{s['processedPosition']:>9} {s['exportedPosition']:>9} "
+                f"{s['sizeBytes']:>10} {s['chainLength']:>5} {valid}")
+        if not part["snapshots"]:
+            lines.append("  (no snapshots)")
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
+def _snapshots(args) -> int:
+    from pathlib import Path
+
+    root = Path(args.data_dir)
+    if not root.is_dir():
+        print(f"no such directory: {root}", file=sys.stderr)
+        return 2
+    stores = _snapshot_stores(root)
+    if not stores:
+        print(f"no snapshot stores under {root} (expected partition-*/ "
+              f"children, a partition dir, or a store root)", file=sys.stderr)
+        return 2
+    report = {"dataDir": str(root), "partitions": [
+        _inspect_partition(label, store_root, stream_dir)
+        for label, store_root, stream_dir in stores
+    ]}
+    if args.pretty:
+        print(_render_snapshots(report))
+    else:
+        _out(report)
     return 0
 
 

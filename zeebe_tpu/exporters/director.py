@@ -150,6 +150,10 @@ class ExporterContainer:
         self.exporter = exporter
         self.state = state
         self.position = state.position(exporter_id)
+        # the cursor as RECOVERED from state at open, before any delivery —
+        # test oracles use it to tell a legitimately-ahead recovered cursor
+        # (stream not re-materialized yet) from an export past commit
+        self.recovered_position = self.position
         # highest position handed to the exporter AND exported without error
         # but not yet acked; a skip may only advance the persisted position
         # when nothing is pending, or a crash-before-flush loses the buffered

@@ -6,6 +6,7 @@ from zeebe_tpu.journal.journal import (
     InvalidAsqnError,
     JournalRecord,
     SegmentedJournal,
+    read_only_records,
 )
 
 __all__ = [
@@ -14,4 +15,5 @@ __all__ = [
     "InvalidAsqnError",
     "JournalRecord",
     "SegmentedJournal",
+    "read_only_records",
 ]

@@ -31,7 +31,7 @@ import os
 import struct
 import zlib
 from pathlib import Path
-from typing import Iterator
+from typing import Callable, Iterator
 
 from zeebe_tpu.observability.tracer import get_tracer as _get_tracer
 from zeebe_tpu.utils.metrics import REGISTRY as _REGISTRY
@@ -78,6 +78,10 @@ _M_LAST_FLUSHED = _REGISTRY.gauge(
 _M_COMPACTION_MS = _REGISTRY.histogram(
     "compaction_time_ms", "ms per journal compaction pass",
     buckets=(0.1, 0.5, 1, 5, 10, 50, 100, 1000))
+_M_COMPACTION_CLAMPED = _REGISTRY.counter(
+    "journal_compaction_clamped_total",
+    "compaction requests clamped by the safety guard "
+    "(min of snapshot position and exporter cursors)")
 _M_SEGMENT_ALLOC = _REGISTRY.histogram(
     "segment_allocation_time", "seconds to allocate a new segment file")
 _M_DRAINS = _REGISTRY.counter(
@@ -408,6 +412,13 @@ class SegmentedJournal:
         self._last_flush_t = _perf()
         self._meta_path = self.dir / f"{name}.meta"
         self._meta_fd: int | None = None
+        # compaction safety guard (broker/partition.py installs one): a
+        # callable returning the max journal index (exclusive) compaction may
+        # delete below — derived from min(snapshot position, all exporter
+        # container cursors). compact() clamps to it; a guard failure fails
+        # SAFE (no compaction this pass). None = unguarded (standalone
+        # journals: tests, raft-internal resets).
+        self.compact_guard: "Callable[[], int] | None" = None
         self.segments: list[_Segment] = []
         # this journal's contribution to the global segment_count gauge —
         # updated by delta whenever the segment list changes, and returned
@@ -699,7 +710,18 @@ class SegmentedJournal:
     def compact(self, index: int) -> None:
         """Delete whole segments whose records are all < ``index`` (snapshot
         compaction; reference: SegmentedJournal.deleteUntil). Never deletes the
-        tail segment."""
+        tail segment, and never passes the installed ``compact_guard`` — the
+        durability invariant that segment deletion cannot outrun the latest
+        snapshot or any exporter container cursor is enforced HERE, below
+        every caller."""
+        if self.compact_guard is not None:
+            try:
+                bound = self.compact_guard()
+            except Exception:  # noqa: BLE001 — a broken guard must fail safe
+                bound = 0      # (skip compaction), never delete unguarded
+            if index > bound:
+                _M_COMPACTION_CLAMPED.inc()
+                index = bound
         start = _perf()
         compacted = False
         while len(self.segments) > 1 and self.segments[0].last_index < index:
@@ -718,3 +740,44 @@ class SegmentedJournal:
         self._update_segment_gauge()
         # invalidate the stale flushed-index marker from the pre-reset log
         self._write_flush_marker(max(next_index - 1, 0))
+
+
+def read_only_records(directory: str | Path,
+                      name: str = "journal") -> Iterator[JournalRecord]:
+    """Iterate a journal directory's records WITHOUT opening it for write —
+    unlike ``SegmentedJournal`` (which truncates crash-torn suffixes on
+    open), this never mutates anything, so operator inspection tools (``cli
+    snapshots``) can point it at a live broker's data directory. Stops
+    silently at the first corrupt/torn frame, exactly where a real open
+    would truncate."""
+    directory = Path(directory)
+    paths = sorted(directory.glob(f"{name}-*.log"),
+                   key=lambda p: int(p.stem.rsplit("-", 1)[1]))
+    prev_last: int | None = None
+    for path in paths:
+        try:
+            raw = path.read_bytes()
+        except OSError:
+            return
+        if len(raw) < _SEG_HEADER.size:
+            return
+        magic, version, _seg_id, first_index = _SEG_HEADER.unpack_from(raw)
+        if magic != _MAGIC or version != _VERSION:
+            return
+        if prev_last is not None and first_index != prev_last + 1:
+            return  # gap between segments: later segments are unreachable
+        offset = _SEG_HEADER.size
+        expected = first_index
+        n = len(raw)
+        while offset + _FRAME.size <= n:
+            length, crc, index, asqn = _FRAME.unpack_from(raw, offset)
+            end = offset + _FRAME.size + length
+            if length == 0 or end > n or index != expected:
+                return
+            data = raw[offset + _FRAME.size:end]
+            if _checksum(index, asqn, data) != crc:
+                return
+            yield JournalRecord(index, asqn, data)
+            prev_last = index
+            expected += 1
+            offset = end

@@ -603,6 +603,26 @@ class LogStream:
     def last_position(self) -> int:
         return self._next_position - 1
 
+    def compact_to_position(self, position: int) -> int:
+        """Compact the backing journal so records whose positions are all
+        <= ``position`` can be deleted (whole segments only; the journal's
+        ``compact_guard`` — min of snapshot position and exporter cursors —
+        clamps further). The batch index arrays are intentionally NOT pruned:
+        reader hints are slots into them, and a prune would silently shift
+        every live hint; stale leading entries cost 2 ints per batch and
+        resolve to empty reads nobody issues (all consumers are past the
+        bound by construction). Decoded-batch caches for compacted indexes
+        ARE dropped. Returns the journal's new first index."""
+        idx = self.journal.seek_to_asqn(position)
+        if idx > 1:
+            self.journal.compact(idx)
+        first = self.journal.first_index
+        for stale in [k for k in self._batch_cache if k < first]:
+            del self._batch_cache[stale]
+        for stale in [k for k in self._batch_has_commands if k < first]:
+            del self._batch_has_commands[stale]
+        return first
+
     def new_reader(self, from_position: int = 1) -> LogStreamReader:
         return LogStreamReader(self, from_position)
 
@@ -668,16 +688,17 @@ class LogStream:
         if position > self.last_position:
             return None, hint
         slot = self._locate_slot(position, hint)
-        batch = self._read_batch_at(self._batch_indexes[slot])
-        logged = _record_at_or_after(batch, position)
-        if logged is not None:
-            return logged, slot
-        # position falls in a gap after this batch; first record of the next
-        if slot + 1 < len(self._batch_indexes):
-            nxt = self._read_batch_at(self._batch_indexes[slot + 1])
-            if nxt:
-                return nxt[0], slot + 1
-        return None, slot
+        while True:
+            batch = self._read_batch_at(self._batch_indexes[slot])
+            logged = _record_at_or_after(batch, position)
+            if logged is not None:
+                return logged, slot
+            # position falls in a gap after this batch — or the batch was
+            # compacted away (journal read returns empty; the stale index
+            # entry is kept so hints stay valid): first record of the next
+            if slot + 1 >= len(self._batch_indexes):
+                return None, slot
+            slot += 1
 
     def _scan_batches(self, from_position: int):
         """Shared scan skeleton: yields (cached_records, payload) per

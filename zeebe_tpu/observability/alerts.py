@@ -102,6 +102,17 @@ def default_rules() -> list[AlertRule]:
             labels_contains='cache="miss"',
             kind="changes", threshold=6.0, window_ms=60_000,
             severity="warning"),
+        AlertRule(
+            # recovery_budget_exceeded_total is stored as a rate: a blown
+            # recovery is a 0→spike→0 episode, so ANY value change inside
+            # the trailing minute means a partition rebuild just ran past
+            # its recovery_budget_ms (ISSUE 6). Partition-labeled only (no
+            # node label — recoveries are partition-scoped), so like
+            # exporter lag it passes every evaluator's _mine().
+            name="recovery_budget_exceeded",
+            series="zeebe_recovery_budget_exceeded_total",
+            kind="changes", threshold=1.0, window_ms=60_000,
+            severity="critical"),
     ]
 
 

@@ -386,6 +386,13 @@ class Transaction:
 
     def commit(self) -> None:
         db = self._db
+        dirty = db._dirty_keys
+        if dirty is not None and self._writes:
+            # incremental-snapshot delta tracking: every committed overlay
+            # key (put OR delete) joins the changed-keys-since-last-snapshot
+            # set, regardless of which commit pass (native/python/durable)
+            # applies it below
+            dirty.update(self._writes)
         db._pre_commit(self._writes)
         if db._native_commit is not None:
             # one native pass (codec.c commit_overlay) applying the overlay
@@ -502,6 +509,10 @@ class ZbDb:
         # through _pre_commit
         self._native_iterate = _iterate_snapshot
         self._native_commit = _commit_overlay
+        # changed-keys-since-last-snapshot set for incremental snapshots
+        # (state/snapshot.py delta chains); None = tracking off — one is-None
+        # check per commit
+        self._dirty_keys: set[bytes] | None = None
 
     # -- committed-store internals ------------------------------------------
 
@@ -609,6 +620,74 @@ class ZbDb:
     def content_equals(self, other: "ZbDb") -> bool:
         """Deep state equality — the replay≡processing test oracle."""
         return self._data == other._data
+
+    # -- incremental-snapshot delta serialization ----------------------------
+
+    DELTA_MAGIC = b"ZDLT\x01"
+    # subclasses whose _data holds non-msgpack-able representations (the
+    # durable store's _Packed/memoryview cold values) must opt OUT: a delta
+    # serialized from them would crash packb or decode as the wrong type
+    supports_delta_snapshots = True
+
+    def begin_delta_tracking(self) -> None:
+        """Start (or restart) recording changed keys. Call after recovery so
+        the first delta captures exactly the writes since the recovered
+        snapshot chain's tip."""
+        self._dirty_keys = set()
+
+    @property
+    def delta_tracking(self) -> bool:
+        return self._dirty_keys is not None
+
+    @property
+    def dirty_key_count(self) -> int:
+        return len(self._dirty_keys) if self._dirty_keys is not None else 0
+
+    @property
+    def key_count(self) -> int:
+        return len(self._data)
+
+    def to_delta_bytes(self) -> bytes:
+        """Serialize the changed-keys-since-tracking-start as a delta
+        (msgpack ``[[key, deleted, value], …]`` + crc32 trailer, same
+        integrity scheme as the full snapshot). Does NOT clear the tracked
+        set — the caller clears only after the delta is durably persisted,
+        so an aborted snapshot never loses changes."""
+        if self.in_transaction:
+            raise RuntimeError("cannot snapshot with an open transaction")
+        if self._dirty_keys is None:
+            raise RuntimeError("delta tracking is not active")
+        data = self._data
+        entries = []
+        for key in sorted(self._dirty_keys):
+            if key in data:
+                entries.append([key, False, data[key]])
+            else:
+                entries.append([key, True, None])
+        body = msgpack.packb(entries)
+        crc = zlib.crc32(body) & 0xFFFFFFFF
+        return self.DELTA_MAGIC + struct.pack("<I", crc) + body
+
+    def clear_delta_tracking(self) -> None:
+        """Reset the changed-key window (the just-persisted delta covers it)."""
+        self._dirty_keys = set()
+
+    def apply_delta_bytes(self, raw: bytes) -> int:
+        """Apply one delta on top of the committed store (chain recovery:
+        base snapshot, then each delta in order). Returns the entry count."""
+        if raw[:5] != self.DELTA_MAGIC:
+            raise ValueError("bad state delta magic")
+        (crc,) = struct.unpack_from("<I", raw, 5)
+        body = raw[9:]
+        if zlib.crc32(body) & 0xFFFFFFFF != crc:
+            raise ValueError("state delta checksum mismatch")
+        entries = msgpack.unpackb(body)
+        for key, deleted, value in entries:
+            if deleted:
+                self._delete_committed(key)
+            else:
+                self._put_committed(key, value)
+        return len(entries)
 
 
 class _TxnContext:

@@ -213,6 +213,11 @@ class DurableZbDb(ZbDb):
       decoded-object budget.
     """
 
+    # _data holds _Packed/memoryview cold representations: a delta snapshot
+    # serialized from it would crash msgpack or round-trip wrong types (the
+    # durable store has its own O(delta) story — checkpoint())
+    supports_delta_snapshots = False
+
     #: knob defaults, shared by __init__ and open()
     DEFAULT_HOT_BUDGET_BYTES = 256 << 20
     DEFAULT_COMPACT_FACTOR = 2.0

@@ -7,9 +7,19 @@ FileBasedSnapshotChunkReader.java.
 A snapshot is a directory of files identified by
 ``<index>-<term>-<processedPosition>-<exportedPosition>``; it is written into a
 pending dir, checksummed (one CRC per file recorded in an SFV-style manifest),
-then atomically renamed into place. Only the latest valid snapshot is kept
-(older ones are purged on persist), except snapshots pinned by a reservation
-(backup in progress). A chunk reader serves replication to followers.
+then atomically renamed into place. Only the latest valid snapshot *chain* is
+kept (older ones are purged on persist), except snapshots pinned by a
+reservation (backup in progress). A chunk reader serves replication to
+followers.
+
+Incremental snapshots (ISSUE 6): a snapshot may be a **base** (full
+``state.bin``) or a **delta** (``delta.bin`` holding the changed keys since
+its parent, plus a ``chain.bin`` naming the parent snapshot id). Recovery
+resolves the newest snapshot whose whole chain — base through tip — exists
+and verifies; a torn or corrupt member invalidates every descendant, and
+recovery falls back to the newest fully-valid ancestor chain (or an older
+independent chain) instead of crashing. ``_purge_older_than`` keeps the kept
+snapshot's ancestors alive, so a chain can never lose its base to the purge.
 """
 
 from __future__ import annotations
@@ -24,6 +34,9 @@ from typing import Callable, Iterator
 
 _ID_RE = re.compile(r"^(\d+)-(\d+)-(\d+)-(\d+)$")
 _MANIFEST = "CHECKSUM.sfv"
+_CHAIN_FILE = "chain.bin"
+STATE_FILE = "state.bin"
+DELTA_FILE = "delta.bin"
 
 
 class InvalidSnapshotError(Exception):
@@ -59,6 +72,18 @@ def _file_crc(path: Path) -> int:
     return crc & 0xFFFFFFFF
 
 
+def manifest_bytes(files: dict[str, bytes]) -> bytes:
+    """The SFV-style manifest for a set of in-memory snapshot files — the
+    ONE owner of the line format `_verify_manifest` checks (backup's
+    materialized-chain path builds snapshots outside `persist()` and must
+    stay restorable)."""
+    return "".join(
+        f"{name}\t{zlib.crc32(data) & 0xFFFFFFFF:08x}\n"
+        for name, data in sorted(files.items())
+        if name != _MANIFEST
+    ).encode()
+
+
 def _write_manifest(directory: Path) -> None:
     lines = []
     for p in sorted(directory.iterdir()):
@@ -68,17 +93,29 @@ def _write_manifest(directory: Path) -> None:
 
 
 def _verify_manifest(directory: Path) -> bool:
+    """True iff the directory's manifest exists, parses, and matches every
+    file. Never raises: a torn/partially-written snapshot (power loss during
+    commit) must be *skipped* by recovery, not crash it — a malformed
+    manifest line, an unreadable file, or a vanished directory all read as
+    "invalid"."""
     manifest = directory / _MANIFEST
-    if not manifest.exists():
+    try:
+        if not manifest.exists():
+            return False
+        expected = {}
+        for line in manifest.read_text().splitlines():
+            name, sep, crc = line.partition("\t")
+            if not sep or not name:
+                return False
+            expected[name] = int(crc, 16)
+        actual = {
+            p.name: _file_crc(p)
+            for p in directory.iterdir()
+            if p.is_file() and p.name != _MANIFEST
+        }
+        return expected == actual
+    except (OSError, ValueError):
         return False
-    expected = {}
-    for line in manifest.read_text().splitlines():
-        name, _, crc = line.partition("\t")
-        expected[name] = int(crc, 16)
-    actual = {
-        p.name: _file_crc(p) for p in directory.iterdir() if p.is_file() and p.name != _MANIFEST
-    }
-    return expected == actual
 
 
 @dataclasses.dataclass(frozen=True, slots=True)
@@ -91,6 +128,28 @@ class PersistedSnapshot:
 
     def read_file(self, name: str) -> bytes:
         return (self.path / name).read_bytes()
+
+    def has_file(self, name: str) -> bool:
+        return (self.path / name).is_file()
+
+    @property
+    def is_delta(self) -> bool:
+        return self.has_file(DELTA_FILE)
+
+    def parent_id(self) -> "SnapshotId | None":
+        """Parent snapshot id for a delta snapshot (from chain.bin), None for
+        a base snapshot or on any read/parse failure (the chain validator
+        treats an unreadable link as a broken chain)."""
+        try:
+            raw = (self.path / _CHAIN_FILE).read_bytes()
+        except OSError:
+            return None
+        try:
+            from zeebe_tpu.protocol.msgpack import unpackb
+
+            return SnapshotId.parse(unpackb(raw).get("parent", ""))
+        except Exception:  # noqa: BLE001 — corrupt chain meta = no parent
+            return None
 
 
 @dataclasses.dataclass(frozen=True, slots=True)
@@ -125,6 +184,14 @@ class TransientSnapshot:
     def write_file(self, name: str, data: bytes) -> None:
         (self.path / name).write_bytes(data)
         self._taken = True
+
+    def link_parent(self, parent: PersistedSnapshot, depth: int) -> None:
+        """Mark this transient as a delta on ``parent`` (chain.bin carries
+        the parent id and the 1-based chain depth of this snapshot)."""
+        from zeebe_tpu.protocol.msgpack import packb
+
+        self.write_file(_CHAIN_FILE, packb(
+            {"parent": str(parent.id), "depth": depth}))
 
     def persist(self) -> PersistedSnapshot:
         if not self._taken:
@@ -175,6 +242,67 @@ class FileBasedSnapshotStore:
                 out.append(PersistedSnapshot(snap_id, p))
         return sorted(out, key=lambda s: s.id)
 
+    # -- chains (incremental snapshots) --------------------------------------
+
+    def snapshot_at(self, snap_id: SnapshotId) -> PersistedSnapshot | None:
+        path = self.snapshots_dir / str(snap_id)
+        return PersistedSnapshot(snap_id, path) if path.is_dir() else None
+
+    def chain_of(self, snapshot: PersistedSnapshot
+                 ) -> list[PersistedSnapshot] | None:
+        """Resolve and validate ``snapshot``'s full chain, base → tip.
+
+        Returns None when ANY member is torn (manifest mismatch), missing,
+        structurally wrong (a delta without a parent file, a base without
+        state), or the parent links cycle — the caller falls back to an
+        older snapshot instead of recovering from a broken chain."""
+        chain = [snapshot]
+        seen = {snapshot.id}
+        cur = snapshot
+        while True:
+            if not _verify_manifest(cur.path):
+                return None
+            parent_id = cur.parent_id()
+            if parent_id is None:
+                if cur.is_delta:
+                    return None  # delta whose parent link is unreadable
+                break  # a base: full state.bin or a durable marker
+            if not cur.is_delta or parent_id in seen:
+                return None
+            parent = self.snapshot_at(parent_id)
+            if parent is None:
+                return None
+            seen.add(parent_id)
+            chain.append(parent)
+            cur = parent
+        chain.reverse()
+        return chain
+
+    def iter_valid_chains(self) -> Iterator[list[PersistedSnapshot]]:
+        """Valid chains, newest tip first — recovery takes the first one it
+        can actually load, so a corrupt tip falls back to the last fully-
+        valid ancestor (which is itself a persisted snapshot)."""
+        for snapshot in reversed(self.list_snapshots()):
+            chain = self.chain_of(snapshot)
+            if chain is not None:
+                yield chain
+
+    def latest_valid_chain(self) -> list[PersistedSnapshot] | None:
+        return next(self.iter_valid_chains(), None)
+
+    def _ancestor_ids(self, snap_id: SnapshotId) -> set[SnapshotId]:
+        """``snap_id`` plus every ancestor reachable through parent links
+        (validity not required here: the purge must err on keeping)."""
+        out = {snap_id}
+        cur = self.snapshot_at(snap_id)
+        while cur is not None:
+            parent_id = cur.parent_id()
+            if parent_id is None or parent_id in out:
+                break
+            out.add(parent_id)
+            cur = self.snapshot_at(parent_id)
+        return out
+
     # -- take ----------------------------------------------------------------
 
     def new_transient_snapshot(
@@ -217,8 +345,15 @@ class FileBasedSnapshotStore:
             os.close(fd)
 
     def _purge_older_than(self, keep: SnapshotId) -> None:
+        # chain-aware: the kept snapshot's ancestors (its delta chain's base
+        # and intermediates) and every reserved snapshot's ancestors survive
+        # — purging a base out from under a live delta chain would turn the
+        # latest snapshot unrecoverable
+        protected = self._ancestor_ids(keep)
+        for reserved in self._reservations:
+            protected |= self._ancestor_ids(reserved)
         for snap in self.list_snapshots():
-            if snap.id < keep and snap.id not in self._reservations:
+            if snap.id < keep and snap.id not in protected:
                 shutil.rmtree(snap.path, ignore_errors=True)
 
     # -- reservations (pin during backup) ------------------------------------
@@ -274,3 +409,93 @@ class FileBasedSnapshotStore:
         for name, buf in files.items():
             transient.write_file(name, bytes(buf))
         return transient.persist()
+
+
+# -- chain loading (shared by partition recovery, chaos oracle, backup) -------
+
+
+def load_chain_db(chain: list[PersistedSnapshot], consistency_checks: bool = False):
+    """Materialize a validated snapshot chain into a ZbDb: install the base's
+    full ``state.bin``, then apply each delta in order. Raises ValueError on
+    a base without state (durable-marker chains are the caller's special
+    case) or on checksum mismatches the manifest somehow missed."""
+    from zeebe_tpu.state.db import ZbDb
+
+    base = chain[0]
+    if not base.has_file(STATE_FILE):
+        raise ValueError(f"chain base {base.id} has no {STATE_FILE}")
+    db = ZbDb.from_snapshot_bytes(base.read_file(STATE_FILE),
+                                  consistency_checks=consistency_checks)
+    for delta in chain[1:]:
+        db.apply_delta_bytes(delta.read_file(DELTA_FILE))
+    return db
+
+
+# -- read-only inspection (cli snapshots) -------------------------------------
+
+
+def inspect_store(directory: str | Path) -> list[dict]:
+    """Describe every snapshot under a store root WITHOUT mutating anything —
+    unlike constructing a FileBasedSnapshotStore (which deletes pending
+    leftovers and corrupt snapshots on open), this is safe to point at a
+    live or postmortem data directory. Returns one dict per snapshot, oldest
+    first: id, positions, kind (full/delta/durable-marker), per-file sizes,
+    manifest validity, parent link, and whether the full chain validates."""
+    root = Path(directory)
+    snapshots_dir = root / "snapshots"
+    if not snapshots_dir.is_dir():
+        return []
+    snapshots: list[PersistedSnapshot] = []
+    for p in sorted(snapshots_dir.iterdir()):
+        snap_id = SnapshotId.parse(p.name)
+        if snap_id is not None and p.is_dir():
+            snapshots.append(PersistedSnapshot(snap_id, p))
+    snapshots.sort(key=lambda s: s.id)
+    by_id = {s.id: s for s in snapshots}
+    valid = {s.id: _verify_manifest(s.path) for s in snapshots}
+
+    def chain_valid(snap: PersistedSnapshot) -> tuple[bool, int]:
+        depth, cur, seen = 1, snap, {snap.id}
+        while True:
+            if not valid.get(cur.id, False):
+                return False, depth
+            parent_id = cur.parent_id()
+            if parent_id is None:
+                return (not cur.is_delta), depth
+            if not cur.is_delta or parent_id in seen or parent_id not in by_id:
+                return False, depth
+            seen.add(parent_id)
+            cur = by_id[parent_id]
+            depth += 1
+
+    out = []
+    for snap in snapshots:
+        if snap.has_file(STATE_FILE):
+            kind = "full"
+        elif snap.is_delta:
+            kind = "delta"
+        elif snap.has_file("durable.bin"):
+            kind = "durable-marker"
+        else:
+            kind = "unknown"
+        files = {}
+        try:
+            for f in snap.files():
+                files[f.name] = f.stat().st_size
+        except OSError:
+            pass
+        ok, depth = chain_valid(snap)
+        parent = snap.parent_id()
+        out.append({
+            "id": str(snap.id),
+            "kind": kind,
+            "processedPosition": snap.id.processed_position,
+            "exportedPosition": snap.id.exported_position,
+            "files": files,
+            "sizeBytes": sum(files.values()),
+            "valid": valid.get(snap.id, False),
+            "parent": str(parent) if parent is not None else None,
+            "chainLength": depth,
+            "chainValid": ok,
+        })
+    return out

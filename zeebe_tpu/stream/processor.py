@@ -243,6 +243,10 @@ class StreamProcessor:
         self._scan_hint = -1  # batch-slot cursor for the sequential scans
         self.last_processed_position = -1
         self.last_written_position = -1
+        # plain int lifetime counter (metrics children are shared across
+        # partition transitions): the partition's recovery accounting reads
+        # it right after start() to learn this recovery's replay length
+        self.replayed_records = 0
         # double-buffered pipeline state: each processed group's post-commit
         # side effects (client responses, jobs-available notifications) are
         # deferred and run while the NEXT group's device chunk computes.
@@ -361,6 +365,7 @@ class StreamProcessor:
             position = batch[-1].position + 1
         self._reader_position = position
         if applied:
+            self.replayed_records += applied
             self._m_replayed.inc(applied)
             self._m_replay_events.inc(applied)
         return applied

@@ -160,10 +160,11 @@ def replay_state_of(partition, partition_count: int | None = None):
     from zeebe_tpu.state import ZbDb
     from zeebe_tpu.stream import StreamProcessor, StreamProcessorMode
 
-    snapshot = partition.snapshot_store.latest_snapshot()
-    if snapshot is not None:
-        db = ZbDb.from_snapshot_bytes(snapshot.read_file("state.bin"),
-                                      consistency_checks=False)
+    from zeebe_tpu.state.snapshot import STATE_FILE, load_chain_db
+
+    chain = partition.snapshot_store.latest_valid_chain()
+    if chain is not None and chain[0].has_file(STATE_FILE):
+        db = load_chain_db(chain)
     else:
         db = ZbDb(consistency_checks=False)
     # migrations run between recovery and replay, exactly like _transition
@@ -206,7 +207,10 @@ class ChaosHarness:
                  partition_count: int = 1, replication_factor: int = 3,
                  directory: str | Path | None = None,
                  exporters_factory: Callable[[], dict[str, Any]] | None = None,
-                 step_ms: int = 50) -> None:
+                 step_ms: int = 50,
+                 snapshot_period_ms: int = 5 * 60 * 1000,
+                 recovery_budget_ms: int = 60_000,
+                 snapshot_chain_length: int = 8) -> None:
         from zeebe_tpu.broker import InProcessCluster
 
         self.plan = plan
@@ -215,6 +219,9 @@ class ChaosHarness:
             broker_count=broker_count, partition_count=partition_count,
             replication_factor=replication_factor, directory=directory,
             exporters_factory=exporters_factory, network=self.net,
+            snapshot_period_ms=snapshot_period_ms,
+            recovery_budget_ms=recovery_budget_ms,
+            snapshot_chain_length=snapshot_chain_length,
         )
         self.step_ms = step_ms
         self.tick = 0
@@ -312,10 +319,22 @@ class ChaosHarness:
                         self.violations.append(
                             f"tick {self.tick}: exporter {key} position "
                             f"regressed {prev} -> {pos}")
-                    if pos > commit:
+                    # only an ADVANCE past commit is a violation: right
+                    # after a crash-restart the cursor RECOVERED from state
+                    # legitimately sits ahead of a stream journal that has
+                    # not re-materialized yet (exports can only come from
+                    # stream reads, so a recovered cursor can never advance
+                    # until the stream passes it again). The advance baseline
+                    # is the previous sample for an observed container, or
+                    # the position recovered at open for a FIRST observation
+                    # — without the latter, an export past commit inside the
+                    # container's first tick would go unflagged
+                    baseline = (prev if prev_cont is container
+                                else container.recovered_position)
+                    if pos > baseline and pos > commit:
                         self.violations.append(
                             f"tick {self.tick}: exporter {key} position {pos} "
-                            f"ahead of commit {commit}")
+                            f"advanced ahead of commit {commit}")
                     self._exporter_watermarks[key] = (container, pos)
 
     def check_exactly_once_materialization(self, partition_id: int = 1) -> None:
