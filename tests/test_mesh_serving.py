@@ -269,6 +269,75 @@ def drive_r4_scenario(h: EngineHarness) -> None:
             h.complete_job(job["key"], None)
 
 
+def scenario_to_dir(directory: str, mesh: bool) -> None:
+    """Run the shared scenario into ``directory`` (journal persists at
+    <directory>/log). Importable from a WORKER SUBPROCESS — the byte-parity
+    oracle's third leg: same commands, same deterministic clock, different
+    process."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    runner = MeshKernelRunner(n_shards=8) if mesh else None
+    h = EngineHarness(directory=directory, use_kernel_backend=True,
+                      mesh_runner=runner)
+    drive_scenario(h)
+    h.close()
+
+
+def persisted_log_bytes(directory) -> list[tuple]:
+    from zeebe_tpu.journal import SegmentedJournal
+    from zeebe_tpu.logstreams import LogStream
+
+    journal = SegmentedJournal(str(directory) + "/log")
+    try:
+        stream = LogStream(journal, 1)
+        return [
+            (v.position, v.record.to_bytes(), v.processed, v.source_position)
+            for v in stream.scan()
+        ]
+    finally:
+        journal.close()
+
+
+@pytest.mark.slow
+class TestWorkerProcessByteParity:
+    def test_solo_vs_coalesced_vs_separate_worker_process(self, tmp_path):
+        """ISSUE 7 satellite: a partition's materialized log is byte-identical
+        whether its wave dispatched solo, coalesced on the shared mesh
+        runner, or ran in a SEPARATE worker process — the determinism
+        contract the multi-process scale-out rests on."""
+        import os
+        import subprocess
+        import sys
+
+        solo_dir, mesh_dir, proc_dir = (tmp_path / n
+                                        for n in ("solo", "mesh", "proc"))
+        scenario_to_dir(str(solo_dir), mesh=False)
+        scenario_to_dir(str(mesh_dir), mesh=True)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (repo, env.get("PYTHONPATH")) if p)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if not f.startswith("--xla_force_host_platform_device_count")]
+        env["XLA_FLAGS"] = " ".join(
+            flags + ["--xla_force_host_platform_device_count=8"])
+        code = (
+            f"import sys; sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+            f"import test_mesh_serving as t\n"
+            f"t.scenario_to_dir({str(proc_dir)!r}, mesh=True)\n")
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True, timeout=560)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+
+        solo = persisted_log_bytes(solo_dir)
+        mesh = persisted_log_bytes(mesh_dir)
+        worker = persisted_log_bytes(proc_dir)
+        assert len(solo) > 20
+        assert mesh == solo, "coalesced mesh dispatch diverged from solo"
+        assert worker == solo, "separate worker process diverged from solo"
+
+
 class TestMeshRound4Shapes:
     def test_mi_and_call_groups_byte_identical_on_mesh(self):
         """The mesh path shards mi_left and the inlined call rows; groups

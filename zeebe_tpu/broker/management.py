@@ -50,6 +50,9 @@ from zeebe_tpu.utils.metrics import REGISTRY
 class ManagementServer:
     def __init__(self, broker, bind: tuple[str, int] = ("127.0.0.1", 0),
                  registry=None, runtime=None) -> None:
+        # broker=None: the gateway-process shape (multiproc workers host the
+        # brokers) — /metrics, /cluster/status, and /health (aggregated from
+        # the runtime) stay up; broker-local endpoints answer 404
         self.broker = broker
         self.registry = registry or REGISTRY
         # hosting ClusterRuntime (optional): enables the /cluster/status
@@ -88,6 +91,40 @@ class ManagementServer:
 
     def _get(self, handler) -> None:
         path = handler.path.split("?", 1)[0]
+        if self.broker is None:
+            # no local broker (gateway process, or a broker-free test
+            # server): /cluster/status, /health, /ready aggregate from the
+            # runtime; broker-independent endpoints (/metrics, /traces,
+            # /profile, and the getattr-guarded observability paths) fall
+            # through to the shared handlers; true broker-local endpoints
+            # answer 404 instead of crashing
+            if path == "/health" and self.runtime is not None:
+                # LIVENESS of the gateway process: always 200 while it can
+                # answer — one crash-looping worker (reported in the payload)
+                # must not get the gateway, and with it the supervisor and
+                # every healthy worker, liveness-probed to death
+                handler._send(200, json.dumps(self.runtime.cluster_status()))
+                return
+            if path == "/ready" and self.runtime is not None:
+                # READINESS aggregates: serving needs a live leader for every
+                # partition (the runtime knows; default to the health roll-up)
+                ready_fn = getattr(self.runtime, "ready", None)
+                status = self.runtime.cluster_status()
+                ready = (bool(ready_fn()) if ready_fn is not None
+                         else status.get("health") in ("HEALTHY", "DEGRADED"))
+                handler._send(200 if ready else 503, json.dumps(
+                    {"ready": ready, **status}))
+                return
+            broker_free = {"/metrics", "/traces", "/profile", "/flight",
+                           "/timeseries", "/alerts", "/profile/continuous"}
+            if self.runtime is not None:
+                # the shared handler below serves it via the runtime fan-out
+                broker_free.add("/cluster/status")
+            if path not in broker_free:
+                handler._send(404, json.dumps(
+                    {"error": "no local broker: "
+                              f"endpoint {path} unavailable"}))
+                return
         if path == "/metrics":
             handler._send(200, self.registry.expose(), "text/plain; version=0.0.4")
         elif path == "/health":
@@ -258,6 +295,11 @@ class ManagementServer:
 
     def _post(self, handler) -> None:
         path = handler.path.split("?", 1)[0]
+        if self.broker is None:
+            handler._send(404, json.dumps(
+                {"error": "gateway-process management: broker-local "
+                          f"endpoint {path} unavailable"}))
+            return
         if path.startswith("/backups/"):
             checkpoint_id = int(path.rsplit("/", 1)[-1])
             accepted = self.broker.trigger_checkpoint(checkpoint_id)

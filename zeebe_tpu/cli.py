@@ -426,6 +426,12 @@ def _register_metrics_scenario() -> None:
             cluster.close()
     ElasticsearchExporter(sink=lambda payload: None)
     import zeebe_tpu.engine.decision  # noqa: F401 — registers the DMN counter
+    # ISSUE 7 families: killable device probe + worker supervision
+    from zeebe_tpu.multiproc.supervisor import WorkerSupervisor
+    from zeebe_tpu.utils import backend_probe
+
+    backend_probe._probe_metric()
+    WorkerSupervisor([])
     from zeebe_tpu.gateway.gateway import _wrap
 
     def Topology(request, context):  # noqa: N802 — rpc-shaped name

@@ -345,6 +345,23 @@ class TcpMessagingService(MessagingService):
             count += 1
         return count
 
+    async def _watch_peer(self, member_id: str, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        """Drain the outbound connection's read side until EOF/error (peers
+        never send on it), then close and evict the writer so stale
+        connections to a restarted peer are detected eagerly."""
+        try:
+            while await reader.read(65536):
+                pass
+        except Exception:  # noqa: BLE001 — any transport error = dead peer
+            pass
+        if self._writers.get(member_id) is writer:
+            self._writers.pop(member_id, None)
+        try:
+            writer.close()
+        except Exception:  # noqa: BLE001 — already broken
+            pass
+
     def send(self, member_id: str, topic: str, payload: Any) -> None:
         if self._loop is None:
             raise RuntimeError("messaging not started")
@@ -353,19 +370,40 @@ class TcpMessagingService(MessagingService):
         )
 
     async def _send(self, member_id: str, topic: str, payload: Any) -> None:
-        try:
-            writer = self._writers.get(member_id)
-            if writer is None or writer.is_closing():
-                if member_id not in self.peers:
+        data = packb({"topic": topic, "sender": self.member_id, "payload": payload})
+        # one reconnect retry: a cached writer to a RESTARTED peer (e.g. a
+        # supervisor-respawned worker) only reveals its death on the first
+        # write — without the retry that first message after every restart
+        # was silently dropped, which a one-shot request path (gateway
+        # submit) cannot absorb the way Raft's retries can
+        for attempt in (0, 1):
+            try:
+                writer = self._writers.get(member_id)
+                if writer is None or writer.is_closing():
+                    if member_id not in self.peers:
+                        return
+                    host, port = self.peers[member_id]
+                    reader, writer = await asyncio.open_connection(
+                        host, port,
+                        ssl=self.tls.client_context() if self.tls else None,
+                    )
+                    self._writers[member_id] = writer
+                    # watch for peer EOF: a cleanly-died peer half-closes the
+                    # socket, which does NOT make write()/drain() raise — the
+                    # frame would vanish into the half-open connection and
+                    # the reconnect retry below would never fire. Evicting
+                    # the writer at EOF makes the NEXT send reconnect.
+                    self._loop.create_task(
+                        self._watch_peer(member_id, reader, writer))
+                writer.write(_FRAME.pack(len(data)) + data)
+                await writer.drain()
+                return
+            except (ConnectionError, OSError):
+                stale = self._writers.pop(member_id, None)
+                if stale is not None:
+                    try:  # release the dead transport's fd now, not at GC
+                        stale.close()
+                    except Exception:  # noqa: BLE001 — already broken
+                        pass
+                if attempt:  # peer really down: drop (Raft retries)
                     return
-                host, port = self.peers[member_id]
-                _, writer = await asyncio.open_connection(
-                    host, port,
-                    ssl=self.tls.client_context() if self.tls else None,
-                )
-                self._writers[member_id] = writer
-            data = packb({"topic": topic, "sender": self.member_id, "payload": payload})
-            writer.write(_FRAME.pack(len(data)) + data)
-            await writer.drain()
-        except (ConnectionError, OSError):
-            self._writers.pop(member_id, None)  # peer down: drop (Raft retries)

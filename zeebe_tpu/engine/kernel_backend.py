@@ -1314,6 +1314,18 @@ class KernelBackend:
     def is_candidate(self, record) -> bool:
         return (record.value_type, int(record.intent)) in _CANDIDATE_COMMANDS
 
+    def note_sequential_head(self, record) -> None:
+        """The processor's batch scan found a non-candidate command at the
+        HEAD of the pending log (a deployment, a message publish, …):
+        ordinary sequential traffic, counted BY KIND so the bench fallback
+        accounting separates it from kernel failures and from admission
+        regressions (ISSUE 7: the bare "head-not-admittable" count hid
+        what actually fell back — and end-of-log probes inflated it)."""
+        self.fallbacks += 1
+        self.fallback_reasons[
+            f"head-sequential:{record.value_type.name}.{record.intent.name}"
+        ] += 1
+
     # -- admission ----------------------------------------------------------
 
     def _admit(self, cmd, instances: dict[int, _Inst],
@@ -2340,7 +2352,10 @@ class KernelBackend:
         # keeps admission O(1) instead of O(group) per command
         admitted_pis: set[int] = set()
         admitted: list[_Admitted] = []
+        head_cmd = None
         for cmd in cmds:
+            if head_cmd is None:
+                head_cmd = cmd
             adm = self._admit(cmd, instances, admitted_pis)
             if adm is None:
                 break
@@ -2352,11 +2367,22 @@ class KernelBackend:
             if len(admitted) >= self.max_group:
                 break
         if not admitted:
+            if head_cmd is None:
+                # the candidate iterator was EMPTY — an end-of-log probe, not
+                # a fallback (ISSUE 7: these probes were counted as
+                # "head-not-admittable" and made mesh_serving p1 report 4
+                # phantom fallbacks per run)
+                return None
             # the head command is not kernel-admittable (deploys, unknown
             # defs, non-default tenants, …): normal sequential traffic, but
-            # counted so BENCH can separate it from real kernel failures
+            # counted — WITH the head's kind — so BENCH separates ordinary
+            # sequential commands (a deployment, a message publish) from a
+            # regression where an admittable kind stopped admitting
             self.fallbacks += 1
-            self.fallback_reasons["head-not-admittable"] += 1
+            rec = head_cmd.record
+            self.fallback_reasons[
+                f"head-not-admittable:{rec.value_type.name}.{rec.intent.name}"
+            ] += 1
             return None
         pg = _PendingGroup(admitted)
         pg.t_admit = _time.perf_counter() - t0

@@ -43,8 +43,12 @@ class ResourceExhaustedError(Exception):
 
 
 class GatewayRuntimeBase:
-    """Shared request plumbing for gateway runtimes (in-process and TCP):
-    the nonce'd request-id sequence, the pending/response correlation table,
+    """Shared request plumbing for gateway runtimes — in-process
+    (:class:`ClusterRuntime`), one-broker-per-process TCP
+    (:class:`~zeebe_tpu.gateway.tcp_runtime.TcpClusterRuntime`), and
+    supervised per-core workers
+    (:class:`~zeebe_tpu.multiproc.runtime.MultiProcClusterRuntime`): the
+    nonce'd request-id sequence, the pending/response correlation table,
     and the partition-selection helpers."""
 
     def _init_jobstreams(self) -> None:

@@ -1,0 +1,370 @@
+"""Gateway-side runtime for the multi-process cluster: route client commands
+to per-core worker processes, aggregate topology and /cluster/status.
+
+Implements the same surface as the in-process ``ClusterRuntime`` and the
+one-broker ``TcpClusterRuntime`` (``submit``, partition selection,
+``topology``, ``cluster_status``, jobs-available), so the gRPC gateway, the
+management server, and ``cli top`` work unchanged — the client cannot tell
+whether partitions live in this interpreter or in worker processes.
+
+Routing: the gateway joins the TCP cluster as a messaging member (it hosts
+no partitions and takes no part in Raft/SWIM). Worker leadership is learned
+from the workers' ``worker-status`` pushes; commands go to the leader over
+``mp-client-command-<partition>`` with the gateway request id on the
+envelope, and responses return over ``mp-gateway-response`` addressed by the
+record's ``request_stream_id`` (the gateway's index in the sorted member
+list — both sides derive it, no handshake). A typed error frame
+(``not-leader`` / ``backpressure`` / ``unavailable``) resolves the request
+immediately instead of letting it time out: ``not-leader`` means the worker
+did NOT append, so the gateway may safely re-route the SAME request id after
+the next status refresh.
+
+Tracing (Dapper discipline, PR 3): the response carries the command's
+position, so the gateway mints its root ``gateway.request`` span with the
+derived trace id ``partition:position`` — the same id the worker-side
+ingress/processing/export spans key on. One trace, two processes, zero
+extra wire fields beyond the request id the record already carries.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from zeebe_tpu.gateway.broker_client import (
+    GatewayRuntimeBase,
+    NoLeaderError,
+    RequestTimeoutError,
+    ResourceExhaustedError,
+)
+from zeebe_tpu.multiproc.worker import (
+    CLIENT_COMMAND_TOPIC,
+    GATEWAY_RESPONSE_TOPIC,
+    JOBS_AVAILABLE_TOPIC,
+    WORKER_STATUS_TOPIC,
+)
+from zeebe_tpu.protocol import Record
+
+#: a worker silent for this long is considered stale for leader routing
+STALE_STATUS_MS = 15_000
+
+
+class MultiProcClusterRuntime(GatewayRuntimeBase):
+    """The gateway's view of a supervised multi-process worker cluster."""
+
+    def __init__(self, node_id: str, workers: dict[str, tuple[str, int]],
+                 partition_count: int, replication_factor: int = 1,
+                 bind: tuple[str, int] | None = None,
+                 supervisor=None, messaging=None,
+                 gateway_members: list[str] | None = None) -> None:
+        self.node_id = node_id
+        self.partition_count = partition_count
+        self.replication_factor = replication_factor
+        self.worker_members = sorted(workers)
+        # stream-id derivation must MATCH the workers' _route_members
+        # (sorted union of broker members and EVERY gateway): with multiple
+        # gateways, pass the same gateway list the workers got via
+        # --gateway, or responses route to the wrong gateway
+        self._members = sorted(
+            set(workers) | set(gateway_members or ()) | {node_id})
+        self._stream_id = self._members.index(node_id)
+        self.supervisor = supervisor
+        if messaging is None:
+            from zeebe_tpu.cluster.messaging import TcpMessagingService
+
+            if bind is None:
+                raise ValueError("bind is required without injected messaging")
+            messaging = TcpMessagingService(node_id, bind, dict(workers))
+        self.messaging = messaging
+        self._owns_messaging = hasattr(messaging, "start")
+        self._init_requests()
+        self._init_jobstreams()
+        # error frames ride the same response table as records; submit()
+        # inspects the type
+        self._worker_status: dict[str, dict] = {}
+        self._status_seen_ms: dict[str, float] = {}
+        messaging.subscribe(GATEWAY_RESPONSE_TOPIC, self._on_worker_response)
+        messaging.subscribe(WORKER_STATUS_TOPIC, self._on_worker_status)
+        messaging.subscribe(JOBS_AVAILABLE_TOPIC, self._on_remote_jobs_available)
+        self._running = False
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._owns_messaging:
+            start = getattr(self.messaging, "start", None)
+            if start is not None and getattr(self.messaging, "_thread", None) is None:
+                start()
+        if self.supervisor is not None:
+            self.supervisor.start()
+        self._running = True
+        poll = getattr(self.messaging, "poll", None)
+        if poll is not None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name=f"mp-gateway-{self.node_id}")
+            self._thread.start()
+        self.job_streams.start()
+
+    def _run(self) -> None:
+        poll = self.messaging.poll
+        while self._running:
+            if poll() == 0:
+                time.sleep(0.001)
+
+    def stop(self) -> None:
+        # robust against a partially-started runtime (boot-failure teardown
+        # path): whatever else breaks, the supervisor MUST be stopped — it
+        # is the only thing that can tear down the detached workers
+        try:
+            self.job_streams.stop()
+        except Exception:  # noqa: BLE001
+            pass
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        try:
+            if self.supervisor is not None:
+                self.supervisor.stop()
+        finally:
+            stop = getattr(self.messaging, "stop", None)
+            if stop is not None:
+                stop()
+
+    def ready(self) -> bool:
+        """Readiness: every partition has a live (non-stale) leader."""
+        return all(self._leader_of(p) is not None
+                   for p in range(1, self.partition_count + 1))
+
+    def await_leaders(self, timeout_s: float = 60.0) -> None:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if all(self._leader_of(p) is not None
+                   for p in range(1, self.partition_count + 1)):
+                return
+            time.sleep(0.05)
+        raise RuntimeError("partition leaders not elected in time")
+
+    # -- worker status ---------------------------------------------------------
+
+    def _on_worker_status(self, sender: str, payload: dict) -> None:
+        status = payload.get("status")
+        if isinstance(status, dict):
+            self._worker_status[sender] = status
+            self._status_seen_ms[sender] = time.time() * 1000.0
+
+    def _on_remote_jobs_available(self, sender: str, payload: dict) -> None:
+        self._on_jobs_available(payload["partitionId"], set(payload["types"]))
+
+    def _leader_of(self, partition_id: int) -> str | None:
+        now_ms = time.time() * 1000.0
+        key = str(partition_id)
+        for member in self.worker_members:
+            status = self._worker_status.get(member)
+            if status is None:
+                continue
+            if now_ms - self._status_seen_ms.get(member, 0.0) > STALE_STATUS_MS:
+                continue  # silent worker: likely dead, don't route to it
+            if status.get("partitions", {}).get(key, {}).get("role") == "leader":
+                return member
+        return None
+
+    # -- topology / status -----------------------------------------------------
+
+    def topology(self) -> dict:
+        brokers = []
+        for member in self.worker_members:
+            status = self._worker_status.get(member)
+            partitions = []
+            if status is not None:
+                partitions = [
+                    {"partitionId": int(pid), "role": info.get("role", "?")}
+                    for pid, info in sorted(
+                        status.get("partitions", {}).items(),
+                        key=lambda kv: int(kv[0]))
+                ]
+            brokers.append({"member": member, "nodeId": member,
+                            "partitions": partitions})
+        return {
+            "clusterSize": len(self.worker_members),
+            "partitionsCount": self.partition_count,
+            "replicationFactor": self.replication_factor,
+            "brokers": brokers,
+        }
+
+    def cluster_status(self) -> dict:
+        """The /cluster/status aggregation, fed by worker status pushes
+        instead of in-process fan-out — same shape as
+        ``broker.management.cluster_status`` plus a ``workers`` supervision
+        section (pids, restarts, liveness)."""
+        order = ["HEALTHY", "DEGRADED", "UNHEALTHY", "DEAD"]
+        rows = []
+        worst = "HEALTHY"
+        now_ms = time.time() * 1000.0
+        for member in self.worker_members:
+            status = self._worker_status.get(member)
+            if status is None:
+                rows.append({"nodeId": member, "health": "DEAD",
+                             "partitions": {}, "stale": True})
+                worst = "DEAD"
+                continue
+            row = dict(status)
+            age = now_ms - self._status_seen_ms.get(member, 0.0)
+            if age > STALE_STATUS_MS:
+                row["stale"] = True
+                worst = "DEAD"
+            health = row.get("health", "HEALTHY")
+            if health in order and order.index(health) > order.index(worst):
+                worst = health
+            rows.append(row)
+        partition_ids = {
+            pid for row in rows for pid in row.get("partitions", {})
+        }
+        out = {
+            "clusterSize": len(rows),
+            "partitionsCount": max(len(partition_ids), self.partition_count),
+            "health": worst,
+            "alertsFiring": sum(r.get("alertsFiring", 0) for r in rows),
+            "appendPerSec": round(sum(
+                r.get("rates", {}).get("appendPerSec", 0.0) for r in rows), 1),
+            "processedPerSec": round(sum(
+                r.get("rates", {}).get("processedPerSec", 0.0)
+                for r in rows), 1),
+            "topology": {"members": {
+                r.get("nodeId", "?"): {"partitions": r.get("partitions", {})}
+                for r in rows
+            }},
+            "brokers": rows,
+        }
+        if self.supervisor is not None:
+            out["workers"] = self.supervisor.status()
+        return out
+
+    def has_activatable_jobs(self, partition_id: int, job_type: str,
+                             tenant_ids: list[str] | None = None) -> bool:
+        # no local state to peek: let the long-poll write a real activation
+        # (an empty JOB_BATCH comes back quickly) — same as the TCP runtime's
+        # remote-leader case
+        return True
+
+    # -- request path ----------------------------------------------------------
+
+    def _on_worker_response(self, sender: str, payload: dict) -> None:
+        request_id = payload.get("requestId")
+        event = self._pending.get(request_id)
+        if event is None:
+            return
+        error = payload.get("error")
+        if error is not None:
+            self._responses[request_id] = dict(error)
+        else:
+            self._responses[request_id] = {
+                "record": Record.from_bytes(payload["record"]),
+                "commandPosition": payload.get("commandPosition", -1),
+            }
+        event.set()
+
+    def submit(self, partition_id: int, record: Record,
+               timeout_s: float = 10.0) -> Record:
+        from zeebe_tpu.observability.tracer import get_tracer
+
+        if not 1 <= partition_id <= self.partition_count:
+            raise NoLeaderError(f"unknown partition {partition_id}")
+        tracer = get_tracer()
+        traced = tracer.enabled
+        t_submit = time.perf_counter() if traced else 0.0
+        request_id, event = self._register_request()
+        rec = record.replace(request_id=request_id,
+                             request_stream_id=self._stream_id)
+        payload = {"record": rec.to_bytes(), "requestId": request_id}
+        deadline = time.time() + timeout_s
+        sent_to: str | None = None
+        resend_slice = 1.0
+        try:
+            while time.time() < deadline:
+                leader = self._leader_of(partition_id)
+                if leader is None:
+                    time.sleep(0.02)
+                    continue
+                if sent_to is None:
+                    sent_to = leader
+                if not event.is_set():
+                    # a restored wakeup (late reply raced a not-leader frame)
+                    # means a response is already waiting — consume it below
+                    # instead of sending a redundant envelope
+                    self.messaging.send(
+                        sent_to, f"{CLIENT_COMMAND_TOPIC}-{partition_id}",
+                        payload)
+                # bounded wait per send, then RESEND with backoff — to the
+                # SAME worker: a send can race a worker restart (the stale
+                # roles looked fresh, the TCP frame died with the old
+                # process), and that member's dedupe map makes the resend
+                # idempotent. Re-ROUTING to a different member is only safe
+                # after its typed not-leader frame ("I did not append") —
+                # a silent timeout may mean the first member DID append, and
+                # another member has no record of it (duplicate append).
+                if not event.wait(
+                        min(max(deadline - time.time(), 0.001), resend_slice)):
+                    if time.time() >= deadline:
+                        raise RequestTimeoutError(
+                            f"partition {partition_id} (worker {sent_to}) "
+                            f"did not respond in {timeout_s}s")
+                    resend_slice = min(resend_slice * 2, 8.0)
+                    continue
+                response = self._responses.pop(request_id, None)
+                if response is None:  # pragma: no cover — resolver raced
+                    raise RequestTimeoutError(
+                        f"partition {partition_id} response lost")
+                if "record" in response:
+                    result: Record = response["record"]
+                    if traced:
+                        self._emit_root_span(
+                            tracer, partition_id, record, result,
+                            response.get("commandPosition", -1),
+                            request_id, sent_to,
+                            time.perf_counter() - t_submit)
+                    return result
+                # typed error frame
+                kind = response.get("type")
+                if kind == "backpressure":
+                    raise ResourceExhaustedError(
+                        response.get("message", "backpressure"))
+                if kind in ("not-leader", "unavailable"):
+                    # the worker did NOT append this request: safe to
+                    # re-route the same request id once fresher status
+                    # arrives
+                    event.clear()
+                    if request_id in self._responses:
+                        # a reply from an earlier resend landed between the
+                        # pop above and the clear — restore the wakeup and
+                        # keep sent_to so the next iteration consumes it
+                        # instead of resending
+                        event.set()
+                    else:
+                        sent_to = None
+                        time.sleep(0.02)
+                    continue
+                raise NoLeaderError(
+                    response.get("message", f"worker error {kind!r}"))
+            raise NoLeaderError(f"no leader for partition {partition_id}")
+        finally:
+            self._pending.pop(request_id, None)
+            self._responses.pop(request_id, None)
+
+    def _emit_root_span(self, tracer, partition_id: int, record: Record,
+                        response: Record, position: int, request_id: int,
+                        worker: str | None, latency: float) -> None:
+        tracer.observe_ack("gateway", latency)
+        if position < 0:
+            return  # worker predates the position-carrying envelope
+        trace_id = f"{partition_id}:{position}"
+        if not tracer.sampled(trace_id):
+            return
+        attrs = {"position": position, "requestId": request_id,
+                 "valueType": record.value_type.name,
+                 "intent": record.intent.name,
+                 "worker": worker or "?"}
+        if response.is_rejection:
+            attrs["rejection"] = response.rejection_type.name
+        tracer.emit(trace_id, "gateway.request", latency, partition_id,
+                    attrs=attrs)

@@ -1,0 +1,320 @@
+"""Worker process: one Broker behind the multi-process gateway.
+
+Reference: a deployed StandaloneBroker instance minus the embedded gateway —
+the broker, its partitions, Raft/SWIM over TCP messaging, and a management
+port. The gateway-facing protocol on top:
+
+- ``mp-client-command-<partition>``: client command ingress. The envelope
+  carries the serialized record plus the gateway request id (trace
+  satellite: the id that annotates lineage roots), and — unlike the
+  raw ``command-api`` topic — replies with a typed ERROR frame on
+  backpressure / not-leader / paused, so the gateway can surface
+  RESOURCE_EXHAUSTED vs retry instead of timing out blind.
+- ``gateway-response``: processing results routed back to the ORIGIN gateway
+  by the record's ``request_stream_id`` (index into the sorted member list,
+  gateways included — the reference does the same with gateway stream ids
+  over atomix messaging). The reply carries the command's position so the
+  gateway can mint its root span with the SAME trace id
+  (``partition:position``) the worker-side spans use.
+- ``worker-status``: periodic (and on-role-change) status push to every
+  gateway: the same per-broker row ``/cluster/status`` aggregates in-process
+  (health, roles, rates, firing alerts) plus worker pid and the partitions'
+  last-recovery records — a supervisor-restarted worker's PR 6 recovery
+  accounting is visible on the gateway's ``/cluster/status`` without an
+  extra HTTP hop.
+- ``jobs-available``: long-poll/stream wakeups forwarded to the gateways.
+
+``WorkerRuntime`` is messaging-injectable (tests drive a gateway runtime and
+a worker over the deterministic loopback network in one process); ``main()``
+is the real process entry (``python -m zeebe_tpu.multiproc.worker``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from zeebe_tpu.protocol import Record
+
+CLIENT_COMMAND_TOPIC = "mp-client-command"  # + "-<partition id>"
+GATEWAY_RESPONSE_TOPIC = "mp-gateway-response"
+WORKER_STATUS_TOPIC = "mp-worker-status"
+JOBS_AVAILABLE_TOPIC = "mp-jobs-available"
+
+#: bound on the request-id → command-position map (responses normally pop
+#: their entry; a request whose gateway timed out never will — the oldest
+#: entries are evicted past this, keeping dedupe live for recent traffic)
+_MAX_INFLIGHT = 65536
+
+
+class WorkerRuntime:
+    """One broker + the gateway-facing protocol, pump-driven."""
+
+    def __init__(self, node_id: str, messaging, gateway_members: list[str],
+                 cfg, directory=None, status_interval_ms: int = 1000,
+                 **broker_kwargs) -> None:
+        from zeebe_tpu.broker import Broker
+
+        self.node_id = node_id
+        self.messaging = messaging
+        self.gateway_members = list(gateway_members)
+        # response routing table: request_stream_id indexes this list — the
+        # gateway computes the SAME sorted union, so indices agree without a
+        # handshake
+        self._route_members = sorted(
+            set(cfg.cluster_members) | set(gateway_members))
+        self.broker = Broker(
+            cfg, messaging, directory=directory,
+            response_sink=self._on_processing_response, **broker_kwargs)
+        self.broker.jobs_listener = self._on_jobs_available
+        # idempotent ingress for a LIVE worker: the gateway RESENDS an
+        # unanswered envelope (e.g. its first send raced this worker's
+        # restart); appending it twice would duplicate the command, so
+        # remember what was appended (in flight) and replay the reply for
+        # what was already answered. Keys are (gateway, request id) — two
+        # gateways booted in the same millisecond derive the same request-id
+        # nonce, and a bare-id collision would drop one's command or replay
+        # the other's reply to it. Both maps are bounded LRU (a request
+        # whose gateway timed out never gets a response and would leak its
+        # in-flight entry forever — evicting the OLDEST keeps dedupe live
+        # for everything recent instead of silently turning off at a cap).
+        # In-memory: a crash BETWEEN append and reply loses them, and a
+        # gateway resend to the restarted worker can duplicate that command
+        # — the same at-most-once caveat the TCP runtime documents;
+        # exactly-once would need the dedupe table in the replicated log.
+        from collections import OrderedDict
+
+        self._inflight_positions: OrderedDict[tuple, int] = OrderedDict()
+        self._recent_replies: OrderedDict[tuple, dict] = OrderedDict()
+        self._status_interval_ms = status_interval_ms
+        self._last_status_ms = 0
+        self._last_roles: dict[str, str] = {}
+        for pid in range(1, cfg.partition_count + 1):
+            messaging.subscribe(
+                f"{CLIENT_COMMAND_TOPIC}-{pid}",
+                lambda s, p, pid=pid: self._on_client_command(pid, s, p))
+
+    # -- command ingress -------------------------------------------------------
+
+    def _reply_error(self, gateway: str, request_id: int, kind: str,
+                     message: str) -> None:
+        self.messaging.send(gateway, GATEWAY_RESPONSE_TOPIC, {
+            "requestId": request_id,
+            "error": {"type": kind, "message": message},
+        })
+
+    def _on_client_command(self, partition_id: int, sender: str,
+                           payload: dict) -> None:
+        from zeebe_tpu.broker.partition import BackpressureExceeded
+        from zeebe_tpu.observability.tracer import get_tracer
+
+        record = Record.from_bytes(payload["record"])
+        request_id = payload.get("requestId", record.request_id)
+        dedupe_key = (sender, request_id)
+        if dedupe_key in self._inflight_positions:
+            return  # duplicate resend: already appended, reply is coming
+        replay = self._recent_replies.get(dedupe_key)
+        if replay is not None:
+            self.messaging.send(sender, GATEWAY_RESPONSE_TOPIC, replay)
+            return  # duplicate resend of an already-answered request
+        partition = self.broker.partitions.get(partition_id)
+        if partition is None or not partition.is_leader:
+            # the worker did NOT append: the gateway may safely re-route
+            self._reply_error(sender, request_id, "not-leader",
+                              f"{self.node_id} does not lead partition "
+                              f"{partition_id}")
+            return
+        try:
+            position = partition.client_write(record)
+        except BackpressureExceeded as exc:
+            self._reply_error(sender, request_id, "backpressure", str(exc))
+            return
+        if position is None:
+            self._reply_error(sender, request_id, "unavailable",
+                              f"partition {partition_id} paused or disk-paused")
+            return
+        self._inflight_positions[dedupe_key] = position
+        while len(self._inflight_positions) > _MAX_INFLIGHT:
+            self._inflight_positions.popitem(last=False)
+        tracer = get_tracer()
+        if tracer.enabled:
+            # cross-process Dapper discipline: the trace id is DERIVED
+            # (partition:position), identical on both sides of the process
+            # boundary; this span records where the command crossed it
+            trace_id = f"{partition_id}:{position}"
+            if tracer.sampled(trace_id):
+                tracer.emit(trace_id, "gateway.ingress", 0.0, partition_id,
+                            attrs={"requestId": request_id,
+                                   "gateway": sender,
+                                   "worker": self.node_id,
+                                   "workerPid": os.getpid()})
+
+    def _on_processing_response(self, response) -> None:
+        origin = response.request_stream_id
+        if not 0 <= origin < len(self._route_members):
+            return
+        target = self._route_members[origin]
+        if target == self.node_id:
+            return  # workers never originate client requests
+        dedupe_key = (target, response.request_id)
+        payload = {
+            "requestId": response.request_id,
+            "record": response.record.to_bytes(),
+            "commandPosition": self._inflight_positions.pop(dedupe_key, -1),
+        }
+        self._recent_replies[dedupe_key] = payload
+        while len(self._recent_replies) > 4096:
+            self._recent_replies.popitem(last=False)
+        self.messaging.send(target, GATEWAY_RESPONSE_TOPIC, payload)
+
+    # -- jobs available --------------------------------------------------------
+
+    def _on_jobs_available(self, partition_id: int, job_types: set) -> None:
+        payload = {"partitionId": partition_id, "types": sorted(job_types)}
+        for gateway in self.gateway_members:
+            self.messaging.send(gateway, JOBS_AVAILABLE_TOPIC, payload)
+
+    # -- status push -----------------------------------------------------------
+
+    def _roles(self) -> dict[str, str]:
+        return {str(pid): ("leader" if p.is_leader else "follower")
+                for pid, p in self.broker.partitions.items()}
+
+    def send_status(self) -> None:
+        from zeebe_tpu.broker.management import broker_status
+
+        status = broker_status(self.broker)
+        status["workerPid"] = os.getpid()
+        recoveries = {
+            str(pid): p.last_recovery
+            for pid, p in self.broker.partitions.items()
+            if getattr(p, "last_recovery", None) is not None
+        }
+        if recoveries:
+            # PR 6 recovery accounting crosses the process boundary with the
+            # status row: /cluster/status answers "what did the restart cost"
+            status["recoveries"] = recoveries
+        for gateway in self.gateway_members:
+            self.messaging.send(gateway, WORKER_STATUS_TOPIC,
+                                {"status": status})
+
+    def maybe_send_status(self) -> None:
+        now = self.broker.clock_millis()
+        roles = self._roles()
+        if (roles != self._last_roles
+                or now - self._last_status_ms >= self._status_interval_ms):
+            self._last_roles = roles
+            self._last_status_ms = now
+            self.send_status()
+
+    # -- pump ------------------------------------------------------------------
+
+    def pump(self) -> int:
+        moved = 0
+        poll = getattr(self.messaging, "poll", None)
+        if poll is not None:
+            moved += poll()
+        moved += self.broker.pump()
+        self.maybe_send_status()
+        return moved
+
+    def close(self) -> None:
+        self.broker.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Process entry: ``python -m zeebe_tpu.multiproc.worker ...`` (normally
+    spawned by :class:`zeebe_tpu.multiproc.supervisor.WorkerSupervisor`)."""
+    import argparse
+    import signal
+
+    from zeebe_tpu.utils.zlogging import configure_logging
+
+    configure_logging()
+    parser = argparse.ArgumentParser(prog="zeebe-tpu-worker")
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--bind", required=True, help="host:port for TCP "
+                        "cluster messaging")
+    parser.add_argument("--contact", required=True,
+                        help="comma-separated member=host:port for EVERY "
+                             "member (workers AND gateways)")
+    parser.add_argument("--gateway", required=True,
+                        help="comma-separated gateway member ids (subset of "
+                             "--contact)")
+    parser.add_argument("--partitions", type=int, default=1)
+    parser.add_argument("--replication", type=int, default=1)
+    parser.add_argument("--data-dir", default=None)
+    parser.add_argument("--management-port", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    # startup device probe (killable, SIGKILL on wedge): a wedged TPU tunnel
+    # must degrade this worker to host devices, never hang its boot
+    from zeebe_tpu.utils.backend_probe import pin_cpu_if_unreachable
+
+    diag = pin_cpu_if_unreachable()
+    if diag.get("outcome") != "env-pinned-cpu":
+        print(f"[{args.node_id}] device probe: {diag}", file=sys.stderr,
+              flush=True)
+
+    from zeebe_tpu.utils.xla_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    from zeebe_tpu.backup import backup_store_from_env
+    from zeebe_tpu.broker.config import load_broker_cfg
+    from zeebe_tpu.cluster.messaging import TcpMessagingService
+    from zeebe_tpu.standalone import _parse_contacts
+    from zeebe_tpu.utils.external_code import exporters_factory_from_env
+
+    contacts = _parse_contacts(args.contact)
+    gateways = [g.strip() for g in args.gateway.split(",") if g.strip()]
+    broker_members = sorted(m for m in contacts if m not in gateways)
+    host, port = args.bind.rsplit(":", 1)
+    peers = {m: a for m, a in contacts.items() if m != args.node_id}
+    messaging = TcpMessagingService(args.node_id, (host, int(port)), peers)
+    messaging.start()
+
+    ext = load_broker_cfg(overrides={
+        "base.node_id": args.node_id,
+        "base.partition_count": args.partitions,
+        "base.replication_factor": args.replication,
+        "base.cluster_members": broker_members,
+    })
+    runtime = WorkerRuntime(
+        args.node_id, messaging, gateways, ext.base,
+        directory=args.data_dir,
+        exporters_factory=exporters_factory_from_env(),
+        backup_store=backup_store_from_env(),
+        backpressure_algorithm=ext.backpressure.algorithm,
+        backpressure_enabled=ext.backpressure.enabled,
+        disk_min_free_bytes=(ext.disk.min_free_bytes
+                             if ext.disk.enable_monitoring and args.data_dir
+                             else 0),
+    )
+    management = None
+    if args.management_port:
+        from zeebe_tpu.broker.management import ManagementServer
+
+        management = ManagementServer(
+            runtime.broker, bind=("0.0.0.0", args.management_port))
+        management.start()
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    print(f"[{args.node_id}] worker up: partitions<={args.partitions} "
+          f"bind {args.bind} pid {os.getpid()}", file=sys.stderr, flush=True)
+    while not stop.is_set():
+        if runtime.pump() == 0:
+            time.sleep(0.001)
+    if management is not None:
+        management.stop()
+    runtime.close()
+    messaging.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

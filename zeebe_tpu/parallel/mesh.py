@@ -37,8 +37,34 @@ def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
                       check_rep=check_vma)
 
 
+#: the mesh's single axis: partitions = shards of the batch axis
+#: (SNIPPETS.md [1]: ``NamedSharding(mesh, PartitionSpec("batch"))``)
+BATCH_AXIS = "batch"
+
+
+def resolve_mesh_devices() -> list:
+    """Device list for mesh construction — WITHOUT an unguarded in-process
+    ``jax.devices()``: on this host class a wedged TPU tunnel hangs the
+    default-backend query forever, and mesh construction runs on broker
+    startup paths that must never block. When the platform is already
+    pinned to cpu (tests, bench after its probe, drive scripts) the
+    in-process query is safe; otherwise the default backend is probed in a
+    killable subprocess (``utils/backend_probe``) and a wedged/failed probe
+    DEGRADES to host devices — the broker keeps serving on the CPU mesh and
+    the ``zeebe_device_probe_total{outcome="probe-killed"}`` counter carries
+    the evidence."""
+    if str(jax.config.jax_platforms or "").startswith("cpu"):
+        return jax.devices()
+    from zeebe_tpu.utils.backend_probe import pin_cpu_if_unreachable
+
+    # probe (memoized per process), pin cpu on wedge/no-accelerator — the
+    # shared rule lives in backend_probe; host devices are the degrade path
+    pin_cpu_if_unreachable()
+    return jax.devices()
+
+
 def make_mesh(n_devices: int | None = None) -> Mesh:
-    devices = jax.devices()
+    devices = resolve_mesh_devices()
     if n_devices is not None:
         if len(devices) < n_devices:
             # truncating silently would mismatch callers' shard-block state
@@ -48,7 +74,7 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
                 "devices are available"
             )
         devices = devices[:n_devices]
-    return Mesh(np.array(devices), ("data",))
+    return Mesh(np.array(devices), (BATCH_AXIS,))
 
 
 _SHARDED_KEYS = ("elem", "phase", "inst", "def_of", "var_slots", "join_counts",
@@ -57,7 +83,7 @@ _REPLICATED_KEYS = ("transitions", "jobs_created", "completed", "overflow")
 
 
 def state_specs() -> dict:
-    specs = {k: P("data") for k in _SHARDED_KEYS}
+    specs = {k: P(BATCH_AXIS) for k in _SHARDED_KEYS}
     specs.update({k: P() for k in _REPLICATED_KEYS})
     return specs
 
@@ -85,8 +111,9 @@ def make_sharded_step(mesh: Mesh, auto_jobs: bool = True, config=None):
         # counters: psum the per-shard delta so the replicated value stays global
         for key in ("transitions", "jobs_created", "completed"):
             delta = new_state[key] - state[key]
-            new_state[key] = state[key] + jax.lax.psum(delta, "data")
-        overflow_any = jax.lax.psum(new_state["overflow"].astype(jax.numpy.int32), "data") > 0
+            new_state[key] = state[key] + jax.lax.psum(delta, BATCH_AXIS)
+        overflow_any = jax.lax.psum(
+            new_state["overflow"].astype(jax.numpy.int32), BATCH_AXIS) > 0
         new_state["overflow"] = overflow_any
         return new_state
 

@@ -34,7 +34,12 @@ from typing import Any
 
 import numpy as np
 
-from zeebe_tpu.parallel.mesh import make_mesh, shard_map_compat, state_specs
+from zeebe_tpu.parallel.mesh import (
+    BATCH_AXIS,
+    make_mesh,
+    shard_map_compat,
+    state_specs,
+)
 
 
 @dataclass
@@ -172,7 +177,7 @@ class MeshKernelRunner:
         def put(name, value):
             return jax.device_put(value, NamedSharding(mesh, specs[name]))
 
-        row = NamedSharding(mesh, P("data"))
+        row = NamedSharding(mesh, P(BATCH_AXIS))
         state = {
             "elem": put("elem", elem),
             "phase": put("phase", phase),
@@ -235,10 +240,11 @@ class MeshKernelRunner:
             from zeebe_tpu.ops.automaton import DeviceTables, run_collect
 
             specs = state_specs()
-            # per-shard scalar tails ride as length-S rows sharded on "data"
+            # per-shard scalar tails ride as length-S rows sharded on the
+            # batch axis
             local_specs = dict(specs)
             for name in ("transitions", "jobs_created", "completed", "overflow"):
-                local_specs[name] = P("data")
+                local_specs[name] = P(BATCH_AXIS)
 
             def local(dt, state):
                 # shard-local view: scalar counters for the kernel body
@@ -262,7 +268,7 @@ class MeshKernelRunner:
                     }),
                     local_specs,
                 ),
-                out_specs=(local_specs, P(None, "data")),
+                out_specs=(local_specs, P(None, BATCH_AXIS)),
                 check_vma=False,
             ))
             self._collect_cache[key] = fn

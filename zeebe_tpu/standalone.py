@@ -14,6 +14,13 @@ Two deployment shapes:
        --bind 127.0.0.1:26601 \
        --contact broker-0=127.0.0.1:26601,broker-1=127.0.0.1:26602,... \
        --partitions 3 --replication 3 --port 26500 --data-dir /data/b0``
+
+- supervised per-core workers (ISSUE 7 scale-out shape): this process runs
+  ONLY the gateway; a supervisor spawns one broker worker process per core
+  (``zeebe_tpu/multiproc/``), partitions distribute round-robin over them,
+  and crash-restarted workers recover via snapshots+replay.
+  ``python -m zeebe_tpu.standalone --workers 8 --partitions 8 \
+       --port 26500 --data-dir /data --management-port 9600``
 """
 
 from __future__ import annotations
@@ -57,6 +64,143 @@ def _parse_contacts(spec: str) -> dict[str, tuple[str, int]]:
     return out
 
 
+def _free_ports(n: int) -> list[int]:
+    """n distinct OS-assigned loopback ports (bound briefly, then released).
+
+    Bind-then-release is racy by construction: another process can claim a
+    port in the gap, which surfaces as the worker crash-looping on bind (see
+    its worker.log) and boot failing at await_leaders. Acceptable for the
+    single-operator single-host shape this mode targets; fixed ports via a
+    real config are the answer when two clusters share a host."""
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _run_workers_mode(args) -> int:
+    """``--workers N``: this process hosts ONLY the gateway (+ management);
+    N broker worker processes are spawned and supervised, one per core
+    (zeebe_tpu/multiproc/). Partitions distribute round-robin over the
+    workers via the standard distribution; the client-visible surface
+    (gRPC API, topology, /cluster/status) is unchanged."""
+    from pathlib import Path
+
+    from zeebe_tpu.gateway import Gateway
+    from zeebe_tpu.multiproc import (
+        MultiProcClusterRuntime,
+        WorkerSpec,
+        WorkerSupervisor,
+    )
+    from zeebe_tpu.multiproc.supervisor import worker_cmd
+    from zeebe_tpu.utils.external_code import gateway_interceptors_from_env
+
+    gateway_member = "gateway-0"
+    worker_names = [f"worker-{i}" for i in range(args.workers)]
+    ports = _free_ports(args.workers + 1)
+    contacts = {m: ("127.0.0.1", p) for m, p in zip(worker_names, ports)}
+    contacts[gateway_member] = ("127.0.0.1", ports[-1])
+    contact_str = ",".join(
+        f"{m}={h}:{p}" for m, (h, p) in sorted(contacts.items()))
+    specs = []
+    for name in worker_names:
+        data_dir = (str(Path(args.data_dir) / name)
+                    if args.data_dir else None)
+        specs.append(WorkerSpec(
+            node_id=name,
+            cmd=worker_cmd(
+                name, f"127.0.0.1:{contacts[name][1]}", contact_str,
+                gateway_member, args.partitions, args.replication,
+                data_dir=data_dir),
+            data_dir=data_dir,
+        ))
+    supervisor = WorkerSupervisor(specs)
+    runtime = MultiProcClusterRuntime(
+        gateway_member,
+        {m: a for m, a in contacts.items() if m != gateway_member},
+        partition_count=args.partitions,
+        replication_factor=args.replication,
+        bind=contacts[gateway_member],
+        supervisor=supervisor,
+    )
+    # signal handlers BEFORE anything spawns: a SIGTERM during the (long —
+    # probe deadline + jax import) boot window must run the teardown below,
+    # not the default action that would orphan the detached workers
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    gateway = None
+    management = None
+    try:
+        # runtime.start() spawns the workers (via the supervisor) — it must
+        # sit INSIDE the teardown scope: a thread-start failure after the
+        # spawn would otherwise orphan the detached worker processes
+        runtime.start()
+        # worker boot pays the killable device probe BEFORE binding
+        # messaging (up to ZEEBE_PROBE_TIMEOUT_S on a wedged host), then
+        # jax import + broker recovery: budget for all of it, in short
+        # slices so a stop signal interrupts the wait
+        import time as _time
+
+        from zeebe_tpu.utils.backend_probe import probe_timeout_secs
+
+        boot_deadline = _time.monotonic() + probe_timeout_secs() + 120.0
+        while not stop.is_set():
+            try:
+                runtime.await_leaders(timeout_s=2.0)
+                break
+            except RuntimeError:
+                if _time.monotonic() >= boot_deadline:
+                    raise
+        if stop.is_set():
+            raise SystemExit(143)  # stopped during boot: teardown below
+        gateway = Gateway(runtime, bind=f"0.0.0.0:{args.port}",
+                          oauth=_gateway_oauth(),
+                          extra_interceptors=gateway_interceptors_from_env())
+        gateway.start()
+        print(f"gateway listening on {gateway.address} "
+              f"({args.workers} worker process(es), {args.partitions} "
+              f"partition(s))", file=sys.stderr, flush=True)
+        if args.management_port:
+            from zeebe_tpu.broker.management import ManagementServer
+
+            management = ManagementServer(
+                None, bind=("0.0.0.0", args.management_port), runtime=runtime)
+            management.start()
+            print(f"management on :{management.port}", file=sys.stderr,
+                  flush=True)
+    except BaseException:
+        # ANY boot failure (leader timeout, gateway/management port in use)
+        # must tear the supervisor down: the workers are detached processes
+        # (start_new_session) and would otherwise outlive the failed boot
+        if management is not None:
+            management.stop()
+        if gateway is not None:
+            gateway.stop()
+        runtime.stop()  # stops the supervisor (SIGTERM→SIGKILL) too
+        raise
+    stop.wait()
+    # shutdown must reach runtime.stop() even if a front-end stop raises:
+    # the workers are detached processes and only the supervisor (stopped
+    # by runtime.stop) can tear them down
+    try:
+        if management is not None:
+            management.stop()
+    finally:
+        try:
+            gateway.stop()
+        finally:
+            runtime.stop()
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     from zeebe_tpu.utils.zlogging import configure_logging
 
@@ -71,6 +215,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--data-dir", default=None)
     parser.add_argument("--management-port", type=int, default=0,
                         help="health/metrics/admin HTTP port (0 = disabled)")
+    parser.add_argument("--workers", type=int, default=0,
+                        help="spawn N supervised broker worker processes "
+                             "(one per core) behind this gateway process "
+                             "(0 = host brokers in-process)")
     parser.add_argument("--node-id", default=None,
                         help="this broker's member id (enables the "
                              "multi-process TCP cluster mode)")
@@ -86,6 +234,9 @@ def main(argv: list[str] | None = None) -> int:
     enable_persistent_cache()
     from zeebe_tpu.broker.config import load_broker_cfg
     from zeebe_tpu.gateway import ClusterRuntime, Gateway
+
+    if args.workers > 0:
+        return _run_workers_mode(args)
 
     if args.node_id is not None:
         if not args.bind or not args.contact:

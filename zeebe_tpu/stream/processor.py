@@ -391,6 +391,7 @@ class StreamProcessor:
         """Lazily yield pending commands in log order, stopping at the first
         the kernel backend cannot be a candidate for. Does not consume."""
         position = self._reader_position
+        first = True
         while True:
             logged, self._scan_hint, _ = self.log_stream.next_command_with_hint(
                 position, self._scan_hint
@@ -401,7 +402,12 @@ class StreamProcessor:
             if not (logged.record.is_command and not logged.processed):
                 continue
             if not self.kernel_backend.is_candidate(logged.record):
+                if first:
+                    # precise fallback accounting: a sequential HEAD is named
+                    # by kind; an empty scan (end of log) counts nothing
+                    self.kernel_backend.note_sequential_head(logged.record)
                 return
+            first = False
             yield logged
 
     def process_available_batch(self) -> int:

@@ -3,34 +3,115 @@
 On this host the TPU tunnel can hang *forever* at first device use
 (``jax.devices()`` never returns), so no driver may initialize the default
 backend in-process before knowing it answers. The probe runs the device query
-in a subprocess with a timeout — the one place the hazard is handled, so
-``bench.py`` and ``__graft_entry__.py`` cannot drift apart on timeout or
-interpretation (they did in round 2: the dryrun had no probe at all and
-recorded rc=124).
+in a subprocess with a hard deadline — SIGKILL on wedge, never a blocking
+``wait()`` on an unanswering child — so the one place the hazard is handled
+cannot itself hang. ``bench.py``, ``__graft_entry__.py``, broker startup, and
+mesh construction all delegate here (they drifted apart in round 2: the
+dryrun had no probe at all and recorded rc=124).
+
+A wedged probe is a *verdict*, not a hang: the diagnostics record
+``outcome: "probe-killed"`` with the deadline and the kill evidence, callers
+pin the CPU platform and keep serving on host devices, and the
+``zeebe_device_probe_total{outcome}`` counter makes the degradation visible
+on the metrics plane.
 """
 
 from __future__ import annotations
 
 import os
+import signal
 import subprocess
 import sys
 import time
 
-#: one shared timeout so all drivers agree on whether the backend is up
-PROBE_TIMEOUT_SECS = 240
+#: one shared timeout so all drivers agree on whether the backend is up.
+#: 90s covers a cold TPU runtime handshake with slack; the historical 240s
+#: default meant three retries burned 12+ minutes before the fallback —
+#: BENCH.json recorded exactly that (three 240s hangs in probe_attempts).
+#: Override per-host with ZEEBE_PROBE_TIMEOUT_S.
+PROBE_TIMEOUT_SECS = 90
+
+
+def probe_timeout_secs() -> int:
+    """The effective probe deadline: ``ZEEBE_PROBE_TIMEOUT_S`` when set and
+    parseable, else :data:`PROBE_TIMEOUT_SECS`."""
+    raw = os.environ.get("ZEEBE_PROBE_TIMEOUT_S")
+    if raw:
+        try:
+            value = int(float(raw))
+            if value > 0:
+                return value
+        except ValueError:
+            pass
+    return PROBE_TIMEOUT_SECS
+
+
+_PROBE_CODE = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+
+#: per-process probe memo keyed by the child command: broker startup, worker
+#: boot, and mesh construction ALL consult the probe, and each subprocess
+#: pays a jax import + device-runtime handshake (up to the full deadline on
+#: a wedged host) — one verdict per process is the intended granularity.
+#: ``probe_with_retries`` bypasses cache READS so retries really re-probe.
+_PROBE_CACHE: dict[tuple, tuple] = {}
+
+
+def _probe_metric():
+    """``zeebe_device_probe_total{outcome}`` — lazily resolved so importing
+    this module never pulls the metrics registry into probe *subprocesses*
+    (they re-import the package) for nothing."""
+    from zeebe_tpu.utils.metrics import REGISTRY
+
+    return REGISTRY.counter(
+        "device_probe_total",
+        "killable default-backend probes by outcome (ok / probe-killed / "
+        "nonzero-exit / unparseable-stdout / env-pinned-cpu)",
+        ("outcome",))
+
+
+def _run_killable(cmd: list[str], timeout: int, cwd: str | None) -> tuple:
+    """Run ``cmd`` with a HARD deadline: SIGKILL the child the moment the
+    deadline passes (``subprocess.run``'s TimeoutExpired path first closes
+    pipes and *waits*, which a truly wedged device runtime can outlive).
+    Returns (rc | None, stdout, stderr, killed)."""
+    proc = subprocess.Popen(
+        cmd, cwd=cwd, env=dict(os.environ), text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        start_new_session=True,  # kill the child's whole session: the TPU
+        # runtime forks helpers that would otherwise inherit the wedge
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+        return proc.returncode, stdout, stderr, False
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            proc.kill()
+        try:
+            stdout, stderr = proc.communicate(timeout=5)
+        except subprocess.TimeoutExpired:  # pragma: no cover — kernel-stuck
+            stdout, stderr = "", ""
+        return None, stdout, stderr, True
 
 
 def probe_with_diagnostics(
-    cwd: str | None = None, timeout: int = PROBE_TIMEOUT_SECS
+    cwd: str | None = None, timeout: int | None = None,
+    probe_cmd: list[str] | None = None, use_cache: bool = True,
 ) -> tuple[tuple[str, int] | None, dict]:
     """((platform, device_count) | None, diagnostics) of the default backend.
 
     THE probe implementation — every other entry point delegates here.
-    None means the backend did not come up inside ``timeout`` (wedged
-    tunnel) or the probe subprocess failed — callers must pin the CPU
-    platform before their first in-process backend use. The diagnostics
-    dict carries the failure evidence (rc, stderr tail, elapsed) so bench
-    runs can record WHY the tunnel was unreachable, not just that it was.
+    None means the backend did not come up inside the deadline (wedged
+    tunnel — the child is SIGKILLed, outcome ``probe-killed``) or the probe
+    subprocess failed — callers must pin the CPU platform before their first
+    in-process backend use. The diagnostics dict carries the failure
+    evidence (rc, stderr tail, elapsed, killed) so bench runs can record WHY
+    the tunnel was unreachable, not just that it was.
+
+    ``probe_cmd`` injects the child command (tests simulate a wedged tunnel
+    with a subprocess that never answers and assert it is killed at the
+    deadline); default is the one-line jax device query.
 
     A ``("cpu", n)`` result may reflect ``JAX_PLATFORMS=cpu`` /
     ``--xla_force_host_platform_device_count`` in the inherited env — that
@@ -39,7 +120,16 @@ def probe_with_diagnostics(
     ``jax.config.update('jax_platforms', 'cpu')`` truly pins it). Callers
     that need *real* chips must check the platform, not just the count.
     """
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    if timeout is None:
+        timeout = probe_timeout_secs()
+    if probe_cmd is None and os.environ.get("ZEEBE_PROBE_CMD"):
+        # test/chaos seam: simulate a wedged tunnel from OUTSIDE the process
+        # (e.g. a subprocess that never answers) without touching call sites
+        import shlex
+
+        probe_cmd = shlex.split(os.environ["ZEEBE_PROBE_CMD"])
+    if (probe_cmd is None
+            and os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"):
         flags = os.environ.get("XLA_FLAGS", "")
         count = 1
         for flag in flags.split():
@@ -48,44 +138,55 @@ def probe_with_diagnostics(
                     count = int(flag.split("=", 1)[1])
                 except ValueError:
                     pass
+        _probe_metric().labels("env-pinned-cpu").inc()
         return ("cpu", count), {"outcome": "env-pinned-cpu"}
-    code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+    cmd = probe_cmd or [sys.executable, "-c", _PROBE_CODE]
+    cache_key = tuple(cmd)
+    if use_cache and cache_key in _PROBE_CACHE:
+        cached_res, cached_diag = _PROBE_CACHE[cache_key]
+        return cached_res, dict(cached_diag, cached=True)
     t0 = time.monotonic()
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            timeout=timeout, capture_output=True, text=True,
-            cwd=cwd, env=dict(os.environ),
-        )
-    except subprocess.TimeoutExpired as exc:
-        stderr = exc.stderr or b""
-        if isinstance(stderr, bytes):
-            stderr = stderr.decode("utf-8", "replace")
-        return None, {
-            "outcome": "timeout",
+    rc, stdout, stderr, killed = _run_killable(cmd, timeout, cwd)
+    elapsed = round(time.monotonic() - t0, 1)
+    if killed:
+        _probe_metric().labels("probe-killed").inc()
+        diag = {
+            # the clean verdict the MULTICHIP record needs: the child was
+            # killed AT its deadline, the caller keeps running on host devices
+            "outcome": "probe-killed",
             "timeout_s": timeout,
-            "elapsed_s": round(time.monotonic() - t0, 1),
-            "stderr_tail": stderr[-800:],
+            "elapsed_s": elapsed,
+            "killed": True,
+            "stderr_tail": (stderr or "")[-800:],
         }
+        _PROBE_CACHE[cache_key] = (None, dict(diag))
+        return None, diag
     diag = {
-        "outcome": "ok" if proc.returncode == 0 else "nonzero-exit",
-        "rc": proc.returncode,
-        "elapsed_s": round(time.monotonic() - t0, 1),
-        "stderr_tail": (proc.stderr or "")[-800:],
+        "outcome": "ok" if rc == 0 else "nonzero-exit",
+        "rc": rc,
+        "elapsed_s": elapsed,
+        "stderr_tail": (stderr or "")[-800:],
     }
-    if proc.returncode != 0:
+    if rc != 0:
+        _probe_metric().labels("nonzero-exit").inc()
+        _PROBE_CACHE[cache_key] = (None, dict(diag))
         return None, diag
     try:
-        platform, count = proc.stdout.split()[-2:]
-        return (platform, int(count)), diag
+        platform, count = (stdout or "").split()[-2:]
+        result = (platform, int(count))
     except (ValueError, IndexError):
         diag["outcome"] = "unparseable-stdout"
-        diag["stdout_tail"] = (proc.stdout or "")[-200:]
+        diag["stdout_tail"] = (stdout or "")[-200:]
+        _probe_metric().labels("unparseable-stdout").inc()
+        _PROBE_CACHE[cache_key] = (None, dict(diag))
         return None, diag
+    _probe_metric().labels("ok").inc()
+    _PROBE_CACHE[cache_key] = (result, dict(diag))
+    return result, diag
 
 
 def probe_default_backend(
-    cwd: str | None = None, timeout: int = PROBE_TIMEOUT_SECS
+    cwd: str | None = None, timeout: int | None = None
 ) -> tuple[str, int] | None:
     """(platform, device_count) of the default jax backend, or None."""
     return probe_with_diagnostics(cwd, timeout)[0]
@@ -94,7 +195,7 @@ def probe_default_backend(
 def probe_with_retries(
     attempts: int = 3,
     backoff_s: float = 20.0,
-    timeout: int = PROBE_TIMEOUT_SECS,
+    timeout: int | None = None,
     log: list | None = None,
     cwd: str | None = None,
 ) -> tuple[str, int] | None:
@@ -102,7 +203,9 @@ def probe_with_retries(
     item 1). Each attempt's diagnostics are appended to ``log``. Returns the
     first successful (platform, device_count), else None after ``attempts``."""
     for i in range(attempts):
-        res, diag = probe_with_diagnostics(cwd, timeout)
+        # bypass cache READS: a retry that returned the memoized failure
+        # would never actually re-probe the flaky tunnel
+        res, diag = probe_with_diagnostics(cwd, timeout, use_cache=False)
         diag["attempt"] = i + 1
         if log is not None:
             log.append(diag)
@@ -114,9 +217,28 @@ def probe_with_retries(
 
 
 def real_device_count(cwd: str | None = None,
-                      timeout: int = PROBE_TIMEOUT_SECS) -> int:
+                      timeout: int | None = None) -> int:
     """Number of real (non-CPU) devices, or 0 if none/unreachable."""
     res = probe_default_backend(cwd, timeout)
     if res is None or res[0] == "cpu":
         return 0
     return res[1]
+
+
+def pin_cpu_if_unreachable(timeout: int | None = None,
+                           cwd: str | None = None,
+                           probe_cmd: list[str] | None = None) -> dict:
+    """Startup guard for broker/worker processes: probe the default backend
+    in a killable subprocess and PIN the CPU platform in-process when nothing
+    real answers — the broker then serves on host devices instead of hanging
+    at its first device touch. Returns the probe diagnostics (callers log
+    them / feed the flight recorder). Idempotent: an already-pinned platform
+    short-circuits through the env-pinned fast path."""
+    res, diag = probe_with_diagnostics(cwd=cwd, timeout=timeout,
+                                       probe_cmd=probe_cmd)
+    if res is None or res[0] == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        diag["pinned"] = "cpu"
+    return diag
