@@ -1441,6 +1441,70 @@ def _soak_main(quick: bool) -> None:
         raise SystemExit(1)
 
 
+def _scale_soak_main(quick: bool) -> None:
+    """--scale-soak: the million-instance state-tiering gate (ISSUE 8).
+    Parks 1M+ instances (100k in --quick) on a tiered-state broker under
+    sustained traffic with correlation storms, snapshots + compaction under
+    load, and crash-restarts mid-spill and mid-snapshot; gates on bounded
+    RSS, zero acked-record loss, byte-identical re-exports, recovery within
+    budget, and the cold tier holding the parked majority. Writes
+    SCALE_SOAK[_quick].json and copies the per-recovery flight dumps for
+    CI upload."""
+    import shutil
+    import time as _time
+
+    from zeebe_tpu.testing.scale_soak import (
+        FULL_CONFIG,
+        ScaleSoakConfig,
+        run_scale_soak,
+    )
+
+    cfg = ScaleSoakConfig() if quick else FULL_CONFIG
+    started = _time.perf_counter()
+    work_dir = tempfile.mkdtemp(prefix="zeebe-scale-soak-")
+    try:
+        report = run_scale_soak(cfg, directory=work_dir)
+        repo_dir = os.path.dirname(os.path.abspath(__file__))
+        dumps_dir = os.path.join(repo_dir, "SCALE_SOAK_dumps")
+        shutil.rmtree(dumps_dir, ignore_errors=True)
+        os.makedirs(dumps_dir, exist_ok=True)
+        copied = []
+        for dump in report["flightDumps"]:
+            rel = os.path.relpath(dump, work_dir).replace(os.sep, "__")
+            target = os.path.join(dumps_dir, rel)
+            try:
+                shutil.copyfile(dump, target)
+                copied.append(os.path.relpath(target, repo_dir))
+            except OSError:
+                pass
+        report["flightDumps"] = copied
+    finally:
+        shutil.rmtree(work_dir, ignore_errors=True)
+    report["wallSeconds"] = round(_time.perf_counter() - started, 2)
+    report["quick"] = quick
+    name = "SCALE_SOAK_quick.json" if quick else "SCALE_SOAK.json"
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "scaleSoak": True, "quick": quick, "seed": report["seed"],
+        "created": report["created"],
+        "peakSpilledInstances": report["peakSpilledInstances"],
+        "peakSpilledFraction": report["peakSpilledFraction"],
+        "peakRssMiB": report["rss"]["peakMiB"],
+        "rssWithinBound": report["rss"]["withinBound"],
+        "withinBudget": report["withinBudget"],
+        "sweepProbes": report["sweepProbes"],
+        "violations": len(report["violations"]),
+        "full_results": name,
+    }))
+    if report["violations"]:
+        for v in report["violations"][:20]:
+            print(f"scale-soak violation: {v}", file=sys.stderr)
+        raise SystemExit(1)
+
+
 # ---------------------------------------------------------------------------
 # interleaved A/B comparison + mesh scaling modes (ISSUE 7 satellites)
 
@@ -1580,7 +1644,7 @@ def _mesh_main(counts_spec: str, gate: bool, platform: str) -> None:
 
 def main(quick: bool = False, trace: bool = False,
          sample_metrics: bool = False, profile: bool = False,
-         soak: bool = False) -> None:
+         soak: bool = False, scale_soak: bool = False) -> None:
     # install the filter BEFORE any backend use: the mismatch warning fires
     # whenever a persistent-cache executable loads, including the probe's
     # subprocess (which inherits the filtered fd 2)
@@ -1588,6 +1652,9 @@ def main(quick: bool = False, trace: bool = False,
     platform = _ensure_backend()
     if soak:
         _soak_main(quick)
+        return
+    if scale_soak:
+        _scale_soak_main(quick)
         return
     if trace:
         _enable_tracing()
@@ -1744,6 +1811,15 @@ if __name__ == "__main__":
                          "cadence, recovery within budget. Writes "
                          "SOAK[_quick].json; --quick bounds it to a few "
                          "minutes")
+    ap.add_argument("--scale-soak", action="store_true",
+                    help="million-instance state-tiering gate: park 1M+ "
+                         "instances (100k with --quick) on a tiered-state "
+                         "broker with correlation storms, snapshots + "
+                         "compaction under load, and crash-restarts "
+                         "mid-spill/mid-snapshot; gates on bounded RSS, "
+                         "zero acked-record loss, byte-identical "
+                         "re-exports, and recovery within budget. Writes "
+                         "SCALE_SOAK[_quick].json")
     ap.add_argument("--interleave", metavar="A,B",
                     help="interleaved same-box A/B comparison: alternate the "
                          "two named scenarios --rounds times and report "
@@ -1773,4 +1849,4 @@ if __name__ == "__main__":
     else:
         main(quick=_args.quick, trace=_args.trace,
              sample_metrics=_args.sample_metrics, profile=_args.profile,
-             soak=_args.soak)
+             soak=_args.soak, scale_soak=_args.scale_soak)

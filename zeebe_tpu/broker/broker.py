@@ -74,6 +74,14 @@ class BrokerCfg:
     # max incremental-snapshot chain length (base + deltas) before the next
     # snapshot rebases to a full one; 1 = every snapshot is full
     snapshot_chain_length: int = 8
+    # state tiering (ISSUE 8): spill parked process instances (waiting on
+    # timers/messages/jobs past tiering_park_after_ms) from the hot dict to
+    # a disk-backed cold store, faulting back in transparently on wake —
+    # bounded RSS under a million-instance parked backlog. Off by default;
+    # DURABLESTATE supersedes it (the durable backend has its own tiers).
+    tiering: bool = False
+    tiering_park_after_ms: int = 30_000
+    tiering_spill_batch: int = 256
 
 
 _AUTO_DEVICE_COUNT: int | None = None
@@ -225,7 +233,12 @@ class Broker:
                 MetricsSampler,
                 TimeSeriesStore,
             )
+            from zeebe_tpu.utils.metrics import install_process_metrics
 
+            # the rss_watermark default rule reads the process self-metrics
+            # gauge: make sure it exists wherever the alert plane runs
+            # (idempotent; refresh rides the sampler's collect hooks)
+            install_process_metrics(REGISTRY)
             self.timeseries: TimeSeriesStore | None = TimeSeriesStore()
             self.sampler: MetricsSampler | None = MetricsSampler(
                 REGISTRY, self.timeseries,
@@ -273,7 +286,26 @@ class Broker:
             "join_time": REGISTRY.histogram(
                 "partition_server_join_time",
                 "seconds to join a partition at runtime", ("partition",)),
+            # state-tiering plane (ISSUE 8)
+            "state_keys": REGISTRY.gauge(
+                "state_keys",
+                "committed state keys per column family",
+                ("node", "partition", "cf")),
+            "tier_bytes": REGISTRY.gauge(
+                "state_tier_bytes",
+                "state bytes per tier (hot = estimated packed size of "
+                "resident values, cold = exact live cold-store bytes)",
+                ("node", "partition", "tier")),
+            "parked": REGISTRY.gauge(
+                "state_parked_instances",
+                "process instances parked in a wait state and spilled cold, "
+                "plus pending park candidates",
+                ("node", "partition", "kind")),
         }
+        # cf-gauge children already emitted per partition: a CF that empties
+        # must drop to 0, not freeze at its last count
+        self._state_cf_seen: dict[int, set[str]] = {}
+        self._state_gauges_ms = 0
         self.responses: list = []
         # per-partition ownership guard (set by ClusterRuntime): topology-
         # driven partition lifecycle must not close journals under a pump
@@ -457,6 +489,18 @@ class Broker:
             self._owned_mesh_runner = MeshKernelRunner(n_shards=shards)
         return self._owned_mesh_runner
 
+    def _tiering_cfg(self):
+        """The partition-facing TieringCfg, or None when tiering is off."""
+        if not self.cfg.tiering:
+            return None
+        from zeebe_tpu.state.tiering import TieringCfg
+
+        return TieringCfg(
+            enabled=True,
+            park_after_ms=self.cfg.tiering_park_after_ms,
+            spill_batch=self.cfg.tiering_spill_batch,
+        )
+
     def _create_partition(self, partition_id: int, members: list[str],
                           priority: int = 1) -> None:
         import time as _time
@@ -490,6 +534,7 @@ class Broker:
             flight_recorder=self.flight_recorder,
             recovery_budget_ms=self.cfg.recovery_budget_ms,
             snapshot_chain_length=self.cfg.snapshot_chain_length,
+            tiering=self._tiering_cfg(),
         )
         self.health_monitor.register(f"partition-{partition_id}")
         from zeebe_tpu.utils.metrics import REGISTRY as _REG
@@ -744,6 +789,9 @@ class Broker:
         from zeebe_tpu.utils.health import HealthStatus
 
         node = self.cfg.node_id
+        # the per-CF key-count gauges bisect the whole key index: 1s cadence,
+        # not every pump round
+        now_ms = self.clock_millis()
         for pid, partition in self.partitions.items():
             label = str(pid)
             self._metrics["role"].labels(node, label).set(
@@ -769,6 +817,31 @@ class Broker:
                 if exported < 2**62:
                     self._metrics["exported"].labels(node, label).set(
                         float(exported))
+            db = partition.db
+            if db is not None and not db.in_transaction \
+                    and now_ms - self._state_gauges_ms >= 1000:
+                counts = db.key_counts_by_cf()
+                seen = self._state_cf_seen.setdefault(pid, set())
+                for cf_name in seen - counts.keys():
+                    self._metrics["state_keys"].labels(
+                        node, label, cf_name).set(0.0)
+                for cf_name, count in counts.items():
+                    self._metrics["state_keys"].labels(
+                        node, label, cf_name).set(float(count))
+                seen.update(counts)
+                stats = (db.tier_stats() if hasattr(db, "tier_stats")
+                         else None)
+                if stats is not None:
+                    self._metrics["tier_bytes"].labels(node, label, "hot").set(
+                        float(stats["hotBytesEstimate"]))
+                    self._metrics["tier_bytes"].labels(node, label, "cold").set(
+                        float(stats["coldBytes"]))
+                if partition.tiering is not None:
+                    self._metrics["parked"].labels(node, label, "cold").set(
+                        float(partition.tiering.spilled_instances))
+                    self._metrics["parked"].labels(
+                        node, label, "candidate").set(
+                        float(partition.tiering.pending_candidates))
             failed = (
                 partition.processor is not None
                 and partition.processor.phase.value == "failed"
@@ -777,6 +850,8 @@ class Broker:
                 f"partition-{pid}",
                 HealthStatus.UNHEALTHY if failed else HealthStatus.HEALTHY,
             )
+        if now_ms - self._state_gauges_ms >= 1000:
+            self._state_gauges_ms = now_ms
         self._metrics["health"].labels(node).set(
             float(self.health_monitor.status()))
 
@@ -864,7 +939,10 @@ class InProcessCluster:
                  durable_state: bool = False,
                  network: LoopbackNetwork | None = None,
                  recovery_budget_ms: int = 60_000,
-                 snapshot_chain_length: int = 8) -> None:
+                 snapshot_chain_length: int = 8,
+                 tiering: bool = False,
+                 tiering_park_after_ms: int = 30_000,
+                 tiering_spill_batch: int = 256) -> None:
         from zeebe_tpu.testing import ControlledClock
 
         self._tmp = None
@@ -890,6 +968,9 @@ class InProcessCluster:
                 durable_state=durable_state,
                 recovery_budget_ms=recovery_budget_ms,
                 snapshot_chain_length=snapshot_chain_length,
+                tiering=tiering,
+                tiering_park_after_ms=tiering_park_after_ms,
+                tiering_spill_batch=tiering_spill_batch,
             )
             self.brokers[m] = Broker(
                 cfg, self.net.join(m), directory=self.directory / m,

@@ -66,6 +66,10 @@ class ExtendedBrokerCfg:
             raise ValueError("maxCommandsInBatch must be >= 1")
         if self.base.snapshot_chain_length < 1:
             raise ValueError("snapshotChainLength must be >= 1")
+        if self.base.tiering_park_after_ms < 0:
+            raise ValueError("tiering parkAfterMs must be >= 0")
+        if self.base.tiering_spill_batch < 1:
+            raise ValueError("tiering spillBatch must be >= 1")
 
 
 # env var → (section, field, type); relaxed-binding names follow the
@@ -107,6 +111,14 @@ _ENV_BINDINGS: dict[str, tuple[str, str, Any]] = {
     # (1 = every snapshot is a full snapshot)
     "ZEEBE_BROKER_DATA_SNAPSHOTCHAINLENGTH": (
         "base", "snapshot_chain_length", int),
+    # state tiering (ISSUE 8): cold parked-instance store — spill instances
+    # parked past PARKAFTERMS to disk, SPILLBATCH instances per pump pass
+    "ZEEBE_BROKER_DATA_TIERING_ENABLED": (
+        "base", "tiering", lambda v: v.lower() in ("1", "true", "yes")),
+    "ZEEBE_BROKER_DATA_TIERING_PARKAFTERMS": (
+        "base", "tiering_park_after_ms", int),
+    "ZEEBE_BROKER_DATA_TIERING_SPILLBATCH": (
+        "base", "tiering_spill_batch", int),
 }
 
 

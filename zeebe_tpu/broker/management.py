@@ -387,8 +387,17 @@ def broker_status(broker) -> dict:
         "nodeId": node,
         "health": broker.health_monitor.status().name,
         "partitions": {
-            str(pid): {"role": p.role.value, "term": p.raft.current_term,
-                       "lastPosition": p.stream.last_position}
+            str(pid): {
+                "role": p.role.value, "term": p.raft.current_term,
+                "lastPosition": p.stream.last_position,
+                # state tiering (ISSUE 8): parked-instance accounting when
+                # the cold store is on
+                **({"parkedCold": p.tiering.spilled_instances,
+                    "parkCandidates": p.tiering.pending_candidates,
+                    "coldBytes": p.db.tier_stats()["coldBytes"]}
+                   if p.tiering is not None and p.db is not None
+                   and hasattr(p.db, "tier_stats") else {}),
+            }
             for pid, p in sorted(broker.partitions.items())
         },
     }

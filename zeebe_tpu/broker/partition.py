@@ -161,6 +161,7 @@ class ZeebePartition:
         flight_recorder=None,
         recovery_budget_ms: int = DEFAULT_RECOVERY_BUDGET_MS,
         snapshot_chain_length: int = DEFAULT_SNAPSHOT_CHAIN_LENGTH,
+        tiering=None,
     ) -> None:
         self.partition_id = partition_id
         self.partition_count = partition_count
@@ -182,6 +183,13 @@ class ZeebePartition:
         self.kernel_backend_enabled = kernel_backend_enabled
         self.mesh_runner = mesh_runner
         self.durable_state = durable_state
+        # state tiering (ISSUE 8): cold parked-instance store config
+        # (state/tiering.py TieringCfg | None). Durable state supersedes it —
+        # the durable backend has its own hot/cold residency story.
+        self.tiering_cfg = tiering if (tiering is not None
+                                       and getattr(tiering, "enabled", False)
+                                       and not durable_state) else None
+        self.tiering = None  # TieringManager | None, built per transition
         # broker health monitor (CriticalComponentsHealthMonitor | None): the
         # exporter director reports per-exporter DEGRADED/HEALTHY through it
         self.health_monitor = health_monitor
@@ -359,6 +367,15 @@ class ZeebePartition:
         self.checkers = DueDateCheckers(
             self.engine.state, self.processor.schedule_service, self.clock_millis
         )
+        if self.tiering_cfg is not None:
+            # fresh manager per transition over the fresh db (the seams —
+            # park_listener/woken_listener — rewire to it); replay feeds it
+            # on followers too, so a promoted follower spills immediately
+            from zeebe_tpu.state.tiering import TieringManager
+
+            self.tiering = TieringManager(
+                self.db, self.clock_millis, self.tiering_cfg,
+                partition_id=self.partition_id)
         self.redistributor = CommandRedistributor(
             self.engine.state, self.engine.sender,
             self.processor.schedule_service, self.clock_millis,
@@ -527,6 +544,13 @@ class ZeebePartition:
         self._snapshot_anchor = None
         self._chain_len = 0
         self._last_snapshot_processed = -1
+        from zeebe_tpu.state.tiering import TieredZbDb
+
+        if isinstance(self.db, TieredZbDb):
+            # release the previous life's cold segments/fds; the new store
+            # wipes the directory on open (cold is a cache tier — durability
+            # lives in the chain + log)
+            self.db.close()
         if self.durable_state:
             from zeebe_tpu.state import ColumnFamilyCode
             from zeebe_tpu.state.durable import DurableZbDb
@@ -576,7 +600,8 @@ class ZeebePartition:
                 continue
             try:
                 db = load_chain_db(chain,
-                                   consistency_checks=self.consistency_checks)
+                                   consistency_checks=self.consistency_checks,
+                                   db=self._new_memory_db())
             except (OSError, ValueError):
                 continue  # corruption the manifest missed: next-older chain
             self.db = db
@@ -596,9 +621,23 @@ class ZeebePartition:
             # conservatively re-walks)
             self._compact_bound_memo = (tip.id, tip.id.processed_position)
             return
-        db = ZbDb(consistency_checks=self.consistency_checks)
+        db = self._new_memory_db()
         db.begin_delta_tracking()
         self.db = db
+
+    def _new_memory_db(self) -> ZbDb:
+        """An empty in-memory-rooted store for recovery to install into:
+        tiered (cold parked-instance store under ``<partition>/cold``) when
+        tiering is on, the plain dict store otherwise."""
+        if self.tiering_cfg is not None:
+            from zeebe_tpu.state.tiering import TieredZbDb
+
+            return TieredZbDb(
+                self.directory / "cold",
+                consistency_checks=self.consistency_checks,
+                segment_max_bytes=self.tiering_cfg.segment_max_bytes,
+                partition_id=self.partition_id)
+        return ZbDb(consistency_checks=self.consistency_checks)
 
     def _last_raft_position(self) -> int:
         """Highest stream position assigned in the raft log (scan the suffix
@@ -696,6 +735,11 @@ class ZeebePartition:
                 work += 1  # scheduled commands were written; next pump processes
         else:
             work += self.processor.replay_available()
+            if self.checkers is not None:
+                # followers never sweep, but their wheel (fed by replay)
+                # must still drop spent deadlines or it grows with every
+                # due date ever applied; throttled inside maybe_advance
+                self.checkers.maybe_advance_wheel(self.clock_millis())
             if (self._replay_barrier is not None
                     and self.role == RaftRole.LEADER
                     and self.processor.phase == _Phase.REPLAY):
@@ -717,6 +761,10 @@ class ZeebePartition:
             for position in [p for p in self.limiter.in_flight if p <= processed]:
                 self.limiter.on_processed(position)
         self._maybe_snapshot()
+        if self.tiering is not None:
+            # between transactions by construction: processing/replay above
+            # has drained, snapshots never hold a transaction open
+            self.tiering.maybe_run()
         return work
 
     # -- snapshotting (AsyncSnapshotDirector equivalent) -----------------------
@@ -997,10 +1045,11 @@ class ZeebePartition:
             self.exporter_director.close()
         self.raft.close()
         self.stream_journal.close()
-        if self.durable_state and self.db is not None:
+        if self.db is not None:
             from zeebe_tpu.state.durable import DurableZbDb
+            from zeebe_tpu.state.tiering import TieredZbDb
 
-            if isinstance(self.db, DurableZbDb):
+            if isinstance(self.db, (DurableZbDb, TieredZbDb)):
                 self.db.close()
 
     def hard_crash(self) -> None:
@@ -1069,4 +1118,12 @@ class ZeebePartition:
             # operators read this off /health after every restart
             "lastRecovery": self.last_recovery,
             "snapshotChainLength": self._chain_len,
+            # state tiering (ISSUE 8): parked-instance + tier accounting —
+            # /cluster/status and `cli top` surface these
+            **({"stateTiering": {
+                **self.db.tier_stats(),
+                "parkedColdInstances": self.tiering.spilled_instances,
+                "parkCandidates": self.tiering.pending_candidates,
+            }} if self.tiering is not None and self.db is not None
+               and hasattr(self.db, "tier_stats") else {}),
         }

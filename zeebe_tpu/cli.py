@@ -259,21 +259,25 @@ def _render_top(status: dict) -> str:
         + (" · change in progress" if topo.get("changeInProgress") else ""))
     lines.append("")
     header = (f"{'NODE':<14} {'HEALTH':<10} {'ROLES':<22} "
-              f"{'APPEND/S':>9} {'PROC/S':>9} {'EXPLAG':>7} {'ALERTS':>6}")
+              f"{'APPEND/S':>9} {'PROC/S':>9} {'EXPLAG':>7} "
+              f"{'PARKED':>8} {'ALERTS':>6}")
     lines.append(header)
     for row in status.get("brokers", []):
+        parts = row.get("partitions", {})
         roles = " ".join(
             f"{pid}:{info['role'][:1].upper()}"
-            for pid, info in sorted(row.get("partitions", {}).items(),
-                                    key=lambda kv: int(kv[0]))
+            for pid, info in sorted(parts.items(), key=lambda kv: int(kv[0]))
         ) or "-"
         rates = row.get("rates", {})
+        # parked instances spilled to the cold tier (state tiering, ISSUE 8)
+        parked = sum(info.get("parkedCold", 0) for info in parts.values())
         lines.append(
             f"{row.get('nodeId', '?'):<14} {row.get('health', '?'):<10} "
             f"{roles:<22} "
             f"{rates.get('appendPerSec', 0.0):>9} "
             f"{rates.get('processedPerSec', 0.0):>9} "
             f"{int(rates.get('exportLagRecords', 0)):>7} "
+            f"{parked:>8} "
             f"{row.get('alertsFiring', 0):>6}")
     firing = [a for row in status.get("brokers", [])
               for a in row.get("alerts", [])]

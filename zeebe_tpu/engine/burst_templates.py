@@ -475,6 +475,10 @@ class BurstTemplate:
     # compiled state-op plan (native apply_state_plan): per-op tuples +
     # distinct role list; False = not compilable (fallback loop)
     _state_plan: Any = field(default=None, repr=False, compare=False)
+    # cached puts into the due-date index CFs (timer-wheel note_due replay)
+    _due_ops: Any = field(default=None, repr=False, compare=False)
+    # cached puts into the wait-state CFs (tiering note_parked replay)
+    _park_ops: Any = field(default=None, repr=False, compare=False)
 
     def _compiled_plan(self):
         """(plan bytes, distinct roles) for the native patcher, or None.
@@ -552,6 +556,71 @@ class BurstTemplate:
                 False if ops is None else (ops, list(role_idx)))
         return None if plan is False else plan
 
+    def _due_index_ops(self) -> list:
+        """Puts into the due-date index CFs (timer due dates, message TTLs,
+        job deadlines/backoff): the template applies raw encoded keys below
+        the state facades, so the hierarchical timer wheel's ``note_due``
+        seam must be replayed from the key bytes (ISSUE 8) — a missed due
+        insert would be a timer that never fires."""
+        ops = self._due_ops
+        if ops is None:
+            from zeebe_tpu.state import ColumnFamilyCode as _CF
+
+            prefixes = {struct.pack(">H", int(cf)) for cf in (
+                _CF.TIMER_DUE_DATES, _CF.MESSAGE_DEADLINES,
+                _CF.JOB_DEADLINES, _CF.JOB_BACKOFF)}
+            ops = [op for op in self.state_ops
+                   if op.op == "put" and op.key[:2] in prefixes]
+            self._due_ops = ops
+        return ops
+
+    def _park_index_ops(self) -> list:
+        """Puts into the wait-state CFs (timers, jobs, message
+        subscriptions): the tiering manager's ``note_parked`` seam must be
+        replayed too, or template-cacheable park workloads (constant
+        variables → near-1.0 template hit rates) would never produce spill
+        candidates and RSS would grow unbounded with the parked backlog."""
+        ops = self._park_ops
+        if ops is None:
+            from zeebe_tpu.state import ColumnFamilyCode as _CF
+
+            prefixes = {struct.pack(">H", int(cf)) for cf in (
+                _CF.TIMERS, _CF.JOBS, _CF.PROCESS_SUBSCRIPTION_BY_KEY)}
+            ops = [op for op in self.state_ops
+                   if op.op == "put" and op.key[:2] in prefixes]
+            self._park_ops = ops
+        return ops
+
+    def _note_parks(self, txn, resolve: Callable[[tuple], int]) -> None:
+        db = getattr(txn, "_db", None)
+        if db is None or db.park_listener is None:
+            return  # tiering off: zero cost beyond this check
+        for op in self._park_index_ops():
+            # the instance key lives in the record document; one small
+            # unpack per park-op per instantiation, paid only with a
+            # tiering manager wired
+            val = op.build_value(resolve)
+            if type(val) is dict:
+                db.note_parked(val.get("processInstanceKey", -1))
+
+    def _note_dues(self, txn, resolve: Callable[[tuple], int]) -> None:
+        db = getattr(txn, "_db", None)
+        if db is None or db.due_listener is None:
+            return
+        for op in self._due_index_ops():
+            # first key part = the due millis: tag byte at offset 2, flipped
+            # big-endian i64 at 3..11 — patched when role-derived
+            due = None
+            for off, role in op.key_patches:
+                if off == 3:
+                    due = resolve(role)
+                    break
+            if due is None:
+                flipped = _PACK_BE_Q.unpack_from(op.key, 3)[0]
+                raw = flipped ^ 0x8000000000000000
+                due = raw - (1 << 64) if raw >= (1 << 63) else raw
+            db.note_due(due)
+
     def apply_state(self, txn, resolve: Callable[[tuple], int]) -> None:
         if (_apply_state_plan is not None and getattr(txn, "capture", True) is None
                 and getattr(txn, "_writes", None) is not None):
@@ -560,6 +629,8 @@ class BurstTemplate:
                 ops, roles = plan
                 _apply_state_plan(ops, [resolve(r) for r in roles],
                                   txn._writes, txn._sorted_writes, _DB_DELETED)
+                self._note_dues(txn, resolve)
+                self._note_parks(txn, resolve)
                 return
         for op in self.state_ops:
             if op.key_patches:
@@ -575,6 +646,8 @@ class BurstTemplate:
                 txn.put(key, op.build_value(resolve))
             else:
                 txn.delete(key)
+        self._note_dues(txn, resolve)
+        self._note_parks(txn, resolve)
 
     def build_responses(self, resolve: Callable[[tuple], int]):
         from zeebe_tpu.protocol.record import Record

@@ -316,6 +316,7 @@ class JobState:
     """Jobs + activatable queue by type + deadlines + retry backoff."""
 
     def __init__(self, db: ZbDb) -> None:
+        self._db = db
         self._jobs = db.column_family(CF.JOBS)
         self._states = db.column_family(CF.JOB_STATES)
         self._activatable = db.column_family(CF.JOB_ACTIVATABLE)
@@ -334,6 +335,9 @@ class JobState:
         self._jobs.put((key,), dict(record_value))
         self._states.put((key,), JOB_ACTIVATABLE)
         self._activatable.put(self._act_key(record_value, key), None)
+        # physical park seam: an instance waiting on a job is a tiering
+        # candidate (state/tiering.py); no-op when tiering is off
+        self._db.note_parked(record_value.get("processInstanceKey", -1))
 
     def activate(self, key: int, worker: str, deadline: int) -> None:
         job = self._jobs.get((key,))
@@ -343,6 +347,7 @@ class JobState:
         self._states.put((key,), JOB_ACTIVATED)
         self._activatable.delete(self._act_key(job, key))
         self._deadlines.put((deadline, key), None)
+        self._db.note_due(deadline)
 
     def complete(self, key: int) -> None:
         self._remove(key)
@@ -385,6 +390,7 @@ class JobState:
             if backoff_until > 0:
                 self._states.put((key,), JOB_FAILED)
                 self._backoff.put((backoff_until, key), None)
+                self._db.note_due(backoff_until)
             else:
                 self._states.put((key,), JOB_ACTIVATABLE)
                 self._activatable.put(self._act_key(job, key), None)
@@ -428,6 +434,7 @@ class JobState:
         self._jobs.put((key,), job)
         if self._states.get((key,)) == JOB_ACTIVATED:
             self._deadlines.put((deadline, key), None)
+            self._db.note_due(deadline)
 
     def error_thrown(self, key: int) -> None:
         """The job is consumed by a thrown BPMN error (reference:
@@ -467,35 +474,29 @@ class JobState:
                     return out
         return out
 
+    # due-date-prefixed sorted keys + range-bounded scans: each sweep touches
+    # exactly the due entries — O(due), never O(parked) — where the previous
+    # break-on-first-future loop still MATERIALIZED the whole index first
+
     def expired_deadlines(self, now_millis: int) -> list[int]:
-        out = []
-        for enc_key, _ in self._deadlines.items():
-            deadline, job_key = _decode_two_i64(enc_key)
-            if deadline > now_millis:
-                break
-            out.append(job_key)
-        return out
+        return [
+            _decode_two_i64(enc_key)[1]
+            for enc_key, _ in self._deadlines.items_below((now_millis + 1,))
+        ]
 
     def backoff_due(self, now_millis: int) -> list[tuple[int, int]]:
-        out = []
-        for enc_key, _ in self._backoff.items():
-            until, job_key = _decode_two_i64(enc_key)
-            if until > now_millis:
-                break
-            out.append((until, job_key))
-        return out
+        return [
+            _decode_two_i64(enc_key)
+            for enc_key, _ in self._backoff.items_below((now_millis + 1,))
+        ]
 
     def next_deadline(self) -> int | None:
-        for enc_key, _ in self._deadlines.items():
-            deadline, _key = _decode_two_i64(enc_key)
-            return deadline
-        return None
+        item = self._deadlines.first_item()
+        return None if item is None else _decode_two_i64(item[0])[0]
 
     def next_backoff(self) -> int | None:
-        for enc_key, _ in self._backoff.items():
-            until, _key = _decode_two_i64(enc_key)
-            return until
-        return None
+        item = self._backoff.first_item()
+        return None if item is None else _decode_two_i64(item[0])[0]
 
 
 def _decode_trailing_i64(enc_key: bytes) -> int:
@@ -583,6 +584,7 @@ class TimerState:
     TimerInstanceState keys timers by (elementInstanceKey, timerKey))."""
 
     def __init__(self, db: ZbDb) -> None:
+        self._db = db
         self._timers = db.column_family(CF.TIMERS)
         self._due = db.column_family(CF.TIMER_DUE_DATES)
         self._by_element = db.column_family(CF.TIMER_BY_ELEMENT)
@@ -593,6 +595,8 @@ class TimerState:
         element_key = record_value.get("elementInstanceKey", -1)
         if element_key >= 0:
             self._by_element.put((element_key, key), None)
+        self._db.note_due(record_value["dueDate"])
+        self._db.note_parked(record_value.get("processInstanceKey", -1))
 
     def remove(self, key: int) -> None:
         timer = self._timers.get((key,))
@@ -608,19 +612,16 @@ class TimerState:
         return self._timers.get((key,))
 
     def due_timers(self, now_millis: int) -> list[tuple[int, dict]]:
+        # range-bounded: O(due) even with a million parked timers behind now
         out = []
-        for enc_key, _ in self._due.items():
-            due, key = _decode_two_i64(enc_key)
-            if due > now_millis:
-                break
+        for enc_key, _ in self._due.items_below((now_millis + 1,)):
+            key = _decode_two_i64(enc_key)[1]
             out.append((key, self._timers.get((key,))))
         return out
 
     def next_due(self) -> int | None:
-        for enc_key, _ in self._due.items():
-            due, _key = _decode_two_i64(enc_key)
-            return due
-        return None
+        item = self._due.first_item()
+        return None if item is None else _decode_two_i64(item[0])[0]
 
     def timers_for_element_instance(self, element_instance_key: int) -> list[tuple[int, dict]]:
         out = []
@@ -646,6 +647,7 @@ class MessageState:
     MessageState: MESSAGES, MESSAGE_DEADLINES, MESSAGE_IDS CFs)."""
 
     def __init__(self, db: ZbDb) -> None:
+        self._db = db
         self._messages = db.column_family(CF.MESSAGES)
         self._by_name_key = db.column_family(CF.MESSAGE_PROCESSES)  # (name, corrKey, msgKey)
         self._deadlines = db.column_family(CF.MESSAGE_DEADLINES)
@@ -657,6 +659,7 @@ class MessageState:
         self._by_name_key.put((record_value["name"], record_value["correlationKey"], key), None)
         if deadline > 0:
             self._deadlines.put((deadline, key), None)
+            self._db.note_due(deadline)
         message_id = record_value.get("messageId") or ""
         if message_id:
             # tenant is part of the dedup key: id reuse across tenants must
@@ -704,19 +707,15 @@ class MessageState:
         return self._correlated.exists((message_key, process_instance_key))
 
     def expired(self, now_millis: int) -> list[tuple[int, int]]:
-        out = []
-        for enc_key, _ in self._deadlines.items():
-            deadline, key = _decode_two_i64(enc_key)
-            if deadline > now_millis:
-                break
-            out.append((deadline, key))
-        return out
+        # range-bounded: O(due) regardless of the parked TTL backlog
+        return [
+            _decode_two_i64(enc_key)
+            for enc_key, _ in self._deadlines.items_below((now_millis + 1,))
+        ]
 
     def next_deadline(self) -> int | None:
-        for enc_key, _ in self._deadlines.items():
-            deadline, _key = _decode_two_i64(enc_key)
-            return deadline
-        return None
+        item = self._deadlines.first_item()
+        return None if item is None else _decode_two_i64(item[0])[0]
 
 
 class MessageSubscriptionState:
@@ -755,10 +754,13 @@ class ProcessMessageSubscriptionState:
     ProcessMessageSubscriptionState)."""
 
     def __init__(self, db: ZbDb) -> None:
+        self._db = db
         self._by_key = db.column_family(CF.PROCESS_SUBSCRIPTION_BY_KEY)
 
     def put(self, element_instance_key: int, message_name: str, record_value: dict) -> None:
         self._by_key.put((element_instance_key, message_name), dict(record_value))
+        # an instance waiting on a message is a tiering candidate
+        self._db.note_parked(record_value.get("processInstanceKey", -1))
 
     def update(self, element_instance_key: int, message_name: str, **fields) -> None:
         sub = self._by_key.get((element_instance_key, message_name))

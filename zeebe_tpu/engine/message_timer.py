@@ -392,15 +392,34 @@ class DueDateCheckers:
     """Schedules and runs the due-date sweeps: timers, message TTL, job
     deadlines, job retry backoff (reference: DueDateChecker, MessageObserver,
     JobTimeoutTrigger, JobBackoffChecker). Wired by the harness/broker pump:
-    call ``reschedule()`` after every processing batch."""
+    call ``reschedule()`` after every processing batch.
 
-    def __init__(self, engine_state: EngineState, schedule_service, clock_millis) -> None:
+    Scheduling rides the hierarchical timer wheel (engine/timer_wheel.py,
+    ISSUE 8): the wheel is rebuilt from the due-date indexes at construction
+    (every partition transition builds fresh checkers) and fed afterwards by
+    the ``ZbDb.note_due`` seam, so ``reschedule()`` is a constant-time wheel
+    probe instead of four index scans per processing batch. The wheel only
+    over-approximates (lazy cancellation, rolled-back inserts); the sweep
+    itself re-verifies against the sorted state indexes with range-bounded
+    O(due) scans — state stays the single source of truth."""
+
+    def __init__(self, engine_state: EngineState, schedule_service,
+                 clock_millis) -> None:
+        from zeebe_tpu.engine.timer_wheel import DueDateWheel
+
         self.state = engine_state
         self.schedule = schedule_service
         self.clock_millis = clock_millis
         self._handle = None
+        self._scheduled_due: int | None = None
+        self.wheel = DueDateWheel(clock_millis,
+                                  partition_id=engine_state.partition_id)
+        self.wheel.rebuild(engine_state)
+        engine_state.db.due_listener = self.wheel.note_due
 
     def _next_due(self) -> int | None:
+        """The state-index next-due probe (kept as the test oracle for the
+        wheel's never-late property; O(log n) per index since ISSUE 8)."""
         with self.state.db.transaction():
             candidates = [
                 self.state.timers.next_due(),
@@ -411,16 +430,35 @@ class DueDateCheckers:
         due = [c for c in candidates if c is not None]
         return min(due) if due else None
 
+    def maybe_advance_wheel(self, now_ms: int) -> None:
+        """Follower-side wheel hygiene: drop deadlines the leader has long
+        since swept (replay feeds the wheel on followers too). Throttled —
+        one advance per second of stream clock."""
+        if now_ms - self._last_follower_advance_ms >= 1000:
+            self._last_follower_advance_ms = now_ms
+            self.wheel.advance(now_ms)
+
+    _last_follower_advance_ms = 0
+
     def reschedule(self) -> None:
-        due = self._next_due()
+        due = self.wheel.next_due()
+        if due == self._scheduled_due and self._handle is not None \
+                and not self._handle.cancelled:
+            return  # already armed for exactly this instant
         if self._handle is not None:
             self._handle.cancel()
             self._handle = None
+        self._scheduled_due = due
         if due is not None:
             self._handle = self.schedule.run_at(due, self._sweep)
 
     def _sweep(self) -> list[Record]:
         now = self.clock_millis()
+        # the wheel entries this sweep covers are spent: drop them and
+        # cascade entered coarse buckets (stale/canceled entries die here
+        # too — their only cost was this sweep looking)
+        self.wheel.advance(now)
+        self._scheduled_due = None
         commands: list[Record] = []
         with self.state.db.transaction():
             for timer_key, _timer in self.state.timers.due_timers(now):

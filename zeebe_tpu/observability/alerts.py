@@ -24,6 +24,7 @@ deterministically.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Callable
 
 from zeebe_tpu.utils.metrics import REGISTRY as _REG
@@ -102,6 +103,19 @@ def default_rules() -> list[AlertRule]:
             labels_contains='cache="miss"',
             kind="changes", threshold=6.0, window_ms=60_000,
             severity="warning"),
+        AlertRule(
+            # RSS watermark (ISSUE 8): the process self-metrics gauge (raw
+            # name, un-namespaced — install_process_metrics follows the
+            # prometheus_client convention) held above the watermark for
+            # 10s. The default watermark is deliberately high (4 GiB);
+            # deployments bound it tighter via
+            # ZEEBE_ALERT_RSSWATERMARKBYTES — the scale soak wires this in
+            # as an invariant monitor over the million-instance park.
+            name="rss_watermark",
+            series="process_resident_memory_bytes",
+            threshold=float(os.environ.get(
+                "ZEEBE_ALERT_RSSWATERMARKBYTES", 4 << 30)),
+            for_ms=10_000, severity="critical"),
         AlertRule(
             # recovery_budget_exceeded_total is stored as a rate: a blown
             # recovery is a 0→spike→0 episode, so ANY value change inside

@@ -9,6 +9,13 @@ from zeebe_tpu.state.db import (
     encode_key,
 )
 from zeebe_tpu.state.durable import DurableZbDb
+from zeebe_tpu.state.tiering import (
+    ColdRef,
+    ColdStore,
+    TieredZbDb,
+    TieringCfg,
+    TieringManager,
+)
 from zeebe_tpu.state.snapshot import (
     FileBasedSnapshotStore,
     InvalidSnapshotError,
@@ -19,9 +26,14 @@ from zeebe_tpu.state.snapshot import (
 )
 
 __all__ = [
+    "ColdRef",
+    "ColdStore",
     "ColumnFamily",
     "ColumnFamilyCode",
     "DurableZbDb",
+    "TieredZbDb",
+    "TieringCfg",
+    "TieringManager",
     "FileBasedSnapshotStore",
     "InvalidSnapshotError",
     "PersistedSnapshot",

@@ -361,19 +361,28 @@ class Transaction:
             return iter(db._native_iterate(
                 db._sorted_keys, db._data, prefix, self._sorted_writes,
                 self._writes, _DELETED, self._reads))
+        return self.iterate_range(prefix, _prefix_successor(prefix))
+
+    def iterate_range(self, lo: bytes, hi: bytes | None) -> Iterator[tuple[bytes, Any]]:
+        """Ordered iteration over committed ∪ pending entries in ``[lo, hi)``.
+
+        The due-date sweep primitive: the work (and the materialized
+        snapshot) is O(entries in range), never O(entries under the column
+        family) — a million parked deadlines cost a sweep nothing when none
+        are due. Same snapshot semantics as ``iterate``."""
+        db = self._db
         snapshot: list[tuple[bytes, Any]] = []
         writes = self._writes
         sw = self._sorted_writes
-        lo = bisect_left(sw, prefix)
-        end = _prefix_successor(prefix)
-        hi = bisect_left(sw, end) if end is not None else len(sw)
-        overlay_keys = sw[lo:hi]
+        wlo = bisect_left(sw, lo)
+        whi = bisect_left(sw, hi) if hi is not None else len(sw)
+        overlay_keys = sw[wlo:whi]
         if not overlay_keys:
-            for key in db._keys_with_prefix(prefix):
+            for key in db._keys_in_range(lo, hi):
                 snapshot.append((key, self._committed_read(key)))
             return iter(snapshot)
         overlay = set(overlay_keys)
-        for key in db._keys_with_prefix(prefix):
+        for key in db._keys_in_range(lo, hi):
             if key in overlay:
                 continue  # superseded by pending write/delete
             snapshot.append((key, self._committed_read(key)))
@@ -383,6 +392,40 @@ class Transaction:
                 snapshot.append((key, val))
         snapshot.sort(key=lambda kv: kv[0])
         return iter(snapshot)
+
+    def first_in_range(self, lo: bytes, hi: bytes | None) -> tuple[bytes, Any] | None:
+        """Smallest committed-∪-pending entry in ``[lo, hi)``, or None.
+
+        O(log n): one bisect each side plus a skip loop over pending deletes
+        — the next-due-date probe that used to materialize a whole index."""
+        db = self._db
+        writes = self._writes
+        sw = self._sorted_writes
+        wi = bisect_left(sw, lo)
+        cursor = lo
+        while True:
+            ck = db._first_key_at_or_after(cursor, hi)
+            wk = None
+            while wi < len(sw):
+                k = sw[wi]
+                if hi is not None and k >= hi:
+                    break
+                if k >= cursor:
+                    wk = k
+                    break
+                wi += 1
+            if wk is not None and (ck is None or wk <= ck):
+                val = writes[wk]
+                if val is _DELETED:
+                    # deleted overlay entry shadows any committed twin; skip
+                    # past it in both streams
+                    wi += 1
+                    cursor = wk + b"\x00"
+                    continue
+                return (wk, val)
+            if ck is None:
+                return None
+            return (ck, self._committed_read(ck))
 
     def commit(self) -> None:
         db = self._db
@@ -477,15 +520,32 @@ class ColumnFamily:
         pfx = encode_key(self.code, prefix) if prefix else self._prefix
         yield from self._ctx().iterate(pfx)
 
+    def items_below(self, hi_parts: tuple,
+                    prefix: tuple = ()) -> Iterator[tuple[bytes, Any]]:
+        """Ordered (encoded_key, value) pairs under ``prefix`` whose key
+        parts sort strictly below ``hi_parts`` — the O(in-range) primitive
+        for due-date sweeps: ``items_below((now + 1,))`` over a
+        ``(deadline, key)`` index touches exactly the due entries, never the
+        parked backlog behind them."""
+        lo = encode_key(self.code, prefix) if prefix else self._prefix
+        hi = encode_key(self.code, hi_parts)
+        yield from self._ctx().iterate_range(lo, hi)
+
+    def first_item(self, prefix: tuple = ()) -> tuple[bytes, Any] | None:
+        """Smallest (encoded_key, value) under ``prefix`` or None — O(log n),
+        where ``next(items())`` materializes the whole prefix first."""
+        lo = encode_key(self.code, prefix) if prefix else self._prefix
+        return self._ctx().first_in_range(lo, _prefix_successor(lo))
+
     def values(self, prefix: tuple = ()) -> Iterator[Any]:
         for _, v in self.items(prefix):
             yield v
 
     def is_empty(self, prefix: tuple = ()) -> bool:
-        return next(self.items(prefix), None) is None
+        return self.first_item(prefix) is None
 
     def first_value(self, prefix: tuple = ()) -> Any:
-        item = next(self.items(prefix), None)
+        item = self.first_item(prefix)
         return None if item is None else item[1]
 
 
@@ -513,6 +573,58 @@ class ZbDb:
         # (state/snapshot.py delta chains); None = tracking off — one is-None
         # check per commit
         self._dirty_keys: set[bytes] | None = None
+        # physical observation seams (ISSUE 8) — NOT state, never replayed:
+        # due_listener feeds deadline inserts to the hierarchical timer wheel
+        # (engine/timer_wheel.py); park_listener feeds instances entering a
+        # wait state to the tiering manager (state/tiering.py). Both fire on
+        # processing AND replay (appliers run on both), both tolerate loss
+        # (wheel rebuilds at transition; an unspilled instance just stays
+        # hot), and both cost one is-None check when unwired.
+        self.due_listener: Callable[[int], None] | None = None
+        self.park_listener: Callable[[int], None] | None = None
+
+    def note_due(self, due_ms: int) -> None:
+        """State facades call this on every deadline-index insert (timer due
+        dates, message TTLs, job deadlines/backoff)."""
+        listener = self.due_listener
+        if listener is not None:
+            listener(due_ms)
+
+    def note_parked(self, process_instance_key: int) -> None:
+        """State facades call this when an instance enters a wait state
+        (timer created, message subscription opened, job created)."""
+        listener = self.park_listener
+        if listener is not None:
+            listener(process_instance_key)
+
+    def key_counts_by_cf(self) -> dict[str, int]:
+        """Committed key count per (non-empty) column family — one boundary
+        bisect per CF over the sorted index, O(cfs × log n): cheap enough
+        for the metrics cadence (``zeebe_state_keys{cf=…}``)."""
+        out: dict[str, int] = {}
+        for code, prefix in _CF_PREFIX.items():
+            end = _prefix_successor(prefix)
+            count = self._count_key_range(prefix, end)
+            if count:
+                out[code.name] = count
+        return out
+
+    def _count_key_range(self, lo: bytes, hi: bytes | None) -> int:
+        i = bisect_left(self._sorted_keys, lo)
+        j = (bisect_left(self._sorted_keys, hi) if hi is not None
+             else len(self._sorted_keys))
+        return j - i
+
+    def committed_keys_of(self, code: ColumnFamilyCode,
+                          prefix_parts: tuple = ()) -> list[bytes]:
+        """Encoded COMMITTED keys under a column family (optionally a
+        key-part prefix) without opening a transaction or materializing
+        values — the timer-wheel rebuild and tiering scans read key indexes
+        only. The returned list holds references into the sorted index, so a
+        million keys cost one slice, not a million tuples."""
+        pfx = (encode_key(code, prefix_parts) if prefix_parts
+               else _CF_PREFIX[code])
+        return self._keys_with_prefix(pfx)
 
     # -- committed-store internals ------------------------------------------
 
@@ -538,10 +650,21 @@ class ZbDb:
                 self._sorted_keys.pop(i)
 
     def _keys_with_prefix(self, prefix: bytes) -> list[bytes]:
-        lo = bisect_left(self._sorted_keys, prefix)
-        end = _prefix_successor(prefix)
-        hi = bisect_left(self._sorted_keys, end) if end is not None else len(self._sorted_keys)
-        return self._sorted_keys[lo:hi]
+        return self._keys_in_range(prefix, _prefix_successor(prefix))
+
+    def _keys_in_range(self, lo: bytes, hi: bytes | None) -> list[bytes]:
+        i = bisect_left(self._sorted_keys, lo)
+        j = bisect_left(self._sorted_keys, hi) if hi is not None else len(self._sorted_keys)
+        return self._sorted_keys[i:j]
+
+    def _first_key_at_or_after(self, lo: bytes, hi: bytes | None) -> bytes | None:
+        i = bisect_left(self._sorted_keys, lo)
+        if i >= len(self._sorted_keys):
+            return None
+        key = self._sorted_keys[i]
+        if hi is not None and key >= hi:
+            return None
+        return key
 
     # -- transactions --------------------------------------------------------
 
@@ -605,17 +728,59 @@ class ZbDb:
 
     @classmethod
     def from_snapshot_bytes(cls, raw: bytes, consistency_checks: bool = False) -> "ZbDb":
-        if raw[:5] != cls.SNAPSHOT_MAGIC:
+        db = cls(consistency_checks=consistency_checks)
+        db.load_snapshot_bytes(raw)
+        return db
+
+    def load_snapshot_bytes(self, raw: bytes) -> int:
+        """Install a full snapshot into THIS (possibly subclassed) store in
+        one bulk pass — the instance-method twin of ``from_snapshot_bytes``
+        for backends whose constructors need more than consistency flags
+        (the tiered store). Returns the entry count."""
+        if raw[:5] != self.SNAPSHOT_MAGIC:
             raise ValueError("bad state snapshot magic")
         (crc,) = struct.unpack_from("<I", raw, 5)
         body = raw[9:]
         if zlib.crc32(body) & 0xFFFFFFFF != crc:
             raise ValueError("state snapshot checksum mismatch")
-        db = cls(consistency_checks=consistency_checks)
-        for k, v in msgpack.unpackb(body):
-            db._data[k] = v
-            db._sorted_keys.append(k)
-        return db
+        entries = msgpack.unpackb(body)
+        data = self._data
+        if not data:
+            # snapshot bodies serialize in sorted-key order: installing into
+            # an empty store is a straight O(n) append, no sort needed
+            keys = []
+            for k, v in entries:
+                data[k] = v
+                keys.append(k)
+            self._install_sorted_keys(keys)
+        else:
+            for k, v in entries:
+                data[k] = v
+            self._rebuild_sorted_keys()
+        return len(entries)
+
+    # -- bulk load (snapshot/chain install fast path) -------------------------
+
+    def bulk_apply(self, puts: dict[bytes, Any],
+                   deletes: "tuple | list | set" = ()) -> None:
+        """Apply many puts/deletes in one pass: dict update + ONE sorted-key
+        rebuild — O(n log n) total where per-key ``insort`` is O(n) each
+        (quadratic on a million-key restore). Semantically identical to the
+        incremental path (tests/test_state.py asserts parity)."""
+        data = self._data
+        for key in deletes:
+            data.pop(key, None)
+        data.update(puts)
+        self._rebuild_sorted_keys()
+
+    def _rebuild_sorted_keys(self) -> None:
+        """Rebuild the key index from ``_data`` (hook: the durable backend
+        rebuilds its blocked SortedList here instead of a flat list)."""
+        self._sorted_keys = sorted(self._data)
+
+    def _install_sorted_keys(self, keys: list[bytes]) -> None:
+        """Install an ALREADY-SORTED key list as the index (same hook)."""
+        self._sorted_keys = keys
 
     def content_equals(self, other: "ZbDb") -> bool:
         """Deep state equality — the replay≡processing test oracle."""
@@ -682,11 +847,26 @@ class ZbDb:
         if zlib.crc32(body) & 0xFFFFFFFF != crc:
             raise ValueError("state delta checksum mismatch")
         entries = msgpack.unpackb(body)
-        for key, deleted, value in entries:
-            if deleted:
-                self._delete_committed(key)
-            else:
-                self._put_committed(key, value)
+        # bulk fast path: insort per key is O(existing) each — a delta the
+        # size of the store (chain recovery of a freshly-parked million
+        # instances) turns quadratic. Sort-once rebuild wins when the delta
+        # is large both absolutely and relative to the resident key set.
+        if len(entries) >= 1024 and len(entries) * 8 >= len(self._data):
+            puts: dict[bytes, Any] = {}
+            deletes: list[bytes] = []
+            for key, deleted, value in entries:
+                if deleted:
+                    puts.pop(key, None)
+                    deletes.append(key)
+                else:
+                    puts[key] = value
+            self.bulk_apply(puts, deletes)
+        else:
+            for key, deleted, value in entries:
+                if deleted:
+                    self._delete_committed(key)
+                else:
+                    self._put_committed(key, value)
         return len(entries)
 
 

@@ -135,6 +135,13 @@ except ImportError:
 
             return gen()
 
+        def bisect_left(self, key) -> int:
+            i = bisect_left(self._maxes, key)
+            if i == len(self._lists):
+                return self._len
+            before = sum(len(blk) for blk in self._lists[:i])
+            return before + bisect_left(self._lists[i], key)
+
         def __iter__(self):
             for blk in self._lists:
                 yield from blk
@@ -288,11 +295,29 @@ class DurableZbDb(ZbDb):
     def _keys_with_prefix(self, prefix: bytes) -> list[bytes]:
         from zeebe_tpu.state.db import _prefix_successor
 
-        end = _prefix_successor(prefix)
-        if end is None:
-            return list(self._sorted_keys.irange(prefix))
-        return list(self._sorted_keys.irange(prefix, end,
-                                             inclusive=(True, False)))
+        return self._keys_in_range(prefix, _prefix_successor(prefix))
+
+    def _keys_in_range(self, lo: bytes, hi: bytes | None) -> list[bytes]:
+        if hi is None:
+            return list(self._sorted_keys.irange(lo))
+        return list(self._sorted_keys.irange(lo, hi, inclusive=(True, False)))
+
+    def _first_key_at_or_after(self, lo: bytes, hi: bytes | None) -> bytes | None:
+        if hi is None:
+            return next(iter(self._sorted_keys.irange(lo)), None)
+        return next(iter(self._sorted_keys.irange(lo, hi,
+                                                  inclusive=(True, False))), None)
+
+    def _rebuild_sorted_keys(self) -> None:
+        self._sorted_keys = SortedList(self._data)
+
+    def _install_sorted_keys(self, keys) -> None:
+        self._sorted_keys = SortedList(keys)
+
+    def _count_key_range(self, lo: bytes, hi: bytes | None) -> int:
+        j = (self._sorted_keys.bisect_left(hi) if hi is not None
+             else len(self._sorted_keys))
+        return j - self._sorted_keys.bisect_left(lo)
 
     # -- wal ------------------------------------------------------------------
 

@@ -414,18 +414,24 @@ class FileBasedSnapshotStore:
 # -- chain loading (shared by partition recovery, chaos oracle, backup) -------
 
 
-def load_chain_db(chain: list[PersistedSnapshot], consistency_checks: bool = False):
+def load_chain_db(chain: list[PersistedSnapshot], consistency_checks: bool = False,
+                  db=None):
     """Materialize a validated snapshot chain into a ZbDb: install the base's
-    full ``state.bin``, then apply each delta in order. Raises ValueError on
-    a base without state (durable-marker chains are the caller's special
-    case) or on checksum mismatches the manifest somehow missed."""
+    full ``state.bin`` (one bulk pass — O(n log n), not per-key insorts),
+    then apply each delta in order. Raises ValueError on a base without
+    state (durable-marker chains are the caller's special case) or on
+    checksum mismatches the manifest somehow missed.
+
+    ``db``: install into this (empty) instance instead of a fresh ``ZbDb`` —
+    the tiered backend recovers through here (state/tiering.py)."""
     from zeebe_tpu.state.db import ZbDb
 
     base = chain[0]
     if not base.has_file(STATE_FILE):
         raise ValueError(f"chain base {base.id} has no {STATE_FILE}")
-    db = ZbDb.from_snapshot_bytes(base.read_file(STATE_FILE),
-                                  consistency_checks=consistency_checks)
+    if db is None:
+        db = ZbDb(consistency_checks=consistency_checks)
+    db.load_snapshot_bytes(base.read_file(STATE_FILE))
     for delta in chain[1:]:
         db.apply_delta_bytes(delta.read_file(DELTA_FILE))
     return db

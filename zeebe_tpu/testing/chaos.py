@@ -193,8 +193,12 @@ def engine_state_equals(a, b) -> bool:
     from zeebe_tpu.state.db import ColumnFamilyCode
 
     prefix = struct.pack(">H", int(ColumnFamilyCode.EXPORTER))
-    fa = {k: v for k, v in a._data.items() if not k.startswith(prefix)}
-    fb = {k: v for k, v in b._data.items() if not k.startswith(prefix)}
+    # tiered stores hold ColdRef stubs in _data: resolve to the logical
+    # value so a partially-spilled partition compares byte-identically
+    ra = getattr(a, "_resolve", lambda v: v)
+    rb = getattr(b, "_resolve", lambda v: v)
+    fa = {k: ra(v) for k, v in a._data.items() if not k.startswith(prefix)}
+    fb = {k: rb(v) for k, v in b._data.items() if not k.startswith(prefix)}
     return fa == fb
 
 
@@ -210,7 +214,10 @@ class ChaosHarness:
                  step_ms: int = 50,
                  snapshot_period_ms: int = 5 * 60 * 1000,
                  recovery_budget_ms: int = 60_000,
-                 snapshot_chain_length: int = 8) -> None:
+                 snapshot_chain_length: int = 8,
+                 tiering: bool = False,
+                 tiering_park_after_ms: int = 30_000,
+                 tiering_spill_batch: int = 256) -> None:
         from zeebe_tpu.broker import InProcessCluster
 
         self.plan = plan
@@ -222,6 +229,9 @@ class ChaosHarness:
             snapshot_period_ms=snapshot_period_ms,
             recovery_budget_ms=recovery_budget_ms,
             snapshot_chain_length=snapshot_chain_length,
+            tiering=tiering,
+            tiering_park_after_ms=tiering_park_after_ms,
+            tiering_spill_batch=tiering_spill_batch,
         )
         self.step_ms = step_ms
         self.tick = 0

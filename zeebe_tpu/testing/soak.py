@@ -159,6 +159,44 @@ def _soak_models():
     return work, timer, msg
 
 
+def tamper_newest_snapshot(cluster_directory, node_id: str,
+                           partition_id: int) -> str | None:
+    """Simulate power loss during the snapshot store's pending→committed
+    commit on the crashed broker's disk: newest snapshot dir loses the
+    tail of one file (torn write) and a half-written pending dir is left
+    behind. Recovery must skip both and fall back. Shared by the crash
+    soak (ISSUE 6) and the scale soak (ISSUE 8)."""
+    from zeebe_tpu.state.snapshot import SnapshotId
+
+    part_dir = (Path(cluster_directory) / node_id
+                / f"partition-{partition_id}" / "snapshots")
+    # numeric snapshot-id order, NOT name order: lexicographic sort ranks
+    # "98-…" after "103-…" and would tear an older chain member (the
+    # base!) instead of the tip
+    snaps = sorted(
+        ((snap_id, p)
+         for p in (part_dir / "snapshots").iterdir() if p.is_dir()
+         and (snap_id := SnapshotId.parse(p.name)) is not None),
+        key=lambda pair: pair[0])
+    if not snaps:
+        return None
+    victim = snaps[-1][1]
+    torn = False
+    for name in ("delta.bin", "state.bin", "durable.bin"):
+        f = victim / name
+        if f.is_file():
+            data = f.read_bytes()
+            f.write_bytes(data[: max(len(data) // 2, 1)])
+            torn = True
+            break
+    if not torn:
+        return None
+    pending = part_dir / "pending" / "999999-1-999999-999999"
+    pending.mkdir(parents=True, exist_ok=True)
+    (pending / "state.bin").write_bytes(b"partial")
+    return victim.name
+
+
 class SoakHarness:
     """Drives the endurance workload over a seeded chaos cluster and turns
     each crash-restart into a budget-checked, flight-recorded recovery."""
@@ -238,39 +276,8 @@ class SoakHarness:
     # -- crash / tamper / restart ----------------------------------------------
 
     def _tamper_newest_snapshot(self, node_id: str) -> str | None:
-        """Simulate power loss during the snapshot store's pending→committed
-        commit on the crashed broker's disk: newest snapshot dir loses the
-        tail of one file (torn write) and a half-written pending dir is left
-        behind. Recovery must skip both and fall back."""
-        from zeebe_tpu.state.snapshot import SnapshotId
-
-        part_dir = (self.cluster.directory / node_id
-                    / f"partition-{self.cfg.partition_id}" / "snapshots")
-        # numeric snapshot-id order, NOT name order: lexicographic sort ranks
-        # "98-…" after "103-…" and would tear an older chain member (the
-        # base!) instead of the tip
-        snaps = sorted(
-            ((snap_id, p)
-             for p in (part_dir / "snapshots").iterdir() if p.is_dir()
-             and (snap_id := SnapshotId.parse(p.name)) is not None),
-            key=lambda pair: pair[0])
-        if not snaps:
-            return None
-        victim = snaps[-1][1]
-        torn = False
-        for name in ("delta.bin", "state.bin", "durable.bin"):
-            f = victim / name
-            if f.is_file():
-                data = f.read_bytes()
-                f.write_bytes(data[: max(len(data) // 2, 1)])
-                torn = True
-                break
-        if not torn:
-            return None
-        pending = part_dir / "pending" / "999999-1-999999-999999"
-        pending.mkdir(parents=True, exist_ok=True)
-        (pending / "state.bin").write_bytes(b"partial")
-        return victim.name
+        return tamper_newest_snapshot(
+            self.cluster.directory, node_id, self.cfg.partition_id)
 
     def _await_recovery(self, round_no: int) -> None:
         """Run until a leader re-emerges and exporters drain; cap bounded."""
