@@ -1401,20 +1401,8 @@ def _soak_main(quick: bool) -> None:
         # the per-recovery flight dumps are the reviewable artifacts the
         # soak exists to leave behind — copy them out of the work dir (CI
         # uploads SOAK_dumps/) before it is deleted
-        repo_dir = os.path.dirname(os.path.abspath(__file__))
-        dumps_dir = os.path.join(repo_dir, "SOAK_dumps")
-        shutil.rmtree(dumps_dir, ignore_errors=True)
-        os.makedirs(dumps_dir, exist_ok=True)
-        copied = []
-        for dump in report["flightDumps"]:
-            rel = os.path.relpath(dump, work_dir).replace(os.sep, "__")
-            target = os.path.join(dumps_dir, rel)
-            try:
-                shutil.copyfile(dump, target)
-                copied.append(os.path.relpath(target, repo_dir))
-            except OSError:
-                pass
-        report["flightDumps"] = copied
+        report["flightDumps"] = _collect_gate_dumps(
+            report["flightDumps"], "SOAK_dumps", work_dir)
     finally:
         shutil.rmtree(work_dir, ignore_errors=True)
     report["wallSeconds"] = round(_time.perf_counter() - started, 2)
@@ -1441,6 +1429,87 @@ def _soak_main(quick: bool) -> None:
         raise SystemExit(1)
 
 
+def _collect_gate_dumps(dump_paths, dumps_name: str, work_dir: str) -> list:
+    """Copy a chaos gate's flight dumps out of its (about-to-be-deleted)
+    work dir into ``<repo>/<dumps_name>/`` for CI artifact upload; returns
+    the repo-relative copied paths. Shared by the soak, scale-soak, and
+    consistency gates — one dump-preservation protocol, not three."""
+    import shutil
+
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    dumps_dir = os.path.join(repo_dir, dumps_name)
+    shutil.rmtree(dumps_dir, ignore_errors=True)
+    os.makedirs(dumps_dir, exist_ok=True)
+    copied = []
+    for dump in dump_paths:
+        rel = os.path.relpath(str(dump), work_dir).replace(os.sep, "__")
+        target = os.path.join(dumps_dir, rel)
+        try:
+            shutil.copyfile(dump, target)
+            copied.append(os.path.relpath(target, repo_dir))
+        except OSError:
+            pass
+    return copied
+
+
+def _consistency_main(quick: bool) -> None:
+    """--consistency: the exactly-once delivery gate (ISSUE 9). Boots a
+    REAL supervised multi-process worker cluster over TCP with seeded
+    TCP-layer chaos (drop/dup/delay/reorder + link partitions), fires a
+    kill_worker storm and a deterministic crash-between-append-and-reply,
+    records the full client history + export streams, and checks the
+    Jepsen-shaped invariants: no acked command lost, no duplicate
+    application (per-request-id export uniqueness, byte-level), rejections
+    terminal, gateway positions monotone per partition. Writes
+    CONSISTENCY[_quick].json; violations fail the run."""
+    import shutil
+    import time as _time
+
+    from zeebe_tpu.testing.consistency import ConsistencyConfig, run_consistency
+
+    cfg = (ConsistencyConfig() if quick else
+           ConsistencyConfig(drive_seconds=120.0, kills=8, link_windows=5,
+                             reject_every=20))
+    started = _time.perf_counter()
+    work_dir = tempfile.mkdtemp(prefix="zeebe-consistency-")
+    try:
+        report = run_consistency(cfg, directory=work_dir)
+        # worker flight dumps are the postmortem artifacts (every kill's
+        # recovery + the dedupe hits/replays land in the rings) — copy them
+        # out before the work dir is deleted so CI can upload them
+        from pathlib import Path as _Path
+
+        report["flightDumps"] = _collect_gate_dumps(
+            sorted(_Path(work_dir).glob("*/flight-*.json")),
+            "CONSISTENCY_dumps", work_dir)
+    finally:
+        shutil.rmtree(work_dir, ignore_errors=True)
+    report["wallSecondsTotal"] = round(_time.perf_counter() - started, 2)
+    report["quick"] = quick
+    name = "CONSISTENCY_quick.json" if quick else "CONSISTENCY.json"
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "consistency": True, "quick": quick, "seed": report["seed"],
+        "requests": report["requests"],
+        "ackedCommands": report["ackedCommands"],
+        "kills": report["kills"],
+        "linkPartitionWindows": report["linkPartitionWindows"],
+        "crashSequencesVerified": report["crashSequencesVerified"],
+        "dedupeProbeVerified": report.get("dedupeProbe", {}).get("verified"),
+        "dedupeRepliesObserved": report["dedupeRepliesObserved"],
+        "reExportedRecords": report["reExportedRecords"],
+        "violations": len(report["violations"]),
+        "full_results": name,
+    }))
+    if report["violations"]:
+        for v in report["violations"][:20]:
+            print(f"consistency violation: {v}", file=sys.stderr)
+        raise SystemExit(1)
+
+
 def _scale_soak_main(quick: bool) -> None:
     """--scale-soak: the million-instance state-tiering gate (ISSUE 8).
     Parks 1M+ instances (100k in --quick) on a tiered-state broker under
@@ -1464,20 +1533,8 @@ def _scale_soak_main(quick: bool) -> None:
     work_dir = tempfile.mkdtemp(prefix="zeebe-scale-soak-")
     try:
         report = run_scale_soak(cfg, directory=work_dir)
-        repo_dir = os.path.dirname(os.path.abspath(__file__))
-        dumps_dir = os.path.join(repo_dir, "SCALE_SOAK_dumps")
-        shutil.rmtree(dumps_dir, ignore_errors=True)
-        os.makedirs(dumps_dir, exist_ok=True)
-        copied = []
-        for dump in report["flightDumps"]:
-            rel = os.path.relpath(dump, work_dir).replace(os.sep, "__")
-            target = os.path.join(dumps_dir, rel)
-            try:
-                shutil.copyfile(dump, target)
-                copied.append(os.path.relpath(target, repo_dir))
-            except OSError:
-                pass
-        report["flightDumps"] = copied
+        report["flightDumps"] = _collect_gate_dumps(
+            report["flightDumps"], "SCALE_SOAK_dumps", work_dir)
     finally:
         shutil.rmtree(work_dir, ignore_errors=True)
     report["wallSeconds"] = round(_time.perf_counter() - started, 2)
@@ -1644,11 +1701,17 @@ def _mesh_main(counts_spec: str, gate: bool, platform: str) -> None:
 
 def main(quick: bool = False, trace: bool = False,
          sample_metrics: bool = False, profile: bool = False,
-         soak: bool = False, scale_soak: bool = False) -> None:
+         soak: bool = False, scale_soak: bool = False,
+         consistency: bool = False) -> None:
     # install the filter BEFORE any backend use: the mismatch warning fires
     # whenever a persistent-cache executable loads, including the probe's
     # subprocess (which inherits the filtered fd 2)
     _install_stderr_spam_filter()
+    if consistency:
+        # worker processes probe/pin their own backends; the harness itself
+        # never touches a device
+        _consistency_main(quick)
+        return
     platform = _ensure_backend()
     if soak:
         _soak_main(quick)
@@ -1811,6 +1874,14 @@ if __name__ == "__main__":
                          "cadence, recovery within budget. Writes "
                          "SOAK[_quick].json; --quick bounds it to a few "
                          "minutes")
+    ap.add_argument("--consistency", action="store_true",
+                    help="exactly-once delivery gate (ISSUE 9): real "
+                         "supervised worker processes over TCP with seeded "
+                         "chaos (drop/dup/delay/reorder, link partitions, "
+                         "kill storm, crash-between-append-and-reply); "
+                         "checks no acked command lost, no duplicate "
+                         "application, terminal rejections, monotone "
+                         "positions. Writes CONSISTENCY[_quick].json")
     ap.add_argument("--scale-soak", action="store_true",
                     help="million-instance state-tiering gate: park 1M+ "
                          "instances (100k with --quick) on a tiered-state "
@@ -1849,4 +1920,5 @@ if __name__ == "__main__":
     else:
         main(quick=_args.quick, trace=_args.trace,
              sample_metrics=_args.sample_metrics, profile=_args.profile,
-             soak=_args.soak, scale_soak=_args.scale_soak)
+             soak=_args.soak, scale_soak=_args.scale_soak,
+             consistency=_args.consistency)

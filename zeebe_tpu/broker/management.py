@@ -187,7 +187,11 @@ class ManagementServer:
                 "series": store.query(name, since_ms=since, step_ms=step),
             }))
         elif path == "/flight":
+            # broker-local recorder, or the gateway runtime's own ring
+            # (worker restarts, routing-epoch changes, request re-routes)
             recorder = getattr(self.broker, "flight_recorder", None)
+            if recorder is None and self.runtime is not None:
+                recorder = getattr(self.runtime, "flight", None)
             if recorder is None:
                 handler._send(404, json.dumps(
                     {"error": "no flight recorder"}))

@@ -94,6 +94,17 @@ _M_ADAPTIVE_SNAPSHOTS = _REG.counter(
     "snapshot_adaptive_triggers_total",
     "snapshots taken early because projected replay debt threatened the "
     "recovery budget", ("partition",))
+# replicated request dedupe (ISSUE 9): ingress consults the materialized
+# table before appending — a hit suppresses a duplicate append, a replay
+# re-sends the stored reply for an already-answered request
+_M_DEDUPE_HITS = _REG.counter(
+    "request_dedupe_hits_total",
+    "resent requests recognized as in flight or processed (duplicate "
+    "append suppressed)", ("partition",))
+_M_DEDUPE_REPLAYS = _REG.counter(
+    "request_dedupe_replays_total",
+    "resent requests answered by replaying the stored reply from the "
+    "replicated dedupe table", ("partition",))
 
 
 class BackpressureExceeded(Exception):
@@ -263,6 +274,14 @@ class ZeebePartition:
         self._latest_checkpoint = 0
         self._next_position = self.stream.last_position + 1
         self._last_snapshot_ms = clock_millis()
+        # replicated dedupe, leader-side in-memory half: (stream id, request
+        # id) → position of APPENDED-but-unprocessed client commands. The
+        # dedupe column family only learns a request at processing time;
+        # this map covers the append→process window and is REBUILT from the
+        # materialized log on every leader transition, so a restarted leader
+        # (or a promoted follower) still refuses to double-append a resend
+        # that races recovery.
+        self._pending_requests: dict[tuple[int, int], int] = {}
         self._transition()  # start as follower (replay mode)
         # catch up on whatever the raft log already committed before we wired
         self._materialize_committed()
@@ -309,6 +328,7 @@ class ZeebePartition:
         replay length, and snapshot age land in the metrics plane and the
         flight recorder."""
         recovery_start = _perf_counter()
+        self._pending_requests.clear()  # rebuilt below for leaders
         self._replay_barrier = None  # a re-transition supersedes any barrier
         # ...and so does its blown-budget flag: left set, it would suppress
         # the exceeded counter for this (distinct) rebuild's own verdict
@@ -427,6 +447,8 @@ class ZeebePartition:
                 self._barrier_budget_flagged = False
                 self.processor.phase = _Phase.REPLAY
                 return
+        if self.role == RaftRole.LEADER:
+            self._rebuild_pending_requests()
         self._record_recovery(_perf_counter() - recovery_start,
                               self.processor.replayed_records)
 
@@ -445,6 +467,7 @@ class ZeebePartition:
             1 if processor.last_processed_position < 0
             else processor.last_processed_position + 1
         )
+        self._rebuild_pending_requests()
         self._record_recovery(_perf_counter() - self._recovery_started,
                               processor.replayed_records)
 
@@ -656,6 +679,88 @@ class ZeebePartition:
 
     # -- command ingress (CommandApiRequestHandler equivalent) -----------------
 
+    def _rebuild_pending_requests(self) -> None:
+        """Re-derive the append→process request window from the materialized
+        log: unprocessed client commands carrying a request id, scanned from
+        the suffix after last-processed. Runs at leader transitions (after
+        the replay barrier, when one was pending — the stream is complete
+        through the election-time raft end by then)."""
+        self._pending_requests.clear()
+        if self.processor is None:
+            return
+        start = max(self.processor.last_processed_position + 1, 1)
+        for logged in self.stream.new_reader(start):
+            rec = logged.record
+            if rec.is_command and not logged.processed and rec.request_id >= 0:
+                self._pending_requests[
+                    (rec.request_stream_id, rec.request_id)] = logged.position
+
+    def _note_pending_request(self, record: Record, position: int) -> None:
+        if record.request_id < 0:
+            return
+        pending = self._pending_requests
+        pending[(record.request_stream_id, record.request_id)] = position
+        while len(pending) > 65536:
+            # oldest-first eviction keeps dedupe live for recent traffic
+            # (an evicted request falls back to the dedupe column family
+            # once processed — only its unprocessed window is uncovered)
+            del pending[next(iter(pending))]
+
+    @property
+    def ready_for_ingress(self) -> bool:
+        """Leader actively processing (replay barrier cleared): only then is
+        the pending-request window complete enough for exactly-once ingress
+        dedupe. A leader mid-recovery answers ``unavailable`` instead — it
+        did NOT append, so the gateway may safely retry."""
+        return (self.role == RaftRole.LEADER
+                and self.processor is not None
+                and self.processor.phase == _Phase.PROCESSING)
+
+    def lookup_request(self, stream_id: int, request_id: int):
+        """Replicated-dedupe ingress consult (committed-read discipline; the
+        worker ingress handler runs on the pump thread between
+        transactions). Returns ``("replied", entry)`` when a stored reply
+        can be replayed, ``("pending", {"c": position})`` when the request
+        is appended or processed-awaiting (do NOT append again; the reply
+        arrives from processing), or None (unknown: append)."""
+        if request_id < 0:
+            return None
+        key = (stream_id, request_id)
+        position = self._pending_requests.get(key)
+        if position is not None:
+            if (self.processor is not None
+                    and position <= self.processor.last_processed_position):
+                # graduated to the dedupe column family at processing time
+                del self._pending_requests[key]
+            else:
+                self._observe_dedupe("hit", request_id, position)
+                return ("pending", {"c": position})
+        if self.db is None or self.db.in_transaction:
+            return None
+        from zeebe_tpu.state.request_dedupe import RequestDedupeState
+
+        entry = RequestDedupeState.lookup_committed(self.db, stream_id,
+                                                    request_id)
+        if entry is None:
+            return None
+        if entry.get("f"):
+            self._observe_dedupe("replay", request_id, entry["c"])
+            return ("replied", entry)
+        self._observe_dedupe("hit", request_id, entry["c"])
+        return ("pending", entry)
+
+    def _observe_dedupe(self, kind: str, request_id: int,
+                        position: int) -> None:
+        pid = str(self.partition_id)
+        if kind == "replay":
+            _M_DEDUPE_REPLAYS.labels(pid).inc()
+        else:
+            _M_DEDUPE_HITS.labels(pid).inc()
+        if self.flight is not None:
+            self.flight.record(self.partition_id, "request_dedupe",
+                               result=kind, requestId=request_id,
+                               commandPosition=position)
+
     def client_write(self, record: Record) -> int | None:
         """Client API ingress: backpressure + pause gate, then the normal
         write path (reference: CommandApiRequestHandler.handleExecuteCommand —
@@ -680,8 +785,10 @@ class ZeebePartition:
             )
         t_acquired = _perf_counter() if traced else 0.0
         position = self.write_commands([record])
-        if position is not None and self.limiter is not None:
-            self.limiter.on_appended(position)
+        if position is not None:
+            self._note_pending_request(record, position)
+            if self.limiter is not None:
+                self.limiter.on_appended(position)
         if traced and position is not None:
             # the Raft path bypasses the local LogStreamWriter, so the ack
             # stamp is taken here; the trace root is the command's own
@@ -1124,6 +1231,12 @@ class ZeebePartition:
                 **self.db.tier_stats(),
                 "parkedColdInstances": self.tiering.spilled_instances,
                 "parkCandidates": self.tiering.pending_candidates,
+                # write-error degradation (ISSUE 9 satellite): ENOSPC/EIO
+                # during spill stops admissions without killing the pump
+                "status": ("DEGRADED" if self.tiering.degraded
+                           else "HEALTHY"),
+                **({"degradedReason": self.tiering.degraded_reason}
+                   if self.tiering.degraded else {}),
             }} if self.tiering is not None and self.db is not None
                and hasattr(self.db, "tier_stats") else {}),
         }

@@ -279,6 +279,20 @@ def _render_top(status: dict) -> str:
             f"{int(rates.get('exportLagRecords', 0)):>7} "
             f"{parked:>8} "
             f"{row.get('alertsFiring', 0):>6}")
+    workers = status.get("workers")
+    if workers:
+        # multi-process deployment: the supervisor's per-worker view —
+        # restart counts are the first thing to look at when routing flaps
+        lines.append("")
+        lines.append(f"{'WORKER':<14} {'PID':>8} {'ALIVE':<6} "
+                     f"{'RESTARTS':>8}")
+        for name, info in sorted(workers.items()):
+            lines.append(
+                f"{name:<14} {str(info.get('pid', '-')):>8} "
+                f"{'yes' if info.get('alive') else 'NO':<6} "
+                f"{info.get('restarts', 0):>8}")
+        if "routingEpoch" in status:
+            lines.append(f"routing epoch v{status['routingEpoch']}")
     firing = [a for row in status.get("brokers", [])
               for a in row.get("alerts", [])]
     if firing:
@@ -436,6 +450,9 @@ def _register_metrics_scenario() -> None:
 
     backend_probe._probe_metric()
     WorkerSupervisor([])
+    # ISSUE 9 family: the gateway's bounded-resend deadline counter lives
+    # at module level in the multi-process runtime
+    import zeebe_tpu.multiproc.runtime  # noqa: F401
     from zeebe_tpu.gateway.gateway import _wrap
 
     def Topology(request, context):  # noqa: N802 — rpc-shaped name

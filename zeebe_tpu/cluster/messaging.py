@@ -308,7 +308,19 @@ class TcpMessagingService(MessagingService):
 
     def stop(self) -> None:
         if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+            # close cached outbound writers on the loop before stopping it:
+            # a long-lived gateway process that cycles runtimes (tests, the
+            # consistency harness) must not leak one fd per former peer
+            def _close_writers() -> None:
+                for writer in list(self._writers.values()):
+                    try:
+                        writer.close()
+                    except Exception:  # noqa: BLE001 — already broken
+                        pass
+                self._writers.clear()
+                self._loop.stop()
+
+            self._loop.call_soon_threadsafe(_close_writers)
         if self._thread is not None:
             self._thread.join(timeout=5)
 

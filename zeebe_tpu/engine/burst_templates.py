@@ -787,6 +787,18 @@ def build_template(
     all_responses = ([] if builder.response is None else [(False, builder.response)]) + [
         (True, r) for r in builder.extra_responses
     ]
+    # replicated-dedupe parity guard (ISSUE 9): the live burst path notes
+    # dedupe entries from `responses` while replay notes them from the
+    # logged frames — a request-carrying follow-up frame that is NOT a
+    # registered response would make the two diverge. Such steps (none in
+    # the engine today) fall back to the slow path instead.
+    response_records = {id(r.record) for _extra, r in all_responses}
+    for fu in builder.follow_ups:
+        rec = fu.record
+        if (rec.request_id >= 0 and not rec.is_command
+                and id(rec) not in response_records):
+            raise NotTemplatable(
+                "request-carrying follow-up is not a registered response")
     for extra, resp in all_responses:
         rec = resp.record
         header: dict[str, Any] = {}

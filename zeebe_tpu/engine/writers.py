@@ -60,16 +60,40 @@ class Writers:
 
     # -- TypedResponseWriter --------------------------------------------------
 
+    def _stamp_response(self, record: Record, request_stream_id: int,
+                        request_id: int) -> Record:
+        """Stamp the request identity into the response record's FRAME (the
+        reference does the same in RecordMetadata): the logged bytes then
+        carry which request a reply answers, which is what lets the
+        replicated dedupe table (state/request_dedupe.py) be materialized
+        identically on processing and replay. Appliers never read the
+        request fields, so applied state is unchanged; the follow-up entry
+        is swapped in place so the stamped frame is what gets logged."""
+        if (record.request_id == request_id
+                and record.request_stream_id == request_stream_id):
+            return record  # rejections arrive pre-stamped
+        stamped = record.replace(request_stream_id=request_stream_id,
+                                 request_id=request_id)
+        for entry in self._builder.follow_ups:
+            if entry.record is record:
+                entry.record = stamped
+                break
+        return stamped
+
     def respond(self, cmd: LoggedRecord, record: Record) -> None:
         if cmd.record.request_id >= 0:
+            stamped = self._stamp_response(
+                record, cmd.record.request_stream_id, cmd.record.request_id)
             self._builder.with_response(
-                record, cmd.record.request_stream_id, cmd.record.request_id
+                stamped, cmd.record.request_stream_id, cmd.record.request_id
             )
 
     def respond_to(self, record: Record, request_stream_id: int, request_id: int) -> None:
         """Answer a parked request from an earlier command (await-result)."""
         if request_id >= 0:
-            self._builder.add_response(record, request_stream_id, request_id)
+            stamped = self._stamp_response(record, request_stream_id,
+                                           request_id)
+            self._builder.add_response(stamped, request_stream_id, request_id)
 
     def respond_rejection(self, cmd: LoggedRecord, rejection_type: RejectionType, reason: str) -> None:
         rec = self.append_rejection(cmd, rejection_type, reason)

@@ -34,6 +34,14 @@ class RequestTimeoutError(Exception):
     pass
 
 
+class DeadlineExceededError(RequestTimeoutError):
+    """The overall per-request deadline expired (bounded gateway resend
+    loop, ``ZEEBE_GATEWAY_REQUEST_TIMEOUT_MS``): the request is abandoned
+    with a typed error instead of retrying forever against a dead
+    partition. Subclasses RequestTimeoutError so existing gRPC mappings
+    (DEADLINE_EXCEEDED) and retry handlers keep working."""
+
+
 class NoLeaderError(Exception):
     pass
 

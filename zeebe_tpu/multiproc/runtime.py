@@ -28,13 +28,14 @@ extra wire fields beyond the request id the record already carries.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
 from zeebe_tpu.gateway.broker_client import (
+    DeadlineExceededError,
     GatewayRuntimeBase,
     NoLeaderError,
-    RequestTimeoutError,
     ResourceExhaustedError,
 )
 from zeebe_tpu.multiproc.worker import (
@@ -47,6 +48,28 @@ from zeebe_tpu.protocol import Record
 
 #: a worker silent for this long is considered stale for leader routing
 STALE_STATUS_MS = 15_000
+
+#: overall per-request deadline default (``ZEEBE_GATEWAY_REQUEST_TIMEOUT_MS``)
+DEFAULT_REQUEST_TIMEOUT_MS = 30_000
+
+
+def request_timeout_s() -> float:
+    """The bounded-resend ceiling: no request outlives this, however long
+    the caller's own timeout is — a dead partition surfaces a typed
+    DEADLINE_EXCEEDED instead of an unbounded retry loop."""
+    try:
+        ms = int(os.environ.get("ZEEBE_GATEWAY_REQUEST_TIMEOUT_MS", ""))
+    except ValueError:
+        ms = DEFAULT_REQUEST_TIMEOUT_MS
+    return max(ms, 1) / 1000.0
+
+
+from zeebe_tpu.utils.metrics import REGISTRY as _REG  # noqa: E402
+
+_M_REQUEST_TIMEOUTS = _REG.counter(
+    "gateway_request_timeouts_total",
+    "client requests abandoned at the overall gateway deadline "
+    "(DEADLINE_EXCEEDED)", ("partition",))
 
 
 class MultiProcClusterRuntime(GatewayRuntimeBase):
@@ -83,6 +106,16 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
         # inspects the type
         self._worker_status: dict[str, dict] = {}
         self._status_seen_ms: dict[str, float] = {}
+        # cluster-routing observability (ISSUE 9): the gateway's own flight
+        # recorder (node-level ring; no data dir — served live, dumped
+        # never) records worker restarts and routing-table epoch changes
+        from zeebe_tpu.observability.flight_recorder import FlightRecorder
+
+        self.flight = FlightRecorder(node_id, data_dir=None)
+        self.routing_epoch = 0
+        self._last_leaders: dict[int, str | None] = {}
+        if supervisor is not None:
+            supervisor.on_restart = self._on_worker_restart
         messaging.subscribe(GATEWAY_RESPONSE_TOPIC, self._on_worker_response)
         messaging.subscribe(WORKER_STATUS_TOPIC, self._on_worker_status)
         messaging.subscribe(JOBS_AVAILABLE_TOPIC, self._on_remote_jobs_available)
@@ -152,6 +185,24 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
         if isinstance(status, dict):
             self._worker_status[sender] = status
             self._status_seen_ms[sender] = time.time() * 1000.0
+            self._observe_routing_table()
+
+    def _on_worker_restart(self, node_id: str, restarts: int) -> None:
+        self.flight.record(0, "worker_restart", worker=node_id,
+                           restarts=restarts)
+
+    def _observe_routing_table(self) -> None:
+        """Bump the routing epoch when the leader map changes — every
+        re-route decision is attributable to a concrete epoch in the
+        flight recorder."""
+        leaders = {p: self._leader_of(p)
+                   for p in range(1, self.partition_count + 1)}
+        if leaders != self._last_leaders:
+            self._last_leaders = leaders
+            self.routing_epoch += 1
+            self.flight.record(0, "routing_epoch", epoch=self.routing_epoch,
+                               leaders={str(p): m
+                                        for p, m in leaders.items()})
 
     def _on_remote_jobs_available(self, sender: str, payload: dict) -> None:
         self._on_jobs_available(payload["partitionId"], set(payload["types"]))
@@ -236,6 +287,7 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
             }},
             "brokers": rows,
         }
+        out["routingEpoch"] = self.routing_epoch
         if self.supervisor is not None:
             out["workers"] = self.supervisor.status()
         return out
@@ -256,16 +308,30 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
             return
         error = payload.get("error")
         if error is not None:
-            self._responses[request_id] = dict(error)
+            self._responses[request_id] = {**error, "from": sender}
         else:
             self._responses[request_id] = {
                 "record": Record.from_bytes(payload["record"]),
                 "commandPosition": payload.get("commandPosition", -1),
+                # "replayed": the worker answered from the replicated dedupe
+                # table instead of processing (a resend of an answered
+                # request) — surfaced to the consistency checker
+                "dedupe": payload.get("dedupe"),
             }
         event.set()
 
     def submit(self, partition_id: int, record: Record,
-               timeout_s: float = 10.0) -> Record:
+               timeout_s: float = 10.0, meta: dict | None = None) -> Record:
+        """Route a command to the partition leader and await the reply.
+
+        Bounded (ISSUE 9): the effective deadline is
+        ``min(timeout_s, ZEEBE_GATEWAY_REQUEST_TIMEOUT_MS)``; expiry raises
+        a typed :class:`DeadlineExceededError` and increments
+        ``gateway_request_timeouts_total`` instead of retrying forever
+        against a dead partition. ``meta`` (optional dict) is filled with
+        routing evidence — resends, re-routes, the answering worker, the
+        command position, and whether the reply was a dedupe replay — for
+        the consistency harness's history."""
         from zeebe_tpu.observability.tracer import get_tracer
 
         if not 1 <= partition_id <= self.partition_count:
@@ -277,13 +343,34 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
         rec = record.replace(request_id=request_id,
                              request_stream_id=self._stream_id)
         payload = {"record": rec.to_bytes(), "requestId": request_id}
-        deadline = time.time() + timeout_s
+        effective_timeout = min(timeout_s, request_timeout_s())
+        deadline = time.time() + effective_timeout
         sent_to: str | None = None
         resend_slice = 1.0
+        sends = 0
+        reroutes = 0
+        # a member that answered not-leader/unavailable is not re-routed to
+        # until a NEWER status push from it arrives — the stale table that
+        # mis-routed us would otherwise bounce the same envelope (and
+        # produce duplicate typed frames) every retry tick
+        refused_member: str | None = None
+        refused_seen_ms = 0.0
+        if meta is not None:
+            meta.update(requestId=request_id, resends=0, reroutes=0)
+
+        def _fill_meta(**kw) -> None:
+            if meta is not None:
+                meta.update(resends=max(sends - 1, 0), reroutes=reroutes,
+                            worker=sent_to, **kw)
+
         try:
             while time.time() < deadline:
                 leader = self._leader_of(partition_id)
-                if leader is None:
+                if (leader is not None and leader == refused_member
+                        and self._status_seen_ms.get(leader, 0.0)
+                        <= refused_seen_ms):
+                    leader = None  # its refusal postdates our routing info
+                if leader is None and sent_to is None:
                     time.sleep(0.02)
                     continue
                 if sent_to is None:
@@ -292,31 +379,43 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
                     # a restored wakeup (late reply raced a not-leader frame)
                     # means a response is already waiting — consume it below
                     # instead of sending a redundant envelope
+                    sends += 1
                     self.messaging.send(
                         sent_to, f"{CLIENT_COMMAND_TOPIC}-{partition_id}",
                         payload)
-                # bounded wait per send, then RESEND with backoff — to the
-                # SAME worker: a send can race a worker restart (the stale
-                # roles looked fresh, the TCP frame died with the old
-                # process), and that member's dedupe map makes the resend
-                # idempotent. Re-ROUTING to a different member is only safe
-                # after its typed not-leader frame ("I did not append") —
-                # a silent timeout may mean the first member DID append, and
-                # another member has no record of it (duplicate append).
+                # bounded wait per send, then RESEND with backoff. A resend
+                # normally targets the SAME worker (its dedupe map makes it
+                # idempotent); when the routing table names a DIFFERENT
+                # leader — the first worker died or lost leadership — the
+                # resend re-routes there. Re-routing the same request id
+                # without a typed "I did not append" frame used to risk a
+                # duplicate append; the replicated dedupe table (ISSUE 9)
+                # travels with the partition's log, so the new leader
+                # recognizes the first member's append and answers instead
+                # of appending again.
                 if not event.wait(
                         min(max(deadline - time.time(), 0.001), resend_slice)):
                     if time.time() >= deadline:
-                        raise RequestTimeoutError(
-                            f"partition {partition_id} (worker {sent_to}) "
-                            f"did not respond in {timeout_s}s")
+                        break  # deadline exceeded below
                     resend_slice = min(resend_slice * 2, 8.0)
+                    current = self._leader_of(partition_id)
+                    if current is not None and current != sent_to:
+                        sent_to = current
+                        reroutes += 1
+                        self.flight.record(0, "request_reroute",
+                                           partition=partition_id,
+                                           requestId=request_id,
+                                           to=current,
+                                           epoch=self.routing_epoch)
                     continue
                 response = self._responses.pop(request_id, None)
                 if response is None:  # pragma: no cover — resolver raced
-                    raise RequestTimeoutError(
-                        f"partition {partition_id} response lost")
+                    break  # deadline path below
                 if "record" in response:
                     result: Record = response["record"]
+                    _fill_meta(
+                        commandPosition=response.get("commandPosition", -1),
+                        dedupe=response.get("dedupe"))
                     if traced:
                         self._emit_root_span(
                             tracer, partition_id, record, result,
@@ -327,12 +426,17 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
                 # typed error frame
                 kind = response.get("type")
                 if kind == "backpressure":
+                    _fill_meta(error=kind)
                     raise ResourceExhaustedError(
                         response.get("message", "backpressure"))
                 if kind in ("not-leader", "unavailable"):
                     # the worker did NOT append this request: safe to
                     # re-route the same request id once fresher status
                     # arrives
+                    refused_member = response.get("from", sent_to)
+                    if refused_member is not None:
+                        refused_seen_ms = self._status_seen_ms.get(
+                            refused_member, 0.0)
                     event.clear()
                     if request_id in self._responses:
                         # a reply from an earlier resend landed between the
@@ -341,12 +445,21 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
                         # instead of resending
                         event.set()
                     else:
+                        if sent_to is not None:
+                            reroutes += 1
                         sent_to = None
                         time.sleep(0.02)
                     continue
+                _fill_meta(error=kind)
                 raise NoLeaderError(
                     response.get("message", f"worker error {kind!r}"))
-            raise NoLeaderError(f"no leader for partition {partition_id}")
+            _M_REQUEST_TIMEOUTS.labels(str(partition_id)).inc()
+            _fill_meta(error="deadline")
+            raise DeadlineExceededError(
+                f"partition {partition_id} request {request_id} exceeded the "
+                f"{effective_timeout:.1f}s gateway deadline "
+                f"(last worker {sent_to}, {sends} send(s), "
+                f"{reroutes} re-route(s))")
         finally:
             self._pending.pop(request_id, None)
             self._responses.pop(request_id, None)

@@ -45,6 +45,9 @@ class WorkerSpec:
     cmd: list[str]
     data_dir: str | None = None
     management_port: int = 0
+    # per-worker environment overlay on the supervisor env (chaos knobs:
+    # e.g. one worker armed with ZEEBE_CHAOS_CRASH_AFTER_APPENDS)
+    extra_env: dict | None = None
 
 
 def worker_cmd(node_id: str, bind: str, contact: str, gateways: str,
@@ -91,6 +94,9 @@ class WorkerSupervisor:
         self._restart_at: dict[str, float] = {}
         self._spawned_at: dict[str, float] = {}
         self.restarts: dict[str, int] = {s: 0 for s in self.specs}
+        # observer seam: called (node_id, restart count) AFTER a successful
+        # respawn — the gateway runtime records it in its flight recorder
+        self.on_restart = None
         self._running = False
         self._monitor_thread: threading.Thread | None = None
         self._lock = threading.Lock()
@@ -123,8 +129,11 @@ class WorkerSupervisor:
             log = open(Path(spec.data_dir) / "worker.log", "ab")
             self._logs[node_id] = log
             stderr = log
+        env = self._env
+        if spec.extra_env:
+            env = {**env, **spec.extra_env}
         proc = subprocess.Popen(
-            spec.cmd, env=self._env,
+            spec.cmd, env=env,
             stdout=stderr, stderr=stderr,
             start_new_session=True,  # SIGKILL escalation targets the whole
             # session: a worker's own children must not survive it
@@ -175,6 +184,11 @@ class WorkerSupervisor:
             self._spawn(node_id)
             self.restarts[node_id] += 1
             self._m_restarts.labels(node_id).inc()
+            if self.on_restart is not None:
+                try:
+                    self.on_restart(node_id, self.restarts[node_id])
+                except Exception:  # noqa: BLE001 — observation must never
+                    logger.exception("on_restart observer failed")  # stop supervision
 
     def stop(self) -> None:
         self._running = False

@@ -80,14 +80,36 @@ class WorkerRuntime:
         # whose gateway timed out never gets a response and would leak its
         # in-flight entry forever — evicting the OLDEST keeps dedupe live
         # for everything recent instead of silently turning off at a cap).
-        # In-memory: a crash BETWEEN append and reply loses them, and a
-        # gateway resend to the restarted worker can duplicate that command
-        # — the same at-most-once caveat the TCP runtime documents;
-        # exactly-once would need the dedupe table in the replicated log.
+        # These in-memory maps are only the FAST path now: a crash between
+        # append and reply loses them, but ingress falls back to the
+        # partition's replicated dedupe (pending-request window rebuilt from
+        # the log at leader transitions + the REQUEST_DEDUPE column family
+        # materialized on processing and replay — state/request_dedupe.py),
+        # so a gateway resend to the restarted worker or the new leader
+        # yields exactly one appended command (ISSUE 9).
         from collections import OrderedDict
 
         self._inflight_positions: OrderedDict[tuple, int] = OrderedDict()
         self._recent_replies: OrderedDict[tuple, dict] = OrderedDict()
+        # chaos seam (ISSUE 9): crash THIS process between a successful
+        # append and its reply after N ingress appends — one-shot per data
+        # dir (a marker file disarms it after the restart), letting the
+        # consistency harness pin the crash-between-append-and-reply →
+        # resend → dedupe sequence deterministically
+        self._crash_after_appends: int | None = None
+        self._crash_marker = None
+        crash_spec = os.environ.get("ZEEBE_CHAOS_CRASH_AFTER_APPENDS")
+        if crash_spec and directory is not None:
+            from pathlib import Path
+
+            try:
+                count = int(crash_spec)
+            except ValueError:
+                count = 0
+            marker = Path(directory) / "chaos-crash-after-append.done"
+            if count > 0 and not marker.exists():
+                self._crash_after_appends = count
+                self._crash_marker = marker
         self._status_interval_ms = status_interval_ms
         self._last_status_ms = 0
         self._last_roles: dict[str, str] = {}
@@ -126,6 +148,42 @@ class WorkerRuntime:
                               f"{self.node_id} does not lead partition "
                               f"{partition_id}")
             return
+        if not partition.ready_for_ingress:
+            # leader mid-recovery (replay barrier / startup replay): its
+            # replicated dedupe window is not complete yet, so appending now
+            # could duplicate a command this very log already carries. We
+            # did NOT append — the gateway retries until recovery finishes.
+            self._reply_error(sender, request_id, "unavailable",
+                              f"partition {partition_id} leader is "
+                              f"recovering")
+            return
+        # replicated dedupe (ISSUE 9): the in-memory maps above die with the
+        # process; this consult survives crashes because the table is
+        # materialized from the replicated log on processing AND replay —
+        # the resend after a crash-between-append-and-reply lands here
+        hit = partition.lookup_request(record.request_stream_id, request_id)
+        if hit is not None:
+            kind, entry = hit
+            if kind == "replied":
+                reply = {
+                    "requestId": request_id,
+                    "record": entry["f"],
+                    "commandPosition": entry["c"],
+                    "dedupe": "replayed",
+                }
+                self._recent_replies[dedupe_key] = reply
+                while len(self._recent_replies) > 4096:
+                    self._recent_replies.popitem(last=False)
+                self.messaging.send(sender, GATEWAY_RESPONSE_TOPIC, reply)
+                return
+            # appended (or processed-awaiting, e.g. await-result): do NOT
+            # append again; processing answers it through the normal reply
+            # path. Backfill the in-flight map so that reply carries the
+            # original command position.
+            self._inflight_positions[dedupe_key] = entry["c"]
+            while len(self._inflight_positions) > _MAX_INFLIGHT:
+                self._inflight_positions.popitem(last=False)
+            return
         try:
             position = partition.client_write(record)
         except BackpressureExceeded as exc:
@@ -135,6 +193,7 @@ class WorkerRuntime:
             self._reply_error(sender, request_id, "unavailable",
                               f"partition {partition_id} paused or disk-paused")
             return
+        self._maybe_chaos_crash(partition)
         self._inflight_positions[dedupe_key] = position
         while len(self._inflight_positions) > _MAX_INFLIGHT:
             self._inflight_positions.popitem(last=False)
@@ -150,6 +209,27 @@ class WorkerRuntime:
                                    "gateway": sender,
                                    "worker": self.node_id,
                                    "workerPid": os.getpid()})
+
+    def _maybe_chaos_crash(self, partition) -> None:
+        """Armed by ``ZEEBE_CHAOS_CRASH_AFTER_APPENDS=N``: hard-exit between
+        the Nth successful append and its reply. The raft journal is flushed
+        first so the appended command SURVIVES the crash (the scenario under
+        test is dedupe-on-resend, not a legitimately-lost volatile append),
+        and the marker file keeps the restarted process from re-arming."""
+        if self._crash_after_appends is None:
+            return
+        self._crash_after_appends -= 1
+        if self._crash_after_appends > 0:
+            return
+        self._crash_after_appends = None
+        try:
+            self._crash_marker.parent.mkdir(parents=True, exist_ok=True)
+            self._crash_marker.touch()
+            partition.raft.journal.flush()
+        finally:
+            print(f"[{self.node_id}] chaos: crashing between append and reply",
+                  file=sys.stderr, flush=True)
+            os._exit(86)
 
     def _on_processing_response(self, response) -> None:
         origin = response.request_stream_id
@@ -275,6 +355,17 @@ def main(argv: list[str] | None = None) -> int:
     peers = {m: a for m, a in contacts.items() if m != args.node_id}
     messaging = TcpMessagingService(args.node_id, (host, int(port)), peers)
     messaging.start()
+    # TCP-layer chaos (ISSUE 9): ZEEBE_CHAOS_TCP wraps this worker's whole
+    # messaging plane — gateway↔worker AND worker↔worker (raft/SWIM) frames
+    # ride through the seeded fault injector
+    from zeebe_tpu.testing.chaos_tcp import ChaosTcpMessagingService, maybe_wrap_chaos
+
+    messaging = maybe_wrap_chaos(messaging)
+    if isinstance(messaging, ChaosTcpMessagingService) and args.data_dir:
+        # observed-fault evidence for the consistency report, one snapshot
+        # file per process life (a SIGKILL loses ≤1 dump interval)
+        messaging.counts_file = os.path.join(
+            args.data_dir, f"chaos-counts-{os.getpid()}.json")
 
     ext = load_broker_cfg(overrides={
         "base.node_id": args.node_id,

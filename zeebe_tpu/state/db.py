@@ -112,6 +112,12 @@ class ColumnFamilyCode(enum.IntEnum):
     USER_TASK_STATES = 171
     COMPENSATION_SUBSCRIPTION = 180
     PROCESS_INSTANCE_RESULT = 190
+    # replicated request dedupe (ISSUE 9): (gateway stream id, request id) →
+    # {command position, stored reply frame}; the BY_POSITION index ages
+    # entries out by log position. Materialized on processing AND replay, so
+    # followers and restarted leaders inherit acked-command identity.
+    REQUEST_DEDUPE = 200
+    REQUEST_DEDUPE_BY_POSITION = 201
 
 
 _I64 = struct.Struct(">Q")

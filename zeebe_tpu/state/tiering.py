@@ -69,6 +69,11 @@ _M_FAULTS = _REG.counter(
     "cold state records faulted back into the hot tier", ("partition",))
 _M_COLD_SEGMENTS = _REG.gauge(
     "state_cold_segments", "cold-tier segment files", ("partition",))
+_M_TIER_WRITE_ERRORS = _REG.counter(
+    "state_tier_write_errors_total",
+    "cold-tier write failures (ENOSPC/EIO during spill or compaction); "
+    "tiering degrades to hot-only instead of poisoning the pump",
+    ("partition",))
 
 
 class ColdRef:
@@ -505,6 +510,14 @@ class TieringManager:
         self._candidates: OrderedDict[int, int] = OrderedDict()
         self._spilled: set[int] = set()
         self._last_check_ms = 0
+        # write-error degradation (ISSUE 9 satellite): a persistent OSError
+        # (ENOSPC/EIO) during spill/compaction latches DEGRADED — no new
+        # spill batches are admitted, the pump thread survives, and cold
+        # values already faulted-in (or still readable) keep serving. The
+        # next partition transition rebuilds the manager (and wipes the
+        # cold dir), which is the retry path.
+        self.degraded = False
+        self.degraded_reason: str | None = None
         db.park_listener = self.note_parked
         db.woken_listener = self.note_woken
         self._m_instances = _M_SPILLED_INSTANCES.labels(str(partition_id))
@@ -540,19 +553,36 @@ class TieringManager:
         if now - self._last_check_ms < self.cfg.check_interval_ms:
             return 0
         self._last_check_ms = now
+        if self.degraded:
+            return 0  # no new spill batches; reads/fault-ins stay servable
         spilled = 0
         horizon = now - self.cfg.park_after_ms
-        while self._candidates and spilled < self.cfg.spill_batch:
-            pi_key, noted_at = next(iter(self._candidates.items()))
-            if noted_at > horizon:
-                break  # FIFO order: the rest are younger
-            self._candidates.popitem(last=False)
-            if self.spill_instance(pi_key):
-                spilled += 1
-        if spilled:
-            self._m_instances.set(float(len(self._spilled)))
-        self.db.compact_cold()
-        self._m_segments.set(float(self.db.cold.segment_count))
+        try:
+            while self._candidates and spilled < self.cfg.spill_batch:
+                pi_key, noted_at = next(iter(self._candidates.items()))
+                if noted_at > horizon:
+                    break  # FIFO order: the rest are younger
+                self._candidates.popitem(last=False)
+                if self.spill_instance(pi_key):
+                    spilled += 1
+            if spilled:
+                self._m_instances.set(float(len(self._spilled)))
+            self.db.compact_cold()
+            self._m_segments.set(float(self.db.cold.segment_count))
+        except OSError as exc:
+            # ENOSPC/EIO on the cold dir: a half-appended frame is harmless
+            # (refs only publish after flush), but the segment write cursor
+            # can no longer be trusted — latch DEGRADED instead of poisoning
+            # the pump thread on every pass
+            self.degraded = True
+            self.degraded_reason = f"{type(exc).__name__}: {exc}"
+            _M_TIER_WRITE_ERRORS.labels(str(self.partition_id)).inc()
+            import logging
+
+            logging.getLogger("zeebe_tpu.state.tiering").error(
+                "partition %s cold-tier write failed (%s); tiering DEGRADED "
+                "— parked instances stay hot, cold reads keep serving",
+                self.partition_id, self.degraded_reason)
         return spilled
 
     # -- instance spilling -----------------------------------------------------

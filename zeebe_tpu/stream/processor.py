@@ -111,6 +111,20 @@ class StreamProcessor:
         self.on_jobs_available: Callable[[set], None] | None = None
         self.phase = Phase.INITIAL
         self._positions = db.column_family(ColumnFamilyCode.LAST_PROCESSED_POSITION)
+        # replicated request dedupe (ISSUE 9): materialized here on BOTH the
+        # processing and replay paths from the same logged evidence, so the
+        # family replays to byte-identical state (chaos parity oracle) and a
+        # promoted follower / restarted leader inherits every request's fate
+        from collections import OrderedDict as _OrderedDict
+
+        from zeebe_tpu.state.request_dedupe import RequestDedupeState
+
+        self._dedupe = RequestDedupeState(db)
+        # position → (stream id, request id) of request-carrying commands
+        # seen during replay, awaiting their processing evidence (the
+        # follow-up batch with that source); bounded — an evicted entry just
+        # skips one awaiting note for a request that never got processed
+        self._replay_pending: _OrderedDict[int, tuple[int, int]] = _OrderedDict()
         # hot-path metrics, children pre-resolved (reference names:
         # stream-platform impl/metrics/StreamProcessorMetrics —
         # zeebe_stream_processor_records_total, processing latency)
@@ -347,6 +361,7 @@ class StreamProcessor:
                                 # duplicates the rejection + client response
                                 if rec.source_position > max_source:
                                     max_source = rec.source_position
+                    self._note_replay_dedupe(batch, position)
                     if max_source > self.last_processed_position:
                         self.last_processed_position = max_source
                         self._store_last_processed(max_source)
@@ -369,6 +384,90 @@ class StreamProcessor:
             self._m_replayed.inc(applied)
             self._m_replay_events.inc(applied)
         return applied
+
+    # -- replicated request dedupe (ISSUE 9) ---------------------------------
+    #
+    # One materialization rule, two observation points with identical final
+    # state: the live paths note from the step's own builder/burst (whose
+    # records become the logged batch verbatim), replay notes from the
+    # logged batch. A processed command carrying a request id gets an
+    # awaiting entry; every response-stamped EVENT/REJECTION frame
+    # overwrites it with the stored reply; entries age out by log position.
+
+    def _note_replay_dedupe(self, batch, resume_position: int) -> None:
+        src = batch[0].source_position
+        evidence = src >= 0 and src > self.last_processed_position
+        noted = False
+        reply_keys = None
+        for rec in batch:
+            if rec.position < resume_position:
+                continue
+            record = rec.record
+            request_id = record.request_id
+            if request_id < 0:
+                continue
+            if record.is_command:
+                if not rec.processed:
+                    # a client command awaiting its processing evidence (the
+                    # later batch whose source backlink names this position)
+                    self._replay_pending[rec.position] = (
+                        record.request_stream_id, request_id)
+                    while len(self._replay_pending) > 65536:
+                        self._replay_pending.popitem(last=False)
+                continue
+            if evidence:
+                self._dedupe.note_reply(src, record)
+                noted = True
+                if reply_keys is None:
+                    reply_keys = set()
+                reply_keys.add((record.request_stream_id, request_id))
+        if not evidence:
+            return
+        pending = self._replay_pending.pop(src, None)
+        if pending is not None and (reply_keys is None
+                                    or pending not in reply_keys):
+            # processed but not (yet) answered — await-result parks the
+            # reply for a later step; live wrote the same awaiting entry at
+            # processing time (its own reply, when present in this batch,
+            # overwrote it there too)
+            self._dedupe.note_awaiting(src, *pending)
+            noted = True
+        if noted:
+            self._dedupe.age_out(src)
+
+    def _note_live_dedupe(self, cmd: LoggedRecord, follow_ups) -> None:
+        """Inside the step transaction, after the follow-ups are final."""
+        record = cmd.record
+        noted = False
+        if record.request_id >= 0:
+            self._dedupe.note_awaiting(cmd.position, record.request_stream_id,
+                                       record.request_id)
+            noted = True
+        for f in follow_ups:
+            fr = f.record
+            if fr.request_id >= 0 and not fr.is_command:
+                self._dedupe.note_reply(cmd.position, fr)
+                noted = True
+        if noted:
+            self._dedupe.age_out(cmd.position)
+
+    def _note_burst_dedupe(self, cmd: LoggedRecord, burst) -> None:
+        """Burst fast path: the template's instantiated responses are the
+        request-carrying follow-ups (build_template falls back to the slow
+        path otherwise — the parity guard), so noting them here matches
+        what replay derives from the patched frames."""
+        record = cmd.record
+        noted = False
+        if record.request_id >= 0:
+            self._dedupe.note_awaiting(cmd.position, record.request_stream_id,
+                                       record.request_id)
+            noted = True
+        for _extra, resp, _stream_id, _request_id in burst.responses:
+            if resp.request_id >= 0 and not resp.is_command:
+                self._dedupe.note_reply(cmd.position, resp)
+                noted = True
+        if noted:
+            self._dedupe.age_out(cmd.position)
 
     # -- processing ----------------------------------------------------------
 
@@ -469,6 +568,12 @@ class StreamProcessor:
                     raise
                 self.last_processed_position = cmds[-1].position
                 self._store_last_processed(self.last_processed_position)
+                for cmd, result in zip(cmds, builders):
+                    if isinstance(result, PreparedBurst):
+                        if result.count:
+                            self._note_burst_dedupe(cmd, result)
+                    else:
+                        self._note_live_dedupe(cmd, result.follow_ups)
                 append_dur = _time.perf_counter() - t_append
                 pipeline["append"].observe(append_dur)
         except Exception:  # noqa: BLE001 — the fallback/rollback seam
@@ -732,6 +837,7 @@ class StreamProcessor:
             )
         self.last_processed_position = cmd.position
         self._store_last_processed(cmd.position)
+        self._note_live_dedupe(cmd, builder.follow_ups)
 
     def _on_processing_error(self, cmd: LoggedRecord, error: Exception) -> None:
         builder = ProcessingResultBuilder()
