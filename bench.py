@@ -1430,26 +1430,15 @@ def _soak_main(quick: bool) -> None:
 
 
 def _collect_gate_dumps(dump_paths, dumps_name: str, work_dir: str) -> list:
-    """Copy a chaos gate's flight dumps out of its (about-to-be-deleted)
-    work dir into ``<repo>/<dumps_name>/`` for CI artifact upload; returns
-    the repo-relative copied paths. Shared by the soak, scale-soak, and
-    consistency gates — one dump-preservation protocol, not three."""
-    import shutil
+    """Copy a chaos gate's flight dumps into ``<repo>/<dumps_name>/`` for
+    CI artifact upload — shared home: zeebe_tpu/testing/evidence.py (one
+    dump-preservation protocol for the soak, scale-soak, and consistency
+    gates; zlint's drift-copy rule pins it there)."""
+    from zeebe_tpu.testing.evidence import collect_gate_dumps
 
-    repo_dir = os.path.dirname(os.path.abspath(__file__))
-    dumps_dir = os.path.join(repo_dir, dumps_name)
-    shutil.rmtree(dumps_dir, ignore_errors=True)
-    os.makedirs(dumps_dir, exist_ok=True)
-    copied = []
-    for dump in dump_paths:
-        rel = os.path.relpath(str(dump), work_dir).replace(os.sep, "__")
-        target = os.path.join(dumps_dir, rel)
-        try:
-            shutil.copyfile(dump, target)
-            copied.append(os.path.relpath(target, repo_dir))
-        except OSError:
-            pass
-    return copied
+    return collect_gate_dumps(
+        dump_paths, dumps_name, work_dir,
+        repo_dir=os.path.dirname(os.path.abspath(__file__)))
 
 
 def _consistency_main(quick: bool) -> None:

@@ -20,6 +20,14 @@ import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# ZEEBE_SANITIZE=1: wrap ZbDb/journal/flight-recorder with single-writer and
+# reentrancy assertions for this run (zeebe_tpu/testing/sanitizer.py) — CI
+# runs the fast engine/state slice under it so latent cross-thread races
+# fail deterministically instead of corrupting state silently
+from zeebe_tpu.testing.sanitizer import maybe_install  # noqa: E402
+
+maybe_install()
+
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running integration tests")

@@ -1,0 +1,11 @@
+"""Fixture: ingress code opening the processing-owned transaction slot."""
+
+
+def has_activatable_jobs(partition, job_type):
+    with partition.db.transaction():           # line 5: transaction open
+        return bool(partition.engine.state.jobs.keys(job_type))
+
+
+def peek(db):
+    txn = db.require_transaction()             # line 10: transactional read
+    return txn.get(b"x"), db._data             # line 11: raw _data access

@@ -1,0 +1,13 @@
+"""Fixture: the sanctioned committed-read shapes (zero findings)."""
+
+
+def has_activatable_jobs(db, job_type):
+    return bool(db.committed_keys_of(17, (job_type,)))
+
+
+def peek(db, key):
+    return db.committed_get(3, (key,))
+
+
+def consult(partition, stream_id, request_id):
+    return partition.lookup_request(stream_id, request_id)

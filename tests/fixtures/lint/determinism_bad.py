@@ -1,0 +1,37 @@
+"""Fixture: every construct the replay-determinism rule must flag."""
+import os
+import random
+import time
+import uuid
+from time import time_ns
+
+
+def applier_wall_clock(record):
+    return {"t": time.time()}          # line 10: wall clock
+
+
+def applier_aliased_clock():
+    return time_ns()                   # line 14: from-import alias
+
+
+def applier_rng():
+    return random.randint(0, 10)       # line 18: RNG
+
+
+def applier_uuid():
+    return uuid.uuid4().hex            # line 22: uuid
+
+
+def applier_env():
+    return os.environ.get("ZEEBE_X")   # line 26: env read
+
+
+def applier_set_iteration(keys):
+    out = []
+    for k in set(keys):                # line 31: set iteration
+        out.append(k)
+    return list({1, 2, 3})             # line 33: list() over set literal
+
+
+def applier_set_comprehension(keys):
+    return [k for k in {x for x in keys}]   # line 37: comp over set

@@ -1,0 +1,23 @@
+"""Fixture: deterministic twins of every flagged construct — zero findings."""
+
+
+def applier_stamped_time(record):
+    return {"t": record["timestamp"]}        # time comes from the record
+
+
+def applier_sorted_set(keys):
+    out = []
+    for k in sorted(set(keys)):              # sorted() sanitizes the order
+        out.append(k)
+    return sorted({1, 2, 3})
+
+
+def applier_set_membership(keys, allowed):
+    # membership and size are order-free: not flagged
+    return len(set(keys)) if keys[0] in set(allowed) else 0
+
+
+def applier_suppressed():
+    import time
+
+    return time.time()  # zlint: disable=replay-determinism — fixture proof

@@ -1,0 +1,11 @@
+"""Fixture: the sanctioned shape — delegate to the killable probe (zero
+findings; this file is pointed at by the test as an allowed location)."""
+import jax
+
+
+def resolve_mesh_devices():
+    return jax.devices()
+
+
+def boot():
+    return len(resolve_mesh_devices())

@@ -1,0 +1,15 @@
+"""Fixture: unguarded in-process device queries the rule must flag."""
+import jax
+import jax as j
+
+
+def boot():
+    return len(jax.devices())          # line 7: unguarded device query
+
+
+def boot_aliased():
+    return j.local_devices()           # line 11: aliased module
+
+
+def boot_backend():
+    return jax.default_backend()       # line 15: backend init

@@ -1,0 +1,34 @@
+"""Fixture: original of a drifted copy (see drift_b.py)."""
+import json
+from pathlib import Path
+
+
+def collect_dumps(self, round_no, node_id, since_ms):
+    data_dir = self.cluster.directory / node_id
+    found = False
+    for path in sorted(data_dir.glob("flight-*.json")):
+        if str(path) in self.flight_dumps:
+            continue
+        try:
+            dump = json.loads(Path(path).read_text())
+        except (OSError, ValueError):
+            self.violations.append(f"round {round_no}: {path} unreadable")
+            continue
+        if dump.get("dumpedAtMs", 0) < since_ms:
+            continue
+        self.flight_dumps.append(str(path))
+        found = True
+    if not found:
+        self.violations.append(f"round {round_no}: nothing found")
+
+
+def unrelated_function(items):
+    total = 0
+    for item in items:
+        if item > 0:
+            total += item * 2
+        elif item < -10:
+            total -= item
+        else:
+            total += 1
+    return total

@@ -1,0 +1,23 @@
+"""Fixture: the drifted copy — renamed identifiers, reworded messages,
+same body shape as drift_a.collect_dumps."""
+import json
+from pathlib import Path
+
+
+def gather_flight_evidence(self, label, broker, cutoff_ms):
+    directory = self.cluster.directory / broker
+    seen_any = False
+    for dump_path in sorted(directory.glob("flight-*.json")):
+        if str(dump_path) in self.flight_dumps:
+            continue
+        try:
+            payload = json.loads(Path(dump_path).read_text())
+        except (OSError, ValueError):
+            self.violations.append(f"{label}: unreadable {dump_path}")
+            continue
+        if payload.get("dumpedAtMs", 0) < cutoff_ms:
+            continue
+        self.flight_dumps.append(str(dump_path))
+        seen_any = True
+    if not seen_any:
+        self.violations.append(f"{label}: no dump carried the evidence")

@@ -1,0 +1,20 @@
+"""Fixture: blocking I/O reachable from a pump hook (direct and one hop)."""
+import os
+import subprocess
+import time
+
+
+class Partition:
+    def pump(self):
+        time.sleep(0.01)               # line 9: direct blocking call
+        self._maybe_snapshot()
+        return 1
+
+    def _maybe_snapshot(self):
+        fd = os.open("x", os.O_RDONLY)
+        os.fsync(fd)                   # line 15: reachable via self call
+        subprocess.run(["sync"])       # line 16: reachable via self call
+
+    def unrelated(self):
+        # NOT reachable from pump: must not be flagged
+        time.sleep(1.0)

@@ -1,0 +1,11 @@
+"""Fixture: a clean pump — buffered writes, no blocking calls (zero
+findings)."""
+
+
+class Partition:
+    def pump(self):
+        self._drain_buffers()
+        return 0
+
+    def _drain_buffers(self):
+        self.buffer = []

@@ -1,0 +1,299 @@
+"""zlint (zeebe_tpu/analysis): rule-by-rule fixture proofs + the tree gate.
+
+Every rule family gets at least one fixture-proven true positive (exact
+rule, file, and line asserted) and a clean twin proving the rule does not
+over-fire, per ISSUE 10's acceptance criteria. The final test mirrors the
+CI gate: the real tree with the committed baseline is clean.
+"""
+
+from pathlib import Path
+
+import pytest
+
+import zeebe_tpu
+from zeebe_tpu.analysis import (
+    BASELINE_FILENAME,
+    format_baseline,
+    load_baseline,
+    run_lint,
+    split_findings,
+)
+from zeebe_tpu.analysis.framework import ParsedModule
+from zeebe_tpu.analysis.knobs import (
+    KNOB_NOTES,
+    render_knobs_doc,
+    scan_knobs,
+    undocumented,
+)
+from zeebe_tpu.analysis.rules import (
+    CommittedReadDisciplineRule,
+    DeviceCallDisciplineRule,
+    DriftCopyRule,
+    PumpBlockingIoRule,
+    ReplayDeterminismRule,
+)
+
+FIXTURES = Path(__file__).parent / "fixtures" / "lint"
+REPO_ROOT = Path(zeebe_tpu.__file__).resolve().parent.parent
+
+
+def fixture_module(name: str) -> ParsedModule:
+    return ParsedModule(FIXTURES, FIXTURES / name)
+
+
+def lines_by_rule(findings):
+    return sorted((f.path, f.line, f.rule) for f in findings)
+
+
+# -- rule 1: replay determinism -----------------------------------------------
+
+
+def determinism_rule():
+    # point the rule's scope at the fixture files
+    return ReplayDeterminismRule(scope=(
+        ("determinism_bad.py", None),
+        ("determinism_good.py", None),
+    ))
+
+
+def test_determinism_flags_every_banned_construct():
+    findings = determinism_rule().check(fixture_module("determinism_bad.py"))
+    assert lines_by_rule(findings) == [
+        ("determinism_bad.py", 10, "replay-determinism"),   # time.time()
+        ("determinism_bad.py", 14, "replay-determinism"),   # time_ns alias
+        ("determinism_bad.py", 18, "replay-determinism"),   # random
+        ("determinism_bad.py", 22, "replay-determinism"),   # uuid
+        ("determinism_bad.py", 26, "replay-determinism"),   # os.environ.get
+        ("determinism_bad.py", 31, "replay-determinism"),   # for over set()
+        ("determinism_bad.py", 33, "replay-determinism"),   # list({…})
+        ("determinism_bad.py", 37, "replay-determinism"),   # comp over set
+    ]
+    # messages carry the resolved dotted name for the call findings
+    assert any("time.time" in f.message for f in findings)
+
+
+def test_determinism_clean_twin_and_inline_suppression():
+    findings = determinism_rule().check(fixture_module("determinism_good.py"))
+    # sorted(set(…)), membership, len() — and the suppressed time.time()
+    assert findings == []
+
+
+def test_determinism_out_of_scope_module_untouched():
+    rule = ReplayDeterminismRule(scope=(("somewhere_else.py", None),))
+    assert rule.check(fixture_module("determinism_bad.py")) == []
+
+
+# -- rule 2: device-call discipline -------------------------------------------
+
+
+def test_device_rule_flags_unguarded_queries():
+    rule = DeviceCallDisciplineRule(allowed=())
+    findings = rule.check(fixture_module("device_bad.py"))
+    assert lines_by_rule(findings) == [
+        ("device_bad.py", 7, "device-call-discipline"),
+        ("device_bad.py", 11, "device-call-discipline"),   # aliased import
+        ("device_bad.py", 15, "device-call-discipline"),   # default_backend
+    ]
+
+
+def test_device_rule_honors_allowed_locations():
+    rule = DeviceCallDisciplineRule(
+        allowed=(("device_allowed.py", "resolve_mesh_devices"),))
+    module = fixture_module("device_allowed.py")
+    assert rule.check(module) == []
+    # the same file WITHOUT the allowance is flagged — the allowance is
+    # doing the work, not the rule going blind
+    strict = DeviceCallDisciplineRule(allowed=())
+    assert len(strict.check(module)) == 1
+
+
+# -- rule 3: pump-thread hygiene ----------------------------------------------
+
+
+def test_pump_rule_flags_direct_and_one_hop_blocking_calls():
+    findings = PumpBlockingIoRule(extra_roots=()).check(
+        fixture_module("pump_bad.py"))
+    assert lines_by_rule(findings) == [
+        ("pump_bad.py", 9, "pump-blocking-io"),    # time.sleep in pump
+        ("pump_bad.py", 15, "pump-blocking-io"),   # os.fsync via self call
+        ("pump_bad.py", 16, "pump-blocking-io"),   # subprocess.run via self
+    ]
+    # the blocking call in the UNREACHABLE method is not flagged
+    assert not any(f.line == 20 for f in findings)
+    assert all("Partition.pump" in f.message
+               or "Partition._maybe_snapshot" in f.message for f in findings)
+
+
+def test_pump_rule_clean_twin():
+    assert PumpBlockingIoRule(extra_roots=()).check(
+        fixture_module("pump_good.py")) == []
+
+
+# -- rule 4: committed-read discipline ----------------------------------------
+
+
+def test_committed_read_rule_flags_transactional_access():
+    rule = CommittedReadDisciplineRule(scope=("committed_bad.py",))
+    findings = rule.check(fixture_module("committed_bad.py"))
+    assert lines_by_rule(findings) == [
+        ("committed_bad.py", 5, "committed-read-discipline"),
+        ("committed_bad.py", 10, "committed-read-discipline"),
+        ("committed_bad.py", 11, "committed-read-discipline"),
+    ]
+
+
+def test_committed_read_rule_clean_twin():
+    rule = CommittedReadDisciplineRule(scope=("committed_good.py",))
+    assert rule.check(fixture_module("committed_good.py")) == []
+
+
+# -- rule 5: drift-copy -------------------------------------------------------
+
+
+def test_drift_copy_rule_catches_renamed_reworded_copy():
+    modules = [fixture_module("drift_a.py"), fixture_module("drift_b.py")]
+    findings = DriftCopyRule().check_tree(modules)
+    flagged = {(f.path, f.scope) for f in findings}
+    assert flagged == {("drift_a.py", "collect_dumps"),
+                       ("drift_b.py", "gather_flight_evidence")}
+    # each finding names its twin
+    assert any("drift_b.py:gather_flight_evidence" in f.message
+               for f in findings)
+    # the structurally different function is NOT flagged
+    assert not any(f.scope == "unrelated_function" for f in findings)
+
+
+def test_drift_copy_requires_minimum_size():
+    # with an absurd threshold nothing qualifies
+    modules = [fixture_module("drift_a.py"), fixture_module("drift_b.py")]
+    assert DriftCopyRule(min_body_statements=500).check_tree(modules) == []
+
+
+# -- baseline + suppression machinery -----------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    rule = CommittedReadDisciplineRule(scope=("committed_bad.py",))
+    findings = rule.check(fixture_module("committed_bad.py"))
+    path = tmp_path / BASELINE_FILENAME
+    path.write_text(format_baseline(findings))
+    baseline = load_baseline(path)
+    assert len(baseline) == len({f.baseline_key for f in findings})
+    new, stale = split_findings(findings, baseline)
+    assert new == [] and stale == []
+    # a fresh finding in another scope is NOT covered
+    other = fixture_module("committed_bad.py")
+    extra = other.finding("committed-read-discipline",
+                          other.tree.body[-1], "synthetic")
+    new, _ = split_findings(findings + [extra], baseline)
+    assert len(new) == 1
+    # justifications survive a rewrite
+    key = findings[0].baseline_key
+    edited = {**baseline, key: "because reasons"}
+    path.write_text(format_baseline(findings, edited))
+    assert load_baseline(path)[key] == "because reasons"
+
+
+def test_baseline_keys_are_line_number_free():
+    rule = CommittedReadDisciplineRule(scope=("committed_bad.py",))
+    f = min(rule.check(fixture_module("committed_bad.py")),
+            key=lambda f: f.line)
+    assert f.baseline_key == (
+        "committed-read-discipline", "committed_bad.py",
+        "has_activatable_jobs",
+        "with partition.db.transaction():           # line 5: transaction open")
+
+
+def test_stale_scope_registrations_become_findings():
+    """A rename that orphans a scope/root registration must FAIL the lint,
+    not silently disable the invariant (every scoped rule shares the
+    validator)."""
+    modules = [fixture_module("pump_bad.py")]
+    stale_path = ReplayDeterminismRule(
+        scope=(("renamed_away.py", None),)).validate(modules)
+    assert len(stale_path) == 1 and "stale" in stale_path[0].message
+    assert stale_path[0].rule == "replay-determinism"
+    stale_qual = PumpBlockingIoRule(
+        extra_roots=(("pump_bad.py", "Partition.renamed_hook"),)
+    ).validate(modules)
+    assert len(stale_qual) == 1
+    assert "Partition.renamed_hook" in stale_qual[0].code
+    stale_ingress = CommittedReadDisciplineRule(
+        scope=("gone/",)).validate(modules)
+    assert len(stale_ingress) == 1
+    # live registrations validate clean
+    assert PumpBlockingIoRule(
+        extra_roots=(("pump_bad.py", "Partition._maybe_snapshot"),)
+    ).validate(modules) == []
+
+
+# -- the tree gate (mirror of `cli lint --check` in CI) ------------------------
+
+
+def test_tree_is_clean_with_committed_baseline():
+    findings = run_lint(REPO_ROOT)
+    baseline = load_baseline(REPO_ROOT / BASELINE_FILENAME)
+    new, stale = split_findings(findings, baseline)
+    assert new == [], "unbaselined zlint findings:\n" + "\n".join(
+        f.render() for f in new)
+    assert stale == [], f"stale baseline entries: {stale}"
+    # every baselined exception carries a real justification
+    assert all(j.strip() and j.strip() != "TODO: justify"
+               for j in baseline.values())
+
+
+def test_cli_lint_check_exit_codes(tmp_path, capsys):
+    from zeebe_tpu.cli import main
+
+    assert main(["lint", "--check", "--root", str(REPO_ROOT)]) == 0
+    capsys.readouterr()
+    # a tree with a violation and no baseline fails the check
+    bad = tmp_path / "zeebe_tpu" / "gateway"
+    bad.mkdir(parents=True)
+    (bad / "leak.py").write_text(
+        "def peek(partition):\n"
+        "    with partition.db.transaction():\n"
+        "        return 1\n")
+    assert main(["lint", "--check", "--root", str(tmp_path)]) == 1
+    out = capsys.readouterr()
+    assert "committed-read-discipline" in out.out
+    # a stale baseline entry alone also fails the gate: fixing a violation
+    # must shrink the baseline in the same change
+    (bad / "leak.py").write_text("def peek(partition):\n    return 1\n")
+    (tmp_path / BASELINE_FILENAME).write_text(
+        "committed-read-discipline\tzeebe_tpu/gateway/leak.py\tpeek\t"
+        "with partition.db.transaction():\tgone\n")
+    assert main(["lint", "--check", "--root", str(tmp_path)]) == 1
+    assert "stale" in capsys.readouterr().err
+
+
+# -- env-knob drift gate -------------------------------------------------------
+
+
+def test_knob_scan_finds_declarative_and_call_style_reads():
+    knobs = {k.name: k for k in scan_knobs(REPO_ROOT)}
+    # call-style read (os.environ.get)
+    assert "ZEEBE_SANITIZE" in knobs
+    # declarative binding table (broker/config.py) — no environ call on
+    # the literal's line; the literal-based scan is what catches it
+    assert "ZEEBE_BROKER_CLUSTER_PARTITIONSCOUNT" in knobs
+    assert any("broker/config.py" in s
+               for s in knobs["ZEEBE_BROKER_CLUSTER_PARTITIONSCOUNT"].sites)
+    # prefix family with folded members
+    fam = knobs["ZEEBE_BROKER_EXPORTERS_"]
+    assert fam.is_prefix and fam.examples
+
+
+def test_every_knob_is_documented_and_doc_is_current():
+    knobs = scan_knobs(REPO_ROOT)
+    assert undocumented(knobs) == []
+    committed = (REPO_ROOT / "docs" / "knobs.md").read_text()
+    assert committed == render_knobs_doc(knobs), (
+        "docs/knobs.md drifted — regenerate with "
+        "`python -m zeebe_tpu.cli knobs-doc`")
+
+
+def test_no_stale_knob_notes():
+    names = {k.name for k in scan_knobs(REPO_ROOT)}
+    stale = sorted(set(KNOB_NOTES) - names)
+    assert stale == [], f"KNOB_NOTES entries without an in-tree read: {stale}"

@@ -14,6 +14,13 @@ Beyond zbctl parity:
                  profiler's retained windows instead of blocking)
   metrics-doc  — generate docs/metrics.md from the live metric registry
                  (``--check`` fails on drift; wired into CI)
+  lint         — zlint, the repo's AST invariant linter (replay
+                 determinism, device-call discipline, pump hygiene,
+                 committed-read discipline, drift copies) against the
+                 committed ``.zlint-baseline``; ``--check`` is the CI gate
+  knobs-doc    — generate docs/knobs.md from every ``ZEEBE_*`` env knob the
+                 AST scanner finds (``--check`` fails on drift or on an
+                 undocumented knob; wired into CI)
 
 Usage: python -m zeebe_tpu.cli --address host:port <command> …
 """
@@ -153,6 +160,32 @@ def main(argv: list[str] | None = None) -> int:
                         "unix-ms timestamp")
 
     p = sub.add_parser(
+        "lint",
+        help="run zlint, the repo's AST-based invariant linter "
+             "(offline; no gateway, no jax)")
+    p.add_argument("--root", default=None,
+                   help="repo root to lint (default: the tree this package "
+                        "was imported from)")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 on findings not covered by the committed "
+                        "baseline (CI gate)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to cover current findings, "
+                        "preserving existing justifications")
+
+    p = sub.add_parser(
+        "knobs-doc",
+        help="generate the env-knob reference (docs/knobs.md) from the "
+             "AST scanner's ZEEBE_* inventory")
+    p.add_argument("--root", default=None,
+                   help="repo root to scan (default: the tree this package "
+                        "was imported from)")
+    p.add_argument("--output", default="docs/knobs.md")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 if the committed file drifted or any knob "
+                        "lacks a KNOB_NOTES one-liner (CI gate)")
+
+    p = sub.add_parser(
         "snapshots",
         help="list snapshot chains (positions, sizes, validity, projected "
              "replay debt) from a data directory — offline, read-only, safe "
@@ -183,6 +216,11 @@ def main(argv: list[str] | None = None) -> int:
         return _profile(args)
     if args.cmd == "metrics-doc":
         return _metrics_doc(args)
+    if args.cmd == "lint":
+        # offline AST walk — stdlib only, never initializes jax
+        return _lint(args)
+    if args.cmd == "knobs-doc":
+        return _knobs_doc(args)
     if args.cmd == "snapshots":
         # offline store walk — no gateway connection
         return _snapshots(args)
@@ -509,6 +547,108 @@ def _metrics_doc(args) -> int:
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(content)
     print(f"wrote {path}")
+    return 0
+
+
+# -- lint: zlint, the AST invariant linter (ISSUE 10) --------------------------
+
+
+def _repo_root(arg: str | None):
+    from pathlib import Path
+
+    if arg:
+        return Path(arg)
+    # the tree this package was imported from: zeebe_tpu/cli.py -> repo root
+    return Path(__file__).resolve().parent.parent
+
+
+def _lint(args) -> int:
+    from zeebe_tpu.analysis import (
+        BASELINE_FILENAME, format_baseline, load_baseline, run_lint,
+        split_findings)
+
+    root = _repo_root(args.root)
+    baseline_path = root / BASELINE_FILENAME
+    findings = run_lint(root)
+    baseline = load_baseline(baseline_path)
+    new, stale = split_findings(findings, baseline)
+
+    if args.update_baseline:
+        baseline_path.write_text(format_baseline(findings, baseline))
+        todo = sum(1 for f in findings
+                   if baseline.get(f.baseline_key, "").strip()
+                   in ("", "TODO: justify"))
+        print(f"wrote {baseline_path} ({len({f.baseline_key for f in findings})}"
+              f" entries, {todo} needing justification)")
+        return 0
+
+    for f in new:
+        print(f.render())
+    for key in stale:
+        print(f"stale baseline entry (no longer matches anything — remove "
+              f"it): {chr(9).join(key)}", file=sys.stderr)
+    covered = len(findings) - len(new)
+    summary = (f"zlint: {len(findings)} finding(s) — {len(new)} new, "
+               f"{covered} baselined, {len(stale)} stale baseline entr(ies)")
+    # stale entries fail the gate too: a fixed violation must shrink the
+    # baseline in the same change, or the dedicated lint job and the tier-1
+    # tree-gate test would disagree about the same tree state
+    if (new or stale) and args.check:
+        print(f"{summary}\nfix the findings above, suppress inline with "
+              f"`# zlint: disable=<rule>` next to a justification, or "
+              f"refresh {BASELINE_FILENAME} via `cli lint --update-baseline` "
+              f"(new entries need a one-line justification; stale entries "
+              f"are dropped)", file=sys.stderr)
+        return 1
+    print(summary)
+    return 1 if (new or stale) else 0
+
+
+def _knobs_doc(args) -> int:
+    from pathlib import Path
+
+    from zeebe_tpu.analysis import render_knobs_doc, scan_knobs
+    from zeebe_tpu.analysis.knobs import undocumented
+
+    root = _repo_root(args.root)
+    knobs = scan_knobs(root)
+    content = render_knobs_doc(knobs)
+    path = Path(args.output)
+    if not path.is_absolute():
+        path = root / path
+    if args.check:
+        from zeebe_tpu.analysis.knobs import KNOB_NOTES
+
+        missing = undocumented(knobs)
+        if missing:
+            print(f"undocumented env knob(s): {', '.join(missing)} — add a "
+                  f"one-liner to zeebe_tpu/analysis/knobs.py::KNOB_NOTES and "
+                  f"regenerate with `python -m zeebe_tpu.cli knobs-doc`",
+                  file=sys.stderr)
+            return 1
+        stale_notes = sorted(set(KNOB_NOTES) - {k.name for k in knobs})
+        if stale_notes:
+            print(f"stale KNOB_NOTES entr(ies) with no in-tree read: "
+                  f"{', '.join(stale_notes)} — the knob was removed/renamed; "
+                  f"drop the note and regenerate", file=sys.stderr)
+            return 1
+        committed = path.read_text() if path.exists() else ""
+        if committed != content:
+            print(f"{path} drifted from the env-knob scan — regenerate with "
+                  f"`python -m zeebe_tpu.cli knobs-doc`", file=sys.stderr)
+            import difflib
+
+            diff = difflib.unified_diff(
+                committed.splitlines(), content.splitlines(),
+                fromfile=str(path), tofile="generated", lineterm="", n=1)
+            for line in list(diff)[:40]:
+                print(line, file=sys.stderr)
+            return 1
+        print(f"{path} is up to date ({len(knobs)} knobs)")
+        return 0
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(content)
+    print(f"wrote {path} ({len(knobs)} knobs)")
     return 0
 
 

@@ -335,28 +335,28 @@ class EventAppliers:
     def _element_completing(self, record: Record) -> None:
         self.state.element_instances.set_state(record.key, EI_COMPLETING)
 
-    def _element_completed(self, record: Record) -> None:
+    def _element_finished(self, record: Record, state: int) -> None:
+        """Shared completed/terminated epilogue: stamp the terminal state,
+        release the parent scope's child slot, drop the variable scope,
+        remove the instance. One body on purpose — the two intents differed
+        only in the terminal state constant and had started to drift."""
         v = record.value
         ei = self.state.element_instances
-        ei.set_state(record.key, EI_COMPLETED)
+        ei.set_state(record.key, state)
         scope_key = v.get("flowScopeKey", -1)
         if scope_key >= 0:
             ei.remove_child(scope_key)
         self.state.variables.remove_scope(record.key)
         ei.remove(record.key)
+
+    def _element_completed(self, record: Record) -> None:
+        self._element_finished(record, EI_COMPLETED)
 
     def _element_terminating(self, record: Record) -> None:
         self.state.element_instances.set_state(record.key, EI_TERMINATING)
 
     def _element_terminated(self, record: Record) -> None:
-        v = record.value
-        ei = self.state.element_instances
-        ei.set_state(record.key, EI_TERMINATED)
-        scope_key = v.get("flowScopeKey", -1)
-        if scope_key >= 0:
-            ei.remove_child(scope_key)
-        self.state.variables.remove_scope(record.key)
-        ei.remove(record.key)
+        self._element_finished(record, EI_TERMINATED)
 
     def _sequence_flow_taken(self, record: Record) -> None:
         v = record.value

@@ -455,6 +455,21 @@ class JobState:
     def state_of(self, key: int) -> int | None:
         return self._states.get((key,))
 
+    @staticmethod
+    def any_activatable_committed(db: ZbDb, job_type: str,
+                                  tenant_ids: list[str] | None = None) -> bool:
+        """Lock-free long-poll peek at the COMMITTED activatable index —
+        the cross-thread twin of :meth:`activatable_keys`. Gateway threads
+        must never open the processing-owned transaction slot
+        (committed-read discipline, enforced by zlint's
+        committed-read-discipline rule); the key-index read costs one
+        bisect and no value materialization."""
+        if tenant_ids is None:
+            return bool(db.committed_keys_of(CF.JOB_ACTIVATABLE, (job_type,)))
+        return any(
+            db.committed_keys_of(CF.JOB_ACTIVATABLE, (job_type, tenant))
+            for tenant in tenant_ids)
+
     def activatable_keys(self, job_type: str, limit: int,
                          tenant_ids: list[str] | None = None) -> list[int]:
         """Activatable job keys of a type, optionally restricted to the

@@ -316,9 +316,14 @@ class ClusterRuntime(GatewayRuntimeBase):
             leader = self._leader_partition(partition_id)
             if leader is None or leader.db is None:
                 return False
-            with leader.db.transaction():
-                return bool(leader.engine.state.jobs.activatable_keys(
-                    job_type, 1, tenant_ids))
+            # committed-read discipline: long-poll peeks run off the pump
+            # thread — read the committed activatable index, never the
+            # processing-owned transaction slot (zlint caught the old
+            # `with leader.db.transaction()` here racing processing)
+            from zeebe_tpu.engine.engine_state import JobState
+
+            return JobState.any_activatable_committed(
+                leader.db, job_type, tenant_ids)
         finally:
             lock.release()
 

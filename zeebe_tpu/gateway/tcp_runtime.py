@@ -181,11 +181,13 @@ class TcpClusterRuntime(GatewayRuntimeBase):
         with self._lock:
             partition = self.broker.partitions.get(partition_id)
             if partition is not None and partition.is_leader and partition.db is not None:
-                with partition.db.transaction():
-                    return bool(
-                        partition.engine.state.jobs.activatable_keys(
-                            job_type, 1, tenant_ids)
-                    )
+                # committed-read discipline: this runs on a gateway thread —
+                # opening the processing-owned transaction slot here raced
+                # the pump thread's own transaction (zlint caught it)
+                from zeebe_tpu.engine.engine_state import JobState
+
+                return JobState.any_activatable_committed(
+                    partition.db, job_type, tenant_ids)
         # remote leader: no cheap peek — let the long-poll try a real
         # activation (an empty JOB_BATCH comes back quickly)
         return True

@@ -38,7 +38,6 @@ PR 6 recovery budgets (incremental snapshot chains, compaction guards).
 from __future__ import annotations
 
 import dataclasses
-import json
 import os
 import random
 import time
@@ -418,26 +417,11 @@ class ScaleSoakHarness:
 
     def _collect_flight_dumps(self, label: str, node_id: str,
                               since_ms: int) -> None:
-        data_dir = self.cluster.directory / node_id
-        found = False
-        for path in sorted(data_dir.glob("flight-*.json")):
-            if str(path) in self.flight_dumps:
-                continue
-            try:
-                dump = json.loads(Path(path).read_text())
-            except (OSError, ValueError):
-                self.violations.append(f"{label}: unreadable flight dump {path}")
-                continue
-            if dump.get("dumpedAtMs", 0) < since_ms:
-                continue
-            self.flight_dumps.append(str(path))
-            if any(ev.get("kind") == "recovery"
-                   for ring in dump.get("partitions", {}).values()
-                   for ev in ring):
-                found = True
-        if not found:
-            self.violations.append(
-                f"{label}: no flight dump carries the recovery event")
+        from zeebe_tpu.testing.evidence import collect_flight_dumps
+
+        collect_flight_dumps(self.cluster.directory / node_id,
+                             self.flight_dumps, since_ms, label,
+                             self.violations)
 
     # -- probes ----------------------------------------------------------------
 

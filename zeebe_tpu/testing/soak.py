@@ -34,7 +34,6 @@ flight recorder).
 from __future__ import annotations
 
 import dataclasses
-import json
 from pathlib import Path
 from typing import Any
 
@@ -336,32 +335,11 @@ class SoakHarness:
 
     def _collect_flight_dumps(self, round_no: int, node_id: str,
                               since_ms: int) -> None:
-        """The partition dumps the flight rings itself when a recovery
-        completes; the soak verifies each restart left such an artifact —
-        a readable dump, newer than the restart, whose rings carry the
-        recovery event."""
-        data_dir = self.cluster.directory / node_id
-        found = False
-        for path in sorted(data_dir.glob("flight-*.json")):
-            if str(path) in self.flight_dumps:
-                continue
-            try:
-                dump = json.loads(Path(path).read_text())
-            except (OSError, ValueError):
-                self.violations.append(
-                    f"round {round_no}: flight dump {path} is unreadable")
-                continue
-            if dump.get("dumpedAtMs", 0) < since_ms:
-                continue
-            self.flight_dumps.append(str(path))
-            if any(ev.get("kind") == "recovery"
-                   for ring in dump.get("partitions", {}).values()
-                   for ev in ring):
-                found = True
-        if not found:
-            self.violations.append(
-                f"round {round_no}: no flight dump carries the recovery "
-                f"event for this restart")
+        from zeebe_tpu.testing.evidence import collect_flight_dumps
+
+        collect_flight_dumps(self.cluster.directory / node_id,
+                             self.flight_dumps, since_ms,
+                             f"round {round_no}", self.violations)
 
     # -- final invariants ------------------------------------------------------
 
