@@ -1499,6 +1499,64 @@ def _consistency_main(quick: bool) -> None:
         raise SystemExit(1)
 
 
+def _serving_main(quick: bool) -> None:
+    """--serving: the open-loop SLO'd serving gate (ISSUE 11). Drives the
+    real multi-process cluster with seeded Poisson arrivals from hundreds
+    of concurrent client streams — per-tenant quotas with one hot tenant at
+    5x its quota, a diurnal ramp, a correlation storm waking cold-parked
+    instances, and a live worker kill — then gates on the well-behaved
+    tenants' p50/p99 ack latency (open-loop: dispatch queueing counts),
+    fairness vs the calm baseline, typed-and-fast sheds, goodput vs the
+    no-chaos window, and zero acked loss against the workers' journals.
+    Writes SERVING[_quick].json; violations fail the run."""
+    import shutil
+    import time as _time
+
+    from zeebe_tpu.testing.serving import FULL_CONFIG, ServingConfig, run_serving
+
+    cfg = ServingConfig() if quick else FULL_CONFIG
+    started = _time.perf_counter()
+    work_dir = tempfile.mkdtemp(prefix="zeebe-serving-")
+    try:
+        report = run_serving(cfg, directory=work_dir)
+    finally:
+        # collect dumps BEFORE the work dir is deleted, even when the run
+        # raised — a failed gate is exactly the run whose flight evidence
+        # the CI artifact upload must keep
+        from pathlib import Path as _Path
+
+        dumps = _collect_gate_dumps(
+            sorted(_Path(work_dir).glob("*/flight-*.json")),
+            "SERVING_dumps", work_dir)
+        shutil.rmtree(work_dir, ignore_errors=True)
+    report["flightDumps"] = dumps
+    report["wallSecondsTotal"] = round(_time.perf_counter() - started, 2)
+    report["quick"] = quick
+    name = "SERVING_quick.json" if quick else "SERVING.json"
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "serving": True, "quick": quick, "seed": report["seed"],
+        "requests": report["requests"],
+        "ackedCommands": report["ackedCommands"],
+        "shedCommands": report["shedCommands"],
+        "kills": report["kills"],
+        "wellBehavedP99MsUnderLoad": report.get(
+            "wellBehaved", {}).get("underLoad", {}).get("p99Ms"),
+        "goodput": report.get("goodput"),
+        "parkedColdBeforeStorm": report.get(
+            "stormPool", {}).get("parkedColdBeforeStorm"),
+        "violations": len(report["violations"]),
+        "full_results": name,
+    }))
+    if report["violations"]:
+        for v in report["violations"][:20]:
+            print(f"serving violation: {v}", file=sys.stderr)
+        raise SystemExit(1)
+
+
 def _scale_soak_main(quick: bool) -> None:
     """--scale-soak: the million-instance state-tiering gate (ISSUE 8).
     Parks 1M+ instances (100k in --quick) on a tiered-state broker under
@@ -1691,7 +1749,7 @@ def _mesh_main(counts_spec: str, gate: bool, platform: str) -> None:
 def main(quick: bool = False, trace: bool = False,
          sample_metrics: bool = False, profile: bool = False,
          soak: bool = False, scale_soak: bool = False,
-         consistency: bool = False) -> None:
+         consistency: bool = False, serving: bool = False) -> None:
     # install the filter BEFORE any backend use: the mismatch warning fires
     # whenever a persistent-cache executable loads, including the probe's
     # subprocess (which inherits the filtered fd 2)
@@ -1700,6 +1758,10 @@ def main(quick: bool = False, trace: bool = False,
         # worker processes probe/pin their own backends; the harness itself
         # never touches a device
         _consistency_main(quick)
+        return
+    if serving:
+        # same posture: the gateway-side harness never touches a device
+        _serving_main(quick)
         return
     platform = _ensure_backend()
     if soak:
@@ -1880,6 +1942,17 @@ if __name__ == "__main__":
                          "zero acked-record loss, byte-identical "
                          "re-exports, and recovery within budget. Writes "
                          "SCALE_SOAK[_quick].json")
+    ap.add_argument("--serving", action="store_true",
+                    help="open-loop SLO'd serving gate (ISSUE 11): seeded "
+                         "Poisson arrivals from hundreds of client streams "
+                         "over the real multi-process cluster — per-tenant "
+                         "quotas, one hot tenant at 5x quota, a diurnal "
+                         "ramp, a correlation storm waking cold-parked "
+                         "instances, and a live worker kill; gates on "
+                         "well-behaved p50/p99 ack latency, fairness, "
+                         "typed-and-fast sheds, goodput vs the no-chaos "
+                         "window, and zero acked loss. Writes "
+                         "SERVING[_quick].json")
     ap.add_argument("--interleave", metavar="A,B",
                     help="interleaved same-box A/B comparison: alternate the "
                          "two named scenarios --rounds times and report "
@@ -1910,4 +1983,4 @@ if __name__ == "__main__":
         main(quick=_args.quick, trace=_args.trace,
              sample_metrics=_args.sample_metrics, profile=_args.profile,
              soak=_args.soak, scale_soak=_args.scale_soak,
-             consistency=_args.consistency)
+             consistency=_args.consistency, serving=_args.serving)

@@ -661,6 +661,30 @@ class TestTopView:
         frame = _render_top({})  # must not crash on a degenerate payload
         assert "0 broker(s)" in frame
 
+    def test_render_top_admission_section(self):
+        from zeebe_tpu.cli import _render_top
+
+        status = dict(self.STATUS)
+        status["admission"] = {
+            "enabled": True, "shedLevel": 2, "draining": True,
+            "observedP99Ms": 1834.2, "shedP99TargetMs": 1000.0,
+            "inflight": 37, "maxInflight": 256,
+            "tenants": {
+                "t-hot": {"admitted": 206, "shed": 520,
+                          "shedByReason": {"tenant-quota": 520},
+                          "inflight": 30, "quotaRate": 8.0, "weight": 1.0},
+                "t-well": {"admitted": 400, "shed": 0, "shedByReason": {},
+                           "inflight": 7, "quotaRate": None, "weight": 2.0},
+            },
+        }
+        frame = _render_top(status)
+        assert "ADMISSION" in frame and "shed level 2" in frame
+        assert "DRAINING" in frame
+        assert "t-hot" in frame and "520" in frame
+        # unmetered tenant renders a dash, not None
+        well_line = next(l for l in frame.splitlines() if "t-well" in l)
+        assert " - " in well_line or well_line.rstrip().split()[-2] == "-"
+
     def test_top_once_against_live_server(self, management, capsys):
         from zeebe_tpu.cli import main as cli_main
 

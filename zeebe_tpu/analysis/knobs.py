@@ -75,6 +75,10 @@ KNOB_NOTES: dict[str, str] = {
     "ZEEBE_BROKER_METRICS_SAMPLINGINTERVALMS": (
         "registry→time-series sampling cadence (0 disables the store, "
         "sampler, and alert evaluation)"),
+    "ZEEBE_BROKER_NETWORK_MAXOUTBOUNDBUFFERBYTES": (
+        "zombie-client protection: per-stream outbound buffer bound — a "
+        "connected peer that stops reading is disconnected once this many "
+        "bytes buffer (default 8MiB)"),
     "ZEEBE_BROKER_NETWORK_SECURITY_CERTIFICATEAUTHORITYPATH": (
         "TLS: CA bundle path for cluster messaging"),
     "ZEEBE_BROKER_NETWORK_SECURITY_CERTIFICATECHAINPATH": (
@@ -112,9 +116,35 @@ KNOB_NOTES: dict[str, str] = {
     "ZEEBE_GATEWAY_INTERCEPTORS_": (
         "prefix family: external gateway interceptor loading — "
         "`…_<ID>_CLASSNAME` / `…_<ID>_PATH` (utils/external_code.py)"),
+    "ZEEBE_GATEWAY_ADMISSION_DRAINAFTERMS": (
+        "admission: /ready degrades after shedding NEW WORK for this long, "
+        "so an LB can drain the gateway (0 disables; default 10s)"),
+    "ZEEBE_GATEWAY_ADMISSION_ENABLED": (
+        "tenant-aware admission + cooperative load shedding at the gateway "
+        "and worker ingress (default true)"),
+    "ZEEBE_GATEWAY_ADMISSION_MAXINFLIGHT": (
+        "admission: in-flight command window for the weighted-fair tenant "
+        "share (default 256; workers derive theirs from the partition "
+        "backpressure limits)"),
+    "ZEEBE_GATEWAY_ADMISSION_SHEDP99MS": (
+        "admission: shed-ladder target — the shed level rises while the "
+        "observed ack p99 exceeds this (ms, default 1000; hysteresis "
+        "recovers below half)"),
     "ZEEBE_GATEWAY_REQUEST_TIMEOUT_MS": (
         "multi-process gateway: per-request routing deadline (bounded "
         "resend across workers)"),
+    "ZEEBE_GATEWAY_TENANT_DEFAULTBURST": (
+        "admission: default per-tenant token-bucket burst (0 derives "
+        "2x rate)"),
+    "ZEEBE_GATEWAY_TENANT_DEFAULTRATE": (
+        "admission: default per-tenant token-bucket quota (tokens/s; "
+        "0 = unmetered)"),
+    "ZEEBE_GATEWAY_TENANT_QUOTAS": (
+        "admission: per-tenant quota overrides, "
+        "`tenant=rate[:burst],...` (e.g. `t-hot=8:16,t-batch=50`)"),
+    "ZEEBE_GATEWAY_TENANT_WEIGHTS": (
+        "admission: per-tenant weights for the fair in-flight share, "
+        "`tenant=weight,...` (default 1.0)"),
     "ZEEBE_GATEWAY_SECURITY_AUTHENTICATION_MODE": (
         "gateway auth mode: `none` (default) or `identity` (JWT)"),
     "ZEEBE_GATEWAY_SECURITY_AUTHENTICATION_SECRET": (

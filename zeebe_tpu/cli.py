@@ -317,6 +317,27 @@ def _render_top(status: dict) -> str:
             f"{int(rates.get('exportLagRecords', 0)):>7} "
             f"{parked:>8} "
             f"{row.get('alertsFiring', 0):>6}")
+    admission = status.get("admission")
+    if admission and (admission.get("tenants") or admission.get("shedLevel")):
+        # tenant admission (ISSUE 11): per-tenant rate/shed/queue evidence —
+        # the first place to look when one tenant's p99 moves
+        lines.append("")
+        lines.append(
+            f"ADMISSION · shed level {admission.get('shedLevel', 0)} · "
+            f"p99 {admission.get('observedP99Ms', 0.0)}ms "
+            f"(target {admission.get('shedP99TargetMs', '?')}ms) · "
+            f"in-flight {admission.get('inflight', 0)}"
+            f"/{admission.get('maxInflight', '?')}"
+            + (" · DRAINING" if admission.get("draining") else ""))
+        lines.append(f"{'TENANT':<18} {'ADMITTED':>9} {'SHED':>7} "
+                     f"{'INFLIGHT':>8} {'QUOTA/S':>8} {'WEIGHT':>6}")
+        for tenant, row in sorted(admission.get("tenants", {}).items()):
+            quota = row.get("quotaRate")
+            lines.append(
+                f"{tenant:<18} {row.get('admitted', 0):>9} "
+                f"{row.get('shed', 0):>7} {row.get('inflight', 0):>8} "
+                f"{(f'{quota:g}' if quota else '-'):>8} "
+                f"{row.get('weight', 1.0):>6}")
     workers = status.get("workers")
     if workers:
         # multi-process deployment: the supervisor's per-worker view —
@@ -491,6 +512,12 @@ def _register_metrics_scenario() -> None:
     # ISSUE 9 family: the gateway's bounded-resend deadline counter lives
     # at module level in the multi-process runtime
     import zeebe_tpu.multiproc.runtime  # noqa: F401
+    # ISSUE 11 families: tenant admission (module-level) + one controller so
+    # the labeled gauges/histogram exist; messaging's zombie-client counter
+    import zeebe_tpu.cluster.messaging  # noqa: F401
+    from zeebe_tpu.gateway.admission import AdmissionCfg, AdmissionController
+
+    AdmissionController(AdmissionCfg(), node_id="gateway")
     from zeebe_tpu.gateway.gateway import _wrap
 
     def Topology(request, context):  # noqa: N802 — rpc-shaped name

@@ -27,6 +27,32 @@ from zeebe_tpu.protocol.msgpack import packb, unpackb
 
 logger = logging.getLogger("zeebe_tpu.messaging")
 
+# slow-client / zombie-client protection (ISSUE 11): a connected peer that
+# stops reading must not wedge this process's send path or buffer frames
+# without bound — once a connection's outbound transport buffer exceeds
+# this, the connection is dropped (the peer reconnects when it recovers;
+# Raft retries, the gateway resend loop re-sends) and a metric counts it
+DEFAULT_MAX_OUTBOUND_BUFFER_BYTES = 8 * 1024 * 1024
+
+
+def _max_outbound_buffer_bytes() -> int:
+    import os
+
+    try:
+        return int(os.environ.get(
+            "ZEEBE_BROKER_NETWORK_MAXOUTBOUNDBUFFERBYTES", ""))
+    except ValueError:
+        return DEFAULT_MAX_OUTBOUND_BUFFER_BYTES
+
+
+from zeebe_tpu.utils.metrics import REGISTRY as _REG  # noqa: E402
+
+_M_STREAM_OVERFLOW = _REG.counter(
+    "messaging_stream_overflow_disconnects_total",
+    "outbound connections dropped because the peer stopped reading and the "
+    "buffered frames exceeded the per-stream bound (zombie-client "
+    "protection)", ("peer",))
+
 # a topic's first embedded integer is its partition id (raft-3-append,
 # inter-partition-3, command-api-3, raft-reconfigure-3); control topics
 # (swim-probe, gateway-response, …) carry none
@@ -276,6 +302,10 @@ class TcpMessagingService(MessagingService):
         self._started = threading.Event()
         self._inbox: deque[tuple[str, str, Any]] = deque()
         self._inbox_lock = threading.Lock()
+        # per-stream outbound bound: read once (env) so the send hot path
+        # never touches os.environ
+        self.max_outbound_buffer_bytes = _max_outbound_buffer_bytes()
+        self.stream_overflow_disconnects = 0
 
     def subscribe(self, topic: str, handler: Handler) -> None:
         self.handlers[topic] = handler
@@ -375,6 +405,17 @@ class TcpMessagingService(MessagingService):
             pass
 
     def send(self, member_id: str, topic: str, payload: Any) -> None:
+        if member_id == self.member_id:
+            # self-delivery via the inbox, not TCP: a worker leading BOTH
+            # sides of an inter-partition send (deployment distribution,
+            # message correlation) addresses itself — it is never in its own
+            # peers table, and the loopback network's self-delivery is the
+            # semantics every caller was written against. Dropping these
+            # silently stalled cross-partition distribution whenever two
+            # partitions' leaderships landed on one worker.
+            with self._inbox_lock:
+                self._inbox.append((topic, member_id, payload))
+            return
         if self._loop is None:
             raise RuntimeError("messaging not started")
         self._loop.call_soon_threadsafe(
@@ -408,7 +449,27 @@ class TcpMessagingService(MessagingService):
                     self._loop.create_task(
                         self._watch_peer(member_id, reader, writer))
                 writer.write(_FRAME.pack(len(data)) + data)
-                await writer.drain()
+                # NO drain(): a peer that stops reading (zombie client)
+                # would park this task — and every later send's task —
+                # forever while the transport buffer grows without bound.
+                # Instead the buffer is checked against a hard per-stream
+                # cap: past it the connection is aborted (frames dropped,
+                # counted) and the peer gets a fresh connection when it
+                # reads again. Write errors surface via the peer watcher's
+                # EOF eviction + the reconnect retry above.
+                if (writer.transport.get_write_buffer_size()
+                        > self.max_outbound_buffer_bytes):
+                    self.stream_overflow_disconnects += 1
+                    _M_STREAM_OVERFLOW.labels(member_id).inc()
+                    logger.warning(
+                        "dropping outbound connection to %s: peer stopped "
+                        "reading (%d bytes buffered > %d bound)",
+                        member_id,
+                        writer.transport.get_write_buffer_size(),
+                        self.max_outbound_buffer_bytes)
+                    if self._writers.get(member_id) is writer:
+                        self._writers.pop(member_id, None)
+                    writer.transport.abort()
                 return
             except (ConnectionError, OSError):
                 stale = self._writers.pop(member_id, None)

@@ -32,6 +32,7 @@ import os
 import threading
 import time
 
+from zeebe_tpu.gateway.admission import AdmissionCfg, AdmissionController
 from zeebe_tpu.gateway.broker_client import (
     DeadlineExceededError,
     GatewayRuntimeBase,
@@ -79,7 +80,8 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
                  partition_count: int, replication_factor: int = 1,
                  bind: tuple[str, int] | None = None,
                  supervisor=None, messaging=None,
-                 gateway_members: list[str] | None = None) -> None:
+                 gateway_members: list[str] | None = None,
+                 admission: AdmissionController | None = None) -> None:
         self.node_id = node_id
         self.partition_count = partition_count
         self.replication_factor = replication_factor
@@ -112,6 +114,12 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
         from zeebe_tpu.observability.flight_recorder import FlightRecorder
 
         self.flight = FlightRecorder(node_id, data_dir=None)
+        # tenant-aware admission + cooperative shedding (ISSUE 11): every
+        # client command passes the controller before it is routed; sheds
+        # are typed RESOURCE_EXHAUSTED and land in this flight recorder
+        self.admission = admission if admission is not None else \
+            AdmissionController(AdmissionCfg.from_env(), node_id=node_id,
+                                flight=self.flight)
         self.routing_epoch = 0
         self._last_leaders: dict[int, str | None] = {}
         if supervisor is not None:
@@ -142,6 +150,9 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
     def _run(self) -> None:
         poll = self.messaging.poll
         while self._running:
+            # the shed ladder's feedback loop rides the poll thread
+            # (throttled internally to its tick interval)
+            self.admission.tick()
             if poll() == 0:
                 time.sleep(0.001)
 
@@ -165,7 +176,12 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
                 stop()
 
     def ready(self) -> bool:
-        """Readiness: every partition has a live (non-stale) leader."""
+        """Readiness: every partition has a live (non-stale) leader AND the
+        admission controller is not draining (sustained shedding of new work
+        degrades /ready so an LB can rotate this gateway out while
+        completions keep draining)."""
+        if self.admission.draining:
+            return False
         return all(self._leader_of(p) is not None
                    for p in range(1, self.partition_count + 1))
 
@@ -288,6 +304,9 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
             "brokers": rows,
         }
         out["routingEpoch"] = self.routing_epoch
+        # admission + shed counters ride /cluster/status (ISSUE 11): the
+        # gateway's own gate plus whatever the workers pushed in their rows
+        out["admission"] = self.admission.snapshot()
         if self.supervisor is not None:
             out["workers"] = self.supervisor.status()
         return out
@@ -336,13 +355,42 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
 
         if not 1 <= partition_id <= self.partition_count:
             raise NoLeaderError(f"unknown partition {partition_id}")
+        # tenant admission (ISSUE 11): typed, fast shed — no routing, no
+        # worker round trip, no queue. The caller sees RESOURCE_EXHAUSTED
+        # with the reason; the flight recorder carries the evidence.
+        shed_reason, tenant, _priority = self.admission.try_admit(record)
+        if shed_reason is not None:
+            if meta is not None:
+                meta.update(tenant=tenant, shed=shed_reason)
+            raise ResourceExhaustedError(
+                f"admission shed ({shed_reason}): tenant {tenant!r} on "
+                f"partition {partition_id} (shed level "
+                f"{self.admission.shed_level})")
+        if meta is not None:
+            meta.update(tenant=tenant)
+        t_admitted = time.perf_counter()
+        # feed the shed ladder only latencies that measure the CLUSTER:
+        # engine replies and deadline expiries. Typed fast errors
+        # (backpressure, not-leader) would read as "fast" and mask overload.
+        observe_latency = False
         tracer = get_tracer()
         traced = tracer.enabled
         t_submit = time.perf_counter() if traced else 0.0
-        request_id, event = self._register_request()
-        rec = record.replace(request_id=request_id,
-                             request_stream_id=self._stream_id)
-        payload = {"record": rec.to_bytes(), "requestId": request_id}
+        request_id = None
+        try:
+            request_id, event = self._register_request()
+            rec = record.replace(request_id=request_id,
+                                 request_stream_id=self._stream_id)
+            payload = {"record": rec.to_bytes(), "requestId": request_id}
+        except BaseException:
+            # nothing was sent: the admitted in-flight slot must not leak —
+            # an unserializable record value (to_bytes raising) would
+            # otherwise inflate this tenant's count until the fair-share
+            # gate sheds everyone forever
+            if request_id is not None:
+                self._pending.pop(request_id, None)
+            self.admission.release(tenant)
+            raise
         effective_timeout = min(timeout_s, request_timeout_s())
         deadline = time.time() + effective_timeout
         sent_to: str | None = None
@@ -412,6 +460,7 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
                 if response is None:  # pragma: no cover — resolver raced
                     break  # deadline path below
                 if "record" in response:
+                    observe_latency = True
                     result: Record = response["record"]
                     _fill_meta(
                         commandPosition=response.get("commandPosition", -1),
@@ -425,10 +474,13 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
                     return result
                 # typed error frame
                 kind = response.get("type")
-                if kind == "backpressure":
+                if kind in ("backpressure", "resource-exhausted"):
+                    # resource-exhausted: the WORKER's admission controller
+                    # shed it (tenant quota / fair share / shed ladder) —
+                    # same typed surface as partition backpressure
                     _fill_meta(error=kind)
                     raise ResourceExhaustedError(
-                        response.get("message", "backpressure"))
+                        response.get("message", kind))
                 if kind in ("not-leader", "unavailable"):
                     # the worker did NOT append this request: safe to
                     # re-route the same request id once fresher status
@@ -454,6 +506,8 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
                 raise NoLeaderError(
                     response.get("message", f"worker error {kind!r}"))
             _M_REQUEST_TIMEOUTS.labels(str(partition_id)).inc()
+            # a deadline IS an overload observation: feed it to the ladder
+            observe_latency = True
             _fill_meta(error="deadline")
             raise DeadlineExceededError(
                 f"partition {partition_id} request {request_id} exceeded the "
@@ -463,6 +517,10 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
         finally:
             self._pending.pop(request_id, None)
             self._responses.pop(request_id, None)
+            self.admission.release(
+                tenant,
+                latency_ms=((time.perf_counter() - t_admitted) * 1000.0
+                            if observe_latency else None))
 
     def _emit_root_span(self, tracer, partition_id: int, record: Record,
                         response: Record, position: int, request_id: int,

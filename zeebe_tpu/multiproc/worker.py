@@ -91,6 +91,24 @@ class WorkerRuntime:
 
         self._inflight_positions: OrderedDict[tuple, int] = OrderedDict()
         self._recent_replies: OrderedDict[tuple, dict] = OrderedDict()
+        # tenant-aware admission in front of the per-partition backpressure
+        # limiters (ISSUE 11): the worker's own gate — a multi-gateway
+        # deployment cannot rely on any single gateway's buckets. Sheds are
+        # typed `resource-exhausted` frames (the gateway maps them to
+        # RESOURCE_EXHAUSTED) and the shed ladder's feedback signal is the
+        # observed append→reply latency, read back through the broker's
+        # time-series store when the metrics plane is on (signal latency =
+        # one sampler tick) or the controller's own window otherwise.
+        from zeebe_tpu.gateway.admission import AdmissionCfg, AdmissionController
+
+        self.admission = AdmissionController(
+            AdmissionCfg.from_env(), node_id=node_id,
+            clock_millis=lambda: float(self.broker.clock_millis()),
+            flight=self.broker.flight_recorder,
+            max_inflight_fn=self._admission_window,
+            p99_source=self._store_p99)
+        self._inflight_tenants: OrderedDict[tuple, tuple[str, int]] = \
+            OrderedDict()
         # chaos seam (ISSUE 9): crash THIS process between a successful
         # append and its reply after N ingress appends — one-shot per data
         # dir (a marker file disarms it after the restart), letting the
@@ -117,6 +135,42 @@ class WorkerRuntime:
             messaging.subscribe(
                 f"{CLIENT_COMMAND_TOPIC}-{pid}",
                 lambda s, p, pid=pid: self._on_client_command(pid, s, p))
+
+    # -- admission plumbing ----------------------------------------------------
+
+    def _admission_window(self) -> int:
+        """The weighted-fair share's window: the sum of the LEADER
+        partitions' current adaptive backpressure limits — admission sits
+        exactly in front of the limiters, so its window is theirs."""
+        total = 0
+        for partition in self.broker.partitions.values():
+            if partition.is_leader and partition.limiter is not None:
+                total += partition.limiter.limit
+        return total
+
+    def _store_p99(self) -> float | None:
+        """Shed signal from the Gorilla plane: the sampler distills the
+        controller's own ack-latency histogram into a retained ``:p99``
+        series; a stale sample (idle broker, sampler off) yields None so
+        the controller falls back to its in-process window."""
+        store = getattr(self.broker, "timeseries", None)
+        if store is None:
+            return None
+        now_ms = self.broker.clock_millis()
+        values = [entry["value"]
+                  for entry in store.latest("zeebe_admission_ack_latency_ms:p99")
+                  if self.node_id in entry["labels"]
+                  and now_ms - entry["t"] <= 15_000]
+        return max(values) if values else None
+
+    def _release_admission(self, dedupe_key: tuple,
+                           observe: bool = True) -> None:
+        entry = self._inflight_tenants.pop(dedupe_key, None)
+        if entry is not None:
+            tenant, t0 = entry
+            latency = float(self.broker.clock_millis() - t0) if observe \
+                else None
+            self.admission.release(tenant, latency_ms=latency)
 
     # -- command ingress -------------------------------------------------------
 
@@ -184,12 +238,26 @@ class WorkerRuntime:
             while len(self._inflight_positions) > _MAX_INFLIGHT:
                 self._inflight_positions.popitem(last=False)
             return
+        # tenant admission (ISSUE 11) — AFTER the dedupe consults (a resend
+        # of an already-appended request must reach its stored answer, not
+        # a shed) and BEFORE the partition limiter, so one hot tenant
+        # exhausts its own share instead of the whole in-flight window
+        shed_reason, tenant, _priority = self.admission.try_admit(record)
+        if shed_reason is not None:
+            self._reply_error(
+                sender, request_id, "resource-exhausted",
+                f"admission shed ({shed_reason}): tenant {tenant!r} on "
+                f"partition {partition_id} (shed level "
+                f"{self.admission.shed_level})")
+            return
         try:
             position = partition.client_write(record)
         except BackpressureExceeded as exc:
+            self.admission.release(tenant)
             self._reply_error(sender, request_id, "backpressure", str(exc))
             return
         if position is None:
+            self.admission.release(tenant)
             self._reply_error(sender, request_id, "unavailable",
                               f"partition {partition_id} paused or disk-paused")
             return
@@ -197,6 +265,14 @@ class WorkerRuntime:
         self._inflight_positions[dedupe_key] = position
         while len(self._inflight_positions) > _MAX_INFLIGHT:
             self._inflight_positions.popitem(last=False)
+        self._inflight_tenants[dedupe_key] = (tenant,
+                                              self.broker.clock_millis())
+        while len(self._inflight_tenants) > _MAX_INFLIGHT:
+            # evicted entries (gateway gave up; no reply will come) still
+            # release their in-flight slot — a leak here would slowly
+            # starve the tenant's fair share
+            stale_key = next(iter(self._inflight_tenants))
+            self._release_admission(stale_key, observe=False)
         tracer = get_tracer()
         if tracer.enabled:
             # cross-process Dapper discipline: the trace id is DERIVED
@@ -239,6 +315,8 @@ class WorkerRuntime:
         if target == self.node_id:
             return  # workers never originate client requests
         dedupe_key = (target, response.request_id)
+        # the append→reply latency IS the shed ladder's feedback signal
+        self._release_admission(dedupe_key)
         payload = {
             "requestId": response.request_id,
             "record": response.record.to_bytes(),
@@ -267,6 +345,11 @@ class WorkerRuntime:
 
         status = broker_status(self.broker)
         status["workerPid"] = os.getpid()
+        if self.admission.cfg.enabled:
+            # per-worker admission evidence rides the status row the same
+            # way recovery accounting does — /cluster/status and `cli top`
+            # see every worker's tenant rates/sheds without an extra hop
+            status["admission"] = self.admission.snapshot()
         recoveries = {
             str(pid): p.last_recovery
             for pid, p in self.broker.partitions.items()
@@ -297,6 +380,8 @@ class WorkerRuntime:
         if poll is not None:
             moved += poll()
         moved += self.broker.pump()
+        # shed-ladder feedback loop (throttled internally to its tick)
+        self.admission.tick(float(self.broker.clock_millis()))
         self.maybe_send_status()
         return moved
 

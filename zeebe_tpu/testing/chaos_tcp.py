@@ -334,6 +334,60 @@ class ChaosTcpMessagingService:
             pass
 
 
+class ZombiePeer:
+    """Slow-client / zombie-client chaos seam (ISSUE 11): a listening TCP
+    endpoint that ACCEPTS connections and never reads a byte — the shape of
+    a client stream that wedged mid-download or a gateway whose process is
+    SIGSTOPped. Register its address as a peer of a
+    :class:`~zeebe_tpu.cluster.messaging.TcpMessagingService` and keep
+    sending: the kernel receive window (shrunk via ``recv_buffer``) fills,
+    the sender's transport buffer grows, and the sender's per-stream
+    outbound bound must disconnect-on-overflow instead of blocking its pump
+    or buffering without limit."""
+
+    def __init__(self, host: str = "127.0.0.1", recv_buffer: int = 4096):
+        import socket
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # tiny receive buffer BEFORE listen so accepted sockets inherit it:
+        # the kernel-side window fills after a few frames instead of 100s
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, recv_buffer)
+        self._sock.bind((host, 0))
+        self._sock.listen(16)
+        self.address: tuple[str, int] = self._sock.getsockname()
+        self.accepted = 0
+        self._conns: list = []
+        self._closing = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True,
+                                        name="zombie-peer")
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        self._sock.settimeout(0.2)
+        while not self._closing.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                continue
+            # never read: the connection stays open, the window stays shut
+            self.accepted += 1
+            self._conns.append(conn)
+
+    def close(self) -> None:
+        self._closing.set()
+        self._thread.join(timeout=2)
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
 def maybe_wrap_chaos(messaging, env: dict | None = None):
     """Wrap ``messaging`` in a :class:`ChaosTcpMessagingService` when
     ``ZEEBE_CHAOS_TCP`` is set; pass it through untouched otherwise."""

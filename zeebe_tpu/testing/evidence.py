@@ -3,7 +3,7 @@ preservation — ONE home (the ``_collect_gate_dumps`` consolidation started
 in PR 9, finished here after zlint's drift-copy rule caught the
 ``_collect_flight_dumps`` twins in the soak and scale-soak harnesses).
 
-Two protocols, each used by every chaos gate:
+Protocols, each used by every chaos gate:
 
 - :func:`collect_flight_dumps` — after a crash-restart, verify the broker
   left a readable flight dump newer than the restart whose rings carry the
@@ -11,6 +11,9 @@ Two protocols, each used by every chaos gate:
 - :func:`collect_gate_dumps` — copy a gate's flight dumps out of its
   about-to-be-deleted work dir into ``<repo>/<NAME>_dumps/`` for CI
   artifact upload.
+- :func:`percentile` — the one shared latency-percentile rule for gate
+  reports (the serving gate's SLO math must not drift from any other
+  gate's).
 """
 
 from __future__ import annotations
@@ -18,6 +21,18 @@ from __future__ import annotations
 import json
 import os
 from pathlib import Path
+
+
+def percentile(ordered: list, q: float) -> float:
+    """Nearest-rank percentile (rank = ceil(q*n)) over an ASCENDING list,
+    0 < q <= 1. Empty input yields 0.0 — a gate with no samples must gate
+    on the count, not on a synthetic latency."""
+    import math
+
+    if not ordered:
+        return 0.0
+    rank = max(math.ceil(q * len(ordered)) - 1, 0)
+    return float(ordered[min(rank, len(ordered) - 1)])
 
 
 def collect_flight_dumps(data_dir: str | Path, seen: list[str],
