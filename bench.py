@@ -1557,6 +1557,62 @@ def _serving_main(quick: bool) -> None:
         raise SystemExit(1)
 
 
+def _autotune_main(quick: bool) -> None:
+    """--autotune: the closed-loop control plane's A/B gate (ISSUE 12).
+    Offers the SAME seeded bursty open-loop schedule to the adaptive
+    broker and a panel of fixed-knob configurations (default,
+    journal-aggressive, journal-conservative, small/large coalescing) at
+    equal load over real supervised worker processes, then gates: the
+    adaptive arm beats every fixed arm on acked p99 with goodput within
+    5% of the best fixed arm, zero acked loss everywhere, every
+    adjustment a control_adjust flight event, and every knob provably
+    inside its declared bounds. Writes AUTOTUNE[_quick].json; violations
+    fail the run."""
+    import shutil
+    import time as _time
+
+    from zeebe_tpu.testing.autotune import (
+        FULL_CONFIG,
+        AutotuneConfig,
+        run_autotune,
+    )
+
+    cfg = AutotuneConfig() if quick else FULL_CONFIG
+    started = _time.perf_counter()
+    work_dir = tempfile.mkdtemp(prefix="zeebe-autotune-")
+    try:
+        report = run_autotune(cfg, work_dir)
+    finally:
+        # collect dumps BEFORE the work dir is deleted, even when the run
+        # raised — a failed gate is exactly the run whose control audit
+        # trail the CI artifact upload must keep
+        from pathlib import Path as _Path
+
+        dumps = _collect_gate_dumps(
+            sorted(_Path(work_dir).glob("*/*/flight-*.json")),
+            "AUTOTUNE_dumps", work_dir)
+        shutil.rmtree(work_dir, ignore_errors=True)
+    report["flightDumps"] = dumps
+    report["wallSecondsTotal"] = round(_time.perf_counter() - started, 2)
+    report["quick"] = quick
+    name = "AUTOTUNE_quick.json" if quick else "AUTOTUNE.json"
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "autotune": True, "quick": quick, "seed": report["seed"],
+        "offeredArrivals": report["offeredArrivals"],
+        "summary": report["summary"],
+        "violations": len(report["violations"]),
+        "full_results": name,
+    }))
+    if report["violations"]:
+        for v in report["violations"][:20]:
+            print(f"autotune violation: {v}", file=sys.stderr)
+        raise SystemExit(1)
+
+
 def _scale_soak_main(quick: bool) -> None:
     """--scale-soak: the million-instance state-tiering gate (ISSUE 8).
     Parks 1M+ instances (100k in --quick) on a tiered-state broker under
@@ -1749,7 +1805,8 @@ def _mesh_main(counts_spec: str, gate: bool, platform: str) -> None:
 def main(quick: bool = False, trace: bool = False,
          sample_metrics: bool = False, profile: bool = False,
          soak: bool = False, scale_soak: bool = False,
-         consistency: bool = False, serving: bool = False) -> None:
+         consistency: bool = False, serving: bool = False,
+         autotune: bool = False) -> None:
     # install the filter BEFORE any backend use: the mismatch warning fires
     # whenever a persistent-cache executable loads, including the probe's
     # subprocess (which inherits the filtered fd 2)
@@ -1762,6 +1819,10 @@ def main(quick: bool = False, trace: bool = False,
     if serving:
         # same posture: the gateway-side harness never touches a device
         _serving_main(quick)
+        return
+    if autotune:
+        # same posture: arms run in worker processes
+        _autotune_main(quick)
         return
     platform = _ensure_backend()
     if soak:
@@ -1953,6 +2014,15 @@ if __name__ == "__main__":
                          "typed-and-fast sheds, goodput vs the no-chaos "
                          "window, and zero acked loss. Writes "
                          "SERVING[_quick].json")
+    ap.add_argument("--autotune", action="store_true",
+                    help="closed-loop control plane A/B gate (ISSUE 12): "
+                         "the SAME seeded bursty open-loop schedule offered "
+                         "to the adaptive broker and a panel of fixed-knob "
+                         "configurations at equal load; gates on adaptive "
+                         "beating every fixed arm's acked p99 with goodput "
+                         "within 5%, zero acked loss, and a complete "
+                         "control_adjust audit trail with every knob inside "
+                         "its declared bounds. Writes AUTOTUNE[_quick].json")
     ap.add_argument("--interleave", metavar="A,B",
                     help="interleaved same-box A/B comparison: alternate the "
                          "two named scenarios --rounds times and report "
@@ -1983,4 +2053,5 @@ if __name__ == "__main__":
         main(quick=_args.quick, trace=_args.trace,
              sample_metrics=_args.sample_metrics, profile=_args.profile,
              soak=_args.soak, scale_soak=_args.scale_soak,
-             consistency=_args.consistency, serving=_args.serving)
+             consistency=_args.consistency, serving=_args.serving,
+             autotune=_args.autotune)

@@ -1,0 +1,20 @@
+"""Fixture: runtime mutations of controller-owned knobs OUTSIDE an
+actuator (control-actuation-discipline true positives)."""
+
+
+class SomewhereInTheRuntime:
+    def __init__(self, cfg, raft):
+        self.cfg = cfg
+        self.raft = raft
+
+    def react_to_load(self, rss):  # line 10
+        if rss > 1 << 30:
+            self.cfg.park_after_ms = 1_000          # flagged (line 12)
+            self.cfg.spill_batch += 128             # flagged (line 13)
+        self.raft.flush_interval_s = 0.005          # flagged (line 14)
+
+    def tune_everything(self, worker, router):
+        worker.coalesce_window_ms, router.route_threshold_s = 5.0, 0.1  # flagged (line 17)
+
+    def suppressed_with_reason(self, ladder):
+        ladder.shed_level = 2  # zlint: disable=control-actuation-discipline

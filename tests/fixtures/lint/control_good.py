@@ -1,0 +1,19 @@
+"""Fixture: the clean twin — construction seeds statics, reads are free,
+and unrelated attributes never fire control-actuation-discipline."""
+
+
+class ConfiguresAtConstruction:
+    def __init__(self, park_after_ms=30_000):
+        # construction is configuration, not a runtime decision: allowed
+        self.park_after_ms = park_after_ms
+        self.spill_batch = 256
+        self.coalesce_window_ms = 0.0
+
+    def observe(self, cfg):
+        # reads of owned knobs are always fine
+        horizon = cfg.park_after_ms - 1
+        return horizon, cfg.spill_batch
+
+    def unrelated_attribute(self):
+        self.spill_batches_processed = 3  # not an owned knob name
+        self.window_ms = 9.0

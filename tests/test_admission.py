@@ -237,7 +237,14 @@ class TestAdmissionController:
         assert ctl.draining
         kinds = [e["kind"] for ring in flight.snapshot()["partitions"].values()
                  for e in ring]
-        assert "admission_shed_level" in kinds
+        # shed-level decisions are re-homed under the shared control_adjust
+        # vocabulary (ISSUE 12): one audit schema for every feedback loop
+        assert "control_adjust" in kinds
+        adjusts = [e for ring in flight.snapshot()["partitions"].values()
+                   for e in ring if e["kind"] == "control_adjust"]
+        assert all(e["controller"] == "admission-shed-ladder"
+                   and e["knob"] == "admission.shedLevel" for e in adjusts)
+        assert any(e["after"] > e["before"] for e in adjusts)
         assert "admission_draining" in kinds
         # recovery clears the drain
         self._clear(ctl, clock, ticks=30)

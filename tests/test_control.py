@@ -1,0 +1,931 @@
+"""Closed-loop control plane (ISSUE 12): actuator framework, controller
+decision functions, raft group-commit posture safety, worker ingress
+coalescing, surfaces (/control, status rows, `cli top` CONTROL), and the
+seeded 5k-sample fuzz keeping every knob inside its declared bounds.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from zeebe_tpu.control import (
+    Actuator,
+    CoalescingController,
+    ControlCfg,
+    JournalFlushController,
+    RoutingController,
+    SignalReader,
+    TieringController,
+)
+from zeebe_tpu.observability.flight_recorder import FlightRecorder
+from zeebe_tpu.observability.timeseries import TimeSeriesStore
+from zeebe_tpu.testing import ControlledClock
+
+
+def make_actuator(value=0.0, **kw):
+    box = {"v": float(value)}
+
+    def write(v):
+        box["v"] = v
+
+    defaults = dict(min_value=0.0, max_value=10.0, max_step=2.0, static=0.0,
+                    hold_band=0.0)
+    defaults.update(kw)
+    act = Actuator("test-loop", "test.knob", lambda: box["v"], write,
+                   **defaults)
+    return act, box
+
+
+# ---------------------------------------------------------------------------
+# the actuator framework
+
+
+class TestActuator:
+    def test_clamps_to_declared_bounds(self):
+        act, box = make_actuator(max_step=100.0)
+        act.apply(99.0, "way past max")
+        assert box["v"] == 10.0
+        act.apply(-99.0, "way past min")
+        assert box["v"] == 0.0
+        assert act.min_seen == 0.0 and act.max_seen == 10.0
+
+    def test_max_step_rate_limits_each_tick(self):
+        act, box = make_actuator()
+        act.apply(10.0, "step 1")
+        assert box["v"] == 2.0
+        act.apply(10.0, "step 2")
+        assert box["v"] == 4.0
+        act.apply(0.0, "reverse")
+        assert box["v"] == 2.0
+
+    def test_hysteresis_band_holds(self):
+        act, box = make_actuator(value=5.0, hold_band=1.0, static=5.0)
+        act.apply(5.8, "inside the band")
+        assert box["v"] == 5.0 and act.adjustments == 0 and act.holds == 1
+        act.apply(7.5, "outside the band")
+        assert box["v"] == 7.0 and act.adjustments == 1
+
+    def test_every_change_is_a_control_adjust_event(self):
+        flight = FlightRecorder("test-node", data_dir=None)
+        act, _ = make_actuator()
+        act.apply(6.0, "because the test says so",
+                  {"signalA": 1.5}, flight=flight, now_ms=1234)
+        events = [e for ring in flight.snapshot()["partitions"].values()
+                  for e in ring if e["kind"] == "control_adjust"]
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["controller"] == "test-loop"
+        assert ev["knob"] == "test.knob"
+        assert ev["before"] == 0.0 and ev["after"] == 2.0
+        assert ev["reason"] == "because the test says so"
+        assert ev["signals"] == {"signalA": 1.5}
+        assert act.last_adjust_ms == 1234
+
+    def test_stale_fallback_walks_toward_static(self):
+        act, box = make_actuator(value=8.0, static=1.0)
+        assert act.fall_back("sensor died") == 6.0
+        act.fall_back("sensor died")
+        act.fall_back("sensor died")
+        act.fall_back("sensor died")
+        assert box["v"] == 1.0
+        # at static: no further churn, no event
+        before = act.adjustments
+        act.fall_back("sensor still dead")
+        assert act.adjustments == before
+
+    def test_nan_desired_means_static(self):
+        act, box = make_actuator(value=6.0, static=2.0, max_step=100.0)
+        act.apply(float("nan"), "drift back")
+        assert box["v"] == 2.0
+
+    def test_integer_knob_rounds(self):
+        act, box = make_actuator(value=100.0, min_value=0, max_value=1000,
+                                 max_step=33.4, static=100.0, integer=True)
+        act.apply(1000.0, "up")
+        assert box["v"] == 133.0
+
+    def test_static_outside_bounds_rejected(self):
+        with pytest.raises(ValueError):
+            make_actuator(static=99.0)
+
+    def test_out_of_bounds_initial_value_clamps_through(self):
+        """A configured knob value past the declared max is clamped INTO
+        bounds at construction and written through — the runtime must
+        never sit outside the bounds the snapshot reports."""
+        act, box = make_actuator(value=50.0)  # max is 10.0
+        assert box["v"] == 10.0
+        assert act.min_seen == act.max_seen == 10.0
+
+    def test_snapshot_carries_bounds_evidence(self):
+        act, _ = make_actuator()
+        act.apply(10.0, "move")
+        snap = act.snapshot()
+        assert snap["min"] <= snap["minSeen"] <= snap["maxSeen"] <= snap["max"]
+        assert snap["adjustments"] == 1
+        assert snap["lastReason"] == "move"
+
+
+# ---------------------------------------------------------------------------
+# controller decision functions (pure) + signal plumbing
+
+
+def reader_with(clock, *series):
+    """SignalReader over a store pre-loaded with (name, labels, kind,
+    t_ms, value) samples."""
+    store = TimeSeriesStore()
+    for name, labels, kind, t, value in series:
+        store.append(name, labels, kind, t, value)
+    return SignalReader(store, clock)
+
+
+class TestCoalescingController:
+    def test_low_rate_wants_zero_window(self):
+        c = CoalescingController([])
+        out = c.decide({"appendPerSec": 10.0}, {c.KNOB: 4.0})
+        assert out[c.KNOB][0] == 0.0
+
+    def test_high_rate_wants_inverse_window(self):
+        c = CoalescingController([])
+        desired, reason = c.decide({"appendPerSec": 300.0}, {c.KNOB: 0.0})[c.KNOB]
+        assert desired == pytest.approx(1000.0 * c.TARGET_BATCH / 300.0)
+        assert "300" in reason
+
+    def test_step_response_through_actuator(self):
+        """A rate step from calm to burst walks the window up one bounded
+        step per tick; the burst clearing walks it back to 0."""
+        act, box = make_actuator(min_value=0.0, max_value=10.0, max_step=2.0,
+                                 static=0.0, hold_band=0.5)
+        c = CoalescingController([act])
+        for _ in range(6):
+            desired, reason = c.decide({"appendPerSec": 400.0},
+                                       {c.KNOB: act.read()})[c.KNOB]
+            act.apply(desired, reason)
+        # desired = 1000*TARGET_BATCH/400, reached stepwise
+        assert box["v"] == pytest.approx(1000.0 * c.TARGET_BATCH / 400.0)
+        for _ in range(6):
+            desired, reason = c.decide({"appendPerSec": 5.0},
+                                       {c.KNOB: act.read()})[c.KNOB]
+            act.apply(desired, reason)
+        assert box["v"] == 0.0
+
+    def test_signals_freshness_guard(self):
+        clock = ControlledClock()
+        c = CoalescingController([])
+        r = reader_with(clock, ("zeebe_log_appender_record_appended_total",
+                                '{node="n"}', "rate", clock.millis, 120.0))
+        assert c.read_signals(r) == {"appendPerSec": 120.0}
+        clock.advance(60_000)  # stale now
+        assert c.read_signals(r) is None
+
+
+class TestJournalFlushController:
+    def test_fsync_pressure_widens_the_barrier(self):
+        c = JournalFlushController([], ack_p99_target_ms=250.0)
+        sig = {"flushPerSec": 400.0, "flushP50Ms": 1.5,
+               "flushUtilization": 0.6}
+        desired, reason = c.decide(sig, {c.KNOB: 0.0})[c.KNOB]
+        assert desired == float("inf")  # actuator clamps to its max
+        assert "widening" in reason
+
+    def test_ack_slo_breach_with_flush_evidence_widens(self):
+        c = JournalFlushController([], ack_p99_target_ms=250.0)
+        sig = {"flushPerSec": 100.0, "flushP50Ms": 1.5,
+               "flushUtilization": 0.15, "ackP99Ms": 900.0}
+        desired, _ = c.decide(sig, {c.KNOB: 2.0})[c.KNOB]
+        assert desired == float("inf")
+
+    def test_idle_disk_narrows_back(self):
+        c = JournalFlushController([], ack_p99_target_ms=250.0)
+        sig = {"flushPerSec": 5.0, "flushP50Ms": 0.5,
+               "flushUtilization": 0.002, "ackP99Ms": 20.0}
+        desired, _ = c.decide(sig, {c.KNOB: 8.0})[c.KNOB]
+        assert desired == 0.0
+
+    def test_band_between_holds(self):
+        c = JournalFlushController([], ack_p99_target_ms=250.0)
+        sig = {"flushPerSec": 100.0, "flushP50Ms": 2.0,
+               "flushUtilization": 0.2, "ackP99Ms": 150.0}
+        desired, reason = c.decide(sig, {c.KNOB: 4.0})[c.KNOB]
+        assert desired == 4.0 and "holding" in reason
+
+    def test_signals_distill_utilization(self):
+        clock = ControlledClock()
+        t = clock.millis
+        r = reader_with(
+            clock,
+            ("zeebe_flush_duration_seconds", '{partition="1"}', "rate", t, 200.0),
+            ("zeebe_flush_duration_seconds", '{partition="2"}', "rate", t, 100.0),
+            ("zeebe_flush_duration_seconds:p50", '{partition="1"}', "quantile",
+             t, 0.002),
+            ("zeebe_admission_ack_latency_ms:p99", '{node="w"}', "quantile",
+             t, 42.0))
+        sig = JournalFlushController([]).read_signals(r)
+        assert sig["flushPerSec"] == 300.0
+        assert sig["flushP50Ms"] == 2.0
+        assert sig["flushUtilization"] == pytest.approx(0.6)
+        assert sig["ackP99Ms"] == 42.0
+
+
+class TestTieringController:
+    def c(self):
+        return TieringController([], rss_target_bytes=float(1 << 30))
+
+    def test_memory_pressure_parks_sooner_spills_harder(self):
+        out = self.c().decide({"rssBytes": float(2 << 30), "faultPerSec": 0.0},
+                              {"tiering.parkAfterMs": 30_000.0,
+                               "tiering.spillBatch": 256.0})
+        assert out["tiering.parkAfterMs"][0] == 0.0
+        assert out["tiering.spillBatch"][0] == float("inf")
+
+    def test_fault_thrash_with_comfortable_memory_backs_off(self):
+        out = self.c().decide({"rssBytes": float(200 << 20),
+                               "faultPerSec": 100.0},
+                              {"tiering.parkAfterMs": 5_000.0,
+                               "tiering.spillBatch": 256.0})
+        assert out["tiering.parkAfterMs"][0] == float("inf")
+
+    def test_comfortable_and_quiet_drifts_to_static(self):
+        out = self.c().decide({"rssBytes": float(100 << 20),
+                               "faultPerSec": 0.0},
+                              {"tiering.parkAfterMs": 5_000.0,
+                               "tiering.spillBatch": 512.0})
+        park = out["tiering.parkAfterMs"][0]
+        assert park != park  # NaN sentinel = actuator drifts to static
+
+    def test_band_holds(self):
+        out = self.c().decide({"rssBytes": float(900 << 20),
+                               "faultPerSec": 0.0},
+                              {"tiering.parkAfterMs": 7_000.0,
+                               "tiering.spillBatch": 512.0})
+        assert out["tiering.parkAfterMs"][0] == 7_000.0
+        assert out["tiering.spillBatch"][0] == 512.0
+
+
+class TestRoutingController:
+    def test_recompile_storm_biases_host(self):
+        c = RoutingController([])
+        desired, reason = c.decide({"compileMissPerSec": 0.2},
+                                   {c.KNOB: 0.0})[c.KNOB]
+        assert desired == float("inf") and "storm" in reason
+
+    def test_settled_compiles_unbias(self):
+        c = RoutingController([])
+        desired, _ = c.decide({"compileMissPerSec": 0.0},
+                              {c.KNOB: 100.0})[c.KNOB]
+        assert desired == 0.0
+
+    def test_signals_filter_cache_miss_label(self):
+        clock = ControlledClock()
+        t = clock.millis
+        r = reader_with(
+            clock,
+            ("zeebe_xla_compiles_total", '{cache="hit"}', "rate", t, 9.0),
+            ("zeebe_xla_compiles_total", '{cache="miss"}', "rate", t, 0.25))
+        sig = RoutingController([]).read_signals(r)
+        assert sig == {"compileMissPerSec": 0.25}
+
+
+# ---------------------------------------------------------------------------
+# seeded fuzz: every controller keeps its knob inside [min, max] on every
+# tick of 5k-sample random telemetry (the PR 11 AIMD/Vegas fuzz pattern)
+
+
+def _fuzz_controller(make_controller, make_signals, actuators, seed):
+    rng = random.Random(seed)
+    controller = make_controller(actuators)
+    for tick in range(5_000):
+        if rng.random() < 0.05:
+            for act in actuators:
+                act.fall_back("fuzz staleness")
+        else:
+            signals = make_signals(rng)
+            current = {a.knob: a.read() for a in actuators}
+            desired = controller.decide(signals, current)
+            for act in actuators:
+                target, reason = desired[act.knob]
+                act.apply(target, reason, signals)
+        for act in actuators:
+            value = act.read()
+            assert act.min_value <= value <= act.max_value, (
+                f"{act.knob} escaped bounds at tick {tick}: {value}")
+            assert act.min_value <= act.min_seen
+            assert act.max_seen <= act.max_value
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fuzz_coalescing_holds_bounds(seed):
+    act, _ = make_actuator(min_value=0.0, max_value=10.0, max_step=2.0,
+                           static=0.0, hold_band=0.5)
+    act.knob = CoalescingController.KNOB
+    _fuzz_controller(
+        lambda acts: CoalescingController(acts),
+        lambda rng: {"appendPerSec": rng.uniform(0, 50_000)},
+        [act], seed)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzz_journal_flush_holds_bounds(seed):
+    act, _ = make_actuator(min_value=0.0, max_value=20.0, max_step=2.0,
+                           static=0.0, hold_band=0.5)
+    act.knob = JournalFlushController.KNOB
+
+    def signals(rng):
+        sig = {"flushPerSec": rng.uniform(0, 5000),
+               "flushP50Ms": rng.uniform(0, 50)}
+        sig["flushUtilization"] = round(
+            sig["flushPerSec"] * sig["flushP50Ms"] / 1000.0, 3)
+        if rng.random() < 0.5:
+            sig["ackP99Ms"] = rng.uniform(0, 10_000)
+        return sig
+
+    _fuzz_controller(
+        lambda acts: JournalFlushController(acts, ack_p99_target_ms=250.0),
+        signals, [act], seed)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzz_tiering_holds_bounds(seed):
+    park, _ = make_actuator(value=30_000, min_value=1_000.0,
+                            max_value=600_000.0, max_step=5_000.0,
+                            static=30_000.0, hold_band=100.0, integer=True)
+    park.knob = "tiering.parkAfterMs"
+    spill, _ = make_actuator(value=256, min_value=32.0, max_value=2_048.0,
+                             max_step=128.0, static=256.0, hold_band=16.0,
+                             integer=True)
+    spill.knob = "tiering.spillBatch"
+    _fuzz_controller(
+        lambda acts: TieringController(acts, rss_target_bytes=float(1 << 30)),
+        lambda rng: {"rssBytes": rng.uniform(0, float(8 << 30)),
+                     "faultPerSec": rng.uniform(0, 500)},
+        [park, spill], seed)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzz_routing_holds_bounds(seed):
+    act, _ = make_actuator(min_value=0.0, max_value=250.0, max_step=25.0,
+                           static=0.0, hold_band=1.0)
+    act.knob = "router.routeThresholdMs"
+    _fuzz_controller(
+        lambda acts: RoutingController(acts),
+        lambda rng: {"compileMissPerSec": rng.uniform(0, 5)},
+        [act], seed)
+
+
+# ---------------------------------------------------------------------------
+# raft group-commit posture: nothing acked before its covering fsync
+
+
+class _RaftCluster:
+    def __init__(self, tmp_path, n, flush_interval_s=0.0):
+        from zeebe_tpu.cluster.messaging import LoopbackNetwork
+        from zeebe_tpu.cluster.raft import RaftNode
+
+        self.clock = ControlledClock()
+        self.net = LoopbackNetwork()
+        members = [f"node-{i}" for i in range(n)]
+        self.nodes = {}
+        for i, m in enumerate(members):
+            self.nodes[m] = RaftNode(
+                self.net.join(m), partition_id=1, members=members,
+                directory=tmp_path / m, clock_millis=self.clock,
+                seed=i, flush_interval_s=flush_interval_s)
+
+    def run(self, millis, step=50):
+        for _ in range(millis // step):
+            self.clock.advance(step)
+            for node in self.nodes.values():
+                node.tick()
+            self.net.deliver_all()
+
+    def elect(self):
+        from zeebe_tpu.cluster.raft import ELECTION_TIMEOUT_MS, RaftRole
+
+        self.run(4 * ELECTION_TIMEOUT_MS)
+        leaders = [n for n in self.nodes.values()
+                   if n.role == RaftRole.LEADER]
+        assert len(leaders) == 1
+        return leaders[0]
+
+    def force_flush_due(self):
+        for node in self.nodes.values():
+            node._last_flush_perf = -1e18
+
+    def close(self):
+        for node in self.nodes.values():
+            node.close()
+
+
+class TestRaftGroupCommitPosture:
+    def test_single_node_defers_commit_until_the_covering_fsync(self, tmp_path):
+        cluster = _RaftCluster(tmp_path, 1, flush_interval_s=3600.0)
+        try:
+            leader = cluster.elect()
+            # the election's init entry is already flushed; a fresh append
+            # inside the (huge) window defers
+            before_commit = leader.commit_index
+            index = leader.append(b"payload-1", asqn=100)
+            assert index is not None
+            assert leader.commit_index == before_commit, \
+                "entry committed before its covering fsync"
+            # SAFETY invariant: the ack index never passes the flushed prefix
+            assert leader._ack_index() <= leader._flushed_index
+            # window elapses -> the deferred flush drains on tick and the
+            # leader's own durable vote advances the commit
+            cluster.force_flush_due()
+            cluster.run(100)
+            assert leader.commit_index >= index
+            assert leader._flushed_index >= index
+        finally:
+            cluster.close()
+
+    def test_byte_bound_triggers_the_group_flush_early(self, tmp_path):
+        cluster = _RaftCluster(tmp_path, 1, flush_interval_s=3600.0)
+        try:
+            leader = cluster.elect()
+            leader.journal.max_unflushed_bytes = 64  # tiny bound
+            index = leader.append(b"x" * 256, asqn=200)
+            # the append itself drained the group flush (bytes >= bound)
+            assert leader._flushed_index >= index
+            cluster.run(100)
+            assert leader.commit_index >= index
+        finally:
+            cluster.close()
+
+    def test_followers_ack_only_flushed_prefix_then_proactively_ack(self, tmp_path):
+        cluster = _RaftCluster(tmp_path, 3, flush_interval_s=3600.0)
+        try:
+            leader = cluster.elect()
+            cluster.force_flush_due()
+            cluster.run(200)  # drain election-era deferred flushes
+            base = leader.commit_index
+            index = leader.append(b"payload-2", asqn=300)
+            cluster.run(200)  # replicate; everyone defers the fsync
+            assert leader.commit_index == base, \
+                "commit advanced with no replica fsynced"
+            for node in cluster.nodes.values():
+                assert node._ack_index() <= node._flushed_index
+            cluster.force_flush_due()
+            cluster.run(300)  # deferred flushes drain; followers send the
+            assert leader.commit_index >= index  # unsolicited ack
+        finally:
+            cluster.close()
+
+    def test_narrowing_the_interval_mid_deferral_never_lifts_the_ack_hold(
+            self, tmp_path):
+        """Regression: the journal-flush actuator stepping the interval
+        back to 0 while a deferred flush is pending must NOT ack the
+        unfsynced suffix — the hold stays until the next tick drains it."""
+        cluster = _RaftCluster(tmp_path, 1, flush_interval_s=3600.0)
+        try:
+            leader = cluster.elect()
+            before_commit = leader.commit_index
+            index = leader.append(b"payload-4", asqn=500)
+            assert leader._flush_dirty
+            # the actuator narrows the knob to 0 mid-deferral
+            leader.flush_interval_s = 0.0
+            assert leader._ack_index() <= leader._flushed_index, \
+                "ack hold lifted on an unfsynced suffix by a knob change"
+            assert leader.commit_index == before_commit
+            # the next tick drains the deferral and releases the commit
+            cluster.run(100)
+            assert leader._flushed_index >= index
+            assert leader.commit_index >= index
+        finally:
+            cluster.close()
+
+    def test_zero_interval_is_the_legacy_immediate_path(self, tmp_path):
+        cluster = _RaftCluster(tmp_path, 1, flush_interval_s=0.0)
+        try:
+            leader = cluster.elect()
+            index = leader.append(b"payload-3", asqn=400)
+            # immediate posture: flushed and committed with no extra ticks
+            assert leader._flushed_index >= index
+            assert leader.commit_index >= index
+        finally:
+            cluster.close()
+
+
+# ---------------------------------------------------------------------------
+# worker ingress batch-coalescing window
+
+
+def _client_payload(request_id, tenant="t-a"):
+    from zeebe_tpu.protocol import ValueType, command
+    from zeebe_tpu.protocol.intent import ProcessInstanceCreationIntent
+
+    rec = command(ValueType.PROCESS_INSTANCE_CREATION,
+                  ProcessInstanceCreationIntent.CREATE,
+                  {"bpmnProcessId": "ctl", "version": -1, "variables": {},
+                   "tenantId": tenant})
+    rec = rec.replace(request_id=request_id, request_stream_id=0)
+    return {"record": rec.to_bytes(), "requestId": request_id}
+
+
+class _CoalescingWorker:
+    """One WorkerRuntime over the loopback, pumped MANUALLY (deterministic
+    window mechanics — no background thread)."""
+
+    def __init__(self, tmp_path, window_ms):
+        from zeebe_tpu.broker.broker import BrokerCfg
+        from zeebe_tpu.cluster.messaging import LoopbackNetwork
+        from zeebe_tpu.multiproc.worker import WorkerRuntime
+
+        self.net = LoopbackNetwork()
+        cfg = BrokerCfg(node_id="worker-0", partition_count=1,
+                        replication_factor=1, cluster_members=["worker-0"],
+                        kernel_backend=False)
+        self.gateway_messaging = self.net.join("gateway-0")
+        self.gateway_frames = []
+        self.gateway_messaging.subscribe(
+            "mp-gateway-response",
+            lambda sender, payload: self.gateway_frames.append(payload))
+        self.worker = WorkerRuntime(
+            "worker-0", self.net.join("worker-0"), ["gateway-0"], cfg,
+            directory=tmp_path / "worker-0",
+            coalesce_window_ms=window_ms)
+
+    def pump_until_leader(self):
+        for _ in range(2_000):
+            self.worker.pump()
+            self.net.deliver_all()
+            if all(p.is_leader and p.ready_for_ingress
+                   for p in self.worker.broker.partitions.values()):
+                return
+            time.sleep(0.001)
+        raise AssertionError("no leader")
+
+    def close(self):
+        self.worker.close()
+
+
+class TestIngressCoalescing:
+    def test_window_batches_commands_into_one_raft_entry(self, tmp_path):
+        w = _CoalescingWorker(tmp_path, window_ms=10_000.0)  # flush manually
+        try:
+            w.pump_until_leader()
+            partition = w.worker.broker.partitions[1]
+            raft_before = partition.raft.journal.last_index
+            for rid in (101, 102, 103):
+                w.worker._on_client_command(1, "gateway-0",
+                                            _client_payload(rid))
+            # queued, not appended: the window is open
+            assert len(w.worker._ingress_pending[1]) == 3
+            assert partition.raft.journal.last_index == raft_before
+            # a duplicate resend of a QUEUED request does not double-enqueue
+            w.worker._on_client_command(1, "gateway-0", _client_payload(102))
+            assert len(w.worker._ingress_pending[1]) == 3
+            flushed = w.worker._flush_ingress_partition(1)
+            assert flushed == 3
+            # ONE raft entry for the whole batch, contiguous positions
+            assert partition.raft.journal.last_index == raft_before + 1
+            positions = sorted(
+                w.worker._inflight_positions[("gateway-0", rid)]
+                for rid in (101, 102, 103))
+            assert positions == [positions[0], positions[0] + 1,
+                                 positions[0] + 2]
+            # processing answers every queued command (rejections: nothing
+            # is deployed — the reply path is what we assert)
+            for _ in range(200):
+                w.worker.pump()
+                w.net.deliver_all()
+                if len(w.gateway_frames) >= 3:
+                    break
+            replied = {f["requestId"] for f in w.gateway_frames}
+            assert {101, 102, 103} <= replied
+        finally:
+            w.close()
+
+    def test_zero_window_is_the_legacy_per_command_path(self, tmp_path):
+        w = _CoalescingWorker(tmp_path, window_ms=0.0)
+        try:
+            w.pump_until_leader()
+            partition = w.worker.broker.partitions[1]
+            raft_before = partition.raft.journal.last_index
+            w.worker._on_client_command(1, "gateway-0", _client_payload(201))
+            w.worker._on_client_command(1, "gateway-0", _client_payload(202))
+            assert not w.worker._ingress_pending
+            assert partition.raft.journal.last_index == raft_before + 2
+        finally:
+            w.close()
+
+    def test_batch_cap_flushes_immediately(self, tmp_path):
+        w = _CoalescingWorker(tmp_path, window_ms=10_000.0)
+        try:
+            w.pump_until_leader()
+            w.worker.coalesce_max_batch = 2
+            partition = w.worker.broker.partitions[1]
+            raft_before = partition.raft.journal.last_index
+            w.worker._on_client_command(1, "gateway-0", _client_payload(301))
+            w.worker._on_client_command(1, "gateway-0", _client_payload(302))
+            # cap hit -> flushed as one entry without waiting for the window
+            assert not w.worker._ingress_pending.get(1)
+            assert partition.raft.journal.last_index == raft_before + 1
+        finally:
+            w.close()
+
+    def test_batch_admission_counts_its_own_provisional_slots(self, tmp_path):
+        """Regression: one coalesced batch must not overshoot the
+        backpressure limit by its own size — the limiter's in_flight only
+        grows after the append, so the batch admission threads a
+        provisional count through try_acquire."""
+        from zeebe_tpu.protocol import Record
+
+        w = _CoalescingWorker(tmp_path, window_ms=10_000.0)
+        try:
+            w.pump_until_leader()
+            partition = w.worker.broker.partitions[1]
+            partition.limiter.algorithm.limit = 2
+            assert not partition.limiter.in_flight
+            records = [Record.from_bytes(_client_payload(rid)["record"])
+                       for rid in range(501, 506)]
+            results = partition.client_write_batch(records)
+            assert [s for s, _ in results] == \
+                ["ok", "ok", "backpressure", "backpressure", "backpressure"]
+            # the admitted pair landed in ONE raft batch with contiguous
+            # positions, and the limiter's in-flight reflects exactly them
+            positions = [p for s, p in results if s == "ok"]
+            assert positions[1] == positions[0] + 1
+            assert set(partition.limiter.in_flight) == set(positions)
+        finally:
+            w.close()
+
+    def test_leadership_loss_inside_the_window_replies_not_leader(self, tmp_path):
+        from zeebe_tpu.cluster.raft import RaftRole
+
+        w = _CoalescingWorker(tmp_path, window_ms=10_000.0)
+        try:
+            w.pump_until_leader()
+            partition = w.worker.broker.partitions[1]
+            w.worker._on_client_command(1, "gateway-0", _client_payload(401))
+            partition.role = RaftRole.FOLLOWER  # leadership moved mid-window
+            try:
+                w.worker._flush_ingress_partition(1)
+            finally:
+                partition.role = RaftRole.LEADER
+            w.net.deliver_all()
+            errors = [f for f in w.gateway_frames
+                      if f.get("error", {}).get("type") == "not-leader"]
+            assert len(errors) == 1 and errors[0]["requestId"] == 401
+            # nothing admitted leaked an in-flight slot
+            assert w.worker.admission._inflight_total == 0
+        finally:
+            w.close()
+
+    def test_worker_wires_the_coalescing_loop_into_the_plane(self, tmp_path):
+        w = _CoalescingWorker(tmp_path, window_ms=0.0)
+        try:
+            plane = w.worker.broker.control
+            assert plane is not None
+            names = [c.name for c in plane.controllers]
+            assert "ingress-coalescing" in names
+            # the actuator's write seam drives the worker attribute
+            ctl = next(c for c in plane.controllers
+                       if c.name == "ingress-coalescing")
+            act = ctl.actuators[0]
+            act.apply(9.0, "test drive")       # max_step paced
+            assert w.worker.coalesce_window_ms == act.max_step
+            act.apply(9.0, "test drive")       # second step reaches target
+            assert w.worker.coalesce_window_ms == 9.0
+            # the aggregated admission ladder renders as a loop
+            assert "admission-shed-ladder" in plane.snapshot()["loops"]
+        finally:
+            w.close()
+
+
+# ---------------------------------------------------------------------------
+# plane wiring + surfaces
+
+
+def _single_broker(tmp_path, **cfg_kw):
+    from zeebe_tpu.broker.broker import Broker, BrokerCfg
+    from zeebe_tpu.cluster.messaging import LoopbackNetwork
+
+    net = LoopbackNetwork()
+    clock = ControlledClock()
+    cfg = BrokerCfg(node_id="broker-0", cluster_members=["broker-0"],
+                    kernel_backend=False, **cfg_kw)
+    broker = Broker(cfg, net.join("broker-0"), directory=tmp_path,
+                    clock_millis=clock)
+    return broker, net, clock
+
+
+class TestPlaneWiring:
+    def test_disabled_env_means_no_plane(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("ZEEBE_CONTROL_ENABLED", "0")
+        broker, _, _ = _single_broker(tmp_path / "a")
+        try:
+            assert broker.control is None
+        finally:
+            broker.close()
+
+    def test_metrics_plane_off_means_no_plane(self, tmp_path):
+        broker, _, _ = _single_broker(tmp_path / "b", metrics_sampling_ms=0)
+        try:
+            assert broker.control is None
+        finally:
+            broker.close()
+
+    def test_plane_ticks_off_the_pump_and_snapshots(self, tmp_path):
+        broker, net, clock = _single_broker(tmp_path / "c", tiering=True)
+        try:
+            assert broker.control is not None
+            for _ in range(10):
+                clock.advance(500)
+                broker.pump()
+                net.deliver_all()
+            assert broker.control.ticks >= 5
+            snap = broker.control.snapshot()
+            names = set(snap["controllers"])
+            assert {"journal-flush", "state-tiering",
+                    "kernel-routing"} <= names
+            for ctl in snap["controllers"].values():
+                for act in ctl["actuators"]:
+                    assert act["min"] <= act["minSeen"] \
+                        <= act["maxSeen"] <= act["max"]
+            assert "snapshot-scheduler" in snap["loops"]
+        finally:
+            broker.close()
+
+    def test_shared_tiering_cfg_is_the_partitions_cfg(self, tmp_path):
+        broker, _, _ = _single_broker(tmp_path / "d", tiering=True)
+        try:
+            shared = broker._tiering_cfg()
+            assert shared is broker._tiering_cfg()
+            for partition in broker.partitions.values():
+                assert partition.tiering_cfg is shared
+        finally:
+            broker.close()
+
+    def test_control_endpoint_and_status_block(self, tmp_path):
+        import urllib.request
+
+        from zeebe_tpu.broker.management import ManagementServer, broker_status
+
+        broker, _, _ = _single_broker(tmp_path / "e")
+        server = ManagementServer(broker)
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/control"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                body = json.loads(resp.read().decode())
+            assert body["enabled"] is True
+            assert "journal-flush" in body["controllers"]
+            status = broker_status(broker)
+            assert "control" in status
+        finally:
+            server.stop()
+            broker.close()
+
+    def test_control_endpoint_404_when_disabled(self, tmp_path, monkeypatch):
+        import urllib.error
+        import urllib.request
+
+        from zeebe_tpu.broker.management import ManagementServer
+
+        monkeypatch.setenv("ZEEBE_CONTROL_ENABLED", "false")
+        broker, _, _ = _single_broker(tmp_path / "f")
+        server = ManagementServer(broker)
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/control"
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(url, timeout=5)
+            assert err.value.code == 404
+        finally:
+            server.stop()
+            broker.close()
+
+    def test_journal_flush_actuator_writes_through_to_every_raft(self, tmp_path):
+        broker, _, _ = _single_broker(tmp_path / "g")
+        try:
+            plane = broker.control
+            ctl = next(c for c in plane.controllers
+                       if c.name == "journal-flush")
+            act = ctl.actuators[0]
+            act.apply(2.0, "test drive")
+            for partition in broker.partitions.values():
+                assert partition.raft.flush_interval_s == pytest.approx(0.002)
+        finally:
+            broker.close()
+
+    def test_stale_signals_fall_back_to_static(self, tmp_path):
+        """A plane whose store stops receiving samples walks every moved
+        knob back to its configured value."""
+        broker, net, clock = _single_broker(tmp_path / "h")
+        try:
+            plane = broker.control
+            ctl = next(c for c in plane.controllers
+                       if c.name == "journal-flush")
+            act = ctl.actuators[0]
+            act.apply(20.0, "pushed for the test")
+            act.apply(20.0, "pushed for the test")
+            assert act.read() > 0
+            # advance far past signal freshness without sampling: every
+            # series in the store is now stale -> fallback path
+            clock.advance(120_000)
+            plane.tick(clock.millis)
+            plane.tick(clock.millis)
+            for _ in range(12):
+                plane.tick(clock.millis)
+            assert act.read() == act.static
+        finally:
+            broker.close()
+
+
+# ---------------------------------------------------------------------------
+# `cli top` CONTROL rendering (pure)
+
+
+def test_top_renders_control_section():
+    from zeebe_tpu.cli import _render_top
+
+    status = {
+        "clusterSize": 1, "partitionsCount": 1, "health": "HEALTHY",
+        "alertsFiring": 0, "appendPerSec": 10.0, "processedPerSec": 9.0,
+        "topology": {"version": 1},
+        "brokers": [{
+            "nodeId": "worker-0", "health": "HEALTHY",
+            "partitions": {"1": {"role": "leader"}},
+            "rates": {"appendPerSec": 10.0, "processedPerSec": 9.0},
+            "control": {
+                "enabled": True,
+                "controllers": {
+                    "journal-flush": {"actuators": [{
+                        "knob": "raft.flushDelayMs", "value": 4.0,
+                        "min": 0.0, "max": 20.0, "adjustments": 7,
+                    }]},
+                },
+                "loops": {
+                    "admission-shed-ladder": {
+                        "knob": "admission.shedLevel", "value": 1,
+                        "adjustments": 3},
+                    "snapshot-scheduler": {
+                        "knob": "snapshot.cadence", "adjustments": 2},
+                },
+            },
+        }],
+    }
+    frame = _render_top(status)
+    assert "CONTROL" in frame
+    assert "journal-flush" in frame
+    assert "raft.flushDelayMs" in frame
+    assert "[0,20]" in frame
+    assert "admission-shed-ladder" in frame
+    assert "snapshot-scheduler" in frame
+
+
+# ---------------------------------------------------------------------------
+# re-homed loops: the snapshot scheduler's control_adjust vocabulary
+
+
+def test_adaptive_snapshot_records_control_adjust(tmp_path):
+    """The PR 6 adaptive snapshot trigger emits the shared control_adjust
+    event (controller=snapshot-scheduler) — behavior unchanged, vocabulary
+    re-homed."""
+    from zeebe_tpu.broker.broker import InProcessCluster
+    from zeebe_tpu.models.bpmn import Bpmn, to_bpmn_xml
+    from zeebe_tpu.protocol import ValueType, command
+    from zeebe_tpu.protocol.intent import (
+        DeploymentIntent,
+        ProcessInstanceCreationIntent,
+    )
+
+    cluster = InProcessCluster(
+        broker_count=1, partition_count=1, replication_factor=1,
+        directory=str(tmp_path), snapshot_period_ms=10 ** 9,
+        recovery_budget_ms=100)  # tiny budget: debt projects over it fast
+    try:
+        cluster.await_leaders()
+        model = (Bpmn.create_executable_process("ctl_snap")
+                 .start_event("s").end_event("e").done())
+        cluster.write_command(1, command(
+            ValueType.DEPLOYMENT, DeploymentIntent.CREATE,
+            {"resources": [{"resourceName": "m.bpmn",
+                            "resource": to_bpmn_xml(model)}]}))
+        leader = cluster.leader(1)
+        leader._observed_replay_rate = 1.0  # 1 rec/s: any debt blows 100ms
+        for _ in range(4):
+            cluster.write_command(1, command(
+                ValueType.PROCESS_INSTANCE_CREATION,
+                ProcessInstanceCreationIntent.CREATE,
+                {"bpmnProcessId": "ctl_snap", "version": -1,
+                 "variables": {}}))
+            cluster.run(1_100)  # past the 1s debt-check throttle
+        leader = cluster.leader(1)
+        assert leader.adaptive_snapshot_count >= 1
+        broker = cluster.leader_broker(1)
+        events = [e for ring in
+                  broker.flight_recorder.snapshot()["partitions"].values()
+                  for e in ring if e["kind"] == "control_adjust"]
+        snap_events = [e for e in events
+                       if e["controller"] == "snapshot-scheduler"]
+        assert snap_events, "no snapshot-scheduler control_adjust event"
+        assert snap_events[0]["knob"] == "snapshot.cadence"
+        assert "debtRecords" in snap_events[0]["signals"]
+    finally:
+        cluster.close()

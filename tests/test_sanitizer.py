@@ -109,6 +109,34 @@ def test_journal_append_affinity(sanitized, tmp_path):
         journal.close()
 
 
+def test_actuator_apply_pump_thread_affinity(sanitized):
+    """Control-plane actuator applications happen on the pump thread that
+    ticks the plane (ISSUE 12): the first applying thread claims the
+    actuator; a different thread applying — a management handler or a test
+    harness steering knobs from the side — fires the sanitizer, and the
+    knob does NOT move."""
+    from zeebe_tpu.control.actuators import Actuator
+
+    box = {"value": 0.0}
+
+    def write(v):
+        box["value"] = v
+
+    act = Actuator("test-loop", "test.knob", lambda: box["value"], write,
+                   min_value=0.0, max_value=10.0, max_step=10.0, static=0.0)
+    act.apply(2.0, "claimed by the pump thread")  # main thread claims
+    assert box["value"] == 2.0
+    exc = run_in_thread(lambda: act.apply(9.0, "cross-thread intruder"))
+    assert isinstance(exc, SanitizerViolation)
+    assert "intruder" in str(exc)
+    assert box["value"] == 2.0  # rejected, not applied
+    # a declared handoff re-claims legitimately
+    exc = run_in_thread(lambda: (sanitizer.adopt_writer(act),
+                                 act.apply(4.0, "after handoff")))
+    assert exc is None
+    assert box["value"] == 4.0
+
+
 def test_flight_recorder_reentrancy_guard(sanitized):
     from zeebe_tpu.observability.flight_recorder import FlightRecorder
 

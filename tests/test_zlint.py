@@ -27,6 +27,7 @@ from zeebe_tpu.analysis.knobs import (
 )
 from zeebe_tpu.analysis.rules import (
     CommittedReadDisciplineRule,
+    ControlActuationDisciplineRule,
     DeviceCallDisciplineRule,
     DriftCopyRule,
     PumpBlockingIoRule,
@@ -147,7 +148,66 @@ def test_committed_read_rule_clean_twin():
     assert rule.check(fixture_module("committed_good.py")) == []
 
 
-# -- rule 5: drift-copy -------------------------------------------------------
+# -- rule 5: control actuation discipline (ISSUE 12) --------------------------
+
+
+def test_control_rule_flags_out_of_actuator_mutations():
+    rule = ControlActuationDisciplineRule()
+    findings = rule.check_tree([fixture_module("control_bad.py"),
+                                fixture_module("control_good.py")])
+    assert lines_by_rule(findings) == [
+        ("control_bad.py", 12, "control-actuation-discipline"),
+        ("control_bad.py", 13, "control-actuation-discipline"),   # AugAssign
+        ("control_bad.py", 14, "control-actuation-discipline"),
+        ("control_bad.py", 17, "control-actuation-discipline"),   # tuple x2
+        ("control_bad.py", 17, "control-actuation-discipline"),
+    ]
+    # each finding names the owning loop
+    assert any("state-tiering controller" in f.message for f in findings)
+    assert any("journal-flush controller" in f.message for f in findings)
+
+
+def test_control_rule_allows_construction_and_reads():
+    rule = ControlActuationDisciplineRule()
+    assert [f for f in rule.check_tree([fixture_module("control_good.py")])
+            if f.scope != "<registration>"] == []
+
+
+def test_control_rule_allowed_package_and_suppression():
+    # the same bad module under the allowed prefix is clean (the actuator
+    # framework is the sanctioned write path)...
+    rule = ControlActuationDisciplineRule(allowed_prefixes=("",))
+    assert [f for f in rule.check_tree([fixture_module("control_bad.py")])
+            if f.scope != "<registration>"] == []
+    # ...and the inline suppression on line 20 held in the default run
+    findings = ControlActuationDisciplineRule().check_tree(
+        [fixture_module("control_bad.py")])
+    assert not any(f.scope == "suppressed_with_reason" for f in findings)
+
+
+def test_control_rule_stale_registration_is_a_finding():
+    rule = ControlActuationDisciplineRule(
+        owned={"park_after_ms": "state-tiering controller",
+               "renamed_knob_attr": "ghost controller"})
+    findings = rule.check_tree([fixture_module("control_bad.py")])
+    stale = [f for f in findings if f.scope == "<registration>"]
+    assert len(stale) == 1 and "renamed_knob_attr" in stale[0].message
+
+
+def test_control_rule_single_write_path_in_tree():
+    """The REAL tree's only unsuppressed/unbaselined owned-knob mutations
+    live inside zeebe_tpu/control/ — the audit trail's load-bearing
+    property, checked against the live code, not a fixture."""
+    from zeebe_tpu.analysis.framework import parse_tree
+
+    modules = parse_tree(REPO_ROOT)
+    findings = ControlActuationDisciplineRule().check_tree(modules)
+    baseline = load_baseline(REPO_ROOT / BASELINE_FILENAME)
+    new = [f for f in findings if f.baseline_key not in baseline]
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+# -- rule 6: drift-copy -------------------------------------------------------
 
 
 def test_drift_copy_rule_catches_renamed_reworded_copy():

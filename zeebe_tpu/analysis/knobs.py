@@ -49,6 +49,15 @@ KNOB_NOTES: dict[str, str] = {
         "enable free-disk monitoring / ingestion pause (default true)"),
     "ZEEBE_BROKER_DATA_DISK_MINFREEBYTES": (
         "pause ingestion below this free-space watermark (default 128MiB)"),
+    "ZEEBE_BROKER_DATA_LOGFLUSHDELAYMS": (
+        "raft journal group-commit pacing: 0 (default) = fsync before every "
+        "ack; > 0 = defer the fsync up to this many ms with acks strictly "
+        "AFTER the covering fsync (several appends share one fsync). The "
+        "journal-flush controller's knob — its actuator owns runtime "
+        "changes"),
+    "ZEEBE_BROKER_DATA_LOGMAXUNFLUSHEDBYTES": (
+        "raft journal group-commit byte bound: a deferred flush drains "
+        "early once this many unfsynced bytes accumulate (default 1MiB)"),
     "ZEEBE_BROKER_DATA_RECOVERYBUDGETMS": (
         "recovery-time budget: slower recoveries fire the "
         "recovery_budget_exceeded alert; the snapshot scheduler adapts its "
@@ -87,6 +96,11 @@ KNOB_NOTES: dict[str, str] = {
         "TLS on the cluster messaging plane (default off)"),
     "ZEEBE_BROKER_NETWORK_SECURITY_PRIVATEKEYPATH": (
         "TLS: private key path for cluster messaging"),
+    "ZEEBE_BROKER_PROCESSING_COALESCEWINDOWMS": (
+        "worker ingress batch-coalescing window (ms): admitted client "
+        "commands arriving within it append as ONE raft batch (one fsync, "
+        "one replication round). 0 (default) = append per command; the "
+        "ingress-coalescing controller's knob"),
     "ZEEBE_BROKER_PROCESSING_MAXCOMMANDSINBATCH": (
         "commands processed per batch transaction (default 100)"),
     "ZEEBE_BROKER_PROFILING_HZ": (
@@ -113,6 +127,22 @@ KNOB_NOTES: dict[str, str] = {
     "ZEEBE_AUTHORIZATION_SERVER_URL": (
         "OAuth token endpoint for the client credentials flow"),
     "ZEEBE_TOKEN_AUDIENCE": "OAuth audience claim requested for gateway tokens",
+    "ZEEBE_CONTROL_ENABLED": (
+        "closed-loop control plane (docs/control.md): controllers tick off "
+        "the broker pump and drive the knob surface from the time-series "
+        "store through bounded, audited actuators. 0 = the plane is not "
+        "constructed (one is-None check per control pump); default on, "
+        "inert without the metrics plane"),
+    "ZEEBE_CONTROL_INTERVALMS": (
+        "control plane: controller tick cadence (default 500ms; each tick "
+        "moves each knob at most one bounded step)"),
+    "ZEEBE_CONTROL_ACKP99TARGETMS": (
+        "control plane: the journal-flush controller's ack-latency SLO "
+        "(default 250ms) — fsync pacing widens while flush pressure "
+        "threatens it"),
+    "ZEEBE_CONTROL_RSSTARGETBYTES": (
+        "control plane: the state-tiering controller's RSS set point; 0 "
+        "(default) derives 80% of the rss_watermark alert bound"),
     "ZEEBE_GATEWAY_INTERCEPTORS_": (
         "prefix family: external gateway interceptor loading — "
         "`…_<ID>_CLASSNAME` / `…_<ID>_PATH` (utils/external_code.py)"),

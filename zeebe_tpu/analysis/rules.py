@@ -485,7 +485,115 @@ class CommittedReadDisciplineRule(Rule):
 
 
 # ---------------------------------------------------------------------------
-# rule 5: drift-copy detection
+# rule 5: control actuation discipline (ISSUE 12)
+
+
+#: runtime knobs owned by a registered control-plane loop: the attribute
+#: name plus the loop that owns its write path. The audit trail
+#: (control_adjust flight events + zeebe_control_* metrics) is only
+#: trustworthy if the actuator is the SINGLE runtime write path — a direct
+#: assignment anywhere else mutates the knob invisibly.
+_CONTROLLER_OWNED_ATTRS = {
+    "flush_interval_s": "journal-flush controller (raft group-commit pacing)",
+    "coalesce_window_ms": "ingress-coalescing controller (worker ingress "
+                          "batch window)",
+    "park_after_ms": "state-tiering controller (TieringCfg park horizon)",
+    "spill_batch": "state-tiering controller (TieringCfg spill batch)",
+    "route_threshold_s": "kernel-routing controller (BackendRouter "
+                         "host-vs-device threshold)",
+    "shed_level": "admission shed ladder (aggregated loop)",
+}
+
+
+class ControlActuationDisciplineRule(Rule):
+    """Runtime mutation of a controller-owned knob outside a registered
+    Actuator: assignments to the attributes above are legal only inside
+    ``zeebe_tpu/control/`` (the actuator framework) or in ``__init__``
+    (construction seeds the static default — it is configuration, not a
+    runtime decision). Anything else bypasses the bounds clamp, the
+    max-step pacing, and the control_adjust audit trail; intentional
+    exceptions (a loop that IS its own registered decision engine, like
+    the admission shed ladder) are baselined with justifications.
+
+    Honest limit (docs/static-analysis.md): ``setattr(obj, "knob", v)``
+    with a dynamic name escapes the AST — the runtime sanitizer's
+    actuator-thread assertion is the dynamic complement."""
+
+    name = "control-actuation-discipline"
+    summary = ("controller-owned runtime knobs mutate only through "
+               "zeebe_tpu/control actuators (construction in __init__ "
+               "exempt)")
+    cross_module = True
+
+    #: module prefixes allowed to assign owned knobs (the actuator home)
+    DEFAULT_ALLOWED_PREFIXES = ("zeebe_tpu/control/",)
+
+    def __init__(self, allowed_prefixes=None, owned=None) -> None:
+        self.allowed_prefixes = (self.DEFAULT_ALLOWED_PREFIXES
+                                 if allowed_prefixes is None
+                                 else tuple(allowed_prefixes))
+        self.owned = (_CONTROLLER_OWNED_ATTRS if owned is None
+                      else dict(owned))
+
+    @staticmethod
+    def _attr_targets(node: ast.AST):
+        """Attribute nodes assigned by this statement (tuple targets and
+        augmented/annotated assignments included)."""
+        targets: list[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        out = []
+        stack = list(targets)
+        while stack:
+            t = stack.pop()
+            if isinstance(t, (ast.Tuple, ast.List)):
+                stack.extend(t.elts)
+            elif isinstance(t, ast.Attribute):
+                out.append(t)
+        return out
+
+    def check_tree(self, modules: list[ParsedModule]) -> list[Finding]:
+        out: list[Finding] = []
+        seen_attrs: set[str] = set()
+        for module in modules:
+            allowed_module = any(module.relpath.startswith(p)
+                                 for p in self.allowed_prefixes)
+            for node in ast.walk(module.tree):
+                for target in self._attr_targets(node):
+                    attr = target.attr
+                    if attr not in self.owned:
+                        continue
+                    seen_attrs.add(attr)
+                    if allowed_module:
+                        continue
+                    scope = module.scope_of(node)
+                    if scope == "__init__" or scope.endswith(".__init__"):
+                        continue  # construction seeds the static default
+                    if module.is_suppressed(self.name, node):
+                        continue
+                    out.append(module.finding(
+                        self.name, node,
+                        f"runtime mutation of controller-owned knob "
+                        f"`.{attr}` outside a registered actuator — owned "
+                        f"by the {self.owned[attr]}; route the change "
+                        f"through zeebe_tpu/control (bounds, pacing, and "
+                        f"the control_adjust audit trail live there)"))
+        # stale-registration analogue: an owned attr that no linted module
+        # even assigns any more was renamed/removed — the registration is
+        # silently guarding nothing
+        for attr in sorted(set(self.owned) - seen_attrs):
+            out.append(self.registration_finding(
+                attr,
+                f"stale controller-owned-knob registration: `.{attr}` is "
+                f"assigned nowhere in the linted tree — the knob was "
+                f"renamed or removed; update _CONTROLLER_OWNED_ATTRS"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# rule 6: drift-copy detection
 
 
 class _Normalizer(ast.NodeTransformer):
@@ -607,5 +715,6 @@ RULES: list[Rule] = [
     DeviceCallDisciplineRule(),
     PumpBlockingIoRule(),
     CommittedReadDisciplineRule(),
+    ControlActuationDisciplineRule(),
     DriftCopyRule(),
 ]

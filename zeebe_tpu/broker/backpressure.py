@@ -136,13 +136,17 @@ class CommandRateLimiter:
     def limit(self) -> int:
         return self.algorithm.limit
 
-    def try_acquire(self, record: Record) -> bool:
+    def try_acquire(self, record: Record, provisional: int = 0) -> bool:
+        """``provisional``: admissions already granted in the caller's
+        current batch but not yet appended (``on_appended`` is what grows
+        ``in_flight``) — the coalesced ingress passes its running count so
+        one batch cannot overshoot the limit by its own size."""
         if not self.enabled:
             return True
         self._m_received.inc()
         if (record.value_type, int(record.intent)) in WHITELIST:
             return True
-        if len(self.in_flight) >= self.algorithm.limit:
+        if len(self.in_flight) + provisional >= self.algorithm.limit:
             # gate rejections are NOT fed to the limit algorithm: the Netflix
             # concurrency-limits reference only records drop samples for timed-
             # out in-flight requests, and multiplicative-decrease per rejected

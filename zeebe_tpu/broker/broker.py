@@ -82,6 +82,16 @@ class BrokerCfg:
     tiering: bool = False
     tiering_park_after_ms: int = 30_000
     tiering_spill_batch: int = 256
+    # raft journal group-commit pacing (ISSUE 12): 0 = fsync before every
+    # ack (the reference default); > 0 = defer the fsync up to this many ms
+    # (or max_unflushed_bytes), with acks strictly AFTER the covering fsync
+    # — the journal-flush controller's knob surface
+    log_flush_delay_ms: int = 0
+    log_max_unflushed_bytes: int = 1 << 20
+    # closed-loop control plane (ISSUE 12): controllers tick off the pump
+    # and drive the knob surface from the time-series store; requires the
+    # metrics plane (its sensor). Off = the plane is not constructed.
+    control: bool = True
 
 
 _AUTO_DEVICE_COUNT: int | None = None
@@ -329,6 +339,12 @@ class Broker:
         else:
             self.backup_store = None
         self.partitions: dict[int, ZeebePartition] = {}
+        # one TieringCfg shared by every partition (control-plane actuated)
+        self._shared_tiering_cfg = None
+        # closed-loop control plane (ISSUE 12) — built AFTER the partitions
+        # exist, at the end of __init__; None = disabled (one is-None check
+        # per control pump is the whole disabled cost)
+        self.control = None
         # gateway-facing jobs-available listener (runtime hub); assignable
         # after construction — partitions route through the indirection below
         self.jobs_listener: Callable[[int, set], None] | None = None
@@ -375,6 +391,12 @@ class Broker:
             self.topology.bootstrap(distribution, sorted(cfg.cluster_members))
         start_steps.labels("partition-manager").observe(
             time.perf_counter() - step_start)
+        if cfg.control:
+            # the plane needs the time-series store (its sensor) and the
+            # partitions (its knob surface): last startup step by design
+            from zeebe_tpu.control import maybe_build_plane
+
+            self.control = maybe_build_plane(self)
 
     # -- metrics plane ---------------------------------------------------------
 
@@ -490,16 +512,21 @@ class Broker:
         return self._owned_mesh_runner
 
     def _tiering_cfg(self):
-        """The partition-facing TieringCfg, or None when tiering is off."""
+        """The partition-facing TieringCfg, or None when tiering is off.
+        ONE shared instance per broker: every partition's manager reads the
+        same object, so the state-tiering controller's actuator (the single
+        runtime write path for park_after_ms/spill_batch) steers them all."""
         if not self.cfg.tiering:
             return None
-        from zeebe_tpu.state.tiering import TieringCfg
+        if self._shared_tiering_cfg is None:
+            from zeebe_tpu.state.tiering import TieringCfg
 
-        return TieringCfg(
-            enabled=True,
-            park_after_ms=self.cfg.tiering_park_after_ms,
-            spill_batch=self.cfg.tiering_spill_batch,
-        )
+            self._shared_tiering_cfg = TieringCfg(
+                enabled=True,
+                park_after_ms=self.cfg.tiering_park_after_ms,
+                spill_batch=self.cfg.tiering_spill_batch,
+            )
+        return self._shared_tiering_cfg
 
     def _create_partition(self, partition_id: int, members: list[str],
                           priority: int = 1) -> None:
@@ -535,6 +562,8 @@ class Broker:
             recovery_budget_ms=self.cfg.recovery_budget_ms,
             snapshot_chain_length=self.cfg.snapshot_chain_length,
             tiering=self._tiering_cfg(),
+            log_flush_delay_ms=self.cfg.log_flush_delay_ms,
+            log_max_unflushed_bytes=self.cfg.log_max_unflushed_bytes,
         )
         self.health_monitor.register(f"partition-{partition_id}")
         from zeebe_tpu.utils.metrics import REGISTRY as _REG
@@ -782,6 +811,10 @@ class Broker:
             # never touches an unpinned, uninitialized accelerator backend)
             self._profiler_mod.sample_device_memory()
             self.alerts.evaluate(self.clock_millis())
+        if self.control is not None:
+            # control ticks AFTER the sampler: decisions see telemetry at
+            # most one sampling interval old
+            self.control.maybe_tick(self.clock_millis())
         self._gossip_roles()
         return 0
 

@@ -38,6 +38,11 @@ class BackpressureCfg:
 @dataclasses.dataclass
 class ProcessingCfg:
     max_commands_in_batch: int = 100
+    # ingress batch-coalescing window (ms, multiproc worker): commands
+    # arriving within the window append as ONE raft batch (one fsync, one
+    # replication round). 0 = append per command (the legacy byte path);
+    # at runtime the ingress-coalescing controller owns this knob.
+    coalesce_window_ms: float = 0.0
 
 
 @dataclasses.dataclass
@@ -64,6 +69,12 @@ class ExtendedBrokerCfg:
                              f"{self.backpressure.algorithm!r}")
         if self.processing.max_commands_in_batch < 1:
             raise ValueError("maxCommandsInBatch must be >= 1")
+        if self.processing.coalesce_window_ms < 0:
+            raise ValueError("coalesceWindowMs must be >= 0")
+        if self.base.log_flush_delay_ms < 0:
+            raise ValueError("logFlushDelayMs must be >= 0")
+        if self.base.log_max_unflushed_bytes < 1:
+            raise ValueError("logMaxUnflushedBytes must be >= 1")
         if self.base.snapshot_chain_length < 1:
             raise ValueError("snapshotChainLength must be >= 1")
         if self.base.tiering_park_after_ms < 0:
@@ -119,6 +130,14 @@ _ENV_BINDINGS: dict[str, tuple[str, str, Any]] = {
         "base", "tiering_park_after_ms", int),
     "ZEEBE_BROKER_DATA_TIERING_SPILLBATCH": (
         "base", "tiering_spill_batch", int),
+    # raft journal group-commit pacing (ISSUE 12): 0 = fsync before every
+    # ack; > 0 = defer the fsync up to this many ms (acks wait for it)
+    "ZEEBE_BROKER_DATA_LOGFLUSHDELAYMS": ("base", "log_flush_delay_ms", int),
+    "ZEEBE_BROKER_DATA_LOGMAXUNFLUSHEDBYTES": (
+        "base", "log_max_unflushed_bytes", int),
+    # ingress batch-coalescing window (multiproc worker ingress)
+    "ZEEBE_BROKER_PROCESSING_COALESCEWINDOWMS": (
+        "processing", "coalesce_window_ms", float),
 }
 
 

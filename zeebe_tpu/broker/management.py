@@ -15,6 +15,9 @@ Endpoints:
   GET  /flight    → the flight recorder's live event rings (the same payload
                     a crash dumps to <data-dir>/flight-<ts>.json)
   GET  /alerts    → alert evaluator state (pending + firing)
+  GET  /control   → closed-loop control plane state: controllers, actuator
+                    bounds/values/audit counters, aggregated loops
+                    (404 when ZEEBE_CONTROL_ENABLED=0 or sampling is off)
   GET  /cluster/status → topology + per-broker health/alerts/headline rates,
                     aggregated across all brokers when the server is given
                     the hosting runtime (in-process fan-out), else local
@@ -197,6 +200,18 @@ class ManagementServer:
                     {"error": "no flight recorder"}))
                 return
             handler._send(200, json.dumps(recorder.snapshot(), default=str))
+        elif path == "/control":
+            # closed-loop control plane (ISSUE 12): controllers, actuator
+            # bounds/values/audit counters, and the aggregated loops
+            # (snapshot scheduler, admission shed ladder)
+            plane = getattr(self.broker, "control", None)
+            if plane is None:
+                handler._send(404, json.dumps(
+                    {"error": "control plane disabled "
+                              "(ZEEBE_CONTROL_ENABLED=0 or metrics "
+                              "sampling off)"}))
+                return
+            handler._send(200, json.dumps(plane.snapshot(), default=str))
         elif path == "/alerts":
             alerts = getattr(self.broker, "alerts", None)
             if alerts is None:
@@ -410,6 +425,11 @@ def broker_status(broker) -> dict:
         firing = alerts.firing()
         status["alertsFiring"] = len(firing)
         status["alerts"] = firing
+    control = getattr(broker, "control", None)
+    if control is not None:
+        # control-plane evidence rides the row: knob values, bounds, and
+        # adjustment counts per controller (rendered by `cli top` CONTROL)
+        status["control"] = control.snapshot()
     store = getattr(broker, "timeseries", None)
     if store is not None:
         now = broker.clock_millis()

@@ -173,6 +173,8 @@ class ZeebePartition:
         recovery_budget_ms: int = DEFAULT_RECOVERY_BUDGET_MS,
         snapshot_chain_length: int = DEFAULT_SNAPSHOT_CHAIN_LENGTH,
         tiering=None,
+        log_flush_delay_ms: int = 0,
+        log_max_unflushed_bytes: int = 1 << 20,
     ) -> None:
         self.partition_id = partition_id
         self.partition_count = partition_count
@@ -233,6 +235,9 @@ class ZeebePartition:
         self._last_snapshot_processed = -1
         self._observed_replay_rate = DEFAULT_REPLAY_RATE_RPS
         self._last_debt_check_ms = 0
+        # adaptive snapshot triggers this life (the control plane's
+        # snapshot-scheduler loop row reads it without a registry scrape)
+        self.adaptive_snapshot_count = 0
         # compaction-bound memo keyed by the newest snapshot id: chain
         # validation re-reads and CRCs every chain member (the base is the
         # whole state), and the guards run several times per snapshot — only
@@ -245,6 +250,11 @@ class ZeebePartition:
         self.raft = RaftNode(
             messaging, partition_id, members, self.directory / "raft",
             clock_millis, priority=priority,
+            # group-commit pacing static defaults (ISSUE 12): runtime
+            # mutation of raft.flush_interval_s belongs to the journal-flush
+            # controller's actuator exclusively
+            flush_interval_s=max(log_flush_delay_ms, 0) / 1000.0,
+            max_unflushed_bytes=log_max_unflushed_bytes,
         )
         self.raft.commit_listeners.append(self._on_raft_commit)
         self.raft.role_listeners.append(self._on_role_change)
@@ -807,6 +817,56 @@ class ZeebePartition:
                                    "intent": record.intent.name})
         return position
 
+    def client_write_batch(self, records: list[Record]
+                           ) -> list[tuple[str, int]]:
+        """Batched client ingress (the worker's coalescing window, ISSUE
+        12): every record passes the SAME backpressure/pause gates as
+        :meth:`client_write`, then the admitted ones append as ONE raft
+        entry — one fsync, one replication round, positions assigned
+        contiguously. Returns per-record ``(status, position)`` where
+        status is ``"ok"`` | ``"backpressure"`` | ``"unavailable"``."""
+        if self.paused or self.disk_paused:
+            return [("unavailable", -1)] * len(records)
+        results: list[tuple[str, int]] = [("unavailable", -1)] * len(records)
+        admitted: list[tuple[int, Record]] = []
+        # provisional count: the limiter's in_flight set only grows at
+        # on_appended (after the batch appends), so without it every
+        # record in the batch would be admitted against the same stale
+        # count and one open window could overshoot the adaptive limit by
+        # the whole batch size — exactly under the overload that made the
+        # window open
+        provisional = 0
+        for i, record in enumerate(records):
+            if self.limiter is not None and not self.limiter.try_acquire(
+                    record, provisional=provisional):
+                if self.flight is not None:
+                    self.flight.record(
+                        self.partition_id, "backpressure_reject",
+                        limit=self.limiter.limit,
+                        valueType=record.value_type.name)
+                results[i] = ("backpressure", -1)
+            else:
+                provisional += 1
+                admitted.append((i, record))
+        if not admitted:
+            return results
+        last = self.write_commands([r for _, r in admitted])
+        if last is None:
+            # role lost between the gate and the append: same evidence as
+            # client_write returning None (the gateway retries typed)
+            return results
+        first = last - len(admitted) + 1
+        tracer = _TRACER
+        for offset, (i, record) in enumerate(admitted):
+            position = first + offset
+            results[i] = ("ok", position)
+            self._note_pending_request(record, position)
+            if self.limiter is not None:
+                self.limiter.on_appended(position)
+            if tracer.enabled:
+                tracer.note_append(self.partition_id, position)
+        return results
+
     def write_commands(self, records: list[Record],
                        source_position: int = -1) -> int | None:
         """Leader-only: sequence the records and append to Raft; they become
@@ -906,12 +966,21 @@ class ZeebePartition:
             # PERIODIC snapshot out a full period while debt keeps growing
             self._last_snapshot_ms = now
             _M_ADAPTIVE_SNAPSHOTS.labels(pid).inc()
-            if self.flight is not None:
-                self.flight.record(
-                    self.partition_id, "adaptive_snapshot",
-                    debtRecords=debt,
-                    projectedReplayMs=round(projected_ms, 1),
-                    budgetMs=self.recovery_budget_ms)
+            self.adaptive_snapshot_count += 1
+            # this pre-dated the control plane but IS a closed feedback
+            # loop: its decisions record under the shared control_adjust
+            # vocabulary (ISSUE 12) so `cli top` CONTROL shows every loop
+            from zeebe_tpu.control.audit import record_adjust
+
+            record_adjust(
+                self.flight, self.partition_id,
+                controller="snapshot-scheduler", knob="snapshot.cadence",
+                before=round(projected_ms, 1), after=0,
+                reason="snapshot early: projected replay debt threatened "
+                       "recovery_budget_ms",
+                signals={"debtRecords": debt,
+                         "projectedReplayMs": round(projected_ms, 1),
+                         "budgetMs": self.recovery_budget_ms})
 
     def take_snapshot(self, force_full: bool = False) -> bool:
         """Snapshot the db at lastProcessedPosition, then compact both logs up

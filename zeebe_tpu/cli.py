@@ -338,6 +338,32 @@ def _render_top(status: dict) -> str:
                 f"{row.get('shed', 0):>7} {row.get('inflight', 0):>8} "
                 f"{(f'{quota:g}' if quota else '-'):>8} "
                 f"{row.get('weight', 1.0):>6}")
+    control_rows = [(row.get("nodeId", "?"), row["control"])
+                    for row in status.get("brokers", [])
+                    if row.get("control")]
+    if not control_rows and status.get("control"):
+        control_rows = [("-", status["control"])]
+    if control_rows:
+        # closed-loop control plane (ISSUE 12): EVERY feedback loop — the
+        # control-plane actuators plus the aggregated snapshot-scheduler /
+        # admission-ladder loops — in one place, with bounds + audit counts
+        lines.append("")
+        lines.append(f"{'CONTROL':<14} {'LOOP':<20} {'KNOB':<26} "
+                     f"{'VALUE':>9} {'BOUNDS':>15} {'ADJ':>5}")
+        for node, block in control_rows:
+            for name, ctl in sorted(block.get("controllers", {}).items()):
+                for act in ctl.get("actuators", []):
+                    bounds = f"[{act.get('min'):g},{act.get('max'):g}]"
+                    lines.append(
+                        f"{node:<14} {name:<20} {act.get('knob', '?'):<26} "
+                        f"{act.get('value', 0):>9g} {bounds:>15} "
+                        f"{act.get('adjustments', 0):>5}")
+            for name, loop in sorted(block.get("loops", {}).items()):
+                value = loop.get("value", loop.get("adjustments", "-"))
+                lines.append(
+                    f"{node:<14} {name:<20} {loop.get('knob', '?'):<26} "
+                    f"{value!s:>9} {'-':>15} "
+                    f"{loop.get('adjustments', 0):>5}")
     workers = status.get("workers")
     if workers:
         # multi-process deployment: the supervisor's per-worker view —
@@ -512,6 +538,9 @@ def _register_metrics_scenario() -> None:
     # ISSUE 9 family: the gateway's bounded-resend deadline counter lives
     # at module level in the multi-process runtime
     import zeebe_tpu.multiproc.runtime  # noqa: F401
+    # ISSUE 12 families: the control_adjust audit vocabulary — explicit so
+    # the doc stays deterministic even with ZEEBE_CONTROL_ENABLED=0
+    import zeebe_tpu.control.audit  # noqa: F401
     # ISSUE 11 families: tenant admission (module-level) + one controller so
     # the labeled gauges/histogram exist; messaging's zombie-client counter
     import zeebe_tpu.cluster.messaging  # noqa: F401

@@ -25,6 +25,7 @@ import json
 import os
 import random
 from pathlib import Path
+from time import perf_counter as _perf_counter
 from typing import Any, Callable
 
 from zeebe_tpu.cluster.messaging import MessagingService
@@ -57,6 +58,8 @@ class RaftNode:
         priority: int = 1,
         seed: int | None = None,
         flush_policy: str = "immediate",
+        flush_interval_s: float = 0.0,
+        max_unflushed_bytes: int = 1 << 20,
     ) -> None:
         self.messaging = messaging
         self.member_id = messaging.member_id
@@ -147,7 +150,8 @@ class RaftNode:
             seed if seed is not None else hash((self.member_id, partition_id)) & 0xFFFF
         )
 
-        self.journal = SegmentedJournal(self.directory / "raft-log")
+        self.journal = SegmentedJournal(self.directory / "raft-log",
+                                        max_unflushed_bytes=max_unflushed_bytes)
         # "immediate": fsync before acking appends / advancing own match —
         # the reference's default (journal flush-before-ack, SURVEY §2.2);
         # "delayed": fsync on the next tick (reference DelayedFlusher);
@@ -155,6 +159,18 @@ class RaftNode:
         if flush_policy not in ("immediate", "delayed", "none"):
             raise ValueError(f"unknown flush_policy {flush_policy!r}")
         self.flush_policy = flush_policy
+        # group-commit pacing over the "immediate" policy (ISSUE 12): with
+        # flush_interval_s > 0 the fsync is DEFERRED up to the interval (or
+        # the journal's max_unflushed_bytes), and the *acknowledgement*
+        # waits for it — _ack_index() holds at the flushed prefix, so
+        # unlike "delayed" nothing is ever acked/committed before its
+        # covering fsync; a power loss costs only unacked entries. Several
+        # appends inside the window share one fsync: the classic
+        # group-commit latency/throughput trade, and the journal-flush
+        # controller's knob (zeebe_tpu/control — the single runtime write
+        # path for it).
+        self.flush_interval_s = max(float(flush_interval_s), 0.0)
+        self._last_flush_perf = _perf_counter()
         # trust only the journal's flush marker on open: entries beyond it may
         # sit in the OS page cache (a process crash reopens them readable, but
         # a later power loss would drop them), so they get re-fsynced before
@@ -251,20 +267,49 @@ class RaftNode:
         """Durability barrier after appending entries, before acknowledging
         them (follower ack, or leader counting itself toward the quorum)."""
         if self.flush_policy == "immediate":
-            self._flush_journal()
+            if self.flush_interval_s <= 0:
+                self._flush_journal()
+                return
+            # group-commit posture: defer the fsync up to flush_interval_s
+            # or the byte bound; _ack_index() holds at the flushed prefix,
+            # so deferral delays the ack — it never precedes the fsync
+            self._flush_dirty = True
+            if self._group_flush_due():
+                self._flush_journal()
         elif self.flush_policy == "delayed":
             self._flush_dirty = True
             self._m_deferred_appends.inc()
 
+    def _group_flush_due(self) -> bool:
+        return (self.journal.unflushed_bytes
+                >= self.journal.max_unflushed_bytes
+                or _perf_counter() - self._last_flush_perf
+                >= self.flush_interval_s)
+
+    def _ack_index(self) -> int:
+        """Highest index this node may acknowledge (follower ack, or the
+        leader's own quorum vote). Under the group-commit posture that is
+        the durably flushed prefix — never an unfsynced entry; every other
+        posture keeps its existing semantics (notably "delayed", which
+        deliberately acks before fsync). The ``_flush_dirty`` clause keeps
+        the hold when the journal-flush actuator narrows the interval back
+        to 0 WHILE a deferral is pending — the suffix stays unackable
+        until the next tick drains it (dropping the hold on the knob
+        change alone would ack entries whose fsync never happened)."""
+        if self.flush_policy == "immediate" and (self.flush_interval_s > 0
+                                                 or self._flush_dirty):
+            return min(self._last_log_index(),
+                       max(self._flushed_index, self.snapshot_index))
+        return self._last_log_index()
+
     def _flush_journal(self) -> None:
         if self.journal.last_index != self._flushed_index:
-            import time as _time
-
-            start = _time.perf_counter()
+            start = _perf_counter()
             self.journal.flush()
-            self._m_flush_duration.observe(_time.perf_counter() - start)
+            self._m_flush_duration.observe(_perf_counter() - start)
             self._flushed_index = self.journal.last_index
         self._flush_dirty = False
+        self._last_flush_perf = _perf_counter()
 
     def _truncate_after(self, index: int) -> None:
         had_config_after = any(
@@ -348,7 +393,28 @@ class RaftNode:
     def tick(self, now_millis: int | None = None) -> None:
         now = self.clock_millis() if now_millis is None else now_millis
         if self._flush_dirty:
-            self._flush_journal()  # delayed flush policy drains here
+            if self.flush_policy == "immediate":
+                # group-commit posture: drain when due — or immediately
+                # when the actuator narrowed the interval to 0 mid-deferral
+                # — then release the acks the deferral was holding: the
+                # leader re-counts its own durable vote, a follower
+                # proactively acks the leader (waiting for the next
+                # heartbeat would add up to HEARTBEAT_INTERVAL_MS to every
+                # deferred commit)
+                if self.flush_interval_s <= 0 or self._group_flush_due():
+                    self._flush_journal()
+                    if self.role == RaftRole.LEADER:
+                        self._advance_commit()
+                    elif (self.role == RaftRole.FOLLOWER
+                          and self.leader_id is not None
+                          and self.leader_id != self.member_id):
+                        self._send(self.leader_id, "append-resp", {
+                            "term": self.current_term, "success": True,
+                            "lastIndex": self._ack_index(),
+                            "follower": self.member_id,
+                        })
+            else:
+                self._flush_journal()  # delayed flush policy drains here
         if self.role == RaftRole.LEADER:
             if now - self._last_heartbeat_sent_ms >= HEARTBEAT_INTERVAL_MS:
                 self._broadcast_appends()
@@ -659,7 +725,10 @@ class RaftNode:
             self._set_commit(min(req["commit"], self._last_log_index()))
         self._send(sender, "append-resp", {
             "term": self.current_term, "success": True,
-            "lastIndex": self._last_log_index(), "follower": self.member_id,
+            # group-commit posture acks only the flushed prefix; the leader
+            # resends the (already stored, idempotently skipped) suffix and
+            # the deferred-flush tick proactively acks when it drains
+            "lastIndex": self._ack_index(), "follower": self.member_id,
         })
 
     def _append_at(self, index: int, entry: dict) -> None:
@@ -698,9 +767,13 @@ class RaftNode:
         """Advance commit index to the highest index replicated on a quorum
         whose entry is from the current term (Raft §5.4.2)."""
         last = self._last_log_index()
+        # under the group-commit posture the leader's own vote counts only
+        # up to its flushed prefix (every other posture: the whole log)
+        own = self._ack_index()
         for candidate in range(last, self.commit_index, -1):
-            count = 1 + sum(1 for m in self._other_members()
-                            if self.match_index.get(m, 0) >= candidate)
+            count = (1 if own >= candidate else 0) + sum(
+                1 for m in self._other_members()
+                if self.match_index.get(m, 0) >= candidate)
             if self._quorum(count) and self._entry_term(candidate) == self.current_term:
                 self._set_commit(candidate)
                 break

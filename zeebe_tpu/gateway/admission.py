@@ -297,6 +297,7 @@ class AdmissionController:
         self._lat_buckets = list(_M_ACK_LATENCY.buckets)
         self._lat_counts = [0] * (len(self._lat_buckets) + 1)
         self.shed_level = 0
+        self.level_changes = 0
         self._breaches = 0
         self._clears = 0
         self._last_tick_ms = 0.0
@@ -482,10 +483,22 @@ class AdmissionController:
             self._clears = 0
         if level != self.shed_level:
             old, self.shed_level = self.shed_level, level
+            self.level_changes += 1
             self._g_level.set(level)
-            if self.flight is not None:
-                self.flight.record(0, "admission_shed_level", old=old,
-                                   new=level, p99Ms=round(p99 or 0.0, 1))
+            # the shed ladder pre-dated the control plane but IS a closed
+            # feedback loop: its decisions record under the shared
+            # control_adjust vocabulary (ISSUE 12) — one audit schema for
+            # every loop, rendered together by `cli top` CONTROL
+            from zeebe_tpu.control.audit import record_adjust
+
+            record_adjust(
+                self.flight, 0, controller="admission-shed-ladder",
+                knob="admission.shedLevel", before=old, after=level,
+                reason=("ack p99 breached the shed target"
+                        if level > old else
+                        "ack p99 cleared the recovery floor"),
+                signals={"p99Ms": round(p99 or 0.0, 1),
+                         "targetMs": self.cfg.shed_p99_ms})
         # /ready drain: sustained shedding of NEW WORK (level >= 2) means
         # this gateway cannot serve its purpose — degrade readiness so the
         # LB sends tenants elsewhere while completions keep draining

@@ -497,6 +497,12 @@ class SegmentedJournal:
     def is_empty(self) -> bool:
         return self.last_index < self.first_index
 
+    @property
+    def unflushed_bytes(self) -> int:
+        """Appended bytes not yet covered by an fsync (group-commit pacing
+        reads this to decide when a deferred flush is due)."""
+        return self._unflushed_bytes
+
     # -- write path ----------------------------------------------------------
 
     def append(self, data: bytes, asqn: int = ASQN_IGNORE) -> JournalRecord:

@@ -148,6 +148,7 @@ def install() -> None:
     global _installed
     if _installed:
         return
+    from zeebe_tpu.control.actuators import Actuator
     from zeebe_tpu.journal.journal import SegmentedJournal
     from zeebe_tpu.observability.flight_recorder import FlightRecorder
     from zeebe_tpu.state.db import Transaction, ZbDb
@@ -166,6 +167,12 @@ def install() -> None:
     # enforced at runtime)
     _wrap_mutator(ZbDb, "require_transaction")
     _wrap_mutator(SegmentedJournal, "append")
+    # control-plane actuators (ISSUE 12): apply is the single runtime
+    # write path to a controller-owned knob, and it runs on the pump
+    # thread that ticks the plane — same first-writer-claims discipline as
+    # ZbDb (a management thread or test harness mutating a knob through an
+    # actuator from the side is exactly the race the audit trail can't see)
+    _wrap_mutator(Actuator, "apply")
     _wrap_reentrancy_guard(FlightRecorder, "record")
     _wrap_reentrancy_guard(FlightRecorder, "dump")
     _installed = True

@@ -438,6 +438,67 @@ def _count(items) -> dict:
     return out
 
 
+def execute_op(runtime, op: ServingOp, record, timeout_s: float,
+               drive_ms: Callable[[], float]) -> ServingOp:
+    """Submit one open-loop op and record its terminal outcome + routing
+    meta — ONE submission protocol for every open-loop harness (the
+    serving gate and the autotune A/B), so their latency/outcome taxonomy
+    cannot drift."""
+    from zeebe_tpu.gateway.broker_client import (
+        DeadlineExceededError,
+        NoLeaderError,
+        ResourceExhaustedError,
+    )
+
+    op.started_ms = drive_ms()
+    meta: dict = {}
+    try:
+        result = runtime.submit(op.partition, record, timeout_s=timeout_s,
+                                meta=meta)
+        op.outcome = "rejected" if result.is_rejection else "ack"
+        if result.is_rejection:
+            op.rejection = result.rejection_type.name
+    except ResourceExhaustedError as exc:
+        op.outcome = "shed"
+        # gateway-side sheds carry the admission reason; worker-side
+        # sheds arrive as typed resource-exhausted/backpressure frames
+        op.shed_reason = meta.get("shed") or meta.get("error") or "typed"
+        op.rejection = str(exc)[:160]
+    except DeadlineExceededError:
+        op.outcome = "deadline"
+    except NoLeaderError:
+        op.outcome = "no-leader"
+    except Exception as exc:  # noqa: BLE001 — untyped = gate evidence
+        op.outcome = "error"
+        op.rejection = repr(exc)[:200]
+    op.done_ms = drive_ms()
+    op.request_id = meta.get("requestId", -1)
+    op.position = meta.get("commandPosition", -1)
+    op.resends = meta.get("resends", 0)
+    op.reroutes = meta.get("reroutes", 0)
+    return op
+
+
+def gate_cli_main(prog: str, quick_cfg, full_cfg, run_fn,
+                  argv: list[str] | None = None) -> int:
+    """Shared manual entry point for the open-loop gates: parse
+    --seed/--quick, run in a temp dir, dump the report, exit on
+    violations."""
+    import argparse
+    import tempfile
+
+    parser = argparse.ArgumentParser(prog=prog)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--quick", action="store_true")
+    args = parser.parse_args(argv)
+    cfg = dataclasses.replace(quick_cfg if args.quick else full_cfg,
+                              seed=args.seed)
+    with tempfile.TemporaryDirectory(prefix=f"{prog}-") as tmp:
+        report = run_fn(cfg, tmp)
+    json.dump(report, sys.stdout, indent=2)
+    return 1 if report["violations"] else 0
+
+
 # ---------------------------------------------------------------------------
 # the harness
 
@@ -445,11 +506,6 @@ def _count(items) -> dict:
 def run_serving(cfg: ServingConfig, directory: str | Path) -> dict:
     """Run the full serving gate; returns the report (violations inside)."""
     from zeebe_tpu.gateway.admission import AdmissionCfg, AdmissionController
-    from zeebe_tpu.gateway.broker_client import (
-        DeadlineExceededError,
-        NoLeaderError,
-        ResourceExhaustedError,
-    )
     from zeebe_tpu.models.bpmn import Bpmn, to_bpmn_xml
     from zeebe_tpu.multiproc.runtime import MultiProcClusterRuntime
     from zeebe_tpu.multiproc.supervisor import (
@@ -537,34 +593,8 @@ def run_serving(cfg: ServingConfig, directory: str | Path) -> dict:
         return op
 
     def execute(op: ServingOp, record) -> ServingOp:
-        op.started_ms = drive_ms()
-        meta: dict = {}
-        try:
-            result = runtime.submit(op.partition, record,
-                                    timeout_s=cfg.request_timeout_s,
-                                    meta=meta)
-            op.outcome = "rejected" if result.is_rejection else "ack"
-            if result.is_rejection:
-                op.rejection = result.rejection_type.name
-        except ResourceExhaustedError as exc:
-            op.outcome = "shed"
-            # gateway-side sheds carry the admission reason; worker-side
-            # sheds arrive as typed resource-exhausted/backpressure frames
-            op.shed_reason = meta.get("shed") or meta.get("error") or "typed"
-            op.rejection = str(exc)[:160]
-        except DeadlineExceededError:
-            op.outcome = "deadline"
-        except NoLeaderError:
-            op.outcome = "no-leader"
-        except Exception as exc:  # noqa: BLE001 — untyped = gate evidence
-            op.outcome = "error"
-            op.rejection = repr(exc)[:200]
-        op.done_ms = drive_ms()
-        op.request_id = meta.get("requestId", -1)
-        op.position = meta.get("commandPosition", -1)
-        op.resends = meta.get("resends", 0)
-        op.reroutes = meta.get("reroutes", 0)
-        return op
+        return execute_op(runtime, op, record, cfg.request_timeout_s,
+                          drive_ms)
 
     def create_cmd(tenant: str):
         return command(ValueType.PROCESS_INSTANCE_CREATION,
@@ -830,19 +860,8 @@ def run_serving(cfg: ServingConfig, directory: str | Path) -> dict:
 
 
 def main(argv: list[str] | None = None) -> int:  # pragma: no cover — manual
-    import argparse
-    import tempfile
-
-    parser = argparse.ArgumentParser(prog="zeebe-tpu-serving")
-    parser.add_argument("--seed", type=int, default=0)
-    parser.add_argument("--quick", action="store_true")
-    args = parser.parse_args(argv)
-    cfg = ServingConfig(seed=args.seed) if args.quick else \
-        dataclasses.replace(FULL_CONFIG, seed=args.seed)
-    with tempfile.TemporaryDirectory(prefix="zeebe-serving-") as tmp:
-        report = run_serving(cfg, tmp)
-    json.dump(report, sys.stdout, indent=2)
-    return 1 if report["violations"] else 0
+    return gate_cli_main("zeebe-tpu-serving", ServingConfig(), FULL_CONFIG,
+                         run_serving, argv)
 
 
 if __name__ == "__main__":  # pragma: no cover

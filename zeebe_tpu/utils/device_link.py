@@ -57,6 +57,12 @@ class BackendRouter:
         self.enabled = False
         self.link_put_s: float | None = None
         self.link_get_s: float | None = None
+        # host-vs-device routing threshold (ISSUE 12): the accelerator must
+        # beat the host prediction by at least this margin to win a group —
+        # raising it biases groups host-ward (the kernel-routing controller
+        # raises it during XLA recompile storms and decays it back to 0).
+        # Runtime mutation belongs to that controller's actuator.
+        self.route_threshold_s = 0.0
         self._host_ema: dict[Any, float] = {}
         self._accel_ema: dict[Any, float] = {}
         self.host_groups = 0
@@ -111,7 +117,8 @@ class BackendRouter:
                 return None
             link = self.link_cost_s()
             host_ema = self._host_ema.get(bucket)
-            accel_total = link + self._accel_ema.get(bucket, 0.0)
+            accel_total = (link + self._accel_ema.get(bucket, 0.0)
+                           + self.route_threshold_s)
             if host_ema is None:
                 # un-seated host model: only an effectively-local accelerator
                 # skips the host trial run
@@ -145,6 +152,7 @@ class BackendRouter:
             "enabled": self.enabled,
             "link_put_ms": None if self.link_put_s is None else round(1e3 * self.link_put_s, 2),
             "link_get_ms": None if self.link_get_s is None else round(1e3 * self.link_get_s, 2),
+            "route_threshold_ms": round(1e3 * self.route_threshold_s, 2),
             "host_groups": self.host_groups,
             "accel_groups": self.accel_groups,
         }
