@@ -313,6 +313,40 @@ class E2EPartition:
             int(ValueType.PROCESS_INSTANCE)))
 
 
+def _coverage_block(part: "E2EPartition", models, mark: dict) -> dict:
+    """Per-scenario kernel-path coverage + the static-vs-observed parity
+    verdict (ISSUE 13): the classifier's per-definition prediction is
+    compared against the routing the measured window actually observed —
+    a predicted-eligible definition host-routing for a non-runtime reason
+    (or vice versa) is a gate violation that fails the bench run."""
+    from zeebe_tpu.engine.eligibility import (
+        classify_definition,
+        parity_violations,
+    )
+    from zeebe_tpu.engine.kernel_backend import KernelRegistry
+
+    delta = part.kernel.accounting.delta_since(mark)
+    total = delta["kernel"] + delta["host"]
+    # ONE shared registry: the prediction must see the deployment SET the
+    # runtime saw (joint SlotMap clashes, max_definitions capacity) — a
+    # solo prediction would blame the classifier for set-dependent declines
+    reg = KernelRegistry()
+    predictions = {}
+    for i, m in enumerate(models):
+        report = classify_definition(transform(m), definition_key=i + 1,
+                                     registry=reg)
+        predictions[m.process_id] = report["eligible"]
+    return {
+        "coverage_pct": round(100.0 * delta["kernel"] / total, 2) if total else 100.0,
+        "kernel_records": delta["kernel"],
+        "host_records": delta["host"],
+        "per_definition": delta["perDefinition"],
+        "predicted_eligible": predictions,
+        "parity_violations": parity_violations(
+            predictions, delta["perDefinition"]),
+    }
+
+
 def run_e2e_workload(models, drives, n_instances: int, variables: dict) -> dict:
     """drives: how many job-drain rounds the workload needs (0 for pure
     routing workloads). Returns transitions/instances counts and rates plus
@@ -337,6 +371,7 @@ def run_e2e_workload(models, drives, n_instances: int, variables: dict) -> dict:
             warm_base = part.stream.last_position
             part.complete_in_type_waves(jobs)
         start_position = part.stream.last_position
+        coverage_mark = part.kernel.accounting.mark()
 
         elapsed = 0.0
         t0 = time.perf_counter()
@@ -357,6 +392,7 @@ def run_e2e_workload(models, drives, n_instances: int, variables: dict) -> dict:
         assert not part.pending_job_keys(scan_from), "workload did not drain"
         transitions = part.count_transitions(start_position)
         total_instances = per_def * len(models)
+        coverage = _coverage_block(part, models, coverage_mark)
         part.journal.close()
         return {
             "transitions_per_sec": round(transitions / elapsed, 1),
@@ -367,6 +403,9 @@ def run_e2e_workload(models, drives, n_instances: int, variables: dict) -> dict:
                 part.kernel.template_hits
                 / max(1, part.kernel.template_hits + part.kernel.template_misses
                       + part.kernel.fallbacks), 3),
+            # ISSUE 13: records on the kernel path / total routed, over the
+            # measured window, plus the static-vs-observed parity verdict
+            "kernel_coverage": coverage,
         }
 
 
@@ -429,6 +468,7 @@ def run_adversarial_cold(n_instances: int = 1200) -> dict:
         part.pump()
         start_position = part.stream.last_position
         part.kernel.template_hits = part.kernel.template_misses = 0
+        coverage_mark = part.kernel.accounting.mark()
 
         per_def = n_instances // 2
         elapsed = 0.0
@@ -465,6 +505,9 @@ def run_adversarial_cold(n_instances: int = 1200) -> dict:
         elapsed += time.perf_counter() - t0
         transitions = part.count_transitions(start_position)
         hits, misses = part.kernel.template_hits, part.kernel.template_misses
+        coverage = _coverage_block(
+            part, [adversarial_gateway(), adversarial_message()],
+            coverage_mark)
         part.journal.close()
         return {
             "transitions_per_sec": round(transitions / elapsed, 1),
@@ -472,6 +515,7 @@ def run_adversarial_cold(n_instances: int = 1200) -> dict:
             "transitions": transitions,
             "instances": n_instances,
             "template_hit_rate": round(hits / max(1, hits + misses), 3),
+            "kernel_coverage": coverage,
         }
 
 
@@ -1327,18 +1371,59 @@ def _tracing_extra() -> dict:
     }
 
 
+def _eligibility_gate(scenarios: dict[str, dict], quick: bool) -> list[str]:
+    """ISSUE 13: write the per-scenario eligibility/coverage artifact
+    (ELIGIBILITY[_quick].json — CI uploads it) and return every scenario's
+    static-vs-observed parity violations (the caller fails the run on any).
+    """
+    report = {
+        "quick": quick,
+        "scenarios": {
+            name: result["kernel_coverage"]
+            for name, result in scenarios.items()
+            if isinstance(result, dict) and "kernel_coverage" in result
+        },
+    }
+    violations = [
+        f"{name}: {v}"
+        for name, cov in report["scenarios"].items()
+        for v in cov.get("parity_violations", [])
+    ]
+    report["parityViolations"] = violations
+    name = "ELIGIBILITY_quick.json" if quick else "ELIGIBILITY.json"
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    for v in violations:
+        print(f"eligibility parity violation: {v}", file=sys.stderr)
+    return violations
+
+
 def _quick_main(platform: str, trace: bool = False,
                 sample_metrics: bool = False, profile: bool = False) -> None:
-    """--quick: the two headline workloads at small instance counts plus a
-    reduced kernel ceiling — a <60s smoke of the full pipeline (log →
+    """--quick: the headline workloads at small instance counts plus a
+    reduced kernel ceiling — a fast smoke of the full pipeline (log →
     processor → kernel backend → log) with the same JSON summary shape.
     Writes BENCH_quick.json so a quick run never clobbers the real
-    BENCH.json artifact."""
+    BENCH.json artifact. Since ISSUE 13 the quick run also carries the
+    ROADMAP item 3 coverage baselines (e2e_mixed_8_definitions and
+    adversarial_cold_templates at reduced counts) and fails on any
+    static-vs-observed eligibility parity violation."""
     e2e_one_task = run_e2e_workload([one_task()], drives=1, n_instances=600,
                                     variables={})
     e2e_ten = run_e2e_workload([ten_tasks()], drives=10, n_instances=120,
                                variables={})
+    e2e_mixed = run_e2e_workload(mixed_definitions(), drives=4,
+                                 n_instances=480, variables={"x": 15})
+    adversarial = run_adversarial_cold(n_instances=240)
     ceiling = run_kernel_ceiling(num_instances=1 << 17, rounds=2)
+    parity = _eligibility_gate({
+        "e2e_one_task": e2e_one_task,
+        "e2e_ten_tasks": e2e_ten,
+        "e2e_mixed_8_definitions": e2e_mixed,
+        "adversarial_cold_templates": adversarial,
+    }, quick=True)
     value = e2e_one_task["transitions_per_sec"]
     full = {
         "metric": "e2e_process_instance_transitions_per_sec_per_chip",
@@ -1349,6 +1434,8 @@ def _quick_main(platform: str, trace: bool = False,
             "quick": True,
             "e2e_one_task": e2e_one_task,
             "e2e_ten_tasks": e2e_ten,
+            "e2e_mixed_8_definitions": e2e_mixed,
+            "adversarial_cold_templates": adversarial,
             "kernel_ceiling_transitions_per_sec": ceiling["transitions_per_sec"],
             "pipeline_stages": _pipeline_stage_summary(),
             "platform": platform,
@@ -1374,9 +1461,15 @@ def _quick_main(platform: str, trace: bool = False,
         "platform": platform,
         "quick": True,
         "ten_tasks_transitions_per_sec": e2e_ten["transitions_per_sec"],
+        "mixed_8_kernel_coverage_pct":
+            e2e_mixed["kernel_coverage"]["coverage_pct"],
+        "adversarial_kernel_coverage_pct":
+            adversarial["kernel_coverage"]["coverage_pct"],
         "kernel_ceiling_transitions_per_sec": ceiling["transitions_per_sec"],
         "full_results": "BENCH_quick.json",
     }))
+    if parity:
+        raise SystemExit(1)
 
 
 def _soak_main(quick: bool) -> None:
@@ -1856,6 +1949,16 @@ def main(quick: bool = False, trace: bool = False,
     e2e_scope = run_e2e_workload([subprocess_boundary()], drives=1,
                                  n_instances=2000, variables={})
     adversarial = run_adversarial_cold()
+    parity = _eligibility_gate({
+        "e2e_one_task": e2e_one_task,
+        "e2e_exclusive_chain": e2e_excl,
+        "e2e_fork_join": e2e_fork,
+        "e2e_mixed_8_definitions": e2e_mixed,
+        "e2e_ten_tasks": e2e_ten,
+        "e2e_ten_tasks_io_mapped": e2e_ten_io,
+        "e2e_subprocess_boundary": e2e_scope,
+        "adversarial_cold_templates": adversarial,
+    }, quick=False)
     warm_large = run_one_task_warm_large_state()
     # on-chip e2e (router bypassed): only when a real accelerator resolved
     on_chip = (run_one_task_on_chip()
@@ -1958,6 +2061,8 @@ def main(quick: bool = False, trace: bool = False,
             on_chip["transitions_per_sec"]} if on_chip else {}),
         "full_results": "BENCH.json",
     }))
+    if parity:
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

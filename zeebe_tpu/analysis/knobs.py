@@ -143,6 +143,10 @@ KNOB_NOTES: dict[str, str] = {
     "ZEEBE_CONTROL_RSSTARGETBYTES": (
         "control plane: the state-tiering controller's RSS set point; 0 "
         "(default) derives 80% of the rss_watermark alert bound"),
+    "ZEEBE_FLIGHT_MAXDUMPBYTES": (
+        "flight recorder: per-dump serialized-size cap (default 256KiB) — "
+        "oldest ring entries drop first, the dump records truncatedEntries; "
+        "0 disables bounding"),
     "ZEEBE_GATEWAY_INTERCEPTORS_": (
         "prefix family: external gateway interceptor loading — "
         "`…_<ID>_CLASSNAME` / `…_<ID>_PATH` (utils/external_code.py)"),

@@ -396,6 +396,20 @@ def parse_profile_seconds(raw: str) -> float | None:
 _RATE_WINDOW_MS = 10_000
 
 
+def _kernel_coverage_row(partition) -> dict:
+    """The compact kernelCoverage block riding a /cluster/status partition
+    row: cumulative path split + ratio + the dominant host reason (the full
+    per-definition report lives on the partition's /health)."""
+    acct = partition.processor.kernel_backend.accounting
+    top = acct.reasons.most_common(1)
+    return {
+        "kernelRecords": acct.kernel_records,
+        "hostRecords": acct.host_records,
+        "coverageRatio": round(acct.coverage_ratio(), 4),
+        **({"dominantHostReason": top[0][0]} if top else {}),
+    }
+
+
 def broker_status(broker) -> dict:
     """One broker's row in /cluster/status: health, roles, alert state, and
     headline rates read from its time-series store (appends/s from the
@@ -416,6 +430,11 @@ def broker_status(broker) -> dict:
                     "coldBytes": p.db.tier_stats()["coldBytes"]}
                    if p.tiering is not None and p.db is not None
                    and hasattr(p.db, "tier_stats") else {}),
+                # kernel-path coverage (ISSUE 13): the compact split `cli
+                # top` renders (full per-definition detail on /health)
+                **({"kernelCoverage": _kernel_coverage_row(p)}
+                   if p.processor is not None
+                   and p.processor.kernel_backend is not None else {}),
             }
             for pid, p in sorted(broker.partitions.items())
         },

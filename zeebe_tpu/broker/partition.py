@@ -388,6 +388,13 @@ class ZeebePartition:
             writer=_RaftWriter(self),
             kernel_backend=kernel_backend,
         )
+        if kernel_backend is not None and self.flight is not None:
+            # bounded per-wave path accounting into the black box (ISSUE
+            # 13): ≤1 kernel_wave event/s with the wave-size / chunk /
+            # path-split / dominant-fallback aggregate since the last one
+            self.processor.wave_listener = (
+                lambda event, pid=self.partition_id:
+                self.flight.record(pid, "kernel_wave", **event))
         if self.on_jobs_available is not None:
             listener = self.on_jobs_available
             self.processor.on_jobs_available = (
@@ -1308,4 +1315,11 @@ class ZeebePartition:
                    if self.tiering.degraded else {}),
             }} if self.tiering is not None and self.db is not None
                and hasattr(self.db, "tier_stats") else {}),
+            # kernel-path coverage (ISSUE 13): which records rode the
+            # device plane vs host, and why — the ruler ROADMAP item 3's
+            # "≥90% on the kernel path" is graded with
+            **({"kernelCoverage": self.processor.kernel_backend
+                .accounting.snapshot()}
+               if self.processor is not None
+               and self.processor.kernel_backend is not None else {}),
         }

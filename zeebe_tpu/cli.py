@@ -21,6 +21,13 @@ Beyond zbctl parity:
   knobs-doc    — generate docs/knobs.md from every ``ZEEBE_*`` env knob the
                  AST scanner finds (``--check`` fails on drift or on an
                  undocumented knob; wired into CI)
+  eligibility  — static kernel-eligibility report: which elements of a
+                 definition ride the device kernel vs the host path, with
+                 a typed catalog reason per host-forced element (offline;
+                 a .bpmn file or ``--deployed --data-dir``)
+  eligibility-doc — generate docs/eligibility.md from the reason catalog
+                 + curated notes (``--check`` fails on drift or an
+                 unexplained reason; wired into CI)
 
 Usage: python -m zeebe_tpu.cli --address host:port <command> …
 """
@@ -186,6 +193,40 @@ def main(argv: list[str] | None = None) -> int:
                         "lacks a KNOB_NOTES one-liner (CI gate)")
 
     p = sub.add_parser(
+        "eligibility",
+        help="static kernel-eligibility report for process definitions: "
+             "which elements ride the device kernel vs the host path, with "
+             "a typed reason per host-forced element (offline; classifies "
+             "a .bpmn file or everything deployed in a data dir)")
+    p.add_argument("definition", nargs="?",
+                   help="a .bpmn file to classify (omit with --deployed)")
+    p.add_argument("--deployed", action="store_true",
+                   help="classify every definition deployed in --data-dir "
+                        "(read from the stream journals' PROCESS CREATED "
+                        "records; call activities resolve against what is "
+                        "actually deployed)")
+    p.add_argument("--data-dir", default=None,
+                   help="broker data dir (partition-*/ children) or one "
+                        "partition's dir, for --deployed")
+    p.add_argument("--pretty", action="store_true",
+                   help="human-readable table instead of JSON")
+    p.add_argument("-o", "--output", default=None,
+                   help="write the JSON report to a file")
+
+    p = sub.add_parser(
+        "eligibility-doc",
+        help="generate the eligibility reason-catalog reference "
+             "(docs/eligibility.md) from the catalog + curated notes")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: the tree this package was "
+                        "imported from)")
+    p.add_argument("--output", default="docs/eligibility.md")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 if the committed file drifted or any "
+                        "catalog reason lacks a REASON_NOTES one-liner "
+                        "(CI gate)")
+
+    p = sub.add_parser(
         "snapshots",
         help="list snapshot chains (positions, sizes, validity, projected "
              "replay debt) from a data directory — offline, read-only, safe "
@@ -221,6 +262,11 @@ def main(argv: list[str] | None = None) -> int:
         return _lint(args)
     if args.cmd == "knobs-doc":
         return _knobs_doc(args)
+    if args.cmd == "eligibility":
+        # offline classification — no gateway connection, no device init
+        return _eligibility(args)
+    if args.cmd == "eligibility-doc":
+        return _eligibility_doc(args)
     if args.cmd == "snapshots":
         # offline store walk — no gateway connection
         return _snapshots(args)
@@ -317,6 +363,27 @@ def _render_top(status: dict) -> str:
             f"{int(rates.get('exportLagRecords', 0)):>7} "
             f"{parked:>8} "
             f"{row.get('alertsFiring', 0):>6}")
+    coverage_rows = [
+        (row.get("nodeId", "?"), pid, info["kernelCoverage"])
+        for row in status.get("brokers", [])
+        for pid, info in sorted(row.get("partitions", {}).items(),
+                                key=lambda kv: int(kv[0]))
+        if info.get("kernelCoverage")
+    ]
+    if coverage_rows:
+        # kernel-path coverage (ISSUE 13): which records rode the device
+        # plane vs host per partition — the first place to look when the
+        # ROADMAP item 3 coverage metric moves
+        lines.append("")
+        lines.append(f"{'KERNEL':<14} {'PART':>4} {'COV%':>6} "
+                     f"{'KERNEL':>9} {'HOST':>9} DOMINANT HOST REASON")
+        for node, pid, cov in coverage_rows:
+            lines.append(
+                f"{node:<14} {pid:>4} "
+                f"{cov.get('coverageRatio', 0.0) * 100:>5.1f}% "
+                f"{cov.get('kernelRecords', 0):>9} "
+                f"{cov.get('hostRecords', 0):>9} "
+                f"{cov.get('dominantHostReason', '-')}")
     admission = status.get("admission")
     if admission and (admission.get("tenants") or admission.get("shedLevel")):
         # tenant admission (ISSUE 11): per-tenant rate/shed/queue evidence —
@@ -705,6 +772,227 @@ def _knobs_doc(args) -> int:
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(content)
     print(f"wrote {path} ({len(knobs)} knobs)")
+    return 0
+
+
+# -- eligibility: static kernel-path classification (ISSUE 13) -----------------
+
+
+class _OfflineProcesses:
+    """Minimal ProcessState shim over journal-harvested deployments, so the
+    classifier's call-activity inlining resolves against what is actually
+    deployed (the two methods _inline_call_activities consults)."""
+
+    def __init__(self, defs: dict[str, dict]) -> None:
+        # bpmnProcessId → {"meta": …, "exe": ExecutableProcess}
+        self._defs = defs
+        self._by_key = {d["meta"]["processDefinitionKey"]: d
+                       for d in defs.values()}
+
+    def get_latest_by_id(self, process_id: str, tenant=None):
+        entry = self._defs.get(process_id)
+        return entry["meta"] if entry else None
+
+    def executable(self, key: int):
+        entry = self._by_key.get(key)
+        return entry["exe"] if entry else None
+
+
+def _harvest_deployed(data_dir) -> dict[str, dict]:
+    """Latest deployed definition per bpmnProcessId, read offline from the
+    stream journals' PROCESS CREATED events (the resource XML rides the
+    event — no state load, no device init, safe on a live broker dir)."""
+    from zeebe_tpu.journal import SegmentedJournal
+    from zeebe_tpu.logstreams import LogStream
+    from zeebe_tpu.models.bpmn import parse_bpmn_xml
+    from zeebe_tpu.models.bpmn.executable import transform
+    from zeebe_tpu.protocol import RecordType, ValueType
+    from zeebe_tpu.protocol.intent import ProcessIntent
+
+    # broker layout (<dir>/partition-N/stream), standalone layout
+    # (<dir>/broker-N/partition-N/stream), or one partition's dir
+    journal_dirs = sorted(data_dir.glob("partition-*/stream")) or sorted(
+        data_dir.glob("*/partition-*/stream"))
+    if not journal_dirs:
+        # EngineHarness/bench layout: one partition, journal at <dir>/log
+        for candidate in (data_dir / "log", data_dir / "stream", data_dir):
+            if candidate.is_dir() and any(candidate.glob("journal-*.log")):
+                journal_dirs = [candidate]
+                break
+    defs: dict[str, dict] = {}
+    for journal_dir in journal_dirs:
+        journal = SegmentedJournal(journal_dir)
+        try:
+            stream = LogStream(journal, partition_id=1)
+            for view in stream.scan_filtered(
+                    1, int(RecordType.EVENT), int(ValueType.PROCESS),
+                    int(ProcessIntent.CREATED)):
+                value = view.value
+                pid = value.get("bpmnProcessId")
+                if not pid or "resource" not in value:
+                    continue
+                known = defs.get(pid)
+                if known and known["meta"]["version"] >= value.get("version", 1):
+                    continue
+                model = next((m for m in parse_bpmn_xml(value["resource"])
+                              if m.process_id == pid), None)
+                if model is None:
+                    continue
+                defs[pid] = {
+                    "meta": {
+                        "bpmnProcessId": pid,
+                        "version": value.get("version", 1),
+                        "processDefinitionKey":
+                            value.get("processDefinitionKey", view.key),
+                    },
+                    "exe": transform(model),
+                }
+        finally:
+            journal.close()
+    return defs
+
+
+def _render_eligibility(reports: list[dict]) -> str:
+    """Human-readable view of classification reports (``--pretty``)."""
+    lines = []
+    for report in reports:
+        counts = report.get("counts", {})
+        verdict = ("KERNEL-ELIGIBLE" if report.get("eligible")
+                   else "HOST-FORCED "
+                        f"({', '.join(report.get('definitionReasons', []))})")
+        lines.append(f"{report.get('bpmnProcessId', '?')}: {verdict} · "
+                     f"{counts.get('kernel', 0)} kernel / "
+                     f"{counts.get('host', 0)} host element(s)")
+        for el in report.get("elements", []):
+            if el.get("path") == "host":
+                lines.append(f"  host   {el.get('id', '?'):<24} "
+                             f"{el.get('type', '?'):<26} "
+                             f"{el.get('reason', '')}")
+        lines.append("")
+    lines.append("runtime-only reasons (never statically predictable): "
+                 + ", ".join(reports[0].get("runtimeOnlyReasons", []))
+                 if reports else "no definitions found")
+    return "\n".join(lines)
+
+
+def _eligibility(args) -> int:
+    from pathlib import Path
+
+    from zeebe_tpu.engine.eligibility import classify_definition
+
+    reports: list[dict] = []
+    if args.deployed:
+        if not args.data_dir:
+            print("eligibility --deployed requires --data-dir",
+                  file=sys.stderr)
+            return 2
+        data_dir = Path(args.data_dir)
+        if not data_dir.exists():
+            print(f"no data dir at {data_dir}", file=sys.stderr)
+            return 2
+        defs = _harvest_deployed(data_dir)
+        if not defs:
+            print(f"no deployed definitions found under {data_dir}",
+                  file=sys.stderr)
+            return 1
+        from zeebe_tpu.engine.kernel_backend import KernelRegistry
+
+        processes = _OfflineProcesses(defs)
+        # ONE shared registry across the whole deployment set: the report
+        # must see what runtime admission will — joint SlotMap clashes and
+        # registry capacity (table-set-full) are invisible to solo passes
+        registry = KernelRegistry()
+        for pid in sorted(defs):
+            entry = defs[pid]
+            reports.append(classify_definition(
+                entry["exe"], processes=processes,
+                definition_key=entry["meta"]["processDefinitionKey"],
+                registry=registry))
+    else:
+        if not args.definition:
+            print("eligibility requires a .bpmn file or --deployed "
+                  "--data-dir", file=sys.stderr)
+            return 2
+        path = Path(args.definition)
+        if not path.exists():
+            print(f"no such file: {path}", file=sys.stderr)
+            return 2
+        from zeebe_tpu.models.bpmn import parse_bpmn_xml
+        from zeebe_tpu.models.bpmn.executable import (
+            ProcessValidationError,
+            transform,
+        )
+
+        for model in parse_bpmn_xml(path.read_text()):
+            try:
+                reports.append(classify_definition(transform(model)))
+            except ProcessValidationError as exc:
+                print(f"{model.process_id}: not deployable ({exc})",
+                      file=sys.stderr)
+                return 1
+        if not reports:
+            print(f"no process definitions in {path}", file=sys.stderr)
+            return 1
+    payload = {"definitions": reports}
+    if args.output:
+        Path(args.output).write_text(json.dumps(payload, indent=1) + "\n")
+        print(f"wrote {args.output} ({len(reports)} definition(s))",
+              file=sys.stderr)
+    if args.pretty:
+        print(_render_eligibility(reports))
+    elif not args.output:
+        _out(payload)
+    return 0
+
+
+def _eligibility_doc(args) -> int:
+    from pathlib import Path
+
+    from zeebe_tpu.analysis.eligibility_notes import (
+        REASON_NOTES,
+        render_eligibility_doc,
+        stale_reason_notes,
+        undocumented_reasons,
+    )
+
+    root = _repo_root(args.root)
+    content = render_eligibility_doc()
+    path = Path(args.output)
+    if not path.is_absolute():
+        path = root / path
+    if args.check:
+        missing = undocumented_reasons()
+        if missing:
+            print(f"unexplained eligibility reason(s): {', '.join(missing)} "
+                  f"— add a one-liner to zeebe_tpu/analysis/"
+                  f"eligibility_notes.py::REASON_NOTES and regenerate with "
+                  f"`python -m zeebe_tpu.cli eligibility-doc`",
+                  file=sys.stderr)
+            return 1
+        stale = stale_reason_notes()
+        if stale:
+            print(f"stale REASON_NOTES entr(ies) for retired code(s): "
+                  f"{', '.join(stale)} — drop the note and regenerate",
+                  file=sys.stderr)
+            return 1
+        committed = path.read_text() if path.exists() else ""
+        if committed != content:
+            print(f"{path} drifted from the reason catalog — regenerate "
+                  f"with `python -m zeebe_tpu.cli eligibility-doc`",
+                  file=sys.stderr)
+            import difflib
+
+            diff = difflib.unified_diff(
+                committed.splitlines(), content.splitlines(),
+                fromfile=str(path), tofile="generated", lineterm="", n=1)
+            for line in list(diff)[:40]:
+                print(line, file=sys.stderr)
+            return 1
+        print(f"{path} is up to date ({len(REASON_NOTES)} reasons)")
+        return 0
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(content)
+    print(f"wrote {path} ({len(REASON_NOTES)} reasons)")
     return 0
 
 

@@ -39,6 +39,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from zeebe_tpu.engine.eligibility import PathAccounting, esp_start_host_reason
 from zeebe_tpu.models.bpmn.executable import ExecutableElement, ExecutableProcess
 from zeebe_tpu.feel.feel import (
     FeelEvalError,
@@ -261,135 +262,12 @@ def _condition_var_names(exe: ExecutableProcess) -> frozenset[str]:
 def check_element_eligibility(exe: ExecutableProcess, el: ExecutableElement) -> bool:
     """True when the sequential engine's behavior for this element is exactly
     the kernel's opcode behavior (engine/…/processing/bpmn element processors
-    vs ops/automaton masks)."""
-    if el.multi_instance is not None:
-        # only synthetic K_MI bodies (_inline_mi_bodies sets child_start on a
-        # task-type element) ride the device; real loop elements host-escape
-        return el.child_start_idx >= 0 and el.element_type in _MI_BODY_TYPES
-    if el.inputs or el.outputs:
-        # io-mappings ride the kernel on job-worker tasks only, and only
-        # when they cannot fail mid-burst (safe expressions) and their
-        # outputs cannot invalidate prefetched device condition slots
-        if _KERNEL_OP.get(el.element_type) != K_TASK:
-            return False
-        if not all(_safe_mapping_expr(e) for e, _t in el.inputs):
-            return False
-        if el.outputs:
-            if not all(_safe_mapping_expr(e) for e, _t in el.outputs):
-                return False
-            if {t for _e, t in el.outputs} & _condition_var_names(exe):
-                return False
-    if el.native_user_task or el.called_decision_id:
-        return False
-    if el.script_expression is not None:
-        # expression-flavor script tasks ride as K_PASS with the evaluation
-        # and result write emitted between ACTIVATED and COMPLETING: the
-        # expression must be a never-raises safe expression, and the result
-        # variable must not invalidate prefetched device condition slots
-        # (same discipline as io-mapping outputs). Every value the script
-        # can read is a function of fingerprinted inputs (creation/completion
-        # variables, parked locals), so templates stay sound.
-        return (el.element_type == BpmnElementType.SCRIPT_TASK
-                and el.job_type is None
-                and not el.inputs and not el.outputs
-                and not el.boundary_idxs
-                and _safe_mapping_expr(el.script_expression)
-                and (el.script_result_variable is None
-                     or el.script_result_variable
-                     not in _condition_var_names(exe)))
-    if el.element_type == BpmnElementType.BOUNDARY_EVENT:
-        # triggers route sequentially (route_trigger); the kernel only needs
-        # the attached wait state to be reconstructable, so the boundary's
-        # subscription kind must be one _reconstruct knows how to collect
-        if el.event_type == BpmnEventType.TIMER:
-            return el.timer_duration is not None and el.timer_date is None
-        if el.event_type == BpmnEventType.MESSAGE:
-            return el.message_name is not None
-        if el.event_type == BpmnEventType.SIGNAL:
-            # signal subscriptions count in the reconstruction integrity
-            # check like timers/messages (boundary_waits third slot)
-            return el.signal_name is not None
-        # error boundaries carry no wait state at all (the job THROW_ERROR
-        # command routes through _find_catcher on the host). Escalation
-        # boundaries only fire from a CHILD SCOPE (call activity /
-        # sub-process host) — and scope hosts fail the K_TASK host check
-        # below anyway, so admitting them here would be dead eligibility
-        return el.event_type == BpmnEventType.ERROR
-    if el.boundary_idxs:
-        # boundary wait-state reconstruction is implemented for parked
-        # job-worker tasks only, and every attached boundary must itself be
-        # collectable (an escaped signal boundary would open a subscription
-        # the reconstruction doesn't count — so the host task escapes too)
-        if _KERNEL_OP.get(el.element_type) != K_TASK:
-            return False
-        if not all(check_element_eligibility(exe, exe.elements[b])
-                   for b in el.boundary_idxs):
-            return False
-    if el.element_type == BpmnElementType.SUB_PROCESS:
-        # embedded sub-process with a none start rides the kernel (K_SCOPE);
-        # attached boundaries or event sub-processes would need host-side
-        # trigger state the scope reconstruction does not collect yet
-        return el.child_start_idx >= 0 and not exe.event_sub_processes_of(el.idx)
-    if el.element_type in (BpmnElementType.CALL_ACTIVITY,
-                           BpmnElementType.PROCESS):
-        # only synthetic inlined rows carry a child_start here (the call
-        # activity scope and its child-root placeholder); a plain call
-        # activity host-escapes (_inline_call_activities decides which)
-        return el.child_start_idx >= 0
-    if el.element_type == BpmnElementType.EVENT_BASED_GATEWAY:
-        # parks on device like a catch; every succeeding catch must hold a
-        # wait state the reconstruction counts — fixed-duration timers,
-        # message subscriptions, and (since round 5) signal subscriptions
-        # all count in _collect_wait_states, so any mix of those targets
-        # keeps the gateway kernel-reconstructable; cycle/date timers stay
-        # host-side (their wait state is not collectable)
-        for fidx in el.outgoing:
-            target = exe.elements[exe.flows[fidx].target_idx]
-            if target.timer_duration is not None:
-                if target.timer_cycle or target.timer_date is not None:
-                    return False
-            elif target.message_name is None and target.signal_name is None:
-                return False
-        return bool(el.outgoing)
-    if (el.element_type == BpmnElementType.INTERMEDIATE_THROW_EVENT
-            and el.event_type == BpmnEventType.LINK):
-        # link throw rides the kernel as a K_PASS with a synthetic edge to
-        # the resolved same-scope catch (tables.compile_tables link branch)
-        return el.link_target_idx >= 0
-    if el.element_type in (BpmnElementType.INTERMEDIATE_CATCH_EVENT,
-                           BpmnElementType.RECEIVE_TASK):
-        if el.event_type == BpmnEventType.LINK:
-            # catch link: plain pass-through, no wait state to reconstruct
-            return True
-        # timer (fixed duration), message, and signal catches park on device
-        # (K_CATCH); the host resumes them via TRIGGER / CORRELATE /
-        # COMPLETE_ELEMENT commands — duration and correlation-key
-        # expressions are evaluated on the host at emission, so they may
-        # reference variables freely
-        if el.timer_duration is not None:
-            return (not el.timer_cycle and el.timer_date is None
-                    and el.message_name is None and el.signal_name is None)
-        return el.message_name is not None or el.signal_name is not None
-    op = _KERNEL_OP.get(el.element_type)
-    if op is None:
-        return False
-    if el.event_type not in (BpmnEventType.NONE, BpmnEventType.UNSPECIFIED):
-        return False
-    if (
-        el.timer_duration is not None
-        or el.timer_cycle is not None
-        or el.timer_date is not None
-        or el.message_name is not None
-        or el.signal_name is not None
-    ):
-        return False
-    if op == K_TASK:
-        # job-worker semantics only, with deploy-time-constant type/retries
-        if el.job_type is None or not el.job_type.is_static:
-            return False
-        if el.job_retries is not None and not el.job_retries.is_static:
-            return False
-    return True
+    vs ops/automaton masks). Derived from the reason-returning classifier in
+    engine/eligibility.py (ISSUE 13) — ONE eligibility logic feeding both the
+    runtime lowering and the static eligibility report."""
+    from zeebe_tpu.engine.eligibility import element_host_reason
+
+    return element_host_reason(exe, el) is None
 
 
 @dataclass(frozen=True)
@@ -866,7 +744,13 @@ class KernelRegistry:
     def __init__(self, max_definitions: int = 64) -> None:
         self.max_definitions = max_definitions
         self._by_key: dict[int, _DefInfo] = {}
-        self._ineligible: set[int] = set()
+        # definition key → typed catalog reason the registry declined it
+        # for (engine/eligibility.py DEFINITION_REASONS) — the eligibility
+        # report reads this, so the prediction IS the runtime's own verdict
+        self._ineligible: dict[int, str] = {}
+        # the most recent _build_info decline reason (set before each
+        # ``return None`` so lookup can record it without re-deriving)
+        self._last_decline: str | None = None
         self._infos: list[_DefInfo] = []
         self._tables: ProcessTables | None = None
         self._device = None
@@ -884,7 +768,8 @@ class KernelRegistry:
             return None
         info = self._build_info(definition_key, exe, processes, len(self._infos))
         if info is None:
-            self._ineligible.add(definition_key)
+            self._ineligible[definition_key] = (
+                self._last_decline or "condition-not-compilable")
             return None
         self._infos.append(info)
         self._by_key[definition_key] = info
@@ -897,12 +782,17 @@ class KernelRegistry:
         except ConditionNotCompilable:
             self._infos.pop()
             del self._by_key[definition_key]
-            self._ineligible.add(definition_key)
+            self._ineligible[definition_key] = "condition-not-compilable"
             self._tables = None  # previous set recompiles lazily
             return None
         self._device = None
         self._device_by_dev.clear()
         return info
+
+    def decline_reason(self, definition_key: int) -> str | None:
+        """The typed catalog reason a definition was declined for (None when
+        never declined) — the eligibility report's definition-level truth."""
+        return self._ineligible.get(definition_key)
 
     def refresh_segments(self, definition_key: int, exe, processes):
         """Re-inline a cached definition whose call segments went stale (a
@@ -934,6 +824,7 @@ class KernelRegistry:
         inlined when resolvable) into a _DefInfo at ``index``. Returns None
         when it cannot ride the kernel; callers decide whether that marks
         the key ineligible (lookup) or keeps the old info (refresh)."""
+        self._last_decline = None
         segments: tuple = ()
         if processes is not None:
             # statically-resolvable call activities inline as scope regions
@@ -952,6 +843,7 @@ class KernelRegistry:
         if exe.none_start_of(0) < 0:
             # only message/timer starts: every creation carries an explicit
             # start element — nothing for the kernel's entry path to run
+            self._last_decline = "no-none-start"
             return None
         root_esp_start_idxs: list[int] = []
         for esp in exe.event_sub_processes_of(0):
@@ -961,25 +853,19 @@ class KernelRegistry:
             # behavior verbatim, reconstruction counts them as root wait
             # state, and triggers route sequentially (a live ESP instance
             # makes resumes decline until it drains). Only subscription
-            # shapes the reconstruction can count are eligible.
+            # shapes the reconstruction can count are eligible
+            # (engine/eligibility.py esp_start_host_reason — shared with the
+            # static classifier so prediction cannot drift).
             start = exe.elements[esp.child_start_idx]
-            if not (
-                start.event_type in (BpmnEventType.ERROR,
-                                     BpmnEventType.ESCALATION)
-                or (start.event_type == BpmnEventType.TIMER
-                    and start.timer_duration is not None
-                    and start.timer_cycle is None
-                    and start.timer_date is None)
-                or (start.event_type == BpmnEventType.MESSAGE
-                    and start.message_name)
-                or (start.event_type == BpmnEventType.SIGNAL
-                    and start.signal_name)
-            ):
-                return None  # cycle/date timers: sequential end to end
+            decline = esp_start_host_reason(start)
+            if decline is not None:
+                self._last_decline = decline
+                return None  # e.g. cycle/date timers: sequential end to end
             root_esp_start_idxs.append(esp.child_start_idx)
         try:
             solo = compile_tables([exe], host_idxs=[host])
         except ConditionNotCompilable:
+            self._last_decline = "condition-not-compilable"
             return None
         clock = lambda: 0  # noqa: E731 — static expressions ignore the clock
         job_types: dict[int, str] = {}
@@ -1224,6 +1110,13 @@ class _PendingGroup:
 
     admitted: list
     failed: bool = False
+    # typed catalog reason when the device run declines (geometry bounds,
+    # non-quiescence, pool overflow, mesh errors) — finish_group feeds it
+    # into the consolidated PathAccounting exactly once per failed group
+    fail_reason: str | None = None
+    # device chunks actually fetched (the kernel_wave flight event's
+    # chunk-count field); mesh groups report 0 (the runner owns chunking)
+    chunks_run: int = 0
     mesh: bool = False
     arrays: dict | None = None
     I: int = 0
@@ -1291,11 +1184,14 @@ class KernelBackend:
         self.groups_processed = 0
         self.commands_processed = 0
         self.fallbacks = 0
-        # why each fallback happened (VERDICT r4 item 5: explain, then
-        # drive the rate down) — reason → count, surfaced in BENCH
-        from collections import Counter
-
-        self.fallback_reasons: Counter = Counter()
+        # consolidated path accounting (ISSUE 13): ONE reason catalog + ONE
+        # counter home for every kernel-vs-host routing decision — feeds
+        # zeebe_kernel_records_total{path,reason}, the per-definition
+        # coverage gauge, and the static-vs-observed parity gate.
+        # fallback_reasons aliases its Counter (VERDICT r4 item 5 / BENCH
+        # back-compat: reason → count, full strings incl. head-*:<kind>)
+        self.accounting = PathAccounting(engine.state.partition_id)
+        self.fallback_reasons = self.accounting.reasons
         self.template_hits = 0
         self.template_misses = 0
         self.template_audits = 0
@@ -1322,9 +1218,31 @@ class KernelBackend:
         regressions (ISSUE 7: the bare "head-not-admittable" count hid
         what actually fell back — and end-of-log probes inflated it)."""
         self.fallbacks += 1
-        self.fallback_reasons[
-            f"head-sequential:{record.value_type.name}.{record.intent.name}"
-        ] += 1
+        self.accounting.note_host(
+            f"head-sequential:{record.value_type.name}.{record.intent.name}",
+            self._definition_of(record),
+        )
+
+    def _definition_of(self, record) -> str:
+        """Best-effort bpmnProcessId attribution for a host-routed head
+        command (the per-definition coverage split). Creations carry the id
+        on the value; job completes resolve it through the job's state entry
+        (we are inside the partition's open transaction on every caller
+        path); everything else is unattributed ('-'). Attribution must never
+        take routing down."""
+        try:
+            value = record.value
+            definition = value.get("bpmnProcessId") if isinstance(value, dict) else None
+            if definition:
+                return definition
+            if (record.value_type, int(record.intent)) == (
+                    ValueType.JOB, int(JobIntent.COMPLETE)):
+                job = self.engine.state.jobs.get(record.key)
+                if job is not None and job.get("bpmnProcessId"):
+                    return job["bpmnProcessId"]
+        except Exception:  # noqa: BLE001 — attribution is best-effort
+            pass
+        return "-"
 
     # -- admission ----------------------------------------------------------
 
@@ -2040,7 +1958,6 @@ class KernelBackend:
             # the bit-packed event tensor carries dest in 16 bits and elem in
             # 14 — geometries beyond that (absurd for real workloads) take
             # the sequential path instead of corrupting the decode
-            self.fallback_reasons["geometry-bounds"] += 1
             logger.warning("kernel geometry T=%d E=%d exceeds event packing "
                            "bounds; falling back", T, E)
             return None
@@ -2094,6 +2011,7 @@ class KernelBackend:
         built = self._build_group_arrays(pg.admitted)
         if built is None:
             pg.failed = True
+            pg.fail_reason = "geometry-bounds"
             return
         pg.arrays, pg.I, pg.T = built
         pg.tables = self.registry.tables
@@ -2149,15 +2067,15 @@ class KernelBackend:
             ))
             pg.device_elapsed += _time.perf_counter() - t0
             if result.steps is None:
-                self.fallback_reasons["mesh-dispatch-error"] += 1
+                pg.fail_reason = "mesh-dispatch-error"
                 logger.warning("mesh kernel dispatch errored; falling back")
                 return None
             if not result.quiesced:
-                self.fallback_reasons["mesh-no-quiesce"] += 1
+                pg.fail_reason = "mesh-no-quiesce"
                 logger.warning("mesh kernel group did not quiesce; falling back")
                 return None
             if result.overflow:
-                self.fallback_reasons["mesh-token-overflow"] += 1
+                pg.fail_reason = "mesh-token-overflow"
                 logger.warning("mesh kernel token pool overflow (T=%d); falling back", pg.T)
                 return None
             return result.steps
@@ -2286,6 +2204,7 @@ class KernelBackend:
                     nxt = run_collect(pg.dt, state, n_steps=chunk,
                                       config=pg.config)
             flat = jax.device_get(packed)
+            pg.chunks_run = k + 1
             # per row: T*(2+FO) packed event ints + (active, overflow) tail
             events_host = flat[:, :-2].reshape(chunk, T, 2 + FO)
             active = flat[:, -2]
@@ -2313,11 +2232,11 @@ class KernelBackend:
                     state, packed = run_collect(pg.dt, state, n_steps=chunk,
                                                 config=pg.config)
         if not hit_quiescence:
-            self.fallback_reasons["no-quiesce"] += 1
+            pg.fail_reason = "no-quiesce"
             logger.warning("kernel group did not quiesce in %d steps; falling back", self.max_steps)
             return None
         if bool(overflow):
-            self.fallback_reasons["token-overflow"] += 1
+            pg.fail_reason = "token-overflow"
             logger.warning("kernel token pool overflow (T=%d); falling back", T)
             return None
         return steps
@@ -2380,9 +2299,10 @@ class KernelBackend:
             # regression where an admittable kind stopped admitting
             self.fallbacks += 1
             rec = head_cmd.record
-            self.fallback_reasons[
-                f"head-not-admittable:{rec.value_type.name}.{rec.intent.name}"
-            ] += 1
+            self.accounting.note_host(
+                f"head-not-admittable:{rec.value_type.name}.{rec.intent.name}",
+                self._definition_of(rec),
+            )
             return None
         pg = _PendingGroup(admitted)
         pg.t_admit = _time.perf_counter() - t0
@@ -2399,7 +2319,15 @@ class KernelBackend:
             return [], []
         steps = self._await_kernel(pg)
         if steps is None:
+            # the whole group declined at dispatch; the HEAD is what the
+            # caller processes sequentially next (the rest re-admit), so
+            # exactly one host record is noted, with the typed reason
             self.fallbacks += 1
+            head = pg.admitted[0]
+            self.accounting.note_host(
+                pg.fail_reason or "group-error",
+                head.inst.info.exe.process_id,
+            )
             return [], []
 
         t0 = _time.perf_counter()
@@ -2412,6 +2340,20 @@ class KernelBackend:
         self.groups_processed += 1
         self.commands_processed += len(admitted)
         return [a.cmd for a in admitted], results
+
+    def note_group_success(self, pg: _PendingGroup) -> None:
+        """Per-definition kernel-path accounting for one materialized group
+        (coverage gauge + parity gate), batched per definition to bound
+        gauge writes. Called by the processor AFTER the group's transaction
+        commits — noting inside ``finish_group`` would double-count the
+        group when a post-materialization commit failure rolls it back and
+        the same commands re-admit on the next pump."""
+        defs: dict[str, int] = {}
+        for adm in pg.admitted:
+            pid = adm.inst.info.exe.process_id
+            defs[pid] = defs.get(pid, 0) + 1
+        for pid, n in defs.items():
+            self.accounting.note_kernel(pid, n)
 
     # -- template routing ----------------------------------------------------
 

@@ -22,6 +22,7 @@ later, more fatal dump still carries the earlier context.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from collections import deque
@@ -30,15 +31,30 @@ from typing import Callable
 
 DEFAULT_CAPACITY = 256
 DUMP_MIN_INTERVAL_MS = 5_000
+# per-dump serialized-size cap (ISSUE 13 satellite): PR 12's autotune run
+# committed multiple >4k-line flight JSONs — gate dumps must stay
+# reviewable. Oldest ring entries drop first; the dump records how many.
+DEFAULT_MAX_DUMP_BYTES = 262_144
+
+
+def _max_dump_bytes() -> int:
+    try:
+        return int(os.environ.get("ZEEBE_FLIGHT_MAXDUMPBYTES",
+                                  DEFAULT_MAX_DUMP_BYTES))
+    except ValueError:
+        return DEFAULT_MAX_DUMP_BYTES
 
 
 class FlightRecorder:
     def __init__(self, node_id: str, data_dir: str | Path | None,
                  capacity: int = DEFAULT_CAPACITY,
-                 clock_millis: Callable[[], int] | None = None) -> None:
+                 clock_millis: Callable[[], int] | None = None,
+                 max_dump_bytes: int | None = None) -> None:
         self.node_id = node_id
         self.data_dir = Path(data_dir) if data_dir is not None else None
         self.capacity = capacity
+        self.max_dump_bytes = (max_dump_bytes if max_dump_bytes is not None
+                               else _max_dump_bytes())
         self.clock_millis = clock_millis or (lambda: int(time.time() * 1000))
         # partition id 0 = node-level ring (health, alerts, journal stalls)
         self._rings: dict[int, deque] = {}
@@ -93,17 +109,46 @@ class FlightRecorder:
                 payload.update(provider())
             except Exception:  # noqa: BLE001 — context is best-effort; the
                 pass           # rings themselves must always land on disk
+        body = self._bounded_body(payload)
         # wall-clock nanos disambiguate dumps under a controlled test clock
         # (many dumps can share one frozen clock_millis value)
         path = self.data_dir / f"flight-{now}-{time.monotonic_ns()}.json"
         try:
             self.data_dir.mkdir(parents=True, exist_ok=True)
             tmp = path.with_suffix(".json.tmp")
-            tmp.write_text(json.dumps(payload, indent=1, default=str))
+            tmp.write_bytes(body)
             tmp.replace(path)
         except OSError:
             return None  # a full/readonly disk must not turn a dump fatal
         return path
+
+    def _bounded_body(self, payload: dict) -> bytes:
+        """Serialize a dump under ``max_dump_bytes`` (UTF-8 bytes on disk,
+        not code points — non-ASCII event content must not overshoot the
+        cap): oldest ring entries drop first (round-robin across the
+        largest rings so one chatty partition cannot evict every other
+        ring), and the dump records ``truncatedEntries`` so a bounded dump
+        is never mistaken for the full evidence. Context providers are
+        kept — they are small and per-dump (the rings are what grow)."""
+        body = json.dumps(payload, indent=1, default=str).encode("utf-8")
+        if self.max_dump_bytes <= 0 or len(body) <= self.max_dump_bytes:
+            return body
+        rings = {pid: list(events)
+                 for pid, events in payload["partitions"].items()}
+        truncated = 0
+        while len(body) > self.max_dump_bytes:
+            victim = max(rings, key=lambda pid: len(rings[pid]), default=None)
+            if victim is None or not rings[victim]:
+                break  # nothing left to drop; ship what we have
+            # drop the oldest quarter of the largest ring per pass: a few
+            # serialize rounds instead of one per event
+            drop = max(1, len(rings[victim]) // 4)
+            del rings[victim][:drop]
+            truncated += drop
+            payload["partitions"] = {p: r for p, r in rings.items() if r}
+            payload["truncatedEntries"] = truncated
+            body = json.dumps(payload, indent=1, default=str).encode("utf-8")
+        return body
 
 
 def install_journal_stall_listener(recorder: FlightRecorder) -> None:

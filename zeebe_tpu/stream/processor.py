@@ -244,6 +244,27 @@ class StreamProcessor:
             for stage in ("decode", "device", "materialize", "append",
                           "flush", "side_effects")
         }
+        # dispatch-overlap receipt (ISSUE 13): fraction of a kernel group's
+        # wall time during which the host did useful work (the previous
+        # group's deferred side effects) while a dispatched device chunk was
+        # in flight — the begin_group/finish_group double-buffer seam's
+        # before/after number for the ROADMAP item 2 async work. EMA'd so
+        # the gauge reads as a recent-history ratio, not one group's jitter.
+        self._m_overlap = REGISTRY.gauge(
+            "kernel_dispatch_overlap_ratio",
+            "EMA of host-work-overlapping-device-dispatch time / kernel "
+            "group wall time (begin_group..finish_group seam)",
+            ("partition",)).labels(partition_label)
+        self._overlap_ema: float | None = None
+        # bounded kernel_wave flight events: per-wave stats aggregate here
+        # and flush through wave_listener (set by the broker partition →
+        # flight recorder) at most once per second — the ring stays
+        # reviewable and the hot loop never records per group
+        self.wave_listener: Callable[[dict], None] | None = None
+        self._wave_agg = {"waves": 0, "commands": 0, "chunks": 0,
+                          "maxWave": 0}
+        self._wave_marks: tuple[int, int, dict] = (0, 0, {})
+        self._wave_last_emit = 0.0
         # tracing: spans are minted ONLY on the PROCESSING-phase paths below —
         # replay_available has no tracing hooks, so crash-restart replay is
         # structurally unable to emit (duplicate) spans. The singleton is
@@ -534,13 +555,17 @@ class StreamProcessor:
         # out-of-transaction drain point: deferred groups carrying post-commit
         # tasks (skipped by the in-transaction overlap drain below) go out here
         self._run_deferred_effects()
+        overlap = 0.0
         try:
             with self.db.transaction():
                 pending = self.kernel_backend.begin_group(
                     self._iter_candidate_commands())
                 # the device is computing the first chunk: run the previous
-                # group's deferred host work in the gap
+                # group's deferred host work in the gap — the overlap window
+                # the dispatch-overlap gauge measures
+                t_overlap = _time.perf_counter()
                 self._run_deferred_effects()
+                overlap = _time.perf_counter() - t_overlap
                 cmds, builders = self.kernel_backend.finish_group(
                     pending, ProcessingResultBuilder)
                 if not cmds:
@@ -587,8 +612,15 @@ class StreamProcessor:
                 self.phase = Phase.FAILED
                 raise
             logger.exception("kernel group processing failed; falling back to sequential")
+            # consolidated path accounting: the head retries sequentially,
+            # so this IS one host-routed record with a runtime-only reason
+            self.kernel_backend.fallbacks += 1
+            self.kernel_backend.accounting.note_host("group-error")
             return 0
         self._reader_position = cmds[-1].position + 1
+        # kernel-path accounting AFTER the commit: a rolled-back group that
+        # re-admits next pump must not count twice (coverage/parity ruler)
+        self.kernel_backend.note_group_success(pending)
         # defer this group's post-commit side effects: they run while the
         # NEXT group's device chunk computes (or at the next sequential
         # command / idle boundary, whichever comes first)
@@ -605,13 +637,72 @@ class StreamProcessor:
         self._m_latency.observe(elapsed)
         self._m_batch_commands.observe(len(cmds))
         self._m_batch_duration.observe(elapsed)
+        self._observe_wave(pending, len(cmds), overlap, elapsed)
         if self._tracer.enabled:
             self._trace_group(cmds, elapsed, {
                 "decode": pending.t_admit, "device": pending.device_elapsed,
                 "materialize": pending.t_materialize, "append": append_dur,
-                "flush": flush_dur,
+                "flush": flush_dur, "overlap": overlap,
             })
         return len(cmds)
+
+    def _observe_wave(self, pending, commands: int, overlap: float,
+                      elapsed: float) -> None:
+        """Per-wave path accounting (ISSUE 13): the dispatch-overlap gauge
+        and the bounded ``kernel_wave`` flight events (wave size, chunk
+        count, kernel/host path split since the last event, dominant
+        fallback reason), flushed through ``wave_listener`` at most once
+        per second."""
+        import time as _time
+
+        if elapsed > 0:
+            ratio = min(1.0, overlap / elapsed)
+            ema = self._overlap_ema
+            self._overlap_ema = ratio if ema is None else ema + 0.2 * (ratio - ema)
+            self._m_overlap.set(round(self._overlap_ema, 4))
+        agg = self._wave_agg
+        agg["waves"] += 1
+        agg["commands"] += commands
+        agg["chunks"] += pending.chunks_run
+        if commands > agg["maxWave"]:
+            agg["maxWave"] = commands
+        if self.wave_listener is None:
+            return
+        now = _time.perf_counter()
+        if now - self._wave_last_emit < 1.0 and self._wave_last_emit:
+            return
+        self._wave_last_emit = now
+        acct = self.kernel_backend.accounting
+        k_mark, h_mark, reasons_mark = self._wave_marks
+        delta_reasons = {
+            r: c - reasons_mark.get(r, 0)
+            for r, c in acct.reasons.items() if c > reasons_mark.get(r, 0)
+        }
+        dominant = max(delta_reasons, key=delta_reasons.get, default=None)
+        d_kernel = acct.kernel_records - k_mark
+        d_host = acct.host_records - h_mark
+        event = {
+            "waves": agg["waves"],
+            "commands": agg["commands"],
+            "avgWave": round(agg["commands"] / max(1, agg["waves"]), 1),
+            "maxWave": agg["maxWave"],
+            "chunks": agg["chunks"],
+            "kernelRecords": d_kernel,
+            "hostRecords": d_host,
+            # the EVENT's window, consistent with its own delta counters
+            # (the cumulative ratio lives on /health and the gauge)
+            "coverageRatio": round(d_kernel / max(1, d_kernel + d_host), 4),
+            "overlapRatio": round(self._overlap_ema or 0.0, 4),
+            **({"dominantFallback": dominant} if dominant else {}),
+        }
+        self._wave_marks = (acct.kernel_records, acct.host_records,
+                            dict(acct.reasons))
+        self._wave_agg = {"waves": 0, "commands": 0, "chunks": 0,
+                          "maxWave": 0}
+        try:
+            self.wave_listener(event)
+        except Exception:  # noqa: BLE001 — telemetry must not wedge the pump
+            logger.exception("kernel_wave listener failed")
 
     def _trace_group(self, cmds: list[LoggedRecord], elapsed: float,
                      stages: dict[str, float]) -> None:
