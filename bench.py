@@ -1592,6 +1592,59 @@ def _consistency_main(quick: bool) -> None:
         raise SystemExit(1)
 
 
+def _torture_main(quick: bool) -> None:
+    """--torture: the storage fault-survival gate (ISSUE 14). Real
+    supervised workers serve the Jepsen-shaped workload while the disk,
+    the network, and the process table all lie at once; offline checks
+    prove delivery invariants held, every configured disk-fault class
+    fired, every at-rest bit-rot flip was detected-or-repaired before
+    wrong bytes were served, and the corrupted-follower repair probe
+    re-converged CRC-identical to the leader. Writes
+    TORTURE[_quick].json; violations fail the run."""
+    import shutil
+    import time as _time
+
+    from zeebe_tpu.testing.torture import TortureConfig, run_torture
+
+    cfg = (TortureConfig() if quick else
+           TortureConfig(drive_seconds=90.0, kills=3))
+    started = _time.perf_counter()
+    work_dir = tempfile.mkdtemp(prefix="zeebe-torture-")
+    try:
+        report = run_torture(cfg, directory=work_dir)
+    finally:
+        from pathlib import Path as _Path
+
+        dumps = _collect_gate_dumps(
+            sorted(_Path(work_dir).glob("*/flight-*.json")),
+            "TORTURE_dumps", work_dir)
+        shutil.rmtree(work_dir, ignore_errors=True)
+    report["flightDumps"] = dumps
+    report["wallSecondsTotal"] = round(_time.perf_counter() - started, 2)
+    report["quick"] = quick
+    name = "TORTURE_quick.json" if quick else "TORTURE.json"
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "torture": True, "quick": quick, "seed": report["seed"],
+        "requests": report["requests"],
+        "ackedCommands": report["ackedCommands"],
+        "kills": report["kills"],
+        "diskFaultsObserved": report["diskFaultsObserved"],
+        "bitrotFlips": report["bitrotFlips"],
+        "repairProbeVerified": report["repairProbe"].get("verified"),
+        "scrubEvidenceEvents": report["scrubEvidenceEvents"],
+        "violations": len(report["violations"]),
+        "full_results": name,
+    }))
+    if report["violations"]:
+        for v in report["violations"][:20]:
+            print(f"torture violation: {v}", file=sys.stderr)
+        raise SystemExit(1)
+
+
 def _serving_main(quick: bool) -> None:
     """--serving: the open-loop SLO'd serving gate (ISSUE 11). Drives the
     real multi-process cluster with seeded Poisson arrivals from hundreds
@@ -1899,7 +1952,7 @@ def main(quick: bool = False, trace: bool = False,
          sample_metrics: bool = False, profile: bool = False,
          soak: bool = False, scale_soak: bool = False,
          consistency: bool = False, serving: bool = False,
-         autotune: bool = False) -> None:
+         autotune: bool = False, torture: bool = False) -> None:
     # install the filter BEFORE any backend use: the mismatch warning fires
     # whenever a persistent-cache executable loads, including the probe's
     # subprocess (which inherits the filtered fd 2)
@@ -1916,6 +1969,10 @@ def main(quick: bool = False, trace: bool = False,
     if autotune:
         # same posture: arms run in worker processes
         _autotune_main(quick)
+        return
+    if torture:
+        # same posture: workers own the (faulted) disks
+        _torture_main(quick)
         return
     platform = _ensure_backend()
     if soak:
@@ -2143,6 +2200,19 @@ if __name__ == "__main__":
                     help="with --mesh: exit 1 unless every multi-partition "
                          "aggregate beats the first count's rate (the CI "
                          "mesh-smoke gate)")
+    ap.add_argument("--torture", action="store_true",
+                    help="storage fault-survival gate (ISSUE 14): the "
+                         "consistency workload over real supervised worker "
+                         "processes with DISK chaos (write EIO/ENOSPC, torn "
+                         "writes, fsync stalls/failures, at-rest bit rot) "
+                         "live simultaneously with TCP chaos and a kill "
+                         "storm; gates on zero acked loss, zero duplicate "
+                         "application, every configured disk-fault class "
+                         "observed, every bit-rot flip detected-or-repaired "
+                         "before wrong bytes served, and a deliberately "
+                         "corrupted follower journal re-converging "
+                         "CRC-identical to the leader's. Writes "
+                         "TORTURE[_quick].json")
     ap.add_argument("--mesh-worker-spec", help=argparse.SUPPRESS)
     _args = ap.parse_args()
     if _args.mesh_worker_spec:
@@ -2159,4 +2229,4 @@ if __name__ == "__main__":
              sample_metrics=_args.sample_metrics, profile=_args.profile,
              soak=_args.soak, scale_soak=_args.scale_soak,
              consistency=_args.consistency, serving=_args.serving,
-             autotune=_args.autotune)
+             autotune=_args.autotune, torture=_args.torture)

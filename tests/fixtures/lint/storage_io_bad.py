@@ -1,0 +1,20 @@
+"""Fixture: a storage module bypassing the storage_io seam (every call here
+is a finding — a write or durability barrier disk-fault injection cannot
+see)."""
+
+import os
+from pathlib import Path
+
+
+def persist(directory: Path, data: bytes) -> None:
+    with open(directory / "state.bin", "wb") as f:  # line 10: bare open
+        f.write(data)
+    fd = os.open(directory / "state.bin", os.O_RDONLY)  # line 12: os.open
+    os.fsync(fd)  # line 13: raw durability barrier
+    os.close(fd)
+    os.replace(directory / "tmp", directory / "final")  # line 15
+
+
+def write_sidecar(path: Path, text: str) -> None:
+    path.write_text(text)  # line 19: Path write
+    (path.parent / "blob").write_bytes(b"x")  # line 20

@@ -32,6 +32,7 @@ from zeebe_tpu.analysis.rules import (
     DriftCopyRule,
     PumpBlockingIoRule,
     ReplayDeterminismRule,
+    StorageIoDisciplineRule,
 )
 
 FIXTURES = Path(__file__).parent / "fixtures" / "lint"
@@ -202,6 +203,57 @@ def test_control_rule_single_write_path_in_tree():
 
     modules = parse_tree(REPO_ROOT)
     findings = ControlActuationDisciplineRule().check_tree(modules)
+    baseline = load_baseline(REPO_ROOT / BASELINE_FILENAME)
+    new = [f for f in findings if f.baseline_key not in baseline]
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+# -- rule 7: storage-io discipline (ISSUE 14) ---------------------------------
+
+
+def test_storage_io_rule_flags_every_bypass():
+    rule = StorageIoDisciplineRule(scope=("storage_io_bad.py",))
+    findings = rule.check(fixture_module("storage_io_bad.py"))
+    assert lines_by_rule(findings) == [
+        ("storage_io_bad.py", 10, "storage-io-discipline"),  # bare open
+        ("storage_io_bad.py", 12, "storage-io-discipline"),  # os.open
+        ("storage_io_bad.py", 13, "storage-io-discipline"),  # os.fsync
+        ("storage_io_bad.py", 15, "storage-io-discipline"),  # os.replace
+        ("storage_io_bad.py", 19, "storage-io-discipline"),  # write_text
+        ("storage_io_bad.py", 20, "storage-io-discipline"),  # write_bytes
+    ]
+    assert all("storage_io" in f.message for f in findings)
+
+
+def test_storage_io_rule_allows_the_seam_and_reads():
+    rule = StorageIoDisciplineRule(scope=("storage_io_good.py",))
+    assert rule.check(fixture_module("storage_io_good.py")) == []
+
+
+def test_storage_io_rule_ignores_out_of_scope_modules():
+    rule = StorageIoDisciplineRule(scope=("storage_io_good.py",))
+    assert rule.check(fixture_module("storage_io_bad.py")) == []
+
+
+def test_storage_io_rule_stale_scope_registration_fails():
+    rule = StorageIoDisciplineRule(scope=("gone/moved_away.py",))
+    findings = rule.validate([fixture_module("storage_io_bad.py")])
+    assert len(findings) == 1
+    assert "stale storage-module registration" in findings[0].message
+
+
+def test_storage_io_rule_live_tree_single_seam():
+    """The REAL storage modules perform no direct file IO — every write
+    and durability barrier routes through utils/storage_io, so the disk-
+    fault plane's coverage claim holds tree-wide (0 new findings)."""
+    from zeebe_tpu.analysis.framework import parse_tree
+
+    modules = parse_tree(REPO_ROOT)
+    findings = []
+    rule = StorageIoDisciplineRule()
+    findings += rule.validate(modules)
+    for module in modules:
+        findings += rule.check(module)
     baseline = load_baseline(REPO_ROOT / BASELINE_FILENAME)
     new = [f for f in findings if f.baseline_key not in baseline]
     assert new == [], "\n".join(f.render() for f in new)

@@ -66,6 +66,16 @@ KNOB_NOTES: dict[str, str] = {
         "incremental snapshots: base+delta chain length before a full "
         "rebase (1 = every snapshot full)"),
     "ZEEBE_BROKER_DATA_SNAPSHOTPERIOD": "periodic snapshot cadence (ms)",
+    "ZEEBE_BROKER_DATA_SCRUB_ENABLED": (
+        "at-rest storage scrubber: pump-throttled background CRC walk over "
+        "journal bytes, snapshot chain files, and cold segments — bit rot "
+        "is detected (and repaired) before a read serves it (default on)"),
+    "ZEEBE_BROKER_DATA_SCRUB_INTERVALMS": (
+        "scrubber: minimum ms between scrub slices on the pump "
+        "(default 1000)"),
+    "ZEEBE_BROKER_DATA_SCRUB_BYTESPERPASS": (
+        "scrubber: byte budget re-CRCed per slice — bounds the pump stall "
+        "per pass (default 4MiB)"),
     "ZEEBE_BROKER_DATA_TIERING_ENABLED": (
         "state tiering: spill parked instances to the cold disk store"),
     "ZEEBE_BROKER_DATA_TIERING_PARKAFTERMS": (
@@ -113,6 +123,15 @@ KNOB_NOTES: dict[str, str] = {
         "chaos seam: hard-exit the worker process between the Nth "
         "successful ingress append and its reply (one-shot per data dir; "
         "consistency gate)"),
+    "ZEEBE_CHAOS_DISK": (
+        "chaos disk: seeded storage fault-injection spec (write EIO/ENOSPC/"
+        "torn rates, fsync stall/failure rates, at-rest bit-rot cadence, "
+        "path classes) installed into the utils/storage_io seam; the "
+        "torture gate's fault source"),
+    "ZEEBE_CHAOS_DISK_DISARMFILE": (
+        "chaos disk: path the controller polls each tick — creating it "
+        "disarms all disk faults (the torture harness ends the survival "
+        "window before its probe/quiesce phases)"),
     "ZEEBE_CHAOS_EPOCH_MS": (
         "chaos TCP: epoch anchor for deterministic link-partition windows "
         "across processes"),

@@ -326,6 +326,10 @@ class PumpBlockingIoRule(Rule):
         ("zeebe_tpu/engine/kernel_backend.py", "KernelBackend.process_group"),
         ("zeebe_tpu/engine/kernel_backend.py", "KernelBackend.begin_group"),
         ("zeebe_tpu/engine/kernel_backend.py", "KernelBackend.finish_group"),
+        # at-rest storage scrubber (ISSUE 14): its slice runs between
+        # transactions on the partition pump — a sleep or fsync slipped
+        # into a scrub walk stalls the whole partition
+        ("zeebe_tpu/broker/scrubber.py", "StorageScrubber.maybe_run"),
     )
 
     def __init__(self, extra_roots=None) -> None:
@@ -710,6 +714,95 @@ class DriftCopyRule(Rule):
         return out
 
 
+# ---------------------------------------------------------------------------
+# rule 7: storage IO discipline (ISSUE 14)
+
+
+#: syscall-shaped calls that must route through the seam in storage modules
+_STORAGE_IO_CALLS = (
+    "os.fsync", "os.replace", "os.pwrite", "os.open", "os.rename",
+)
+_STORAGE_IO_BARE_CALLS = ("open",)
+#: attribute-call names that write a file when invoked on a Path
+_STORAGE_IO_WRITE_ATTRS = ("write_bytes", "write_text")
+
+
+class StorageIoDisciplineRule(Rule):
+    """Storage modules (journal, snapshot store, cold tier, backup store)
+    perform file IO only through ``zeebe_tpu/utils/storage_io.py`` — the
+    one seam the disk-fault injector (``ZEEBE_CHAOS_DISK``) and therefore
+    the whole torture gate's coverage claim hang off. A direct ``open`` /
+    ``os.fsync`` / ``os.replace`` / ``write_bytes`` in a storage module is
+    a write (or a durability barrier) the chaos plane cannot fault and the
+    fsyncgate handling cannot protect; deliberate exceptions (read-only
+    inspection helpers, advisory evidence files) are baselined with
+    justifications."""
+
+    name = "storage-io-discipline"
+    summary = ("journal/snapshot/tiering/backup file IO routes through "
+               "utils/storage_io (the disk-fault seam) — no direct "
+               "open/os.fsync/os.replace/write_bytes")
+
+    #: the storage modules under the seam's contract
+    DEFAULT_SCOPE = (
+        "zeebe_tpu/journal/journal.py",
+        "zeebe_tpu/state/snapshot.py",
+        "zeebe_tpu/state/tiering.py",
+        "zeebe_tpu/backup/store.py",
+    )
+    #: the seam itself is the only place the raw calls are legal
+    SEAM = "zeebe_tpu/utils/storage_io.py"
+
+    def __init__(self, scope=None) -> None:
+        self.scope = self.DEFAULT_SCOPE if scope is None else tuple(scope)
+
+    def validate(self, modules):
+        out = []
+        for entry in self.scope:
+            if not any(m.relpath == entry for m in modules):
+                out.append(self.registration_finding(
+                    entry,
+                    f"stale storage-module registration: `{entry}` matches "
+                    f"no linted file — the module was moved/renamed and "
+                    f"this rule is silently scanning nothing; update the "
+                    f"registration"))
+        return out
+
+    def check(self, module: ParsedModule) -> list[Finding]:
+        if module.relpath not in self.scope:
+            return []
+        aliases = _import_aliases(module.tree)
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func, aliases)
+            if dotted is not None and dotted.startswith(
+                    "zeebe_tpu.utils.storage_io."):
+                continue  # a call INTO the seam is the whole point
+            hit = None
+            if dotted is not None:
+                if _matches(dotted, _STORAGE_IO_CALLS) is not None:
+                    hit = dotted
+                elif dotted in _STORAGE_IO_BARE_CALLS:
+                    hit = dotted
+            if (hit is None and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _STORAGE_IO_WRITE_ATTRS):
+                hit = f".{node.func.attr}"
+            if hit is None:
+                continue
+            if module.is_suppressed(self.name, node):
+                continue
+            out.append(module.finding(
+                self.name, node,
+                f"direct file IO `{hit}(...)` in a storage module — route "
+                f"through zeebe_tpu.utils.storage_io (open_file/fsync/"
+                f"pwrite/replace/write_bytes); bypassing the seam makes "
+                f"this write invisible to disk-fault injection and the "
+                f"at-rest scrub/torture coverage claim"))
+        return out
+
+
 RULES: list[Rule] = [
     ReplayDeterminismRule(),
     DeviceCallDisciplineRule(),
@@ -717,4 +810,5 @@ RULES: list[Rule] = [
     CommittedReadDisciplineRule(),
     ControlActuationDisciplineRule(),
     DriftCopyRule(),
+    StorageIoDisciplineRule(),
 ]

@@ -17,6 +17,8 @@ import json
 import shutil
 from pathlib import Path
 
+from zeebe_tpu.utils import storage_io
+
 
 class BackupStatusCode(enum.Enum):
     DOES_NOT_EXIST = "DOES_NOT_EXIST"
@@ -66,9 +68,9 @@ class FileSystemBackupStore:
         (in_progress / "snapshot").mkdir(parents=True)
         (in_progress / "segments").mkdir(parents=True)
         for name, data in backup.snapshot_files.items():
-            (in_progress / "snapshot" / name).write_bytes(data)
+            storage_io.write_bytes(in_progress / "snapshot" / name, data)
         for name, data in backup.segment_files.items():
-            (in_progress / "segments" / name).write_bytes(data)
+            storage_io.write_bytes(in_progress / "segments" / name, data)
         manifest = {
             "checkpointId": backup.checkpoint_id,
             "partitionId": backup.partition_id,
@@ -78,8 +80,9 @@ class FileSystemBackupStore:
             "snapshotFiles": sorted(backup.snapshot_files),
             "segmentFiles": sorted(backup.segment_files),
         }
-        (in_progress / "manifest.json").write_text(json.dumps(manifest, indent=2))
-        in_progress.rename(target)  # atomic publish (the "COMPLETED" marker)
+        storage_io.write_text(in_progress / "manifest.json",
+                              json.dumps(manifest, indent=2))
+        storage_io.replace(in_progress, target)  # atomic publish ("COMPLETED")
         return self.get_status(backup.checkpoint_id, backup.partition_id)
 
     def get_status(self, checkpoint_id: int, partition_id: int) -> BackupStatus:

@@ -92,6 +92,13 @@ class BrokerCfg:
     # and drive the knob surface from the time-series store; requires the
     # metrics plane (its sensor). Off = the plane is not constructed.
     control: bool = True
+    # at-rest storage scrubber (ISSUE 14): pump-throttled background CRC
+    # walk over sealed journal bytes, snapshot chain files, and cold-store
+    # segments — bit rot is detected (and repaired) before a read serves
+    # it. ON by default: the budget bounds the pump cost per slice.
+    scrub: bool = True
+    scrub_interval_ms: int = 1_000
+    scrub_bytes_per_pass: int = 4 << 20
 
 
 _AUTO_DEVICE_COUNT: int | None = None
@@ -528,6 +535,16 @@ class Broker:
             )
         return self._shared_tiering_cfg
 
+    def _scrub_cfg(self):
+        """The partition-facing ScrubCfg, or None when scrubbing is off."""
+        if not self.cfg.scrub:
+            return None
+        from zeebe_tpu.broker.scrubber import ScrubCfg
+
+        return ScrubCfg(enabled=True,
+                        interval_ms=self.cfg.scrub_interval_ms,
+                        bytes_per_pass=self.cfg.scrub_bytes_per_pass)
+
     def _create_partition(self, partition_id: int, members: list[str],
                           priority: int = 1) -> None:
         import time as _time
@@ -564,6 +581,7 @@ class Broker:
             tiering=self._tiering_cfg(),
             log_flush_delay_ms=self.cfg.log_flush_delay_ms,
             log_max_unflushed_bytes=self.cfg.log_max_unflushed_bytes,
+            scrub=self._scrub_cfg(),
         )
         self.health_monitor.register(f"partition-{partition_id}")
         from zeebe_tpu.utils.metrics import REGISTRY as _REG

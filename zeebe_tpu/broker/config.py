@@ -81,6 +81,10 @@ class ExtendedBrokerCfg:
             raise ValueError("tiering parkAfterMs must be >= 0")
         if self.base.tiering_spill_batch < 1:
             raise ValueError("tiering spillBatch must be >= 1")
+        if self.base.scrub_interval_ms < 0:
+            raise ValueError("scrub intervalMs must be >= 0")
+        if self.base.scrub_bytes_per_pass < 1:
+            raise ValueError("scrub bytesPerPass must be >= 1")
 
 
 # env var → (section, field, type); relaxed-binding names follow the
@@ -138,6 +142,14 @@ _ENV_BINDINGS: dict[str, tuple[str, str, Any]] = {
     # ingress batch-coalescing window (multiproc worker ingress)
     "ZEEBE_BROKER_PROCESSING_COALESCEWINDOWMS": (
         "processing", "coalesce_window_ms", float),
+    # at-rest storage scrubber (ISSUE 14): pump-throttled background CRC
+    # walk over journals, snapshot chains, and cold segments
+    "ZEEBE_BROKER_DATA_SCRUB_ENABLED": (
+        "base", "scrub", lambda v: v.lower() in ("1", "true", "yes")),
+    "ZEEBE_BROKER_DATA_SCRUB_INTERVALMS": (
+        "base", "scrub_interval_ms", int),
+    "ZEEBE_BROKER_DATA_SCRUB_BYTESPERPASS": (
+        "base", "scrub_bytes_per_pass", int),
 }
 
 

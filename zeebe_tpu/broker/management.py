@@ -435,6 +435,14 @@ def broker_status(broker) -> dict:
                 **({"kernelCoverage": _kernel_coverage_row(p)}
                    if p.processor is not None
                    and p.processor.kernel_backend is not None else {}),
+                # at-rest storage integrity (ISSUE 14): compact form — the
+                # full detection/repair detail lives on /health
+                **({"storageIntegrity": {
+                    "status": p.scrubber.status()["status"],
+                    "corruptions": len(p.scrubber.detections),
+                    "repairs": len(p.scrubber.repairs),
+                    "fullPasses": p.scrubber.full_passes,
+                }} if p.scrubber is not None else {}),
             }
             for pid, p in sorted(broker.partitions.items())
         },

@@ -30,6 +30,8 @@ from zeebe_tpu.engine.engine import Engine
 from zeebe_tpu.engine.message_timer import DueDateCheckers
 from zeebe_tpu.exporters.director import ExporterDirector
 from zeebe_tpu.journal import SegmentedJournal
+from zeebe_tpu.journal.journal import CorruptedJournalError
+from zeebe_tpu.state.tiering import ColdCorruptionError
 from zeebe_tpu.logstreams import LogAppendEntry, LogStream, patch_prepatched_batch
 from zeebe_tpu.observability.tracer import get_tracer as _get_tracer
 from zeebe_tpu.protocol import Record
@@ -94,6 +96,10 @@ _M_ADAPTIVE_SNAPSHOTS = _REG.counter(
     "snapshot_adaptive_triggers_total",
     "snapshots taken early because projected replay debt threatened the "
     "recovery budget", ("partition",))
+_M_SNAPSHOT_WRITE_FAILURES = _REG.counter(
+    "snapshot_write_failures_total",
+    "snapshots aborted by a storage write/fsync fault (ISSUE 14); the "
+    "previous valid chain stays the recovery anchor", ("partition",))
 # replicated request dedupe (ISSUE 9): ingress consults the materialized
 # table before appending — a hit suppresses a duplicate append, a replay
 # re-sends the stored reply for an already-answered request
@@ -175,6 +181,7 @@ class ZeebePartition:
         tiering=None,
         log_flush_delay_ms: int = 0,
         log_max_unflushed_bytes: int = 1 << 20,
+        scrub=None,
     ) -> None:
         self.partition_id = partition_id
         self.partition_count = partition_count
@@ -292,6 +299,21 @@ class ZeebePartition:
         # (or a promoted follower) still refuses to double-append a resend
         # that races recovery.
         self._pending_requests: dict[tuple[int, int], int] = {}
+        # storage-fault plane (ISSUE 14): at-rest scrubber + repair seams.
+        # ``scrub`` is a ScrubCfg | None; the scrubber survives transitions
+        # (cursors and evidence are partition-lifetime) and reads the LIVE
+        # journals/stores through `self` each slice.
+        self.scrubber = None
+        if scrub is not None and getattr(scrub, "enabled", False):
+            from zeebe_tpu.broker.scrubber import StorageScrubber
+
+            self.scrubber = StorageScrubber(self, scrub, clock_millis)
+        self.raft.storage_listener = self._on_raft_storage_event
+        # repair-loop guard: target -> monotonic time of last repair; a
+        # second repair of the same target within the window means the
+        # fault is not repairable by that seam — fail the processor instead
+        # of looping the partition through endless rebuilds
+        self._last_storage_repair: dict[str, float] = {}
         self._transition()  # start as follower (replay mode)
         # catch up on whatever the raft log already committed before we wired
         self._materialize_committed()
@@ -896,7 +918,37 @@ class ZeebePartition:
     # -- pump (the actor loop, driven by the broker) ---------------------------
 
     def pump(self) -> int:
-        """Advance processing/replay, scheduled work, and exporters."""
+        """Advance processing/replay, scheduled work, and exporters.
+
+        Storage-fault containment (ISSUE 14): the typed corruption errors
+        the read paths raise — a cold-store CRC mismatch on fault-in, a
+        stream-journal checksum mismatch under replay/export — are caught
+        HERE, above the stream processor's blanket failure containment, and
+        routed to their repair seams instead of poisoning the pump or
+        failing the partition."""
+        try:
+            return self._pump_inner()
+        except ColdCorruptionError as exc:
+            self.repair_cold_corruption(str(exc))
+            return 1
+        except CorruptedJournalError as exc:
+            if (exc.path is not None
+                    and str(exc.path).startswith(str(self.raft.journal.dir))):
+                # raft-journal rot surfaced through a pump-side read (e.g.
+                # a compaction-guard seek): raft owns that repair, and its
+                # storage_listener records the repair evidence
+                if self.scrubber is not None:
+                    self.scrubber.note_corruption(
+                        "raft", {"corruptIndex": exc.index}, source="read")
+                self.raft.repair_journal_corruption(exc)
+                return 1
+            if self.scrubber is not None:
+                self.scrubber.note_corruption(
+                    "stream", {"corruptIndex": exc.index}, source="read")
+            self.repair_stream_corruption(exc.index)
+            return 1
+
+    def _pump_inner(self) -> int:
         work = 0
         if self.processor is None:
             return work
@@ -939,7 +991,177 @@ class ZeebePartition:
             # between transactions by construction: processing/replay above
             # has drained, snapshots never hold a transaction open
             self.tiering.maybe_run()
+        if self.scrubber is not None:
+            # at-rest integrity walk (ISSUE 14): throttled, byte-budgeted,
+            # between transactions like tiering
+            self.scrubber.maybe_run()
         return work
+
+    # -- storage-fault repair seams (ISSUE 14) ---------------------------------
+
+    def _storage_repair_ok(self, target: str) -> bool:
+        """Repair-loop guard: the same target repairing twice inside the
+        window means the fault is not repairable by that seam — contain it
+        like a poison record (processor FAILED, partition unhealthy) instead
+        of looping the partition through endless rebuilds."""
+        now = _perf_counter()
+        last = self._last_storage_repair.get(target, -60.0)
+        self._last_storage_repair[target] = now
+        if now - last < 5.0:
+            if self.processor is not None:
+                self.processor.phase = _Phase.FAILED
+            if self.flight is not None:
+                self.flight.record(self.partition_id, "storage_repair",
+                                   target=target, action="gave-up",
+                                   complete=False)
+                self.flight.dump(f"storage-giveup:partition-"
+                                 f"{self.partition_id}", force=True)
+            return False
+        return True
+
+    def _on_raft_storage_event(self, event: str, detail: dict) -> None:
+        """Raft's storage_listener: corruption repairs and fsync failures
+        land in the flight recorder (and the scrubber's evidence, which
+        the torture gate reads offline)."""
+        if event == "journal_repair":
+            if self.scrubber is not None:
+                self.scrubber.note_repair("raft", "truncate-reconverge",
+                                          detail)
+            elif self.flight is not None:
+                self.flight.record(self.partition_id, "storage_repair",
+                                   target="raft",
+                                   action="truncate-reconverge", **detail)
+        elif event == "journal_unrepairable":
+            # the raft repair seam is looping on a fault it cannot fix:
+            # contain like a poison record — raft deliberately never raises
+            # (its callers are rpc handlers and tick(), whose escape path
+            # is the worker's whole poll loop)
+            if self.processor is not None:
+                self.processor.phase = _Phase.FAILED
+            if self.flight is not None:
+                self.flight.record(self.partition_id, "storage_repair",
+                                   target="raft", action="gave-up",
+                                   complete=False, **detail)
+                self.flight.dump(f"storage-giveup:partition-"
+                                 f"{self.partition_id}", force=True)
+        elif self.flight is not None:
+            self.flight.record(self.partition_id, "storage_error", **detail)
+
+    def repair_stream_corruption(self, corrupt_index: int | None = None
+                                 ) -> dict:
+        """Stream-journal corruption repair: the materialized log is DERIVED
+        from the raft log, so the repair is truncate-at-the-corrupt-frame +
+        re-materialize. The raft compaction guard keeps every record any
+        exporter still needs (and everything above the snapshot) in the
+        raft log, so the refill is always sufficient: records that can no
+        longer be refilled are exactly the ones snapshot + exporter cursors
+        already covered."""
+        if not self._storage_repair_ok("stream"):
+            return {}
+        evidence = self.stream_journal.repair_corruption()
+        surviving_asqn = self.stream_journal.last_asqn
+        # rebuild the LogStream over the repaired journal (its in-memory
+        # position maps still describe the truncated suffix)
+        self.stream = LogStream(self.stream_journal, self.partition_id,
+                                clock=self.clock_millis)
+        self.stream_journal.compact_guard = self._stream_compact_guard
+        # rewind the applied raft index to the last surviving batch so
+        # materialization re-appends the lost suffix from the raft log
+        self._applied_raft_index = (
+            self.raft.journal.seek_to_asqn(surviving_asqn)
+            if surviving_asqn > 0 else 0)
+        self._next_position = self.stream.last_position + 1
+        evidence.update({"journal": "stream",
+                         "corruptIndex": corrupt_index,
+                         "rewoundRaftIndex": self._applied_raft_index})
+        self._materialize_committed()
+        self._transition()  # rebuild the vertical over the repaired log
+        if self.scrubber is not None:
+            self.scrubber.note_repair("stream", "truncate-rematerialize",
+                                      evidence)
+        elif self.flight is not None:
+            self.flight.record(self.partition_id, "storage_repair",
+                               target="stream",
+                               action="truncate-rematerialize", **evidence)
+        return evidence
+
+    def repair_snapshot_corruption(self, detail: dict | None = None) -> dict:
+        """Snapshot corruption repair (tip or mid-chain): QUARANTINE the
+        corrupt member (renamed out of the recovery path — the chain
+        validator, queries, and a later recovery all skip it), then
+        re-anchor: a leader takes a fresh FULL snapshot from its live
+        state; a follower asks the leader to stream an install
+        (``receive_snapshot`` persists it). An idle partition that cannot
+        produce a newer snapshot id yet re-anchors at its next periodic
+        snapshot — recovery meanwhile falls back to the older valid chain
+        (single-replica clusters with a compacted log can only truncate;
+        docs/durability.md carries that caveat honestly)."""
+        from zeebe_tpu.state.snapshot import SnapshotId
+
+        detail = detail or {}
+        snap_id_str = detail.get("snapshotId")
+        evidence: dict = {"snapshotId": snap_id_str}
+        snap_id = SnapshotId.parse(snap_id_str) if snap_id_str else None
+        quarantined = None
+        snap = (self.snapshot_store.snapshot_at(snap_id)
+                if snap_id is not None else None)
+        if snap is not None:
+            quarantined = self.snapshot_store.quarantine(snap)
+            evidence["quarantined"] = (str(quarantined)
+                                       if quarantined else None)
+        # the corrupt member may sit anywhere in the live chain: drop the
+        # anchor so the next snapshot rebases to a FULL one, and invalidate
+        # the compaction-bound memo (it may have trusted the dead chain)
+        self._snapshot_anchor = None
+        self._chain_len = 0
+        self._compact_bound_memo = (None, -1)
+        action = "pending"
+        if self.role == RaftRole.LEADER:
+            try:
+                if self.take_snapshot(force_full=True):
+                    action = "fresh-full-snapshot"
+            except OSError:
+                pass  # disk still failing; retried on a later scrub pass
+        elif self.raft.request_snapshot():
+            action = "requested-install"
+        evidence["action"] = action
+        # "pending" (no leader to ask, or the fresh snapshot itself failed
+        # on the still-faulting disk) must keep the DEGRADED latch so the
+        # scrubber's per-cycle retry actually fires; quarantine alone is
+        # only half the repair
+        complete = (snap is None
+                    or (quarantined is not None and action != "pending"))
+        if self.scrubber is not None:
+            self.scrubber.note_repair("snapshot", action, evidence,
+                                      complete=complete)
+        elif self.flight is not None:
+            self.flight.record(self.partition_id, "storage_repair",
+                               target="snapshot", action=action, **evidence)
+        return evidence
+
+    def repair_cold_corruption(self, reason: str) -> dict:
+        """Cold-store corruption repair (read-side parity with PR 9's
+        write-side degradation): latch tiering DEGRADED, then TRANSITION —
+        the cold tier is a cache, so the rebuild from chain + log (which
+        wipes the cold dir) restores every value the rotten frame held.
+        The pump survives; nothing is served from the bad frame."""
+        if not self._storage_repair_ok("cold"):
+            return {}
+        from zeebe_tpu.state.tiering import note_cold_read_error
+
+        evidence = {"reason": str(reason)[:300]}
+        note_cold_read_error(self.partition_id)
+        if self.tiering is not None:
+            self.tiering.degraded = True
+            self.tiering.degraded_reason = evidence["reason"]
+        self._transition()
+        if self.scrubber is not None:
+            self.scrubber.note_repair("cold", "transition-rebuild", evidence)
+        elif self.flight is not None:
+            self.flight.record(self.partition_id, "storage_repair",
+                               target="cold", action="transition-rebuild",
+                               **evidence)
+        return evidence
 
     # -- snapshotting (AsyncSnapshotDirector equivalent) -----------------------
 
@@ -1026,6 +1248,34 @@ class ZeebePartition:
             )
         except Exception:
             return False  # not newer than the latest snapshot
+        return self._write_and_persist_snapshot(
+            transient, processed, exported, force_full,
+            snapshot_started=snapshot_started)
+
+    def _write_and_persist_snapshot(self, transient, processed: int,
+                                    exported: int, force_full: bool,
+                                    snapshot_started: float) -> bool:
+        import time as _time
+
+        from zeebe_tpu.utils.metrics import REGISTRY
+
+        try:
+            return self._write_and_persist_snapshot_inner(
+                transient, processed, exported, force_full,
+                snapshot_started, _time, REGISTRY)
+        except OSError:
+            # disk fault mid-snapshot (ISSUE 14): abort the transient (the
+            # half-written pending dir must not survive) and decline — the
+            # periodic/adaptive scheduler retries; recovery still has the
+            # previous valid chain
+            transient.abort()
+            _M_SNAPSHOT_WRITE_FAILURES.labels(str(self.partition_id)).inc()
+            return False
+
+    def _write_and_persist_snapshot_inner(self, transient, processed: int,
+                                          exported: int, force_full: bool,
+                                          snapshot_started: float,
+                                          _time, REGISTRY) -> bool:
         kind = "full"
         if self.durable_state:
             # O(delta): fsync the durable delta log + manifest; the snapshot
@@ -1216,6 +1466,13 @@ class ZeebePartition:
                                 clock=self.clock_millis)
         self.stream._next_position = meta["lastPosition"] + 1
         self._next_position = meta["lastPosition"] + 1
+        # re-anchor materialization at the installed snapshot: entries below
+        # it are covered by the snapshot, entries above it refill from the
+        # (reset) raft log. Without this, a NON-lagging follower that
+        # requested an install as a snapshot-corruption repair (ISSUE 14)
+        # would skip the refilled entries — its applied index still pointed
+        # past them from the pre-install log.
+        self._applied_raft_index = self.raft.snapshot_index
         self._transition()
 
     # -- lifecycle -------------------------------------------------------------
@@ -1322,4 +1579,9 @@ class ZeebePartition:
                 .accounting.snapshot()}
                if self.processor is not None
                and self.processor.kernel_backend is not None else {}),
+            # at-rest storage integrity (ISSUE 14): scrub coverage,
+            # detections, repairs, and the DEGRADED latch while a repair
+            # is still pending
+            **({"storageIntegrity": self.scrubber.status()}
+               if self.scrubber is not None else {}),
         }

@@ -30,12 +30,22 @@ from typing import Any, Callable
 
 from zeebe_tpu.cluster.messaging import MessagingService
 from zeebe_tpu.journal import SegmentedJournal
+from zeebe_tpu.journal.journal import CorruptedJournalError
 from zeebe_tpu.protocol.msgpack import packb, unpackb
 
 HEARTBEAT_INTERVAL_MS = 250
 ELECTION_TIMEOUT_MS = 2_500
 MAX_ENTRIES_PER_APPEND = 64
 SNAPSHOT_CHUNK_BYTES = 512 * 1024
+# last-resort window for corruption-repaired nodes (ISSUE 14): a node whose
+# log was truncated below its own commit index abstains from elections —
+# but if NO leader has been heard for this long, every replica may be in
+# that state (rot hit a quorum) and abstention would wedge the cluster
+# forever. Past the window the node re-enters elections under the standard
+# longest-log-wins rule; what rot destroyed on every replica is gone either
+# way (the documented caveat), and a healthy leader's heartbeats make the
+# window unreachable in normal operation.
+LAST_RESORT_ELECTION_MS = 10 * ELECTION_TIMEOUT_MS
 
 
 class RaftRole(enum.Enum):
@@ -178,6 +188,23 @@ class RaftNode:
         self._flushed_index = min(self.journal.last_flushed_index,
                                   self.journal.last_index)
         self._flush_dirty = False
+        # boot-time rot suspicion (ISSUE 14): the open() scan truncates the
+        # journal at the first corrupt frame — safe for a torn UNFSYNCED
+        # tail (those bytes were never acked), but at-rest bit rot can land
+        # BELOW the persisted flush marker, i.e. below bytes this node
+        # promised were durable (and possibly voted into a commit). The
+        # marker is written only after a successful fsync, so marker >
+        # last_index on open means flushed history was LOST: the node boots
+        # SUSPECT and abstains from elections (see _election_safe) until a
+        # leader re-converges it past the marker — without this, a
+        # restarted replica with a silently-shortened log can win an
+        # election and re-mint different bytes at committed positions
+        # (caught as export split-brain by the torture gate). RF=1 has no
+        # one to re-converge from: the loss is accepted (documented caveat).
+        marker = self.journal.last_flushed_index
+        self._suspect_index = (
+            marker if (marker > self.journal.last_index
+                       and len(self.members) > 1) else 0)
         self._meta_path = self.directory / "raft-meta.json"
         self.current_term = 0
         self.voted_for: str | None = None
@@ -212,6 +239,11 @@ class RaftNode:
         # the partition owner so lagging followers receive state snapshots
         self.snapshot_provider: Callable[[], tuple[int, int, bytes] | None] | None = None
         self.snapshot_receiver: Callable[[bytes], None] | None = None
+        # storage-fault plane (ISSUE 14): called with (event, detail) on
+        # journal corruption repairs and fsync failures so the partition can
+        # flight-record them; repairs are throttled against hot loops
+        self.storage_listener: Callable[[str, dict], None] | None = None
+        self._last_repair_perf = -60.0
 
         t = f"raft-{partition_id}"
 
@@ -220,7 +252,19 @@ class RaftNode:
 
             def wrapped(sender, payload):
                 child.inc()
-                handler(sender, payload)
+                try:
+                    handler(sender, payload)
+                except CorruptedJournalError as exc:
+                    # at-rest rot surfaced on a read inside an rpc handler:
+                    # repair (truncate at the corrupt frame) instead of
+                    # letting the error poison the messaging poll loop —
+                    # the raft append path re-converges the lost suffix
+                    self.repair_journal_corruption(exc)
+                except OSError as exc:
+                    # storage trouble inside an rpc handler (failed fsync,
+                    # write fault): nothing was acked beyond the flushed
+                    # prefix — note it and let the protocol retry
+                    self._note_storage_error(exc)
 
             return wrapped
 
@@ -230,6 +274,8 @@ class RaftNode:
         messaging.subscribe(f"{t}-append-resp", _counted("append-resp", self._on_append_response))
         messaging.subscribe(f"{t}-snapshot", _counted("snapshot", self._on_install_snapshot))
         messaging.subscribe(f"{t}-timeout-now", _counted("timeout-now", self._on_timeout_now))
+        messaging.subscribe(f"{t}-snapshot-req",
+                            _counted("snapshot-req", self._on_snapshot_request))
 
     # -- persistence ----------------------------------------------------------
 
@@ -305,7 +351,26 @@ class RaftNode:
     def _flush_journal(self) -> None:
         if self.journal.last_index != self._flushed_index:
             start = _perf_counter()
-            self.journal.flush()
+            try:
+                self.journal.flush()
+            except OSError as exc:
+                # fsyncgate (ISSUE 14): the journal already failed the
+                # segment hard — fresh fd, file re-verified from the last
+                # known-flushed offset, suffix discarded. Our job is the
+                # consensus side of the contract: nothing the failed fsync
+                # covered may be acked, and a LEADER whose own log just
+                # rewound must stop leading (re-appending at reused indexes
+                # in the same term would hand followers conflicting entries
+                # the protocol cannot detect). The surviving cluster
+                # re-elects; this node re-converges as a follower.
+                self._flushed_index = min(self._flushed_index,
+                                          self.journal.last_index)
+                self._flush_dirty = False
+                self._last_flush_perf = _perf_counter()
+                self._note_storage_error(exc)
+                if self.role == RaftRole.LEADER:
+                    self._become(RaftRole.FOLLOWER)
+                return
             self._m_flush_duration.observe(_perf_counter() - start)
             self._flushed_index = self.journal.last_index
         self._flush_dirty = False
@@ -347,6 +412,87 @@ class RaftNode:
         # the log prefix (and any config entries in it) is gone: the current
         # membership becomes the configuration base for rollbacks
         self._config_base = list(self.members)
+
+    # -- storage-fault repair (ISSUE 14) --------------------------------------
+
+    def repair_journal_corruption(self, exc: Exception | None = None) -> dict:
+        """At-rest corruption in the raft journal (bit rot caught by the
+        scrubber, or a checksum mismatch hit on a live read): truncate at
+        the corrupt frame and let the protocol re-converge — the leader
+        backs up to the survivors' end and resends, exactly the divergent-
+        follower repair Raft already owns. A LEADER repairing its own log
+        steps down first (leader completeness: the committed suffix lives
+        on a quorum; a single-replica cluster can only truncate — that
+        caveat is documented, not hidden). Throttled: a second repair
+        within 5s reports ``journal_unrepairable`` through the storage
+        listener (the partition fails its processor) instead of looping a
+        hot unrepairable fault — and never raises: the callers are rpc
+        handlers and tick(), whose escape path is the worker's whole poll
+        loop."""
+        now = _perf_counter()
+        if now - self._last_repair_perf < 5.0:
+            # unrepairable by this seam: surface it through the listener —
+            # NEVER raise from here, the callers are rpc handlers and
+            # tick() whose escape path is the worker's whole poll loop;
+            # the partition listener contains it like a poison record
+            evidence = {"journal": "raft", "member": self.member_id,
+                        "gaveUp": True,
+                        "reason": f"repair looping on {self.directory}"
+                                  f" ({exc})"}
+            if self.storage_listener is not None:
+                self.storage_listener("journal_unrepairable", evidence)
+            return evidence
+        self._last_repair_perf = now
+        evidence = self.journal.repair_corruption()
+        self._flushed_index = min(self._flushed_index, self.journal.last_index)
+        if (len(self.members) <= 1
+                and self.journal.last_index < self.commit_index):
+            # single-replica cluster: there is no leader to re-fetch the
+            # truncated committed suffix from — the disk ate it (the
+            # documented RF=1 caveat). Rewind the commit index so the node
+            # keeps serving what survives instead of abstaining forever
+            # (_election_safe would otherwise never clear).
+            evidence["rewoundCommitIndex"] = self.commit_index
+            self.commit_index = self.journal.last_index
+        evidence["journal"] = "raft"
+        evidence["member"] = self.member_id
+        evidence["wasLeader"] = self.role == RaftRole.LEADER
+        if exc is not None:
+            evidence["trigger"] = str(exc)
+        if self.role == RaftRole.LEADER:
+            self._become(RaftRole.FOLLOWER)
+        if self.storage_listener is not None:
+            self.storage_listener("journal_repair", evidence)
+        return evidence
+
+    def _note_storage_error(self, exc: OSError) -> None:
+        if self.storage_listener is not None:
+            self.storage_listener("storage_error", {
+                "journal": "raft", "member": self.member_id,
+                "error": f"{type(exc).__name__}: {exc}"})
+
+    def request_snapshot(self) -> bool:
+        """Follower-side snapshot re-fetch (ISSUE 14): ask the current
+        leader to stream its snapshot install — the repair path for a
+        follower whose at-rest snapshot chain is corrupt. The existing
+        install machinery does the rest (reset journal past the snapshot,
+        persist, rebuild the vertical). Returns False when there is no
+        known leader to ask (retry on a later scrub pass)."""
+        if self.role == RaftRole.LEADER or self.leader_id is None:
+            return False
+        self._send(self.leader_id, "snapshot-req",
+                   {"term": self.current_term, "follower": self.member_id})
+        return True
+
+    def _on_snapshot_request(self, sender: str, req: dict) -> None:
+        if req.get("term", 0) > self.current_term:
+            # like every raft rpc: a higher term deposes a stale leader
+            self._set_term(req["term"])
+            self._become(RaftRole.FOLLOWER)
+            return
+        if self.role != RaftRole.LEADER:
+            return
+        self._send_snapshot(sender)
 
     def close(self) -> None:
         if self.flush_policy != "none":
@@ -391,6 +537,21 @@ class RaftNode:
         return self.clock_millis() + bias + jitter
 
     def tick(self, now_millis: int | None = None) -> None:
+        try:
+            self._tick_inner(now_millis)
+        except CorruptedJournalError as exc:
+            # journal reads ride the tick (heartbeat entry reads, election
+            # up-to-date terms): rot there repairs exactly like rot inside
+            # an rpc handler
+            self.repair_journal_corruption(exc)
+        except OSError as exc:
+            # deliberately broad: storage faults AND transport errors that
+            # escape a tick are both contained here — the caller is the
+            # worker's whole poll loop, and the next tick (~one pump round
+            # away) redoes any work this one dropped
+            self._note_storage_error(exc)
+
+    def _tick_inner(self, now_millis: int | None = None) -> None:
         now = self.clock_millis() if now_millis is None else now_millis
         if self._flush_dirty:
             if self.flush_policy == "immediate":
@@ -423,11 +584,41 @@ class RaftNode:
 
     # -- elections ------------------------------------------------------------
 
+    def _election_safe(self) -> bool:
+        """Raft's quorum-intersection safety argument assumes stable
+        storage. A node whose journal was truncate-REPAIRED below its own
+        known commit index (at-rest corruption, ISSUE 14) holds a log that
+        LIES about history: letting it start elections — or grant votes
+        against its shortened log — can elect a leader missing committed
+        entries (commit majority {A,B}, election majority {A,C}, A is the
+        corrupted intersection). Until the leader re-converges this node
+        past its commit index, it ABSTAINS from elections entirely. Healthy
+        operation always satisfies the check (commit ≤ last log index), so
+        this costs nothing outside a repair window. The same rule covers
+        BOOT-time rot: a journal that opened below its own flush marker
+        (``_suspect_index``) lost flushed — possibly committed — history
+        and must not lead or judge until refilled past the marker."""
+        return self._last_log_index() >= max(self.commit_index,
+                                             self._suspect_index)
+
+    def _last_resort_due(self) -> bool:
+        """True when no leader has been heard for LAST_RESORT_ELECTION_MS:
+        the abstention rule yields to liveness (rot on a quorum would
+        otherwise wedge the cluster with every replica waiting for a
+        leader that can never be elected)."""
+        return (self.clock_millis() - self._last_heartbeat_ms
+                >= LAST_RESORT_ELECTION_MS)
+
     def _start_prevote(self) -> None:
         """Pre-vote phase: probe electability without disturbing the term
         (reference: raft pre-vote, PreVoteRequest). A candidate whose election
         timed out retries the election directly — prevote responses are only
         collected while still a follower."""
+        if not self._election_safe() and not self._last_resort_due():
+            # corruption-repaired log below our own commit: wait for the
+            # leader to refill it (see _election_safe) instead of electing
+            self._election_deadline_ms = self._next_election_deadline()
+            return
         if self.role == RaftRole.CANDIDATE:
             self._start_election()
             return
@@ -472,11 +663,28 @@ class RaftNode:
             # learned of the removal) must not be able to bump our terms
             return
         term = req["term"]
-        up_to_date = (
+        standard_up_to_date = (
             req["lastLogTerm"] > self._last_log_term()
             or (req["lastLogTerm"] == self._last_log_term()
                 and req["lastLogIndex"] >= self._last_log_index())
         )
+        if self._election_safe():
+            up_to_date = standard_up_to_date
+        else:
+            # corruption-repaired log below our own commit index: our
+            # shortened history cannot judge candidates — it would grant
+            # votes to candidates missing committed entries (see
+            # _election_safe). But the REMEMBERED commit index still can:
+            # a candidate whose log covers it cannot be missing anything
+            # we know committed. Past the last-resort window (no leader
+            # for 10x the election timeout — rot hit a quorum and nobody
+            # can satisfy the commit-index bar) fall back to the standard
+            # longest-log-wins rule: the best surviving log leads, and
+            # what rot destroyed everywhere is gone either way.
+            bar = max(self.commit_index, self._suspect_index)
+            up_to_date = (req["lastLogIndex"] >= bar
+                          or (self._last_resort_due()
+                              and standard_up_to_date))
         if req.get("prevote"):
             # leader stickiness: deny pre-votes while we hear from a live
             # leader, so a rejoining partitioned node cannot depose a healthy
@@ -633,6 +841,10 @@ class RaftNode:
             "term": self.current_term, "init": False, "asqn": asqn, "data": data,
         })
         self._after_local_append()
+        if self.role != RaftRole.LEADER:
+            # a failed fsync inside the append stepped this leader down and
+            # rewound the suffix — the caller must treat this as not-leader
+            return None
         if on_commit is not None:
             self._pending_appends[index] = on_commit
         self._broadcast_appends()

@@ -34,6 +34,7 @@ from pathlib import Path
 from typing import Callable, Iterator
 
 from zeebe_tpu.observability.tracer import get_tracer as _get_tracer
+from zeebe_tpu.utils import storage_io
 from zeebe_tpu.utils.metrics import REGISTRY as _REGISTRY
 
 # group-flush tracing (singleton mutated in place; one enabled-check per
@@ -117,7 +118,26 @@ _SPARSE_EVERY = 64
 
 
 class CorruptedJournalError(Exception):
-    """Unrecoverable corruption detected (e.g. bad segment header)."""
+    """Corruption detected on a read path (checksum mismatch, bad header).
+
+    ``index`` (first corrupt record index, when known) and ``path`` (the
+    segment file) let the storage-repair plane (ISSUE 14) truncate at the
+    corrupt frame and re-converge from a replica instead of crashing."""
+
+    def __init__(self, message: str, index: int | None = None,
+                 path: Path | None = None) -> None:
+        super().__init__(message)
+        self.index = index
+        self.path = path
+
+
+class FlushFailedError(OSError):
+    """An fsync failed (fsyncgate, ISSUE 14): the page cache state of the
+    device is undefined, so the journal already failed the segment hard —
+    closed the fd, reopened, and re-verified from the last known-flushed
+    offset. Bytes covered by the failed fsync were discarded and MUST NOT
+    count toward any acked prefix (the raft layer clamps its flushed index
+    to ``journal.last_index`` on this error)."""
 
 
 class InvalidAsqnError(Exception):
@@ -168,20 +188,20 @@ class _Segment:
         self._pending_bytes = 0
         if create:
             start = _perf()
-            self.file = open(path, "w+b")
+            self.file = storage_io.open_file(path, "w+b")
             self.file.write(_SEG_HEADER.pack(_MAGIC, _VERSION, segment_id, first_index))
             self.file.flush()
             self.size = _SEG_HEADER.size
             self.durable_size = _SEG_HEADER.size
             _M_SEGMENT_ALLOC.observe(_perf() - start)
         else:
-            self.file = open(path, "r+b")
+            self.file = storage_io.open_file(path, "r+b")
             self.size = _SEG_HEADER.size  # recomputed by scan()
             self.durable_size = _SEG_HEADER.size
 
     @classmethod
     def open_existing(cls, path: Path) -> "_Segment":
-        with open(path, "rb") as f:
+        with storage_io.open_file(path, "rb") as f:
             raw = f.read(_SEG_HEADER.size)
         if len(raw) < _SEG_HEADER.size:
             raise CorruptedJournalError(f"segment header truncated: {path}")
@@ -193,11 +213,17 @@ class _Segment:
         return cls(path, segment_id, first_index, create=False)
 
     def scan(self) -> None:
-        """Rebuild in-memory state from disk; truncate at first corrupt frame."""
+        """Rebuild in-memory state from disk; truncate at first corrupt
+        frame. Idempotent: a RE-scan (the ISSUE 14 repair path) resets the
+        in-memory view first, so a walk that finds less than before (a
+        mid-file corruption truncation) cannot leave stale last_index /
+        last_asqn claims behind."""
         f = self.file
         self._pending.clear()
         self._pending_bytes = 0
         self._file_pos = -1
+        self.last_index = self.first_index - 1
+        self.last_asqn = ASQN_IGNORE
         f.seek(0, os.SEEK_END)
         file_len = f.tell()
         offset = _SEG_HEADER.size
@@ -293,8 +319,8 @@ class _Segment:
                 if _checksum(rec_index, asqn, data) != crc:
                     mv.release()
                     raise CorruptedJournalError(
-                        f"checksum mismatch reading record {rec_index} in {self.path}"
-                    )
+                        f"checksum mismatch reading record {rec_index} in "
+                        f"{self.path}", index=rec_index, path=self.path)
                 yield JournalRecord(rec_index, asqn, data)
         mv.release()
 
@@ -325,8 +351,8 @@ class _Segment:
                 data = f.read(length)
                 if _checksum(rec_index, asqn, data) != crc:
                     raise CorruptedJournalError(
-                        f"checksum mismatch reading record {rec_index} in {self.path}"
-                    )
+                        f"checksum mismatch reading record {rec_index} in "
+                        f"{self.path}", index=rec_index, path=self.path)
                 self._read_hint = (index + 1, offset + _FRAME.size + length)
                 return JournalRecord(rec_index, asqn, data)
             offset += _FRAME.size + length
@@ -363,9 +389,82 @@ class _Segment:
         start = _perf()
         self._drain()
         self.file.flush()
-        os.fsync(self.file.fileno())
+        try:
+            storage_io.fsync(self.file.fileno(), self.path)
+        except OSError as exc:
+            # fsyncgate (ISSUE 14): after a failed fsync the page cache
+            # state is UNDEFINED — retrying on the same fd can "succeed"
+            # without the earlier dirty pages ever reaching the platter
+            # (the PostgreSQL fsyncgate lesson). Fail the segment hard:
+            # drop the fd, reopen, re-verify from the last known-flushed
+            # offset; everything the failed fsync covered is discarded and
+            # must never count toward an acked prefix.
+            self._reopen_after_failed_fsync()
+            raise FlushFailedError(
+                exc.errno, f"fsync failed on {self.path}: {exc}") from exc
         self.durable_size = self.size
         _M_SEGMENT_FLUSH.observe(_perf() - start)
+
+    def _reopen_after_failed_fsync(self) -> None:
+        self._pending.clear()
+        self._pending_bytes = 0
+        try:
+            self.file.close()
+        except OSError:
+            pass
+        self.file = storage_io.open_file(self.path, "r+b")
+        # bytes beyond the durable prefix may or may not have hit the
+        # platter — truncate them away and re-verify what remains (scan
+        # re-CRCs every frame and truncates at the first bad one)
+        try:
+            self.file.truncate(self.durable_size)
+        except OSError:
+            pass
+        self.last_index = self.first_index - 1
+        self.last_asqn = ASQN_IGNORE
+        self.scan()
+
+    def scrub(self, from_index: int, max_bytes: int) -> tuple[int, int, int | None]:
+        """CRC-walk the drained file extent from ``from_index`` for up to
+        ``max_bytes`` (ISSUE 14 scrubber). Returns ``(next_index,
+        scanned_bytes, corrupt_index)`` — ``next_index`` past this
+        segment's end means the segment is clean through its extent. Never
+        drains and never raises on corruption: detection is the caller's
+        signal to repair. Runs on the pump thread (the only writer), so
+        the extent is stable for the duration of the walk."""
+        limit = self.size - self._pending_bytes
+        if from_index < self.first_index:
+            from_index = self.first_index
+        offset, _ = self._sparse_span(from_index)
+        f = self.file
+        self._file_pos = -1
+        scanned = 0
+        index = from_index
+        while offset < limit and scanned < max_bytes:
+            f.seek(offset)
+            head = f.read(_FRAME.size)
+            if len(head) < _FRAME.size:
+                break
+            length, crc, rec_index, asqn = _FRAME.unpack(head)
+            end = offset + _FRAME.size + length
+            if length == 0 or end > limit:
+                # a torn frame inside the drained extent: corrupt from
+                # here. The garbage header's rec_index is only trusted
+                # when it is a plausible index for this segment — rotted
+                # header bytes otherwise leak an arbitrary huge value into
+                # the repair evidence
+                plausible = (self.first_index <= rec_index
+                             <= self.last_index + 1)
+                return (self.last_index + 1, scanned,
+                        rec_index if plausible else index)
+            if rec_index >= from_index:
+                data = f.read(length)
+                scanned += _FRAME.size + length
+                if _checksum(rec_index, asqn, data) != crc:
+                    return self.last_index + 1, scanned, rec_index
+                index = rec_index + 1
+            offset = end
+        return index, scanned, None
 
     def close(self) -> None:
         # clean shutdown: buffered appends reach the OS (matching the old
@@ -525,7 +624,14 @@ class SegmentedJournal:
         tail.append(index, asqn, data)
         self._unflushed_bytes += _FRAME.size + len(data)
         if tail._pending_bytes >= self.max_unflushed_bytes:
-            tail._drain()
+            try:
+                tail._drain()
+            except OSError:
+                # transient write fault (EIO/ENOSPC/torn): the buffered
+                # frames are KEPT and the next drain re-seeks over any torn
+                # prefix — the append itself stays valid, and durability is
+                # decided at flush() where a persistent error surfaces
+                pass
         self._m_pending += 1
         self._m_pending_bytes += _FRAME.size + len(data)
         if sampled:
@@ -639,9 +745,16 @@ class SegmentedJournal:
             self._meta_fd = None
 
     def _write_flush_marker(self, idx: int) -> None:
-        if self._meta_fd is None:
-            self._meta_fd = os.open(self._meta_path, os.O_RDWR | os.O_CREAT, 0o644)
-        os.pwrite(self._meta_fd, struct.pack("<Q", idx), 0)
+        # advisory (recovery re-derives from segment scans): a write fault
+        # here must not fail a flush whose fsync already succeeded
+        try:
+            if self._meta_fd is None:
+                self._meta_fd = storage_io.os_open(
+                    self._meta_path, os.O_RDWR | os.O_CREAT, 0o644)
+            storage_io.pwrite(self._meta_fd, struct.pack("<Q", idx), 0,
+                              path=self._meta_path)
+        except OSError:
+            pass
 
     @property
     def last_flushed_index(self) -> int:
@@ -712,6 +825,63 @@ class SegmentedJournal:
         while len(self.segments) > 1 and self.segments[-1].first_index > index:
             self.segments.pop().delete()
         self.segments[-1].truncate_after(index)
+
+    # -- at-rest integrity (ISSUE 14) ----------------------------------------
+
+    def scrub(self, from_index: int, max_bytes: int
+              ) -> tuple[int, int, int | None]:
+        """Incremental CRC walk over the drained file bytes, resumable at
+        ``from_index``: returns ``(next_index, scanned_bytes,
+        corrupt_index)``. ``next_index > last_index`` means the walk
+        wrapped (one full pass complete). Detection only — the caller
+        decides whether to :meth:`repair_corruption`. Pump-thread only."""
+        scanned = 0
+        index = max(from_index, self.first_index)
+        for seg in self.segments:
+            if scanned >= max_bytes:
+                break
+            if seg.last_index < index and seg.last_index >= seg.first_index:
+                continue
+            next_index, seg_scanned, corrupt = seg.scrub(
+                index, max_bytes - scanned)
+            scanned += seg_scanned
+            if corrupt is not None:
+                return next_index, scanned, corrupt
+            index = max(index, next_index)
+        return index, scanned, None
+
+    def repair_corruption(self) -> dict:
+        """Truncate the journal at its first corrupt frame (ISSUE 14 repair
+        seam): every segment is re-scanned from disk — ``scan()`` re-CRCs
+        each frame and truncates at the first bad one — and any segment
+        left non-contiguous with its predecessor is deleted. The surviving
+        prefix is exactly what a crash-restart open would have recovered.
+        Returns before/after evidence for the repair's flight event. The
+        caller (raft) owns the consequences: clamping its flushed index and
+        re-converging the lost suffix from the leader."""
+        before_last = self.last_index
+        self._flush_append_metrics()
+        for seg in self.segments:
+            try:
+                seg._drain()  # valid buffered appends survive the re-scan
+            except OSError:
+                pass  # never-acked bytes; losing them is safe
+            seg.scan()
+        kept = [self.segments[0]]
+        for seg in self.segments[1:]:
+            if seg.first_index != kept[-1].last_index + 1:
+                seg.delete()
+                continue
+            kept.append(seg)
+        self.segments = kept
+        # drop empty trailing segments except the first (mirrors open)
+        while len(self.segments) > 1 and \
+                self.segments[-1].last_index < self.segments[-1].first_index:
+            self.segments.pop().delete()
+        self._update_segment_gauge()
+        return {"beforeLastIndex": before_last,
+                "afterLastIndex": self.last_index,
+                "truncatedRecords": max(before_last - self.last_index, 0)}
 
     def compact(self, index: int) -> None:
         """Delete whole segments whose records are all < ``index`` (snapshot

@@ -296,6 +296,15 @@ class WorkerRuntime:
             self.admission.release(tenant)
             self._reply_error(sender, request_id, "backpressure", str(exc))
             return
+        except OSError as exc:
+            # storage fault under the append (ISSUE 14): nothing was acked
+            # — we did NOT durably append, so the gateway may retry; the
+            # journal/raft layers own the repair
+            self.admission.release(tenant)
+            self._reply_error(sender, request_id, "unavailable",
+                              f"storage fault on partition {partition_id}: "
+                              f"{type(exc).__name__}")
+            return
         if position is None:
             self.admission.release(tenant)
             self._reply_error(sender, request_id, "unavailable",
@@ -378,8 +387,19 @@ class WorkerRuntime:
                                   f"partition {partition_id} leader is "
                                   f"recovering")
             return 0
-        results = partition.client_write_batch(
-            [entry["record"] for entry in entries])
+        try:
+            results = partition.client_write_batch(
+                [entry["record"] for entry in entries])
+        except OSError as exc:
+            # storage fault under the batched append (ISSUE 14): nothing
+            # was acked; typed unavailable, gateway retries
+            for entry in entries:
+                self.admission.release(entry["tenant"])
+                self._reply_error(entry["sender"], entry["requestId"],
+                                  "unavailable",
+                                  f"storage fault on partition "
+                                  f"{partition_id}: {type(exc).__name__}")
+            return 0
         for entry, (status, position) in zip(entries, results):
             if status == "ok":
                 self._note_appended(entry, partition_id, position, partition)
@@ -592,6 +612,14 @@ def main(argv: list[str] | None = None) -> int:
         # file per process life (a SIGKILL loses ≤1 dump interval)
         messaging.counts_file = os.path.join(
             args.data_dir, f"chaos-counts-{os.getpid()}.json")
+    # disk-layer chaos (ISSUE 14): ZEEBE_CHAOS_DISK installs the seeded
+    # fault controller into the storage_io seam BEFORE any journal opens;
+    # its tick (at-rest bit-rot + counts evidence) rides the pump loop
+    from zeebe_tpu.testing.chaos_disk import maybe_install_from_env as \
+        _maybe_disk_chaos
+
+    disk_chaos = _maybe_disk_chaos(member_id=args.node_id,
+                                   data_dir=args.data_dir)
 
     ext = load_broker_cfg(overrides={
         "base.node_id": args.node_id,
@@ -625,6 +653,8 @@ def main(argv: list[str] | None = None) -> int:
     print(f"[{args.node_id}] worker up: partitions<={args.partitions} "
           f"bind {args.bind} pid {os.getpid()}", file=sys.stderr, flush=True)
     while not stop.is_set():
+        if disk_chaos is not None:
+            disk_chaos.tick()
         if runtime.pump() == 0:
             time.sleep(0.001)
     if management is not None:

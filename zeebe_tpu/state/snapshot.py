@@ -32,6 +32,8 @@ import zlib
 from pathlib import Path
 from typing import Callable, Iterator
 
+from zeebe_tpu.utils import storage_io
+
 _ID_RE = re.compile(r"^(\d+)-(\d+)-(\d+)-(\d+)$")
 _MANIFEST = "CHECKSUM.sfv"
 _CHAIN_FILE = "chain.bin"
@@ -66,10 +68,35 @@ class SnapshotId:
 
 def _file_crc(path: Path) -> int:
     crc = 0
-    with open(path, "rb") as f:
+    with storage_io.open_file(path, "rb") as f:
         while chunk := f.read(1 << 20):
             crc = zlib.crc32(chunk, crc)
     return crc & 0xFFFFFFFF
+
+
+def file_crc(path: Path) -> int:
+    """Public alias of the store's one file-CRC rule (the at-rest scrubber
+    must compute exactly what the manifest verifier compares)."""
+    return _file_crc(path)
+
+
+def manifest_entries(directory: Path) -> dict[str, int] | None:
+    """Parse a snapshot directory's manifest into {file name: crc}, or
+    None when the manifest is missing/unreadable/malformed — the scrubber's
+    per-file walk (verify ONE file per slice, not the whole chain) reads
+    expectations through this so its CRC rule can never drift from
+    ``_verify_manifest``."""
+    manifest = directory / _MANIFEST
+    try:
+        expected: dict[str, int] = {}
+        for line in manifest.read_text().splitlines():
+            name, sep, crc = line.partition("\t")
+            if not sep or not name:
+                return None
+            expected[name] = int(crc, 16)
+        return expected
+    except (OSError, ValueError):
+        return None
 
 
 def manifest_bytes(files: dict[str, bytes]) -> bytes:
@@ -89,7 +116,7 @@ def _write_manifest(directory: Path) -> None:
     for p in sorted(directory.iterdir()):
         if p.name != _MANIFEST and p.is_file():
             lines.append(f"{p.name}\t{_file_crc(p):08x}\n")
-    (directory / _MANIFEST).write_text("".join(lines))
+    storage_io.write_text(directory / _MANIFEST, "".join(lines))
 
 
 def _verify_manifest(directory: Path) -> bool:
@@ -182,7 +209,7 @@ class TransientSnapshot:
         self._taken = True
 
     def write_file(self, name: str, data: bytes) -> None:
-        (self.path / name).write_bytes(data)
+        storage_io.write_bytes(self.path / name, data)
         self._taken = True
 
     def link_parent(self, parent: PersistedSnapshot, depth: int) -> None:
@@ -325,24 +352,16 @@ class FileBasedSnapshotStore:
         # data after the log prefix was compacted away
         for p in transient.path.iterdir():
             if p.is_file():
-                fd = os.open(p, os.O_RDONLY)
-                try:
-                    os.fsync(fd)
-                finally:
-                    os.close(fd)
+                storage_io.fsync_path(p)
         self._fsync_dir(transient.path)
-        os.replace(transient.path, target)
+        storage_io.replace(transient.path, target)
         self._fsync_dir(self.snapshots_dir)
         self._purge_older_than(transient.id)
         return PersistedSnapshot(transient.id, target)
 
     @staticmethod
     def _fsync_dir(path: Path) -> None:
-        fd = os.open(path, os.O_RDONLY)
-        try:
-            os.fsync(fd)
-        finally:
-            os.close(fd)
+        storage_io.fsync_path(path)
 
     def _purge_older_than(self, keep: SnapshotId) -> None:
         # chain-aware: the kept snapshot's ancestors (its delta chain's base
@@ -355,6 +374,25 @@ class FileBasedSnapshotStore:
         for snap in self.list_snapshots():
             if snap.id < keep and snap.id not in protected:
                 shutil.rmtree(snap.path, ignore_errors=True)
+
+    # -- at-rest integrity (ISSUE 14) ----------------------------------------
+
+    def quarantine(self, snapshot: PersistedSnapshot) -> Path | None:
+        """Move a corrupt snapshot OUT of the recovery path: the directory
+        is renamed to ``<id>.corrupt`` (bits preserved for postmortems, but
+        ``SnapshotId.parse`` no longer matches, so queries, chains, and a
+        later recovery all skip it and a replacement snapshot at the same
+        positions is permitted again). Returns the quarantine path, or None
+        when the rename failed (the snapshot stays visibly corrupt and the
+        scrubber stays DEGRADED)."""
+        target = snapshot.path.with_name(snapshot.path.name + ".corrupt")
+        try:
+            if target.exists():
+                shutil.rmtree(target, ignore_errors=True)
+            storage_io.replace(snapshot.path, target)
+            return target
+        except OSError:
+            return None
 
     # -- reservations (pin during backup) ------------------------------------
 

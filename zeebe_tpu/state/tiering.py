@@ -52,6 +52,7 @@ from typing import Any, Callable
 
 from zeebe_tpu.protocol import msgpack
 from zeebe_tpu.state.db import ZbDb, encode_key
+from zeebe_tpu.utils import storage_io
 from zeebe_tpu.utils.metrics import REGISTRY as _REG
 
 #: cold frame: total length, crc32(key+value), key length
@@ -74,6 +75,31 @@ _M_TIER_WRITE_ERRORS = _REG.counter(
     "cold-tier write failures (ENOSPC/EIO during spill or compaction); "
     "tiering degrades to hot-only instead of poisoning the pump",
     ("partition",))
+_M_TIER_READ_ERRORS = _REG.counter(
+    "state_tier_read_errors_total",
+    "cold-tier read failures (CRC mismatch / EIO on fault-in); the "
+    "partition latches DEGRADED and rebuilds from chain + log (ISSUE 14)",
+    ("partition",))
+
+
+def note_cold_read_error(partition_id: int) -> None:
+    """Read-side degradation metric seam (the partition's cold-corruption
+    repair calls this; one metric home next to its write-side sibling)."""
+    _M_TIER_READ_ERRORS.labels(str(partition_id)).inc()
+
+
+class ColdCorruptionError(ValueError):
+    """A cold-store read hit a CRC mismatch, short read, or IO error
+    (ISSUE 14). Typed so the partition pump can catch it ABOVE the stream
+    processor's blanket failure containment and repair — latch tiering
+    DEGRADED + transition (state rebuilds from chain + log; cold is a
+    cache) — instead of poisoning the pump or failing the partition.
+    Subclasses ValueError: pre-existing corrupt-frame handling keeps
+    matching."""
+
+    def __init__(self, message: str, ref: "ColdRef | None" = None) -> None:
+        super().__init__(message)
+        self.ref = ref
 
 
 class ColdRef:
@@ -100,8 +126,8 @@ class _Segment:
     def __init__(self, seg_id: int, path: Path) -> None:
         self.seg_id = seg_id
         self.path = path
-        self.write_f = open(path, "wb")
-        self.read_fd = os.open(path, os.O_RDONLY)
+        self.write_f = storage_io.open_file(path, "wb")
+        self.read_fd = storage_io.os_open(path, os.O_RDONLY)
         self.size = 0
         self.live = 0
         self.live_bytes = 0
@@ -174,15 +200,63 @@ class ColdStore:
         seg = self._segments.get(ref.seg)
         if seg is None:
             raise ValueError(f"cold segment {ref.seg} is gone ({ref!r})")
-        raw = os.pread(seg.read_fd, ref.length, ref.off)
+        try:
+            raw = storage_io.pread(seg.read_fd, ref.length, ref.off)
+        except OSError as exc:
+            # EIO on fault-in: same degradation class as corruption — the
+            # frame is unreadable, the value must rebuild from chain + log
+            raise ColdCorruptionError(
+                f"cold read failed at {ref!r}: {exc}", ref=ref) from exc
         if len(raw) != ref.length:
-            raise ValueError(f"short cold read at {ref!r}")
+            raise ColdCorruptionError(f"short cold read at {ref!r}", ref=ref)
         frame_len, crc, key_len = _FRAME.unpack_from(raw)
         payload = raw[_FRAME.size:]
         if frame_len != ref.length or \
                 zlib.crc32(payload) & 0xFFFFFFFF != crc:
-            raise ValueError(f"corrupt cold frame at {ref!r} (crc mismatch)")
+            raise ColdCorruptionError(
+                f"corrupt cold frame at {ref!r} (crc mismatch)", ref=ref)
         return payload[key_len:]
+
+    def scrub(self, cursor: tuple[int, int], max_bytes: int
+              ) -> tuple[tuple[int, int], int, dict | None]:
+        """CRC-walk sealed segments' frames from ``cursor=(seg_id, off)``
+        for up to ``max_bytes`` (ISSUE 14 scrubber). Returns ``(next_cursor,
+        scanned_bytes, corruption)``; a ``(0, 0)`` next cursor means the
+        walk wrapped. Sealed segments only — the current segment still has
+        a buffered tail. Pump-thread only (segments roll/drop there)."""
+        seg_ids = sorted(s for s, seg in self._segments.items()
+                         if seg is not self._current)
+        scanned = 0
+        seg_id, off = cursor
+        for sid in seg_ids:
+            if sid < seg_id:
+                continue
+            seg = self._segments.get(sid)
+            if seg is None:
+                continue
+            pos = off if sid == seg_id else 0
+            while pos < seg.size and scanned < max_bytes:
+                head = storage_io.pread(seg.read_fd, _FRAME.size, pos)
+                if len(head) < _FRAME.size:
+                    return ((sid, pos), scanned,
+                            {"segment": sid, "offset": pos,
+                             "reason": "short-header"})
+                frame_len, crc, _key_len = _FRAME.unpack_from(head)
+                if frame_len < _FRAME.size or pos + frame_len > seg.size:
+                    return ((sid, pos), scanned,
+                            {"segment": sid, "offset": pos,
+                             "reason": "bad-frame-length"})
+                payload = storage_io.pread(
+                    seg.read_fd, frame_len - _FRAME.size, pos + _FRAME.size)
+                scanned += frame_len
+                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                    return ((sid, pos), scanned,
+                            {"segment": sid, "offset": pos,
+                             "reason": "crc-mismatch"})
+                pos += frame_len
+            if scanned >= max_bytes:
+                return (sid, pos), scanned, None
+        return (0, 0), scanned, None
 
     # -- reclamation -----------------------------------------------------------
 
@@ -329,7 +403,7 @@ class TieredZbDb(ZbDb):
                 return val
             try:
                 return msgpack.unpackb(self.cold.read_value(val))
-            except (OSError, ValueError):
+            except (OSError, ValueError, ColdCorruptionError):
                 if attempt:
                     raise
         return None  # unreachable

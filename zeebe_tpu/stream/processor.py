@@ -30,8 +30,17 @@ import enum
 import logging
 from typing import Callable
 
+from zeebe_tpu.journal.journal import CorruptedJournalError
 from zeebe_tpu.logstreams import LogAppendEntry, LoggedRecord, LogStream
 from zeebe_tpu.protocol import Record, RecordType, RejectionType, ValueType, rejection
+from zeebe_tpu.state.tiering import ColdCorruptionError
+
+#: typed storage-corruption errors (ISSUE 14) pass THROUGH the processor's
+#: blanket failure containment: the partition pump catches them and runs
+#: the matching repair (truncate/re-materialize/transition) — converting
+#: them into FAILED phases or command rejections would bury a repairable
+#: disk fault
+_STORAGE_CORRUPTION = (CorruptedJournalError, ColdCorruptionError)
 from zeebe_tpu.state import ColumnFamilyCode, ZbDb
 from zeebe_tpu.stream.api import (
     ClientResponse,
@@ -387,6 +396,8 @@ class StreamProcessor:
                         self.last_processed_position = max_source
                         self._store_last_processed(max_source)
                 applied += batch_applied
+            except _STORAGE_CORRUPTION:
+                raise  # repairable disk fault: the pump's repair seam owns it
             except Exception:  # noqa: BLE001 — the transaction rolled back
                 # (the failed batch's events count for nothing); retrying the
                 # same batch would throw forever
@@ -601,6 +612,8 @@ class StreamProcessor:
                         self._note_live_dedupe(cmd, result.follow_ups)
                 append_dur = _time.perf_counter() - t_append
                 pipeline["append"].observe(append_dur)
+        except _STORAGE_CORRUPTION:
+            raise  # repairable disk fault: the pump's repair seam owns it
         except Exception:  # noqa: BLE001 — the fallback/rollback seam
             if write_failed:
                 # a partial group append is already in the log; reprocessing
@@ -842,6 +855,8 @@ class StreamProcessor:
             with self.db.transaction():
                 self._batch_process(cmd, builder)
                 self._write_and_mark(cmd, builder)
+        except _STORAGE_CORRUPTION:
+            raise  # repairable disk fault: the pump's repair seam owns it
         except Exception as error:  # noqa: BLE001 — the rollback/onError seam
             logger.debug("processing error at position %s: %s", cmd.position, error, exc_info=True)
             self._m_batch_retry.inc()

@@ -158,13 +158,21 @@ def _soak_models():
     return work, timer, msg
 
 
-def tamper_newest_snapshot(cluster_directory, node_id: str,
-                           partition_id: int) -> str | None:
-    """Simulate power loss during the snapshot store's pending→committed
-    commit on the crashed broker's disk: newest snapshot dir loses the
-    tail of one file (torn write) and a half-written pending dir is left
-    behind. Recovery must skip both and fall back. Shared by the crash
-    soak (ISSUE 6) and the scale soak (ISSUE 8)."""
+def tamper_snapshot(cluster_directory, node_id: str, partition_id: int,
+                    pick: str = "newest") -> str | None:
+    """Corrupt a persisted snapshot on a (crashed) broker's disk.
+
+    ``pick="newest"`` simulates power loss during the store's
+    pending→committed commit: the newest snapshot dir loses the tail of
+    one file (torn write) and a half-written pending dir is left behind —
+    recovery must skip both and fall back (ISSUE 6 / ISSUE 8 crash soaks).
+
+    ``pick="mid-chain"`` tears a DELTA in the *middle* of the incremental
+    chain (neither tip nor base) instead — bit rot / latent media error on
+    an old chain member. The chain validator must declare every descendant
+    invalid and recovery must fall back to the newest fully-valid ancestor
+    chain (ISSUE 14). Returns the torn snapshot's dir name, or None when
+    no eligible victim exists (e.g. no mid-chain delta yet)."""
     from zeebe_tpu.state.snapshot import SnapshotId
 
     part_dir = (Path(cluster_directory) / node_id
@@ -179,9 +187,22 @@ def tamper_newest_snapshot(cluster_directory, node_id: str,
         key=lambda pair: pair[0])
     if not snaps:
         return None
-    victim = snaps[-1][1]
+    if pick == "mid-chain":
+        # a delta that is neither the newest dir (the tip) nor the chain
+        # base: snaps[1:-1] with a delta.bin
+        candidates = [p for _sid, p in snaps[1:-1]
+                      if (p / "delta.bin").is_file()]
+        if not candidates:
+            return None
+        victim = candidates[len(candidates) // 2]
+        names = ("delta.bin",)
+        leave_pending = False
+    else:
+        victim = snaps[-1][1]
+        names = ("delta.bin", "state.bin", "durable.bin")
+        leave_pending = True
     torn = False
-    for name in ("delta.bin", "state.bin", "durable.bin"):
+    for name in names:
         f = victim / name
         if f.is_file():
             data = f.read_bytes()
@@ -190,10 +211,19 @@ def tamper_newest_snapshot(cluster_directory, node_id: str,
             break
     if not torn:
         return None
-    pending = part_dir / "pending" / "999999-1-999999-999999"
-    pending.mkdir(parents=True, exist_ok=True)
-    (pending / "state.bin").write_bytes(b"partial")
+    if leave_pending:
+        pending = part_dir / "pending" / "999999-1-999999-999999"
+        pending.mkdir(parents=True, exist_ok=True)
+        (pending / "state.bin").write_bytes(b"partial")
     return victim.name
+
+
+def tamper_newest_snapshot(cluster_directory, node_id: str,
+                           partition_id: int) -> str | None:
+    """Back-compat alias: tear the newest snapshot (see
+    :func:`tamper_snapshot`)."""
+    return tamper_snapshot(cluster_directory, node_id, partition_id,
+                           pick="newest")
 
 
 class SoakHarness:
