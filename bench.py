@@ -1645,6 +1645,62 @@ def _torture_main(quick: bool) -> None:
         raise SystemExit(1)
 
 
+def _device_chaos_main(quick: bool) -> None:
+    """--device-chaos: the device fault-survival gate (ISSUE 15). Real
+    supervised workers run the KERNEL backend while the accelerator lies
+    (compile/dispatch failures, watchdogged stalls, partial-chunk
+    failures, bit-flipped result rows) and a kill rides along; offline
+    checks prove delivery invariants + replica CRC equality held, every
+    configured device-fault class fired, every injected corruption was
+    caught before commit, and at least one worker life completed the full
+    SUSPECT→QUARANTINED→canary→HEALTHY cycle. Writes
+    DEVICE_CHAOS[_quick].json; violations fail the run."""
+    import shutil
+    import time as _time
+
+    from zeebe_tpu.testing.device_chaos import (
+        DeviceChaosConfig,
+        run_device_chaos,
+    )
+
+    cfg = (DeviceChaosConfig() if quick else
+           DeviceChaosConfig(drive_seconds=90.0, kills=3))
+    started = _time.perf_counter()
+    work_dir = tempfile.mkdtemp(prefix="zeebe-device-chaos-")
+    try:
+        report = run_device_chaos(cfg, directory=work_dir)
+    finally:
+        from pathlib import Path as _Path
+
+        dumps = _collect_gate_dumps(
+            sorted(_Path(work_dir).glob("*/flight-*.json")),
+            "DEVICE_CHAOS_dumps", work_dir)
+        shutil.rmtree(work_dir, ignore_errors=True)
+    report["flightDumps"] = dumps
+    report["wallSecondsTotal"] = round(_time.perf_counter() - started, 2)
+    report["quick"] = quick
+    name = "DEVICE_CHAOS_quick.json" if quick else "DEVICE_CHAOS.json"
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "deviceChaos": True, "quick": quick, "seed": report["seed"],
+        "requests": report["requests"],
+        "ackedCommands": report["ackedCommands"],
+        "kills": report["kills"],
+        "deviceFaultsObserved": report["deviceFaultsObserved"],
+        "corruptionAccounting": report["corruptionAccounting"],
+        "healthCycle": report["healthCycle"],
+        "violations": len(report["violations"]),
+        "full_results": name,
+    }))
+    if report["violations"]:
+        for v in report["violations"][:20]:
+            print(f"device-chaos violation: {v}", file=sys.stderr)
+        raise SystemExit(1)
+
+
 def _serving_main(quick: bool) -> None:
     """--serving: the open-loop SLO'd serving gate (ISSUE 11). Drives the
     real multi-process cluster with seeded Poisson arrivals from hundreds
@@ -1952,7 +2008,8 @@ def main(quick: bool = False, trace: bool = False,
          sample_metrics: bool = False, profile: bool = False,
          soak: bool = False, scale_soak: bool = False,
          consistency: bool = False, serving: bool = False,
-         autotune: bool = False, torture: bool = False) -> None:
+         autotune: bool = False, torture: bool = False,
+         device_chaos: bool = False) -> None:
     # install the filter BEFORE any backend use: the mismatch warning fires
     # whenever a persistent-cache executable loads, including the probe's
     # subprocess (which inherits the filtered fd 2)
@@ -1973,6 +2030,10 @@ def main(quick: bool = False, trace: bool = False,
     if torture:
         # same posture: workers own the (faulted) disks
         _torture_main(quick)
+        return
+    if device_chaos:
+        # same posture: workers own the (faulted) kernel dispatch path
+        _device_chaos_main(quick)
         return
     platform = _ensure_backend()
     if soak:
@@ -2213,6 +2274,19 @@ if __name__ == "__main__":
                          "corrupted follower journal re-converging "
                          "CRC-identical to the leader's. Writes "
                          "TORTURE[_quick].json")
+    ap.add_argument("--device-chaos", action="store_true",
+                    help="device fault-survival gate (ISSUE 15): the "
+                         "consistency workload over real supervised worker "
+                         "processes with the KERNEL backend live and DEVICE "
+                         "chaos (compile/dispatch failures, watchdogged "
+                         "stalls, partial-chunk failures, bit-flipped "
+                         "result rows) plus a worker kill; gates on zero "
+                         "acked loss, zero duplicate application, replica "
+                         "CRC equality, every configured device-fault "
+                         "class observed, every injected corruption caught "
+                         "before commit, and >=1 full SUSPECT->QUARANTINED"
+                         "->canary->HEALTHY ladder cycle. Writes "
+                         "DEVICE_CHAOS[_quick].json")
     ap.add_argument("--mesh-worker-spec", help=argparse.SUPPRESS)
     _args = ap.parse_args()
     if _args.mesh_worker_spec:
@@ -2229,4 +2303,5 @@ if __name__ == "__main__":
              sample_metrics=_args.sample_metrics, profile=_args.profile,
              soak=_args.soak, scale_soak=_args.scale_soak,
              consistency=_args.consistency, serving=_args.serving,
-             autotune=_args.autotune, torture=_args.torture)
+             autotune=_args.autotune, torture=_args.torture,
+             device_chaos=_args.device_chaos)

@@ -1,0 +1,15 @@
+"""zlint fixture: device output reaching a transaction OUTSIDE the kernel
+dispatch/shadow seam — every primitive use below is a finding."""
+
+import jax
+
+from zeebe_tpu.ops.automaton import run_collect, unpack_events
+
+
+def sneak_device_result_into_txn(db, dt, state, config, num_instances):
+    run = run_collect(dt, state, n_steps=8, config=config)
+    _carry, packed = run
+    flat = jax.device_get(packed)
+    events = unpack_events(flat[0], num_instances)
+    with db.transaction():
+        db.put(("steps",), events)

@@ -1,0 +1,21 @@
+"""zlint fixture: the legal shape — device-result primitives only inside
+the registered dispatch/shadow seam scopes; everything downstream receives
+decoded steps through the finish_group validation gate."""
+
+import jax
+
+from zeebe_tpu.ops.automaton import run_collect, unpack_events
+
+
+class KernelBackend:
+    def _fetch_rows(self, packed):
+        return jax.device_get(packed)
+
+    def _complete_device_run(self, dt, state, config, num_instances):
+        run = run_collect(dt, state, n_steps=8, config=config)
+        _carry, packed = run
+        return unpack_events(self._fetch_rows(packed)[0], num_instances)
+
+    def finish_group(self, pg):
+        # results reach materialization only through the validation gate
+        return self._complete_device_run(*pg)

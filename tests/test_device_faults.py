@@ -1,0 +1,798 @@
+"""Device fault survival (ISSUE 15): seeded accelerator chaos, containment,
+sampled shadow verification, and the quarantine/canary health ladder.
+
+The oracle throughout is the PR-era byte-equivalence discipline: whatever
+the device plane does — raise, stall, corrupt — the record stream must stay
+byte-identical to the sequential engine's, because every defense layer ends
+in "the host result wins".
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from zeebe_tpu.engine import kernel_backend as kb
+from zeebe_tpu.engine.device_health import (
+    HEALTHY,
+    QUARANTINED,
+    SUSPECT,
+    DeviceDefenseCfg,
+    DeviceHealth,
+    defense_cfg_from_env,
+    reset_shared_device_health,
+    shared_device_health,
+)
+from zeebe_tpu.models.bpmn import Bpmn
+from zeebe_tpu.testing import EngineHarness
+from zeebe_tpu.testing.chaos_device import (
+    FAULT_CLASSES,
+    DeviceChaosController,
+    DeviceChaosError,
+    DeviceFaultPlan,
+    format_spec,
+    maybe_install_from_env,
+    parse_spec,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_device_plane():
+    """Every test starts HEALTHY with no chaos installed, and cannot leak
+    its posture into later tests (the ladder is process-wide)."""
+    kb.install_device_chaos(None)
+    reset_shared_device_health()
+    yield
+    kb.install_device_chaos(None)
+    reset_shared_device_health()
+
+
+def one_task(pid="one_task"):
+    return (
+        Bpmn.create_executable_process(pid)
+        .start_event("start")
+        .service_task("task", job_type="work")
+        .end_event("end")
+        .done()
+    )
+
+
+def log_fingerprint(harness):
+    out = []
+    for logged in harness.stream.new_reader(1):
+        rec = logged.record
+        out.append((
+            logged.position, logged.source_position, logged.processed,
+            rec.key, rec.record_type.name, rec.value_type.name,
+            int(rec.intent),
+            rec.rejection_type.name if rec.is_rejection else "",
+            dict(rec.value) if rec.value else {},
+        ))
+    return out
+
+
+def drive_scenario(h, instances=6):
+    h.deploy(one_task())
+    for i in range(instances):
+        h.create_instance("one_task", request_id=10 + i)
+    for job in h.activate_jobs("work", max_jobs=100):
+        h.complete_job(job["key"])
+
+
+def sequential_fingerprint():
+    h = EngineHarness(use_kernel_backend=False)
+    try:
+        drive_scenario(h)
+        return log_fingerprint(h)
+    finally:
+        h.close()
+
+
+# ---------------------------------------------------------------------------
+# spec + controller units
+
+
+class TestChaosDeviceSpec:
+    def test_round_trip(self):
+        plan = DeviceFaultPlan(seed=7, compile_fail_p=0.01,
+                               dispatch_fail_p=0.02, stall_p=0.03,
+                               stall_ms=450, chunk_fail_p=0.04,
+                               corrupt_p=0.05, flips=2)
+        assert parse_spec(format_spec(plan)) == plan
+
+    def test_defaults_round_trip(self):
+        assert parse_spec(format_spec(DeviceFaultPlan())) == DeviceFaultPlan()
+
+    def test_configured_classes(self):
+        assert DeviceFaultPlan().configured_classes() == []
+        plan = DeviceFaultPlan(compile_fail_p=0.1, corrupt_p=0.1)
+        assert plan.configured_classes() == ["compile_fail", "corrupt"]
+        assert set(DeviceFaultPlan(
+            compile_fail_p=1, dispatch_fail_p=1, stall_p=1, chunk_fail_p=1,
+            corrupt_p=1).configured_classes()) == set(FAULT_CLASSES)
+
+    def test_seeded_member_streams(self):
+        a1 = DeviceChaosController(DeviceFaultPlan(seed=3), "worker-0")
+        a2 = DeviceChaosController(DeviceFaultPlan(seed=3), "worker-0")
+        b = DeviceChaosController(DeviceFaultPlan(seed=3), "worker-1")
+        s1 = [a1.rng.random() for _ in range(32)]
+        s2 = [a2.rng.random() for _ in range(32)]
+        s3 = [b.rng.random() for _ in range(32)]
+        assert s1 == s2
+        assert s1 != s3
+
+    def test_env_install_and_disarm(self, tmp_path):
+        plan = DeviceFaultPlan(seed=1, dispatch_fail_p=1.0)
+        disarm = tmp_path / "disarm"
+        env = {"ZEEBE_CHAOS_DEVICE": format_spec(plan),
+               "ZEEBE_CHAOS_DEVICE_DISARMFILE": str(disarm)}
+        controller = maybe_install_from_env("worker-0", str(tmp_path), env)
+        assert controller is not None
+        assert kb.device_chaos() is controller
+        assert controller.counts_file and controller.ledger_file
+        assert shared_device_health().evidence_file is not None
+        with pytest.raises(DeviceChaosError):
+            controller.dispatch_fault()
+        disarm.write_text("x")
+        controller.tick()
+        assert not controller.armed
+        controller.dispatch_fault()  # disarmed: no raise
+        assert maybe_install_from_env("worker-0", None, {}) is None
+
+    def test_corrupt_rows_ledger_and_caught(self, tmp_path):
+        controller = DeviceChaosController(
+            DeviceFaultPlan(seed=5, corrupt_p=1.0, flips=3), "worker-0")
+        controller.ledger_file = str(tmp_path / "ledger.jsonl")
+        rows = np.zeros((4, 10), np.int32)
+        token = controller.corrupt_rows(rows, chunk_index=0)
+        assert token == 1
+        assert np.count_nonzero(rows) > 0  # bits actually flipped
+        controller.note_caught(token, "shadow")
+        lines = [json.loads(line) for line in Path(
+            controller.ledger_file).read_text().splitlines()]
+        assert [e["kind"] for e in lines] == ["inject", "caught"]
+        assert lines[0]["seq"] == lines[1]["seq"] == 1
+        assert lines[1]["how"] == "shadow"
+        assert controller.counts["corrupt"] == 1
+        assert controller.counts["corrupt_caught"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the health ladder (fake clock — deterministic)
+
+
+def ladder(cfg=None, start_ms=1_000_000.0):
+    clock = {"now": start_ms / 1000.0}
+    cfg = cfg or DeviceDefenseCfg(quarantine_faults=3, fault_window_ms=10_000,
+                                  suspect_clear_ms=5_000,
+                                  canary_interval_ms=1_000,
+                                  canary_successes=2)
+    health = DeviceHealth(cfg, clock=lambda: clock["now"])
+    return health, clock
+
+
+class TestDeviceHealthLadder:
+    def test_first_fault_latches_suspect(self):
+        health, _ = ladder()
+        assert health.state == HEALTHY
+        health.note_fault("device-dispatch-error")
+        assert health.state == SUSPECT
+        assert health.faults["device-dispatch-error"] == 1
+
+    def test_faults_in_window_quarantine(self):
+        health, clock = ladder()
+        for _ in range(3):
+            health.note_fault("device-wedged")
+            clock["now"] += 0.1
+        assert health.state == QUARANTINED
+        targets = [t["to"] for t in health.transitions]
+        assert targets == [SUSPECT, QUARANTINED]
+
+    def test_spread_out_faults_stay_suspect(self):
+        health, clock = ladder()
+        for _ in range(4):
+            health.note_fault("device-wedged")
+            clock["now"] += 11.0  # past the 10s window each time
+        assert health.state == SUSPECT
+
+    def test_quiet_window_clears_suspect(self):
+        health, clock = ladder()
+        health.note_fault("shadow-mismatch")
+        health.note_group_ok()
+        assert health.state == SUSPECT  # too soon
+        clock["now"] += 6.0
+        health.note_group_ok()
+        assert health.state == HEALTHY
+
+    def test_canary_cycle_recovers_quarantine(self):
+        health, clock = ladder()
+        for _ in range(3):
+            health.note_fault("device-dispatch-error")
+        assert health.state == QUARANTINED
+        assert health.canary_due()
+        assert not health.canary_due()  # interval not elapsed
+        health.note_canary(False)       # failed canary resets the streak
+        clock["now"] += 1.1
+        assert health.canary_due()
+        health.note_canary(True)
+        assert health.state == QUARANTINED  # needs 2 consecutive
+        clock["now"] += 1.1
+        assert health.canary_due()
+        health.note_canary(True)
+        assert health.state == HEALTHY
+        targets = [t["to"] for t in health.transitions]
+        assert targets == [SUSPECT, QUARANTINED, HEALTHY]
+        assert "canary" in health.transitions[-1]["reason"]
+
+    def test_transitions_reach_flight_sink_and_evidence(self, tmp_path):
+        health, _ = ladder()
+        events = []
+
+        class Flight:
+            # mirrors FlightRecorder.record(partition_id, kind, **detail)
+            def record(self, partition_id, kind, **fields):
+                events.append((partition_id, kind, fields))
+
+        health.flight_sink = (Flight(), 1)
+        health.evidence_file = str(tmp_path / "health.jsonl")
+        health.note_fault("device-wedged", detail="probe")
+        kinds = [k for _pid, k, _f in events]
+        assert "device_fault" in kinds
+        fault = next(f for _p, k, f in events if k == "device_fault")
+        assert fault["faultKind"] == "device-wedged"
+        assert "control_adjust" in kinds
+        assert "device_health" in kinds
+        adjust = next(f for _p, k, f in events if k == "control_adjust")
+        assert adjust["controller"] == "device-health"
+        assert adjust["before"] == HEALTHY and adjust["after"] == SUSPECT
+        lines = [json.loads(line) for line in Path(
+            health.evidence_file).read_text().splitlines()]
+        assert lines[0]["to"] == SUSPECT
+
+    def test_cfg_binds_from_env(self):
+        cfg = defense_cfg_from_env({
+            "ZEEBE_BROKER_DEVICE_DISPATCHTIMEOUTMS": "1500",
+            "ZEEBE_BROKER_DEVICE_SHADOWSAMPLERATE": "0.5",
+            "ZEEBE_BROKER_DEVICE_QUARANTINEFAULTS": "9",
+            "ZEEBE_BROKER_DEVICE_CANARYINTERVALMS": "250",
+        })
+        assert cfg.dispatch_timeout_ms == 1500
+        assert cfg.shadow_sample_rate == 0.5
+        assert cfg.quarantine_faults == 9
+        assert cfg.canary_interval_ms == 250
+        # malformed values fall back to defaults, never raise
+        cfg = defense_cfg_from_env(
+            {"ZEEBE_BROKER_DEVICE_SHADOWSAMPLERATE": "lots"})
+        assert cfg.shadow_sample_rate == DeviceDefenseCfg().shadow_sample_rate
+
+    def test_status_block(self):
+        health, _ = ladder()
+        health.note_shadow_check()
+        health.note_shadow_mismatch()
+        status = health.status()
+        assert status["state"] == SUSPECT
+        assert status["shadowChecks"] == 1
+        assert status["shadowMismatches"] == 1
+        assert status["lastTransition"]["to"] == SUSPECT
+
+
+# ---------------------------------------------------------------------------
+# containment at the dispatch seam (end to end, byte parity)
+
+
+class TestContainment:
+    def test_dispatch_exception_contained_byte_identical(self):
+        """Every dispatch raises → every group host re-executes in the same
+        pump pass; the log is byte-identical to the sequential engine and
+        the pump never sees the exception."""
+        shared_device_health()  # construct before the backend binds cfg
+        kb.install_device_chaos(DeviceChaosController(
+            DeviceFaultPlan(seed=1, dispatch_fail_p=1.0), "t"))
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            drive_scenario(h)
+            fingerprint = log_fingerprint(h)
+            acct = h.kernel_backend.accounting
+            assert acct.reasons["device-dispatch-error"] > 0
+            assert not acct.unregistered
+            assert acct.kernel_records == 0  # nothing rode the device
+            assert h.kernel_backend.health.state in (SUSPECT, QUARANTINED)
+        finally:
+            h.close()
+        assert fingerprint == sequential_fingerprint()
+
+    def test_watchdog_converts_stall_to_typed_wedge(self):
+        """A chaos stall longer than the dispatch deadline is contained as
+        `device-wedged` — the pump waits only the deadline, not the stall."""
+        health = shared_device_health()
+        health.cfg.dispatch_timeout_ms = 120
+        kb.install_device_chaos(DeviceChaosController(
+            DeviceFaultPlan(seed=1, stall_p=1.0, stall_ms=600), "t"))
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(one_task())
+            h.create_instance("one_task", request_id=10)
+            acct = h.kernel_backend.accounting
+            assert acct.reasons["device-wedged"] > 0
+            assert not acct.unregistered
+            fingerprint = log_fingerprint(h)
+        finally:
+            h.close()
+        seq = EngineHarness(use_kernel_backend=False)
+        try:
+            seq.deploy(one_task())
+            seq.create_instance("one_task", request_id=10)
+            assert fingerprint == log_fingerprint(seq)
+        finally:
+            seq.close()
+
+    def test_finish_group_exception_cannot_poison_pump(self):
+        """Satellite pin (PR 13 note_group_success seam): a backend that
+        raises mid-finish_group falls back to sequential host execution
+        with byte parity, exactly-once accounting (the rolled-back group
+        is never counted kernel), and a surviving pump."""
+        shared_device_health()
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            backend = h.kernel_backend
+            real_finish = backend.finish_group
+            boom = {"left": 2}
+
+            def flaky_finish(pg, make_builder):
+                if boom["left"] > 0:
+                    boom["left"] -= 1
+                    raise RuntimeError("fake backend exploded mid-group")
+                return real_finish(pg, make_builder)
+
+            backend.finish_group = flaky_finish
+            drive_scenario(h)
+            fingerprint = log_fingerprint(h)
+            acct = backend.accounting
+            # both explosions fell back, typed; each head re-executed on
+            # the host exactly once
+            assert acct.reasons["group-error"] == 2
+            # exactly-once: kernel+host notes cover the routed heads with
+            # no double count from the rolled-back groups
+            assert backend.groups_processed == 0 or acct.kernel_records >= 0
+            from zeebe_tpu.stream.processor import Phase
+
+            assert h.processor.phase == Phase.PROCESSING  # pump survived
+        finally:
+            h.close()
+        assert fingerprint == sequential_fingerprint()
+
+
+# ---------------------------------------------------------------------------
+# shadow verification (detection) + quarantine routing + canary recovery
+
+
+class TestShadowVerification:
+    def test_corruption_caught_before_commit(self, tmp_path):
+        """Every group corrupt + every group shadow-verified → every ledger
+        injection has a caught line, the host oracle's result commits, and
+        the log stays byte-identical to the sequential engine."""
+        health = shared_device_health()
+        health.cfg.shadow_sample_rate = 1.0
+        controller = DeviceChaosController(
+            DeviceFaultPlan(seed=2, corrupt_p=1.0, flips=4), "t")
+        controller.ledger_file = str(tmp_path / "ledger.jsonl")
+        kb.install_device_chaos(controller)
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            drive_scenario(h)
+            fingerprint = log_fingerprint(h)
+            backend = h.kernel_backend
+            assert backend.health.shadow_checks > 0
+            assert backend.health.shadow_mismatches > 0
+            assert backend.shadow_quarantined > 0
+        finally:
+            h.close()
+        assert fingerprint == sequential_fingerprint()
+        lines = [json.loads(line) for line in Path(
+            controller.ledger_file).read_text().splitlines()]
+        injected = {e["seq"] for e in lines if e["kind"] == "inject"}
+        caught = {e["seq"] for e in lines if e["kind"] == "caught"}
+        assert injected
+        assert injected == caught  # nothing corrupt ever reached the log
+
+    def test_clean_groups_verify_without_mismatch(self):
+        health = shared_device_health()
+        health.cfg.shadow_sample_rate = 1.0
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            drive_scenario(h)
+            backend = h.kernel_backend
+            assert backend.health.shadow_checks > 0
+            assert backend.health.shadow_mismatches == 0
+            assert backend.health.state == HEALTHY
+            assert backend.accounting.kernel_records > 0
+        finally:
+            h.close()
+
+    def test_sampling_rate_zero_never_shadows(self):
+        health = shared_device_health()
+        health.cfg.shadow_sample_rate = 0.0
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            drive_scenario(h)
+            assert h.kernel_backend.health.shadow_checks == 0
+        finally:
+            h.close()
+
+    def test_sampled_stream_is_deterministic(self):
+        health = shared_device_health()
+        health.cfg.shadow_sample_rate = 0.4
+        h1 = EngineHarness(use_kernel_backend=True)
+        try:
+            decisions1 = [h1.kernel_backend._shadow_sampled()
+                          for _ in range(64)]
+        finally:
+            h1.close()
+        reset_shared_device_health()
+        health = shared_device_health()
+        health.cfg.shadow_sample_rate = 0.4
+        h2 = EngineHarness(use_kernel_backend=True)
+        try:
+            decisions2 = [h2.kernel_backend._shadow_sampled()
+                          for _ in range(64)]
+        finally:
+            h2.close()
+        assert decisions1 == decisions2
+        assert any(decisions1) and not all(decisions1)
+
+
+class TestQuarantineLadderEndToEnd:
+    def test_full_cycle_quarantine_reroute_canary_recovery(self):
+        """The acceptance cycle on a live engine: faults escalate to
+        QUARANTINED (groups host-route with typed accounting), the chaos
+        plane goes quiet, canaries re-prove the device, kernel routing
+        resumes — and the whole ride is byte-identical to sequential."""
+        health = shared_device_health()
+        health.cfg.quarantine_faults = 2
+        health.cfg.fault_window_ms = 600_000
+        # phase A: no canary slots — every quarantined pass must REROUTE
+        health.cfg.canary_interval_ms = 600_000
+        health.cfg.canary_successes = 2
+        controller = DeviceChaosController(
+            DeviceFaultPlan(seed=4, dispatch_fail_p=1.0), "t")
+        kb.install_device_chaos(controller)
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(one_task())
+            # phase A: every dispatch fails → SUSPECT then QUARANTINED
+            for i in range(4):
+                h.create_instance("one_task", request_id=10 + i)
+            backend = h.kernel_backend
+            assert backend.health.state == QUARANTINED
+            assert backend.accounting.reasons["device-quarantined"] > 0
+            assert backend.health.host_reroutes > 0
+            # phase B: device honest again → canaries (forced shadow)
+            # re-prove it within two groups
+            controller.armed = False
+            health.cfg.canary_interval_ms = 0  # every pass may canary now
+            for i in range(4):
+                h.create_instance("one_task", request_id=20 + i)
+            assert backend.health.state == HEALTHY
+            targets = [t["to"] for t in backend.health.transitions]
+            assert targets == [SUSPECT, QUARANTINED, HEALTHY]
+            # phase C: kernel routing is live again
+            before = backend.accounting.kernel_records
+            for i in range(2):
+                h.create_instance("one_task", request_id=30 + i)
+            assert backend.accounting.kernel_records > before
+            for job in h.activate_jobs("work", max_jobs=100):
+                h.complete_job(job["key"])
+            fingerprint = log_fingerprint(h)
+        finally:
+            h.close()
+        seq = EngineHarness(use_kernel_backend=False)
+        try:
+            seq.deploy(one_task())
+            for i in range(4):
+                seq.create_instance("one_task", request_id=10 + i)
+            for i in range(4):
+                seq.create_instance("one_task", request_id=20 + i)
+            for i in range(2):
+                seq.create_instance("one_task", request_id=30 + i)
+            for job in seq.activate_jobs("work", max_jobs=100):
+                seq.complete_job(job["key"])
+            assert fingerprint == log_fingerprint(seq)
+        finally:
+            seq.close()
+
+
+    def test_failed_canary_counted_exactly_once(self):
+        """A canary whose shadow oracle raises is one failed canary, not
+        two: _verify_steps abandons the group and finish_group's decline
+        branch is the single seam that notes the outcome."""
+        health = shared_device_health()
+        health.cfg.quarantine_faults = 2
+        health.cfg.fault_window_ms = 600_000
+        health.cfg.canary_interval_ms = 600_000
+        controller = DeviceChaosController(
+            DeviceFaultPlan(seed=4, dispatch_fail_p=1.0), "t")
+        kb.install_device_chaos(controller)
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(one_task())
+            for i in range(4):
+                h.create_instance("one_task", request_id=10 + i)
+            backend = h.kernel_backend
+            assert backend.health.state == QUARANTINED
+            controller.armed = False
+            health.cfg.canary_interval_ms = 0
+
+            def broken_oracle(pg):
+                raise RuntimeError("oracle lost the device")
+
+            backend._shadow_execute = broken_oracle
+            before = backend.health.canary_attempts
+            h.create_instance("one_task", request_id=20)
+            assert backend.health.canary_attempts == before + 1
+            assert backend.health.canary_verified == 0
+            assert backend.health.state == QUARANTINED  # streak reset
+        finally:
+            h.close()
+
+    def test_canary_pins_accelerator_past_quarantine_host_bias(self):
+        """On a router-enabled broker the quarantine posture routes every
+        ordinary group host-ward (route_threshold_s=+inf), but the canary
+        pins the SUSPECT accelerator — a canary the router re-routed to
+        the host would byte-match the host oracle by construction."""
+        from zeebe_tpu.utils.device_link import BackendRouter
+
+        router = BackendRouter()
+        router._measured = True
+        router.enabled = True
+        accel, host = object(), object()
+        router._accel, router._host = accel, host
+        router.link_put_s = router.link_get_s = 1e-4
+        router.route_threshold_s = float("inf")  # the quarantine posture
+        bucket = ("fp", 4, 8)
+        router._host_ema[bucket] = 0.5
+        assert router.choose(bucket) is host  # ordinary traffic host-routes
+        assert router.accel_device() is accel  # the canary's pin
+
+    def test_accel_device_none_when_routing_disabled(self):
+        from zeebe_tpu.utils.device_link import BackendRouter
+
+        router = BackendRouter()
+        router._measured = True  # host-default process: routing disabled
+        assert router.accel_device() is None
+
+
+class TestCorruptionAccountingWaiver:
+    def test_surviving_life_gets_no_waiver(self):
+        """An uncaught inject in the tail of a life that SURVIVED to
+        teardown is a violation — the process had every chance to report
+        the catch; only verifiably dead lives may waive their final
+        moments (SIGKILL mid-group)."""
+        from zeebe_tpu.testing.device_chaos import check_corruption_accounting
+
+        entries = [{"kind": "inject", "seq": 1, "member": "w0", "pid": 11,
+                    "atMs": 1000.0}]
+        violations, stats = check_corruption_accounting(
+            entries, dead_pids=set())
+        assert len(violations) == 1 and "never caught" in violations[0]
+        assert stats["waivedByDeath"] == 0
+        violations, stats = check_corruption_accounting(
+            entries, dead_pids={11})
+        assert violations == []
+        assert stats["waivedByDeath"] == 1
+
+    def test_waiver_is_tail_only_even_for_dead_lives(self):
+        from zeebe_tpu.testing.device_chaos import check_corruption_accounting
+
+        entries = [
+            {"kind": "inject", "seq": 1, "member": "w0", "pid": 11,
+             "atMs": 1000.0},
+            {"kind": "inject", "seq": 2, "member": "w0", "pid": 11,
+             "atMs": 9000.0},
+            {"kind": "caught", "seq": 2, "member": "w0", "pid": 11,
+             "how": "shadow", "atMs": 9001.0},
+        ]
+        # seq 1 sits mid-life: even a dead life cannot waive it
+        violations, stats = check_corruption_accounting(
+            entries, dead_pids={11})
+        assert len(violations) == 1 and "seq 1" in violations[0]
+        assert stats == {"injected": 2, "caughtShadow": 1,
+                         "caughtContained": 0, "waivedByDeath": 0}
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+
+
+class TestDeviceObservability:
+    def test_kernel_wave_event_carries_device_health(self):
+        shared_device_health()
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            events = []
+            h.processor.wave_listener = events.append
+            drive_scenario(h, instances=3)
+            assert events, "no kernel_wave event emitted"
+            event = events[0]
+            assert event["deviceHealth"] == HEALTHY
+            assert "shadowChecks" in event and "shadowMismatches" in event
+        finally:
+            h.close()
+
+    def test_device_status_block(self):
+        shared_device_health()
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            drive_scenario(h, instances=2)
+            status = h.kernel_backend.device_status()
+            assert status["state"] == HEALTHY
+            assert set(status) >= {"faults", "shadowChecks",
+                                   "shadowMismatches", "hostReroutes",
+                                   "canaries", "shadowQuarantinedGroups"}
+        finally:
+            h.close()
+
+    def test_routing_controller_biases_on_device_state(self):
+        from zeebe_tpu.control.controllers import RoutingController
+
+        controller = RoutingController(actuators=[])
+        knob = RoutingController.KNOB
+        value, reason = controller.decide(
+            {"compileMissPerSec": 0.0, "deviceHealthState": 1.0},
+            {knob: 0.0})[knob]
+        assert value == float("inf") and "SUSPECT" in reason
+        value, reason = controller.decide(
+            {"compileMissPerSec": 0.0, "deviceHealthState": 2.0},
+            {knob: 0.0})[knob]
+        assert value == float("inf") and "QUARANTINED" in reason
+        value, _reason = controller.decide(
+            {"compileMissPerSec": 0.0, "deviceHealthState": 0.0},
+            {knob: 0.0})[knob]
+        assert value == 0.0
+
+    def test_routing_signals_stale_without_compile_telemetry(self):
+        """The always-registered (and always-fresh) health gauge must not
+        masquerade as a live compile signal: no compile telemetry + a
+        HEALTHY ladder reads STALE (the actuator walks the knob back to
+        its static posture), while a SUSPECT ladder still actuates."""
+        from zeebe_tpu.control import RoutingController, SignalReader
+        from zeebe_tpu.observability.timeseries import TimeSeriesStore
+        from zeebe_tpu.testing import ControlledClock
+
+        clock = ControlledClock()
+        controller = RoutingController(actuators=[])
+
+        def reader(*series):
+            store = TimeSeriesStore()
+            for name, labels, value in series:
+                store.append(name, labels, "gauge", clock.millis, value)
+            return SignalReader(store, clock)
+
+        # healthy ladder, no compile series at all → stale, not a
+        # fabricated compileMissPerSec=0.0 actuation
+        assert controller.read_signals(
+            reader(("zeebe_device_health_state", "", 0.0))) is None
+        # SUSPECT ladder alone is a live signal (host-ward bias)
+        sig = controller.read_signals(
+            reader(("zeebe_device_health_state", "", 1.0)))
+        assert sig is not None and sig["deviceHealthState"] == 1.0
+        assert controller.decide(
+            sig, {controller.KNOB: 0.0})[controller.KNOB][0] == float("inf")
+
+    def test_host_side_canary_decline_is_not_a_failed_canary(self):
+        """A canary group declined HOST-side (geometry-bounds: the probe
+        never reached the device) must not reset the recovery streak or
+        burn the interval slot — only device-probing failures
+        (device-dispatch-error / device-wedged) count as failed canaries."""
+        from types import SimpleNamespace
+
+        health = shared_device_health()
+        health.cfg.quarantine_faults = 2
+        health.cfg.fault_window_ms = 600_000
+        health.cfg.canary_interval_ms = 3_600_000
+        health.cfg.canary_successes = 3
+        health.note_fault("device-dispatch-error")
+        health.note_fault("device-dispatch-error")
+        assert health.state == QUARANTINED
+        assert health.canary_due()       # claim the hour slot
+        health.note_canary(True)         # verified streak: 1 of 3
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            backend = h.kernel_backend
+            pg = kb._PendingGroup([SimpleNamespace(
+                cmd=None,
+                inst=SimpleNamespace(info=SimpleNamespace(
+                    exe=SimpleNamespace(process_id="one_task"))))])
+            pg.canary = True
+            pg.failed = True
+            pg.fail_reason = "geometry-bounds"
+            attempts = health.canary_attempts
+            streak = health._canary_streak
+            assert backend.finish_group(pg, lambda: None) == ([], [])
+            assert health.canary_attempts == attempts  # not counted failed
+            assert health._canary_streak == streak     # streak survives
+            assert health.canary_due()                 # slot released
+        finally:
+            h.close()
+
+    def test_declined_canary_releases_its_slot(self):
+        """A canary slot claimed by a group that never dispatched (the
+        head was not kernel-admittable) is un-claimed — the next
+        admittable pass probes immediately instead of waiting out an
+        interval the device never saw."""
+        health = shared_device_health()
+        health.cfg.quarantine_faults = 2
+        health.cfg.fault_window_ms = 600_000
+        # one canary per hour: burning the slot would stall recovery
+        health.cfg.canary_interval_ms = 3_600_000
+        health.cfg.canary_successes = 1
+        controller = DeviceChaosController(
+            DeviceFaultPlan(seed=6, dispatch_fail_p=1.0), "t")
+        kb.install_device_chaos(controller)
+        h = EngineHarness(use_kernel_backend=True)
+        try:
+            h.deploy(one_task())
+            for i in range(2):
+                h.create_instance("one_task", request_id=10 + i)
+            backend = h.kernel_backend
+            assert backend.health.state == QUARANTINED
+            controller.armed = False  # device honest again
+            # a non-admittable head claims (then must release) the slot
+            h.deploy(one_task("other_def"))
+            # the very next admittable group must canary and recover
+            h.create_instance("one_task", request_id=11)
+            assert backend.health.state == HEALTHY
+            assert backend.health.canary_verified == 1
+        finally:
+            h.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos_common (the extracted shared machinery)
+
+
+class TestChaosCommon:
+    def test_member_rng_matches_legacy_derivation(self):
+        import random
+        import zlib
+
+        from zeebe_tpu.testing.chaos_common import member_rng
+
+        legacy = random.Random(9 ^ zlib.crc32(b"worker-2"))
+        shared = member_rng(9, "worker-2")
+        assert [legacy.random() for _ in range(16)] == \
+               [shared.random() for _ in range(16)]
+
+    def test_sum_counts_files_and_ledger_reader(self, tmp_path):
+        from zeebe_tpu.testing.chaos_common import (
+            read_jsonl_ledgers,
+            sum_counts_files,
+        )
+
+        (tmp_path / "a.json").write_text(
+            json.dumps({"member": "w0", "eio": 2, "torn": 1}))
+        (tmp_path / "b.json").write_text(
+            json.dumps({"member": "w1", "eio": 3}))
+        (tmp_path / "broken.json").write_text("{torn")
+        totals = sum_counts_files(sorted(tmp_path.glob("*.json")))
+        assert totals == {"eio": 5, "torn": 1}
+        ledger = tmp_path / "l.jsonl"
+        ledger.write_text('{"kind":"inject","seq":1}\n{"kind":"ca')
+        rows = read_jsonl_ledgers([ledger])
+        assert rows == [{"kind": "inject", "seq": 1}]  # torn tail skipped
+
+    def test_counts_snapshot_throttles_and_is_atomic(self, tmp_path):
+        from zeebe_tpu.testing.chaos_common import CountsSnapshot
+
+        snap = CountsSnapshot("w0")
+        snap.counts_file = str(tmp_path / "counts.json")
+        snap.maybe_dump({"eio": 1})
+        first = json.loads(Path(snap.counts_file).read_text())
+        assert first == {"member": "w0", "eio": 1}
+        snap.maybe_dump({"eio": 2})  # throttled: unchanged on disk
+        assert json.loads(Path(snap.counts_file).read_text()) == first
+        snap._last_dump = 0.0
+        snap.maybe_dump({"eio": 2})
+        assert json.loads(Path(snap.counts_file).read_text())["eio"] == 2

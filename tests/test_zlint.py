@@ -30,6 +30,7 @@ from zeebe_tpu.analysis.rules import (
     ControlActuationDisciplineRule,
     DeviceCallDisciplineRule,
     DriftCopyRule,
+    KernelResultCommitDisciplineRule,
     PumpBlockingIoRule,
     ReplayDeterminismRule,
     StorageIoDisciplineRule,
@@ -251,6 +252,68 @@ def test_storage_io_rule_live_tree_single_seam():
     modules = parse_tree(REPO_ROOT)
     findings = []
     rule = StorageIoDisciplineRule()
+    findings += rule.validate(modules)
+    for module in modules:
+        findings += rule.check(module)
+    baseline = load_baseline(REPO_ROOT / BASELINE_FILENAME)
+    new = [f for f in findings if f.baseline_key not in baseline]
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+# -- rule 8: kernel-result commit discipline (ISSUE 15) -----------------------
+
+
+def kernel_result_rule():
+    return KernelResultCommitDisciplineRule(
+        scope_prefixes=("kernel_result_",),
+        seam_module="kernel_result_good.py",
+        seam_scopes=("KernelBackend._fetch_rows",
+                     "KernelBackend._complete_device_run"))
+
+
+def test_kernel_result_rule_flags_out_of_seam_primitives():
+    findings = kernel_result_rule().check(
+        fixture_module("kernel_result_bad.py"))
+    assert lines_by_rule(findings) == [
+        ("kernel_result_bad.py", 10, "kernel-result-commit-discipline"),
+        ("kernel_result_bad.py", 12, "kernel-result-commit-discipline"),
+        ("kernel_result_bad.py", 13, "kernel-result-commit-discipline"),
+    ]
+    assert all("validation gate" in f.message for f in findings)
+
+
+def test_kernel_result_rule_allows_the_seam():
+    assert kernel_result_rule().check(
+        fixture_module("kernel_result_good.py")) == []
+
+
+def test_kernel_result_rule_ignores_out_of_scope_modules():
+    rule = KernelResultCommitDisciplineRule(
+        scope_prefixes=("somewhere_else_",),
+        seam_module="kernel_result_good.py",
+        seam_scopes=("KernelBackend._fetch_rows",))
+    assert rule.check(fixture_module("kernel_result_bad.py")) == []
+
+
+def test_kernel_result_rule_stale_seam_registration_fails():
+    rule = KernelResultCommitDisciplineRule(
+        scope_prefixes=("kernel_result_",),
+        seam_module="kernel_result_good.py",
+        seam_scopes=("KernelBackend._renamed_away",))
+    findings = rule.validate([fixture_module("kernel_result_good.py")])
+    assert len(findings) == 1
+    assert "stale kernel-result seam registration" in findings[0].message
+
+
+def test_kernel_result_rule_live_tree_single_seam():
+    """The REAL engine//stream/ trees touch device results only inside the
+    kernel_backend dispatch/shadow seam — a decoded device row cannot reach
+    a transaction without passing finish_group's verification gate."""
+    from zeebe_tpu.analysis.framework import parse_tree
+
+    modules = parse_tree(REPO_ROOT)
+    findings = []
+    rule = KernelResultCommitDisciplineRule()
     findings += rule.validate(modules)
     for module in modules:
         findings += rule.check(module)

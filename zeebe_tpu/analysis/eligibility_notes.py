@@ -113,6 +113,18 @@ REASON_NOTES: dict[str, str] = {
     "group-error": (
         "the group's processing raised before any append — transaction "
         "rolled back, head re-processed sequentially"),
+    "device-dispatch-error": (
+        "a device compile/dispatch/fetch exception was contained at the "
+        "kernel dispatch seam — the group abandoned, the head host "
+        "re-executed, the device health ladder notified"),
+    "device-wedged": (
+        "a device dispatch exceeded the per-dispatch watchdog deadline "
+        "(ZEEBE_BROKER_DEVICE_DISPATCHTIMEOUTMS) — the gray-failure "
+        "slow-but-alive shape, contained like a dispatch exception"),
+    "device-quarantined": (
+        "the broker's device health ladder is QUARANTINED: every group is "
+        "host-routed until periodic canary dispatches re-prove the device "
+        "against the host oracle"),
     # -- head families (noted as <family>:<VALUE_TYPE>.<INTENT>) ------------
     "head-sequential": (
         "ordinary sequential traffic at the group boundary: the pending "

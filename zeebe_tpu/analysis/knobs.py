@@ -83,6 +83,34 @@ KNOB_NOTES: dict[str, str] = {
         "candidate"),
     "ZEEBE_BROKER_DATA_TIERING_SPILLBATCH": (
         "tiering: instances spilled per pump pass"),
+    "ZEEBE_BROKER_DEVICE_DISPATCHTIMEOUTMS": (
+        "device dispatch watchdog: a dispatch/fetch exceeding this deadline "
+        "is contained as a typed wedge (0 disables; armed only on real "
+        "accelerators or under device chaos — default 45000)"),
+    "ZEEBE_BROKER_DEVICE_SHADOWSAMPLERATE": (
+        "fraction of kernel groups re-executed on the host oracle and "
+        "compared byte-for-byte before commit (silent-corruption "
+        "detection; default 0.02)"),
+    "ZEEBE_BROKER_DEVICE_SUSPECTSHADOWBOOST": (
+        "shadow-sample-rate multiplier while the device health ladder is "
+        "SUSPECT (default 8)"),
+    "ZEEBE_BROKER_DEVICE_QUARANTINEFAULTS": (
+        "device faults inside the fault window that escalate SUSPECT to "
+        "QUARANTINED (default 3)"),
+    "ZEEBE_BROKER_DEVICE_FAULTWINDOWMS": (
+        "sliding window the quarantine fault count is evaluated over "
+        "(default 60000)"),
+    "ZEEBE_BROKER_DEVICE_SUSPECTCLEARMS": (
+        "fault-free ms under boosted shadow sampling that steps SUSPECT "
+        "back to HEALTHY (default 30000)"),
+    "ZEEBE_BROKER_DEVICE_CANARYINTERVALMS": (
+        "cadence of known-answer canary dispatches while QUARANTINED "
+        "(default 5000)"),
+    "ZEEBE_BROKER_DEVICE_CANARYSUCCESSES": (
+        "consecutive verified canaries that re-prove a QUARANTINED device "
+        "(default 2)"),
+    "ZEEBE_BROKER_DEVICE_SHADOWSEED": (
+        "seed of the deterministic shadow-sampling decision stream"),
     "ZEEBE_BROKER_EXPERIMENTAL_CONSISTENCYCHECKS": (
         "enable foreign-key consistency checks in the state store"),
     "ZEEBE_BROKER_EXPERIMENTAL_DURABLESTATE": (
@@ -132,6 +160,15 @@ KNOB_NOTES: dict[str, str] = {
         "chaos disk: path the controller polls each tick — creating it "
         "disarms all disk faults (the torture harness ends the survival "
         "window before its probe/quiesce phases)"),
+    "ZEEBE_CHAOS_DEVICE": (
+        "chaos device: seeded accelerator fault-injection spec (compile/"
+        "dispatch failure, stall, partial-chunk failure, result bit-flip "
+        "rates) installed into the kernel dispatch seam; the device-chaos "
+        "gate's fault source"),
+    "ZEEBE_CHAOS_DEVICE_DISARMFILE": (
+        "chaos device: path the controller polls each tick — creating it "
+        "disarms all device faults (the gate's recovery phase lets the "
+        "canary ladder re-prove an honest device)"),
     "ZEEBE_CHAOS_EPOCH_MS": (
         "chaos TCP: epoch anchor for deterministic link-partition windows "
         "across processes"),

@@ -803,6 +803,93 @@ class StorageIoDisciplineRule(Rule):
         return out
 
 
+#: the device-result decode/ingestion primitives: calls that turn raw
+#: device output into host-side data the engine could commit
+_KERNEL_RESULT_CALLS = (
+    "zeebe_tpu.ops.automaton.unpack_events",
+    "zeebe_tpu.ops.automaton.run_collect",
+    "jax.device_get",
+)
+
+
+class KernelResultCommitDisciplineRule(Rule):
+    """Kernel group results may only enter the group transaction through
+    the validation/shadow seam (ISSUE 15): inside ``engine/`` and
+    ``stream/`` the device-result primitives — ``run_collect`` dispatch,
+    ``jax.device_get`` fetch, ``unpack_events`` decode — are legal ONLY in
+    the registered seam functions of ``engine/kernel_backend.py``
+    (``_dispatch_first_chunk`` / ``_complete_device_run`` / ``_fetch_rows``
+    / ``_shadow_execute``), whose results flow to materialization
+    exclusively via ``finish_group``'s shadow-verification gate. A direct
+    fetch+decode anywhere else is a path for silently-corrupted device
+    output to reach the replicated log without the watchdog, the chaos
+    seam, or shadow verification ever seeing it. (The mesh runner lives
+    under ``parallel/`` and is covered at its ``submit`` seam — an honest
+    scope limit documented in docs/static-analysis.md.)"""
+
+    name = "kernel-result-commit-discipline"
+    summary = ("device-result primitives (run_collect/device_get/"
+               "unpack_events) in engine//stream/ only inside the "
+               "kernel_backend dispatch/shadow seam")
+
+    DEFAULT_SCOPE_PREFIXES = ("zeebe_tpu/engine/", "zeebe_tpu/stream/")
+    SEAM_MODULE = "zeebe_tpu/engine/kernel_backend.py"
+    DEFAULT_SEAM_SCOPES = (
+        "KernelBackend._dispatch_first_chunk",
+        "KernelBackend._complete_device_run",
+        "KernelBackend._fetch_rows",
+        "KernelBackend._shadow_execute",
+    )
+
+    def __init__(self, scope_prefixes=None, seam_module=None,
+                 seam_scopes=None) -> None:
+        self.scope_prefixes = (self.DEFAULT_SCOPE_PREFIXES
+                               if scope_prefixes is None
+                               else tuple(scope_prefixes))
+        self.seam_module = (self.SEAM_MODULE if seam_module is None
+                            else seam_module)
+        self.seam_scopes = (self.DEFAULT_SEAM_SCOPES if seam_scopes is None
+                            else tuple(seam_scopes))
+
+    def validate(self, modules):
+        return _validate_scoped_entries(
+            self, [(self.seam_module, prefix) for prefix in self.seam_scopes],
+            modules, "kernel-result seam")
+
+    def _in_seam(self, module: ParsedModule, node: ast.AST) -> bool:
+        if module.relpath != self.seam_module:
+            return False
+        scope = module.scope_of(node)
+        return any(scope == s or scope.startswith(s + ".")
+                   for s in self.seam_scopes)
+
+    def check(self, module: ParsedModule) -> list[Finding]:
+        if not module.relpath.startswith(self.scope_prefixes):
+            return []
+        aliases = _import_aliases(module.tree)
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func, aliases)
+            if dotted is None or _matches(dotted, _KERNEL_RESULT_CALLS) is None:
+                continue
+            if self._in_seam(module, node):
+                continue
+            if module.is_suppressed(self.name, node):
+                continue
+            out.append(module.finding(
+                self.name, node,
+                f"device-result primitive `{dotted}(...)` outside the "
+                f"kernel dispatch/shadow seam — device output may only "
+                f"enter the group transaction through "
+                f"KernelBackend.finish_group's validation gate "
+                f"({self.seam_module}); a direct fetch/decode here "
+                f"bypasses the watchdog, the chaos seam, and shadow "
+                f"verification"))
+        return out
+
+
 RULES: list[Rule] = [
     ReplayDeterminismRule(),
     DeviceCallDisciplineRule(),
@@ -811,4 +898,5 @@ RULES: list[Rule] = [
     ControlActuationDisciplineRule(),
     DriftCopyRule(),
     StorageIoDisciplineRule(),
+    KernelResultCommitDisciplineRule(),
 ]

@@ -400,13 +400,21 @@ def _kernel_coverage_row(partition) -> dict:
     """The compact kernelCoverage block riding a /cluster/status partition
     row: cumulative path split + ratio + the dominant host reason (the full
     per-definition report lives on the partition's /health)."""
-    acct = partition.processor.kernel_backend.accounting
+    backend = partition.processor.kernel_backend
+    acct = backend.accounting
     top = acct.reasons.most_common(1)
+    # one locked snapshot, the canonical key names (the CLI renderer and
+    # the /health block read the same surface)
+    device = backend.health.status()
     return {
         "kernelRecords": acct.kernel_records,
         "hostRecords": acct.host_records,
         "coverageRatio": round(acct.coverage_ratio(), 4),
         **({"dominantHostReason": top[0][0]} if top else {}),
+        # device-fault defense (ISSUE 15): compact ladder state — the full
+        # fault/canary detail lives on the partition's /health
+        "device": {k: device[k]
+                   for k in ("state", "shadowChecks", "shadowMismatches")},
     }
 
 

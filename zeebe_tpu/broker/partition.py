@@ -417,6 +417,11 @@ class ZeebePartition:
             self.processor.wave_listener = (
                 lambda event, pid=self.partition_id:
                 self.flight.record(pid, "kernel_wave", **event))
+            # device health audit sink (ISSUE 15): the process-wide ladder's
+            # transitions (control_adjust + device_health events) and typed
+            # device_fault evidence land in this broker's flight recorder
+            kernel_backend.health.flight_sink = (self.flight,
+                                                 self.partition_id)
         if self.on_jobs_available is not None:
             listener = self.on_jobs_available
             self.processor.on_jobs_available = (
@@ -1575,9 +1580,13 @@ class ZeebePartition:
             # kernel-path coverage (ISSUE 13): which records rode the
             # device plane vs host, and why — the ruler ROADMAP item 3's
             # "≥90% on the kernel path" is graded with
-            **({"kernelCoverage": self.processor.kernel_backend
-                .accounting.snapshot()}
-               if self.processor is not None
+            **({"kernelCoverage": {
+                **self.processor.kernel_backend.accounting.snapshot(),
+                # device-fault defense (ISSUE 15): health ladder state +
+                # shadow counters, so a quarantine explains its own
+                # coverage drop in the same block
+                "device": self.processor.kernel_backend.device_status(),
+            }} if self.processor is not None
                and self.processor.kernel_backend is not None else {}),
             # at-rest storage integrity (ISSUE 14): scrub coverage,
             # detections, repairs, and the DEGRADED latch while a repair

@@ -376,13 +376,20 @@ def _render_top(status: dict) -> str:
         # ROADMAP item 3 coverage metric moves
         lines.append("")
         lines.append(f"{'KERNEL':<14} {'PART':>4} {'COV%':>6} "
-                     f"{'KERNEL':>9} {'HOST':>9} DOMINANT HOST REASON")
+                     f"{'KERNEL':>9} {'HOST':>9} {'DEVICE':<12} "
+                     f"{'SHADOW':>7} {'MISM':>5} DOMINANT HOST REASON")
         for node, pid, cov in coverage_rows:
+            # device health ladder (ISSUE 15): a QUARANTINED device is the
+            # first thing to look at when a partition's COV% drops
+            dev = cov.get("device", {})
             lines.append(
                 f"{node:<14} {pid:>4} "
                 f"{cov.get('coverageRatio', 0.0) * 100:>5.1f}% "
                 f"{cov.get('kernelRecords', 0):>9} "
                 f"{cov.get('hostRecords', 0):>9} "
+                f"{dev.get('state', '-'):<12} "
+                f"{dev.get('shadowChecks', 0):>7} "
+                f"{dev.get('shadowMismatches', 0):>5} "
                 f"{cov.get('dominantHostReason', '-')}")
     admission = status.get("admission")
     if admission and (admission.get("tenants") or admission.get("shedLevel")):

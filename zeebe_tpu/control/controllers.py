@@ -245,7 +245,11 @@ class TieringController(Controller):
 
 
 class RoutingController(Controller):
-    """Host-vs-device routing threshold ← XLA compile telemetry."""
+    """Host-vs-device routing threshold ← XLA compile telemetry + the
+    device health ladder (ISSUE 15): a SUSPECT/QUARANTINED device biases
+    kernel groups host-ward through the same actuator a recompile storm
+    uses — recent device faults and compile churn are the same posture
+    (don't trust the accelerator with latency-critical groups right now)."""
 
     name = "kernel-routing"
 
@@ -258,9 +262,17 @@ class RoutingController(Controller):
     def read_signals(self, reader: SignalReader) -> dict | None:
         miss_rate = reader.latest_sum("zeebe_xla_compiles_total",
                                       labels_contains='cache="miss"')
-        if miss_rate is None:
+        device_state = reader.latest_max("zeebe_device_health_state")
+        if miss_rate is None and not device_state:
+            # no compile telemetry and a HEALTHY (or absent) ladder: the
+            # health gauge is registered at import and always fresh, so it
+            # must not masquerade as a live compile signal — report stale
+            # and let the actuator walk back to the configured static
+            # threshold instead of actuating on a fabricated 0.0 miss rate
             return None
-        signals = {"compileMissPerSec": round(miss_rate, 3)}
+        signals = {"compileMissPerSec": round(miss_rate or 0.0, 3)}
+        if device_state is not None:
+            signals["deviceHealthState"] = device_state
         p99 = reader.latest_max("zeebe_xla_compile_seconds:p99")
         if p99 is not None:
             signals["compileP99Ms"] = round(p99 * 1000.0, 1)
@@ -268,6 +280,13 @@ class RoutingController(Controller):
 
     def decide(self, signals, current):
         miss = signals["compileMissPerSec"]
+        device_state = signals.get("deviceHealthState", 0.0)
+        if device_state and device_state >= 1.0:
+            label = "QUARANTINED" if device_state >= 2.0 else "SUSPECT"
+            return {self.KNOB: (
+                float("inf"),
+                f"device health {label}: biasing kernel groups onto the "
+                f"host backend until the ladder clears")}
         if miss > self.STORM_MISS_PER_S:
             return {self.KNOB: (
                 float("inf"),

@@ -102,6 +102,10 @@ RUNTIME_REASONS = frozenset({
     "mesh-no-quiesce",
     "mesh-token-overflow",
     "group-error",
+    # device-fault defense (ISSUE 15): containment + quarantine routing
+    "device-dispatch-error",
+    "device-wedged",
+    "device-quarantined",
 })
 
 #: dynamic families noted as ``<family>:<VALUE_TYPE>.<INTENT>`` —

@@ -1082,6 +1082,61 @@ def _device_ctx(dev):
     return jax.default_device(dev)
 
 
+class DeviceWedgedError(RuntimeError):
+    """A device dispatch/fetch exceeded the per-dispatch watchdog deadline
+    (``ZEEBE_BROKER_DEVICE_DISPATCHTIMEOUTMS``) — the gray-failure shape a
+    slow-but-alive device tunnel produces. Contained exactly like a
+    dispatch exception: the group is abandoned and host re-executed."""
+
+
+#: the device-chaos seam (ISSUE 15): ``testing/chaos_device.py`` installs a
+#: controller here (worker entry, from ``ZEEBE_CHAOS_DEVICE``); the dispatch
+#: path consults it with ONE is-None check per group when chaos is off
+_DEVICE_CHAOS = None
+
+
+def install_device_chaos(controller) -> None:
+    """Install (or, with None, remove) the process-wide device-fault
+    controller consulted at the kernel dispatch seam."""
+    global _DEVICE_CHAOS
+    _DEVICE_CHAOS = controller
+
+
+def device_chaos():
+    return _DEVICE_CHAOS
+
+
+def _watchdog_call(fn, deadline_s: float):
+    """Run ``fn`` on a daemon thread with a deadline — the dispatch
+    watchdog. A deadline miss raises :class:`DeviceWedgedError`; the
+    worker thread keeps blocking on the wedged call (honest caveat in
+    docs/device-faults.md: a truly wedged device leaks one thread per
+    expired dispatch — the quarantine ladder stops further dispatches
+    after the first few)."""
+    import threading
+
+    box: dict = {}
+    done = threading.Event()
+
+    def run() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as exc:  # noqa: BLE001 — re-raised on the caller
+            box["error"] = exc
+        done.set()
+
+    thread = threading.Thread(target=run, daemon=True,
+                              name="device-dispatch-watchdog")
+    thread.start()
+    if not done.wait(deadline_s):
+        raise DeviceWedgedError(
+            f"device dispatch exceeded the {deadline_s * 1000:.0f}ms "
+            f"watchdog deadline (wedged or badly degraded device)")
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
 def _profiler_annotation(name: str):
     """``jax.profiler.TraceAnnotation`` around one kernel-chunk dispatch —
     the device-side counterpart of the observability spans: a
@@ -1132,10 +1187,20 @@ class _PendingGroup:
     # chunk's threads compete with the decoding host thread for the same
     # cores (measured: ten_tasks regression on a 2-vCPU box)
     pipeline_chunks: bool = False
+    # device-fault defense (ISSUE 15): shadow=True keeps the fetched result
+    # rows for byte-for-byte comparison against the host oracle before the
+    # group transaction commits; canary marks a quarantine re-proving
+    # dispatch (forced shadow); corrupt_tokens are chaos-ledger sequences
+    # the backend must report caught (shadow or containment)
+    shadow: bool = False
+    canary: bool = False
+    raw_rows: list = field(default_factory=list)
+    corrupt_tokens: list = field(default_factory=list)
     # stage wall times (seconds), observed by the stream processor
     t_admit: float = 0.0
     device_elapsed: float = 0.0
     t_materialize: float = 0.0
+    t_shadow: float = 0.0
 
 
 class KernelBackend:
@@ -1196,6 +1261,17 @@ class KernelBackend:
         self.template_misses = 0
         self.template_audits = 0
         self.template_audit_skips = 0
+        # device-fault defense (ISSUE 15): the per-broker health ladder
+        # (shared across partitions like the router — the device is a
+        # process resource), the shadow-verification sample rate, and the
+        # dispatch watchdog deadline all bind from ZEEBE_BROKER_DEVICE_*
+        from zeebe_tpu.engine.device_health import shared_device_health
+
+        self.health = shared_device_health()
+        self._shadow_seq = 0
+        #: groups whose device result a shadow mismatch quarantined (the
+        #: host oracle's result committed instead)
+        self.shadow_quarantined = 0
         # per-I-bucket cached zero planes for _dispatch_first_chunk (jax
         # arrays are immutable, so sharing across groups is safe)
         self._zero_state: dict = {}
@@ -1204,6 +1280,17 @@ class KernelBackend:
         # loads the persistent-cache executable) — was already timed into
         # xla_compile_seconds / xla_compiles_total{cache=hit|miss}
         self._compiles_seen: set = set()
+
+    # ONE source of truth for the device-defense knobs: the shared ladder's
+    # cfg — a snapshot copied at construction would split-brain against the
+    # live suspect_shadow_boost/shadow_seed reads in _shadow_sampled
+    @property
+    def shadow_sample_rate(self) -> float:
+        return self.health.cfg.shadow_sample_rate
+
+    @property
+    def dispatch_timeout_ms(self) -> int:
+        return self.health.cfg.dispatch_timeout_ms
 
     # -- candidate test (no state access) ----------------------------------
 
@@ -2029,7 +2116,17 @@ class KernelBackend:
         # allocation after a redeploy recompile, and lets partitions with
         # equal sets share cost observations through the shared router.
         pg.bucket = (self.registry.tables_fingerprint, pg.I, pg.T)
-        dev = self.router.choose(pg.bucket) if self.router is not None else None
+        dev = None
+        if self.router is not None:
+            if pg.canary:
+                # a canary must probe the SUSPECT device: pin the
+                # accelerator rather than ask choose(), whose quarantine
+                # host-ward bias (route_threshold_s=+inf) would send the
+                # canary to the host — where it trivially byte-matches
+                # the host oracle and re-proves nothing
+                dev = self.router.accel_device()
+            if dev is None:
+                dev = self.router.choose(pg.bucket)
         pg.dev = dev
         if dev is not None:
             pg.pipeline_chunks = getattr(dev, "platform", "cpu") != "cpu"
@@ -2037,8 +2134,20 @@ class KernelBackend:
             import jax
 
             pg.pipeline_chunks = jax.default_backend() != "cpu"
+        # shadow sampling decided BEFORE dispatch: only sampled groups pay
+        # the fetched-row retention (canaries are forced-shadow)
+        pg.shadow = pg.canary or self._shadow_sampled()
         t0 = _time.perf_counter()
-        self._dispatch_first_chunk(pg)
+        try:
+            chaos = _DEVICE_CHAOS
+            if chaos is not None:
+                chaos.dispatch_fault()
+            self._dispatch_first_chunk(pg)
+        except Exception as exc:  # noqa: BLE001 — containment: a device
+            # failure (chaos-injected or real) must degrade to the host
+            # path, never poison the pump
+            self._contain_device_failure(pg, exc, where="dispatch")
+            return
         # device_elapsed feeds the router's cost model: it must cover only
         # dispatch + fetch/decode windows, never the host work the caller
         # overlaps between them
@@ -2081,7 +2190,13 @@ class KernelBackend:
             return result.steps
 
         t0 = _time.perf_counter()
-        steps = self._complete_device_run(pg)
+        try:
+            steps = self._complete_device_run(pg)
+        except Exception as exc:  # noqa: BLE001 — containment: a mid-group
+            # fetch failure or watchdog-expired stall abandons the group
+            self._contain_device_failure(pg, exc, where="fetch")
+            pg.device_elapsed += _time.perf_counter() - t0
+            return None
         pg.device_elapsed += _time.perf_counter() - t0
         if self.router is not None and pg.dev is not None and steps is not None:
             # failed runs (non-quiescence, pool overflow) fall back to the
@@ -2106,49 +2221,56 @@ class KernelBackend:
         except Exception:  # noqa: BLE001
             pass
 
-    def _dispatch_first_chunk(self, pg: "_PendingGroup") -> None:
+    def _group_state(self, pg: "_PendingGroup", dev) -> dict:
+        """The group's initial kernel state dict: the host-filled arrays
+        plus cached zero planes. Must be called inside ``_device_ctx(dev)``
+        — the zero planes must materialize in the placement context, or a
+        routed accelerator's cache entry would hold default-device arrays
+        and pay the transfer the cache exists to eliminate.
+
+        Fresh per-group zero planes are IDENTICAL every group: cache the
+        immutable device constants per (device, I) bucket — each jnp.zeros
+        call otherwise costs a dispatch (~0.1ms × 5 per group adds up at
+        small group sizes); the key carries the device because the link
+        router alternates a bucket between host and accelerator and planes
+        cached on one device must not leak into a group running on the
+        other. The real (host-filled) arrays convert inside the jit call
+        itself. Shared by the dispatch path and the shadow oracle — both
+        must start from byte-identical state."""
         import jax.numpy as jnp
 
+        I = pg.I
+        arrays = pg.arrays
+        zeros = self._zero_state.get((dev, I))
+        if zeros is None:
+            zeros = {
+                "incident": jnp.zeros(I, jnp.bool_),
+                "transitions": jnp.zeros((), jnp.int32),
+                "jobs_created": jnp.zeros((), jnp.int32),
+                "completed": jnp.zeros((), jnp.int32),
+                "overflow": jnp.zeros((), jnp.bool_),
+            }
+            self._zero_state[(dev, I)] = zeros
+        return {
+            "elem": arrays["elem"],
+            "phase": arrays["phase"],
+            "inst": arrays["inst"],
+            "def_of": arrays["def_of"],
+            "var_slots": arrays["var_slots"],
+            "join_counts": arrays["join_counts"],
+            "mi_left": arrays["mi_left"],
+            "done": arrays["done"],
+            **zeros,
+        }
+
+    def _dispatch_first_chunk(self, pg: "_PendingGroup") -> None:
         from zeebe_tpu.ops.automaton import run_collect
 
         dev, I = pg.dev, pg.I
-        arrays = pg.arrays
-        # fresh per-group zero planes are IDENTICAL every group: cache the
-        # immutable device constants per shape bucket — each jnp.zeros call
-        # otherwise costs a dispatch (~0.1ms × 5 per group adds up at small
-        # group sizes). The real (host-filled) arrays convert inside the jit
-        # call itself.
-        # keyed by (device, I): the link router alternates a bucket between
-        # host and accelerator, and planes cached on one device must not
-        # leak into a group running on the other (cross-device transfers at
-        # best, a placement error at worst)
         pg.config = pg.tables.kernel_config
         pg.dt = self.registry.device_tables_for(dev)
         with _device_ctx(dev):
-            # the zero planes must materialize INSIDE the placement context,
-            # or a routed accelerator's cache entry would hold default-device
-            # arrays and pay the transfer this cache exists to eliminate
-            zeros = self._zero_state.get((dev, I))
-            if zeros is None:
-                zeros = {
-                    "incident": jnp.zeros(I, jnp.bool_),
-                    "transitions": jnp.zeros((), jnp.int32),
-                    "jobs_created": jnp.zeros((), jnp.int32),
-                    "completed": jnp.zeros((), jnp.int32),
-                    "overflow": jnp.zeros((), jnp.bool_),
-                }
-                self._zero_state[(dev, I)] = zeros
-            state = {
-                "elem": arrays["elem"],
-                "phase": arrays["phase"],
-                "inst": arrays["inst"],
-                "def_of": arrays["def_of"],
-                "var_slots": arrays["var_slots"],
-                "join_counts": arrays["join_counts"],
-                "mi_left": arrays["mi_left"],
-                "done": arrays["done"],
-                **zeros,
-            }
+            state = self._group_state(pg, dev)
             # JAX async dispatch: the call returns with the device still
             # computing; the first host transfer (in _complete_device_run)
             # is the synchronization point
@@ -2173,8 +2295,6 @@ class KernelBackend:
                                       _time.perf_counter() - t_compile)
 
     def _complete_device_run(self, pg: "_PendingGroup"):
-        import jax
-
         from zeebe_tpu.ops.automaton import run_collect, unpack_events
 
         # chunked device loop: one dispatch + ONE host transfer per chunk of
@@ -2203,7 +2323,7 @@ class KernelBackend:
                         _profiler_annotation("zeebe.kernel_chunk.prefetch"):
                     nxt = run_collect(pg.dt, state, n_steps=chunk,
                                       config=pg.config)
-            flat = jax.device_get(packed)
+            flat = self._fetch_rows(pg, packed, k)
             pg.chunks_run = k + 1
             # per row: T*(2+FO) packed event ints + (active, overflow) tail
             events_host = flat[:, :-2].reshape(chunk, T, 2 + FO)
@@ -2241,6 +2361,194 @@ class KernelBackend:
             return None
         return steps
 
+    # -- device-fault defense (ISSUE 15) --------------------------------------
+
+    def _fetch_rows(self, pg: "_PendingGroup", packed, chunk_index: int):
+        """The ONE device→host ingestion point for kernel results: every
+        fetched chunk of packed event rows passes through here before
+        decode. The chaos seam (stalls, partial-chunk failures, result
+        corruption) and the dispatch watchdog live exactly here; sampled
+        groups additionally retain the rows for shadow comparison."""
+        import jax
+
+        chaos = _DEVICE_CHAOS
+
+        def fetch():
+            if chaos is not None:
+                chaos.fetch_fault(chunk_index)
+            return jax.device_get(packed)
+
+        deadline_ms = self.dispatch_timeout_ms
+        # the watchdog thread-hop is paid only where it can pay off: on a
+        # real accelerator (a tunnel can wedge) or under the chaos plane —
+        # the plain host XLA path keeps its direct, zero-overhead fetch
+        if deadline_ms > 0 and (chaos is not None or pg.pipeline_chunks):
+            flat = _watchdog_call(fetch, deadline_ms / 1000.0)
+        else:
+            flat = fetch()
+        if chaos is not None:
+            # device_get may hand back a read-only view; corruption needs a
+            # writable copy (chaos-only cost, never on the clean path)
+            flat = np.array(flat)
+            token = chaos.corrupt_rows(flat, chunk_index)
+            if token is not None:
+                pg.corrupt_tokens.append(token)
+        if pg.shadow:
+            pg.raw_rows.append(flat)
+        return flat
+
+    def _contain_device_failure(self, pg: "_PendingGroup", exc,
+                                where: str) -> None:
+        """Containment: a dispatch exception, compile failure, or watchdog-
+        expired stall abandons the group with a TYPED reason — the caller
+        falls back to the sequential host path inside the same pump pass
+        (byte-identical by the template-shadow discipline), the health
+        ladder hears about it, and any chaos-injected corruption riding
+        the abandoned group is reported caught (its rows are discarded)."""
+        kind = ("device-wedged" if isinstance(exc, DeviceWedgedError)
+                else "device-dispatch-error")
+        pg.failed = True
+        pg.fail_reason = kind
+        chaos = _DEVICE_CHAOS
+        if chaos is not None and pg.corrupt_tokens:
+            for token in pg.corrupt_tokens:
+                chaos.note_caught(token, "contained")
+            pg.corrupt_tokens = []
+        logger.warning("device failure contained at %s (%s): %r — group "
+                       "host re-executed", where, kind, exc)
+        self.health.note_fault(kind, detail=f"{where}: {exc!r}"[:200])
+
+    def _shadow_sampled(self) -> bool:
+        """Deterministic seeded sampling stream for shadow verification:
+        one decision per dispatched group, boosted while SUSPECT. Counter-
+        hash based (no ``random`` module — kernel-path decisions must be
+        reproducible for a fixed seed + group sequence)."""
+        rate = self.shadow_sample_rate
+        if rate <= 0:
+            return False
+        cfg = self.health.cfg
+        from zeebe_tpu.engine.device_health import SUSPECT
+
+        if self.health.state == SUSPECT:
+            rate = min(1.0, rate * cfg.suspect_shadow_boost)
+        if rate >= 1.0:
+            return True
+        import zlib
+
+        self._shadow_seq += 1
+        h = zlib.crc32(
+            f"{cfg.shadow_seed}:{self.accounting.partition}:"
+            f"{self._shadow_seq}".encode("ascii"))
+        return (h % 1_000_000) < rate * 1_000_000
+
+    def _shadow_execute(self, pg: "_PendingGroup"):
+        """Re-execute the group's kernel program on the HOST backend from
+        the same initial arrays — the known-answer oracle for shadow
+        verification and quarantine canaries. Runs the identical jitted
+        program with the identical chunking, WITHOUT the chaos/watchdog
+        seam (the oracle path must not be faultable), and returns
+        (steps, rows) for byte-for-byte comparison.
+
+        Honest caveat (docs/device-faults.md): the oracle assumes the host
+        engine/XLA-CPU path is correct — it detects *divergence*, and the
+        host result is the one trusted. On a host-default process the
+        \"device\" and the oracle share a backend; the seam still catches
+        everything injected between fetch and decode (the chaos plane's
+        corruption model), which is what the gate proves."""
+        import jax
+
+        from zeebe_tpu.ops.automaton import run_collect, unpack_events
+
+        router = self.router
+        host_dev = None
+        if router is not None and getattr(router, "enabled", False):
+            host_dev = router._host
+        dt = (self.registry.device_tables_for(host_dev)
+              if host_dev is not None else self.registry.device_tables)
+        config = pg.tables.kernel_config
+        chunk = self.chunk_steps
+        T, I = pg.T, pg.I
+        FO = pg.tables.out_target.shape[2]
+        steps: list[dict] = []
+        rows: list = []
+        max_chunks = max(1, self.max_steps // chunk)
+        with _device_ctx(host_dev), \
+                _profiler_annotation("zeebe.kernel_chunk.shadow"):
+            state = self._group_state(pg, host_dev)
+            run = run_collect(dt, state, n_steps=chunk, config=config)
+        for k in range(max_chunks):
+            carry, packed = run
+            flat = jax.device_get(packed)
+            rows.append(flat)
+            events_host = flat[:, :-2].reshape(chunk, T, 2 + FO)
+            active = flat[:, -2]
+            quiesced = np.flatnonzero(active == 0)
+            keep = int(quiesced[0]) + 1 if quiesced.size else chunk
+            for s in range(keep):
+                steps.append(unpack_events(events_host[s], I))
+            if quiesced.size:
+                return steps, rows
+            if k + 1 < max_chunks:
+                with _device_ctx(host_dev), \
+                        _profiler_annotation("zeebe.kernel_chunk.shadow"):
+                    run = run_collect(dt, carry, n_steps=chunk, config=config)
+        # the oracle did not quiesce: the group is genuinely pathological —
+        # raise so the caller abandons it (sequential host re-execution)
+        raise RuntimeError(
+            f"shadow oracle did not quiesce in {self.max_steps} steps")
+
+    def _verify_steps(self, pg: "_PendingGroup", steps):
+        """Sampled shadow verification: compare the device's fetched result
+        rows byte-for-byte against the host oracle BEFORE anything from
+        this group enters the group transaction. On mismatch the device
+        result is quarantined — the HOST result is decoded and committed
+        instead, so a silently-corrupting device can never reach the
+        replicated log — and the health ladder latches SUSPECT. Returns
+        the steps to materialize (None → abandon the group)."""
+        import time as _time
+
+        health = self.health
+        health.note_shadow_check()
+        t0 = _time.perf_counter()
+        try:
+            shadow_steps, shadow_rows = self._shadow_execute(pg)
+        except Exception as exc:  # noqa: BLE001 — oracle failure: abandon
+            # the group rather than commit an unverified device result; the
+            # failed canary is noted ONCE, by finish_group's decline branch
+            # (the same seam that notes containment-declined canaries)
+            self._contain_device_failure(pg, exc, where="shadow")
+            return None
+        pg.t_shadow = _time.perf_counter() - t0
+        rows = pg.raw_rows
+        match = (len(rows) == len(shadow_rows)
+                 and all(np.array_equal(a, b)
+                         for a, b in zip(rows, shadow_rows)))
+        if match:
+            if pg.canary:
+                health.note_canary(True)
+            return steps
+        chaos = _DEVICE_CHAOS
+        if chaos is not None and pg.corrupt_tokens:
+            for token in pg.corrupt_tokens:
+                chaos.note_caught(token, "shadow")
+            pg.corrupt_tokens = []
+        self.shadow_quarantined += 1
+        health.note_shadow_mismatch(
+            detail=f"I={pg.I} T={pg.T} deviceChunks={len(rows)} "
+                   f"oracleChunks={len(shadow_rows)}")
+        if pg.canary:
+            health.note_canary(False, detail="shadow mismatch")
+        logger.warning(
+            "shadow verification MISMATCH (I=%d T=%d): device result "
+            "quarantined, host oracle result committed", pg.I, pg.T)
+        return shadow_steps
+
+    def device_status(self) -> dict:
+        """The ``device`` block under ``kernelCoverage`` on /health and
+        /cluster/status: ladder state + shadow/canary counters."""
+        return {**self.health.status(),
+                "shadowQuarantinedGroups": self.shadow_quarantined}
+
     # -- materialization ------------------------------------------------------
 
     def process_group(self, cmds, make_builder: Callable[[], Any]) -> tuple[list, list]:
@@ -2265,6 +2573,26 @@ class KernelBackend:
         the same transaction must stay open through ``finish_group``."""
         import time as _time
 
+        # device health gating (ISSUE 15): while QUARANTINED every group is
+        # host-routed (typed accounting) except the periodic canary — ONE
+        # group per interval dispatched under FORCED shadow verification (a
+        # known-answer probe: the host oracle is the answer, so a wrong
+        # canary cannot commit wrong bytes). Mesh dispatch has its own
+        # killable probe (PR 7) and is not gated here.
+        canary = False
+        if self.mesh_runner is None and self.health.is_quarantined():
+            if self.health.canary_due():
+                canary = True
+            else:
+                head = next(iter(cmds), None)
+                if head is None:
+                    return None  # end-of-log probe, not a reroute
+                self.fallbacks += 1
+                self.accounting.note_host("device-quarantined",
+                                          self._definition_of(head.record))
+                self.health.note_host_reroute()
+                return None
+
         t0 = _time.perf_counter()
         instances: dict[int, _Inst] = {}
         # pi_key conflict index: one command per instance per group; a set
@@ -2286,6 +2614,11 @@ class KernelBackend:
             if len(admitted) >= self.max_group:
                 break
         if not admitted:
+            if canary:
+                # the claimed canary slot never dispatched: un-claim it so
+                # the next admittable group can probe immediately instead
+                # of waiting out an interval the device never saw
+                self.health.release_canary()
             if head_cmd is None:
                 # the candidate iterator was EMPTY — an end-of-log probe, not
                 # a fallback (ISSUE 7: these probes were counted as
@@ -2305,6 +2638,7 @@ class KernelBackend:
             )
             return None
         pg = _PendingGroup(admitted)
+        pg.canary = canary
         pg.t_admit = _time.perf_counter() - t0
         self._start_kernel(pg)
         return pg
@@ -2318,11 +2652,38 @@ class KernelBackend:
         if pg is None:
             return [], []
         steps = self._await_kernel(pg)
+        if steps is not None and not pg.mesh and pg.shadow:
+            # the validation/shadow seam (ISSUE 15): the ONLY way a device
+            # result may proceed toward the group transaction when sampled
+            # — on mismatch the host oracle's steps come back instead
+            steps = self._verify_steps(pg, steps)
         if steps is None:
             # the whole group declined at dispatch; the HEAD is what the
             # caller processes sequentially next (the rest re-admit), so
             # exactly one host record is noted, with the typed reason
             self.fallbacks += 1
+            chaos = _DEVICE_CHAOS
+            if chaos is not None and pg.corrupt_tokens:
+                # a typed decline (no-quiesce/overflow a corruption itself
+                # provoked) discards the fetched rows: caught by containment
+                for token in pg.corrupt_tokens:
+                    chaos.note_caught(token, "contained")
+                pg.corrupt_tokens = []
+            if pg.canary:
+                if pg.fail_reason in ("device-dispatch-error",
+                                      "device-wedged"):
+                    # the probe reached the device and the device failed:
+                    # a real failed canary, the recovery streak resets
+                    self.health.note_canary(
+                        False, detail=pg.fail_reason)
+                else:
+                    # a host-side decline (geometry-bounds, no-quiesce,
+                    # token-overflow) never proved anything about the
+                    # device — un-claim the slot so the next admittable
+                    # group probes immediately, and leave the verified
+                    # streak alone (a pathological GROUP must not hold
+                    # the device in quarantine)
+                    self.health.release_canary()
             head = pg.admitted[0]
             self.accounting.note_host(
                 pg.fail_reason or "group-error",
@@ -2354,6 +2715,10 @@ class KernelBackend:
             defs[pid] = defs.get(pid, 0) + 1
         for pid, n in defs.items():
             self.accounting.note_kernel(pid, n)
+        # clean-group evidence for the health ladder: a committed group
+        # with no fault steps SUSPECT back toward HEALTHY after the
+        # configured quiet window
+        self.health.note_group_ok()
 
     # -- template routing ----------------------------------------------------
 

@@ -620,6 +620,14 @@ def main(argv: list[str] | None = None) -> int:
 
     disk_chaos = _maybe_disk_chaos(member_id=args.node_id,
                                    data_dir=args.data_dir)
+    # device-layer chaos (ISSUE 15): ZEEBE_CHAOS_DEVICE installs the seeded
+    # fault controller into the kernel backend's dispatch seam; its tick
+    # (disarm check + counts evidence) rides the pump loop
+    from zeebe_tpu.testing.chaos_device import maybe_install_from_env as \
+        _maybe_device_chaos
+
+    device_chaos = _maybe_device_chaos(member_id=args.node_id,
+                                       data_dir=args.data_dir)
 
     ext = load_broker_cfg(overrides={
         "base.node_id": args.node_id,
@@ -655,6 +663,8 @@ def main(argv: list[str] | None = None) -> int:
     while not stop.is_set():
         if disk_chaos is not None:
             disk_chaos.tick()
+        if device_chaos is not None:
+            device_chaos.tick()
         if runtime.pump() == 0:
             time.sleep(0.001)
     if management is not None:

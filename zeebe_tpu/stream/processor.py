@@ -694,6 +694,7 @@ class StreamProcessor:
         dominant = max(delta_reasons, key=delta_reasons.get, default=None)
         d_kernel = acct.kernel_records - k_mark
         d_host = acct.host_records - h_mark
+        health = self.kernel_backend.health
         event = {
             "waves": agg["waves"],
             "commands": agg["commands"],
@@ -707,6 +708,12 @@ class StreamProcessor:
             "coverageRatio": round(d_kernel / max(1, d_kernel + d_host), 4),
             "overlapRatio": round(self._overlap_ema or 0.0, 4),
             **({"dominantFallback": dominant} if dominant else {}),
+            # device-fault defense (ISSUE 15): the wave event carries the
+            # ladder state + shadow counters, so a quarantine explains its
+            # own coverage drop right in the flight ring
+            "deviceHealth": health.state,
+            "shadowChecks": health.shadow_checks,
+            "shadowMismatches": health.shadow_mismatches,
         }
         self._wave_marks = (acct.kernel_records, acct.host_records,
                             dict(acct.reasons))

@@ -46,13 +46,17 @@ from __future__ import annotations
 
 import dataclasses
 import errno
-import json
 import logging
 import os
-import random
 import time
-import zlib
 from pathlib import Path
+
+from zeebe_tpu.testing.chaos_common import (
+    CountsSnapshot,
+    JsonlLedger,
+    member_rng,
+    parse_spec_fields,
+)
 
 logger = logging.getLogger("zeebe_tpu.testing.chaos_disk")
 
@@ -131,27 +135,19 @@ def parse_spec(spec: str) -> DiskFaultPlan:
                 c.strip() for c in section[len("classes="):].split("|")
                 if c.strip())
             continue
-        for field in section.split(","):
-            key, _, value = field.partition("=")
-            key = key.strip()
-            if key == "seed":
-                plan.seed = int(value)
-            elif key == "eio":
-                plan.eio_p = float(value)
-            elif key == "enospc":
-                plan.enospc_p = float(value)
-            elif key == "torn":
-                plan.torn_p = float(value)
-            elif key == "fsync_fail":
-                plan.fsync_fail_p = float(value)
-            elif key == "fsync_stall":
-                plan.fsync_stall_p = float(value)
-            elif key == "stall_ms":
-                plan.stall_ms = int(value)
-            elif key == "bitrot_interval_ms":
-                plan.bitrot_interval_ms = int(value)
-            elif key == "bitrot_delay_ms":
-                plan.bitrot_delay_ms = int(value)
+        parse_spec_fields(section, {
+            "seed": lambda v: setattr(plan, "seed", int(v)),
+            "eio": lambda v: setattr(plan, "eio_p", float(v)),
+            "enospc": lambda v: setattr(plan, "enospc_p", float(v)),
+            "torn": lambda v: setattr(plan, "torn_p", float(v)),
+            "fsync_fail": lambda v: setattr(plan, "fsync_fail_p", float(v)),
+            "fsync_stall": lambda v: setattr(plan, "fsync_stall_p", float(v)),
+            "stall_ms": lambda v: setattr(plan, "stall_ms", int(v)),
+            "bitrot_interval_ms": lambda v: setattr(
+                plan, "bitrot_interval_ms", int(v)),
+            "bitrot_delay_ms": lambda v: setattr(
+                plan, "bitrot_delay_ms", int(v)),
+        })
     return plan
 
 
@@ -190,14 +186,12 @@ class DiskChaosController:
         self.member_id = member_id
         #: directory tree scanned for at-rest bit-rot candidates
         self.root = Path(root) if root is not None else None
-        self.rng = random.Random(
-            plan.seed ^ zlib.crc32(member_id.encode("utf-8")))
+        self.rng = member_rng(plan.seed, member_id)
         self.counts = {"writes": 0, "fsyncs": 0}
         for cls in FAULT_CLASSES:
             self.counts[cls] = 0
-        self.counts_file: str | None = None
-        self.ledger_file: str | None = None
-        self._last_counts_dump = 0.0
+        self._counts_snap = CountsSnapshot(member_id)
+        self._ledger_sink = JsonlLedger()
         self._last_bitrot = time.time() * 1000.0 + plan.bitrot_delay_ms
         # armed=False freezes probabilistic faults (harness quiesce phases
         # need the disk honest while evidence drains); the harness flips
@@ -318,31 +312,27 @@ class DiskChaosController:
                            cls)
             return
 
+    @property
+    def counts_file(self):
+        return self._counts_snap.counts_file
+
+    @counts_file.setter
+    def counts_file(self, value) -> None:
+        self._counts_snap.counts_file = value
+
+    @property
+    def ledger_file(self):
+        return self._ledger_sink.path
+
+    @ledger_file.setter
+    def ledger_file(self, value) -> None:
+        self._ledger_sink.path = value
+
     def _ledger(self, entry: dict) -> None:
-        if self.ledger_file is None:
-            return
-        try:
-            with open(self.ledger_file, "a", encoding="utf-8") as f:
-                f.write(json.dumps(entry, separators=(",", ":")) + "\n")
-                f.flush()
-        except OSError:  # pragma: no cover — evidence is best-effort
-            pass
+        self._ledger_sink.append(entry)
 
     def _maybe_dump_counts(self) -> None:
-        if self.counts_file is None:
-            return
-        now = time.time()
-        if now - self._last_counts_dump < 2.0:
-            return
-        self._last_counts_dump = now
-        try:
-            payload = json.dumps({"member": self.member_id, **self.counts})
-            tmp = f"{self.counts_file}.tmp"
-            with open(tmp, "w", encoding="utf-8") as f:
-                f.write(payload)
-            os.replace(tmp, self.counts_file)
-        except OSError:  # pragma: no cover — evidence is best-effort
-            pass
+        self._counts_snap.maybe_dump(self.counts)
 
 
 def maybe_install_from_env(member_id: str = "",

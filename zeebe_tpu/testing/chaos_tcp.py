@@ -46,13 +46,16 @@ import dataclasses
 import heapq
 import logging
 import os
-import random
 import threading
 import time
-import zlib
 from typing import Any
 
 from zeebe_tpu.testing.chaos import FaultPlan
+from zeebe_tpu.testing.chaos_common import (
+    CountsSnapshot,
+    member_rng,
+    parse_spec_fields,
+)
 
 logger = logging.getLogger("zeebe_tpu.testing.chaos_tcp")
 
@@ -102,23 +105,19 @@ def parse_spec(spec: str) -> tuple[FaultPlan, list[LinkWindow], int]:
             windows.append(LinkWindow(a.strip(), b.strip() or "*",
                                       int(start), int(end)))
             continue
-        for field in section.split(","):
-            key, _, value = field.partition("=")
-            key = key.strip()
-            if key == "seed":
-                plan.seed = int(value)
-            elif key == "drop":
-                plan.drop_p = float(value)
-            elif key == "dup":
-                plan.duplicate_p = float(value)
-            elif key == "delay":
-                plan.delay_p = float(value)
-            elif key == "reorder":
-                plan.reorder_p = float(value)
-            elif key == "max_delay_ticks":
-                plan.max_delay_ticks = int(value)
-            elif key == "tick_ms":
-                tick_ms = int(value)
+        tick_box: list[int] = []
+        parse_spec_fields(section, {
+            "seed": lambda v: setattr(plan, "seed", int(v)),
+            "drop": lambda v: setattr(plan, "drop_p", float(v)),
+            "dup": lambda v: setattr(plan, "duplicate_p", float(v)),
+            "delay": lambda v: setattr(plan, "delay_p", float(v)),
+            "reorder": lambda v: setattr(plan, "reorder_p", float(v)),
+            "max_delay_ticks": lambda v: setattr(plan, "max_delay_ticks",
+                                                 int(v)),
+            "tick_ms": lambda v: tick_box.append(int(v)),
+        })
+        if tick_box:
+            tick_ms = tick_box[-1]
     return plan, windows, tick_ms
 
 
@@ -136,8 +135,7 @@ class ChaosTcpMessagingService:
         self.tick_ms = max(tick_ms, 1)
         # per-member stream: same seed ⇒ same decisions for the same send
         # sequence, but member A and member B never mirror each other
-        self.rng = random.Random(
-            plan.seed ^ zlib.crc32(inner.member_id.encode("utf-8")))
+        self.rng = member_rng(plan.seed, inner.member_id)
         self.counts = {
             "sent": 0, "dropped": 0, "duplicated": 0, "delayed": 0,
             "reordered": 0, "link_blocked": 0,
@@ -152,8 +150,7 @@ class ChaosTcpMessagingService:
         self._reorder_max_hold_s = 0.25
         # periodic counts evidence for the consistency report: a SIGKILLed
         # worker loses at most one dump interval of observations
-        self.counts_file = None
-        self._last_counts_dump = 0.0
+        self._counts_snap = CountsSnapshot(inner.member_id)
         # dynamically-reloaded windows (the chaos controller writes the
         # file once the fleet is actually up): mtime-checked, throttled
         self.windows_file = None
@@ -311,27 +308,19 @@ class ChaosTcpMessagingService:
             if not held:
                 del self._reorder_held[member_id]
 
+    @property
+    def counts_file(self):
+        return self._counts_snap.counts_file
+
+    @counts_file.setter
+    def counts_file(self, value) -> None:
+        self._counts_snap.counts_file = value
+
     def _maybe_dump_counts(self) -> None:
         """Throttled counts snapshot to ``counts_file`` (set by the worker
         entry): the consistency report aggregates these as OBSERVED fault
         evidence — configured-but-never-applied chaos must be visible."""
-        if self.counts_file is None:
-            return
-        now = time.time()
-        if now - self._last_counts_dump < 2.0:
-            return
-        self._last_counts_dump = now
-        try:
-            import json
-
-            payload = json.dumps({"member": self.inner.member_id,
-                                  **self.counts})
-            tmp = f"{self.counts_file}.tmp"
-            with open(tmp, "w", encoding="utf-8") as f:
-                f.write(payload)
-            os.replace(tmp, self.counts_file)
-        except OSError:  # pragma: no cover — evidence is best-effort
-            pass
+        self._counts_snap.maybe_dump(self.counts)
 
 
 class ZombiePeer:

@@ -128,6 +128,49 @@ class ClientOp:
         return dataclasses.asdict(self)
 
 
+def submit_client_op(runtime, partition: int, kind: str, record, *,
+                     history: list, history_lock, op_seq: list, clock_ms,
+                     timeout_s: float) -> ClientOp:
+    """One sequential-driver client request against the multi-process
+    runtime, recorded with its routing evidence — the shared submit half
+    of the consistency / torture / device-chaos harness drivers."""
+    with history_lock:
+        op_seq[0] += 1
+        op = ClientOp(index=op_seq[0], partition=partition, kind=kind,
+                      submit_ms=clock_ms())
+    meta: dict = {}
+    try:
+        result = runtime.submit(partition, record, timeout_s=timeout_s,
+                                meta=meta)
+        op.outcome = "rejected" if result.is_rejection else "ack"
+        if result.is_rejection:
+            op.rejection = result.rejection_type.name
+    except Exception as exc:  # noqa: BLE001 — typed below
+        from zeebe_tpu.gateway.broker_client import (
+            DeadlineExceededError,
+            NoLeaderError,
+            ResourceExhaustedError,
+        )
+
+        op.outcome = (
+            "backpressure" if isinstance(exc, ResourceExhaustedError)
+            else "deadline" if isinstance(exc, DeadlineExceededError)
+            else "no-leader" if isinstance(exc, NoLeaderError)
+            else "error")
+        if op.outcome == "error":
+            op.rejection = repr(exc)[:200]
+    op.done_ms = clock_ms()
+    op.request_id = meta.get("requestId", -1)
+    op.position = meta.get("commandPosition", -1)
+    op.worker = meta.get("worker")
+    op.resends = meta.get("resends", 0)
+    op.reroutes = meta.get("reroutes", 0)
+    op.dedupe = meta.get("dedupe")
+    with history_lock:
+        history.append(op)
+    return op
+
+
 def check_consistency(history: list[ClientOp],
                       logs: dict[int, list[dict]],
                       exports: dict[int, dict[int, dict]] | None = None,
@@ -453,48 +496,11 @@ def run_consistency(cfg: ConsistencyConfig, directory: str | Path) -> dict:
     def clock_ms() -> float:
         return time.time() * 1000.0 - epoch_ms
 
-    def record_op(op: ClientOp) -> None:
-        with history_lock:
-            history.append(op)
-
     def submit_op(partition: int, kind: str, record) -> ClientOp:
-        with history_lock:
-            op_seq[0] += 1
-            op = ClientOp(index=op_seq[0], partition=partition, kind=kind,
-                          submit_ms=clock_ms())
-        meta: dict = {}
-        try:
-            result = runtime.submit(partition, record,
-                                    timeout_s=cfg.request_timeout_s,
-                                    meta=meta)
-            op.outcome = "rejected" if result.is_rejection else "ack"
-            if result.is_rejection:
-                op.rejection = result.rejection_type.name
-        except Exception as exc:  # noqa: BLE001 — typed below
-            from zeebe_tpu.gateway.broker_client import (
-                DeadlineExceededError,
-                NoLeaderError,
-                ResourceExhaustedError,
-            )
-
-            if isinstance(exc, ResourceExhaustedError):
-                op.outcome = "backpressure"
-            elif isinstance(exc, DeadlineExceededError):
-                op.outcome = "deadline"
-            elif isinstance(exc, NoLeaderError):
-                op.outcome = "no-leader"
-            else:
-                op.outcome = "error"
-                op.rejection = repr(exc)[:200]
-        op.done_ms = clock_ms()
-        op.request_id = meta.get("requestId", -1)
-        op.position = meta.get("commandPosition", -1)
-        op.worker = meta.get("worker")
-        op.resends = meta.get("resends", 0)
-        op.reroutes = meta.get("reroutes", 0)
-        op.dedupe = meta.get("dedupe")
-        record_op(op)
-        return op
+        return submit_client_op(
+            runtime, partition, kind, record, history=history,
+            history_lock=history_lock, op_seq=op_seq, clock_ms=clock_ms,
+            timeout_s=cfg.request_timeout_s)
 
     model = (Bpmn.create_executable_process("consist")
              .start_event("s").end_event("e").done())

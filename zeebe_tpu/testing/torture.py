@@ -54,6 +54,7 @@ from zeebe_tpu.testing.consistency import (
     _await_exports,
     check_consistency,
     collect_exports,
+    submit_client_op,
 )
 
 logger = logging.getLogger("zeebe_tpu.testing.torture")
@@ -754,42 +755,10 @@ def run_torture(cfg: TortureConfig, directory: str | Path) -> dict:
         return time.time() * 1000.0 - epoch_ms
 
     def submit_op(partition: int, kind: str, record) -> ClientOp:
-        with history_lock:
-            op_seq[0] += 1
-            op = ClientOp(index=op_seq[0], partition=partition, kind=kind,
-                          submit_ms=clock_ms())
-        meta: dict = {}
-        try:
-            result = runtime.submit(partition, record,
-                                    timeout_s=cfg.request_timeout_s,
-                                    meta=meta)
-            op.outcome = "rejected" if result.is_rejection else "ack"
-            if result.is_rejection:
-                op.rejection = result.rejection_type.name
-        except Exception as exc:  # noqa: BLE001 — typed below
-            from zeebe_tpu.gateway.broker_client import (
-                DeadlineExceededError,
-                NoLeaderError,
-                ResourceExhaustedError,
-            )
-
-            op.outcome = (
-                "backpressure" if isinstance(exc, ResourceExhaustedError)
-                else "deadline" if isinstance(exc, DeadlineExceededError)
-                else "no-leader" if isinstance(exc, NoLeaderError)
-                else "error")
-            if op.outcome == "error":
-                op.rejection = repr(exc)[:200]
-        op.done_ms = clock_ms()
-        op.request_id = meta.get("requestId", -1)
-        op.position = meta.get("commandPosition", -1)
-        op.worker = meta.get("worker")
-        op.resends = meta.get("resends", 0)
-        op.reroutes = meta.get("reroutes", 0)
-        op.dedupe = meta.get("dedupe")
-        with history_lock:
-            history.append(op)
-        return op
+        return submit_client_op(
+            runtime, partition, kind, record, history=history,
+            history_lock=history_lock, op_seq=op_seq, clock_ms=clock_ms,
+            timeout_s=cfg.request_timeout_s)
 
     # workload: plain creates plus message-wait instances that PARK (the
     # tiering path spills them → the cold tier becomes a live bit-rot
@@ -1137,21 +1106,11 @@ def _corruption_repair_probe(runtime, directory: Path,
 
 
 def main(argv: list[str] | None = None) -> int:  # pragma: no cover — manual
-    import argparse
-    import tempfile
+    from zeebe_tpu.testing.serving import gate_cli_main
 
-    parser = argparse.ArgumentParser(prog="zeebe-tpu-torture")
-    parser.add_argument("--seed", type=int, default=0)
-    parser.add_argument("--quick", action="store_true")
-    args = parser.parse_args(argv)
-    cfg = TortureConfig(seed=args.seed)
-    if not args.quick:
-        cfg.drive_seconds = 90.0
-        cfg.kills = 3
-    with tempfile.TemporaryDirectory(prefix="zeebe-torture-") as tmp:
-        report = run_torture(cfg, tmp)
-    json.dump(report, sys.stdout, indent=2)
-    return 1 if report["violations"] else 0
+    return gate_cli_main(
+        "zeebe-tpu-torture", TortureConfig(),
+        TortureConfig(drive_seconds=90.0, kills=3), run_torture, argv)
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -108,6 +108,19 @@ class BackendRouter:
 
     # -- routing --------------------------------------------------------------
 
+    def accel_device(self):
+        """The measured accelerator (None when routing is disabled — the
+        process default backend already is the host). Quarantine canaries
+        pin their dispatch here instead of asking :meth:`choose`: while
+        QUARANTINED the kernel-routing controller holds
+        ``route_threshold_s`` host-ward, and a canary the router quietly
+        re-routes to the host would byte-match the host oracle by
+        construction — re-proving the host, not the suspect device."""
+        with self._lock:
+            if not self._measured:
+                self._measure()
+            return self._accel if self.enabled else None
+
     def choose(self, bucket: Any):
         """Device for this group (None = process default device)."""
         with self._lock:
