@@ -1096,6 +1096,8 @@ def run_kernel_ceiling(num_instances: int = 1 << 20, rounds: int = 5) -> dict:
 
 # resolved by _ensure_backend(); "cpu" until probed
 _PLATFORM = "cpu"
+# real (non-CPU) device count from the killable probe; 0 until/unless probed
+_REAL_DEVICES = 0
 
 # XLA:CPU logs a multi-kilobyte machine-feature-mismatch warning every time
 # it loads a persistent-cache executable compiled under a different feature
@@ -1218,6 +1220,9 @@ def _ensure_backend() -> str:
         _PLATFORM = "cpu-fallback(tpu-unreachable)"
         return _PLATFORM
     _PLATFORM = probed[0]
+    if not _PLATFORM.startswith("cpu"):
+        global _REAL_DEVICES
+        _REAL_DEVICES = probed[1]
     return _PLATFORM
 
 
@@ -1424,6 +1429,20 @@ def _quick_main(platform: str, trace: bool = False,
         "e2e_mixed_8_definitions": e2e_mixed,
         "adversarial_cold_templates": adversarial,
     }, quick=True)
+    # ROADMAP item 1 honesty: every quick run carries a typed multichip
+    # verdict instead of silently emitting nothing (skippable for tight
+    # inner loops; the probe itself never fails the bench)
+    multichip = None
+    if not os.environ.get("ZEEBE_SKIP_MULTICHIP_PROBE"):
+        try:
+            probe_out = run_multichip_probe(platform)
+            multichip = {"outcome": probe_out["outcome"],
+                         "verdict": probe_out["verdict"],
+                         "full_results": "MULTICHIP_probe.json"}
+        except Exception as exc:  # noqa: BLE001 — a probe crash is itself
+            # a verdict, not a bench failure
+            multichip = {"outcome": "probe-error",
+                         "verdict": f"{type(exc).__name__}: {exc}"}
     value = e2e_one_task["transitions_per_sec"]
     full = {
         "metric": "e2e_process_instance_transitions_per_sec_per_chip",
@@ -1440,6 +1459,7 @@ def _quick_main(platform: str, trace: bool = False,
             "pipeline_stages": _pipeline_stage_summary(),
             "platform": platform,
             "probe_attempts": _PROBE_LOG,
+            **({"multichip_probe": multichip} if multichip else {}),
             "xla_spam": dict(_XLA_SPAM),
             **({"tracing": _tracing_extra()} if trace else {}),
             **({"timeseries": _timeseries_extra()} if sample_metrics else {}),
@@ -2004,12 +2024,158 @@ def _mesh_main(counts_spec: str, gate: bool, platform: str) -> None:
             raise SystemExit(1)
 
 
+# ---------------------------------------------------------------------------
+# multichip honesty probe (ISSUE 17 satellite / ROADMAP item 1)
+
+
+def _counter_total(name: str) -> float:
+    from zeebe_tpu.utils.metrics import REGISTRY
+
+    fam = REGISTRY._metrics.get(name)
+    if fam is None:
+        return 0.0
+    return float(sum(child.value for child in fam._children.values()))
+
+
+def _measure_mesh_seam_coverage() -> dict:
+    """Drive a few instances through a mesh-runner-backed kernel backend with
+    shadow sampling forced to 100% and MEASURE whether any mesh dispatch was
+    shadow-verified. ROADMAP item 1 says the mesh runner bypasses the
+    begin_group/finish_group commit seam (no shadow verification, no
+    watchdog, no health ladder); this turns that claim into a counter delta
+    the verdict can cite instead of an assumption."""
+    from zeebe_tpu.models.bpmn import Bpmn as _Bpmn
+    from zeebe_tpu.parallel.mesh import make_mesh
+    from zeebe_tpu.parallel.mesh_runner import MeshKernelRunner
+    from zeebe_tpu.testing import EngineHarness
+
+    runner = MeshKernelRunner(mesh=make_mesh(1))
+    h = EngineHarness(use_kernel_backend=True, mesh_runner=runner)
+    cfg = h.kernel_backend.health.cfg
+    saved_rate = cfg.shadow_sample_rate
+    cfg.shadow_sample_rate = 1.0
+    checks0 = _counter_total("zeebe_device_shadow_checks_total")
+    try:
+        h.deploy(
+            _Bpmn.create_executable_process("mc_probe")
+            .start_event("s").service_task("t", job_type="w")
+            .end_event("e").done()
+        )
+        for _ in range(4):
+            h.create_instance("mc_probe")
+        for job in h.activate_jobs("w", max_jobs=8):
+            h.complete_job(job["key"], None)
+    finally:
+        cfg.shadow_sample_rate = saved_rate
+        h.close()
+    shadow_delta = _counter_total("zeebe_device_shadow_checks_total") - checks0
+    return {
+        "mesh_dispatches": runner.dispatches,
+        "shadow_checks_at_100pct_sampling": shadow_delta,
+        "covered": runner.dispatches > 0 and shadow_delta > 0,
+    }
+
+
+def run_multichip_probe(platform: str) -> dict:
+    """ROADMAP item 1 asks for "a first nonzero MULTICHIP sample … or an
+    honest probe verdict explaining why not" — this is the honest probe.
+
+    It ATTEMPTS a minimal 2-shard mesh dispatch (the ``__graft_entry__``
+    re-execed child: real devices when a probed pair exists, else the
+    virtual 2-device cpu mesh as sharding-correctness evidence), measures
+    whether mesh dispatch is covered by the commit seam's shadow
+    verification, and writes a TYPED verdict to MULTICHIP_probe.json.
+    ``outcome`` is ``"ran"`` only when the sample would honestly count
+    (>= 2 real non-CPU devices AND seam coverage); otherwise the precise
+    why-not — never silence.
+    """
+    import io
+    from contextlib import redirect_stdout
+
+    import __graft_entry__ as graft
+
+    # the killable probe's count, never an in-process jax.devices() (which
+    # can hang forever on a wedged tunnel — device-call-discipline)
+    real = 0 if platform.startswith("cpu") else _REAL_DEVICES
+
+    dispatch = {
+        "attempted": True,
+        "n_shards": 2,
+        "mode": "real devices" if real >= 2 else "virtual cpu mesh",
+    }
+    buf = io.StringIO()
+    t0 = time.perf_counter()
+    try:
+        with redirect_stdout(buf):
+            graft.dryrun_multichip(2, real_devices=real)
+        dispatch["ok"] = True
+        dispatch["error"] = None
+    except Exception as exc:  # noqa: BLE001 — the verdict carries it
+        dispatch["ok"] = False
+        dispatch["error"] = f"{type(exc).__name__}: {exc}"
+    dispatch["elapsed_s"] = round(time.perf_counter() - t0, 1)
+    dispatch["tail"] = buf.getvalue()[-400:]
+
+    try:
+        seam = _measure_mesh_seam_coverage()
+    except Exception as exc:  # noqa: BLE001 — a broken measurement is a
+        # why-not datum, not a probe crash
+        seam = {"error": f"{type(exc).__name__}: {exc}", "covered": False}
+
+    evidence = ("2-shard dispatch on the virtual cpu mesh "
+                + ("completed — sharding-correctness evidence, not a "
+                   "multichip sample" if dispatch["ok"]
+                   else f"FAILED ({dispatch['error']})"))
+    if real == 0:
+        outcome = "why-not:platform"
+        verdict = (f"no real accelerator answered (platform={platform}); "
+                   + evidence)
+    elif real < 2:
+        outcome = "why-not:device-count"
+        verdict = (f"only {real} real device(s) — a 2-shard mesh needs a "
+                   f"pair; " + evidence)
+    elif not seam.get("covered"):
+        outcome = "why-not:mesh-bypasses-seam"
+        verdict = (
+            "a real device pair exists, but the mesh runner bypasses the "
+            "begin_group/finish_group commit seam "
+            f"({seam.get('shadow_checks_at_100pct_sampling', 0):.0f} shadow "
+            f"checks at 100% sampling over "
+            f"{seam.get('mesh_dispatches', 0)} mesh dispatches) — an "
+            "unhardened sample would not honestly count (ROADMAP item 1: "
+            "route mesh dispatch through the seam first)")
+    elif not dispatch["ok"]:
+        outcome = "why-not:dispatch-failed"
+        verdict = f"2-shard real-device dispatch failed: {dispatch['error']}"
+    else:
+        outcome = "ran"
+        verdict = ("first nonzero MULTICHIP sample: 2-shard mesh dispatch "
+                   "OK with commit-seam shadow coverage")
+
+    out = {
+        "probe": "multichip-honesty",
+        "platform": platform,
+        "real_devices": real,
+        "dispatch": dispatch,
+        "seam": seam,
+        "outcome": outcome,
+        "verdict": verdict,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "MULTICHIP_probe.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(f"multichip probe: {outcome} — {verdict}", file=sys.stderr)
+    return out
+
+
 def main(quick: bool = False, trace: bool = False,
          sample_metrics: bool = False, profile: bool = False,
          soak: bool = False, scale_soak: bool = False,
          consistency: bool = False, serving: bool = False,
          autotune: bool = False, torture: bool = False,
-         device_chaos: bool = False) -> None:
+         device_chaos: bool = False, multichip_probe: bool = False) -> None:
     # install the filter BEFORE any backend use: the mismatch warning fires
     # whenever a persistent-cache executable loads, including the probe's
     # subprocess (which inherits the filtered fd 2)
@@ -2036,6 +2202,9 @@ def main(quick: bool = False, trace: bool = False,
         _device_chaos_main(quick)
         return
     platform = _ensure_backend()
+    if multichip_probe:
+        run_multichip_probe(platform)
+        return
     if soak:
         _soak_main(quick)
         return
@@ -2287,6 +2456,14 @@ if __name__ == "__main__":
                          "before commit, and >=1 full SUSPECT->QUARANTINED"
                          "->canary->HEALTHY ladder cycle. Writes "
                          "DEVICE_CHAOS[_quick].json")
+    ap.add_argument("--multichip-probe", action="store_true",
+                    help="multichip honesty probe (ROADMAP item 1): attempt "
+                         "a minimal 2-shard mesh dispatch and write a TYPED "
+                         "verdict (ran / why-not: platform, device count, "
+                         "mesh-bypasses-seam) to MULTICHIP_probe.json "
+                         "instead of silently emitting nothing; also runs "
+                         "inside --quick unless ZEEBE_SKIP_MULTICHIP_PROBE "
+                         "is set")
     ap.add_argument("--mesh-worker-spec", help=argparse.SUPPRESS)
     _args = ap.parse_args()
     if _args.mesh_worker_spec:
@@ -2304,4 +2481,5 @@ if __name__ == "__main__":
              soak=_args.soak, scale_soak=_args.scale_soak,
              consistency=_args.consistency, serving=_args.serving,
              autotune=_args.autotune, torture=_args.torture,
-             device_chaos=_args.device_chaos)
+             device_chaos=_args.device_chaos,
+             multichip_probe=_args.multichip_probe)

@@ -12,11 +12,13 @@ path (committed log → stream processor → kernel + burst templates → events
 appended), measured best-of-3. Best-of-N is the JMH-fork analogue for a
 noisy shared box: interference only ever slows a run down, so the fastest
 run is the least-contended estimate. The floors are set well below current
-steady-state numbers (≈35-50% of them) but above the worst regression we
+steady-state numbers (≈45-60% of them) but above the worst regression we
 ever shipped — a return to round-3 throughput still fails.
 
-Floors (transitions/s, CPU, 1 vCPU CI box; current best-of-3 ≈ 68-74k
-one_task, ≈ 200k+ exclusive_chain as of round 4):
+Floors (transitions/s, CPU, 1 vCPU CI box; re-anchored for the ISSUE 17
+pipelined pump — burst best-of-3 ≈ 51-61k one_task, ≈ 213-221k
+exclusive_chain, ≈ 43-48k mixed_8; full-bench one_task moved 62.5k → 86.6k
+box-locally with cross-wave speculation + the native frame fast path):
 """
 
 from __future__ import annotations
@@ -34,11 +36,12 @@ import bench  # noqa: E402
 
 # transitions/s floors. one_task's round-3 driver value was 47,720 — the
 # regression this gate exists to catch. exclusive_chain gates the
-# routing-only (no job drive) path.
+# routing-only (no job drive) path. Raised with ISSUE 17 (pipelined pump):
+# losing the speculation/native-codec gains entirely now fails the gate.
 FLOORS = {
-    "one_task": 30_000.0,
-    "exclusive_chain": 80_000.0,
-    "mixed_8": 18_000.0,
+    "one_task": 35_000.0,
+    "exclusive_chain": 100_000.0,
+    "mixed_8": 24_000.0,
 }
 RUNS = 3
 

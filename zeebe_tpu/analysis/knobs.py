@@ -139,6 +139,11 @@ KNOB_NOTES: dict[str, str] = {
         "commands arriving within it append as ONE raft batch (one fsync, "
         "one replication round). 0 (default) = append per command; the "
         "ingress-coalescing controller's knob"),
+    "ZEEBE_BROKER_PIPELINE_SPECULATION": (
+        "cross-wave double-buffered dispatch: admit wave k+1 and dispatch "
+        "its first device chunk inside wave k's transaction so the chunk "
+        "computes under wave k's append/commit/fsync tail (default on; "
+        "0/false/off disables)"),
     "ZEEBE_BROKER_PROCESSING_MAXCOMMANDSINBATCH": (
         "commands processed per batch transaction (default 100)"),
     "ZEEBE_BROKER_PROFILING_HZ": (

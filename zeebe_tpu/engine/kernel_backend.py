@@ -1334,18 +1334,19 @@ class KernelBackend:
     # -- admission ----------------------------------------------------------
 
     def _admit(self, cmd, instances: dict[int, _Inst],
-               admitted_pis: set[int]) -> _Admitted | None:
+               admitted_pis: set[int], wave: dict) -> _Admitted | None:
         record = cmd.record
         kind = (record.value_type, int(record.intent))
         if kind == (ValueType.PROCESS_INSTANCE_CREATION, int(ProcessInstanceCreationIntent.CREATE)):
-            adm = self._admit_creation(cmd, instances)
+            adm = self._admit_creation(cmd, instances, wave)
         elif kind == (ValueType.JOB, int(JobIntent.COMPLETE)):
-            adm = self._admit_job_complete(cmd, instances, admitted_pis)
+            adm = self._admit_job_complete(cmd, instances, admitted_pis, wave)
         elif kind == (ValueType.TIMER, int(TimerIntent.TRIGGER)):
-            adm = self._admit_timer_trigger(cmd, instances, admitted_pis)
+            adm = self._admit_timer_trigger(cmd, instances, admitted_pis, wave)
         elif kind == (ValueType.PROCESS_MESSAGE_SUBSCRIPTION,
                       int(ProcessMessageSubscriptionIntent.CORRELATE)):
-            adm = self._admit_message_correlate(cmd, instances, admitted_pis)
+            adm = self._admit_message_correlate(cmd, instances, admitted_pis,
+                                                wave)
         else:
             return None
         if adm is not None and self.use_templates and adm.templatable:
@@ -1357,7 +1358,50 @@ class KernelBackend:
             adm.fp_docs = None
         return adm
 
-    def _admit_creation(self, cmd, instances) -> _Admitted | None:
+    # wave-context sentinel: distinguishes a memoized None from a cache miss
+    _WAVE_MISS = object()
+
+    def _wave_def_info(self, wave: dict, def_key: int) -> "_DefInfo | None":
+        """Per-wave memo of registry lookup + segment freshness — the
+        vectorized admission prevalidation (ISSUE 17): a wave of commands
+        against one definition pays the eligibility lookup and the inlined-
+        segment staleness probe once, not once per head. None is memoized
+        too (a stale-segment definition declines for the whole wave; the
+        refresh `_segments_fresh` triggers readmits it next wave)."""
+        hit = wave.get(def_key, self._WAVE_MISS)
+        if hit is not self._WAVE_MISS:
+            return hit
+        state = self.engine.state
+        info = self.registry.lookup(def_key, state.processes.executable(def_key),
+                                    processes=state.processes)
+        if info is not None and not self._segments_fresh(info):
+            info = None
+        wave[def_key] = info
+        return info
+
+    def _condition_slots_cached(self, wave: dict, info: "_DefInfo",
+                                merged: dict) -> dict[str, tuple] | None:
+        """``_condition_slots`` with a per-wave memo keyed by the condition
+        variables' VALUES: instances that agree on every device-read
+        variable (the common wave shape — identical creation variables, or
+        resumes whose root scopes converged) share one slot-plane
+        computation. Unhashable values fall through to the direct path."""
+        names = self.registry.tables.cond_vars_by_def[info.index]
+        if not names:
+            return {}
+        try:
+            key = ("slots", info.index,
+                   tuple(merged.get(n) for n in names))
+            hit = wave.get(key, self._WAVE_MISS)
+        except TypeError:
+            return self._condition_slots(info, merged)
+        if hit is not self._WAVE_MISS:
+            return hit
+        slots = self._condition_slots(info, merged)
+        wave[key] = slots
+        return slots
+
+    def _admit_creation(self, cmd, instances, wave: dict) -> _Admitted | None:
         state = self.engine.state
         value = cmd.record.value
         if value.get("startInstructions"):
@@ -1382,16 +1426,18 @@ class KernelBackend:
             key = state.processes.get_key_by_id_version(bpmn_process_id, version)
             meta = None if key is None else state.processes.get_by_key(key)
         else:
-            meta = state.processes.get_latest_by_id(bpmn_process_id)
+            meta = wave.get(("latest", bpmn_process_id), self._WAVE_MISS)
+            if meta is self._WAVE_MISS:
+                meta = state.processes.get_latest_by_id(bpmn_process_id)
+                wave[("latest", bpmn_process_id)] = meta
         if meta is None or meta.get("deleted"):
             return None  # sequential path writes the NOT_FOUND rejection
         def_key = meta["processDefinitionKey"]
-        info = self.registry.lookup(def_key, state.processes.executable(def_key),
-                                    processes=state.processes)
-        if info is None or not self._segments_fresh(info):
+        info = self._wave_def_info(wave, def_key)
+        if info is None:
             return None
         variables = value.get("variables") or {}
-        slots = self._condition_slots(info, variables)
+        slots = self._condition_slots_cached(wave, info, variables)
         if slots is None:
             # a condition could read a variable whose runtime type the device
             # slot kind cannot represent: host and device would disagree
@@ -1459,7 +1505,8 @@ class KernelBackend:
                 return False
         return True
 
-    def _reconstruct(self, pi_key: int, info: _DefInfo, resume_key: int):
+    def _reconstruct(self, pi_key: int, info: _DefInfo, resume_key: int,
+                     root=None):
         """Rebuild a running instance's device tokens from element-instance
         state. Every live element instance must be parked in a kernel wait
         state (task on a job, catch on a timer/subscription, or a sub-process
@@ -1471,7 +1518,8 @@ class KernelBackend:
         (0 → the process instance), join_counts maps join gateway element
         idx → unconsumed arrivals."""
         state = self.engine.state
-        root = state.element_instances.get(pi_key)
+        if root is None:
+            root = state.element_instances.get(pi_key)
         from zeebe_tpu.engine.engine_state import EI_ACTIVATED
 
         if root is None or root["state"] != EI_ACTIVATED:
@@ -1756,7 +1804,7 @@ class KernelBackend:
     def _admit_resume(self, cmd, instances, admitted_pis: set[int],
                       pi_key: int, resume_key: int,
                       kind: str, head_docs: list, extra_variables: dict | None,
-                      require_op: int) -> _Admitted | None:
+                      require_op: int, wave: dict) -> _Admitted | None:
         """Shared admission for resume commands (job complete, timer trigger,
         message correlate). A command whose instance is a call-activity child
         first tries the TOP ancestor instance — when the caller's definition
@@ -1781,19 +1829,20 @@ class KernelBackend:
         if top_pi != pi_key:
             adm = self._admit_resume_at(
                 cmd, instances, admitted_pis, top_pi, top_meta, resume_key,
-                kind, head_docs, extra_variables, require_op,
+                kind, head_docs, extra_variables, require_op, wave,
                 require_segments=True)
             if adm is not None:
                 return adm
         return self._admit_resume_at(
             cmd, instances, admitted_pis, pi_key, root_meta, resume_key,
-            kind, head_docs, extra_variables, require_op,
+            kind, head_docs, extra_variables, require_op, wave,
             extra_family=ancestors)
 
     def _admit_resume_at(self, cmd, instances, admitted_pis: set[int],
                          pi_key: int, root_meta, resume_key: int,
                          kind: str, head_docs: list,
                          extra_variables: dict | None, require_op: int,
+                         wave: dict,
                          require_segments: bool = False,
                          extra_family: list | None = None,
                          ) -> _Admitted | None:
@@ -1805,8 +1854,7 @@ class KernelBackend:
             # end (the kernel's value builders emit default-tenant shapes)
             return None
         def_key = root_meta["value"].get("processDefinitionKey", -1)
-        info = self.registry.lookup(def_key, state.processes.executable(def_key),
-                                    processes=state.processes)
+        info = self._wave_def_info(wave, def_key)
         if info is None:
             return None
         if require_segments and not info.segments:
@@ -1814,9 +1862,7 @@ class KernelBackend:
             # inlines its call activities — otherwise the call element is a
             # host escape and reconstruction would decline at it anyway
             return None
-        if not self._segments_fresh(info):
-            return None
-        rebuilt = self._reconstruct(pi_key, info, resume_key)
+        rebuilt = self._reconstruct(pi_key, info, resume_key, root_meta)
         if rebuilt is None:
             return None
         (tokens, resume, root, wait_docs, wait_keys, scope_keys,
@@ -1851,7 +1897,7 @@ class KernelBackend:
             return None
         merged = state.variables.collect(pi_key)
         merged.update(extra_variables or {})
-        slots = self._condition_slots(info, merged)
+        slots = self._condition_slots_cached(wave, info, merged)
         if slots is None:
             return None
         mi_left: dict[int, int] = {}
@@ -1936,7 +1982,8 @@ class KernelBackend:
             wait_keys=wait_keys,
         )
 
-    def _admit_job_complete(self, cmd, instances, admitted_pis) -> _Admitted | None:
+    def _admit_job_complete(self, cmd, instances, admitted_pis,
+                            wave) -> _Admitted | None:
         state = self.engine.state
         job = state.jobs.get(cmd.record.key)
         if job is None:
@@ -1949,9 +1996,11 @@ class KernelBackend:
             head_docs=[job],
             extra_variables=cmd.record.value.get("variables"),
             require_op=K_TASK,
+            wave=wave,
         )
 
-    def _admit_timer_trigger(self, cmd, instances, admitted_pis) -> _Admitted | None:
+    def _admit_timer_trigger(self, cmd, instances, admitted_pis,
+                             wave) -> _Admitted | None:
         state = self.engine.state
         timer = state.timers.get(cmd.record.key)
         if timer is None:
@@ -1974,9 +2023,11 @@ class KernelBackend:
             head_docs=[timer],
             extra_variables=None,
             require_op=K_CATCH,
+            wave=wave,
         )
 
-    def _admit_message_correlate(self, cmd, instances, admitted_pis) -> _Admitted | None:
+    def _admit_message_correlate(self, cmd, instances, admitted_pis,
+                                 wave) -> _Admitted | None:
         state = self.engine.state
         value = cmd.record.value
         eik = value.get("elementInstanceKey", -1)
@@ -1994,6 +2045,7 @@ class KernelBackend:
             head_docs=[sub],
             extra_variables=value.get("variables"),
             require_op=K_CATCH,
+            wave=wave,
         )
 
     # -- device run ----------------------------------------------------------
@@ -2566,12 +2618,30 @@ class KernelBackend:
         itself and overlaps host work between them."""
         return self.finish_group(self.begin_group(cmds), make_builder)
 
-    def begin_group(self, cmds) -> _PendingGroup | None:
+    def begin_group(self, cmds, speculative: bool = False) -> _PendingGroup | None:
         """Admit a group and dispatch its first device chunk asynchronously.
         Returns None when the head command is not admittable (sequential
         traffic). Must run inside the partition's open db transaction, and
-        the same transaction must stay open through ``finish_group``."""
+        the same transaction must stay open through ``finish_group``.
+
+        ``speculative`` (ISSUE 17, cross-wave double buffering): the
+        processor is beginning wave k+1 inside wave k's still-open
+        transaction, right after wave k materialized — admission reads the
+        post-wave overlay, which is byte-identical to the committed state
+        the next round's transaction will open over. A speculative begin is
+        silent on decline (no fallback counters, no typed host notes, no
+        quarantine reroute accounting): the group may never be consumed, so
+        the NEXT round's authoritative scan owns all accounting. It also
+        never claims a canary slot — under quarantine the ladder's one-
+        probe-per-interval discipline belongs to the real scan."""
         import time as _time
+
+        if speculative and (self.mesh_runner is not None
+                            or self.health.is_quarantined()):
+            # mesh has its own submit pipeline; a quarantined device gets
+            # exactly the canary probes the health ladder schedules, never
+            # an extra speculative dispatch
+            return None
 
         # device health gating (ISSUE 15): while QUARANTINED every group is
         # host-routed (typed accounting) except the periodic canary — ONE
@@ -2599,11 +2669,16 @@ class KernelBackend:
         # keeps admission O(1) instead of O(group) per command
         admitted_pis: set[int] = set()
         admitted: list[_Admitted] = []
+        # per-wave admission memo (definition lookups, segment freshness,
+        # condition slot planes): admission runs inside one open transaction
+        # over state nothing mutates until materialization, so everything it
+        # derives from state alone is stable for the whole wave
+        wave: dict = {}
         head_cmd = None
         for cmd in cmds:
             if head_cmd is None:
                 head_cmd = cmd
-            adm = self._admit(cmd, instances, admitted_pis)
+            adm = self._admit(cmd, instances, admitted_pis, wave)
             if adm is None:
                 break
             instances[adm.inst.idx] = adm.inst
@@ -2619,6 +2694,10 @@ class KernelBackend:
                 # the next admittable group can probe immediately instead
                 # of waiting out an interval the device never saw
                 self.health.release_canary()
+            if speculative:
+                # nothing speculatively admittable — no accounting: the next
+                # round's real scan re-encounters this head and notes it once
+                return None
             if head_cmd is None:
                 # the candidate iterator was EMPTY — an end-of-log probe, not
                 # a fallback (ISSUE 7: these probes were counted as

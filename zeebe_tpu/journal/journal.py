@@ -27,6 +27,7 @@ can later be swapped for the C++ implementation without contract changes.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import struct
 import zlib
@@ -40,6 +41,8 @@ from zeebe_tpu.utils.metrics import REGISTRY as _REGISTRY
 # group-flush tracing (singleton mutated in place; one enabled-check per
 # flush when tracing is off)
 _TRACER = _get_tracer()
+
+logger = logging.getLogger("zeebe_tpu.journal")
 
 # journal metrics (reference names: journal/ JournalMetrics —
 # zeebe_journal_append_total, flush counts/latency); process-global because a
@@ -154,9 +157,20 @@ class JournalRecord:
     data: bytes
 
 
-def _checksum(index: int, asqn: int, data: bytes) -> int:
+def _py_checksum(index: int, asqn: int, data: bytes) -> int:
     head = struct.pack("<Qq", index, asqn)
     return zlib.crc32(data, zlib.crc32(head)) & 0xFFFFFFFF
+
+
+# native frame fast path (native/codec.c): _py_checksum above is the crc
+# specification (tests assert equality); journal_frame builds the complete
+# <IIQq>-framed record in one C pass — one allocation and one crc sweep per
+# append instead of two zlib calls, two struct packs, and a bytes concat
+from zeebe_tpu import native as _native  # noqa: E402  (cycle-free leaf package)
+
+_native_checksum = _native.codec_fn("journal_checksum")
+_native_frame = _native.codec_fn("journal_frame")
+_checksum = _native_checksum if _native_checksum is not None else _py_checksum
 
 
 class _Segment:
@@ -257,8 +271,15 @@ class _Segment:
         self.durable_size = offset
 
     def append(self, index: int, asqn: int, data: bytes) -> None:
-        frame = _FRAME.pack(len(data), _checksum(index, asqn, data), index, asqn)
-        self._pending.append(frame + data)
+        # data may be any bytes-like object (the prepatched burst path hands
+        # the writer's bytearray straight through); both paths below copy it
+        # into an immutable pending frame synchronously, so the caller's
+        # buffer is never aliased past this call
+        if _native_frame is not None:
+            self._pending.append(_native_frame(index, asqn, data))
+        else:
+            frame = _FRAME.pack(len(data), _checksum(index, asqn, data), index, asqn)
+            self._pending.append(frame + data)
         self._pending_bytes += _FRAME.size + len(data)
         if (index - self.first_index) % _SPARSE_EVERY == 0:
             self.sparse.append((index, self.size))
@@ -518,6 +539,15 @@ class SegmentedJournal:
         # SAFE (no compaction this pass). None = unguarded (standalone
         # journals: tests, raft-internal resets).
         self.compact_guard: "Callable[[], int] | None" = None
+        # async ack seam (ISSUE 17): called with the covered last index after
+        # EVERY successful fsync — the pump-tail cadence flush, the idle
+        # boundary, a backup barrier. Flush-gated consumers (the stream
+        # processor's deferred client replies) release acks from here instead
+        # of polling at the pump tail. Listeners are only ever invoked after
+        # the fsync returned, so an acked prefix is a durable prefix by
+        # construction; a failed fsync raises before this point and the
+        # listeners stay silent.
+        self.flush_listeners: list[Callable[[int], None]] = []
         self.segments: list[_Segment] = []
         # this journal's contribution to the global segment_count gauge —
         # updated by delta whenever the segment list changes, and returned
@@ -605,7 +635,11 @@ class SegmentedJournal:
     # -- write path ----------------------------------------------------------
 
     def append(self, data: bytes, asqn: int = ASQN_IGNORE) -> JournalRecord:
-        """Append one record; returns it with its assigned index.
+        """Append one record; returns it with its assigned index. ``data``
+        may be any contiguous bytes-like object — it is copied into the
+        segment's framed write buffer before this call returns, so passing a
+        mutable buffer (the prepatched burst path) is safe; the returned
+        record aliases the caller's object.
 
         Metric updates are amortized the way the reference's hot loops do:
         counts/bytes accumulate in plain ints and flush to the registry every
@@ -696,6 +730,15 @@ class SegmentedJournal:
                     listener(str(self.dir), elapsed)
                 except Exception:  # noqa: BLE001 — diagnostics must never
                     pass           # fail the durability path
+        # async ack callbacks: the fsync succeeded, so every appended byte is
+        # durable — release whatever was gated on this covering flush. Fired
+        # after all durability bookkeeping; listener failures must not
+        # invalidate the flush itself.
+        for listener in list(self.flush_listeners):
+            try:
+                listener(max(idx, 0))
+            except Exception:  # noqa: BLE001 — ack fan-out must never
+                logger.exception("journal flush listener failed (%s)", self.dir)
         if _TRACER.enabled:
             # group-flush span: the durability edge every gated ack waits on
             # (flushes are group-commit cadence, not per-append — cheap)

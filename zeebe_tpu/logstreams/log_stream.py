@@ -315,7 +315,11 @@ class LogStreamWriter:
             timestamp = stream.clock_millis()
             patch_prepatched_batch(buf, pos_offsets, ts_offsets,
                                    first_position, timestamp)
-            jrec = stream.journal.append(bytes(buf), asqn=first_position)
+            # the journal copies the buffer into its framed write buffer
+            # synchronously, so the bytearray goes straight through — no
+            # bytes() copy. Safe: every PreparedBurst buf is freshly built
+            # per instantiation and never mutated after this append.
+            jrec = stream.journal.append(buf, asqn=first_position)
             stream._on_appended(first_position, jrec.index)
             stream._next_position = first_position + count
             last = first_position + count - 1

@@ -611,6 +611,90 @@ done:
     return out;
 }
 
+static void wr_i64(uint8_t *p, int64_t v) { memcpy(p, &v, 8); }
+static void wr_i32(uint8_t *p, int32_t v) { memcpy(p, &v, 4); }
+
+/* encode_record_frame(record_type, value_type, intent, rejection_type,
+ *     key, source_position, timestamp, request_stream_id, request_id,
+ *     operation_reference, reason, value) -> (frame, value_body)
+ * One-pass encode mirror of decode_record_frame above. protocol/record.py
+ * Record.encode is the specification (tests assert byte-equality): fixed
+ * little-endian header, rejection reason truncated to u16 bytes on a
+ * codepoint boundary, u32 body length, msgpack body. The body bytes are
+ * returned separately so the append path can seed its decode cache
+ * without re-packing the value. */
+static PyObject *codec_encode_record_frame(PyObject *self, PyObject *args)
+{
+    int record_type, value_type, intent, rejection, request_stream_id;
+    long long key, source_pos, timestamp, request_id, operation_reference;
+    PyObject *reason_obj, *value;
+    if (!PyArg_ParseTuple(args, "iiiiLLLiLLUO",
+                          &record_type, &value_type, &intent, &rejection,
+                          &key, &source_pos, &timestamp, &request_stream_id,
+                          &request_id, &operation_reference,
+                          &reason_obj, &value))
+        return NULL;
+    if ((unsigned)record_type > 0xFF || (unsigned)value_type > 0xFF
+        || (unsigned)intent > 0xFF || (unsigned)rejection > 0xFF)
+        return codec_error("record header byte field out of range");
+    Py_ssize_t rlen;
+    const char *reason = PyUnicode_AsUTF8AndSize(reason_obj, &rlen);
+    if (!reason)
+        return NULL;
+    if (rlen > 0xFFFF) {
+        /* the wire field is u16; truncate on a codepoint boundary so an
+         * oversized error message can never poison the append path (same
+         * continuation/lead-byte walk as Record.encode) */
+        rlen = 0xFFFF;
+        while (rlen && ((unsigned char)reason[rlen - 1] & 0xC0) == 0x80)
+            rlen--;
+        if (rlen && (unsigned char)reason[rlen - 1] >= 0xC0)
+            rlen--;
+    }
+    uint8_t hdr[FRAME_HEADER_SIZE];
+    hdr[0] = (uint8_t)record_type;
+    hdr[1] = (uint8_t)value_type;
+    hdr[2] = (uint8_t)intent;
+    hdr[3] = (uint8_t)rejection;
+    wr_i64(hdr + 4, key);
+    wr_i64(hdr + 12, source_pos);
+    wr_i64(hdr + 20, timestamp);
+    wr_i32(hdr + 28, request_stream_id);
+    wr_i64(hdr + 32, request_id);
+    wr_i64(hdr + 40, operation_reference);
+    hdr[48] = (uint8_t)(rlen & 0xFF);
+    hdr[49] = (uint8_t)(rlen >> 8);
+    Writer w = {NULL, 0, 0};
+    static const uint8_t zero4[4] = {0, 0, 0, 0};
+    if (put(&w, hdr, FRAME_HEADER_SIZE) < 0 || put(&w, reason, rlen) < 0
+        || put(&w, zero4, 4) < 0)
+        goto fail;
+    Py_ssize_t body_off = w.len;
+    if (pack_obj(&w, value, 0) < 0)
+        goto fail;
+    Py_ssize_t body_len = w.len - body_off;
+    if (body_len > 0xFFFFFFFFLL) {
+        codec_error("record value too large: %zd bytes", body_len);
+        goto fail;
+    }
+    wr_i32(w.data + body_off - 4, (int32_t)(uint32_t)body_len);
+    {
+        PyObject *frame = PyBytes_FromStringAndSize((const char *)w.data, w.len);
+        PyObject *body = PyBytes_FromStringAndSize(
+            (const char *)w.data + body_off, body_len);
+        PyMem_Free(w.data);
+        if (!frame || !body) {
+            Py_XDECREF(frame);
+            Py_XDECREF(body);
+            return NULL;
+        }
+        return Py_BuildValue("(NN)", frame, body);
+    }
+fail:
+    PyMem_Free(w.data);
+    return NULL;
+}
+
 /* Sequenced-batch header scan (logstreams/log_stream.py framing):
  *   batch header:  u32 count | i64 sourcePosition | u64 timestamp
  *   per entry:     u8 processed | i64 position | u32 recordLen | frame
@@ -1527,6 +1611,7 @@ static PyObject *codec_apply_state_plan(PyObject *self, PyObject *args)
 /* -- durable-state base-segment indexing ---------------------------------- */
 
 static uint32_t crc32_tab[256];
+static uint32_t crc32_tab8[8][256]; /* slice-by-8 lanes; lane 0 == crc32_tab */
 static int crc32_ready = 0;
 
 static void crc32_build(void)
@@ -1536,16 +1621,41 @@ static void crc32_build(void)
         for (int k = 0; k < 8; k++)
             c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
         crc32_tab[i] = c;
+        crc32_tab8[0][i] = c;
     }
+    for (uint32_t i = 0; i < 256; i++)
+        for (int k = 1; k < 8; k++)
+            crc32_tab8[k][i] =
+                (crc32_tab8[k - 1][i] >> 8) ^ crc32_tab[crc32_tab8[k - 1][i] & 0xFF];
     crc32_ready = 1;
+}
+
+/* advance the RAW crc register (pre/post inversion is the caller's business)
+ * over n bytes — slice-by-8 body, bytewise tail. Little-endian word loads,
+ * the same host assumption the frame readers (rd_i64 &c.) already make. */
+static uint32_t crc32_update(uint32_t c, const unsigned char *p, Py_ssize_t n)
+{
+    while (n >= 8) {
+        uint32_t lo, hi;
+        memcpy(&lo, p, 4);
+        memcpy(&hi, p + 4, 4);
+        c ^= lo;
+        c = crc32_tab8[7][c & 0xFF] ^ crc32_tab8[6][(c >> 8) & 0xFF]
+            ^ crc32_tab8[5][(c >> 16) & 0xFF] ^ crc32_tab8[4][c >> 24]
+            ^ crc32_tab8[3][hi & 0xFF] ^ crc32_tab8[2][(hi >> 8) & 0xFF]
+            ^ crc32_tab8[1][(hi >> 16) & 0xFF] ^ crc32_tab8[0][hi >> 24];
+        p += 8;
+        n -= 8;
+    }
+    while (n--) {
+        c = crc32_tab[(c ^ *p++) & 0xFF] ^ (c >> 8);
+    }
+    return c;
 }
 
 static uint32_t crc32_buf(const unsigned char *p, Py_ssize_t n)
 {
-    uint32_t c = 0xFFFFFFFFu;
-    for (Py_ssize_t i = 0; i < n; i++)
-        c = crc32_tab[(c ^ p[i]) & 0xFF] ^ (c >> 8);
-    return c ^ 0xFFFFFFFFu;
+    return crc32_update(0xFFFFFFFFu, p, n) ^ 0xFFFFFFFFu;
 }
 
 /* index_base_segment(view, data) -> [keys in file order]
@@ -1726,6 +1836,76 @@ static PyObject *codec_encode_key(PyObject *self, PyObject *args)
     return out;
 }
 
+/* -- journal frame fast path ---------------------------------------------- */
+
+/* journal/journal.py _checksum is the specification: one continuous crc32
+ * register over pack("<Qq", index, asqn) then the payload — the exact
+ * zlib.crc32(data, zlib.crc32(head)) continuation semantics. */
+static uint32_t journal_crc(uint64_t index, int64_t asqn,
+                            const unsigned char *data, Py_ssize_t n)
+{
+    unsigned char head[16];
+    memcpy(head, &index, 8);
+    memcpy(head + 8, &asqn, 8);
+    uint32_t c = crc32_update(0xFFFFFFFFu, head, 16);
+    return crc32_update(c, data, n) ^ 0xFFFFFFFFu;
+}
+
+/* journal_checksum(index, asqn, data) -> int — the scan/verify side. */
+static PyObject *codec_journal_checksum(PyObject *self, PyObject *args)
+{
+    unsigned long long index;
+    long long asqn;
+    Py_buffer data;
+    if (!PyArg_ParseTuple(args, "KLy*", &index, &asqn, &data))
+        return NULL;
+    if (!crc32_ready)
+        crc32_build();
+    uint32_t crc = journal_crc(index, asqn,
+                               (const unsigned char *)data.buf, data.len);
+    PyBuffer_Release(&data);
+    return PyLong_FromUnsignedLong(crc);
+}
+
+/* journal_frame(index, asqn, data) -> bytes — the append side: one
+ * complete frame (<IIQq> header = payload length, checksum, index, asqn —
+ * then the payload) in a single allocation and a single crc pass,
+ * replacing two zlib.crc32 calls, two struct packs, and a bytes concat
+ * per append. Accepts any contiguous buffer (the prepatched burst path
+ * hands the writer's bytearray straight through). */
+static PyObject *codec_journal_frame(PyObject *self, PyObject *args)
+{
+    unsigned long long index;
+    long long asqn;
+    Py_buffer data;
+    if (!PyArg_ParseTuple(args, "KLy*", &index, &asqn, &data))
+        return NULL;
+    if (!crc32_ready)
+        crc32_build();
+    const unsigned char *p = (const unsigned char *)data.buf;
+    Py_ssize_t n = data.len;
+    if (n > 0xFFFFFFFFLL) {
+        PyBuffer_Release(&data);
+        return codec_error("journal payload too large: %zd bytes", n);
+    }
+    PyObject *out = PyBytes_FromStringAndSize(NULL, 24 + n);
+    if (!out) {
+        PyBuffer_Release(&data);
+        return NULL;
+    }
+    unsigned char *q = (unsigned char *)PyBytes_AS_STRING(out);
+    uint32_t length = (uint32_t)n;
+    uint32_t crc = journal_crc(index, asqn, p, n);
+    int64_t sq = asqn;
+    memcpy(q, &length, 4);
+    memcpy(q + 4, &crc, 4);
+    memcpy(q + 8, &index, 8);
+    memcpy(q + 16, &sq, 8);
+    memcpy(q + 24, p, n);
+    PyBuffer_Release(&data);
+    return out;
+}
+
 static PyMethodDef codec_methods[] = {
     {"encode_key", codec_encode_key, METH_VARARGS,
      "Order-preserving state-key encoding (spec: state/db.py encode_key)."},
@@ -1742,6 +1922,12 @@ static PyMethodDef codec_methods[] = {
     {"unpackb", codec_unpackb, METH_O, "Deserialize one msgpack value (consumes all bytes)."},
     {"decode_record_frame", codec_decode_record_frame, METH_O,
      "Parse one record wire frame into a 12-tuple (header fields, reason, value)."},
+    {"encode_record_frame", codec_encode_record_frame, METH_VARARGS,
+     "Serialize one record wire frame; returns (frame, value_body)."},
+    {"journal_frame", codec_journal_frame, METH_VARARGS,
+     "Build one complete journal frame (header + payload) in a single pass."},
+    {"journal_checksum", codec_journal_checksum, METH_VARARGS,
+     "Journal frame checksum over (index, asqn, payload) — zlib.crc32 parity."},
     {"scan_batch_headers", codec_scan_batch_headers, METH_O,
      "Parse a sequenced batch into per-record header tuples without decoding values."},
     {"scan_batch_headers_filtered", codec_scan_batch_headers_filtered, METH_VARARGS,

@@ -75,6 +75,10 @@ _decode_frame = (
     if _codec is not None and hasattr(_codec, "decode_record_frame")
     else _py_decode_frame
 )
+# encode mirror (native/codec.c encode_record_frame): None on a stale .so or
+# under ZEEBE_TPU_NO_NATIVE — the Python body in _py_encode stays the
+# byte-parity oracle either way
+_encode_frame = _native.codec_fn("encode_record_frame")
 
 NO_POSITION = -1
 NO_KEY = -1
@@ -138,7 +142,25 @@ class Record:
         are exposed so the append path can seed its decode cache without
         re-packing the value. ``timestamp`` (when given) is packed instead of
         ``self.timestamp`` — the append path stamps one batch timestamp, and
-        passing it here avoids a per-record replace()."""
+        passing it here avoids a per-record replace().
+
+        One native call builds header, reason, and msgpack body in a single
+        buffer pass (native/codec.c encode_record_frame); ``_py_encode`` is
+        the pure-Python specification with identical bytes."""
+        if _encode_frame is not None:
+            value = self.value
+            return _encode_frame(
+                self.record_type, self.value_type, self.intent,
+                self.rejection_type, self.key, self.source_record_position,
+                self.timestamp if timestamp is None else timestamp,
+                self.request_stream_id, self.request_id,
+                self.operation_reference, self.rejection_reason,
+                value if type(value) is dict else dict(value),
+            )
+        return self._py_encode(timestamp)
+
+    def _py_encode(self, timestamp: int | None = None) -> tuple[bytes, bytes]:
+        """Pure-Python frame encode; same (frame, body) as the native path."""
         reason = self.rejection_reason.encode("utf-8")
         if len(reason) > 0xFFFF:
             # the wire field is u16; truncate on a codepoint boundary so an

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import enum
 import logging
+import os
 from typing import Callable
 
 from zeebe_tpu.journal.journal import CorruptedJournalError
@@ -265,6 +266,33 @@ class StreamProcessor:
             "group wall time (begin_group..finish_group seam)",
             ("partition",)).labels(partition_label)
         self._overlap_ema: float | None = None
+        # cross-wave double-buffered dispatch (ISSUE 17): wave k+1 is
+        # admitted and its first device chunk dispatched inside wave k's
+        # transaction, right after wave k materialized — the chunk computes
+        # under wave k's entire host tail (append, dedupe notes, commit,
+        # group-commit fsync, deferred effects) instead of starting cold at
+        # the next round. The stash is (pending_group, expected_reader_pos,
+        # state_epoch, dispatch_stamp); the next round consumes it only if
+        # nothing invalidated the admission snapshot in between.
+        self._spec_group: tuple | None = None
+        # bumped by anything that mutates engine state outside the group
+        # pipeline itself (a post-commit task with its own transaction);
+        # sequential commands are covered by the reader-position check
+        self._state_epoch = 0
+        self._speculation_enabled = os.environ.get(
+            "ZEEBE_BROKER_PIPELINE_SPECULATION", "1"
+        ).lower() not in ("0", "false", "off")
+        self._m_spec = {
+            outcome: REGISTRY.counter(
+                "kernel_speculative_groups",
+                "cross-wave speculative dispatches by outcome: consumed = "
+                "committed by the next pump round; discarded = invalidated "
+                "before consumption (interleaved sequential command, "
+                "state-mutating post-commit task, quarantine latched, or "
+                "the speculating round rolled back)",
+                ("partition", "outcome")).labels(partition_label, outcome)
+            for outcome in ("consumed", "discarded")
+        }
         # bounded kernel_wave flight events: per-wave stats aggregate here
         # and flush through wave_listener (set by the broker partition →
         # flight recorder) at most once per second — the ring stays
@@ -307,6 +335,16 @@ class StreamProcessor:
             self.writer is log_stream.writer
             and getattr(log_stream.journal, "flush_interval", None) is not None
         )
+        # async ack path (ISSUE 17): gated replies release from the journal's
+        # flush callback — EVERY covering fsync (the pump-tail cadence check,
+        # the idle-boundary flush, an external barrier) frees the replies it
+        # covers the moment durability is real, instead of the pump polling
+        # for it at the next group tail. The reentrancy latch stops the drain
+        # from re-entering itself when a post-commit task (or the drain's own
+        # forced flush) triggers another fsync mid-drain.
+        self._in_flush_ack = False
+        if self._ack_gated:
+            log_stream.journal.flush_listeners.append(self._on_journal_flush)
 
     # -- bookkeeping ---------------------------------------------------------
 
@@ -518,28 +556,49 @@ class StreamProcessor:
                 return logged
             position = logged.position + 1
 
-    def _iter_candidate_commands(self):
+    def _iter_candidate_commands(self, start: int | None = None,
+                                 note_head: bool = True):
         """Lazily yield pending commands in log order, stopping at the first
-        the kernel backend cannot be a candidate for. Does not consume."""
-        position = self._reader_position
-        first = True
+        the kernel backend cannot be a candidate for. Does not consume.
+
+        Batched scan: after the hinted lookup finds a record, the rest of
+        its decoded sequenced batch is walked inline — a wave-sized ingress
+        batch (thousands of commands in one append) costs one slot lookup,
+        not one ``next_command_with_hint`` round-trip per record.
+
+        ``start``/``note_head``: the speculative cross-wave scan reads from
+        an explicit position (the just-finished wave's end, before
+        ``_reader_position`` advances) and must NOT note a sequential head —
+        a discarded speculation would otherwise double-count the head when
+        the next round's authoritative scan re-encounters it."""
+        position = self._reader_position if start is None else start
+        first = note_head
+        is_candidate = self.kernel_backend.is_candidate
         while True:
             logged, self._scan_hint, _ = self.log_stream.next_command_with_hint(
                 position, self._scan_hint
             )
             if logged is None:
                 return
-            position = logged.position + 1
-            if not (logged.record.is_command and not logged.processed):
-                continue
-            if not self.kernel_backend.is_candidate(logged.record):
-                if first:
-                    # precise fallback accounting: a sequential HEAD is named
-                    # by kind; an empty scan (end of log) counts nothing
-                    self.kernel_backend.note_sequential_head(logged.record)
-                return
-            first = False
-            yield logged
+            batch = self.log_stream.read_batch_containing(logged.position)
+            start = logged.position - batch[0].position if batch else -1
+            if not (0 <= start < len(batch)
+                    and batch[start].position == logged.position):
+                batch, start = (logged,), 0  # defensive: non-contiguous batch
+            for i in range(start, len(batch)):
+                logged = batch[i]
+                position = logged.position + 1
+                if not (logged.record.is_command and not logged.processed):
+                    continue
+                if not is_candidate(logged.record):
+                    if first:
+                        # precise fallback accounting: a sequential HEAD is
+                        # named by kind; an empty scan (end of log) counts
+                        # nothing
+                        self.kernel_backend.note_sequential_head(logged.record)
+                    return
+                first = False
+                yield logged
 
     def process_available_batch(self) -> int:
         """Process a group of kernel-eligible commands in one device run and
@@ -563,14 +622,35 @@ class StreamProcessor:
         builders: list[ProcessingResultBuilder] = []
         pending = None
         write_failed = False
+        # cross-wave double buffering: pop any group speculated by the
+        # PREVIOUS round — popped unconditionally so a group that fails
+        # validation (or a round that fails outright) can never be consumed
+        # against state its admission snapshot no longer matches
+        spec, self._spec_group = self._spec_group, None
+        spec_next = None
+        spec_dispatched_at = 0.0
         # out-of-transaction drain point: deferred groups carrying post-commit
         # tasks (skipped by the in-transaction overlap drain below) go out here
         self._run_deferred_effects()
         overlap = 0.0
         try:
             with self.db.transaction():
-                pending = self.kernel_backend.begin_group(
-                    self._iter_candidate_commands())
+                if spec is not None:
+                    pg, expected_pos, epoch, t_disp = spec
+                    if (expected_pos == self._reader_position
+                            and epoch == self._state_epoch
+                            and not self.kernel_backend.health.is_quarantined()):
+                        # the admission snapshot still holds: the speculating
+                        # round committed the exact state this transaction
+                        # opened over, nothing processed or mutated since
+                        pending = pg
+                        spec_dispatched_at = t_disp
+                        self._m_spec["consumed"].inc()
+                    else:
+                        self._m_spec["discarded"].inc()
+                if pending is None:
+                    pending = self.kernel_backend.begin_group(
+                        self._iter_candidate_commands())
                 # the device is computing the first chunk: run the previous
                 # group's deferred host work in the gap — the overlap window
                 # the dispatch-overlap gauge measures
@@ -581,6 +661,13 @@ class StreamProcessor:
                     pending, ProcessingResultBuilder)
                 if not cmds:
                     return 0
+                # speculate wave k+1 BEFORE this wave's host tail: state is
+                # materialized (the overlay this transaction will commit), so
+                # admission is exact, and the dispatched chunk computes under
+                # the append/commit/fsync work below. Stays local until the
+                # commit succeeds — a rollback discards it with the overlay.
+                if self._speculation_enabled:
+                    spec_next = self._maybe_speculate(cmds[-1].position + 1)
                 t_append = _time.perf_counter()
                 try:
                     for cmd, result in zip(cmds, builders):
@@ -631,6 +718,9 @@ class StreamProcessor:
             self.kernel_backend.accounting.note_host("group-error")
             return 0
         self._reader_position = cmds[-1].position + 1
+        # the commit succeeded: the speculative admission's state snapshot is
+        # now THE committed state — promote the stash for the next round
+        self._spec_group = spec_next
         # kernel-path accounting AFTER the commit: a rolled-back group that
         # re-admits next pump must not count twice (coverage/parity ruler)
         self.kernel_backend.note_group_success(pending)
@@ -650,6 +740,17 @@ class StreamProcessor:
         self._m_latency.observe(elapsed)
         self._m_batch_commands.observe(len(cmds))
         self._m_batch_duration.observe(elapsed)
+        # overlap receipt: for a consumed speculation, the group's device
+        # work really started at the PREVIOUS round's dispatch stamp, and the
+        # window from there to this round's start was all host work (the
+        # speculating wave's append, dedupe notes, commit, fsync, deferred
+        # effects) done while the chunk was in flight — count it as overlap
+        # and widen the denominator by the same amount so the ratio stays an
+        # honest fraction of this group's true wall span
+        if spec_dispatched_at:
+            pre = max(0.0, group_start - spec_dispatched_at)
+            overlap += pre
+            elapsed += pre
         self._observe_wave(pending, len(cmds), overlap, elapsed)
         if self._tracer.enabled:
             self._trace_group(cmds, elapsed, {
@@ -658,6 +759,29 @@ class StreamProcessor:
                 "flush": flush_dur, "overlap": overlap,
             })
         return len(cmds)
+
+    def _maybe_speculate(self, start_pos: int) -> tuple | None:
+        """Admit wave k+1 and dispatch its first device chunk while still
+        inside wave k's transaction (cross-wave double buffering, ISSUE 17).
+
+        Runs strictly after wave k materialized, so the overlay this
+        admission reads is exactly the state wave k is about to commit; the
+        scan starts at wave k's end position and cannot see wave k's
+        follow-up appends (not yet written — they land at higher positions
+        and are picked up by later scans in order). Declines silently
+        (``speculative=True``) and never notes a sequential head: if the
+        stash is discarded, the next round's authoritative scan owns all
+        accounting. Returns (group, expected_reader_pos, state_epoch,
+        dispatch_stamp) or None."""
+        import time as _time
+
+        pg = self.kernel_backend.begin_group(
+            self._iter_candidate_commands(start=start_pos, note_head=False),
+            speculative=True,
+        )
+        if pg is None:
+            return None
+        return (pg, start_pos, self._state_epoch, _time.perf_counter())
 
     def _observe_wave(self, pending, commands: int, overlap: float,
                       elapsed: float) -> None:
@@ -781,12 +905,32 @@ class StreamProcessor:
     def _group_commit_point(self) -> None:
         """Per-step flush point: advance the acked position — immediately
         when acks are not flush-gated (append = visible, the pre-pipeline
-        semantics), else only when ``maybe_flush``'s cadence fsyncs."""
+        semantics). Gated acks are fully async: ``maybe_flush`` only decides
+        WHETHER the cadence fsyncs here; the ack advance and the reply drain
+        happen in ``_on_journal_flush``, fired by the journal after any
+        successful covering fsync — this one or anyone else's."""
         if not self._ack_gated:
             self._acked_position = self.last_written_position
-        elif self.log_stream.journal.maybe_flush() is not None:
-            # the group-commit fsync covered everything appended so far
-            self._acked_position = self.last_written_position
+        else:
+            self.log_stream.journal.maybe_flush()
+
+    def _on_journal_flush(self, covered_index: int) -> None:
+        """Journal flush callback — the async ack path. Runs strictly after
+        a successful fsync, so everything appended before the flush call is
+        durable: advance the acked position to the last appended record and
+        emit the deferred replies it releases. A FAILED fsync never reaches
+        this callback (FlushFailedError propagates from flush() first), so
+        no reply can ever cover an unfsynced prefix. Single-threaded with
+        the pump (every flush origin runs on the processor thread), so
+        ``last_written_position`` is exactly the covered prefix."""
+        self._acked_position = self.last_written_position
+        if self._in_flush_ack:
+            return  # re-entered from a drain-triggered fsync: outer drain owns it
+        self._in_flush_ack = True
+        try:
+            self._run_deferred_effects()
+        finally:
+            self._in_flush_ack = False
 
     def _run_deferred_effects(self) -> None:
         """Emit deferred group side effects whose appends are acked (always
@@ -829,9 +973,14 @@ class StreamProcessor:
         if not dq:
             return
         if dq[-1][0] > self._acked_position:
-            # acks gated on durability: this IS the group-commit flush point
+            # acks gated on durability: force the covering fsync. The flush
+            # callback (_on_journal_flush) advances the acked position and
+            # drains; the explicit advance below is the no-listener fallback
+            # (a gated processor is always subscribed, but keep the boundary
+            # correct even if the journal lacks the callback seam).
             self.log_stream.journal.flush()
-            self._acked_position = self.last_written_position
+            self._acked_position = max(self._acked_position,
+                                       self.last_written_position)
         self._run_deferred_effects()
 
     def process_next(self) -> bool:
@@ -1015,6 +1164,12 @@ class StreamProcessor:
             self.response_sink(builder.response)
         for extra in builder.extra_responses:
             self.response_sink(extra)
+        if builder.post_commit_tasks:
+            # post-commit tasks may open their own transaction and mutate
+            # state a speculative admission already read: invalidate any
+            # outstanding cross-wave stash (reader-position checks cannot
+            # see this — tasks move no positions)
+            self._state_epoch += 1
         for task in builder.post_commit_tasks:
             try:
                 task()
