@@ -781,24 +781,30 @@ class TestScrapeRecordRace:
         stop = threading.Event()
 
         def register_loop():
-            i = 0
-            while not stop.is_set():
+            # bounded: an unbounded writer on a slow box grows the registry
+            # to millions of label children, and every later expose() pass
+            # over it takes minutes — the race window doesn't need volume
+            for i in range(20_000):
+                if stop.is_set():
+                    break
                 metric = registry.counter(f"m{i % 37}", "h", ("l",))
                 metric.labels(str(i)).inc()
                 registry.histogram(f"h{i % 23}", "h", ("l",)).labels(
                     str(i)).observe(0.01)
-                i += 1
 
         def scrape_loop():
             try:
                 for _ in range(300):
+                    if stop.is_set():
+                        break
                     registry.expose()
                     registry.snapshot()
             except BaseException as exc:  # noqa: BLE001 — the assertion
                 errors.append(exc)
 
-        writers = [threading.Thread(target=register_loop) for _ in range(3)]
-        scraper = threading.Thread(target=scrape_loop)
+        writers = [threading.Thread(target=register_loop, daemon=True)
+                   for _ in range(3)]
+        scraper = threading.Thread(target=scrape_loop, daemon=True)
         for t in writers:
             t.start()
         scraper.start()
@@ -806,7 +812,12 @@ class TestScrapeRecordRace:
         stop.set()
         for t in writers:
             t.join(timeout=10)
+        scraper.join(timeout=30)
         assert not errors, errors[0]
+        # a leaked scrape thread outlives the test and stalls interpreter
+        # shutdown for the whole suite — termination is part of the contract
+        assert not scraper.is_alive(), "scrape loop failed to terminate"
+        assert not any(t.is_alive() for t in writers)
 
 
 class TestProcessSelfMetrics:

@@ -273,6 +273,11 @@ KNOB_NOTES: dict[str, str] = {
         "mode)"),
     "ZEEBE_TRACING": "1/true = enable the Dapper-style tracer",
     "ZEEBE_TRACE_CAPACITY": "tracer ring capacity (spans retained)",
+    "ZEEBE_TRACE_DUMP_DIR": (
+        "directory the gateway writes its span dump "
+        "(spans-<node>-<pid>.jsonl) into at orderly stop, for the offline "
+        "critical-path assembler; unset = no gateway dump (workers always "
+        "dump into their broker data dir)"),
     "ZEEBE_TRACE_SAMPLE_RATE": "trace sampling rate in [0,1]",
     "ZEEBE_TRACE_SEED": "trace sampling hash seed (deterministic sampling)",
 }

@@ -451,6 +451,12 @@ def broker_status(broker) -> dict:
                     "repairs": len(p.scrubber.repairs),
                     "fullPasses": p.scrubber.full_passes,
                 }} if p.scrubber is not None else {}),
+                # latency observatory (ISSUE 19): last window's per-stage
+                # critical path — what `cli top` LATENCY renders
+                **({"criticalPath": cp}
+                   if getattr(p, "latency_observatory", None) is not None
+                   and (cp := p.latency_observatory.status()) is not None
+                   else {}),
             }
             for pid, p in sorted(broker.partitions.items())
         },

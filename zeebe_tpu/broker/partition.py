@@ -216,6 +216,10 @@ class ZeebePartition:
         # flight recorder (observability/flight_recorder.py | None): this
         # partition's bounded black-box ring of operational events
         self.flight = flight_recorder
+        # latency observatory (ISSUE 19): windowed worst-N ack exemplars +
+        # bounded critical_path flight events; built per transition so the
+        # hook always points at the live processor
+        self.latency_observatory = None
         self._exporter_flight_status: dict[str, Any] = {}
         # client-ingress backpressure (CommandRateLimiter | None) and the
         # disk-monitor pause flag; both gate client_write only — follow-ups,
@@ -427,6 +431,20 @@ class ZeebePartition:
             self.processor.on_jobs_available = (
                 lambda types, pid=self.partition_id: listener(pid, types)
             )
+        if self.flight is not None:
+            # slow-exemplar capture (ISSUE 19): the N worst acked traces per
+            # window dump their span trees through the flight recorder, and
+            # a bounded critical_path event carries the window's top stages
+            # (→ /cluster/status → `cli top` LATENCY section). Zero cost
+            # while tracing is off — the ack hook only fires under the
+            # tracer's enabled guard.
+            from zeebe_tpu.observability.critical_path import (
+                LatencyObservatory,
+            )
+
+            self.latency_observatory = LatencyObservatory(
+                _TRACER, self.flight, self.partition_id)
+            self.processor.on_ack = self.latency_observatory.observe
         self.processor.start()
         self.checkers = DueDateCheckers(
             self.engine.state, self.processor.schedule_service, self.clock_millis
@@ -884,21 +902,37 @@ class ZeebePartition:
                 admitted.append((i, record))
         if not admitted:
             return results
+        tracer = _TRACER
+        traced = tracer.enabled
+        t_append = _perf_counter() if traced else 0.0
         last = self.write_commands([r for _, r in admitted])
         if last is None:
             # role lost between the gate and the append: same evidence as
             # client_write returning None (the gateway retries typed)
             return results
+        append_dur = (_perf_counter() - t_append) if traced else 0.0
         first = last - len(admitted) + 1
-        tracer = _TRACER
         for offset, (i, record) in enumerate(admitted):
             position = first + offset
             results[i] = ("ok", position)
             self._note_pending_request(record, position)
             if self.limiter is not None:
                 self.limiter.on_appended(position)
-            if tracer.enabled:
+            if traced:
                 tracer.note_append(self.partition_id, position)
+                # PR 17's coalesced ingress made this path span-blind: every
+                # record in the batch waited the whole one-raft-entry append,
+                # so each sampled trace gets the full window (batched=true
+                # marks the shared cost for the throughput-minded reader)
+                trace_id = f"{self.partition_id}:{position}"
+                if tracer.sampled(trace_id):
+                    tracer.emit(trace_id, "broker.command_append",
+                                append_dur, self.partition_id,
+                                attrs={"position": position,
+                                       "valueType": record.value_type.name,
+                                       "intent": record.intent.name,
+                                       "batched": True,
+                                       "batchSize": len(admitted)})
         return results
 
     def write_commands(self, records: list[Record],
@@ -914,11 +948,52 @@ class ZeebePartition:
             return None
         first_position = self._next_position
         payload = self.stream.serialize_batch(entries, first_position, source_position)
-        index = self.raft.append(payload, asqn=first_position)
+        on_commit = None
+        if _TRACER.enabled:
+            on_commit = self._replicate_span_cb(first_position, len(entries),
+                                                source_position)
+        index = self.raft.append(payload, asqn=first_position,
+                                 on_commit=on_commit)
         if index is None:
             return None
         self._next_position = first_position + len(entries)
         return first_position + len(entries) - 1
+
+    def _replicate_span_cb(self, first_position: int, count: int,
+                           source_position: int):
+        """Closure for ``raft.append(on_commit=...)``: fires once at quorum
+        and emits one ``raft.replicate`` span per distinct sampled ROOT trace
+        covered by the entry (append→quorum wall time — the replication wait
+        the PR 17 span set could not see). Capped at 256 records per entry,
+        far above any client batch (≤128), so a pathological internal batch
+        cannot turn a quorum callback into a span storm."""
+        tracer = _TRACER
+        partition_id = self.partition_id
+        t_append = _perf_counter()
+
+        def _on_commit(_index: int) -> None:
+            if not tracer.enabled:
+                return
+            dur = _perf_counter() - t_append
+            emitted: set[str] = set()
+            for i in range(min(count, 256)):
+                position = first_position + i
+                fallback = source_position if source_position >= 0 else position
+                root = tracer.resolve_root(partition_id, position, fallback)
+                trace_id = f"{partition_id}:{root}"
+                if trace_id in emitted or not tracer.sampled(trace_id):
+                    continue
+                emitted.add(trace_id)
+                # `position` names the raft entry (its first record): one
+                # root trace legitimately waits on several entries (command
+                # append, then its follow-up records), and each wait is a
+                # distinct span — the entry position is its identity.
+                tracer.emit(trace_id, "raft.replicate", dur, partition_id,
+                            parent="processor.ack",
+                            attrs={"position": first_position,
+                                   "entries": count})
+
+        return _on_commit
 
     # -- pump (the actor loop, driven by the broker) ---------------------------
 

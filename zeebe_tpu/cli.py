@@ -391,6 +391,27 @@ def _render_top(status: dict) -> str:
                 f"{dev.get('shadowChecks', 0):>7} "
                 f"{dev.get('shadowMismatches', 0):>5} "
                 f"{cov.get('dominantHostReason', '-')}")
+    latency_rows = [
+        (row.get("nodeId", "?"), pid, info["criticalPath"])
+        for row in status.get("brokers", [])
+        for pid, info in sorted(row.get("partitions", {}).items(),
+                                key=lambda kv: int(kv[0]))
+        if info.get("criticalPath")
+    ]
+    if latency_rows:
+        # latency observatory (ISSUE 19): the last window's critical-path
+        # verdict per partition — WHERE the worst acks spent their time,
+        # not just how long they took
+        lines.append("")
+        lines.append(f"{'LATENCY':<14} {'PART':>4} {'ACKS':>7} "
+                     f"{'WORST':>9} TOP STAGES (p99)")
+        for node, pid, cp in latency_rows:
+            stages = " ".join(
+                f"{s.get('stage', '?')}:{s.get('p99Us', 0) / 1000.0:.2f}ms"
+                for s in cp.get("topStages", [])[:3]) or "-"
+            lines.append(
+                f"{node:<14} {pid:>4} {cp.get('windowAcks', 0):>7} "
+                f"{cp.get('worstMs', 0.0):>7.2f}ms {stages}")
     admission = status.get("admission")
     if admission and (admission.get("tenants") or admission.get("shedLevel")):
         # tenant admission (ISSUE 11): per-tenant rate/shed/queue evidence —

@@ -1257,6 +1257,13 @@ class KernelBackend:
         # back-compat: reason → count, full strings incl. head-*:<kind>)
         self.accounting = PathAccounting(engine.state.partition_id)
         self.fallback_reasons = self.accounting.reasons
+        # mesh submit seam tracing (ISSUE 19): the singleton is mutated in
+        # place by configure_tracing, so caching the reference is safe — one
+        # attribute read per mesh submit when tracing is off
+        from zeebe_tpu.observability.tracer import get_tracer
+
+        self._tracer = get_tracer()
+        self._partition_id = engine.state.partition_id
         self.template_hits = 0
         self.template_misses = 0
         self.template_audits = 0
@@ -2226,18 +2233,33 @@ class KernelBackend:
                 max_steps=self.max_steps,
                 chunk_steps=self.chunk_steps,
             ))
-            pg.device_elapsed += _time.perf_counter() - t0
+            submit_dur = _time.perf_counter() - t0
+            pg.device_elapsed += submit_dur
             if result.steps is None:
                 pg.fail_reason = "mesh-dispatch-error"
                 logger.warning("mesh kernel dispatch errored; falling back")
-                return None
-            if not result.quiesced:
+            elif not result.quiesced:
                 pg.fail_reason = "mesh-no-quiesce"
                 logger.warning("mesh kernel group did not quiesce; falling back")
-                return None
-            if result.overflow:
+            elif result.overflow:
                 pg.fail_reason = "mesh-token-overflow"
                 logger.warning("mesh kernel token pool overflow (T=%d); falling back", pg.T)
+            # the mesh submit seam span (ISSUE 19): ROADMAP item 1's
+            # fused-dispatch refactor changes exactly this window, so it
+            # must arrive measurable — one span per submit on the wave's
+            # group trace, outcome included so declined submits are visible
+            tracer = self._tracer
+            if tracer.enabled and pg.admitted:
+                group_trace = (f"{self._partition_id}:"
+                               f"g{pg.admitted[0].cmd.position}")
+                # group spans bypass head sampling — they carry the
+                # substitution intervals for every sampled command
+                tracer.emit(
+                    group_trace, "kernel.mesh_submit", submit_dur,
+                    self._partition_id, parent="processor.kernel_group",
+                    attrs={"instances": pg.I, "tokens": pg.T,
+                           "outcome": pg.fail_reason or "ok"})
+            if pg.fail_reason:
                 return None
             return result.steps
 

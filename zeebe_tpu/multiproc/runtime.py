@@ -164,6 +164,7 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
             self.job_streams.stop()
         except Exception:  # noqa: BLE001
             pass
+        self._dump_spans()
         self._running = False
         if self._thread is not None:
             self._thread.join(timeout=5)
@@ -174,6 +175,30 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
             stop = getattr(self.messaging, "stop", None)
             if stop is not None:
                 stop()
+
+    def _dump_spans(self) -> None:
+        """Persist this gateway's span ring as ``spans-<node>-<pid>.jsonl``
+        under ``ZEEBE_TRACE_DUMP_DIR`` (the gateway owns no data dir — the
+        harness that wants merged cluster traces points every process at a
+        shared dump dir). The offline assembler joins these per-process
+        dumps by derived trace id."""
+        import os
+
+        from zeebe_tpu.observability.tracer import get_tracer
+
+        dump_dir = os.environ.get("ZEEBE_TRACE_DUMP_DIR")
+        tracer = get_tracer()
+        if not dump_dir or not tracer.enabled or not len(tracer.collector):
+            return
+        from pathlib import Path
+
+        path = (Path(dump_dir)
+                / f"spans-{self.node_id}-{os.getpid()}.jsonl")
+        try:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            tracer.collector.to_jsonl(path)
+        except OSError:
+            pass  # best-effort evidence; shutdown must not fail on a dump
 
     def ready(self) -> bool:
         """Readiness: every partition has a live (non-stale) leader AND the
@@ -355,6 +380,9 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
 
         if not 1 <= partition_id <= self.partition_count:
             raise NoLeaderError(f"unknown partition {partition_id}")
+        # admission-gate entry: the root span covers from HERE so the
+        # critical-path sweep can see the admission wait as a queue edge
+        t_enter = time.perf_counter()
         # tenant admission (ISSUE 11): typed, fast shed — no routing, no
         # worker round trip, no queue. The caller sees RESOURCE_EXHAUSTED
         # with the reason; the flight recorder carries the evidence.
@@ -375,7 +403,6 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
         observe_latency = False
         tracer = get_tracer()
         traced = tracer.enabled
-        t_submit = time.perf_counter() if traced else 0.0
         request_id = None
         try:
             request_id, event = self._register_request()
@@ -470,7 +497,8 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
                             tracer, partition_id, record, result,
                             response.get("commandPosition", -1),
                             request_id, sent_to,
-                            time.perf_counter() - t_submit)
+                            time.perf_counter() - t_enter,
+                            t_admitted - t_enter)
                     return result
                 # typed error frame
                 kind = response.get("type")
@@ -524,7 +552,8 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
 
     def _emit_root_span(self, tracer, partition_id: int, record: Record,
                         response: Record, position: int, request_id: int,
-                        worker: str | None, latency: float) -> None:
+                        worker: str | None, latency: float,
+                        admit_wait: float = 0.0) -> None:
         tracer.observe_ack("gateway", latency)
         if position < 0:
             return  # worker predates the position-carrying envelope
@@ -537,5 +566,15 @@ class MultiProcClusterRuntime(GatewayRuntimeBase):
                  "worker": worker or "?"}
         if response.is_rejection:
             attrs["rejection"] = response.rejection_type.name
+        from zeebe_tpu.observability.span import now_us
+
+        root_start_us = now_us() - int(latency * 1e6)
         tracer.emit(trace_id, "gateway.request", latency, partition_id,
-                    attrs=attrs)
+                    attrs=attrs, start_us=root_start_us)
+        if admit_wait > 0:
+            # admission-gate wait pinned to the FRONT of the root window —
+            # a back-dated-from-now emit would charge it to the reply edge
+            tracer.emit(trace_id, "gateway.admission", admit_wait,
+                        partition_id, parent="gateway.request",
+                        attrs={"requestId": request_id},
+                        start_us=root_start_us)

@@ -346,6 +346,16 @@ class WorkerRuntime:
                                    "gateway": entry["sender"],
                                    "worker": self.node_id,
                                    "workerPid": os.getpid()})
+                # coalesce-window wait: enqueue→append, ms-clock resolution
+                # (the window itself is ms-scale). The direct path appends
+                # within the same millisecond and emits nothing — the span
+                # set records the wait only where a wait existed.
+                wait_ms = self.broker.clock_millis() - entry["enqMs"]
+                if wait_ms > 0:
+                    tracer.emit(trace_id, "gateway.coalesce_wait",
+                                wait_ms / 1000.0, partition_id,
+                                parent="gateway.ingress",
+                                attrs={"windowMs": self.coalesce_window_ms})
 
     def _flush_due_ingress(self) -> int:
         """Flush every partition queue whose coalescing window elapsed (a
@@ -447,6 +457,10 @@ class WorkerRuntime:
         dedupe_key = (target, response.request_id)
         # the append→reply latency IS the shed ladder's feedback signal
         self._release_admission(dedupe_key)
+        from zeebe_tpu.observability.tracer import get_tracer
+
+        tracer = get_tracer()
+        t_reply = time.perf_counter() if tracer.enabled else 0.0
         payload = {
             "requestId": response.request_id,
             "record": response.record.to_bytes(),
@@ -456,6 +470,20 @@ class WorkerRuntime:
         while len(self._recent_replies) > 4096:
             self._recent_replies.popitem(last=False)
         self.messaging.send(target, GATEWAY_RESPONSE_TOPIC, payload)
+        if tracer.enabled:
+            # reply-release seam: serialize + enqueue to the gateway, on the
+            # ROOT trace so the critical-path sweep can close the tail edge
+            pid = response.record.partition_id
+            position = payload["commandPosition"]
+            if position >= 0:
+                root = tracer.resolve_root(pid, position, position)
+                trace_id = f"{pid}:{root}"
+                if tracer.sampled(trace_id):
+                    tracer.emit(trace_id, "processor.reply_release",
+                                time.perf_counter() - t_reply, pid,
+                                parent="processor.ack",
+                                attrs={"position": position,
+                                       "gateway": target})
 
     # -- jobs available --------------------------------------------------------
 
@@ -547,7 +575,24 @@ class WorkerRuntime:
             # the arm's flight dump (with the control context block) is
             # the evidence the autotune gate collects offline
             self.broker.flight_recorder.dump("control-shutdown", force=True)
+        self._dump_spans()
         self.broker.close()
+
+    def _dump_spans(self) -> None:
+        """Persist this process's span ring as ``spans-<node>-<pid>.jsonl``
+        under the data dir: the offline critical-path assembler merges these
+        per-process dumps by derived trace id (no in-band propagation)."""
+        from zeebe_tpu.observability.tracer import get_tracer
+
+        tracer = get_tracer()
+        if not tracer.enabled or not len(tracer.collector):
+            return
+        path = (self.broker.directory
+                / f"spans-{self.node_id}-{os.getpid()}.jsonl")
+        try:
+            tracer.collector.to_jsonl(path)
+        except OSError:
+            pass  # a full disk must not turn shutdown fatal
 
 
 def main(argv: list[str] | None = None) -> int:

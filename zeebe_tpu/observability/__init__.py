@@ -2,7 +2,7 @@
 
 What the reference broker never had (SURVEY §5.1): a Dapper-style span layer
 over the engine's own causal substrate — and what it outsourced to
-Prometheus: retained metric history. Six pieces:
+Prometheus: retained metric history. Seven pieces:
 
 - ``span``: the span model, the seeded deterministic sampler, and the
   bounded per-process collector with JSONL / Chrome-trace (Perfetto) export.
@@ -22,6 +22,13 @@ Prometheus: retained metric history. Six pieces:
   (default set: lag / backpressure / flush latency / role flapping /
   XLA recompile storms), surfaced in ``/health`` and the
   ``zeebe_alerts_firing`` gauge.
+- ``critical_path``: the offline latency observatory (PR 19) — merges
+  per-process span dumps by derived trace id and attributes every
+  microsecond of each request's gateway-observed latency to exactly one
+  edge (queue / coalesce / replicate / fsync / device / host-execute /
+  reply), Canopy-style, with a conservation check; plus the in-broker
+  ``LatencyObservatory`` that dumps the window's worst traces via the
+  flight recorder.
 - ``profiler``: the continuous profiling plane — an always-on low-rate
   folded-stack sampler (``GET /profile/continuous``), the kernel backend's
   XLA compile telemetry sink, device-memory gauges, alert-triggered profile
@@ -37,6 +44,17 @@ from zeebe_tpu.observability.alerts import (
     AlertEvaluator,
     AlertRule,
     default_rules,
+)
+from zeebe_tpu.observability.critical_path import (
+    EDGES,
+    LatencyObservatory,
+    aggregate_breakdowns,
+    assemble,
+    breakdowns_from_spans,
+    check_conservation,
+    extract_trace,
+    load_spans,
+    top_stages,
 )
 from zeebe_tpu.observability.flight_recorder import FlightRecorder
 from zeebe_tpu.observability.lineage import collect_lineage, format_lineage
@@ -68,6 +86,7 @@ from zeebe_tpu.observability.tracer import (
 )
 
 __all__ = [
+    "EDGES",
     "AlertEvaluator",
     "AlertProfileCapture",
     "AlertRule",
@@ -76,20 +95,28 @@ __all__ = [
     "DeterministicSampler",
     "DeviceTraceCapture",
     "FlightRecorder",
+    "LatencyObservatory",
     "MetricsSampler",
     "Span",
     "SpanCollector",
     "TimeSeriesStore",
     "Tracer",
     "acquire_profiler",
+    "aggregate_breakdowns",
+    "assemble",
+    "breakdowns_from_spans",
+    "check_conservation",
     "chrome_trace",
     "collect_lineage",
     "configure_tracing",
     "default_rules",
+    "extract_trace",
     "format_lineage",
     "get_tracer",
+    "load_spans",
     "observe_compile",
     "release_profiler",
     "sample_device_memory",
     "summarize_store",
+    "top_stages",
 ]

@@ -122,6 +122,44 @@ class FlightRecorder:
             return None  # a full/readonly disk must not turn a dump fatal
         return path
 
+    def dump_payload(self, reason: str, payload: dict,
+                     force: bool = False) -> Path | None:
+        """Write a non-ring evidence payload (e.g. the latency observatory's
+        slow-exemplar span trees) under the same data dir, per-reason-class
+        throttle, and ``max_dump_bytes`` cap as ring dumps. Oversized
+        payloads drop whole entries from a ``traces`` dict, largest first,
+        recording ``truncatedTraces`` — a bounded dump is never mistaken
+        for the full evidence."""
+        if self.data_dir is None:
+            return None
+        now = self.clock_millis()
+        reason_class = reason.split(":", 1)[0]
+        if not force:
+            last = self._last_dump_ms.get(reason_class, -DUMP_MIN_INTERVAL_MS)
+            if now - last < DUMP_MIN_INTERVAL_MS:
+                return None
+        self._last_dump_ms[reason_class] = now
+        doc = {"nodeId": self.node_id, "reason": reason, "dumpedAtMs": now}
+        doc.update(payload)
+        body = json.dumps(doc, indent=1, default=str).encode("utf-8")
+        while self.max_dump_bytes > 0 and len(body) > self.max_dump_bytes:
+            traces = doc.get("traces")
+            if not isinstance(traces, dict) or not traces:
+                break  # nothing droppable; ship what we have
+            victim = max(traces, key=lambda t: len(traces[t]))
+            del traces[victim]
+            doc["truncatedTraces"] = doc.get("truncatedTraces", 0) + 1
+            body = json.dumps(doc, indent=1, default=str).encode("utf-8")
+        path = self.data_dir / f"flight-{now}-{time.monotonic_ns()}.json"
+        try:
+            self.data_dir.mkdir(parents=True, exist_ok=True)
+            tmp = path.with_suffix(".json.tmp")
+            tmp.write_bytes(body)
+            tmp.replace(path)
+        except OSError:
+            return None
+        return path
+
     def _bounded_body(self, payload: dict) -> bytes:
         """Serialize a dump under ``max_dump_bytes`` (UTF-8 bytes on disk,
         not code points — non-ASCII event content must not overshoot the

@@ -121,12 +121,18 @@ class Tracer:
 
     def emit(self, trace_id: str, name: str, dur_s: float,
              partition_id: int = 0, parent: str = "",
-             attrs: dict | None = None) -> None:
+             attrs: dict | None = None, start_us: int | None = None) -> None:
         """Record a span that just finished (start is back-dated by the
-        duration). Caller is responsible for the ``enabled`` + ``sampled``
-        guards — this method only materializes the span."""
+        duration unless the caller positions it with ``start_us`` — waits
+        that ended BEFORE emission time, like a command's backlog wait
+        reported at group end, must carry their real interval or the
+        critical-path sweep would charge them to the wrong segment).
+        Caller is responsible for the ``enabled`` + ``sampled`` guards —
+        this method only materializes the span."""
         dur_us = int(dur_s * 1e6)
-        self.collector.add(Span(trace_id, name, now_us() - dur_us, dur_us,
+        if start_us is None:
+            start_us = now_us() - dur_us
+        self.collector.add(Span(trace_id, name, start_us, dur_us,
                                 partition_id, parent, attrs))
 
     # -- trace roots (transitive causal lineage) -------------------------------

@@ -309,6 +309,10 @@ class StreamProcessor:
         from zeebe_tpu.observability.tracer import get_tracer
 
         self._tracer = get_tracer()
+        # ack-release hook (ISSUE 19): the broker partition wires this to its
+        # LatencyObservatory — called as (trace_id, latency_s) at the moment
+        # a command's reply is released, only while tracing is enabled
+        self.on_ack: Callable[[str, float], None] | None = None
         clock = clock_millis or log_stream.clock_millis
         self.schedule_service = ProcessingScheduleService(clock, self._write_scheduled_commands)
         self._reader_position = 1
@@ -322,10 +326,15 @@ class StreamProcessor:
         # double-buffered pipeline state: each processed group's post-commit
         # side effects (client responses, jobs-available notifications) are
         # deferred and run while the NEXT group's device chunk computes.
-        # Entries are (last_written_position, builders); with a journal
-        # flush_interval configured they additionally wait for the covering
-        # group-commit fsync before acking (no-acked-command-lost invariant)
-        self._deferred_effects: list[tuple[int, list]] = []
+        # Entries are (last_written_position, builders, ack_notes); with a
+        # journal flush_interval configured they additionally wait for the
+        # covering group-commit fsync before acking (no-acked-command-lost
+        # invariant). ack_notes (tracing only) are the commands' append→ack
+        # stamps, resolved at RELEASE time — so the processor-scope
+        # command_ack_latency observation and the ack/fsync-wait spans fire
+        # when the reply actually goes out, never for a prefix whose
+        # covering fsync failed and was rewound (ISSUE 19 satellite).
+        self._deferred_effects: list[tuple[int, list, list | None]] = []
         self._acked_position = -1
         # acks gated on the covering group-commit fsync: only meaningful when
         # this processor appends to the local stream journal AND that journal
@@ -648,6 +657,15 @@ class StreamProcessor:
                         self._m_spec["consumed"].inc()
                     else:
                         self._m_spec["discarded"].inc()
+                        # exactly-once span contract (ISSUE 19 satellite):
+                        # the ONLY span a discarded speculation ever emits is
+                        # this off-path marker — outcome="discarded" keeps it
+                        # out of critical-path attribution, and the next
+                        # round's authoritative re-scan of the same wave owns
+                        # every kernel_group/kernel_command emission
+                        if self._tracer.enabled:
+                            self._trace_speculative(expected_pos, t_disp,
+                                                    "discarded")
                 if pending is None:
                     pending = self.kernel_backend.begin_group(
                         self._iter_candidate_commands())
@@ -726,8 +744,14 @@ class StreamProcessor:
         self.kernel_backend.note_group_success(pending)
         # defer this group's post-commit side effects: they run while the
         # NEXT group's device chunk computes (or at the next sequential
-        # command / idle boundary, whichever comes first)
-        self._deferred_effects.append((self.last_written_position, builders))
+        # command / idle boundary, whichever comes first). Ack notes are
+        # taken HERE (commit time) because the flush below may drain the
+        # entry synchronously — gated notes must already ride it.
+        traced = self._tracer.enabled
+        notes = self._take_ack_notes(cmds) if traced else None
+        self._deferred_effects.append(
+            (self.last_written_position, builders,
+             notes if self._ack_gated else None))
         t_flush = _time.perf_counter()
         self._group_commit_point()
         flush_dur = _time.perf_counter() - t_flush
@@ -752,13 +776,35 @@ class StreamProcessor:
             overlap += pre
             elapsed += pre
         self._observe_wave(pending, len(cmds), overlap, elapsed)
-        if self._tracer.enabled:
+        if traced:
+            if spec_dispatched_at:
+                self._trace_speculative(cmds[0].position, spec_dispatched_at,
+                                        "consumed")
             self._trace_group(cmds, elapsed, {
                 "decode": pending.t_admit, "device": pending.device_elapsed,
                 "materialize": pending.t_materialize, "append": append_dur,
                 "flush": flush_dur, "overlap": overlap,
-            })
+            }, notes)
         return len(cmds)
+
+    def _trace_speculative(self, first_pos: int, t_disp: float,
+                           outcome: str) -> None:
+        """One span per speculative dispatch, emitted exactly once at
+        outcome resolution on the wave's group trace. ``outcome="discarded"``
+        marks it off the critical path (the extractor skips it);
+        ``"consumed"`` measures how early the next wave's chunk launched."""
+        import time as _time
+
+        tracer = self._tracer
+        pid = self.log_stream.partition_id
+        group_trace = f"{pid}:g{first_pos}"
+        # Group spans bypass head sampling: one per wave, and they are the
+        # substitution substrate for EVERY sampled command's attribution —
+        # a sampled command whose wave wasn't sampled would be unattributable.
+        if tracer.enabled:
+            tracer.emit(group_trace, "processor.speculative",
+                        _time.perf_counter() - t_disp, pid,
+                        attrs={"speculative": True, "outcome": outcome})
 
     def _maybe_speculate(self, start_pos: int) -> tuple | None:
         """Admit wave k+1 and dispatch its first device chunk while still
@@ -848,21 +894,80 @@ class StreamProcessor:
         except Exception:  # noqa: BLE001 — telemetry must not wedge the pump
             logger.exception("kernel_wave listener failed")
 
-    def _trace_group(self, cmds: list[LoggedRecord], elapsed: float,
-                     stages: dict[str, float]) -> None:
-        """Spans for one kernel group: a group span with one child per
-        pipeline stage (the per-trace view of the stream_processor_pipeline_*
-        histograms), plus a latency-attributed span per sampled command —
-        Canopy-style: the group's wall time split evenly across its commands.
-        Also resolves each command's append stamp into the append→ack
-        histogram. Only called from the live PROCESSING path."""
+    def _take_ack_notes(self, cmds) -> list[tuple]:
+        """Consume the commands' append stamps at COMMIT time into ack
+        notes ``(trace_id, position, t_append, t_commit)``. Notes are
+        resolved by :meth:`_release_acks` when the reply actually releases
+        — immediately when ungated, at the covering-fsync drain when gated
+        — so a failed flush (rewound prefix) can never feed the ack
+        histogram or emit an ack span for a reply that never went out."""
+        import time as _time
+
+        tracer = self._tracer
+        pid = self.log_stream.partition_id
+        t_commit = _time.perf_counter()
+        notes = []
+        for cmd in cmds:
+            t_append = tracer.take_append(pid, cmd.position)
+            fallback = (cmd.source_position if cmd.source_position >= 0
+                        else cmd.position)
+            root = tracer.resolve_root(pid, cmd.position, fallback)
+            notes.append((f"{pid}:{root}", cmd.position, t_append, t_commit))
+        return notes
+
+    def _release_acks(self, notes: list[tuple]) -> None:
+        """The ack-release seam: observe append→ack latency, emit the
+        ``processor.ack`` envelope (the attribution root on gateway-less
+        harnesses) and the ``processor.fsync_wait`` cover span, and feed
+        the slow-exemplar observatory."""
         import time as _time
 
         tracer = self._tracer
         pid = self.log_stream.partition_id
         now = _time.perf_counter()
+        enabled = tracer.enabled
+        on_ack = self.on_ack
+        for trace_id, position, t_append, t_commit in notes:
+            if t_append is None:
+                continue  # stamp evicted, or a burst append without one
+            latency = now - t_append
+            tracer.observe_ack("processor", latency)
+            if enabled and tracer.sampled(trace_id):
+                tracer.emit(trace_id, "processor.ack", latency, pid,
+                            attrs={"position": position})
+                wait = now - t_commit
+                if self._ack_gated and wait > 0:
+                    tracer.emit(trace_id, "processor.fsync_wait", wait, pid,
+                                parent="processor.ack",
+                                attrs={"position": position})
+            if enabled and on_ack is not None:
+                on_ack(trace_id, latency)
+
+    def _trace_group(self, cmds: list[LoggedRecord], elapsed: float,
+                     stages: dict[str, float],
+                     notes: list[tuple] | None) -> None:
+        """Spans for one kernel group: a group span with one child per
+        pipeline stage (the per-trace view of the stream_processor_pipeline_*
+        histograms), a backlog-wait span per sampled command (append → wave
+        start, positioned at its REAL interval so the critical-path sweep
+        charges it as queue time), plus a latency-attributed span per
+        sampled command — Canopy-style: the group's wall time split evenly
+        across its commands. Ungated acks release here; gated acks release
+        from the covering-fsync drain. Only called from the live
+        PROCESSING path."""
+        import time as _time
+
+        from zeebe_tpu.observability.span import now_us as _now_us
+
+        tracer = self._tracer
+        pid = self.log_stream.partition_id
+        now = _time.perf_counter()
+        anchor_us = _now_us()
         group_trace = f"{pid}:g{cmds[0].position}"
-        if tracer.sampled(group_trace):
+        # Group spans bypass head sampling (see _trace_speculative): ~one
+        # span bundle per wave, required by every sampled command's
+        # interval substitution.
+        if tracer.enabled:
             tracer.emit(group_trace, "processor.kernel_group", elapsed, pid,
                         attrs={"commands": len(cmds),
                                "firstPosition": cmds[0].position,
@@ -871,22 +976,31 @@ class StreamProcessor:
                 tracer.emit(group_trace, f"processor.stage.{stage}", dur, pid,
                             parent="processor.kernel_group")
         share = elapsed / len(cmds)
+        by_position = ({note[1]: note for note in notes} if notes else {})
         for cmd in cmds:
-            t_append = tracer.take_append(pid, cmd.position)
+            note = by_position.get(cmd.position)
+            trace_id = (note[0] if note is not None
+                        else f"{pid}:{tracer.resolve_root(pid, cmd.position, cmd.position)}")
+            if not tracer.sampled(trace_id):
+                continue
+            rec = cmd.record
+            t_append = note[2] if note is not None else None
             if t_append is not None:
-                tracer.observe_ack("processor", now - t_append)
-            fallback = (cmd.source_position if cmd.source_position >= 0
-                        else cmd.position)
-            root = tracer.resolve_root(pid, cmd.position, fallback)
-            trace_id = f"{pid}:{root}"
-            if tracer.sampled(trace_id):
-                rec = cmd.record
-                tracer.emit(trace_id, "processor.kernel_command", share, pid,
-                            attrs={"position": cmd.position,
-                                   "valueType": rec.value_type.name,
-                                   "intent": rec.intent.name,
-                                   "group": group_trace,
-                                   "attributed": True})
+                backlog = (now - elapsed) - t_append
+                if backlog > 0:
+                    tracer.emit(
+                        trace_id, "processor.backlog_wait", backlog, pid,
+                        parent="processor.ack",
+                        attrs={"position": cmd.position},
+                        start_us=anchor_us - int((now - t_append) * 1e6))
+            tracer.emit(trace_id, "processor.kernel_command", share, pid,
+                        attrs={"position": cmd.position,
+                               "valueType": rec.value_type.name,
+                               "intent": rec.intent.name,
+                               "group": group_trace,
+                               "attributed": True})
+        if notes and not self._ack_gated:
+            self._release_acks(notes)
 
     def _emit_group_effects(self, builders: list) -> None:
         from zeebe_tpu.engine.burst_templates import PreparedBurst
@@ -957,8 +1071,13 @@ class StreamProcessor:
                 # points (FIFO preserved: the queue stops at the first
                 # task-bearing group; responses never overtake it)
                 break
-            _position, builders = dq.pop(0)
+            _position, builders, notes = dq.pop(0)
             self._emit_group_effects(builders)
+            if notes:
+                # gated ack release: the covering fsync succeeded (this drain
+                # only runs past an advanced acked position), so the
+                # append→ack observation and ack/fsync-wait spans are real
+                self._release_acks(notes)
             emitted += 1
         if emitted:
             # observed only when work happened: the stage breakdown stays a
@@ -1018,10 +1137,14 @@ class StreamProcessor:
             self._m_batch_retry.inc()
             self._on_processing_error(cmd, error)
             return
+        traced = self._tracer.enabled
+        notes = self._take_ack_notes((cmd,)) if traced else None
         if self._ack_gated:
             # acked ⇒ durable: the response waits for the covering fsync
-            # (maybe_flush cadence, or the idle-boundary flush)
-            self._deferred_effects.append((self.last_written_position, [builder]))
+            # (maybe_flush cadence, or the idle-boundary flush); its ack
+            # notes wait with it — a failed flush releases neither
+            self._deferred_effects.append(
+                (self.last_written_position, [builder], notes))
             self._group_commit_point()
             self._run_deferred_effects()
         else:
@@ -1030,8 +1153,8 @@ class StreamProcessor:
         self._observe_follow_ups(builder.follow_ups)
         self._m_processed.inc()
         elapsed = _time.perf_counter() - start
-        if self._tracer.enabled:
-            self._trace_command(cmd, builder, elapsed)
+        if traced:
+            self._trace_command(cmd, builder, elapsed, notes)
         self._m_latency.observe(elapsed)
         self._m_processing_duration.observe(elapsed)
         self._m_batch_commands.observe(
@@ -1041,28 +1164,43 @@ class StreamProcessor:
         self._m_post_commit.observe(len(builder.post_commit_tasks))
 
     def _trace_command(self, cmd: LoggedRecord,
-                       builder: ProcessingResultBuilder, elapsed: float) -> None:
-        """Span + append→ack observation for one sequentially processed
-        command. The trace id is the root command's position (follow-up
-        commands inherit their producer's root via the batch source
-        backlink), so the span stream joins to the lineage walker's trees."""
+                       builder: ProcessingResultBuilder, elapsed: float,
+                       notes: list[tuple] | None) -> None:
+        """Spans for one sequentially processed command: the processing span,
+        a backlog-wait span (append → processing start, at its real
+        interval), and — when acks are ungated — the immediate ack release.
+        Gated notes release from the covering-fsync drain instead. The trace
+        id is the root command's position (follow-up commands inherit their
+        producer's root via the batch source backlink), so the span stream
+        joins to the lineage walker's trees."""
         import time as _time
+
+        from zeebe_tpu.observability.span import now_us as _now_us
 
         tracer = self._tracer
         pid = self.log_stream.partition_id
-        t_append = tracer.take_append(pid, cmd.position)
-        if t_append is not None:
-            tracer.observe_ack("processor", _time.perf_counter() - t_append)
-        fallback = cmd.source_position if cmd.source_position >= 0 else cmd.position
-        root = tracer.resolve_root(pid, cmd.position, fallback)
-        trace_id = f"{pid}:{root}"
+        note = notes[0] if notes else None
+        trace_id = (note[0] if note is not None
+                    else f"{pid}:{tracer.resolve_root(pid, cmd.position, cmd.position)}")
         if tracer.sampled(trace_id):
             rec = cmd.record
+            t_append = note[2] if note is not None else None
+            if t_append is not None:
+                now = _time.perf_counter()
+                backlog = (now - elapsed) - t_append
+                if backlog > 0:
+                    tracer.emit(
+                        trace_id, "processor.backlog_wait", backlog, pid,
+                        parent="processor.ack",
+                        attrs={"position": cmd.position},
+                        start_us=_now_us() - int((now - t_append) * 1e6))
             tracer.emit(trace_id, "processor.command", elapsed, pid,
                         attrs={"position": cmd.position,
                                "valueType": rec.value_type.name,
                                "intent": rec.intent.name,
                                "followUps": len(builder.follow_ups)})
+        if notes and not self._ack_gated:
+            self._release_acks(notes)
 
     def _batch_process(self, cmd: LoggedRecord, builder: ProcessingResultBuilder) -> None:
         """The batchProcessing loop: the input command plus follow-up commands
@@ -1114,7 +1252,9 @@ class StreamProcessor:
             self._write_and_mark(cmd, builder)
         if self._ack_gated:
             # rejections ack like any response: after the covering fsync
-            self._deferred_effects.append((self.last_written_position, [builder]))
+            # (no ack notes — rejections never fed the ack histogram)
+            self._deferred_effects.append(
+                (self.last_written_position, [builder], None))
             self._group_commit_point()
             self._run_deferred_effects()
             return

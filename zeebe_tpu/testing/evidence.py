@@ -14,6 +14,9 @@ Protocols, each used by every chaos gate:
 - :func:`percentile` — the one shared latency-percentile rule for gate
   reports (the serving gate's SLO math must not drift from any other
   gate's).
+- :func:`collect_span_dumps` — gather the per-process span JSONL files
+  (``spans-<node>-<pid>.jsonl``) a traced cluster run left behind, for the
+  offline critical-path assembler (PR 19).
 """
 
 from __future__ import annotations
@@ -67,6 +70,16 @@ def collect_flight_dumps(data_dir: str | Path, seen: list[str],
         violations.append(
             f"{label}: no flight dump carries the recovery event for this "
             f"restart")
+
+
+def collect_span_dumps(root: str | Path) -> list[Path]:
+    """Every per-process span dump under ``root`` (recursive): each traced
+    process — gateway (``ZEEBE_TRACE_DUMP_DIR``) and workers (their broker
+    data dirs) — writes ``spans-<node>-<pid>.jsonl`` at orderly shutdown.
+    Point every process at dirs under one root and this finds them all;
+    feed the result to ``critical_path.load_spans`` / ``assemble`` to merge
+    the cluster's view of each trace."""
+    return sorted(Path(root).rglob("spans-*.jsonl"))
 
 
 def collect_gate_dumps(dump_paths, dumps_name: str, work_dir: str,
