@@ -1927,6 +1927,65 @@ def _device_chaos_main(quick: bool) -> None:
         raise SystemExit(1)
 
 
+def _fleetday_main(quick: bool) -> None:
+    """--fleetday: the long-horizon fleet-day gate (ISSUE 20, ROADMAP
+    item 4). The open-loop multi-tenant serving workload with diurnal
+    ramps + tiered state + ALL THREE chaos planes at background rates +
+    live definition churn + rolling worker restarts, while the fleet
+    auditor watches invariants/burn-rates/leak-trends online; gated on
+    the PR 9 offline checker, SLOs outside declared incident windows,
+    ≥1 event per chaos plane, corruption accounting, zero leak verdicts
+    on the clean fleet, auditor recall vs offline findings, and a
+    leak-injection arm where the auditor MUST fire. Writes
+    FLEETDAY[_quick].json; violations fail the run."""
+    import shutil
+    import time as _time
+
+    from zeebe_tpu.testing.fleetday import FULL_FLEETDAY, FleetDayConfig
+    from zeebe_tpu.testing.fleetday import run_fleetday
+
+    cfg = FleetDayConfig() if quick else FULL_FLEETDAY
+    started = _time.perf_counter()
+    work_dir = tempfile.mkdtemp(prefix="zeebe-fleetday-")
+    try:
+        report = run_fleetday(cfg, directory=work_dir)
+    finally:
+        from pathlib import Path as _Path
+
+        dumps = _collect_gate_dumps(
+            sorted(_Path(work_dir).glob("*/flight-*.json")),
+            "FLEETDAY_dumps", work_dir)
+        shutil.rmtree(work_dir, ignore_errors=True)
+    report["flightDumps"] = dumps
+    report["wallSecondsTotal"] = round(_time.perf_counter() - started, 2)
+    report["quick"] = quick
+    name = "FLEETDAY_quick.json" if quick else "FLEETDAY.json"
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "fleetday": True, "quick": quick, "seed": report["seed"],
+        "requests": report["requests"],
+        "ackedCommands": report["ackedCommands"],
+        "chaosPlanes": {p: sum(c.values())
+                        for p, c in report["chaosPlanes"].items()},
+        "rollingRestarts": report["rollingRestarts"],
+        "definitionChurn": report["definitionChurn"],
+        "slo": {k: report["slo"].get(k)
+                for k in ("p50Ms", "p99Ms", "ackFraction")},
+        "leakVerdicts": report["leakVerdicts"],
+        "leakArmFired": report["leakArm"].get("fired"),
+        "auditorRecallPct": report["auditorRecall"]["recallPct"],
+        "violations": len(report["violations"]),
+        "full_results": name,
+    }))
+    if report["violations"]:
+        for v in report["violations"][:20]:
+            print(f"fleetday violation: {v}", file=sys.stderr)
+        raise SystemExit(1)
+
+
 def _serving_main(quick: bool) -> None:
     """--serving: the open-loop SLO'd serving gate (ISSUE 11). Drives the
     real multi-process cluster with seeded Poisson arrivals from hundreds
@@ -2381,7 +2440,8 @@ def main(quick: bool = False, trace: bool = False,
          soak: bool = False, scale_soak: bool = False,
          consistency: bool = False, serving: bool = False,
          autotune: bool = False, torture: bool = False,
-         device_chaos: bool = False, multichip_probe: bool = False) -> None:
+         device_chaos: bool = False, multichip_probe: bool = False,
+         fleetday: bool = False) -> None:
     # install the filter BEFORE any backend use: the mismatch warning fires
     # whenever a persistent-cache executable loads, including the probe's
     # subprocess (which inherits the filtered fd 2)
@@ -2406,6 +2466,11 @@ def main(quick: bool = False, trace: bool = False,
     if device_chaos:
         # same posture: workers own the (faulted) kernel dispatch path
         _device_chaos_main(quick)
+        return
+    if fleetday:
+        # same posture: everything runs in worker processes; the gateway
+        # harness + the cluster auditor never touch a device
+        _fleetday_main(quick)
         return
     platform = _ensure_backend()
     if multichip_probe:
@@ -2683,6 +2748,20 @@ if __name__ == "__main__":
                          "before commit, and >=1 full SUSPECT->QUARANTINED"
                          "->canary->HEALTHY ladder cycle. Writes "
                          "DEVICE_CHAOS[_quick].json")
+    ap.add_argument("--fleetday", action="store_true",
+                    help="long-horizon fleet-day gate (ISSUE 20): the "
+                         "open-loop multi-tenant serving workload with "
+                         "diurnal ramps, tiered state, ALL THREE chaos "
+                         "planes at background rates, live definition "
+                         "churn, and rolling worker restarts — while the "
+                         "fleet auditor watches invariants, SLO burn "
+                         "rates, and resource leak trends ONLINE; gates "
+                         "on the offline exactly-once checker, SLOs held "
+                         "outside declared incident windows, >=1 event "
+                         "per chaos plane, zero leak verdicts on the "
+                         "clean fleet, 100%% auditor recall vs offline "
+                         "findings, and a leak-injection arm where the "
+                         "auditor MUST fire. Writes FLEETDAY[_quick].json")
     ap.add_argument("--multichip-probe", action="store_true",
                     help="multichip honesty probe (ROADMAP item 1): attempt "
                          "a minimal 2-shard mesh dispatch and write a TYPED "
@@ -2709,4 +2788,5 @@ if __name__ == "__main__":
              consistency=_args.consistency, serving=_args.serving,
              autotune=_args.autotune, torture=_args.torture,
              device_chaos=_args.device_chaos,
-             multichip_probe=_args.multichip_probe)
+             multichip_probe=_args.multichip_probe,
+             fleetday=_args.fleetday)

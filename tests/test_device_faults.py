@@ -364,6 +364,36 @@ class TestContainment:
             h.close()
         assert fingerprint == sequential_fingerprint()
 
+    def test_watchdog_thread_count_flat_across_50_wedges(self):
+        """Satellite pin (ISSUE 20): the dispatch watchdog reuses a
+        bounded worker pool, so 50 seeded wedges leave the process thread
+        count flat once each wedge resolves. The old per-call daemon
+        thread leaked one thread per expired dispatch — exactly the trend
+        the fleet auditor's thread_count detector would flag."""
+        import random
+        import threading
+        import time
+
+        rng = random.Random(20)
+        baseline = threading.active_count()
+        cap = kb._WatchdogPool.MAX_IDLE
+        for _ in range(50):
+            gate = threading.Event()
+            with pytest.raises(kb.DeviceWedgedError):
+                kb._watchdog_call(gate.wait, 0.002 + rng.random() * 0.004)
+            gate.set()  # un-wedge: the pooled worker must re-idle itself
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                with kb._WATCHDOG_POOL._lock:
+                    if kb._WATCHDOG_POOL._idle:
+                        break
+                time.sleep(0.001)
+            assert threading.active_count() <= baseline + cap
+        # serial wedges reuse pooled workers: no 50-thread residue
+        assert threading.active_count() <= baseline + cap
+        # and the pool still serves the happy path after all that abuse
+        assert kb._watchdog_call(lambda: 42, 1.0) == 42
+
 
 # ---------------------------------------------------------------------------
 # shadow verification (detection) + quarantine routing + canary recovery

@@ -583,8 +583,8 @@ class TestManagementEndpoints:
         assert status == 200
         assert "alerts" in body and "alertsFiring" in body
         status, body = _http_get(server.port, "/alerts")
-        # 7 default rules since ISSUE 8 added rss_watermark
-        assert status == 200 and len(body["rules"]) == 7
+        # 9 default rules since ISSUE 20 added slo_burn_page/slo_burn_ticket
+        assert status == 200 and len(body["rules"]) == 9
 
     def test_cluster_status_local(self, management):
         server, cluster = management

@@ -31,6 +31,47 @@ KNOB_NOTES: dict[str, str] = {
     "ZEEBE_ALERT_RSSWATERMARKBYTES": (
         "RSS high-watermark (bytes) for the default memory alert rule; the "
         "scale soak tightens it to its budget"),
+    "ZEEBE_AUDIT_CRCWINDOW": (
+        "records per replica-CRC checkpoint window the online auditor "
+        "publishes for cross-worker spot agreement (default 5000)"),
+    "ZEEBE_AUDIT_ENABLED": (
+        "enable the per-broker online auditor: invariant monitors, SLO "
+        "burn-rate alerts, leak-trend detection (default true)"),
+    "ZEEBE_AUDIT_FASTWINDOWMS": (
+        "fast burn-rate window (ms, default 5m): pages only when BOTH "
+        "windows burn — the multiwindow SRE alerting shape"),
+    "ZEEBE_AUDIT_GOODPUTFLOOR": (
+        "acked/terminal fraction below which a tick counts as bad toward "
+        "the burn-rate budget (default 0.7)"),
+    "ZEEBE_AUDIT_LEAKMINGROWTH": (
+        "minimum relative growth over the leak window before a trend can "
+        "latch a leak verdict (default 0.3 = +30%)"),
+    "ZEEBE_AUDIT_LEAKMINSAMPLES": (
+        "minimum samples before the leak-trend detector renders any "
+        "verdict (default 24)"),
+    "ZEEBE_AUDIT_LEAKWARMUPMS": (
+        "hold-off after broker boot before resource series feed the leak "
+        "detector — boot-era monotone climbs are genuine, not leaks "
+        "(default 60s)"),
+    "ZEEBE_AUDIT_LEAKWINDOWMS": (
+        "sliding window (ms, default 10m) for the least-squares "
+        "resource-trend leak detector"),
+    "ZEEBE_AUDIT_QUARANTINEMAXMS": (
+        "max time the device-health ladder may sit QUARANTINED before the "
+        "auditor latches a quarantine_latch violation (default 10m)"),
+    "ZEEBE_AUDIT_SLOP99MS": (
+        "admission ack-p99 SLO bound (ms) feeding the burn-rate good/bad "
+        "classification (default 5000)"),
+    "ZEEBE_AUDIT_SLOTARGET": (
+        "availability SLO target for burn-rate math, e.g. 0.999 = 0.1% "
+        "error budget (default 0.999)"),
+    "ZEEBE_AUDIT_SLOWWINDOWMS": (
+        "slow burn-rate window (ms, default 1h); sustained-but-mild burns "
+        "raise a ticket instead of a page"),
+    "ZEEBE_AUDIT_TESTLEAK": (
+        "test-only deliberate leak (`fd:25`, `ring:50`) for the fleet-day "
+        "recall arm — the auditor MUST convict a worker running this; "
+        "never enable outside a harness"),
     "ZEEBE_BROKER_BACKPRESSURE_ALGORITHM": (
         "ingress rate-limit algorithm: `vegas` (default) | `aimd` | `fixed`"),
     "ZEEBE_BROKER_BACKPRESSURE_ENABLED": (

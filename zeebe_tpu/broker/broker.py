@@ -267,10 +267,17 @@ class Broker:
             # dumps carry the alert state alongside the event rings
             self.flight_recorder.add_context_provider(
                 lambda: {"alerts": self.alerts.snapshot()})
+            # the fleet auditor (ISSUE 20) rides the same cadence: online
+            # invariant monitors + burn-rate + leak trends; its burn-rate
+            # rules append onto self.alerts, so it constructs after it
+            from zeebe_tpu.observability.auditor import BrokerAuditor
+
+            self.auditor: BrokerAuditor | None = BrokerAuditor(self)
         else:
             self.timeseries = None
             self.sampler = None
             self.alerts = None
+            self.auditor = None
         self.health_monitor.add_listener(self._on_health_transition)
         self._metrics = {
             "written": REGISTRY.counter(
@@ -828,6 +835,10 @@ class Broker:
             # off already-initialized devices (profiler._resolve_devices
             # never touches an unpinned, uninitialized accelerator backend)
             self._profiler_mod.sample_device_memory()
+            if self.auditor is not None:
+                # audit BEFORE the alert sweep so the burn-rate series this
+                # tick publishes is what the evaluator judges
+                self.auditor.tick(self.clock_millis())
             self.alerts.evaluate(self.clock_millis())
         if self.control is not None:
             # control ticks AFTER the sampler: decisions see telemetry at

@@ -471,6 +471,12 @@ def broker_status(broker) -> dict:
         # control-plane evidence rides the row: knob values, bounds, and
         # adjustment counts per controller (rendered by `cli top` CONTROL)
         status["control"] = control.snapshot()
+    auditor = getattr(broker, "auditor", None)
+    if auditor is not None:
+        # online-audit evidence (ISSUE 20): latched invariant alerts,
+        # burn-rate state, leak verdicts, and the replica-CRC checkpoints
+        # the harness-side ClusterAuditor joins across workers
+        status["audit"] = auditor.snapshot()
     store = getattr(broker, "timeseries", None)
     if store is not None:
         now = broker.clock_millis()

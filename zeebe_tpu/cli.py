@@ -459,6 +459,28 @@ def _render_top(status: dict) -> str:
                     f"{node:<14} {name:<20} {loop.get('knob', '?'):<26} "
                     f"{value!s:>9} {'-':>15} "
                     f"{loop.get('adjustments', 0):>5}")
+    audit_rows = [(row.get("nodeId", "?"), row["audit"])
+                  for row in status.get("brokers", [])
+                  if row.get("audit")]
+    if audit_rows:
+        # fleet auditor (ISSUE 20): per-broker burn-rate state, leak
+        # verdict, and latched invariant violations — the online view the
+        # fleet-day gate cross-checks against the offline checker
+        lines.append("")
+        lines.append(f"{'AUDIT':<14} {'BURN':<8} {'FAST':>7} {'SLOW':>7} "
+                     f"{'LEAK':<6} {'VIOL':>5} TRENDING RESOURCES")
+        for node, audit in audit_rows:
+            burn = audit.get("burn", {})
+            trending = " ".join(
+                f"{name}:{v.get('state', '?')}"
+                for name, v in sorted(audit.get("leaks", {}).items())
+                if v.get("state") not in ("quiet", "insufficient")) or "-"
+            lines.append(
+                f"{node:<14} {burn.get('state', '?'):<8} "
+                f"{burn.get('fast', 0.0):>7.2f} "
+                f"{burn.get('slow', 0.0):>7.2f} "
+                f"{audit.get('leakVerdict', '?'):<6} "
+                f"{audit.get('violations', 0):>5} {trending}")
     workers = status.get("workers")
     if workers:
         # multi-process deployment: the supervisor's per-worker view —

@@ -1106,28 +1106,81 @@ def device_chaos():
     return _DEVICE_CHAOS
 
 
+class _WatchdogWorker:
+    """One reusable daemon thread of the dispatch-watchdog pool. A worker
+    abandoned by a deadline miss keeps blocking on the wedged ``fn`` — but
+    instead of dying (and leaking, one thread per expired dispatch, the
+    old PR 15 behavior) it re-idles ITSELF when the wedged call finally
+    returns, so a bounded pool serves any number of wedges."""
+
+    def __init__(self, pool: "_WatchdogPool") -> None:
+        import queue
+        import threading
+
+        self._pool = pool
+        self._tasks: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="device-dispatch-watchdog")
+        self._thread.start()
+
+    def submit(self, fn, box: dict, done) -> None:
+        self._tasks.put((fn, box, done))
+
+    def _loop(self) -> None:
+        while True:
+            fn, box, done = self._tasks.get()
+            try:
+                box["value"] = fn()
+            except BaseException as exc:  # noqa: BLE001 — re-raised on caller
+                box["error"] = exc
+            done.set()
+            # re-idle AFTER the task finishes — a deadline-missed caller
+            # already walked away, so this is what un-leaks a wedge; the
+            # pool drops us when already at capacity and the thread exits
+            if not self._pool.release(self):
+                return
+
+
+class _WatchdogPool:
+    """Bounded free-list of :class:`_WatchdogWorker` threads."""
+
+    MAX_IDLE = 8
+
+    def __init__(self) -> None:
+        import threading
+
+        self._idle: list[_WatchdogWorker] = []
+        self._lock = threading.Lock()
+
+    def acquire(self) -> _WatchdogWorker:
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+        return _WatchdogWorker(self)
+
+    def release(self, worker: _WatchdogWorker) -> bool:
+        with self._lock:
+            if len(self._idle) < self.MAX_IDLE:
+                self._idle.append(worker)
+                return True
+        return False
+
+
+_WATCHDOG_POOL = _WatchdogPool()
+
+
 def _watchdog_call(fn, deadline_s: float):
-    """Run ``fn`` on a daemon thread with a deadline — the dispatch
-    watchdog. A deadline miss raises :class:`DeviceWedgedError`; the
-    worker thread keeps blocking on the wedged call (honest caveat in
-    docs/device-faults.md: a truly wedged device leaks one thread per
-    expired dispatch — the quarantine ladder stops further dispatches
-    after the first few)."""
+    """Run ``fn`` on a pooled daemon thread with a deadline — the dispatch
+    watchdog. A deadline miss raises :class:`DeviceWedgedError` while the
+    pooled worker keeps blocking on the wedged call; when that call
+    eventually returns the worker re-idles itself, so repeated wedges
+    reuse a bounded pool instead of leaking one thread per expiry."""
     import threading
 
     box: dict = {}
     done = threading.Event()
-
-    def run() -> None:
-        try:
-            box["value"] = fn()
-        except BaseException as exc:  # noqa: BLE001 — re-raised on the caller
-            box["error"] = exc
-        done.set()
-
-    thread = threading.Thread(target=run, daemon=True,
-                              name="device-dispatch-watchdog")
-    thread.start()
+    worker = _WATCHDOG_POOL.acquire()
+    worker.submit(fn, box, done)
     if not done.wait(deadline_s):
         raise DeviceWedgedError(
             f"device dispatch exceeded the {deadline_s * 1000:.0f}ms "

@@ -595,6 +595,54 @@ class WorkerRuntime:
             pass  # a full disk must not turn shutdown fatal
 
 
+class _TestLeak:
+    """Deliberate resource leak for the fleet-day recall arm (ISSUE 20):
+    ``ZEEBE_AUDIT_TESTLEAK=fd:20`` leaks ~20 file descriptors per second,
+    ``ring:50`` pushes ~50 junk events/s into the flight recorder's node
+    ring. The online auditor MUST return a leak verdict against a worker
+    running with this armed — proving the detector's recall, not just its
+    quietness on a clean tree. Never enable outside a test harness."""
+
+    def __init__(self, kind: str, per_sec: float) -> None:
+        self.kind = kind
+        self.per_sec = per_sec
+        self._held: list = []   # leaked fds stay referenced until exit
+        self._last = time.monotonic()
+
+    @staticmethod
+    def from_env() -> "_TestLeak | None":
+        spec = os.environ.get("ZEEBE_AUDIT_TESTLEAK", "")
+        if not spec:
+            return None
+        kind, _, rate = spec.partition(":")
+        try:
+            per_sec = float(rate) if rate else 10.0
+        except ValueError:
+            per_sec = 10.0
+        if kind not in ("fd", "ring"):
+            return None
+        return _TestLeak(kind, per_sec)
+
+    def tick(self, runtime) -> None:
+        now = time.monotonic()
+        count = int((now - self._last) * self.per_sec)
+        if count <= 0:
+            return
+        self._last = now
+        if self.kind == "fd":
+            for _ in range(min(count, 64)):
+                try:
+                    self._held.append(open(os.devnull, "rb"))  # noqa: SIM115
+                except OSError:
+                    return  # fd table exhausted: stop leaking, stay alive
+        else:
+            flight = getattr(runtime.broker, "flight_recorder", None)
+            if flight is not None:
+                for i in range(min(count, 256)):
+                    flight.record(0, "test_leak", seq=len(self._held) + i)
+                self._held.extend(range(min(count, 256)))
+
+
 def main(argv: list[str] | None = None) -> int:
     """Process entry: ``python -m zeebe_tpu.multiproc.worker ...`` (normally
     spawned by :class:`zeebe_tpu.multiproc.supervisor.WorkerSupervisor`)."""
@@ -703,6 +751,7 @@ def main(argv: list[str] | None = None) -> int:
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    test_leak = _TestLeak.from_env()
     print(f"[{args.node_id}] worker up: partitions<={args.partitions} "
           f"bind {args.bind} pid {os.getpid()}", file=sys.stderr, flush=True)
     while not stop.is_set():
@@ -710,6 +759,8 @@ def main(argv: list[str] | None = None) -> int:
             disk_chaos.tick()
         if device_chaos is not None:
             device_chaos.tick()
+        if test_leak is not None:
+            test_leak.tick(runtime)
         if runtime.pump() == 0:
             time.sleep(0.001)
     if management is not None:

@@ -18,6 +18,12 @@ Prometheus: retained metric history. Seven pieces:
 - ``flight_recorder``: per-partition bounded event rings (role changes,
   errors, backpressure, flush stalls, exporter transitions, batch
   summaries), dumped to ``<data-dir>/flight-<ts>.json`` on crash/unhealthy.
+- ``auditor``: the fleet auditor (PR 20) — online invariant monitors
+  (position monotonicity, exporter gaplessness, quarantine-latch bounds,
+  replica-CRC spot checkpoints), multi-window SLO burn-rate alerting
+  layered on ``alerts``, and windowed least-squares leak-trend detection
+  over process resources; per-broker off the sampler tick, cross-worker
+  via the status push (``ClusterAuditor``).
 - ``alerts``: threshold + for-duration rules over the time-series store
   (default set: lag / backpressure / flush latency / role flapping /
   XLA recompile storms), surfaced in ``/health`` and the
@@ -44,6 +50,13 @@ from zeebe_tpu.observability.alerts import (
     AlertEvaluator,
     AlertRule,
     default_rules,
+)
+from zeebe_tpu.observability.auditor import (
+    AuditorCfg,
+    BrokerAuditor,
+    BurnRateTracker,
+    ClusterAuditor,
+    TrendDetector,
 )
 from zeebe_tpu.observability.critical_path import (
     EDGES,
@@ -90,7 +103,11 @@ __all__ = [
     "AlertEvaluator",
     "AlertProfileCapture",
     "AlertRule",
+    "AuditorCfg",
+    "BrokerAuditor",
+    "BurnRateTracker",
     "CaptureInFlight",
+    "ClusterAuditor",
     "ContinuousProfiler",
     "DeterministicSampler",
     "DeviceTraceCapture",
@@ -101,6 +118,7 @@ __all__ = [
     "SpanCollector",
     "TimeSeriesStore",
     "Tracer",
+    "TrendDetector",
     "acquire_profiler",
     "aggregate_breakdowns",
     "assemble",

@@ -153,6 +153,16 @@ class AlertEvaluator:
             r.name: _M_FIRING.labels(node_id, r.name) for r in self.rules
         }
 
+    def add_rules(self, rules: list[AlertRule]) -> None:
+        """Layer extra rules onto a live evaluator (the fleet auditor's
+        burn-rate pair) — the per-rule firing gauge must exist before the
+        next ``evaluate`` sweep, so appending to ``rules`` directly is not
+        enough."""
+        for rule in rules:
+            self.rules.append(rule)
+            self._gauges.setdefault(
+                rule.name, _M_FIRING.labels(self.node_id, rule.name))
+
     # -- evaluation ------------------------------------------------------------
 
     def _breaches(self, rule: AlertRule, value: float) -> bool:

@@ -76,6 +76,17 @@ class FlightRecorder:
             ring.append(event)
             self.events_recorded += 1
 
+    def occupancy(self) -> float:
+        """Mean fill ratio across the live rings (0..1) — the fleet
+        auditor's ``zeebe_flight_ring_occupancy_ratio`` source. Bounded
+        rings saturate at 1.0 by design; the leak trend watches the CLIMB
+        toward it, not the ceiling."""
+        with self._lock:
+            if not self._rings or self.capacity <= 0:
+                return 0.0
+            return sum(len(r) for r in self._rings.values()) / (
+                len(self._rings) * self.capacity)
+
     def snapshot(self) -> dict:
         with self._lock:
             rings = {str(pid): list(ring)

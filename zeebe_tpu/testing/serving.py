@@ -169,6 +169,24 @@ def tenant_rate_fn(spec: TenantSpec, phase_a_s: float,
     return rate
 
 
+def drain_arrival_queue(arrivals: "queue.Queue",
+                        stop: threading.Event,
+                        submit: Callable[[float, str], None]) -> None:
+    """The client-stream body shared by the serving and fleet-day
+    harnesses: drain due ``(at_ms, tenant)`` arrivals and submit each,
+    never waiting on another stream's request. ``None`` drains the
+    stream; ``stop`` abandons whatever is still queued."""
+    while not stop.is_set():
+        try:
+            item = arrivals.get(timeout=0.2)
+        except queue.Empty:
+            continue
+        if item is None:
+            return
+        at_ms, tenant = item
+        submit(at_ms, tenant)
+
+
 def build_schedule(cfg: ServingConfig) -> list[tuple[float, str]]:
     """The merged ``(at_s, tenant)`` arrival schedule for the whole drive,
     sorted by time; one independent seeded stream per tenant."""
@@ -629,20 +647,14 @@ def run_serving(cfg: ServingConfig, directory: str | Path) -> dict:
     arrivals: "queue.Queue[tuple[float, str] | None]" = queue.Queue()
     stop_streams = threading.Event()
 
+    def submit_create(at_ms: float, tenant: str) -> None:
+        op = new_op(tenant, "create",
+                    runtime.partition_for_new_instance(), at_ms)
+        execute(op, create_cmd(tenant))
+
     def client_stream() -> None:
-        """One of the hundreds of concurrent client streams: drain due
-        arrivals and submit, never waiting on another stream's request."""
-        while not stop_streams.is_set():
-            try:
-                item = arrivals.get(timeout=0.2)
-            except queue.Empty:
-                continue
-            if item is None:
-                return
-            at_ms, tenant = item
-            op = new_op(tenant, "create",
-                        runtime.partition_for_new_instance(), at_ms)
-            execute(op, create_cmd(tenant))
+        """One of the hundreds of concurrent client streams."""
+        drain_arrival_queue(arrivals, stop_streams, submit_create)
 
     def scheduler() -> None:
         """The open-loop clock: release each arrival AT its scheduled time

@@ -11,6 +11,7 @@ exposition format.
 from __future__ import annotations
 
 import bisect
+import os
 import threading
 from typing import Callable, Iterable
 
@@ -369,13 +370,31 @@ def install_process_metrics(registry: MetricsRegistry | None = None) -> None:
     gc_uncollectable = reg.gauge(
         "python_gc_objects_uncollectable_total",
         "Uncollectable objects found during GC", ("generation",), raw=True)
+    # zeebe-namespaced process gauges (ISSUE 20): the fleet auditor's
+    # leak-trend detectors read these off the sampler tick, so they ride
+    # the normal zeebe_ namespace and land in the time-series store
+    proc_rss = reg.gauge(
+        "process_rss_bytes",
+        "resident set size of this process (bytes), from /proc/self with "
+        "an ru_maxrss fallback")
+    proc_fds = reg.gauge(
+        "process_fd_count",
+        "open file descriptors of this process (0 where /proc/self/fd is "
+        "unavailable)")
+    proc_threads = reg.gauge(
+        "process_thread_count",
+        "live threads in this process")
 
     def refresh() -> None:
         ru = resource.getrusage(resource.RUSAGE_SELF)
         # counters are cumulative by contract: assign, don't inc — rusage is
         # already the monotonic total
         cpu._default().value = ru.ru_utime + ru.ru_stime
-        rss.set(_read_rss_bytes())
+        rss_bytes = _read_rss_bytes()
+        rss.set(rss_bytes)
+        proc_rss.set(rss_bytes)
+        proc_fds.set(float(read_fd_count()))
+        proc_threads.set(float(read_thread_count()))
         for gen, stats in enumerate(gc.get_stats()):
             g = str(gen)
             gc_collections.labels(g).value = float(stats.get("collections", 0))
@@ -385,3 +404,27 @@ def install_process_metrics(registry: MetricsRegistry | None = None) -> None:
 
     reg.add_collect_hook(refresh)
     refresh()
+
+
+def read_fd_count() -> int:
+    """Open file descriptors of this process — ``/proc/self/fd`` on Linux,
+    gracefully 0 elsewhere (the trend detector treats a constant 0 as a
+    flat line, never a leak)."""
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return 0
+
+
+def read_thread_count() -> int:
+    """Live threads in this process. ``threading.active_count`` only sees
+    threads started through :mod:`threading`, so prefer the kernel's count
+    from ``/proc/self/status`` when available."""
+    try:
+        with open("/proc/self/status", "rb") as fh:
+            for line in fh:
+                if line.startswith(b"Threads:"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    return threading.active_count()
